@@ -1,0 +1,205 @@
+"""Typed configuration registry.
+
+Re-design of the reference's layered config system:
+``core/src/main/scala/org/apache/spark/SparkConf.scala`` (string k/v map) +
+``internal/config/ConfigBuilder.scala`` / ``ConfigEntry.scala`` (typed entries
+with defaults, validators, fallbacks) + the session-mutable
+``sql/catalyst/.../internal/SQLConf.scala``.
+
+One mechanism serves both roles here: a global registry of ``ConfigEntry``
+objects, with ``Conf`` instances (per-session) holding string overrides.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+_REGISTRY: Dict[str, "ConfigEntry"] = {}
+
+
+class ConfigEntry(Generic[T]):
+    def __init__(self, key: str, default: T, value_type: type,
+                 doc: str = "", validator: Optional[Callable[[T], bool]] = None,
+                 fallback: Optional["ConfigEntry"] = None):
+        self.key = key
+        self.default = default
+        self.value_type = value_type
+        self.doc = doc
+        self.validator = validator
+        self.fallback = fallback
+        if key in _REGISTRY:
+            raise ValueError(f"duplicate config key {key}")
+        _REGISTRY[key] = self
+
+    def parse(self, raw: Any) -> T:
+        if isinstance(raw, str):
+            if self.value_type is bool:
+                low = raw.strip().lower()
+                if low in ("true", "1", "yes"):
+                    v = True
+                elif low in ("false", "0", "no"):
+                    v = False
+                else:
+                    raise ValueError(f"invalid boolean {raw!r} for config {self.key}")
+            elif self.value_type in (int, float):
+                v = self.value_type(raw.strip())
+            else:
+                v = raw
+        else:
+            v = self.value_type(raw) if raw is not None else raw
+        if self.validator is not None and not self.validator(v):
+            raise ValueError(f"invalid value {v!r} for config {self.key}")
+        return v  # type: ignore[return-value]
+
+
+class ConfigBuilder:
+    """Fluent builder mirroring ``ConfigBuilder.scala``."""
+
+    def __init__(self, key: str):
+        self.key = key
+        self._doc = ""
+        self._validator: Optional[Callable] = None
+        self._fallback: Optional[ConfigEntry] = None
+
+    def doc(self, text: str) -> "ConfigBuilder":
+        self._doc = text
+        return self
+
+    def check(self, fn: Callable[[Any], bool]) -> "ConfigBuilder":
+        self._validator = fn
+        return self
+
+    def fallback(self, entry: ConfigEntry) -> "ConfigBuilder":
+        self._fallback = entry
+        return self
+
+    def _make(self, default, value_type) -> ConfigEntry:
+        return ConfigEntry(self.key, default, value_type, self._doc,
+                           self._validator, self._fallback)
+
+    def boolean(self, default: bool) -> ConfigEntry:
+        return self._make(default, bool)
+
+    def int(self, default: int) -> ConfigEntry:
+        return self._make(default, int)
+
+    def float(self, default: float) -> ConfigEntry:
+        return self._make(default, float)
+
+    def string(self, default: Optional[str]) -> ConfigEntry:
+        return self._make(default, str)
+
+
+def conf(key: str) -> ConfigBuilder:
+    return ConfigBuilder(key)
+
+
+class Conf:
+    """A mutable configuration: overrides on top of registered defaults.
+
+    Plays both the ``SparkConf`` role (cloned into the session) and the
+    ``SQLConf``/``RuntimeConfig`` role (``session.conf.set(...)``).
+    """
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None):
+        self._overrides: Dict[str, Any] = dict(overrides or {})
+
+    def clone(self) -> "Conf":
+        return Conf(self._overrides)
+
+    def set(self, key_or_entry, value: Any) -> "Conf":
+        key = key_or_entry.key if isinstance(key_or_entry, ConfigEntry) else key_or_entry
+        self._overrides[key] = value
+        return self
+
+    def unset(self, key: str) -> None:
+        self._overrides.pop(key, None)
+
+    def get(self, key_or_entry, default: Any = None) -> Any:
+        if isinstance(key_or_entry, ConfigEntry):
+            entry = key_or_entry
+        else:
+            entry = _REGISTRY.get(key_or_entry)
+            if entry is None:
+                return self._overrides.get(key_or_entry, default)
+        if entry.key in self._overrides:
+            return entry.parse(self._overrides[entry.key])
+        if entry.fallback is not None and entry.fallback.key in self._overrides:
+            return self.get(entry.fallback)
+        return entry.default
+
+    def __getitem__(self, entry: ConfigEntry) -> Any:
+        return self.get(entry)
+
+    def items(self):
+        return dict(self._overrides).items()
+
+
+def registered_entries() -> List[ConfigEntry]:
+    return list(_REGISTRY.values())
+
+
+# ---------------------------------------------------------------------------
+# Core entries (analogs of internal/config/package.scala + SQLConf.scala)
+# ---------------------------------------------------------------------------
+
+APP_NAME = conf("spark.app.name").doc("Application name.").string("spark-tpu")
+
+MASTER = conf("spark.master").doc(
+    "Execution target: local[*] (host CPU backend), tpu (single process, all "
+    "local devices in one mesh)."
+).string("tpu")
+
+DEFAULT_PARALLELISM = conf("spark.default.parallelism").doc(
+    "Default number of partitions for RDDs and shuffles."
+).int(8)
+
+SHUFFLE_PARTITIONS = conf("spark.sql.shuffle.partitions").doc(
+    "Number of logical shuffle buckets for exchanges (SQLConf analog)."
+).int(8)
+
+BATCH_CAPACITY = conf("spark.sql.execution.batch.capacity").doc(
+    "Default device batch row capacity (padded, static shape). Analog of "
+    "spark.sql.inMemoryColumnarStorage.batchSize / ColumnarBatch capacity."
+).int(1 << 16)
+
+AUTO_BROADCAST_JOIN_THRESHOLD = conf("spark.sql.autoBroadcastJoinThreshold").doc(
+    "Max estimated row count of a relation that will be broadcast for joins "
+    "(reference uses bytes, SQLConf autoBroadcastJoinThreshold; rows here "
+    "because columnar batches make row counts the natural stat)."
+).int(1 << 22)
+
+JOIN_OUTPUT_FACTOR = conf("spark.sql.join.outputCapacityFactor").doc(
+    "Static output capacity of an equi-join as a multiple of the probe-side "
+    "capacity; overflow is detected and reported (dynamic-shape escape hatch)."
+).float(1.0)
+
+EXCHANGE_SKEW_FACTOR = conf("spark.sql.exchange.skewFactor").doc(
+    "Per-destination bucket capacity of an all_to_all exchange as a multiple "
+    "of the even split (capacity/num_shards); overflow detected at runtime."
+).float(4.0)
+
+ADAPTIVE_ENABLED = conf("spark.sql.adaptive.enabled").doc(
+    "Coalesce small post-exchange partitions (ExchangeCoordinator analog)."
+).boolean(True)
+
+CODEGEN_ENABLED = conf("spark.sql.codegen.wholeStage").doc(
+    "Fuse operator pipelines into a single jitted XLA program (WholeStage"
+    "Codegen analog). Off = eager per-op numpy execution (debug path)."
+).boolean(True)
+
+CASE_SENSITIVE = conf("spark.sql.caseSensitive").boolean(False)
+
+SESSION_TIME_ZONE = conf("spark.sql.session.timeZone").string("UTC")
+
+SPECULATION = conf("spark.speculation").boolean(False)
+
+MAX_RESULT_ROWS = conf("spark.driver.maxResultRows").doc(
+    "Safety cap on collect() row counts (maxResultSize analog)."
+).int(1 << 26)
+
+EAGER_EVAL = conf("spark.sql.repl.eagerEval.enabled").boolean(False)
+
+CROSS_JOIN_ENABLED = conf("spark.sql.crossJoin.enabled").boolean(True)

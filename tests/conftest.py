@@ -1,0 +1,28 @@
+"""Test bootstrap: force a virtual 8-device CPU platform BEFORE jax import.
+
+Mirrors the reference's `local-cluster[N,...]` testing trick
+(`core/src/main/scala/org/apache/spark/deploy/LocalSparkCluster.scala:36`):
+distributed code paths are exercised in-process on N virtual devices.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def spark():
+    """Shared session (SharedSparkContext/SharedSQLContext analog)."""
+    from spark_tpu.sql.session import SparkSession
+    return SparkSession.builder.appName("tests").getOrCreate()

@@ -1,0 +1,164 @@
+"""ColumnBatch / type-system unit tests."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+
+from spark_tpu import types as T
+from spark_tpu.columnar import (
+    ColumnBatch, encode_strings, merge_dictionaries, pad_capacity,
+)
+
+
+def test_pad_capacity():
+    assert pad_capacity(0) == 8
+    assert pad_capacity(8) == 8
+    assert pad_capacity(9) == 16
+    assert pad_capacity(1000) == 1024
+
+
+def test_type_names():
+    assert T.type_for_name("bigint") is T.int64
+    assert T.type_for_name("string") is T.string
+    d = T.type_for_name("decimal(12,2)")
+    assert d.precision == 12 and d.scale == 2
+    with pytest.raises(ValueError):
+        T.type_for_name("blob")
+
+
+def test_numeric_promotion():
+    assert T.numeric_promote(T.int32, T.int64) is T.int64
+    assert T.numeric_promote(T.int64, T.float32) is T.float64
+    assert T.numeric_promote(T.int8, T.float32) is T.float32
+    assert T.common_type(T.null_type, T.int32) is T.int32
+    assert T.common_type(T.string, T.string) is T.string
+
+
+def test_encode_strings_sorted_order():
+    codes, d = encode_strings(["pear", "apple", None, "apple", "fig"])
+    assert d == ("apple", "fig", "pear")
+    assert codes.tolist() == [2, 0, -1, 0, 1]
+    # sorted dictionary ⇒ code comparisons == string comparisons
+    assert (codes[0] > codes[1]) == ("pear" > "apple")
+
+
+def test_merge_dictionaries():
+    merged, ra, rb = merge_dictionaries(("a", "c"), ("b", "c"))
+    assert merged == ("a", "b", "c")
+    assert ra.tolist() == [0, 2]
+    assert rb.tolist() == [1, 2]
+
+
+def test_from_arrays_roundtrip():
+    b = ColumnBatch.from_arrays({
+        "id": np.arange(5, dtype=np.int64),
+        "name": ["e", "d", None, "b", "a"],
+        "score": np.array([1.5, np.nan, 3.0, 4.0, 5.0]),
+    })
+    assert b.capacity == 8
+    assert int(np.asarray(b.num_rows())) == 5
+    assert b.schema.names == ["id", "name", "score"]
+    assert b.column("name").dtype is T.string
+    rows = b.to_pylist()
+    assert rows[0] == (0, "e", 1.5)
+    assert rows[1][2] is None  # NaN → NULL
+    assert rows[2][1] is None
+
+
+def test_from_pandas_roundtrip():
+    df = pd.DataFrame({"x": [1, 2, 3], "s": ["b", None, "a"]})
+    b = ColumnBatch.from_pandas(df)
+    out = b.to_pandas()
+    assert out["x"].tolist() == [1, 2, 3]
+    vals = out["s"].tolist()
+    assert vals[0] == "b" and vals[2] == "a" and pd.isna(vals[1])
+
+
+def test_decimal_and_dates():
+    import datetime
+    b = ColumnBatch.from_arrays(
+        {"d": [datetime.date(2020, 1, 1), None],
+         "m": [1.25, 2.50]},
+        schema=T.StructType([
+            T.StructField("d", T.date),
+            T.StructField("m", T.DecimalType(10, 2)),
+        ]),
+    )
+    rows = b.to_pylist()
+    assert rows[0][0] == datetime.date(2020, 1, 1)
+    assert rows[1][0] is None
+    assert rows[0][1] == 1.25
+
+
+def test_pytree_roundtrip_under_jit():
+    b = ColumnBatch.from_arrays({
+        "id": np.arange(4, dtype=np.int64),
+        "s": ["x", "y", None, "x"],
+    }).to_device()
+
+    @jax.jit
+    def bump(batch):
+        vec = batch.column("id")
+        out = vec.with_data(vec.data + 1)
+        return batch.with_columns(batch.names, [out, batch.column("s")])
+
+    out = bump(b)
+    assert out.column("s").dictionary == ("x", "y")
+    assert np.asarray(out.column("id").data)[:4].tolist() == [1, 2, 3, 4]
+    # second call hits the jit cache (same treedef incl. dictionaries)
+    out2 = bump(out)
+    assert np.asarray(out2.column("id").data)[0] == 2
+
+
+def test_empty_batch():
+    schema = T.StructType([T.StructField("a", T.int64), T.StructField("s", T.string)])
+    b = ColumnBatch.empty(schema)
+    assert b.to_pylist() == []
+
+
+def test_conf_registry():
+    from spark_tpu import config as C
+    conf = C.Conf()
+    assert conf.get(C.SHUFFLE_PARTITIONS) == 8
+    conf.set("spark.sql.shuffle.partitions", "16")
+    assert conf.get(C.SHUFFLE_PARTITIONS) == 16
+    conf.set(C.ADAPTIVE_ENABLED, "false")
+    assert conf.get(C.ADAPTIVE_ENABLED) is False
+    assert conf.get("unknown.key", "dflt") == "dflt"
+
+
+def test_review_regressions():
+    """Fixes from the initial code review (date coercion, decimal ndarray
+    ingest, binary bytes, pd.NA, capacity validation, strict booleans)."""
+    import datetime
+    import pandas as pd
+
+    assert T.common_type(T.date, T.timestamp) is T.timestamp
+    assert T.common_type(T.date, T.int32) is None
+
+    b = ColumnBatch.from_arrays(
+        {"m": np.array([1.25])},
+        schema=T.StructType([T.StructField("m", T.DecimalType(10, 2))]))
+    assert b.to_pylist()[0][0] == 1.25
+
+    b2 = ColumnBatch.from_arrays(
+        {"d": np.array(["2020-01-02"], dtype="datetime64[D]")},
+        schema=T.StructType([T.StructField("d", T.date)]))
+    assert b2.to_pylist()[0][0] == datetime.date(2020, 1, 2)
+
+    b3 = ColumnBatch.from_arrays({"b": [b"ab", None]})
+    assert b3.to_pylist()[0][0] == b"ab"
+    v = b3.column("b")
+    v.with_data(v.data, valid=np.ones(8, bool))  # ndarray mask must not crash
+
+    b4 = ColumnBatch.from_pandas(pd.DataFrame({"s": pd.array(["a", None], dtype="string")}))
+    assert b4.to_pylist()[1][0] is None
+
+    with pytest.raises(ValueError):
+        ColumnBatch.from_arrays({"x": np.arange(10)}, capacity=8)
+
+    from spark_tpu.config import Conf, ADAPTIVE_ENABLED
+    with pytest.raises(ValueError):
+        Conf().set(ADAPTIVE_ENABLED, "ture").get(ADAPTIVE_ENABLED)
