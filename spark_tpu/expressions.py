@@ -1,0 +1,1320 @@
+"""Expression IR with dual-path evaluation.
+
+The analog of Catalyst's expression tree
+(``sql/catalyst/.../expressions/Expression.scala``), redesigned for XLA:
+
+* every expression evaluates VECTORIZED over a whole ColumnBatch — there is
+  no row-at-a-time path at all;
+* ``eval(ctx)`` is written against an array-module ``ctx.xp`` that is either
+  numpy (interpreted/host path) or jax.numpy (traced path).  Running the same
+  code under ``jax.jit`` IS the codegen path — XLA plays Janino
+  (``codegen/CodeGenerator.scala:905``) — and the numpy run is the
+  interpreted oracle, preserving the reference's dual-path testing pattern
+  (``ExpressionEvalHelper`` cross-checks eval vs codegen);
+* NULLs are validity masks threaded through every operator, with Kleene
+  three-valued logic for AND/OR (reference ``expressions/predicates.scala``);
+* string expressions are DICTIONARY transforms: the host rewrites the (small)
+  sorted dictionary and the device only gathers/remaps int32 codes.  This is
+  the TPU replacement for ``UTF8String.java`` byte-twiddling.
+
+Aggregate functions live in ``spark_tpu.aggregates``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import types as T
+from .columnar import ColumnBatch, encode_strings
+
+__all__ = [
+    "ExprValue", "EvalContext", "Expression", "Col", "Literal", "Alias",
+    "Cast", "Add", "Sub", "Mul", "Div", "IntDiv", "Mod", "Pow", "Neg",
+    "UnaryMath", "RoundExpr", "EQ", "NE", "LT", "LE", "GT", "GE", "EqNullSafe",
+    "And", "Or", "Not", "IsNull", "IsNotNull", "IsNaN", "Coalesce", "If",
+    "CaseWhen", "In", "Between", "StringPredicate", "StringTransform",
+    "StringLength", "Concat", "Substring", "ExtractDatePart", "Hash64",
+    "Greatest", "Least", "lit", "col", "AnalysisException",
+]
+
+
+class AnalysisException(Exception):
+    """Resolution/type error (reference ``sql/AnalysisException.scala``)."""
+
+
+class ExprValue(NamedTuple):
+    """A vectorized value: data array (+ scalar broadcastable), optional
+    validity mask (None = no NULLs), optional string dictionary."""
+
+    data: Any
+    valid: Optional[Any]
+    dictionary: Optional[Tuple] = None
+
+
+def and_valid(xp, a: Optional[Any], b: Optional[Any]) -> Optional[Any]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+class EvalContext:
+    """Evaluation environment: a ColumnBatch plus the array module.
+
+    ``xp`` is numpy for the interpreted path, jax.numpy inside jit traces.
+    """
+
+    def __init__(self, batch: ColumnBatch, xp):
+        self.batch = batch
+        self.xp = xp
+        self.capacity = batch.capacity
+
+    def col(self, name: str) -> ExprValue:
+        vec = self.batch.column(name)
+        return ExprValue(vec.data, vec.valid, vec.dictionary)
+
+    def broadcast(self, value: ExprValue) -> ExprValue:
+        """Materialize scalars to full capacity (project output)."""
+        data = value.data
+        if getattr(data, "shape", ()) == ():
+            data = self.xp.broadcast_to(data, (self.capacity,))
+        elif not hasattr(data, "shape"):
+            data = self.xp.full((self.capacity,), data)
+        valid = value.valid
+        if valid is not None and getattr(valid, "shape", ()) == ():
+            valid = self.xp.broadcast_to(valid, (self.capacity,))
+        return ExprValue(data, valid, value.dictionary)
+
+
+class Expression:
+    """Base expression node: typed, vectorized, rewritable."""
+
+    children: Tuple["Expression", ...] = ()
+
+    # -- analysis ---------------------------------------------------------
+    def data_type(self, schema: T.StructType) -> T.DataType:
+        raise NotImplementedError
+
+    def references(self) -> set:
+        out = set()
+        for c in self.children:
+            out |= c.references()
+        return out
+
+    @property
+    def foldable(self) -> bool:
+        return bool(self.children) and all(c.foldable for c in self.children)
+
+    def map_children(self, fn: Callable[["Expression"], "Expression"]) -> "Expression":
+        """Rebuild this node with transformed children (rule rewrites)."""
+        if not self.children:
+            return self
+        import copy
+        new = copy.copy(self)
+        new.children = tuple(fn(c) for c in self.children)
+        return new
+
+    def transform_up(self, fn) -> "Expression":
+        node = self.map_children(lambda c: c.transform_up(fn))
+        return fn(node)
+
+    # -- execution --------------------------------------------------------
+    def eval(self, ctx: EvalContext) -> ExprValue:
+        raise NotImplementedError
+
+    # -- display ----------------------------------------------------------
+    @property
+    def name(self) -> str:
+        """Auto-generated output column name (Catalyst ``toString``)."""
+        return repr(self)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        args = ", ".join(repr(c) for c in self.children)
+        return f"{type(self).__name__.lower()}({args})"
+
+    # -- sugar (the user-facing Column API builds on these) ---------------
+    def __add__(self, o): return Add(self, _wrap(o))
+    def __radd__(self, o): return Add(_wrap(o), self)
+    def __sub__(self, o): return Sub(self, _wrap(o))
+    def __rsub__(self, o): return Sub(_wrap(o), self)
+    def __mul__(self, o): return Mul(self, _wrap(o))
+    def __rmul__(self, o): return Mul(_wrap(o), self)
+    def __truediv__(self, o): return Div(self, _wrap(o))
+    def __rtruediv__(self, o): return Div(_wrap(o), self)
+    def __mod__(self, o): return Mod(self, _wrap(o))
+    def __neg__(self): return Neg(self)
+    def __eq__(self, o): return EQ(self, _wrap(o))  # type: ignore[override]
+    def __ne__(self, o): return NE(self, _wrap(o))  # type: ignore[override]
+    def __lt__(self, o): return LT(self, _wrap(o))
+    def __le__(self, o): return LE(self, _wrap(o))
+    def __gt__(self, o): return GT(self, _wrap(o))
+    def __ge__(self, o): return GE(self, _wrap(o))
+    def __and__(self, o): return And(self, _wrap(o))
+    def __or__(self, o): return Or(self, _wrap(o))
+    def __invert__(self): return Not(self)
+    def __hash__(self):  # __eq__ is overloaded; identity hash keeps sets working
+        return id(self)
+
+
+def _wrap(v: Any) -> Expression:
+    return v if isinstance(v, Expression) else Literal(v)
+
+
+def lit(v: Any) -> Expression:
+    return _wrap(v)
+
+
+def col(name: str) -> "Col":
+    return Col(name)
+
+
+# ---------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------
+
+class Col(Expression):
+    """Column reference (``AttributeReference`` after resolution)."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def foldable(self) -> bool:
+        return False
+
+    def data_type(self, schema: T.StructType) -> T.DataType:
+        try:
+            return schema[self._name].dataType
+        except KeyError:
+            raise AnalysisException(
+                f"cannot resolve column '{self._name}' among ({', '.join(schema.names)})")
+
+    def references(self) -> set:
+        return {self._name}
+
+    def eval(self, ctx: EvalContext) -> ExprValue:
+        return ctx.col(self._name)
+
+    def __repr__(self) -> str:
+        return self._name
+
+
+class Literal(Expression):
+    def __init__(self, value: Any, dtype: Optional[T.DataType] = None):
+        self.value = value
+        self.dtype = dtype or T.infer_type(value)
+
+    @property
+    def foldable(self) -> bool:
+        return True
+
+    def data_type(self, schema: T.StructType) -> T.DataType:
+        return self.dtype
+
+    def eval(self, ctx: EvalContext) -> ExprValue:
+        xp = ctx.xp
+        if self.value is None:
+            return ExprValue(xp.zeros((), self.dtype.np_dtype),
+                             xp.zeros((), bool))
+        if self.dtype.is_string:
+            # a lone string literal: single-entry dictionary, code 0
+            return ExprValue(xp.zeros((), np.int32), None, (str(self.value),))
+        if isinstance(self.dtype, T.DecimalType):
+            scaled = int(round(float(self.value) * 10 ** self.dtype.scale))
+            return ExprValue(xp.asarray(scaled, dtype=np.int64), None)
+        if isinstance(self.dtype, T.DateType):
+            return ExprValue(xp.asarray(np.datetime64(self.value, "D").astype(np.int32)), None)
+        if isinstance(self.dtype, T.TimestampType):
+            return ExprValue(xp.asarray(np.datetime64(self.value, "us").astype(np.int64)), None)
+        return ExprValue(xp.asarray(self.value, dtype=self.dtype.np_dtype), None)
+
+    def __repr__(self) -> str:
+        return repr(self.value)
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, alias: str):
+        self.children = (child,)
+        self._alias = alias
+
+    @property
+    def name(self) -> str:
+        return self._alias
+
+    def data_type(self, schema):
+        return self.children[0].data_type(schema)
+
+    def eval(self, ctx):
+        return self.children[0].eval(ctx)
+
+    def __repr__(self) -> str:
+        return f"{self.children[0]!r} AS {self._alias}"
+
+
+# ---------------------------------------------------------------------------
+# Arithmetic (reference expressions/arithmetic.scala)
+# ---------------------------------------------------------------------------
+
+class BinaryArithmetic(Expression):
+    op_name = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def data_type(self, schema):
+        lt_, rt = (c.data_type(schema) for c in self.children)
+        if isinstance(lt_, T.NullType):
+            return rt
+        if isinstance(rt, T.NullType):
+            return lt_
+        return T.numeric_promote(lt_, rt)
+
+    def _compute(self, xp, a, b):
+        raise NotImplementedError
+
+    def eval(self, ctx: EvalContext) -> ExprValue:
+        xp = ctx.xp
+        l, r = (c.eval(ctx) for c in self.children)
+        dt = self.data_type(ctx.batch.schema)
+        a = l.data.astype(dt.np_dtype)
+        b = r.data.astype(dt.np_dtype)
+        return ExprValue(self._compute(xp, a, b), and_valid(xp, l.valid, r.valid))
+
+    def __repr__(self) -> str:
+        return f"({self.children[0]!r} {self.op_name} {self.children[1]!r})"
+
+
+class Add(BinaryArithmetic):
+    op_name = "+"
+    def _compute(self, xp, a, b): return a + b
+
+
+class Sub(BinaryArithmetic):
+    op_name = "-"
+    def _compute(self, xp, a, b): return a - b
+
+
+class Mul(BinaryArithmetic):
+    op_name = "*"
+    def _compute(self, xp, a, b): return a * b
+
+
+class Div(BinaryArithmetic):
+    """True division; x/0 → NULL (ANSI-off Spark semantics)."""
+
+    op_name = "/"
+
+    def data_type(self, schema):
+        dt = super().data_type(schema)
+        return dt if dt.is_fractional else T.float64
+
+    def eval(self, ctx: EvalContext) -> ExprValue:
+        xp = ctx.xp
+        l, r = (c.eval(ctx) for c in self.children)
+        dt = self.data_type(ctx.batch.schema)
+        zero = r.data == 0
+        a = l.data.astype(dt.np_dtype)
+        b = xp.where(zero, xp.ones((), r.data.dtype), r.data).astype(dt.np_dtype)
+        valid = and_valid(xp, and_valid(xp, l.valid, r.valid), ~zero)
+        return ExprValue(a / b, valid)
+
+
+class IntDiv(Div):
+    op_name = "div"
+
+    def data_type(self, schema):
+        return T.int64
+
+    def eval(self, ctx: EvalContext) -> ExprValue:
+        xp = ctx.xp
+        l, r = (c.eval(ctx) for c in self.children)
+        zero = r.data == 0
+        b = xp.where(zero, xp.ones((), r.data.dtype), r.data)
+        valid = and_valid(xp, and_valid(xp, l.valid, r.valid), ~zero)
+        return ExprValue((l.data // b).astype(np.int64), valid)
+
+
+class Mod(BinaryArithmetic):
+    op_name = "%"
+
+    def eval(self, ctx: EvalContext) -> ExprValue:
+        xp = ctx.xp
+        l, r = (c.eval(ctx) for c in self.children)
+        dt = self.data_type(ctx.batch.schema)
+        zero = r.data == 0
+        a = l.data.astype(dt.np_dtype)
+        b = xp.where(zero, xp.ones((), r.data.dtype), r.data).astype(dt.np_dtype)
+        valid = and_valid(xp, and_valid(xp, l.valid, r.valid), ~zero)
+        # Spark % keeps the sign of the dividend (Java semantics), i.e. fmod —
+        # not numpy's floored mod.
+        if dt.is_fractional:
+            res = xp.fmod(a, b)
+        else:
+            res = (xp.sign(a) * (xp.abs(a) % xp.abs(b))).astype(dt.np_dtype)
+        return ExprValue(res, valid)
+
+
+class Pow(BinaryArithmetic):
+    op_name = "pow"
+
+    def data_type(self, schema):
+        return T.float64
+
+    def _compute(self, xp, a, b):
+        return xp.power(a, b)
+
+
+class Neg(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def data_type(self, schema):
+        return self.children[0].data_type(schema)
+
+    def eval(self, ctx):
+        v = self.children[0].eval(ctx)
+        return ExprValue(-v.data, v.valid)
+
+    def __repr__(self):
+        return f"(- {self.children[0]!r})"
+
+
+class UnaryMath(Expression):
+    """sqrt/exp/log/sin/... — float64 elementwise fns (mathExpressions.scala).
+
+    Domain errors (log of ≤0, sqrt of <0) produce NULL like Spark's NaN→null
+    behavior is emulated by masking.
+    """
+
+    FNS = {
+        "sqrt": (lambda xp, x: xp.sqrt(xp.maximum(x, 0.0)), lambda xp, x: x >= 0),
+        "exp": (lambda xp, x: xp.exp(x), None),
+        "ln": (lambda xp, x: xp.log(xp.where(x > 0, x, 1.0)), lambda xp, x: x > 0),
+        "log10": (lambda xp, x: xp.log10(xp.where(x > 0, x, 1.0)), lambda xp, x: x > 0),
+        "log2": (lambda xp, x: xp.log2(xp.where(x > 0, x, 1.0)), lambda xp, x: x > 0),
+        "sin": (lambda xp, x: xp.sin(x), None),
+        "cos": (lambda xp, x: xp.cos(x), None),
+        "tan": (lambda xp, x: xp.tan(x), None),
+        "asin": (lambda xp, x: xp.arcsin(xp.clip(x, -1, 1)), lambda xp, x: xp.abs(x) <= 1),
+        "acos": (lambda xp, x: xp.arccos(xp.clip(x, -1, 1)), lambda xp, x: xp.abs(x) <= 1),
+        "atan": (lambda xp, x: xp.arctan(x), None),
+        "sinh": (lambda xp, x: xp.sinh(x), None),
+        "cosh": (lambda xp, x: xp.cosh(x), None),
+        "tanh": (lambda xp, x: xp.tanh(x), None),
+        "floor": (lambda xp, x: xp.floor(x), None),
+        "ceil": (lambda xp, x: xp.ceil(x), None),
+        "abs": (lambda xp, x: xp.abs(x), None),
+        "sign": (lambda xp, x: xp.sign(x), None),
+        "radians": (lambda xp, x: x * (math.pi / 180.0), None),
+        "degrees": (lambda xp, x: x * (180.0 / math.pi), None),
+    }
+
+    def __init__(self, fn: str, child: Expression):
+        assert fn in self.FNS, fn
+        self.fn = fn
+        self.children = (child,)
+
+    def data_type(self, schema):
+        if self.fn in ("floor", "ceil"):
+            return T.int64
+        if self.fn in ("abs", "sign"):
+            return self.children[0].data_type(schema)
+        return T.float64
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        if self.fn in ("abs", "sign"):
+            return ExprValue(xp.abs(v.data) if self.fn == "abs" else xp.sign(v.data), v.valid)
+        x = v.data.astype(np.float64)
+        fn, domain = self.FNS[self.fn]
+        out = fn(xp, x)
+        valid = v.valid
+        if domain is not None:
+            valid = and_valid(xp, valid, domain(xp, x))
+        if self.fn in ("floor", "ceil"):
+            out = out.astype(np.int64)
+        return ExprValue(out, valid)
+
+    def __repr__(self):
+        return f"{self.fn}({self.children[0]!r})"
+
+
+class RoundExpr(Expression):
+    def __init__(self, child: Expression, scale: int = 0):
+        self.children = (child,)
+        self.scale = scale
+
+    def data_type(self, schema):
+        dt = self.children[0].data_type(schema)
+        return dt if dt.is_numeric else T.float64
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        if not np.issubdtype(np.asarray(v.data).dtype if ctx.xp is np else v.data.dtype, np.floating):
+            return v
+        factor = 10.0 ** self.scale
+        # HALF_UP like Spark, not banker's rounding
+        out = xp.floor(xp.abs(v.data) * factor + 0.5) / factor * xp.sign(v.data)
+        return ExprValue(out, v.valid)
+
+    def __repr__(self):
+        return f"round({self.children[0]!r}, {self.scale})"
+
+
+# ---------------------------------------------------------------------------
+# Comparisons & boolean logic (reference expressions/predicates.scala)
+# ---------------------------------------------------------------------------
+
+def _comparison_operands(ctx: EvalContext, le: Expression, re_: Expression):
+    """Evaluate both sides coerced to a common comparable representation.
+
+    Strings compare by dictionary code, which is order-correct only when both
+    sides share a dictionary; a string literal vs a column is rewritten into
+    code space via searchsorted on the host dictionary (static under jit).
+    """
+    xp = ctx.xp
+    l, r = le.eval(ctx), re_.eval(ctx)
+    if l.dictionary is not None or r.dictionary is not None:
+        if l.dictionary is not None and r.dictionary is not None:
+            if l.dictionary == r.dictionary:
+                return l, r, True
+            if len(r.dictionary) == 1:  # literal side
+                word = r.dictionary[0]
+                idx = int(np.searchsorted(np.array(l.dictionary, dtype=object), word))
+                exact = idx < len(l.dictionary) and l.dictionary[idx] == word
+                # map literal into left's code space: for exact match use the
+                # code; otherwise use idx-0.5 boundary → encode by doubling
+                return (ExprValue(l.data * 2, l.valid, None),
+                        ExprValue(xp.asarray(idx * 2 if exact else idx * 2 - 1, np.int64),
+                                  r.valid, None), True)
+            if len(l.dictionary) == 1:
+                word = l.dictionary[0]
+                idx = int(np.searchsorted(np.array(r.dictionary, dtype=object), word))
+                exact = idx < len(r.dictionary) and r.dictionary[idx] == word
+                return (ExprValue(xp.asarray(idx * 2 if exact else idx * 2 - 1, np.int64),
+                                  l.valid, None),
+                        ExprValue(r.data * 2, r.valid, None), True)
+            raise AnalysisException(
+                "comparing string columns with different dictionaries requires "
+                "dictionary alignment (planner inserts AlignDictionaries)")
+        raise AnalysisException("cannot compare string with non-string")
+    return l, r, False
+
+
+class BinaryComparison(Expression):
+    op_name = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def data_type(self, schema):
+        lt_, rt = (c.data_type(schema) for c in self.children)
+        if T.common_type(lt_, rt) is None and not (lt_ == rt):
+            raise AnalysisException(f"cannot compare {lt_} and {rt}")
+        return T.boolean
+
+    def _compute(self, xp, a, b):
+        raise NotImplementedError
+
+    def eval(self, ctx: EvalContext) -> ExprValue:
+        xp = ctx.xp
+        l, r, is_str = _comparison_operands(ctx, *self.children)
+        if not is_str:
+            ct = T.common_type(self.children[0].data_type(ctx.batch.schema),
+                               self.children[1].data_type(ctx.batch.schema))
+            np_dt = (ct or T.float64).np_dtype
+            a, b = l.data.astype(np_dt), r.data.astype(np_dt)
+        else:
+            a, b = l.data, r.data
+        return ExprValue(self._compute(xp, a, b), and_valid(xp, l.valid, r.valid))
+
+    def __repr__(self):
+        return f"({self.children[0]!r} {self.op_name} {self.children[1]!r})"
+
+
+class EQ(BinaryComparison):
+    op_name = "="
+    def _compute(self, xp, a, b): return a == b
+
+
+class NE(BinaryComparison):
+    op_name = "!="
+    def _compute(self, xp, a, b): return a != b
+
+
+class LT(BinaryComparison):
+    op_name = "<"
+    def _compute(self, xp, a, b): return a < b
+
+
+class LE(BinaryComparison):
+    op_name = "<="
+    def _compute(self, xp, a, b): return a <= b
+
+
+class GT(BinaryComparison):
+    op_name = ">"
+    def _compute(self, xp, a, b): return a > b
+
+
+class GE(BinaryComparison):
+    op_name = ">="
+    def _compute(self, xp, a, b): return a >= b
+
+
+class EqNullSafe(BinaryComparison):
+    """<=> : NULL-safe equality, never NULL itself."""
+
+    op_name = "<=>"
+
+    def eval(self, ctx: EvalContext) -> ExprValue:
+        xp = ctx.xp
+        l, r, _ = _comparison_operands(ctx, *self.children)
+        lv = l.valid if l.valid is not None else xp.ones((), bool)
+        rv = r.valid if r.valid is not None else xp.ones((), bool)
+        eq = (l.data == r.data) & lv & rv
+        both_null = ~lv & ~rv
+        return ExprValue(eq | both_null, None)
+
+
+class And(Expression):
+    """Kleene AND: F & NULL = F, T & NULL = NULL."""
+
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    def data_type(self, schema):
+        return T.boolean
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        l, r = (c.eval(ctx) for c in self.children)
+        lv = l.valid if l.valid is not None else xp.ones((), bool)
+        rv = r.valid if r.valid is not None else xp.ones((), bool)
+        data = (l.data | ~lv) & (r.data | ~rv)  # null treated true, then masked
+        valid = (lv & rv) | (lv & ~l.data) | (rv & ~r.data)
+        if l.valid is None and r.valid is None:
+            valid = None
+        return ExprValue(data & (valid if valid is not None else True), valid)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} AND {self.children[1]!r})"
+
+
+class Or(Expression):
+    """Kleene OR: T | NULL = T, F | NULL = NULL."""
+
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    def data_type(self, schema):
+        return T.boolean
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        l, r = (c.eval(ctx) for c in self.children)
+        lv = l.valid if l.valid is not None else xp.ones((), bool)
+        rv = r.valid if r.valid is not None else xp.ones((), bool)
+        data = (l.data & lv) | (r.data & rv)
+        valid = (lv & rv) | (lv & l.data) | (rv & r.data)
+        if l.valid is None and r.valid is None:
+            valid = None
+        return ExprValue(data, valid)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} OR {self.children[1]!r})"
+
+
+class Not(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def data_type(self, schema):
+        return T.boolean
+
+    def eval(self, ctx):
+        v = self.children[0].eval(ctx)
+        return ExprValue(~v.data, v.valid)
+
+    def __repr__(self):
+        return f"(NOT {self.children[0]!r})"
+
+
+# ---------------------------------------------------------------------------
+# Null handling & conditionals (nullExpressions.scala, conditionalExpressions.scala)
+# ---------------------------------------------------------------------------
+
+class IsNull(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def data_type(self, schema):
+        return T.boolean
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        if v.valid is None:
+            return ExprValue(xp.zeros((), bool), None)
+        return ExprValue(~v.valid, None)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} IS NULL)"
+
+
+class IsNotNull(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def data_type(self, schema):
+        return T.boolean
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        if v.valid is None:
+            return ExprValue(xp.ones((), bool), None)
+        return ExprValue(v.valid, None)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} IS NOT NULL)"
+
+
+class IsNaN(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def data_type(self, schema):
+        return T.boolean
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        d = v.data
+        if not np.issubdtype(np.dtype(str(d.dtype)), np.floating):
+            return ExprValue(xp.zeros((), bool), None)
+        return ExprValue(xp.isnan(d), None)
+
+
+class Coalesce(Expression):
+    def __init__(self, *children):
+        self.children = tuple(children)
+
+    def data_type(self, schema):
+        out = T.null_type
+        for c in self.children:
+            nxt = T.common_type(out, c.data_type(schema))
+            if nxt is None:
+                raise AnalysisException("incompatible coalesce branches")
+            out = nxt
+        return out
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        dt = self.data_type(ctx.batch.schema)
+        vals = [c.eval(ctx) for c in self.children]
+        dicts = [v.dictionary for v in vals if v.dictionary is not None]
+        if dicts and not all(d == dicts[0] for d in dicts):
+            raise AnalysisException("coalesce over unaligned string dictionaries")
+        out = ExprValue(vals[-1].data.astype(dt.np_dtype), vals[-1].valid,
+                        dicts[0] if dicts else None)
+        for v in reversed(vals[:-1]):
+            if v.valid is None:
+                out = ExprValue(v.data.astype(dt.np_dtype), None, out.dictionary)
+            else:
+                taken_valid = out.valid if out.valid is not None else xp.ones((), bool)
+                out = ExprValue(
+                    xp.where(v.valid, v.data.astype(dt.np_dtype), out.data),
+                    v.valid | taken_valid, out.dictionary)
+        return out
+
+    def __repr__(self):
+        return f"coalesce({', '.join(map(repr, self.children))})"
+
+
+class If(Expression):
+    def __init__(self, pred, then, otherwise):
+        self.children = (pred, then, otherwise)
+
+    def data_type(self, schema):
+        t = T.common_type(self.children[1].data_type(schema),
+                          self.children[2].data_type(schema))
+        if t is None:
+            raise AnalysisException("IF branches have incompatible types")
+        return t
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        p, a, b = (c.eval(ctx) for c in self.children)
+        dt = self.data_type(ctx.batch.schema)
+        dicts = [v.dictionary for v in (a, b) if v.dictionary is not None]
+        if dicts and not all(d == dicts[0] for d in dicts):
+            raise AnalysisException("IF over unaligned string dictionaries")
+        cond = p.data & (p.valid if p.valid is not None else True)
+        data = xp.where(cond, a.data.astype(dt.np_dtype), b.data.astype(dt.np_dtype))
+        av = a.valid if a.valid is not None else xp.ones((), bool)
+        bv = b.valid if b.valid is not None else xp.ones((), bool)
+        valid = None if (a.valid is None and b.valid is None) else xp.where(cond, av, bv)
+        return ExprValue(data, valid, dicts[0] if dicts else None)
+
+    def __repr__(self):
+        p, a, b = self.children
+        return f"if({p!r}, {a!r}, {b!r})"
+
+
+class CaseWhen(Expression):
+    """CASE WHEN p1 THEN v1 ... ELSE d END — desugars to nested If at eval."""
+
+    def __init__(self, branches: Sequence[Tuple[Expression, Expression]],
+                 otherwise: Optional[Expression] = None):
+        self.branches = [(p, v) for p, v in branches]
+        self.otherwise = otherwise if otherwise is not None else Literal(None)
+        flat: List[Expression] = []
+        for p, v in self.branches:
+            flat += [p, v]
+        flat.append(self.otherwise)
+        self.children = tuple(flat)
+
+    def map_children(self, fn):
+        new_branches = [(fn(p), fn(v)) for p, v in self.branches]
+        return CaseWhen(new_branches, fn(self.otherwise))
+
+    def _as_if(self) -> Expression:
+        node: Expression = self.otherwise
+        for p, v in reversed(self.branches):
+            node = If(p, v, node)
+        return node
+
+    def data_type(self, schema):
+        return self._as_if().data_type(schema)
+
+    def eval(self, ctx):
+        return self._as_if().eval(ctx)
+
+    def __repr__(self):
+        parts = " ".join(f"WHEN {p!r} THEN {v!r}" for p, v in self.branches)
+        return f"CASE {parts} ELSE {self.otherwise!r} END"
+
+
+class In(Expression):
+    """`x IN (lit, lit, ...)` — ORs of equality, vectorized as isin."""
+
+    def __init__(self, child: Expression, values: Sequence[Any]):
+        self.children = (child,)
+        self.values = [v.value if isinstance(v, Literal) else v for v in values]
+
+    def data_type(self, schema):
+        return T.boolean
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        if v.dictionary is not None:
+            member = np.array([w in set(self.values) for w in v.dictionary], bool)
+            member = xp.asarray(member)
+            data = xp.where(v.data >= 0, member[xp.clip(v.data, 0, None)], False)
+            return ExprValue(data, v.valid)
+        acc = xp.zeros((), bool)
+        for val in self.values:
+            acc = acc | (v.data == val)
+        return ExprValue(acc, v.valid)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} IN {tuple(self.values)!r})"
+
+
+class Between(Expression):
+    def __init__(self, child, low, high):
+        self.children = (child, _wrap(low), _wrap(high))
+
+    def data_type(self, schema):
+        return T.boolean
+
+    def eval(self, ctx):
+        c, lo, hi = self.children
+        return And(GE(c, lo), LE(c, hi)).eval(ctx)
+
+
+class Greatest(Expression):
+    def __init__(self, *children):
+        self.children = tuple(children)
+
+    def data_type(self, schema):
+        out = self.children[0].data_type(schema)
+        for c in self.children[1:]:
+            out = T.numeric_promote(out, c.data_type(schema))
+        return out
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        dt = self.data_type(ctx.batch.schema)
+        vals = [c.eval(ctx) for c in self.children]
+        out = vals[0].data.astype(dt.np_dtype)
+        valid = vals[0].valid
+        for v in vals[1:]:
+            out = xp.maximum(out, v.data.astype(dt.np_dtype))
+            valid = and_valid(xp, valid, v.valid)
+        return ExprValue(out, valid)
+
+
+class Least(Greatest):
+    def eval(self, ctx):
+        xp = ctx.xp
+        dt = self.data_type(ctx.batch.schema)
+        vals = [c.eval(ctx) for c in self.children]
+        out = vals[0].data.astype(dt.np_dtype)
+        valid = vals[0].valid
+        for v in vals[1:]:
+            out = xp.minimum(out, v.data.astype(dt.np_dtype))
+            valid = and_valid(xp, valid, v.valid)
+        return ExprValue(out, valid)
+
+
+# ---------------------------------------------------------------------------
+# Cast (reference expressions/Cast.scala)
+# ---------------------------------------------------------------------------
+
+class Cast(Expression):
+    def __init__(self, child: Expression, to: T.DataType):
+        self.children = (child,)
+        self.to = to
+
+    def data_type(self, schema):
+        return self.to
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        src = self.children[0].data_type(ctx.batch.schema)
+        to = self.to
+        if src == to:
+            return v
+        if v.dictionary is not None:
+            # string → X: parse the dictionary on host, gather on device
+            if to.is_string:
+                return v
+            def parse(fn, default):
+                arr = []
+                ok = []
+                for w in v.dictionary:
+                    try:
+                        arr.append(fn(w)); ok.append(True)
+                    except (ValueError, TypeError):
+                        arr.append(default); ok.append(False)
+                return (xp.asarray(np.array(arr, to.np_dtype)),
+                        xp.asarray(np.array(ok, bool)))
+            if to.is_numeric:
+                if isinstance(to, T.DecimalType):
+                    table, ok = parse(lambda w: int(round(float(w) * 10 ** to.scale)), 0)
+                else:
+                    table, ok = parse(float if to.is_fractional else (lambda w: int(float(w))), 0)
+            elif isinstance(to, T.DateType):
+                table, ok = parse(lambda w: np.datetime64(w, "D").astype(np.int32), 0)
+            elif isinstance(to, T.TimestampType):
+                table, ok = parse(lambda w: np.datetime64(w, "us").astype(np.int64), 0)
+            elif isinstance(to, T.BooleanType):
+                table, ok = parse(lambda w: w.strip().lower() in ("true", "t", "1", "yes", "y"), False)
+            else:
+                raise AnalysisException(f"unsupported cast string→{to}")
+            codes = xp.clip(v.data, 0, None)
+            return ExprValue(table[codes], and_valid(xp, v.valid, ok[codes]))
+        if to.is_string:
+            raise AnalysisException(
+                "cast to string requires host materialization (non-jittable); "
+                "wrap in a HostCast at planning time")
+        if isinstance(src, T.DecimalType):
+            f = v.data.astype(np.float64) / (10 ** src.scale)
+            if isinstance(to, T.DecimalType):
+                return ExprValue(xp.round(f * 10 ** to.scale).astype(np.int64), v.valid)
+            return ExprValue(f.astype(to.np_dtype), v.valid)
+        if isinstance(to, T.DecimalType):
+            return ExprValue(xp.round(v.data.astype(np.float64) * 10 ** to.scale).astype(np.int64), v.valid)
+        if isinstance(src, T.DateType) and isinstance(to, T.TimestampType):
+            return ExprValue(v.data.astype(np.int64) * 86_400_000_000, v.valid)
+        if isinstance(src, T.TimestampType) and isinstance(to, T.DateType):
+            return ExprValue(xp.floor_divide(v.data, 86_400_000_000).astype(np.int32), v.valid)
+        if isinstance(to, T.BooleanType):
+            return ExprValue(v.data != 0, v.valid)
+        # numeric/bool → numeric: plain astype (truncating float→int like Spark)
+        return ExprValue(v.data.astype(to.np_dtype), v.valid)
+
+    def __repr__(self):
+        return f"CAST({self.children[0]!r} AS {self.to!r})"
+
+
+# ---------------------------------------------------------------------------
+# String expressions — dictionary transforms (stringExpressions.scala)
+# ---------------------------------------------------------------------------
+
+def _dict_gather(xp, table: np.ndarray, codes, valid):
+    t = xp.asarray(table)
+    return t[xp.clip(codes, 0, None)]
+
+
+class StringTransform(Expression):
+    """upper/lower/trim/reverse/...: host rewrites the dictionary, device
+    remaps codes.  The output dictionary is re-sorted so downstream
+    comparisons stay order-correct."""
+
+    FNS = {
+        "upper": str.upper,
+        "lower": str.lower,
+        "trim": str.strip,
+        "ltrim": str.lstrip,
+        "rtrim": str.rstrip,
+        "reverse": lambda s: s[::-1],
+        "initcap": lambda s: s.title(),
+    }
+
+    def __init__(self, fn: str, child: Expression):
+        assert fn in self.FNS
+        self.fn = fn
+        self.children = (child,)
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not ct.is_string:
+            raise AnalysisException(f"{self.fn} expects string, got {ct}")
+        return T.string
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        f = self.FNS[self.fn]
+        transformed = [f(w) for w in v.dictionary]
+        new_dict = tuple(sorted(set(transformed)))
+        pos = {w: i for i, w in enumerate(new_dict)}
+        remap = np.array([pos[w] for w in transformed], np.int32) if transformed else np.zeros(1, np.int32)
+        return ExprValue(_dict_gather(xp, remap, v.data, v.valid), v.valid, new_dict)
+
+    def __repr__(self):
+        return f"{self.fn}({self.children[0]!r})"
+
+
+class Substring(Expression):
+    """substring(s, pos, len) with static pos/len (1-based, Spark semantics)."""
+
+    def __init__(self, child: Expression, pos: int, length: int):
+        self.children = (child,)
+        self.pos = pos
+        self.length = length
+
+    def data_type(self, schema):
+        return T.string
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        start = self.pos - 1 if self.pos > 0 else self.pos
+        transformed = []
+        for w in v.dictionary:
+            s = w[start:] if start >= 0 else w[len(w) + start:]
+            transformed.append(s[:self.length])
+        new_dict = tuple(sorted(set(transformed)))
+        pos = {w: i for i, w in enumerate(new_dict)}
+        remap = np.array([pos[w] for w in transformed], np.int32) if transformed else np.zeros(1, np.int32)
+        return ExprValue(_dict_gather(xp, remap, v.data, v.valid), v.valid, new_dict)
+
+    def __repr__(self):
+        return f"substring({self.children[0]!r}, {self.pos}, {self.length})"
+
+
+class StringLength(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def data_type(self, schema):
+        return T.int32
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        lens = np.array([len(w) for w in v.dictionary], np.int32) if v.dictionary else np.zeros(1, np.int32)
+        return ExprValue(_dict_gather(xp, lens, v.data, v.valid), v.valid)
+
+    def __repr__(self):
+        return f"length({self.children[0]!r})"
+
+
+class StringPredicate(Expression):
+    """LIKE / startswith / endswith / contains / rlike: host evaluates the
+    predicate over the dictionary, device gathers a boolean."""
+
+    def __init__(self, kind: str, child: Expression, pattern: str):
+        assert kind in ("like", "startswith", "endswith", "contains", "rlike")
+        self.kind = kind
+        self.children = (child,)
+        self.pattern = pattern
+
+    def data_type(self, schema):
+        return T.boolean
+
+    def _matcher(self) -> Callable[[str], bool]:
+        import re as _re
+        if self.kind == "like":
+            # translate SQL LIKE to regex (% → .*, _ → .)
+            out = []
+            i = 0
+            p = self.pattern
+            while i < len(p):
+                ch = p[i]
+                if ch == "\\" and i + 1 < len(p):
+                    out.append(_re.escape(p[i + 1])); i += 2; continue
+                if ch == "%":
+                    out.append(".*")
+                elif ch == "_":
+                    out.append(".")
+                else:
+                    out.append(_re.escape(ch))
+                i += 1
+            rx = _re.compile("^" + "".join(out) + "$", _re.DOTALL)
+            return lambda s: rx.match(s) is not None
+        if self.kind == "rlike":
+            rx = _re.compile(self.pattern)
+            return lambda s: rx.search(s) is not None
+        if self.kind == "startswith":
+            return lambda s: s.startswith(self.pattern)
+        if self.kind == "endswith":
+            return lambda s: s.endswith(self.pattern)
+        return lambda s: self.pattern in s
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        m = self._matcher()
+        table = np.array([m(w) for w in v.dictionary], bool) if v.dictionary else np.zeros(1, bool)
+        return ExprValue(_dict_gather(xp, table, v.data, v.valid), v.valid)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} {self.kind} {self.pattern!r})"
+
+
+class Concat(Expression):
+    """concat of string columns/literals.
+
+    The output dictionary is the cross product of input dictionaries — fine
+    for low-cardinality columns, rejected above a size limit (the honest
+    dynamic-shape boundary; high-cardinality concat belongs on the host).
+    """
+
+    MAX_DICT = 1 << 20
+
+    def __init__(self, *children):
+        self.children = tuple(children)
+
+    def data_type(self, schema):
+        return T.string
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        vals = [c.eval(ctx) for c in self.children]
+        dicts = [v.dictionary if v.dictionary is not None else ("",) for v in vals]
+        size = 1
+        for d in dicts:
+            size *= max(len(d), 1)
+        if size > self.MAX_DICT:
+            raise AnalysisException(
+                f"concat dictionary blowup ({size}); use host path")
+        # pairwise fold: combine two dictionary-coded values at a time
+        cur = vals[0]
+        cur_dict = dicts[0]
+        for v, d in zip(vals[1:], dicts[1:]):
+            combined = [a + b for a in cur_dict for b in d]
+            new_dict = tuple(sorted(set(combined)))
+            pos = {w: i for i, w in enumerate(new_dict)}
+            remap = np.array([[pos[a + b] for b in d] for a in cur_dict], np.int32)
+            remap = remap if remap.size else np.zeros((1, 1), np.int32)
+            table = xp.asarray(remap)
+            code = table[xp.clip(cur.data, 0, None), xp.clip(v.data, 0, None)]
+            cur = ExprValue(code, and_valid(xp, cur.valid, v.valid), new_dict)
+            cur_dict = new_dict
+        return cur
+
+    def __repr__(self):
+        return f"concat({', '.join(map(repr, self.children))})"
+
+
+# ---------------------------------------------------------------------------
+# Datetime extraction (datetimeExpressions.scala)
+# ---------------------------------------------------------------------------
+
+class ExtractDatePart(Expression):
+    """year/month/day/... from date (days) or timestamp (micros) columns,
+    via Hinnant's civil-from-days integer algorithm — pure elementwise int
+    ops, so it fuses into the surrounding XLA program."""
+
+    PARTS = ("year", "month", "day", "dayofweek", "dayofyear", "quarter",
+             "hour", "minute", "second", "weekofyear")
+
+    def __init__(self, part: str, child: Expression):
+        assert part in self.PARTS, part
+        self.part = part
+        self.children = (child,)
+
+    def data_type(self, schema):
+        return T.int32
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        src = self.children[0].data_type(ctx.batch.schema)
+        if isinstance(src, T.TimestampType):
+            days = xp.floor_divide(v.data, 86_400_000_000)
+            micros_in_day = v.data - days * 86_400_000_000
+        elif isinstance(src, T.DateType):
+            days = v.data.astype(np.int64)
+            micros_in_day = xp.zeros((), np.int64)
+        else:
+            raise AnalysisException(f"cannot extract {self.part} from {src}")
+
+        if self.part == "hour":
+            return ExprValue((micros_in_day // 3_600_000_000).astype(np.int32), v.valid)
+        if self.part == "minute":
+            return ExprValue(((micros_in_day // 60_000_000) % 60).astype(np.int32), v.valid)
+        if self.part == "second":
+            return ExprValue(((micros_in_day // 1_000_000) % 60).astype(np.int32), v.valid)
+        if self.part == "dayofweek":
+            # Spark: 1 = Sunday. 1970-01-01 was a Thursday.
+            return ExprValue(((days + 4) % 7 + 1).astype(np.int32), v.valid)
+
+        # civil_from_days (Howard Hinnant, public domain algorithm)
+        z = days + 719_468
+        era = xp.floor_divide(z, 146_097)
+        doe = z - era * 146_097
+        yoe = (doe - doe // 1460 + doe // 36_524 - doe // 146_096) // 365
+        y = yoe + era * 400
+        doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+        mp = (5 * doy + 2) // 153
+        d = doy - (153 * mp + 2) // 5 + 1
+        m = xp.where(mp < 10, mp + 3, mp - 9)
+        y = xp.where(m <= 2, y + 1, y)
+        if self.part == "year":
+            return ExprValue(y.astype(np.int32), v.valid)
+        if self.part == "month":
+            return ExprValue(m.astype(np.int32), v.valid)
+        if self.part == "day":
+            return ExprValue(d.astype(np.int32), v.valid)
+        if self.part == "quarter":
+            return ExprValue(((m - 1) // 3 + 1).astype(np.int32), v.valid)
+        if self.part == "dayofyear":
+            jan1 = _days_from_civil(xp, y, 1, 1)
+            return ExprValue((days - jan1 + 1).astype(np.int32), v.valid)
+        if self.part == "weekofyear":
+            # ISO week number
+            dow = (days + 3) % 7  # 0 = Monday
+            thursday = days - dow + 3
+            z2 = thursday + 719_468
+            era2 = xp.floor_divide(z2, 146_097)
+            doe2 = z2 - era2 * 146_097
+            yoe2 = (doe2 - doe2 // 1460 + doe2 // 36_524 - doe2 // 146_096) // 365
+            iso_year = yoe2 + era2 * 400
+            doy2 = doe2 - (365 * yoe2 + yoe2 // 4 - yoe2 // 100)
+            mp2 = (5 * doy2 + 2) // 153
+            m2 = xp.where(mp2 < 10, mp2 + 3, mp2 - 9)
+            iso_year = xp.where(m2 <= 2, iso_year + 1, iso_year)
+            jan4 = _days_from_civil(xp, iso_year, 1, 4)
+            week1_mon = jan4 - (jan4 + 3) % 7
+            return ExprValue(((days - week1_mon) // 7 + 1).astype(np.int32), v.valid)
+        raise AssertionError(self.part)
+
+    def __repr__(self):
+        return f"{self.part}({self.children[0]!r})"
+
+
+def _days_from_civil(xp, y, m: int, d: int):
+    """Inverse of civil_from_days for an array of years y and static month/day."""
+    y = y - (1 if m <= 2 else 0)
+    era = xp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = (m + 9) % 12
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146_097 + doe - 719_468
+
+
+# ---------------------------------------------------------------------------
+# Hashing — bit-exact across hosts/devices for shuffle partitioning
+# ---------------------------------------------------------------------------
+
+class Hash64(Expression):
+    """Deterministic 64-bit mix hash (splitmix64 finalizer) of one or more
+    columns.  The role of ``Murmur3_x86_32`` (reference
+    ``unsafe/hash/Murmur3_x86_32.java``): agreement between partitioners on
+    every host/device, here guaranteed by identical integer ops in XLA/numpy.
+    NULL hashes to a fixed constant; string columns hash their dictionary
+    WORDS (host-side stable hash of the bytes), not codes, so the value is
+    independent of the batch dictionary."""
+
+    NULL_HASH = np.int64(0x9E3779B97F4A7C15 - (1 << 64))
+
+    def __init__(self, *children):
+        self.children = tuple(children)
+
+    def data_type(self, schema):
+        return T.int64
+
+    @staticmethod
+    def _mix(xp, x):
+        # murmur3/splitmix finalizer in uint64 (wraparound, logical shifts)
+        c1 = np.uint64(0xFF51AFD7ED558CCD)
+        c2 = np.uint64(0xC4CEB9FE1A85EC53)
+        x = xp.asarray(x).astype(np.uint64)
+        x = x ^ (x >> np.uint64(33))
+        x = x * c1
+        x = x ^ (x >> np.uint64(33))
+        x = x * c2
+        x = x ^ (x >> np.uint64(33))
+        return x.astype(np.int64)
+
+    @staticmethod
+    def _string_hash_table(dictionary: Tuple[str, ...]) -> np.ndarray:
+        import hashlib
+        out = np.empty(max(len(dictionary), 1), np.int64)
+        out[:] = 0
+        for i, w in enumerate(dictionary):
+            data = w if isinstance(w, bytes) else str(w).encode("utf-8")
+            h = hashlib.blake2b(data, digest_size=8).digest()
+            out[i] = np.frombuffer(h, np.int64)[0]
+        return out
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        acc = xp.asarray(np.int64(42))
+        for c in self.children:
+            v = c.eval(ctx)
+            if v.dictionary is not None:
+                table = xp.asarray(self._string_hash_table(v.dictionary))
+                h = table[xp.clip(v.data, 0, None)]
+            else:
+                bits = v.data
+                if np.issubdtype(np.dtype(str(bits.dtype)), np.floating):
+                    # normalize -0.0 → 0.0 then bitcast
+                    bits = xp.where(bits == 0, xp.zeros((), bits.dtype), bits)
+                    bits = bits.astype(np.float64).view(np.int64) if xp is np \
+                        else _jax_bitcast(bits)
+                h = self._mix(xp, bits.astype(np.int64))
+            if v.valid is not None:
+                h = xp.where(v.valid, h, self.NULL_HASH)
+            combined = (xp.asarray(acc).astype(np.uint64) * np.uint64(31)
+                        + xp.asarray(h).astype(np.uint64))
+            acc = self._mix(xp, combined)
+        return ExprValue(acc, None)
+
+    def __repr__(self):
+        return f"hash64({', '.join(map(repr, self.children))})"
+
+
+def _jax_bitcast(x):
+    import jax
+    import jax.numpy as jnp
+    return jax.lax.bitcast_convert_type(x.astype(jnp.float64), jnp.int64)
