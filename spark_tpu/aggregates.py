@@ -1,0 +1,382 @@
+"""Aggregate functions, decomposed into segment-reducible buffers.
+
+The analog of ``sql/catalyst/.../expressions/aggregate/`` redesigned for
+TPU: every aggregate is expressed as a small set of BUFFERS, each reduced
+with one of {sum, min, max} — the only reductions we ever run on device
+(as ``segment_sum``-style ops locally, ``psum``-style collectives across
+the mesh).  This decomposition *is* the partial/final aggregation split of
+``AggUtils.scala``: partial agg materializes buffer columns, re-aggregation
+after an exchange reduces the same buffers again (sum of sums, min of mins),
+and ``finish`` runs only at the final step.  It gives distributed merge,
+spill-merge, and streaming-state merge one shared code path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import types as T
+from .expressions import (
+    AnalysisException, EvalContext, Expression, ExprValue, Literal, and_valid,
+)
+
+__all__ = [
+    "AggregateFunction", "BufferSpec", "Sum", "Count", "CountStar", "Avg",
+    "Min", "Max", "First", "Last", "VarianceBase", "VarSamp", "VarPop",
+    "StddevSamp", "StddevPop", "AggregateExpression", "is_aggregate",
+]
+
+
+class BufferSpec(NamedTuple):
+    """One reducible buffer: data to reduce, reduction kind, and the value
+    used for rows that do not contribute (the reduction identity)."""
+
+    data: Any            # array (capacity,)
+    kind: str            # 'sum' | 'min' | 'max'
+    np_dtype: np.dtype   # buffer storage dtype
+
+
+IDENTITY = {
+    "sum": lambda dt: np.zeros((), dt).item() if np.issubdtype(dt, np.floating) else 0,
+    "min": lambda dt: np.inf if np.issubdtype(dt, np.floating) else np.iinfo(dt).max,
+    "max": lambda dt: -np.inf if np.issubdtype(dt, np.floating) else np.iinfo(dt).min,
+}
+
+
+class AggregateFunction(Expression):
+    """Base: children are input expressions; eval() is forbidden (aggregates
+    are consumed by the Aggregate operator, reference
+    ``DeclarativeAggregate`` vs row-at-a-time ``ImperativeAggregate``)."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def eval(self, ctx: EvalContext) -> ExprValue:
+        raise AnalysisException(
+            f"aggregate function {self!r} cannot be evaluated row-wise; "
+            "use it under groupBy().agg(...)")
+
+    # -- the buffer contract ---------------------------------------------
+    def num_buffers(self) -> int:
+        raise NotImplementedError
+
+    def make_buffers(self, ctx: EvalContext, contribute) -> List[BufferSpec]:
+        """Per-row buffer contributions.  ``contribute`` is the boolean mask
+        of rows that exist (row_valid AND any operator predicate); each
+        buffer must hold its reduction identity where a row does not
+        contribute (or its input is NULL)."""
+        raise NotImplementedError
+
+    def finish(self, xp, buffers: List[Any]) -> ExprValue:
+        """Combine reduced buffers into the output column value."""
+        raise NotImplementedError
+
+    def output_dictionary(self, ctx: EvalContext):
+        """Dictionary of the output column (min/max/first of strings)."""
+        return None
+
+    def _input(self, ctx: EvalContext, contribute) -> Tuple[Any, Any]:
+        """Evaluate the single input expr; returns (data, valid&contribute)."""
+        v = self.children[0].eval(ctx)
+        xp = ctx.xp
+        valid = and_valid(xp, v.valid, contribute)
+        if valid is None:
+            valid = xp.ones(ctx.capacity, dtype=bool)
+        data = v.data
+        if getattr(data, "shape", ()) == ():
+            data = xp.broadcast_to(data, (ctx.capacity,))
+        valid = xp.broadcast_to(valid, (ctx.capacity,))
+        return data, valid
+
+    def _masked(self, xp, data, valid, kind: str, np_dtype) -> BufferSpec:
+        ident = IDENTITY[kind](np_dtype)
+        return BufferSpec(
+            xp.where(valid, data.astype(np_dtype), np.asarray(ident, np_dtype)),
+            kind, np.dtype(np_dtype))
+
+
+class Sum(AggregateFunction):
+    """sum(x): NULL if no non-null input (Sum.scala)."""
+
+    def data_type(self, schema):
+        dt = self.children[0].data_type(schema)
+        if isinstance(dt, T.DecimalType):
+            return T.DecimalType(T.DecimalType.MAX_PRECISION, dt.scale)
+        if dt.is_integral or isinstance(dt, T.BooleanType):
+            return T.int64
+        return T.float64
+
+    def num_buffers(self):
+        return 2
+
+    def make_buffers(self, ctx, contribute):
+        xp = ctx.xp
+        data, valid = self._input(ctx, contribute)
+        out_dt = self.data_type(ctx.batch.schema).np_dtype
+        return [self._masked(xp, data, valid, "sum", out_dt),
+                BufferSpec(valid.astype(np.int64), "sum", np.dtype(np.int64))]
+
+    def finish(self, xp, buffers):
+        total, cnt = buffers
+        return ExprValue(total, cnt > 0)
+
+    def __repr__(self):
+        return f"sum({self.children[0]!r})"
+
+
+class Count(AggregateFunction):
+    """count(x): number of non-null inputs; never NULL."""
+
+    def data_type(self, schema):
+        return T.int64
+
+    def num_buffers(self):
+        return 1
+
+    def make_buffers(self, ctx, contribute):
+        xp = ctx.xp
+        _, valid = self._input(ctx, contribute)
+        return [BufferSpec(valid.astype(np.int64), "sum", np.dtype(np.int64))]
+
+    def finish(self, xp, buffers):
+        return ExprValue(buffers[0], None)
+
+    def __repr__(self):
+        return f"count({self.children[0]!r})"
+
+
+class CountStar(AggregateFunction):
+    """count(*): counts rows regardless of nulls."""
+
+    def __init__(self):
+        super().__init__()
+
+    def data_type(self, schema):
+        return T.int64
+
+    def num_buffers(self):
+        return 1
+
+    def make_buffers(self, ctx, contribute):
+        xp = ctx.xp
+        c = contribute if contribute is not None else xp.ones(ctx.capacity, bool)
+        return [BufferSpec(c.astype(np.int64), "sum", np.dtype(np.int64))]
+
+    def finish(self, xp, buffers):
+        return ExprValue(buffers[0], None)
+
+    def __repr__(self):
+        return "count(1)"
+
+
+class Avg(AggregateFunction):
+    def data_type(self, schema):
+        return T.float64
+
+    def num_buffers(self):
+        return 2
+
+    def make_buffers(self, ctx, contribute):
+        xp = ctx.xp
+        data, valid = self._input(ctx, contribute)
+        src = self.children[0].data_type(ctx.batch.schema)
+        fdata = data.astype(np.float64)
+        if isinstance(src, T.DecimalType):
+            fdata = fdata / (10 ** src.scale)
+        return [self._masked(xp, fdata, valid, "sum", np.float64),
+                BufferSpec(valid.astype(np.int64), "sum", np.dtype(np.int64))]
+
+    def finish(self, xp, buffers):
+        total, cnt = buffers
+        safe = xp.where(cnt > 0, cnt, 1)
+        return ExprValue(total / safe, cnt > 0)
+
+    def __repr__(self):
+        return f"avg({self.children[0]!r})"
+
+
+class _MinMax(AggregateFunction):
+    kind = "min"
+
+    def data_type(self, schema):
+        return self.children[0].data_type(schema)
+
+    def num_buffers(self):
+        return 2
+
+    def make_buffers(self, ctx, contribute):
+        xp = ctx.xp
+        data, valid = self._input(ctx, contribute)
+        dt = self.data_type(ctx.batch.schema).np_dtype
+        if dt == np.bool_:
+            dt = np.dtype(np.int8)
+        return [self._masked(xp, data, valid, self.kind, dt),
+                BufferSpec(valid.astype(np.int64), "sum", np.dtype(np.int64))]
+
+    def finish(self, xp, buffers):
+        val, cnt = buffers
+        return ExprValue(val, cnt > 0)
+
+    def output_dictionary(self, ctx: EvalContext):
+        return self.children[0].eval(ctx).dictionary
+
+    def __repr__(self):
+        return f"{self.kind}({self.children[0]!r})"
+
+
+class Min(_MinMax):
+    kind = "min"
+
+
+class Max(_MinMax):
+    kind = "max"
+
+
+class First(AggregateFunction):
+    """first(x, ignoreNulls=True): value of x on the first contributing row.
+
+    Implemented order-sensitively via a min-reduction over (row_index) and a
+    gather at finish is not expressible as a pure buffer reduce; instead we
+    encode (index, value) packed — min over index with the value carried via
+    a second min buffer keyed the same way works only when values are
+    monotone.  We use the standard trick: reduce min over
+    ``index*`` and separately reduce min over ``(index << 1) | bit``? —
+    too cute.  Pragmatic choice: min-reduce the row index, then the operator
+    gathers the value at that index (needs the pre-reduction batch, which the
+    Aggregate operator has).  So First contributes an 'argmin' buffer the
+    operator special-cases.
+    """
+
+    def __init__(self, child: Expression, ignore_nulls: bool = True):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def data_type(self, schema):
+        return self.children[0].data_type(schema)
+
+    def num_buffers(self):
+        return 1
+
+    ARGREDUCE = "first"
+
+    def make_buffers(self, ctx, contribute):
+        xp = ctx.xp
+        data, valid = self._input(ctx, contribute)
+        if not self.ignore_nulls:
+            _, valid = None, xp.broadcast_to(
+                contribute if contribute is not None else xp.ones(ctx.capacity, bool),
+                (ctx.capacity,))
+        idx = xp.arange(ctx.capacity, dtype=np.int64)
+        big = np.int64(1 << 62)
+        return [BufferSpec(xp.where(valid, idx, big), "min", np.dtype(np.int64))]
+
+    def finish(self, xp, buffers):
+        raise AnalysisException("First/Last finish requires operator gather")
+
+    def output_dictionary(self, ctx: EvalContext):
+        return self.children[0].eval(ctx).dictionary
+
+    def __repr__(self):
+        return f"first({self.children[0]!r})"
+
+
+class Last(First):
+    ARGREDUCE = "last"
+
+    def make_buffers(self, ctx, contribute):
+        xp = ctx.xp
+        data, valid = self._input(ctx, contribute)
+        if not self.ignore_nulls:
+            valid = xp.broadcast_to(
+                contribute if contribute is not None else xp.ones(ctx.capacity, bool),
+                (ctx.capacity,))
+        idx = xp.arange(ctx.capacity, dtype=np.int64)
+        return [BufferSpec(xp.where(valid, idx, np.int64(-1)), "max", np.dtype(np.int64))]
+
+    def __repr__(self):
+        return f"last({self.children[0]!r})"
+
+
+class VarianceBase(AggregateFunction):
+    """var/stddev via (count, sum, sum of squares) buffers.
+
+    The reference uses Welford-style central moments
+    (``aggregate/CentralMomentAgg.scala``); sum-of-squares buffers are
+    mergeable with plain sums, which Welford deltas are not, and float64
+    accumulation over HBM-sized batches is acceptable precision-wise.
+    """
+
+    ddof = 1
+
+    def data_type(self, schema):
+        return T.float64
+
+    def num_buffers(self):
+        return 3
+
+    def make_buffers(self, ctx, contribute):
+        xp = ctx.xp
+        data, valid = self._input(ctx, contribute)
+        f = data.astype(np.float64)
+        return [BufferSpec(valid.astype(np.int64), "sum", np.dtype(np.int64)),
+                self._masked(xp, f, valid, "sum", np.float64),
+                self._masked(xp, f * f, valid, "sum", np.float64)]
+
+    def _variance(self, xp, buffers):
+        n, s, s2 = buffers
+        nf = n.astype(np.float64)
+        safe_n = xp.where(n > self.ddof, nf, 1.0)
+        mean = s / xp.where(n > 0, nf, 1.0)
+        var = xp.maximum(s2 - nf * mean * mean, 0.0) / xp.maximum(safe_n - self.ddof, 1.0)
+        return var, n > self.ddof
+
+    def finish(self, xp, buffers):
+        var, valid = self._variance(xp, buffers)
+        return ExprValue(var, valid)
+
+
+class VarSamp(VarianceBase):
+    ddof = 1
+
+    def __repr__(self):
+        return f"var_samp({self.children[0]!r})"
+
+
+class VarPop(VarianceBase):
+    ddof = 0
+
+    def __repr__(self):
+        return f"var_pop({self.children[0]!r})"
+
+
+class StddevSamp(VarianceBase):
+    ddof = 1
+
+    def finish(self, xp, buffers):
+        var, valid = self._variance(xp, buffers)
+        return ExprValue(xp.sqrt(var), valid)
+
+    def __repr__(self):
+        return f"stddev_samp({self.children[0]!r})"
+
+
+class StddevPop(StddevSamp):
+    ddof = 0
+
+    def __repr__(self):
+        return f"stddev_pop({self.children[0]!r})"
+
+
+class AggregateExpression(NamedTuple):
+    """A named aggregate output slot in an Aggregate operator."""
+
+    func: AggregateFunction
+    name: str
+
+
+def is_aggregate(e: Expression) -> bool:
+    if isinstance(e, AggregateFunction):
+        return True
+    return any(is_aggregate(c) for c in e.children)

@@ -17,6 +17,13 @@ import pytest  # noqa: E402
 
 import jax  # noqa: E402
 
+# The axon TPU plugin (sitecustomize in /root/.axon_site) force-registers
+# itself and sets jax_platforms='axon,cpu' BEFORE conftest runs, ignoring the
+# env var — and TPU float64 is emulated (double-double, ~1e-15 error), which
+# breaks exact dual-path tests. Override back to pure CPU here, before any
+# backend initialization.
+jax.config.update("jax_platforms", "cpu")
+
 # Persistent compile cache: this jax build pays ~0.8s per jit and ~20ms per
 # uncached eager op; caching across pytest runs keeps the suite usable.
 jax.config.update("jax_compilation_cache_dir", "/tmp/spark_tpu_jax_cache")
