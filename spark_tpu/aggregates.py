@@ -25,7 +25,8 @@ from .expressions import (
 __all__ = [
     "AggregateFunction", "BufferSpec", "Sum", "Count", "CountStar", "Avg",
     "Min", "Max", "First", "Last", "VarianceBase", "VarSamp", "VarPop",
-    "StddevSamp", "StddevPop", "AggregateExpression", "is_aggregate",
+    "StddevSamp", "StddevPop", "CountDistinct", "SumDistinct",
+    "AggregateExpression", "is_aggregate",
 ]
 
 
@@ -367,6 +368,26 @@ class StddevPop(StddevSamp):
 
     def __repr__(self):
         return f"stddev_pop({self.children[0]!r})"
+
+
+class CountDistinct(Count):
+    """count(DISTINCT x): planned as a two-level aggregation — the analyzer
+    rewrites Aggregate[keys][count_distinct(x)] into
+    Aggregate[keys][count(x)] over Aggregate[keys+x][] (the expansion of
+    ``RewriteDistinctAggregates.scala`` restricted to one distinct column).
+    """
+
+    is_distinct = True
+
+    def __repr__(self):
+        return f"count(DISTINCT {self.children[0]!r})"
+
+
+class SumDistinct(Sum):
+    is_distinct = True
+
+    def __repr__(self):
+        return f"sum(DISTINCT {self.children[0]!r})"
 
 
 class AggregateExpression(NamedTuple):

@@ -37,7 +37,7 @@ __all__ = [
     "And", "Or", "Not", "IsNull", "IsNotNull", "IsNaN", "Coalesce", "If",
     "CaseWhen", "In", "Between", "StringPredicate", "StringTransform",
     "StringLength", "Concat", "Substring", "ExtractDatePart", "Hash64",
-    "Greatest", "Least", "lit", "col", "AnalysisException",
+    "Greatest", "Least", "RowIndex", "Rand", "lit", "col", "AnalysisException",
 ]
 
 
@@ -66,12 +66,15 @@ class EvalContext:
     """Evaluation environment: a ColumnBatch plus the array module.
 
     ``xp`` is numpy for the interpreted path, jax.numpy inside jit traces.
+    ``row_offset`` decorrelates RowIndex/Rand across operators/partitions
+    (the upper-bits analog of MonotonicallyIncreasingID's partition id).
     """
 
-    def __init__(self, batch: ColumnBatch, xp):
+    def __init__(self, batch: ColumnBatch, xp, row_offset: int = 0):
         self.batch = batch
         self.xp = xp
         self.capacity = batch.capacity
+        self.row_offset = row_offset
 
     def col(self, name: str) -> ExprValue:
         vec = self.batch.column(name)
@@ -1318,3 +1321,57 @@ def _jax_bitcast(x):
     import jax
     import jax.numpy as jnp
     return jax.lax.bitcast_convert_type(x.astype(jnp.float64), jnp.int64)
+
+
+class RowIndex(Expression):
+    """Global row id: batch-local index + the context's partition offset
+    (``monotonically_increasing_id`` analog — reference
+    ``expressions/MonotonicallyIncreasingID.scala`` packs partition id in the
+    upper bits; here the offset is provided by the executing operator)."""
+
+    def data_type(self, schema):
+        return T.int64
+
+    @property
+    def foldable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalContext) -> ExprValue:
+        xp = ctx.xp
+        offset = getattr(ctx, "row_offset", 0)
+        return ExprValue(xp.arange(ctx.capacity, dtype=np.int64) + offset, None)
+
+    def __repr__(self):
+        return "monotonically_increasing_id()"
+
+
+class Rand(Expression):
+    """Deterministic per-row uniform [0,1): counter-based (hash of row index
+    and seed), so it is reproducible and identical between the interpreted
+    and compiled paths — unlike Spark's stateful XORShiftRandom
+    (``expressions/randomExpressions.scala``), which is seeded per-partition.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    def data_type(self, schema):
+        return T.float64
+
+    @property
+    def foldable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalContext) -> ExprValue:
+        xp = ctx.xp
+        offset = getattr(ctx, "row_offset", 0)
+        idx = xp.arange(ctx.capacity, dtype=np.int64) + offset
+        seed_mix = np.uint64((self.seed * 2654435761 + 1) & 0xFFFFFFFFFFFFFFFF)
+        mixed = Hash64._mix(xp, (idx.astype(np.uint64)
+                                 * np.uint64(0x9E3779B97F4A7C15)
+                                 + seed_mix))
+        u = (mixed.astype(np.uint64) >> np.uint64(11)).astype(np.float64)
+        return ExprValue(u * (1.0 / (1 << 53)), None)
+
+    def __repr__(self):
+        return f"rand({self.seed})"

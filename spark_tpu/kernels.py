@@ -100,14 +100,19 @@ def sort_batch(xp, batch: ColumnBatch,
 
 
 def take_batch(xp, batch: ColumnBatch, perm: Array) -> ColumnBatch:
-    """Gather all columns (and masks) through a permutation/index array."""
+    """Gather all columns (and masks) through an index array.
+
+    ``perm`` may be longer/shorter than the input capacity (join expansion);
+    the output capacity is ``len(perm)``.
+    """
+    out_cap = int(perm.shape[0])
     vectors = []
     for v in batch.vectors:
         data = v.data[perm]
         valid = None if v.valid is None else v.valid[perm]
         vectors.append(ColumnVector(data, v.dtype, valid, v.dictionary))
     rv = None if batch.row_valid is None else batch.row_valid[perm]
-    return ColumnBatch(batch.names, vectors, rv, batch.capacity)
+    return ColumnBatch(batch.names, vectors, rv, out_cap)
 
 
 def compact(xp, batch: ColumnBatch) -> ColumnBatch:
@@ -123,8 +128,9 @@ def compact(xp, batch: ColumnBatch) -> ColumnBatch:
 # row-mask operators
 # ---------------------------------------------------------------------------
 
-def apply_filter(xp, batch: ColumnBatch, pred: Expression) -> ColumnBatch:
-    ctx = EvalContext(batch, xp)
+def apply_filter(xp, batch: ColumnBatch, pred: Expression,
+                 row_offset: int = 0) -> ColumnBatch:
+    ctx = EvalContext(batch, xp, row_offset)
     v = pred.eval(ctx)
     keep = v.data
     if v.valid is not None:
@@ -133,8 +139,9 @@ def apply_filter(xp, batch: ColumnBatch, pred: Expression) -> ColumnBatch:
     return ColumnBatch(batch.names, batch.vectors, rv, batch.capacity)
 
 
-def apply_project(xp, batch: ColumnBatch, exprs: Sequence[Expression]) -> ColumnBatch:
-    ctx = EvalContext(batch, xp)
+def apply_project(xp, batch: ColumnBatch, exprs: Sequence[Expression],
+                  row_offset: int = 0) -> ColumnBatch:
+    ctx = EvalContext(batch, xp, row_offset)
     names, vectors = [], []
     schema = batch.schema
     for e in exprs:

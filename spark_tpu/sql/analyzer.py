@@ -1,0 +1,163 @@
+"""Analyzer: resolution and normalization rewrites.
+
+The (much slimmer) analog of ``catalyst/analysis/Analyzer.scala``.  Columns
+bind by name directly against child schemas, so "resolution" is validation
+plus these structural rewrites:
+
+* ``ResolveAggregates``: `groupBy().agg(expr)` accepts arbitrary expressions
+  mixing aggregate functions and scalars (``sum(x) + 1``); they are split
+  into a Project over a pure Aggregate (Spark plans this shape inside
+  ``HashAggregateExec`` result expressions).
+* ``RewriteDistinctAggregates``: single-column distinct aggregates expand to
+  a two-level aggregation (restriction of
+  ``optimizer/RewriteDistinctAggregates.scala``).
+* ``ResolveRelations``: table names → catalog plans.
+* eager schema validation for early, readable AnalysisException errors.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..aggregates import AggregateFunction, Count, CountDistinct, Sum, SumDistinct
+from ..expressions import (
+    Alias, AnalysisException, Col, Expression, Literal,
+)
+from .logical import (
+    Aggregate, Distinct, Filter, Join, Limit, LocalRelation, LogicalPlan,
+    Project, Sample, Sort, SubqueryAlias, Union, UnresolvedRelation,
+)
+
+def fresh_name(prefix: str, basis: str, index: int) -> str:
+    """DETERMINISTIC generated names: derived from the expression text and
+    slot position, never a global counter — identical queries must produce
+    byte-identical plans so the executor's jit cache can hit."""
+    return f"__{prefix}_{index}_{basis}"
+
+
+def split_aggregate_expr(e: Expression, slots: List[Tuple[AggregateFunction, str]],
+                         ) -> Expression:
+    """Replace AggregateFunction subtrees with Col refs to buffer slots;
+    returns the residual scalar expression."""
+    if isinstance(e, AggregateFunction):
+        for f, n in slots:
+            if f is e:
+                return Col(n)
+        name = fresh_name("agg", repr(e), len(slots))
+        slots.append((e, name))
+        return Col(name)
+    return e.map_children(lambda c: split_aggregate_expr(c, slots))
+
+
+def contains_aggregate(e: Expression) -> bool:
+    if isinstance(e, AggregateFunction):
+        return True
+    return any(contains_aggregate(c) for c in e.children)
+
+
+def build_aggregate(keys: Sequence[Expression], agg_exprs: Sequence[Expression],
+                    child: LogicalPlan) -> LogicalPlan:
+    """Construct Aggregate (+ wrapping Project if needed) from user exprs.
+
+    Grouping keys are also available in output; each agg output expression
+    may reference keys and aggregate functions arbitrarily.
+    """
+    slots: List[Tuple[AggregateFunction, str]] = []
+    out_exprs: List[Expression] = []
+    key_out: List[Expression] = []
+    key_names = []
+    for k in keys:
+        key_out.append(Col(k.name))
+        key_names.append(k.name)
+
+    needs_project = False
+    for e in agg_exprs:
+        name = e.name
+        residual = split_aggregate_expr(e, slots)
+        if isinstance(residual, Col) and not isinstance(e, Alias) \
+                and residual.name not in key_names:
+            # plain aggregate: rename slot to the pretty name
+            for i, (f, n) in enumerate(slots):
+                if n == residual.name:
+                    slots[i] = (f, name)
+                    residual = Col(name)
+                    break
+        out_exprs.append(Alias(residual, name) if not (
+            isinstance(residual, Col) and residual.name == name) else residual)
+        if not (isinstance(residual, Col)):
+            needs_project = True
+
+    agg = Aggregate(list(keys), slots, child)
+    if needs_project or any(isinstance(e, Alias) for e in out_exprs):
+        return Project(key_out + out_exprs, agg)
+    return agg
+
+
+def rewrite_distinct_aggregates(plan: Aggregate) -> LogicalPlan:
+    """Expand single distinct-column aggregates into two-level aggregation."""
+    distinct_slots = [(f, n) for f, n in plan.aggs
+                      if getattr(f, "is_distinct", False)]
+    if not distinct_slots:
+        return plan
+    regular = [(f, n) for f, n in plan.aggs if not getattr(f, "is_distinct", False)]
+    if regular:
+        raise AnalysisException(
+            "mixing DISTINCT and non-DISTINCT aggregates in one GROUP BY is "
+            "not yet supported; split into two aggregations and join")
+    inputs = {repr(f.children[0]) for f, _ in distinct_slots}
+    if len(inputs) > 1:
+        raise AnalysisException(
+            "multiple different DISTINCT columns in one aggregate are not "
+            "yet supported")
+    dcol = distinct_slots[0][0].children[0]
+    dname = fresh_name("distinct", repr(dcol), 0)
+    # level 1: group by keys + distinct column (dedup)
+    inner_keys = list(plan.keys) + [Alias(dcol, dname)]
+    inner = Aggregate(inner_keys, [], plan.child)
+    # level 2: group by keys, aggregate the deduped column
+    outer_slots = []
+    for f, n in distinct_slots:
+        base = Count if isinstance(f, CountDistinct) else Sum
+        outer_slots.append((base(Col(dname)), n))
+    outer_keys = [Col(k.name) for k in plan.keys]
+    return Aggregate(outer_keys, outer_slots, inner)
+
+
+class Analyzer:
+    def __init__(self, catalog=None):
+        self.catalog = catalog
+
+    def analyze(self, plan: LogicalPlan) -> LogicalPlan:
+        plan = self._resolve_relations(plan)
+        plan = plan.transform_up(self._rewrite_node)
+        self._validate(plan)
+        return plan
+
+    def _resolve_relations(self, plan: LogicalPlan) -> LogicalPlan:
+        def fn(node: LogicalPlan) -> LogicalPlan:
+            if isinstance(node, UnresolvedRelation):
+                if self.catalog is None:
+                    raise AnalysisException(f"table not found: {node.name}")
+                resolved = self.catalog.lookup(node.name)
+                return SubqueryAlias(node.name, resolved)
+            return node
+        return plan.transform_up(fn)
+
+    def _rewrite_node(self, node: LogicalPlan) -> LogicalPlan:
+        if isinstance(node, Aggregate):
+            return rewrite_distinct_aggregates(node)
+        return node
+
+    def _validate(self, plan: LogicalPlan) -> None:
+        # forces schema computation everywhere → surfacing unresolved
+        # columns / type errors with plan context
+        for c in plan.children:
+            self._validate(c)
+        try:
+            plan.schema()
+        except AnalysisException:
+            raise
+        except KeyError as e:
+            raise AnalysisException(f"cannot resolve column {e} in {plan!r}")

@@ -1,0 +1,283 @@
+"""Built-in function surface (the analog of ``sql/core/.../functions.scala``
+and ``pyspark.sql.functions``)."""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+from .. import types as T
+from .. import aggregates as A
+from .. import expressions as E
+from .column import Column, ColumnOrName
+
+__all__ = [
+    "col", "column", "lit", "expr", "when", "coalesce", "isnull", "isnan",
+    "greatest", "least", "abs", "sqrt", "exp", "log", "log10", "log2", "pow",
+    "floor", "ceil", "round", "sin", "cos", "tan", "asin", "acos", "atan",
+    "sinh", "cosh", "tanh", "signum", "radians", "degrees",
+    "upper", "lower", "trim", "ltrim", "rtrim", "reverse", "initcap",
+    "length", "substring", "concat", "concat_ws",
+    "year", "month", "dayofmonth", "dayofweek", "dayofyear", "quarter",
+    "hour", "minute", "second", "weekofyear", "to_date", "to_timestamp",
+    "sum", "count", "avg", "mean", "min", "max", "first", "last",
+    "countDistinct", "sumDistinct", "variance", "var_samp", "var_pop",
+    "stddev", "stddev_samp", "stddev_pop", "hash", "xxhash64", "rand",
+    "monotonically_increasing_id", "asc", "desc", "struct",
+]
+
+
+def _e(c: Union[ColumnOrName, Any]) -> E.Expression:
+    if isinstance(c, Column):
+        return c._e
+    if isinstance(c, str):
+        return E.Col(c)
+    return E._wrap(c)
+
+
+def _ev(v: Any) -> E.Expression:
+    """value position: strings are literals."""
+    if isinstance(v, Column):
+        return v._e
+    return E._wrap(v)
+
+
+def col(name: str) -> Column:
+    return Column(E.Col(name))
+
+
+column = col
+
+
+def lit(v: Any) -> Column:
+    return Column(E._wrap(v))
+
+
+def expr(sql_text: str) -> Column:
+    from .parser import parse_expression
+    return Column(parse_expression(sql_text))
+
+
+def when(condition: Column, value) -> Column:
+    return Column(E.CaseWhen([(condition._e, _ev(value))]))
+
+
+def coalesce(*cols) -> Column:
+    return Column(E.Coalesce(*[_e(c) for c in cols]))
+
+
+def isnull(c) -> Column:
+    return Column(E.IsNull(_e(c)))
+
+
+def isnan(c) -> Column:
+    return Column(E.IsNaN(_e(c)))
+
+
+def greatest(*cols) -> Column:
+    return Column(E.Greatest(*[_e(c) for c in cols]))
+
+
+def least(*cols) -> Column:
+    return Column(E.Least(*[_e(c) for c in cols]))
+
+
+# ---- math -----------------------------------------------------------------
+
+def _unary(fn):
+    def f(c) -> Column:
+        return Column(E.UnaryMath(fn, _e(c)))
+    f.__name__ = fn
+    return f
+
+
+abs = _unary("abs")           # noqa: A001
+sqrt = _unary("sqrt")
+exp = _unary("exp")
+log = _unary("ln")
+log10 = _unary("log10")
+log2 = _unary("log2")
+floor = _unary("floor")
+ceil = _unary("ceil")
+sin = _unary("sin")
+cos = _unary("cos")
+tan = _unary("tan")
+asin = _unary("asin")
+acos = _unary("acos")
+atan = _unary("atan")
+sinh = _unary("sinh")
+cosh = _unary("cosh")
+tanh = _unary("tanh")
+signum = _unary("sign")
+radians = _unary("radians")
+degrees = _unary("degrees")
+
+
+def pow(base, exponent) -> Column:  # noqa: A001
+    return Column(E.Pow(_e(base), _e(exponent)))
+
+
+def round(c, scale: int = 0) -> Column:  # noqa: A001
+    return Column(E.RoundExpr(_e(c), scale))
+
+
+# ---- strings --------------------------------------------------------------
+
+def _stransform(fn):
+    def f(c) -> Column:
+        return Column(E.StringTransform(fn, _e(c)))
+    f.__name__ = fn
+    return f
+
+
+upper = _stransform("upper")
+lower = _stransform("lower")
+trim = _stransform("trim")
+ltrim = _stransform("ltrim")
+rtrim = _stransform("rtrim")
+reverse = _stransform("reverse")
+initcap = _stransform("initcap")
+
+
+def length(c) -> Column:
+    return Column(E.StringLength(_e(c)))
+
+
+def substring(c, pos: int, length_: int) -> Column:
+    return Column(E.Substring(_e(c), pos, length_))
+
+
+def concat(*cols) -> Column:
+    return Column(E.Concat(*[_e(c) for c in cols]))
+
+
+def concat_ws(sep: str, *cols) -> Column:
+    parts = []
+    for i, c in enumerate(cols):
+        if i:
+            parts.append(E.Literal(sep))
+        parts.append(_e(c))
+    return Column(E.Concat(*parts))
+
+
+# ---- datetime -------------------------------------------------------------
+
+def _dpart(part):
+    def f(c) -> Column:
+        return Column(E.ExtractDatePart(part, _e(c)))
+    f.__name__ = part
+    return f
+
+
+year = _dpart("year")
+month = _dpart("month")
+dayofmonth = _dpart("day")
+dayofweek = _dpart("dayofweek")
+dayofyear = _dpart("dayofyear")
+quarter = _dpart("quarter")
+hour = _dpart("hour")
+minute = _dpart("minute")
+second = _dpart("second")
+weekofyear = _dpart("weekofyear")
+
+
+def to_date(c) -> Column:
+    return Column(E.Cast(_e(c), T.date))
+
+
+def to_timestamp(c) -> Column:
+    return Column(E.Cast(_e(c), T.timestamp))
+
+
+# ---- aggregates -----------------------------------------------------------
+
+def sum(c) -> Column:  # noqa: A001
+    return Column(A.Sum(_e(c)))
+
+
+def count(c) -> Column:
+    e = _e(c) if not (isinstance(c, str) and c == "*") else None
+    if e is None or (isinstance(e, E.Literal)):
+        return Column(A.CountStar())
+    return Column(A.Count(e))
+
+
+def avg(c) -> Column:
+    return Column(A.Avg(_e(c)))
+
+
+mean = avg
+
+
+def min(c) -> Column:  # noqa: A001
+    return Column(A.Min(_e(c)))
+
+
+def max(c) -> Column:  # noqa: A001
+    return Column(A.Max(_e(c)))
+
+
+def first(c, ignorenulls: bool = True) -> Column:
+    return Column(A.First(_e(c), ignorenulls))
+
+
+def last(c, ignorenulls: bool = True) -> Column:
+    return Column(A.Last(_e(c), ignorenulls))
+
+
+def countDistinct(c) -> Column:
+    return Column(A.CountDistinct(_e(c)))
+
+
+def sumDistinct(c) -> Column:
+    return Column(A.SumDistinct(_e(c)))
+
+
+def variance(c) -> Column:
+    return Column(A.VarSamp(_e(c)))
+
+
+var_samp = variance
+
+
+def var_pop(c) -> Column:
+    return Column(A.VarPop(_e(c)))
+
+
+def stddev(c) -> Column:
+    return Column(A.StddevSamp(_e(c)))
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(c) -> Column:
+    return Column(A.StddevPop(_e(c)))
+
+
+# ---- misc -----------------------------------------------------------------
+
+def hash(*cols) -> Column:  # noqa: A001
+    return Column(E.Hash64(*[_e(c) for c in cols]))
+
+
+xxhash64 = hash
+
+
+def rand(seed: int = 0) -> Column:
+    return Column(E.Rand(seed))
+
+
+def monotonically_increasing_id() -> Column:
+    return Column(E.RowIndex())
+
+
+def asc(name: str):
+    return col(name).asc()
+
+
+def desc(name: str):
+    return col(name).desc()
+
+
+def struct(*cols):
+    raise NotImplementedError("struct columns arrive with nested-type support")

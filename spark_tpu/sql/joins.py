@@ -1,0 +1,439 @@
+"""Join execution.
+
+Replaces the reference join zoo (``execution/joins/``: BroadcastHashJoinExec
+on ``BytesToBytesMap``, SortMergeJoinExec's codegen merge loop) with ONE
+static-shape device algorithm, sorted-build + binary-search probe:
+
+1. both sides' equi-join keys hash-combine into TWO independent 64-bit keys
+   (strings hash their dictionary words, so string joins need no dictionary
+   alignment); NULL keys get per-side sentinels that can never match.
+2. the build side sorts by hash key (dead rows sentineled to the end);
+3. each probe row binary-searches its match range [lo, hi) —
+   ``searchsorted`` is the TPU-friendly stand-in for hash-table lookup;
+4. duplicate expansion uses the counts-cumsum-gather pattern into a STATIC
+   output capacity (``spark.sql.join.outputCapacityFactor`` × probe
+   capacity); the true total is returned as an overflow flag the executor
+   checks host-side after execution — the honest dynamic-shape escape hatch;
+5. matches are verified on the second hash, making cross-key collisions a
+   ~2^-128 event, and false expansion slots are masked out.
+
+Semi/anti joins never expand (capacity preserved); outer joins append
+null-padded unmatched rows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import config as C
+from .. import types as T
+from ..columnar import ColumnBatch, ColumnVector, pad_capacity
+from ..expressions import (
+    AnalysisException, Col, EQ, EvalContext, Expression, Hash64, and_valid,
+)
+from ..kernels import multi_key_argsort, take_batch
+from .logical import Join
+from . import physical as P
+
+Array = Any
+
+
+def split_equi_condition(
+    on: Optional[Expression], left_cols: set, right_cols: set,
+) -> Tuple[List[Tuple[Expression, Expression]], List[Expression]]:
+    """Split a join condition into equi-key pairs and residual conjuncts
+    (the extraction half of ``ExtractEquiJoinKeys``)."""
+    from .optimizer import split_conjuncts
+    if on is None:
+        return [], []
+    keys, residual = [], []
+    for c in split_conjuncts(on):
+        if isinstance(c, EQ):
+            l, r = c.children
+            lr, rr = l.references(), r.references()
+            if lr <= left_cols and rr <= right_cols:
+                keys.append((l, r))
+                continue
+            if lr <= right_cols and rr <= left_cols:
+                keys.append((r, l))
+                continue
+        residual.append(c)
+    return keys, residual
+
+
+# second, independent mixing constants for match verification
+class _Hash64B(Hash64):
+    @staticmethod
+    def _mix(xp, x):
+        c1 = np.uint64(0x9E3779B97F4A7C15)
+        c2 = np.uint64(0xBF58476D1CE4E5B9)
+        x = xp.asarray(x).astype(np.uint64)
+        x = x ^ (x >> np.uint64(31))
+        x = x * c1
+        x = x ^ (x >> np.uint64(29))
+        x = x * c2
+        x = x ^ (x >> np.uint64(32))
+        return x.astype(np.int64)
+
+    @staticmethod
+    def _string_hash_table(dictionary):
+        import hashlib
+        out = np.empty(max(len(dictionary), 1), np.int64)
+        out[:] = 0
+        for i, w in enumerate(dictionary):
+            data = w if isinstance(w, bytes) else str(w).encode("utf-8")
+            h = hashlib.blake2b(data, digest_size=8, key=b"spark-tpu-joinB").digest()
+            out[i] = np.frombuffer(h, np.int64)[0]
+        return out
+
+
+# primary hash keys are masked to 62 bits (range [0, 2^62)) so the sentinels
+# below are STRICTLY outside the hash range — sort/searchsorted invariants
+# must hold for arbitrary hash values
+_HASH_MASK = np.int64((1 << 62) - 1)
+_NULL_PROBE = np.int64(-3)
+_NULL_BUILD = np.int64(-5)
+_DEAD_BUILD = np.int64(np.iinfo(np.int64).max)
+
+
+def _join_keys(ctx: EvalContext, exprs: Sequence[Expression],
+               null_sentinel: np.int64, dead_sentinel: Optional[np.int64]
+               ) -> Tuple[Array, Array]:
+    """(hashA, hashB) int64 keys for one side; NULL/dead rows sentineled."""
+    xp = ctx.xp
+    ha = ctx.broadcast(Hash64(*exprs).eval(ctx))
+    hb = ctx.broadcast(_Hash64B(*exprs).eval(ctx))
+    all_valid = None
+    for e in exprs:
+        v = e.eval(ctx)
+        if v.valid is not None:
+            nn = xp.broadcast_to(v.valid, (ctx.capacity,))
+            all_valid = nn if all_valid is None else (all_valid & nn)
+    ka, kb = ha.data & _HASH_MASK, hb.data
+    if all_valid is not None:
+        ka = xp.where(all_valid, ka, null_sentinel)
+    live = ctx.batch.row_valid_or_true()
+    if dead_sentinel is not None:
+        ka = xp.where(live, ka, dead_sentinel)
+    else:
+        ka = xp.where(live, ka, null_sentinel)
+    return ka, kb
+
+
+class PJoin(P.PhysicalPlan):
+    def __init__(self, left: P.PhysicalPlan, right: P.PhysicalPlan, how: str,
+                 key_pairs: Sequence[Tuple[Expression, Expression]],
+                 residual: Optional[Expression],
+                 schema: T.StructType, out_capacity_factor: float = 1.0):
+        self.children = (left, right)
+        self.how = how
+        self.key_pairs = list(key_pairs)
+        self.residual = residual
+        self._schema = schema
+        self.factor = out_capacity_factor
+
+    def schema(self):
+        return self._schema
+
+    # ------------------------------------------------------------------
+    def run(self, ctx: P.ExecContext) -> ColumnBatch:
+        left = self.children[0].run(ctx)
+        right = self.children[1].run(ctx)
+        return self._run_on(ctx, left, right)
+
+    # ------------------------------------------------------------------
+    def _run_on(self, ctx: P.ExecContext, probe: ColumnBatch,
+                build: ColumnBatch) -> ColumnBatch:
+        xp = ctx.xp
+        how = self.how
+
+        if how == "cross" or not self.key_pairs:
+            return self._cross(ctx, probe, build)
+
+        pctx = EvalContext(probe, xp)
+        bctx = EvalContext(build, xp)
+        pa, pb = _join_keys(pctx, [l for l, _ in self.key_pairs], _NULL_PROBE, None)
+        ba, bb = _join_keys(bctx, [r for _, r in self.key_pairs], _NULL_BUILD,
+                            _DEAD_BUILD)
+
+        # sort build by hash key (dead rows to the end via sentinel)
+        perm = multi_key_argsort(xp, [ba], build.capacity)
+        ba_s = ba[perm]
+        bb_s = bb[perm]
+        build_s = take_batch(xp, build, perm)
+
+        lo = xp.searchsorted(ba_s, pa, side="left")
+        hi = xp.searchsorted(ba_s, pa, side="right")
+        counts = (hi - lo).astype(np.int64)
+        probe_live = probe.row_valid_or_true()
+        counts = xp.where(probe_live, counts, 0)
+        matched = counts > 0
+
+        if how in ("left_semi", "left_anti"):
+            keep = matched if how == "left_semi" else (~matched & probe_live)
+            # verify hashB for semi (first match position suffices w.h.p.)
+            if how == "left_semi":
+                first_b = bb_s[xp.clip(lo, 0, build.capacity - 1)]
+                keep = keep & (first_b == pb) | (counts > 1)  # dup range: trust hashA
+                keep = keep & probe_live
+            return ColumnBatch(probe.names, probe.vectors,
+                               probe.row_valid_or_true() & keep, probe.capacity)
+
+        out_cap = pad_capacity(int(probe.capacity * max(self.factor, 0.1)))
+        extra = build.capacity if how == "full" else 0
+
+        if how in ("left", "full"):
+            counts_eff = xp.where(probe_live, xp.maximum(counts, 1), 0)
+        else:
+            counts_eff = counts
+
+        offsets = xp.cumsum(counts_eff) - counts_eff   # exclusive prefix
+        total = xp.sum(counts_eff)
+
+        # output slot j → probe row i and duplicate index d
+        slot = xp.arange(out_cap, dtype=np.int64)
+        i = xp.searchsorted(offsets + counts_eff, slot, side="right")
+        i = xp.clip(i, 0, probe.capacity - 1)
+        d = slot - offsets[i]
+        in_range = slot < total
+        has_match = matched[i]
+        b_row = xp.clip(lo[i] + d, 0, build.capacity - 1)
+
+        # verify on the second hash; null-extension rows skip verification
+        verify = (pb[i] == bb_s[b_row]) & (pa[i] == ba_s[b_row])
+        pair_ok = in_range & (verify | ~has_match)
+
+        left_out = take_batch(xp, probe, i)
+        right_out = take_batch(xp, build_s, b_row)
+        null_right = has_match  # False → null-extend right side
+
+        vectors: List[ColumnVector] = []
+        names: List[str] = []
+        for n, v in zip(left_out.names, left_out.vectors):
+            names.append(n)
+            vectors.append(v)
+        for n, v in zip(right_out.names, right_out.vectors):
+            valid = v.valid
+            base = valid if valid is not None else xp.ones(out_cap, dtype=bool)
+            valid = base & null_right if how in ("left", "full") else valid
+            names.append(n)
+            vectors.append(ColumnVector(v.data, v.dtype, valid, v.dictionary))
+
+        rv = pair_ok
+        out = ColumnBatch(names, vectors, rv, out_cap)
+
+        if how == "full":
+            out = self._append_unmatched_build(ctx, out, build_s, ba_s,
+                                               lo, hi, counts, probe_live)
+
+        # overflow accounting: rows beyond static capacity are LOST; executor
+        # raises when this flag is positive (raise outputCapacityFactor)
+        ctx_flags = getattr(ctx, "flags", None)
+        if ctx_flags is not None:
+            ctx_flags.append(xp.maximum(total - out_cap, 0))
+
+        if self.residual is not None:
+            from ..kernels import apply_filter
+            out = apply_filter(xp, out, self.residual)
+        return out
+
+    # ------------------------------------------------------------------
+    def _append_unmatched_build(self, ctx, inner_out: ColumnBatch,
+                                build_s: ColumnBatch, ba_s, lo, hi, counts,
+                                probe_live):
+        """FULL OUTER: mark build rows hit by any probe via a diff array,
+        append the unmatched ones null-extended on the left side."""
+        xp = ctx.xp
+        cap_b = build_s.capacity
+        ones = xp.where(probe_live & (counts > 0), 1, 0).astype(np.int64)
+        start = xp.zeros(cap_b + 1, np.int64)
+        if xp is np:
+            np.add.at(start, np.asarray(lo), np.asarray(ones))
+            np.add.at(start, np.asarray(hi), -np.asarray(ones))
+            hit = np.cumsum(start[:cap_b]) > 0
+        else:
+            start = start.at[lo].add(ones, mode="drop")
+            start = start.at[hi].add(-ones, mode="drop")
+            hit = xp.cumsum(start[:cap_b]) > 0
+        build_live = build_s.row_valid_or_true() & (ba_s < _DEAD_BUILD)
+        unmatched = build_live & ~hit
+
+        names = inner_out.names
+        left_n = len(names) - len(build_s.names)
+        vectors: List[ColumnVector] = []
+        for idx, (n, v) in enumerate(zip(names, inner_out.vectors)):
+            if idx < left_n:
+                pad_data = xp.zeros(cap_b, dtype=v.data.dtype)
+                pad_valid = xp.zeros(cap_b, dtype=bool)
+                data = xp.concatenate([v.data, pad_data])
+                valid = xp.concatenate([
+                    v.valid if v.valid is not None else xp.ones(inner_out.capacity, bool),
+                    pad_valid])
+            else:
+                bv = build_s.vectors[idx - left_n]
+                data = xp.concatenate([v.data, bv.data])
+                valid = xp.concatenate([
+                    v.valid if v.valid is not None else xp.ones(inner_out.capacity, bool),
+                    bv.valid if bv.valid is not None else xp.ones(cap_b, bool)])
+            vectors.append(ColumnVector(data, v.dtype, valid, v.dictionary))
+        rv = xp.concatenate([inner_out.row_valid_or_true(), unmatched])
+        return ColumnBatch(names, vectors, rv, inner_out.capacity + cap_b)
+
+    # ------------------------------------------------------------------
+    def _cross(self, ctx, probe: ColumnBatch, build: ColumnBatch) -> ColumnBatch:
+        """Cartesian product: all-pairs expansion (CartesianProductExec)."""
+        xp = ctx.xp
+        np_, nb = probe.capacity, build.capacity
+        out_cap = np_ * nb
+        slot = xp.arange(out_cap, dtype=np.int64)
+        i = slot // nb
+        j = slot % nb
+        left_out = take_batch(xp, probe, i)
+        right_out = take_batch(xp, build, j)
+        rv = probe.row_valid_or_true()[i] & build.row_valid_or_true()[j]
+        names = left_out.names + right_out.names
+        vectors = left_out.vectors + right_out.vectors
+        out = ColumnBatch(names, vectors, rv, out_cap)
+        if self.residual is not None:
+            from ..kernels import apply_filter
+            out = apply_filter(xp, out, self.residual)
+        return out
+
+    def __repr__(self):
+        ks = ", ".join(f"{l!r}={r!r}" for l, r in self.key_pairs)
+        return f"HashJoin {self.how} keys=[{ks}] residual={self.residual!r} f={self.factor}"
+
+
+def plan_join(planner, node: Join, leaves) -> P.PhysicalPlan:
+    ls, rs = node.left.schema(), node.right.schema()
+
+    if node.how == "right":
+        # right outer = left outer with sides swapped; _JoinOutput restores
+        # column order and picks key values from the correct side
+        swapped_on = node.on
+        swapped = Join(node.right, node.left, "left", swapped_on, node.using)
+        inner = plan_join_raw(planner, swapped, leaves)
+        rl, ll = len(rs.names), len(ls.names)
+        return _JoinOutput(node.schema(), ls.names, rs.names,
+                           left_base=rl, right_base=0,
+                           using=node.using or [], how="right", child=inner)
+
+    inner = plan_join_raw(planner, node, leaves)
+    if inner is None:
+        raise AnalysisException(f"cannot plan join {node!r}")
+    if node.how in ("left_semi", "left_anti"):
+        return inner
+    return _JoinOutput(node.schema(), ls.names, rs.names,
+                       left_base=0, right_base=len(ls.names),
+                       using=node.using or [], how=node.how, child=inner)
+
+
+def plan_join_raw(planner, node: Join, leaves) -> P.PhysicalPlan:
+    """Physical join emitting [all left cols + all right cols] (or probe-only
+    for semi/anti); duplicate names allowed internally."""
+    left_p = planner._to_physical(node.left, leaves)
+    right_p = planner._to_physical(node.right, leaves)
+    ls, rs = node.left.schema(), node.right.schema()
+
+    overlap = set(ls.names) & set(rs.names)
+    if node.using:
+        key_pairs = [(Col(n), Col(n)) for n in node.using]
+        residual_list: List[Expression] = []
+        overlap -= set(node.using)
+    else:
+        key_pairs, residual_list = split_equi_condition(
+            node.on, set(ls.names), set(rs.names))
+    if overlap and node.how not in ("left_semi", "left_anti"):
+        raise AnalysisException(
+            f"ambiguous join output columns {sorted(overlap)}; rename before "
+            f"joining (select/withColumnRenamed) or join with using=[...]")
+
+    residual = None
+    if residual_list:
+        from .optimizer import join_conjuncts
+        residual = join_conjuncts(residual_list)
+
+    raw_schema = T.StructType(
+        [T.StructField(f.name, f.dataType, True) for f in ls.fields]
+        + [T.StructField(f.name, f.dataType, True) for f in rs.fields])
+
+    if not key_pairs:
+        if node.how not in ("cross", "inner"):
+            raise AnalysisException(f"{node.how} join requires equi-join keys")
+        return PJoin(left_p, right_p, "cross", [], residual, raw_schema, 1.0)
+
+    factor = planner.session.conf.get(C.JOIN_OUTPUT_FACTOR)
+    return PJoin(left_p, right_p, node.how, key_pairs, residual, raw_schema,
+                 factor)
+
+
+class _JoinOutput(P.PhysicalPlan):
+    """Assembles the user-visible join output: drops duplicate USING key
+    columns, restores left-then-right column order after a right-join swap,
+    and coalesces key values across sides for FULL OUTER (Spark's USING
+    semantics)."""
+
+    def __init__(self, schema: T.StructType, left_names, right_names,
+                 left_base: int, right_base: int, using: List[str], how: str,
+                 child: P.PhysicalPlan):
+        self._schema = schema
+        self.left_names = list(left_names)
+        self.right_names = list(right_names)
+        self.left_base = left_base
+        self.right_base = right_base
+        self.using = list(using)
+        self.how = how
+        self.children = (child,)
+
+    def schema(self):
+        return self._schema
+
+    def _left_idx(self, name: str) -> int:
+        return self.left_base + self.left_names.index(name)
+
+    def _right_idx(self, name: str) -> int:
+        return self.right_base + self.right_names.index(name)
+
+    def run(self, ctx):
+        xp = ctx.xp
+        batch = self.children[0].run(ctx)
+        names: List[str] = []
+        vectors: List[ColumnVector] = []
+        for f in self._schema.fields:
+            n = f.name
+            if n in self.using:
+                lv = batch.vectors[self._left_idx(n)]
+                rv = batch.vectors[self._right_idx(n)]
+                if self.how == "full":
+                    vec = _coalesce_vectors(xp, lv, rv)
+                elif self.how == "right":
+                    vec = rv
+                else:
+                    vec = lv
+            elif n in self.left_names:
+                vec = batch.vectors[self._left_idx(n)]
+            else:
+                vec = batch.vectors[self._right_idx(n)]
+            names.append(n)
+            vectors.append(vec)
+        return ColumnBatch(names, vectors, batch.row_valid, batch.capacity)
+
+    def __repr__(self):
+        return f"JoinOutput how={self.how} using={self.using}"
+
+
+def _coalesce_vectors(xp, a: ColumnVector, b: ColumnVector) -> ColumnVector:
+    """a if valid else b — merging string dictionaries when needed."""
+    av = a.valid if a.valid is not None else xp.ones(a.data.shape[0], bool)
+    bv = b.valid if b.valid is not None else xp.ones(b.data.shape[0], bool)
+    if a.dictionary is not None or b.dictionary is not None:
+        from ..columnar import merge_dictionaries
+        merged, ra, rb = merge_dictionaries(a.dictionary or (), b.dictionary or ())
+        ad = xp.asarray(ra)[xp.clip(a.data, 0, None)] if len(ra) else a.data
+        bd = xp.asarray(rb)[xp.clip(b.data, 0, None)] if len(rb) else b.data
+        data = xp.where(av, ad, bd).astype(np.int32)
+        return ColumnVector(data, a.dtype, av | bv, merged)
+    data = xp.where(av, a.data, b.data)
+    return ColumnVector(data, a.dtype, av | bv, None)

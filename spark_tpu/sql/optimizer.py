@@ -1,0 +1,252 @@
+"""Rule-based optimizer.
+
+The analog of ``catalyst/optimizer/Optimizer.scala``: batches of rewrite
+rules run to fixed point by a RuleExecutor (``rules/RuleExecutor.scala``).
+v0 carries the highest-value batches — constant folding, filter pushdown and
+combination, projection collapsing, limit pushdown; join reordering and CBO
+come later with statistics.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import config as C
+from ..columnar import ColumnBatch
+from ..expressions import (
+    Alias, And, Cast, Col, EvalContext, Expression, Literal, Or, Not, Rand,
+    RowIndex,
+)
+from ..aggregates import AggregateFunction
+from .logical import (
+    Aggregate, Distinct, Filter, Join, Limit, LocalRelation, LogicalPlan,
+    Project, Sample, Sort, SubqueryAlias, Union,
+)
+
+MAX_ITERATIONS = 50
+
+
+def is_deterministic(e: Expression) -> bool:
+    if isinstance(e, (Rand, RowIndex)):
+        return False
+    return all(is_deterministic(c) for c in e.children)
+
+
+def substitute(e: Expression, mapping: Dict[str, Expression]) -> Expression:
+    if isinstance(e, Col):
+        return mapping.get(e.name, e)
+    return e.map_children(lambda c: substitute(c, mapping))
+
+
+def _alias_map(p: Project) -> Optional[Dict[str, Expression]]:
+    m: Dict[str, Expression] = {}
+    for e in p.exprs:
+        if isinstance(e, Alias):
+            if not is_deterministic(e.children[0]):
+                return None
+            m[e.name] = e.children[0]
+        elif isinstance(e, Col):
+            m[e.name] = e
+        else:
+            if not is_deterministic(e):
+                return None
+            m[e.name] = e
+    return m
+
+
+# ---------------------------------------------------------------------------
+# rules — each: LogicalPlan -> LogicalPlan (identity when not applicable)
+# ---------------------------------------------------------------------------
+
+def collapse_projects(node: LogicalPlan) -> LogicalPlan:
+    """Project(Project(x)) → Project(x) with substitution
+    (``CollapseProject`` in the reference)."""
+    if isinstance(node, Project) and isinstance(node.child, Project):
+        inner = node.child
+        m = _alias_map(inner)
+        if m is None:
+            return node
+        new_exprs = []
+        for e in node.exprs:
+            sub = substitute(e, m)
+            if sub.name != e.name:
+                sub = Alias(sub, e.name)
+            new_exprs.append(sub)
+        return Project(new_exprs, inner.child)
+    return node
+
+
+def combine_filters(node: LogicalPlan) -> LogicalPlan:
+    """Filter(Filter(x)) → Filter(a AND b) (``CombineFilters``)."""
+    if isinstance(node, Filter) and isinstance(node.child, Filter):
+        inner = node.child
+        return Filter(And(inner.condition, node.condition), inner.child)
+    return node
+
+
+def push_filter_through_project(node: LogicalPlan) -> LogicalPlan:
+    """Filter(Project(x)) → Project(Filter(x)) (``PushDownPredicate``)."""
+    if isinstance(node, Filter) and isinstance(node.child, Project):
+        proj = node.child
+        m = _alias_map(proj)
+        if m is None or not is_deterministic(node.condition):
+            return node
+        return Project(proj.exprs, Filter(substitute(node.condition, m), proj.child))
+    return node
+
+
+def push_filter_through_union(node: LogicalPlan) -> LogicalPlan:
+    if isinstance(node, Filter) and isinstance(node.child, Union):
+        u = node.child
+        return Union([Filter(node.condition, c) for c in u.children])
+    return node
+
+
+def push_filter_through_join(node: LogicalPlan) -> LogicalPlan:
+    """Filter(Join) → push conjuncts referencing only one side below the join
+    (inner/semi only; outer-join pushdown needs null-supplying-side care)."""
+    if not (isinstance(node, Filter) and isinstance(node.child, Join)):
+        return node
+    j = node.child
+    if j.how not in ("inner", "cross", "left_semi"):
+        return node
+    left_cols = set(j.left.schema().names)
+    right_cols = set(j.right.schema().names)
+    conjuncts = split_conjuncts(node.condition)
+    left_push, right_push, keep = [], [], []
+    for c_ in conjuncts:
+        refs = c_.references()
+        if not is_deterministic(c_):
+            keep.append(c_)
+        elif refs <= left_cols:
+            left_push.append(c_)
+        elif refs <= right_cols and j.how != "left_semi":
+            right_push.append(c_)
+        else:
+            keep.append(c_)
+    if not left_push and not right_push:
+        return node
+    new_left = Filter(join_conjuncts(left_push), j.left) if left_push else j.left
+    new_right = Filter(join_conjuncts(right_push), j.right) if right_push else j.right
+    new_join = Join(new_left, new_right, j.how, j.on, j.using)
+    return Filter(join_conjuncts(keep), new_join) if keep else new_join
+
+
+def split_conjuncts(e: Expression) -> List[Expression]:
+    if isinstance(e, And):
+        return split_conjuncts(e.children[0]) + split_conjuncts(e.children[1])
+    return [e]
+
+
+def join_conjuncts(es: List[Expression]) -> Expression:
+    out = es[0]
+    for e in es[1:]:
+        out = And(out, e)
+    return out
+
+
+def prune_filters(node: LogicalPlan) -> LogicalPlan:
+    """Remove Filter(true); keep Filter(false) (planner emits empty)."""
+    if isinstance(node, Filter) and isinstance(node.condition, Literal):
+        if node.condition.value is True:
+            return node.child
+    return node
+
+
+def push_limit(node: LogicalPlan) -> LogicalPlan:
+    """Limit(Limit) → min; Limit(Project) → Project(Limit)."""
+    if isinstance(node, Limit):
+        if isinstance(node.child, Limit):
+            return Limit(min(node.n, node.child.n), node.child.child)
+        if isinstance(node.child, Project):
+            return Project(node.child.exprs, Limit(node.n, node.child.child))
+    return node
+
+
+class _FoldCtx:
+    """1-row dummy context for folding constant subtrees with numpy."""
+
+    def __init__(self):
+        self.batch = ColumnBatch([], [], None, 1)
+        self.xp = np
+        self.capacity = 1
+
+
+def constant_fold_expr(e: Expression) -> Expression:
+    if isinstance(e, (Literal, AggregateFunction)):
+        return e
+    if isinstance(e, Alias):  # fold inside, keep the output name
+        return Alias(constant_fold_expr(e.children[0]), e.name)
+    e2 = e.map_children(constant_fold_expr)
+    if e2.foldable and is_deterministic(e2):
+        try:
+            from .. import types as T
+            dummy = _FoldCtx()
+            schema = dummy.batch.schema
+            dt = e2.data_type(schema)
+            # only plain numeric/boolean folds; dictionary-typed (string),
+            # decimal (scaled int), and temporal literals stay symbolic
+            if not (dt.is_numeric and not isinstance(dt, T.DecimalType)
+                    or isinstance(dt, (T.BooleanType, T.NullType))):
+                return e2
+            v = e2.eval(dummy)  # type: ignore[arg-type]
+            data = np.asarray(v.data).reshape(-1)
+            valid = None if v.valid is None else np.asarray(v.valid).reshape(-1)
+            if valid is not None and not bool(valid[:1].all() if len(valid) else True):
+                return Literal(None, dt)
+            val = data[0].item() if len(data) else None
+            return Literal(val, dt)
+        except Exception:
+            return e2
+    return e2
+
+
+def constant_folding(node: LogicalPlan) -> LogicalPlan:
+    return node.map_expressions(constant_fold_expr)
+
+
+# ---------------------------------------------------------------------------
+
+class Batch:
+    def __init__(self, name: str, rules: List[Callable], once: bool = False):
+        self.name = name
+        self.rules = rules
+        self.once = once
+
+
+class Optimizer:
+    """Fixed-point rule executor (``RuleExecutor.execute``)."""
+
+    def __init__(self, conf=None):
+        self.conf = conf
+        self.batches = [
+            Batch("finish-analysis", [constant_folding], once=True),
+            Batch("operator-pushdown", [
+                combine_filters,
+                push_filter_through_project,
+                push_filter_through_union,
+                push_filter_through_join,
+                prune_filters,
+                collapse_projects,
+                push_limit,
+            ]),
+        ]
+
+    def optimize(self, plan: LogicalPlan) -> LogicalPlan:
+        for batch in self.batches:
+            iterations = 1 if batch.once else MAX_ITERATIONS
+            for _ in range(iterations):
+                new_plan = plan
+                for rule in batch.rules:
+                    new_plan = new_plan.transform_up(rule)
+                if _plans_equal(new_plan, plan):
+                    plan = new_plan
+                    break
+                plan = new_plan
+        return plan
+
+
+def _plans_equal(a: LogicalPlan, b: LogicalPlan) -> bool:
+    return a.tree_string() == b.tree_string()

@@ -1,0 +1,313 @@
+"""Physical operators.
+
+The analog of ``sql/core/.../execution/SparkPlan.scala`` operators, with one
+deep difference: operators do not produce iterators — each node's ``run`` is
+a PURE ARRAY FUNCTION over ColumnBatches, and the whole tree executes inside
+one ``jax.jit`` trace.  XLA fusing that trace is the WholeStageCodegen
+analog (``WholeStageCodegenExec.scala:312``), with none of the produce/
+consume protocol: function composition does it.
+
+Host-only metadata (string dictionaries) is static under jit, so even
+dictionary merging for Union/Join key alignment happens "inside" the traced
+function — it runs at trace time on the host, the resulting remap tables are
+baked into the program as constants.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..aggregates import AggregateFunction
+from ..columnar import ColumnBatch, ColumnVector, merge_dictionaries, pad_capacity
+from ..expressions import (
+    AnalysisException, Col, EvalContext, Expression, LT, Rand,
+)
+from ..kernels import (
+    apply_filter, apply_limit, apply_project, distinct as k_distinct,
+    grouped_aggregate, sort_batch,
+)
+
+Array = Any
+
+
+class ExecContext:
+    def __init__(self, xp, leaves: List[ColumnBatch]):
+        self.xp = xp
+        self.leaves = leaves
+        # traced scalars checked host-side after execution (join overflow
+        # accounting — the dynamic-shape escape hatch)
+        self.flags: List[Array] = []
+
+
+class PhysicalPlan:
+    children: Tuple["PhysicalPlan", ...] = ()
+    #: stable preorder position, assigned by the planner; shifted into the
+    #: upper bits of RowIndex/Rand offsets so non-deterministic expressions
+    #: decorrelate across operators (MonotonicallyIncreasingID's partition-id
+    #: trick, reapplied to operator identity)
+    op_id: int = 0
+
+    @property
+    def row_offset(self) -> int:
+        return self.op_id << 33
+
+    def schema(self) -> T.StructType:
+        raise NotImplementedError
+
+    def run(self, ctx: ExecContext) -> ColumnBatch:
+        raise NotImplementedError
+
+    def key(self) -> str:
+        """Structural fingerprint for the jit cache (data-independent parts;
+        dictionaries/capacities live in the pytree treedef and are handled
+        by jax's own retrace logic)."""
+        inner = ",".join(c.key() for c in self.children)
+        return f"{self!r}({inner})"
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + "*- " + repr(self) + "\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s
+
+    def __repr__(self):  # pragma: no cover
+        return type(self).__name__
+
+
+class PScan(PhysicalPlan):
+    """Leaf: reads the i-th prepared input batch (device-resident under jit).
+
+    Plays the role of scan + ``InputAdapter``; columnar by construction
+    (reference ``ColumnarBatchScan.scala``)."""
+
+    def __init__(self, index: int, schema: T.StructType):
+        self.index = index
+        self._schema = schema
+
+    def schema(self):
+        return self._schema
+
+    def run(self, ctx: ExecContext) -> ColumnBatch:
+        return ctx.leaves[self.index]
+
+    def __repr__(self):
+        return f"Scan[{self.index}] {self._schema.simpleString()}"
+
+
+class PRange(PhysicalPlan):
+    """range() generated directly on device (no host transfer) —
+    ``RangeExec`` (codegen'd in the reference)."""
+
+    def __init__(self, start: int, end: int, step: int, name: str, num_rows: int):
+        self.start, self.end, self.step = start, end, step
+        self.name = name
+        self.num_rows = num_rows
+        self.capacity = pad_capacity(num_rows)
+
+    def schema(self):
+        return T.StructType([T.StructField(self.name, T.int64, False)])
+
+    def run(self, ctx: ExecContext) -> ColumnBatch:
+        xp = ctx.xp
+        idx = xp.arange(self.capacity, dtype=np.int64)
+        data = idx * self.step + self.start
+        rv = idx < self.num_rows
+        return ColumnBatch([self.name], [ColumnVector(data, T.int64)], rv,
+                           self.capacity)
+
+    def __repr__(self):
+        return f"Range({self.start},{self.end},{self.step})"
+
+
+class PProject(PhysicalPlan):
+    def __init__(self, exprs: Sequence[Expression], child: PhysicalPlan):
+        self.exprs = list(exprs)
+        self.children = (child,)
+
+    def schema(self):
+        cs = self.children[0].schema()
+        return T.StructType([T.StructField(e.name, e.data_type(cs)) for e in self.exprs])
+
+    def run(self, ctx):
+        batch = self.children[0].run(ctx)
+        out = apply_project(ctx.xp, batch, self.exprs, self.row_offset)
+        out.names = [e.name for e in self.exprs]
+        return out
+
+    def __repr__(self):
+        return f"Project [{', '.join(repr(e) for e in self.exprs)}]"
+
+
+class PFilter(PhysicalPlan):
+    def __init__(self, cond: Expression, child: PhysicalPlan):
+        self.cond = cond
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def run(self, ctx):
+        return apply_filter(ctx.xp, self.children[0].run(ctx), self.cond,
+                            self.row_offset)
+
+    def __repr__(self):
+        return f"Filter ({self.cond!r})"
+
+
+class PAggregate(PhysicalPlan):
+    """Sort-based aggregation (HashAggregateExec replacement, §kernels)."""
+
+    def __init__(self, keys: Sequence[Expression],
+                 slots: Sequence[Tuple[AggregateFunction, str]],
+                 child: PhysicalPlan):
+        self.keys = list(keys)
+        self.slots = list(slots)
+        self.children = (child,)
+
+    def schema(self):
+        cs = self.children[0].schema()
+        fields = [T.StructField(k.name, k.data_type(cs)) for k in self.keys]
+        fields += [T.StructField(n, f.data_type(cs)) for f, n in self.slots]
+        return T.StructType(fields)
+
+    def run(self, ctx):
+        batch = self.children[0].run(ctx)
+        return grouped_aggregate(ctx.xp, batch, self.keys, self.slots)
+
+    def __repr__(self):
+        return (f"Aggregate keys=[{', '.join(repr(k) for k in self.keys)}] "
+                f"aggs=[{', '.join(f'{f!r} AS {n}' for f, n in self.slots)}]")
+
+
+class PSort(PhysicalPlan):
+    def __init__(self, orders: Sequence[Tuple[Expression, bool, bool]],
+                 child: PhysicalPlan):
+        self.orders = list(orders)
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def run(self, ctx):
+        batch = self.children[0].run(ctx)
+        ectx = EvalContext(batch, ctx.xp)
+        schema = batch.schema
+        keys = []
+        for e, asc, nf in self.orders:
+            v = ectx.broadcast(e.eval(ectx))
+            keys.append((v.data, v.valid, e.data_type(schema), asc, nf))
+        return sort_batch(ctx.xp, batch, keys)
+
+    def __repr__(self):
+        parts = [f"{e!r} {'ASC' if a else 'DESC'}" for e, a, n in self.orders]
+        return f"Sort [{', '.join(parts)}]"
+
+
+class PLimit(PhysicalPlan):
+    def __init__(self, n: int, child: PhysicalPlan):
+        self.n = n
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def run(self, ctx):
+        return apply_limit(ctx.xp, self.children[0].run(ctx), self.n)
+
+    def __repr__(self):
+        return f"Limit {self.n}"
+
+
+class PDistinct(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan):
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def run(self, ctx):
+        return k_distinct(ctx.xp, self.children[0].run(ctx))
+
+    def __repr__(self):
+        return "Distinct"
+
+
+class PUnion(PhysicalPlan):
+    """Concatenate children on device; string columns re-encode onto merged
+    dictionaries via trace-time remap tables."""
+
+    def __init__(self, children: Sequence[PhysicalPlan], schema: T.StructType):
+        self.children = tuple(children)
+        self._schema = schema
+
+    def schema(self):
+        return self._schema
+
+    def run(self, ctx):
+        xp = ctx.xp
+        batches = [c.run(ctx) for c in self.children]
+        out_fields = self._schema.fields
+        names = self._schema.names
+        capacity = sum(b.capacity for b in batches)
+        vectors: List[ColumnVector] = []
+        for i, f in enumerate(out_fields):
+            vecs = [b.vectors[i] for b in batches]
+            dt = f.dataType
+            if dt.is_string or isinstance(dt, T.BinaryType):
+                merged: tuple = ()
+                remaps: List[Optional[np.ndarray]] = [None] * len(vecs)
+                for j, v in enumerate(vecs):
+                    merged_new, r_old, r_new = merge_dictionaries(merged, v.dictionary or ())
+                    for k in range(j):
+                        if remaps[k] is not None:
+                            remaps[k] = r_old[remaps[k]]
+                        elif len(r_old):
+                            remaps[k] = r_old
+                    remaps[j] = r_new
+                    merged = merged_new
+                datas = []
+                for v, rm in zip(vecs, remaps):
+                    d = v.data
+                    if rm is not None and len(rm):
+                        d = xp.asarray(rm)[xp.clip(d, 0, None)]
+                    datas.append(d.astype(np.int32))
+                data = xp.concatenate(datas)
+                dictionary = merged
+            else:
+                data = xp.concatenate([v.data.astype(dt.np_dtype) for v in vecs])
+                dictionary = None
+            valids = [v.valid for v in vecs]
+            if any(x is not None for x in valids):
+                valid = xp.concatenate([
+                    x if x is not None else xp.ones(b.capacity, dtype=bool)
+                    for x, b in zip(valids, batches)])
+            else:
+                valid = None
+            vectors.append(ColumnVector(data, dt, valid, dictionary))
+        rv = xp.concatenate([b.row_valid_or_true() for b in batches])
+        return ColumnBatch(list(names), vectors, rv, capacity)
+
+    def __repr__(self):
+        return f"Union({len(self.children)})"
+
+
+class PSample(PhysicalPlan):
+    def __init__(self, fraction: float, seed: int, child: PhysicalPlan):
+        self.fraction = fraction
+        self.seed = seed
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def run(self, ctx):
+        from ..expressions import Literal
+        cond = LT(Rand(self.seed), Literal(float(self.fraction)))
+        return apply_filter(ctx.xp, self.children[0].run(ctx), cond,
+                            self.row_offset)
+
+    def __repr__(self):
+        return f"Sample({self.fraction}, seed={self.seed})"

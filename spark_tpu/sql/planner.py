@@ -1,0 +1,188 @@
+"""Planner + executor: logical plan → physical plan → compiled XLA program.
+
+The compressed analog of the reference pipeline
+``QueryExecution.scala:67-92`` (analyzed → optimized → sparkPlan →
+executedPlan → toRdd): here the "executedPlan" is a pure function over the
+prepared input batches, and "codegen" is ``jax.jit`` of that function,
+cached per plan fingerprint (jax itself retraces when batch treedefs —
+capacities, dictionaries, schemas — change).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import config as C
+from .. import types as T
+from ..columnar import ColumnBatch
+from ..expressions import AnalysisException
+from ..kernels import compact
+from .logical import (
+    Aggregate, Distinct, FileRelation, Filter, Join, Limit, LocalRelation,
+    LogicalPlan, Project, RangeRelation, Sample, Sort, SubqueryAlias, Union,
+)
+from . import physical as P
+
+
+def _slice_to_host(result: ColumnBatch, n: int) -> ColumnBatch:
+    """Transfer only the live prefix of a COMPACTED device batch to host.
+
+    collect() of a few rows from a padded million-row batch must not ship
+    the padding over PCIe; slicing on device first costs one tiny dispatch.
+    """
+    from ..columnar import ColumnVector, pad_capacity
+    cap = min(pad_capacity(max(n, 1)), result.capacity)
+    if cap == result.capacity:
+        return result.to_host()
+    vectors = []
+    for v in result.vectors:
+        data = np.asarray(v.data[:cap])
+        valid = None if v.valid is None else np.asarray(v.valid[:cap])
+        vectors.append(ColumnVector(data, v.dtype, valid, v.dictionary))
+    rv = None if result.row_valid is None else np.asarray(result.row_valid[:cap])
+    return ColumnBatch(result.names, vectors, rv, cap)
+
+
+class PlannedQuery:
+    def __init__(self, physical: P.PhysicalPlan, leaves: List[ColumnBatch]):
+        self.physical = physical
+        self.leaves = leaves
+
+
+class Planner:
+    """Logical → physical (``SparkPlanner.strategies`` analog)."""
+
+    def __init__(self, session):
+        self.session = session
+
+    def plan(self, logical: LogicalPlan) -> PlannedQuery:
+        leaves: List[ColumnBatch] = []
+        phys = self._to_physical(logical, leaves)
+        self._assign_op_ids(phys, [1])
+        return PlannedQuery(phys, leaves)
+
+    def _assign_op_ids(self, node: P.PhysicalPlan, counter: List[int]) -> None:
+        node.op_id = counter[0]
+        counter[0] += 1
+        for c in node.children:
+            self._assign_op_ids(c, counter)
+
+    def _scan(self, batch: ColumnBatch, leaves: List[ColumnBatch]) -> P.PScan:
+        leaves.append(batch)
+        return P.PScan(len(leaves) - 1, batch.schema)
+
+    def _to_physical(self, node: LogicalPlan, leaves) -> P.PhysicalPlan:
+        if isinstance(node, LocalRelation):
+            return self._scan(node.batch, leaves)
+        if isinstance(node, RangeRelation):
+            return P.PRange(node.start, node.end, node.step, node.name,
+                            node.num_rows())
+        if isinstance(node, FileRelation):
+            from ..io import read_file_relation
+            batch = read_file_relation(node, self.session)
+            return self._scan(batch, leaves)
+        if isinstance(node, SubqueryAlias):
+            return self._to_physical(node.child, leaves)
+        if isinstance(node, Project):
+            return P.PProject(node.exprs, self._to_physical(node.child, leaves))
+        if isinstance(node, Filter):
+            return P.PFilter(node.condition, self._to_physical(node.child, leaves))
+        if isinstance(node, Aggregate):
+            return P.PAggregate(node.keys, node.aggs,
+                                self._to_physical(node.child, leaves))
+        if isinstance(node, Sort):
+            orders = [(o.child, o.ascending, o.nulls_first) for o in node.orders]
+            return P.PSort(orders, self._to_physical(node.child, leaves))
+        if isinstance(node, Limit):
+            return P.PLimit(node.n, self._to_physical(node.child, leaves))
+        if isinstance(node, Distinct):
+            return P.PDistinct(self._to_physical(node.child, leaves))
+        if isinstance(node, Union):
+            return P.PUnion([self._to_physical(c, leaves) for c in node.children],
+                            node.schema())
+        if isinstance(node, Sample):
+            return P.PSample(node.fraction, node.seed,
+                             self._to_physical(node.child, leaves))
+        if isinstance(node, Join):
+            from .joins import plan_join
+            return plan_join(self, node, leaves)
+        raise AnalysisException(f"no physical plan for {node!r}")
+
+
+class QueryExecution:
+    """Carries one query through analyze → optimize → plan → execute."""
+
+    def __init__(self, session, logical: LogicalPlan):
+        self.session = session
+        self.logical = logical
+        self._analyzed: Optional[LogicalPlan] = None
+        self._optimized: Optional[LogicalPlan] = None
+        self._planned: Optional[PlannedQuery] = None
+
+    @property
+    def analyzed(self) -> LogicalPlan:
+        if self._analyzed is None:
+            from .analyzer import Analyzer
+            self._analyzed = Analyzer(self.session.catalog).analyze(self.logical)
+        return self._analyzed
+
+    @property
+    def optimized(self) -> LogicalPlan:
+        if self._optimized is None:
+            from .optimizer import Optimizer
+            self._optimized = Optimizer(self.session.conf).optimize(self.analyzed)
+        return self._optimized
+
+    @property
+    def planned(self) -> PlannedQuery:
+        if self._planned is None:
+            self._planned = Planner(self.session).plan(self.optimized)
+        return self._planned
+
+    # ------------------------------------------------------------------
+    def execute(self) -> ColumnBatch:
+        """Run the query; returns a COMPACTED host batch."""
+        pq = self.planned
+        use_jit = self.session.conf.get(C.CODEGEN_ENABLED)
+        if not use_jit:
+            ctx = P.ExecContext(np, [b.to_host() for b in pq.leaves])
+            out = pq.physical.run(ctx)
+            self._check_flags([int(f) for f in ctx.flags])
+            return compact(np, out.to_host())
+
+        fn = self.session._jit_cache.get(pq.physical.key())
+        if fn is None:
+            physical = pq.physical
+
+            def run(leaves):
+                ctx = P.ExecContext(jnp, list(leaves))
+                out = physical.run(ctx)
+                c = compact(jnp, out)
+                return c, c.num_rows(), ctx.flags
+
+            fn = jax.jit(run)
+            self.session._jit_cache[pq.physical.key()] = fn
+        dev_leaves = tuple(b.to_device() for b in pq.leaves)
+        result, n_rows, flags = fn(dev_leaves)
+        self._check_flags([int(np.asarray(f)) for f in flags])
+        return _slice_to_host(result, int(np.asarray(n_rows)))
+
+    @staticmethod
+    def _check_flags(flags: List[int]) -> None:
+        lost = sum(flags)
+        if lost > 0:
+            raise RuntimeError(
+                f"join output overflowed its static capacity by {lost} rows; "
+                f"raise {C.JOIN_OUTPUT_FACTOR.key} (current factor too small "
+                f"for this key multiplicity)")
+
+    def explain_string(self) -> str:
+        s = "== Analyzed Logical Plan ==\n" + self.analyzed.tree_string()
+        s += "== Optimized Logical Plan ==\n" + self.optimized.tree_string()
+        s += "== Physical Plan ==\n" + self.planned.physical.tree_string()
+        return s

@@ -1,0 +1,201 @@
+"""SparkSession: the entry point (``sql/SparkSession.scala:77`` analog).
+
+One process = driver + executor: the SPMD mesh replaces the task-scheduler
+split, so the session directly owns the conf, catalog, jit cache, and (in
+distributed mode) the device mesh (see ``spark_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .. import config as C
+from .. import types as T
+from ..columnar import ColumnBatch
+from ..expressions import AnalysisException
+from . import logical as L
+from .dataframe import DataFrame
+
+
+class Catalog:
+    """Temp-view registry (slim ``SessionCatalog``)."""
+
+    def __init__(self):
+        self._views: Dict[str, L.LogicalPlan] = {}
+
+    def register(self, name: str, plan: L.LogicalPlan) -> None:
+        self._views[name.lower()] = plan
+
+    def lookup(self, name: str) -> L.LogicalPlan:
+        key = name.lower()
+        if key not in self._views:
+            raise AnalysisException(f"Table or view not found: {name}")
+        return self._views[key]
+
+    def drop(self, name: str) -> bool:
+        return self._views.pop(name.lower(), None) is not None
+
+    def listTables(self) -> List[str]:
+        return sorted(self._views)
+
+    dropTempView = drop
+
+
+class RuntimeConfig:
+    def __init__(self, conf: C.Conf):
+        self._conf = conf
+
+    def set(self, key: str, value: Any) -> None:
+        self._conf.set(key, value)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._conf.get(key, default)
+
+    def unset(self, key: str) -> None:
+        self._conf.unset(key)
+
+
+class Builder:
+    def __init__(self):
+        self._options: Dict[str, Any] = {}
+
+    def appName(self, name: str) -> "Builder":
+        self._options["spark.app.name"] = name
+        return self
+
+    def master(self, master: str) -> "Builder":
+        self._options["spark.master"] = master
+        return self
+
+    def config(self, key: str, value: Any = None) -> "Builder":
+        self._options[key] = value
+        return self
+
+    def enableHiveSupport(self) -> "Builder":
+        return self
+
+    def getOrCreate(self) -> "SparkSession":
+        if SparkSession._active is None:
+            SparkSession._active = SparkSession(C.Conf(self._options))
+        else:
+            for k, v in self._options.items():
+                SparkSession._active.conf.set(k, v)
+        return SparkSession._active
+
+
+class SparkSession:
+    _active: Optional["SparkSession"] = None
+
+    class _BuilderAccessor:
+        def __get__(self, obj, objtype=None) -> Builder:
+            return Builder()
+
+    builder = _BuilderAccessor()
+
+    def __init__(self, conf: Optional[C.Conf] = None):
+        self.conf_obj = conf or C.Conf()
+        self.conf = self.conf_obj  # Conf has get/set directly
+        self.catalog = Catalog()
+        self._jit_cache: Dict[str, Any] = {}
+        self._sc = None
+
+    @classmethod
+    def getActiveSession(cls) -> Optional["SparkSession"]:
+        return cls._active
+
+    @property
+    def sparkContext(self):
+        if self._sc is None:
+            from ..rdd.context import SparkContext
+            self._sc = SparkContext(conf=self.conf_obj, session=self)
+        return self._sc
+
+    @property
+    def version(self) -> str:
+        from .. import __version__
+        return __version__
+
+    def stop(self) -> None:
+        SparkSession._active = None
+        self._jit_cache.clear()
+
+    # ------------------------------------------------------------------
+    def range(self, start: int, end: Optional[int] = None, step: int = 1
+              ) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(self, L.RangeRelation(start, end, step))
+
+    def createDataFrame(self, data, schema: Union[None, List[str], T.StructType] = None,
+                        ) -> DataFrame:
+        """Rows (list of tuples/dicts/Rows), pandas DataFrame, or dict of
+        columns → DataFrame (``SparkSession.createDataFrame`` analog)."""
+        import pandas as pd
+
+        struct: Optional[T.StructType] = None
+        names: Optional[List[str]] = None
+        if isinstance(schema, T.StructType):
+            struct = schema
+            names = schema.names
+        elif isinstance(schema, (list, tuple)):
+            names = list(schema)
+
+        if isinstance(data, pd.DataFrame):
+            batch = ColumnBatch.from_pandas(data)
+            if names:
+                batch.names = list(names)
+            return DataFrame(self, L.LocalRelation(batch))
+
+        if isinstance(data, dict):
+            batch = ColumnBatch.from_arrays(data, schema=struct)
+            return DataFrame(self, L.LocalRelation(batch))
+
+        rows = list(data)
+        if not rows:
+            if struct is None:
+                raise AnalysisException("cannot infer schema from empty data")
+            return DataFrame(self, L.LocalRelation(ColumnBatch.empty(struct)))
+
+        first = rows[0]
+        if isinstance(first, dict):
+            names = names or list(first.keys())
+            cols = {n: [r.get(n) for r in rows] for n in names}
+        elif hasattr(first, "__fields__"):
+            names = names or list(first.__fields__)
+            cols = {n: [r[i] for r in rows] for i, n in enumerate(names)}
+        elif isinstance(first, (tuple, list)):
+            names = names or [f"_{i + 1}" for i in range(len(first))]
+            cols = {n: [r[i] for r in rows] for i, n in enumerate(names)}
+        else:  # scalars → single column
+            names = names or ["value"]
+            cols = {names[0]: rows}
+        batch = ColumnBatch.from_arrays(cols, schema=struct)
+        return DataFrame(self, L.LocalRelation(batch))
+
+    def sql(self, query: str) -> DataFrame:
+        from .parser import parse_query
+        plan = parse_query(query)
+        return DataFrame(self, plan)
+
+    def table(self, name: str) -> DataFrame:
+        return DataFrame(self, L.UnresolvedRelation(name))
+
+    @property
+    def read(self):
+        from ..io import DataFrameReader
+        return DataFrameReader(self)
+
+    @property
+    def readStream(self):
+        from ..streaming.api import DataStreamReader
+        return DataStreamReader(self)
+
+    @property
+    def streams(self):
+        from ..streaming.api import StreamingQueryManager
+        return StreamingQueryManager.get(self)
+
+    def newSession(self) -> "SparkSession":
+        return SparkSession(self.conf_obj.clone())

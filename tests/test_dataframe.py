@@ -1,0 +1,269 @@
+"""DataFrame API end-to-end tests (jit execution path) vs pandas oracles."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_tpu import types as T
+import spark_tpu.sql.functions as F
+from spark_tpu.expressions import AnalysisException
+
+
+@pytest.fixture()
+def people(spark):
+    return spark.createDataFrame(
+        [(1, "alice", 30, 50.5), (2, "bob", None, 80.0), (3, "carol", 25, 10.0),
+         (4, "dave", 35, None), (5, "eve", 25, 99.0)],
+        ["id", "name", "age", "score"])
+
+
+def test_create_and_collect(people):
+    rows = people.collect()
+    assert len(rows) == 5
+    assert rows[0].name == "alice"
+    assert rows[1].age is None
+    assert rows[0].asDict()["id"] == 1
+
+
+def test_schema(people):
+    assert people.columns == ["id", "name", "age", "score"]
+    assert dict(people.dtypes)["name"] == "string"
+    assert dict(people.dtypes)["score"] == "double"
+
+
+def test_select_expr_arithmetic(people):
+    df = people.select((people.id * 10).alias("x"), F.col("name"))
+    rows = df.collect()
+    assert rows[0].x == 10 and rows[4].x == 50
+    assert rows[2].name == "carol"
+
+
+def test_filter_chain(people):
+    out = people.filter(F.col("age") >= 25).filter(people.score > 20).collect()
+    assert [r.name for r in out] == ["alice", "eve"]
+
+
+def test_with_column_and_drop(people):
+    df = people.withColumn("double_score", people.score * 2).drop("age")
+    assert df.columns == ["id", "name", "score", "double_score"]
+    rows = df.collect()
+    assert rows[0].double_score == 101.0
+
+
+def test_group_by_agg(people):
+    out = (people.groupBy("age")
+           .agg(F.count("*").alias("n"), F.avg("score").alias("avg_s"))
+           .orderBy("age")
+           .collect())
+    # ages: 25 (carol 10.0, eve 99.0), 30 (alice), 35 (dave, null score), null (bob)
+    assert [(r.age, r.n) for r in out] == [(None, 1), (25, 2), (30, 1), (35, 1)]
+    d = {r.age: r.avg_s for r in out}
+    assert d[25] == pytest.approx(54.5)
+    assert d[35] is None  # avg of all-null
+
+
+def test_agg_compound_expression(people):
+    out = people.groupBy().agg(
+        (F.sum("score") / F.count("score")).alias("manual_avg"),
+        F.max(F.col("score") + 1).alias("mp1"),
+    ).collect()
+    assert out[0].manual_avg == pytest.approx((50.5 + 80.0 + 10.0 + 99.0) / 4)
+    assert out[0].mp1 == pytest.approx(100.0)
+
+
+def test_distinct_count(people, spark):
+    df = spark.createDataFrame([(1, "a"), (1, "a"), (2, "b")], ["x", "y"])
+    assert df.distinct().count() == 2
+    assert df.count() == 3
+
+
+def test_count_distinct(spark):
+    df = spark.createDataFrame([(1, "a"), (1, "b"), (2, "a"), (1, "a")], ["k", "v"])
+    out = (df.groupBy("k").agg(F.countDistinct("v").alias("nv"))
+           .orderBy("k").collect())
+    assert [(r.k, r.nv) for r in out] == [(1, 2), (2, 1)]
+
+
+def test_order_by_desc_nulls(people):
+    out = people.orderBy(people.age.desc_nulls_last()).collect()
+    assert [r.age for r in out] == [35, 30, 25, 25, None]
+    out2 = people.orderBy("age", ascending=False).collect()
+    assert out2[-1].age is None  # DESC default nulls last
+
+
+def test_limit_after_sort(people):
+    out = people.orderBy(people.score.desc()).limit(2).collect()
+    assert [r.name for r in out] == ["eve", "bob"]
+
+
+def test_union(spark):
+    a = spark.createDataFrame([(1, "x")], ["i", "s"])
+    b = spark.createDataFrame([(2, "y"), (3, "x")], ["i", "s"])
+    out = a.union(b).orderBy("i").collect()
+    assert [(r.i, r.s) for r in out] == [(1, "x"), (2, "y"), (3, "x")]
+
+
+def test_inner_join_using(spark):
+    emp = spark.createDataFrame(
+        [(1, "alice", 10), (2, "bob", 20), (3, "carol", 10), (4, "dan", 99)],
+        ["id", "name", "dept_id"])
+    dept = spark.createDataFrame(
+        [(10, "eng"), (20, "sales")], ["dept_id", "dept"])
+    out = (emp.join(dept, "dept_id").orderBy("id").collect())
+    assert [(r.id, r.name, r.dept) for r in out] == [
+        (1, "alice", "eng"), (2, "bob", "sales"), (3, "carol", "eng")]
+    assert out[0].__fields__ == ["dept_id", "id", "name", "dept"] or \
+           "dept_id" in out[0].__fields__
+
+
+def test_left_join_nulls(spark):
+    emp = spark.createDataFrame(
+        [(1, 10), (2, 99)], ["id", "dept_id"])
+    dept = spark.createDataFrame([(10, "eng")], ["dept_id", "dept"])
+    out = emp.join(dept, "dept_id", "left").orderBy("id").collect()
+    assert [(r.id, r.dept) for r in out] == [(1, "eng"), (2, None)]
+
+
+def test_right_and_full_join(spark):
+    a = spark.createDataFrame([(1, "a1"), (2, "a2")], ["k", "av"])
+    b = spark.createDataFrame([(2, "b2"), (3, "b3")], ["k", "bv"])
+    r = a.join(b, "k", "right").orderBy("k").collect()
+    assert [(x.k, x.av, x.bv) for x in r] == [(2, "a2", "b2"), (3, None, "b3")]
+    f = a.join(b, "k", "full").orderBy("k").collect()
+    assert [(x.k, x.av, x.bv) for x in f] == [
+        (1, "a1", None), (2, "a2", "b2"), (3, None, "b3")]
+
+
+def test_semi_anti_join(spark):
+    a = spark.createDataFrame([(1,), (2,), (3,)], ["k"])
+    b = spark.createDataFrame([(2,), (2,), (4,)], ["k"])
+    semi = a.join(b, "k", "left_semi").orderBy("k").collect()
+    assert [r.k for r in semi] == [2]
+    anti = a.join(b, "k", "left_anti").orderBy("k").collect()
+    assert [r.k for r in anti] == [1, 3]
+
+
+def test_join_duplicate_keys_expansion(spark):
+    a = spark.createDataFrame([(1, "l1"), (1, "l2"), (2, "l3")], ["k", "lv"])
+    b = spark.createDataFrame([(1, "r1"), (1, "r2")], ["k", "rv"])
+    out = a.join(b, "k").collect()
+    pairs = sorted((r.lv, r.rv) for r in out)
+    assert pairs == [("l1", "r1"), ("l1", "r2"), ("l2", "r1"), ("l2", "r2")]
+
+
+def test_join_string_keys_different_dictionaries(spark):
+    a = spark.createDataFrame([("apple", 1), ("fig", 2)], ["s", "x"])
+    b = spark.createDataFrame([("fig", 20), ("pear", 30)], ["s", "y"])
+    out = a.join(b, "s").collect()
+    assert [(r.s, r.x, r.y) for r in out] == [("fig", 2, 20)]
+
+
+def test_join_condition_expr(spark):
+    a = spark.createDataFrame([(1, 5)], ["ida", "va"])
+    b = spark.createDataFrame([(1, 3), (1, 9)], ["idb", "vb"])
+    out = a.join(b, (F.col("ida") == F.col("idb")) & (F.col("vb") > F.col("va"))).collect()
+    assert [(r.ida, r.vb) for r in out] == [(1, 9)]
+
+
+def test_join_overflow_detection(spark):
+    a = spark.createDataFrame([(1,)] * 8, ["k"])
+    b = spark.createDataFrame([(1, i) for i in range(8)], ["k", "v"])
+    # 8×8 = 64 output rows ≫ 8×factor(1.0) capacity → must raise, not truncate
+    with pytest.raises(RuntimeError, match="overflow"):
+        a.join(b, "k").collect()
+    spark.conf.set("spark.sql.join.outputCapacityFactor", "8.0")
+    try:
+        out = a.join(b, "k").collect()
+        assert len(out) == 64
+    finally:
+        spark.conf.set("spark.sql.join.outputCapacityFactor", "1.0")
+
+
+def test_cross_join(spark):
+    a = spark.createDataFrame([(1,), (2,)], ["x"])
+    b = spark.createDataFrame([("p",), ("q",)], ["y"])
+    out = a.crossJoin(b).collect()
+    assert sorted((r.x, r.y) for r in out) == [
+        (1, "p"), (1, "q"), (2, "p"), (2, "q")]
+
+
+def test_range(spark):
+    assert spark.range(5).count() == 5
+    rows = spark.range(2, 10, 3).collect()
+    assert [r.id for r in rows] == [2, 5, 8]
+
+
+def test_dropna_fillna(people):
+    assert people.dropna(subset=["age"]).count() == 4
+    filled = people.fillna(0, subset=["age"]).collect()
+    assert [r.age for r in filled] == [30, 0, 25, 35, 25]
+
+
+def test_drop_duplicates_subset(spark):
+    df = spark.createDataFrame(
+        [(1, "a"), (1, "b"), (2, "c")], ["k", "v"])
+    out = df.dropDuplicates(["k"]).orderBy("k").collect()
+    assert [r.k for r in out] == [1, 2]
+    assert out[0].v in ("a", "b")
+
+
+def test_sample_deterministic(spark):
+    df = spark.range(1000)
+    n1 = df.sample(0.3, seed=1).count()
+    n2 = df.sample(0.3, seed=1).count()
+    assert n1 == n2
+    assert 200 < n1 < 400
+
+
+def test_temp_view_and_table(people, spark):
+    people.createOrReplaceTempView("people")
+    df = spark.table("people")
+    assert df.count() == 5
+
+
+def test_cache(people):
+    df = people.filter(F.col("id") <= 3).cache()
+    assert df.count() == 3
+    assert len(df.collect()) == 3
+
+
+def test_unresolved_column_error(people):
+    with pytest.raises(AnalysisException, match="cannot resolve"):
+        people.select(F.col("nope")).collect()
+
+
+def test_union_type_mismatch_error(spark):
+    a = spark.createDataFrame([(1,)], ["x"])
+    b = spark.createDataFrame([("s",)], ["x"])
+    with pytest.raises(AnalysisException, match="union"):
+        a.union(b).schema
+
+
+def test_explain_smoke(people, capsys):
+    people.filter(people.id > 1).select("name").explain(extended=True)
+    out = capsys.readouterr().out
+    assert "Filter" in out and "Physical" in out
+
+
+def test_toPandas_roundtrip(people):
+    pdf = people.toPandas()
+    assert list(pdf.columns) == ["id", "name", "age", "score"]
+    assert len(pdf) == 5
+
+
+def test_optimizer_pushes_filter_through_project(people, spark):
+    from spark_tpu.sql.planner import QueryExecution
+    df = people.select((F.col("id") * 2).alias("x")).filter(F.col("x") > 4)
+    qe = QueryExecution(spark, df._plan)
+    s = qe.optimized.tree_string()
+    # Filter must sit below Project after pushdown
+    assert s.index("Project") < s.index("Filter")
+    assert [r.x for r in df.collect()] == [6, 8, 10]
+
+
+def test_constant_folding(spark):
+    from spark_tpu.sql.planner import QueryExecution
+    df = spark.range(3).select((F.lit(2) + F.lit(3) * F.lit(4)).alias("c"))
+    qe = QueryExecution(spark, df._plan)
+    assert "14" in qe.optimized.tree_string()
+    assert [r.c for r in df.collect()] == [14, 14, 14]
