@@ -373,6 +373,13 @@ def _batch_flatten(b: ColumnBatch):
 def _batch_unflatten(aux, children):
     names, dtypes, dicts, capacity = aux
     datas, valids, row_valid = children
+    # Inside shard_map/vmap the leaves are per-shard slices whose length
+    # differs from the stored aux capacity — trust the arrays when possible.
+    for leaf in list(datas) + [row_valid]:
+        shape = getattr(leaf, "shape", None)
+        if shape is not None and len(shape) >= 1:
+            capacity = int(shape[0])
+            break
     vectors = [ColumnVector(d, t, v, dic)
                for d, v, t, dic in zip(datas, valids, dtypes, dicts)]
     b = ColumnBatch.__new__(ColumnBatch)

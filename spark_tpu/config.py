@@ -181,6 +181,11 @@ EXCHANGE_SKEW_FACTOR = conf("spark.sql.exchange.skewFactor").doc(
     "of the even split (capacity/num_shards); overflow detected at runtime."
 ).float(4.0)
 
+MESH_SHARDS = conf("spark.tpu.mesh.shards").doc(
+    "Number of mesh shards for distributed execution. 0 = auto (all local "
+    "devices); 1 = single-device local execution."
+).int(0)
+
 ADAPTIVE_ENABLED = conf("spark.sql.adaptive.enabled").doc(
     "Coalesce small post-exchange partitions (ExchangeCoordinator analog)."
 ).boolean(True)

@@ -1,0 +1,17 @@
+"""Distributed execution over a JAX device mesh.
+
+The replacement for the reference's entire distribution stack — Netty
+shuffle (``common/network-common``), ``ShuffleExchange``, ``TorrentBroadcast``,
+and the task scheduler's placement machinery — with XLA collectives over the
+ICI mesh:
+
+* shuffle        → ``lax.all_to_all``   (``collective.hash_exchange``)
+* broadcast      → ``lax.all_gather``   (``collective.broadcast_all``)
+* tree aggregate → ``lax.psum``         (partial/final buffer merge)
+* range shuffle  → sampled splitters + ``all_to_all`` (global sort)
+
+One ``shard_map`` wraps the whole query: the SPMD program IS the stage, and
+XLA schedules the collectives on ICI — there is no per-task placement.
+"""
+
+from .mesh import get_mesh, mesh_shards  # noqa: F401

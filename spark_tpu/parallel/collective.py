@@ -1,0 +1,153 @@
+"""Collective data-movement kernels (run INSIDE shard_map).
+
+These are the engine's data plane — what ``ShuffleExchange.scala:38`` +
+``UnsafeShuffleWriter.java`` + ``ShuffleBlockFetcherIterator`` +
+Netty chunk streams do in the reference, collapsed into XLA collectives:
+
+* ``hash_exchange``: bucket rows by hash, pack per-destination send buffers
+  (static per-bucket capacity = skew factor × even split), ONE
+  ``lax.all_to_all`` over ICI, unpack.  Overflowing a bucket is detected and
+  reported (the skew escape hatch — Spark's answer is spilling; ours is
+  retry with a bigger factor, and later adaptive re-bucketing).
+* ``broadcast_all``: ``all_gather`` the build side to every shard
+  (``BroadcastExchangeExec`` without the driver round-trip).
+* ``psum_batch``: merge global aggregation buffers across shards
+  (``RDD.treeAggregate``'s reduction tree, done by the ICI allreduce).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..columnar import ColumnBatch, ColumnVector
+from ..kernels import multi_key_argsort, take_batch
+from .mesh import DATA_AXIS
+
+Array = Any
+
+
+def shard_count(axis: str = DATA_AXIS) -> int:
+    return lax.axis_size(axis)
+
+
+def hash_exchange(batch: ColumnBatch, bucket: Array, n_shards: int,
+                  cap_out: int, axis: str = DATA_AXIS,
+                  ) -> Tuple[ColumnBatch, Array]:
+    """Repartition rows so shard d receives every row with ``bucket == d``.
+
+    Returns (received batch with capacity n_shards*cap_out, overflow count).
+    Rows beyond a destination's ``cap_out`` are dropped and counted.
+    """
+    xp = jnp
+    C = batch.capacity
+    live = batch.row_valid_or_true()
+    b = xp.where(live, bucket.astype(np.int32), np.int32(n_shards))
+
+    perm = multi_key_argsort(xp, [b], C)
+    bs = b[perm]
+    sorted_batch = take_batch(xp, batch, perm)
+
+    starts = xp.searchsorted(bs, xp.arange(n_shards, dtype=np.int32))
+    slot = xp.arange(C) - starts[xp.clip(bs, 0, n_shards - 1)]
+    ok = (bs < n_shards) & (slot < cap_out)
+    overflow = xp.sum((bs < n_shards).astype(np.int64)) - xp.sum(ok.astype(np.int64))
+
+    dest = xp.where(ok, bs, np.int32(n_shards))      # n_shards row → dropped
+    slot_c = xp.clip(slot, 0, cap_out - 1)
+
+    def scatter(data, fill):
+        buf = xp.full((n_shards, cap_out), fill, dtype=data.dtype)
+        return buf.at[dest, slot_c].set(data, mode="drop")
+
+    vectors: List[Tuple[Array, Optional[Array], ColumnVector]] = []
+    for v in sorted_batch.vectors:
+        data2 = scatter(v.data, 0)
+        valid2 = None if v.valid is None else scatter(v.valid, False)
+        vectors.append((data2, valid2, v))
+    rv_live = sorted_batch.row_valid_or_true() & ok
+    rv2 = scatter(rv_live, False)
+
+    # ONE all_to_all moves every bucket to its destination over ICI
+    received = []
+    for data2, valid2, v in vectors:
+        rd = lax.all_to_all(data2, axis, split_axis=0, concat_axis=0, tiled=True)
+        rvd = None if valid2 is None else lax.all_to_all(
+            valid2, axis, split_axis=0, concat_axis=0, tiled=True)
+        received.append(ColumnVector(rd.reshape(-1), v.dtype,
+                                     None if rvd is None else rvd.reshape(-1),
+                                     v.dictionary))
+    rv_recv = lax.all_to_all(rv2, axis, split_axis=0, concat_axis=0,
+                             tiled=True).reshape(-1)
+    out = ColumnBatch(batch.names, received, rv_recv, n_shards * cap_out)
+    return out, overflow
+
+
+def round_robin_exchange(batch: ColumnBatch, n_shards: int,
+                         axis: str = DATA_AXIS) -> ColumnBatch:
+    """Spread rows evenly round-robin (RoundRobinPartitioning analog).
+
+    Used before a range exchange: when input order correlates with the sort
+    key (very common), whole shards map to one range bucket and the
+    per-(source,dest) all_to_all capacity explodes; a round-robin pass makes
+    every source hold a representative slice, bounding per-pair traffic at
+    ~C/n.  Capacity is exact — this exchange cannot overflow.
+    """
+    from ..columnar import pad_capacity
+    xp = jnp
+    C = batch.capacity
+    bucket = (xp.arange(C, dtype=np.int32) % n_shards)
+    cap_out = pad_capacity(-(-C // n_shards))
+    out, _ = hash_exchange(batch, bucket, n_shards, cap_out, axis)
+    return out
+
+
+def broadcast_all(batch: ColumnBatch, axis: str = DATA_AXIS) -> ColumnBatch:
+    """Every shard receives the concatenation of all shards' rows."""
+    n = lax.axis_size(axis)
+
+    def gather(x):
+        return lax.all_gather(x, axis, tiled=True)
+
+    vectors = []
+    for v in batch.vectors:
+        data = gather(v.data)
+        valid = None if v.valid is None else gather(v.valid)
+        vectors.append(ColumnVector(data, v.dtype, valid, v.dictionary))
+    rv = gather(batch.row_valid_or_true())
+    return ColumnBatch(batch.names, vectors, rv, batch.capacity * n)
+
+
+def psum_arrays(arrays: List[Array], axis: str = DATA_AXIS) -> List[Array]:
+    return [lax.psum(a, axis) for a in arrays]
+
+
+def sampled_splitters(key: Array, live: Array, n_shards: int,
+                      samples_per_shard: int = 64, axis: str = DATA_AXIS) -> Array:
+    """Range-partition splitters from a global sample of sort keys
+    (``RangePartitioner.sketch`` analog: sample → gather → quantiles).
+
+    key: int64-comparable sort key per row (nulls/dead pre-sentineled).
+    Returns (n_shards-1,) splitter array, identical on every shard.
+    """
+    xp = jnp
+    C = key.shape[0]
+    # deterministic stratified sample: every k-th live row (sorted sample
+    # would bias; stride sampling is what RangePartitioner's reservoir
+    # approximates for static shapes)
+    stride = max(C // samples_per_shard, 1)
+    idx = xp.arange(samples_per_shard) * stride % C
+    sample = key[idx]
+    sample_live = live[idx]
+    big = np.int64(np.iinfo(np.int64).max)
+    sample = xp.where(sample_live, sample, big)   # dead samples sort last
+    all_samples = lax.all_gather(sample, axis, tiled=True)
+    all_samples = xp.sort(all_samples)
+    total = samples_per_shard * n_shards
+    pos = (xp.arange(1, n_shards) * total) // n_shards
+    return all_samples[pos]

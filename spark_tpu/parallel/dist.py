@@ -1,0 +1,569 @@
+"""Distributed physical operators + planner + executor.
+
+The EnsureRequirements analog (``exchange/EnsureRequirements.scala:33``):
+each operator that needs co-located data gets an exchange inserted under it —
+but instead of stage boundaries + Netty, exchanges are collectives inside
+the ONE shard_map program:
+
+* Aggregate  → partial (per-shard buffers) → hash exchange on keys → final
+  merge (the ``AggUtils`` partial/final split; buffers are sum/min/max-
+  mergeable by construction, see ``spark_tpu.aggregates``)
+* global Agg → partial → ``psum`` → finish (treeAggregate → ICI allreduce)
+* Join       → hash exchange BOTH sides on the key hash → per-shard local
+  join (shuffled hash join); small build sides instead ``all_gather``
+  (broadcast hash join, ``autoBroadcastJoinThreshold`` by row capacity)
+* Sort       → sampled splitters → range exchange → per-shard sort; shard
+  order == global order at collect
+* Limit      → per-shard count prefix via all_gather (global-exact)
+
+Partitioning properties (``plans/physical/partitioning.scala`` contract)
+are tracked so exchanges are skipped when the child already satisfies the
+requirement (e.g. aggregate after an exchange on the same keys).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .. import config as C
+from .. import types as T
+from ..aggregates import AggregateFunction, First
+from ..columnar import ColumnBatch, ColumnVector, pad_capacity
+from ..expressions import Col, EvalContext, Expression, Hash64
+from ..kernels import (
+    apply_limit, compact, grouped_aggregate, multi_key_argsort,
+    segment_reduce, sort_batch, sort_key_transform, take_batch,
+)
+from ..sql import physical as P
+from ..sql.planner import Planner, PlannedQuery
+from ..sql.logical import (
+    Aggregate, Distinct, Filter, Join, Limit, LocalRelation, LogicalPlan,
+    Project, RangeRelation, Sample, Sort, SubqueryAlias, Union,
+)
+from .collective import (
+    broadcast_all, hash_exchange, psum_arrays, sampled_splitters,
+)
+from .mesh import DATA_AXIS, get_mesh, mesh_shards
+
+Array = Any
+
+
+# ---------------------------------------------------------------------------
+# partitioning properties (the Distribution/Partitioning contract)
+# ---------------------------------------------------------------------------
+
+class Partitioning:
+    """Output partitioning property; used to elide redundant exchanges."""
+
+    def satisfies_hash(self, key_names: Tuple[str, ...]) -> bool:
+        return False
+
+
+class UnknownPartitioning(Partitioning):
+    pass
+
+
+class HashPartitioning(Partitioning):
+    def __init__(self, key_names: Tuple[str, ...]):
+        self.key_names = key_names
+
+    def satisfies_hash(self, key_names: Tuple[str, ...]) -> bool:
+        return self.key_names == key_names
+
+
+UNKNOWN = UnknownPartitioning()
+
+
+def _key_names(keys: Sequence[Expression]) -> Optional[Tuple[str, ...]]:
+    names = []
+    for k in keys:
+        if isinstance(k, Col):
+            names.append(k.name)
+        else:
+            return None
+    return tuple(names)
+
+
+# ---------------------------------------------------------------------------
+# distributed nodes (run INSIDE shard_map; ctx.xp is jnp)
+# ---------------------------------------------------------------------------
+
+class DNode(P.PhysicalPlan):
+    n_shards: int = 1
+
+    def partitioning(self) -> Partitioning:
+        return UNKNOWN
+
+
+class DRange(P.PRange):
+    """Each shard generates its contiguous slice of the range."""
+
+    def __init__(self, start, end, step, name, num_rows, n_shards):
+        super().__init__(start, end, step, name, num_rows)
+        self.n_shards = n_shards
+        self.rows_per_shard = -(-num_rows // n_shards)
+        self.capacity = pad_capacity(max(self.rows_per_shard, 1))
+
+    def run(self, ctx):
+        xp = ctx.xp
+        shard = lax.axis_index(DATA_AXIS)
+        base = shard.astype(np.int64) * self.rows_per_shard
+        idx = xp.arange(self.capacity, dtype=np.int64)
+        gidx = base + idx
+        data = gidx * self.step + self.start
+        rv = (idx < self.rows_per_shard) & (gidx < self.num_rows)
+        return ColumnBatch([self.name], [ColumnVector(data, T.int64)], rv,
+                           self.capacity)
+
+    def partitioning(self):
+        return UNKNOWN
+
+    def __repr__(self):
+        return f"DRange({self.start},{self.end},{self.step} x{self.n_shards})"
+
+
+class DExchangeHash(DNode):
+    """all_to_all repartition on key hash (ShuffleExchange)."""
+
+    def __init__(self, keys: Sequence[Expression], n_shards: int,
+                 skew_factor: float, child: P.PhysicalPlan):
+        self.keys = list(keys)
+        self.n_shards = n_shards
+        self.skew_factor = skew_factor
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def cap_out(self, child_cap: int) -> int:
+        even = -(-child_cap // self.n_shards)
+        return pad_capacity(max(int(even * self.skew_factor), 1))
+
+    def run(self, ctx):
+        batch = self.children[0].run(ctx)
+        ectx = EvalContext(batch, ctx.xp)
+        h = ectx.broadcast(Hash64(*self.keys).eval(ectx)).data
+        bucket = (h.astype(np.uint64) % np.uint64(self.n_shards)).astype(np.int32)
+        out, overflow = hash_exchange(batch, bucket, self.n_shards,
+                                      self.cap_out(batch.capacity))
+        ctx.flags.append(overflow)   # per-shard; executor psums once
+        return out
+
+    def partitioning(self):
+        kn = _key_names(self.keys)
+        return HashPartitioning(kn) if kn is not None else UNKNOWN
+
+    def __repr__(self):
+        return f"ExchangeHash [{', '.join(map(repr, self.keys))}] x{self.n_shards} f={self.skew_factor}"
+
+
+class DExchangeRange(DNode):
+    """Range repartition by sampled splitters (global sort step 1)."""
+
+    def __init__(self, orders: Sequence[Tuple[Expression, bool, bool]],
+                 n_shards: int, skew_factor: float, child: P.PhysicalPlan):
+        self.orders = list(orders)
+        self.n_shards = n_shards
+        self.skew_factor = skew_factor
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def run(self, ctx):
+        xp = ctx.xp
+        batch = self.children[0].run(ctx)
+        from .collective import round_robin_exchange
+        batch = round_robin_exchange(batch, self.n_shards)
+        ectx = EvalContext(batch, xp)
+        schema = batch.schema
+        # single-key composite: use the FIRST sort key for range partitioning
+        # (ties keep original shard → resolved by the local sort afterwards;
+        # exact multi-key splitters arrive with stats support)
+        e, asc, nf = self.orders[0]
+        v = ectx.broadcast(e.eval(ectx))
+        _, key = sort_key_transform(xp, v.data, v.valid, e.data_type(schema), asc, nf)
+        if str(key.dtype).startswith("float"):
+            key64 = _float_to_ordered_int(xp, key)
+        else:
+            key64 = key.astype(np.int64)
+        if v.valid is not None:
+            # nulls route to the extreme bucket on their side of the order
+            extreme = np.int64(np.iinfo(np.int64).min) if nf \
+                else np.int64(np.iinfo(np.int64).max)
+            key64 = xp.where(v.valid, key64, extreme)
+        live = batch.row_valid_or_true()
+        splitters = sampled_splitters(key64, live, self.n_shards)
+        bucket = xp.searchsorted(splitters, key64, side="right").astype(np.int32)
+        even = -(-batch.capacity // self.n_shards)
+        cap_out = pad_capacity(max(int(even * self.skew_factor), 1))
+        out, overflow = hash_exchange(batch, bucket, self.n_shards, cap_out)
+        ctx.flags.append(overflow)   # per-shard; executor psums once
+        return out
+
+    def __repr__(self):
+        parts = [f"{e!r} {'ASC' if a else 'DESC'} {'NF' if nf else 'NL'}"
+                 for e, a, nf in self.orders]
+        return f"ExchangeRange [{', '.join(parts)}] x{self.n_shards} f={self.skew_factor}"
+
+
+def _float_to_ordered_int(xp, f):
+    """Order-preserving float64 → int64 (sign-flip trick, RadixSort.java)."""
+    bits = lax.bitcast_convert_type(f.astype(jnp.float64), jnp.int64) if xp is jnp \
+        else np.asarray(f, np.float64).view(np.int64)
+    mask = xp.where(bits < 0, np.int64(-1), np.int64(np.int64(1) << np.int64(63)))
+    return bits ^ mask
+
+
+class DBroadcast(DNode):
+    """all_gather the child to every shard (BroadcastExchangeExec)."""
+
+    def __init__(self, child: P.PhysicalPlan):
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def run(self, ctx):
+        return broadcast_all(self.children[0].run(ctx))
+
+    def __repr__(self):
+        return "BroadcastExchange"
+
+
+class DPartialAggregate(DNode):
+    """Per-shard partial aggregation: emits group keys + RAW buffer columns
+    (mode=Partial of the reference's two-phase aggregation)."""
+
+    def __init__(self, keys, slots, child):
+        self.keys = list(keys)
+        self.slots = list(slots)
+        self.children = (child,)
+
+    def buffer_names(self, slot_idx: int, func: AggregateFunction) -> List[str]:
+        return [f"__buf_{slot_idx}_{j}" for j in range(func.num_buffers())]
+
+    def schema(self):
+        cs = self.children[0].schema()
+        fields = [T.StructField(k.name, k.data_type(cs)) for k in self.keys]
+        for i, (f, n) in enumerate(self.slots):
+            for j, bn in enumerate(self.buffer_names(i, f)):
+                fields.append(T.StructField(bn, T.int64))  # dtype refined at run
+        return T.StructType(fields)
+
+    def run(self, ctx):
+        xp = ctx.xp
+        batch = self.children[0].run(ctx)
+        ectx = EvalContext(batch, xp)
+        live = batch.row_valid_or_true()
+        capacity = batch.capacity
+
+        key_vals = [ectx.broadcast(k.eval(ectx)) for k in self.keys]
+        sort_cols = [(~live).astype(np.int8)]
+        for v in key_vals:
+            data = v.data.astype(np.int8) if str(v.data.dtype) == "bool" else v.data
+            if v.valid is None:
+                sort_cols += [xp.zeros(capacity, np.int8), data]
+            else:
+                sort_cols += [xp.where(v.valid, np.int8(0), np.int8(-1)),
+                              xp.where(v.valid, data, xp.zeros((), data.dtype))]
+        perm = multi_key_argsort(xp, sort_cols, capacity)
+        sorted_cols = [c[perm] for c in sort_cols]
+        live_s = live[perm]
+
+        if self.keys:
+            change = xp.zeros(capacity, bool)
+            for c in sorted_cols:
+                change = change | (c != xp.concatenate([c[:1], c[:-1]]))
+            is_start = change.at[0].set(True) if xp is jnp else _np_set0(change)
+            is_start = is_start & live_s
+            seg_ids = xp.cumsum(is_start.astype(np.int64)) - 1
+            seg_ids = xp.where(live_s, seg_ids, np.int64(capacity - 1))
+            num_groups = xp.sum(is_start.astype(np.int64))
+        else:
+            seg_ids = xp.zeros(capacity, np.int64)
+            is_start = None
+            num_groups = None
+
+        names: List[str] = []
+        vectors: List[ColumnVector] = []
+        from ..kernels import _scatter_starts
+        for k, v in zip(self.keys, key_vals):
+            dt = k.data_type(batch.schema)
+            data_s = v.data[perm]
+            valid_s = None if v.valid is None else v.valid[perm]
+            kd = _scatter_starts(xp, data_s, seg_ids, is_start, capacity)
+            kv = None if valid_s is None else _scatter_starts(xp, valid_s, seg_ids, is_start, capacity)
+            names.append(k.name)
+            vectors.append(ColumnVector(kd.astype(dt.np_dtype), dt, kv, v.dictionary))
+
+        for i, (func, n) in enumerate(self.slots):
+            if isinstance(func, First):
+                raise NotImplementedError(
+                    "first/last in distributed aggregation needs value-carry "
+                    "buffers; rewrite with min/max or collect locally")
+            specs = func.make_buffers(ectx, live)
+            for j, (bn, spec) in enumerate(zip(self.buffer_names(i, func), specs)):
+                reduced = segment_reduce(xp, spec.data[perm], seg_ids, capacity,
+                                         spec.kind)
+                names.append(bn)
+                vectors.append(ColumnVector(reduced, T.np_dtype_to_engine(spec.np_dtype)
+                                            if spec.np_dtype != np.bool_ else T.boolean,
+                                            None, None))
+        if self.keys:
+            rv = xp.arange(capacity, dtype=np.int64) < num_groups
+        else:
+            rv = xp.arange(capacity, dtype=np.int64) < 1
+        return ColumnBatch(names, vectors, rv, capacity)
+
+    def __repr__(self):
+        return (f"PartialAggregate keys=[{', '.join(map(repr, self.keys))}] "
+                f"aggs=[{', '.join(repr(f) for f, _ in self.slots)}]")
+
+
+def _np_set0(change):
+    change = change.copy()
+    change[0] = True
+    return change
+
+
+class DFinalAggregate(DNode):
+    """Merge partial buffers after the exchange and finish.
+
+    Re-groups by keys (partials from different shards collide here) and
+    reduces each buffer with ITS OWN kind — sum-of-sums, min-of-mins."""
+
+    def __init__(self, keys, slots, partial: DPartialAggregate, child):
+        self.keys = list(keys)
+        self.slots = list(slots)
+        self.partial = partial
+        self.children = (child,)
+
+    def schema(self):
+        cs_child = self.partial.children[0].schema()
+        fields = [T.StructField(k.name, k.data_type(cs_child)) for k in self.keys]
+        fields += [T.StructField(n, f.data_type(cs_child)) for f, n in self.slots]
+        return T.StructType(fields)
+
+    def run(self, ctx):
+        xp = ctx.xp
+        batch = self.children[0].run(ctx)   # partial rows, exchanged
+        ectx = EvalContext(batch, xp)
+        live = batch.row_valid_or_true()
+        capacity = batch.capacity
+
+        key_refs = [Col(k.name) for k in self.keys]
+        key_vals = [ectx.broadcast(k.eval(ectx)) for k in key_refs]
+        sort_cols = [(~live).astype(np.int8)]
+        for v in key_vals:
+            data = v.data.astype(np.int8) if str(v.data.dtype) == "bool" else v.data
+            if v.valid is None:
+                sort_cols += [xp.zeros(capacity, np.int8), data]
+            else:
+                sort_cols += [xp.where(v.valid, np.int8(0), np.int8(-1)),
+                              xp.where(v.valid, data, xp.zeros((), data.dtype))]
+        perm = multi_key_argsort(xp, sort_cols, capacity)
+        sorted_cols = [c[perm] for c in sort_cols]
+        live_s = live[perm]
+
+        if self.keys:
+            change = xp.zeros(capacity, bool)
+            for c in sorted_cols:
+                change = change | (c != xp.concatenate([c[:1], c[:-1]]))
+            is_start = change.at[0].set(True) if xp is jnp else _np_set0(change)
+            is_start = is_start & live_s
+            seg_ids = xp.cumsum(is_start.astype(np.int64)) - 1
+            seg_ids = xp.where(live_s, seg_ids, np.int64(capacity - 1))
+            num_groups = xp.sum(is_start.astype(np.int64))
+        else:
+            seg_ids = xp.zeros(capacity, np.int64)
+            is_start = None
+            num_groups = None
+
+        from ..kernels import _scatter_starts
+        names, vectors = [], []
+        cs_child = self.partial.children[0].schema()
+        for k, kref, v in zip(self.keys, key_refs, key_vals):
+            dt = k.data_type(cs_child)
+            data_s = v.data[perm]
+            valid_s = None if v.valid is None else v.valid[perm]
+            kd = _scatter_starts(xp, data_s, seg_ids, is_start, capacity)
+            kv = None if valid_s is None else _scatter_starts(xp, valid_s, seg_ids, is_start, capacity)
+            names.append(k.name)
+            vectors.append(ColumnVector(kd.astype(dt.np_dtype), dt, kv, v.dictionary))
+
+        for i, (func, n) in enumerate(self.slots):
+            bufs = []
+            specs_kinds = self._buffer_kinds(func)
+            for j, kind in enumerate(specs_kinds):
+                bname = self.partial.buffer_names(i, func)[j]
+                col = batch.column(bname)
+                masked = col.data
+                from ..aggregates import IDENTITY
+                np_dt = np.dtype(str(masked.dtype))
+                ident = IDENTITY[kind](np_dt)
+                masked = xp.where(live, masked, np.asarray(ident, np_dt))
+                reduced = segment_reduce(xp, masked[perm], seg_ids, capacity, kind)
+                bufs.append(reduced)
+            out = func.finish(xp, bufs)
+            dt = func.data_type(cs_child)
+            dictionary = out.dictionary
+            if dictionary is None:
+                # min/max over strings: dictionary comes from the partial's
+                # key-side eval; look it up on the buffer column
+                bname = self.partial.buffer_names(i, func)[0]
+                dictionary = batch.column(bname).dictionary
+            data = out.data.astype(dt.np_dtype)
+            names.append(n)
+            vectors.append(ColumnVector(data, dt, out.valid, dictionary))
+
+        if self.keys:
+            rv = xp.arange(capacity, dtype=np.int64) < num_groups
+        else:
+            rv = xp.arange(capacity, dtype=np.int64) < 1
+        return ColumnBatch(names, vectors, rv, capacity)
+
+    @staticmethod
+    def _buffer_kinds(func: AggregateFunction) -> List[str]:
+        """Reduction kind of each buffer (mirrors make_buffers order)."""
+        from ..aggregates import (Avg, Count, CountStar, Max, Min, Sum,
+                                  VarianceBase)
+        if isinstance(func, (Sum, Avg)):
+            return ["sum", "sum"]
+        if isinstance(func, (Count, CountStar)):
+            return ["sum"]
+        if isinstance(func, Min):
+            return ["min", "sum"]
+        if isinstance(func, Max):
+            return ["max", "sum"]
+        if isinstance(func, VarianceBase):
+            return ["sum", "sum", "sum"]
+        raise NotImplementedError(f"distributed merge for {func!r}")
+
+    def __repr__(self):
+        return (f"FinalAggregate keys=[{', '.join(map(repr, self.keys))}] "
+                f"aggs=[{', '.join(n for _, n in self.slots)}]")
+
+
+class DGlobalAggregate(DNode):
+    """No-key aggregation: partial buffers per shard → psum → finish."""
+
+    def __init__(self, slots, child):
+        self.slots = list(slots)
+        self.children = (child,)
+
+    def schema(self):
+        cs = self.children[0].schema()
+        return T.StructType([T.StructField(n, f.data_type(cs))
+                             for f, n in self.slots])
+
+    def run(self, ctx):
+        xp = ctx.xp
+        batch = self.children[0].run(ctx)
+        ectx = EvalContext(batch, xp)
+        live = batch.row_valid_or_true()
+        names, vectors = [], []
+        for func, n in self.slots:
+            specs = func.make_buffers(ectx, live)
+            reduced_local = [xp.sum(s.data) if s.kind == "sum"
+                             else (xp.min(s.data) if s.kind == "min" else xp.max(s.data))
+                             for s in specs]
+            reduced = [lax.psum(r, DATA_AXIS) if s.kind == "sum"
+                       else (lax.pmin(r, DATA_AXIS) if s.kind == "min"
+                             else lax.pmax(r, DATA_AXIS))
+                       for r, s in zip(reduced_local, specs)]
+            out = func.finish(xp, [xp.broadcast_to(r, (1,)) for r in reduced])
+            dt = func.data_type(batch.schema)
+            data = xp.broadcast_to(out.data[0].astype(dt.np_dtype), (8,))
+            valid = None if out.valid is None \
+                else xp.broadcast_to(out.valid[0], (8,))
+            names.append(n)
+            vectors.append(ColumnVector(data, dt, valid,
+                                        func.output_dictionary(ectx)))
+        shard = lax.axis_index(DATA_AXIS)
+        rv = (xp.arange(8) < 1) & (shard == 0)   # one global row, on shard 0
+        return ColumnBatch(names, vectors, rv, 8)
+
+    def __repr__(self):
+        return f"GlobalAggregate [{', '.join(n for _, n in self.slots)}]"
+
+
+def _np_set_first(arr, val):
+    arr = arr.copy()
+    arr[0] = val
+    return arr
+
+
+class DLimit(DNode):
+    """Globally exact limit: shards agree via all_gather of live counts."""
+
+    def __init__(self, n: int, child: P.PhysicalPlan):
+        self.n = n
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def run(self, ctx):
+        xp = ctx.xp
+        batch = self.children[0].run(ctx)
+        live = batch.row_valid_or_true()
+        count = xp.sum(live.astype(np.int64))
+        counts = lax.all_gather(count, DATA_AXIS)          # (n_shards,)
+        shard = lax.axis_index(DATA_AXIS)
+        prefix = xp.sum(xp.where(xp.arange(counts.shape[0]) < shard, counts, 0))
+        local_rank = xp.cumsum(live.astype(np.int64))       # 1-based
+        keep = live & (prefix + local_rank <= self.n)
+        return ColumnBatch(batch.names, batch.vectors, keep, batch.capacity)
+
+    def __repr__(self):
+        return f"GlobalLimit {self.n}"
+
+
+class DShardSort(DNode):
+    """Per-shard local sort (used after a range exchange)."""
+
+    def __init__(self, orders, child):
+        self.orders = list(orders)
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def run(self, ctx):
+        batch = self.children[0].run(ctx)
+        ectx = EvalContext(batch, ctx.xp)
+        schema = batch.schema
+        keys = []
+        for e, asc, nf in self.orders:
+            v = ectx.broadcast(e.eval(ectx))
+            keys.append((v.data, v.valid, e.data_type(schema), asc, nf))
+        return sort_batch(ctx.xp, batch, keys)
+
+    def __repr__(self):
+        parts = [f"{e!r} {'ASC' if a else 'DESC'} {'NF' if nf else 'NL'}"
+                 for e, a, nf in self.orders]
+        return f"ShardSort [{', '.join(parts)}]"
+
+
+class DShardCompact(DNode):
+    """Per-shard compaction (pre-collect)."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def run(self, ctx):
+        return compact(ctx.xp, self.children[0].run(ctx))
+
+    def __repr__(self):
+        return "ShardCompact"

@@ -1,0 +1,49 @@
+"""Device mesh management.
+
+One 1-D mesh axis ``"data"`` carries row-partitioning (Spark's partition
+axis).  Multi-host pods simply contribute their devices to the same mesh —
+``jax.distributed`` + ``Mesh(jax.devices())`` — and XLA routes collectives
+over ICI within a slice and DCN across slices; the engine code is identical.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+
+_current: Optional[Mesh] = None
+
+
+def get_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """The engine's 1-D data mesh (defaults to all local devices)."""
+    global _current
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if _current is not None and _current.devices.size == n:
+        return _current
+    _current = Mesh(np.array(devs[:n]), (DATA_AXIS,))
+    return _current
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    global _current
+    _current = mesh
+
+
+def mesh_shards(mesh: Mesh) -> int:
+    return int(mesh.devices.size)
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Row-sharded: first axis split over the data axis."""
+    return NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

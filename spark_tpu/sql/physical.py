@@ -54,6 +54,11 @@ class PhysicalPlan:
     def row_offset(self) -> int:
         return self.op_id << 33
 
+    def offset_in(self, ctx: "ExecContext"):
+        """Operator offset + shard offset (traced under shard_map)."""
+        shard = getattr(ctx, "shard_offset", 0)
+        return self.row_offset + shard
+
     def schema(self) -> T.StructType:
         raise NotImplementedError
 
@@ -133,7 +138,7 @@ class PProject(PhysicalPlan):
 
     def run(self, ctx):
         batch = self.children[0].run(ctx)
-        out = apply_project(ctx.xp, batch, self.exprs, self.row_offset)
+        out = apply_project(ctx.xp, batch, self.exprs, self.offset_in(ctx))
         out.names = [e.name for e in self.exprs]
         return out
 
@@ -151,7 +156,7 @@ class PFilter(PhysicalPlan):
 
     def run(self, ctx):
         return apply_filter(ctx.xp, self.children[0].run(ctx), self.cond,
-                            self.row_offset)
+                            self.offset_in(ctx))
 
     def __repr__(self):
         return f"Filter ({self.cond!r})"
@@ -202,7 +207,8 @@ class PSort(PhysicalPlan):
         return sort_batch(ctx.xp, batch, keys)
 
     def __repr__(self):
-        parts = [f"{e!r} {'ASC' if a else 'DESC'}" for e, a, n in self.orders]
+        parts = [f"{e!r} {'ASC' if a else 'DESC'} {'NF' if n else 'NL'}"
+                 for e, a, n in self.orders]
         return f"Sort [{', '.join(parts)}]"
 
 
@@ -307,7 +313,7 @@ class PSample(PhysicalPlan):
         from ..expressions import Literal
         cond = LT(Rand(self.seed), Literal(float(self.fraction)))
         return apply_filter(ctx.xp, self.children[0].run(ctx), cond,
-                            self.row_offset)
+                            self.offset_in(ctx))
 
     def __repr__(self):
         return f"Sample({self.fraction}, seed={self.seed})"

@@ -147,6 +147,14 @@ class QueryExecution:
     # ------------------------------------------------------------------
     def execute(self) -> ColumnBatch:
         """Run the query; returns a COMPACTED host batch."""
+        n_shards = self.session.conf.get(C.MESH_SHARDS)
+        if n_shards == 0:
+            n_shards = len(jax.devices())
+        if n_shards > 1:
+            from ..parallel.executor import DistributedExecution
+            from ..parallel.mesh import get_mesh
+            return DistributedExecution(
+                self.session, get_mesh(n_shards)).execute(self.optimized)
         pq = self.planned
         use_jit = self.session.conf.get(C.CODEGEN_ENABLED)
         if not use_jit:

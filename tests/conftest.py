@@ -38,6 +38,12 @@ def rng():
 
 @pytest.fixture(scope="session")
 def spark():
-    """Shared session (SharedSparkContext/SharedSQLContext analog)."""
+    """Shared session (SharedSparkContext/SharedSQLContext analog).
+
+    Pinned to single-shard local execution; distributed suites opt into the
+    8-device mesh via their own fixture (see test_distributed.py).
+    """
     from spark_tpu.sql.session import SparkSession
-    return SparkSession.builder.appName("tests").getOrCreate()
+    s = SparkSession.builder.appName("tests").getOrCreate()
+    s.conf.set("spark.tpu.mesh.shards", "1")
+    return s
