@@ -709,6 +709,29 @@ class IsNaN(Expression):
         return ExprValue(xp.isnan(d), None)
 
 
+def _align_value_dicts(xp, vals):
+    """Re-encode ExprValues that carry different string dictionaries onto one
+    merged dictionary (host-merged, device-gathered; static under jit).
+    Returns (vals, merged_dictionary_or_None)."""
+    dicts = [v.dictionary for v in vals if v.dictionary is not None]
+    if not dicts:
+        return vals, None
+    if all(d == dicts[0] for d in dicts):
+        return vals, dicts[0]
+    merged = tuple(sorted(set().union(*[set(d) for d in dicts])))
+    lookup = {w: i for i, w in enumerate(merged)}
+    out = []
+    for v in vals:
+        if v.dictionary is None:
+            out.append(v)
+            continue
+        remap = xp.asarray(
+            np.fromiter((lookup[w] for w in v.dictionary), np.int32,
+                        count=len(v.dictionary)))
+        out.append(ExprValue(remap[xp.clip(v.data, 0, None)], v.valid, merged))
+    return out, merged
+
+
 class Coalesce(Expression):
     def __init__(self, *children):
         self.children = tuple(children)
@@ -726,9 +749,8 @@ class Coalesce(Expression):
         xp = ctx.xp
         dt = self.data_type(ctx.batch.schema)
         vals = [c.eval(ctx) for c in self.children]
-        dicts = [v.dictionary for v in vals if v.dictionary is not None]
-        if dicts and not all(d == dicts[0] for d in dicts):
-            raise AnalysisException("coalesce over unaligned string dictionaries")
+        vals, merged = _align_value_dicts(xp, vals)
+        dicts = [merged] if merged is not None else []
         out = ExprValue(vals[-1].data.astype(dt.np_dtype), vals[-1].valid,
                         dicts[0] if dicts else None)
         for v in reversed(vals[:-1]):
@@ -760,9 +782,8 @@ class If(Expression):
         xp = ctx.xp
         p, a, b = (c.eval(ctx) for c in self.children)
         dt = self.data_type(ctx.batch.schema)
-        dicts = [v.dictionary for v in (a, b) if v.dictionary is not None]
-        if dicts and not all(d == dicts[0] for d in dicts):
-            raise AnalysisException("IF over unaligned string dictionaries")
+        (a, b), merged = _align_value_dicts(xp, [a, b])
+        dicts = [merged] if merged is not None else []
         cond = p.data & (p.valid if p.valid is not None else True)
         data = xp.where(cond, a.data.astype(dt.np_dtype), b.data.astype(dt.np_dtype))
         av = a.valid if a.valid is not None else xp.ones((), bool)

@@ -27,7 +27,8 @@ from ..expressions import (
 )
 from .logical import (
     Aggregate, Distinct, Filter, Join, Limit, LocalRelation, LogicalPlan,
-    Project, Sample, Sort, SubqueryAlias, Union, UnresolvedRelation,
+    Project, Sample, Sort, SortOrder, SubqueryAlias, Union,
+    UnresolvedRelation,
 )
 
 def fresh_name(prefix: str, basis: str, index: int) -> str:
@@ -125,22 +126,152 @@ def rewrite_distinct_aggregates(plan: Aggregate) -> LogicalPlan:
     return Aggregate(outer_keys, outer_slots, inner)
 
 
+class _JoinSideRename(Project):
+    """Marker Project inserted by join disambiguation: renames overlapping
+    columns to their qualified names while passing other qualifiers through."""
+
+
+def qualifier_map(plan: LogicalPlan) -> Dict[str, str]:
+    """``alias.column`` → ``column`` visible from a plan subtree.
+
+    The slim analog of Catalyst attribute qualifiers: a SubqueryAlias
+    qualifies its output; schema-preserving nodes pass qualifiers through;
+    Join unions both sides; Project/Aggregate reset the scope.
+    """
+    if isinstance(plan, _JoinSideRename):
+        inner = qualifier_map(plan.children[0])
+        visible = set(plan.schema().names)
+        return {q: n for q, n in inner.items() if n in visible}
+    if isinstance(plan, SubqueryAlias):
+        return {f"{plan.alias}.{n}": n for n in plan.schema().names}
+    if isinstance(plan, (Filter, Sort, Limit, Distinct, Sample)):
+        return qualifier_map(plan.children[0])
+    if isinstance(plan, Join):
+        left = qualifier_map(plan.children[0])
+        right = qualifier_map(plan.children[1])
+        merged = dict(left)
+        merged.update(right)
+        return merged
+    return {}
+
+
 class Analyzer:
     def __init__(self, catalog=None):
         self.catalog = catalog
 
     def analyze(self, plan: LogicalPlan) -> LogicalPlan:
         plan = self._resolve_relations(plan)
+        plan = plan.transform_up(self._disambiguate_joins)
+        plan = plan.transform_up(self._expand_stars)
+        plan = plan.transform_up(self._resolve_qualified)
         plan = plan.transform_up(self._rewrite_node)
         self._validate(plan)
         return plan
 
-    def _resolve_relations(self, plan: LogicalPlan) -> LogicalPlan:
+    def _expand_stars(self, node: LogicalPlan) -> LogicalPlan:
+        """Expand `*` / `tbl.*` left by the parser over unresolved relations
+        (ResolveStar analog; runs after catalog resolution)."""
+        from .parser import _Star
+        if not isinstance(node, Project) \
+                or not any(isinstance(e, _Star) for e in node.exprs):
+            return node
+        child = node.children[0]
+        names = child.schema().names
+        new: List[Expression] = []
+        for e in node.exprs:
+            if not isinstance(e, _Star):
+                new.append(e)
+            elif e.qualifier is None:
+                new += [Col(n) for n in names]
+            else:
+                qmap = qualifier_map(child)
+                pref = e.qualifier + "."
+                # preserve child column order; a column belongs to the
+                # qualifier if its (possibly join-renamed) name carries the
+                # prefix literally, or a qualified alias maps to it
+                qualified_plain = {v for k, v in qmap.items()
+                                   if k.startswith(pref)}
+                hits = [n for n in names
+                        if n.startswith(pref) or n in qualified_plain]
+                if not hits:
+                    raise AnalysisException(
+                        f"cannot resolve {e.qualifier}.* among ({', '.join(names)})")
+                new += [Col(n) for n in hits]
+        return Project(new, child)
+
+    def _disambiguate_joins(self, node: LogicalPlan) -> LogicalPlan:
+        """When both join sides expose a same-named column, rename each side's
+        copy to its qualified name (``t.k`` / ``d.k``) so references bind
+        unambiguously — the by-name analog of Catalyst exprId identity."""
+        if not isinstance(node, Join) or node.using:
+            return node
+        try:
+            ls = node.children[0].schema()
+            rs = node.children[1].schema()
+        except AnalysisException:
+            return node
+        overlap = set(ls.names) & set(rs.names)
+        if not overlap:
+            return node
+
+        def rename(child, schema):
+            rev: Dict[str, str] = {}
+            for q, plain in qualifier_map(child).items():
+                rev.setdefault(plain, q)
+            exprs: List[Expression] = []
+            changed = False
+            for n in schema.names:
+                if n in overlap and n in rev:
+                    exprs.append(Alias(Col(n), rev[n]))
+                    changed = True
+                else:
+                    exprs.append(Col(n))
+            return _JoinSideRename(exprs, child) if changed else child
+
+        left = rename(node.children[0], ls)
+        right = rename(node.children[1], rs)
+        if left is node.children[0] and right is node.children[1]:
+            return node
+        return Join(left, right, node.how, node.on, node.using)
+
+    def _resolve_qualified(self, node: LogicalPlan) -> LogicalPlan:
+        if not node.children or not node.expressions():
+            return node
+        qmap: Dict[str, str] = {}
+        for c in node.children:
+            try:
+                qmap.update(qualifier_map(c))
+            except AnalysisException:
+                return node
+        # plain names visible from children (qualified ref may also be the
+        # literal column name, e.g. after a previous rewrite)
+        try:
+            plain = {n for c in node.children for n in c.schema().names}
+        except AnalysisException:
+            return node
+        if not qmap:
+            return node
+
+        def rewrite(e: Expression) -> Expression:
+            if isinstance(e, Col) and e.name not in plain and e.name in qmap:
+                return Col(qmap[e.name])
+            if isinstance(e, AggregateFunction) or e.children:
+                return e.map_children(rewrite)
+            return e
+
+        return node.map_expressions(rewrite)
+
+    def _resolve_relations(self, plan: LogicalPlan, _depth: int = 0) -> LogicalPlan:
+        if _depth > 32:
+            raise AnalysisException("cyclic or too deeply nested view definitions")
+
         def fn(node: LogicalPlan) -> LogicalPlan:
             if isinstance(node, UnresolvedRelation):
                 if self.catalog is None:
                     raise AnalysisException(f"table not found: {node.name}")
-                resolved = self.catalog.lookup(node.name)
+                # view bodies may themselves reference views: recurse
+                resolved = self._resolve_relations(
+                    self.catalog.lookup(node.name), _depth + 1)
                 return SubqueryAlias(node.name, resolved)
             return node
         return plan.transform_up(fn)
@@ -148,7 +279,49 @@ class Analyzer:
     def _rewrite_node(self, node: LogicalPlan) -> LogicalPlan:
         if isinstance(node, Aggregate):
             return rewrite_distinct_aggregates(node)
+        if isinstance(node, Sort):
+            return self._resolve_sort_references(node)
         return node
+
+    def _resolve_sort_references(self, node: Sort) -> LogicalPlan:
+        """ORDER BY may reference input columns dropped by the SELECT list
+        (Spark's ResolveSortReferences): push the Sort below the Project,
+        substituting select-list aliases with their defining expressions."""
+        child = node.children[0]
+        if not isinstance(child, Project):
+            return node
+        proj = child
+        out_names = set(proj.schema().names)
+        refs = set()
+        for o in node.orders:
+            refs |= o.child.references()
+        missing = refs - out_names
+        if not missing:
+            return node
+        try:
+            input_names = set(proj.children[0].schema().names)
+        except AnalysisException:
+            return node
+        qmap = qualifier_map(proj.children[0])
+        if not all(m in input_names or m in qmap for m in missing):
+            return node  # genuinely unresolvable; validation will report
+        amap: Dict[str, Expression] = {}
+        for e in proj.exprs:
+            if isinstance(e, Alias):
+                amap[e.name] = e.children[0]
+
+        def subst(e: Expression) -> Expression:
+            if isinstance(e, Col):
+                if e.name in amap:
+                    return amap[e.name]
+                if e.name not in input_names and e.name in qmap:
+                    return Col(qmap[e.name])
+            return e.map_children(subst)
+
+        new_orders = [SortOrder(subst(o.child), o.ascending, o.nulls_first)
+                      for o in node.orders]
+        return Project(proj.exprs, Sort(new_orders, proj.children[0],
+                                        node.is_global))
 
     def _validate(self, plan: LogicalPlan) -> None:
         # forces schema computation everywhere → surfacing unresolved

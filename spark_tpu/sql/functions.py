@@ -196,7 +196,7 @@ def sum(c) -> Column:  # noqa: A001
 
 def count(c) -> Column:
     e = _e(c) if not (isinstance(c, str) and c == "*") else None
-    if e is None or (isinstance(e, E.Literal)):
+    if e is None or (isinstance(e, E.Literal) and e.value is not None):
         return Column(A.CountStar())
     return Column(A.Count(e))
 

@@ -154,7 +154,9 @@ class Project(LogicalPlan):
         return list(self.exprs)
 
     def map_expressions(self, fn):
-        return Project([fn(e) for e in self.exprs], self.children[0])
+        # type(self): subclasses (e.g. the analyzer's _JoinSideRename marker)
+        # must survive expression rewrites
+        return type(self)([fn(e) for e in self.exprs], self.children[0])
 
     def schema(self) -> T.StructType:
         cs = self.child.schema()
@@ -323,16 +325,15 @@ class Union(LogicalPlan):
     def __init__(self, children: Sequence[LogicalPlan]):
         if len(children) < 2:
             raise AnalysisException("union needs >=2 children")
-        first = children[0].schema()
-        for c in children[1:]:
-            s = c.schema()
-            if len(s) != len(first):
-                raise AnalysisException(
-                    f"union arity mismatch: {len(first)} vs {len(s)}")
         self.children = tuple(children)
 
     def schema(self) -> T.StructType:
         schemas = [c.schema() for c in self.children]
+        first = schemas[0]
+        for s in schemas[1:]:
+            if len(s) != len(first):
+                raise AnalysisException(
+                    f"union arity mismatch: {len(first)} vs {len(s)}")
         fields = []
         for i, f in enumerate(schemas[0].fields):
             dt = f.dataType

@@ -175,9 +175,61 @@ class SparkSession:
         return DataFrame(self, L.LocalRelation(batch))
 
     def sql(self, query: str) -> DataFrame:
-        from .parser import parse_query
-        plan = parse_query(query)
-        return DataFrame(self, plan)
+        from . import parser as P
+        st = P.parse_statement(query)
+        if not isinstance(st, P.Command):
+            return DataFrame(self, st)
+        return self._run_command(st)
+
+    def _run_command(self, cmd) -> DataFrame:
+        from . import parser as P
+        from ..columnar import ColumnBatch
+
+        def string_df(cols: dict) -> DataFrame:
+            names = list(cols)
+            struct = T.StructType(
+                [T.StructField(n, T.string) for n in names])
+            vals = list(cols.values())
+            if vals and len(vals[0]) == 0:
+                return DataFrame(self, L.LocalRelation(ColumnBatch.empty(struct)))
+            return DataFrame(
+                self, L.LocalRelation(ColumnBatch.from_arrays(cols, schema=struct)))
+
+        if isinstance(cmd, P.CreateViewCommand):
+            if not cmd.replace and cmd.name.lower() in {
+                    t.lower() for t in self.catalog.listTables()}:
+                raise AnalysisException(f"temp view {cmd.name} already exists")
+            self.catalog.register(cmd.name, cmd.query)
+            return string_df({})
+        if isinstance(cmd, P.DropViewCommand):
+            found = self.catalog.drop(cmd.name)
+            if not found and not cmd.if_exists:
+                raise AnalysisException(f"view not found: {cmd.name}")
+            return string_df({})
+        if isinstance(cmd, P.ShowTablesCommand):
+            names = self.catalog.listTables()
+            return string_df({"tableName": names,
+                              "isTemporary": ["true"] * len(names)})
+        if isinstance(cmd, P.DescribeCommand):
+            schema = DataFrame(self, self.catalog.lookup(cmd.name)).schema
+            return string_df({
+                "col_name": [f.name for f in schema.fields],
+                "data_type": [f.dataType.simpleString() for f in schema.fields],
+                "comment": [""] * len(schema.fields)})
+        if isinstance(cmd, P.SetCommand):
+            if cmd.key is not None and cmd.value is not None:
+                self.conf.set(cmd.key, cmd.value)
+            key = cmd.key if cmd.key is not None else ""
+            value = str(self.conf.get(cmd.key, "<undefined>")) \
+                if cmd.key is not None else ""
+            return string_df({"key": [key], "value": [value]})
+        if isinstance(cmd, P.ExplainCommand):
+            from .planner import QueryExecution
+            qe = QueryExecution(self, cmd.query)
+            text = qe.explain_string() if cmd.extended else \
+                "== Physical Plan ==\n" + qe.planned.physical.tree_string()
+            return string_df({"plan": [text]})
+        raise AnalysisException(f"unsupported command {type(cmd).__name__}")
 
     def table(self, name: str) -> DataFrame:
         return DataFrame(self, L.UnresolvedRelation(name))
