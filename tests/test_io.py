@@ -1,0 +1,122 @@
+"""Datasource IO round-trips (datasources/{parquet,csv,json,text} analog)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from spark_tpu.expressions import AnalysisException
+
+
+def rows(df):
+    return sorted((tuple(r) for r in df.collect()),
+                  key=lambda t: tuple(str(x) for x in t))
+
+
+@pytest.fixture()
+def sample(spark):
+    return spark.createDataFrame({
+        "id": np.arange(6, dtype=np.int64),
+        "grp": ["a", "b", "a", "c", "b", "a"],
+        "x": np.array([0.5, 1.5, 2.5, 3.5, 4.5, 5.5], np.float64),
+    })
+
+
+def test_parquet_roundtrip(spark, sample, tmp_path):
+    p = str(tmp_path / "t.parquet")
+    sample.write.parquet(p)
+    assert os.path.exists(os.path.join(p, "_SUCCESS"))
+    back = spark.read.parquet(p)
+    assert back.schema.names == ["id", "grp", "x"]
+    assert rows(back) == rows(sample)
+
+
+def test_parquet_overwrite_and_modes(spark, sample, tmp_path):
+    p = str(tmp_path / "m.parquet")
+    sample.write.parquet(p)
+    with pytest.raises(AnalysisException):
+        sample.write.parquet(p)
+    sample.write.mode("ignore").parquet(p)
+    sample.write.mode("overwrite").parquet(p)
+    assert len(rows(spark.read.parquet(p))) == 6
+    sample.write.mode("append").parquet(p)
+    assert len(rows(spark.read.parquet(p))) == 12
+
+
+def test_csv_roundtrip_header(spark, sample, tmp_path):
+    p = str(tmp_path / "t.csv")
+    sample.write.option("header", True).csv(p)
+    back = spark.read.csv(p, header=True, inferSchema=True)
+    assert back.schema.names == ["id", "grp", "x"]
+    assert rows(back) == rows(sample)
+
+
+def test_csv_no_infer_all_strings(spark, sample, tmp_path):
+    p = str(tmp_path / "s.csv")
+    sample.write.option("header", True).csv(p)
+    back = spark.read.csv(p, header=True)
+    assert all(dt == "string" for _, dt in back.dtypes)
+
+
+def test_json_roundtrip(spark, sample, tmp_path):
+    p = str(tmp_path / "t.json")
+    sample.write.json(p)
+    back = spark.read.json(p)
+    assert set(back.schema.names) == {"id", "grp", "x"}
+    got = rows(back.select("id", "grp", "x"))
+    assert got == rows(sample)
+
+
+def test_text_roundtrip(spark, tmp_path):
+    df = spark.createDataFrame({"value": ["hello", "tpu", "world"]})
+    p = str(tmp_path / "t.txt")
+    df.write.text(p)
+    back = spark.read.text(p)
+    assert rows(back) == rows(df)
+
+
+def test_partitioned_write_and_discovery(spark, sample, tmp_path):
+    p = str(tmp_path / "part.parquet")
+    sample.write.partitionBy("grp").parquet(p)
+    assert os.path.isdir(os.path.join(p, "grp=a"))
+    back = spark.read.parquet(p)
+    assert set(back.schema.names) == {"id", "x", "grp"}
+    assert rows(back.select("id", "grp", "x")) == rows(sample)
+    # partition pruning via filter works through the normal pipeline
+    a = back.filter(back["grp"] == "a")
+    assert len(a.collect()) == 3
+
+
+def test_int_partition_values_inferred(spark, tmp_path):
+    df = spark.createDataFrame({"v": [1.0, 2.0, 3.0, 4.0],
+                                "year": np.array([2020, 2020, 2021, 2021],
+                                                 np.int64)})
+    p = str(tmp_path / "byyear")
+    df.write.partitionBy("year").parquet(p)
+    back = spark.read.parquet(p)
+    assert dict(back.dtypes)["year"] == "bigint"
+    assert len(back.filter(back["year"] == 2021).collect()) == 2
+
+
+def test_sql_over_file_relation(spark, sample, tmp_path):
+    p = str(tmp_path / "q.parquet")
+    sample.write.parquet(p)
+    spark.read.parquet(p).createOrReplaceTempView("filetbl")
+    out = spark.sql("SELECT grp, count(*) AS c, sum(x) AS s FROM filetbl "
+                    "GROUP BY grp ORDER BY grp")
+    got = [tuple(r) for r in out.collect()]
+    assert got[0][0] == "a" and got[0][1] == 3
+    spark.catalog.drop("filetbl")
+
+
+def test_reader_schema_string(spark):
+    r = spark.read.schema("a int, b string")
+    assert r._schema.names == ["a", "b"]
+
+
+def test_nulls_roundtrip(spark, tmp_path):
+    df = spark.createDataFrame([(1, "x"), (2, None), (None, "z")], ["a", "b"])
+    p = str(tmp_path / "n.parquet")
+    df.write.parquet(p)
+    back = spark.read.parquet(p)
+    assert rows(back) == rows(df)
