@@ -317,9 +317,8 @@ class DataFrame:
 
     @property
     def rdd(self):
-        from ..rdd.context import RDD
         rows = self.collect()
-        return self.session._sc.parallelize(rows)
+        return self.session.sparkContext.parallelize(rows)
 
     def __repr__(self):
         cols = ", ".join(f"{f.name}: {f.dataType.simpleString()}"

@@ -1,0 +1,214 @@
+"""RDD core API tests (mirrors `core/src/test/.../rdd/RDDSuite.scala` and
+`PairRDDFunctionsSuite.scala` coverage shapes)."""
+
+import os
+
+import pytest
+
+from spark_tpu.rdd import Accumulator, HashPartitioner, SparkContext
+
+
+@pytest.fixture(scope="module")
+def sc():
+    ctx = SparkContext.getOrCreate(master="local[4]", appName="rdd-tests")
+    yield ctx
+
+
+def test_parallelize_partitions(sc):
+    r = sc.parallelize(range(10), 3)
+    assert r.getNumPartitions() == 3
+    assert r.collect() == list(range(10))
+    assert sorted(len(p) for p in r.glom().collect()) == [3, 3, 4]
+
+
+def test_map_filter_flatmap(sc):
+    r = sc.parallelize(range(8), 2)
+    assert r.map(lambda x: x * 2).collect() == [0, 2, 4, 6, 8, 10, 12, 14]
+    assert r.filter(lambda x: x % 2 == 0).collect() == [0, 2, 4, 6]
+    assert r.flatMap(lambda x: [x, x]).count() == 16
+
+
+def test_reduce_fold_aggregate(sc):
+    r = sc.parallelize(range(1, 101), 7)
+    assert r.reduce(lambda a, b: a + b) == 5050
+    assert r.fold(0, lambda a, b: a + b) == 5050
+    assert r.aggregate((0, 0),
+                       lambda acc, v: (acc[0] + v, acc[1] + 1),
+                       lambda a, b: (a[0] + b[0], a[1] + b[1])) == (5050, 100)
+
+
+def test_tree_aggregate(sc):
+    r = sc.parallelize(range(1000), 16)
+    total = r.treeAggregate(0, lambda a, v: a + v, lambda a, b: a + b, depth=3)
+    assert total == 499500
+
+
+def test_reduce_by_key(sc):
+    r = sc.parallelize([("a", 1), ("b", 2), ("a", 3), ("c", 4), ("b", 5)], 3)
+    assert sorted(r.reduceByKey(lambda a, b: a + b).collect()) == \
+        [("a", 4), ("b", 7), ("c", 4)]
+
+
+def test_group_by_key_and_combine(sc):
+    r = sc.parallelize([(1, "x"), (2, "y"), (1, "z")], 2)
+    got = {k: sorted(v) for k, v in r.groupByKey().collect()}
+    assert got == {1: ["x", "z"], 2: ["y"]}
+    c = r.combineByKey(lambda v: [v], lambda acc, v: acc + [v],
+                       lambda a, b: a + b)
+    assert {k: sorted(v) for k, v in c.collect()} == got
+
+
+def test_joins(sc):
+    a = sc.parallelize([("k1", 1), ("k2", 2)], 2)
+    b = sc.parallelize([("k1", "x"), ("k3", "y")], 2)
+    assert a.join(b).collect() == [("k1", (1, "x"))]
+    assert sorted(a.leftOuterJoin(b).collect()) == \
+        [("k1", (1, "x")), ("k2", (2, None))]
+    assert sorted(b.rightOuterJoin(a).collect()) == \
+        [("k1", ("x", 1)), ("k2", (None, 2))]
+    assert len(a.fullOuterJoin(b).collect()) == 3
+
+
+def test_cogroup(sc):
+    a = sc.parallelize([("k", 1), ("k", 2)], 2)
+    b = sc.parallelize([("k", "x")], 1)
+    [(k, (l, r))] = a.cogroup(b).collect()
+    assert k == "k" and sorted(l) == [1, 2] and r == ["x"]
+
+
+def test_sort_by_key_global_order(sc):
+    import random
+    rng = random.Random(3)
+    data = [(rng.randrange(1000), i) for i in range(500)]
+    r = sc.parallelize(data, 8).sortByKey()
+    keys = [k for k, _ in r.collect()]
+    assert keys == sorted(keys)
+    desc = sc.parallelize(data, 8).sortByKey(ascending=False)
+    dkeys = [k for k, _ in desc.collect()]
+    assert dkeys == sorted(dkeys, reverse=True)
+
+
+def test_sort_by(sc):
+    r = sc.parallelize([5, 3, 8, 1], 2).sortBy(lambda x: -x)
+    assert r.collect() == [8, 5, 3, 1]
+
+
+def test_distinct_union_intersection_subtract(sc):
+    a = sc.parallelize([1, 2, 2, 3, 3, 3], 3)
+    b = sc.parallelize([3, 4], 2)
+    assert sorted(a.distinct().collect()) == [1, 2, 3]
+    assert sorted(a.union(b).collect()) == [1, 2, 2, 3, 3, 3, 3, 4]
+    assert sorted(a.intersection(b).collect()) == [3]
+    assert sorted(a.subtract(b).collect()) == [1, 2, 2]
+
+
+def test_cartesian_zip(sc):
+    a = sc.parallelize([1, 2], 2)
+    b = sc.parallelize(["x", "y"], 2)
+    assert sorted(a.cartesian(b).collect()) == \
+        [(1, "x"), (1, "y"), (2, "x"), (2, "y")]
+    assert a.zip(b).collect() == [(1, "x"), (2, "y")]
+    assert a.zipWithIndex().collect() == [(1, 0), (2, 1)]
+
+
+def test_take_top_first(sc):
+    r = sc.parallelize([7, 2, 9, 1, 5], 3)
+    assert r.first() == 7
+    assert r.take(3) == [7, 2, 9]
+    assert r.top(2) == [9, 7]
+    assert r.takeOrdered(2) == [1, 2]
+    assert not r.isEmpty()
+    assert sc.emptyRDD().isEmpty()
+
+
+def test_stats(sc):
+    r = sc.parallelize([1.0, 2.0, 3.0, 4.0], 2)
+    s = r.stats()
+    assert s.count() == 4 and s.mean() == 2.5
+    assert s.min() == 1.0 and s.max() == 4.0
+    assert r.sum() == 10.0
+    assert r.mean() == 2.5
+
+
+def test_partition_by_preserves(sc):
+    r = sc.parallelize([(i, i) for i in range(20)], 4)
+    p = r.partitionBy(5)
+    assert p.getNumPartitions() == 5
+    assert p.partitioner == HashPartitioner(5)
+    # mapValues preserves partitioner, map does not
+    assert p.mapValues(lambda v: v + 1).partitioner == HashPartitioner(5)
+    assert p.map(lambda kv: kv).partitioner is None
+
+
+def test_coalesce_repartition(sc):
+    r = sc.parallelize(range(12), 6)
+    assert r.coalesce(2).getNumPartitions() == 2
+    assert sorted(r.coalesce(2).collect()) == list(range(12))
+    assert r.repartition(3).getNumPartitions() == 3
+    assert sorted(r.repartition(3).collect()) == list(range(12))
+
+
+def test_accumulator_broadcast(sc):
+    acc = sc.accumulator(0)
+    b = sc.broadcast({"offset": 100})
+    r = sc.parallelize(range(10), 4)
+
+    def f(x):
+        acc.add(1)
+        return x + b.value["offset"]
+    out = r.map(f).collect()
+    assert out[0] == 100 and len(out) == 10
+    assert acc.value == 10
+
+
+def test_count_by_key_value(sc):
+    r = sc.parallelize([("a", 1), ("a", 2), ("b", 1)], 2)
+    assert r.countByKey() == {"a": 2, "b": 1}
+    assert sc.parallelize([1, 1, 2], 2).countByValue() == {1: 2, 2: 1}
+
+
+def test_sample_deterministic(sc):
+    r = sc.parallelize(range(1000), 4)
+    s1 = r.sample(False, 0.1, seed=42).collect()
+    s2 = r.sample(False, 0.1, seed=42).collect()
+    assert s1 == s2
+    assert 40 < len(s1) < 200
+
+
+def test_text_file_roundtrip(sc, tmp_path):
+    r = sc.parallelize(["alpha", "beta", "gamma"], 2)
+    p = str(tmp_path / "txt")
+    r.saveAsTextFile(p)
+    assert os.path.exists(os.path.join(p, "_SUCCESS"))
+    back = sc.textFile(p)
+    assert sorted(back.collect()) == ["alpha", "beta", "gamma"]
+
+
+def test_cache_and_debug_string(sc):
+    r = sc.parallelize(range(4), 2).map(lambda x: x + 1)
+    r.cache()
+    assert r.collect() == [1, 2, 3, 4]
+    assert "MapRDD" in r.toDebugString()
+
+
+def test_to_df_bridge(sc, spark):
+    r = sc.parallelize([(1, "a"), (2, "b")], 2)
+    df = r.toDF(["id", "s"])
+    assert [tuple(x) for x in df.collect()] == [(1, "a"), (2, "b")]
+
+
+def test_df_to_rdd_bridge(spark):
+    df = spark.createDataFrame({"x": [1, 2, 3]})
+    assert spark.sparkContext is not None
+    assert sorted(r[0] for r in df.rdd.collect()) == [1, 2, 3]
+
+
+def test_pipe(sc):
+    r = sc.parallelize(["a", "b"], 1)
+    assert r.pipe("cat").collect() == ["a", "b"]
+
+
+def test_histogram(sc):
+    r = sc.parallelize([1.0, 2.0, 2.5, 3.0, 9.9], 2)
+    edges, counts = r.histogram([0, 5, 10])
+    assert edges == [0, 5, 10] and counts == [4, 1]
