@@ -281,7 +281,49 @@ class Analyzer:
             return rewrite_distinct_aggregates(node)
         if isinstance(node, Sort):
             return self._resolve_sort_references(node)
+        if isinstance(node, Project):
+            return self._extract_window_expressions(node)
         return node
+
+    def _extract_window_expressions(self, node: Project) -> LogicalPlan:
+        """ExtractWindowExpressions: pull `f(...) OVER spec` out of the
+        select list into WindowNode operators (one per distinct spec),
+        leaving Col references behind."""
+        from .window import WindowExpression, WindowNode, contains_window
+        if not any(contains_window(e) for e in node.exprs):
+            return node
+        found: List[Tuple[WindowExpression, str]] = []
+
+        def repl(e: Expression) -> Expression:
+            if isinstance(e, WindowExpression):
+                for we, n in found:
+                    if repr(we) == repr(e):
+                        return Col(n)
+                name = fresh_name("win", repr(e), len(found))
+                found.append((e, name))
+                return Col(name)
+            return e.map_children(repl)
+
+        new_exprs = []
+        for e in node.exprs:
+            r = repl(e)
+            # a bare window expr keeps its pretty name
+            if isinstance(r, Col) and not isinstance(e, Alias):
+                r = Alias(r, e.name) if r.name != e.name else r
+            new_exprs.append(r)
+
+        child = node.children[0]
+        by_spec: Dict[Any, List[Tuple[WindowExpression, str]]] = {}
+        order: List[Any] = []
+        for we, n in found:
+            k = we.spec._key()
+            if k not in by_spec:
+                by_spec[k] = []
+                order.append(k)
+            by_spec[k].append((we, n))
+        for k in order:
+            child = WindowNode(by_spec[k], child)
+        return type(node)(new_exprs, child)
 
     def _resolve_sort_references(self, node: Sort) -> LogicalPlan:
         """ORDER BY may reference input columns dropped by the SELECT list

@@ -121,6 +121,18 @@ class Column:
             raise ValueError("otherwise() follows functions.when(...)")
         return Column(CaseWhen(e.branches, _expr(value)))
 
+    # -- window -----------------------------------------------------------
+    def over(self, window) -> "Column":
+        from .window import WindowExpression
+        from ..aggregates import AggregateFunction
+        from .window import WindowFunction
+        e = self._e
+        if isinstance(e, Alias):
+            inner = e.children[0]
+            if isinstance(inner, (AggregateFunction, WindowFunction)):
+                return Column(Alias(WindowExpression(inner, window), e.name))
+        return Column(WindowExpression(e, window))
+
     # -- sort orders ------------------------------------------------------
     def asc(self):
         return sort_order(self._e, True, None)

@@ -228,6 +228,48 @@ def countDistinct(c) -> Column:
     return Column(A.CountDistinct(_e(c)))
 
 
+# ---- window functions ------------------------------------------------------
+
+def row_number() -> Column:
+    from .window import RowNumber
+    return Column(RowNumber())
+
+
+def rank() -> Column:
+    from .window import Rank
+    return Column(Rank())
+
+
+def dense_rank() -> Column:
+    from .window import DenseRank
+    return Column(DenseRank())
+
+
+def percent_rank() -> Column:
+    from .window import PercentRank
+    return Column(PercentRank())
+
+
+def cume_dist() -> Column:
+    from .window import CumeDist
+    return Column(CumeDist())
+
+
+def ntile(n: int) -> Column:
+    from .window import NTile
+    return Column(NTile(n))
+
+
+def lag(c, offset: int = 1, default=None) -> Column:
+    from .window import Lag
+    return Column(Lag(_e(c), offset, default))
+
+
+def lead(c, offset: int = 1, default=None) -> Column:
+    from .window import Lead
+    return Column(Lead(_e(c), offset, default))
+
+
 def sumDistinct(c) -> Column:
     return Column(A.SumDistinct(_e(c)))
 

@@ -281,6 +281,45 @@ AGG_FUNCTIONS = {
 }
 
 
+def _win0(cls):
+    return lambda a: cls()
+
+
+def _lag_lead(cls):
+    def f(a):
+        off = int(a[1].value) if len(a) > 1 else 1
+        default = a[2].value if len(a) > 2 else None
+        return cls(a[0], off, default)
+    return f
+
+
+def _window_registry():
+    from . import window as W
+    return {
+        "row_number": _win0(W.RowNumber),
+        "rank": _win0(W.Rank),
+        "dense_rank": _win0(W.DenseRank),
+        "percent_rank": _win0(W.PercentRank),
+        "cume_dist": _win0(W.CumeDist),
+        "ntile": lambda a: W.NTile(int(a[0].value)),
+        "lag": _lag_lead(W.Lag),
+        "lead": _lag_lead(W.Lead),
+    }
+
+
+class _LazyWindowRegistry(dict):
+    def __missing__(self, key):
+        raise KeyError(key)
+
+    def __contains__(self, key):
+        if not len(self):
+            self.update(_window_registry())
+        return dict.__contains__(self, key)
+
+
+_WINDOW_FUNCTIONS = _LazyWindowRegistry()
+
+
 class _Star(Expression):
     """`*` or `tbl.*` in a select list (UnresolvedStar)."""
 
@@ -968,17 +1007,99 @@ class Parser:
                 args.append(self.expr())
             self.expect_op(")")
 
+        out: Optional[Expression] = None
         if lname == "count":
-            return _count(args, distinct)
-        if lname in ("sum",) and distinct:
-            return A.SumDistinct(_one(args, "sum"))
-        if lname in AGG_FUNCTIONS:
+            out = _count(args, distinct)
+        elif lname in ("sum",) and distinct:
+            out = A.SumDistinct(_one(args, "sum"))
+        elif lname in AGG_FUNCTIONS:
             if distinct:
                 raise ParseException(f"DISTINCT not supported for {lname}")
-            return AGG_FUNCTIONS[lname](_one(args, lname))
-        if lname in SCALAR_FUNCTIONS:
-            return SCALAR_FUNCTIONS[lname](args)
-        raise ParseException(f"undefined function: {name}")
+            out = AGG_FUNCTIONS[lname](_one(args, lname))
+        elif lname in SCALAR_FUNCTIONS:
+            out = SCALAR_FUNCTIONS[lname](args)
+        elif lname in _WINDOW_FUNCTIONS:
+            out = _WINDOW_FUNCTIONS[lname](args)
+        else:
+            raise ParseException(f"undefined function: {name}")
+
+        # OVER ( [PARTITION BY ...] [ORDER BY ...] [ROWS BETWEEN ...] )
+        t = self.peek()
+        if t.kind == "IDENT" and t.value.upper() == "OVER":
+            self.next()
+            out = self._over_clause(out)
+        return out
+
+    def _over_clause(self, func: Expression) -> Expression:
+        from .window import Window, WindowExpression, WindowSpec
+        self.expect_op("(")
+        spec = WindowSpec()
+        t = self.peek()
+        if t.kind == "IDENT" and t.value.upper() == "PARTITION":
+            self.next()
+            self.expect_kw("BY")
+            parts = [self.expr()]
+            while self.accept_op(","):
+                parts.append(self.expr())
+            spec = WindowSpec(parts, spec.order_by, spec.frame,
+                              spec.frame_type)
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            orders = []
+            while True:
+                e = self.expr()
+                asc = True
+                if self.accept_kw("ASC"):
+                    asc = True
+                elif self.accept_kw("DESC"):
+                    asc = False
+                nulls_first = None
+                if self.accept_kw("NULLS"):
+                    if self.accept_kw("FIRST"):
+                        nulls_first = True
+                    else:
+                        self.expect_kw("LAST")
+                        nulls_first = False
+                from .logical import SortOrder
+                orders.append(SortOrder(e, asc, nulls_first))
+                if not self.accept_op(","):
+                    break
+            spec = WindowSpec(spec.partition_by, orders, spec.frame,
+                              spec.frame_type)
+        t = self.peek()
+        if t.kind == "IDENT" and t.value.upper() in ("ROWS", "RANGE"):
+            kind = self.next().value.lower()
+            self.expect_kw("BETWEEN")
+            lo = self._frame_bound()
+            self.expect_kw("AND")
+            hi = self._frame_bound()
+            if kind == "rows":
+                spec = spec.rowsBetween(
+                    lo if lo is not None else Window.unboundedPreceding,
+                    hi if hi is not None else Window.unboundedFollowing)
+        self.expect_op(")")
+        return WindowExpression(func, spec)
+
+    def _frame_bound(self) -> Optional[int]:
+        from .window import Window
+        t = self.peek()
+        if t.kind == "IDENT" and t.value.upper() == "UNBOUNDED":
+            self.next()
+            t2 = self.next()
+            if t2.value.upper() == "PRECEDING":
+                return Window.unboundedPreceding
+            return Window.unboundedFollowing
+        if t.kind == "IDENT" and t.value.upper() == "CURRENT":
+            self.next()
+            self.next()    # ROW
+            return 0
+        if t.kind == "NUMBER":
+            n = int(self.next().value)
+            t2 = self.next()
+            if t2.value.upper() == "PRECEDING":
+                return -n
+            return n
+        raise ParseException(f"bad frame bound at {t.pos}: {t.value!r}")
 
 
 # ---------------------------------------------------------------------------

@@ -227,6 +227,32 @@ class PLimit(PhysicalPlan):
         return f"Limit {self.n}"
 
 
+class PWindow(PhysicalPlan):
+    """Window operator: one sort per spec + vectorized prefix scans
+    (`execution/window/WindowExec.scala` analog, without per-group loops)."""
+
+    def __init__(self, wexprs, child: PhysicalPlan):
+        self.wexprs = list(wexprs)     # [(WindowExpression, out_name)]
+        self.children = (child,)
+
+    def schema(self):
+        cs = self.children[0].schema()
+        fields = list(cs.fields)
+        for we, name in self.wexprs:
+            fields.append(T.StructField(name, we.data_type(cs), True))
+        return T.StructType(fields)
+
+    def run(self, ctx):
+        from .window import compute_windows
+        batch = self.children[0].run(ctx)
+        spec = self.wexprs[0][0].spec
+        funcs = [(we.func, name) for we, name in self.wexprs]
+        return compute_windows(ctx.xp, batch, spec, funcs)
+
+    def __repr__(self):
+        return f"Window [{', '.join(n for _, n in self.wexprs)}]"
+
+
 class PDistinct(PhysicalPlan):
     def __init__(self, child: PhysicalPlan):
         self.children = (child,)

@@ -102,6 +102,10 @@ class Planner:
             return P.PLimit(node.n, self._to_physical(node.child, leaves))
         if isinstance(node, Distinct):
             return P.PDistinct(self._to_physical(node.child, leaves))
+        from .window import WindowNode
+        if isinstance(node, WindowNode):
+            return P.PWindow(node.wexprs,
+                             self._to_physical(node.child, leaves))
         if isinstance(node, Union):
             return P.PUnion([self._to_physical(c, leaves) for c in node.children],
                             node.schema())

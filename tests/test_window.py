@@ -1,0 +1,169 @@
+"""Window function tests (`sql/core/.../window/` + DataFrameWindowFunctions
+suite shapes).  Cross-checked against hand-computed answers and (for scans)
+the numpy dual path implicitly via CPU jax."""
+
+import numpy as np
+import pytest
+
+from spark_tpu.sql import functions as F
+from spark_tpu.sql.window import Window
+
+
+def rows(df):
+    return [tuple(r) for r in df.collect()]
+
+
+@pytest.fixture()
+def sales(spark):
+    return spark.createDataFrame({
+        "dept": ["a", "a", "a", "b", "b", "c"],
+        "emp": ["e1", "e2", "e3", "e4", "e5", "e6"],
+        "salary": np.array([100, 200, 200, 50, 70, 10], np.int64),
+    })
+
+
+def test_row_number(sales):
+    w = Window.partitionBy("dept").orderBy(F.desc("salary"))
+    out = sales.select("dept", "emp", "salary",
+                       F.row_number().over(w).alias("rn"))
+    got = {(r[0], r[1]): r[3] for r in rows(out)}
+    assert got[("a", "e1")] == 3
+    assert got[("b", "e5")] == 1
+    assert got[("c", "e6")] == 1
+    # rows within a dept get distinct row numbers
+    assert {got[("a", "e2")], got[("a", "e3")]} == {1, 2}
+
+
+def test_rank_dense_rank(sales):
+    w = Window.partitionBy("dept").orderBy("salary")
+    out = sales.select("dept", "salary",
+                       F.rank().over(w).alias("r"),
+                       F.dense_rank().over(w).alias("dr"))
+    a = sorted([(r[1], r[2], r[3]) for r in rows(out) if r[0] == "a"])
+    # salaries 100,200,200 -> rank 1,2,2 dense 1,2,2
+    assert a == [(100, 1, 1), (200, 2, 2), (200, 2, 2)]
+
+
+def test_percent_rank_cume_dist(sales):
+    w = Window.partitionBy("dept").orderBy("salary")
+    out = sales.select("dept", "salary",
+                       F.percent_rank().over(w).alias("pr"),
+                       F.cume_dist().over(w).alias("cd"))
+    a = sorted([(r[1], r[2], r[3]) for r in rows(out) if r[0] == "a"])
+    assert a[0] == (100, 0.0, pytest.approx(1 / 3))
+    assert a[1] == (200, pytest.approx(0.5), pytest.approx(1.0))
+
+
+def test_lag_lead(sales):
+    w = Window.partitionBy("dept").orderBy("salary")
+    out = sales.select("dept", "salary",
+                       F.lag("salary").over(w).alias("lg"),
+                       F.lead("salary").over(w).alias("ld"))
+    b = sorted([(r[1], r[2], r[3]) for r in rows(out) if r[0] == "b"])
+    assert b == [(50, None, 70), (70, 50, None)]
+
+
+def test_lag_default(sales):
+    w = Window.partitionBy("dept").orderBy("salary")
+    out = sales.select("dept", "salary",
+                       F.lag("salary", 1, -1).over(w).alias("lg"))
+    c = [(r[1], r[2]) for r in rows(out) if r[0] == "c"]
+    assert c == [(10, -1)]
+
+
+def test_running_sum(sales):
+    w = Window.partitionBy("dept").orderBy("emp")
+    out = sales.select("dept", "emp", F.sum("salary").over(w).alias("rs"))
+    a = sorted([(r[1], r[2]) for r in rows(out) if r[0] == "a"])
+    assert a == [("e1", 100), ("e2", 300), ("e3", 500)]
+
+
+def test_running_sum_peers_range(spark):
+    # default frame is RANGE: peers (equal order values) are included
+    df = spark.createDataFrame({
+        "g": ["x", "x", "x"],
+        "o": np.array([1, 1, 2], np.int64),
+        "v": np.array([10, 20, 5], np.int64),
+    })
+    w = Window.partitionBy("g").orderBy("o")
+    out = df.select("o", F.sum("v").over(w).alias("s"))
+    got = sorted(rows(out))
+    assert got == [(1, 30), (1, 30), (2, 35)]
+
+
+def test_whole_partition_agg(sales):
+    w = Window.partitionBy("dept")
+    out = sales.select("dept", "salary",
+                       F.sum("salary").over(w).alias("total"),
+                       F.count("*").over(w).alias("n"),
+                       F.avg("salary").over(w).alias("m"))
+    for r in rows(out):
+        if r[0] == "a":
+            assert r[2] == 500 and r[3] == 3 and r[4] == pytest.approx(500 / 3)
+        if r[0] == "c":
+            assert r[2] == 10 and r[3] == 1
+
+
+def test_rows_between_bounded(sales):
+    w = Window.partitionBy("dept").orderBy("salary").rowsBetween(-1, 1)
+    out = sales.select("dept", "salary", F.sum("salary").over(w).alias("s"))
+    a = sorted([(r[1], r[2]) for r in rows(out) if r[0] == "a"])
+    # sorted salaries 100,200,200: windows [100+200, 100+200+200, 200+200]
+    assert a == [(100, 300), (200, 400), (200, 500)]
+
+
+def test_min_max_over_partition(sales):
+    w = Window.partitionBy("dept")
+    out = sales.select("dept", F.min("salary").over(w).alias("lo"),
+                       F.max("salary").over(w).alias("hi"))
+    for r in rows(out):
+        if r[0] == "a":
+            assert (r[1], r[2]) == (100, 200)
+
+
+def test_running_min(sales):
+    w = Window.partitionBy("dept").orderBy(F.desc("salary")) \
+        .rowsBetween(Window.unboundedPreceding, Window.currentRow)
+    out = sales.select("dept", "salary", F.min("salary").over(w).alias("rm"))
+    a = sorted([(r[1], r[2]) for r in rows(out) if r[0] == "a"])
+    assert a == [(100, 100), (200, 200), (200, 200)]
+
+
+def test_ntile(spark):
+    df = spark.createDataFrame({"g": ["x"] * 7,
+                                "v": np.arange(7, dtype=np.int64)})
+    w = Window.partitionBy("g").orderBy("v")
+    out = df.select("v", F.ntile(3).over(w).alias("t"))
+    got = sorted(rows(out))
+    assert [t for _, t in got] == [1, 1, 1, 2, 2, 3, 3]
+
+
+def test_window_sql(spark):
+    df = spark.createDataFrame({
+        "dept": ["a", "a", "b"],
+        "salary": np.array([10, 20, 30], np.int64),
+    })
+    df.createOrReplaceTempView("wt")
+    out = spark.sql(
+        "SELECT dept, salary, "
+        "row_number() OVER (PARTITION BY dept ORDER BY salary DESC) AS rn, "
+        "sum(salary) OVER (PARTITION BY dept) AS tot FROM wt ORDER BY dept, salary")
+    assert rows(out) == [("a", 10, 2, 30), ("a", 20, 1, 30), ("b", 30, 1, 30)]
+    spark.catalog.drop("wt")
+
+
+def test_window_sql_rows_between(spark):
+    df = spark.createDataFrame({"v": np.array([1, 2, 3, 4], np.int64)})
+    df.createOrReplaceTempView("wb")
+    out = spark.sql(
+        "SELECT v, sum(v) OVER (ORDER BY v ROWS BETWEEN 1 PRECEDING AND "
+        "CURRENT ROW) AS s FROM wb ORDER BY v")
+    assert rows(out) == [(1, 1), (2, 3), (3, 5), (4, 7)]
+    spark.catalog.drop("wb")
+
+
+def test_global_window_no_partition(spark):
+    df = spark.createDataFrame({"v": np.array([3, 1, 2], np.int64)})
+    w = Window.orderBy("v")
+    out = df.select("v", F.row_number().over(w).alias("rn"))
+    assert sorted(rows(out)) == [(1, 1), (2, 2), (3, 3)]
