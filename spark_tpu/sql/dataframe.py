@@ -316,6 +316,24 @@ class DataFrame:
         return DataFrameWriter(self)
 
     @property
+    def writeStream(self):
+        from ..streaming.api import DataStreamWriter
+        return DataStreamWriter(self)
+
+    @property
+    def isStreaming(self) -> bool:
+        from ..streaming.core import StreamingRelation
+        found = []
+
+        def walk(n):
+            if isinstance(n, StreamingRelation):
+                found.append(n)
+            for c in n.children:
+                walk(c)
+        walk(self._plan)
+        return bool(found)
+
+    @property
     def rdd(self):
         rows = self.collect()
         return self.session.sparkContext.parallelize(rows)
