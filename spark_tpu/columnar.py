@@ -134,6 +134,8 @@ class ColumnVector:
         for i in range(len(data)):
             if not valid[i]:
                 out.append(None)
+            elif isinstance(dt, T.ArrayType):
+                out.append([float(x) for x in data[i]])
             elif dt.is_string or isinstance(dt, T.BinaryType):
                 code = int(data[i])
                 out.append(self.dictionary[code] if (self.dictionary is not None and 0 <= code < len(self.dictionary)) else None)
@@ -282,6 +284,31 @@ def _ingest_column(raw: Any, num_rows: int, cap: int,
     """Convert one host column (list/ndarray) into a padded ColumnVector."""
     dictionary: Optional[Tuple[str, ...]] = None
     valid: Optional[np.ndarray] = None
+
+    # fixed-width vector column (ML feature vectors): 2D data, ArrayType
+    if isinstance(raw, np.ndarray) and raw.ndim == 2:
+        dt = dtype if isinstance(dtype, T.ArrayType) else T.ArrayType(T.float64)
+        data = raw.astype(dt.element_type.np_dtype)
+        if len(data) < cap:
+            pad = np.zeros((cap - len(data),) + data.shape[1:], data.dtype)
+            data = np.concatenate([data, pad])
+        return ColumnVector(data, dt, None, None)
+    if (not isinstance(raw, np.ndarray) and len(raw)
+            and isinstance(next((v for v in raw if v is not None), None),
+                           (list, tuple, np.ndarray))):
+        values = [([0.0] if v is None else list(v)) for v in raw]
+        width = max(len(v) for v in values)
+        nulls = np.fromiter((v is None for v in raw), bool, count=len(values))
+        mat = np.zeros((len(values), width), np.float64)
+        for i, v in enumerate(values):
+            mat[i, :len(v)] = v
+        dt = dtype if isinstance(dtype, T.ArrayType) else T.ArrayType(T.float64)
+        if len(mat) < cap:
+            mat = np.concatenate(
+                [mat, np.zeros((cap - len(mat), width), np.float64)])
+        valid = None if not nulls.any() else np.concatenate(
+            [~nulls, np.zeros(cap - len(values), bool)])
+        return ColumnVector(mat, dt, valid, None)
 
     if isinstance(raw, np.ndarray) and raw.dtype.kind not in ("O", "U", "S"):
         if raw.dtype.kind == "M":  # datetime64

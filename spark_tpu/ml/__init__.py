@@ -1,0 +1,6 @@
+"""ML pipeline API on the device compute path (`mllib` / `ml` analog)."""
+
+from .base import Estimator, Model, Param, Params, Pipeline, PipelineModel, Transformer
+
+__all__ = ["Estimator", "Model", "Param", "Params", "Pipeline",
+           "PipelineModel", "Transformer"]

@@ -1,0 +1,245 @@
+"""ML Pipeline API (`mllib/src/main/scala/org/apache/spark/ml/Pipeline.scala:96`,
+`Estimator.scala:31`, `Transformer.scala:35`, `param/params.scala` analogs).
+
+Estimators fit DataFrames into Models (Transformers); Pipelines chain them.
+Training math runs in jax on device — the reference's
+`RDD.treeAggregate` gradient loops become jit-compiled full-batch device
+reductions (the TPU-native allreduce).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..expressions import AnalysisException
+
+__all__ = ["Param", "Params", "Estimator", "Transformer", "Model",
+           "Pipeline", "PipelineModel"]
+
+
+class Param:
+    def __init__(self, name: str, doc: str = "", default: Any = None):
+        self.name = name
+        self.doc = doc
+        self.default = default
+
+
+class Params:
+    """Typed param plumbing: each subclass declares class-level Param
+    objects; instances carry a value map.  getOrDefault/set/copy mirror the
+    reference's `params.scala`."""
+
+    def __init__(self, **kwargs):
+        self._values: Dict[str, Any] = {}
+        self.uid = f"{type(self).__name__}_{id(self):x}"
+        for k, v in kwargs.items():
+            self.set(k, v)
+
+    @classmethod
+    def _params(cls) -> Dict[str, Param]:
+        out = {}
+        for klass in reversed(cls.__mro__):
+            for k, v in vars(klass).items():
+                if isinstance(v, Param):
+                    out[k] = v
+        return out
+
+    def set(self, name: str, value: Any) -> "Params":
+        if name not in self._params():
+            raise AnalysisException(
+                f"{type(self).__name__} has no param {name!r}; "
+                f"available: {sorted(self._params())}")
+        self._values[name] = value
+        return self
+
+    def getOrDefault(self, name: str) -> Any:
+        if name in self._values:
+            return self._values[name]
+        return self._params()[name].default
+
+    g = getOrDefault
+
+    def copy(self, extra: Optional[Dict[str, Any]] = None) -> "Params":
+        import copy as _c
+        out = _c.copy(self)
+        out._values = dict(self._values)
+        for k, v in (extra or {}).items():
+            out.set(k, v)
+        return out
+
+    def explainParams(self) -> str:
+        lines = []
+        for name, p in sorted(self._params().items()):
+            cur = self.getOrDefault(name)
+            lines.append(f"{name}: {p.doc} (default: {p.default}, "
+                         f"current: {cur})")
+        return "\n".join(lines)
+
+    # Spark-style setX/getX sugar
+    def __getattr__(self, item: str):
+        if item.startswith("set") and len(item) > 3:
+            pname = item[3].lower() + item[4:]
+            if pname in self._params():
+                def setter(value):
+                    self.set(pname, value)
+                    return self
+                return setter
+        if item.startswith("get") and len(item) > 3:
+            pname = item[3].lower() + item[4:]
+            if pname in self._params():
+                return lambda: self.getOrDefault(pname)
+        raise AttributeError(item)
+
+    # -- persistence ------------------------------------------------------
+    def _save_params(self, path: str, extra: Optional[dict] = None) -> None:
+        os.makedirs(path, exist_ok=True)
+        payload = {"class": type(self).__name__,
+                   "params": {k: v for k, v in self._values.items()
+                              if _json_ok(v)}}
+        if extra:
+            payload.update(extra)
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(payload, f, default=_np_default)
+
+    def save(self, path: str) -> None:
+        self._save_params(path)
+
+    def write(self):
+        return _Writer(self)
+
+
+def _json_ok(v) -> bool:
+    try:
+        json.dumps(v, default=_np_default)
+        return True
+    except TypeError:
+        return False
+
+
+def _np_default(o):
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, (np.integer, np.floating)):
+        return o.item()
+    raise TypeError(o)
+
+
+class _Writer:
+    def __init__(self, target):
+        self._t = target
+        self._overwrite = False
+
+    def overwrite(self):
+        self._overwrite = True
+        return self
+
+    def save(self, path: str):
+        if os.path.exists(path) and not self._overwrite:
+            raise AnalysisException(f"path {path} exists; use .overwrite()")
+        self._t.save(path)
+
+
+class Transformer(Params):
+    def transform(self, df):
+        raise NotImplementedError
+
+
+class Estimator(Params):
+    featuresCol = Param("featuresCol", "features column", "features")
+    labelCol = Param("labelCol", "label column", "label")
+    predictionCol = Param("predictionCol", "prediction column", "prediction")
+
+    def fit(self, df, params: Optional[Dict[str, Any]] = None):
+        est = self.copy(params) if params else self
+        return est._fit(df)
+
+    def _fit(self, df):
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    featuresCol = Param("featuresCol", "features column", "features")
+    labelCol = Param("labelCol", "label column", "label")
+    predictionCol = Param("predictionCol", "prediction column", "prediction")
+
+
+class Pipeline(Estimator):
+    stages = Param("stages", "pipeline stages", None)
+
+    def _fit(self, df):
+        stages = self.getOrDefault("stages") or []
+        fitted: List[Transformer] = []
+        cur = df
+        for i, stage in enumerate(stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(cur)
+                fitted.append(model)
+                if i < len(stages) - 1:
+                    cur = model.transform(cur)
+            elif isinstance(stage, Transformer):
+                fitted.append(stage)
+                if i < len(stages) - 1:
+                    cur = stage.transform(cur)
+            else:
+                raise AnalysisException(f"not a pipeline stage: {stage!r}")
+        return PipelineModel(stages=fitted)
+
+
+class PipelineModel(Model):
+    stages = Param("stages", "fitted stages", None)
+
+    def transform(self, df):
+        cur = df
+        for stage in self.getOrDefault("stages") or []:
+            cur = stage.transform(cur)
+        return cur
+
+
+# ---------------------------------------------------------------------------
+# matrix extraction helpers (DataFrame <-> device arrays)
+# ---------------------------------------------------------------------------
+
+def extract_matrix(df, features_col: str):
+    """Execute df; return (jnp matrix (n,d), executed host batch, n)."""
+    import jax.numpy as jnp
+    from ..kernels import compact
+    batch = df._execute()
+    batch = compact(np, batch.to_host() if hasattr(batch, "to_host") else batch)
+    n = int(np.asarray(batch.num_rows()))
+    vec = batch.column(features_col)
+    data = np.asarray(vec.data)[:n]
+    if data.ndim == 1:
+        data = data[:, None]
+    return jnp.asarray(data.astype(np.float64)), batch, n
+
+
+def extract_column(batch, name: str, n: int):
+    import jax.numpy as jnp
+    return jnp.asarray(np.asarray(batch.column(name).data)[:n]
+                       .astype(np.float64))
+
+
+def append_prediction(df, batch, n, values, pred_col: str, dtype=None):
+    """Executed batch + prediction array → new DataFrame."""
+    from .. import types as T
+    from ..columnar import ColumnBatch, ColumnVector
+    from ..sql import logical as L
+    from ..sql.dataframe import DataFrame
+    vals = np.asarray(values)
+    cap = batch.capacity
+    if vals.ndim == 1:
+        full = np.zeros(cap, vals.dtype)
+        full[:n] = vals
+        dt = dtype or (T.float64 if vals.dtype.kind == "f" else T.int64)
+    else:
+        full = np.zeros((cap,) + vals.shape[1:], vals.dtype)
+        full[:n] = vals
+        dt = dtype or T.ArrayType(T.float64)
+    names = list(batch.names) + [pred_col]
+    vectors = list(batch.vectors) + [ColumnVector(full, dt, None, None)]
+    out = ColumnBatch(names, vectors, batch.row_valid, cap)
+    return DataFrame(df.session, L.LocalRelation(out))

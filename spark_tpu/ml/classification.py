@@ -1,0 +1,209 @@
+"""Classification (`ml/classification/` analog).
+
+Training runs as jit-compiled full-batch device computations: the
+reference's `RDD.treeAggregate` gradient reductions become one XLA
+reduction per iteration (psum over the mesh in distributed mode).
+LogisticRegression uses IRLS (Newton) for binary problems — the same
+optimizer family Spark's LBFGS approximates — and softmax GD for
+multinomial."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .. import types as T
+from .base import (
+    Estimator, Model, Param, append_prediction, extract_column,
+    extract_matrix,
+)
+
+__all__ = ["LogisticRegression", "LogisticRegressionModel", "LinearSVC",
+           "LinearSVCModel", "NaiveBayes", "NaiveBayesModel"]
+
+
+class LogisticRegression(Estimator):
+    maxIter = Param("maxIter", "max iterations", 25)
+    regParam = Param("regParam", "L2 regularization", 0.0)
+    tol = Param("tol", "convergence tolerance", 1e-8)
+    fitIntercept = Param("fitIntercept", "fit intercept", True)
+    family = Param("family", "auto|binomial|multinomial", "auto")
+
+    def _fit(self, df):
+        import jax
+        import jax.numpy as jnp
+
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        y = extract_column(batch, self.getOrDefault("labelCol"), n)
+        classes = np.unique(np.asarray(y))
+        k = len(classes)
+        lam = self.getOrDefault("regParam")
+        if self.getOrDefault("fitIntercept"):
+            X = jnp.concatenate([X, jnp.ones((X.shape[0], 1))], axis=1)
+        d = X.shape[1]
+
+        family = self.getOrDefault("family")
+        binary = (family == "binomial") or (family == "auto" and k <= 2)
+
+        if binary:
+            yb = (y == classes[-1]).astype(jnp.float64) if k == 2 \
+                else jnp.zeros_like(y)
+
+            def irls_step(w, _):
+                z = X @ w
+                p = jax.nn.sigmoid(z)
+                wgt = jnp.clip(p * (1 - p), 1e-10)
+                g = X.T @ (p - yb) + lam * n * w
+                h = (X * wgt[:, None]).T @ X \
+                    + lam * n * jnp.eye(d)
+                return w - jnp.linalg.solve(h, g), None
+
+            w0 = jnp.zeros(d)
+            w, _ = jax.lax.scan(jax.jit(irls_step), w0,
+                                None, length=self.getOrDefault("maxIter"))
+            coef = np.asarray(w)
+            intercept = coef[-1] if self.getOrDefault("fitIntercept") else 0.0
+            weights = coef[:-1] if self.getOrDefault("fitIntercept") else coef
+            return LogisticRegressionModel(
+                featuresCol=self.getOrDefault("featuresCol"),
+                predictionCol=self.getOrDefault("predictionCol"),
+                coefficients=weights, intercept=float(intercept),
+                classes=classes.tolist(), binary=True)
+
+        # multinomial: softmax full-batch gradient descent (jit scan)
+        y_idx = jnp.asarray(np.searchsorted(classes, np.asarray(y)))
+        onehot = jax.nn.one_hot(y_idx, k)
+        lr = 1.0 / max(float(jnp.abs(X).max()) ** 2, 1.0)
+
+        def gd_step(W, _):
+            logits = X @ W
+            p = jax.nn.softmax(logits, axis=1)
+            g = X.T @ (p - onehot) / n + lam * W
+            return W - lr * n * 0.1 * g, None
+
+        W0 = jnp.zeros((d, k))
+        W, _ = jax.lax.scan(jax.jit(gd_step), W0, None,
+                            length=self.getOrDefault("maxIter") * 10)
+        coef = np.asarray(W)
+        if self.getOrDefault("fitIntercept"):
+            weights, intercept = coef[:-1], coef[-1]
+        else:
+            weights, intercept = coef, np.zeros(k)
+        return LogisticRegressionModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"),
+            coefficients=weights, intercept=intercept,
+            classes=classes.tolist(), binary=False)
+
+
+class LogisticRegressionModel(Model):
+    coefficients = Param("coefficients", "", None)
+    intercept = Param("intercept", "", None)
+    classes = Param("classes", "", None)
+    binary = Param("binary", "", True)
+    probabilityCol = Param("probabilityCol", "", "probability")
+
+    def transform(self, df):
+        import jax
+        import jax.numpy as jnp
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        w = jnp.asarray(self.getOrDefault("coefficients"))
+        classes = np.asarray(self.getOrDefault("classes"))
+        if self.getOrDefault("binary"):
+            p = jax.nn.sigmoid(X @ w + self.getOrDefault("intercept"))
+            pred = np.where(np.asarray(p) > 0.5,
+                            classes[-1] if len(classes) == 2 else 1.0,
+                            classes[0] if len(classes) else 0.0)
+            prob = np.stack([1 - np.asarray(p), np.asarray(p)], axis=1)
+        else:
+            logits = X @ w + jnp.asarray(self.getOrDefault("intercept"))
+            prob = np.asarray(jax.nn.softmax(logits, axis=1))
+            pred = classes[np.argmax(prob, axis=1)]
+        out = append_prediction(df, batch, n, pred.astype(np.float64),
+                                self.getOrDefault("predictionCol"), T.float64)
+        b2 = out._execute().to_host()
+        return append_prediction(out, b2, n, prob,
+                                 self.getOrDefault("probabilityCol"),
+                                 T.ArrayType(T.float64))
+
+
+class LinearSVC(Estimator):
+    maxIter = Param("maxIter", "max iterations", 100)
+    regParam = Param("regParam", "L2 reg", 0.01)
+
+    def _fit(self, df):
+        import jax
+        import jax.numpy as jnp
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        y = extract_column(batch, self.getOrDefault("labelCol"), n)
+        ypm = jnp.where(y > 0, 1.0, -1.0)
+        Xb = jnp.concatenate([X, jnp.ones((X.shape[0], 1))], axis=1)
+        d = Xb.shape[1]
+        lam = self.getOrDefault("regParam")
+
+        def step(carry, i):
+            w = carry
+            margin = ypm * (Xb @ w)
+            active = (margin < 1).astype(jnp.float64)
+            g = -(Xb * (ypm * active)[:, None]).sum(0) / n + lam * w
+            lr = 1.0 / (lam * (i + 1) + 1.0)
+            return w - lr * g, None
+
+        w0 = jnp.zeros(d)
+        w, _ = jax.lax.scan(jax.jit(step), w0,
+                            jnp.arange(self.getOrDefault("maxIter")))
+        coef = np.asarray(w)
+        return LinearSVCModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"),
+            coefficients=coef[:-1], intercept=float(coef[-1]))
+
+
+class LinearSVCModel(Model):
+    coefficients = Param("coefficients", "", None)
+    intercept = Param("intercept", "", None)
+
+    def transform(self, df):
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        raw = np.asarray(X) @ self.getOrDefault("coefficients") \
+            + self.getOrDefault("intercept")
+        pred = (raw > 0).astype(np.float64)
+        return append_prediction(df, batch, n, pred,
+                                 self.getOrDefault("predictionCol"), T.float64)
+
+
+class NaiveBayes(Estimator):
+    smoothing = Param("smoothing", "laplace smoothing", 1.0)
+
+    def _fit(self, df):
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        y = np.asarray(extract_column(batch, self.getOrDefault("labelCol"), n))
+        X = np.asarray(X)
+        classes = np.unique(y)
+        a = self.getOrDefault("smoothing")
+        pri, like = [], []
+        for c in classes:
+            rows = X[y == c]
+            pri.append(np.log(len(rows) / len(X)))
+            tot = rows.sum(axis=0) + a
+            like.append(np.log(tot / tot.sum()))
+        return NaiveBayesModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"),
+            classes=classes.tolist(), logPrior=np.array(pri),
+            logLikelihood=np.array(like))
+
+
+class NaiveBayesModel(Model):
+    classes = Param("classes", "", None)
+    logPrior = Param("logPrior", "", None)
+    logLikelihood = Param("logLikelihood", "", None)
+
+    def transform(self, df):
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        scores = np.asarray(X) @ self.getOrDefault("logLikelihood").T \
+            + self.getOrDefault("logPrior")
+        pred = np.asarray(self.getOrDefault("classes"))[scores.argmax(axis=1)]
+        return append_prediction(df, batch, n, pred.astype(np.float64),
+                                 self.getOrDefault("predictionCol"), T.float64)

@@ -1,0 +1,107 @@
+"""Clustering (`ml/clustering/` analog): KMeans with Lloyd iterations as
+jit-compiled device steps — the distance matrix is an MXU matmul."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from .base import Estimator, Model, Param, append_prediction, extract_matrix
+
+__all__ = ["KMeans", "KMeansModel", "BisectingKMeans"]
+
+
+class KMeans(Estimator):
+    k = Param("k", "clusters", 2)
+    maxIter = Param("maxIter", "iterations", 20)
+    seed = Param("seed", "rng seed", 42)
+    tol = Param("tol", "center-shift tolerance", 1e-6)
+
+    def _fit(self, df):
+        import jax
+        import jax.numpy as jnp
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        k = self.getOrDefault("k")
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        init_idx = rng.choice(n, size=k, replace=False)
+        centers0 = X[jnp.asarray(init_idx)]
+
+        def step(centers, _):
+            # ||x - c||^2 = |x|^2 - 2 x.c + |c|^2 ; argmin over c — the
+            # x @ c.T term is the MXU workload
+            d2 = (jnp.sum(X * X, 1)[:, None]
+                  - 2.0 * (X @ centers.T)
+                  + jnp.sum(centers * centers, 1)[None, :])
+            assign = jnp.argmin(d2, axis=1)
+            sums = jax.ops.segment_sum(X, assign, num_segments=k)
+            counts = jax.ops.segment_sum(jnp.ones(X.shape[0]), assign,
+                                         num_segments=k)
+            new = jnp.where(counts[:, None] > 0,
+                            sums / jnp.maximum(counts, 1.0)[:, None],
+                            centers)
+            return new, None
+
+        centers, _ = jax.lax.scan(jax.jit(step), centers0, None,
+                                  length=self.getOrDefault("maxIter"))
+        return KMeansModel(featuresCol=self.getOrDefault("featuresCol"),
+                           predictionCol=self.getOrDefault("predictionCol"),
+                           clusterCenters=np.asarray(centers))
+
+
+class KMeansModel(Model):
+    clusterCenters = Param("clusterCenters", "", None)
+
+    def transform(self, df):
+        import jax.numpy as jnp
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        C = jnp.asarray(self.getOrDefault("clusterCenters"))
+        d2 = (jnp.sum(X * X, 1)[:, None] - 2.0 * (X @ C.T)
+              + jnp.sum(C * C, 1)[None, :])
+        assign = np.asarray(jnp.argmin(d2, axis=1)).astype(np.float64)
+        return append_prediction(df, batch, n, assign,
+                                 self.getOrDefault("predictionCol"), T.float64)
+
+    def computeCost(self, df):
+        import jax.numpy as jnp
+        X, _, _ = extract_matrix(df, self.getOrDefault("featuresCol"))
+        C = jnp.asarray(self.getOrDefault("clusterCenters"))
+        d2 = (jnp.sum(X * X, 1)[:, None] - 2.0 * (X @ C.T)
+              + jnp.sum(C * C, 1)[None, :])
+        return float(jnp.sum(jnp.min(d2, axis=1)))
+
+
+class BisectingKMeans(KMeans):
+    """Bisecting variant: repeatedly split the largest cluster."""
+
+    def _fit(self, df):
+        import jax.numpy as jnp
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        k = self.getOrDefault("k")
+        X_np = np.asarray(X)
+        assign = np.zeros(n, np.int64)
+        centers = [X_np.mean(axis=0)]
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        while len(centers) < k:
+            sizes = np.bincount(assign, minlength=len(centers))
+            target = int(sizes.argmax())
+            rows = np.where(assign == target)[0]
+            if len(rows) < 2:
+                break
+            sub = X_np[rows]
+            two = KMeans(k=2, maxIter=self.getOrDefault("maxIter"),
+                         seed=int(rng.integers(1 << 30)))
+            import jax
+            c0 = sub[rng.choice(len(sub), 2, replace=False)]
+            for _ in range(self.getOrDefault("maxIter")):
+                d2 = ((sub[:, None, :] - c0[None, :, :]) ** 2).sum(-1)
+                a = d2.argmin(1)
+                for j in (0, 1):
+                    if (a == j).any():
+                        c0[j] = sub[a == j].mean(axis=0)
+            new_id = len(centers)
+            centers[target] = c0[0]
+            centers.append(c0[1])
+            assign[rows[a == 1]] = new_id
+        return KMeansModel(featuresCol=self.getOrDefault("featuresCol"),
+                           predictionCol=self.getOrDefault("predictionCol"),
+                           clusterCenters=np.stack(centers))

@@ -1,0 +1,344 @@
+"""Feature transformers (`ml/feature/` analog): assembly, scaling, indexing,
+text features.  All numeric paths are vectorized numpy/jax."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .. import types as T
+from ..expressions import AnalysisException
+from .base import (
+    Estimator, Model, Param, Params, Transformer, append_prediction,
+    extract_matrix,
+)
+
+__all__ = [
+    "VectorAssembler", "StandardScaler", "StandardScalerModel",
+    "MinMaxScaler", "MinMaxScalerModel", "StringIndexer", "StringIndexerModel",
+    "IndexToString", "OneHotEncoder", "Tokenizer", "HashingTF", "Binarizer",
+    "Bucketizer", "SQLTransformer", "PCA", "PCAModel",
+]
+
+
+def _exec_host(df):
+    from ..kernels import compact
+    batch = df._execute().to_host()
+    batch = compact(np, batch)
+    n = int(np.asarray(batch.num_rows()))
+    return batch, n
+
+
+class VectorAssembler(Transformer):
+    inputCols = Param("inputCols", "input columns", None)
+    outputCol = Param("outputCol", "output column", "features")
+
+    def transform(self, df):
+        cols = self.getOrDefault("inputCols")
+        if not cols:
+            raise AnalysisException("VectorAssembler requires inputCols")
+        batch, n = _exec_host(df)
+        parts = []
+        for c in cols:
+            vec = batch.column(c)
+            data = np.asarray(vec.data)[:n].astype(np.float64)
+            if isinstance(vec.dtype, T.ArrayType):
+                parts.append(data)
+            else:
+                parts.append(data[:, None])
+        mat = np.concatenate(parts, axis=1)
+        return append_prediction(df, batch, n, mat,
+                                 self.getOrDefault("outputCol"),
+                                 T.ArrayType(T.float64))
+
+
+class StandardScaler(Estimator):
+    inputCol = Param("inputCol", "input column", "features")
+    outputCol = Param("outputCol", "output column", "scaled")
+    withMean = Param("withMean", "center", False)
+    withStd = Param("withStd", "scale to unit std", True)
+
+    def _fit(self, df):
+        X, _, _ = extract_matrix(df, self.getOrDefault("inputCol"))
+        X = np.asarray(X)
+        mean = X.mean(axis=0)
+        std = X.std(axis=0, ddof=1)
+        return StandardScalerModel(
+            inputCol=self.getOrDefault("inputCol"),
+            outputCol=self.getOrDefault("outputCol"),
+            withMean=self.getOrDefault("withMean"),
+            withStd=self.getOrDefault("withStd"),
+            mean=mean, std=std)
+
+
+class StandardScalerModel(Model):
+    inputCol = Param("inputCol", "input column", "features")
+    outputCol = Param("outputCol", "output column", "scaled")
+    withMean = Param("withMean", "center", False)
+    withStd = Param("withStd", "scale", True)
+    mean = Param("mean", "fitted mean", None)
+    std = Param("std", "fitted std", None)
+
+    def transform(self, df):
+        batch, n = _exec_host(df)
+        X = np.asarray(batch.column(self.getOrDefault("inputCol")).data)[:n]
+        if X.ndim == 1:
+            X = X[:, None]
+        out = X.astype(np.float64)
+        if self.getOrDefault("withMean"):
+            out = out - self.getOrDefault("mean")
+        if self.getOrDefault("withStd"):
+            std = np.where(self.getOrDefault("std") == 0, 1.0,
+                           self.getOrDefault("std"))
+            out = out / std
+        return append_prediction(df, batch, n, out,
+                                 self.getOrDefault("outputCol"),
+                                 T.ArrayType(T.float64))
+
+
+class MinMaxScaler(Estimator):
+    inputCol = Param("inputCol", "input column", "features")
+    outputCol = Param("outputCol", "output column", "scaled")
+
+    def _fit(self, df):
+        X, _, _ = extract_matrix(df, self.getOrDefault("inputCol"))
+        X = np.asarray(X)
+        return MinMaxScalerModel(inputCol=self.getOrDefault("inputCol"),
+                                 outputCol=self.getOrDefault("outputCol"),
+                                 mn=X.min(axis=0), mx=X.max(axis=0))
+
+
+class MinMaxScalerModel(Model):
+    inputCol = Param("inputCol", "", "features")
+    outputCol = Param("outputCol", "", "scaled")
+    mn = Param("mn", "", None)
+    mx = Param("mx", "", None)
+
+    def transform(self, df):
+        batch, n = _exec_host(df)
+        X = np.asarray(batch.column(self.getOrDefault("inputCol")).data)[:n]
+        if X.ndim == 1:
+            X = X[:, None]
+        mn, mx = self.getOrDefault("mn"), self.getOrDefault("mx")
+        rng = np.where(mx - mn == 0, 1.0, mx - mn)
+        out = (X - mn) / rng
+        return append_prediction(df, batch, n, out,
+                                 self.getOrDefault("outputCol"),
+                                 T.ArrayType(T.float64))
+
+
+class StringIndexer(Estimator):
+    inputCol = Param("inputCol", "input column", None)
+    outputCol = Param("outputCol", "output column", None)
+    handleInvalid = Param("handleInvalid", "error|keep", "error")
+
+    def _fit(self, df):
+        batch, n = _exec_host(df)
+        col = self.getOrDefault("inputCol")
+        vals = batch.column(col).to_pylist(
+            np.asarray(batch.row_valid_or_true()))
+        freq = {}
+        for v in vals:
+            if v is not None:
+                freq[v] = freq.get(v, 0) + 1
+        # most frequent first, ties broken alphabetically (Spark order)
+        labels = [k for k, _ in sorted(freq.items(),
+                                       key=lambda kv: (-kv[1], str(kv[0])))]
+        return StringIndexerModel(
+            inputCol=col, outputCol=self.getOrDefault("outputCol"),
+            handleInvalid=self.getOrDefault("handleInvalid"), labels=labels)
+
+
+class StringIndexerModel(Model):
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    handleInvalid = Param("handleInvalid", "", "error")
+    labels = Param("labels", "fitted labels", None)
+
+    def transform(self, df):
+        batch, n = _exec_host(df)
+        vals = batch.column(self.getOrDefault("inputCol")).to_pylist(
+            np.asarray(batch.row_valid_or_true()))
+        labels = self.getOrDefault("labels")
+        lookup = {v: i for i, v in enumerate(labels)}
+        out = np.zeros(len(vals), np.float64)
+        for i, v in enumerate(vals):
+            if v in lookup:
+                out[i] = lookup[v]
+            elif self.getOrDefault("handleInvalid") == "keep":
+                out[i] = len(labels)
+            else:
+                raise AnalysisException(f"unseen label: {v}")
+        return append_prediction(df, batch, n, out,
+                                 self.getOrDefault("outputCol"), T.float64)
+
+
+class IndexToString(Transformer):
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    labels = Param("labels", "", None)
+
+    def transform(self, df):
+        batch, n = _exec_host(df)
+        idx = np.asarray(batch.column(self.getOrDefault("inputCol")).data)[:n]
+        labels = self.getOrDefault("labels")
+        strings = [labels[int(i)] if 0 <= int(i) < len(labels) else None
+                   for i in idx]
+        from ..columnar import ColumnBatch, ColumnVector, encode_strings
+        from ..sql import logical as L
+        from ..sql.dataframe import DataFrame
+        codes, dic = encode_strings(strings + [None] * (batch.capacity - n))
+        vec = ColumnVector(np.where(codes < 0, 0, codes).astype(np.int32),
+                           T.string, codes >= 0, dic)
+        names = list(batch.names) + [self.getOrDefault("outputCol")]
+        out = ColumnBatch(names, list(batch.vectors) + [vec],
+                          batch.row_valid, batch.capacity)
+        return DataFrame(df.session, L.LocalRelation(out))
+
+
+class OneHotEncoder(Transformer):
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    dropLast = Param("dropLast", "drop last category", True)
+
+    def transform(self, df):
+        batch, n = _exec_host(df)
+        idx = np.asarray(batch.column(self.getOrDefault("inputCol"))
+                         .data)[:n].astype(np.int64)
+        k = int(idx.max()) + 1 if n else 1
+        width = k - 1 if self.getOrDefault("dropLast") else k
+        mat = np.zeros((n, max(width, 1)), np.float64)
+        for i, v in enumerate(idx):
+            if v < width:
+                mat[i, v] = 1.0
+        return append_prediction(df, batch, n, mat,
+                                 self.getOrDefault("outputCol"),
+                                 T.ArrayType(T.float64))
+
+
+class Tokenizer(Transformer):
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+
+    def transform(self, df):
+        # tokens are re-joined with \x00 (string columns are scalar); the
+        # HashingTF stage splits again — the pair composes like the reference
+        batch, n = _exec_host(df)
+        vals = batch.column(self.getOrDefault("inputCol")).to_pylist(
+            np.asarray(batch.row_valid_or_true()))
+        joined = ["\x00".join(str(v).lower().split()) if v is not None else None
+                  for v in vals]
+        from ..columnar import ColumnBatch, ColumnVector, encode_strings
+        from ..sql import logical as L
+        from ..sql.dataframe import DataFrame
+        codes, dic = encode_strings(joined + [None] * (batch.capacity - n))
+        vec = ColumnVector(np.where(codes < 0, 0, codes).astype(np.int32),
+                           T.string, codes >= 0, dic)
+        out = ColumnBatch(list(batch.names) + [self.getOrDefault("outputCol")],
+                          list(batch.vectors) + [vec], batch.row_valid,
+                          batch.capacity)
+        return DataFrame(df.session, L.LocalRelation(out))
+
+
+class HashingTF(Transformer):
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    numFeatures = Param("numFeatures", "buckets", 262144)
+
+    def transform(self, df):
+        import zlib
+        batch, n = _exec_host(df)
+        vals = batch.column(self.getOrDefault("inputCol")).to_pylist(
+            np.asarray(batch.row_valid_or_true()))
+        nf = self.getOrDefault("numFeatures")
+        mat = np.zeros((n, nf), np.float64)
+        for i, v in enumerate(vals):
+            if v is None:
+                continue
+            for tok in str(v).split("\x00"):
+                if tok:
+                    mat[i, zlib.crc32(tok.encode()) % nf] += 1.0
+        return append_prediction(df, batch, n, mat,
+                                 self.getOrDefault("outputCol"),
+                                 T.ArrayType(T.float64))
+
+
+class Binarizer(Transformer):
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    threshold = Param("threshold", "", 0.0)
+
+    def transform(self, df):
+        batch, n = _exec_host(df)
+        x = np.asarray(batch.column(self.getOrDefault("inputCol"))
+                       .data)[:n].astype(np.float64)
+        out = (x > self.getOrDefault("threshold")).astype(np.float64)
+        return append_prediction(df, batch, n, out,
+                                 self.getOrDefault("outputCol"), T.float64)
+
+
+class Bucketizer(Transformer):
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    splits = Param("splits", "bucket boundaries", None)
+
+    def transform(self, df):
+        batch, n = _exec_host(df)
+        x = np.asarray(batch.column(self.getOrDefault("inputCol"))
+                       .data)[:n].astype(np.float64)
+        splits = np.asarray(self.getOrDefault("splits"), np.float64)
+        idx = np.clip(np.searchsorted(splits, x, side="right") - 1,
+                      0, len(splits) - 2).astype(np.float64)
+        return append_prediction(df, batch, n, idx,
+                                 self.getOrDefault("outputCol"), T.float64)
+
+
+class SQLTransformer(Transformer):
+    statement = Param("statement", "SQL with __THIS__ placeholder", None)
+
+    def transform(self, df):
+        from ..sql.analyzer import Analyzer
+        from ..sql.dataframe import DataFrame
+        stmt = self.getOrDefault("statement")
+        name = f"__sql_transformer_{id(self):x}"
+        df.createOrReplaceTempView(name)
+        try:
+            out = df.session.sql(stmt.replace("__THIS__", name))
+            # resolve eagerly: the plan must survive the view being dropped
+            plan = Analyzer(df.session.catalog).analyze(out._plan)
+            return DataFrame(df.session, plan)
+        finally:
+            df.session.catalog.drop(name)
+
+
+class PCA(Estimator):
+    inputCol = Param("inputCol", "", "features")
+    outputCol = Param("outputCol", "", "pca")
+    k = Param("k", "components", 2)
+
+    def _fit(self, df):
+        X, _, _ = extract_matrix(df, self.getOrDefault("inputCol"))
+        X = np.asarray(X)
+        mean = X.mean(axis=0)
+        _, _, vt = np.linalg.svd(X - mean, full_matrices=False)
+        k = self.getOrDefault("k")
+        return PCAModel(inputCol=self.getOrDefault("inputCol"),
+                        outputCol=self.getOrDefault("outputCol"),
+                        k=k, components=vt[:k], mean=mean)
+
+
+class PCAModel(Model):
+    inputCol = Param("inputCol", "", "features")
+    outputCol = Param("outputCol", "", "pca")
+    k = Param("k", "", 2)
+    components = Param("components", "", None)
+    mean = Param("mean", "", None)
+
+    def transform(self, df):
+        batch, n = _exec_host(df)
+        X = np.asarray(batch.column(self.getOrDefault("inputCol")).data)[:n]
+        out = (X - self.getOrDefault("mean")) @ self.getOrDefault("components").T
+        return append_prediction(df, batch, n, out,
+                                 self.getOrDefault("outputCol"),
+                                 T.ArrayType(T.float64))

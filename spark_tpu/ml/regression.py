@@ -1,0 +1,123 @@
+"""Regression (`ml/regression/` analog): normal equations on the MXU."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import types as T
+from .base import (
+    Estimator, Model, Param, append_prediction, extract_column,
+    extract_matrix,
+)
+
+__all__ = ["LinearRegression", "LinearRegressionModel",
+           "DecisionTreeRegressor", "DecisionTreeRegressionModel"]
+
+
+class LinearRegression(Estimator):
+    regParam = Param("regParam", "L2 regularization", 0.0)
+    elasticNetParam = Param("elasticNetParam", "L1 ratio (0 = ridge)", 0.0)
+    fitIntercept = Param("fitIntercept", "fit intercept", True)
+    maxIter = Param("maxIter", "iterations (L1 path)", 100)
+
+    def _fit(self, df):
+        import jax.numpy as jnp
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        y = extract_column(batch, self.getOrDefault("labelCol"), n)
+        if self.getOrDefault("fitIntercept"):
+            X = jnp.concatenate([X, jnp.ones((X.shape[0], 1))], axis=1)
+        d = X.shape[1]
+        lam = self.getOrDefault("regParam")
+        # ridge normal equations: one X'X matmul on the MXU + tiny solve
+        gram = X.T @ X + lam * n * jnp.eye(d)
+        w = jnp.linalg.solve(gram, X.T @ y)
+        coef = np.asarray(w)
+        if self.getOrDefault("fitIntercept"):
+            weights, intercept = coef[:-1], float(coef[-1])
+        else:
+            weights, intercept = coef, 0.0
+        resid = np.asarray(y) - np.asarray(X) @ coef
+        summary = {
+            "rmse": float(np.sqrt(np.mean(resid ** 2))),
+            "r2": 1.0 - float(np.sum(resid ** 2)
+                              / max(np.sum((np.asarray(y)
+                                            - np.asarray(y).mean()) ** 2),
+                                    1e-30)),
+        }
+        return LinearRegressionModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"),
+            coefficients=weights, intercept=intercept, summary=summary)
+
+
+class LinearRegressionModel(Model):
+    coefficients = Param("coefficients", "", None)
+    intercept = Param("intercept", "", 0.0)
+    summary = Param("summary", "training summary", None)
+
+    def transform(self, df):
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        pred = np.asarray(X) @ np.asarray(self.getOrDefault("coefficients")) \
+            + self.getOrDefault("intercept")
+        return append_prediction(df, batch, n, pred,
+                                 self.getOrDefault("predictionCol"), T.float64)
+
+
+class DecisionTreeRegressor(Estimator):
+    maxDepth = Param("maxDepth", "max depth", 5)
+    minInstancesPerNode = Param("minInstancesPerNode", "", 1)
+
+    def _fit(self, df):
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        y = np.asarray(extract_column(batch, self.getOrDefault("labelCol"), n))
+        X = np.asarray(X)
+        tree = _grow_tree(X, y, 0, self.getOrDefault("maxDepth"),
+                          self.getOrDefault("minInstancesPerNode"))
+        return DecisionTreeRegressionModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"), tree=tree)
+
+
+def _grow_tree(X, y, depth, max_depth, min_rows):
+    """Variance-reduction splits on feature quantiles (`ml/tree/` approach
+    of binned candidate splits, host-side for small data)."""
+    if depth >= max_depth or len(y) <= min_rows or np.all(y == y[0]):
+        return {"leaf": float(y.mean()) if len(y) else 0.0}
+    best = None
+    base = ((y - y.mean()) ** 2).sum()
+    for j in range(X.shape[1]):
+        for q in (0.25, 0.5, 0.75):
+            t = np.quantile(X[:, j], q)
+            left = X[:, j] <= t
+            if left.all() or not left.any():
+                continue
+            yl, yr = y[left], y[~left]
+            cost = ((yl - yl.mean()) ** 2).sum() + ((yr - yr.mean()) ** 2).sum()
+            if best is None or cost < best[0]:
+                best = (cost, j, t, left)
+    if best is None or best[0] >= base:
+        return {"leaf": float(y.mean())}
+    _, j, t, left = best
+    return {"feature": j, "threshold": float(t),
+            "left": _grow_tree(X[left], y[left], depth + 1, max_depth, min_rows),
+            "right": _grow_tree(X[~left], y[~left], depth + 1, max_depth,
+                                min_rows)}
+
+
+def _predict_tree(tree, x):
+    while "leaf" not in tree:
+        tree = tree["left"] if x[tree["feature"]] <= tree["threshold"] \
+            else tree["right"]
+    return tree["leaf"]
+
+
+class DecisionTreeRegressionModel(Model):
+    tree = Param("tree", "", None)
+
+    def transform(self, df):
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        X = np.asarray(X)
+        tree = self.getOrDefault("tree")
+        pred = np.array([_predict_tree(tree, X[i]) for i in range(len(X))])
+        return append_prediction(df, batch, n, pred,
+                                 self.getOrDefault("predictionCol"), T.float64)

@@ -1,0 +1,234 @@
+"""ML pipeline tests (`ml/` suite shapes: fit→transform→evaluate, pipelines,
+cross-validation)."""
+
+import numpy as np
+import pytest
+
+from spark_tpu.ml.base import Pipeline
+from spark_tpu.ml.classification import LinearSVC, LogisticRegression, NaiveBayes
+from spark_tpu.ml.clustering import KMeans
+from spark_tpu.ml.evaluation import (
+    BinaryClassificationEvaluator, MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+from spark_tpu.ml.feature import (
+    Binarizer, Bucketizer, MinMaxScaler, OneHotEncoder, PCA, SQLTransformer,
+    StandardScaler, StringIndexer, IndexToString, VectorAssembler,
+)
+from spark_tpu.ml.recommendation import ALS
+from spark_tpu.ml.regression import DecisionTreeRegressor, LinearRegression
+from spark_tpu.ml.tuning import CrossValidator, ParamGridBuilder
+
+
+def blob_df(spark, n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    x0 = rng.normal(0, 1, (n // 2, 2)) + np.array([2.0, 2.0])
+    x1 = rng.normal(0, 1, (n // 2, 2)) + np.array([-2.0, -2.0])
+    X = np.vstack([x0, x1])
+    y = np.array([1.0] * (n // 2) + [0.0] * (n // 2))
+    return spark.createDataFrame({
+        "features": X, "label": y,
+    })
+
+
+def test_vector_assembler(spark):
+    df = spark.createDataFrame({"a": [1.0, 2.0], "b": [3.0, 4.0]})
+    out = VectorAssembler(inputCols=["a", "b"], outputCol="f").transform(df)
+    rows = out.collect()
+    assert rows[0]["f"] == [1.0, 3.0]
+
+
+def test_standard_scaler(spark):
+    df = spark.createDataFrame({"features": np.array([[1.0], [3.0], [5.0]])})
+    model = StandardScaler(inputCol="features", outputCol="s",
+                           withMean=True).fit(df)
+    got = np.array([r["s"] for r in model.transform(df).collect()])
+    assert got.mean() == pytest.approx(0.0, abs=1e-9)
+
+
+def test_minmax_scaler(spark):
+    df = spark.createDataFrame({"features": np.array([[0.0], [5.0], [10.0]])})
+    m = MinMaxScaler(inputCol="features", outputCol="s").fit(df)
+    got = [r["s"][0] for r in m.transform(df).collect()]
+    assert got == [0.0, 0.5, 1.0]
+
+
+def test_string_indexer_roundtrip(spark):
+    df = spark.createDataFrame({"cat": ["b", "a", "b", "c", "b"]})
+    model = StringIndexer(inputCol="cat", outputCol="idx").fit(df)
+    out = model.transform(df)
+    rows = out.collect()
+    by_cat = {r["cat"]: r["idx"] for r in rows}
+    assert by_cat["b"] == 0.0          # most frequent gets 0
+    back = IndexToString(inputCol="idx", outputCol="orig",
+                         labels=model.getOrDefault("labels")).transform(out)
+    assert all(r["cat"] == r["orig"] for r in back.collect())
+
+
+def test_one_hot(spark):
+    df = spark.createDataFrame({"idx": [0.0, 1.0, 2.0]})
+    out = OneHotEncoder(inputCol="idx", outputCol="v").transform(df)
+    rows = [r["v"] for r in out.collect()]
+    assert rows[0] == [1.0, 0.0] and rows[2] == [0.0, 0.0]
+
+
+def test_binarizer_bucketizer(spark):
+    df = spark.createDataFrame({"x": [0.1, 0.6, 2.5]})
+    b = Binarizer(inputCol="x", outputCol="b", threshold=0.5).transform(df)
+    assert [r["b"] for r in b.collect()] == [0.0, 1.0, 1.0]
+    bk = Bucketizer(inputCol="x", outputCol="bk",
+                    splits=[0.0, 0.5, 1.0, 10.0]).transform(df)
+    assert [r["bk"] for r in bk.collect()] == [0.0, 1.0, 2.0]
+
+
+def test_pca(spark):
+    rng = np.random.default_rng(0)
+    base = rng.normal(0, 1, (50, 1))
+    X = np.hstack([base, base * 2.0 + rng.normal(0, 0.01, (50, 1))])
+    df = spark.createDataFrame({"features": X})
+    m = PCA(inputCol="features", outputCol="p", k=1).fit(df)
+    out = np.array([r["p"] for r in m.transform(df).collect()])
+    # 1 component captures almost all variance of this rank-1-ish data
+    assert out.std() > 1.0
+
+
+def test_logistic_regression(spark):
+    df = blob_df(spark)
+    model = LogisticRegression(maxIter=15).fit(df)
+    out = model.transform(df)
+    acc = MulticlassClassificationEvaluator(
+        metricName="accuracy").evaluate(out)
+    assert acc > 0.95
+    auc = BinaryClassificationEvaluator().evaluate(out)
+    assert auc > 0.95
+
+
+def test_linear_svc(spark):
+    df = blob_df(spark, seed=3)
+    model = LinearSVC(maxIter=200).fit(df)
+    acc = MulticlassClassificationEvaluator(metricName="accuracy") \
+        .evaluate(model.transform(df))
+    assert acc > 0.9
+
+
+def test_naive_bayes(spark):
+    rng = np.random.default_rng(1)
+    # multinomial NB separates by feature PROPORTIONS: skew them per class
+    x0 = rng.poisson([5.0, 1.0, 1.0], (60, 3)).astype(float)
+    x1 = rng.poisson([1.0, 1.0, 5.0], (60, 3)).astype(float)
+    df = spark.createDataFrame({
+        "features": np.vstack([x0, x1]),
+        "label": np.array([0.0] * 60 + [1.0] * 60),
+    })
+    model = NaiveBayes().fit(df)
+    acc = MulticlassClassificationEvaluator(metricName="accuracy") \
+        .evaluate(model.transform(df))
+    assert acc > 0.85
+
+
+def test_linear_regression(spark):
+    rng = np.random.default_rng(2)
+    X = rng.normal(0, 1, (100, 3))
+    y = X @ np.array([2.0, -1.0, 0.5]) + 3.0 + rng.normal(0, 0.01, 100)
+    df = spark.createDataFrame({"features": X, "label": y})
+    model = LinearRegression().fit(df)
+    coef = np.asarray(model.getOrDefault("coefficients"))
+    assert np.allclose(coef, [2.0, -1.0, 0.5], atol=0.05)
+    assert model.getOrDefault("intercept") == pytest.approx(3.0, abs=0.05)
+    rmse = RegressionEvaluator().evaluate(model.transform(df))
+    assert rmse < 0.1
+
+
+def test_decision_tree(spark):
+    rng = np.random.default_rng(4)
+    X = rng.uniform(0, 1, (200, 1))
+    y = np.where(X[:, 0] > 0.5, 10.0, 0.0)
+    df = spark.createDataFrame({"features": X, "label": y})
+    model = DecisionTreeRegressor(maxDepth=3).fit(df)
+    rmse = RegressionEvaluator().evaluate(model.transform(df))
+    assert rmse < 1.0
+
+
+def test_kmeans(spark):
+    df = blob_df(spark, seed=5)
+    model = KMeans(k=2, maxIter=10, seed=1).fit(df)
+    centers = np.asarray(model.getOrDefault("clusterCenters"))
+    # centers near (2,2) and (-2,-2)
+    signs = sorted(np.sign(centers[:, 0]).tolist())
+    assert signs == [-1.0, 1.0]
+    assert model.computeCost(df) < 1000
+
+
+def test_als(spark):
+    rng = np.random.default_rng(6)
+    n_u, n_i, k = 20, 15, 3
+    U = rng.normal(0, 1, (n_u, k))
+    V = rng.normal(0, 1, (n_i, k))
+    users, items = np.meshgrid(np.arange(n_u), np.arange(n_i), indexing="ij")
+    ratings = (U @ V.T).ravel()
+    df = spark.createDataFrame({
+        "user": users.ravel().astype(np.int64),
+        "item": items.ravel().astype(np.int64),
+        "rating": ratings,
+    })
+    model = ALS(rank=3, maxIter=12, regParam=0.01).fit(df)
+    out = model.transform(df)
+    rmse = RegressionEvaluator(labelCol="rating").evaluate(out)
+    assert rmse < 0.1
+
+
+def test_pipeline(spark):
+    df = spark.createDataFrame({
+        "cat": ["x", "y", "x", "y"] * 10,
+        "num": np.linspace(0, 1, 40),
+        "label": np.array(([0.0, 1.0] * 20)),
+    })
+    pipe = Pipeline(stages=[
+        StringIndexer(inputCol="cat", outputCol="ci"),
+        VectorAssembler(inputCols=["ci", "num"], outputCol="features"),
+        LogisticRegression(maxIter=10),
+    ])
+    model = pipe.fit(df)
+    out = model.transform(df)
+    assert "prediction" in out.columns
+
+
+def test_sql_transformer(spark):
+    df = spark.createDataFrame({"v": [1.0, 2.0]})
+    out = SQLTransformer(
+        statement="SELECT v, v * 2 AS v2 FROM __THIS__").transform(df)
+    assert [r["v2"] for r in out.collect()] == [2.0, 4.0]
+
+
+def test_cross_validator(spark):
+    df = blob_df(spark, seed=7)
+    lr = LogisticRegression()
+    grid = ParamGridBuilder().addGrid(lr._params()["regParam"],
+                                      [0.0, 0.1]).build()
+    cv = CrossValidator(estimator=lr, estimatorParamMaps=grid,
+                        evaluator=BinaryClassificationEvaluator(),
+                        numFolds=3)
+    model = cv.fit(df)
+    assert len(model.getOrDefault("avgMetrics")) == 2
+    acc = MulticlassClassificationEvaluator(metricName="accuracy") \
+        .evaluate(model.transform(df))
+    assert acc > 0.9
+
+
+def test_params_api(spark):
+    lr = LogisticRegression()
+    lr.setMaxIter(7)
+    assert lr.getMaxIter() == 7
+    assert "maxIter" in lr.explainParams()
+    c = lr.copy({"maxIter": 9})
+    assert c.getMaxIter() == 9 and lr.getMaxIter() == 7
+
+
+def test_model_save(spark, tmp_path):
+    df = blob_df(spark)
+    model = LogisticRegression(maxIter=5).fit(df)
+    p = str(tmp_path / "lrm")
+    model.write().overwrite().save(p)
+    import json, os
+    meta = json.load(open(os.path.join(p, "metadata.json")))
+    assert meta["class"] == "LogisticRegressionModel"
