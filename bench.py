@@ -1,38 +1,135 @@
-"""Headline benchmark: hash aggregate with grouping keys, rows/sec.
+"""Headline benchmarks, hardened against flaky TPU-backend initialization.
 
-Reference baseline: Spark Tungsten "codegen + vectorized hashmap" path at
-93.5 M rows/s (`sql/core/src/test/.../benchmark/AggregateBenchmark.scala:125-131`,
-i7-4960HQ) — see BASELINE.md.  Same workload shape: N rows, grouped sum/count
-over a keyed column, executed through the planner as one fused XLA program.
-The aggregation itself runs on the MXU (`kernels._mxu_grouped_aggregate`:
-one-hot matmul over 8-bit limb planes, bit-exact int64 sums).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-Timing methodology: the per-batch step runs ITERS times inside a single
-`lax.fori_loop` with a carried dependency on both the group count and the
-aggregated sums (so no iteration can be hoisted or dead-code-eliminated),
-and one scalar is fetched at the end — device-dispatch and host-link
-round-trips are amortized over all iterations, the way a real pipeline
-amortizes them over a stream of batches.  Inputs are perturbed per
-iteration from the carried index.
+Primary metric — hash aggregate with grouping keys, rows/sec.  Reference
+baseline: Spark Tungsten "codegen + vectorized hashmap" at 93.5 M rows/s
+(`sql/core/src/test/.../benchmark/AggregateBenchmark.scala:125-131`,
+i7-4960HQ) — see BASELINE.md.  Same workload shape: N rows, grouped
+sum/count over a keyed column, executed through the planner as one fused
+XLA program; the aggregation runs on the MXU
+(`kernels._mxu_grouped_aggregate`: one-hot matmul over 8-bit limb planes,
+bit-exact int64 sums).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Secondary metric (reported in the same JSON object) — a TPC-DS q3-shaped
+pipeline: fact⋈dim broadcast join → filter → grouped sum → sort, vs the
+Spark broadcast-hash-join baseline of 65.3 M rows/s
+(`JoinBenchmark.scala:42-47`).
+
+Timing methodology: the per-batch step runs ITERS times inside one
+`lax.fori_loop` with a carried dependency on both the row count and the
+aggregated values (nothing can be hoisted or dead-code-eliminated), and
+one scalar is fetched at the end — dispatch and host-link round-trips are
+amortized the way a real pipeline amortizes them over a stream of batches.
+Inputs are perturbed per iteration from the carried index.
+
+Robustness (round-1 failure was `RuntimeError: Unable to initialize
+backend 'axon'` before any measurement): the default entry point is an
+ORCHESTRATOR that runs the actual benchmark in a child process, because a
+failed backend init poisons the parent's jax process state.  It retries
+the TPU child with backoff, then falls back to CPU (reported via the
+"backend" key), and on total failure still prints a well-formed JSON line
+carrying the error tail instead of a raw traceback.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+BASELINE_AGG_ROWS_PER_S = 93.5e6    # AggregateBenchmark.scala:125-131
+BASELINE_JOIN_ROWS_PER_S = 65.3e6   # JoinBenchmark.scala:42-47
 
-BASELINE_ROWS_PER_S = 93.5e6
-
-N = 1 << 22          # rows per iteration (static-shape batch)
+N = 1 << 22          # rows per iteration for the agg bench (static batch)
 ITERS = 20
 GROUPS = 1024
-RESULT_CAP = 8192    # static result capacity (>= bucket cap of the MXU path)
+RESULT_CAP = 8192    # static result capacity (>= bucket cap of MXU path)
 
+J_FACT = 1 << 21     # q3-shape: fact rows per iteration
+J_DIM = 2048         # q3-shape: dimension rows (broadcast side)
+J_BRANDS = 64
+J_ITERS = 10
+
+CHILD_TIMEOUT_S = int(os.environ.get("SPARK_TPU_BENCH_CHILD_TIMEOUT", "600"))
+TPU_ATTEMPTS = int(os.environ.get("SPARK_TPU_BENCH_TPU_ATTEMPTS", "3"))
+BACKOFFS_S = [20, 60, 120]
+
+
+# ======================================================================
+# orchestrator
+# ======================================================================
+
+def _run_child(platform: str | None) -> tuple[int, str, str]:
+    # NB: the axon plugin's sitecustomize force-sets jax_platforms and
+    # ignores the JAX_PLATFORMS env var, so the platform is passed as an
+    # argv flag and applied via jax.config inside the child.
+    argv = [sys.executable, os.path.abspath(__file__), "--child"]
+    if platform is not None:
+        argv.append(f"--platform={platform}")
+    try:
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=CHILD_TIMEOUT_S)
+        return proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        # TimeoutExpired carries bytes even under text=True
+        out = e.stdout.decode(errors="replace") if e.stdout else ""
+        err = e.stderr.decode(errors="replace") if e.stderr else ""
+        return -1, out, err + "\n[child timed out]"
+
+
+def _extract_json(stdout: str) -> dict | None:
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+                if isinstance(obj, dict) and "metric" in obj:
+                    return obj
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def orchestrate() -> int:
+    tails: list[str] = []
+    attempts: list[str | None] = [None] * TPU_ATTEMPTS + ["cpu"]
+    for i, platform in enumerate(attempts):
+        label = platform or "tpu"
+        print(f"[bench] attempt {i + 1}/{len(attempts)} (platform={label})",
+              file=sys.stderr)
+        rc, out, err = _run_child(platform)
+        obj = _extract_json(out)
+        if rc == 0 and obj is not None:
+            if platform == "cpu":
+                obj["backend"] = "cpu-fallback"
+            print(json.dumps(obj))
+            return 0
+        tail = (err or out).strip().splitlines()[-6:]
+        tails.append(f"[{label} rc={rc}] " + " | ".join(tail))
+        print(f"[bench] attempt failed (rc={rc}); tail: {tail}",
+              file=sys.stderr)
+        # back off only before another TPU attempt; the CPU fallback does
+        # not depend on TPU recovery
+        if i + 1 < len(attempts) and attempts[i + 1] is None:
+            delay = BACKOFFS_S[min(i, len(BACKOFFS_S) - 1)]
+            print(f"[bench] backing off {delay}s", file=sys.stderr)
+            time.sleep(delay)
+    print(json.dumps({
+        "metric": "hash_agg_keys_rows_per_sec",
+        "value": 0.0,
+        "unit": "rows/s",
+        "vs_baseline": 0.0,
+        "error": " || ".join(tails)[-1500:],
+    }))
+    return 0
+
+
+# ======================================================================
+# child: the actual measurement
+# ======================================================================
 
 def _slice_batch(batch, cap: int):
     from spark_tpu.columnar import ColumnBatch, ColumnVector
@@ -43,38 +140,46 @@ def _slice_batch(batch, cap: int):
     return ColumnBatch(batch.names, vecs, rv, cap)
 
 
-def main() -> None:
+def _preflight():
+    """Backend init with in-process retry; returns the platform name."""
     import jax
-    import jax.numpy as jnp
+    last = None
+    for attempt in range(3):
+        try:
+            devs = jax.devices()
+            print(f"[bench-child] devices: {devs}", file=sys.stderr)
+            return devs[0].platform
+        except RuntimeError as e:   # backend setup/compile error
+            last = e
+            print(f"[bench-child] jax.devices() failed "
+                  f"(attempt {attempt + 1}): {e}", file=sys.stderr)
+            if attempt < 2:
+                time.sleep(5 * (attempt + 1))
+                try:
+                    jax.extend.backend.clear_backends()
+                except Exception:
+                    pass
+    raise last
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/spark_tpu_jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
+def _bench_hash_agg(jax, jnp, np, session):
     from spark_tpu.columnar import ColumnBatch, ColumnVector
     from spark_tpu.kernels import compact
-    from spark_tpu.sql.session import SparkSession
     from spark_tpu.sql import functions as F
     from spark_tpu.sql import physical as P
     from spark_tpu.sql.planner import QueryExecution
 
     rng = np.random.default_rng(7)
-    session = SparkSession.builder.appName("bench").getOrCreate()
-    session.conf.set("spark.tpu.mesh.shards", "1")
     keys = rng.integers(0, GROUPS, N).astype(np.int64)
     vals = rng.integers(0, 100, N).astype(np.int64)
     df = session.createDataFrame({"k": keys, "v": vals})
     q = df.groupBy("k").agg(F.sum("v").alias("s"), F.count("*").alias("c"))
-
-    qe = QueryExecution(session, q._plan)
-    pq = qe.planned
+    pq = QueryExecution(session, q._plan).planned
     physical = pq.physical
 
     def step(leaves, bump):
-        """One planner-built aggregation over the (perturbed) input batch.
-
-        BOTH columns depend on the carried index — keys via an XOR that
-        preserves the [0, GROUPS) range — so no reduction, bucket-code, or
-        plane computation is loop-invariant and hoistable."""
+        # BOTH columns depend on the carried index — keys via an XOR that
+        # preserves [0, GROUPS) — so nothing is loop-invariant.
         perturbed = []
         for b in leaves:
             vecs = []
@@ -96,7 +201,6 @@ def main() -> None:
     def run_loop(leaves):
         def body(i, acc):
             c, nr = step(leaves, i.astype(jnp.int64))
-            # depend on counts AND sums: nothing may be hoisted or DCE'd
             s_dep = c.vectors[1].data.sum()
             return acc + nr + (s_dep & jnp.int64(1))
         return jax.lax.fori_loop(0, ITERS, body, jnp.int64(0))
@@ -119,15 +223,134 @@ def main() -> None:
     acc = int(np.asarray(loop(dev_leaves)))        # one fetch syncs all iters
     dt = time.perf_counter() - t0
     assert acc >= GROUPS * ITERS, acc
+    return N * ITERS / dt
 
-    rows_per_s = N * ITERS / dt
+
+def _bench_q3_join(jax, jnp, np, session):
+    """TPC-DS q3 shape: fact ⋈ dim (broadcast) → filter → group-sum → sort."""
+    from spark_tpu.columnar import ColumnBatch, ColumnVector
+    from spark_tpu.kernels import compact
+    from spark_tpu.sql import functions as F
+    from spark_tpu.sql import physical as P
+    from spark_tpu.sql.planner import QueryExecution
+
+    rng = np.random.default_rng(11)
+    f_sk = rng.integers(0, J_DIM, J_FACT).astype(np.int64)
+    f_price = rng.integers(1, 1000, J_FACT).astype(np.int64)
+    d_sk = np.arange(J_DIM, dtype=np.int64)
+    d_brand = rng.integers(0, J_BRANDS, J_DIM).astype(np.int64)
+    d_year = rng.integers(1998, 2003, J_DIM).astype(np.int64)
+
+    fact = session.createDataFrame({"sk": f_sk, "price": f_price})
+    dim = session.createDataFrame({"d_sk": d_sk, "brand": d_brand,
+                                   "year": d_year})
+    q = (fact.join(dim, fact["sk"] == dim["d_sk"])
+             .filter(dim["year"] == 2000)
+             .groupBy("brand").agg(F.sum("price").alias("rev"))
+             .orderBy(F.col("rev").desc()))
+    pq = QueryExecution(session, q._plan).planned
+    physical = pq.physical
+
+    def step(leaves, bump):
+        # fact keys AND values depend on the carried index (key XOR
+        # preserves [0, J_DIM)) so the join build/probe cannot be hoisted
+        # out of the timing loop as loop-invariant code.
+        perturbed = []
+        for b in leaves:
+            vecs = []
+            for name, v in zip(b.names, b.vectors):
+                if name == "price":
+                    data = v.data + bump
+                elif name == "sk":
+                    data = v.data ^ (bump & jnp.int64(J_DIM - 1))
+                else:
+                    data = v.data
+                vecs.append(ColumnVector(data, v.dtype, v.valid, v.dictionary))
+            perturbed.append(ColumnBatch(b.names, vecs, b.row_valid,
+                                         b.capacity))
+        ctx = P.ExecContext(jnp, perturbed)
+        out = physical.run(ctx)
+        c = compact(jnp, _slice_batch(out, RESULT_CAP))
+        return c, c.num_rows()
+
+    def run_loop(leaves):
+        def body(i, acc):
+            c, nr = step(leaves, i.astype(jnp.int64))
+            s_dep = c.vectors[1].data.sum()
+            return acc + nr + (s_dep & jnp.int64(1))
+        return jax.lax.fori_loop(0, J_ITERS, body, jnp.int64(0))
+
+    dev_leaves = tuple(b.to_device() for b in pq.leaves)
+
+    # correctness gate vs numpy oracle
+    c0, nr0 = jax.jit(lambda l: step(l, jnp.int64(0)))(dev_leaves)
+    sel = d_year[f_sk] == 2000
+    expect = np.zeros(J_BRANDS, np.int64)
+    np.add.at(expect, d_brand[f_sk[sel]], f_price[sel])
+    # prices are >= 1, so sum > 0 iff the brand matched any fact row
+    n_expected = int((expect > 0).sum())
+    got_n = int(np.asarray(nr0))
+    got_rev = np.asarray(c0.vectors[1].data)[:got_n]
+    exp_rev = np.sort(expect[expect > 0])[::-1]
+    assert got_n == n_expected, (got_n, n_expected)
+    assert np.array_equal(np.sort(got_rev)[::-1], exp_rev), "q3 rev mismatch"
+
+    loop = jax.jit(run_loop)
+    _ = int(np.asarray(loop(dev_leaves)))
+    t0 = time.perf_counter()
+    _ = int(np.asarray(loop(dev_leaves)))
+    dt = time.perf_counter() - t0
+    return J_FACT * J_ITERS / dt
+
+
+def child_main() -> None:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/spark_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    forced = [a.split("=", 1)[1] for a in sys.argv if a.startswith("--platform=")]
+    if forced:
+        jax.config.update("jax_platforms", forced[0])
+        if forced[0] == "cpu":
+            # CPU fallback exists to land *a* number when the TPU tunnel is
+            # down; scale the workload so it finishes inside the timeout.
+            global N, ITERS, J_FACT, J_ITERS
+            N, ITERS, J_FACT, J_ITERS = 1 << 19, 5, 1 << 18, 3
+
+    platform = _preflight()
+
+    from spark_tpu.sql.session import SparkSession
+    session = SparkSession.builder.appName("bench").getOrCreate()
+    session.conf.set("spark.tpu.mesh.shards", "1")
+
+    agg_rows_per_s = _bench_hash_agg(jax, jnp, np, session)
+
+    try:
+        join_rows_per_s = _bench_q3_join(jax, jnp, np, session)
+        q3 = {
+            "q3_join_agg_sort_rows_per_sec": round(join_rows_per_s, 1),
+            "q3_vs_join_baseline": round(
+                join_rows_per_s / BASELINE_JOIN_ROWS_PER_S, 3),
+        }
+    except Exception as e:   # secondary must not sink the primary number
+        print(f"[bench-child] q3 bench failed: {e}", file=sys.stderr)
+        q3 = {"q3_error": str(e)[:300]}
+
     print(json.dumps({
         "metric": "hash_agg_keys_rows_per_sec",
-        "value": round(rows_per_s, 1),
+        "value": round(agg_rows_per_s, 1),
         "unit": "rows/s",
-        "vs_baseline": round(rows_per_s / BASELINE_ROWS_PER_S, 3),
+        "vs_baseline": round(agg_rows_per_s / BASELINE_AGG_ROWS_PER_S, 3),
+        "backend": platform,
+        **q3,
     }))
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    if "--child" in sys.argv:
+        child_main()
+    else:
+        sys.exit(orchestrate())
