@@ -39,10 +39,24 @@ class BufferSpec(NamedTuple):
     np_dtype: np.dtype   # buffer storage dtype
 
 
+def _min_ident(dt):
+    dt = np.dtype(dt)
+    if dt == np.bool_:
+        return True
+    return np.inf if np.issubdtype(dt, np.floating) else np.iinfo(dt).max
+
+
+def _max_ident(dt):
+    dt = np.dtype(dt)
+    if dt == np.bool_:
+        return False
+    return -np.inf if np.issubdtype(dt, np.floating) else np.iinfo(dt).min
+
+
 IDENTITY = {
     "sum": lambda dt: np.zeros((), dt).item() if np.issubdtype(dt, np.floating) else 0,
-    "min": lambda dt: np.inf if np.issubdtype(dt, np.floating) else np.iinfo(dt).max,
-    "max": lambda dt: -np.inf if np.issubdtype(dt, np.floating) else np.iinfo(dt).min,
+    "min": _min_ident,
+    "max": _max_ident,
 }
 
 

@@ -434,6 +434,16 @@ def _first_flag(xp, seg_start_idx, idx):
     return seg_start_idx == idx
 
 
+def _minmax_identity(kind: str, np_dtype):
+    """Scan identity for min/max in the accumulator's OWN dtype.
+
+    Integer min/max must stay integer (Spark's are exact); ±inf only for
+    floats; bool handled (no np.iinfo)."""
+    from ..aggregates import IDENTITY
+    dt = np.dtype(np_dtype)
+    return dt.type(IDENTITY[kind](dt))
+
+
 def _window_aggregate(xp, func, ctx, spec, perm, pos, seg_start_idx,
                       seg_end_idx, vg_end_idx, idx, live_s, schema, cap):
     """sum/count/avg/min/max over partition frames via prefix scans."""
@@ -459,8 +469,8 @@ def _window_aggregate(xp, func, ctx, spec, perm, pos, seg_start_idx,
             kind = "sum"
         elif isinstance(func, (Min, Max)):
             kind = "min" if isinstance(func, Min) else "max"
-            ident = np.inf if kind == "min" else -np.inf
-            buf = xp.where(valid_in, data_s.astype(np.float64), ident)
+            buf = xp.where(valid_in, data_s.astype(dt_out.np_dtype),
+                           _minmax_identity(kind, dt_out.np_dtype))
         else:
             raise AnalysisException(
                 f"unsupported window aggregate {func!r}")
@@ -472,17 +482,13 @@ def _window_aggregate(xp, func, ctx, spec, perm, pos, seg_start_idx,
     def prefix(a):
         return xp.cumsum(a)
 
-    def scan_minmax(a):
-        if kind == "min":
-            return -_cummax(xp, -a) if xp is not np else np.minimum.accumulate(a)
-        return _cummax(xp, a) if xp is not np else np.maximum.accumulate(a)
-
     if kind in ("sum",) or isinstance(func, (Sum, Avg, Count, CountStar)):
         cs = prefix(buf)
         ccnt = prefix(cnt_buf)
-        zero = xp.zeros(1, np.float64)
-        cs0 = xp.concatenate([zero, cs])     # cs0[i] = sum of rows < i
-        ccnt0 = xp.concatenate([zero, ccnt])
+        # sentinel in the ACCUMULATOR dtype: a float64 zero would promote
+        # the whole prefix array and lose int64 exactness beyond 2^53
+        cs0 = xp.concatenate([xp.zeros(1, cs.dtype), cs])  # sum of rows < i
+        ccnt0 = xp.concatenate([xp.zeros(1, ccnt.dtype), ccnt])
 
         if frame is None and not has_order:
             lo_idx, hi_idx = seg_start_idx, seg_end_idx
@@ -510,23 +516,12 @@ def _window_aggregate(xp, func, ctx, spec, perm, pos, seg_start_idx,
             "min/max window frames support only UNBOUNDED PRECEDING")
     base_flag = seg_start_idx == idx
     if frame == (None, 0) or (frame is None and has_order):
-        run = scan_minmax(xp.where(base_flag, buf,
-                                   buf))  # plain scan then re-base
-        # re-base per segment: scan of (buf with identity before segment)
-        # implement via: value = scan(buf masked to segment) using reset at
-        # starts: compute scan over global, then fix by scanning within
-        # segment: use trick scan(where(first_of_seg, buf, combine)) is not
-        # expressible; instead use prefix over segmented reduce: do
-        # a blocked approach: min over [seg_start, i] via cummax of
-        # transformed running index — use the cs0 trick on sorted order
-        # with monotone scan via "reset" encoding:
-        big = np.float64(np.inf if kind == "min" else -np.inf)
-        # encode resets by replacing value at segment start with buf only,
-        # and for scan correctness mask rows before segment via pairing
-        # (segment_id, value) lexicographic scan
+        # running min/max with per-segment reset: a (segment_id, value)
+        # scan that restarts the accumulator at each segment start
+        big = _minmax_identity(kind, np.dtype(buf.dtype))
         seg_id = xp.cumsum(base_flag.astype(np.int64)) - 1
         if xp is np:
-            out = np.empty(cap, np.float64)
+            out = np.empty(cap, buf.dtype)
             cur_seg = -1
             acc = big
             bufn = np.asarray(buf)
@@ -550,8 +545,7 @@ def _window_aggregate(xp, func, ctx, spec, perm, pos, seg_start_idx,
                                        (seg_id, buf))
         run = out
         cnt_run = xp.cumsum(cnt_buf)
-        zero = xp.zeros(1, np.float64)
-        c0 = xp.concatenate([zero, cnt_run])
+        c0 = xp.concatenate([xp.zeros(1, cnt_run.dtype), cnt_run])
         count = c0[idx + 1] - c0[seg_start_idx]
         return run, live_s & (count > 0), dt_out
     # whole partition

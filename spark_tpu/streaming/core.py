@@ -77,6 +77,19 @@ class Source:
         """Rows in (start, end] — must be replayable for recovery."""
         raise NotImplementedError
 
+    # -- offset durability hooks ----------------------------------------
+    # Sources whose offset→data mapping lives in process memory (e.g. the
+    # file source's seen-file list) must persist that mapping in the
+    # offset WAL, or a logged-but-uncommitted batch cannot be replayed
+    # after restart (reference: FileStreamSourceLog).
+    def offset_metadata(self, start: Optional[int], end: int) -> Optional[dict]:
+        """JSON payload stored in the offset WAL entry for this batch."""
+        return None
+
+    def restore_offset_metadata(self, start: Optional[int], end: int,
+                                meta: dict) -> None:
+        """Rebuild in-memory offset state from a WAL entry on recovery."""
+
 
 class MemoryStream(Source):
     """Test/source analog of `streaming/memory.scala` MemoryStream."""
@@ -165,6 +178,19 @@ class FileStreamSource(Source):
             return ColumnBatch.empty(self.schema())
         from ..io import _load_batch
         return _load_batch(self.fmt, files, self.options)
+
+    def offset_metadata(self, start, end) -> dict:
+        lo = start or 0
+        return {"files": self._seen[lo:end]}
+
+    def restore_offset_metadata(self, start, end, meta) -> None:
+        # the WAL's file list is authoritative: offsets must replay to the
+        # exact files originally assigned, not whatever a re-listing
+        # (mtime,name) order would assign now
+        lo = start or 0
+        if len(self._seen) < end:
+            self._seen.extend([""] * (end - len(self._seen)))
+        self._seen[lo:end] = meta["files"]
 
 
 class RateStreamSource(Source):
@@ -305,6 +331,19 @@ class MetadataLog:
 _MERGE_BY_KIND = {"sum": Sum, "min": Min, "max": Max}
 
 
+def _decode_host_col(vec: ColumnVector, cap: int):
+    """(values, valid) numpy arrays for one column, dictionary-decoded so
+    keys compare by VALUE across batches with different dictionaries."""
+    data = np.asarray(vec.data)[:cap]
+    valid = np.ones(cap, bool) if vec.valid is None \
+        else np.asarray(vec.valid)[:cap]
+    if vec.dictionary is not None:
+        d = np.asarray(vec.dictionary, dtype=object)
+        codes = np.clip(data.astype(np.int64), 0, len(d) - 1)
+        data = d[codes]
+    return data, valid
+
+
 class AggregationState:
     """State = one host batch of (key cols + raw partial buffer cols)."""
 
@@ -368,10 +407,16 @@ class AggregationState:
         specs = f.make_buffers(ctx, live)
         return specs[j].kind
 
-    def update(self, new_batch: ColumnBatch) -> ColumnBatch:
-        """Merge one micro-batch; returns the finished (complete) output."""
+    def update(self, new_batch: ColumnBatch,
+               changed_only: bool = False) -> ColumnBatch:
+        """Merge one micro-batch; returns the finished output.
+
+        ``changed_only`` (update output mode) restricts the output to
+        groups touched by THIS batch, the reference's update-mode contract
+        (`StateStoreSaveExec` update path) — not the whole state."""
         from ..kernels import _sorted_grouped_aggregate
         partial = self._partial_rows(new_batch)
+        batch_partial = partial if changed_only else None
         if self.state is not None:
             partial = union_all([self.state, partial])
         merge_slots = self._merge_aggs()
@@ -402,7 +447,39 @@ class AggregationState:
             valid = out.valid if out.valid is not None else None
             names.append(out_name)
             vectors.append(ColumnVector(data, dt, valid, out.dictionary))
-        return ColumnBatch(names, vectors, merged.row_valid, merged.capacity)
+        finished = ColumnBatch(names, vectors, merged.row_valid,
+                               merged.capacity)
+        if batch_partial is not None:
+            keep = self._changed_mask(finished, batch_partial)
+            rv = np.asarray(finished.row_valid_or_true()) & keep
+            finished = compact(np, ColumnBatch(
+                finished.names, finished.vectors, rv, finished.capacity))
+        return finished
+
+    def _changed_mask(self, finished: ColumnBatch,
+                      batch_partial: ColumnBatch) -> np.ndarray:
+        """Vectorized membership: which finished rows' keys appear among
+        the live rows of this batch's partial?  Joint np.unique coding per
+        key column (re-compacted each round so codes never overflow), then
+        one np.isin — no per-row Python in the micro-batch hot loop."""
+        nk = len(self.keys)
+        nf, nb = finished.capacity, batch_partial.capacity
+        live_b = np.broadcast_to(
+            np.asarray(batch_partial.row_valid_or_true()), (nb,))
+        combined = np.zeros(nf + nb, np.int64)
+        for i in range(nk):
+            va, ka = _decode_host_col(finished.vectors[i], nf)
+            vb, kb = _decode_host_col(batch_partial.vectors[i], nb)
+            vals = np.concatenate([va, vb])
+            valids = np.concatenate([ka, kb])
+            _, inv = np.unique(vals, return_inverse=True)
+            inv = inv.astype(np.int64) + 1
+            inv[~valids] = 0         # NULL keys group together
+            _, combined = np.unique(
+                combined * np.int64(inv.max() + 1) + inv,
+                return_inverse=True)
+            combined = combined.astype(np.int64)
+        return np.isin(combined[:nf], combined[nf:][live_b])
 
     def snapshot(self, path: str, batch_id: int) -> None:
         os.makedirs(path, exist_ok=True)
@@ -443,16 +520,20 @@ class AggregationState:
 # the engine
 # ---------------------------------------------------------------------------
 
-def _find_streaming(plan: L.LogicalPlan) -> List[StreamingRelation]:
+def _find_nodes(plan: L.LogicalPlan, cls) -> list:
     out = []
 
     def walk(n):
-        if isinstance(n, StreamingRelation):
+        if isinstance(n, cls):
             out.append(n)
         for c in n.children:
             walk(c)
     walk(plan)
     return out
+
+
+def _find_streaming(plan: L.LogicalPlan) -> List[StreamingRelation]:
+    return _find_nodes(plan, StreamingRelation)
 
 
 class StreamExecution:
@@ -493,30 +574,66 @@ class StreamExecution:
         self._recover()
 
     # -- stateful plan surgery -------------------------------------------
+    #
+    # The UnsupportedOperationChecker analog (reference:
+    # `catalyst/.../analysis/UnsupportedOperationChecker.scala`): find ALL
+    # aggregates in the plan and reject shapes the incremental path cannot
+    # run, instead of silently falling back to per-batch execution.
     def _build_agg_state(self) -> Optional[AggregationState]:
-        node = self.plan
-        # unwrap Projects above the aggregate (post-agg scalar exprs)
-        while isinstance(node, (L.Project,)) and node.children:
-            child = node.children[0]
-            if isinstance(child, L.Aggregate):
-                node = child
-                break
-            node = child
-        if isinstance(node, L.Aggregate):
-            self._agg_node = node
-            return AggregationState(node.keys, node.aggs,
-                                    node.child.schema())
+        # only aggregates whose subtree reads the STREAM are stateful; an
+        # aggregate over a static join side runs per-batch like any other
+        # static subplan
+        aggs = [a for a in _find_nodes(self.plan, L.Aggregate)
+                if _find_streaming(a)]
         self._agg_node = None
-        if self.mode == "complete":
+        if not aggs:
+            if self.mode == "complete":
+                raise AnalysisException(
+                    "complete output mode requires an aggregation")
+            return None
+        if len(aggs) > 1:
+            # covers both siblings and nesting: a nested streaming agg
+            # appears in this list alongside its ancestor
             raise AnalysisException(
-                "complete output mode requires an aggregation")
-        return None
+                "multiple streaming aggregations are not supported")
+        agg = aggs[0]
+        # root→aggregate path must be single-child stateless operators the
+        # finish step can re-apply per batch
+        node = self.plan
+        while node is not agg:
+            if not isinstance(node, (L.Project, L.Filter, L.Sort, L.Limit)) \
+                    or len(node.children) != 1:
+                raise AnalysisException(
+                    f"streaming aggregation under "
+                    f"{type(node).__name__} cannot be executed "
+                    f"incrementally")
+            if isinstance(node, L.Sort) and self.mode != "complete":
+                raise AnalysisException(
+                    "sorting a streaming aggregation is only supported in "
+                    "complete output mode")
+            node = node.children[0]
+        if self.mode == "append":
+            # append over an aggregate needs a watermark to know when
+            # groups are final (EventTimeWatermarkExec); without one this
+            # would emit duplicated, ever-growing group rows
+            raise AnalysisException(
+                "append output mode is not supported for streaming "
+                "aggregations without a watermark")
+        self._agg_node = agg
+        return AggregationState(agg.keys, agg.aggs, agg.child.schema())
 
     def _recover(self):
         last_commit, _ = self.commit_log.latest()
         last_offset_batch, off = self.offset_log.latest()
         if last_offset_batch is None:
             return
+        # rebuild the source's in-memory offset state from the WAL so every
+        # logged batch (committed or not) replays to the same data
+        for b in range(last_offset_batch + 1):
+            entry = self.offset_log.get(b)
+            if entry is not None and entry.get("meta") is not None:
+                self.source.restore_offset_metadata(
+                    entry.get("start"), entry["end"], entry["meta"])
         if last_commit is not None and self._agg_state is not None \
                 and self.state_dir:
             self._agg_state.restore(self.state_dir, last_commit)
@@ -547,8 +664,13 @@ class StreamExecution:
             start = self.committed_offset
             if end is None or end == start:
                 return False
-            # WAL BEFORE compute (exactly-once contract)
-            self.offset_log.add(self.batch_id, {"start": start, "end": end})
+            # WAL BEFORE compute (exactly-once contract); include any
+            # source-side offset→data mapping so the batch replays exactly
+            payload = {"start": start, "end": end}
+            meta = self.source.offset_metadata(start, end)
+            if meta is not None:
+                payload["meta"] = meta
+            self.offset_log.add(self.batch_id, payload)
         t0 = time.time()
         batch = self.source.get_batch(start, end)
         out = self._execute_batch(batch)
@@ -574,7 +696,8 @@ class StreamExecution:
             # StateStoreRestore/Save pair collapsed into one merge
             below = self._replace_source(self._agg_node.child, data)
             pre = QueryExecution(self.session, below).execute()
-            finished = self._agg_state.update(pre)
+            finished = self._agg_state.update(
+                pre, changed_only=(self.mode == "update"))
             above = self._rebuild_above(finished)
             return QueryExecution(self.session, above).execute()
         plan = self._replace_source(self.plan, data)

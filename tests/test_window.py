@@ -167,3 +167,49 @@ def test_global_window_no_partition(spark):
     w = Window.orderBy("v")
     out = df.select("v", F.row_number().over(w).alias("rn"))
     assert sorted(rows(out)) == [(1, 1), (2, 2), (3, 3)]
+
+
+def test_int64_sum_exact_beyond_2_53(spark):
+    """Window SUM of int64 must stay bit-exact past float64's 2^53 mantissa
+    (Spark's long sums are exact; the prefix-scan sentinel must not promote
+    the accumulator to float64)."""
+    big = 1 << 60
+    vals = np.array([big + 5, big + 2], np.int64)
+    df = spark.createDataFrame({"g": ["x", "x"], "v": vals})
+    w = Window.partitionBy("g")
+    out = rows(df.select(F.sum("v").over(w).alias("s")))
+    assert out == [(int(vals.sum()),)] * 2
+
+
+def test_int64_min_max_stay_integer(spark):
+    big = (1 << 60) + 7
+    df = spark.createDataFrame({"g": ["x", "x", "y"],
+                                "v": np.array([big, 3, 9], np.int64)})
+    w = Window.partitionBy("g")
+    out = rows(df.select("g", F.min("v").over(w).alias("lo"),
+                         F.max("v").over(w).alias("hi")))
+    got = {(r[0]): (r[1], r[2]) for r in out}
+    assert got["x"] == (3, big)      # exact, not float64-rounded
+    assert got["y"] == (9, 9)
+    assert all(isinstance(r[1], int) for r in out)
+
+
+def test_int64_running_sum_exact(spark):
+    big = 1 << 60
+    df = spark.createDataFrame({
+        "g": ["x", "x", "x"],
+        "o": np.array([1, 2, 3], np.int64),
+        "v": np.array([big + 1, big + 2, big + 4], np.int64),
+    })
+    w = Window.partitionBy("g").orderBy("o")
+    out = rows(df.select("o", F.sum("v").over(w).alias("s")).orderBy("o"))
+    assert [r[1] for r in out] == [big + 1, 2 * big + 3, 3 * big + 7]
+
+
+def test_bool_max_with_null(spark):
+    """Max over a boolean column with NULLs: identity must be False (the
+    old float64 -inf buffer cast back to bool gave True)."""
+    df = spark.createDataFrame([("x", False), ("x", None)], ["g", "b"])
+    w = Window.partitionBy("g")
+    out = rows(df.select(F.max("b").over(w).alias("m")))
+    assert out == [(False,), (False,)]
