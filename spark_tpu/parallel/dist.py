@@ -151,9 +151,9 @@ class DExchangeHash(DNode):
         ectx = EvalContext(batch, ctx.xp)
         h = ectx.broadcast(Hash64(*self.keys).eval(ectx)).data
         bucket = (h.astype(np.uint64) % np.uint64(self.n_shards)).astype(np.int32)
-        out, overflow = hash_exchange(batch, bucket, self.n_shards,
-                                      self.cap_out(batch.capacity))
-        ctx.flags.append(overflow)   # per-shard; executor psums once
+        cap_out = self.cap_out(batch.capacity)
+        out, overflow = hash_exchange(batch, bucket, self.n_shards, cap_out)
+        ctx.add_flag(overflow, "exchange", cap_out)  # per-shard; executor reduces
         return out
 
     def partitioning(self):
@@ -205,7 +205,7 @@ class DExchangeRange(DNode):
         even = -(-batch.capacity // self.n_shards)
         cap_out = pad_capacity(max(int(even * self.skew_factor), 1))
         out, overflow = hash_exchange(batch, bucket, self.n_shards, cap_out)
-        ctx.flags.append(overflow)   # per-shard; executor psums once
+        ctx.add_flag(overflow, "exchange", cap_out)  # per-shard; executor reduces
         return out
 
     def __repr__(self):
@@ -525,6 +525,29 @@ class DLimit(DNode):
 
     def __repr__(self):
         return f"GlobalLimit {self.n}"
+
+
+class DGatherOne(DNode):
+    """Gather every shard's rows onto shard 0 (other shards go empty).
+
+    Used for windows with an empty partitionBy: the whole dataset is one
+    window partition, which (like the reference's WindowExec under
+    SinglePartition distribution) must be evaluated in one place."""
+
+    def __init__(self, child: P.PhysicalPlan):
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def run(self, ctx):
+        out = broadcast_all(self.children[0].run(ctx))
+        shard = lax.axis_index(DATA_AXIS)
+        rv = out.row_valid_or_true() & (shard == 0)
+        return ColumnBatch(out.names, out.vectors, rv, out.capacity)
+
+    def __repr__(self):
+        return "GatherToOne"
 
 
 class DShardSort(DNode):

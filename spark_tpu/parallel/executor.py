@@ -7,6 +7,7 @@ single XLA program whose collectives are the stage boundaries).
 
 from __future__ import annotations
 
+import logging
 from functools import partial
 from typing import Any, List, Optional, Tuple
 
@@ -33,16 +34,23 @@ from ..sql.planner import Planner, PlannedQuery, _slice_to_host
 from . import dist as D
 from .mesh import DATA_AXIS, get_mesh, mesh_shards
 
+_log = logging.getLogger("spark_tpu.execution")
+
 
 class DistributedPlanner(Planner):
     """Planner emitting exchange-aware physical plans (EnsureRequirements)."""
 
-    def __init__(self, session, n_shards: int):
-        super().__init__(session)
+    def __init__(self, session, n_shards: int,
+                 skew_override: Optional[float] = None,
+                 join_factor_override: Optional[float] = None):
+        super().__init__(session, join_factor_override)
         self.n_shards = n_shards
+        self.skew_override = skew_override
 
     @property
     def skew(self) -> float:
+        if self.skew_override is not None:
+            return self.skew_override
         return self.session.conf.get(C.EXCHANGE_SKEW_FACTOR)
 
     def _to_physical(self, node: LogicalPlan, leaves) -> P.PhysicalPlan:
@@ -73,7 +81,37 @@ class DistributedPlanner(Planner):
             return D.DLimit(node.n, self._to_physical(node.child, leaves))
         if isinstance(node, Join):
             return self._plan_dist_join(node, leaves)
+        from ..sql.window import WindowNode
+        if isinstance(node, WindowNode):
+            return self._plan_dist_window(node, leaves)
         return super()._to_physical(node, leaves)
+
+    def _plan_dist_window(self, node, leaves) -> P.PhysicalPlan:
+        """Windows need all rows of a partition on one shard
+        (WindowExec.requiredChildDistribution: ClusteredDistribution on
+        partitionBy, SinglePartition when empty — `EnsureRequirements.scala:33`).
+        Group the window expressions by partition keys; each group gets a
+        hash exchange (or a gather-to-one-shard for empty partitionBy)
+        before the per-shard window kernel."""
+        child = self._to_physical(node.child, leaves)
+        groups: List[Tuple[Optional[Tuple[str, ...]], list, list]] = []
+        for we, nm in node.wexprs:
+            pb = we.spec.partition_by
+            gkey = tuple(repr(e) for e in pb) if pb else None
+            for g in groups:
+                if g[0] == gkey:
+                    g[2].append((we, nm))
+                    break
+            else:
+                groups.append((gkey, list(pb), [(we, nm)]))
+        plan = child
+        for gkey, pb, wexprs in groups:
+            if gkey is None:
+                plan = D.DGatherOne(plan)
+            else:
+                plan = D.DExchangeHash(pb, self.n_shards, self.skew, plan)
+            plan = P.PWindow(wexprs, plan)
+        return plan
 
     def _plan_dist_join(self, node: Join, leaves) -> P.PhysicalPlan:
         n = self.n_shards
@@ -135,8 +173,49 @@ class DistributedExecution:
         self.mesh = mesh
         self.n = mesh_shards(mesh)
 
+    #: attempts of the adaptive capacity retry before giving up
+    MAX_ADAPT = 4
+
     def execute(self, optimized: LogicalPlan) -> ColumnBatch:
-        planner = DistributedPlanner(self.session, self.n)
+        """Run with adaptive capacity retry: when an exchange bucket or a
+        join output overflows its static capacity, replan with factors
+        sized from the MEASURED worst-shard overflow and rerun — the
+        static-shape answer to `ExchangeCoordinator.scala:85`-style
+        adaptation (which coalesces partitions; here capacities grow)."""
+        base_key = f"dist{self.n}:adapt:" + optimized.tree_string()
+        skew, jf = self.session._adapted_factors.get(base_key, (None, None))
+        for attempt in range(self.MAX_ADAPT + 1):
+            result, ex_ratio, join_ratio = self._run_once(optimized, skew, jf)
+            if ex_ratio <= 0.0 and join_ratio <= 0.0:
+                if skew is not None or jf is not None:
+                    self.session._adapted_factors[base_key] = (skew, jf)
+                return result
+            base_skew = skew if skew is not None \
+                else self.session.conf.get(C.EXCHANGE_SKEW_FACTOR)
+            base_jf = jf if jf is not None \
+                else self.session.conf.get(C.JOIN_OUTPUT_FACTOR)
+            if attempt == self.MAX_ADAPT:
+                raise RuntimeError(
+                    f"exchange/join still overflows after {attempt} adaptive "
+                    f"retries (skew={base_skew}, join factor={base_jf}); "
+                    f"raise {C.EXCHANGE_SKEW_FACTOR.key} / "
+                    f"{C.JOIN_OUTPUT_FACTOR.key} explicitly")
+            if ex_ratio > 0.0:
+                # worst shard lost ex_ratio × its bucket capacity; grow at
+                # least 2× so pathological hashing converges in few steps
+                skew = base_skew * max(2.0, (1.0 + ex_ratio) * 1.25)
+            if join_ratio > 0.0:
+                jf = base_jf * max(2.0, (1.0 + join_ratio) * 1.25)
+            _log.warning(
+                "capacity overflow (exchange %.0f%%, join %.0f%%); "
+                "replanning with skew=%s join_factor=%s",
+                ex_ratio * 100, join_ratio * 100, skew, jf)
+
+    def _run_once(self, optimized: LogicalPlan, skew: Optional[float],
+                  jf: Optional[float]) -> Tuple[ColumnBatch, float, float]:
+        planner = DistributedPlanner(self.session, self.n,
+                                     skew_override=skew,
+                                     join_factor_override=jf)
         pq = planner.plan(optimized)
         key = f"dist{self.n}:" + pq.physical.key()
 
@@ -151,31 +230,39 @@ class DistributedExecution:
                 out = physical.run(ctx)
                 out = compact(jnp, out)
                 n_rows = lax.psum(out.num_rows(), DATA_AXIS)
-                local = sum([jnp.asarray(f, np.int64) for f in ctx.flags]) \
-                    if ctx.flags else jnp.zeros((), np.int64)
-                flags_total = lax.psum(local, DATA_AXIS)
-                return out, n_rows, flags_total
+                # per-kind worst overflow RATIO (lost rows / capacity),
+                # pmax'd over shards — sizes the adaptive retry
+                ex_r = jnp.zeros((), jnp.float32)
+                join_r = jnp.zeros((), jnp.float32)
+                for f, kind, cap in zip(ctx.flags, ctx.flag_kinds,
+                                        ctx.flag_caps):
+                    r = f.astype(jnp.float32) / np.float32(max(cap, 1))
+                    if kind == "exchange":
+                        ex_r = jnp.maximum(ex_r, r)
+                    else:
+                        join_r = jnp.maximum(join_r, r)
+                ex_r = lax.pmax(ex_r, DATA_AXIS)
+                join_r = lax.pmax(join_r, DATA_AXIS)
+                return out, n_rows, ex_r, join_r
 
             wrapped = shard_map(
                 shard_fn, mesh=mesh,
                 in_specs=(PartitionSpec(DATA_AXIS),),
                 out_specs=(PartitionSpec(DATA_AXIS), PartitionSpec(),
-                           PartitionSpec()),
+                           PartitionSpec(), PartitionSpec()),
                 check_vma=False,
             )
             fn = jax.jit(wrapped)
             self.session._jit_cache[key] = fn
 
         dev_leaves = tuple(self._shard_leaf(b) for b in pq.leaves)
-        result, n_rows, flags_total = fn(dev_leaves)
-        lost = int(np.asarray(flags_total))
-        if lost > 0:
-            raise RuntimeError(
-                f"exchange/join overflowed static capacity by {lost} rows; "
-                f"raise {C.EXCHANGE_SKEW_FACTOR.key} or "
-                f"{C.JOIN_OUTPUT_FACTOR.key}")
+        result, n_rows, ex_r, join_r = fn(dev_leaves)
+        ex_ratio = float(np.asarray(ex_r))
+        join_ratio = float(np.asarray(join_r))
+        if ex_ratio > 0.0 or join_ratio > 0.0:
+            return result, ex_ratio, join_ratio
         host = result.to_host()
-        return compact(np, host)
+        return compact(np, host), 0.0, 0.0
 
 
 

@@ -228,11 +228,11 @@ class PJoin(P.PhysicalPlan):
             out = self._append_unmatched_build(ctx, out, build_s, ba_s,
                                                lo, hi, counts, probe_live)
 
-        # overflow accounting: rows beyond static capacity are LOST; executor
-        # raises when this flag is positive (raise outputCapacityFactor)
-        ctx_flags = getattr(ctx, "flags", None)
-        if ctx_flags is not None:
-            ctx_flags.append(xp.maximum(total - out_cap, 0))
+        # overflow accounting: rows beyond static capacity are LOST; the
+        # executor retries with an adapted outputCapacityFactor when this
+        # flag is positive
+        if hasattr(ctx, "add_flag"):
+            ctx.add_flag(xp.maximum(total - out_cap, 0), "join", out_cap)
 
         if self.residual is not None:
             from ..kernels import apply_filter
@@ -364,9 +364,8 @@ def plan_join_raw(planner, node: Join, leaves) -> P.PhysicalPlan:
             raise AnalysisException(f"{node.how} join requires equi-join keys")
         return PJoin(left_p, right_p, "cross", [], residual, raw_schema, 1.0)
 
-    factor = planner.session.conf.get(C.JOIN_OUTPUT_FACTOR)
     return PJoin(left_p, right_p, node.how, key_pairs, residual, raw_schema,
-                 factor)
+                 planner.join_factor)
 
 
 class _JoinOutput(P.PhysicalPlan):

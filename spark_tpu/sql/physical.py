@@ -37,9 +37,18 @@ class ExecContext:
     def __init__(self, xp, leaves: List[ColumnBatch]):
         self.xp = xp
         self.leaves = leaves
-        # traced scalars checked host-side after execution (join overflow
-        # accounting — the dynamic-shape escape hatch)
+        # traced scalars checked host-side after execution (join/exchange
+        # overflow accounting — the dynamic-shape escape hatch); kinds and
+        # static capacities let the executor adapt the right factor and
+        # size the retry from the measured overflow
         self.flags: List[Array] = []
+        self.flag_kinds: List[str] = []
+        self.flag_caps: List[int] = []
+
+    def add_flag(self, value: Array, kind: str, cap: int) -> None:
+        self.flags.append(value)
+        self.flag_kinds.append(kind)
+        self.flag_caps.append(cap)
 
 
 class PhysicalPlan:

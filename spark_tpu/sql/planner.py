@@ -10,6 +10,7 @@ capacities, dictionaries, schemas — change).
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -27,6 +28,21 @@ from .logical import (
     LogicalPlan, Project, RangeRelation, Sample, Sort, SubqueryAlias, Union,
 )
 from . import physical as P
+
+_log = logging.getLogger("spark_tpu.execution")
+
+
+def _overflow_ratio(flags: List[int], caps: List[int]) -> float:
+    """Worst lost-rows / static-capacity ratio across all overflow flags.
+
+    A missing capacity (shouldn't happen) degrades to cap=1 so a positive
+    flag is NEVER silently ignored."""
+    ratio = 0.0
+    for i, f in enumerate(flags):
+        if f > 0:
+            c = caps[i] if i < len(caps) else 1
+            ratio = max(ratio, f / max(c, 1))
+    return ratio
 
 
 def _slice_to_host(result: ColumnBatch, n: int) -> ColumnBatch:
@@ -57,8 +73,17 @@ class PlannedQuery:
 class Planner:
     """Logical → physical (``SparkPlanner.strategies`` analog)."""
 
-    def __init__(self, session):
+    def __init__(self, session, join_factor_override: Optional[float] = None):
         self.session = session
+        self.join_factor_override = join_factor_override
+
+    @property
+    def join_factor(self) -> float:
+        """Join output capacity factor; the executor overrides it upward
+        when a run reports overflow (adaptive capacity retry)."""
+        if self.join_factor_override is not None:
+            return self.join_factor_override
+        return self.session.conf.get(C.JOIN_OUTPUT_FACTOR)
 
     def plan(self, logical: LogicalPlan) -> PlannedQuery:
         leaves: List[ColumnBatch] = []
@@ -149,8 +174,16 @@ class QueryExecution:
         return self._planned
 
     # ------------------------------------------------------------------
+    #: attempts of the adaptive capacity retry before giving up
+    MAX_ADAPT = 4
+
     def execute(self) -> ColumnBatch:
-        """Run the query; returns a COMPACTED host batch."""
+        """Run the query; returns a COMPACTED host batch.
+
+        Capacity overflow (a join producing more rows than its static
+        output buffer) triggers an automatic replan with a factor sized
+        from the MEASURED overflow, instead of erroring — the dynamic-shape
+        answer to ExchangeCoordinator-style adaptation."""
         n_shards = self.session.conf.get(C.MESH_SHARDS)
         if n_shards == 0:
             n_shards = len(jax.devices())
@@ -159,39 +192,67 @@ class QueryExecution:
             from ..parallel.mesh import get_mesh
             return DistributedExecution(
                 self.session, get_mesh(n_shards)).execute(self.optimized)
-        pq = self.planned
+
+        base_key = "local:" + self.planned.physical.key()
+        factor: Optional[float] = \
+            self.session._adapted_factors.get(base_key)
+        for attempt in range(self.MAX_ADAPT + 1):
+            pq = self.planned if factor is None \
+                else Planner(self.session, join_factor_override=factor) \
+                .plan(self.optimized)
+            result, ratio = self._run_planned(pq)
+            if ratio <= 0.0:
+                if factor is not None:
+                    self.session._adapted_factors[base_key] = factor
+                return result
+            base = factor if factor is not None else self.session.conf.get(
+                C.JOIN_OUTPUT_FACTOR)
+            if attempt == self.MAX_ADAPT:
+                raise RuntimeError(
+                    f"join output still overflows after {attempt} adaptive "
+                    f"retries (factor {base}); raise "
+                    f"{C.JOIN_OUTPUT_FACTOR.key} explicitly")
+            factor = base * max(2.0, (1.0 + ratio) * 1.25)
+            _log.warning(
+                "join output overflowed its static capacity by %.0f%%; "
+                "replanning with %s=%.2f", ratio * 100,
+                C.JOIN_OUTPUT_FACTOR.key, factor)
+
+    def _run_planned(self, pq: PlannedQuery) -> Tuple[ColumnBatch, float]:
+        """One execution attempt → (host result, worst overflow ratio)."""
         use_jit = self.session.conf.get(C.CODEGEN_ENABLED)
         if not use_jit:
             ctx = P.ExecContext(np, [b.to_host() for b in pq.leaves])
             out = pq.physical.run(ctx)
-            self._check_flags([int(f) for f in ctx.flags])
-            return compact(np, out.to_host())
+            ratio = _overflow_ratio(
+                [int(f) for f in ctx.flags], ctx.flag_caps)
+            return compact(np, out.to_host()), ratio
 
-        fn = self.session._jit_cache.get(pq.physical.key())
-        if fn is None:
+        cached = self.session._jit_cache.get(pq.physical.key())
+        if cached is None:
             physical = pq.physical
+            meta: Dict[Tuple, List] = {}
 
             def run(leaves):
                 ctx = P.ExecContext(jnp, list(leaves))
                 out = physical.run(ctx)
                 c = compact(jnp, out)
+                # host-side capture at trace time, KEYED BY INPUT SHAPE:
+                # different leaf capacities retrace and may produce
+                # different static flag capacities
+                shape_key = tuple(b.capacity for b in leaves)
+                meta[shape_key] = list(ctx.flag_caps)
                 return c, c.num_rows(), ctx.flags
 
-            fn = jax.jit(run)
-            self.session._jit_cache[pq.physical.key()] = fn
+            cached = (jax.jit(run), meta)
+            self.session._jit_cache[pq.physical.key()] = cached
+        fn, meta = cached
         dev_leaves = tuple(b.to_device() for b in pq.leaves)
         result, n_rows, flags = fn(dev_leaves)
-        self._check_flags([int(np.asarray(f)) for f in flags])
-        return _slice_to_host(result, int(np.asarray(n_rows)))
-
-    @staticmethod
-    def _check_flags(flags: List[int]) -> None:
-        lost = sum(flags)
-        if lost > 0:
-            raise RuntimeError(
-                f"join output overflowed its static capacity by {lost} rows; "
-                f"raise {C.JOIN_OUTPUT_FACTOR.key} (current factor too small "
-                f"for this key multiplicity)")
+        shape_key = tuple(b.capacity for b in pq.leaves)
+        ratio = _overflow_ratio([int(np.asarray(f)) for f in flags],
+                                meta.get(shape_key, []))
+        return _slice_to_host(result, int(np.asarray(n_rows))), ratio
 
     def explain_string(self) -> str:
         s = "== Analyzed Logical Plan ==\n" + self.analyzed.tree_string()

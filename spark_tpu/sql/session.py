@@ -99,6 +99,10 @@ class SparkSession:
         self.conf = self.conf_obj  # Conf has get/set directly
         self.catalog = Catalog()
         self._jit_cache: Dict[str, Any] = {}
+        # learned capacity factors from adaptive overflow retries, keyed by
+        # the pre-adaptation plan key — later executions of the same query
+        # shape start at the factor that worked (no repeat overflow+recompile)
+        self._adapted_factors: Dict[str, Any] = {}
         self._sc = None
 
     @classmethod
@@ -120,6 +124,7 @@ class SparkSession:
     def stop(self) -> None:
         SparkSession._active = None
         self._jit_cache.clear()
+        self._adapted_factors.clear()
 
     # ------------------------------------------------------------------
     def range(self, start: int, end: Optional[int] = None, step: int = 1

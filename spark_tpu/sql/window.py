@@ -434,6 +434,25 @@ def _first_flag(xp, seg_start_idx, idx):
     return seg_start_idx == idx
 
 
+def _segmented_running_scan(xp, buf, seg_id, kind: str, cap: int):
+    """Inclusive running min/max within segments, vectorized.
+
+    Hillis-Steele doubling: after pass k, out[i] covers the last 2^k rows
+    of its segment; log2(cap) passes total.  Works identically under numpy
+    and traced jax (static trip count)."""
+    op = xp.minimum if kind == "min" else xp.maximum
+    out = buf
+    shift = 1
+    while shift < cap:
+        prev = xp.concatenate([out[:shift], out[:-shift]])
+        seg_prev = xp.concatenate([seg_id[:shift], seg_id[:-shift]])
+        idx = xp.arange(cap)
+        same = (seg_id == seg_prev) & (idx >= shift)
+        out = xp.where(same, op(out, prev), out)
+        shift <<= 1
+    return out
+
+
 def _minmax_identity(kind: str, np_dtype):
     """Scan identity for min/max in the accumulator's OWN dtype.
 
@@ -516,37 +535,21 @@ def _window_aggregate(xp, func, ctx, spec, perm, pos, seg_start_idx,
             "min/max window frames support only UNBOUNDED PRECEDING")
     base_flag = seg_start_idx == idx
     if frame == (None, 0) or (frame is None and has_order):
-        # running min/max with per-segment reset: a (segment_id, value)
-        # scan that restarts the accumulator at each segment start
-        big = _minmax_identity(kind, np.dtype(buf.dtype))
+        # running min/max with per-segment reset: vectorized Hillis-Steele
+        # segmented scan (log2(cap) doubling passes; same code on numpy and
+        # jax — no sequential lax.scan, no per-row Python)
         seg_id = xp.cumsum(base_flag.astype(np.int64)) - 1
-        if xp is np:
-            out = np.empty(cap, buf.dtype)
-            cur_seg = -1
-            acc = big
-            bufn = np.asarray(buf)
-            segn = np.asarray(seg_id)
-            for i in range(cap):
-                if segn[i] != cur_seg:
-                    cur_seg = segn[i]
-                    acc = big
-                acc = min(acc, bufn[i]) if kind == "min" else max(acc, bufn[i])
-                out[i] = acc
-        else:
-            import jax
-            def step(carry, x):
-                seg_prev, acc = carry
-                s, b = x
-                acc = xp.where(s != seg_prev, b,
-                               xp.minimum(acc, b) if kind == "min"
-                               else xp.maximum(acc, b))
-                return (s, acc), acc
-            (_, _), out = jax.lax.scan(step, (np.int64(-1), big),
-                                       (seg_id, buf))
-        run = out
+        run = _segmented_running_scan(xp, buf, seg_id, kind, cap)
         cnt_run = xp.cumsum(cnt_buf)
         c0 = xp.concatenate([xp.zeros(1, cnt_run.dtype), cnt_run])
-        count = c0[idx + 1] - c0[seg_start_idx]
+        if frame is None:
+            # default RANGE frame: the current row's ORDER BY peers are IN
+            # the frame — read the running value at the peer-group end
+            # (consistent with the sum/count path's vg_end_idx)
+            run = run[vg_end_idx]
+            count = c0[vg_end_idx + 1] - c0[seg_start_idx]
+        else:
+            count = c0[idx + 1] - c0[seg_start_idx]
         return run, live_s & (count > 0), dt_out
     # whole partition
     from ..kernels import segment_reduce

@@ -165,18 +165,13 @@ def test_join_condition_expr(spark):
     assert [(r.ida, r.vb) for r in out] == [(1, 9)]
 
 
-def test_join_overflow_detection(spark):
+def test_join_overflow_auto_recovery_small(spark):
     a = spark.createDataFrame([(1,)] * 8, ["k"])
     b = spark.createDataFrame([(1, i) for i in range(8)], ["k", "v"])
-    # 8×8 = 64 output rows ≫ 8×factor(1.0) capacity → must raise, not truncate
-    with pytest.raises(RuntimeError, match="overflow"):
-        a.join(b, "k").collect()
-    spark.conf.set("spark.sql.join.outputCapacityFactor", "8.0")
-    try:
-        out = a.join(b, "k").collect()
-        assert len(out) == 64
-    finally:
-        spark.conf.set("spark.sql.join.outputCapacityFactor", "1.0")
+    # 8×8 = 64 output rows ≫ 8×factor(1.0) capacity → the adaptive retry
+    # must grow the factor and return all 64 rows (never truncate)
+    out = a.join(b, "k").collect()
+    assert len(out) == 64
 
 
 def test_cross_join(spark):
@@ -267,3 +262,16 @@ def test_constant_folding(spark):
     qe = QueryExecution(spark, df._plan)
     assert "14" in qe.optimized.tree_string()
     assert [r.c for r in df.collect()] == [14, 14, 14]
+
+
+def test_join_output_overflow_auto_recovery(spark):
+    """High key multiplicity overflows the static join output buffer; the
+    executor must replan with a factor sized from the measured overflow
+    and return the exact result instead of erroring."""
+    import numpy as np
+    left = spark.createDataFrame({"k": np.zeros(100, np.int64),
+                                  "i": np.arange(100, dtype=np.int64)})
+    right = spark.createDataFrame({"k": np.zeros(100, np.int64),
+                                   "j": np.arange(100, dtype=np.int64)})
+    out = left.join(right, "k")
+    assert len(out.collect()) == 100 * 100

@@ -136,14 +136,14 @@ def test_dist_union(dspark):
     assert a.union(b).agg(F.sum("id").alias("s")).collect()[0].s == sum(range(200))
 
 
-def test_dist_skew_overflow_detection(dspark):
-    # high-cardinality distinct with an absurdly small bucket capacity must
-    # overflow and RAISE (never silently drop rows)
+def test_dist_skew_overflow_auto_recovery(dspark):
+    # an absurdly small bucket capacity must trigger the adaptive capacity
+    # retry (factors grown from the measured worst-shard overflow) and
+    # still return the EXACT result — never silently drop rows
     df = dspark.createDataFrame({"k": np.arange(4096, dtype=np.int64)})
     dspark.conf.set("spark.sql.exchange.skewFactor", "0.25")
     try:
-        with pytest.raises(RuntimeError, match="overflow"):
-            df.distinct().count()
+        assert df.distinct().count() == 4096
     finally:
         dspark.conf.set("spark.sql.exchange.skewFactor", "4.0")
     assert df.distinct().count() == 4096
@@ -188,3 +188,90 @@ def test_dist_matches_local_pipeline(dspark):
     np.testing.assert_allclose([r.s for r in dist_rows],
                                [r.s for r in local_rows], rtol=1e-12)
     assert [r.c for r in dist_rows] == [r.c for r in local_rows]
+
+
+def test_dist_window_rank_matches_local(dspark):
+    """Window partitions must be co-located before the per-shard window
+    kernel (WindowExec.requiredChildDistribution); rows of one partition
+    spread over shards previously produced wrong ranks."""
+    from spark_tpu.sql.window import Window
+    rng = np.random.default_rng(23)
+    n = 2000
+    keys = rng.integers(0, 7, n).astype(np.int64)   # << shards: partitions span shards
+    vals = rng.integers(0, 10_000, n).astype(np.int64)
+    w = Window.partitionBy("k").orderBy("v")
+
+    def run(spark_like):
+        df = spark_like.createDataFrame({"k": keys, "v": vals})
+        return sorted(tuple(r) for r in df.select(
+            "k", "v",
+            F.row_number().over(w).alias("rn"),
+            F.sum("v").over(Window.partitionBy("k")).alias("tot"),
+        ).collect())
+
+    got = run(dspark)
+    dspark.conf.set("spark.tpu.mesh.shards", "1")
+    expected = run(dspark)
+    dspark.conf.set("spark.tpu.mesh.shards", "8")
+    assert got == expected
+
+
+def test_dist_window_running_sum_and_lag(dspark):
+    from spark_tpu.sql.window import Window
+    rng = np.random.default_rng(31)
+    n = 1000
+    keys = rng.integers(0, 5, n).astype(np.int64)
+    order = np.arange(n, dtype=np.int64)
+    rng.shuffle(order)
+    vals = rng.integers(-100, 100, n).astype(np.int64)
+    w = Window.partitionBy("k").orderBy("o")
+
+    def run(spark_like):
+        df = spark_like.createDataFrame({"k": keys, "o": order, "v": vals})
+        return sorted(tuple(r) for r in df.select(
+            "k", "o",
+            F.sum("v").over(w).alias("rs"),
+            F.lag("v", 1).over(w).alias("lg"),
+        ).collect())
+
+    got = run(dspark)
+    dspark.conf.set("spark.tpu.mesh.shards", "1")
+    expected = run(dspark)
+    dspark.conf.set("spark.tpu.mesh.shards", "8")
+    assert got == expected
+
+
+def test_dist_window_empty_partition_by(dspark):
+    """Empty partitionBy: the whole dataset is ONE window partition, so it
+    is gathered to a single shard (SinglePartition distribution)."""
+    from spark_tpu.sql.window import Window
+    df = dspark.createDataFrame({"v": np.arange(100, dtype=np.int64)})
+    w = Window.orderBy(F.desc("v"))
+    out = sorted(tuple(r) for r in
+                 df.select("v", F.row_number().over(w).alias("rn")).collect())
+    assert out == sorted((v, 100 - v) for v in range(100))
+
+
+def test_dist_window_mixed_partition_keys(dspark):
+    """Two window specs with different partition keys in one select: each
+    group gets its own exchange."""
+    from spark_tpu.sql.window import Window
+    rng = np.random.default_rng(41)
+    n = 600
+    a = rng.integers(0, 4, n).astype(np.int64)
+    b = rng.integers(0, 3, n).astype(np.int64)
+    v = rng.integers(0, 1000, n).astype(np.int64)
+
+    def run(spark_like):
+        df = spark_like.createDataFrame({"a": a, "b": b, "v": v})
+        return sorted(tuple(r) for r in df.select(
+            "a", "b", "v",
+            F.sum("v").over(Window.partitionBy("a")).alias("sa"),
+            F.sum("v").over(Window.partitionBy("b")).alias("sb"),
+        ).collect())
+
+    got = run(dspark)
+    dspark.conf.set("spark.tpu.mesh.shards", "1")
+    expected = run(dspark)
+    dspark.conf.set("spark.tpu.mesh.shards", "8")
+    assert got == expected

@@ -213,3 +213,27 @@ def test_bool_max_with_null(spark):
     w = Window.partitionBy("g")
     out = rows(df.select(F.max("b").over(w).alias("m")))
     assert out == [(False,), (False,)]
+
+
+def test_running_min_includes_order_peers(spark):
+    """Default (RANGE) frame with ORDER BY includes the current row's
+    peers: min/max must agree with the sum path about frame bounds."""
+    df = spark.createDataFrame(
+        [("g", 1, 5), ("g", 1, 3), ("g", 2, 9)], ["k", "o", "v"])
+    w = Window.partitionBy("k").orderBy("o")
+    out = rows(df.select("o", "v",
+                         F.min("v").over(w).alias("lo"),
+                         F.max("v").over(w).alias("hi")).orderBy("o", "v"))
+    # o=1 rows are peers: both see min=3, max=5; o=2 sees the full set
+    assert out == [(1, 3, 3, 5), (1, 5, 3, 5), (2, 9, 3, 9)]
+
+
+def test_rows_frame_min_excludes_peers(spark):
+    """ROWS UNBOUNDED PRECEDING..CURRENT ROW is position-based: the peer
+    that sorts later does NOT see the one before it excluded."""
+    df = spark.createDataFrame(
+        [("g", 1, 5), ("g", 2, 3), ("g", 3, 9)], ["k", "o", "v"])
+    w = (Window.partitionBy("k").orderBy("o")
+         .rowsBetween(Window.unboundedPreceding, Window.currentRow))
+    out = rows(df.select("o", F.min("v").over(w).alias("lo")).orderBy("o"))
+    assert out == [(1, 5), (2, 3), (3, 3)]
