@@ -30,7 +30,10 @@ from ..sql.logical import (
     Aggregate, Distinct, FileRelation, Filter, Join, Limit, LocalRelation,
     LogicalPlan, Project, RangeRelation, Sample, Sort, SubqueryAlias, Union,
 )
-from ..sql.planner import Planner, PlannedQuery, _slice_to_host
+from ..sql.planner import (
+    ADAPT_MAX_RETRIES, Planner, PlannedQuery, _slice_to_host,
+    grow_capacity_factor,
+)
 from . import dist as D
 from .mesh import DATA_AXIS, get_mesh, mesh_shards
 
@@ -94,24 +97,15 @@ class DistributedPlanner(Planner):
         hash exchange (or a gather-to-one-shard for empty partitionBy)
         before the per-shard window kernel."""
         child = self._to_physical(node.child, leaves)
-        groups: List[Tuple[Optional[Tuple[str, ...]], list, list]] = []
-        for we, nm in node.wexprs:
-            pb = we.spec.partition_by
-            gkey = tuple(repr(e) for e in pb) if pb else None
-            for g in groups:
-                if g[0] == gkey:
-                    g[2].append((we, nm))
-                    break
-            else:
-                groups.append((gkey, list(pb), [(we, nm)]))
-        plan = child
-        for gkey, pb, wexprs in groups:
-            if gkey is None:
-                plan = D.DGatherOne(plan)
-            else:
-                plan = D.DExchangeHash(pb, self.n_shards, self.skew, plan)
-            plan = P.PWindow(wexprs, plan)
-        return plan
+        # the analyzer emits one WindowNode per distinct window spec, so
+        # all wexprs here share one partitionBy — one exchange suffices
+        pb = node.wexprs[0][0].spec.partition_by
+        if pb:
+            exchanged = D.DExchangeHash(list(pb), self.n_shards, self.skew,
+                                        child)
+        else:
+            exchanged = D.DGatherOne(child)
+        return P.PWindow(node.wexprs, exchanged)
 
     def _plan_dist_join(self, node: Join, leaves) -> P.PhysicalPlan:
         n = self.n_shards
@@ -173,8 +167,7 @@ class DistributedExecution:
         self.mesh = mesh
         self.n = mesh_shards(mesh)
 
-    #: attempts of the adaptive capacity retry before giving up
-    MAX_ADAPT = 4
+    MAX_ADAPT = ADAPT_MAX_RETRIES
 
     def execute(self, optimized: LogicalPlan) -> ColumnBatch:
         """Run with adaptive capacity retry: when an exchange bucket or a
@@ -201,11 +194,9 @@ class DistributedExecution:
                     f"raise {C.EXCHANGE_SKEW_FACTOR.key} / "
                     f"{C.JOIN_OUTPUT_FACTOR.key} explicitly")
             if ex_ratio > 0.0:
-                # worst shard lost ex_ratio × its bucket capacity; grow at
-                # least 2× so pathological hashing converges in few steps
-                skew = base_skew * max(2.0, (1.0 + ex_ratio) * 1.25)
+                skew = grow_capacity_factor(base_skew, ex_ratio)
             if join_ratio > 0.0:
-                jf = base_jf * max(2.0, (1.0 + join_ratio) * 1.25)
+                jf = grow_capacity_factor(base_jf, join_ratio)
             _log.warning(
                 "capacity overflow (exchange %.0f%%, join %.0f%%); "
                 "replanning with skew=%s join_factor=%s",

@@ -1,24 +1,30 @@
 """Join execution.
 
 Replaces the reference join zoo (``execution/joins/``: BroadcastHashJoinExec
-on ``BytesToBytesMap``, SortMergeJoinExec's codegen merge loop) with ONE
-static-shape device algorithm, sorted-build + binary-search probe:
+on ``BytesToBytesMap``, SortMergeJoinExec's codegen merge loop
+``SortMergeJoinExec.scala:36``) with ONE static-shape device algorithm,
+sorted-build + binary-search probe:
 
-1. both sides' equi-join keys hash-combine into TWO independent 64-bit keys
-   (strings hash their dictionary words, so string joins need no dictionary
-   alignment); NULL keys get per-side sentinels that can never match.
-2. the build side sorts by hash key (dead rows sentineled to the end);
+1. single-key joins search on an EXACT order-consistent int64 encoding of
+   the key value itself (ints directly; floats via NaN/-0.0-normalizing
+   bitcast, the ``NormalizeFloatingNumbers`` analog; dictionary strings via
+   a host-canonicalized shared id space) — no hashing, collisions
+   impossible by construction.  Multi-key joins search on a 62-bit-masked
+   combined hash with NULL/dead sentinels outside the hash range.
+2. the build side sorts by search key (dead rows sentineled to the end);
 3. each probe row binary-searches its match range [lo, hi) —
    ``searchsorted`` is the TPU-friendly stand-in for hash-table lookup;
 4. duplicate expansion uses the counts-cumsum-gather pattern into a STATIC
    output capacity (``spark.sql.join.outputCapacityFactor`` × probe
-   capacity); the true total is returned as an overflow flag the executor
-   checks host-side after execution — the honest dynamic-shape escape hatch;
-5. matches are verified on the second hash, making cross-key collisions a
-   ~2^-128 event, and false expansion slots are masked out.
+   capacity); the true total is returned as an overflow flag that triggers
+   the executor's adaptive capacity retry — the honest dynamic-shape
+   escape hatch;
+5. every candidate pair is verified by EXACT per-key value comparison
+   (null-aware), so result rows are exact even on the hash search path;
+   existence for semi/anti and outer null-extension derives from a
+   scatter-OR of verified pairs, never from hash-range counts alone.
 
-Semi/anti joins never expand (capacity preserved); outer joins append
-null-padded unmatched rows.
+Outer joins append null-padded unmatched rows.
 """
 
 from __future__ import annotations
@@ -98,6 +104,84 @@ _NULL_BUILD = np.int64(-5)
 _DEAD_BUILD = np.int64(np.iinfo(np.int64).max)
 
 
+def _bitcast_f64(xp, x):
+    import jax.numpy as jnp
+    from jax import lax
+    if xp is np:
+        return np.ascontiguousarray(np.asarray(x, np.float64)).view(np.int64)
+    return lax.bitcast_convert_type(x.astype(jnp.float64), jnp.int64)
+
+
+_CANON_NAN = np.float64(np.nan).view(np.int64) if hasattr(np.float64(0), "view") \
+    else np.int64(0x7FF8000000000000)
+
+
+def _exact_encode_pair(pctx: EvalContext, bctx: EvalContext,
+                       l: Expression, r: Expression):
+    """Exact int64 encodings of one equi-key pair, value-comparable across
+    sides; None when the pair's type has no exact 64-bit encoding (then
+    verification for this pair falls back to the second hash).
+
+    Floats are normalized so NaN == NaN and -0.0 == 0.0 — the join-key
+    contract of the reference's NormalizeFloatingNumbers / Spark NaN
+    grouping semantics.  Dictionary strings map through a HOST-side
+    canonical id space built from both dictionaries at trace time (static
+    metadata), so codes compare by word value across sides."""
+    xp = pctx.xp
+    lv = pctx.broadcast(l.eval(pctx))
+    rv = bctx.broadcast(r.eval(bctx))
+
+    def enc(side_ctx, v, other_dict):
+        if v.dictionary is not None:
+            words = [w if isinstance(w, str) else str(w) for w in v.dictionary]
+            other = [w if isinstance(w, str) else str(w) for w in other_dict]
+            pos = {w: i for i, w in enumerate(sorted(set(words) | set(other)))}
+            table = np.array([pos[w] for w in words] or [0], np.int64)
+            codes = xp.clip(v.data.astype(np.int64), 0,
+                            max(len(words) - 1, 0))
+            return xp.asarray(table)[codes]
+        dt = np.dtype(str(v.data.dtype))
+        if np.issubdtype(dt, np.floating):
+            x = v.data.astype(np.float64)
+            x = xp.where(x == 0.0, np.float64(0.0), x)   # -0.0 → +0.0
+            bits = _bitcast_f64(xp, x)
+            return xp.where(xp.isnan(x), np.int64(_CANON_NAN), bits)
+        if dt == np.bool_ or np.issubdtype(dt, np.integer):
+            return v.data.astype(np.int64)
+        return None
+
+    ld = np.dtype(str(lv.data.dtype))
+    rd = np.dtype(str(rv.data.dtype))
+    has_dict = lv.dictionary is not None or rv.dictionary is not None
+    if has_dict and (lv.dictionary is None or rv.dictionary is None):
+        return None                      # string vs non-dict string
+    if not has_dict and (np.issubdtype(ld, np.floating)
+                         != np.issubdtype(rd, np.floating)):
+        # mixed int/float pair: compare both as float64
+        from ..expressions import ExprValue
+        lv = ExprValue(lv.data.astype(np.float64), lv.valid, None)
+        rv = ExprValue(rv.data.astype(np.float64), rv.valid, None)
+    p_enc = enc(pctx, lv, rv.dictionary if has_dict else [])
+    b_enc = enc(bctx, rv, lv.dictionary if has_dict else [])
+    if p_enc is None or b_enc is None:
+        return None
+    p_val = None if lv.valid is None \
+        else xp.broadcast_to(lv.valid, (pctx.capacity,))
+    b_val = None if rv.valid is None \
+        else xp.broadcast_to(rv.valid, (bctx.capacity,))
+    return p_enc, p_val, b_enc, b_val
+
+
+def _scatter_or(xp, size: int, idx, values):
+    """out[j] = OR of values where idx == j (bounded scatter)."""
+    if xp is np:
+        out = np.zeros(size, bool)
+        np.logical_or.at(out, np.asarray(idx), np.asarray(values))
+        return out
+    import jax.numpy as jnp
+    return jnp.zeros(size, bool).at[idx].max(values, mode="drop")
+
+
 def _join_keys(ctx: EvalContext, exprs: Sequence[Expression],
                null_sentinel: np.int64, dead_sentinel: Optional[np.int64]
                ) -> Tuple[Array, Array]:
@@ -154,36 +238,44 @@ class PJoin(P.PhysicalPlan):
 
         pctx = EvalContext(probe, xp)
         bctx = EvalContext(build, xp)
-        pa, pb = _join_keys(pctx, [l for l, _ in self.key_pairs], _NULL_PROBE, None)
-        ba, bb = _join_keys(bctx, [r for _, r in self.key_pairs], _NULL_BUILD,
-                            _DEAD_BUILD)
+        probe_live = probe.row_valid_or_true()
+        build_live = build.row_valid_or_true()
 
-        # sort build by hash key (dead rows to the end via sentinel)
-        perm = multi_key_argsort(xp, [ba], build.capacity)
-        ba_s = ba[perm]
-        bb_s = bb[perm]
+        # exact int64 encodings per key pair (None → hashB fallback for
+        # that pair's verification)
+        encs = [_exact_encode_pair(pctx, bctx, l, r)
+                for l, r in self.key_pairs]
+
+        if len(encs) == 1 and encs[0] is not None:
+            # EXACT search path: sort/search the encoded value itself —
+            # no hash, collisions impossible by construction
+            p_enc, p_val, b_enc, b_val = encs[0]
+            b_ok = build_live if b_val is None else (build_live & b_val)
+            # lexicographic (flag, key) sort puts valid keys first sorted
+            # by value; null/dead rows sink into an INT64_MAX-keyed suffix
+            b_flag = xp.where(b_ok, np.int8(0), np.int8(1))
+            perm = multi_key_argsort(xp, [b_flag, b_enc], build.capacity)
+            b_flag_s = b_flag[perm]
+            ba_s = xp.where(b_flag_s == 0, b_enc[perm], _DEAD_BUILD)
+            pa = p_enc
+            p_ok = probe_live if p_val is None else (probe_live & p_val)
+        else:
+            # multi-key / unencodable: combined-hash search with sentinels
+            pa, _pb = _join_keys(pctx, [l for l, _ in self.key_pairs],
+                                 _NULL_PROBE, None)
+            ba, _bb = _join_keys(bctx, [r for _, r in self.key_pairs],
+                                 _NULL_BUILD, _DEAD_BUILD)
+            perm = multi_key_argsort(xp, [ba], build.capacity)
+            ba_s = ba[perm]
+            p_ok = probe_live
         build_s = take_batch(xp, build, perm)
 
         lo = xp.searchsorted(ba_s, pa, side="left")
         hi = xp.searchsorted(ba_s, pa, side="right")
-        counts = (hi - lo).astype(np.int64)
-        probe_live = probe.row_valid_or_true()
-        counts = xp.where(probe_live, counts, 0)
-        matched = counts > 0
-
-        if how in ("left_semi", "left_anti"):
-            keep = matched if how == "left_semi" else (~matched & probe_live)
-            # verify hashB for semi (first match position suffices w.h.p.)
-            if how == "left_semi":
-                first_b = bb_s[xp.clip(lo, 0, build.capacity - 1)]
-                keep = keep & (first_b == pb) | (counts > 1)  # dup range: trust hashA
-                keep = keep & probe_live
-            return ColumnBatch(probe.names, probe.vectors,
-                               probe.row_valid_or_true() & keep, probe.capacity)
+        counts = xp.where(p_ok, (hi - lo).astype(np.int64), 0)
+        matched_hash = counts > 0
 
         out_cap = pad_capacity(int(probe.capacity * max(self.factor, 0.1)))
-        extra = build.capacity if how == "full" else 0
-
         if how in ("left", "full"):
             counts_eff = xp.where(probe_live, xp.maximum(counts, 1), 0)
         else:
@@ -198,67 +290,105 @@ class PJoin(P.PhysicalPlan):
         i = xp.clip(i, 0, probe.capacity - 1)
         d = slot - offsets[i]
         in_range = slot < total
-        has_match = matched[i]
+        has_match = matched_hash[i]
         b_row = xp.clip(lo[i] + d, 0, build.capacity - 1)
 
-        # verify on the second hash; null-extension rows skip verification
-        verify = (pb[i] == bb_s[b_row]) & (pa[i] == ba_s[b_row])
-        pair_ok = in_range & (verify | ~has_match)
+        # EXACT per-pair verification (null-aware): a pair survives only
+        # if every key column compares equal with both sides valid
+        build_live_s = build_live[perm]
+        verify = in_range & has_match & build_live_s[b_row]
+        hashb_needed = any(e is None for e in encs)
+        for e in encs:
+            if e is not None:
+                pe, pv, be, bv = e
+                be_s = be[perm]
+                ok = pe[i] == be_s[b_row]
+                if pv is not None:
+                    ok = ok & pv[i]
+                if bv is not None:
+                    ok = ok & bv[perm][b_row]
+                verify = verify & ok
+        if hashb_needed:
+            # unencodable pairs: fall back to the independent second hash
+            # over exactly those pairs (collision ~2^-64, documented)
+            exprs_l = [l for (l, _), e in zip(self.key_pairs, encs) if e is None]
+            exprs_r = [r for (_, r), e in zip(self.key_pairs, encs) if e is None]
+            pb2 = pctx.broadcast(_Hash64B(*exprs_l).eval(pctx)).data
+            bb2 = bctx.broadcast(_Hash64B(*exprs_r).eval(bctx)).data[perm]
+            verify = verify & (pb2[i] == bb2[b_row])
 
+        # assemble the combined (probe row, build row) batch for each slot;
+        # needed before existence when a residual ON conjunct participates
+        # in the match decision
         left_out = take_batch(xp, probe, i)
         right_out = take_batch(xp, build_s, b_row)
-        null_right = has_match  # False → null-extend right side
+        names: List[str] = list(left_out.names) + list(right_out.names)
+        raw_vectors: List[ColumnVector] = \
+            list(left_out.vectors) + list(right_out.vectors)
 
-        vectors: List[ColumnVector] = []
-        names: List[str] = []
-        for n, v in zip(left_out.names, left_out.vectors):
-            names.append(n)
-            vectors.append(v)
-        for n, v in zip(right_out.names, right_out.vectors):
-            valid = v.valid
-            base = valid if valid is not None else xp.ones(out_cap, dtype=bool)
-            valid = base & null_right if how in ("left", "full") else valid
-            names.append(n)
-            vectors.append(ColumnVector(v.data, v.dtype, valid, v.dictionary))
+        if self.residual is not None:
+            # non-equi ON conjuncts are part of the MATCH CONDITION
+            # (ExtractEquiJoinKeys keeps them as the join's `condition`):
+            # a pair that fails them is not a match — it does not satisfy
+            # semi-existence and DOES null-extend in outer joins
+            rctx = EvalContext(
+                ColumnBatch(names, raw_vectors, verify, out_cap), xp)
+            rv_res = rctx.broadcast(self.residual.eval(rctx))
+            res_ok = rv_res.data.astype(bool)
+            if rv_res.valid is not None:
+                res_ok = res_ok & rv_res.valid   # NULL condition → no match
+            verify = verify & res_ok
 
-        rv = pair_ok
-        out = ColumnBatch(names, vectors, rv, out_cap)
+        # exact existence per probe row — drives semi/anti and outer
+        # null-extension (never hash-range counts alone)
+        exact_m = _scatter_or(xp, probe.capacity, i, verify)
 
-        if how == "full":
-            out = self._append_unmatched_build(ctx, out, build_s, ba_s,
-                                               lo, hi, counts, probe_live)
-
-        # overflow accounting: rows beyond static capacity are LOST; the
-        # executor retries with an adapted outputCapacityFactor when this
-        # flag is positive
         if hasattr(ctx, "add_flag"):
             ctx.add_flag(xp.maximum(total - out_cap, 0), "join", out_cap)
 
-        if self.residual is not None:
-            from ..kernels import apply_filter
-            out = apply_filter(xp, out, self.residual)
+        if how in ("left_semi", "left_anti"):
+            keep = exact_m if how == "left_semi" \
+                else (probe_live & ~exact_m)
+            return ColumnBatch(probe.names, probe.vectors,
+                               probe.row_valid_or_true() & keep,
+                               probe.capacity)
+
+        if how in ("left", "full"):
+            # probe rows with zero VERIFIED matches emit one null-extended
+            # row on their first slot (covers zero-hash-match rows,
+            # all-pairs-refuted collisions, and residual-refuted matches)
+            null_slot = in_range & (d == 0) & ~exact_m[i] & probe_live[i]
+            pair_ok = verify | null_slot
+            null_right = verify
+        else:
+            pair_ok = verify
+            null_right = None
+
+        vectors: List[ColumnVector] = []
+        for idx, v in enumerate(raw_vectors):
+            if null_right is not None and idx >= len(left_out.vectors):
+                base = v.valid if v.valid is not None \
+                    else xp.ones(out_cap, bool)
+                v = ColumnVector(v.data, v.dtype, base & null_right,
+                                 v.dictionary)
+            vectors.append(v)
+
+        out = ColumnBatch(names, vectors, pair_ok, out_cap)
+
+        if how == "full":
+            hit_b = _scatter_or(xp, build.capacity, b_row, verify)
+            unmatched_b = build_live_s & ~hit_b
+            out = self._append_unmatched_build(ctx, out, build_s, unmatched_b)
         return out
 
     # ------------------------------------------------------------------
     def _append_unmatched_build(self, ctx, inner_out: ColumnBatch,
-                                build_s: ColumnBatch, ba_s, lo, hi, counts,
-                                probe_live):
-        """FULL OUTER: mark build rows hit by any probe via a diff array,
-        append the unmatched ones null-extended on the left side."""
+                                build_s: ColumnBatch, unmatched):
+        """FULL OUTER: append build rows with no VERIFIED match,
+        null-extended on the left side (exact — derived from the per-pair
+        verification scatter, not hash-range hit spans)."""
         xp = ctx.xp
         cap_b = build_s.capacity
-        ones = xp.where(probe_live & (counts > 0), 1, 0).astype(np.int64)
-        start = xp.zeros(cap_b + 1, np.int64)
-        if xp is np:
-            np.add.at(start, np.asarray(lo), np.asarray(ones))
-            np.add.at(start, np.asarray(hi), -np.asarray(ones))
-            hit = np.cumsum(start[:cap_b]) > 0
-        else:
-            start = start.at[lo].add(ones, mode="drop")
-            start = start.at[hi].add(-ones, mode="drop")
-            hit = xp.cumsum(start[:cap_b]) > 0
-        build_live = build_s.row_valid_or_true() & (ba_s < _DEAD_BUILD)
-        unmatched = build_live & ~hit
 
         names = inner_out.names
         left_n = len(names) - len(build_s.names)

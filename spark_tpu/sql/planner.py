@@ -31,6 +31,16 @@ from . import physical as P
 
 _log = logging.getLogger("spark_tpu.execution")
 
+#: adaptive capacity retry policy — ONE definition shared by the local and
+#: distributed executors so overflow behavior cannot diverge
+ADAPT_MAX_RETRIES = 4
+
+
+def grow_capacity_factor(base: float, ratio: float) -> float:
+    """Next capacity factor after an overflow of `ratio` (lost/capacity):
+    at least 2× so pathological distributions converge in few retries."""
+    return base * max(2.0, (1.0 + ratio) * 1.25)
+
 
 def _overflow_ratio(flags: List[int], caps: List[int]) -> float:
     """Worst lost-rows / static-capacity ratio across all overflow flags.
@@ -174,8 +184,7 @@ class QueryExecution:
         return self._planned
 
     # ------------------------------------------------------------------
-    #: attempts of the adaptive capacity retry before giving up
-    MAX_ADAPT = 4
+    MAX_ADAPT = ADAPT_MAX_RETRIES
 
     def execute(self) -> ColumnBatch:
         """Run the query; returns a COMPACTED host batch.
@@ -212,7 +221,7 @@ class QueryExecution:
                     f"join output still overflows after {attempt} adaptive "
                     f"retries (factor {base}); raise "
                     f"{C.JOIN_OUTPUT_FACTOR.key} explicitly")
-            factor = base * max(2.0, (1.0 + ratio) * 1.25)
+            factor = grow_capacity_factor(base, ratio)
             _log.warning(
                 "join output overflowed its static capacity by %.0f%%; "
                 "replanning with %s=%.2f", ratio * 100,

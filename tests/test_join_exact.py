@@ -1,0 +1,176 @@
+"""Exact join semantics (`SortMergeJoinExec.scala:36` parity).
+
+Joins must be EXACT, not hash-probabilistic: single-key joins search on
+exact value encodings; every candidate pair is verified by value; semi/
+anti existence and outer null-extension derive from verified pairs.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_tpu.sql.functions as F
+
+
+I64_MAX = np.iinfo(np.int64).max
+I64_MIN = np.iinfo(np.int64).min
+
+
+def rows(df):
+    def key(t):
+        return tuple((v is None, 0 if v is None else v) for v in t)
+    return sorted((tuple(r) for r in df.collect()), key=key)
+
+
+def test_extreme_int64_keys(spark):
+    """INT64_MAX collides with the null/dead sentinel suffix of the exact
+    search path; verification must still produce the exact answer."""
+    left = spark.createDataFrame(
+        {"k": np.array([I64_MAX, I64_MIN, 0, 7], np.int64),
+         "l": np.array([1, 2, 3, 4], np.int64)})
+    right = spark.createDataFrame(
+        {"k": np.array([I64_MAX, 5, I64_MIN], np.int64),
+         "r": np.array([10, 20, 30], np.int64)})
+    got = rows(left.join(right, "k"))
+    assert got == [(I64_MIN, 2, 30), (I64_MAX, 1, 10)]
+
+
+def test_negative_zero_normalization_and_nan_as_null(spark):
+    """-0.0 == 0.0 on join keys (NormalizeFloatingNumbers contract).
+    NaN is NULL in this engine's ingestion semantics (columnar.py NaN→NULL
+    by design), so NaN-keyed rows never match — like NULL keys."""
+    left = spark.createDataFrame(
+        {"k": np.array([np.nan, -0.0, 1.5], np.float64),
+         "l": np.array([1, 2, 3], np.int64)})
+    right = spark.createDataFrame(
+        {"k": np.array([np.nan, 0.0], np.float64),
+         "r": np.array([10, 20], np.int64)})
+    out = rows(left.join(right, "k").select("l", "r"))
+    assert out == [(2, 20)]
+
+
+def test_string_join_disjoint_dictionaries(spark):
+    """Each side dictionary-encodes independently; equality must compare
+    word VALUES through the canonical id space, not codes."""
+    left = spark.createDataFrame(
+        [("zebra", 1), ("apple", 2), ("mango", 3)], ["k", "l"])
+    right = spark.createDataFrame(
+        [("apple", 10), ("zebra", 20), ("kiwi", 30)], ["k", "r"])
+    got = rows(left.join(right, "k").select("k", "l", "r"))
+    assert got == [("apple", 2, 10), ("zebra", 1, 20)]
+
+
+def test_null_keys_never_match(spark):
+    left = spark.createDataFrame([(None, 1), (5, 2)], ["k", "l"])
+    right = spark.createDataFrame([(None, 10), (5, 20)], ["k", "r"])
+    assert rows(left.join(right, "k").select("l", "r")) == [(2, 20)]
+    # left outer: null-key row null-extends
+    got = rows(left.join(right, "k", "left").select("l", "r"))
+    assert got == [(1, None), (2, 20)]
+    # semi/anti exact
+    assert rows(left.join(right, "k", "left_semi").select("l")) == [(2,)]
+    assert rows(left.join(right, "k", "left_anti").select("l")) == [(1,)]
+
+
+def test_semi_anti_with_duplicate_build_keys(spark):
+    """The old dup-range shortcut trusted hashA alone when the build range
+    had duplicates; existence must come from verified pairs."""
+    left = spark.createDataFrame(
+        {"k": np.array([1, 2, 3], np.int64), "l": np.array([1, 2, 3], np.int64)})
+    right = spark.createDataFrame(
+        {"k": np.array([2, 2, 2, 9, 9], np.int64),
+         "r": np.arange(5, dtype=np.int64)})
+    assert rows(left.join(right, "k", "left_semi").select("l")) == [(2,)]
+    assert rows(left.join(right, "k", "left_anti").select("l")) == [(1,), (3,)]
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full"])
+def test_property_vs_pandas(spark, how):
+    rng = np.random.default_rng(hash(how) % 2**31)
+    n, m = 300, 200
+    lk = rng.integers(0, 50, n).astype(np.int64)
+    rk = rng.integers(25, 75, m).astype(np.int64)
+    lv = rng.integers(0, 1000, n).astype(np.int64)
+    rv = rng.integers(0, 1000, m).astype(np.int64)
+    left = spark.createDataFrame({"k": lk, "l": lv})
+    right = spark.createDataFrame({"k2": rk, "r": rv})
+    got = rows(left.join(right, left["k"] == right["k2"], how)
+               .select("l", "r"))
+    pdf = pd.DataFrame({"k": lk, "l": lv}).merge(
+        pd.DataFrame({"k": rk, "r": rv}), on="k",
+        how={"inner": "inner", "left": "left", "right": "right",
+             "full": "outer"}[how])
+    def key(t):
+        return tuple((v is None, 0 if v is None else v) for v in t)
+    exp = sorted(((None if pd.isna(a) else int(a),
+                   None if pd.isna(b) else int(b))
+                  for a, b in zip(pdf["l"], pdf["r"])), key=key)
+    assert got == exp
+
+
+def test_property_multi_key_vs_pandas(spark):
+    rng = np.random.default_rng(99)
+    n, m = 250, 250
+    lk1 = rng.integers(0, 10, n).astype(np.int64)
+    lk2 = rng.integers(0, 10, n).astype(np.int64)
+    rk1 = rng.integers(0, 10, m).astype(np.int64)
+    rk2 = rng.integers(0, 10, m).astype(np.int64)
+    lv = np.arange(n, dtype=np.int64)
+    rv = np.arange(m, dtype=np.int64)
+    left = spark.createDataFrame({"a": lk1, "b": lk2, "l": lv})
+    right = spark.createDataFrame({"c": rk1, "d": rk2, "r": rv})
+    cond = (left["a"] == right["c"]) & (left["b"] == right["d"])
+    got = rows(left.join(right, cond).select("l", "r"))
+    pdf = pd.DataFrame({"k1": lk1, "k2": lk2, "l": lv}).merge(
+        pd.DataFrame({"k1": rk1, "k2": rk2, "r": rv}), on=["k1", "k2"])
+    exp = sorted((int(a), int(b)) for a, b in zip(pdf["l"], pdf["r"]))
+    assert got == exp
+
+
+def test_dist_join_exact_matches_local(spark):
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    rng = np.random.default_rng(7)
+    n = 2000
+    lk = rng.integers(0, 100, n).astype(np.int64)
+    rk = rng.integers(50, 150, n).astype(np.int64)
+    left_d = {"k": lk, "l": np.arange(n, dtype=np.int64)}
+    right_d = {"k2": rk, "r": np.arange(n, dtype=np.int64)}
+
+    def run():
+        left = spark.createDataFrame(left_d)
+        right = spark.createDataFrame(right_d)
+        return rows(left.join(right, left["k"] == right["k2"], "left")
+                    .select("l", "r"))
+
+    spark.conf.set("spark.tpu.mesh.shards", "8")
+    try:
+        got = run()
+    finally:
+        spark.conf.set("spark.tpu.mesh.shards", "1")
+    assert got == run()
+
+
+def test_residual_condition_in_semi_anti(spark):
+    """Non-equi ON conjuncts are part of the MATCH condition: semi/anti
+    existence must respect them, not just the equi keys."""
+    left = spark.createDataFrame([(1, 5), (2, 50)], ["k", "v"])
+    right = spark.createDataFrame([(1, 10), (2, 10)], ["k2", "w"])
+    cond = (left["k"] == right["k2"]) & (left["v"] < right["w"])
+    assert rows(left.join(right, cond, "left_semi").select("k")) == [(1,)]
+    assert rows(left.join(right, cond, "left_anti").select("k")) == [(2,)]
+
+
+def test_residual_condition_null_extends_outer(spark):
+    """A probe row whose only equi-match fails the residual is UNMATCHED:
+    it must appear null-extended in a left join, not be dropped."""
+    left = spark.createDataFrame([(1, 5), (2, 50)], ["k", "v"])
+    right = spark.createDataFrame([(1, 10), (2, 10)], ["k2", "w"])
+    cond = (left["k"] == right["k2"]) & (left["v"] < right["w"])
+    got = rows(left.join(right, cond, "left").select("k", "v", "w"))
+    assert got == [(1, 5, 10), (2, 50, None)]
+    # full outer: the refused build row appears null-extended too
+    got_full = rows(left.join(right, cond, "full").select("k", "v", "k2", "w"))
+    assert got_full == [(1, 5, 1, 10), (2, 50, None, None),
+                        (None, None, 2, 10)]
