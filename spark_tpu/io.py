@@ -301,6 +301,68 @@ def read_file_relation(rel: L.FileRelation, session) -> ColumnBatch:
 
 
 # ---------------------------------------------------------------------------
+# streamed (multi-batch) scans — FileScanRDD.scala analog
+# ---------------------------------------------------------------------------
+
+def file_row_count(rel: L.FileRelation) -> Optional[int]:
+    """Total rows WITHOUT loading data when possible (parquet metadata);
+    other formats load (host-cached) and count."""
+    try:
+        files = _resolve_paths(rel.paths)
+    except AnalysisException:
+        return None
+    if rel.fmt == "parquet":
+        import pyarrow.parquet as pq
+        return sum(pq.ParquetFile(f).metadata.num_rows for f in files)
+    batch = _load_batch(rel.fmt, rel.paths, rel.options)
+    return int(np.asarray(batch.num_rows()))
+
+
+def scan_file_batches(rel: L.FileRelation, batch_rows: int):
+    """Yield host ColumnBatches of ≤ batch_rows rows each.
+
+    Parquet streams record batches straight off the file (the
+    VectorizedParquetRecordReader path — bounded host memory); other
+    formats slice the host-cached table.  Partition-directory columns are
+    appended per file."""
+    files = _resolve_paths(rel.paths)
+    base = rel.paths[0] if isinstance(rel.paths, list) else rel.paths
+    base = base if os.path.isdir(base) else os.path.dirname(base)
+    if rel.fmt == "parquet":
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        for f in files:
+            pvals = _partition_values(f, base)
+            pf = pq.ParquetFile(f)
+            for rb in pf.iter_batches(batch_size=batch_rows):
+                table = pa.Table.from_batches([rb])
+                extra = {k: _infer_partition_column([v] * table.num_rows)
+                         for k, v in pvals.items()} or None
+                yield _table_to_batch(table, extra)
+        return
+    whole = _load_batch(rel.fmt, rel.paths, rel.options)
+    n = int(np.asarray(whole.num_rows()))
+    # the cached batch is compacted on load (row_valid all-true prefix)
+    for start in range(0, max(n, 1), batch_rows):
+        stop = min(start + batch_rows, n)
+        yield _slice_rows(whole, start, stop)
+
+
+def _slice_rows(batch: ColumnBatch, start: int, stop: int) -> ColumnBatch:
+    from .columnar import ColumnVector as CV
+    vectors = []
+    for v in batch.vectors:
+        data = np.asarray(v.data)[start:stop]
+        valid = None if v.valid is None else np.asarray(v.valid)[start:stop]
+        vectors.append(CV(data, v.dtype, valid, v.dictionary))
+    rv = None if batch.row_valid is None \
+        else np.asarray(batch.row_valid)[start:stop]
+    out = ColumnBatch(batch.names, vectors, rv, stop - start)
+    from .columnar import pad_batch
+    return pad_batch(out)
+
+
+# ---------------------------------------------------------------------------
 # DataFrameReader (`sql/DataFrameReader.scala` analog)
 # ---------------------------------------------------------------------------
 

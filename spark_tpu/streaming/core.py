@@ -407,6 +407,20 @@ class AggregationState:
         specs = f.make_buffers(ctx, live)
         return specs[j].kind
 
+    def merge(self, new_batch: ColumnBatch) -> ColumnBatch:
+        """Fold one batch's partial buffers into the state (no finish);
+        returns THIS batch's partial rows (for changed-group tracking).
+        Also the cross-batch merge step of multi-batch scans."""
+        from ..kernels import _sorted_grouped_aggregate
+        partial = self._partial_rows(new_batch)
+        allp = partial if self.state is None \
+            else union_all([self.state, partial])
+        merge_slots = self._merge_aggs()
+        key_cols = [Col(k.name) for k in self.keys]
+        merged = _sorted_grouped_aggregate(np, allp, key_cols, merge_slots)
+        self.state = compact(np, merged)
+        return partial
+
     def update(self, new_batch: ColumnBatch,
                changed_only: bool = False) -> ColumnBatch:
         """Merge one micro-batch; returns the finished output.
@@ -414,18 +428,20 @@ class AggregationState:
         ``changed_only`` (update output mode) restricts the output to
         groups touched by THIS batch, the reference's update-mode contract
         (`StateStoreSaveExec` update path) — not the whole state."""
-        from ..kernels import _sorted_grouped_aggregate
-        partial = self._partial_rows(new_batch)
-        batch_partial = partial if changed_only else None
-        if self.state is not None:
-            partial = union_all([self.state, partial])
-        merge_slots = self._merge_aggs()
-        key_cols = [Col(k.name) for k in self.keys]
-        merged = _sorted_grouped_aggregate(np, partial, key_cols, merge_slots)
-        merged = compact(np, merged)
-        self.state = merged
+        partial = self.merge(new_batch)
+        finished = self.finished()
+        if changed_only:
+            keep = self._changed_mask(finished, partial)
+            rv = np.asarray(finished.row_valid_or_true()) & keep
+            finished = compact(np, ColumnBatch(
+                finished.names, finished.vectors, rv, finished.capacity))
+        return finished
 
-        # ---- finish: output columns from merged buffers -----------------
+    def finished(self) -> ColumnBatch:
+        """Output columns (keys + finished aggregates) from the state."""
+        merged = self.state
+        if merged is None:
+            raise AnalysisException("no batches merged yet")
         names: List[str] = [k.name for k in self.keys]
         vectors: List[ColumnVector] = [
             merged.vectors[merged.names.index(k.name)] for k in self.keys]
@@ -447,14 +463,7 @@ class AggregationState:
             valid = out.valid if out.valid is not None else None
             names.append(out_name)
             vectors.append(ColumnVector(data, dt, valid, out.dictionary))
-        finished = ColumnBatch(names, vectors, merged.row_valid,
-                               merged.capacity)
-        if batch_partial is not None:
-            keep = self._changed_mask(finished, batch_partial)
-            rv = np.asarray(finished.row_valid_or_true()) & keep
-            finished = compact(np, ColumnBatch(
-                finished.names, finished.vectors, rv, finished.capacity))
-        return finished
+        return ColumnBatch(names, vectors, merged.row_valid, merged.capacity)
 
     def _changed_mask(self, finished: ColumnBatch,
                       batch_partial: ColumnBatch) -> np.ndarray:
