@@ -46,6 +46,50 @@ def _xp(arr: Array):
     return jnp if isinstance(arr, jax.Array) else np
 
 
+def pad_to_capacity(batch: "ColumnBatch", cap: int) -> "ColumnBatch":
+    """Grow a HOST batch to a larger static capacity.
+
+    Streamed scans pad every batch to ONE shared capacity so the per-batch
+    jitted step compiles once (the multi-batch analog of the reference's
+    fixed ColumnarBatch capacity, `ColumnarBatch.java:46`)."""
+    if cap < batch.capacity:
+        raise ValueError(f"cannot shrink batch {batch.capacity} -> {cap}")
+    if cap == batch.capacity:
+        return batch
+    pad = cap - batch.capacity
+    vectors = []
+    for v in batch.vectors:
+        data = np.concatenate(
+            [np.asarray(v.data), np.zeros(pad, np.asarray(v.data).dtype)])
+        valid = None
+        if v.valid is not None:
+            valid = np.concatenate(
+                [np.asarray(v.valid), np.zeros(pad, bool)])
+        vectors.append(ColumnVector(data, v.dtype, valid, v.dictionary))
+    rv = np.zeros(cap, bool)
+    rv[:batch.capacity] = np.asarray(batch.row_valid_or_true())
+    return ColumnBatch(list(batch.names), vectors, rv, cap)
+
+
+def normalize_valids(batch: "ColumnBatch") -> "ColumnBatch":
+    """Materialize every implicit (None) validity mask as an explicit array.
+
+    Validity masks live in the pytree STRUCTURE (None vs array), so two scan
+    batches that differ only in "column happened to contain a null" would
+    retrace the jitted per-batch step; normalizing makes the treedef stable
+    across a streamed scan."""
+    vectors = [
+        v if v.valid is not None else
+        ColumnVector(v.data, v.dtype,
+                     np.ones(batch.capacity, bool), v.dictionary)
+        for v in batch.vectors
+    ]
+    rv = batch.row_valid
+    if rv is None:
+        rv = np.ones(batch.capacity, bool)
+    return ColumnBatch(list(batch.names), vectors, rv, batch.capacity)
+
+
 def encode_strings(values: Sequence[Optional[str]]) -> Tuple[np.ndarray, Tuple[str, ...]]:
     """Dictionary-encode strings: codes into a SORTED dictionary.
 

@@ -208,3 +208,29 @@ MAX_RESULT_ROWS = conf("spark.driver.maxResultRows").doc(
 EAGER_EVAL = conf("spark.sql.repl.eagerEval.enabled").boolean(False)
 
 CROSS_JOIN_ENABLED = conf("spark.sql.crossJoin.enabled").boolean(True)
+
+MULTIBATCH_ENABLED = conf("spark.tpu.multibatch.enabled").doc(
+    "Stream file scans larger than maxBatchRows through a jitted per-batch "
+    "step with cross-batch merge (FileScanRDD + ExternalSorter analog): HBM "
+    "holds one batch at a time, intermediates accumulate host-side."
+).boolean(True)
+
+SCAN_MAX_BATCH_ROWS = conf("spark.tpu.scan.maxBatchRows").doc(
+    "Row count per streamed scan batch; file relations above this row count "
+    "take the multi-batch path instead of one eager device batch."
+).int(1 << 21)
+
+SPILL_MEMORY_ROWS = conf("spark.tpu.spill.hostMemoryRows").doc(
+    "Host-RAM row budget for multi-batch intermediates (sorted runs, "
+    "concatenated spine output); beyond it, runs spill to disk under "
+    "spark.tpu.spill.dir (Spillable threshold analog)."
+).int(1 << 24)
+
+SPILL_DIR = conf("spark.tpu.spill.dir").doc(
+    "Directory for spilled intermediate runs; empty = a fresh temp dir."
+).string("")
+
+AGG_FOLD_ROWS = conf("spark.tpu.multibatch.aggFoldRows").doc(
+    "Accumulated partial-aggregate rows that trigger an intermediate "
+    "buffer-merge fold during a multi-batch aggregation."
+).int(1 << 18)

@@ -348,6 +348,77 @@ def scan_file_batches(rel: L.FileRelation, batch_rows: int):
         yield _slice_rows(whole, start, stop)
 
 
+def scan_string_dictionaries(rel: L.FileRelation,
+                             batch_rows: int) -> Dict[str, tuple]:
+    """One cheap pre-pass over a file relation collecting the GLOBAL sorted
+    dictionary of every string column.
+
+    Streamed scans encode every batch onto these fixed dictionaries so the
+    per-batch jitted step never retraces on dictionary changes, and sort
+    order on codes stays globally consistent (sorted-dictionary invariant
+    of ``encode_strings``).  For parquet only the string columns are read."""
+    schema = rel.schema()
+    str_cols = [f.name for f in schema.fields if f.dataType.is_string]
+    if not str_cols:
+        return {}
+    uniques: Dict[str, set] = {c: set() for c in str_cols}
+    files = _resolve_paths(rel.paths)
+    if rel.fmt == "parquet":
+        import pyarrow.parquet as pq
+        for f in files:
+            pf = pq.ParquetFile(f)
+            present = [c for c in str_cols if c in pf.schema_arrow.names]
+            if not present:
+                continue
+            for rb in pf.iter_batches(batch_size=batch_rows, columns=present):
+                for c in present:
+                    col = rb.column(rb.schema.get_field_index(c))
+                    uniques[c].update(
+                        v for v in col.to_pylist() if v is not None)
+    else:
+        whole = _load_batch(rel.fmt, rel.paths, rel.options)
+        for c in str_cols:
+            if c in whole.names:
+                vec = whole.column(c)
+                if vec.dictionary:
+                    uniques[c].update(vec.dictionary)
+    # partition-directory columns (string-typed) also need fixed dicts
+    base = rel.paths[0] if isinstance(rel.paths, list) else rel.paths
+    base = base if os.path.isdir(base) else os.path.dirname(base)
+    for f in files:
+        for k, v in _partition_values(f, base).items():
+            if k in uniques:
+                uniques[k].add(v)
+    return {c: tuple(sorted(s)) for c, s in uniques.items()}
+
+
+def reencode_strings(batch: ColumnBatch,
+                     fixed_dicts: Dict[str, tuple]) -> ColumnBatch:
+    """Remap per-batch string codes onto fixed global dictionaries.
+
+    Both dictionaries are sorted, so the remap table is one searchsorted."""
+    if not fixed_dicts:
+        return batch
+    vectors = []
+    for name, v in zip(batch.names, batch.vectors):
+        target = fixed_dicts.get(name)
+        if target is None or v.dictionary is None or \
+                tuple(v.dictionary) == tuple(target):
+            vectors.append(v)
+            continue
+        tarr = np.asarray(target, dtype=object)
+        local = np.asarray(v.dictionary, dtype=object)
+        remap = np.searchsorted(tarr, local).astype(np.int32) \
+            if len(local) else np.zeros(0, np.int32)
+        codes = np.asarray(v.data).astype(np.int64)
+        new_codes = remap[np.clip(codes, 0, max(len(local) - 1, 0))] \
+            if len(local) else np.zeros_like(codes, np.int32)
+        new_codes = np.where(codes < 0, -1, new_codes).astype(np.int32)
+        vectors.append(ColumnVector(new_codes, v.dtype, v.valid, tuple(target)))
+    return ColumnBatch(list(batch.names), vectors, batch.row_valid,
+                       batch.capacity)
+
+
 def _slice_rows(batch: ColumnBatch, start: int, stop: int) -> ColumnBatch:
     from .columnar import ColumnVector as CV
     vectors = []
@@ -358,8 +429,8 @@ def _slice_rows(batch: ColumnBatch, start: int, stop: int) -> ColumnBatch:
     rv = None if batch.row_valid is None \
         else np.asarray(batch.row_valid)[start:stop]
     out = ColumnBatch(batch.names, vectors, rv, stop - start)
-    from .columnar import pad_batch
-    return pad_batch(out)
+    from .columnar import pad_capacity, pad_to_capacity
+    return pad_to_capacity(out, pad_capacity(stop - start))
 
 
 # ---------------------------------------------------------------------------

@@ -631,7 +631,10 @@ def union_all(batches: Sequence[ColumnBatch]) -> ColumnBatch:
             datas = []
             for v, rm in zip(vecs, remaps):
                 d = np.asarray(v.data)
-                datas.append(rm[np.clip(d, 0, None)] if rm is not None and len(rm) else d)
+                # clip BOTH ends: dead rows may carry out-of-dictionary
+                # sentinel codes (e.g. min-buffer identity = int32 max)
+                datas.append(rm[np.clip(d, 0, len(rm) - 1)]
+                             if rm is not None and len(rm) else d)
             data = np.concatenate(datas)
             dictionary = merged
         else:
@@ -660,7 +663,7 @@ def align_string_columns(a: ColumnBatch, a_col: str, b: ColumnBatch, b_col: str
 
     def remap(batch, name, vec, rm):
         data = np.asarray(vec.data)
-        new = rm[np.clip(data, 0, None)] if len(rm) else data
+        new = rm[np.clip(data, 0, len(rm) - 1)] if len(rm) else data
         i = batch.names.index(name)
         vecs = list(batch.vectors)
         vecs[i] = ColumnVector(new.astype(np.int32), vec.dtype, vec.valid, merged)

@@ -1,0 +1,552 @@
+"""Out-of-core multi-batch execution: the host-side stage runner.
+
+Datasets larger than one device batch stream through a jitted per-batch
+step (compiled ONCE — every scan batch is padded to one shared capacity
+with fixed string dictionaries), and a host-side merger folds per-batch
+results across batches.  This is the TPU answer to the reference's
+multi-stage machinery:
+
+- streamed file splits  → ``FileScanRDD.scala`` (one split at a time)
+- cross-batch aggregate → partial/final split of ``AggUtils.scala``:
+  the device step emits RAW mergeable buffers (DPartialAggregate), the
+  host merges sum-of-sums/min-of-mins and finishes once at the end
+- sorted-run spill      → ``ExternalSorter.scala:89`` /
+  ``UnsafeExternalSorter.java``: per-batch device-sorted runs accumulate
+  under a host-RAM budget, overflow goes to disk, one final merge
+- the stage pipeline    → ``DAGScheduler.scala:114`` collapsed to a
+  scan-stage + merge-stage pair (all in-batch operator fusion is XLA)
+
+HBM only ever holds one input batch and one partial result at a time; the
+host (RAM, then disk) is the spill hierarchy.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import tempfile
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import config as C
+from .. import types as T
+from ..aggregates import First, Last, Max, Min, Sum
+from ..columnar import (
+    ColumnBatch, ColumnVector, normalize_valids, pad_capacity,
+    pad_to_capacity,
+)
+from ..expressions import Col, EvalContext, Expression, Rand, RowIndex
+from ..kernels import (
+    _sorted_grouped_aggregate, compact, distinct as k_distinct, union_all,
+)
+from . import logical as L
+from . import physical as P
+from .planner import Planner, _slice_to_host
+from .window import WindowNode
+
+_log = logging.getLogger("spark_tpu.multibatch")
+
+#: merge funcs per buffer reduction kind (shared with streaming state merge)
+_MERGE_BY_KIND = {"sum": Sum, "min": Min, "max": Max}
+
+
+# ---------------------------------------------------------------------------
+# plan decomposition
+# ---------------------------------------------------------------------------
+
+class _Decomposed(NamedTuple):
+    rel: L.FileRelation
+    spine: List[L.LogicalPlan]        # streamable ops, bottom-up
+    breaker: Optional[L.LogicalPlan]  # Aggregate | Sort | Distinct | Limit
+    topk: Optional[int]               # Limit fused into a Sort breaker
+    above: List[L.LogicalPlan]        # ops above the breaker, top-down
+
+
+def _with_child(op: L.LogicalPlan, child: L.LogicalPlan):
+    """Rebuild a single-child logical node over a new child (logical nodes
+    are immutable; the runner re-roots subtrees over materialized results)."""
+    if isinstance(op, L.Project):
+        return L.Project(op.exprs, child)
+    if isinstance(op, L.Filter):
+        return L.Filter(op.condition, child)
+    if isinstance(op, L.Aggregate):
+        return L.Aggregate(op.keys, op.aggs, child)
+    if isinstance(op, L.Sort):
+        return L.Sort(op.orders, child, op.is_global)
+    if isinstance(op, L.Limit):
+        return L.Limit(op.n, child)
+    if isinstance(op, L.Distinct):
+        return L.Distinct(child)
+    if isinstance(op, WindowNode):
+        return WindowNode(op.wexprs, child)
+    if isinstance(op, L.Sample):
+        return L.Sample(op.fraction, op.seed, child)
+    return None
+
+
+def _nondeterministic(e: Expression) -> bool:
+    """Rand/RowIndex offsets are per-program, so replaying the same program
+    per batch would CORRELATE draws/ids across batches — such plans keep the
+    eager single-batch path."""
+    if isinstance(e, (Rand, RowIndex)):
+        return True
+    return any(_nondeterministic(c) for c in e.children)
+
+
+def _spine_ok(op: L.LogicalPlan) -> bool:
+    if isinstance(op, L.Project):
+        return not any(_nondeterministic(e) for e in op.exprs)
+    if isinstance(op, L.Filter):
+        return not _nondeterministic(op.condition)
+    return False
+
+
+def _decompose(optimized: L.LogicalPlan) -> Optional[_Decomposed]:
+    chain: List[L.LogicalPlan] = []
+    node = optimized
+    while True:
+        if isinstance(node, L.SubqueryAlias):
+            node = node.children[0]
+            continue
+        chain.append(node)
+        if not node.children:
+            break
+        if len(node.children) != 1:
+            return None
+        node = node.children[0]
+    leaf = chain[-1]
+    if not isinstance(leaf, L.FileRelation):
+        return None
+    ops = chain[:-1]                      # root .. just-above-leaf
+    i = len(ops)
+    while i > 0 and _spine_ok(ops[i - 1]):
+        i -= 1
+    spine = ops[i:][::-1]                 # bottom-up
+    rest = ops[:i]                        # root .. breaker
+    breaker: Optional[L.LogicalPlan] = None
+    topk: Optional[int] = None
+    above: List[L.LogicalPlan] = []
+    if rest:
+        cand = rest[-1]
+        if not isinstance(cand, (L.Aggregate, L.Sort, L.Distinct, L.Limit)):
+            return None
+        breaker = cand
+        above = rest[:-1]
+        if isinstance(cand, L.Sort) and above \
+                and isinstance(above[-1], L.Limit):
+            topk = above[-1].n
+            above = above[:-1]
+        if isinstance(breaker, L.Aggregate):
+            for f, _n in breaker.aggs:
+                if isinstance(f, (First, Last)) \
+                        or getattr(f, "is_distinct", False):
+                    return None
+        for op in above:
+            if _with_child(op, leaf) is None:
+                return None
+    return _Decomposed(leaf, spine, breaker, topk, above)
+
+
+# ---------------------------------------------------------------------------
+# spill-backed run accumulator
+# ---------------------------------------------------------------------------
+
+class SpilledRuns:
+    """Run batches held in host RAM up to a row budget, then on disk.
+
+    The ``Spillable`` threshold idiom (`util/collection/Spillable.scala`)
+    with pickle files as the spill format (host batches are numpy arrays +
+    dictionaries — self-describing and compact enough for intermediates)."""
+
+    def __init__(self, budget_rows: int, spill_dir: str):
+        self.budget_rows = budget_rows
+        self._dir = spill_dir
+        self._mem: List[ColumnBatch] = []
+        self._disk: List[str] = []
+        self.total_rows = 0
+        self._mem_rows = 0
+        self._n_spilled = 0
+
+    def add(self, batch: ColumnBatch) -> None:
+        rows = int(np.asarray(batch.num_rows()))
+        self.total_rows += rows
+        self._mem.append(batch)
+        self._mem_rows += rows
+        if self._mem_rows > self.budget_rows:
+            self._spill()
+
+    def _spill(self) -> None:
+        os.makedirs(self._dir, exist_ok=True)
+        path = os.path.join(self._dir, f"run-{self._n_spilled:05d}.spill")
+        self._n_spilled += 1
+        with open(path, "wb") as f:
+            pickle.dump(self._mem, f, protocol=pickle.HIGHEST_PROTOCOL)
+        _log.info("spilled %d rows in %d runs to %s",
+                  self._mem_rows, len(self._mem), path)
+        self._disk.append(path)
+        self._mem = []
+        self._mem_rows = 0
+
+    def drain(self) -> List[ColumnBatch]:
+        """All runs (disk runs loaded back); clears the accumulator."""
+        runs: List[ColumnBatch] = []
+        for path in self._disk:
+            with open(path, "rb") as f:
+                runs.extend(pickle.load(f))
+            os.remove(path)
+        runs.extend(self._mem)
+        self._disk = []
+        self._mem = []
+        self._mem_rows = 0
+        self.total_rows = 0
+        return runs
+
+    def replace(self, batches: List[ColumnBatch]) -> None:
+        for b in batches:
+            self.add(b)
+
+
+# ---------------------------------------------------------------------------
+# cross-batch mergers
+# ---------------------------------------------------------------------------
+
+class _ConcatMerger:
+    """Map-only spine (or plain Limit): concatenate per-batch outputs."""
+
+    def __init__(self, spill: SpilledRuns, limit: Optional[int] = None):
+        self.spill = spill
+        self.limit = limit
+
+    def add(self, batch: ColumnBatch) -> bool:
+        self.spill.add(batch)
+        if self.limit is not None and self.spill.total_rows >= self.limit:
+            return False                       # early-exit the scan
+        return True
+
+    def finish(self) -> ColumnBatch:
+        runs = self.spill.drain()
+        if not runs:
+            raise RuntimeError("no scan batches produced")
+        out = union_all(runs) if len(runs) > 1 else runs[0]
+        if self.limit is not None:
+            phys = P.PLimit(self.limit, P.PScan(0, out.schema))
+            out = phys.run(P.ExecContext(np, [out]))
+        return compact(np, out)
+
+
+class _SortMerger:
+    """Sorted-run accumulation + one final host merge; a fused Limit (the
+    ORDER BY ... LIMIT k top-k pattern) keeps the accumulation bounded by
+    folding whenever it exceeds a few multiples of k."""
+
+    def __init__(self, spill: SpilledRuns, orders, topk: Optional[int]):
+        self.spill = spill
+        self.orders = orders                  # [(expr, asc, nulls_first)]
+        self.topk = topk
+
+    def _sort_limit(self, batch: ColumnBatch) -> ColumnBatch:
+        phys: P.PhysicalPlan = P.PSort(self.orders, P.PScan(0, batch.schema))
+        if self.topk is not None:
+            phys = P.PLimit(self.topk, phys)
+        return compact(np, phys.run(P.ExecContext(np, [batch])))
+
+    def add(self, batch: ColumnBatch) -> bool:
+        self.spill.add(batch)
+        if self.topk is not None and \
+                self.spill.total_rows > max(4 * self.topk, 1 << 16):
+            runs = self.spill.drain()
+            folded = self._sort_limit(
+                union_all(runs) if len(runs) > 1 else runs[0])
+            self.spill.add(folded)
+        return True
+
+    def finish(self) -> ColumnBatch:
+        runs = self.spill.drain()
+        if not runs:
+            raise RuntimeError("no scan batches produced")
+        return self._sort_limit(union_all(runs) if len(runs) > 1 else runs[0])
+
+
+class _DistinctMerger:
+    """Per-batch distincts re-distincted whenever the accumulation exceeds
+    a budget that grows if the true distinct count is legitimately larger."""
+
+    def __init__(self, spill: SpilledRuns, fold_rows: int):
+        self.spill = spill
+        self.fold_rows = fold_rows
+
+    def _fold(self) -> None:
+        runs = self.spill.drain()
+        folded = compact(
+            np, k_distinct(np, union_all(runs) if len(runs) > 1 else runs[0]))
+        self.spill.add(folded)
+        got = self.spill.total_rows
+        if got > self.fold_rows:
+            self.fold_rows = 2 * got          # avoid quadratic refolding
+
+    def add(self, batch: ColumnBatch) -> bool:
+        self.spill.add(batch)
+        if self.spill.total_rows > self.fold_rows:
+            self._fold()
+        return True
+
+    def finish(self) -> ColumnBatch:
+        self._fold()
+        runs = self.spill.drain()
+        return runs[0] if runs else ColumnBatch.empty(T.StructType([]))
+
+
+class _AggMerger:
+    """Accumulates DPartialAggregate outputs (keys + raw buffer columns),
+    folds them with per-buffer-kind re-reduction (sum-of-sums, min-of-mins),
+    and finishes once via DFinalAggregate — the exact merge contract the
+    distributed layer uses across shards, reused across scan batches."""
+
+    def __init__(self, keys, slots, child_schema: T.StructType,
+                 fold_rows: int, str_minmax_dicts):
+        from ..parallel.dist import DPartialAggregate
+        self.keys = list(keys)
+        self.slots = list(slots)
+        self.child_schema = child_schema
+        self.partial = DPartialAggregate(
+            self.keys, self.slots, P.PScan(0, child_schema))
+        self.fold_rows = fold_rows
+        self._acc: List[ColumnBatch] = []
+        self._rows = 0
+        # slot_idx -> dictionary for string-typed min/max value buffers
+        self._str_dicts = str_minmax_dicts
+
+    def _attach_dicts(self, pbatch: ColumnBatch) -> ColumnBatch:
+        if not self._str_dicts:
+            return pbatch
+        vectors = list(pbatch.vectors)
+        for i, d in self._str_dicts.items():
+            bname = self.partial.buffer_names(i, self.slots[i][0])[0]
+            j = pbatch.names.index(bname)
+            v = vectors[j]
+            # typed as STRING (codes + dictionary) so union_all's fold path
+            # carries the dictionary through intermediate merges
+            vectors[j] = ColumnVector(v.data.astype(np.int32), T.string,
+                                      v.valid, d)
+        return ColumnBatch(list(pbatch.names), vectors, pbatch.row_valid,
+                           pbatch.capacity)
+
+    def _merge_slots(self):
+        from ..parallel.dist import DFinalAggregate
+        out = []
+        for i, (f, _n) in enumerate(self.slots):
+            kinds = DFinalAggregate._buffer_kinds(f)
+            for j, kind in enumerate(kinds):
+                bname = self.partial.buffer_names(i, f)[j]
+                out.append((_MERGE_BY_KIND[kind](Col(bname)), bname))
+        return out
+
+    def _fold(self) -> None:
+        if len(self._acc) <= 1:
+            return
+        allp = union_all(self._acc)
+        key_cols = [Col(k.name) for k in self.keys]
+        merged = _sorted_grouped_aggregate(
+            np, allp, key_cols, self._merge_slots())
+        folded = compact(np, merged)
+        self._acc = [folded]
+        self._rows = int(np.asarray(folded.num_rows()))
+
+    def add(self, pbatch: ColumnBatch) -> bool:
+        pbatch = self._attach_dicts(pbatch)
+        self._acc.append(pbatch)
+        self._rows += int(np.asarray(pbatch.num_rows()))
+        if self._rows > self.fold_rows:
+            self._fold()
+        return True
+
+    def finish(self) -> ColumnBatch:
+        from ..parallel.dist import DFinalAggregate
+        if not self._acc:
+            raise RuntimeError("no scan batches produced")
+        self._fold()
+        state = self._acc[0]
+        final = DFinalAggregate(self.keys, self.slots, self.partial,
+                                P.PScan(0, state.schema))
+        return compact(np, final.run(P.ExecContext(np, [state])))
+
+
+# ---------------------------------------------------------------------------
+# the runner
+# ---------------------------------------------------------------------------
+
+class MultiBatchExecution:
+    def __init__(self, session, dec: _Decomposed, batch_rows: int):
+        self.session = session
+        self.dec = dec
+        self.batch_rows = batch_rows
+        self.capacity = pad_capacity(batch_rows)
+
+    # -- per-batch device step -------------------------------------------
+    def _build_step(self, template: ColumnBatch):
+        """(jitted step fn, spine output schema) for one padded scan batch."""
+        planner = Planner(self.session)
+        node: L.LogicalPlan = L.LocalRelation(template)
+        for op in self.dec.spine:
+            node = _with_child(op, node)
+        leaves: List[ColumnBatch] = []
+        phys = planner._to_physical(node, leaves)
+        spine_schema = phys.schema()
+        breaker = self.dec.breaker
+        if isinstance(breaker, L.Aggregate):
+            from ..parallel.dist import DPartialAggregate
+            phys = DPartialAggregate(breaker.keys, breaker.aggs, phys)
+        elif isinstance(breaker, L.Sort):
+            orders = [(o.child, o.ascending, o.nulls_first)
+                      for o in breaker.orders]
+            phys = P.PSort(orders, phys)
+            if self.dec.topk is not None:
+                phys = P.PLimit(self.dec.topk, phys)
+        elif isinstance(breaker, L.Distinct):
+            phys = P.PDistinct(phys)
+        elif isinstance(breaker, L.Limit):
+            phys = P.PLimit(breaker.n, phys)
+        planner._assign_op_ids(phys, [1])
+
+        def step(leaf):
+            ctx = P.ExecContext(jnp, [leaf])
+            out = phys.run(ctx)
+            c = compact(jnp, out)
+            return c, c.num_rows()
+
+        return jax.jit(step), spine_schema
+
+    # -- merger selection ------------------------------------------------
+    def _make_merger(self, spine_schema: T.StructType,
+                     template: ColumnBatch):
+        conf = self.session.conf
+        spill_dir = conf.get(C.SPILL_DIR) or \
+            os.path.join(tempfile.gettempdir(),
+                         f"spark_tpu_spill_{os.getpid()}")
+        spill = SpilledRuns(conf.get(C.SPILL_MEMORY_ROWS), spill_dir)
+        breaker = self.dec.breaker
+        if isinstance(breaker, L.Aggregate):
+            str_dicts = self._string_minmax_dicts(
+                breaker, spine_schema, template)
+            return _AggMerger(breaker.keys, breaker.aggs, spine_schema,
+                              conf.get(C.AGG_FOLD_ROWS), str_dicts)
+        if isinstance(breaker, L.Sort):
+            orders = [(o.child, o.ascending, o.nulls_first)
+                      for o in breaker.orders]
+            return _SortMerger(spill, orders, self.dec.topk)
+        if isinstance(breaker, L.Distinct):
+            return _DistinctMerger(spill, conf.get(C.AGG_FOLD_ROWS))
+        if isinstance(breaker, L.Limit):
+            return _ConcatMerger(spill, limit=breaker.n)
+        return _ConcatMerger(spill)
+
+    def _string_minmax_dicts(self, agg: L.Aggregate,
+                             spine_schema: T.StructType,
+                             template: ColumnBatch):
+        """Dictionary per slot for min/max over STRING inputs: the partial's
+        value buffer holds dictionary CODES, and the dictionary itself is
+        dropped by the buffer vector — probe it host-side once on a tiny
+        slice (dictionaries are trace-time-static: they depend only on the
+        input dictionaries, which streamed scans fix globally, never on the
+        rows)."""
+        needed = [
+            i for i, (f, _n) in enumerate(agg.aggs)
+            if isinstance(f, (Min, Max)) and f.children
+            and f.children[0].data_type(spine_schema).is_string
+        ]
+        if not needed:
+            return {}
+        from ..io import _slice_rows
+        probe_in = _slice_rows(template.to_host(), 0,
+                               min(8, template.capacity))
+        probe = self._host_spine_probe(probe_in)
+        ectx = EvalContext(probe, np)
+        return {i: agg.aggs[i][0].children[0].eval(ectx).dictionary
+                for i in needed}
+
+    # -- main loop -------------------------------------------------------
+    def execute(self) -> ColumnBatch:
+        from ..io import (
+            reencode_strings, scan_file_batches, scan_string_dictionaries,
+        )
+        rel = self.dec.rel
+        fixed_dicts = scan_string_dictionaries(rel, self.batch_rows)
+        jstep = None
+        merger = None
+        n_batches = 0
+        for raw in scan_file_batches(rel, self.batch_rows):
+            b = reencode_strings(raw, fixed_dicts)
+            b = normalize_valids(pad_to_capacity(b, self.capacity))
+            if jstep is None:
+                jstep, spine_schema = self._build_step(b)
+                merger = self._make_merger(spine_schema, b)
+            out_dev, n = jstep(b.to_device())
+            host = _slice_to_host(out_dev, int(np.asarray(n)))
+            n_batches += 1
+            if not merger.add(host):
+                _log.info("multi-batch scan early exit after %d batches",
+                          n_batches)
+                break
+        if merger is None:
+            raise RuntimeError(f"empty file relation {rel!r}")
+        _log.info("multi-batch scan: %d batches of <=%d rows merged",
+                  n_batches, self.batch_rows)
+        result = merger.finish()
+        return self._run_above(result)
+
+    def _host_spine_probe(self, template: ColumnBatch) -> ColumnBatch:
+        """Run the spine interpreted on the (host) template batch — used
+        only to discover trace-time-static string dictionaries."""
+        planner = Planner(self.session)
+        node: L.LogicalPlan = L.LocalRelation(template)
+        for op in self.dec.spine:
+            node = _with_child(op, node)
+        leaves: List[ColumnBatch] = []
+        phys = planner._to_physical(node, leaves)
+        planner._assign_op_ids(phys, [1])
+        return phys.run(P.ExecContext(np, [template]))
+
+    def _run_above(self, result: ColumnBatch) -> ColumnBatch:
+        """Ops above the breaker run on the merged result — interpreted
+        (host numpy): post-breaker data is usually tiny, and a huge
+        Sort/concat result must not be forced back into HBM whole."""
+        if not self.dec.above:
+            return compact(np, result.to_host())
+        planner = Planner(self.session)
+        node: L.LogicalPlan = L.LocalRelation(result)
+        for op in reversed(self.dec.above):
+            node = _with_child(op, node)
+        leaves: List[ColumnBatch] = []
+        phys = planner._to_physical(node, leaves)
+        planner._assign_op_ids(phys, [1])
+        out = phys.run(P.ExecContext(np, [b.to_host() for b in leaves]))
+        return compact(np, out.to_host())
+
+
+def plan_multibatch(session, optimized: L.LogicalPlan
+                    ) -> Optional[MultiBatchExecution]:
+    """Decide whether a query takes the multi-batch path.
+
+    Conditions: enabled, the plan decomposes into scan→spine→breaker→above
+    over a single FileRelation, and the file exceeds one batch."""
+    if not session.conf.get(C.MULTIBATCH_ENABLED):
+        return None
+    dec = _decompose(optimized)
+    if dec is None:
+        return None
+    batch_rows = session.conf.get(C.SCAN_MAX_BATCH_ROWS)
+    from ..io import file_row_count
+    try:
+        total = file_row_count(dec.rel)
+    except Exception:
+        return None
+    if total is None or total <= batch_rows:
+        return None
+    _log.info("multi-batch path: %d rows > %d rows/batch (%s)",
+              total, batch_rows, dec.rel)
+    return MultiBatchExecution(session, dec, batch_rows)

@@ -202,6 +202,14 @@ class QueryExecution:
             return DistributedExecution(
                 self.session, get_mesh(n_shards)).execute(self.optimized)
 
+        # out-of-core path: file scans larger than one device batch stream
+        # through the multi-batch stage runner (FileScanRDD/ExternalSorter
+        # analog) instead of one eager batch
+        from .multibatch import plan_multibatch
+        mb = plan_multibatch(self.session, self.optimized)
+        if mb is not None:
+            return mb.execute()
+
         base_key = "local:" + self.planned.physical.key()
         factor: Optional[float] = \
             self.session._adapted_factors.get(base_key)

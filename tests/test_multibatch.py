@@ -1,0 +1,212 @@
+"""Multi-batch (out-of-core) execution: streamed scans + cross-batch merge.
+
+The stage-runner analog of FileScanRDD + ExternalSorter + AggUtils
+partial/final (VERDICT r1 #2): datasets several times one batch capacity
+must produce the same answers as the eager single-batch path / a pandas
+oracle, with HBM holding only one batch at a time.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_tpu.config as C
+from spark_tpu.sql import functions as F
+
+
+BATCH = 256          # rows per streamed batch (tiny for tests)
+N = 2000             # ~8 batches
+
+
+def _pdf(seed=7):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "id": np.arange(N, dtype=np.int64),
+        "grp": rng.choice(["apple", "pear", "plum", "fig", "kiwi"], N),
+        "x": rng.normal(10.0, 5.0, N),
+        "k": rng.integers(0, 50, N).astype(np.int64),
+    })
+
+
+@pytest.fixture(scope="module")
+def bigfile(tmp_path_factory):
+    """A parquet dataset written in several files (multi-file scan)."""
+    d = tmp_path_factory.mktemp("mb") / "big.parquet"
+    os.makedirs(d)
+    pdf = _pdf()
+    step = N // 4
+    for i in range(4):
+        pdf.iloc[i * step:(i + 1) * step].to_parquet(
+            d / f"part-{i:03d}.parquet", index=False)
+    return str(d), pdf
+
+
+@pytest.fixture()
+def mb(spark):
+    """Session configured for streamed scans of BATCH rows."""
+    old = spark.conf.get(C.SCAN_MAX_BATCH_ROWS)
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(BATCH))
+    yield spark
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(old))
+
+
+def test_uses_multibatch_path(mb, bigfile):
+    from spark_tpu.sql.multibatch import plan_multibatch
+    from spark_tpu.sql.planner import QueryExecution
+    path, _ = bigfile
+    df = mb.read.parquet(path).groupBy("grp").agg(F.sum("x"))
+    qe = QueryExecution(mb, df._plan)
+    assert plan_multibatch(mb, qe.optimized) is not None
+
+
+def test_groupby_agg_matches_pandas(mb, bigfile):
+    path, pdf = bigfile
+    df = (mb.read.parquet(path)
+          .groupBy("grp")
+          .agg(F.sum("x").alias("sx"), F.count("x").alias("c"),
+               F.avg("k").alias("ak"), F.min("x").alias("mn"),
+               F.max("x").alias("mx")))
+    got = {r[0]: r[1:] for r in df.collect()}
+    exp = pdf.groupby("grp").agg(
+        sx=("x", "sum"), c=("x", "count"), ak=("k", "mean"),
+        mn=("x", "min"), mx=("x", "max"))
+    assert set(got) == set(exp.index)
+    for g, row in exp.iterrows():
+        np.testing.assert_allclose(got[g], row.to_numpy(), rtol=1e-12)
+
+
+def test_global_agg_no_keys(mb, bigfile):
+    path, pdf = bigfile
+    df = mb.read.parquet(path).agg(
+        F.sum("k").alias("s"), F.count("x").alias("c"),
+        F.min("id").alias("mn"))
+    (s, c, mn), = df.collect()
+    assert (s, c, mn) == (int(pdf.k.sum()), N, 0)
+
+
+def test_string_min_max(mb, bigfile):
+    path, pdf = bigfile
+    df = mb.read.parquet(path).groupBy("k").agg(
+        F.min("grp").alias("mn"), F.max("grp").alias("mx"))
+    got = {r[0]: (r[1], r[2]) for r in df.collect()}
+    exp = pdf.groupby("k").agg(mn=("grp", "min"), mx=("grp", "max"))
+    assert got == {k: (r.mn, r.mx) for k, r in exp.iterrows()}
+
+
+def test_filter_project_concat(mb, bigfile):
+    path, pdf = bigfile
+    df = (mb.read.parquet(path)
+          .filter(F.col("k") < 10)
+          .select("id", (F.col("x") * 2).alias("x2")))
+    got = sorted(df.collect())
+    sub = pdf[pdf.k < 10]
+    exp = sorted(zip(sub.id.tolist(), (sub.x * 2).tolist()))
+    assert [i for i, _ in got] == [i for i, _ in exp]
+    np.testing.assert_allclose([v for _, v in got], [v for _, v in exp])
+
+
+def test_sort_matches_pandas(mb, bigfile):
+    path, pdf = bigfile
+    df = mb.read.parquet(path).orderBy(F.col("x").desc())
+    got = [r[0] for r in df.select("id").orderBy(F.col("x").desc()).collect()]
+    exp = pdf.sort_values("x", ascending=False).id.tolist()
+    assert got == exp
+
+
+def test_topk_order_by_limit(mb, bigfile):
+    path, pdf = bigfile
+    df = mb.read.parquet(path).orderBy(F.col("x").desc()).limit(17)
+    got = [(r[0], r[3]) for r in df.collect()]
+    exp = pdf.sort_values("x", ascending=False).head(17)
+    assert [i for i, _ in got] == exp.id.tolist()
+
+
+def test_distinct(mb, bigfile):
+    path, pdf = bigfile
+    got = sorted(r[0] for r in
+                 mb.read.parquet(path).select("grp").distinct().collect())
+    assert got == sorted(pdf.grp.unique())
+
+
+def test_limit_early_exit(mb, bigfile):
+    path, _ = bigfile
+    assert len(mb.read.parquet(path).limit(40).collect()) == 40
+
+
+def test_ops_above_breaker(mb, bigfile):
+    """HAVING-style filter + order + limit above the aggregation."""
+    path, pdf = bigfile
+    df = (mb.read.parquet(path)
+          .groupBy("k").agg(F.sum("x").alias("sx"))
+          .filter(F.col("sx") > 0)
+          .orderBy(F.col("sx").desc())
+          .limit(5))
+    got = [(r[0], r[1]) for r in df.collect()]
+    exp = (pdf.groupby("k").x.sum().reset_index()
+           .query("x > 0").sort_values("x", ascending=False).head(5))
+    assert [k for k, _ in got] == exp.k.tolist()
+    np.testing.assert_allclose([v for _, v in got], exp.x.tolist())
+
+
+def test_matches_eager_path(mb, bigfile):
+    path, _ = bigfile
+    q = lambda s: (s.read.parquet(path).filter(F.col("k") % 3 == 0)
+                   .groupBy("grp").agg(F.avg("x").alias("a"),
+                                       F.count("id").alias("c")))
+    multi = sorted(q(mb).collect())
+    mb.conf.set(C.MULTIBATCH_ENABLED.key, "false")
+    try:
+        eager = sorted(q(mb).collect())
+    finally:
+        mb.conf.set(C.MULTIBATCH_ENABLED.key, "true")
+    assert [r[0] for r in multi] == [r[0] for r in eager]
+    np.testing.assert_allclose(
+        np.array([r[1:] for r in multi], float),
+        np.array([r[1:] for r in eager], float), rtol=1e-12)
+
+
+def test_disk_spill(mb, bigfile, tmp_path):
+    """Force the sorted-run accumulator over its host budget: runs must
+    spill to disk and the merged result stay exact."""
+    path, pdf = bigfile
+    spill_dir = str(tmp_path / "spill")
+    mb.conf.set(C.SPILL_MEMORY_ROWS.key, str(BATCH))
+    mb.conf.set(C.SPILL_DIR.key, spill_dir)
+    try:
+        df = mb.read.parquet(path).orderBy("x")
+        got = [r[0] for r in df.select("id").orderBy("x").collect()]
+    finally:
+        mb.conf.set(C.SPILL_MEMORY_ROWS.key,
+                    str(C.SPILL_MEMORY_ROWS.default))
+        mb.conf.set(C.SPILL_DIR.key, "")
+    assert got == pdf.sort_values("x").id.tolist()
+    assert not glob.glob(os.path.join(spill_dir, "*.spill"))  # cleaned up
+
+
+def test_aggregation_fold_small_threshold(mb, bigfile):
+    """Intermediate partial folds triggered every batch stay exact."""
+    path, pdf = bigfile
+    mb.conf.set(C.AGG_FOLD_ROWS.key, "8")
+    try:
+        df = mb.read.parquet(path).groupBy("grp").agg(
+            F.sum("k").alias("s"))
+        got = dict(df.collect())
+    finally:
+        mb.conf.set(C.AGG_FOLD_ROWS.key, str(C.AGG_FOLD_ROWS.default))
+    exp = pdf.groupby("grp").k.sum()
+    assert got == exp.to_dict()
+
+
+def test_count_rows_csv_scan(mb, tmp_path):
+    """Non-parquet formats stream via host-cached slices."""
+    p = str(tmp_path / "big.csv")
+    pdf = _pdf(11)
+    df = mb.createDataFrame(pdf)
+    df.write.option("header", True).csv(p)
+    back = mb.read.csv(p, header=True, inferSchema=True)
+    assert back.count() == N
+    got = dict(back.groupBy("grp").agg(F.count("id").alias("c")).collect())
+    assert got == pdf.groupby("grp").id.count().to_dict()
