@@ -404,6 +404,21 @@ class SumDistinct(Sum):
         return f"sum(DISTINCT {self.children[0]!r})"
 
 
+#: merge function per buffer reduction kind — the single definition shared
+#: by distributed final-agg, streaming state merge, and multi-batch folds
+MERGE_BY_KIND = {"sum": Sum, "min": Min, "max": Max}
+
+
+def buffer_kinds(func: AggregateFunction, child_schema) -> List[str]:
+    """Reduction kind of each buffer, derived by probing make_buffers on an
+    empty batch — stays correct by construction when buffer layouts change."""
+    from .columnar import ColumnBatch
+    probe = ColumnBatch.empty(child_schema)
+    ctx = EvalContext(probe, np)
+    live = np.zeros(probe.capacity, bool)
+    return [s.kind for s in func.make_buffers(ctx, live)]
+
+
 class AggregateExpression(NamedTuple):
     """A named aggregate output slot in an Aggregate operator."""
 

@@ -507,9 +507,18 @@ def _comparison_operands(ctx: EvalContext, le: Expression, re_: Expression):
                 return (ExprValue(xp.asarray(idx * 2 if exact else idx * 2 - 1, np.int64),
                                   l.valid, None),
                         ExprValue(r.data * 2, r.valid, None), True)
-            raise AnalysisException(
-                "comparing string columns with different dictionaries requires "
-                "dictionary alignment (planner inserts AlignDictionaries)")
+            # two dictionary-coded columns: dictionaries are trace-time
+            # static, so align by merging them and remapping both code
+            # spaces (the remap tables bake into the program as constants)
+            from .columnar import merge_dictionaries
+            _merged, ra, rb = merge_dictionaries(l.dictionary, r.dictionary)
+            ldata, rdata = l.data, r.data
+            if len(ra):
+                ldata = xp.asarray(ra)[xp.clip(ldata, 0, len(ra) - 1)]
+            if len(rb):
+                rdata = xp.asarray(rb)[xp.clip(rdata, 0, len(rb) - 1)]
+            return (ExprValue(ldata, l.valid, None),
+                    ExprValue(rdata, r.valid, None), True)
         raise AnalysisException("cannot compare string with non-string")
     return l, r, False
 

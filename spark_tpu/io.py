@@ -364,6 +364,7 @@ def scan_string_dictionaries(rel: L.FileRelation,
     uniques: Dict[str, set] = {c: set() for c in str_cols}
     files = _resolve_paths(rel.paths)
     if rel.fmt == "parquet":
+        import pyarrow.compute as pc
         import pyarrow.parquet as pq
         for f in files:
             pf = pq.ParquetFile(f)
@@ -373,8 +374,11 @@ def scan_string_dictionaries(rel: L.FileRelation,
             for rb in pf.iter_batches(batch_size=batch_rows, columns=present):
                 for c in present:
                     col = rb.column(rb.schema.get_field_index(c))
+                    # dedup in native code; only the per-batch uniques
+                    # become Python objects
                     uniques[c].update(
-                        v for v in col.to_pylist() if v is not None)
+                        v for v in pc.unique(col).to_pylist()
+                        if v is not None)
     else:
         whole = _load_batch(rel.fmt, rel.paths, rel.options)
         for c in str_cols:

@@ -203,9 +203,10 @@ def grouped_aggregate(
 ) -> ColumnBatch:
     """GROUP BY keys with aggregate outputs; one batch in, one batch out.
 
-    Output capacity equals input capacity (worst case: every live row its own
-    group); ``row_valid`` marks real groups.  NULL is a group key value (SQL
-    semantics).  With no keys, produces the single global-aggregate row.
+    With keys, output capacity equals input capacity (worst case: every live
+    row its own group) and ``row_valid`` marks real groups.  NULL is a group
+    key value (SQL semantics).  With no keys, the single global-aggregate
+    row comes back as a capacity-1 batch (see `_sorted_grouped_aggregate`).
 
     Device path: when keys are integral and the key range fits ``bucket_cap``
     buckets, aggregation runs on the MXU (one-hot matmul over 8-bit limb
@@ -317,9 +318,16 @@ def _sorted_grouped_aggregate(
     # ---- output row mask -------------------------------------------------
     if key_exprs:
         out_rv = group_pos < num_groups
-    else:
-        out_rv = group_pos < 1
-    return ColumnBatch(out_names, out_vectors, out_rv, capacity)
+        return ColumnBatch(out_names, out_vectors, out_rv, capacity)
+    # keyless (global) aggregation: exactly ONE row, so emit capacity 1 —
+    # cross joins of scalar subquery blocks (TPC-DS q88/q90) stay tiny
+    # instead of multiplying input capacities
+    out_vectors = [
+        ColumnVector(v.data[:1], v.dtype,
+                     None if v.valid is None else v.valid[:1], v.dictionary)
+        for v in out_vectors
+    ]
+    return ColumnBatch(out_names, out_vectors, None, 1)
 
 
 def _scatter_starts(xp, sorted_data: Array, seg_ids: Array, is_start: Array,

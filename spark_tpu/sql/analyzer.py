@@ -52,6 +52,19 @@ def split_aggregate_expr(e: Expression, slots: List[Tuple[AggregateFunction, str
     return e.map_children(lambda c: split_aggregate_expr(c, slots))
 
 
+def substitute_grouping_keys(e: Expression,
+                             keys: Sequence[Expression]) -> Expression:
+    """Occurrences of a grouping EXPRESSION above the Aggregate become
+    references to its output column: `GROUP BY substr(c,1,5)` with
+    `SELECT substr(c,1,5)` must read the key column — the input column no
+    longer exists above the Aggregate.  Matching is structural via repr
+    (expression reprs are canonical)."""
+    for k in keys:
+        if not isinstance(k, Col) and repr(e) == repr(k):
+            return Col(k.name)
+    return e.map_children(lambda c: substitute_grouping_keys(c, keys))
+
+
 def contains_aggregate(e: Expression) -> bool:
     if isinstance(e, AggregateFunction):
         return True
@@ -77,6 +90,7 @@ def build_aggregate(keys: Sequence[Expression], agg_exprs: Sequence[Expression],
     for e in agg_exprs:
         name = e.name
         residual = split_aggregate_expr(e, slots)
+        residual = substitute_grouping_keys(residual, keys)
         if isinstance(residual, Col) and not isinstance(e, Alias) \
                 and residual.name not in key_names:
             # plain aggregate: rename slot to the pretty name

@@ -35,12 +35,12 @@ import jax.numpy as jnp
 
 from .. import config as C
 from .. import types as T
-from ..aggregates import First, Last, Max, Min, Sum
+from ..aggregates import MERGE_BY_KIND, First, Last, Max, Min, buffer_kinds
 from ..columnar import (
     ColumnBatch, ColumnVector, normalize_valids, pad_capacity,
     pad_to_capacity,
 )
-from ..expressions import Col, EvalContext, Expression, Rand, RowIndex
+from ..expressions import Col, EvalContext, Expression
 from ..kernels import (
     _sorted_grouped_aggregate, compact, distinct as k_distinct, union_all,
 )
@@ -50,9 +50,6 @@ from .planner import Planner, _slice_to_host
 from .window import WindowNode
 
 _log = logging.getLogger("spark_tpu.multibatch")
-
-#: merge funcs per buffer reduction kind (shared with streaming state merge)
-_MERGE_BY_KIND = {"sum": Sum, "min": Min, "max": Max}
 
 
 # ---------------------------------------------------------------------------
@@ -89,20 +86,15 @@ def _with_child(op: L.LogicalPlan, child: L.LogicalPlan):
     return None
 
 
-def _nondeterministic(e: Expression) -> bool:
-    """Rand/RowIndex offsets are per-program, so replaying the same program
-    per batch would CORRELATE draws/ids across batches — such plans keep the
-    eager single-batch path."""
-    if isinstance(e, (Rand, RowIndex)):
-        return True
-    return any(_nondeterministic(c) for c in e.children)
-
-
 def _spine_ok(op: L.LogicalPlan) -> bool:
+    # nondeterministic expressions (Rand/RowIndex offsets are per-program)
+    # would CORRELATE draws/ids across batches if the same program replayed
+    # per batch — such plans keep the eager single-batch path
+    from .optimizer import is_deterministic
     if isinstance(op, L.Project):
-        return not any(_nondeterministic(e) for e in op.exprs)
+        return all(is_deterministic(e) for e in op.exprs)
     if isinstance(op, L.Filter):
-        return not _nondeterministic(op.condition)
+        return is_deterministic(op.condition)
     return False
 
 
@@ -165,7 +157,10 @@ class SpilledRuns:
 
     def __init__(self, budget_rows: int, spill_dir: str):
         self.budget_rows = budget_rows
-        self._dir = spill_dir
+        # a fresh subdirectory per accumulator: concurrent queries (or two
+        # mergers in one query) must never collide on run file names
+        os.makedirs(spill_dir, exist_ok=True)
+        self._dir = tempfile.mkdtemp(prefix="runs-", dir=spill_dir)
         self._mem: List[ColumnBatch] = []
         self._disk: List[str] = []
         self.total_rows = 0
@@ -181,7 +176,6 @@ class SpilledRuns:
             self._spill()
 
     def _spill(self) -> None:
-        os.makedirs(self._dir, exist_ok=True)
         path = os.path.join(self._dir, f"run-{self._n_spilled:05d}.spill")
         self._n_spilled += 1
         with open(path, "wb") as f:
@@ -209,6 +203,20 @@ class SpilledRuns:
     def replace(self, batches: List[ColumnBatch]) -> None:
         for b in batches:
             self.add(b)
+
+    def close(self) -> None:
+        """Remove all spill files and the run directory (crash cleanup)."""
+        for path in self._disk:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        self._disk = []
+        self._mem = []
+        try:
+            os.rmdir(self._dir)
+        except OSError:
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -337,13 +345,12 @@ class _AggMerger:
                            pbatch.capacity)
 
     def _merge_slots(self):
-        from ..parallel.dist import DFinalAggregate
         out = []
         for i, (f, _n) in enumerate(self.slots):
-            kinds = DFinalAggregate._buffer_kinds(f)
+            kinds = buffer_kinds(f, self.child_schema)
             for j, kind in enumerate(kinds):
                 bname = self.partial.buffer_names(i, f)[j]
-                out.append((_MERGE_BY_KIND[kind](Col(bname)), bname))
+                out.append((MERGE_BY_KIND[kind](Col(bname)), bname))
         return out
 
     def _fold(self) -> None:
@@ -425,16 +432,16 @@ class MultiBatchExecution:
     def _make_merger(self, spine_schema: T.StructType,
                      template: ColumnBatch):
         conf = self.session.conf
-        spill_dir = conf.get(C.SPILL_DIR) or \
-            os.path.join(tempfile.gettempdir(),
-                         f"spark_tpu_spill_{os.getpid()}")
-        spill = SpilledRuns(conf.get(C.SPILL_MEMORY_ROWS), spill_dir)
         breaker = self.dec.breaker
         if isinstance(breaker, L.Aggregate):
             str_dicts = self._string_minmax_dicts(
                 breaker, spine_schema, template)
             return _AggMerger(breaker.keys, breaker.aggs, spine_schema,
                               conf.get(C.AGG_FOLD_ROWS), str_dicts)
+        spill_dir = conf.get(C.SPILL_DIR) or \
+            os.path.join(tempfile.gettempdir(),
+                         f"spark_tpu_spill_{os.getpid()}")
+        spill = SpilledRuns(conf.get(C.SPILL_MEMORY_ROWS), spill_dir)
         if isinstance(breaker, L.Sort):
             orders = [(o.child, o.ascending, o.nulls_first)
                       for o in breaker.orders]
@@ -479,24 +486,29 @@ class MultiBatchExecution:
         jstep = None
         merger = None
         n_batches = 0
-        for raw in scan_file_batches(rel, self.batch_rows):
-            b = reencode_strings(raw, fixed_dicts)
-            b = normalize_valids(pad_to_capacity(b, self.capacity))
-            if jstep is None:
-                jstep, spine_schema = self._build_step(b)
-                merger = self._make_merger(spine_schema, b)
-            out_dev, n = jstep(b.to_device())
-            host = _slice_to_host(out_dev, int(np.asarray(n)))
-            n_batches += 1
-            if not merger.add(host):
-                _log.info("multi-batch scan early exit after %d batches",
-                          n_batches)
-                break
-        if merger is None:
-            raise RuntimeError(f"empty file relation {rel!r}")
-        _log.info("multi-batch scan: %d batches of <=%d rows merged",
-                  n_batches, self.batch_rows)
-        result = merger.finish()
+        try:
+            for raw in scan_file_batches(rel, self.batch_rows):
+                b = reencode_strings(raw, fixed_dicts)
+                b = normalize_valids(pad_to_capacity(b, self.capacity))
+                if jstep is None:
+                    jstep, spine_schema = self._build_step(b)
+                    merger = self._make_merger(spine_schema, b)
+                out_dev, n = jstep(b.to_device())
+                host = _slice_to_host(out_dev, int(np.asarray(n)))
+                n_batches += 1
+                if not merger.add(host):
+                    _log.info("multi-batch scan early exit after %d batches",
+                              n_batches)
+                    break
+            if merger is None:
+                raise RuntimeError(f"empty file relation {rel!r}")
+            _log.info("multi-batch scan: %d batches of <=%d rows merged",
+                      n_batches, self.batch_rows)
+            result = merger.finish()
+        finally:
+            spill = getattr(merger, "spill", None)
+            if spill is not None:
+                spill.close()          # crash-safe: no leaked run files
         return self._run_above(result)
 
     def _host_spine_probe(self, template: ColumnBatch) -> ColumnBatch:

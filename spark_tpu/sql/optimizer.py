@@ -134,6 +134,147 @@ def push_filter_through_join(node: LogicalPlan) -> LogicalPlan:
     return Filter(join_conjuncts(keep), new_join) if keep else new_join
 
 
+def _collect_cross_inner(node: LogicalPlan, rels: List[LogicalPlan],
+                         conds: List[Expression]) -> None:
+    """Flatten a tree of cross/inner joins into (relations, conjuncts)."""
+    if isinstance(node, Join) and node.how in ("inner", "cross") \
+            and not node.using:
+        if node.on is not None:
+            conds.extend(split_conjuncts(node.on))
+        _collect_cross_inner(node.left, rels, conds)
+        _collect_cross_inner(node.right, rels, conds)
+    else:
+        rels.append(node)
+
+
+def rows_estimate(node: LogicalPlan) -> int:
+    """Crude cardinality upper bound for join ordering (the stats the
+    reference keeps in `statsEstimation/`; here capacity-based)."""
+    from .logical import (
+        FileRelation, LocalRelation, RangeRelation, Limit as LLimit,
+        Union as LUnion, Join as LJoin,
+    )
+    if isinstance(node, LocalRelation):
+        return node.batch.capacity
+    if isinstance(node, RangeRelation):
+        return node.num_rows()
+    if isinstance(node, FileRelation):
+        est = node.__dict__.get("_est_rows")
+        if est is None:
+            try:
+                from ..io import file_row_count
+                est = file_row_count(node) or (1 << 20)
+            except Exception:
+                est = 1 << 20
+            node.__dict__["_est_rows"] = est
+        return est
+    if isinstance(node, LLimit):
+        return min(node.n, rows_estimate(node.children[0]))
+    if isinstance(node, LUnion):
+        return sum(rows_estimate(c) for c in node.children)
+    if isinstance(node, LJoin):
+        return max(rows_estimate(c) for c in node.children)
+    if node.children:
+        return max(rows_estimate(c) for c in node.children)
+    return 1 << 10
+
+
+def reorder_joins(node: LogicalPlan) -> LogicalPlan:
+    """Reorder a comma-join chain so every join is condition-connected
+    (`ReorderJoin` / `ExtractFiltersAndInnerJoins` in
+    `optimizer/joins.scala`): FROM a, b, c WHERE a.x = c.y AND c.z = b.w
+    must not materialize the a x b cross product just because b precedes c.
+
+    Greedy: start from the first relation, repeatedly attach the first
+    remaining relation that some unused conjunct connects to the joined
+    set; attach every conjunct that closes over the new combined schema at
+    that join.  Deterministic, so the fixed-point executor converges."""
+    if not (isinstance(node, Filter) and isinstance(node.child, Join)):
+        return node
+    j = node.child
+    if j.how not in ("inner", "cross") or j.using:
+        return node
+    rels: List[LogicalPlan] = []
+    conds: List[Expression] = []
+    _collect_cross_inner(j, rels, conds)
+    if len(rels) < 3:
+        return node                  # pair case: push_filter_into_join
+    conds = conds + split_conjuncts(node.condition)
+    if not all(is_deterministic(c) for c in conds):
+        return node
+    schemas = [set(r.schema().names) for r in rels]
+
+    # the base relation becomes the probe side of every join in the
+    # left-deep tree, and join output capacity scales with PROBE capacity —
+    # so start from the largest relation (usually the fact table)
+    base = max(range(len(rels)), key=lambda i: rows_estimate(rels[i]))
+    joined = rels[base]
+    joined_cols = set(schemas[base])
+    remaining = [i for i in range(len(rels)) if i != base]
+    unused = list(conds)
+    made_progress = base != 0
+    while remaining:
+        pick = None
+        for idx in remaining:
+            cand_cols = schemas[idx]
+            for c_ in unused:
+                refs = c_.references()
+                if (refs & joined_cols) and (refs & cand_cols) \
+                        and refs <= (joined_cols | cand_cols):
+                    pick = idx
+                    break
+            if pick is not None:
+                break
+        if pick is None:
+            pick = remaining[0]      # genuinely unconnected: cross join
+        cand_cols = schemas[pick]
+        new_cols = joined_cols | cand_cols
+        attach = [c_ for c_ in unused if c_.references() <= new_cols
+                  and (c_.references() & cand_cols)]
+        if attach and pick != remaining[0]:
+            made_progress = True
+        # identity filtering: Expression.__eq__ builds EQ nodes (DSL
+        # operator overloading), so `in`/`==` must never be used here
+        attach_ids = {id(x) for x in attach}
+        unused = [c_ for c_ in unused if id(c_) not in attach_ids]
+        how = "inner" if attach else "cross"
+        joined = Join(joined, rels[pick], how,
+                      join_conjuncts(attach) if attach else None, None)
+        joined_cols = new_cols
+        remaining.remove(pick)
+    if not made_progress:
+        return node                  # already in a connected order
+    return Filter(join_conjuncts(unused), joined) if unused else joined
+
+
+def push_filter_into_join(node: LogicalPlan) -> LogicalPlan:
+    """Filter conjuncts over a cross/inner join that reference BOTH sides
+    become the join condition — the comma-join `FROM a, b WHERE a.x = b.y`
+    pattern turns into an equi inner join (the moral of
+    `ExtractEquiJoinKeys` + `ReorderJoin`'s condition collection in
+    `catalyst/.../planning/patterns.scala` / `optimizer/joins.scala`)."""
+    if not (isinstance(node, Filter) and isinstance(node.child, Join)):
+        return node
+    j = node.child
+    if j.how not in ("inner", "cross") or j.using:
+        return node
+    left_cols = set(j.left.schema().names)
+    right_cols = set(j.right.schema().names)
+    both, keep = [], []
+    for c_ in split_conjuncts(node.condition):
+        refs = c_.references()
+        if is_deterministic(c_) and (refs & left_cols) and \
+                (refs & right_cols) and refs <= (left_cols | right_cols):
+            both.append(c_)
+        else:
+            keep.append(c_)
+    if not both:
+        return node
+    cond = join_conjuncts(both + ([j.on] if j.on is not None else []))
+    new_join = Join(j.left, j.right, "inner", cond, None)
+    return Filter(join_conjuncts(keep), new_join) if keep else new_join
+
+
 def split_conjuncts(e: Expression) -> List[Expression]:
     if isinstance(e, And):
         return split_conjuncts(e.children[0]) + split_conjuncts(e.children[1])
@@ -228,6 +369,8 @@ class Optimizer:
                 push_filter_through_project,
                 push_filter_through_union,
                 push_filter_through_join,
+                reorder_joins,
+                push_filter_into_join,
                 prune_filters,
                 collapse_projects,
                 push_limit,

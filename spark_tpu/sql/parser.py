@@ -666,12 +666,14 @@ class Parser:
             else:
                 resolved_keys.append(k)
 
+        from .analyzer import substitute_grouping_keys
         slots: List[Tuple[A.AggregateFunction, str]] = []
         key_names = [k.name for k in resolved_keys]
         out_exprs: List[Expression] = []
         for e in expanded:
             name = e.name
-            residual = split_aggregate_expr(e, slots)
+            residual = substitute_grouping_keys(
+                split_aggregate_expr(e, slots), resolved_keys)
             if isinstance(residual, Col) and not isinstance(e, Alias) \
                     and residual.name not in key_names:
                 for j, (f, n) in enumerate(slots):
@@ -685,7 +687,8 @@ class Parser:
 
         having_residual = None
         if having is not None:
-            having_residual = split_aggregate_expr(having, slots)
+            having_residual = substitute_grouping_keys(
+                split_aggregate_expr(having, slots), resolved_keys)
 
         node: LogicalPlan = Aggregate(resolved_keys, slots, plan)
         if having_residual is not None:

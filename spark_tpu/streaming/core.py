@@ -328,7 +328,7 @@ class MetadataLog:
 # stateful aggregation: partial-buffer state merge
 # ---------------------------------------------------------------------------
 
-_MERGE_BY_KIND = {"sum": Sum, "min": Min, "max": Max}
+from ..aggregates import MERGE_BY_KIND as _MERGE_BY_KIND  # noqa: E402
 
 
 def _decode_host_col(vec: ColumnVector, cap: int):

@@ -1,0 +1,14 @@
+"""TPC-DS harness: schema, scaled-down data generator, query set.
+
+The engine analog of the reference's TPC-DS test assets
+(`sql/core/src/test/resources/tpcds/`, planned by `TPCDSQuerySuite`,
+benchmarked by `benchmark/TPCDSQueryBenchmark.scala:63`).  Queries are
+re-derived from the public TPC-DS specification, adapted to this engine's
+SQL dialect (parameters fixed, multi-instance dimension tables expressed
+as renamed FROM-subqueries, fully-determining ORDER BYs so oracle
+comparison is exact).
+"""
+
+from .schema import TABLES                        # noqa: F401
+from .datagen import generate                     # noqa: F401
+from .queries import QUERIES, RUNNABLE, PENDING   # noqa: F401

@@ -1,0 +1,95 @@
+"""TPC-DS harness: every RUNNABLE query validated against a sqlite oracle.
+
+The engine analog of `SQLQueryTestSuite.scala:82` + `TPCDSQuerySuite`:
+identical SQL text runs on both engines over identical generated data;
+results compare exactly (floats by tolerance).  STDDEV_SAMP is rewritten
+for sqlite, which lacks it.
+"""
+
+import math
+import re
+import sqlite3
+
+import numpy as np
+import pytest
+
+from spark_tpu.tpcds import QUERIES, RUNNABLE, PENDING, generate
+
+SF_ROWS = 20_000
+
+
+def _sqlite_text(sql: str) -> str:
+    """Adapt engine SQL to sqlite: expand STDDEV_SAMP via moments."""
+    return re.sub(
+        r"STDDEV_SAMP\((\w+)\)",
+        r"(CASE WHEN count(\1) > 1 THEN "
+        r"sqrt(max(sum(\1*\1*1.0) - count(\1)*avg(\1)*avg(\1), 0)"
+        r" / (count(\1) - 1)) ELSE NULL END)",
+        sql, flags=re.IGNORECASE)
+
+
+@pytest.fixture(scope="module")
+def tpcds(spark):
+    tables = generate(SF_ROWS)
+    for name, pdf in tables.items():
+        spark.createDataFrame(pdf).createOrReplaceTempView(name)
+    con = sqlite3.connect(":memory:")
+    for name, pdf in tables.items():
+        pdf.to_sql(name, con, index=False)
+    yield spark, con
+    con.close()
+    for name in tables:
+        spark.catalog.dropTempView(name)
+
+
+def _norm(v):
+    if v is None:
+        return None
+    if isinstance(v, (bool, np.bool_)):
+        return bool(v)
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        f = float(v)
+        return None if math.isnan(f) else round(f, 6)
+    return str(v)
+
+
+def _key(row):
+    return tuple("\0" if x is None else str(x) for x in row)
+
+
+def _compare(got, exp, qname):
+    got = sorted((tuple(_norm(v) for v in r) for r in got), key=_key)
+    exp = sorted((tuple(_norm(v) for v in r) for r in exp), key=_key)
+    assert len(got) == len(exp), \
+        f"{qname}: {len(got)} rows != oracle {len(exp)}"
+    for i, (g, e) in enumerate(zip(got, exp)):
+        assert len(g) == len(e), f"{qname} row {i}: arity {len(g)}!={len(e)}"
+        for j, (a, b) in enumerate(zip(g, e)):
+            if isinstance(a, float) and isinstance(b, float):
+                assert math.isclose(a, b, rel_tol=1e-6, abs_tol=1e-6), \
+                    f"{qname} row {i} col {j}: {a} != {b}"
+            else:
+                assert a == b, f"{qname} row {i} col {j}: {a!r} != {b!r}"
+
+
+@pytest.mark.parametrize("qname", RUNNABLE)
+def test_query(tpcds, qname):
+    spark, con = tpcds
+    sql = QUERIES[qname]
+    got = [tuple(r) for r in spark.sql(sql).collect()]
+    exp = con.execute(_sqlite_text(sql)).fetchall()
+    assert exp, f"{qname}: oracle returned no rows — weak test, fix params"
+    _compare(got, exp, qname)
+
+
+def test_runnable_count():
+    """The VERDICT r1 #4 bar: >= 20 oracle-validated queries."""
+    assert len(RUNNABLE) >= 20
+    assert not set(RUNNABLE) & set(PENDING)
+
+
+def test_pending_tracked():
+    for q, reason in PENDING.items():
+        assert reason, q
