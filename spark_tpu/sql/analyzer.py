@@ -21,9 +21,11 @@ import itertools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import types as T
-from ..aggregates import AggregateFunction, Count, CountDistinct, Sum, SumDistinct
+from ..aggregates import (
+    AggregateFunction, Count, CountDistinct, CountStar, Sum, SumDistinct,
+)
 from ..expressions import (
-    Alias, AnalysisException, Col, Expression, Literal,
+    Alias, And, AnalysisException, Col, EQ, Expression, Literal,
 )
 from .logical import (
     Aggregate, Distinct, Filter, Join, Limit, LocalRelation, LogicalPlan,
@@ -49,6 +51,19 @@ def split_aggregate_expr(e: Expression, slots: List[Tuple[AggregateFunction, str
         name = fresh_name("agg", repr(e), len(slots))
         slots.append((e, name))
         return Col(name)
+    from .window import WindowExpression, WindowSpec
+    if isinstance(e, WindowExpression):
+        # windows over aggregates (SUM(SUM(x)) OVER ...): the window
+        # function's ARGUMENTS slot-ify like any other post-agg expression;
+        # the window itself computes over the aggregated rows.  PARTITION/
+        # ORDER must reference grouping keys (plain columns survive; key
+        # EXPRESSIONS in a window spec are not substituted yet).
+        f2 = e.func.map_children(lambda c: split_aggregate_expr(c, slots))
+        p2 = [split_aggregate_expr(p, slots) for p in e.spec.partition_by]
+        o2 = [type(o)(split_aggregate_expr(o.child, slots), o.ascending,
+                      o.nulls_first) for o in e.spec.order_by]
+        return WindowExpression(
+            f2, WindowSpec(p2, o2, e.spec.frame, e.spec.frame_type))
     return e.map_children(lambda c: split_aggregate_expr(c, slots))
 
 
@@ -116,11 +131,16 @@ def rewrite_distinct_aggregates(plan: Aggregate) -> LogicalPlan:
                       if getattr(f, "is_distinct", False)]
     if not distinct_slots:
         return plan
-    regular = [(f, n) for f, n in plan.aggs if not getattr(f, "is_distinct", False)]
-    if regular:
-        raise AnalysisException(
-            "mixing DISTINCT and non-DISTINCT aggregates in one GROUP BY is "
-            "not yet supported; split into two aggregations and join")
+    regular = [(f, n) for f, n in plan.aggs
+               if not getattr(f, "is_distinct", False)]
+    from ..aggregates import Max, Min
+    mergeable = (Sum, Count, CountStar, Min, Max)
+    for f, _n in regular:
+        if not isinstance(f, mergeable):
+            raise AnalysisException(
+                f"mixing DISTINCT aggregates with {f!r} is not supported: "
+                "only sum/count/min/max merge through the two-level "
+                "expansion (rewrite avg as sum/count)")
     inputs = {repr(f.children[0]) for f, _ in distinct_slots}
     if len(inputs) > 1:
         raise AnalysisException(
@@ -128,14 +148,20 @@ def rewrite_distinct_aggregates(plan: Aggregate) -> LogicalPlan:
             "yet supported")
     dcol = distinct_slots[0][0].children[0]
     dname = fresh_name("distinct", repr(dcol), 0)
-    # level 1: group by keys + distinct column (dedup)
+    # level 1: group by keys + distinct column (dedup); regular aggregates
+    # evaluate per fine group and MERGE at level 2 (sum-of-sums,
+    # min-of-mins — `RewriteDistinctAggregates.scala` without the Expand)
     inner_keys = list(plan.keys) + [Alias(dcol, dname)]
-    inner = Aggregate(inner_keys, [], plan.child)
+    inner = Aggregate(inner_keys, list(regular), plan.child)
     # level 2: group by keys, aggregate the deduped column
     outer_slots = []
     for f, n in distinct_slots:
         base = Count if isinstance(f, CountDistinct) else Sum
         outer_slots.append((base(Col(dname)), n))
+    for f, n in regular:
+        merge = Sum if isinstance(f, (Sum, Count, CountStar)) \
+            else (Min if isinstance(f, Min) else Max)
+        outer_slots.append((merge(Col(n)), n))
     outer_keys = [Col(k.name) for k in plan.keys]
     return Aggregate(outer_keys, outer_slots, inner)
 
@@ -175,9 +201,14 @@ class Analyzer:
 
     def analyze(self, plan: LogicalPlan) -> LogicalPlan:
         plan = self._resolve_relations(plan)
+        from .subquery import rewrite_subqueries
+        plan = rewrite_subqueries(plan, self._resolve_relations)
         plan = plan.transform_up(self._disambiguate_joins)
         plan = plan.transform_up(self._expand_stars)
         plan = plan.transform_up(self._resolve_qualified)
+        # set-op replacement needs fully-resolved sides (stars expanded,
+        # qualified refs bound) to build the all-column join condition
+        plan = plan.transform_up(self._replace_set_ops)
         plan = plan.transform_up(self._rewrite_node)
         self._validate(plan)
         return plan
@@ -289,6 +320,30 @@ class Analyzer:
                 return SubqueryAlias(node.name, resolved)
             return node
         return plan.transform_up(fn)
+
+    def _replace_set_ops(self, node: LogicalPlan) -> LogicalPlan:
+        """INTERSECT -> Distinct(semi join); EXCEPT -> Distinct(anti join)
+        (`ReplaceIntersectWithSemiJoin` / `ReplaceExceptWithAntiJoin`).
+        The right side's columns are renamed fresh so the all-column
+        equality condition binds unambiguously."""
+        from .logical import Except, Intersect
+        if not isinstance(node, (Intersect, Except)):
+            return node
+        left, right = node.children
+        ls, rs = left.schema(), right.schema()
+        if len(ls.names) != len(rs.names):
+            raise AnalysisException(
+                f"{node!r} requires same-arity sides: "
+                f"{len(ls.names)} vs {len(rs.names)}")
+        renamed = [f"__setop_{i}_{n}" for i, n in enumerate(rs.names)]
+        rproj = Project([Alias(Col(n), rn)
+                         for n, rn in zip(rs.names, renamed)], right)
+        cond = None
+        for ln, rn in zip(ls.names, renamed):
+            eq = EQ(Col(ln), Col(rn))
+            cond = eq if cond is None else And(cond, eq)
+        how = "left_semi" if isinstance(node, Intersect) else "left_anti"
+        return Distinct(Join(left, rproj, how, cond, None))
 
     def _rewrite_node(self, node: LogicalPlan) -> LogicalPlan:
         if isinstance(node, Aggregate):

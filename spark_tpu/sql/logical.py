@@ -358,6 +358,35 @@ class Union(LogicalPlan):
         return f"Union({len(self.children)})"
 
 
+class Intersect(LogicalPlan):
+    """INTERSECT DISTINCT; analysis rewrites it to Distinct(left-semi join)
+    on all columns (`ReplaceIntersectWithSemiJoin` analog).  NULL rows
+    match only by plain equality here (no null-safe compare yet)."""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan):
+        self.children = (left, right)
+
+    def schema(self) -> T.StructType:
+        return self.children[0].schema()
+
+    def __repr__(self):
+        return "Intersect"
+
+
+class Except(LogicalPlan):
+    """EXCEPT DISTINCT -> Distinct(left-anti join)
+    (`ReplaceExceptWithAntiJoin` analog)."""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan):
+        self.children = (left, right)
+
+    def schema(self) -> T.StructType:
+        return self.children[0].schema()
+
+    def __repr__(self):
+        return "Except"
+
+
 class Distinct(LogicalPlan):
     def __init__(self, child: LogicalPlan):
         self.children = (child,)

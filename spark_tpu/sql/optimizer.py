@@ -110,7 +110,15 @@ def push_filter_through_join(node: LogicalPlan) -> LogicalPlan:
     if not (isinstance(node, Filter) and isinstance(node.child, Join)):
         return node
     j = node.child
-    if j.how not in ("inner", "cross", "left_semi"):
+    # a conjunct may push into a side only if that side is not
+    # null-supplying (left side of LEFT/anti joins, right side of RIGHT)
+    if j.how in ("inner", "cross"):
+        may_left, may_right = True, True
+    elif j.how in ("left", "left_semi", "left_anti"):
+        may_left, may_right = True, False
+    elif j.how == "right":
+        may_left, may_right = False, True
+    else:
         return node
     left_cols = set(j.left.schema().names)
     right_cols = set(j.right.schema().names)
@@ -120,9 +128,9 @@ def push_filter_through_join(node: LogicalPlan) -> LogicalPlan:
         refs = c_.references()
         if not is_deterministic(c_):
             keep.append(c_)
-        elif refs <= left_cols:
+        elif refs <= left_cols and may_left:
             left_push.append(c_)
-        elif refs <= right_cols and j.how != "left_semi":
+        elif refs <= right_cols and may_right and not (refs <= left_cols):
             right_push.append(c_)
         else:
             keep.append(c_)

@@ -39,8 +39,9 @@ from ..expressions import (
     StringPredicate, StringTransform, Sub, Substring, UnaryMath,
 )
 from .logical import (
-    Aggregate, Distinct, Filter, Join, Limit, LogicalPlan, Project,
-    RangeRelation, Sort, SortOrder, SubqueryAlias, Union, UnresolvedRelation,
+    Aggregate, Distinct, Except, Filter, Intersect, Join, Limit, LogicalPlan,
+    Project, RangeRelation, Sort, SortOrder, SubqueryAlias, Union,
+    UnresolvedRelation,
 )
 
 __all__ = [
@@ -76,6 +77,7 @@ KEYWORDS = {
     "DESC", "NULLS", "FIRST", "LAST", "WITH", "CREATE", "OR", "REPLACE",
     "TEMP", "TEMPORARY", "VIEW", "TABLE", "DROP", "IF", "EXISTS", "SHOW",
     "TABLES", "DESCRIBE", "DESC", "EXPLAIN", "SET", "VALUES", "INTERVAL",
+    "INTERSECT", "EXCEPT", "MINUS",
 }
 
 
@@ -515,28 +517,61 @@ class Parser:
                     break
         plan = self._set_op_query()
         if ctes:
+            from .subquery import SubqueryExpr
+
+            def subst_plan(p: LogicalPlan) -> LogicalPlan:
+                return p.transform_up(subst).transform_up(subst_exprs)
+
             def subst(node: LogicalPlan) -> LogicalPlan:
                 if isinstance(node, UnresolvedRelation) and node.name.lower() in ctes:
                     return ctes[node.name.lower()]
                 return node
-            plan = plan.transform_up(subst)
+
+            def subst_exprs(node: LogicalPlan) -> LogicalPlan:
+                # CTE references inside subquery EXPRESSIONS (scalar/IN/
+                # EXISTS) are invisible to plan-level transform_up
+                if not node.expressions():
+                    return node
+
+                def fe(e):
+                    if isinstance(e, SubqueryExpr):
+                        return e.with_plan(subst_plan(e.plan))
+                    return e.map_children(fe)
+                return node.map_expressions(fe)
+
+            plan = subst_plan(plan)
         return plan
 
     def _set_op_query(self) -> LogicalPlan:
-        plan = self._query_term()
-        while self.at_kw("UNION"):
-            self.next()
-            distinct = not self.accept_kw("ALL")
-            if not distinct:
-                pass
+        # standard precedence: INTERSECT binds tighter than UNION/EXCEPT
+        plan = self._intersect_term()
+        while self.at_kw("UNION") or self.at_kw("EXCEPT") \
+                or self.at_kw("MINUS"):
+            op = self.next().value.upper()
+            if op == "UNION":
+                distinct = not self.accept_kw("ALL")
+                if distinct:
+                    self.accept_kw("DISTINCT")
+                right = self._intersect_term()
+                plan = Union([plan, right])
+                if distinct:
+                    plan = Distinct(plan)
             else:
+                # EXCEPT/MINUS is a DISTINCT set op (no ALL variant, as in
+                # the reference's 2.3 parser defaults)
                 self.accept_kw("DISTINCT")
-            right = self._query_term()
-            plan = Union([plan, right])
-            if distinct:
-                plan = Distinct(plan)
+                right = self._intersect_term()
+                plan = Except(plan, right)
         # ORDER BY / LIMIT after a set op applies to the whole thing
         plan = self._order_limit(plan, allow=True)
+        return plan
+
+    def _intersect_term(self) -> LogicalPlan:
+        plan = self._query_term()
+        while self.at_kw("INTERSECT"):
+            self.next()
+            self.accept_kw("DISTINCT")
+            plan = Intersect(plan, self._query_term())
         return plan
 
     def _query_term(self) -> LogicalPlan:
@@ -845,14 +880,20 @@ class Parser:
                 continue
             if self.accept_kw("IN"):
                 self.expect_op("(")
-                vals = [self.expr()]
-                while self.accept_op(","):
-                    vals.append(self.expr())
-                self.expect_op(")")
-                for v in vals:
-                    if not isinstance(v, Literal):
-                        raise ParseException("IN list must be literals")
-                e = In(e, vals)
+                if self.at_kw("SELECT") or self.at_kw("WITH"):
+                    from .subquery import InSubquery
+                    sub = self.parse_query()
+                    self.expect_op(")")
+                    e = InSubquery(e, sub)
+                else:
+                    vals = [self.expr()]
+                    while self.accept_op(","):
+                        vals.append(self.expr())
+                    self.expect_op(")")
+                    for v in vals:
+                        if not isinstance(v, Literal):
+                            raise ParseException("IN list must be literals")
+                    e = In(e, vals)
                 if neg:
                     e = Not(e)
                 continue
@@ -936,7 +977,19 @@ class Parser:
             except ValueError as ex:
                 raise ParseException(str(ex))
             return Cast(e, to)
+        if t.kind == "KW" and t.value == "EXISTS":
+            self.next()
+            from .subquery import ExistsSubquery
+            self.expect_op("(")
+            sub = self.parse_query()
+            self.expect_op(")")
+            return ExistsSubquery(sub)
         if self.accept_op("("):
+            if self.at_kw("SELECT") or self.at_kw("WITH"):
+                from .subquery import ScalarSubquery
+                sub = self.parse_query()
+                self.expect_op(")")
+                return ScalarSubquery(sub)
             e = self.expr()
             self.expect_op(")")
             return e

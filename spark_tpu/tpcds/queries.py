@@ -523,42 +523,199 @@ ORDER BY i_category, i_class, i_item_id, i_item_desc, revenueratio
 LIMIT 100
 """
 
-#: committed text but not yet executable (construct named in PENDING)
-_TEXT_ONLY = {"q12", "q20", "q98"}
+QUERIES["q1"] = """
+WITH customer_total_return AS (
+  SELECT sr_customer_sk AS ctr_customer_sk, sr_store_sk AS ctr_store_sk,
+         SUM(sr_return_amt) AS ctr_total_return
+  FROM store_returns, date_dim
+  WHERE sr_returned_date_sk = d_date_sk AND d_year = 2000
+  GROUP BY sr_customer_sk, sr_store_sk)
+SELECT c_customer_id
+FROM customer_total_return ctr1, store, customer
+WHERE ctr1.ctr_total_return >
+      (SELECT AVG(ctr_total_return) * 1.2 FROM customer_total_return ctr2
+       WHERE ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+  AND s_store_sk = ctr1.ctr_store_sk AND s_state = 'TN'
+  AND ctr1.ctr_customer_sk = c_customer_sk
+ORDER BY c_customer_id
+LIMIT 100
+"""
+
+QUERIES["q6"] = """
+SELECT ca_state AS state, COUNT(*) AS cnt
+FROM customer_address a, customer c, store_sales s, date_dim d, item i
+WHERE a.ca_address_sk = c.c_current_addr_sk
+  AND c.c_customer_sk = s.ss_customer_sk
+  AND s.ss_sold_date_sk = d.d_date_sk
+  AND s.ss_item_sk = i.i_item_sk
+  AND d.d_month_seq =
+      (SELECT MIN(d_month_seq) FROM date_dim
+       WHERE d_year = 2001 AND d_moy = 1)
+  AND i.i_current_price > 1.2 *
+      (SELECT AVG(j.i_current_price) FROM item j
+       WHERE j.i_category = i.i_category)
+GROUP BY ca_state
+HAVING COUNT(*) >= 10
+ORDER BY cnt, state
+LIMIT 100
+"""
+
+QUERIES["q15"] = """
+SELECT ca_zip, SUM(cs_sales_price) AS total
+FROM catalog_sales, customer, customer_address, date_dim
+WHERE cs_bill_customer_sk = c_customer_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND (substr(ca_zip, 1, 5) IN ('85669', '86197', '88274', '83405', '86475')
+       OR ca_state IN ('CA', 'WA', 'GA')
+       OR cs_sales_price > 500)
+  AND cs_sold_date_sk = d_date_sk AND d_qoy = 2 AND d_year = 2001
+GROUP BY ca_zip
+ORDER BY ca_zip
+LIMIT 100
+"""
+
+QUERIES["q16"] = """
+SELECT COUNT(DISTINCT cs1.cs_order_number) AS order_count,
+       SUM(cs1.cs_ext_ship_cost) AS total_shipping_cost,
+       SUM(cs1.cs_net_profit) AS total_net_profit
+FROM catalog_sales cs1, date_dim, customer_address, call_center
+WHERE d_date BETWEEN '2000-02-01' AND '2000-04-02'
+  AND cs1.cs_ship_date_sk = d_date_sk
+  AND cs1.cs_ship_addr_sk = ca_address_sk AND ca_state = 'TN'
+  AND cs1.cs_call_center_sk = cc_call_center_sk
+  AND cc_county = 'Williamson County'
+  AND EXISTS (SELECT * FROM catalog_sales cs2
+              WHERE cs1.cs_order_number = cs2.cs_order_number
+                AND cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
+  AND NOT EXISTS (SELECT * FROM catalog_returns cr1
+                  WHERE cs1.cs_order_number = cr1.cr_order_number)
+ORDER BY order_count
+LIMIT 100
+"""
+
+QUERIES["q30"] = """
+WITH customer_total_return AS (
+  SELECT wr_returning_customer_sk AS ctr_customer_sk,
+         ca_state AS ctr_state,
+         SUM(wr_return_amt_inc_tax) AS ctr_total_return
+  FROM web_returns, date_dim, customer_address
+  WHERE wr_returned_date_sk = d_date_sk AND d_year = 2002
+    AND wr_returning_addr_sk = ca_address_sk
+  GROUP BY wr_returning_customer_sk, ca_state)
+SELECT c_customer_id, c_salutation, c_first_name, c_last_name,
+       c_preferred_cust_flag, c_birth_day, c_birth_month, c_birth_year,
+       c_birth_country, c_email_address, ctr_total_return
+FROM customer_total_return ctr1, customer_address, customer
+WHERE ctr1.ctr_total_return >
+      (SELECT AVG(ctr_total_return) * 1.2 FROM customer_total_return ctr2
+       WHERE ctr1.ctr_state = ctr2.ctr_state)
+  AND ca_address_sk = c_current_addr_sk AND ca_state = 'TN'
+  AND ctr1.ctr_customer_sk = c_customer_sk
+ORDER BY c_customer_id, ctr_total_return
+LIMIT 100
+"""
+
+QUERIES["q32"] = """
+SELECT SUM(cs_ext_discount_amt) AS excess_discount_amount
+FROM catalog_sales, item, date_dim
+WHERE i_manufact_id = 77 AND i_item_sk = cs_item_sk
+  AND d_date BETWEEN '2000-01-27' AND '2000-04-26'
+  AND d_date_sk = cs_sold_date_sk
+  AND cs_ext_discount_amt >
+      (SELECT 1.3 * AVG(cs_ext_discount_amt)
+       FROM catalog_sales cs2, date_dim d2
+       WHERE cs2.cs_item_sk = i_item_sk
+         AND d2.d_date BETWEEN '2000-01-27' AND '2000-04-26'
+         AND d2.d_date_sk = cs2.cs_sold_date_sk)
+ORDER BY excess_discount_amount
+LIMIT 100
+"""
+
+_Q38_BLOCK = """
+SELECT DISTINCT c_last_name, c_first_name, d_date
+FROM {fact}, date_dim, customer
+WHERE {fact}.{date_col} = date_dim.d_date_sk
+  AND {fact}.{cust_col} = customer.c_customer_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+"""
+
+QUERIES["q38"] = (
+    "SELECT COUNT(*) AS cnt FROM ("
+    + _Q38_BLOCK.format(fact="store_sales", date_col="ss_sold_date_sk",
+                        cust_col="ss_customer_sk")
+    + " INTERSECT "
+    + _Q38_BLOCK.format(fact="catalog_sales", date_col="cs_sold_date_sk",
+                        cust_col="cs_bill_customer_sk")
+    + " INTERSECT "
+    + _Q38_BLOCK.format(fact="web_sales", date_col="ws_sold_date_sk",
+                        cust_col="ws_bill_customer_sk")
+    + ") hot_cust LIMIT 100")
+
+QUERIES["q87"] = (
+    "SELECT COUNT(*) AS cnt FROM ("
+    + _Q38_BLOCK.format(fact="store_sales", date_col="ss_sold_date_sk",
+                        cust_col="ss_customer_sk")
+    + " EXCEPT "
+    + _Q38_BLOCK.format(fact="catalog_sales", date_col="cs_sold_date_sk",
+                        cust_col="cs_bill_customer_sk")
+    + " EXCEPT "
+    + _Q38_BLOCK.format(fact="web_sales", date_col="ws_sold_date_sk",
+                        cust_col="ws_bill_customer_sk")
+    + ") cool_cust LIMIT 100")
+
+QUERIES["q92"] = """
+SELECT SUM(ws_ext_discount_amt) AS excess_discount_amount
+FROM web_sales, item, date_dim
+WHERE i_manufact_id = 35 AND i_item_sk = ws_item_sk
+  AND d_date BETWEEN '2000-01-27' AND '2000-04-26'
+  AND d_date_sk = ws_sold_date_sk
+  AND ws_ext_discount_amt >
+      (SELECT 1.3 * AVG(ws_ext_discount_amt)
+       FROM web_sales ws2, date_dim d2
+       WHERE ws2.ws_item_sk = i_item_sk
+         AND d2.d_date BETWEEN '2000-01-27' AND '2000-04-26'
+         AND d2.d_date_sk = ws2.ws_sold_date_sk)
+ORDER BY excess_discount_amount
+LIMIT 100
+"""
+
+QUERIES["q94"] = """
+SELECT COUNT(DISTINCT ws1.ws_order_number) AS order_count,
+       SUM(ws1.ws_ext_ship_cost) AS total_shipping_cost,
+       SUM(ws1.ws_net_profit) AS total_net_profit
+FROM web_sales ws1, date_dim, customer_address, web_site
+WHERE d_date BETWEEN '1999-02-01' AND '1999-04-02'
+  AND ws1.ws_ship_date_sk = d_date_sk
+  AND ws1.ws_ship_addr_sk = ca_address_sk AND ca_state = 'TN'
+  AND ws1.ws_web_site_sk = web_site_sk AND web_company_name = 'pri'
+  AND EXISTS (SELECT * FROM web_sales ws2
+              WHERE ws1.ws_order_number = ws2.ws_order_number
+                AND ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+  AND NOT EXISTS (SELECT * FROM web_returns wr1
+                  WHERE ws1.ws_order_number = wr1.wr_order_number)
+ORDER BY order_count
+LIMIT 100
+"""
 
 #: queries that execute end-to-end and are oracle-validated
-RUNNABLE = sorted((q for q in QUERIES if q not in _TEXT_ONLY),
-                  key=lambda q: int(q[1:]))
+RUNNABLE = sorted(QUERIES.keys(), key=lambda q: int(q[1:]))
 
 #: query -> missing construct (the explicit tracking VERDICT r1 #4 asks for)
 PENDING = {
-    "q12": "window over aggregate output (SUM(SUM(x)) OVER (PARTITION BY))",
-    "q20": "window over aggregate output (SUM(SUM(x)) OVER (PARTITION BY))",
-    "q98": "window over aggregate output (SUM(SUM(x)) OVER (PARTITION BY))",
-    "q1": "CTE + correlated scalar subquery (> avg over partition)",
     "q2": "CTE self-join across week_seq arithmetic",
-    "q6": "scalar subquery in predicate + subquery in HAVING",
-    "q9": "scalar subqueries inside CASE branches",
-    "q14": "multi-CTE + INTERSECT",
-    "q15": "IN-subquery over zip list OR-chain",
-    "q16": "EXISTS / NOT EXISTS on order numbers",
+    "q9": "scalar subqueries inside CASE branches (SELECT-list position)",
+    "q14": "multi-CTE + INTERSECT feeding a shared aggregation",
     "q23": "multi-CTE + max-over-subquery threshold",
-    "q24": "CTE + scalar subquery threshold (0.05 * avg)",
-    "q30": "CTE + correlated scalar subquery (1.2 * avg per state)",
-    "q32": "scalar subquery threshold (1.3 * avg discount)",
+    "q24": "CTE + scalar subquery threshold (0.05 * avg) in SELECT position",
     "q33": "three aliased union'd aggregation blocks over manufact subquery",
-    "q38": "INTERSECT of three channels",
-    "q41": "correlated count subquery over item variants",
+    "q41": "correlated count subquery over item variants (non-agg EXISTS)",
     "q45": "IN-subquery on item ids union zip list",
     "q54": "CTE + cross-channel customer subquery chain",
     "q58": "three scalar subqueries + inter-block ratio comparisons",
     "q61": "promotional/total ratio of two aggregation blocks sharing dims",
     "q64": "two-pass CTE self-join on cross-year sales",
-    "q69": "EXISTS / NOT EXISTS per channel",
-    "q81": "CTE + correlated scalar subquery (1.2 * avg per state)",
+    "q69": "EXISTS / NOT EXISTS per channel over cross-joined demographics",
+    "q81": "same shape as q30 (runnable once q30-size params are chosen)",
     "q83": "three CTE blocks joined on item ids with IN-subqueries",
-    "q87": "EXCEPT of three channels",
-    "q92": "scalar subquery threshold (1.3 * avg discount)",
-    "q94": "EXISTS / NOT EXISTS on web order numbers",
-    "q95": "CTE + EXISTS over two-site shipments",
+    "q95": "CTE referenced from EXISTS over two-site shipments",
 }

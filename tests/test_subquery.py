@@ -1,0 +1,171 @@
+"""Subquery rewrites (subquery.scala analog): scalar/IN/EXISTS -> joins,
+INTERSECT/EXCEPT -> semi/anti joins."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_tpu.expressions import AnalysisException
+
+
+@pytest.fixture()
+def tu(spark):
+    t = spark.createDataFrame(pd.DataFrame({
+        "k": [1, 2, 3, 4, 5], "g": ["a", "a", "b", "b", "c"],
+        "v": [1.0, 2.0, 3.0, 4.0, 10.0]}))
+    u = spark.createDataFrame(pd.DataFrame({
+        "k2": [2, 3, 9], "w": [5.0, 6.0, 7.0]}))
+    t.createOrReplaceTempView("t")
+    u.createOrReplaceTempView("u")
+    yield spark
+    spark.catalog.dropTempView("t")
+    spark.catalog.dropTempView("u")
+
+
+def rows(df):
+    return [tuple(r) for r in df.collect()]
+
+
+def test_scalar_uncorrelated(tu):
+    got = rows(tu.sql("SELECT k FROM t WHERE v > (SELECT AVG(v) FROM t) "
+                      "ORDER BY k"))
+    assert got == [(5,)]
+
+
+def test_scalar_correlated(tu):
+    got = rows(tu.sql(
+        "SELECT k FROM t t1 WHERE v > "
+        "(SELECT AVG(t2.v) FROM t t2 WHERE t2.g = t1.g) ORDER BY k"))
+    assert got == [(2,), (4,)]
+
+
+def test_scalar_in_arithmetic(tu):
+    got = rows(tu.sql(
+        "SELECT k FROM t WHERE v > 0.5 * (SELECT MAX(v) FROM t) ORDER BY k"))
+    assert got == [(5,)]
+
+
+def test_scalar_missing_group_is_null(tu):
+    """Correlated group absent -> NULL -> comparison false (left join)."""
+    got = rows(tu.sql(
+        "SELECT k2 FROM u WHERE k2 > "
+        "(SELECT SUM(t.k) FROM t WHERE t.k = u.k2) ORDER BY k2"))
+    assert got == []   # 2 > 2 false, 3 > 3 false, 9 has no group -> NULL
+
+
+def test_in_subquery(tu):
+    got = rows(tu.sql(
+        "SELECT k FROM t WHERE k IN (SELECT k2 FROM u) ORDER BY k"))
+    assert got == [(2,), (3,)]
+
+
+def test_not_in_subquery(tu):
+    got = rows(tu.sql(
+        "SELECT k FROM t WHERE k NOT IN (SELECT k2 FROM u) ORDER BY k"))
+    assert got == [(1,), (4,), (5,)]
+
+
+def test_in_subquery_correlated(tu):
+    got = rows(tu.sql(
+        "SELECT k FROM t WHERE k IN "
+        "(SELECT k2 FROM u WHERE u.w > t.v) ORDER BY k"))
+    assert got == [(2,), (3,)]
+
+
+def test_exists(tu):
+    got = rows(tu.sql(
+        "SELECT k FROM t WHERE EXISTS "
+        "(SELECT * FROM u WHERE u.k2 = t.k) ORDER BY k"))
+    assert got == [(2,), (3,)]
+
+
+def test_not_exists(tu):
+    got = rows(tu.sql(
+        "SELECT k FROM t WHERE NOT EXISTS "
+        "(SELECT * FROM u WHERE u.k2 = t.k) ORDER BY k"))
+    assert got == [(1,), (4,), (5,)]
+
+
+def test_exists_non_equi_residual(tu):
+    got = rows(tu.sql(
+        "SELECT k FROM t WHERE EXISTS "
+        "(SELECT * FROM u WHERE u.k2 = t.k AND u.w > 5.5) ORDER BY k"))
+    assert got == [(3,)]
+
+
+def test_uncorrelated_exists_raises(tu):
+    with pytest.raises(AnalysisException):
+        tu.sql("SELECT k FROM t WHERE EXISTS (SELECT * FROM u)").collect()
+
+
+def test_intersect(tu):
+    got = sorted(rows(tu.sql("SELECT k FROM t INTERSECT SELECT k2 FROM u")))
+    assert got == [(2,), (3,)]
+
+
+def test_except(tu):
+    got = sorted(rows(tu.sql("SELECT k FROM t EXCEPT SELECT k2 FROM u")))
+    assert got == [(1,), (4,), (5,)]
+
+
+def test_intersect_deduplicates(tu):
+    got = rows(tu.sql(
+        "SELECT g FROM t INTERSECT SELECT 'a' AS x FROM u"))
+    assert got == [("a",)]
+
+
+def test_intersect_precedence(tu):
+    """INTERSECT binds tighter than UNION (standard precedence)."""
+    got = sorted(rows(tu.sql(
+        "SELECT k FROM t WHERE k = 1 UNION "
+        "SELECT k FROM t INTERSECT SELECT k2 FROM u")))
+    assert got == [(1,), (2,), (3,)]
+
+
+def test_intersect_star_and_qualified(tu):
+    assert len(rows(tu.sql("SELECT * FROM u INTERSECT SELECT * FROM u"))) == 3
+    got = sorted(rows(tu.sql(
+        "SELECT t.k FROM t INTERSECT SELECT u.k2 FROM u")))
+    assert got == [(2,), (3,)]
+
+
+def test_nested_subquery(tu):
+    got = rows(tu.sql(
+        "SELECT k FROM t WHERE k IN "
+        "(SELECT k2 FROM u WHERE w > (SELECT AVG(w) FROM u))"))
+    assert got == []      # avg(w)=6 -> only k2=9 qualifies, not in t
+
+
+def test_exists_with_limit(tu):
+    got = sorted(rows(tu.sql(
+        "SELECT k FROM t WHERE EXISTS "
+        "(SELECT 1 FROM u WHERE u.k2 = t.k LIMIT 1)")))
+    assert got == [(2,), (3,)]
+
+
+def test_cte_in_subquery(tu):
+    got = rows(tu.sql("""
+        WITH big AS (SELECT g, SUM(v) AS sv FROM t GROUP BY g)
+        SELECT g FROM big b1
+        WHERE b1.sv > (SELECT AVG(sv) FROM big b2) ORDER BY g"""))
+    assert got == [("b",), ("c",)]
+
+
+def test_subquery_in_having(tu):
+    got = rows(tu.sql(
+        "SELECT g, SUM(v) AS sv FROM t GROUP BY g "
+        "HAVING SUM(v) > (SELECT AVG(v) FROM t) ORDER BY g"))
+    assert got == [("b", 7.0), ("c", 10.0)]
+
+
+def test_mixed_distinct_and_sum(tu):
+    got = rows(tu.sql(
+        "SELECT COUNT(DISTINCT g) AS dg, SUM(v) AS sv, MIN(k) AS mk FROM t"))
+    assert got == [(3, 20.0, 1)]
+
+
+def test_window_over_aggregate(tu):
+    got = rows(tu.sql(
+        "SELECT g, SUM(v) AS sv, "
+        "SUM(SUM(v)) OVER () AS total FROM t GROUP BY g ORDER BY g"))
+    assert got == [("a", 3.0, 20.0), ("b", 7.0, 20.0), ("c", 10.0, 20.0)]
