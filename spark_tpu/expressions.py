@@ -38,6 +38,7 @@ __all__ = [
     "CaseWhen", "In", "Between", "StringPredicate", "StringTransform",
     "StringLength", "Concat", "Substring", "ExtractDatePart", "Hash64",
     "Greatest", "Least", "RowIndex", "Rand", "lit", "col", "AnalysisException",
+    "TimeWindow", "parse_duration",
 ]
 
 
@@ -1180,6 +1181,76 @@ class Concat(Expression):
 # ---------------------------------------------------------------------------
 # Datetime extraction (datetimeExpressions.scala)
 # ---------------------------------------------------------------------------
+
+def parse_duration(text) -> int:
+    """'10 seconds' / '5 minutes' / '1 hour' / '2 days' -> microseconds.
+
+    The CalendarInterval subset event-time windows and watermarks need
+    (reference `unsafe/types/CalendarInterval.java` parsing, fixed-length
+    units only — months/years are not fixed durations)."""
+    if isinstance(text, (int, float)):
+        return int(text)
+    parts = str(text).strip().lower().split()
+    if len(parts) != 2:
+        raise AnalysisException(
+            f"cannot parse duration {text!r}: expected '<n> <unit>'")
+    try:
+        n = float(parts[0])
+    except ValueError:
+        raise AnalysisException(f"cannot parse duration {text!r}")
+    unit = parts[1].rstrip("s")
+    scale = {"microsecond": 1, "millisecond": 1_000, "second": 1_000_000,
+             "minute": 60_000_000, "hour": 3_600_000_000,
+             "day": 86_400_000_000, "week": 7 * 86_400_000_000}.get(unit)
+    if scale is None:
+        raise AnalysisException(f"unknown duration unit {parts[1]!r}")
+    return int(n * scale)
+
+
+class TimeWindow(Expression):
+    """Tumbling event-time bucket (`expressions/TimeWindow.scala`):
+    start = floor(ts / duration) * duration; `field` picks start or end.
+
+    Nested struct output (Spark's window.start/.end) is flattened into the
+    field choice — sliding windows (slide < duration) need row expansion
+    (Expand) and are not supported yet."""
+
+    def __init__(self, child: Expression, duration_us: int,
+                 slide_us: Optional[int] = None, field: str = "start"):
+        if slide_us is not None and slide_us != duration_us:
+            raise AnalysisException(
+                "sliding windows (slide != duration) are not supported yet")
+        assert field in ("start", "end"), field
+        self.duration_us = int(duration_us)
+        self.field = field
+        self.children = (child,)
+
+    def map_children(self, fn):
+        return TimeWindow(fn(self.children[0]), self.duration_us,
+                          None, self.field)
+
+    @property
+    def name(self):
+        return "window" if self.field == "start" else "window_end"
+
+    def data_type(self, schema):
+        src = self.children[0].data_type(schema)
+        if not (isinstance(src, T.TimestampType) or src.is_integral):
+            raise AnalysisException(
+                f"window() needs a timestamp/integral column, got {src}")
+        return T.timestamp
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        d = np.int64(self.duration_us)
+        start = xp.floor_divide(v.data.astype(np.int64), d) * d
+        out = start if self.field == "start" else start + d
+        return ExprValue(out, v.valid)
+
+    def __repr__(self):
+        return f"window({self.children[0]!r}, {self.duration_us}us).{self.field}"
+
 
 class ExtractDatePart(Expression):
     """year/month/day/... from date (days) or timestamp (micros) columns,

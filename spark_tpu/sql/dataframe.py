@@ -158,6 +158,17 @@ class DataFrame:
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(self.session, L.Limit(n, self._plan))
 
+    def withWatermark(self, eventTime: str, delayThreshold: str) -> "DataFrame":
+        """Event-time watermark (`Dataset.withWatermark`); no-op in batch."""
+        from ..expressions import parse_duration
+        if eventTime not in self.schema.names:
+            from ..expressions import AnalysisException
+            raise AnalysisException(
+                f"watermark column {eventTime!r} not found among "
+                f"{self.schema.names}")
+        return DataFrame(self.session, L.EventTimeWatermark(
+            eventTime, parse_duration(delayThreshold), self._plan))
+
     def distinct(self) -> "DataFrame":
         return DataFrame(self.session, L.Distinct(self._plan))
 

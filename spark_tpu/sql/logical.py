@@ -358,6 +358,28 @@ class Union(LogicalPlan):
         return f"Union({len(self.children)})"
 
 
+class EventTimeWatermark(LogicalPlan):
+    """withWatermark(col, delay): event-time lateness bound
+    (`EventTimeWatermarkExec.scala`).  A no-op in batch execution; the
+    streaming engine uses it to drop late rows, finalize append-mode
+    groups, and evict state."""
+
+    def __init__(self, col_name: str, delay_us: int, child: LogicalPlan):
+        self.col_name = col_name
+        self.delay_us = delay_us
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def schema(self) -> T.StructType:
+        return self.child.schema()
+
+    def __repr__(self):
+        return f"EventTimeWatermark {self.col_name} -{self.delay_us}us"
+
+
 class Intersect(LogicalPlan):
     """INTERSECT DISTINCT; analysis rewrites it to Distinct(left-semi join)
     on all columns (`ReplaceIntersectWithSemiJoin` analog).  NULL rows

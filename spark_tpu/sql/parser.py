@@ -200,6 +200,14 @@ def _if_fn(args):
     return If(*args)
 
 
+def _time_window(args, field):
+    from ..expressions import TimeWindow, parse_duration
+    if len(args) != 2 or not isinstance(args[1], Literal):
+        raise ParseException(
+            "window expects (timeColumn, 'duration literal')")
+    return TimeWindow(args[0], parse_duration(args[1].value), None, field)
+
+
 def _count(args, distinct):
     if len(args) != 1:
         raise ParseException("count expects 1 argument")
@@ -254,6 +262,8 @@ SCALAR_FUNCTIONS = {
     "rand": lambda a: Rand(int(a[0].value) if a else 42),
     "hash": lambda a: Hash64(*a),
     "xxhash64": lambda a: Hash64(*a),
+    "window": lambda a: _time_window(a, "start"),
+    "window_end": lambda a: _time_window(a, "end"),
     "to_date": lambda a: Cast(_one(a, "to_date"), T.date),
     "to_timestamp": lambda a: Cast(_one(a, "to_timestamp"), T.timestamp),
     "double": lambda a: Cast(_one(a, "double"), T.float64),

@@ -123,6 +123,9 @@ class Planner:
             return self._scan(batch, leaves)
         if isinstance(node, SubqueryAlias):
             return self._to_physical(node.child, leaves)
+        from .logical import EventTimeWatermark
+        if isinstance(node, EventTimeWatermark):
+            return self._to_physical(node.children[0], leaves)  # batch no-op
         if isinstance(node, Project):
             return P.PProject(node.exprs, self._to_physical(node.child, leaves))
         if isinstance(node, Filter):

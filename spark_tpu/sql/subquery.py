@@ -248,7 +248,28 @@ def _rewrite_scalar(child: LogicalPlan, sub: LogicalPlan
     value_expr = first.children[0] if isinstance(first, Alias) else first
     fresh_v = _fresh_name(first.name)
 
+    # COUNT over an empty set is 0, but the correlated left-join rewrite
+    # yields NULL for outer rows with no matching group — the classic
+    # COUNT bug (`RewriteCorrelatedScalarSubquery.scala` aggregates'
+    # default-value handling).  Handle the plain `(SELECT count(...) ...)`
+    # shape with coalesce(cnt, 0); reject count buried in arithmetic
+    # loudly rather than return wrong NULLs.
+    from ..aggregates import Count, CountStar
+    count_slots = {n for f, n in agg.aggs if isinstance(f, (Count, CountStar))}
+    is_plain_count = isinstance(value_expr, Col) \
+        and value_expr.name in count_slots
+
+    def _refs_count_slot(e: Expression) -> bool:
+        if isinstance(e, Col) and e.name in count_slots:
+            return True
+        return any(_refs_count_slot(c) for c in e.children)
+
     agg_child, pulled = _pull_correlated(agg.child)
+    if pulled and not is_plain_count and _refs_count_slot(value_expr):
+        raise AnalysisException(
+            "correlated scalar subqueries may use count() only as the "
+            "whole select expression (empty groups must default to 0); "
+            "move arithmetic on the count outside the subquery")
     if not pulled:
         new_sub = Project([Alias(value_expr, fresh_v)],
                           Aggregate([], agg.aggs, agg_child))
@@ -282,9 +303,13 @@ def _rewrite_scalar(child: LogicalPlan, sub: LogicalPlan
     from .optimizer import join_conjuncts
     new_sub = Project(proj, Aggregate(keys, agg.aggs, agg_child))
     # LEFT join: outer rows without a matching group see NULL, so any
-    # comparison against the scalar is false — SQL scalar semantics
-    return Join(child, new_sub, "left", join_conjuncts(on), None), \
-        Col(fresh_v)
+    # comparison against the scalar is false — SQL scalar semantics —
+    # except COUNT, which must read 0 for empty groups
+    ref: Expression = Col(fresh_v)
+    if is_plain_count:
+        from ..expressions import Coalesce, Literal
+        ref = Coalesce(ref, Literal(0))
+    return Join(child, new_sub, "left", join_conjuncts(on), None), ref
 
 
 # ---------------------------------------------------------------------------

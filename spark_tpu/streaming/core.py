@@ -468,27 +468,50 @@ class AggregationState:
     def _changed_mask(self, finished: ColumnBatch,
                       batch_partial: ColumnBatch) -> np.ndarray:
         """Vectorized membership: which finished rows' keys appear among
-        the live rows of this batch's partial?  Joint np.unique coding per
-        key column (re-compacted each round so codes never overflow), then
-        one np.isin — no per-row Python in the micro-batch hot loop."""
+        the live rows of this batch's partial?  One _joint_codes pass +
+        np.isin — no per-row Python in the micro-batch hot loop."""
         nk = len(self.keys)
         nf, nb = finished.capacity, batch_partial.capacity
+        if nk == 0:
+            return np.ones(nf, bool)   # the single global group: changed
         live_b = np.broadcast_to(
             np.asarray(batch_partial.row_valid_or_true()), (nb,))
-        combined = np.zeros(nf + nb, np.int64)
-        for i in range(nk):
-            va, ka = _decode_host_col(finished.vectors[i], nf)
-            vb, kb = _decode_host_col(batch_partial.vectors[i], nb)
-            vals = np.concatenate([va, vb])
-            valids = np.concatenate([ka, kb])
-            _, inv = np.unique(vals, return_inverse=True)
-            inv = inv.astype(np.int64) + 1
-            inv[~valids] = 0         # NULL keys group together
-            _, combined = np.unique(
-                combined * np.int64(inv.max() + 1) + inv,
-                return_inverse=True)
-            combined = combined.astype(np.int64)
-        return np.isin(combined[:nf], combined[nf:][live_b])
+        cols_f = [_decode_host_col(finished.vectors[i], nf)
+                  for i in range(nk)]
+        cols_b = [_decode_host_col(batch_partial.vectors[i], nb)
+                  for i in range(nk)]
+        cf, cb = _joint_codes(cols_f, cols_b)
+        return np.isin(cf, cb[live_b])
+
+    def evict_finalized(self, key_idx: int, dur_us: int, wm_us: int,
+                        emit: bool = True) -> Optional[ColumnBatch]:
+        """Groups whose event-time key is final under the watermark:
+        windows with start + duration <= wm, or raw event keys < wm
+        (StateStoreSaveExec's append-mode emit + state cleanup).  Removes
+        them from state; returns their finished rows when `emit`."""
+        if self.state is None:
+            return None
+        live = np.asarray(self.state.row_valid_or_true())
+        kvec = self.state.vectors[key_idx]
+        kv = np.asarray(kvec.data).astype(np.int64)
+        kvalid = np.ones(self.state.capacity, bool) if kvec.valid is None \
+            else np.asarray(kvec.valid)
+        if dur_us:
+            final = live & kvalid & ((kv + np.int64(dur_us)) <= wm_us)
+        else:
+            final = live & kvalid & (kv < wm_us)
+        if not final.any():
+            return None
+        out = None
+        if emit:
+            finished = self.finished()
+            rv = np.asarray(finished.row_valid_or_true()) & final
+            out = compact(np, ColumnBatch(finished.names, finished.vectors,
+                                          rv, finished.capacity))
+        keep = np.asarray(self.state.row_valid_or_true()) & ~final
+        self.state = compact(np, ColumnBatch(
+            self.state.names, self.state.vectors, keep, self.state.capacity))
+        return out
 
     def snapshot(self, path: str, batch_id: int) -> None:
         os.makedirs(path, exist_ok=True)
@@ -523,6 +546,97 @@ class AggregationState:
         self.state = ColumnBatch(payload["names"], vectors,
                                  payload["row_valid"], payload["capacity"])
         return True
+
+
+def _joint_codes(cols_a: List[Tuple], cols_b: List[Tuple]) -> Tuple:
+    """Joint group codes for two row sets' key columns (value-compared,
+    NULLs group together): returns (codes_a, codes_b)."""
+    na = len(cols_a[0][0]) if cols_a else 0
+    nb = len(cols_b[0][0]) if cols_b else 0
+    combined = np.zeros(na + nb, np.int64)
+    for (va, ka), (vb, kb) in zip(cols_a, cols_b):
+        vals = np.concatenate([va, vb])
+        valids = np.concatenate([ka, kb])
+        _, inv = np.unique(vals, return_inverse=True)
+        inv = inv.astype(np.int64) + 1
+        inv[~valids] = 0
+        _, combined = np.unique(
+            combined * np.int64(inv.max() + 1) + inv, return_inverse=True)
+        combined = combined.astype(np.int64)
+    return combined[:na], combined[na:]
+
+
+class DedupState:
+    """Streaming dropDuplicates (`StreamingDeduplicateExec`): state = the
+    first-seen row per key; each batch emits only rows whose key is new.
+    With a watermark on one of the key/value columns, old state evicts."""
+
+    def __init__(self, key_names: List[str], schema: T.StructType):
+        self.key_names = list(key_names)
+        self.schema = schema
+        self.state: Optional[ColumnBatch] = None
+        # reuse the aggregation snapshot format by delegation
+        self._io = AggregationState([], [], schema)
+
+    def _key_cols(self, batch: ColumnBatch) -> List[Tuple]:
+        out = []
+        for n in self.key_names:
+            vec = batch.column(n)
+            out.append(_decode_host_col(
+                vec, batch.capacity))
+        return out
+
+    def update(self, batch: ColumnBatch) -> ColumnBatch:
+        """New-key rows of `batch` (first occurrence kept, intra- and
+        cross-batch); extends the state with them."""
+        batch = compact(np, batch.to_host())
+        live = np.asarray(batch.row_valid_or_true())
+        n = int(live.sum())
+        if n == 0:
+            return batch
+        cols = self._key_cols(batch)
+        if self.state is not None:
+            scols = self._key_cols(self.state)
+            sc, bc = _joint_codes(scols, cols)
+            seen_mask = np.isin(bc, sc[np.asarray(
+                self.state.row_valid_or_true())])
+        else:
+            bc = _joint_codes(cols, cols)[0]
+            seen_mask = np.zeros(batch.capacity, bool)
+        # intra-batch: keep the FIRST live occurrence of each new key
+        # (np.unique return_index = first occurrence in array order)
+        live_idx = np.nonzero(live)[0]
+        _, first_idx = np.unique(bc[live_idx], return_index=True)
+        first_of_code = np.zeros(batch.capacity, bool)
+        first_of_code[live_idx[first_idx]] = True
+        emit_mask = live & first_of_code & ~seen_mask
+        out = compact(np, ColumnBatch(batch.names, batch.vectors,
+                                      emit_mask, batch.capacity))
+        self.state = out if self.state is None \
+            else compact(np, union_all([self.state, out]))
+        return out
+
+    def evict(self, col_name: str, wm_us: int) -> None:
+        if self.state is None or col_name not in self.state.names:
+            return
+        vec = self.state.column(col_name)
+        kv = np.asarray(vec.data).astype(np.int64)
+        kvalid = np.ones(self.state.capacity, bool) if vec.valid is None \
+            else np.asarray(vec.valid)
+        keep = np.asarray(self.state.row_valid_or_true()) \
+            & ~(kvalid & (kv < wm_us))
+        self.state = compact(np, ColumnBatch(
+            self.state.names, self.state.vectors, keep, self.state.capacity))
+
+    def snapshot(self, path: str, batch_id: int) -> None:
+        self._io.state = self.state
+        self._io.snapshot(path, batch_id)
+
+    def restore(self, path: str, batch_id: int) -> bool:
+        ok = self._io.restore(path, batch_id)
+        if ok:
+            self.state = self._io.state
+        return ok
 
 
 # ---------------------------------------------------------------------------
@@ -575,8 +689,28 @@ class StreamExecution:
 
         self.batch_id = 0
         self.committed_offset: Optional[int] = None
+        # event-time watermark (EventTimeWatermarkExec accumulation)
+        wms = _find_nodes(plan, L.EventTimeWatermark)
+        if len(wms) > 1:
+            raise AnalysisException("multiple watermarks are not supported")
+        self._wm_col: Optional[str] = wms[0].col_name if wms else None
+        self._wm_delay: int = wms[0].delay_us if wms else 0
+        if self._wm_col is not None \
+                and self._wm_col not in self.source.schema().names:
+            raise AnalysisException(
+                f"watermark column {self._wm_col!r} must come from the "
+                "streaming source schema")
+        self.watermark_us: Optional[int] = None
+        self._max_event_us: Optional[int] = None
+        self._dedup_state: Optional[DedupState] = None
+        self._dedup_node = None
+        self._event_key = None
         self._agg_state = self._build_agg_state()
         self._stopped = threading.Event()
+        # the trigger-loop thread and processAllAvailable() callers must
+        # never execute a micro-batch concurrently: state merges are not
+        # idempotent
+        self._batch_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self.exception: Optional[BaseException] = None
         self.progress: List[dict] = []
@@ -589,6 +723,57 @@ class StreamExecution:
     # aggregates in the plan and reject shapes the incremental path cannot
     # run, instead of silently falling back to per-batch execution.
     def _build_agg_state(self) -> Optional[AggregationState]:
+        # streaming dropDuplicates: a Distinct (all columns) or an
+        # all-First Aggregate (dropDuplicates(subset)) over the stream
+        # becomes stateful deduplication (StreamingDeduplicateExec)
+        from ..aggregates import First
+        dedups = [d for d in _find_nodes(self.plan, L.Distinct)
+                  if _find_streaming(d)]
+        first_aggs = [
+            a for a in _find_nodes(self.plan, L.Aggregate)
+            if _find_streaming(a) and a.aggs
+            and all(isinstance(f, First) for f, _n in a.aggs)
+        ]
+        if dedups or first_aggs:
+            if self.mode == "complete":
+                raise AnalysisException(
+                    "complete output mode is not supported for streaming "
+                    "deduplication")
+            if len(dedups) + len(first_aggs) > 1:
+                raise AnalysisException(
+                    "multiple streaming deduplications are not supported")
+            node = (dedups or first_aggs)[0]
+            # a streaming AGGREGATE below the dedup would run per-batch
+            # with no state merge — reject instead of silently mis-merging
+            inner_aggs = [a for a in _find_nodes(node.children[0],
+                                                 L.Aggregate)
+                          if _find_streaming(a)]
+            if inner_aggs:
+                raise AnalysisException(
+                    "deduplicating the output of a streaming aggregation "
+                    "cannot be executed incrementally")
+            walk = self.plan
+            while walk is not node:
+                if not isinstance(walk, (L.Project, L.Filter)) \
+                        or len(walk.children) != 1:
+                    raise AnalysisException(
+                        f"streaming deduplication under "
+                        f"{type(walk).__name__} cannot run incrementally")
+                walk = walk.children[0]
+            if isinstance(node, L.Aggregate):
+                for f, n in node.aggs:
+                    if not (isinstance(f.children[0], Col)
+                            and f.children[0].name == n):
+                        raise AnalysisException(
+                            "streaming first() aggregates are only "
+                            "supported in the dropDuplicates(subset) shape")
+                keys = [k.name for k in node.keys]
+            else:
+                keys = list(node.schema().names)
+            self._dedup_node = node
+            self._dedup_state = DedupState(keys, node.child.schema())
+            self._agg_node = None
+            return None
         # only aggregates whose subtree reads the STREAM are stateful; an
         # aggregate over a static join side runs per-batch like any other
         # static subplan
@@ -621,18 +806,42 @@ class StreamExecution:
                     "sorting a streaming aggregation is only supported in "
                     "complete output mode")
             node = node.children[0]
-        if self.mode == "append":
-            # append over an aggregate needs a watermark to know when
-            # groups are final (EventTimeWatermarkExec); without one this
-            # would emit duplicated, ever-growing group rows
+        self._event_key = self._find_event_key(agg)
+        if self.mode == "append" and self._event_key is None:
+            # append over an aggregate needs a watermark on a group key to
+            # know when groups are final (EventTimeWatermarkExec); without
+            # one this would emit duplicated, ever-growing group rows
             raise AnalysisException(
-                "append output mode is not supported for streaming "
-                "aggregations without a watermark")
+                "append output mode for streaming aggregations requires a "
+                "watermark on an event-time grouping key "
+                "(withWatermark + window()/the event column in groupBy)")
         self._agg_node = agg
         return AggregationState(agg.keys, agg.aggs, agg.child.schema())
 
+    def _find_event_key(self, agg: L.Aggregate):
+        """(key index, window duration) of the event-time grouping key tied
+        to the watermark column; duration 0 = the raw event column."""
+        from ..expressions import Alias, TimeWindow
+        if self._wm_col is None:
+            return None
+        for i, k in enumerate(agg.keys):
+            base = k.children[0] if isinstance(k, Alias) else k
+            if isinstance(base, TimeWindow) and base.field == "start" \
+                    and isinstance(base.children[0], Col) \
+                    and base.children[0].name.split(".")[-1] == self._wm_col:
+                return i, base.duration_us
+            if isinstance(base, Col) \
+                    and base.name.split(".")[-1] == self._wm_col:
+                return i, 0
+        return None
+
     def _recover(self):
-        last_commit, _ = self.commit_log.latest()
+        last_commit, commit_meta = self.commit_log.latest()
+        if commit_meta:
+            if commit_meta.get("max_event") is not None:
+                self._max_event_us = commit_meta["max_event"]
+            if commit_meta.get("wm") is not None:
+                self.watermark_us = commit_meta["wm"]
         last_offset_batch, off = self.offset_log.latest()
         if last_offset_batch is None:
             return
@@ -643,9 +852,16 @@ class StreamExecution:
             if entry is not None and entry.get("meta") is not None:
                 self.source.restore_offset_metadata(
                     entry.get("start"), entry["end"], entry["meta"])
+            if entry is not None and entry.get("wm") is not None:
+                if self.watermark_us is None \
+                        or entry["wm"] > self.watermark_us:
+                    self.watermark_us = entry["wm"]
         if last_commit is not None and self._agg_state is not None \
                 and self.state_dir:
             self._agg_state.restore(self.state_dir, last_commit)
+        if last_commit is not None and self._dedup_state is not None \
+                and self.state_dir:
+            self._dedup_state.restore(self.state_dir, last_commit)
         if last_commit is not None and last_commit == last_offset_batch:
             self.batch_id = last_commit + 1
             self.committed_offset = off["end"]
@@ -664,29 +880,48 @@ class StreamExecution:
     processAllAvailable = process_all_available
 
     def _run_one_batch(self) -> bool:
+        with self._batch_lock:
+            return self._run_one_batch_locked()
+
+    def _run_one_batch_locked(self) -> bool:
         # replay path: offsets already logged for this batch id
         logged = self.offset_log.get(self.batch_id)
         if logged is not None:
             start, end = logged.get("start"), logged["end"]
+            if "wm" in logged:
+                self.watermark_us = logged["wm"]
         else:
             end = self.source.get_offset()
             start = self.committed_offset
             if end is None or end == start:
                 return False
             # WAL BEFORE compute (exactly-once contract); include any
-            # source-side offset→data mapping so the batch replays exactly
+            # source-side offset→data mapping so the batch replays exactly,
+            # and the start-of-batch watermark (derived from prior batches)
             payload = {"start": start, "end": end}
+            if self._wm_col is not None:
+                payload["wm"] = self.watermark_us
             meta = self.source.offset_metadata(start, end)
             if meta is not None:
                 payload["meta"] = meta
             self.offset_log.add(self.batch_id, payload)
         t0 = time.time()
         batch = self.source.get_batch(start, end)
+        if self._wm_col is not None:
+            batch = self._apply_watermark_input(batch)
         out = self._execute_batch(batch)
         self.sink.add_batch(self.batch_id, out, self.mode)
         if self._agg_state is not None and self.state_dir:
             self._agg_state.snapshot(self.state_dir, self.batch_id)
-        self.commit_log.add(self.batch_id, {"ts": time.time()})
+        if self._dedup_state is not None and self.state_dir:
+            self._dedup_state.snapshot(self.state_dir, self.batch_id)
+        commit_payload = {"ts": time.time()}
+        if self._wm_col is not None:
+            # persist event-time progress: recovery must not rewind the
+            # watermark (a rewound watermark would readmit evicted keys)
+            commit_payload["max_event"] = self._max_event_us
+            commit_payload["wm"] = self.watermark_us
+        self.commit_log.add(self.batch_id, commit_payload)
         n_rows = len(batch.to_pylist())
         self.progress.append({
             "batchId": self.batch_id, "numInputRows": n_rows,
@@ -696,8 +931,76 @@ class StreamExecution:
         self.batch_id += 1
         return True
 
+    # -- watermark bookkeeping --------------------------------------------
+    def _apply_watermark_input(self, batch: ColumnBatch) -> ColumnBatch:
+        """Track the batch's max event time; DROP rows later than the
+        current (start-of-batch) watermark (EventTimeWatermarkExec)."""
+        batch = batch.to_host()
+        if self._wm_col not in batch.names:
+            return batch
+        vec = batch.column(self._wm_col)
+        data = np.asarray(vec.data).astype(np.int64)
+        valid = np.ones(batch.capacity, bool) if vec.valid is None \
+            else np.asarray(vec.valid)
+        live = np.asarray(batch.row_valid_or_true())
+        vals = data[live & valid]
+        if len(vals):
+            mx = int(vals.max())
+            if self._max_event_us is None or mx > self._max_event_us:
+                self._max_event_us = mx
+        if self.watermark_us is not None:
+            # a row is TOO LATE only when the state it would update is
+            # already finalized/evicted: its window END <= wm for windowed
+            # aggregation, its event value < wm for dedup/raw-key state.
+            # Stateless plans never drop (the reference's watermark node
+            # does not filter either).
+            wm = self.watermark_us
+            late = None
+            if self._agg_state is not None and self._event_key is not None:
+                _idx, dur = self._event_key
+                if dur:
+                    late = ((data // np.int64(dur)) + 1) * np.int64(dur) <= wm
+                else:
+                    late = data < wm
+            elif self._dedup_state is not None:
+                late = data < wm
+            if late is not None:
+                keep = live & (~valid | ~late)
+                if int(keep.sum()) != int(live.sum()):
+                    batch = ColumnBatch(batch.names, batch.vectors, keep,
+                                        batch.capacity)
+        return batch
+
+    def _advance_watermark(self) -> Optional[int]:
+        """Monotonic watermark update from the max event time seen so far.
+
+        Applied at the END of the batch that observed the events (the
+        reference defers it one trigger and emits on a no-data batch; here
+        finalized windows emit promptly in the same trigger)."""
+        if self._wm_col is None:
+            return None
+        if self._max_event_us is not None:
+            cand = self._max_event_us - self._wm_delay
+            if self.watermark_us is None or cand > self.watermark_us:
+                self.watermark_us = cand
+        return self.watermark_us
+
     def _execute_batch(self, data: ColumnBatch) -> ColumnBatch:
         from ..sql.planner import QueryExecution
+
+        if self._dedup_state is not None:
+            below = self._replace_source(self._dedup_node.child, data)
+            pre = QueryExecution(self.session, below).execute()
+            emit = self._dedup_state.update(pre)
+            new_wm = self._advance_watermark()
+            if new_wm is not None:
+                self._dedup_state.evict(self._wm_col, new_wm)
+            # reorder to the dedup node's output schema, then re-apply
+            # whatever sits above it
+            names = self._dedup_node.schema().names
+            plan = L.Project([Col(n) for n in names], L.LocalRelation(emit))
+            above = self._rebuild_above_plan(self._dedup_node, plan)
+            return QueryExecution(self.session, above).execute()
 
         if self._agg_node is not None:
             # run the plan BELOW the aggregate on the new data, then merge
@@ -705,10 +1008,31 @@ class StreamExecution:
             # StateStoreRestore/Save pair collapsed into one merge
             below = self._replace_source(self._agg_node.child, data)
             pre = QueryExecution(self.session, below).execute()
+            if self.mode == "append":
+                # merge, then emit ONLY groups finalized by the advanced
+                # watermark; they leave the state (exactly-once emission)
+                self._agg_state.merge(pre)
+                idx, dur = self._event_key
+                new_wm = self._advance_watermark()
+                emit = None
+                if new_wm is not None:
+                    emit = self._agg_state.evict_finalized(
+                        idx, dur, new_wm, emit=True)
+                if emit is None:
+                    emit = ColumnBatch.empty(self._agg_node.schema())
+                above = self._rebuild_above(emit)
+                return QueryExecution(self.session, above).execute()
             finished = self._agg_state.update(
                 pre, changed_only=(self.mode == "update"))
+            if self.mode == "update" and self._event_key is not None:
+                new_wm = self._advance_watermark()
+                if new_wm is not None:
+                    idx, dur = self._event_key
+                    self._agg_state.evict_finalized(
+                        idx, dur, new_wm, emit=False)
             above = self._rebuild_above(finished)
             return QueryExecution(self.session, above).execute()
+        self._advance_watermark()
         plan = self._replace_source(self.plan, data)
         return QueryExecution(self.session, plan).execute()
 
@@ -721,15 +1045,20 @@ class StreamExecution:
         return plan.transform_up(fn)
 
     def _rebuild_above(self, finished: ColumnBatch) -> L.LogicalPlan:
-        """Re-apply any Project nodes sitting above the Aggregate."""
+        """Re-apply any nodes sitting above the Aggregate."""
+        return self._rebuild_above_plan(self._agg_node,
+                                        L.LocalRelation(finished))
+
+    def _rebuild_above_plan(self, anchor: L.LogicalPlan,
+                            plan: L.LogicalPlan) -> L.LogicalPlan:
         stack = []
         node = self.plan
-        while node is not self._agg_node:
+        while node is not anchor:
             stack.append(node)
             node = node.children[0]
-        plan: L.LogicalPlan = L.LocalRelation(finished)
         for n in reversed(stack):
-            plan = n.map_children(lambda _c: plan)
+            inner = plan
+            plan = n.map_children(lambda _c: inner)
         return plan
 
     # -- thread control ---------------------------------------------------

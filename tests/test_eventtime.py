@@ -1,0 +1,237 @@
+"""Event-time streaming: watermark, window buckets, append mode, dedup.
+
+Scripted StreamTest-style scenarios (reference `StreamTest.scala:224`,
+`EventTimeWatermarkSuite`, `DeduplicateSuite`): late data dropped,
+append-mode windows emitted exactly once, state evicted, and all of it
+surviving a stop/restart from the checkpoint.
+"""
+
+import datetime
+
+import pandas as pd
+import pytest
+
+from spark_tpu import types as T
+from spark_tpu.sql import functions as F
+from spark_tpu.streaming import MemoryStream
+
+
+def sec(n) -> int:
+    return int(n * 1_000_000)     # timestamps are int64 microseconds
+
+
+def dt(n) -> datetime.datetime:
+    """Decoded timestamp value for second n (collect() yields datetimes)."""
+    return datetime.datetime(1970, 1, 1) + datetime.timedelta(seconds=n)
+
+
+SCHEMA = T.StructType([
+    T.StructField("ts", T.timestamp),
+    T.StructField("k", T.string),
+    T.StructField("v", T.int64),
+])
+
+
+def sink_rows(spark, name):
+    return sorted(tuple(r) for r in
+                  spark.sql(f"SELECT * FROM {name}").collect())
+
+
+# ---------------------------------------------------------------------------
+# batch semantics of window()
+# ---------------------------------------------------------------------------
+
+def test_window_batch(spark):
+    df = spark.createDataFrame(pd.DataFrame({
+        "ts": [sec(1), sec(9), sec(10), sec(25)],
+        "v": [1.0, 2.0, 3.0, 4.0]}))
+    out = sorted(tuple(r) for r in
+                 df.groupBy(F.window("ts", "10 seconds").alias("w"))
+                   .agg(F.sum("v").alias("s")).collect())
+    assert out == [(dt(0), 3.0), (dt(10), 3.0), (dt(20), 4.0)]
+
+
+def test_window_end_and_sliding_rejected(spark):
+    df = spark.createDataFrame(pd.DataFrame({"ts": [sec(14)], "v": [1.0]}))
+    (w,) = df.select(F.window_end("ts", "10 seconds").alias("we")).collect()
+    assert w[0] == dt(20)
+    from spark_tpu.expressions import AnalysisException
+    with pytest.raises(AnalysisException):
+        df.select(F.window("ts", "10 seconds", "5 seconds")).collect()
+
+
+# ---------------------------------------------------------------------------
+# append mode with watermark
+# ---------------------------------------------------------------------------
+
+def _windowed_query(spark, src, name, checkpoint=None, mode="append"):
+    agg = (src.toDF(spark)
+           .withWatermark("ts", "5 seconds")
+           .groupBy(F.window("ts", "10 seconds").alias("w"))
+           .agg(F.sum("v").alias("s")))
+    w = (agg.writeStream.format("memory").queryName(name)
+         .outputMode(mode).trigger(once=True))
+    if checkpoint:
+        w = w.option("checkpointLocation", checkpoint)
+    return w.start()
+
+
+def test_append_requires_watermark(spark):
+    src = MemoryStream(SCHEMA, spark)
+    agg = src.toDF(spark).groupBy("k").agg(F.sum("v").alias("s"))
+    from spark_tpu.expressions import AnalysisException
+    with pytest.raises(AnalysisException):
+        (agg.writeStream.format("memory").queryName("nope")
+         .outputMode("append").start())
+
+
+def test_append_windows_emit_once(spark):
+    src = MemoryStream(SCHEMA, spark)
+    q = _windowed_query(spark, src, "ev_app")
+    # window [0,10) open: wm = 9-5 = 4 < 10 -> nothing final
+    src.addData([(sec(1), "a", 1), (sec(9), "a", 2)])
+    q.processAllAvailable()
+    assert sink_rows(spark, "ev_app") == []
+    # ts=20 -> wm = 15 >= 10: window [0,10) finalizes with sum 3
+    src.addData([(sec(20), "a", 4)])
+    q.processAllAvailable()
+    assert sink_rows(spark, "ev_app") == [(dt(0), 3)]
+    # late row (ts=3 < wm=15) is DROPPED, not re-aggregated
+    src.addData([(sec(3), "a", 100)])
+    q.processAllAvailable()
+    assert sink_rows(spark, "ev_app") == [(dt(0), 3)]
+    # ts=35 -> wm = 30: window [20,30) finalizes; [0,10) NOT re-emitted
+    src.addData([(sec(35), "a", 8)])
+    q.processAllAvailable()
+    assert sink_rows(spark, "ev_app") == [(dt(0), 3), (dt(20), 4)]
+    q.stop()
+
+
+def test_append_recovery_across_restart(spark, tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    src = MemoryStream(SCHEMA, spark)
+    q = _windowed_query(spark, src, "ev_rec", checkpoint=ckpt)
+    src.addData([(sec(2), "a", 5), (sec(8), "a", 6)])
+    q.processAllAvailable()
+    assert sink_rows(spark, "ev_rec") == []
+    q.stop()
+    # restart: state (open window [0,10) sum 11) and watermark recover
+    q2 = _windowed_query(spark, src, "ev_rec2", checkpoint=ckpt)
+    src.addData([(sec(21), "a", 1)])
+    q2.processAllAvailable()
+    assert sink_rows(spark, "ev_rec2") == [(dt(0), 11)]
+    # late data from before the recovered watermark stays dropped
+    src.addData([(sec(5), "a", 50)])
+    q2.processAllAvailable()
+    assert sink_rows(spark, "ev_rec2") == [(dt(0), 11)]
+    q2.stop()
+
+
+def test_update_mode_evicts_state(spark):
+    src = MemoryStream(SCHEMA, spark)
+    q = _windowed_query(spark, src, "ev_upd", mode="update")
+    src.addData([(sec(1), "a", 1)])
+    q.processAllAvailable()
+    src.addData([(sec(30), "a", 2)])   # wm=25: [0,10) evicted from state
+    q.processAllAvailable()
+    state = q._ex._agg_state.state
+    import numpy as np
+    assert int(np.asarray(state.num_rows())) == 1   # only [30,40) remains
+    # update mode emitted each changed group as it changed
+    assert sink_rows(spark, "ev_upd") == [(dt(0), 1), (dt(30), 2)]
+    q.stop()
+
+
+def test_open_window_late_rows_kept(spark):
+    """A row older than the watermark but whose WINDOW is still open must
+    aggregate (dropping keys only when the state is final/evicted)."""
+    src = MemoryStream(SCHEMA, spark)
+    q = _windowed_query(spark, src, "ev_open")
+    src.addData([(sec(19), "a", 1)])   # wm -> 14
+    q.processAllAvailable()
+    src.addData([(sec(12), "a", 1)])   # [10,20) end 20 > 14: kept
+    q.processAllAvailable()
+    src.addData([(sec(31), "a", 1)])   # wm -> 26: [10,20) emits
+    q.processAllAvailable()
+    assert sink_rows(spark, "ev_open")[0] == (dt(10), 2)
+    q.stop()
+
+
+def test_dedup_over_streaming_agg_rejected(spark):
+    src = MemoryStream(SCHEMA, spark)
+    from spark_tpu.expressions import AnalysisException
+    with pytest.raises(AnalysisException):
+        (src.toDF(spark).groupBy("k").agg(F.sum("v").alias("x")).distinct()
+         .writeStream.format("memory").queryName("bad_dd")
+         .outputMode("update").start())
+
+
+# ---------------------------------------------------------------------------
+# streaming deduplication
+# ---------------------------------------------------------------------------
+
+def test_drop_duplicates_subset(spark):
+    src = MemoryStream(SCHEMA, spark)
+    q = (src.toDF(spark).dropDuplicates(["k"])
+         .writeStream.format("memory").queryName("dd1")
+         .outputMode("append").trigger(once=True).start())
+    src.addData([(sec(1), "a", 1), (sec(2), "a", 2), (sec(3), "b", 3)])
+    q.processAllAvailable()
+    assert sink_rows(spark, "dd1") == [(dt(1), "a", 1), (dt(3), "b", 3)]
+    # cross-batch duplicate suppressed, new key passes
+    src.addData([(sec(4), "a", 9), (sec(5), "c", 5)])
+    q.processAllAvailable()
+    assert sink_rows(spark, "dd1") == [
+        (dt(1), "a", 1), (dt(3), "b", 3), (dt(5), "c", 5)]
+    q.stop()
+
+
+def test_drop_duplicates_full_row(spark):
+    src = MemoryStream(SCHEMA, spark)
+    q = (src.toDF(spark).distinct()
+         .writeStream.format("memory").queryName("dd2")
+         .outputMode("append").trigger(once=True).start())
+    src.addData([(sec(1), "a", 1), (sec(1), "a", 1), (sec(1), "a", 2)])
+    q.processAllAvailable()
+    assert sink_rows(spark, "dd2") == [(dt(1), "a", 1), (dt(1), "a", 2)]
+    src.addData([(sec(1), "a", 1), (sec(2), "a", 1)])
+    q.processAllAvailable()
+    assert sink_rows(spark, "dd2") == [
+        (dt(1), "a", 1), (dt(1), "a", 2), (dt(2), "a", 1)]
+    q.stop()
+
+
+def test_dedup_watermark_eviction_and_recovery(spark, tmp_path):
+    ckpt = str(tmp_path / "ckpt_dd")
+    import numpy as np
+
+    def start(name):
+        return (MemoryStream(SCHEMA, spark), name)
+
+    src = MemoryStream(SCHEMA, spark)
+
+    def mk(name):
+        return (src.toDF(spark).withWatermark("ts", "5 seconds")
+                .dropDuplicates(["k", "ts"])
+                .writeStream.format("memory").queryName(name)
+                .outputMode("append")
+                .option("checkpointLocation", ckpt)
+                .trigger(once=True).start())
+
+    q = mk("dd3")
+    src.addData([(sec(1), "a", 1), (sec(1), "a", 9)])
+    q.processAllAvailable()
+    assert sink_rows(spark, "dd3") == [(dt(1), "a", 1)]
+    # wm advances to 15: old keys leave the state...
+    src.addData([(sec(20), "b", 2)])
+    q.processAllAvailable()
+    st = q._ex._dedup_state.state
+    assert int(np.asarray(st.num_rows())) == 1
+    q.stop()
+    # ...and a late duplicate cannot sneak back in after restart because
+    # the recovered watermark drops it at the input
+    q2 = mk("dd4")
+    src.addData([(sec(1), "a", 7), (sec(21), "c", 3)])
+    q2.processAllAvailable()
+    assert sink_rows(spark, "dd4") == [(dt(21), "c", 3)]
+    q2.stop()

@@ -114,6 +114,17 @@ def test_intersect_deduplicates(tu):
     assert got == [("a",)]
 
 
+def test_correlated_count_empty_group_is_zero(tu):
+    """COUNT over an empty correlated group reads 0, not NULL."""
+    got = rows(tu.sql(
+        "SELECT k2 FROM u WHERE "
+        "(SELECT COUNT(*) FROM t WHERE t.k = u.k2) = 0 ORDER BY k2"))
+    assert got == [(9,)]
+    with pytest.raises(AnalysisException):
+        tu.sql("SELECT k2 FROM u WHERE "
+               "(SELECT COUNT(*) + 1 FROM t WHERE t.k = u.k2) = 1").collect()
+
+
 def test_intersect_precedence(tu):
     """INTERSECT binds tighter than UNION (standard precedence)."""
     got = sorted(rows(tu.sql(
