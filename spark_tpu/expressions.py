@@ -1220,6 +1220,9 @@ class TimeWindow(Expression):
         if slide_us is not None and slide_us != duration_us:
             raise AnalysisException(
                 "sliding windows (slide != duration) are not supported yet")
+        if int(duration_us) <= 0:
+            raise AnalysisException(
+                f"window duration must be positive, got {duration_us}us")
         assert field in ("start", "end"), field
         self.duration_us = int(duration_us)
         self.field = field

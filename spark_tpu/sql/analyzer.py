@@ -201,8 +201,16 @@ class Analyzer:
 
     def analyze(self, plan: LogicalPlan) -> LogicalPlan:
         plan = self._resolve_relations(plan)
+        plan = plan.transform_up(self._resolve_functions)
         from .subquery import rewrite_subqueries
-        plan = rewrite_subqueries(plan, self._resolve_relations)
+
+        def resolve_sub(p: LogicalPlan) -> LogicalPlan:
+            # nested subquery plans need relation AND function resolution
+            # (they are invisible to the outer transform_up passes)
+            p = self._resolve_relations(p)
+            return p.transform_up(self._resolve_functions)
+
+        plan = rewrite_subqueries(plan, resolve_sub)
         plan = plan.transform_up(self._disambiguate_joins)
         plan = plan.transform_up(self._expand_stars)
         plan = plan.transform_up(self._resolve_qualified)
@@ -320,6 +328,35 @@ class Analyzer:
                 return SubqueryAlias(node.name, resolved)
             return node
         return plan.transform_up(fn)
+
+    def _resolve_functions(self, node: LogicalPlan) -> LogicalPlan:
+        """UnresolvedFunction -> registered UDF (FunctionRegistry lookup)."""
+        from .udf import UnresolvedFunction
+        if not node.expressions():
+            return node
+
+        from .window import WindowExpression
+
+        def fe(e: Expression) -> Expression:
+            if isinstance(e, WindowExpression):
+                # the window function lives in .func, not .children
+                return e.map_parts(fe)
+            e = e.map_children(fe)
+            if isinstance(e, UnresolvedFunction):
+                wrapper = None
+                if self.catalog is not None \
+                        and hasattr(self.catalog, "lookup_function"):
+                    wrapper = self.catalog.lookup_function(e.fn_name)
+                if wrapper is None:
+                    raise AnalysisException(
+                        f"undefined function: {e.fn_name}")
+                from .udf import PythonUDF
+                return PythonUDF(e.fn_name, wrapper.fn, wrapper.returnType,
+                                 list(e.children),
+                                 getattr(wrapper, "_vectorized", False))
+            return e
+
+        return node.map_expressions(fe)
 
     def _replace_set_ops(self, node: LogicalPlan) -> LogicalPlan:
         """INTERSECT -> Distinct(semi join); EXCEPT -> Distinct(anti join)

@@ -160,14 +160,17 @@ class DataFrame:
 
     def withWatermark(self, eventTime: str, delayThreshold: str) -> "DataFrame":
         """Event-time watermark (`Dataset.withWatermark`); no-op in batch."""
-        from ..expressions import parse_duration
+        from ..expressions import AnalysisException, parse_duration
         if eventTime not in self.schema.names:
-            from ..expressions import AnalysisException
             raise AnalysisException(
                 f"watermark column {eventTime!r} not found among "
                 f"{self.schema.names}")
+        delay = parse_duration(delayThreshold)
+        if delay < 0:
+            raise AnalysisException(
+                f"watermark delay must be >= 0, got {delayThreshold!r}")
         return DataFrame(self.session, L.EventTimeWatermark(
-            eventTime, parse_duration(delayThreshold), self._plan))
+            eventTime, delay, self._plan))
 
     def distinct(self) -> "DataFrame":
         return DataFrame(self.session, L.Distinct(self._plan))

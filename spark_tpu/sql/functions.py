@@ -224,6 +224,17 @@ def last(c, ignorenulls: bool = True) -> Column:
     return Column(A.Last(_e(c), ignorenulls))
 
 
+def udf(f=None, returnType="double", vectorized: bool = False):
+    """Python UDF factory (`functions.udf`): per-row function bridged via
+    jax.pure_callback (slow lane), or `vectorized=True` for jax-traceable
+    array functions that fuse into the compiled plan (fast lane).
+    Usable directly or as a decorator."""
+    from .udf import make_udf
+    if f is None:
+        return lambda fn: make_udf(fn, returnType, vectorized)
+    return make_udf(f, returnType, vectorized)
+
+
 def window(c, windowDuration: str, slideDuration=None) -> Column:
     """Tumbling event-time bucket; evaluates to the window START timestamp
     (the struct-free flattening of the reference's window().start)."""

@@ -1087,7 +1087,13 @@ class Parser:
         elif lname in _WINDOW_FUNCTIONS:
             out = _WINDOW_FUNCTIONS[lname](args)
         else:
-            raise ParseException(f"undefined function: {name}")
+            # maybe a registered UDF: defer to analysis (FunctionRegistry
+            # lookup happens with the session catalog in scope)
+            if distinct:
+                raise ParseException(
+                    f"DISTINCT is not supported for {name}")
+            from .udf import UnresolvedFunction
+            out = UnresolvedFunction(name, args)
 
         # OVER ( [PARTITION BY ...] [ORDER BY ...] [ROWS BETWEEN ...] )
         t = self.peek()

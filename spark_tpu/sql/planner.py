@@ -241,6 +241,18 @@ class QueryExecution:
     def _run_planned(self, pq: PlannedQuery) -> Tuple[ColumnBatch, float]:
         """One execution attempt → (host result, worst overflow ratio)."""
         use_jit = self.session.conf.get(C.CODEGEN_ENABLED)
+        if use_jit:
+            from .udf import backend_supports_callbacks, plan_has_slow_udf
+            if plan_has_slow_udf(self.optimized) \
+                    and not backend_supports_callbacks():
+                # per-row Python UDFs need pure_callback; on backends
+                # without host callbacks (some TPU runtimes) the query
+                # drops to the interpreted host lane — the price the
+                # reference pays per-UDF-operator, paid per-query here.
+                # vectorized=True UDFs stay on the device path.
+                _log.info("slow-lane Python UDF on a backend without host "
+                          "callbacks: running interpreted")
+                use_jit = False
         if not use_jit:
             ctx = P.ExecContext(np, [b.to_host() for b in pq.leaves])
             out = pq.physical.run(ctx)

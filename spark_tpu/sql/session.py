@@ -20,10 +20,20 @@ from .dataframe import DataFrame
 
 
 class Catalog:
-    """Temp-view registry (slim ``SessionCatalog``)."""
+    """Temp-view + function registry (slim ``SessionCatalog``)."""
 
     def __init__(self):
         self._views: Dict[str, L.LogicalPlan] = {}
+        self._functions: Dict[str, Any] = {}
+
+    def register_function(self, name: str, wrapper) -> None:
+        self._functions[name.lower()] = wrapper
+
+    def lookup_function(self, name: str):
+        return self._functions.get(name.lower())
+
+    def listFunctions(self) -> List[str]:
+        return sorted(self._functions)
 
     def register(self, name: str, plan: L.LogicalPlan) -> None:
         self._views[name.lower()] = plan
@@ -104,6 +114,12 @@ class SparkSession:
         # shape start at the factor that worked (no repeat overflow+recompile)
         self._adapted_factors: Dict[str, Any] = {}
         self._sc = None
+
+    @property
+    def udf(self):
+        """`spark.udf.register(name, fn, returnType)` (UDFRegistration)."""
+        from .udf import UDFRegistration
+        return UDFRegistration(self)
 
     @classmethod
     def getActiveSession(cls) -> Optional["SparkSession"]:

@@ -1,0 +1,256 @@
+"""Python UDFs.
+
+The analog of `execution/python/BatchEvalPythonExec.scala` +
+`api/python/PythonRDD.scala:44`, redesigned for the XLA compilation model
+(SURVEY §7.8): there is no JVM<->Python pickle pipe to pay for — the
+driver IS Python — so a UDF is either
+
+- **slow lane** (default): a per-row Python function bridged into the
+  compiled program with `jax.pure_callback`; XLA calls back onto the host
+  once per batch with the argument arrays, the rows loop runs in Python,
+  and the (values, validity) pair returns to the device program.  Static
+  batch shapes make the callback signature fixed.
+- **fast lane** (`vectorized=True`): the function receives the argument
+  ARRAYS inside the trace and must be jax-traceable (jnp ops); it fuses
+  into the surrounding program like any built-in expression.
+
+Limitations (loud, not silent): string/binary RETURN types need a
+dictionary, which cannot be built under a trace — unsupported; UDFs are
+assumed deterministic (they replay per batch in multi-batch scans and per
+shard in distributed plans).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .. import types as T
+from ..expressions import (
+    AnalysisException, EvalContext, Expression, ExprValue, and_valid,
+)
+
+__all__ = ["PythonUDF", "UnresolvedFunction", "UDFRegistration", "make_udf"]
+
+_EPOCH_DATE = datetime.date(1970, 1, 1)
+_EPOCH_TS = datetime.datetime(1970, 1, 1)
+
+
+def _decode_value(raw, dt: T.DataType, dictionary):
+    if dictionary is not None:
+        i = int(raw)
+        return dictionary[i] if 0 <= i < len(dictionary) else None
+    if isinstance(dt, T.DateType):
+        return _EPOCH_DATE + datetime.timedelta(days=int(raw))
+    if isinstance(dt, T.TimestampType):
+        return _EPOCH_TS + datetime.timedelta(microseconds=int(raw))
+    if isinstance(dt, T.BooleanType):
+        return bool(raw)
+    if dt.is_integral:
+        return int(raw)
+    return float(raw) if np.issubdtype(np.asarray(raw).dtype, np.floating) \
+        else raw.item() if hasattr(raw, "item") else raw
+
+
+def _encode_value(v, dt: T.DataType):
+    if isinstance(dt, T.DateType):
+        return (v - _EPOCH_DATE).days if isinstance(v, datetime.date) else v
+    if isinstance(dt, T.TimestampType) and isinstance(v, datetime.datetime):
+        delta = v - _EPOCH_TS
+        return delta.days * 86_400_000_000 + delta.seconds * 1_000_000 \
+            + delta.microseconds
+    return v
+
+
+_udf_uid = __import__("itertools").count()
+
+_callback_support: Optional[bool] = None
+
+
+def backend_supports_callbacks() -> bool:
+    """Whether the default jax backend can run jax.pure_callback inside a
+    compiled program (CPU/GPU: yes; some TPU runtimes: no — they reject
+    host send/recv).  Probed once per process."""
+    global _callback_support
+    if _callback_support is None:
+        import jax
+        import jax.numpy as jnp
+        try:
+            def probe(x):
+                return jax.pure_callback(
+                    lambda v: np.asarray(v) + 1,
+                    jax.ShapeDtypeStruct((), np.int32), x)
+            jax.jit(probe)(jnp.int32(1)).block_until_ready()
+            _callback_support = True
+        except Exception:
+            _callback_support = False
+    return _callback_support
+
+
+def plan_has_slow_udf(plan) -> bool:
+    """Any non-vectorized PythonUDF anywhere in a logical plan's
+    expressions?  Such plans must run on the host when the backend cannot
+    call back (the BatchEvalPythonExec stage-break analog: the whole query
+    drops to the interpreted lane instead of splitting stages)."""
+    from .window import WindowExpression
+
+    def expr_has(e: Expression) -> bool:
+        if isinstance(e, PythonUDF) and not e.vectorized:
+            return True
+        if isinstance(e, WindowExpression):
+            return any(expr_has(s) for s in e.sub_expressions())
+        return any(expr_has(c) for c in e.children)
+
+    def walk(node) -> bool:
+        if any(expr_has(e) for e in node.expressions()):
+            return True
+        return any(walk(c) for c in node.children)
+    return walk(plan)
+
+
+def _check_ret_type(ret_type: T.DataType) -> None:
+    if ret_type.is_string or isinstance(ret_type, T.BinaryType):
+        raise AnalysisException(
+            "UDF string/binary return types are not supported: the "
+            "output dictionary cannot be built inside a compiled plan "
+            "(dictionary-encode in a source column or return codes)")
+
+
+class PythonUDF(Expression):
+    def __init__(self, name: str, fn: Callable, ret_type: T.DataType,
+                 children: Sequence[Expression], vectorized: bool = False,
+                 uid: Optional[int] = None):
+        _check_ret_type(ret_type)
+        self.fn_name = name
+        self.fn = fn
+        self.ret_type = ret_type
+        self.vectorized = vectorized
+        self.children = tuple(children)
+        # a NEVER-REUSED identity for the jit-cache plan key: two different
+        # lambdas share the repr "<lambda>(...)" and must not share a
+        # compiled program
+        self.uid = next(_udf_uid) if uid is None else uid
+
+    def map_children(self, fn):
+        return PythonUDF(self.fn_name, self.fn, self.ret_type,
+                         [fn(c) for c in self.children], self.vectorized,
+                         self.uid)
+
+    def data_type(self, schema):
+        return self.ret_type
+
+    def eval(self, ctx: EvalContext) -> ExprValue:
+        xp = ctx.xp
+        args = [ctx.broadcast(c.eval(ctx)) for c in self.children]
+        if self.vectorized:
+            out = self.fn(*[a.data for a in args])
+            valid = None
+            for a in args:
+                valid = and_valid(xp, valid, a.valid)
+            return ExprValue(xp.asarray(out).astype(self.ret_type.np_dtype),
+                             valid)
+        capacity = ctx.capacity
+        out_dt = self.ret_type.np_dtype
+        live = ctx.batch.row_valid_or_true()
+        arg_types = [c.data_type(ctx.batch.schema) for c in self.children]
+        dicts = [a.dictionary for a in args]     # trace-time static
+        ret_type = self.ret_type
+
+        fn = self.fn
+        n_args = len(args)
+
+        def host(live_, *flat):
+            datas = [np.asarray(x) for x in flat[:n_args]]
+            valids = [np.asarray(x) for x in flat[n_args:]]
+            out = np.zeros(capacity, out_dt)
+            ov = np.zeros(capacity, bool)
+            for i in np.nonzero(np.asarray(live_))[0]:
+                row = []
+                for d, v, dt, dic in zip(datas, valids, arg_types, dicts):
+                    row.append(_decode_value(d[i], dt, dic)
+                               if v[i] else None)
+                r = fn(*row)
+                if r is not None:
+                    out[i] = _encode_value(r, ret_type)
+                    ov[i] = True
+            return out, ov
+
+        datas = [a.data for a in args]
+        valids = [a.valid if a.valid is not None
+                  else xp.ones(capacity, dtype=bool) for a in args]
+        if xp is np:
+            out, ov = host(np.asarray(live), *datas, *valids)
+            return ExprValue(out, ov)
+        import jax
+        out, ov = jax.pure_callback(
+            host,
+            (jax.ShapeDtypeStruct((capacity,), out_dt),
+             jax.ShapeDtypeStruct((capacity,), np.bool_)),
+            live, *datas, *valids)
+        return ExprValue(out, ov)
+
+    def __repr__(self):
+        inner = ", ".join(repr(c) for c in self.children)
+        return f"{self.fn_name}#{self.uid}({inner})"
+
+
+class UnresolvedFunction(Expression):
+    """A function name the parser does not know — resolved against the
+    session's UDF registry during analysis (FunctionRegistry lookup)."""
+
+    def __init__(self, name: str, args: Sequence[Expression]):
+        self.fn_name = name
+        self.children = tuple(args)
+
+    def map_children(self, fn):
+        return UnresolvedFunction(self.fn_name,
+                                  [fn(c) for c in self.children])
+
+    def data_type(self, schema):
+        raise AnalysisException(f"unresolved function: {self.fn_name}")
+
+    def eval(self, ctx):
+        raise AnalysisException(f"unresolved function: {self.fn_name}")
+
+    def __repr__(self):
+        inner = ", ".join(repr(c) for c in self.children)
+        return f"'{self.fn_name}({inner})"
+
+
+def make_udf(fn: Callable, returnType, vectorized: bool = False,
+             name: Optional[str] = None):
+    """F.udf / pandas_udf-style factory: returns a callable that builds
+    PythonUDF expressions over Columns."""
+    from .column import Column, _expr
+    rt = T.type_for_name(returnType) if isinstance(returnType, str) \
+        else returnType
+    _check_ret_type(rt)
+    label = name or getattr(fn, "__name__", "udf") or "udf"
+    uid = next(_udf_uid)
+
+    def wrapper(*cols) -> Column:
+        return Column(PythonUDF(label, fn, rt,
+                                [_expr(c) for c in cols], vectorized, uid))
+
+    wrapper.fn = fn
+    wrapper.returnType = rt
+    wrapper._vectorized = vectorized
+    return wrapper
+
+
+class UDFRegistration:
+    """`spark.udf` (UDFRegistration.scala): register Python functions for
+    SQL by name; also callable from the DataFrame API via the returned
+    wrapper."""
+
+    def __init__(self, session):
+        self._session = session
+
+    def register(self, name: str, fn: Callable, returnType="double",
+                 vectorized: bool = False):
+        wrapper = fn if hasattr(fn, "fn") and hasattr(fn, "returnType") \
+            else make_udf(fn, returnType, vectorized, name=name)
+        self._session.catalog.register_function(name, wrapper)
+        return wrapper

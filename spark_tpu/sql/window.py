@@ -196,6 +196,21 @@ class WindowExpression(Expression):
     def data_type(self, schema):
         return self.func.data_type(schema)
 
+    # children stays () deliberately: generic aggregate-extraction must NOT
+    # slot-ify the window function itself.  Passes that do need to see
+    # inside (UDF resolution, traversal checks) use these two hooks.
+    def sub_expressions(self):
+        return (self.func, *self.spec.partition_by,
+                *(o.child for o in self.spec.order_by))
+
+    def map_parts(self, fn) -> "WindowExpression":
+        spec = WindowSpec(
+            [fn(p) for p in self.spec.partition_by],
+            [type(o)(fn(o.child), o.ascending, o.nulls_first)
+             for o in self.spec.order_by],
+            self.spec.frame, self.spec.frame_type)
+        return WindowExpression(fn(self.func), spec)
+
     def eval(self, ctx):
         raise AnalysisException(
             "window expressions are computed by the Window operator")

@@ -472,10 +472,11 @@ class AggregationState:
         np.isin — no per-row Python in the micro-batch hot loop."""
         nk = len(self.keys)
         nf, nb = finished.capacity, batch_partial.capacity
-        if nk == 0:
-            return np.ones(nf, bool)   # the single global group: changed
         live_b = np.broadcast_to(
             np.asarray(batch_partial.row_valid_or_true()), (nb,))
+        if nk == 0:
+            # the single global group changed iff the batch contributed rows
+            return np.full(nf, bool(live_b.any()))
         cols_f = [_decode_host_col(finished.vectors[i], nf)
                   for i in range(nk)]
         cols_b = [_decode_host_col(batch_partial.vectors[i], nb)
@@ -492,10 +493,8 @@ class AggregationState:
         if self.state is None:
             return None
         live = np.asarray(self.state.row_valid_or_true())
-        kvec = self.state.vectors[key_idx]
-        kv = np.asarray(kvec.data).astype(np.int64)
-        kvalid = np.ones(self.state.capacity, bool) if kvec.valid is None \
-            else np.asarray(kvec.valid)
+        kv, kvalid = _numeric_event_col(
+            self.state.vectors[key_idx], self.state.capacity)
         if dur_us:
             final = live & kvalid & ((kv + np.int64(dur_us)) <= wm_us)
         else:
@@ -548,21 +547,41 @@ class AggregationState:
         return True
 
 
-def _joint_codes(cols_a: List[Tuple], cols_b: List[Tuple]) -> Tuple:
-    """Joint group codes for two row sets' key columns (value-compared,
-    NULLs group together): returns (codes_a, codes_b)."""
-    na = len(cols_a[0][0]) if cols_a else 0
-    nb = len(cols_b[0][0]) if cols_b else 0
-    combined = np.zeros(na + nb, np.int64)
-    for (va, ka), (vb, kb) in zip(cols_a, cols_b):
-        vals = np.concatenate([va, vb])
-        valids = np.concatenate([ka, kb])
+def _numeric_event_col(vec: ColumnVector, cap: int):
+    """(int64 values, valid) of an EVENT-TIME column for threshold math;
+    dictionary-coded columns would compare codes, not values — refuse."""
+    if vec.dictionary is not None:
+        raise AnalysisException(
+            "event-time watermark columns must be timestamps/integers, "
+            "not strings")
+    data = np.asarray(vec.data).astype(np.int64)
+    valid = np.ones(cap, bool) if vec.valid is None \
+        else np.asarray(vec.valid)
+    return data, valid
+
+
+def _key_codes(cols: List[Tuple]) -> np.ndarray:
+    """Group codes for one row set's key columns (value-compared, NULLs
+    group together)."""
+    n = len(cols[0][0]) if cols else 0
+    combined = np.zeros(n, np.int64)
+    for vals, valids in cols:
         _, inv = np.unique(vals, return_inverse=True)
         inv = inv.astype(np.int64) + 1
         inv[~valids] = 0
         _, combined = np.unique(
             combined * np.int64(inv.max() + 1) + inv, return_inverse=True)
         combined = combined.astype(np.int64)
+    return combined
+
+
+def _joint_codes(cols_a: List[Tuple], cols_b: List[Tuple]) -> Tuple:
+    """Joint group codes across two row sets: (codes_a, codes_b) share a
+    code space, so membership tests are one np.isin."""
+    na = len(cols_a[0][0]) if cols_a else 0
+    joined = [(np.concatenate([va, vb]), np.concatenate([ka, kb]))
+              for (va, ka), (vb, kb) in zip(cols_a, cols_b)]
+    combined = _key_codes(joined)
     return combined[:na], combined[na:]
 
 
@@ -571,9 +590,17 @@ class DedupState:
     first-seen row per key; each batch emits only rows whose key is new.
     With a watermark on one of the key/value columns, old state evicts."""
 
-    def __init__(self, key_names: List[str], schema: T.StructType):
+    def __init__(self, key_names: List[str], schema: T.StructType,
+                 wm_col: Optional[str] = None):
         self.key_names = list(key_names)
         self.schema = schema
+        # state carries ONLY what it reads: the key columns plus the
+        # watermark column for eviction — value columns of a wide stream
+        # would bloat state and every checkpoint snapshot for nothing
+        keep = list(key_names)
+        if wm_col and wm_col not in keep and wm_col in schema.names:
+            keep.append(wm_col)
+        self._state_cols = keep
         self.state: Optional[ColumnBatch] = None
         # reuse the aggregation snapshot format by delegation
         self._io = AggregationState([], [], schema)
@@ -601,7 +628,7 @@ class DedupState:
             seen_mask = np.isin(bc, sc[np.asarray(
                 self.state.row_valid_or_true())])
         else:
-            bc = _joint_codes(cols, cols)[0]
+            bc = _key_codes(cols)
             seen_mask = np.zeros(batch.capacity, bool)
         # intra-batch: keep the FIRST live occurrence of each new key
         # (np.unique return_index = first occurrence in array order)
@@ -612,17 +639,19 @@ class DedupState:
         emit_mask = live & first_of_code & ~seen_mask
         out = compact(np, ColumnBatch(batch.names, batch.vectors,
                                       emit_mask, batch.capacity))
-        self.state = out if self.state is None \
-            else compact(np, union_all([self.state, out]))
+        idx = [out.names.index(n) for n in self._state_cols]
+        new_keys = ColumnBatch([out.names[i] for i in idx],
+                               [out.vectors[i] for i in idx],
+                               out.row_valid, out.capacity)
+        self.state = new_keys if self.state is None \
+            else compact(np, union_all([self.state, new_keys]))
         return out
 
     def evict(self, col_name: str, wm_us: int) -> None:
         if self.state is None or col_name not in self.state.names:
             return
-        vec = self.state.column(col_name)
-        kv = np.asarray(vec.data).astype(np.int64)
-        kvalid = np.ones(self.state.capacity, bool) if vec.valid is None \
-            else np.asarray(vec.valid)
+        kv, kvalid = _numeric_event_col(self.state.column(col_name),
+                                        self.state.capacity)
         keep = np.asarray(self.state.row_valid_or_true()) \
             & ~(kvalid & (kv < wm_us))
         self.state = compact(np, ColumnBatch(
@@ -771,7 +800,8 @@ class StreamExecution:
             else:
                 keys = list(node.schema().names)
             self._dedup_node = node
-            self._dedup_state = DedupState(keys, node.child.schema())
+            self._dedup_state = DedupState(keys, node.child.schema(),
+                                           self._wm_col)
             self._agg_node = None
             return None
         # only aggregates whose subtree reads the STREAM are stateful; an

@@ -205,9 +205,6 @@ def test_dedup_watermark_eviction_and_recovery(spark, tmp_path):
     ckpt = str(tmp_path / "ckpt_dd")
     import numpy as np
 
-    def start(name):
-        return (MemoryStream(SCHEMA, spark), name)
-
     src = MemoryStream(SCHEMA, spark)
 
     def mk(name):

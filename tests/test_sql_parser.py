@@ -58,8 +58,11 @@ def test_parse_errors():
         parse_expression("foo(")
     with pytest.raises(ParseException):
         parse_query("SELECT FROM t")
-    with pytest.raises(ParseException):
-        parse_expression("nosuchfunction(x)")
+    # unknown function names PARSE (they may be registered UDFs) and fail
+    # at analysis instead (FunctionRegistry lookup)
+    from spark_tpu.sql.udf import UnresolvedFunction
+    e = parse_expression("nosuchfunction(x)")
+    assert isinstance(e, UnresolvedFunction)
 
 
 def test_case_when_searched(tables):
