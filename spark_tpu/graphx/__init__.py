@@ -1,0 +1,14 @@
+"""Graph processing on device segment ops (the GraphX analog).
+
+The reference (`graphx/.../Graph.scala`, `Pregel.scala:59`) builds graphs
+on RDDs with per-superstep joins; here a graph IS a set of device arrays
+(dense-indexed vertices, edge endpoint indices), `aggregateMessages` is a
+vectorized edge computation + `jax.ops.segment_*` reduction, and Pregel
+supersteps are host-driven iterations of one jitted step — BSP where the
+barrier is the XLA program boundary.
+"""
+
+from .graph import Edge, Graph, pregel                       # noqa: F401
+from .lib import (                                           # noqa: F401
+    connected_components, page_rank, shortest_paths, triangle_count,
+)
