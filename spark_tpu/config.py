@@ -230,6 +230,21 @@ SPILL_DIR = conf("spark.tpu.spill.dir").doc(
     "Directory for spilled intermediate runs; empty = a fresh temp dir."
 ).string("")
 
+METRICS_ENABLED = conf("spark.sql.metrics.enabled").doc(
+    "Record per-operator output row counts (SQLMetrics analog). Adds one "
+    "fetched scalar per operator to every query; off by default."
+).boolean(False)
+
+EVENT_LOG_DIR = conf("spark.eventLog.dir").doc(
+    "Directory for JSON-lines query event logs (EventLoggingListener "
+    "analog); empty = disabled."
+).string("")
+
+WAREHOUSE_DIR = conf("spark.sql.warehouse.dir").doc(
+    "Root directory for persistent (non-temp) tables and databases "
+    "(CREATE TABLE ... USING, saveAsTable)."
+).string("spark-warehouse")
+
 AGG_FOLD_ROWS = conf("spark.tpu.multibatch.aggFoldRows").doc(
     "Accumulated partial-aggregate rows that trigger an intermediate "
     "buffer-merge fold during a multi-batch aggregation."

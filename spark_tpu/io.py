@@ -641,4 +641,8 @@ class DataFrameWriter:
         self.format("text").save(path)
 
     def saveAsTable(self, name: str) -> None:
-        self._df.createOrReplaceTempView(name)
+        """Persist as a catalog table under the warehouse dir
+        (`DataFrameWriter.saveAsTable`)."""
+        self._df.session.catalog.save_table(
+            name, self._df, self._fmt, self._mode, self._options,
+            self._partition_by)

@@ -1,0 +1,13 @@
+"""Native (C++) host kernels, loaded via ctypes.
+
+The runtime-side native layer the reference implements in
+Java-on-Unsafe/JNI (`common/sketch`, `common/unsafe`, the external-sort
+merge in `UnsafeExternalSorter.java`): compiled once per machine with g++
+into a cached shared object.  Every entry point has a numpy fallback so
+the engine still works where no toolchain exists (`native_available()`
+reports which lane is active).
+"""
+
+from .build import load_library, native_available       # noqa: F401
+from .sketch import BloomFilter, CountMinSketch         # noqa: F401
+from .merge import merge_sorted_runs                    # noqa: F401

@@ -1,0 +1,37 @@
+"""Native k-way merge of sorted runs (external-sort merge kernel).
+
+The `UnsafeExternalSorter.java` merge step: spilled sorted runs merge on
+the host by int64 sort key.  C++ heap merge when available, numpy
+mergesort fallback (stable across runs in offset order either way)."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Sequence
+
+import numpy as np
+
+from .build import load_library
+
+
+def merge_sorted_runs(run_keys: Sequence[np.ndarray]) -> np.ndarray:
+    """Global ascending-order permutation over concatenated runs.
+
+    Each entry of `run_keys` must already be sorted ascending; the result
+    indexes into their concatenation, ties broken by run order (stable)."""
+    runs = [np.ascontiguousarray(np.asarray(r, np.int64)) for r in run_keys]
+    keys = np.concatenate(runs) if runs else np.zeros(0, np.int64)
+    offsets = np.zeros(len(runs) + 1, np.int64)
+    np.cumsum([len(r) for r in runs], out=offsets[1:])
+    lib = load_library()
+    if lib is not None:
+        out = np.zeros(len(keys), np.int64)
+        lib.merge_sorted_runs(
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(runs),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return out
+    # fallback: stable mergesort over (key, position) — positions are
+    # already grouped by run, so stability gives run-order ties
+    return np.argsort(keys, kind="stable").astype(np.int64)

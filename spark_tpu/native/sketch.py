@@ -1,0 +1,181 @@
+"""BloomFilter / CountMinSketch (common/sketch analogs).
+
+Native C++ kernels when the toolchain is available, numpy fallback
+otherwise; both lanes share the Murmur3_x86_32 hashing convention of the
+reference (`BloomFilterImpl.java`, `CountMinSketchImpl.java`), so results
+are identical across lanes."""
+
+from __future__ import annotations
+
+import ctypes
+import math
+from typing import Sequence
+
+import numpy as np
+
+from .build import load_library
+
+
+def _u32(x) -> np.ndarray:
+    return np.asarray(x).astype(np.uint32)
+
+
+def _mixK1(k1):
+    k1 = (k1 * np.uint32(0xcc9e2d51)).astype(np.uint32)
+    k1 = ((k1 << np.uint32(15)) | (k1 >> np.uint32(17))).astype(np.uint32)
+    return (k1 * np.uint32(0x1b873593)).astype(np.uint32)
+
+
+def _mixH1(h1, k1):
+    h1 = (h1 ^ k1).astype(np.uint32)
+    h1 = ((h1 << np.uint32(13)) | (h1 >> np.uint32(19))).astype(np.uint32)
+    return (h1 * np.uint32(5) + np.uint32(0xe6546b64)).astype(np.uint32)
+
+
+def murmur3_hash_long(items, seed) -> np.ndarray:
+    """Vectorized Murmur3_x86_32 hashLong; `seed` scalar or per-item
+    array; returns int32 (bit-exact with the reference/native lane)."""
+    items = np.asarray(items, np.int64)
+    x = items.view(np.uint64)
+    low = (x & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    high = (x >> np.uint64(32)).astype(np.uint32)
+    h1 = _u32(np.broadcast_to(np.asarray(seed, np.int32), items.shape))
+    h1 = _mixH1(h1, _mixK1(low))
+    h1 = _mixH1(h1, _mixK1(high))
+    h = (h1 ^ np.uint32(8)).astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    h = (h * np.uint32(0x85ebca6b)).astype(np.uint32)
+    h ^= h >> np.uint32(13)
+    h = (h * np.uint32(0xc2b2ae35)).astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    return h.view(np.int32)
+
+
+def _probe_positions(items: np.ndarray, num_hashes: int,
+                     num_bits: int) -> np.ndarray:
+    """(n, k) bit positions, BloomFilterImpl double-hash scheme."""
+    h1 = murmur3_hash_long(items, 0).astype(np.int32)
+    h2 = murmur3_hash_long(items, h1).astype(np.int32)
+    i = np.arange(1, num_hashes + 1, dtype=np.int32)
+    combined = (h1[:, None] + i[None, :] * h2[:, None]).astype(np.int32)
+    combined = np.where(combined < 0, ~combined, combined)
+    return combined.astype(np.int64) % num_bits
+
+
+class BloomFilter:
+    """`util/sketch/BloomFilter.java` for int64 items."""
+
+    def __init__(self, expected_items: int, fpp: float = 0.03):
+        n = max(int(expected_items), 1)
+        m = int(math.ceil(-n * math.log(fpp) / (math.log(2) ** 2)))
+        self.num_bits = max((m + 63) // 64 * 64, 64)
+        self.num_hashes = max(int(round(self.num_bits / n * math.log(2))), 1)
+        self.bits = np.zeros(self.num_bits // 64, np.uint64)
+
+    @staticmethod
+    def create(expected_items: int, fpp: float = 0.03) -> "BloomFilter":
+        return BloomFilter(expected_items, fpp)
+
+    def put_long(self, items) -> None:
+        items = np.atleast_1d(np.asarray(items, np.int64))
+        lib = load_library()
+        if lib is not None:
+            lib.bloom_put_longs(
+                self.bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                self.num_bits, self.num_hashes,
+                np.ascontiguousarray(items).ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_int64)), len(items))
+            return
+        pos = _probe_positions(items, self.num_hashes, self.num_bits)
+        np.bitwise_or.at(self.bits, pos.ravel() >> 6,
+                         np.uint64(1) << (pos.ravel() & 63).astype(np.uint64))
+
+    putLong = put_long
+
+    def might_contain_long(self, items) -> np.ndarray:
+        items = np.atleast_1d(np.asarray(items, np.int64))
+        lib = load_library()
+        if lib is not None:
+            out = np.zeros(len(items), np.uint8)
+            lib.bloom_might_contain_longs(
+                self.bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                self.num_bits, self.num_hashes,
+                np.ascontiguousarray(items).ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_int64)), len(items),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+            return out.astype(bool)
+        pos = _probe_positions(items, self.num_hashes, self.num_bits)
+        word = self.bits[pos >> 6]
+        bit = (np.uint64(1) << (pos & 63).astype(np.uint64))
+        return ((word & bit) != 0).all(axis=1)
+
+    mightContainLong = might_contain_long
+
+    def merge(self, other: "BloomFilter") -> "BloomFilter":
+        assert self.num_bits == other.num_bits \
+            and self.num_hashes == other.num_hashes
+        self.bits |= other.bits
+        return self
+
+
+class CountMinSketch:
+    """`util/sketch/CountMinSketch.java` for int64 items."""
+
+    def __init__(self, eps: float = 0.001, confidence: float = 0.99):
+        self.width = int(math.ceil(2.0 / eps))
+        self.depth = int(math.ceil(-math.log(1 - confidence) / math.log(2)))
+        self.table = np.zeros((self.depth, self.width), np.int64)
+        self.total = 0
+
+    @staticmethod
+    def create(eps: float = 0.001, confidence: float = 0.99
+               ) -> "CountMinSketch":
+        return CountMinSketch(eps, confidence)
+
+    def _rows(self, items: np.ndarray) -> np.ndarray:
+        seeds = np.arange(self.depth, dtype=np.int32)
+        h = np.stack([murmur3_hash_long(items, int(s)) for s in seeds], 1)
+        h = np.where(h < 0, ~h, h)
+        return h.astype(np.int64) % self.width
+
+    def add_long(self, items, count: int = 1) -> None:
+        items = np.atleast_1d(np.asarray(items, np.int64))
+        self.total += count * len(items)
+        lib = load_library()
+        if lib is not None:
+            lib.cms_add_longs(
+                self.table.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                self.depth, self.width,
+                np.ascontiguousarray(items).ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_int64)), len(items), count)
+            return
+        cols = self._rows(items)
+        for i in range(self.depth):
+            np.add.at(self.table[i], cols[:, i], count)
+
+    addLong = add_long
+
+    def estimate_count(self, items) -> np.ndarray:
+        items = np.atleast_1d(np.asarray(items, np.int64))
+        lib = load_library()
+        if lib is not None:
+            out = np.zeros(len(items), np.int64)
+            lib.cms_estimate_longs(
+                self.table.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                self.depth, self.width,
+                np.ascontiguousarray(items).ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_int64)), len(items),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            return out
+        cols = self._rows(items)
+        ests = np.stack([self.table[i][cols[:, i]]
+                         for i in range(self.depth)], 1)
+        return ests.min(axis=1)
+
+    estimateCount = estimate_count
+
+    def merge(self, other: "CountMinSketch") -> "CountMinSketch":
+        assert self.table.shape == other.table.shape
+        self.table += other.table
+        self.total += other.total
+        return self

@@ -1,0 +1,194 @@
+// Host-side sketch kernels: Murmur3_x86_32, BloomFilter, CountMinSketch.
+//
+// The native equivalent of the reference's `common/sketch` package
+// (`util/sketch/BloomFilterImpl.java`, `CountMinSketchImpl.java`) and the
+// `Murmur3_x86_32.java` hash the JVM side leans on (SURVEY §2.11 native
+// ledger).  Bit-exact with the Java implementations so sketches built here
+// can interoperate with serialized reference sketches for longs.
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
+// Build: spark_tpu/native/build.py (g++ -O3 -shared -fPIC).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// Murmur3_x86_32 (public domain algorithm; layout matches the
+// reference's hashLong/hashBytes conventions)
+// ---------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+    return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t fmix32(uint32_t h) {
+    h ^= h >> 16; h *= 0x85ebca6b;
+    h ^= h >> 13; h *= 0xc2b2ae35;
+    h ^= h >> 16;
+    return h;
+}
+
+static inline uint32_t mixK1(uint32_t k1) {
+    k1 *= 0xcc9e2d51; k1 = rotl32(k1, 15); k1 *= 0x1b873593;
+    return k1;
+}
+
+static inline uint32_t mixH1(uint32_t h1, uint32_t k1) {
+    h1 ^= k1; h1 = rotl32(h1, 13); h1 = h1 * 5 + 0xe6546b64;
+    return h1;
+}
+
+// hashLong: two 32-bit halves, little-endian order (Murmur3_x86_32.java)
+int32_t murmur3_hash_long(int64_t input, int32_t seed) {
+    uint32_t low = (uint32_t)input;
+    uint32_t high = (uint32_t)((uint64_t)input >> 32);
+    uint32_t h1 = (uint32_t)seed;
+    h1 = mixH1(h1, mixK1(low));
+    h1 = mixH1(h1, mixK1(high));
+    return (int32_t)fmix32(h1 ^ 8u);
+}
+
+int32_t murmur3_hash_bytes(const uint8_t* data, int32_t len, int32_t seed) {
+    uint32_t h1 = (uint32_t)seed;
+    int32_t nblocks = len / 4;
+    for (int32_t i = 0; i < nblocks; i++) {
+        uint32_t k1;
+        std::memcpy(&k1, data + 4 * i, 4);
+        h1 = mixH1(h1, mixK1(k1));
+    }
+    // tail: the reference hashes trailing bytes one at a time through
+    // mixK1 WITHOUT mixH1 accumulation order differences — match
+    // Murmur3_x86_32.hashUnsafeBytes (byte-at-a-time variant hashes each
+    // remaining byte as its own int via mixK1/h1^=).
+    for (int32_t i = nblocks * 4; i < len; i++) {
+        uint32_t half = (uint32_t)(int32_t)(int8_t)data[i];
+        h1 ^= mixK1(half);
+    }
+    return (int32_t)fmix32(h1 ^ (uint32_t)len);
+}
+
+// ---------------------------------------------------------------------
+// BloomFilter (BloomFilterImpl.putLong/mightContainLong semantics:
+// h1 = murmur(seed 0), h2 = murmur(seed h1), k probes (h1 + i*h2))
+// ---------------------------------------------------------------------
+
+void bloom_put_longs(uint64_t* bits, int64_t num_bits, int32_t num_hashes,
+                     const int64_t* items, int64_t n) {
+    for (int64_t j = 0; j < n; j++) {
+        int32_t h1 = murmur3_hash_long(items[j], 0);
+        int32_t h2 = murmur3_hash_long(items[j], h1);
+        for (int32_t i = 1; i <= num_hashes; i++) {
+            int32_t combined = h1 + i * h2;
+            if (combined < 0) combined = ~combined;
+            int64_t bit = combined % num_bits;
+            bits[bit >> 6] |= (1ull << (bit & 63));
+        }
+    }
+}
+
+void bloom_might_contain_longs(const uint64_t* bits, int64_t num_bits,
+                               int32_t num_hashes, const int64_t* items,
+                               int64_t n, uint8_t* out) {
+    for (int64_t j = 0; j < n; j++) {
+        int32_t h1 = murmur3_hash_long(items[j], 0);
+        int32_t h2 = murmur3_hash_long(items[j], h1);
+        uint8_t hit = 1;
+        for (int32_t i = 1; i <= num_hashes && hit; i++) {
+            int32_t combined = h1 + i * h2;
+            if (combined < 0) combined = ~combined;
+            int64_t bit = combined % num_bits;
+            if (!(bits[bit >> 6] & (1ull << (bit & 63)))) hit = 0;
+        }
+        out[j] = hit;
+    }
+}
+
+// ---------------------------------------------------------------------
+// CountMinSketch (CountMinSketchImpl addLong/estimateCount: row i uses
+// hash(item, seed=i) % width)
+// ---------------------------------------------------------------------
+
+void cms_add_longs(int64_t* table, int32_t depth, int32_t width,
+                   const int64_t* items, int64_t n, int64_t count) {
+    for (int64_t j = 0; j < n; j++) {
+        for (int32_t i = 0; i < depth; i++) {
+            int32_t h = murmur3_hash_long(items[j], i);
+            if (h < 0) h = ~h;
+            table[(int64_t)i * width + (h % width)] += count;
+        }
+    }
+}
+
+void cms_estimate_longs(const int64_t* table, int32_t depth, int32_t width,
+                        const int64_t* items, int64_t n, int64_t* out) {
+    for (int64_t j = 0; j < n; j++) {
+        int64_t best = INT64_MAX;
+        for (int32_t i = 0; i < depth; i++) {
+            int32_t h = murmur3_hash_long(items[j], i);
+            if (h < 0) h = ~h;
+            int64_t v = table[(int64_t)i * width + (h % width)];
+            if (v < best) best = v;
+        }
+        out[j] = best;
+    }
+}
+
+// ---------------------------------------------------------------------
+// k-way merge of sorted int64 runs (the external-sort merge kernel the
+// multibatch spill path uses: UnsafeExternalSorter.java's merge step)
+// Runs are concatenated in `keys`; `offsets` has k+1 entries.  Emits the
+// permutation of global indices in ascending key order (stable across
+// runs in offset order).
+// ---------------------------------------------------------------------
+
+void merge_sorted_runs(const int64_t* keys, const int64_t* offsets,
+                       int32_t k, int64_t* out_perm) {
+    // simple binary-heap merge
+    struct Node { int64_t key; int32_t run; int64_t pos; };
+    Node* heap = new Node[k];
+    int32_t sz = 0;
+    auto less = [](const Node& a, const Node& b) {
+        return a.key < b.key || (a.key == b.key && a.run < b.run);
+    };
+    auto push = [&](Node nd) {
+        int32_t i = sz++;
+        heap[i] = nd;
+        while (i > 0) {
+            int32_t p = (i - 1) / 2;
+            if (less(heap[i], heap[p])) {
+                Node t = heap[i]; heap[i] = heap[p]; heap[p] = t;
+                i = p;
+            } else break;
+        }
+    };
+    auto pop = [&]() {
+        Node top = heap[0];
+        heap[0] = heap[--sz];
+        int32_t i = 0;
+        for (;;) {
+            int32_t l = 2 * i + 1, r = 2 * i + 2, m = i;
+            if (l < sz && less(heap[l], heap[m])) m = l;
+            if (r < sz && less(heap[r], heap[m])) m = r;
+            if (m == i) break;
+            Node t = heap[i]; heap[i] = heap[m]; heap[m] = t;
+            i = m;
+        }
+        return top;
+    };
+    for (int32_t r = 0; r < k; r++)
+        if (offsets[r] < offsets[r + 1])
+            push(Node{keys[offsets[r]], r, offsets[r]});
+    int64_t w = 0;
+    while (sz > 0) {
+        Node nd = pop();
+        out_perm[w++] = nd.pos;
+        int64_t nxt = nd.pos + 1;
+        if (nxt < offsets[nd.run + 1])
+            push(Node{keys[nxt], nd.run, nxt});
+    }
+    delete[] heap;
+}
+
+}  // extern "C"

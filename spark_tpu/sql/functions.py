@@ -254,6 +254,12 @@ def countDistinct(c) -> Column:
     return Column(A.CountDistinct(_e(c)))
 
 
+def approx_count_distinct(c, rsd: float = 0.05) -> Column:
+    """Exact under the hood (two-level distinct expansion satisfies any
+    rsd); the HLL sketch lane is a future optimization."""
+    return Column(A.CountDistinct(_e(c)))
+
+
 # ---- window functions ------------------------------------------------------
 
 def row_number() -> Column:

@@ -273,10 +273,66 @@ class _SortMerger:
             self.spill.add(folded)
         return True
 
+    def _native_merge(self, runs: List[ColumnBatch]) -> Optional[ColumnBatch]:
+        """k-way merge of the sorted runs with the native heap kernel —
+        applies when the sort is a single plain integral/timestamp column
+        with nulls grouped at one end (the common ORDER BY <key> case)."""
+        if len(self.orders) != 1 or self.topk is not None:
+            return None
+        expr, asc, _nf = self.orders[0]
+        if not isinstance(expr, Col):
+            return None
+        from ..native import merge_sorted_runs
+
+        def live_prefix(b: ColumnBatch) -> ColumnBatch:
+            # compacted runs hold live rows as a prefix; drop the padding
+            # so run offsets line up with the concatenation
+            n = int(np.asarray(b.num_rows()))
+            if n == b.capacity and b.row_valid is None:
+                return b
+            vecs = [ColumnVector(np.asarray(v.data)[:n], v.dtype,
+                                 None if v.valid is None
+                                 else np.asarray(v.valid)[:n], v.dictionary)
+                    for v in b.vectors]
+            return ColumnBatch(list(b.names), vecs, None, n)
+
+        runs = [live_prefix(r) for r in runs]
+        key_arrays = []
+        for r in runs:
+            try:
+                vec = r.column(expr.name)
+            except ValueError:
+                return None
+            if vec.dictionary is not None or vec.valid is not None:
+                return None
+            data = np.asarray(vec.data)
+            if not np.issubdtype(data.dtype, np.signedinteger):
+                return None           # uint64 > int64max would wrap
+            data = data.astype(np.int64)
+            if not asc and len(data) \
+                    and data.min() == np.iinfo(np.int64).min:
+                return None           # -INT64_MIN overflows: fall back
+            key_arrays.append(data if asc else -data)
+        perm = merge_sorted_runs(key_arrays)
+        cat = union_all(runs) if len(runs) > 1 else runs[0]
+        vectors = [
+            ColumnVector(np.asarray(v.data)[perm], v.dtype,
+                         None if v.valid is None
+                         else np.asarray(v.valid)[perm], v.dictionary)
+            for v in cat.vectors
+        ]
+        rv = None if cat.row_valid is None \
+            else np.asarray(cat.row_valid)[perm]
+        return ColumnBatch(list(cat.names), vectors, rv, cat.capacity)
+
     def finish(self) -> ColumnBatch:
         runs = self.spill.drain()
         if not runs:
             raise RuntimeError("no scan batches produced")
+        runs = [compact(np, r) for r in runs]
+        merged = self._native_merge(runs)
+        if merged is not None:
+            return merged
         return self._sort_limit(union_all(runs) if len(runs) > 1 else runs[0])
 
 

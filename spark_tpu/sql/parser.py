@@ -77,7 +77,8 @@ KEYWORDS = {
     "DESC", "NULLS", "FIRST", "LAST", "WITH", "CREATE", "OR", "REPLACE",
     "TEMP", "TEMPORARY", "VIEW", "TABLE", "DROP", "IF", "EXISTS", "SHOW",
     "TABLES", "DESCRIBE", "DESC", "EXPLAIN", "SET", "VALUES", "INTERVAL",
-    "INTERSECT", "EXCEPT", "MINUS",
+    "INTERSECT", "EXCEPT", "MINUS", "DATABASE", "DATABASES", "USE",
+    "INSERT", "INTO", "OVERWRITE",
 }
 
 
@@ -382,6 +383,45 @@ class SetCommand(Command):
         self.key, self.value = key, value
 
 
+class CreateDatabaseCommand(Command):
+    def __init__(self, name: str, if_not_exists: bool):
+        self.name, self.if_not_exists = name, if_not_exists
+
+
+class DropDatabaseCommand(Command):
+    def __init__(self, name: str, if_exists: bool):
+        self.name, self.if_exists = name, if_exists
+
+
+class UseDatabaseCommand(Command):
+    def __init__(self, name: str):
+        self.name = name
+
+
+class ShowDatabasesCommand(Command):
+    pass
+
+
+class CreateTableCommand(Command):
+    def __init__(self, name: str, fmt: str, query, columns,
+                 if_not_exists: bool):
+        self.name, self.fmt = name, fmt
+        self.query = query          # CTAS body or None
+        self.columns = columns      # [(name, typename)] or None
+        self.if_not_exists = if_not_exists
+        self.replace = False
+
+
+class DropTableCommand(Command):
+    def __init__(self, name: str, if_exists: bool):
+        self.name, self.if_exists = name, if_exists
+
+
+class InsertIntoCommand(Command):
+    def __init__(self, name: str, query, overwrite: bool):
+        self.name, self.query, self.overwrite = name, query, overwrite
+
+
 class ExplainCommand(Command):
     def __init__(self, query: LogicalPlan, extended: bool):
         self.query, self.extended = query, extended
@@ -453,11 +493,18 @@ class Parser:
     # -- statements -------------------------------------------------------
     def parse_statement(self):
         if self.at_kw("CREATE"):
-            return self._create_view()
+            return self._create()
         if self.at_kw("DROP"):
-            return self._drop_view()
+            return self._drop()
+        if self.at_kw("USE"):
+            self.next()
+            return UseDatabaseCommand(self.ident())
+        if self.at_kw("INSERT"):
+            return self._insert()
         if self.at_kw("SHOW"):
             self.next()
+            if self.accept_kw("DATABASES"):
+                return ShowDatabasesCommand()
             self.expect_kw("TABLES")
             return ShowTablesCommand()
         if self.at_kw("DESCRIBE"):
@@ -484,14 +531,25 @@ class Parser:
             raise ParseException(
                 f"unexpected trailing input at position {t.pos}: {t.value!r}")
 
-    def _create_view(self):
+    def _create(self):
         self.expect_kw("CREATE")
         replace = False
         if self.accept_kw("OR"):
             self.expect_kw("REPLACE")
             replace = True
+        if self.accept_kw("DATABASE"):
+            if replace:
+                raise ParseException(
+                    "OR REPLACE is not supported for CREATE DATABASE")
+            ine = self._if_not_exists()
+            cmd = CreateDatabaseCommand(self.ident(), ine)
+            self._expect_eof()
+            return cmd
+        if self.accept_kw("TABLE"):
+            return self._create_table(replace)
         if not (self.accept_kw("TEMP") or self.accept_kw("TEMPORARY")):
-            raise ParseException("only CREATE [OR REPLACE] TEMP VIEW is supported")
+            raise ParseException(
+                "expected TEMP VIEW, TABLE, or DATABASE after CREATE")
         self.expect_kw("VIEW")
         name = self.ident()
         self.expect_kw("AS")
@@ -499,8 +557,80 @@ class Parser:
         self._expect_eof()
         return CreateViewCommand(name, query, replace)
 
-    def _drop_view(self):
+    def _if_not_exists(self) -> bool:
+        if self.accept_kw("IF"):
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def _qualified_name(self) -> str:
+        name = self.ident()
+        while self.accept_op("."):
+            name += "." + self.ident()
+        return name
+
+    def _create_table(self, replace: bool = False):
+        # CREATE [OR REPLACE] TABLE [IF NOT EXISTS] name [(col type, ...)]
+        #   [USING fmt] [AS query]
+        ine = self._if_not_exists()
+        name = self._qualified_name()
+        columns = None
+        if self.at_op("("):
+            self.next()
+            columns = []
+            while True:
+                cname = self.ident()
+                tname = self.ident()
+                if self.at_op("("):     # decimal(p,s)
+                    self.next()
+                    args = [self.next().value]
+                    while self.accept_op(","):
+                        args.append(self.next().value)
+                    self.expect_op(")")
+                    tname = f"{tname}({','.join(args)})"
+                columns.append((cname, tname))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        fmt = "parquet"
+        if self.accept_kw("USING"):
+            fmt = self.ident()
+        query = None
+        if self.accept_kw("AS"):
+            query = self.parse_query()
+        self._expect_eof()
+        if query is None and columns is None:
+            raise ParseException(
+                "CREATE TABLE needs a column list or AS <query>")
+        cmd = CreateTableCommand(name, fmt, query, columns, ine)
+        cmd.replace = replace
+        return cmd
+
+    def _insert(self):
+        self.expect_kw("INSERT")
+        overwrite = False
+        if self.accept_kw("OVERWRITE"):
+            overwrite = True
+            self.accept_kw("TABLE")
+        else:
+            self.expect_kw("INTO")
+            self.accept_kw("TABLE")
+        name = self._qualified_name()
+        query = self.parse_query()
+        self._expect_eof()
+        return InsertIntoCommand(name, query, overwrite)
+
+    def _drop(self):
         self.expect_kw("DROP")
+        if self.accept_kw("DATABASE"):
+            if_exists = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                if_exists = True
+            cmd = DropDatabaseCommand(self.ident(), if_exists)
+            self._expect_eof()
+            return cmd
         kind = "view" if self.accept_kw("VIEW") else "table"
         if kind == "table":
             self.expect_kw("TABLE")
@@ -508,8 +638,10 @@ class Parser:
         if self.accept_kw("IF"):
             self.expect_kw("EXISTS")
             if_exists = True
-        name = self.ident()
+        name = self._qualified_name()
         self._expect_eof()
+        if kind == "table":
+            return DropTableCommand(name, if_exists)
         return DropViewCommand(name, if_exists, kind)
 
     # -- queries ----------------------------------------------------------
@@ -1076,6 +1208,15 @@ class Parser:
         out: Optional[Expression] = None
         if lname == "count":
             out = _count(args, distinct)
+        elif lname == "approx_count_distinct":
+            # served exactly through the two-level distinct expansion (the
+            # approximation CONTRACT permits exact answers; an HLL sketch
+            # lane is a future optimization, `ApproximatePercentile.scala`
+            # family).  The optional rsd argument parses and is ignored.
+            if len(args) not in (1, 2):
+                raise ParseException(
+                    "approx_count_distinct expects (col[, rsd])")
+            out = A.CountDistinct(args[0])
         elif lname in ("sum",) and distinct:
             out = A.SumDistinct(_one(args, "sum"))
         elif lname in AGG_FUNCTIONS:

@@ -44,11 +44,17 @@ class ExecContext:
         self.flags: List[Array] = []
         self.flag_kinds: List[str] = []
         self.flag_caps: List[int] = []
+        # per-operator metrics (SQLMetrics.scala:34 analog): traced row
+        # counts keyed by (op_id, label), fetched with the result
+        self.metrics: List[Tuple[int, str, Array]] = []
 
     def add_flag(self, value: Array, kind: str, cap: int) -> None:
         self.flags.append(value)
         self.flag_kinds.append(kind)
         self.flag_caps.append(cap)
+
+    def add_metric(self, op_id: int, label: str, value: Array) -> None:
+        self.metrics.append((op_id, label, value))
 
 
 class PhysicalPlan:
@@ -89,6 +95,33 @@ class PhysicalPlan:
 
     def __repr__(self):  # pragma: no cover
         return type(self).__name__
+
+
+class PMetric(PhysicalPlan):
+    """Transparent wrapper recording the child's output row count
+    (`SQLMetrics` numOutputRows); inserted by the planner when
+    spark.sql.metrics.enabled is on."""
+
+    def __init__(self, child: PhysicalPlan):
+        self.children = (child,)
+
+    @property
+    def label(self) -> str:
+        return repr(self.children[0]).split("(")[0].split(" ")[0]
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def run(self, ctx: ExecContext) -> ColumnBatch:
+        out = self.children[0].run(ctx)
+        ctx.add_metric(self.children[0].op_id, self.label, out.num_rows())
+        return out
+
+    def key(self):
+        return f"M({self.children[0].key()})"
+
+    def __repr__(self):
+        return "Metric"
 
 
 class PScan(PhysicalPlan):

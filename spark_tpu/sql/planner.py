@@ -99,7 +99,13 @@ class Planner:
         leaves: List[ColumnBatch] = []
         phys = self._to_physical(logical, leaves)
         self._assign_op_ids(phys, [1])
+        if self.session.conf.get(C.METRICS_ENABLED):
+            phys = self._wrap_metrics(phys)
         return PlannedQuery(phys, leaves)
+
+    def _wrap_metrics(self, node: P.PhysicalPlan) -> P.PhysicalPlan:
+        node.children = tuple(self._wrap_metrics(c) for c in node.children)
+        return P.PMetric(node)
 
     def _assign_op_ids(self, node: P.PhysicalPlan, counter: List[int]) -> None:
         node.op_id = counter[0]
@@ -165,6 +171,9 @@ class QueryExecution:
         self._analyzed: Optional[LogicalPlan] = None
         self._optimized: Optional[LogicalPlan] = None
         self._planned: Optional[PlannedQuery] = None
+        #: per-operator metrics of the last execution:
+        #: {(op_id, operator label): output row count}
+        self.metrics: Dict[Tuple[int, str], int] = {}
 
     @property
     def analyzed(self) -> LogicalPlan:
@@ -196,6 +205,28 @@ class QueryExecution:
         output buffer) triggers an automatic replan with a factor sized
         from the MEASURED overflow, instead of erroring — the dynamic-shape
         answer to ExchangeCoordinator-style adaptation."""
+        import time as _time
+        t0 = _time.time()
+        self.session._post_event({
+            "event": "SQLExecutionStart", "time": t0,
+            "plan": repr(self.optimized)[:500]})
+        try:
+            result = self._execute_inner()
+        except BaseException as e:
+            self.session._post_event({
+                "event": "SQLExecutionEnd", "time": _time.time(),
+                "durationMs": (_time.time() - t0) * 1000,
+                "error": f"{type(e).__name__}: {e}"[:300]})
+            raise
+        self.session._post_event({
+            "event": "SQLExecutionEnd", "time": _time.time(),
+            "durationMs": (_time.time() - t0) * 1000,
+            "metrics": {f"{oid}:{lbl}": v
+                        for (oid, lbl), v in self.metrics.items()}})
+        return result
+
+    def _execute_inner(self) -> ColumnBatch:
+        self.session._last_qe = self      # metrics/explain introspection
         n_shards = self.session.conf.get(C.MESH_SHARDS)
         if n_shards == 0:
             n_shards = len(jax.devices())
@@ -258,6 +289,8 @@ class QueryExecution:
             out = pq.physical.run(ctx)
             ratio = _overflow_ratio(
                 [int(f) for f in ctx.flags], ctx.flag_caps)
+            self.metrics = {(oid, lbl): int(v)
+                            for oid, lbl, v in ctx.metrics}
             return compact(np, out.to_host()), ratio
 
         cached = self.session._jit_cache.get(pq.physical.key())
@@ -271,19 +304,25 @@ class QueryExecution:
                 c = compact(jnp, out)
                 # host-side capture at trace time, KEYED BY INPUT SHAPE:
                 # different leaf capacities retrace and may produce
-                # different static flag capacities
+                # different static flag capacities / metric keys
                 shape_key = tuple(b.capacity for b in leaves)
-                meta[shape_key] = list(ctx.flag_caps)
-                return c, c.num_rows(), ctx.flags
+                meta[shape_key] = (list(ctx.flag_caps),
+                                   [(oid, lbl)
+                                    for oid, lbl, _v in ctx.metrics])
+                return c, c.num_rows(), ctx.flags, \
+                    [v for _o, _l, v in ctx.metrics]
 
             cached = (jax.jit(run), meta)
             self.session._jit_cache[pq.physical.key()] = cached
         fn, meta = cached
         dev_leaves = tuple(b.to_device() for b in pq.leaves)
-        result, n_rows, flags = fn(dev_leaves)
+        result, n_rows, flags, metric_vals = fn(dev_leaves)
         shape_key = tuple(b.capacity for b in pq.leaves)
+        flag_caps, metric_keys = meta.get(shape_key, ([], []))
         ratio = _overflow_ratio([int(np.asarray(f)) for f in flags],
-                                meta.get(shape_key, []))
+                                flag_caps)
+        self.metrics = {k: int(np.asarray(v))
+                        for k, v in zip(metric_keys, metric_vals)}
         return _slice_to_host(result, int(np.asarray(n_rows))), ratio
 
     def explain_string(self) -> str:

@@ -19,13 +19,37 @@ from . import logical as L
 from .dataframe import DataFrame
 
 
-class Catalog:
-    """Temp-view + function registry (slim ``SessionCatalog``)."""
+class _ListenerManager:
+    """Query-event fan-out (`LiveListenerBus` in miniature): listeners are
+    callables receiving event dicts; failures are swallowed."""
 
     def __init__(self):
+        self._listeners: List[Any] = []
+
+    def register(self, fn) -> None:
+        self._listeners.append(fn)
+
+    def unregister(self, fn) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
+
+
+class Catalog:
+    """Temp views + functions + PERSISTENT databases/tables
+    (``SessionCatalog`` + ``InMemoryCatalog``): the filesystem IS the
+    external catalog — ``<warehouse>/<db>.db/<table>/`` holds the data
+    files plus a ``_meta.json`` (format/schema/options), so there is no
+    separate metastore process to run or corrupt."""
+
+    def __init__(self, session=None):
+        self._session = session
         self._views: Dict[str, L.LogicalPlan] = {}
         self._functions: Dict[str, Any] = {}
+        self.current_database = "default"
 
+    # -- functions ---------------------------------------------------------
     def register_function(self, name: str, wrapper) -> None:
         self._functions[name.lower()] = wrapper
 
@@ -35,22 +59,168 @@ class Catalog:
     def listFunctions(self) -> List[str]:
         return sorted(self._functions)
 
+    # -- temp views ----------------------------------------------------------
     def register(self, name: str, plan: L.LogicalPlan) -> None:
         self._views[name.lower()] = plan
-
-    def lookup(self, name: str) -> L.LogicalPlan:
-        key = name.lower()
-        if key not in self._views:
-            raise AnalysisException(f"Table or view not found: {name}")
-        return self._views[key]
 
     def drop(self, name: str) -> bool:
         return self._views.pop(name.lower(), None) is not None
 
-    def listTables(self) -> List[str]:
-        return sorted(self._views)
-
     dropTempView = drop
+
+    # -- persistent layer ---------------------------------------------------
+    def _warehouse(self) -> str:
+        if self._session is not None:
+            return self._session.conf.get(C.WAREHOUSE_DIR)
+        return C.WAREHOUSE_DIR.default
+
+    def _db_dir(self, db: str) -> str:
+        import os
+        wh = self._warehouse()
+        return wh if db == "default" else os.path.join(wh, f"{db}.db")
+
+    def _split(self, name: str):
+        parts = name.split(".")
+        if len(parts) == 2:
+            return parts[0].lower(), parts[1].lower()
+        return self.current_database, parts[0].lower()
+
+    def table_path(self, name: str) -> str:
+        import os
+        db, tbl = self._split(name)
+        return os.path.join(self._db_dir(db), tbl)
+
+    def create_database(self, name: str, if_not_exists: bool = False) -> None:
+        import os
+        if name.lower() == "default":
+            if if_not_exists:
+                return
+            raise AnalysisException("database default already exists")
+        d = self._db_dir(name.lower())
+        if os.path.isdir(d):
+            if if_not_exists:
+                return
+            raise AnalysisException(f"database {name} already exists")
+        os.makedirs(d, exist_ok=True)
+
+    def drop_database(self, name: str, if_exists: bool = False) -> None:
+        import os
+        import shutil
+        if name.lower() == "default":
+            raise AnalysisException("cannot drop the default database")
+        d = self._db_dir(name.lower())
+        if not os.path.isdir(d):
+            if if_exists:
+                return
+            raise AnalysisException(f"database not found: {name}")
+        shutil.rmtree(d)
+
+    def list_databases(self) -> List[str]:
+        import os
+        wh = self._warehouse()
+        out = ["default"]
+        if os.path.isdir(wh):
+            out += sorted(f[:-3] for f in os.listdir(wh)
+                          if f.endswith(".db")
+                          and os.path.isdir(os.path.join(wh, f)))
+        return out
+
+    listDatabases = list_databases
+
+    def setCurrentDatabase(self, name: str) -> None:
+        if name.lower() not in self.list_databases():
+            raise AnalysisException(f"database not found: {name}")
+        self.current_database = name.lower()
+
+    def save_table(self, name: str, df, fmt: str = "parquet",
+                   mode: str = "error", options: Optional[dict] = None,
+                   partition_by: Optional[List[str]] = None) -> None:
+        """CTAS / saveAsTable: write data files + _meta.json."""
+        import json
+        import os
+        path = self.table_path(name)
+        from ..io import DataFrameWriter
+        w = DataFrameWriter(df).format(fmt).mode(mode)
+        if partition_by:
+            w = w.partitionBy(*partition_by)
+        for k, v in (options or {}).items():
+            w = w.option(k, v)
+        w.save(path)
+        meta = {"format": fmt, "options": options or {},
+                "schema": [[f.name, f.dataType.simpleString()]
+                           for f in df.schema.fields]}
+        with open(os.path.join(path, "_meta.json"), "w") as f:
+            json.dump(meta, f)
+
+    def create_empty_table(self, name: str, schema: T.StructType,
+                           fmt: str = "parquet") -> None:
+        import json
+        import os
+        path = self.table_path(name)
+        if os.path.isdir(path):
+            raise AnalysisException(f"table {name} already exists")
+        os.makedirs(path)
+        meta = {"format": fmt, "options": {},
+                "schema": [[f.name, f.dataType.simpleString()]
+                           for f in schema.fields]}
+        with open(os.path.join(path, "_meta.json"), "w") as f:
+            json.dump(meta, f)
+
+    def drop_table(self, name: str, if_exists: bool = False) -> None:
+        import os
+        import shutil
+        path = self.table_path(name)
+        if not os.path.isdir(path):
+            if if_exists:
+                return
+            raise AnalysisException(f"table not found: {name}")
+        shutil.rmtree(path)
+
+    def _persistent_plan(self, name: str) -> Optional[L.LogicalPlan]:
+        import glob as _glob
+        import json
+        import os
+        path = self.table_path(name)
+        meta_p = os.path.join(path, "_meta.json")
+        if not os.path.isfile(meta_p):
+            return None
+        with open(meta_p) as f:
+            meta = json.load(f)
+        schema = T.StructType([
+            T.StructField(n, T.type_for_name(t)) for n, t in meta["schema"]])
+        pats = {"parquet": "*.parquet", "csv": "*.csv", "json": "*.json",
+                "text": "*.txt"}
+        fmt = meta["format"]
+        has_data = _glob.glob(os.path.join(
+            path, "**", pats.get(fmt, "*"), ), recursive=True)
+        has_data = [p for p in has_data if not os.path.basename(p).startswith(
+            ("_", "."))]
+        if not has_data:
+            return L.LocalRelation(ColumnBatch.empty(schema))
+        return L.FileRelation(fmt, [path], schema,
+                              dict(meta.get("options") or {}))
+
+    # -- unified lookup -----------------------------------------------------
+    def lookup(self, name: str) -> L.LogicalPlan:
+        key = name.lower()
+        if key in self._views:
+            return self._views[key]
+        plan = self._persistent_plan(name)
+        if plan is not None:
+            return plan
+        raise AnalysisException(f"Table or view not found: {name}")
+
+    def list_persistent_tables(self, db: Optional[str] = None) -> List[str]:
+        import os
+        d = self._db_dir((db or self.current_database).lower())
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            t for t in os.listdir(d)
+            if os.path.isfile(os.path.join(d, t, "_meta.json")))
+
+    def listTables(self) -> List[str]:
+        return sorted(set(self._views) | set(self.list_persistent_tables()))
 
 
 class RuntimeConfig:
@@ -107,7 +277,9 @@ class SparkSession:
     def __init__(self, conf: Optional[C.Conf] = None):
         self.conf_obj = conf or C.Conf()
         self.conf = self.conf_obj  # Conf has get/set directly
-        self.catalog = Catalog()
+        self.catalog = Catalog(self)
+        self._listener_manager = _ListenerManager()
+        self._last_qe = None              # most recent QueryExecution
         self._jit_cache: Dict[str, Any] = {}
         # learned capacity factors from adaptive overflow retries, keyed by
         # the pre-adaptation plan key — later executions of the same query
@@ -120,6 +292,26 @@ class SparkSession:
         """`spark.udf.register(name, fn, returnType)` (UDFRegistration)."""
         from .udf import UDFRegistration
         return UDFRegistration(self)
+
+    # -- observability (LiveListenerBus + EventLoggingListener analogs) ---
+    @property
+    def listenerManager(self):
+        return self._listener_manager
+
+    def _post_event(self, event: Dict[str, Any]) -> None:
+        for fn in list(self._listener_manager._listeners):
+            try:
+                fn(event)
+            except Exception:
+                pass                       # listeners never fail the query
+        log_dir = self.conf.get(C.EVENT_LOG_DIR)
+        if log_dir:
+            import json
+            import os
+            os.makedirs(log_dir, exist_ok=True)
+            path = os.path.join(log_dir, "eventlog.jsonl")
+            with open(path, "a") as f:
+                f.write(json.dumps(event, default=str) + "\n")
 
     @classmethod
     def getActiveSession(cls) -> Optional["SparkSession"]:
@@ -217,8 +409,9 @@ class SparkSession:
                 self, L.LocalRelation(ColumnBatch.from_arrays(cols, schema=struct)))
 
         if isinstance(cmd, P.CreateViewCommand):
-            if not cmd.replace and cmd.name.lower() in {
-                    t.lower() for t in self.catalog.listTables()}:
+            # conflict-check TEMP VIEWS only: a temp view may shadow a
+            # persistent table of the same name
+            if not cmd.replace and cmd.name.lower() in self.catalog._views:
                 raise AnalysisException(f"temp view {cmd.name} already exists")
             self.catalog.register(cmd.name, cmd.query)
             return string_df({})
@@ -227,10 +420,85 @@ class SparkSession:
             if not found and not cmd.if_exists:
                 raise AnalysisException(f"view not found: {cmd.name}")
             return string_df({})
+        if isinstance(cmd, P.DropTableCommand):
+            # a temp view may shadow a table of the same name (Spark drops
+            # the view first)
+            if self.catalog.drop(cmd.name):
+                return string_df({})
+            self.catalog.drop_table(cmd.name, cmd.if_exists)
+            return string_df({})
+        if isinstance(cmd, P.CreateDatabaseCommand):
+            self.catalog.create_database(cmd.name, cmd.if_not_exists)
+            return string_df({})
+        if isinstance(cmd, P.DropDatabaseCommand):
+            self.catalog.drop_database(cmd.name, cmd.if_exists)
+            return string_df({})
+        if isinstance(cmd, P.UseDatabaseCommand):
+            self.catalog.setCurrentDatabase(cmd.name)
+            return string_df({})
+        if isinstance(cmd, P.ShowDatabasesCommand):
+            return string_df({"namespace": self.catalog.list_databases()})
+        if isinstance(cmd, P.CreateTableCommand):
+            import os
+            exists = os.path.isdir(self.catalog.table_path(cmd.name))
+            if exists:
+                if cmd.if_not_exists:
+                    return string_df({})
+                if cmd.replace:
+                    self.catalog.drop_table(cmd.name)
+                else:
+                    raise AnalysisException(
+                        f"table {cmd.name} already exists")
+            if cmd.query is not None:
+                df = DataFrame(self, cmd.query)
+                self.catalog.save_table(cmd.name, df, cmd.fmt)
+            else:
+                schema = T.StructType([
+                    T.StructField(n, T.type_for_name(t))
+                    for n, t in cmd.columns])
+                self.catalog.create_empty_table(cmd.name, schema, cmd.fmt)
+            return string_df({})
+        if isinstance(cmd, P.InsertIntoCommand):
+            import json
+            import os
+            path = self.catalog.table_path(cmd.name)
+            meta_p = os.path.join(path, "_meta.json")
+            if not os.path.isfile(meta_p):
+                raise AnalysisException(f"table not found: {cmd.name}")
+            with open(meta_p) as f:
+                meta = json.load(f)
+            # MATERIALIZE the query before touching the table directory:
+            # INSERT OVERWRITE t SELECT ... FROM t must read the old data,
+            # and a failing query must not destroy it.  Inserts bind by
+            # POSITION against the table schema (Spark semantics), so
+            # validate arity and rename.
+            src = DataFrame(self, cmd.query)
+            table_schema = [n for n, _t in meta["schema"]]
+            if len(src.schema.names) != len(table_schema):
+                raise AnalysisException(
+                    f"INSERT into {cmd.name}: query produces "
+                    f"{len(src.schema.names)} columns, table has "
+                    f"{len(table_schema)}")
+            batch = src._execute()
+            batch = ColumnBatch(list(table_schema), batch.vectors,
+                                batch.row_valid, batch.capacity)
+            materialized = DataFrame(self, L.LocalRelation(batch))
+            from ..io import DataFrameWriter
+            mode = "overwrite" if cmd.overwrite else "append"
+            DataFrameWriter(materialized).format(meta["format"]) \
+                .mode(mode).save(path)
+            if cmd.overwrite:
+                # overwrite clears the dir, including the metadata: rewrite
+                with open(meta_p, "w") as f:
+                    json.dump(meta, f)
+            return string_df({})
         if isinstance(cmd, P.ShowTablesCommand):
+            persistent = set(self.catalog.list_persistent_tables())
             names = self.catalog.listTables()
-            return string_df({"tableName": names,
-                              "isTemporary": ["true"] * len(names)})
+            return string_df({
+                "tableName": names,
+                "isTemporary": ["false" if n in persistent else "true"
+                                for n in names]})
         if isinstance(cmd, P.DescribeCommand):
             schema = DataFrame(self, self.catalog.lookup(cmd.name)).schema
             return string_df({

@@ -1,0 +1,128 @@
+"""Persistent catalog: databases, CREATE TABLE USING / CTAS / INSERT,
+saveAsTable, filesystem-backed metadata (SessionCatalog + InMemoryCatalog
+analogs)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_tpu.config as C
+from spark_tpu.expressions import AnalysisException
+
+
+@pytest.fixture()
+def wh(spark, tmp_path):
+    old = spark.conf.get(C.WAREHOUSE_DIR)
+    spark.conf.set(C.WAREHOUSE_DIR.key, str(tmp_path / "wh"))
+    yield spark
+    spark.catalog.current_database = "default"
+    spark.conf.set(C.WAREHOUSE_DIR.key, old)
+
+
+def rows(df):
+    return sorted(tuple(r) for r in df.collect())
+
+
+def test_ctas_roundtrip(wh):
+    wh.range(6).createOrReplaceTempView("src")
+    wh.sql("CREATE TABLE t1 USING parquet AS SELECT id, id * 2 AS d FROM src")
+    assert rows(wh.sql("SELECT * FROM t1")) == [(i, 2 * i) for i in range(6)]
+    # survives in a fresh session sharing the warehouse
+    from spark_tpu.sql.session import SparkSession
+    s2 = SparkSession.builder.getOrCreate()
+    # (builder may return the same session; simulate cold catalog instead)
+    wh.catalog._views.pop("t1", None)
+    assert rows(wh.sql("SELECT d FROM t1")) == [(2 * i,) for i in range(6)]
+    wh.sql("DROP TABLE t1")
+    with pytest.raises(AnalysisException):
+        wh.sql("SELECT * FROM t1").collect()
+
+
+def test_databases(wh):
+    wh.sql("CREATE DATABASE db1")
+    assert "db1" in wh.catalog.list_databases()
+    wh.range(3).createOrReplaceTempView("src")
+    wh.sql("CREATE TABLE db1.t USING parquet AS SELECT id FROM src")
+    assert rows(wh.sql("SELECT * FROM db1.t")) == [(0,), (1,), (2,)]
+    wh.sql("USE db1")
+    assert rows(wh.sql("SELECT * FROM t")) == [(0,), (1,), (2,)]
+    wh.sql("USE default")
+    wh.sql("DROP DATABASE db1")
+    assert "db1" not in wh.catalog.list_databases()
+    with pytest.raises(AnalysisException):
+        wh.sql("CREATE DATABASE default")
+    wh.sql("CREATE DATABASE IF NOT EXISTS default")
+
+
+def test_empty_table_then_insert(wh):
+    wh.sql("CREATE TABLE et (a bigint, b string) USING parquet")
+    assert rows(wh.sql("SELECT * FROM et")) == []
+    wh.range(3).createOrReplaceTempView("src3")
+    wh.sql("INSERT INTO et SELECT id AS a, 'x' AS b FROM src3")
+    assert rows(wh.sql("SELECT * FROM et")) == [
+        (0, "x"), (1, "x"), (2, "x")]
+    wh.sql("INSERT INTO et SELECT id AS a, 'y' AS b FROM src3")
+    assert len(rows(wh.sql("SELECT * FROM et"))) == 6
+    wh.sql("INSERT OVERWRITE et SELECT id AS a, 'z' AS b FROM src3")
+    assert rows(wh.sql("SELECT b FROM et")) == [("z",)] * 3
+    wh.sql("DROP TABLE et")
+
+
+def test_save_as_table_and_show(wh):
+    df = wh.createDataFrame(pd.DataFrame({
+        "k": np.arange(4, dtype=np.int64), "v": ["a", "b", "c", "d"]}))
+    df.write.saveAsTable("sat")
+    assert rows(wh.read.table("sat")) == rows(df)
+    shown = {tuple(r) for r in wh.sql("SHOW TABLES").collect()}
+    assert ("sat", "false") in shown
+    with pytest.raises(AnalysisException):
+        df.write.saveAsTable("sat")          # errorifexists default
+    df.write.mode("overwrite").saveAsTable("sat")
+    wh.sql("DROP TABLE sat")
+
+
+def test_insert_overwrite_self_reference(wh):
+    """INSERT OVERWRITE t SELECT ... FROM t must read before clearing."""
+    wh.range(3).createOrReplaceTempView("srcio")
+    wh.sql("CREATE TABLE io USING parquet AS SELECT id FROM srcio")
+    wh.sql("INSERT OVERWRITE io SELECT id + 10 FROM io")
+    assert rows(wh.sql("SELECT * FROM io")) == [(10,), (11,), (12,)]
+    # a failing overwrite query leaves the table intact
+    with pytest.raises(AnalysisException):
+        wh.sql("INSERT OVERWRITE io SELECT no_col FROM srcio")
+    assert rows(wh.sql("SELECT * FROM io")) == [(10,), (11,), (12,)]
+    # arity mismatch rejected before any write
+    with pytest.raises(AnalysisException):
+        wh.sql("INSERT INTO io SELECT id, id FROM srcio")
+    wh.sql("DROP TABLE io")
+
+
+def test_create_or_replace_table(wh):
+    wh.range(2).createOrReplaceTempView("srccr")
+    wh.sql("CREATE TABLE cr USING parquet AS SELECT id FROM srccr")
+    wh.sql("CREATE OR REPLACE TABLE cr USING parquet "
+           "AS SELECT id * 5 AS id FROM srccr")
+    assert rows(wh.sql("SELECT * FROM cr")) == [(0,), (5,)]
+    wh.sql("DROP TABLE cr")
+
+
+def test_temp_view_can_shadow_table(wh):
+    wh.range(2).createOrReplaceTempView("srctv")
+    wh.sql("CREATE TABLE tv USING parquet AS SELECT id FROM srctv")
+    wh.sql("CREATE TEMP VIEW tv AS SELECT 42 AS id")   # must not raise
+    assert rows(wh.sql("SELECT * FROM tv")) == [(42,)]
+    wh.catalog.dropTempView("tv")
+    wh.sql("DROP TABLE tv")
+
+
+def test_temp_view_shadows_table(wh):
+    wh.range(2).createOrReplaceTempView("src")
+    wh.sql("CREATE TABLE sh USING parquet AS SELECT id FROM src")
+    wh.createDataFrame(pd.DataFrame({"id": [99]})) \
+        .createOrReplaceTempView("sh")
+    assert rows(wh.sql("SELECT * FROM sh")) == [(99,)]
+    wh.sql("DROP TABLE sh")                  # drops the VIEW first
+    assert rows(wh.sql("SELECT * FROM sh")) == [(0,), (1,)]
+    wh.sql("DROP TABLE sh")

@@ -1,0 +1,110 @@
+"""Native C++ kernels (common/sketch + external-merge analogs): the
+compiled lane must exist on this image and agree bit-exactly with the
+numpy fallback lane."""
+
+import numpy as np
+import pytest
+
+from spark_tpu.native import (
+    BloomFilter, CountMinSketch, merge_sorted_runs, native_available,
+)
+from spark_tpu.native.build import load_library
+from spark_tpu.native.sketch import murmur3_hash_long
+
+
+def test_native_lane_builds():
+    assert native_available()
+
+
+def test_murmur_native_matches_numpy():
+    lib = load_library()
+    rng = np.random.default_rng(1)
+    xs = rng.integers(-2**62, 2**62, 500)
+    for seed in (0, 42, -7):
+        np_h = murmur3_hash_long(xs, seed)
+        c_h = np.array([lib.murmur3_hash_long(int(x), seed) for x in xs])
+        np.testing.assert_array_equal(np_h, c_h)
+
+
+def test_bloom_no_false_negatives_and_low_fp():
+    rng = np.random.default_rng(2)
+    bf = BloomFilter.create(20000, 0.01)
+    items = rng.integers(0, 10**15, 10000)
+    bf.put_long(items)
+    assert bf.might_contain_long(items).all()
+    absent = rng.integers(10**16, 10**17, 20000)
+    assert bf.might_contain_long(absent).mean() < 0.03
+
+
+def test_bloom_native_matches_numpy(monkeypatch):
+    rng = np.random.default_rng(3)
+    items = rng.integers(0, 10**12, 2000)
+    probes = rng.integers(0, 10**12, 4000)
+    bf_native = BloomFilter.create(2000, 0.05)
+    bf_native.put_long(items)
+    import spark_tpu.native.build as B
+    monkeypatch.setattr(B, "_lib", None)
+    monkeypatch.setattr(B, "_tried", True)      # force numpy lane
+    bf_np = BloomFilter.create(2000, 0.05)
+    bf_np.put_long(items)
+    np.testing.assert_array_equal(bf_native.bits, bf_np.bits)
+    np.testing.assert_array_equal(bf_native.might_contain_long(probes),
+                                  bf_np.might_contain_long(probes))
+
+
+def test_cms_bounds_and_merge():
+    cms1 = CountMinSketch.create(0.001, 0.99)
+    cms2 = CountMinSketch.create(0.001, 0.99)
+    cms1.add_long(np.repeat(np.arange(50), 10))
+    cms2.add_long(np.repeat(np.arange(50), 5))
+    cms1.merge(cms2)
+    est = cms1.estimate_count(np.arange(50))
+    assert (est >= 15).all()                       # never undercounts
+    assert (est <= 15 + 2 * 0.001 * cms1.total).all()
+
+
+def test_merge_sorted_runs_stable():
+    rng = np.random.default_rng(4)
+    runs = [np.sort(rng.integers(0, 100, rng.integers(1, 80)))
+            for _ in range(7)]
+    perm = merge_sorted_runs(runs)
+    cat = np.concatenate(runs)
+    merged = cat[perm]
+    assert (np.diff(merged) >= 0).all()
+    assert sorted(perm.tolist()) == list(range(len(cat)))
+
+
+def test_multibatch_uses_native_merge(spark, tmp_path):
+    """Integer-key ORDER BY over a multi-batch scan goes through the
+    native run merge and stays exact."""
+    import pandas as pd
+    import spark_tpu.config as C
+    from spark_tpu.sql import functions as F
+    rng = np.random.default_rng(5)
+    pdf = pd.DataFrame({"k": rng.integers(0, 10**9, 3000).astype(np.int64),
+                        "v": rng.normal(size=3000)})
+    p = str(tmp_path / "m.parquet")
+    spark.createDataFrame(pdf).write.parquet(p)
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, "256")
+    try:
+        got = [r[0] for r in
+               spark.read.parquet(p).orderBy("k").select("k").collect()]
+    finally:
+        spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key,
+                       str(C.SCAN_MAX_BATCH_ROWS.default))
+    assert got == sorted(pdf.k.tolist())
+
+
+def test_approx_count_distinct(spark):
+    import pandas as pd
+    from spark_tpu.sql import functions as F
+    df = spark.createDataFrame(pd.DataFrame({
+        "g": ["a", "a", "b", "b", "b"], "v": [1, 2, 1, 1, 3]}))
+    got = sorted(tuple(r) for r in df.groupBy("g").agg(
+        F.approx_count_distinct("v").alias("d")).collect())
+    assert got == [("a", 2), ("b", 2)]
+    df.createOrReplaceTempView("acd_t")
+    got2 = spark.sql(
+        "SELECT approx_count_distinct(v) AS d FROM acd_t").collect()
+    assert got2[0][0] == 3
+    spark.catalog.dropTempView("acd_t")

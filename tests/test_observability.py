@@ -1,0 +1,84 @@
+"""Per-operator SQL metrics + listener bus + event log
+(SQLMetrics.scala:34 / LiveListenerBus / EventLoggingListener analogs)."""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_tpu.config as C
+from spark_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def mdf(spark):
+    return spark.createDataFrame(pd.DataFrame({
+        "k": np.arange(100, dtype=np.int64) % 7,
+        "v": np.arange(100, dtype=np.float64)}))
+
+
+def test_operator_metrics(spark, mdf):
+    spark.conf.set(C.METRICS_ENABLED.key, "true")
+    try:
+        mdf.filter(F.col("v") < 50).groupBy("k").agg(
+            F.sum("v").alias("s")).collect()
+        m = spark._last_qe.metrics
+    finally:
+        spark.conf.set(C.METRICS_ENABLED.key, "false")
+    by_label = {}
+    for (oid, label), v in m.items():
+        by_label.setdefault(label, []).append(v)
+    assert by_label["Filter"] == [50]
+    assert by_label["Aggregate"] == [7]
+    assert "Scan[0]" in by_label or any(
+        lbl.startswith("Scan") for lbl in by_label)
+
+
+def test_metrics_interpreted_lane(spark, mdf):
+    spark.conf.set(C.METRICS_ENABLED.key, "true")
+    spark.conf.set(C.CODEGEN_ENABLED.key, "false")
+    try:
+        mdf.filter(F.col("v") < 10).collect()
+        m = spark._last_qe.metrics
+    finally:
+        spark.conf.set(C.CODEGEN_ENABLED.key, "true")
+        spark.conf.set(C.METRICS_ENABLED.key, "false")
+    assert any(lbl == "Filter" and v == 10 for (_o, lbl), v in m.items())
+
+
+def test_listener_bus(spark, mdf):
+    events = []
+    spark.listenerManager.register(events.append)
+    try:
+        mdf.count()
+    finally:
+        spark.listenerManager.unregister(events.append)
+    kinds = [e["event"] for e in events]
+    assert "SQLExecutionStart" in kinds and "SQLExecutionEnd" in kinds
+    end = [e for e in events if e["event"] == "SQLExecutionEnd"][-1]
+    assert end["durationMs"] >= 0
+
+
+def test_listener_failure_does_not_break_query(spark, mdf):
+    def bad(_e):
+        raise RuntimeError("boom")
+    spark.listenerManager.register(bad)
+    try:
+        assert mdf.count() == 100
+    finally:
+        spark.listenerManager.unregister(bad)
+
+
+def test_event_log(spark, mdf, tmp_path):
+    d = str(tmp_path / "evlog")
+    spark.conf.set(C.EVENT_LOG_DIR.key, d)
+    try:
+        mdf.filter(F.col("v") > 90).count()
+    finally:
+        spark.conf.set(C.EVENT_LOG_DIR.key, "")
+    lines = [json.loads(x) for x in
+             open(os.path.join(d, "eventlog.jsonl"))]
+    assert any(e["event"] == "SQLExecutionStart" for e in lines)
+    assert any(e["event"] == "SQLExecutionEnd" for e in lines)
