@@ -53,9 +53,17 @@ J_DIM = 2048         # q3-shape: dimension rows (broadcast side)
 J_BRANDS = 64
 J_ITERS = 10
 
-CHILD_TIMEOUT_S = int(os.environ.get("SPARK_TPU_BENCH_CHILD_TIMEOUT", "600"))
-TPU_ATTEMPTS = int(os.environ.get("SPARK_TPU_BENCH_TPU_ATTEMPTS", "3"))
+#: cold axon compiles of the fused agg/join programs run several minutes
+#: (f64/i64 emulation); the persistent jax compile cache under /tmp makes
+#: warm runs fast, but the timeout must cover a cold one
+CHILD_TIMEOUT_S = int(os.environ.get("SPARK_TPU_BENCH_CHILD_TIMEOUT", "900"))
+TPU_ATTEMPTS = int(os.environ.get("SPARK_TPU_BENCH_TPU_ATTEMPTS", "2"))
 BACKOFFS_S = [20, 60, 120]
+#: a DOWN tunnel makes jax.devices() hang rather than raise; a child-side
+#: watchdog turns that into a fast rc=3 so the orchestrator recycles
+#: instead of burning the whole child timeout
+PREFLIGHT_HANG_S = int(os.environ.get("SPARK_TPU_BENCH_PREFLIGHT_HANG",
+                                      "150"))
 
 
 # ======================================================================
@@ -67,11 +75,16 @@ def _run_child(platform: str | None) -> tuple[int, str, str]:
     # ignores the JAX_PLATFORMS env var, so the platform is passed as an
     # argv flag and applied via jax.config inside the child.
     argv = [sys.executable, os.path.abspath(__file__), "--child"]
+    env = dict(os.environ)
+    # SPARK_TPU_PLATFORM (honored by spark_tpu at import) must not
+    # override the orchestrator's per-attempt platform choice
+    env.pop("SPARK_TPU_PLATFORM", None)
     if platform is not None:
         argv.append(f"--platform={platform}")
+        env["SPARK_TPU_PLATFORM"] = platform
     try:
         proc = subprocess.run(argv, capture_output=True, text=True,
-                              timeout=CHILD_TIMEOUT_S)
+                              timeout=CHILD_TIMEOUT_S, env=env)
         return proc.returncode, proc.stdout, proc.stderr
     except subprocess.TimeoutExpired as e:
         # TimeoutExpired carries bytes even under text=True
@@ -141,24 +154,46 @@ def _slice_batch(batch, cap: int):
 
 
 def _preflight():
-    """Backend init with in-process retry; returns the platform name."""
+    """Backend init with in-process retry; returns the platform name.
+
+    Runs jax.devices() on a watchdog thread: a down tunnel HANGS instead
+    of raising, and the child must fail fast (rc=3) so the orchestrator
+    can back off and retry rather than eat the whole child timeout."""
+    import threading
+
     import jax
     last = None
     for attempt in range(3):
-        try:
-            devs = jax.devices()
+        box: list = []
+
+        def probe():
+            try:
+                box.append(jax.devices())
+            except BaseException as e:      # noqa: BLE001
+                box.append(e)
+
+        th = threading.Thread(target=probe, daemon=True)
+        th.start()
+        th.join(PREFLIGHT_HANG_S)
+        if not box:
+            print("[bench-child] jax.devices() hung "
+                  f"{PREFLIGHT_HANG_S}s: backend tunnel down", file=sys.stderr)
+            os._exit(3)                     # thread may be stuck in C++
+        if not isinstance(box[0], BaseException):
+            devs = box[0]
             print(f"[bench-child] devices: {devs}", file=sys.stderr)
             return devs[0].platform
-        except RuntimeError as e:   # backend setup/compile error
-            last = e
-            print(f"[bench-child] jax.devices() failed "
-                  f"(attempt {attempt + 1}): {e}", file=sys.stderr)
-            if attempt < 2:
-                time.sleep(5 * (attempt + 1))
-                try:
-                    jax.extend.backend.clear_backends()
-                except Exception:
-                    pass
+        if not isinstance(box[0], RuntimeError):
+            raise box[0]    # deterministic (bad platform, etc): no retry
+        last = box[0]
+        print(f"[bench-child] jax.devices() failed "
+              f"(attempt {attempt + 1}): {last}", file=sys.stderr)
+        if attempt < 2:
+            time.sleep(5 * (attempt + 1))
+            try:
+                jax.extend.backend.clear_backends()
+            except Exception:
+                pass
     raise last
 
 
@@ -316,7 +351,9 @@ def child_main() -> None:
         jax.config.update("jax_platforms", forced[0])
         if forced[0] == "cpu":
             # CPU fallback exists to land *a* number when the TPU tunnel is
-            # down; scale the workload so it finishes inside the timeout.
+            # down; scale the workload so it finishes inside the timeout,
+            # and use the sort-based aggregation (the MXU one-hot matmul
+            # kernel is a systolic-array design — pathological on CPU).
             global N, ITERS, J_FACT, J_ITERS
             N, ITERS, J_FACT, J_ITERS = 1 << 19, 5, 1 << 18, 3
 

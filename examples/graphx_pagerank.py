@@ -1,0 +1,14 @@
+"""PageRank over the graphx analog (examples/graphx/PageRankExample)."""
+import numpy as np
+
+from spark_tpu.graphx import Graph, page_rank
+
+rng = np.random.default_rng(1)
+edges = list(zip(rng.integers(0, 50, 300).tolist(),
+                 rng.integers(0, 50, 300).tolist()))
+g = Graph.from_edge_tuples(edges)
+ranks = np.asarray(page_rank(g, num_iter=20))
+top = np.argsort(-ranks)[:5]
+ids = np.asarray(g.vertex_ids)
+for i in top:
+    print(f"vertex {ids[i]}: rank {ranks[i]:.4f}")

@@ -1,0 +1,25 @@
+"""ML pipeline: features -> logistic regression (examples/ml analog)."""
+import numpy as np
+import pandas as pd
+
+from spark_tpu.sql.session import SparkSession
+from spark_tpu.ml.feature import VectorAssembler, StandardScaler
+from spark_tpu.ml.classification import LogisticRegression
+from spark_tpu.ml.base import Pipeline
+
+spark = SparkSession.builder.appName("ml_pipeline").getOrCreate()
+rng = np.random.default_rng(0)
+n = 400
+x1 = rng.normal(size=n)
+x2 = rng.normal(size=n)
+label = (x1 + 2 * x2 + rng.normal(scale=0.3, size=n) > 0).astype(np.float64)
+df = spark.createDataFrame(pd.DataFrame({"x1": x1, "x2": x2, "label": label}))
+pipe = Pipeline(stages=[
+    VectorAssembler(inputCols=["x1", "x2"], outputCol="raw"),
+    StandardScaler(inputCol="raw", outputCol="features"),
+    LogisticRegression(featuresCol="features", labelCol="label"),
+])
+model = pipe.fit(df)
+pred = model.transform(df)
+acc = pred.selectExpr("avg(CASE WHEN prediction = label THEN 1.0 ELSE 0.0 END) AS acc")
+acc.show()

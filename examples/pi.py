@@ -1,0 +1,18 @@
+"""SparkPi analog: Monte-Carlo pi over the RDD API (examples/SparkPi)."""
+import random
+import sys
+
+from spark_tpu.sql.session import SparkSession
+
+spark = SparkSession.builder.appName("PythonPi").getOrCreate()
+sc = spark.sparkContext
+n = 100_000 * (int(sys.argv[1]) if len(sys.argv) > 1 else 2)
+
+
+def inside(_):
+    x, y = random.random(), random.random()
+    return 1 if x * x + y * y <= 1 else 0
+
+
+count = sc.parallelize(range(n)).map(inside).reduce(lambda a, b: a + b)
+print(f"Pi is roughly {4.0 * count / n:.5f}")

@@ -1,0 +1,17 @@
+"""DataFrame + SQL basics (examples/sql/basic.py analog)."""
+import pandas as pd
+
+from spark_tpu.sql.session import SparkSession
+import spark_tpu.sql.functions as F
+
+spark = SparkSession.builder.appName("sql_basic").getOrCreate()
+df = spark.createDataFrame(pd.DataFrame({
+    "name": ["Alice", "Bob", "Cara", "Dan"],
+    "dept": ["eng", "eng", "ops", "ops"],
+    "salary": [110.0, 95.0, 87.0, 99.0]}))
+df.createOrReplaceTempView("people")
+spark.sql("""
+    SELECT dept, COUNT(*) AS n, AVG(salary) AS avg_salary
+    FROM people GROUP BY dept ORDER BY dept
+""").show()
+df.filter(F.col("salary") > 90).select("name", "salary").show()

@@ -1,0 +1,24 @@
+"""Event-time windowed aggregation with a watermark (structured streaming
+examples analog)."""
+from spark_tpu import types as T
+from spark_tpu.sql import functions as F
+from spark_tpu.streaming import MemoryStream
+from spark_tpu.sql.session import SparkSession
+
+spark = SparkSession.builder.appName("stream_window").getOrCreate()
+SEC = 1_000_000
+schema = T.StructType([T.StructField("ts", T.timestamp),
+                       T.StructField("v", T.int64)])
+src = MemoryStream(schema, spark)
+q = (src.toDF(spark)
+     .withWatermark("ts", "5 seconds")
+     .groupBy(F.window("ts", "10 seconds").alias("w"))
+     .agg(F.sum("v").alias("total"))
+     .writeStream.format("memory").queryName("win")
+     .outputMode("append").trigger(once=True).start())
+src.addData([(1 * SEC, 1), (8 * SEC, 2)])
+q.processAllAvailable()
+src.addData([(21 * SEC, 5)])          # watermark passes 10s: first window
+q.processAllAvailable()
+spark.sql("SELECT * FROM win").show()
+q.stop()

@@ -1,0 +1,14 @@
+"""Run a few TPC-DS queries on generated data (TPCDSQueryBenchmark analog)."""
+import time
+
+from spark_tpu.sql.session import SparkSession
+from spark_tpu.tpcds import QUERIES, RUNNABLE, generate
+
+spark = SparkSession.builder.appName("tpcds_demo").getOrCreate()
+for name, pdf in generate(sf_rows=20_000).items():
+    spark.createDataFrame(pdf).createOrReplaceTempView(name)
+for q in ["q3", "q42", "q55"]:
+    t0 = time.time()
+    rows = spark.sql(QUERIES[q]).collect()
+    print(f"{q}: {len(rows)} rows in {time.time() - t0:.2f}s")
+print(f"({len(RUNNABLE)} queries runnable in total)")

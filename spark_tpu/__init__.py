@@ -21,6 +21,14 @@ import jax as _jax
 # f32/bf16 explicitly where it is safe.
 _jax.config.update("jax_enable_x64", True)
 
+# explicit platform override for subprocesses (CLI tests, spill children):
+# some TPU plugin sitecustomizes force jax_platforms and ignore the
+# JAX_PLATFORMS env var, so honor our own knob after import
+import os as _os  # noqa: E402
+_plat = _os.environ.get("SPARK_TPU_PLATFORM")
+if _plat:
+    _jax.config.update("jax_platforms", _plat)
+
 from . import types  # noqa: F401
 from .config import Conf  # noqa: F401
 from .columnar import ColumnBatch, ColumnVector  # noqa: F401

@@ -1,0 +1,121 @@
+"""Command-line entry points (the `bin/` + `launcher/` analog).
+
+The reference ships shell scripts that assemble a JVM command line
+(`bin/spark-submit` -> `launcher/Main.java` -> `SparkSubmit.scala:109`);
+here the driver IS Python, so the launcher collapses to argv dispatch:
+
+    python -m spark_tpu.cli submit app.py [args...]   # spark-submit
+    python -m spark_tpu.cli sql [-e QUERY] [-f FILE]  # spark-sql shell
+    python -m spark_tpu.cli shell                     # pyspark-style REPL
+
+Repo-root `bin/` holds one-line shims for each.
+"""
+
+from __future__ import annotations
+
+import argparse
+import code
+import runpy
+import sys
+from typing import List, Optional
+
+
+def _session(conf_pairs: List[str]):
+    from spark_tpu.sql.session import SparkSession
+    b = SparkSession.builder.appName("spark-tpu-cli")
+    s = b.getOrCreate()
+    for pair in conf_pairs or []:
+        if "=" not in pair:
+            raise SystemExit(f"--conf expects key=value, got {pair!r}")
+        k, v = pair.split("=", 1)
+        s.conf.set(k, v)
+    return s
+
+
+def _show(df) -> None:
+    df.show(100)
+
+
+def cmd_submit(args) -> int:
+    """Run a user script with sys.argv rewritten (SparkSubmit.runMain:
+    the script builds its own session via SparkSession.builder)."""
+    _session(args.conf)     # pre-warm the active session with --conf
+    sys.argv = [args.script] + list(args.script_args)
+    runpy.run_path(args.script, run_name="__main__")
+    return 0
+
+
+def cmd_sql(args) -> int:
+    """spark-sql: execute -e/-f statements or run an interactive loop
+    (`SparkSQLCLIDriver` analog)."""
+    spark = _session(args.conf)
+    if args.e:
+        _show(spark.sql(args.e))
+        return 0
+    if args.f:
+        with open(args.f) as fh:
+            text = fh.read()
+        for stmt in [s.strip() for s in text.split(";") if s.strip()]:
+            _show(spark.sql(stmt))
+        return 0
+    print("spark-tpu-sql interactive shell; end statements with ';', "
+          "exit with 'quit;'")
+    buf: List[str] = []
+    while True:
+        try:
+            line = input("spark-sql> " if not buf else "         > ")
+        except EOFError:
+            break
+        buf.append(line)
+        joined = "\n".join(buf)
+        if joined.rstrip().endswith(";"):
+            stmt = joined.rstrip()[:-1].strip()
+            buf = []
+            if stmt.lower() in ("quit", "exit"):
+                break
+            if not stmt:
+                continue
+            try:
+                _show(spark.sql(stmt))
+            except Exception as e:        # noqa: BLE001 — REPL keeps going
+                print(f"Error: {e}", file=sys.stderr)
+    return 0
+
+
+def cmd_shell(args) -> int:
+    """pyspark-style Python REPL with `spark` and `sc` bound."""
+    spark = _session(args.conf)
+    banner = ("spark_tpu shell\n"
+              "SparkSession available as 'spark', "
+              "SparkContext as 'sc'.")
+    ns = {"spark": spark, "sc": spark.sparkContext}
+    code.interact(banner=banner, local=ns)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="spark_tpu.cli")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("submit", help="run a python app (spark-submit)")
+    ps.add_argument("--conf", action="append", default=[])
+    ps.add_argument("script")
+    ps.add_argument("script_args", nargs=argparse.REMAINDER)
+    ps.set_defaults(fn=cmd_submit)
+
+    pq = sub.add_parser("sql", help="SQL shell (spark-sql)")
+    pq.add_argument("-e", help="execute one statement and exit")
+    pq.add_argument("-f", help="execute statements from a file")
+    pq.add_argument("--conf", action="append", default=[])
+    pq.set_defaults(fn=cmd_sql)
+
+    pr = sub.add_parser("shell", help="python REPL with a session (pyspark)")
+    pr.add_argument("--conf", action="append", default=[])
+    pr.set_defaults(fn=cmd_shell)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

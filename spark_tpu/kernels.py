@@ -194,6 +194,21 @@ def segment_reduce(xp, data: Array, seg_ids: Array, num_segments: int,
 # grouped aggregation (sort-based HashAggregateExec replacement)
 # ---------------------------------------------------------------------------
 
+#: the one-hot-matmul aggregation only wins where a systolic array exists.
+#: None = auto (TPU backends only); tests force True to exercise the MXU
+#: kernel on the virtual CPU mesh.
+MXU_AGG_ENABLED: "bool | None" = None
+
+
+def _mxu_agg_on() -> bool:
+    if MXU_AGG_ENABLED is not None:
+        return MXU_AGG_ENABLED
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
 def grouped_aggregate(
     xp,
     batch: ColumnBatch,
@@ -213,8 +228,8 @@ def grouped_aggregate(
     planes — see ``_mxu_grouped_aggregate``); a runtime ``lax.cond`` falls
     back to the sort-based path otherwise.
     """
-    if not _is_np(xp) and key_exprs and _mxu_applicable(
-            batch.schema, key_exprs, agg_slots):
+    if _mxu_agg_on() and not _is_np(xp) and key_exprs \
+            and _mxu_applicable(batch.schema, key_exprs, agg_slots):
         return _mxu_grouped_aggregate(xp, batch, key_exprs, agg_slots,
                                       bucket_cap)
     return _sorted_grouped_aggregate(xp, batch, key_exprs, agg_slots)

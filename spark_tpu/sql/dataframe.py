@@ -94,6 +94,20 @@ class DataFrame:
                 exprs += [Col(n) for n in self.schema.names]
             else:
                 exprs.append(_to_expr(c))
+        # select with aggregates and no grouping is a global aggregation
+        # (Dataset.select's ungrouped-agg path): df.select(avg(x)) works;
+        # mixing plain columns in raises like the reference does
+        from .analyzer import build_aggregate, contains_aggregate
+        if any(contains_aggregate(e) for e in exprs):
+            for e in exprs:
+                base = e.children[0] if isinstance(e, Alias) else e
+                if not contains_aggregate(e) \
+                        and not isinstance(base, Literal):
+                    raise AnalysisException(
+                        f"expression {e!r} is neither an aggregate nor "
+                        "grouped; add it to groupBy() or aggregate it")
+            return DataFrame(self.session,
+                             build_aggregate([], exprs, self._plan))
         return DataFrame(self.session, L.Project(exprs, self._plan))
 
     def selectExpr(self, *exprs: str) -> "DataFrame":

@@ -31,6 +31,14 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 
+# the MXU aggregation path auto-disables off-TPU; tests run on the virtual
+# CPU mesh as the TPU stand-in, so force it on to keep exercising the
+# one-hot-matmul kernel (the suite's dual-path oracle checks depend on it)
+from spark_tpu import kernels as _kernels  # noqa: E402
+
+_kernels.MXU_AGG_ENABLED = True
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
