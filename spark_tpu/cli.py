@@ -22,14 +22,83 @@ from typing import List, Optional
 
 def _session(conf_pairs: List[str]):
     from spark_tpu.sql.session import SparkSession
+    # --conf must flow through the BUILDER: SparkSession.__init__ reads
+    # config (HBM budget, storage fraction) during construction
     b = SparkSession.builder.appName("spark-tpu-cli")
-    s = b.getOrCreate()
     for pair in conf_pairs or []:
         if "=" not in pair:
             raise SystemExit(f"--conf expects key=value, got {pair!r}")
         k, v = pair.split("=", 1)
-        s.conf.set(k, v)
-    return s
+        b = b.config(k, v)
+    return b.getOrCreate()
+
+
+def split_sql_statements(text: str) -> List[str]:
+    """Split a script on ';' outside quotes (single, double, and '--'
+    line comments), so literals like SELECT ';' survive."""
+    out: List[str] = []
+    buf: List[str] = []
+    quote: Optional[str] = None
+    i, n = 0, len(text)
+    while i < n:
+        ch = text[i]
+        if quote:
+            buf.append(ch)
+            if ch == quote:
+                # doubled quote inside a literal is an escape ('' / "")
+                if i + 1 < n and text[i + 1] == quote:
+                    buf.append(text[i + 1])
+                    i += 1
+                else:
+                    quote = None
+        elif ch in ("'", '"'):
+            quote = ch
+            buf.append(ch)
+        elif ch == "-" and i + 1 < n and text[i + 1] == "-":
+            while i < n and text[i] != "\n":
+                i += 1
+            continue
+        elif ch == ";":
+            out.append("".join(buf).strip())
+            buf = []
+        else:
+            buf.append(ch)
+        i += 1
+    tail = "".join(buf).strip()
+    if tail:
+        out.append(tail)
+    return [s for s in out if s]
+
+
+def statements_if_complete(text: str) -> Optional[List[str]]:
+    """Statements of ``text`` if it ends with a ';' OUTSIDE any string
+    literal/comment; None while a literal is open or no terminator yet."""
+    quote: Optional[str] = None
+    ends_semi = False
+    i, n = 0, len(text)
+    while i < n:
+        ch = text[i]
+        if quote:
+            if ch == quote:
+                if i + 1 < n and text[i + 1] == quote:
+                    i += 1
+                else:
+                    quote = None
+        elif ch in ("'", '"'):
+            quote = ch
+            ends_semi = False     # a literal after ';' starts a new stmt
+        elif ch == "-" and i + 1 < n and text[i + 1] == "-":
+            while i < n and text[i] != "\n":
+                i += 1
+            continue
+        elif ch == ";":
+            ends_semi = True
+        elif not ch.isspace():
+            ends_semi = False
+        i += 1
+    if quote is not None or not ends_semi:
+        return None
+    return split_sql_statements(text)
 
 
 def _show(df) -> None:
@@ -55,7 +124,7 @@ def cmd_sql(args) -> int:
     if args.f:
         with open(args.f) as fh:
             text = fh.read()
-        for stmt in [s.strip() for s in text.split(";") if s.strip()]:
+        for stmt in split_sql_statements(text):
             _show(spark.sql(stmt))
         return 0
     print("spark-tpu-sql interactive shell; end statements with ';', "
@@ -68,13 +137,13 @@ def cmd_sql(args) -> int:
             break
         buf.append(line)
         joined = "\n".join(buf)
-        if joined.rstrip().endswith(";"):
-            stmt = joined.rstrip()[:-1].strip()
-            buf = []
+        stmts = statements_if_complete(joined)
+        if stmts is None:          # open literal or no terminating ';'
+            continue
+        buf = []
+        for stmt in stmts:
             if stmt.lower() in ("quit", "exit"):
-                break
-            if not stmt:
-                continue
+                return 0
             try:
                 _show(spark.sql(stmt))
             except Exception as e:        # noqa: BLE001 — REPL keeps going

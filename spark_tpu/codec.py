@@ -1,0 +1,134 @@
+"""Host-side compression codecs and columnar block encodings.
+
+Reference parity:
+- ``common/network-common`` / ``io/CompressionCodec.scala`` — a pluggable
+  byte-stream codec registry (lz4/zstd/snappy in the reference).  This
+  image bakes in zlib/lzma/bz2 (stdlib); lz4/zstd register themselves
+  only when their wheels are importable, and the config validator names
+  what is actually available.
+- ``sql/core/.../columnar/compression/compressionSchemes.scala`` — cache
+  block encodings.  The TPU cache keeps columns as fixed-width numpy
+  arrays, so the profitable schemes are RunLength and Dictionary (what
+  the reference's RunLengthEncoding/DictionaryEncoding do), picked per
+  column by measured ratio, falling through to the plain byte codec.
+
+Everything here is host-side: HBM holds only uncompressed device columns,
+and compression exists to make host spill/cache cheap, exactly like the
+reference's on-heap compressed cache vs executor working memory.
+"""
+
+from __future__ import annotations
+
+import bz2
+import lzma
+import zlib
+from typing import Callable, Dict, Tuple
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# byte-stream codecs (CompressionCodec.scala analog)
+# ---------------------------------------------------------------------------
+
+CODECS: Dict[str, Tuple[Callable[[bytes], bytes], Callable[[bytes], bytes]]] = {
+    "none": (lambda b: b, lambda b: b),
+    "zlib": (lambda b: zlib.compress(b, 1), zlib.decompress),
+    "lzma": (lambda b: lzma.compress(b, preset=0), lzma.decompress),
+    "bz2": (lambda b: bz2.compress(b, 1), bz2.decompress),
+}
+
+try:  # optional wheels — register only when importable
+    import lz4.frame as _lz4  # pragma: no cover
+
+    CODECS["lz4"] = (_lz4.compress, _lz4.decompress)  # pragma: no cover
+except Exception:
+    pass
+
+try:
+    import zstandard as _zstd  # pragma: no cover
+
+    CODECS["zstd"] = (  # pragma: no cover
+        lambda b: _zstd.ZstdCompressor().compress(b),
+        lambda b: _zstd.ZstdDecompressor().decompress(b))
+except Exception:
+    pass
+
+
+def compress(data: bytes, codec: str) -> bytes:
+    return CODECS[codec][0](data)
+
+
+def decompress(data: bytes, codec: str) -> bytes:
+    return CODECS[codec][1](data)
+
+
+# ---------------------------------------------------------------------------
+# columnar encodings (compressionSchemes.scala analog)
+# ---------------------------------------------------------------------------
+
+class EncodedColumn:
+    """One encoded fixed-width column; scheme chosen by measured ratio."""
+
+    __slots__ = ("scheme", "dtype", "length", "payload")
+
+    def __init__(self, scheme: str, dtype, length: int, payload):
+        self.scheme = scheme
+        self.dtype = dtype
+        self.length = length
+        self.payload = payload
+
+    @property
+    def nbytes(self) -> int:
+        if self.scheme == "rle":
+            runs, vals = self.payload
+            return runs.nbytes + vals.nbytes
+        if self.scheme == "dict":
+            codes, vals = self.payload
+            return codes.nbytes + vals.nbytes
+        return len(self.payload)
+
+
+def _rle(arr: np.ndarray):
+    if len(arr) == 0:
+        return np.zeros(0, np.int32), arr
+    change = np.empty(len(arr), bool)
+    change[0] = True
+    np.not_equal(arr[1:], arr[:-1], out=change[1:])
+    starts = np.flatnonzero(change)
+    lengths = np.diff(np.append(starts, len(arr))).astype(np.int32)
+    return lengths, arr[starts]
+
+
+def encode_column(arr: np.ndarray, codec: str = "zlib") -> EncodedColumn:
+    """Pick RunLength / Dictionary / plain-codec by measured size."""
+    arr = np.ascontiguousarray(arr)
+    n = len(arr)
+    candidates = []
+
+    lengths, vals = _rle(arr)
+    if len(vals) * (arr.itemsize + 4) < arr.nbytes:
+        candidates.append(("rle", (lengths, vals),
+                           len(vals) * (arr.itemsize + 4)))
+
+    if n and arr.dtype.kind in "iub":
+        uniq, codes = np.unique(arr, return_inverse=True)
+        if len(uniq) <= 0xFFFF and len(uniq) * arr.itemsize + n * 2 < arr.nbytes:
+            candidates.append(("dict", (codes.astype(np.uint16), uniq),
+                               len(uniq) * arr.itemsize + n * 2))
+
+    packed = compress(arr.tobytes(), codec)
+    candidates.append((codec, packed, len(packed)))
+
+    scheme, payload, _ = min(candidates, key=lambda c: c[2])
+    return EncodedColumn(scheme, arr.dtype, n, payload)
+
+
+def decode_column(enc: EncodedColumn) -> np.ndarray:
+    if enc.scheme == "rle":
+        lengths, vals = enc.payload
+        return np.repeat(vals, lengths)
+    if enc.scheme == "dict":
+        codes, vals = enc.payload
+        return vals[codes]
+    raw = decompress(enc.payload, enc.scheme)
+    return np.frombuffer(raw, enc.dtype)[:enc.length].copy()

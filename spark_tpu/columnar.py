@@ -205,7 +205,10 @@ class ColumnBatch:
     capacity are static aux data, so operator pipelines jit cleanly.
     """
 
-    __slots__ = ("names", "vectors", "row_valid", "capacity")
+    # _cache_uid: lazily-assigned identity for plan cache keys
+    # (memory.py) -- id() could be recycled after GC
+    __slots__ = ("names", "vectors", "row_valid", "capacity",
+                 "_cache_uid")
 
     def __init__(self, names: Sequence[str], vectors: Sequence[ColumnVector],
                  row_valid: Optional[Array], capacity: int):

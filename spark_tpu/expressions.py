@@ -704,6 +704,9 @@ class IsNotNull(Expression):
 
 
 class IsNaN(Expression):
+    def __repr__(self):
+        return f"isnan({self.children[0]!r})"
+
     def __init__(self, child):
         self.children = (child,)
 
@@ -868,6 +871,10 @@ class In(Expression):
 
 
 class Between(Expression):
+    def __repr__(self):
+        c = self.children
+        return f"({c[0]!r} BETWEEN {c[1]!r} AND {c[2]!r})"
+
     def __init__(self, child, low, high):
         self.children = (child, _wrap(low), _wrap(high))
 
@@ -880,6 +887,9 @@ class Between(Expression):
 
 
 class Greatest(Expression):
+    def __repr__(self):
+        return f"{type(self).__name__.lower()}({', '.join(map(repr, self.children))})"
+
     def __init__(self, *children):
         self.children = tuple(children)
 

@@ -421,9 +421,49 @@ def _limb_plan(np_dtype) -> Tuple[int, int]:
     return dt.itemsize, 1 << (bits - 1)
 
 
+# TPU VPUs have no 64-bit lanes — XLA emulates every int64 op with a
+# multi-op 32-bit expansion, which made the O(n) prep (bucket codes, limb
+# extraction) dominate the whole aggregation.  The helpers below keep all
+# O(n) arithmetic in native 32-bit: int64 columns are split into (lo, hi)
+# uint32 halves by bitcast (XLA defines minor index 0 = least-significant
+# word), min/max are two-pass lexicographic reductions, and in-range codes
+# come from low-half arithmetic alone (exact whenever the range fits the
+# bucket table — `fits` guards it; the sort-based branch owns the rest).
+
+def _i64_halves(xp, data):
+    """(lo, hi) uint32 halves of ``data`` sign-extended to int64."""
+    import jax
+    import jax.numpy as jnp
+    if data.dtype.itemsize == 8:
+        pair = jax.lax.bitcast_convert_type(data.astype(jnp.int64),
+                                            jnp.uint32)
+        return pair[..., 0], pair[..., 1]
+    w = data.astype(jnp.int32)
+    return w.astype(jnp.uint32), (w >> 31).astype(jnp.uint32)
+
+
+def _masked_minmax64(xp, lo, hi, mask):
+    """(kmin_i64, kmax_i64, kmin_lo_u32) over rows where mask, via int32
+    lexicographic (hi signed, lo unsigned) two-pass reductions.  Empty mask
+    yields (INT64_MAX, INT64_MIN, UINT32_MAX) — the sort-branch sentinels."""
+    import jax.numpy as jnp
+    hi_s = hi.astype(jnp.int32)
+    min_hi = xp.min(xp.where(mask, hi_s, np.int32(np.iinfo(np.int32).max)))
+    min_lo = xp.min(xp.where(mask & (hi_s == min_hi), lo,
+                             np.uint32(0xFFFFFFFF)))
+    max_hi = xp.max(xp.where(mask, hi_s, np.int32(np.iinfo(np.int32).min)))
+    max_lo = xp.max(xp.where(mask & (hi_s == max_hi), lo, np.uint32(0)))
+
+    def comb(h, l):
+        return (h.astype(jnp.int64) << np.int64(32)) | l.astype(jnp.int64)
+
+    return comb(min_hi, min_lo), comb(max_hi, max_lo), min_lo
+
+
 def _mxu_grouped_aggregate(xp, batch, key_exprs, agg_slots, bucket_cap):
     import jax
     import jax.numpy as jnp
+    from . import pallas_agg
     from .aggregates import Avg, Count, CountStar, Sum
 
     ctx = EvalContext(batch, xp)
@@ -436,42 +476,42 @@ def _mxu_grouped_aggregate(xp, batch, key_exprs, agg_slots, bucket_cap):
     n_pad = ((capacity + L - 1) // L) * L
 
     # ---- composite bucket codes (mixed radix over keys, NULL = 0) -------
+    # All O(n) arithmetic is 32-bit native (see _i64_halves): codes come
+    # from low-half differences, exact whenever `fits` holds; the slow
+    # branch owns every other execution, so garbage codes are harmless.
     key_vals: List[ExprValue] = [ctx.broadcast(k.eval(ctx)) for k in key_exprs]
     key_dts = [k.data_type(schema) for k in key_exprs]
-    codes = []          # per-key (code_array int64 in [0, r), r traced int64)
+    codes = []          # per-key (code32 in [0, r), r32, kmin_i64, nullable)
     prod = xp.ones((), np.float64)   # overflow-safe fit check in f64
     for v in key_vals:
         data = v.data
         if data.dtype == np.bool_:
             data = data.astype(np.int8)
-        data = data.astype(np.int64)
+        lo, hi = _i64_halves(xp, data)
         mask = live if v.valid is None else (live & v.valid)
-        big = np.int64(np.iinfo(np.int64).max)
-        small = np.int64(np.iinfo(np.int64).min)
-        kmin = xp.min(xp.where(mask, data, big))
-        kmax = xp.max(xp.where(mask, data, small))
-        # int64 `kmax - kmin` can wrap for spans >= 2^63; the authoritative
-        # range estimate is f64, the int64 one is clamped and only trusted
-        # when `fits` proves the true range is small
+        kmin, kmax, kmin_lo = _masked_minmax64(xp, lo, hi, mask)
+        # the authoritative range estimate is f64 (int64 spans can exceed
+        # any 32-bit arithmetic); only trusted when `fits` proves it small
         rangef = xp.maximum(kmax.astype(np.float64) - kmin.astype(np.float64)
                             + 1.0, 0.0)
-        vrange = xp.clip(kmax - kmin + 1, 0, B + 2)
+        r32 = xp.clip(rangef, 0.0, np.float64(B + 2)).astype(np.int32)
+        diff = (lo - kmin_lo).astype(np.int32)   # mod-2^32; exact iff fits
         nullable = v.valid is not None
         if nullable:
-            code = xp.where(mask, data - kmin + 1, 0)
-            r = vrange + 1
+            code = xp.where(mask, diff + 1, 0)
+            r32 = r32 + 1
             prod = prod * (rangef + 1.0)
         else:
-            code = data - kmin
-            r = xp.maximum(vrange, 1)
+            code = diff
+            r32 = xp.maximum(r32, 1)
             prod = prod * xp.maximum(rangef, 1.0)
-        codes.append((code, r, kmin, nullable))
+        codes.append((code, r32, kmin, nullable))
 
-    bucket = xp.zeros(capacity, np.int64)
-    for code, r, _, _ in codes:
-        bucket = bucket * r + code
+    bucket = xp.zeros(capacity, np.int32)
+    for code, r32, _, _ in codes:
+        bucket = bucket * r32 + code   # wraps only when not fits
     fits = prod <= np.float64(B)
-    bucket32 = xp.clip(bucket, 0, B - 1).astype(np.int32)
+    bucket32 = xp.clip(bucket, 0, B - 1)
 
     def fast_branch(_):
         # ---- plane assembly (fast branch only: fallback executions must
@@ -496,34 +536,52 @@ def _mxu_grouped_aggregate(xp, batch, key_exprs, agg_slots, bucket_cap):
             if data.dtype == np.bool_:
                 data = data.astype(np.int8)
             n_limbs, offset = _limb_plan(data.dtype)
-            shifted = (data.astype(jnp.uint64) + jnp.uint64(offset))
+            # 32-bit-native limb extraction: the +offset sign shift is a
+            # top-bit flip for 8-byte values (no carry: 2^63 IS the top
+            # bit) and a mod-2^32 low-word add for narrower ones (only the
+            # low 8*n_limbs bits are read, which the wrap cannot touch)
+            lo, hi = _i64_halves(xp, data)
+            if n_limbs == 8:
+                words = (lo, hi ^ np.uint32(0x80000000))
+            else:
+                words = (lo + np.uint32(offset),)
             start = len(planes)
             for i in range(n_limbs):
-                limb = ((shifted >> jnp.uint64(8 * i)) & jnp.uint64(0xFF))
-                limb = xp.where(m, limb, jnp.uint64(0))
+                w = words[i // 4]
+                limb = (w >> np.uint32(8 * (i % 4))) & np.uint32(0xFF)
+                limb = xp.where(m, limb, np.uint32(0))
                 planes.append(limb.astype(jnp.bfloat16))
             planes.append(m.astype(jnp.bfloat16))   # per-agg count
             agg_plane_info.append((func, name, "sum", start, offset, n_limbs))
 
         P = len(planes)
         plane_mat = xp.stack(planes, axis=-1)                # (n, P)
-        bucket_pad = bucket32
-        if n_pad != capacity:
-            plane_mat = xp.concatenate(
-                [plane_mat, xp.zeros((n_pad - capacity, P), jnp.bfloat16)])
-            bucket_pad = xp.concatenate(
-                [bucket32, xp.zeros(n_pad - capacity, np.int32)])
-        T_tiles = n_pad // L
 
-        bb = bucket_pad.reshape(T_tiles, L)
-        pp = plane_mat.reshape(T_tiles, L, P)
-        oh = jax.nn.one_hot(bb, B, dtype=jnp.bfloat16)        # (T, L, B)
-        per_tile = jnp.einsum("tlb,tlp->tbp", oh, pp,
-                              preferred_element_type=jnp.float32)
-        # exact integer accumulation across tiles; int32 is enough while
-        # total counts/limb-sums stay < 2^31 (n·255), halving HBM traffic
-        acc_dt = jnp.int32 if n_pad * 255 < (1 << 31) else jnp.int64
-        tot = per_tile.astype(acc_dt).sum(0).astype(jnp.int64)  # (B, P)
+        if pallas_agg.supported(B) and jax.default_backend() == "tpu":
+            # Pallas accumulate: one-hot tiles built in VMEM, (B, P) int32
+            # accumulator in scratch, bucket chunks beyond the runtime key
+            # range skipped — HBM traffic is one pass over the planes
+            n_active = pallas_agg.n_active_chunks(xp, prod, B)
+            tot = pallas_agg.grouped_accumulate(bucket32, plane_mat,
+                                                n_active, B)
+        else:
+            bucket_pad = bucket32
+            if n_pad != capacity:
+                plane_mat = xp.concatenate(
+                    [plane_mat, xp.zeros((n_pad - capacity, P), jnp.bfloat16)])
+                bucket_pad = xp.concatenate(
+                    [bucket32, xp.zeros(n_pad - capacity, np.int32)])
+            T_tiles = n_pad // L
+
+            bb = bucket_pad.reshape(T_tiles, L)
+            pp = plane_mat.reshape(T_tiles, L, P)
+            oh = jax.nn.one_hot(bb, B, dtype=jnp.bfloat16)        # (T, L, B)
+            per_tile = jnp.einsum("tlb,tlp->tbp", oh, pp,
+                                  preferred_element_type=jnp.float32)
+            # exact integer accumulation across tiles; int32 is enough while
+            # total counts/limb-sums stay < 2^31 (n·255), halving HBM traffic
+            acc_dt = jnp.int32 if n_pad * 255 < (1 << 31) else jnp.int64
+            tot = per_tile.astype(acc_dt).sum(0).astype(jnp.int64)  # (B, P)
         live_count = tot[:, 0]
         grow = live_count > 0                                 # real groups
 

@@ -1,0 +1,321 @@
+"""HBM memory accounting and the device cache manager.
+
+The reference splits a fixed heap between EXECUTION (shuffle/sort/join
+working memory) and STORAGE (cached blocks), with storage evictable down
+to a protected floor — ``UnifiedMemoryManager.scala:47`` — and tracks
+cached relations in ``CacheManager.scala`` / ``InMemoryRelation.scala``
+with compressed column blocks and LRU-style eviction via the
+``BlockManager``/``MemoryStore``.
+
+TPU translation:
+
+- the accounted resource is device HBM.  The budget comes from the live
+  device (``Device.memory_stats()['bytes_limit']``) when the backend
+  exposes it, else ``spark.tpu.memory.hbmBudget``.
+- EXECUTION reservations are made by the planner for a query's leaf
+  batches + operator working set *before* dispatch, so an impossible
+  query fails with an honest ``HBMOutOfMemoryError`` naming the reserver
+  instead of an opaque XLA allocation crash.
+- STORAGE holds cached relations as device-resident ColumnBatches.
+  Under pressure they demote: DEVICE -> HOST (numpy) -> HOST_COMPRESSED
+  (columnar RLE/dict/byte-codec blocks — ``codec.py``), mirroring the
+  reference's MEMORY_ONLY -> MEMORY_AND_DISK ladder with the host RAM
+  playing the disk role (HBM:host ~ heap:disk in bandwidth ratio).
+- eviction is LRU over cached entries.  Demotion is safe mid-query: a
+  reader holds a reference to the decompressed/materialized batch it got
+  from ``get``, so the entry's storage can change underneath it freely.
+
+Single-controller scope: accounting covers this process's session (the
+reference's per-executor MemoryManager scope; multi-host counterparts
+each run their own).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import codec as codec_mod
+from . import config as C
+from . import types as T
+from .columnar import ColumnBatch, ColumnVector
+
+HBM_BUDGET = C.conf("spark.tpu.memory.hbmBudget").doc(
+    "Device HBM budget in bytes for execution+storage accounting; 0 = "
+    "discover from device memory_stats (fallback 16 GiB)."
+).int(0)
+
+STORAGE_FRACTION = C.conf("spark.tpu.memory.storageFraction").doc(
+    "Fraction of the HBM budget protected for the device cache before "
+    "execution reservations may force eviction (UnifiedMemoryManager's "
+    "spark.memory.storageFraction analog)."
+).float(0.3)
+
+CACHE_CODEC = C.conf("spark.tpu.cache.codec").doc(
+    "Byte codec for HOST_COMPRESSED cache blocks: one of codec.CODECS "
+    "(zlib/lzma/bz2 always; lz4/zstd when their wheels are present)."
+).string("zlib")
+
+
+class HBMOutOfMemoryError(MemoryError):
+    """Execution reservation cannot fit even after evicting all unpinned
+    storage (SparkOutOfMemoryError analog)."""
+
+
+def batch_nbytes(batch: ColumnBatch) -> int:
+    total = 0
+    for v in batch.vectors:
+        total += np.dtype(v.dtype.np_dtype).itemsize * batch.capacity
+        if v.valid is not None:
+            total += batch.capacity
+    if batch.row_valid is not None:
+        total += batch.capacity
+    return total
+
+
+def _device_budget(conf) -> int:
+    fixed = conf.get(HBM_BUDGET)
+    if fixed:
+        return fixed
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return 16 << 30
+
+
+class MemoryManager:
+    """Execution/storage split over one HBM budget with storage eviction."""
+
+    def __init__(self, conf):
+        self._conf = conf
+        self._lock = threading.RLock()
+        self.budget = _device_budget(conf)
+        self.storage_floor = int(self.budget *
+                                 conf.get(STORAGE_FRACTION))
+        self._execution: Dict[str, int] = {}
+        self._storage: Dict[str, int] = {}
+        self._evict_cb = None            # set by the cache manager
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def execution_used(self) -> int:
+        return sum(self._execution.values())
+
+    @property
+    def storage_used(self) -> int:
+        return sum(self._storage.values())
+
+    @property
+    def free(self) -> int:
+        return self.budget - self.execution_used - self.storage_used
+
+    def set_eviction_callback(self, cb) -> None:
+        """cb(nbytes_needed) -> bytes actually released."""
+        self._evict_cb = cb
+
+    # -- execution pool -----------------------------------------------------
+    def acquire_execution(self, owner: str, nbytes: int) -> None:
+        with self._lock:
+            if nbytes > self.free and self._evict_cb is not None:
+                # evict storage above the protected floor
+                evictable = max(0, self.storage_used - self.storage_floor)
+                want = min(nbytes - self.free, evictable)
+                if want > 0:
+                    self._evict_cb(want)
+            if nbytes > self.free:
+                raise HBMOutOfMemoryError(
+                    f"{owner}: need {nbytes} B, free {self.free} B of "
+                    f"{self.budget} B (execution {self.execution_used} B, "
+                    f"storage {self.storage_used} B)")
+            self._execution[owner] = self._execution.get(owner, 0) + nbytes
+
+    def release_execution(self, owner: str) -> None:
+        with self._lock:
+            self._execution.pop(owner, None)
+
+    # -- storage pool -------------------------------------------------------
+    def try_acquire_storage(self, key: str, nbytes: int) -> bool:
+        with self._lock:
+            if nbytes > self.free and self._evict_cb is not None:
+                self._evict_cb(nbytes - self.free)
+            if nbytes > self.free:
+                return False
+            self._storage[key] = self._storage.get(key, 0) + nbytes
+            return True
+
+    def release_storage(self, key: str) -> None:
+        with self._lock:
+            self._storage.pop(key, None)
+
+
+# ---------------------------------------------------------------------------
+# storage levels & cached entries
+# ---------------------------------------------------------------------------
+
+class StorageLevel:
+    DEVICE = "DEVICE"                      # HBM-resident (MEMORY_ONLY)
+    HOST = "HOST"                          # numpy (MEMORY_AND_DISK's disk)
+    HOST_COMPRESSED = "HOST_COMPRESSED"    # codec blocks (compressed cache)
+
+
+class _Entry:
+    __slots__ = ("key", "level", "requested", "batch", "blocks", "nbytes",
+                 "last_used", "uid")
+
+    def __init__(self, key, level, requested, batch, nbytes):
+        self.key = key
+        self.level = level
+        self.requested = requested
+        self.batch = batch            # device or host ColumnBatch
+        self.blocks = None            # HOST_COMPRESSED payload
+        self.nbytes = nbytes
+        self.last_used = time.monotonic()
+        self.uid = None               # stable plan-key identity (see get())
+
+
+def _compress_batch(batch: ColumnBatch, codec_name: str):
+    host = batch.to_host()
+    cols = []
+    for v in host.vectors:
+        enc = codec_mod.encode_column(np.asarray(v.data), codec_name)
+        validity = (None if v.valid is None
+                    else np.packbits(np.asarray(v.valid, bool)))
+        cols.append((enc, validity, v.dtype, v.dictionary))
+    rv = (None if host.row_valid is None
+          else np.packbits(np.asarray(host.row_valid, bool)))
+    return (host.names, cols, rv, host.capacity)
+
+
+def _decompress_batch(blocks) -> ColumnBatch:
+    names, cols, rv, capacity = blocks
+    vectors = []
+    for enc, validity, dt, dictionary in cols:
+        data = codec_mod.decode_column(enc)
+        valid = (None if validity is None
+                 else np.unpackbits(validity)[:capacity].astype(bool))
+        vectors.append(ColumnVector(data, dt, valid, dictionary))
+    row_valid = (None if rv is None
+                 else np.unpackbits(rv)[:capacity].astype(bool))
+    return ColumnBatch(names, vectors, row_valid, capacity)
+
+
+class DeviceCacheManager:
+    """Plan-keyed cached relations with demotion + LRU eviction."""
+
+    def __init__(self, memory: MemoryManager, conf):
+        self._memory = memory
+        self._conf = conf
+        self._entries: Dict[str, _Entry] = {}
+        # ONE lock with the memory manager: the eviction callback runs
+        # under it, and a second lock here would order-invert (cache.put ->
+        # memory.try_acquire_storage vs memory.acquire_execution -> _evict)
+        self._lock = memory._lock
+        memory.set_eviction_callback(self._evict)
+
+    # -- public -------------------------------------------------------------
+    def put(self, key: str, batch: ColumnBatch,
+            level: str = StorageLevel.DEVICE) -> None:
+        if level not in (StorageLevel.DEVICE, StorageLevel.HOST,
+                         StorageLevel.HOST_COMPRESSED):
+            raise ValueError(
+                f"unknown storage level {level!r}; expected one of "
+                f"StorageLevel.DEVICE/HOST/HOST_COMPRESSED")
+        nbytes = batch_nbytes(batch)
+        with self._lock:
+            self.remove(key)
+            entry = _Entry(key, level, level, batch, nbytes)
+            from .sql.logical import _batch_uid
+            entry.uid = _batch_uid(batch)
+            if level == StorageLevel.DEVICE:
+                if self._memory.try_acquire_storage(key, nbytes):
+                    entry.batch = batch.to_device()
+                else:                      # no room: demote on entry
+                    entry.level = StorageLevel.HOST
+                    entry.batch = batch.to_host()
+            elif level == StorageLevel.HOST:
+                entry.batch = batch.to_host()
+            else:
+                entry.blocks = _compress_batch(
+                    batch, self._conf.get(CACHE_CODEC))
+                entry.batch = None
+                entry.nbytes = sum(c[0].nbytes for c in entry.blocks[1])
+            self._entries[key] = entry
+
+    def get(self, key: str) -> Optional[ColumnBatch]:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            entry.last_used = time.monotonic()
+            if entry.level == StorageLevel.HOST_COMPRESSED:
+                if entry.batch is None:       # decompress ONCE; keep the
+                    entry.batch = _decompress_batch(entry.blocks)  # host copy
+                batch = entry.batch
+                # promote back toward the requested level opportunistically
+                if entry.requested == StorageLevel.DEVICE and \
+                        self._memory.try_acquire_storage(key, batch_nbytes(batch)):
+                    entry.batch = batch.to_device()
+                    entry.blocks = None
+                    entry.level = StorageLevel.DEVICE
+                    entry.nbytes = batch_nbytes(batch)
+                    batch = entry.batch
+            else:
+                batch = entry.batch
+            # every object served under this key carries the SAME uid, so
+            # plan keys built over a cached batch (cache-on-cache) stay
+            # stable across demote/decompress/promote cycles
+            if entry.uid is not None:
+                try:
+                    batch._cache_uid = entry.uid
+                except Exception:
+                    pass
+            return batch
+
+    def remove(self, key: str) -> bool:
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                return False
+            if entry.level == StorageLevel.DEVICE:
+                self._memory.release_storage(key)
+            return True
+
+    def clear(self) -> None:
+        with self._lock:
+            for key in list(self._entries):
+                self.remove(key)
+
+    def entries(self) -> List[dict]:
+        with self._lock:
+            return [{"key": e.key, "level": e.level, "nbytes": e.nbytes}
+                    for e in self._entries.values()]
+
+    # -- eviction (called under memory pressure) ----------------------------
+    def _evict(self, nbytes_needed: int) -> int:
+        """Demote least-recently-used DEVICE entries to HOST_COMPRESSED
+        until ``nbytes_needed`` device bytes are free."""
+        released = 0
+        with self._lock:
+            device_entries = sorted(
+                (e for e in self._entries.values()
+                 if e.level == StorageLevel.DEVICE),
+                key=lambda e: e.last_used)
+            for entry in device_entries:
+                if released >= nbytes_needed:
+                    break
+                host = entry.batch.to_host()
+                entry.blocks = _compress_batch(
+                    host, self._conf.get(CACHE_CODEC))
+                entry.batch = None        # dropped to free host refs too;
+                entry.level = StorageLevel.HOST_COMPRESSED  # get() re-caches
+                self._memory.release_storage(entry.key)
+                released += entry.nbytes
+                entry.nbytes = sum(c[0].nbytes for c in entry.blocks[1])
+        return released

@@ -38,7 +38,7 @@ class DataFrame:
     def __init__(self, session, plan: L.LogicalPlan):
         self.session = session
         self._plan = plan
-        self._cached: Optional[ColumnBatch] = None
+        self._cached: Optional[str] = None   # device-cache key
 
     # -- metadata ---------------------------------------------------------
     @property
@@ -273,20 +273,39 @@ class DataFrame:
     def coalesce(self, num: int) -> "DataFrame":
         return self
 
-    def cache(self) -> "DataFrame":
-        self._cached = self._execute()
+    def cache(self, level: Optional[str] = None) -> "DataFrame":
+        """Materialize and register in the session's device cache manager
+        (``CacheManager.cacheQuery``); other queries containing this exact
+        subtree read the cached batch instead of recomputing.  ``level`` is
+        a ``memory.StorageLevel`` (default DEVICE; demotes under HBM
+        pressure)."""
+        from ..memory import StorageLevel
+        from .planner import QueryExecution
+        # key on the SUBSTITUTED analyzed plan: _use_cached_data rewrites
+        # bottom-up, so a cache-on-cache plan must be keyed the way other
+        # queries' rewritten trees will actually look
+        qe = QueryExecution(self.session, self._plan)
+        key = L.plan_cache_key(qe.analyzed)
+        batch = qe.execute()
+        self.session._cache.put(key, batch, level or StorageLevel.DEVICE)
+        self._cached = key
         return self
 
-    persist = cache
+    def persist(self, level: Optional[str] = None) -> "DataFrame":
+        return self.cache(level)
 
     def unpersist(self) -> "DataFrame":
-        self._cached = None
+        if self._cached is not None:
+            self.session._cache.remove(self._cached)
+            self._cached = None
         return self
 
     # -- actions ----------------------------------------------------------
     def _execute(self) -> ColumnBatch:
         if self._cached is not None:
-            return self._cached
+            hit = self.session._cache.get(self._cached)
+            if hit is not None:
+                return hit
         from .planner import QueryExecution
         return QueryExecution(self.session, self._plan).execute()
 

@@ -75,6 +75,46 @@ class LogicalPlan:
         return type(self).__name__
 
 
+_cache_uid_counter = [0]
+
+
+def _batch_uid(batch) -> int:
+    """Monotonic uid attached to a batch on first use — identity that can
+    never be recycled the way ``id()`` can after garbage collection."""
+    uid = getattr(batch, "_cache_uid", None)
+    if uid is None:
+        _cache_uid_counter[0] += 1
+        uid = _cache_uid_counter[0]
+        try:
+            batch._cache_uid = uid
+        except Exception:       # frozen batch type: fall back to object id,
+            return id(batch)    # keeping the batch alive via the plan ref
+    return uid
+
+
+def plan_cache_key(node: "LogicalPlan") -> str:
+    """Stable fingerprint of a logical subtree for cached-relation lookup
+    (``CacheManager.lookupCachedData`` plan matching).  Reprs alone are NOT
+    trusted — several are elided for humans (Aggregate shows output names,
+    not functions) — so the key serializes every non-child field of the
+    node plus its expressions.  LocalRelation keys on a monotonic batch
+    uid: two different in-memory datasets must never alias."""
+    if isinstance(node, LocalRelation):
+        return f"LocalRelation#{_batch_uid(node.batch)}"
+    fields = []
+    for name in sorted(vars(node)):
+        if name in ("children", "child") or name.startswith("_"):
+            continue
+        v = vars(node)[name]
+        if isinstance(v, LogicalPlan) or (
+                isinstance(v, (list, tuple)) and v
+                and isinstance(v[0], LogicalPlan)):
+            continue
+        fields.append(f"{name}={v!r}")
+    inner = ",".join(plan_cache_key(c) for c in node.children)
+    return f"{type(node).__name__}[{';'.join(fields)}]({inner})"
+
+
 class LocalRelation(LogicalPlan):
     """In-memory data (``LocalRelation.scala``); leaf."""
 

@@ -179,8 +179,27 @@ class QueryExecution:
     def analyzed(self) -> LogicalPlan:
         if self._analyzed is None:
             from .analyzer import Analyzer
-            self._analyzed = Analyzer(self.session.catalog).analyze(self.logical)
+            plan = Analyzer(self.session.catalog).analyze(self.logical)
+            self._analyzed = self._use_cached_data(plan)
         return self._analyzed
+
+    def _use_cached_data(self, plan: LogicalPlan) -> LogicalPlan:
+        """Replace subtrees a DataFrame.cache() materialized with their
+        cached batches (CacheManager.useCachedData on the analyzed plan)."""
+        cache = getattr(self.session, "_cache", None)
+        if cache is None or not cache._entries:
+            return plan
+        from .logical import plan_cache_key
+
+        def sub(node: LogicalPlan) -> LogicalPlan:
+            if isinstance(node, LocalRelation):
+                return node           # never probe: not substitutable, and
+            hit = cache.get(plan_cache_key(node))   # get() has side effects
+            if hit is not None:
+                return LocalRelation(hit)
+            return node
+
+        return plan.transform_up(sub)
 
     @property
     def optimized(self) -> LogicalPlan:
@@ -270,7 +289,30 @@ class QueryExecution:
                 C.JOIN_OUTPUT_FACTOR.key, factor)
 
     def _run_planned(self, pq: PlannedQuery) -> Tuple[ColumnBatch, float]:
-        """One execution attempt → (host result, worst overflow ratio)."""
+        """One execution attempt → (host result, worst overflow ratio).
+
+        Before dispatch the leaf working set is reserved with the HBM
+        memory manager (UnifiedMemoryManager's acquireExecutionMemory):
+        cached relations evict/demote to make room, and a query that
+        cannot fit raises HBMOutOfMemoryError naming itself instead of
+        dying inside XLA's allocator.  The reservation is a LOWER bound
+        (leaves + one same-sized intermediate per leaf); operator blowup
+        beyond it is caught by XLA as before."""
+        from ..memory import batch_nbytes
+        mem = getattr(self.session, "_memory", None)
+        owner = f"query:{id(self)}"
+        reserved = 0
+        if mem is not None:
+            reserved = 2 * sum(batch_nbytes(b) for b in pq.leaves)
+            mem.acquire_execution(owner, reserved)
+        try:
+            return self._run_planned_inner(pq)
+        finally:
+            if mem is not None:
+                mem.release_execution(owner)
+
+    def _run_planned_inner(self, pq: PlannedQuery
+                           ) -> Tuple[ColumnBatch, float]:
         use_jit = self.session.conf.get(C.CODEGEN_ENABLED)
         if use_jit:
             from .udf import backend_supports_callbacks, plan_has_slow_udf

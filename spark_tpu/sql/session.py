@@ -286,6 +286,19 @@ class SparkSession:
         # shape start at the factor that worked (no repeat overflow+recompile)
         self._adapted_factors: Dict[str, Any] = {}
         self._sc = None
+        from ..memory import DeviceCacheManager, MemoryManager
+        self._memory = MemoryManager(self.conf_obj)
+        self._cache = DeviceCacheManager(self._memory, self.conf_obj)
+
+    @property
+    def memoryManager(self):
+        """HBM execution/storage accounting (UnifiedMemoryManager analog)."""
+        return self._memory
+
+    @property
+    def cacheManager(self):
+        """Device cache of materialized relations (CacheManager analog)."""
+        return self._cache
 
     @property
     def udf(self):
@@ -333,6 +346,7 @@ class SparkSession:
         SparkSession._active = None
         self._jit_cache.clear()
         self._adapted_factors.clear()
+        self._cache.clear()
 
     # ------------------------------------------------------------------
     def range(self, start: int, end: Optional[int] = None, step: int = 1
