@@ -1,0 +1,154 @@
+"""Timing experiments for the grouped-aggregate hot loop on real TPU.
+
+Methodology matches bench.py: ITERS iterations inside one lax.fori_loop,
+inputs perturbed from the carried index, scalar dependency carried out.
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/spark_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+N = 1 << 22
+B = 4096
+P = 11
+GROUPS = 1024
+ITERS = 10
+
+rng = np.random.default_rng(0)
+bucket_np = rng.integers(0, GROUPS, N).astype(np.int32)
+planes_np = rng.integers(0, 256, (N, P)).astype(np.float32)
+
+
+def loop_time(name, step):
+    """step(bucket, planes) -> (B,P) i32; time ITERS perturbed iterations."""
+    @jax.jit
+    def run(bucket, planes):
+        def body(i, acc):
+            b = bucket ^ (i & jnp.int32(GROUPS - 1))
+            p = planes + i.astype(jnp.float32) * 0.0   # keep values exact
+            out = step(b, p)
+            return acc + out[0, 0] + out[GROUPS - 1, P - 1]
+        return jax.lax.fori_loop(0, ITERS, body, jnp.int32(0))
+
+    bucket = jnp.asarray(bucket_np)
+    planes = jnp.asarray(planes_np)
+    r = jax.block_until_ready(run(bucket, planes))   # compile+warm
+    t0 = time.perf_counter()
+    r = jax.block_until_ready(run(bucket, planes))
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{name:30s} {dt*1e3:9.3f} ms/iter   {N/dt/1e6:10.1f} M rows/s")
+
+
+# ---------------------------------------------------------------- a) einsum
+def step_einsum(bucket, planes):
+    L_E = 2048
+    T = N // L_E
+    bb = bucket.reshape(T, L_E)
+    pp = planes.astype(jnp.bfloat16).reshape(T, L_E, P)
+    oh = jax.nn.one_hot(bb, B, dtype=jnp.bfloat16)
+    per_tile = jnp.einsum("tlb,tlp->tbp", oh, pp,
+                          preferred_element_type=jnp.float32)
+    return per_tile.astype(jnp.int32).sum(0)
+
+
+# ---------------------------------------------------------------- b) pallas
+def make_pallas_step(L, BB, n_active, in_dtype=jnp.bfloat16):
+    T = N // L
+    BCH = B // BB
+
+    def kernel(nact_ref, bucket_ref, planes_ref, out_ref, acc_ref):
+        t = pl.program_id(0)
+        bj = pl.program_id(1)
+
+        @pl.when(t == 0)
+        def _init():
+            acc_ref[pl.ds(bj * BB, BB), :] = jnp.zeros((BB, P), jnp.int32)
+
+        @pl.when(bj < nact_ref[0])
+        def _active():
+            b = bucket_ref[0, :]
+            base = bj * BB
+            iota = jax.lax.broadcasted_iota(jnp.int32, (L, BB), 1) + base
+            oh = (b[:, None] == iota).astype(in_dtype)
+            pt = jax.lax.dot_general(
+                oh, planes_ref[:],
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            acc_ref[pl.ds(base, BB), :] += pt.astype(jnp.int32)
+
+        @pl.when((t == T - 1) & (bj == BCH - 1))
+        def _fin():
+            out_ref[:] = acc_ref[:]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(T, BCH),
+        in_specs=[
+            pl.BlockSpec((1, L), lambda t, bj, n: (0, t)),
+            pl.BlockSpec((L, P), lambda t, bj, n: (t, 0)),
+        ],
+        out_specs=pl.BlockSpec((B, P), lambda t, bj, n: (0, 0)),
+        scratch_shapes=[pltpu.VMEM((B, P), jnp.int32)],
+    )
+
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, P), jnp.int32),
+    )
+
+    def step(bucket, planes):
+        return call(jnp.array([n_active], jnp.int32),
+                    bucket.reshape(1, N),
+                    planes.astype(in_dtype))
+    return step
+
+
+# ---------------------------------------------------------------- c) sort
+def step_sort(bucket, planes):
+    order = jnp.argsort(bucket)
+    sp = planes[order]
+    return jax.ops.segment_sum(sp, bucket[order],
+                               num_segments=B).astype(jnp.int32)
+
+
+def check(step):
+    """one un-perturbed run vs numpy oracle"""
+    out = np.asarray(jax.jit(step)(jnp.asarray(bucket_np),
+                                   jnp.asarray(planes_np)))
+    expect = np.zeros((B, P), np.int64)
+    np.add.at(expect, bucket_np, planes_np.astype(np.int64))
+    assert np.array_equal(out.astype(np.int64), expect), "WRONG RESULT"
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "einsum"):
+        check(step_einsum)
+        loop_time("xla-einsum B=4096", step_einsum)
+    if which in ("all", "pallas"):
+        for (L, BB) in [(1024, 512)]:
+            try:
+                step = make_pallas_step(L, BB, B // BB)
+                check(step)
+                loop_time(f"pallas L={L} BB={BB} full", step)
+                nact = (GROUPS + BB - 1) // BB
+                step2 = make_pallas_step(L, BB, nact)
+                loop_time(f"pallas L={L} BB={BB} act={nact}", step2)
+            except Exception as e:
+                print(f"pallas L={L} BB={BB} FAILED: {type(e).__name__}: "
+                      f"{str(e)[:300]}")
+    if which in ("all", "sort"):
+        check(step_sort)
+        loop_time("sort+segment_sum", step_sort)
+
+
+if __name__ == "__main__":
+    main()

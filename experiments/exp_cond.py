@@ -1,0 +1,77 @@
+"""Is the lax.cond in _mxu_grouped_aggregate executing the slow branch?
+
+Times grouped_aggregate with the cond monkeypatched to always take the
+fast branch, vs stock.
+"""
+import sys
+import time
+
+sys.path.append("/root/repo")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/spark_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+from spark_tpu.columnar import ColumnBatch, ColumnVector
+from spark_tpu import types as T
+from spark_tpu.kernels import grouped_aggregate
+import spark_tpu.kernels as K
+from spark_tpu.expressions import col
+from spark_tpu.aggregates import Sum, CountStar
+
+N = 1 << 22
+GROUPS = 1024
+ITERS = 5
+
+rng = np.random.default_rng(7)
+keys_j = jnp.asarray(rng.integers(0, GROUPS, N).astype(np.int64))
+vals_j = jnp.asarray(rng.integers(0, 100, N).astype(np.int64))
+
+key_exprs = [col("k")]
+slots = [(Sum(col("v")), "s"), (CountStar(), "c")]
+
+
+def step(bump):
+    b = ColumnBatch(
+        ["k", "v"],
+        [ColumnVector(keys_j ^ (bump & jnp.int64(GROUPS - 1)), T.LongType(),
+                      None, None),
+         ColumnVector(vals_j + bump, T.LongType(), None, None)],
+        None, N)
+    out = grouped_aggregate(jnp, b, key_exprs, slots)
+    return out.vectors[1].data[:32].sum() & jnp.int64(1)
+
+
+def loop_time(name):
+    @jax.jit
+    def run(_x):
+        def body(i, acc):
+            return acc + step(i.astype(jnp.int64))
+        return jax.lax.fori_loop(0, ITERS, body, jnp.int64(0))
+    r = jax.block_until_ready(run(0))
+    t0 = time.perf_counter()
+    r = jax.block_until_ready(run(0))
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{name:34s} {dt*1e3:9.3f} ms/iter   {N/dt/1e6:10.1f} M rows/s",
+          flush=True)
+
+
+which = sys.argv[1] if len(sys.argv) > 1 else "fast"
+if which == "fast":
+    # monkeypatch: always take branch index 0 path = true_fn? lax.cond(pred, t, f)
+    real_cond = jax.lax.cond
+    def fast_cond(pred, true_fn, false_fn, *ops):
+        return true_fn(*ops)
+    jax.lax.cond = fast_cond
+    loop_time("fast branch only (no cond)")
+elif which == "slow":
+    real_cond = jax.lax.cond
+    def slow_cond(pred, true_fn, false_fn, *ops):
+        return false_fn(*ops)
+    jax.lax.cond = slow_cond
+    loop_time("slow branch only (sort-based)")
+else:
+    loop_time("stock (lax.cond)")

@@ -1,0 +1,73 @@
+"""Test: does XLA fuse limb-extraction into the einsum, recomputing per
+bucket tile?  Compare with/without optimization_barrier, plus einsum from
+int64-derived planes.
+"""
+import sys
+import time
+
+sys.path.append("/root/repo")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/spark_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_enable_x64", True)
+
+N = 1 << 22
+B = 4096
+GROUPS = 1024
+ITERS = 5
+L = 2048
+
+rng = np.random.default_rng(7)
+keys_j = jnp.asarray(rng.integers(0, GROUPS, N).astype(np.int64))
+vals_j = jnp.asarray(rng.integers(0, 100, N).astype(np.int64))
+
+
+def build(bump, barrier):
+    kdata = keys_j ^ (bump & jnp.int64(GROUPS - 1))
+    vdata = vals_j + bump
+    kmin = kdata.min()
+    bucket32 = jnp.clip(kdata - kmin, 0, B - 1).astype(jnp.int32)
+    live = jnp.ones(N, jnp.bfloat16)
+    shifted = vdata.astype(jnp.uint64) + jnp.uint64(1 << 63)
+    planes = [live]
+    for i in range(8):
+        limb = ((shifted >> jnp.uint64(8 * i)) & jnp.uint64(0xFF))
+        planes.append(limb.astype(jnp.bfloat16))
+    planes.append(live)
+    plane_mat = jnp.stack(planes, -1)            # (N, 11)
+    if barrier:
+        plane_mat, bucket32 = jax.lax.optimization_barrier(
+            (plane_mat, bucket32))
+    T_t = N // L
+    bb = bucket32.reshape(T_t, L)
+    pp = plane_mat.reshape(T_t, L, 10)
+    oh = jax.nn.one_hot(bb, B, dtype=jnp.bfloat16)
+    if barrier == 2:
+        oh = jax.lax.optimization_barrier(oh)
+    per_tile = jnp.einsum("tlb,tlp->tbp", oh, pp,
+                          preferred_element_type=jnp.float32)
+    tot = per_tile.astype(jnp.int32).sum(0)
+    return tot[:32].sum().astype(jnp.int64) & jnp.int64(1)
+
+
+def loop_time(name, barrier):
+    @jax.jit
+    def run(_x):
+        def body(i, acc):
+            return acc + build(i.astype(jnp.int64), barrier)
+        return jax.lax.fori_loop(0, ITERS, body, jnp.int64(0))
+    r = jax.block_until_ready(run(0))
+    t0 = time.perf_counter()
+    r = jax.block_until_ready(run(0))
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{name:34s} {dt*1e3:9.3f} ms/iter   {N/dt/1e6:10.1f} M rows/s",
+          flush=True)
+
+
+loop_time("no barrier (kernel-like)", 0)
+loop_time("barrier before one_hot", 1)
+loop_time("barrier incl oh", 2)

@@ -1,0 +1,76 @@
+"""Time the INTEGRATED grouped_aggregate (pallas path) on real TPU,
+same methodology as bench.py."""
+import sys
+import time
+
+sys.path.append("/root/repo")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/spark_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+from spark_tpu.columnar import ColumnBatch, ColumnVector
+from spark_tpu import types as T
+from spark_tpu.kernels import grouped_aggregate
+from spark_tpu.expressions import Col
+from spark_tpu.aggregates import Sum, CountStar
+
+N = 1 << 22
+GROUPS = 1024
+ITERS = 10
+
+rng = np.random.default_rng(7)
+keys_np = rng.integers(0, GROUPS, N).astype(np.int64)
+vals_np = rng.integers(0, 100, N).astype(np.int64)
+keys_j = jnp.asarray(keys_np)
+vals_j = jnp.asarray(vals_np)
+
+key_exprs = [Col("k")]
+slots = [(Sum(Col("v")), "s"), (CountStar(), "c")]
+
+
+def step(bump):
+    b = ColumnBatch(
+        ["k", "v"],
+        [ColumnVector(keys_j ^ (bump & jnp.int64(GROUPS - 1)), T.LongType(),
+                      None, None),
+         ColumnVector(vals_j + bump, T.LongType(), None, None)],
+        None, N)
+    out = grouped_aggregate(jnp, b, key_exprs, slots)
+    return out
+
+
+# correctness gate vs numpy oracle (unperturbed)
+print("compiling correctness gate...", flush=True)
+out0 = jax.jit(lambda: step(jnp.int64(0)))()
+got_k = np.asarray(out0.vectors[0].data)
+got_s = np.asarray(out0.vectors[1].data)
+rv = np.asarray(out0.row_valid_or_true())
+live_k = got_k[rv][:GROUPS]
+live_s = got_s[rv][:GROUPS]
+expect = np.zeros(GROUPS, np.int64)
+np.add.at(expect, keys_np, vals_np)
+order = np.argsort(live_k)
+assert len(live_k) == GROUPS, len(live_k)
+assert np.array_equal(live_s[order], expect), "sum mismatch vs oracle"
+print("correctness OK", flush=True)
+
+
+@jax.jit
+def run(_x):
+    def body(i, acc):
+        out = step(i.astype(jnp.int64))
+        return acc + (out.vectors[1].data[:32].sum() & jnp.int64(1))
+    return jax.lax.fori_loop(0, ITERS, body, jnp.int64(0))
+
+
+print("compiling loop...", flush=True)
+r = jax.block_until_ready(run(0))
+t0 = time.perf_counter()
+r = jax.block_until_ready(run(0))
+dt = (time.perf_counter() - t0) / ITERS
+print(f"integrated pallas agg: {dt*1e3:.3f} ms/iter  "
+      f"{N/dt/1e6:.1f} M rows/s  vs_baseline={N/dt/93.5e6:.2f}", flush=True)

@@ -1,0 +1,151 @@
+"""Find where the 550ms/iter goes in the bench hash-agg pipeline.
+
+Times, with the same fori_loop+perturb methodology:
+  1. full physical.run (as bench does, minus compact/slice)
+  2. grouped_aggregate kernel alone on a prebuilt device batch
+  3. MXU fast path manually: bucket+limb extraction+einsum (no cond)
+  4. limb extraction alone
+  5. bucket-code computation alone
+"""
+import sys
+import time
+
+sys.path.append("/root/repo")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/spark_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+from spark_tpu.columnar import ColumnBatch, ColumnVector
+from spark_tpu.kernels import compact, grouped_aggregate, _mxu_grouped_aggregate
+from spark_tpu.sql import functions as F
+from spark_tpu.sql import physical as P
+from spark_tpu.sql.planner import QueryExecution
+from spark_tpu.sql.session import SparkSession
+
+N = 1 << 22
+GROUPS = 1024
+ITERS = 5
+
+rng = np.random.default_rng(7)
+keys = rng.integers(0, GROUPS, N).astype(np.int64)
+vals = rng.integers(0, 100, N).astype(np.int64)
+
+session = SparkSession.builder.appName("exp").getOrCreate()
+session.conf.set("spark.tpu.mesh.shards", "1")
+df = session.createDataFrame({"k": keys, "v": vals})
+q = df.groupBy("k").agg(F.sum("v").alias("s"), F.count("*").alias("c"))
+pq = QueryExecution(session, q._plan).planned
+physical = pq.physical
+dev_leaves = tuple(b.to_device() for b in pq.leaves)
+
+
+def perturb(leaves, bump):
+    out = []
+    for b in leaves:
+        vecs = []
+        for name, v in zip(b.names, b.vectors):
+            if name == "v":
+                data = v.data + bump
+            elif name == "k":
+                data = v.data ^ (bump & jnp.int64(GROUPS - 1))
+            else:
+                data = v.data
+            vecs.append(ColumnVector(data, v.dtype, v.valid, v.dictionary))
+        out.append(ColumnBatch(b.names, vecs, b.row_valid, b.capacity))
+    return tuple(out)
+
+
+def loop_time(name, step_fn):
+    """step_fn(leaves, bump) -> scalar dependency"""
+    @jax.jit
+    def run(leaves):
+        def body(i, acc):
+            return acc + step_fn(leaves, i.astype(jnp.int64))
+        return jax.lax.fori_loop(0, ITERS, body, jnp.int64(0))
+    r = jax.block_until_ready(run(dev_leaves))
+    t0 = time.perf_counter()
+    r = jax.block_until_ready(run(dev_leaves))
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{name:34s} {dt*1e3:9.3f} ms/iter   {N/dt/1e6:10.1f} M rows/s",
+          flush=True)
+
+
+# 1. full plan
+def step_full(leaves, bump):
+    pb = perturb(leaves, bump)
+    ctx = P.ExecContext(jnp, pb)
+    out = physical.run(ctx)
+    return out.vectors[1].data[:32].sum() & jnp.int64(1)
+
+# 2. grouped_aggregate alone
+agg_node = None
+node = physical
+while node is not None:
+    if node.__class__.__name__ in ("PAggregate", "PHashAggregate"):
+        agg_node = node
+        break
+    node = getattr(node, "child", None)
+pass
+  
+
+def get_chain(n):
+    out = []
+    while n is not None:
+        out.append(n.__class__.__name__)
+        n = getattr(n, "child", None)
+    return out
+print("plan chain:", get_chain(physical))
+
+keys_j = jnp.asarray(keys)
+vals_j = jnp.asarray(vals)
+from spark_tpu import types as T
+batch0 = ColumnBatch(
+    ["k", "v"],
+    [ColumnVector(keys_j, T.LongType(), None, None),
+     ColumnVector(vals_j, T.LongType(), None, None)],
+    None, N)
+
+from spark_tpu.expressions import col
+from spark_tpu.aggregates import Sum, CountStar
+key_exprs = [col("k")]
+slots = [(Sum(col("v")), "s"), (CountStar(), "c")]
+
+def step_agg(leaves, bump):
+    b = ColumnBatch(
+        ["k", "v"],
+        [ColumnVector(keys_j ^ (bump & jnp.int64(GROUPS - 1)), T.LongType(),
+                      None, None),
+         ColumnVector(vals_j + bump, T.LongType(), None, None)],
+        None, N)
+    out = grouped_aggregate(jnp, b, key_exprs, slots)
+    return out.vectors[1].data[:32].sum() & jnp.int64(1)
+
+# 4. limb extraction alone (8 limbs, uint64 emulation)
+def step_limbs(leaves, bump):
+    data = vals_j + bump
+    shifted = data.astype(jnp.uint64) + jnp.uint64(1 << 63)
+    acc = jnp.zeros((), jnp.bfloat16)
+    planes = []
+    for i in range(8):
+        limb = ((shifted >> jnp.uint64(8 * i)) & jnp.uint64(0xFF))
+        planes.append(limb.astype(jnp.bfloat16))
+    return jnp.stack(planes, -1)[::65536].sum().astype(jnp.int64) & jnp.int64(1)
+
+# 5. bucket codes alone
+def step_bucket(leaves, bump):
+    data = keys_j ^ (bump & jnp.int64(GROUPS - 1))
+    kmin = data.min()
+    kmax = data.max()
+    code = data - kmin
+    b32 = jnp.clip(code, 0, 4095).astype(jnp.int32)
+    return b32[::65536].sum().astype(jnp.int64) & jnp.int64(1)
+
+
+loop_time("bucket codes alone", step_bucket)
+loop_time("limb extraction alone", step_limbs)
+loop_time("grouped_aggregate kernel", step_agg)
+loop_time("full physical.run", step_full)

@@ -704,9 +704,6 @@ class IsNotNull(Expression):
 
 
 class IsNaN(Expression):
-    def __repr__(self):
-        return f"isnan({self.children[0]!r})"
-
     def __init__(self, child):
         self.children = (child,)
 
@@ -887,9 +884,6 @@ class Between(Expression):
 
 
 class Greatest(Expression):
-    def __repr__(self):
-        return f"{type(self).__name__.lower()}({', '.join(map(repr, self.children))})"
-
     def __init__(self, *children):
         self.children = tuple(children)
 

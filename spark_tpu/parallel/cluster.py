@@ -1,0 +1,217 @@
+"""Multi-host cluster runtime: initialization, topology, health.
+
+The reference runs a driver + standalone/YARN/K8s executors over Netty RPC
+(``core/src/main/scala/org/apache/spark/deploy/``, ``rpc/netty/``,
+``HeartbeatReceiver.scala:43``, executor blacklisting in
+``scheduler/HealthTracker.scala``).  A TPU pod inverts that shape: every
+host runs THE SAME single program (multi-controller SPMD), the data plane
+is XLA collectives over ICI/DCN — never host RPC — and the only
+control-plane traffic left is liveness + coordination, which
+``jax.distributed`` already bootstraps (rendezvous, device discovery,
+barrier).  So this module is deliberately small:
+
+- ``init_cluster``     → ``jax.distributed.initialize`` + mesh axes over
+  (dcn, ici): the hybrid mesh every sharding in the engine composes with.
+  Axis layout follows the scaling-book recipe: data/batch outermost on
+  DCN (pure all-reduce traffic tolerates low bandwidth), everything that
+  all-to-alls or all-gathers rides ICI inside a slice.
+- ``HeartbeatMonitor`` → the HeartbeatReceiver analog for the parts XLA
+  does NOT cover: detecting a hung peer BEFORE a collective deadlocks on
+  it.  Hosts append monotonic beats to a shared rendezvous directory (the
+  cluster filesystem that any multi-host TPU deployment already has for
+  checkpoints); a host whose beat goes stale past the timeout is reported
+  dead so the driver can abort the step instead of hanging in NCCL-style
+  silence.  File-based beats need no listener threads on the data path
+  and survive any networking the pod has.
+- ``ClusterInfo``      → process/host/device topology introspection
+  (``SparkContext.statusTracker`` analog).
+
+Failure response is restart-from-checkpoint (streaming WAL / query rerun),
+matching the lineage-free recovery model SURVEY §2.14 prescribes: TPU
+SPMD cannot surgically replace one executor mid-collective the way the
+reference reschedules one lost task.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+
+from .. import config as C
+
+HEARTBEAT_INTERVAL = C.conf("spark.tpu.cluster.heartbeatIntervalMs").doc(
+    "Milliseconds between liveness beats (spark.executor.heartbeatInterval "
+    "analog)."
+).int(1000)
+
+HEARTBEAT_TIMEOUT = C.conf("spark.tpu.cluster.heartbeatTimeoutMs").doc(
+    "A host whose last beat is older than this is declared dead "
+    "(spark.network.timeout analog)."
+).int(10000)
+
+
+class ClusterInfo:
+    """Topology of the running SPMD program."""
+
+    def __init__(self):
+        self.process_index = jax.process_index()
+        self.process_count = jax.process_count()
+        self.local_devices = jax.local_devices()
+        self.global_devices = jax.devices()
+
+    def __repr__(self):
+        return (f"ClusterInfo(process {self.process_index}/"
+                f"{self.process_count}, {len(self.local_devices)} local / "
+                f"{len(self.global_devices)} global devices)")
+
+
+def init_cluster(coordinator_address: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None) -> ClusterInfo:
+    """Join (or bootstrap) the multi-controller SPMD cluster.
+
+    On managed TPU pods jax.distributed autodetects everything; explicit
+    args cover manual/standalone deployment (the spark-standalone analog:
+    coordinator = master URL, process_id = executor id)."""
+    if jax.process_count() == 1 and (coordinator_address or
+                                     num_processes not in (None, 1)):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+    return ClusterInfo()
+
+
+def hybrid_mesh(ici_axis: str = "data", dcn_axis: str = "dcn",
+                devices: Optional[List] = None):
+    """(dcn, ici) mesh: DCN outermost so cross-slice traffic is the
+    batch/data axis's all-reduces; all-to-all heavy exchanges stay on ICI.
+
+    Single-slice (process_count==1) degenerates to a 1-D ici mesh, so
+    engine code can unconditionally compose with both axis names."""
+    from jax.sharding import Mesh
+    devs = devices if devices is not None else jax.devices()
+    n_proc = max(jax.process_count(), 1)
+    per = len(devs) // n_proc if n_proc > 1 else len(devs)
+    arr = np.array(devs[:n_proc * per]).reshape(n_proc, per)
+    return Mesh(arr, (dcn_axis, ici_axis))
+
+
+# ---------------------------------------------------------------------------
+# heartbeats / failure detection
+# ---------------------------------------------------------------------------
+
+class HeartbeatMonitor:
+    """File-based liveness beats over a shared directory.
+
+    Each host writes ``beat_<pid>.json`` {host_id, seq, ts} every
+    interval; ``dead_hosts()`` reports hosts stale past the timeout.
+    ``on_failure`` callbacks fire once per newly-dead host (the
+    ``HeartbeatReceiver.expireDeadHosts`` analog).
+    """
+
+    def __init__(self, beat_dir: str, host_id: Optional[str] = None,
+                 conf=None, clock: Callable[[], float] = time.monotonic):
+        conf = conf or C.Conf()
+        self.beat_dir = beat_dir
+        self.host_id = host_id if host_id is not None else \
+            f"host-{jax.process_index()}"
+        self.interval_s = conf.get(HEARTBEAT_INTERVAL) / 1000.0
+        self.timeout_s = conf.get(HEARTBEAT_TIMEOUT) / 1000.0
+        self._clock = clock
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._known_dead: set = set()
+        self._callbacks: List[Callable[[str], None]] = []
+        os.makedirs(beat_dir, exist_ok=True)
+
+    # -- beats --------------------------------------------------------------
+    def beat(self) -> None:
+        """Write one liveness beat (atomic rename, shared-fs safe)."""
+        self._seq += 1
+        path = os.path.join(self.beat_dir, f"beat_{self.host_id}.json")
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"host": self.host_id, "seq": self._seq,
+                       "ts": self._clock()}, f)
+        os.replace(tmp, path)
+
+    def start(self) -> None:
+        """Background beat thread (daemon; never on the data path)."""
+        if self._thread is not None:
+            return
+
+        def run():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.beat()
+                except Exception:
+                    pass
+
+        self.beat()
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name=f"heartbeat-{self.host_id}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval_s)
+            self._thread = None
+
+    # -- detection ----------------------------------------------------------
+    def on_failure(self, cb: Callable[[str], None]) -> None:
+        self._callbacks.append(cb)
+
+    def snapshot(self) -> Dict[str, dict]:
+        out = {}
+        try:
+            names = os.listdir(self.beat_dir)
+        except FileNotFoundError:
+            return out
+        for name in names:
+            if not name.startswith("beat_") or not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.beat_dir, name)) as f:
+                    rec = json.load(f)
+                out[rec["host"]] = rec
+            except Exception:
+                continue        # torn write: the NEXT beat will be whole
+        return out
+
+    def dead_hosts(self) -> List[str]:
+        """Hosts whose last beat is stale; fires callbacks for new deaths."""
+        now = self._clock()
+        dead = []
+        for host, rec in self.snapshot().items():
+            if host == self.host_id:
+                continue
+            if now - rec["ts"] > self.timeout_s:
+                dead.append(host)
+        for host in dead:
+            if host not in self._known_dead:
+                self._known_dead.add(host)
+                for cb in self._callbacks:
+                    try:
+                        cb(host)
+                    except Exception:
+                        pass
+        return sorted(dead)
+
+    def check_or_raise(self) -> None:
+        """Barrier guard: call before entering a collective region so a
+        dead peer aborts the step instead of deadlocking it."""
+        dead = self.dead_hosts()
+        if dead:
+            raise RuntimeError(
+                f"hosts {dead} missed heartbeats for > {self.timeout_s}s; "
+                "aborting step (restart from last checkpoint)")

@@ -447,6 +447,38 @@ class GroupedData:
         plan = build_aggregate(self._keys, exprs, self._df._plan)
         return DataFrame(self._df.session, plan)
 
+    def flatMapGroupsWithState(self, func, outputStructType,
+                               outputMode: str = "append",
+                               timeoutConf: str = "NoTimeout") -> DataFrame:
+        """Arbitrary stateful per-group processing
+        (``flatMapGroupsWithState`` / pyspark's applyInPandasWithState).
+
+        ``func(key_tuple, rows, state)`` → iterable of output tuples.  On a
+        stream, ``state`` persists across micro-batches (versioned state
+        store) and, with ``timeoutConf='EventTimeTimeout'``, times out by
+        watermark; in batch mode each group sees one fresh state."""
+        if timeoutConf not in ("NoTimeout", "EventTimeTimeout"):
+            raise AnalysisException(
+                f"unsupported timeoutConf {timeoutConf!r}; processing-time "
+                "timeouts do not replay deterministically — use "
+                "EventTimeTimeout")
+        if outputMode not in ("append", "update"):
+            raise AnalysisException(
+                "flatMapGroupsWithState supports append/update output modes")
+        key_names = []
+        for k in self._keys:
+            base = k.children[0] if isinstance(k, Alias) else k
+            if not isinstance(base, Col):
+                raise AnalysisException(
+                    "flatMapGroupsWithState grouping keys must be plain "
+                    "columns")
+            key_names.append(k.name)
+        return DataFrame(self._df.session, L.FlatMapGroupsWithState(
+            func, key_names, outputStructType, outputMode, timeoutConf,
+            self._df._plan))
+
+    applyInPandasWithState = flatMapGroupsWithState
+
     def count(self) -> DataFrame:
         return self.agg(Column(Alias(CountStar(), "count")))
 

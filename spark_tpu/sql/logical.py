@@ -92,27 +92,41 @@ def _batch_uid(batch) -> int:
     return uid
 
 
-def plan_cache_key(node: "LogicalPlan") -> str:
+def plan_cache_key(node: "LogicalPlan", _memo: Optional[dict] = None) -> str:
     """Stable fingerprint of a logical subtree for cached-relation lookup
     (``CacheManager.lookupCachedData`` plan matching).  Reprs alone are NOT
     trusted — several are elided for humans (Aggregate shows output names,
     not functions) — so the key serializes every non-child field of the
-    node plus its expressions.  LocalRelation keys on a monotonic batch
-    uid: two different in-memory datasets must never alias."""
+    node plus its expressions.  Identity-carrying fields never use raw
+    ``repr``/``id`` (recyclable addresses): LocalRelation keys on a
+    monotonic batch uid and callables (flatMapGroupsWithState functions)
+    on a uid attached the same way.  Pass one ``_memo`` dict across many
+    calls over a shared tree to stay O(n)."""
+    if _memo is not None:
+        hit = _memo.get(id(node))
+        if hit is not None:
+            return hit
     if isinstance(node, LocalRelation):
-        return f"LocalRelation#{_batch_uid(node.batch)}"
-    fields = []
-    for name in sorted(vars(node)):
-        if name in ("children", "child") or name.startswith("_"):
-            continue
-        v = vars(node)[name]
-        if isinstance(v, LogicalPlan) or (
-                isinstance(v, (list, tuple)) and v
-                and isinstance(v[0], LogicalPlan)):
-            continue
-        fields.append(f"{name}={v!r}")
-    inner = ",".join(plan_cache_key(c) for c in node.children)
-    return f"{type(node).__name__}[{';'.join(fields)}]({inner})"
+        key = f"LocalRelation#{_batch_uid(node.batch)}"
+    else:
+        fields = []
+        for name in sorted(vars(node)):
+            if name in ("children", "child") or name.startswith("_"):
+                continue
+            v = vars(node)[name]
+            if isinstance(v, LogicalPlan) or (
+                    isinstance(v, (list, tuple)) and v
+                    and isinstance(v[0], LogicalPlan)):
+                continue
+            if callable(v) and not isinstance(v, type):
+                fields.append(f"{name}=fn#{_batch_uid(v)}")
+            else:
+                fields.append(f"{name}={v!r}")
+        inner = ",".join(plan_cache_key(c, _memo) for c in node.children)
+        key = f"{type(node).__name__}[{';'.join(fields)}]({inner})"
+    if _memo is not None:
+        _memo[id(node)] = key
+    return key
 
 
 class LocalRelation(LogicalPlan):
@@ -396,6 +410,33 @@ class Union(LogicalPlan):
 
     def __repr__(self):
         return f"Union({len(self.children)})"
+
+
+class FlatMapGroupsWithState(LogicalPlan):
+    """Arbitrary stateful per-group processing
+    (``FlatMapGroupsWithStateExec.scala``).  ``func(key, rows, state)``
+    yields output tuples matching ``out_schema``; in batch mode every
+    group sees a fresh empty state (reference batch semantics)."""
+
+    def __init__(self, func, key_names: List[str], out_schema: T.StructType,
+                 output_mode: str, timeout_conf: str, child: LogicalPlan):
+        self.func = func
+        self.key_names = list(key_names)
+        self.out_schema = out_schema
+        self.output_mode = output_mode
+        self.timeout_conf = timeout_conf
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def schema(self) -> T.StructType:
+        return self.out_schema
+
+    def __repr__(self):
+        return (f"FlatMapGroupsWithState[{self.key_names}] "
+                f"{self.out_schema.simpleString()} mode={self.output_mode}")
 
 
 class EventTimeWatermark(LogicalPlan):

@@ -51,6 +51,17 @@ from .window import WindowNode
 
 _log = logging.getLogger("spark_tpu.multibatch")
 
+MULTIBATCH_CKPT = C.conf("spark.tpu.multibatch.checkpointDir").doc(
+    "Directory for multi-batch run checkpoints (merger state + scan "
+    "cursor); empty = no checkpointing.  A rerun of the same query over "
+    "unchanged files resumes at the last checkpointed batch."
+).string("")
+
+MULTIBATCH_CKPT_INTERVAL = C.conf("spark.tpu.multibatch.checkpointInterval"
+                                  ).doc(
+    "Scan batches between checkpoints when checkpointDir is set."
+).int(32)
+
 
 # ---------------------------------------------------------------------------
 # plan decomposition
@@ -533,38 +544,112 @@ class MultiBatchExecution:
                 for i in needed}
 
     # -- main loop -------------------------------------------------------
+    # -- checkpoint/restart (fault tolerance, DAGScheduler-retry analog) --
+    #
+    # A multi-batch run over a huge dataset is the one execution in the
+    # engine long enough to be worth resuming: every CKPT_INTERVAL scan
+    # batches the merger (host numpy state + spill-file references) and the
+    # batch cursor are pickled atomically; a rerun of the same query over
+    # the same files resumes at the cursor instead of rescanning.  Scan
+    # order is deterministic (sorted files, fixed batch_rows), which is
+    # what makes the cursor meaningful.  The reference's lineage-based
+    # per-task retry has no SPMD analog — checkpoint/resume is the TPU
+    # answer (SURVEY §2.14).
+    def _ckpt_path(self) -> Optional[str]:
+        import hashlib
+        ckpt_dir = self.session.conf.get(MULTIBATCH_CKPT)
+        if not ckpt_dir:
+            return None
+        rel = self.dec.rel
+        ident = [repr(self.dec.spine), str(self.batch_rows)]
+        for p in sorted(rel.paths):
+            ident.append(p)
+            try:
+                ident.append(str(os.stat(p).st_mtime_ns))
+            except OSError:
+                pass
+        key = hashlib.sha1("|".join(ident).encode()).hexdigest()[:16]
+        os.makedirs(ckpt_dir, exist_ok=True)
+        return os.path.join(ckpt_dir, f"mb-{key}.ckpt")
+
+    def _ckpt_save(self, path: str, n_batches: int, merger) -> None:
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump({"n": n_batches, "merger": merger}, f,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+        except Exception as e:   # a failed checkpoint must not fail the run
+            _log.warning("multi-batch checkpoint to %s failed: %s", path, e)
+
+    def _ckpt_load(self, path: Optional[str]):
+        if not path or not os.path.exists(path):
+            return 0, None
+        try:
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
+            spill = getattr(payload["merger"], "spill", None)
+            if spill is not None:
+                for run in spill._disk:
+                    if not os.path.exists(run):   # spill files vanished
+                        raise FileNotFoundError(run)
+            _log.info("resuming multi-batch run at batch %d from %s",
+                      payload["n"], path)
+            return payload["n"], payload["merger"]
+        except Exception as e:           # torn/stale checkpoint: start over
+            _log.warning("ignoring unusable checkpoint %s: %s", path, e)
+            return 0, None
+
     def execute(self) -> ColumnBatch:
         from ..io import (
             reencode_strings, scan_file_batches, scan_string_dictionaries,
         )
         rel = self.dec.rel
         fixed_dicts = scan_string_dictionaries(rel, self.batch_rows)
+        ckpt = self._ckpt_path()
+        interval = self.session.conf.get(MULTIBATCH_CKPT_INTERVAL)
+        skip, merger = self._ckpt_load(ckpt)
         jstep = None
-        merger = None
         n_batches = 0
+        completed = False
         try:
             for raw in scan_file_batches(rel, self.batch_rows):
                 b = reencode_strings(raw, fixed_dicts)
                 b = normalize_valids(pad_to_capacity(b, self.capacity))
                 if jstep is None:
                     jstep, spine_schema = self._build_step(b)
-                    merger = self._make_merger(spine_schema, b)
+                    if merger is None:
+                        merger = self._make_merger(spine_schema, b)
+                n_batches += 1
+                if n_batches <= skip:
+                    continue             # already folded into the merger
                 out_dev, n = jstep(b.to_device())
                 host = _slice_to_host(out_dev, int(np.asarray(n)))
-                n_batches += 1
                 if not merger.add(host):
                     _log.info("multi-batch scan early exit after %d batches",
                               n_batches)
                     break
+                if ckpt and interval > 0 and n_batches % interval == 0:
+                    self._ckpt_save(ckpt, n_batches, merger)
             if merger is None:
                 raise RuntimeError(f"empty file relation {rel!r}")
             _log.info("multi-batch scan: %d batches of <=%d rows merged",
                       n_batches, self.batch_rows)
             result = merger.finish()
+            completed = True
         finally:
+            # with checkpointing ON, spill run files referenced by the
+            # checkpoint must SURVIVE a crash — that is the whole point;
+            # they are cleaned on successful completion (below) or by the
+            # next run's resume/restart
             spill = getattr(merger, "spill", None)
-            if spill is not None:
+            if spill is not None and (not ckpt or completed):
                 spill.close()          # crash-safe: no leaked run files
+        if ckpt and os.path.exists(ckpt):
+            try:
+                os.remove(ckpt)        # completed: cursor is obsolete
+            except OSError:
+                pass
         return self._run_above(result)
 
     def _host_spine_probe(self, template: ColumnBatch) -> ColumnBatch:

@@ -129,6 +129,18 @@ class Planner:
             return self._scan(batch, leaves)
         if isinstance(node, SubqueryAlias):
             return self._to_physical(node.child, leaves)
+        from .logical import FlatMapGroupsWithState
+        if isinstance(node, FlatMapGroupsWithState):
+            # host-side user function: the child sub-plan runs as its own
+            # query, the function runs per group with a fresh batch-mode
+            # state, and the result enters THIS plan as a scanned leaf
+            # (FlatMapGroupsWithStateExec batch semantics)
+            from ..streaming.groupstate import run_flat_map_groups
+            child = QueryExecution(self.session, node.child).execute()
+            out, _states, _ch, _rm = run_flat_map_groups(
+                node.func, node.key_names, child, node.out_schema, {},
+                watermark_us=None, timeout_conf=node.timeout_conf)
+            return self._scan(out, leaves)
         from .logical import EventTimeWatermark
         if isinstance(node, EventTimeWatermark):
             return self._to_physical(node.children[0], leaves)  # batch no-op
@@ -190,11 +202,12 @@ class QueryExecution:
         if cache is None or not cache._entries:
             return plan
         from .logical import plan_cache_key
+        memo: dict = {}               # one memo across the walk: O(n) keys
 
         def sub(node: LogicalPlan) -> LogicalPlan:
             if isinstance(node, LocalRelation):
                 return node           # never probe: not substitutable, and
-            hit = cache.get(plan_cache_key(node))   # get() has side effects
+            hit = cache.get(plan_cache_key(node, memo))  # get() bumps LRU
             if hit is not None:
                 return LocalRelation(hit)
             return node

@@ -48,6 +48,17 @@ class DataStreamReader:
         if self._fmt == "rate":
             rps = int(self._options.get("rowspersecond", "1"))
             src = RateStreamSource(rps)
+        elif self._fmt == "socket":
+            from .core import SocketSource
+            host = self._options.get("host")
+            port = self._options.get("port")
+            if not host or not port:
+                raise AnalysisException(
+                    "socket source requires host and port options")
+            src = SocketSource(host, int(port))
+        elif self._fmt == "kafka":
+            from .core import KafkaSourceUnavailable
+            src = KafkaSourceUnavailable()
         else:
             if path is None:
                 raise AnalysisException("streaming load() requires a path")

@@ -752,6 +752,52 @@ class StreamExecution:
     # aggregates in the plan and reject shapes the incremental path cannot
     # run, instead of silently falling back to per-batch execution.
     def _build_agg_state(self) -> Optional[AggregationState]:
+        # arbitrary stateful processing (FlatMapGroupsWithStateExec)
+        fmgws = [n for n in _find_nodes(self.plan, L.FlatMapGroupsWithState)
+                 if _find_streaming(n)]
+        if fmgws:
+            if len(fmgws) > 1:
+                raise AnalysisException(
+                    "multiple flatMapGroupsWithState operators are not "
+                    "supported on one stream")
+            node = fmgws[0]
+            others = ([a for a in _find_nodes(self.plan, L.Aggregate)
+                       if _find_streaming(a)]
+                      + [d for d in _find_nodes(self.plan, L.Distinct)
+                         if _find_streaming(d)])
+            if others:
+                raise AnalysisException(
+                    "flatMapGroupsWithState cannot be combined with "
+                    "streaming aggregation/deduplication in one query")
+            walk = self.plan
+            while walk is not node:
+                if not isinstance(walk, (L.Project, L.Filter)) \
+                        or len(walk.children) != 1:
+                    raise AnalysisException(
+                        f"flatMapGroupsWithState under "
+                        f"{type(walk).__name__} cannot run incrementally")
+                walk = walk.children[0]
+            if node.timeout_conf == "EventTimeTimeout" \
+                    and self._wm_col is None:
+                raise AnalysisException(
+                    "EventTimeTimeout requires withWatermark on the stream")
+            if self.mode == "complete":
+                raise AnalysisException(
+                    "complete output mode is not supported for "
+                    "flatMapGroupsWithState (its output is incremental "
+                    "operator output, not a result table)")
+            self._fmgws_node = node
+            from .state import StateStoreProvider
+            self._fmgws_provider = (
+                StateStoreProvider(self.checkpoint, operator_id=0,
+                                   conf=self.session.conf_obj)
+                if self.checkpoint else None)
+            self._fmgws_states: dict = {}
+            self._agg_node = None
+            return None
+        self._fmgws_node = None
+        self._fmgws_provider = None
+        self._fmgws_states = {}
         # streaming dropDuplicates: a Distinct (all columns) or an
         # all-First Aggregate (dropDuplicates(subset)) over the stream
         # becomes stateful deduplication (StreamingDeduplicateExec)
@@ -892,6 +938,11 @@ class StreamExecution:
         if last_commit is not None and self._dedup_state is not None \
                 and self.state_dir:
             self._dedup_state.restore(self.state_dir, last_commit)
+        if last_commit is not None and self._fmgws_node is not None \
+                and self._fmgws_provider is not None:
+            # state after committed batch b lives at version b+1
+            self._fmgws_states = dict(
+                self._fmgws_provider.get_store(last_commit + 1).iterator())
         if last_commit is not None and last_commit == last_offset_batch:
             self.batch_id = last_commit + 1
             self.committed_offset = off["end"]
@@ -945,6 +996,16 @@ class StreamExecution:
             self._agg_state.snapshot(self.state_dir, self.batch_id)
         if self._dedup_state is not None and self.state_dir:
             self._dedup_state.snapshot(self.state_dir, self.batch_id)
+        if self._fmgws_node is not None and self._fmgws_provider is not None:
+            # versioned commit: state AFTER batch b is version b+1; the
+            # change sets from this batch become the delta
+            store = self._fmgws_provider.get_store(self.batch_id)
+            changed, removed = getattr(self, "_fmgws_changes", (set(), set()))
+            for k in changed:
+                store.put(k, self._fmgws_states[k])
+            for k in removed:
+                store.remove(k)
+            store.commit()
         commit_payload = {"ts": time.time()}
         if self._wm_col is not None:
             # persist event-time progress: recovery must not rewind the
@@ -1017,6 +1078,21 @@ class StreamExecution:
 
     def _execute_batch(self, data: ColumnBatch) -> ColumnBatch:
         from ..sql.planner import QueryExecution
+
+        if self._fmgws_node is not None:
+            from .groupstate import run_flat_map_groups
+            node = self._fmgws_node
+            below = self._replace_source(node.child, data)
+            pre = QueryExecution(self.session, below).execute()
+            new_wm = self._advance_watermark()
+            out, new_states, changed, removed = run_flat_map_groups(
+                node.func, node.key_names, pre, node.out_schema,
+                self._fmgws_states, watermark_us=new_wm,
+                timeout_conf=node.timeout_conf)
+            self._fmgws_states = new_states
+            self._fmgws_changes = (changed, removed)
+            above = self._rebuild_above_plan(node, L.LocalRelation(out))
+            return QueryExecution(self.session, above).execute()
 
         if self._dedup_state is not None:
             below = self._replace_source(self._dedup_node.child, data)
@@ -1177,3 +1253,74 @@ class StreamingQuery:
         self._ex.stop()
         from .api import StreamingQueryManager
         StreamingQueryManager.remove(self)
+
+
+class SocketSource(Source):
+    """``socket`` text source (`TextSocketSource.scala`): line-delimited
+    UTF-8 from host:port into a single `value` string column.
+
+    Like the reference's, it is NOT replayable — data is read once off the
+    wire, so recovery cannot replay lost batches; Spark documents the same
+    caveat ("should be used only for testing")."""
+
+    def __init__(self, host: str, port: int):
+        import socket as _socket
+        self._schema = T.StructType([T.StructField("value", T.string)])
+        self._lines: List[str] = []
+        self._lock = threading.Lock()
+        self._sock = _socket.create_connection((host, port), timeout=10)
+        self._stopped = threading.Event()
+
+        def reader():
+            buf = b""
+            try:
+                while not self._stopped.is_set():
+                    chunk = self._sock.recv(4096)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    *lines, buf = buf.split(b"\n")
+                    if lines:
+                        with self._lock:
+                            self._lines.extend(
+                                l.decode("utf-8", "replace") for l in lines)
+            except OSError:
+                pass
+
+        self._thread = threading.Thread(target=reader, daemon=True)
+        self._thread.start()
+
+    def schema(self) -> T.StructType:
+        return self._schema
+
+    def get_offset(self) -> Optional[int]:
+        with self._lock:
+            return len(self._lines) or None
+
+    def get_batch(self, start, end) -> ColumnBatch:
+        s = start or 0
+        with self._lock:
+            rows = self._lines[s:end]
+        return ColumnBatch.from_arrays(
+            {"value": rows}, schema=self._schema) if rows \
+            else ColumnBatch.empty(self._schema)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class KafkaSourceUnavailable(Source):
+    """Placeholder for the `kafka` format: this image has no Kafka client
+    library, so construction fails with the dependency story instead of a
+    bare KeyError (the reference ships kafka support as a separate
+    artifact, `connector/kafka-0-10-sql`, pulled in the same way)."""
+
+    def __init__(self, *_a, **_k):
+        raise AnalysisException(
+            "kafka source requires the kafka-python client, which is not "
+            "installed in this environment; install it and register a "
+            "Source subclass, or use file/socket/rate/memory sources")

@@ -1,0 +1,96 @@
+"""Multi-host runtime: topology, hybrid mesh, heartbeat failure detection.
+
+Heartbeats use a controllable clock — no sleeps, no flakes.
+"""
+import numpy as np
+
+from spark_tpu import config as C
+from spark_tpu.parallel.cluster import (
+    ClusterInfo, HeartbeatMonitor, hybrid_mesh, init_cluster,
+)
+
+
+def test_cluster_info_single_process():
+    info = init_cluster()
+    assert info.process_count == 1
+    assert info.process_index == 0
+    assert len(info.global_devices) >= 1
+    assert "process 0/1" in repr(info)
+
+
+def test_hybrid_mesh_axes():
+    mesh = hybrid_mesh()
+    assert mesh.axis_names == ("dcn", "data")
+    assert mesh.devices.shape[0] == 1          # single controller
+    # sharding over both axes composes
+    from jax.sharding import NamedSharding, PartitionSpec
+    s = NamedSharding(mesh, PartitionSpec(("dcn", "data")))
+    assert s is not None
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _monitor(tmp_path, host, clock):
+    conf = C.Conf()
+    conf.set("spark.tpu.cluster.heartbeatTimeoutMs", "5000")
+    return HeartbeatMonitor(str(tmp_path), host_id=host, conf=conf,
+                            clock=clock)
+
+
+def test_heartbeat_detects_dead_host(tmp_path):
+    clock = _Clock()
+    a = _monitor(tmp_path, "host-a", clock)
+    b = _monitor(tmp_path, "host-b", clock)
+    a.beat()
+    b.beat()
+    assert a.dead_hosts() == []
+    clock.t += 10.0              # b stops beating; 10s > 5s timeout
+    a.beat()
+    assert a.dead_hosts() == ["host-b"]
+    # b resumes: no longer dead
+    b.beat()
+    assert a.dead_hosts() == []
+
+
+def test_heartbeat_failure_callback_fires_once(tmp_path):
+    clock = _Clock()
+    a = _monitor(tmp_path, "host-a", clock)
+    b = _monitor(tmp_path, "host-b", clock)
+    b.beat()
+    seen = []
+    a.on_failure(seen.append)
+    clock.t += 10.0
+    a.dead_hosts()
+    a.dead_hosts()               # second check: callback must NOT refire
+    assert seen == ["host-b"]
+
+
+def test_check_or_raise_aborts_step(tmp_path):
+    import pytest
+    clock = _Clock()
+    a = _monitor(tmp_path, "host-a", clock)
+    b = _monitor(tmp_path, "host-b", clock)
+    b.beat()
+    clock.t += 10.0
+    with pytest.raises(RuntimeError, match="host-b"):
+        a.check_or_raise()
+
+
+def test_heartbeat_background_thread(tmp_path):
+    import time
+    conf = C.Conf()
+    conf.set("spark.tpu.cluster.heartbeatIntervalMs", "20")
+    m = HeartbeatMonitor(str(tmp_path), host_id="host-x", conf=conf)
+    m.start()
+    try:
+        time.sleep(0.15)
+        snap = m.snapshot()
+        assert snap["host-x"]["seq"] >= 2    # beat several times
+    finally:
+        m.stop()

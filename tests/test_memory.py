@@ -46,7 +46,6 @@ def test_low_cardinality_compresses_well():
 def test_dict_encoding_roundtrip():
     rng = np.random.default_rng(4)
     arr = rng.choice(np.array([7, 99, 123456789], np.int64), 5000)
-    lengths_vals = codec_mod.encode_column(arr)
     forced = codec_mod.EncodedColumn(
         "dict", arr.dtype, len(arr), (
             np.searchsorted(np.unique(arr), arr).astype(np.uint16),
@@ -148,14 +147,25 @@ def test_dataframe_cache_substitution_across_dataframes():
     agg = df.groupBy("k").agg(F.sum("v").alias("s"))
     agg.cache()
     assert spark.cacheManager.entries()
-    # an equivalent NEW DataFrame over the same subtree hits the cache:
-    # poison the underlying batch reference so recompute would differ
+    # PROVE substitution happens: poison the cached entry with a marker
+    # batch — an equivalent NEW DataFrame must return the marker, which
+    # recomputation could never produce
+    key = spark.cacheManager.entries()[0]["key"]
+    marker = spark.createDataFrame({
+        "k": np.array([111, 222], np.int64),
+        "s": np.array([1, 2], np.int64)})._execute()
+    spark.cacheManager.put(key, marker)
     agg2 = df.groupBy("k").agg(F.sum("v").alias("s"))
-    rows1 = {r["k"]: r["s"] for r in agg.collect()}
-    rows2 = {r["k"]: r["s"] for r in agg2.collect()}
-    assert rows1 == rows2
+    rows2 = sorted((r["k"], r["s"]) for r in agg2.collect())
+    assert rows2 == [(111, 1), (222, 2)]
+    spark.cacheManager.remove(key)
+    # recompute (cache cleared) returns the true aggregation
+    rows3 = {r["k"]: r["s"] for r in agg2.collect()}
     expect = {}
-    kk, vv = np.asarray(df._execute().vectors[0].data), None
+    for k, v in zip(np.asarray(df._execute().vectors[0].data),
+                    np.asarray(df._execute().vectors[1].data)):
+        expect[int(k)] = expect.get(int(k), 0) + int(v)
+    assert rows3 == expect
     agg.unpersist()
     assert not spark.cacheManager.entries()
 
