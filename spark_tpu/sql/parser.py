@@ -647,6 +647,28 @@ class Parser:
     # -- queries ----------------------------------------------------------
     def parse_query(self) -> LogicalPlan:
         ctes = {}
+        from .subquery import SubqueryExpr
+
+        def subst_plan(p: LogicalPlan) -> LogicalPlan:
+            return p.transform_up(subst).transform_up(subst_exprs)
+
+        def subst(node: LogicalPlan) -> LogicalPlan:
+            if isinstance(node, UnresolvedRelation) and node.name.lower() in ctes:
+                return ctes[node.name.lower()]
+            return node
+
+        def subst_exprs(node: LogicalPlan) -> LogicalPlan:
+            # CTE references inside subquery EXPRESSIONS (scalar/IN/
+            # EXISTS) are invisible to plan-level transform_up
+            if not node.expressions():
+                return node
+
+            def fe(e):
+                if isinstance(e, SubqueryExpr):
+                    return e.with_plan(subst_plan(e.plan))
+                return e.map_children(fe)
+            return node.map_expressions(fe)
+
         if self.accept_kw("WITH"):
             while True:
                 name = self.ident()
@@ -654,33 +676,14 @@ class Parser:
                 self.expect_op("(")
                 sub = self.parse_query()
                 self.expect_op(")")
-                ctes[name.lower()] = SubqueryAlias(name, sub)
+                # CHAINED CTEs (q2/q14/q23 shape): earlier CTEs are in
+                # scope for later bodies, so substitute them NOW — the
+                # registered plan is fully self-contained
+                ctes[name.lower()] = SubqueryAlias(name, subst_plan(sub))
                 if not self.accept_op(","):
                     break
         plan = self._set_op_query()
         if ctes:
-            from .subquery import SubqueryExpr
-
-            def subst_plan(p: LogicalPlan) -> LogicalPlan:
-                return p.transform_up(subst).transform_up(subst_exprs)
-
-            def subst(node: LogicalPlan) -> LogicalPlan:
-                if isinstance(node, UnresolvedRelation) and node.name.lower() in ctes:
-                    return ctes[node.name.lower()]
-                return node
-
-            def subst_exprs(node: LogicalPlan) -> LogicalPlan:
-                # CTE references inside subquery EXPRESSIONS (scalar/IN/
-                # EXISTS) are invisible to plan-level transform_up
-                if not node.expressions():
-                    return node
-
-                def fe(e):
-                    if isinstance(e, SubqueryExpr):
-                        return e.with_plan(subst_plan(e.plan))
-                    return e.map_children(fe)
-                return node.map_expressions(fe)
-
             plan = subst_plan(plan)
         return plan
 

@@ -233,6 +233,35 @@ def _rewrite_in(child: LogicalPlan, value: Expression, sub: LogicalPlan,
     return Join(child, new_sub, how, join_conjuncts(conds), None)
 
 
+def _rewrite_existence(child: LogicalPlan, value: Expression,
+                       sub: LogicalPlan) -> Tuple[LogicalPlan, Expression]:
+    """Uncorrelated `x IN (SELECT c ...)` anywhere in an expression →
+    left join on the DISTINCT value set + a match flag
+    (``ExistenceJoin`` in `RewritePredicateSubquery`).  NULL deviation as
+    for NOT IN: a NULL probe/set value reads as non-matching (false), not
+    NULL — documented in the module header."""
+    from ..expressions import Coalesce, Literal
+    sub = _strip_alias(sub)
+    if isinstance(sub, Distinct):
+        sub = sub.children[0]       # the Distinct below subsumes it
+    if not isinstance(sub, Project) or len(sub.exprs) != 1:
+        raise AnalysisException(
+            "IN (subquery) requires a single-column subquery select list")
+    first = sub.exprs[0]
+    base = first.children[0] if isinstance(first, Alias) else first
+    inner_child, pulled = _pull_correlated(sub.children[0])
+    if pulled:
+        raise AnalysisException(
+            "correlated IN subqueries are only supported as top-level "
+            "WHERE/HAVING conjuncts")
+    key = _fresh_name(first.name.split(".")[-1])
+    flag = _fresh_name("exists")
+    keyed = Distinct(Project([Alias(base, key)], inner_child))
+    flagged = Project([Col(key), Alias(Literal(True), flag)], keyed)
+    joined = Join(child, flagged, "left", EQ(value, Col(key)), None)
+    return joined, Coalesce(Col(flag), Literal(False))
+
+
 def _rewrite_scalar(child: LogicalPlan, sub: LogicalPlan
                     ) -> Tuple[LogicalPlan, Expression]:
     """Returns (new child with the join attached, replacement expression)."""
@@ -240,9 +269,32 @@ def _rewrite_scalar(child: LogicalPlan, sub: LogicalPlan
     if not (isinstance(sub, Project) and len(sub.exprs) == 1
             and isinstance(sub.children[0], Aggregate)
             and not sub.children[0].keys):
-        raise AnalysisException(
-            "scalar subqueries must be global aggregates "
-            "(SELECT agg(...) FROM ...); got: " + repr(sub))
+        # non-aggregate scalar subquery (`SELECT col FROM one_row_rel` —
+        # q58's week lookup, q23/q14's CTE-scalar reads): when
+        # UNCORRELATED, wrap in first() to make it a global aggregate.
+        # Deviation: a multi-row subquery yields an arbitrary row where
+        # the reference raises "more than one row returned" — the TPC-DS
+        # shapes are single-row by construction.
+        target = sub
+        while isinstance(target, (Distinct, SubqueryAlias)):
+            # a Distinct adds nothing under pick-any-row semantics
+            target = target.children[0]
+        ok = isinstance(target, Project) and len(target.exprs) == 1
+        pulled = []
+        if ok:
+            inner_child, pulled = _pull_correlated(target.children[0])
+        if ok and not pulled:
+            from ..aggregates import First
+            first = target.exprs[0]
+            base = first.children[0] if isinstance(first, Alias) else first
+            slot = _fresh_name(first.name.split(".")[-1])
+            sub = Project([Col(slot)],
+                          Aggregate([], [(First(base), slot)], inner_child))
+        else:
+            raise AnalysisException(
+                "scalar subqueries must be global aggregates "
+                "(SELECT agg(...) FROM ...) or uncorrelated single-column "
+                "queries; got: " + repr(sub))
     agg: Aggregate = sub.children[0]
     first = sub.exprs[0]
     value_expr = first.children[0] if isinstance(first, Alias) else first
@@ -349,12 +401,21 @@ def rewrite_subqueries(plan: LogicalPlan, resolve) -> LogicalPlan:
                 child = _rewrite_in(child, inner.children[0],
                                     prep(inner.plan), neg)
                 continue
-            # scalar subqueries nested anywhere in the conjunct
+            # subqueries nested anywhere in the conjunct: scalars join as
+            # 1-row/grouped relations; IN/EXISTS under OR become existence
+            # joins (ExistenceJoin in `RewritePredicateSubquery`): a left
+            # join against the distinct value set whose match flag replaces
+            # the predicate.  Only UNCORRELATED existence shapes nest —
+            # correlation pull-up under disjunction has no join form here.
 
             def repl(e: Expression) -> Expression:
                 nonlocal child
                 if isinstance(e, ScalarSubquery):
                     child, ref = _rewrite_scalar(child, prep(e.plan))
+                    return ref
+                if isinstance(e, InSubquery):
+                    child, ref = _rewrite_existence(
+                        child, e.children[0], prep(e.plan))
                     return ref
                 if isinstance(e, SubqueryExpr):
                     raise AnalysisException(
@@ -365,4 +426,39 @@ def rewrite_subqueries(plan: LogicalPlan, resolve) -> LogicalPlan:
             out.append(repl(conj))
         return Filter(join_conjuncts(out), child) if out else child
 
-    return plan.transform_up(rewrite_filter)
+    def rewrite_project(node: LogicalPlan) -> LogicalPlan:
+        """SELECT-position scalar subqueries (q9/q24-style `CASE WHEN
+        (SELECT avg(...)...) > x`): each ScalarSubquery in a projection
+        attaches its join to the child; the projection then references the
+        fresh scalar column.  Output schema is unchanged — Project emits
+        only its named expressions."""
+        if not isinstance(node, Project):
+            return node
+        if not any(contains_subquery(e) for e in node.exprs):
+            return node
+        child = node.children[0]
+        new_exprs: List[Expression] = []
+
+        def repl(e: Expression) -> Expression:
+            nonlocal child
+            if isinstance(e, ScalarSubquery):
+                child, ref = _rewrite_scalar(child, prep(e.plan))
+                return ref
+            if isinstance(e, SubqueryExpr):
+                raise AnalysisException(
+                    f"{type(e).__name__} is not supported in a SELECT "
+                    "list; only scalar subqueries are")
+            return e.map_children(repl)
+
+        for e in node.exprs:
+            new_exprs.append(repl(e))
+        import copy
+        new = copy.copy(node)     # keep Project subclasses (join renames)
+        new.exprs = new_exprs
+        new.children = (child,)
+        return new
+
+    def rewrite_node(node: LogicalPlan) -> LogicalPlan:
+        return rewrite_project(rewrite_filter(node))
+
+    return plan.transform_up(rewrite_node)

@@ -178,7 +178,8 @@ def _addresses(rng, n) -> pd.DataFrame:
                                "Centerville", "Riverside", "Salem"], n),
         "ca_county": rng.choice(COUNTIES, n),
         "ca_state": rng.choice(STATES, n),
-        "ca_zip": [f"{x:05d}" for x in rng.integers(10000, 99999, n)],
+        "ca_zip": [f"{x:05d}" for x in
+           rng.choice(rng.integers(10000, 99999, 200), n)],
         "ca_country": "United States",
         "ca_gmt_offset": rng.choice([-5.0, -6.0, -7.0, -8.0], n),
         "ca_location_type": rng.choice(["apartment", "condo",
@@ -212,7 +213,7 @@ def _hdemo(n) -> pd.DataFrame:
     })
 
 
-def _stores(rng, n) -> pd.DataFrame:
+def _stores(rng, n, zips=None) -> pd.DataFrame:
     sk = np.arange(1, n + 1)
     return pd.DataFrame({
         "s_store_sk": sk.astype(np.int64),
@@ -241,7 +242,10 @@ def _stores(rng, n) -> pd.DataFrame:
         "s_city": rng.choice(["Fairview", "Midway"], n),
         "s_county": rng.choice(COUNTIES, n),
         "s_state": rng.choice(STATES[:5], n),
-        "s_zip": [f"{x:05d}" for x in rng.integers(10000, 99999, n)],
+        # store zips come from the address zip pool when provided: spec
+        # queries (q24) join stores to customer addresses on zip equality
+        "s_zip": (list(rng.choice(zips, n)) if zips is not None
+                  else [f"{x:05d}" for x in rng.integers(10000, 99999, n)]),
         "s_country": "United States",
         "s_gmt_offset": rng.choice([-5.0, -6.0], n),
         "s_tax_precentage": np.round(rng.uniform(0.0, 0.11, n), 2),
@@ -339,7 +343,8 @@ def generate(sf_rows: int = 40_000, seed: int = 20260729
         "ib_income_band_sk": ib.astype(np.int64),
         "ib_lower_bound": ((ib - 1) * 10000).astype(np.int32),
         "ib_upper_bound": (ib * 10000).astype(np.int32)})
-    out["store"] = _stores(rng, n_store)
+    out["store"] = _stores(
+        rng, n_store, zips=out["customer_address"]["ca_zip"].values)
     out["promotion"] = _promotions(rng, n_promo, n_items)
     sm = np.arange(1, 21)
     out["ship_mode"] = pd.DataFrame({
@@ -454,8 +459,10 @@ def generate(sf_rows: int = 40_000, seed: int = 20260729
         "ss_net_paid_inc_tax": ss["net_paid_inc_tax"],
         "ss_net_profit": ss["net_profit"],
     })
-    # returns reference ~10% of sales rows by (item, ticket, customer)
-    ridx = rng.choice(n_ss, n_ss // 10, replace=False)
+    # returns reference ~25% of sales rows by (item, ticket, customer)
+    # (raised from 10% so cross-channel return overlap — q83 — exists
+    # at harness scale)
+    ridx = rng.choice(n_ss, n_ss // 4, replace=False)
     ssr = out["store_sales"].iloc[ridx]
     n_sr = len(ssr)
     ret_qty = np.minimum(rng.integers(1, 101, n_sr),
@@ -546,7 +553,7 @@ def generate(sf_rows: int = 40_000, seed: int = 20260729
     cs_t.loc[:n_link - 1, "cs_sold_date_sk"] = \
         sr_t.sr_returned_date_sk.to_numpy()[pick] + rng.integers(0, 60, n_link)
 
-    cidx = rng.choice(n_cs, n_cs // 10, replace=False)
+    cidx = rng.choice(n_cs, n_cs // 4, replace=False)
     csr = out["catalog_sales"].iloc[cidx]
     n_cr = len(csr)
     cret_qty = np.minimum(rng.integers(1, 101, n_cr),
@@ -631,7 +638,7 @@ def generate(sf_rows: int = 40_000, seed: int = 20260729
             np.asarray(ws["net_paid_inc_tax"]) + wship_cost, 2),
         "ws_net_profit": ws["net_profit"],
     })
-    widx = rng.choice(n_ws, n_ws // 10, replace=False)
+    widx = rng.choice(n_ws, n_ws // 4, replace=False)
     wsr = out["web_sales"].iloc[widx]
     n_wr = len(wsr)
     wret_qty = np.minimum(rng.integers(1, 101, n_wr),

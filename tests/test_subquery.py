@@ -180,3 +180,59 @@ def test_window_over_aggregate(tu):
         "SELECT g, SUM(v) AS sv, "
         "SUM(SUM(v)) OVER () AS total FROM t GROUP BY g ORDER BY g"))
     assert got == [("a", 3.0, 20.0), ("b", 7.0, 20.0), ("c", 10.0, 20.0)]
+
+
+def test_scalar_subquery_in_select_list(spark):
+    spark.sql("SELECT 1 AS a UNION ALL SELECT 2 AS a"
+               ).createOrReplaceTempView("sq_t1")
+    spark.sql("SELECT 10 AS b UNION ALL SELECT 20 AS b"
+               ).createOrReplaceTempView("sq_t2")
+    rows = spark.sql(
+        "SELECT a, (SELECT SUM(b) FROM sq_t2) AS s FROM sq_t1 ORDER BY a"
+    ).collect()
+    assert [(r["a"], r["s"]) for r in rows] == [(1, 30), (2, 30)]
+
+
+def test_scalar_subquery_inside_case(spark):
+    spark.sql("SELECT 5 AS x").createOrReplaceTempView("sq_one")
+    rows = spark.sql(
+        "SELECT CASE WHEN (SELECT MAX(x) FROM sq_one) > 3 THEN 'big' "
+        "ELSE 'small' END AS c FROM sq_one").collect()
+    assert rows[0]["c"] == "big"
+
+
+def test_in_subquery_under_or(spark):
+    spark.sql("SELECT 1 AS v UNION ALL SELECT 2 AS v UNION ALL "
+               "SELECT 3 AS v UNION ALL SELECT 4 AS v"
+               ).createOrReplaceTempView("sq_vals")
+    spark.sql("SELECT 2 AS w").createOrReplaceTempView("sq_set")
+    rows = spark.sql(
+        "SELECT v FROM sq_vals WHERE v = 4 OR v IN (SELECT w FROM sq_set) "
+        "ORDER BY v").collect()
+    assert [r["v"] for r in rows] == [2, 4]
+
+
+def test_correlated_in_under_or_rejected(spark):
+    import pytest
+    from spark_tpu.expressions import AnalysisException
+    spark.sql("SELECT 1 AS v").createOrReplaceTempView("sq_a")
+    spark.sql("SELECT 1 AS w, 1 AS k").createOrReplaceTempView("sq_b")
+    with pytest.raises(AnalysisException, match="correlated IN"):
+        spark.sql("SELECT v FROM sq_a WHERE v = 9 OR v IN "
+                   "(SELECT w FROM sq_b WHERE k = sq_a.v)").collect()
+
+
+def test_non_aggregate_scalar_subquery(spark):
+    spark.sql("SELECT 7 AS only").createOrReplaceTempView("sq_single")
+    rows = spark.sql(
+        "SELECT (SELECT only FROM sq_single) + 1 AS r").collect()
+    assert rows[0]["r"] == 8
+
+
+def test_chained_ctes(spark):
+    rows = spark.sql("""
+        WITH base AS (SELECT 1 AS x UNION ALL SELECT 2 AS x),
+             doubled AS (SELECT x * 2 AS y FROM base),
+             shifted AS (SELECT y + 10 AS z FROM doubled)
+        SELECT z FROM shifted ORDER BY z""").collect()
+    assert [r["z"] for r in rows] == [12, 14]
