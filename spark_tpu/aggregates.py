@@ -430,3 +430,37 @@ def is_aggregate(e: Expression) -> bool:
     if isinstance(e, AggregateFunction):
         return True
     return any(is_aggregate(c) for c in e.children)
+
+
+class CollectList(AggregateFunction):
+    """collect_list(x): group elements as an array, in sort order (the
+    reference's order is nondeterministic too).  NULL inputs are skipped.
+    Output arrays are capped at ``spark.tpu.collect.maxArrayLen`` elements
+    (static shapes require a bound); overflow truncates — deviation,
+    raise the cap for bigger groups."""
+
+    is_collect = True
+    distinct_elements = False
+
+    def data_type(self, schema):
+        return T.ArrayType(self.children[0].data_type(schema))
+
+    def num_buffers(self):
+        return 0
+
+    def make_buffers(self, ctx, contribute):
+        raise AnalysisException(
+            "collect_list/collect_set only run on the sort-based "
+            "aggregation path")
+
+    def __repr__(self):
+        return f"collect_list({self.children[0]!r})"
+
+
+class CollectSet(CollectList):
+    """collect_set(x): distinct group elements as an array."""
+
+    distinct_elements = True
+
+    def __repr__(self):
+        return f"collect_set({self.children[0]!r})"

@@ -179,7 +179,21 @@ class ColumnVector:
             if not valid[i]:
                 out.append(None)
             elif isinstance(dt, T.ArrayType):
-                out.append([float(x) for x in data[i]])
+                ed = dt.element_type
+                row = data[i]
+                if ed.is_fractional:
+                    live = row[~np.isnan(row.astype(np.float64))]
+                    out.append([float(x) for x in live])
+                elif ed.is_string:
+                    codes = row[row >= 0]
+                    out.append([
+                        self.dictionary[int(c)] if self.dictionary is not None
+                        and 0 <= int(c) < len(self.dictionary) else None
+                        for c in codes])
+                else:
+                    sent = dt.element_sentinel()
+                    live = row[row != sent]
+                    out.append([int(x) for x in live])
             elif dt.is_string or isinstance(dt, T.BinaryType):
                 code = int(data[i])
                 out.append(self.dictionary[code] if (self.dictionary is not None and 0 <= code < len(self.dictionary)) else None)

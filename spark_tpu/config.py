@@ -240,6 +240,11 @@ EVENT_LOG_DIR = conf("spark.eventLog.dir").doc(
     "analog); empty = disabled."
 ).string("")
 
+COLLECT_MAX_LEN = conf("spark.tpu.collect.maxArrayLen").doc(
+    "Static element capacity of collect_list/collect_set output arrays; "
+    "larger groups truncate (static shapes need a bound)."
+).int(128)
+
 WAREHOUSE_DIR = conf("spark.sql.warehouse.dir").doc(
     "Root directory for persistent (non-temp) tables and databases "
     "(CREATE TABLE ... USING, saveAsTable)."

@@ -419,6 +419,11 @@ class UnaryMath(Expression):
         "sign": (lambda xp, x: xp.sign(x), None),
         "radians": (lambda xp, x: x * (math.pi / 180.0), None),
         "degrees": (lambda xp, x: x * (180.0 / math.pi), None),
+        "log1p": (lambda xp, x: xp.log1p(xp.where(x > -1, x, 0.0)),
+                  lambda xp, x: x > -1),
+        "expm1": (lambda xp, x: xp.expm1(x), None),
+        "cbrt": (lambda xp, x: xp.cbrt(x), None),
+        "rint": (lambda xp, x: xp.round(x), None),
     }
 
     def __init__(self, fn: str, child: Expression):
@@ -983,6 +988,30 @@ class Cast(Expression):
             return ExprValue(xp.floor_divide(v.data, 86_400_000_000).astype(np.int32), v.valid)
         if isinstance(to, T.BooleanType):
             return ExprValue(v.data != 0, v.valid)
+        # float → integral needs JVM-exact semantics on BOTH lanes
+        # ((long)f: truncate toward zero, saturate at long bounds, NaN→0;
+        # then mod-wrap into the narrow type) — numpy's direct astype of
+        # out-of-range floats is platform UB and diverges from XLA
+        if np.issubdtype(np.dtype(getattr(v.data, "dtype", np.float64)),
+                         np.floating) and to.is_integral:
+            f = v.data.astype(np.float64)
+            t = xp.trunc(xp.where(xp.isnan(f), 0.0, f))
+            if np.dtype(to.np_dtype).itemsize >= 8:
+                # largest float64 strictly below 2^63 — clipping to
+                # float(2^63-1) would round UP to 2^63 and wrap
+                lo, hi = float(np.iinfo(np.int64).min), \
+                    float(np.nextafter(2.0 ** 63, 0.0))
+                sat = np.int64(np.iinfo(np.int64).max)
+            else:
+                # JVM narrows through int: saturate at int32, then the
+                # astype below mod-wraps into short/byte exactly like
+                # (short)(int)f / (byte)(int)f
+                lo, hi = float(np.iinfo(np.int32).min), \
+                    float(np.iinfo(np.int32).max)
+                sat = np.int64(np.iinfo(np.int32).max)
+            out = xp.clip(t, lo, hi).astype(np.int64)
+            out = xp.where(t >= hi, sat, out)    # exact top-of-range value
+            return ExprValue(out.astype(to.np_dtype), v.valid)
         # numeric/bool → numeric: plain astype (truncating float→int like Spark)
         return ExprValue(v.data.astype(to.np_dtype), v.valid)
 
@@ -1483,3 +1512,729 @@ class Rand(Expression):
 
     def __repr__(self):
         return f"rand({self.seed})"
+
+
+# ---------------------------------------------------------------------------
+# Expression breadth: parameterized string transforms, date arithmetic,
+# binary math (the long tail of `stringExpressions.scala`,
+# `datetimeExpressions.scala`, `mathExpressions.scala`)
+# ---------------------------------------------------------------------------
+
+def _civil_ymd_vec(xp, days):
+    """(y, m, d) int arrays from day numbers (civil_from_days, vectorized)."""
+    z = days + 719_468
+    era = xp.floor_divide(z, 146_097)
+    doe = z - era * 146_097
+    yoe = (doe - doe // 1460 + doe // 36_524 - doe // 146_096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = xp.where(mp < 10, mp + 3, mp - 9)
+    y = xp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def _days_from_civil_vec(xp, y, m, d):
+    """day numbers from (y, m, d) int arrays (days_from_civil, vectorized)."""
+    y = xp.where(m <= 2, y - 1, y)
+    era = xp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = xp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146_097 + doe - 719_468
+
+
+def _month_len_vec(xp, y, m):
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    # Jan..Dec lengths, Feb patched by leapness
+    table = xp.asarray(np.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31,
+                                 30, 31], np.int64))
+    base = table[xp.clip(m - 1, 0, 11)]
+    return xp.where((m == 2) & leap, 29, base)
+
+
+def _as_days(xp, v: ExprValue, dt) -> Any:
+    if isinstance(dt, T.TimestampType):
+        return xp.floor_divide(v.data, 86_400_000_000).astype(np.int64)
+    if isinstance(dt, T.DateType) or dt.is_integral:
+        return v.data.astype(np.int64)
+    raise AnalysisException(f"expected a date/timestamp, got {dt}")
+
+
+class DateArith(Expression):
+    """date_add/date_sub/datediff/add_months/months_between/last_day —
+    pure elementwise integer calendar math (Hinnant algorithms), so every
+    date function fuses into the surrounding XLA program instead of
+    round-tripping through host datetime objects."""
+
+    KINDS = ("date_add", "date_sub", "datediff", "add_months",
+             "months_between", "last_day")
+
+    def __init__(self, kind: str, *children: Expression):
+        assert kind in self.KINDS, kind
+        self.kind = kind
+        self.children = tuple(children)
+
+    def map_children(self, fn):
+        return DateArith(self.kind, *[fn(c) for c in self.children])
+
+    def data_type(self, schema):
+        if self.kind == "datediff":
+            return T.int32
+        if self.kind == "months_between":
+            return T.float64
+        return T.date
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        schema = ctx.batch.schema
+        a = ctx.broadcast(self.children[0].eval(ctx))
+        da = _as_days(xp, a, self.children[0].data_type(schema))
+        if self.kind == "last_day":
+            y, m, _d = _civil_ymd_vec(xp, da)
+            out = _days_from_civil_vec(xp, y, m, _month_len_vec(xp, y, m))
+            return ExprValue(out.astype(np.int32), a.valid)
+        b = ctx.broadcast(self.children[1].eval(ctx))
+        valid = and_valid(xp, a.valid, b.valid)
+        if self.kind in ("date_add", "date_sub"):
+            n = b.data.astype(np.int64)
+            out = da + (n if self.kind == "date_add" else -n)
+            return ExprValue(out.astype(np.int32), valid)
+        if self.kind == "datediff":
+            db = _as_days(xp, b, self.children[1].data_type(schema))
+            return ExprValue((da - db).astype(np.int32), valid)
+        if self.kind == "add_months":
+            y, m, d = _civil_ymd_vec(xp, da)
+            total = (y * 12 + (m - 1)) + b.data.astype(np.int64)
+            ny = xp.floor_divide(total, 12)
+            nm = total - ny * 12 + 1
+            nd = xp.minimum(d, _month_len_vec(xp, ny, nm))
+            out = _days_from_civil_vec(xp, ny, nm, nd)
+            return ExprValue(out.astype(np.int32), valid)
+        # months_between (Spark's rule: integer when same day-of-month or
+        # both month ends; else day difference / 31, rounded to 8 digits)
+        db = _as_days(xp, b, self.children[1].data_type(schema))
+        y1, m1, d1 = _civil_ymd_vec(xp, da)
+        y2, m2, d2 = _civil_ymd_vec(xp, db)
+        whole = ((y1 - y2) * 12 + (m1 - m2)).astype(np.float64)
+        last1 = d1 == _month_len_vec(xp, y1, m1)
+        last2 = d2 == _month_len_vec(xp, y2, m2)
+        frac = (d1 - d2).astype(np.float64) / 31.0
+        out = xp.where((d1 == d2) | (last1 & last2), whole, whole + frac)
+        return ExprValue(xp.round(out * 1e8) / 1e8, valid)
+
+    def __repr__(self):
+        return f"{self.kind}({', '.join(map(repr, self.children))})"
+
+
+class NextDay(Expression):
+    """next_day(date, 'Mon'): the first date later than `date` falling on
+    the given weekday (datetimeExpressions.scala NextDay)."""
+
+    DOW = {"sun": 0, "mon": 1, "tue": 2, "wed": 3, "thu": 4, "fri": 5,
+           "sat": 6}
+
+    def __init__(self, child: Expression, day_name: str):
+        key = str(day_name).strip().lower()[:3]
+        if key not in self.DOW:
+            raise AnalysisException(f"unknown weekday {day_name!r}")
+        self.day_name = key
+        self.children = (child,)
+
+    def map_children(self, fn):
+        return NextDay(fn(self.children[0]), self.day_name)
+
+    def data_type(self, schema):
+        return T.date
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = ctx.broadcast(self.children[0].eval(ctx))
+        days = _as_days(xp, v, self.children[0].data_type(ctx.batch.schema))
+        # 1970-01-01 was Thursday; dow 0 = Sunday
+        cur = (days + 4) % 7
+        target = np.int64(self.DOW[self.day_name])
+        delta = (target - cur + 7) % 7
+        delta = xp.where(delta == 0, 7, delta)
+        return ExprValue((days + delta).astype(np.int32), v.valid)
+
+    def __repr__(self):
+        return f"next_day({self.children[0]!r}, {self.day_name!r})"
+
+
+class TruncDate(Expression):
+    """trunc(date, 'year'|'month'|'week'|'quarter') -> date."""
+
+    def __init__(self, child: Expression, fmt: str):
+        key = str(fmt).strip().lower()
+        aliases = {"yy": "year", "yyyy": "year", "mm": "month",
+                   "mon": "month"}
+        key = aliases.get(key, key)
+        if key not in ("year", "month", "week", "quarter"):
+            raise AnalysisException(f"unknown trunc unit {fmt!r}")
+        self.fmt = key
+        self.children = (child,)
+
+    def map_children(self, fn):
+        return TruncDate(fn(self.children[0]), self.fmt)
+
+    def data_type(self, schema):
+        return T.date
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = ctx.broadcast(self.children[0].eval(ctx))
+        days = _as_days(xp, v, self.children[0].data_type(ctx.batch.schema))
+        if self.fmt == "week":      # Monday start
+            out = days - (days + 3) % 7
+        else:
+            y, m, _d = _civil_ymd_vec(xp, days)
+            if self.fmt == "year":
+                m = xp.ones_like(m)
+            elif self.fmt == "quarter":
+                m = ((m - 1) // 3) * 3 + 1
+            out = _days_from_civil_vec(xp, y, m, xp.ones_like(days))
+        return ExprValue(out.astype(np.int32), v.valid)
+
+    def __repr__(self):
+        return f"trunc({self.children[0]!r}, {self.fmt!r})"
+
+
+class UnixTimestamp(Expression):
+    """unix_timestamp(ts) -> seconds since epoch (int64); from_unixtime
+    (`FromUnixTime`) is the inverse returning a TIMESTAMP (deviation: the
+    reference formats to string; string materialization is host-side)."""
+
+    def __init__(self, child: Expression, inverse: bool = False):
+        self.inverse = inverse
+        self.children = (child,)
+
+    def map_children(self, fn):
+        return UnixTimestamp(fn(self.children[0]), self.inverse)
+
+    def data_type(self, schema):
+        return T.timestamp if self.inverse else T.int64
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = ctx.broadcast(self.children[0].eval(ctx))
+        dt = self.children[0].data_type(ctx.batch.schema)
+        if self.inverse:
+            return ExprValue(v.data.astype(np.int64) * 1_000_000, v.valid)
+        if isinstance(dt, T.DateType):
+            return ExprValue(v.data.astype(np.int64) * 86_400, v.valid)
+        return ExprValue(xp.floor_divide(v.data.astype(np.int64),
+                                         1_000_000), v.valid)
+
+    def __repr__(self):
+        op = "from_unixtime" if self.inverse else "unix_timestamp"
+        return f"{op}({self.children[0]!r})"
+
+
+class BinaryMath(Expression):
+    """hypot/atan2/nanvl — float64 elementwise binaries."""
+
+    FNS = {
+        "hypot": lambda xp, a, b: xp.hypot(a, b),
+        "atan2": lambda xp, a, b: xp.arctan2(a, b),
+        "nanvl": lambda xp, a, b: xp.where(xp.isnan(a), b, a),
+    }
+
+    def __init__(self, fn: str, left: Expression, right: Expression):
+        assert fn in self.FNS, fn
+        self.fn = fn
+        self.children = (left, right)
+
+    def map_children(self, fn):
+        return BinaryMath(self.fn, fn(self.children[0]), fn(self.children[1]))
+
+    def data_type(self, schema):
+        return T.float64
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        a = ctx.broadcast(self.children[0].eval(ctx))
+        b = ctx.broadcast(self.children[1].eval(ctx))
+        out = self.FNS[self.fn](xp, a.data.astype(np.float64),
+                                b.data.astype(np.float64))
+        return ExprValue(out, and_valid(xp, a.valid, b.valid))
+
+    def __repr__(self):
+        return f"{self.fn}({self.children[0]!r}, {self.children[1]!r})"
+
+
+def _soundex(word: str) -> str:
+    codes = {"b": "1", "f": "1", "p": "1", "v": "1",
+             "c": "2", "g": "2", "j": "2", "k": "2", "q": "2", "s": "2",
+             "x": "2", "z": "2", "d": "3", "t": "3", "l": "4",
+             "m": "5", "n": "5", "r": "6"}
+    w = "".join(c for c in word.upper() if c.isalpha())
+    if not w:
+        return word
+    out = [w[0]]
+    prev = codes.get(w[0].lower(), "")
+    for c in w[1:]:
+        code = codes.get(c.lower(), "")
+        if code and code != prev:
+            out.append(code)
+        if c.lower() not in ("h", "w"):
+            prev = code
+    return (out[0] + "".join(out[1:]) + "000")[:4]
+
+
+class ParamStringTransform(Expression):
+    """String→string transforms with STATIC parameters (regexp_replace,
+    lpad, translate, md5, ...): the host rewrites the dictionary once per
+    trace, the device only remaps int32 codes — same contract as
+    StringTransform."""
+
+    @staticmethod
+    def _make(kind, params):
+        import base64 as b64
+        import hashlib
+        import re as re_mod
+        if kind == "regexp_replace":
+            pat, repl = params
+            rx = re_mod.compile(pat)
+            return lambda s: rx.sub(repl, s)
+        if kind == "regexp_extract":
+            pat, idx = params
+            rx = re_mod.compile(pat)
+
+            def ex(s):
+                m = rx.search(s)
+                return m.group(idx) if m else ""
+            return ex
+        if kind == "lpad":
+            n, pad = params
+            return lambda s: s.rjust(n, pad)[:n] if pad else s[:n]
+        if kind == "rpad":
+            n, pad = params
+            return lambda s: s.ljust(n, pad)[:n] if pad else s[:n]
+        if kind == "translate":
+            frm, to = params
+            table = str.maketrans(frm[:len(to)], to[:len(frm)],
+                                  frm[len(to):])
+            return lambda s: s.translate(table)
+        if kind == "repeat":
+            (n,) = params
+            return lambda s: s * n
+        if kind == "soundex":
+            return _soundex
+        if kind == "md5":
+            return lambda s: hashlib.md5(s.encode()).hexdigest()
+        if kind == "sha1":
+            return lambda s: hashlib.sha1(s.encode()).hexdigest()
+        if kind == "sha2":
+            (bits,) = params
+            return lambda s: hashlib.new(f"sha{bits}",
+                                         s.encode()).hexdigest()
+        if kind == "base64":
+            return lambda s: b64.b64encode(s.encode()).decode()
+        if kind == "unbase64":
+            return lambda s: b64.b64decode(s.encode()).decode("utf-8",
+                                                              "replace")
+        if kind == "hex":
+            return lambda s: s.encode().hex().upper()
+        raise AnalysisException(f"unknown string transform {kind}")
+
+    def __init__(self, kind: str, child: Expression, params: tuple = ()):
+        self.kind = kind
+        self.params = tuple(params)
+        self._fn = self._make(kind, self.params)
+        self.children = (child,)
+
+    def map_children(self, fn):
+        return ParamStringTransform(self.kind, fn(self.children[0]),
+                                    self.params)
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not ct.is_string:
+            raise AnalysisException(f"{self.kind} expects string, got {ct}")
+        return T.string
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        transformed = [self._fn(w) for w in v.dictionary]
+        new_dict = tuple(sorted(set(transformed))) or ("",)
+        pos = {w: i for i, w in enumerate(new_dict)}
+        remap = np.array([pos[w] for w in transformed], np.int32) \
+            if transformed else np.zeros(1, np.int32)
+        return ExprValue(_dict_gather(xp, remap, v.data, v.valid), v.valid,
+                         new_dict)
+
+    def __repr__(self):
+        return f"{self.kind}({self.children[0]!r}, {self.params})"
+
+
+class StringToInt(Expression):
+    """String→int64 via a host-computed dictionary table (instr/locate/
+    levenshtein-vs-literal/crc32)."""
+
+    @staticmethod
+    def _make(kind, params):
+        import zlib
+        if kind == "instr":
+            (sub,) = params
+            return lambda s: s.find(sub) + 1
+        if kind == "locate":
+            sub, start = params
+            return lambda s: s.find(sub, max(start - 1, 0)) + 1
+        if kind == "levenshtein":
+            (other,) = params
+
+            def lev(s):
+                a, b = s, other
+                if len(a) < len(b):
+                    a, b = b, a
+                prev = list(range(len(b) + 1))
+                for i, ca in enumerate(a, 1):
+                    cur = [i]
+                    for j, cb in enumerate(b, 1):
+                        cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                                       prev[j - 1] + (ca != cb)))
+                    prev = cur
+                return prev[-1]
+            return lev
+        if kind == "crc32":
+            return lambda s: zlib.crc32(s.encode()) & 0xFFFFFFFF
+        raise AnalysisException(f"unknown string→int transform {kind}")
+
+    def __init__(self, kind: str, child: Expression, params: tuple = ()):
+        self.kind = kind
+        self.params = tuple(params)
+        self._fn = self._make(kind, self.params)
+        self.children = (child,)
+
+    def map_children(self, fn):
+        return StringToInt(self.kind, fn(self.children[0]), self.params)
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not ct.is_string:
+            raise AnalysisException(f"{self.kind} expects string, got {ct}")
+        return T.int64
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        table = np.array([self._fn(w) for w in v.dictionary] or [0],
+                         np.int64)
+        codes = xp.clip(v.data, 0, None)
+        return ExprValue(xp.asarray(table)[codes], v.valid)
+
+    def __repr__(self):
+        return f"{self.kind}({self.children[0]!r}, {self.params})"
+
+
+class Randn(Rand):
+    """Standard-normal draws (randn): Box-Muller over two Rand streams —
+    deterministic per (seed, row index) like Rand."""
+
+    def eval(self, ctx: EvalContext) -> ExprValue:
+        xp = ctx.xp
+        u1 = Rand(self.seed).eval(ctx).data
+        u2 = Rand(self.seed + 0x5DEECE66D).eval(ctx).data
+        u1 = xp.maximum(u1, 1e-12)
+        out = xp.sqrt(-2.0 * xp.log(u1)) * xp.cos(2.0 * math.pi * u2)
+        return ExprValue(out, None)
+
+    def __repr__(self):
+        return f"randn({self.seed})"
+
+
+class SparkPartitionId(Expression):
+    """spark_partition_id(): the mesh shard index in distributed execution;
+    0 on the single-chip path (set via ExecContext.partition_id)."""
+
+    children = ()
+
+    def data_type(self, schema):
+        return T.int32
+
+    @property
+    def name(self):
+        return "SPARK_PARTITION_ID()"
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        pid = getattr(ctx, "partition_id", 0)
+        return ExprValue(xp.asarray(np.int32(pid)), None)
+
+    def __repr__(self):
+        return "spark_partition_id()"
+
+
+# ---------------------------------------------------------------------------
+# Array expressions (`complexTypeCreator.scala`, `collectionOperations.scala`)
+#
+# Layout contract: see T.ArrayType — (capacity, max_len) element-dtype data
+# with trailing sentinel padding; element order is position order.
+# ---------------------------------------------------------------------------
+
+def _array_elem_mask(xp, dt: "T.ArrayType", data):
+    s = dt.element_sentinel()
+    if dt.element_type.is_fractional:
+        return ~xp.isnan(data)
+    return data != s
+
+
+class MakeArray(Expression):
+    """array(e1, e2, ...): fixed-length array from scalar expressions."""
+
+    def __init__(self, *children: Expression):
+        if not children:
+            raise AnalysisException("array() needs at least one element")
+        self.children = tuple(children)
+
+    def map_children(self, fn):
+        return MakeArray(*[fn(c) for c in self.children])
+
+    @property
+    def name(self):
+        return f"array({', '.join(c.name for c in self.children)})"
+
+    def data_type(self, schema):
+        et = self.children[0].data_type(schema)
+        for c in self.children[1:]:
+            et = T.numeric_promote(et, c.data_type(schema)) \
+                if et != c.data_type(schema) else et
+        return T.ArrayType(et)
+
+    def eval(self, ctx):
+        from .columnar import merge_dictionaries
+        xp = ctx.xp
+        dt = self.data_type(ctx.batch.schema)
+        ed = dt.element_type.np_dtype
+        vals = [ctx.broadcast(c.eval(ctx)) for c in self.children]
+        sent = dt.element_sentinel()
+        out_dict = None
+        if dt.element_type.is_string:
+            # merge each element's dictionary into one shared code space
+            merged = vals[0].dictionary or ("",)
+            remaps = [np.arange(len(merged), dtype=np.int32)]
+            for v in vals[1:]:
+                merged, ra, rb = merge_dictionaries(
+                    merged, v.dictionary or ("",))
+                remaps = [ra[r] for r in remaps] + [rb]
+            vals = [ExprValue(xp.asarray(r)[xp.clip(v.data, 0, None)],
+                              v.valid, merged)
+                    for v, r in zip(vals, remaps)]
+            out_dict = merged
+        cols = []
+        masks = []
+        any_null = any(v.valid is not None for v in vals)
+        for v in vals:
+            d = v.data.astype(ed)
+            if v.valid is not None:          # NULL element -> sentinel slot
+                d = xp.where(v.valid, d, sent)
+                masks.append(v.valid)
+            else:
+                masks.append(None)
+            cols.append(d)
+        data = xp.stack(cols, axis=-1)
+        if any_null:
+            # pack live elements to the FRONT: the ArrayType layout is
+            # position-packed with trailing sentinels (ElementAt/size
+            # depend on it).  Deviation: NULL elements are dropped, not
+            # kept in place — interior nulls are unrepresentable here.
+            k = len(cols)
+            mask = xp.stack(
+                [m if m is not None
+                 else xp.ones(data.shape[0], bool) for m in masks], axis=-1)
+            order = xp.argsort(~mask, axis=-1, stable=True)
+            data = xp.take_along_axis(data, order, axis=-1)
+        return ExprValue(data, None, out_dict)
+
+    def __repr__(self):
+        return f"array({', '.join(map(repr, self.children))})"
+
+
+class SplitStr(Expression):
+    """split(str, regex[, limit]) -> array<string>: the dictionary is
+    split on host once per trace; the device gathers per-row element-code
+    vectors from a (dict_size, max_len) table."""
+
+    def __init__(self, child: Expression, pattern: str, limit: int = -1):
+        self.pattern = pattern
+        self.limit = limit
+        self.children = (child,)
+
+    def map_children(self, fn):
+        return SplitStr(fn(self.children[0]), self.pattern, self.limit)
+
+    @property
+    def name(self):
+        return f"split({self.children[0].name}, {self.pattern!r})"
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not ct.is_string:
+            raise AnalysisException(f"split expects string, got {ct}")
+        return T.ArrayType(T.string)
+
+    def eval(self, ctx):
+        import re as re_mod
+        xp = ctx.xp
+        v = self.children[0].eval(ctx)
+        rx = re_mod.compile(self.pattern)
+        # re.split maxsplit: 0 = unlimited; Spark limit<=0 = split fully
+        maxsplit = 0 if self.limit <= 0 else self.limit - 1
+        parts_per_word = [rx.split(w, maxsplit)
+                          for w in (v.dictionary or ("",))]
+        elem_dict = tuple(sorted({p for parts in parts_per_word
+                                  for p in parts}))
+        pos = {w: i for i, w in enumerate(elem_dict)}
+        L = max(max((len(p) for p in parts_per_word), default=1), 1)
+        table = np.full((len(parts_per_word), L), -1, np.int32)
+        for i, parts in enumerate(parts_per_word):
+            for j, p in enumerate(parts):
+                table[i, j] = pos[p]
+        codes = xp.clip(v.data, 0, None)
+        return ExprValue(xp.asarray(table)[codes], v.valid, elem_dict)
+
+    def __repr__(self):
+        return f"split({self.children[0]!r}, {self.pattern!r})"
+
+
+class ArraySize(Expression):
+    """size(arr): element count (0 for empty; NULL row follows row mask)."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not isinstance(ct, T.ArrayType):
+            raise AnalysisException(f"size expects an array, got {ct}")
+        return T.int32
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        dt = self.children[0].data_type(ctx.batch.schema)
+        v = self.children[0].eval(ctx)
+        mask = _array_elem_mask(xp, dt, v.data)
+        return ExprValue(mask.sum(axis=-1).astype(np.int32), v.valid)
+
+    def __repr__(self):
+        return f"size({self.children[0]!r})"
+
+
+class ElementAt(Expression):
+    """element_at(arr, i): 1-based; negative indexes from the end; out of
+    bounds -> NULL (Spark's non-ANSI behavior)."""
+
+    def __init__(self, child: Expression, index: int):
+        if index == 0:
+            raise AnalysisException("element_at index is 1-based; got 0")
+        self.index = int(index)
+        self.children = (child,)
+
+    def map_children(self, fn):
+        return ElementAt(fn(self.children[0]), self.index)
+
+    @property
+    def name(self):
+        return f"element_at({self.children[0].name}, {self.index})"
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not isinstance(ct, T.ArrayType):
+            raise AnalysisException(f"element_at expects an array, got {ct}")
+        return ct.element_type
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        dt = self.children[0].data_type(ctx.batch.schema)
+        v = self.children[0].eval(ctx)
+        mask = _array_elem_mask(xp, dt, v.data)
+        lengths = mask.sum(axis=-1)
+        idx = np.int64(self.index)
+        eff = xp.where(idx > 0, idx - 1, lengths + idx)
+        ok = (eff >= 0) & (eff < lengths)
+        gathered = xp.take_along_axis(
+            v.data, xp.clip(eff, 0, v.data.shape[-1] - 1)[..., None],
+            axis=-1)[..., 0]
+        return ExprValue(gathered, and_valid(xp, v.valid, ok),
+                         v.dictionary)
+
+    def __repr__(self):
+        return f"element_at({self.children[0]!r}, {self.index})"
+
+
+class ArrayContains(Expression):
+    """array_contains(arr, literal)."""
+
+    def __init__(self, child: Expression, value: Any):
+        self.value = value
+        self.children = (child,)
+
+    def map_children(self, fn):
+        return ArrayContains(fn(self.children[0]), self.value)
+
+    @property
+    def name(self):
+        return f"array_contains({self.children[0].name}, {self.value!r})"
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not isinstance(ct, T.ArrayType):
+            raise AnalysisException(
+                f"array_contains expects an array, got {ct}")
+        return T.boolean
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        dt = self.children[0].data_type(ctx.batch.schema)
+        v = self.children[0].eval(ctx)
+        mask = _array_elem_mask(xp, dt, v.data)
+        if dt.element_type.is_string:
+            words = np.array(v.dictionary or (), dtype=object)
+            idx = int(np.searchsorted(words, self.value)) if len(words) \
+                else 0
+            if idx >= len(words) or words[idx] != self.value:
+                zero = xp.zeros(v.data.shape[0], bool)
+                return ExprValue(zero, v.valid)
+            target = np.int32(idx)
+        else:
+            target = np.asarray(self.value, dt.element_type.np_dtype)
+        hit = ((v.data == target) & mask).any(axis=-1)
+        return ExprValue(hit, v.valid)
+
+    def __repr__(self):
+        return f"array_contains({self.children[0]!r}, {self.value!r})"
+
+
+class ExplodeMarker(Expression):
+    """Marker for explode()/posexplode() in a select list; the DataFrame/
+    analyzer layer rewrites it into the Explode logical operator (the
+    reference's `Generate` + `GeneratorOuter` machinery collapsed to the
+    one generator the columnar engine supports)."""
+
+    def __init__(self, child: Expression, with_pos: bool = False):
+        self.with_pos = with_pos
+        self.children = (child,)
+
+    def map_children(self, fn):
+        return ExplodeMarker(fn(self.children[0]), self.with_pos)
+
+    @property
+    def name(self):
+        return "col" if not self.with_pos else "posexplode"
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not isinstance(ct, T.ArrayType):
+            raise AnalysisException(f"explode expects an array, got {ct}")
+        return ct.element_type
+
+    def eval(self, ctx):
+        raise AnalysisException(
+            "explode() is only supported as a top-level select expression")
+
+    def __repr__(self):
+        return f"explode({self.children[0]!r})"

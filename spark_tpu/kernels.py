@@ -306,6 +306,12 @@ def _sorted_grouped_aggregate(
 
     contribute = live
     for func, name in agg_slots:
+        if getattr(func, "is_collect", False):
+            out_names.append(name)
+            out_vectors.append(_collect_into_arrays(
+                xp, ctx, func, perm, sort_cols, seg_ids, is_start, group_pos,
+                live_s, capacity))
+            continue
         specs = func.make_buffers(ctx, contribute)
         sorted_bufs = [s.data[perm] for s in specs]
         reduced = [segment_reduce(xp, b, seg_ids, capacity, s.kind)
@@ -343,6 +349,80 @@ def _sorted_grouped_aggregate(
         for v in out_vectors
     ]
     return ColumnBatch(out_names, out_vectors, None, 1)
+
+
+def _collect_into_arrays(xp, ctx, func, perm, sort_cols, seg_ids, is_start,
+                         group_pos, live_s, capacity: int) -> ColumnVector:
+    """collect_list/collect_set inside the sort-based group path: scatter
+    each group's (optionally deduplicated) values into a fixed-width
+    ``(groups, Lmax)`` array — position-within-segment is the column, a
+    trash row swallows dead/overflow/NULL slots.  The static bound comes
+    from ``spark.tpu.collect.maxArrayLen``."""
+    from . import config as C
+    dt = func.data_type(ctx.batch.schema)
+    ed = dt.element_type
+    sent = dt.element_sentinel()
+    lmax = C.COLLECT_MAX_LEN.default
+    try:
+        from .sql.session import SparkSession
+        s = SparkSession.getActiveSession()
+        if s is not None:
+            lmax = s.conf.get(C.COLLECT_MAX_LEN)
+    except Exception:
+        pass
+
+    v = ctx.broadcast(func.children[0].eval(ctx))
+    if func.distinct_elements:
+        # per-slot re-sort including the value: equal values in a group
+        # become adjacent so first-occurrence positions dedupe them
+        vdata = v.data
+        if (np.asarray(vdata).dtype if _is_np(xp) else vdata.dtype) \
+                == np.bool_:
+            vdata = vdata.astype(np.int8)
+        vnull = xp.zeros(capacity, np.int8) if v.valid is None else \
+            xp.where(v.valid, np.int8(0), np.int8(1))
+        perm = multi_key_argsort(xp, sort_cols + [vnull, vdata], capacity)
+        live_s = ctx.batch.row_valid_or_true()[perm]
+        if is_start is not None:
+            change = xp.zeros(capacity, bool)
+            for c in [c0[perm] for c0 in sort_cols]:
+                shifted = xp.concatenate([c[:1], c[:-1]])
+                change = change | (c != shifted)
+            if _is_np(xp):
+                change = change.copy()
+                change[0] = True
+            else:
+                change = change.at[0].set(True)
+            is_start = change & live_s
+            seg_ids = xp.cumsum(is_start.astype(np.int64)) - 1
+            seg_ids = xp.where(live_s, seg_ids, np.int64(capacity - 1))
+
+    value_s = v.data[perm]
+    valid_s = None if v.valid is None else v.valid[perm]
+    keep = live_s if valid_s is None else (live_s & valid_s)
+    if func.distinct_elements:
+        prev_v = xp.concatenate([value_s[:1], value_s[:-1]])
+        prev_seg = xp.concatenate([seg_ids[:1] - 1, seg_ids[:-1]])
+        first = (value_s != prev_v) | (seg_ids != prev_seg)
+        keep = keep & first
+    # position among KEPT rows of the same segment (cumsum minus the
+    # segment's running total at its start)
+    ck = xp.cumsum(keep.astype(np.int64))
+    seg_base = segment_reduce(xp, xp.where(keep, ck - 1, np.int64(1 << 62)),
+                              seg_ids, capacity, "min")
+    pos = ck - 1 - seg_base[seg_ids]
+    row = xp.where(keep & (pos >= 0) & (pos < lmax), seg_ids,
+                   np.int64(capacity))
+    col = xp.clip(pos, 0, lmax - 1)
+    np_ed = ed.np_dtype
+    if _is_np(xp):
+        out = np.full((capacity + 1, lmax), sent, np_ed)
+        out[np.asarray(row), np.asarray(col)] = np.asarray(value_s
+                                                           ).astype(np_ed)
+    else:
+        out = xp.full((capacity + 1, lmax), sent, np_ed)
+        out = out.at[row, col].set(value_s.astype(np_ed))
+    return ColumnVector(out[:capacity], dt, None, v.dictionary)
 
 
 def _scatter_starts(xp, sorted_data: Array, seg_ids: Array, is_start: Array,

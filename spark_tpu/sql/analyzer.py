@@ -218,8 +218,36 @@ class Analyzer:
         # qualified refs bound) to build the all-column join condition
         plan = plan.transform_up(self._replace_set_ops)
         plan = plan.transform_up(self._rewrite_node)
+        plan = plan.transform_up(self._rewrite_explode)
         self._validate(plan)
         return plan
+
+    @staticmethod
+    def _rewrite_explode(node: LogicalPlan) -> LogicalPlan:
+        """Project containing explode()/posexplode() → the Explode
+        operator (shared by SQL text and the DataFrame API)."""
+        from ..expressions import Alias, ExplodeMarker
+        from .logical import Explode, Project
+        if not isinstance(node, Project):
+            return node
+
+        def marker(e):
+            base = e.children[0] if isinstance(e, Alias) else e
+            return base if isinstance(base, ExplodeMarker) else None
+
+        markers = [e for e in node.exprs if marker(e) is not None]
+        if not markers:
+            return node
+        if len(markers) != 1:
+            raise AnalysisException(
+                "only one explode() per select is supported")
+        m = markers[0]
+        mk = marker(m)
+        out_name = m.name if isinstance(m, Alias) else "col"
+        pre = [e for e in node.exprs if marker(e) is None]
+        insert_at = node.exprs.index(m)     # keep select-list position
+        return Explode(pre, mk.children[0], out_name, mk.with_pos, "pos",
+                       node.children[0], insert_at=insert_at)
 
     def _expand_stars(self, node: LogicalPlan) -> LogicalPlan:
         """Expand `*` / `tbl.*` left by the parser over unresolved relations

@@ -94,6 +94,16 @@ class DataFrame:
                 exprs += [Col(n) for n in self.schema.names]
             else:
                 exprs.append(_to_expr(c))
+        # explode()/posexplode() flows through the plain Project — the
+        # analyzer's _rewrite_explode turns it into the Explode operator
+        # (ONE rewrite shared with the SQL path)
+        from ..expressions import ExplodeMarker
+
+        def _has_marker(e):
+            base = e.children[0] if isinstance(e, Alias) else e
+            return isinstance(base, ExplodeMarker)
+        if any(_has_marker(e) for e in exprs):
+            return DataFrame(self.session, L.Project(exprs, self._plan))
         # select with aggregates and no grouping is a global aggregation
         # (Dataset.select's ungrouped-agg path): df.select(avg(x)) works;
         # mixing plain columns in raises like the reference does

@@ -355,3 +355,217 @@ def desc(name: str):
 
 def struct(*cols):
     raise NotImplementedError("struct columns arrive with nested-type support")
+
+
+# ---------------------------------------------------------------------------
+# expression breadth: date arithmetic, parameterized string fns, math tail
+# ---------------------------------------------------------------------------
+
+def date_add(c: ColumnOrName, days) -> Column:
+    return Column(E.DateArith("date_add", _e(c), _ev(days)))
+
+
+def date_sub(c: ColumnOrName, days) -> Column:
+    return Column(E.DateArith("date_sub", _e(c), _ev(days)))
+
+
+def datediff(end: ColumnOrName, start: ColumnOrName) -> Column:
+    return Column(E.DateArith("datediff", _e(end), _e(start)))
+
+
+def add_months(c: ColumnOrName, months) -> Column:
+    return Column(E.DateArith("add_months", _e(c), _ev(months)))
+
+
+def months_between(end: ColumnOrName, start: ColumnOrName) -> Column:
+    return Column(E.DateArith("months_between", _e(end), _e(start)))
+
+
+def last_day(c: ColumnOrName) -> Column:
+    return Column(E.DateArith("last_day", _e(c)))
+
+
+def next_day(c: ColumnOrName, dayOfWeek: str) -> Column:
+    return Column(E.NextDay(_e(c), dayOfWeek))
+
+
+def trunc(c: ColumnOrName, fmt: str) -> Column:
+    return Column(E.TruncDate(_e(c), fmt))
+
+
+def unix_timestamp(c: ColumnOrName) -> Column:
+    return Column(E.UnixTimestamp(_e(c)))
+
+
+def from_unixtime(c: ColumnOrName) -> Column:
+    """Returns TIMESTAMP (deviation: the reference formats a string)."""
+    return Column(E.UnixTimestamp(_e(c), inverse=True))
+
+
+def hypot(a: ColumnOrName, b: ColumnOrName) -> Column:
+    return Column(E.BinaryMath("hypot", _e(a), _e(b)))
+
+
+def atan2(a: ColumnOrName, b: ColumnOrName) -> Column:
+    return Column(E.BinaryMath("atan2", _e(a), _e(b)))
+
+
+def nanvl(a: ColumnOrName, b: ColumnOrName) -> Column:
+    return Column(E.BinaryMath("nanvl", _e(a), _e(b)))
+
+
+def log1p(c: ColumnOrName) -> Column:
+    return Column(E.UnaryMath("log1p", _e(c)))
+
+
+def expm1(c: ColumnOrName) -> Column:
+    return Column(E.UnaryMath("expm1", _e(c)))
+
+
+def cbrt(c: ColumnOrName) -> Column:
+    return Column(E.UnaryMath("cbrt", _e(c)))
+
+
+def rint(c: ColumnOrName) -> Column:
+    return Column(E.UnaryMath("rint", _e(c)))
+
+
+def regexp_replace(c: ColumnOrName, pattern: str, replacement: str) -> Column:
+    return Column(E.ParamStringTransform("regexp_replace", _e(c),
+                                         (pattern, replacement)))
+
+
+def regexp_extract(c: ColumnOrName, pattern: str, idx: int = 1) -> Column:
+    return Column(E.ParamStringTransform("regexp_extract", _e(c),
+                                         (pattern, idx)))
+
+
+def lpad(c: ColumnOrName, length: int, pad: str = " ") -> Column:
+    return Column(E.ParamStringTransform("lpad", _e(c), (length, pad)))
+
+
+def rpad(c: ColumnOrName, length: int, pad: str = " ") -> Column:
+    return Column(E.ParamStringTransform("rpad", _e(c), (length, pad)))
+
+
+def translate(c: ColumnOrName, matching: str, replace: str) -> Column:
+    return Column(E.ParamStringTransform("translate", _e(c),
+                                         (matching, replace)))
+
+
+def repeat(c: ColumnOrName, n: int) -> Column:
+    return Column(E.ParamStringTransform("repeat", _e(c), (n,)))
+
+
+def soundex(c: ColumnOrName) -> Column:
+    return Column(E.ParamStringTransform("soundex", _e(c)))
+
+
+def md5(c: ColumnOrName) -> Column:
+    return Column(E.ParamStringTransform("md5", _e(c)))
+
+
+def sha1(c: ColumnOrName) -> Column:
+    return Column(E.ParamStringTransform("sha1", _e(c)))
+
+
+def sha2(c: ColumnOrName, numBits: int = 256) -> Column:
+    return Column(E.ParamStringTransform("sha2", _e(c), (numBits,)))
+
+
+def base64(c: ColumnOrName) -> Column:
+    return Column(E.ParamStringTransform("base64", _e(c)))
+
+
+def unbase64(c: ColumnOrName) -> Column:
+    return Column(E.ParamStringTransform("unbase64", _e(c)))
+
+
+def hex(c: ColumnOrName) -> Column:
+    return Column(E.ParamStringTransform("hex", _e(c)))
+
+
+def instr(c: ColumnOrName, substr: str) -> Column:
+    return Column(E.StringToInt("instr", _e(c), (substr,)))
+
+
+def locate(substr: str, c: ColumnOrName, pos: int = 1) -> Column:
+    return Column(E.StringToInt("locate", _e(c), (substr, pos)))
+
+
+def levenshtein(c: ColumnOrName, other: str) -> Column:
+    """Edit distance to a LITERAL string (column-vs-column needs a host
+    pairwise pass; the dictionary-table contract covers the literal case)."""
+    return Column(E.StringToInt("levenshtein", _e(c), (other,)))
+
+
+def crc32(c: ColumnOrName) -> Column:
+    return Column(E.StringToInt("crc32", _e(c)))
+
+
+def randn(seed: int = 0) -> Column:
+    return Column(E.Randn(seed))
+
+
+def spark_partition_id() -> Column:
+    return Column(E.SparkPartitionId())
+
+
+def input_file_name() -> Column:
+    """The reference returns '' when no file info is attached to the task;
+    scans here are materialized batches, so that is always the case."""
+    return Column(E.Alias(E.Literal(""), "input_file_name()"))
+
+
+__all__ += [
+    "date_add", "date_sub", "datediff", "add_months", "months_between",
+    "last_day", "next_day", "trunc", "unix_timestamp", "from_unixtime",
+    "hypot", "atan2", "nanvl", "log1p", "expm1", "cbrt", "rint",
+    "regexp_replace", "regexp_extract", "lpad", "rpad", "translate",
+    "repeat", "soundex", "md5", "sha1", "sha2", "base64", "unbase64",
+    "hex", "instr", "locate", "levenshtein", "crc32", "randn",
+    "spark_partition_id", "input_file_name",
+]
+
+
+def array(*cols: ColumnOrName) -> Column:
+    return Column(E.MakeArray(*[_e(c) for c in cols]))
+
+
+def split(c: ColumnOrName, pattern: str, limit: int = -1) -> Column:
+    return Column(E.SplitStr(_e(c), pattern, limit))
+
+
+def size(c: ColumnOrName) -> Column:
+    return Column(E.ArraySize(_e(c)))
+
+
+def element_at(c: ColumnOrName, index: int) -> Column:
+    return Column(E.ElementAt(_e(c), index))
+
+
+def array_contains(c: ColumnOrName, value: Any) -> Column:
+    return Column(E.ArrayContains(_e(c), value))
+
+
+def explode(c: ColumnOrName) -> Column:
+    return Column(E.ExplodeMarker(_e(c)))
+
+
+def posexplode(c: ColumnOrName) -> Column:
+    return Column(E.ExplodeMarker(_e(c), with_pos=True))
+
+
+__all__ += ["array", "split", "size", "element_at", "array_contains",
+            "explode", "posexplode"]
+
+
+def collect_list(c: ColumnOrName) -> Column:
+    return Column(A.CollectList(_e(c)))
+
+
+def collect_set(c: ColumnOrName) -> Column:
+    return Column(A.CollectSet(_e(c)))
+
+
+__all__ += ["collect_list", "collect_set"]

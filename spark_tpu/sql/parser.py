@@ -275,7 +275,101 @@ SCALAR_FUNCTIONS = {
     "boolean": lambda a: Cast(_one(a, "boolean"), T.boolean),
 }
 
+# expression-breadth registrations (static args come from literal values)
+def _litval(e, name):
+    from ..expressions import Literal, Neg
+    if isinstance(e, Neg) and isinstance(e.children[0], Literal):
+        return -e.children[0].value
+    if not isinstance(e, Literal):
+        raise ParseException(f"{name} expects a literal argument")
+    return e.value
+
+
+def _register_breadth():
+    from ..expressions import (
+        BinaryMath, DateArith, NextDay, ParamStringTransform, Randn,
+        SparkPartitionId, StringToInt, TruncDate, UnixTimestamp,
+    )
+    out = {
+        "date_add": lambda a: DateArith("date_add", a[0], a[1]),
+        "date_sub": lambda a: DateArith("date_sub", a[0], a[1]),
+        "datediff": lambda a: DateArith("datediff", a[0], a[1]),
+        "add_months": lambda a: DateArith("add_months", a[0], a[1]),
+        "months_between": lambda a: DateArith("months_between", a[0], a[1]),
+        "last_day": lambda a: DateArith("last_day", a[0]),
+        "next_day": lambda a: NextDay(a[0], _litval(a[1], "next_day")),
+        "trunc": lambda a: TruncDate(a[0], _litval(a[1], "trunc")),
+        "unix_timestamp": lambda a: UnixTimestamp(a[0]),
+        "from_unixtime": lambda a: UnixTimestamp(a[0], inverse=True),
+        "hypot": lambda a: BinaryMath("hypot", a[0], a[1]),
+        "atan2": lambda a: BinaryMath("atan2", a[0], a[1]),
+        "nanvl": lambda a: BinaryMath("nanvl", a[0], a[1]),
+        "log1p": _fn_unary("log1p"), "expm1": _fn_unary("expm1"),
+        "cbrt": _fn_unary("cbrt"), "rint": _fn_unary("rint"),
+        "regexp_replace": lambda a: ParamStringTransform(
+            "regexp_replace", a[0], (_litval(a[1], "regexp_replace"),
+                                     _litval(a[2], "regexp_replace"))),
+        "regexp_extract": lambda a: ParamStringTransform(
+            "regexp_extract", a[0],
+            (_litval(a[1], "regexp_extract"),
+             int(_litval(a[2], "regexp_extract")) if len(a) > 2 else 1)),
+        "lpad": lambda a: ParamStringTransform(
+            "lpad", a[0], (int(_litval(a[1], "lpad")),
+                           _litval(a[2], "lpad") if len(a) > 2 else " ")),
+        "rpad": lambda a: ParamStringTransform(
+            "rpad", a[0], (int(_litval(a[1], "rpad")),
+                           _litval(a[2], "rpad") if len(a) > 2 else " ")),
+        "translate": lambda a: ParamStringTransform(
+            "translate", a[0], (_litval(a[1], "translate"),
+                                _litval(a[2], "translate"))),
+        "repeat": lambda a: ParamStringTransform(
+            "repeat", a[0], (int(_litval(a[1], "repeat")),)),
+        "soundex": lambda a: ParamStringTransform("soundex", a[0]),
+        "md5": lambda a: ParamStringTransform("md5", a[0]),
+        "sha1": lambda a: ParamStringTransform("sha1", a[0]),
+        "sha2": lambda a: ParamStringTransform(
+            "sha2", a[0], (int(_litval(a[1], "sha2")) if len(a) > 1
+                           else 256,)),
+        "base64": lambda a: ParamStringTransform("base64", a[0]),
+        "unbase64": lambda a: ParamStringTransform("unbase64", a[0]),
+        "hex": lambda a: ParamStringTransform("hex", a[0]),
+        "instr": lambda a: StringToInt("instr", a[0],
+                                       (_litval(a[1], "instr"),)),
+        "locate": lambda a: StringToInt(
+            "locate", a[1], (_litval(a[0], "locate"),
+                             int(_litval(a[2], "locate")) if len(a) > 2
+                             else 1)),
+        "levenshtein": lambda a: StringToInt(
+            "levenshtein", a[0], (_litval(a[1], "levenshtein"),)),
+        "crc32": lambda a: StringToInt("crc32", a[0]),
+        "randn": lambda a: Randn(int(a[0].value) if a else 42),
+        "spark_partition_id": lambda a: SparkPartitionId(),
+    }
+    from ..expressions import (
+        ArrayContains, ArraySize, ElementAt, ExplodeMarker, MakeArray,
+        SplitStr,
+    )
+    out.update({
+        "array": lambda a: MakeArray(*a),
+        "split": lambda a: SplitStr(a[0], _litval(a[1], "split")),
+        "size": lambda a: ArraySize(_one(a, "size")),
+        "cardinality": lambda a: ArraySize(_one(a, "cardinality")),
+        "element_at": lambda a: ElementAt(
+            a[0], int(_litval(a[1], "element_at"))),
+        "array_contains": lambda a: ArrayContains(
+            a[0], _litval(a[1], "array_contains")),
+        "explode": lambda a: ExplodeMarker(_one(a, "explode")),
+        "posexplode": lambda a: ExplodeMarker(_one(a, "posexplode"),
+                                              with_pos=True),
+    })
+    return out
+
+
+SCALAR_FUNCTIONS.update(_register_breadth())
+
 AGG_FUNCTIONS = {
+    "collect_list": lambda e: A.CollectList(e),
+    "collect_set": lambda e: A.CollectSet(e),
     "sum": lambda e: A.Sum(e),
     "avg": lambda e: A.Avg(e),
     "mean": lambda e: A.Avg(e),

@@ -385,3 +385,83 @@ class PSample(PhysicalPlan):
 
     def __repr__(self):
         return f"Sample({self.fraction}, seed={self.seed})"
+
+
+class PExplode(PhysicalPlan):
+    """Static row generation: ``(capacity, L)`` arrays flatten to
+    ``capacity*L`` rows; companion columns repeat; dead element slots
+    join the row mask."""
+
+    def __init__(self, pre_exprs, array_expr, out_name, with_pos, pos_name,
+                 child, insert_at=None):
+        self.pre_exprs = list(pre_exprs)
+        self.array_expr = array_expr
+        self.out_name = out_name
+        self.with_pos = with_pos
+        self.pos_name = pos_name
+        self.insert_at = len(self.pre_exprs) if insert_at is None \
+            else int(insert_at)
+        self.children = (child,)
+
+    def schema(self):
+        cs = self.children[0].schema()
+        gen = []
+        if self.with_pos:
+            gen.append(T.StructField(self.pos_name, T.int32, False))
+        at = self.array_expr.data_type(cs)
+        gen.append(T.StructField(self.out_name, at.element_type))
+        fields = [T.StructField(e.name, e.data_type(cs))
+                  for e in self.pre_exprs]
+        i = min(self.insert_at, len(fields))
+        return T.StructType(fields[:i] + gen + fields[i:])
+
+    def run(self, ctx):
+        from ..expressions import EvalContext, _array_elem_mask
+        import numpy as _np
+        xp = ctx.xp
+        batch = self.children[0].run(ctx)
+        ectx = EvalContext(batch, xp, self.offset_in(ctx))
+        cap = batch.capacity
+        at = self.array_expr.data_type(batch.schema)
+        av = ectx.broadcast(self.array_expr.eval(ectx))
+        if getattr(av.data, "ndim", 2) == 1:
+            # array literal / scalar-derived array: one row's elements —
+            # broadcast to every row (ExprValue.broadcast only knows rank 0)
+            from ..expressions import ExprValue as _EV
+            av = _EV(xp.broadcast_to(av.data, (cap,) + av.data.shape),
+                     av.valid, av.dictionary)
+        L = int(av.data.shape[-1])
+        emask = _array_elem_mask(xp, at, av.data)        # (cap, L)
+        pre_cols = []
+        for e in self.pre_exprs:
+            v = ectx.broadcast(e.eval(ectx))
+            dt = e.data_type(batch.schema)
+            data = xp.repeat(v.data, L, axis=0)
+            valid = None if v.valid is None else xp.repeat(v.valid, L)
+            pre_cols.append((e.name, ColumnVector(data, dt, valid,
+                                                  v.dictionary)))
+        gen_cols = []
+        if self.with_pos:
+            pos = xp.broadcast_to(xp.arange(L, dtype=_np.int32), (cap, L))
+            gen_cols.append((self.pos_name,
+                             ColumnVector(pos.reshape(cap * L), T.int32,
+                                          None, None)))
+        elem = av.data.reshape(cap * L)
+        gen_cols.append((self.out_name,
+                         ColumnVector(elem, at.element_type, None,
+                                      av.dictionary)))
+        i = min(self.insert_at, len(pre_cols))
+        ordered = pre_cols[:i] + gen_cols + pre_cols[i:]
+        names = [n for n, _v in ordered]
+        vectors = [v for _n, v in ordered]
+        rv = batch.row_valid_or_true()
+        if av.valid is not None:
+            rv = rv & av.valid
+        out_rv = xp.repeat(rv, L) & emask.reshape(cap * L)
+        return ColumnBatch(names, vectors, out_rv, cap * L)
+
+    def __repr__(self):
+        pos = f" POS {self.pos_name}" if self.with_pos else ""
+        pre = ", ".join(repr(e) for e in self.pre_exprs)
+        return (f"Explode[{pre} | {self.array_expr!r} AS "
+                f"{self.out_name}{pos} @{self.insert_at}]")

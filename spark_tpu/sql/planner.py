@@ -74,6 +74,30 @@ def _slice_to_host(result: ColumnBatch, n: int) -> ColumnBatch:
     return ColumnBatch(result.names, vectors, rv, cap)
 
 
+def _needs_local_fallback(plan: LogicalPlan) -> bool:
+    """Plans the distributed executor cannot shard yet: collect_list/set
+    aggregates (no fixed-width mergeable partial form) and any operator
+    whose schema carries ArrayType columns (exchanges are 1-D today)."""
+    found = []
+
+    def walk(node: LogicalPlan):
+        if isinstance(node, Aggregate):
+            for f, _n in node.aggs:
+                if getattr(f, "is_collect", False):
+                    found.append("collect")
+        try:
+            if any(isinstance(f.dataType, T.ArrayType)
+                   for f in node.schema().fields):
+                found.append("array")
+        except Exception:
+            pass
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+    return bool(found)
+
+
 class PlannedQuery:
     def __init__(self, physical: P.PhysicalPlan, leaves: List[ColumnBatch]):
         self.physical = physical
@@ -168,6 +192,12 @@ class Planner:
         if isinstance(node, Sample):
             return P.PSample(node.fraction, node.seed,
                              self._to_physical(node.child, leaves))
+        from .logical import Explode
+        if isinstance(node, Explode):
+            return P.PExplode(node.pre_exprs, node.array_expr, node.out_name,
+                              node.with_pos, node.pos_name,
+                              self._to_physical(node.child, leaves),
+                              insert_at=node.insert_at)
         if isinstance(node, Join):
             from .joins import plan_join
             return plan_join(self, node, leaves)
@@ -262,6 +292,13 @@ class QueryExecution:
         n_shards = self.session.conf.get(C.MESH_SHARDS)
         if n_shards == 0:
             n_shards = len(jax.devices())
+        if n_shards > 1 and _needs_local_fallback(self.optimized):
+            # collect aggregates have no fixed-width mergeable partial
+            # form, and array columns don't ride the 1-D exchanges yet —
+            # run single-shard (the reference's objectHashAggregate also
+            # falls back rather than spilling through the shuffle)
+            _log.info("collect/array plan: falling back to single-shard")
+            n_shards = 1
         if n_shards > 1:
             from ..parallel.executor import DistributedExecution
             from ..parallel.mesh import get_mesh

@@ -289,6 +289,8 @@ class SparkSession:
         from ..memory import DeviceCacheManager, MemoryManager
         self._memory = MemoryManager(self.conf_obj)
         self._cache = DeviceCacheManager(self._memory, self.conf_obj)
+        # pyspark semantics: constructing a session makes it the active one
+        SparkSession._active = self
 
     @property
     def memoryManager(self):

@@ -196,13 +196,39 @@ class DecimalType(FractionalType):
 
 
 class ArrayType(DataType):
-    """Nested array type (host-side representation only in v0)."""
+    """Array column: fixed-width device layout ``(capacity, max_len)`` in
+    the ELEMENT dtype, trailing slots padded with a per-dtype sentinel
+    (string code -1, float NaN, int64 min).  Deviations from the
+    reference, documented: NULL elements inside arrays and arrays
+    containing the sentinel value itself are not representable; a NULL
+    array and an empty array are both "no elements" (size() returns 0)
+    unless the row mask marks the row NULL."""
 
     name = "array"
 
     def __init__(self, element_type: DataType, contains_null: bool = True):
         self.element_type = element_type
         self.contains_null = contains_null
+
+    @property
+    def np_dtype(self):
+        return self.element_type.np_dtype
+
+    @property
+    def is_string(self):
+        return False
+
+    def element_sentinel(self):
+        ed = self.element_type
+        if ed.is_string:
+            return np.int32(-1)
+        if ed.is_fractional:
+            return np.asarray(np.nan, ed.np_dtype)
+        if np.dtype(ed.np_dtype) == np.bool_:
+            raise ValueError(
+                "arrays of boolean have no spare sentinel value; cast the "
+                "elements to int first")
+        return np.asarray(np.iinfo(ed.np_dtype).min, ed.np_dtype)
 
     def __eq__(self, other: Any) -> bool:
         return isinstance(other, ArrayType) and other.element_type == self.element_type

@@ -1,0 +1,12 @@
+-- golden file: statements separated by semicolons; results recorded in
+-- basic.sql.out (SQLQueryTestSuite format analog)
+SELECT 1 + 1 AS two;
+SELECT CAST('2020-02-29' AS DATE) AS leap;
+SELECT upper('mixedCase') AS u, length('abc') AS l;
+SELECT CASE WHEN 1 < 2 THEN 'y' ELSE 'n' END AS c;
+SELECT coalesce(NULL, 3) AS c, nullif(4, 4) AS n;
+SELECT 7 % 3 AS m, 7 / 2 AS d, CAST(7 / 2 AS INT) AS i;
+SELECT greatest(1, 5, 3) AS g, least(1, 5, 3) AS l;
+SELECT round(2.5) AS r1, round(-2.5) AS r2, round(1.2345, 2) AS r3;
+SELECT concat('a', 'b', 'c') AS c, substring('hello', 2, 3) AS s;
+SELECT year(CAST('1999-12-31' AS DATE)) AS y, quarter(CAST('1999-12-31' AS DATE)) AS q;

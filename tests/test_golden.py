@@ -1,0 +1,84 @@
+"""Golden-file SQL tests (`SQLQueryTestSuite.scala:82` analog).
+
+Each `tests/golden/*.sql` holds semicolon-separated statements; the
+expected output lives beside it as `<name>.sql.out` (one block per
+statement: the query, then schema + sorted result rows).  Regenerate
+after intended changes with:
+
+    python -m tests.test_golden --regen
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def _statements(path):
+    from spark_tpu.cli import split_sql_statements
+    with open(path) as f:
+        return split_sql_statements(f.read())
+
+
+def _register_views(spark):
+    import pandas as pd
+    rng = np.random.default_rng(7)
+    t1 = pd.DataFrame({"k": rng.integers(0, 5, 40).astype(np.int64),
+                       "v": rng.integers(0, 20, 40).astype(np.int64)})
+    t2 = pd.DataFrame({"k": np.arange(3, 8, dtype=np.int64),
+                       "w": np.arange(100, 105, dtype=np.int64)})
+    spark.createDataFrame(t1).createOrReplaceTempView("t1")
+    spark.createDataFrame(t2).createOrReplaceTempView("t2")
+
+
+def _run_statement(spark, sql):
+    df = spark.sql(sql)
+    schema = df.schema.simpleString()
+    rows = sorted(tuple(r) for r in df.collect())
+    lines = [f"-- query\n{sql}", f"-- schema\n{schema}", "-- rows"]
+    for r in rows:
+        lines.append(repr(tuple(r)))
+    return "\n".join(lines)
+
+
+def _render(spark, path):
+    return "\n\n".join(_run_statement(spark, s)
+                       for s in _statements(path)) + "\n"
+
+
+def _files():
+    return sorted(f for f in os.listdir(GOLDEN_DIR) if f.endswith(".sql"))
+
+
+@pytest.mark.parametrize("name", _files())
+def test_golden(spark, name):
+    _register_views(spark)
+    path = os.path.join(GOLDEN_DIR, name)
+    expected_path = path + ".out"
+    got = _render(spark, path)
+    assert os.path.exists(expected_path), \
+        f"missing golden output {expected_path}; regenerate with " \
+        f"python -m tests.test_golden --regen"
+    with open(expected_path) as f:
+        expected = f.read()
+    assert got == expected, f"golden mismatch for {name}"
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from spark_tpu.sql.session import SparkSession
+    spark = SparkSession()
+    _register_views(spark)
+    for name in _files():
+        path = os.path.join(GOLDEN_DIR, name)
+        with open(path + ".out", "w") as f:
+            f.write(_render(spark, path))
+        print("wrote", path + ".out")
+
+
+if __name__ == "__main__" and "--regen" in sys.argv:
+    main()
