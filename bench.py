@@ -70,7 +70,8 @@ PREFLIGHT_HANG_S = int(os.environ.get("SPARK_TPU_BENCH_PREFLIGHT_HANG",
 # orchestrator
 # ======================================================================
 
-def _run_child(platform: str | None) -> tuple[int, str, str]:
+def _run_child(platform: str | None,
+               disable_pallas: bool = False) -> tuple[int, str, str]:
     # NB: the axon plugin's sitecustomize force-sets jax_platforms and
     # ignores the JAX_PLATFORMS env var, so the platform is passed as an
     # argv flag and applied via jax.config inside the child.
@@ -82,6 +83,10 @@ def _run_child(platform: str | None) -> tuple[int, str, str]:
     if platform is not None:
         argv.append(f"--platform={platform}")
         env["SPARK_TPU_PLATFORM"] = platform
+    if disable_pallas:
+        env["SPARK_TPU_DISABLE_PALLAS"] = "1"
+    else:
+        env.pop("SPARK_TPU_DISABLE_PALLAS", None)
     try:
         proc = subprocess.run(argv, capture_output=True, text=True,
                               timeout=CHILD_TIMEOUT_S, env=env)
@@ -108,12 +113,15 @@ def _extract_json(stdout: str) -> dict | None:
 
 def orchestrate() -> int:
     tails: list[str] = []
-    attempts: list[str | None] = [None] * TPU_ATTEMPTS + ["cpu"]
-    for i, platform in enumerate(attempts):
-        label = platform or "tpu"
+    # TPU attempts with the Pallas agg kernel, then one TPU attempt with
+    # it disabled (Mosaic regression safety), then the CPU fallback
+    attempts: list[tuple[str | None, bool]] = \
+        [(None, False)] * TPU_ATTEMPTS + [(None, True), ("cpu", False)]
+    for i, (platform, no_pallas) in enumerate(attempts):
+        label = (platform or "tpu") + (" no-pallas" if no_pallas else "")
         print(f"[bench] attempt {i + 1}/{len(attempts)} (platform={label})",
               file=sys.stderr)
-        rc, out, err = _run_child(platform)
+        rc, out, err = _run_child(platform, disable_pallas=no_pallas)
         obj = _extract_json(out)
         if rc == 0 and obj is not None:
             if platform == "cpu":
@@ -126,7 +134,7 @@ def orchestrate() -> int:
               file=sys.stderr)
         # back off only before another TPU attempt; the CPU fallback does
         # not depend on TPU recovery
-        if i + 1 < len(attempts) and attempts[i + 1] is None:
+        if i + 1 < len(attempts) and attempts[i + 1][0] is None:
             delay = BACKOFFS_S[min(i, len(BACKOFFS_S) - 1)]
             print(f"[bench] backing off {delay}s", file=sys.stderr)
             time.sleep(delay)

@@ -1055,17 +1055,24 @@ class StringTransform(Expression):
         return T.string
 
     def eval(self, ctx):
-        xp = ctx.xp
         v = self.children[0].eval(ctx)
-        f = self.FNS[self.fn]
-        transformed = [f(w) for w in v.dictionary]
-        new_dict = tuple(sorted(set(transformed)))
-        pos = {w: i for i, w in enumerate(new_dict)}
-        remap = np.array([pos[w] for w in transformed], np.int32) if transformed else np.zeros(1, np.int32)
-        return ExprValue(_dict_gather(xp, remap, v.data, v.valid), v.valid, new_dict)
+        return _rewrite_dictionary(ctx.xp, v, self.FNS[self.fn])
 
     def __repr__(self):
         return f"{self.fn}({self.children[0]!r})"
+
+
+def _rewrite_dictionary(xp, v: ExprValue, fn) -> ExprValue:
+    """Shared host-rewrites-dictionary/device-remaps-codes contract for
+    every string→string transform (StringTransform + the parameterized
+    family)."""
+    transformed = [fn(w) for w in (v.dictionary or ())]
+    new_dict = tuple(sorted(set(transformed))) or ("",)
+    pos = {w: i for i, w in enumerate(new_dict)}
+    remap = np.array([pos[w] for w in transformed], np.int32) \
+        if transformed else np.zeros(1, np.int32)
+    return ExprValue(_dict_gather(xp, remap, v.data, v.valid), v.valid,
+                     new_dict)
 
 
 class Substring(Expression):
@@ -1857,15 +1864,8 @@ class ParamStringTransform(Expression):
         return T.string
 
     def eval(self, ctx):
-        xp = ctx.xp
         v = self.children[0].eval(ctx)
-        transformed = [self._fn(w) for w in v.dictionary]
-        new_dict = tuple(sorted(set(transformed))) or ("",)
-        pos = {w: i for i, w in enumerate(new_dict)}
-        remap = np.array([pos[w] for w in transformed], np.int32) \
-            if transformed else np.zeros(1, np.int32)
-        return ExprValue(_dict_gather(xp, remap, v.data, v.valid), v.valid,
-                         new_dict)
+        return _rewrite_dictionary(ctx.xp, v, self._fn)
 
     def __repr__(self):
         return f"{self.kind}({self.children[0]!r}, {self.params})"
@@ -1962,8 +1962,14 @@ class SparkPartitionId(Expression):
 
     def eval(self, ctx):
         xp = ctx.xp
-        pid = getattr(ctx, "partition_id", 0)
-        return ExprValue(xp.asarray(np.int32(pid)), None)
+        # distributed execution encodes the mesh shard in the high bits of
+        # the row offset (executor.py: shard_offset = axis_index << 48);
+        # single-chip offsets stay below 2^48 → partition 0
+        offset = getattr(ctx, "row_offset", 0)
+        if isinstance(offset, int):
+            pid = np.int32(offset >> 48)
+            return ExprValue(xp.asarray(pid), None)
+        return ExprValue((offset >> 48).astype(np.int32), None)
 
     def __repr__(self):
         return "spark_partition_id()"
@@ -2201,7 +2207,13 @@ class ArrayContains(Expression):
                 return ExprValue(zero, v.valid)
             target = np.int32(idx)
         else:
-            target = np.asarray(self.value, dt.element_type.np_dtype)
+            ed = np.dtype(dt.element_type.np_dtype)
+            if np.issubdtype(ed, np.integer) and \
+                    float(self.value) != int(self.value):
+                # 1.5 can never equal an integer element; casting would
+                # truncate and false-positive
+                return ExprValue(xp.zeros(v.data.shape[0], bool), v.valid)
+            target = np.asarray(self.value, ed)
         hit = ((v.data == target) & mask).any(axis=-1)
         return ExprValue(hit, v.valid)
 

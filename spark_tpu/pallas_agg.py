@@ -116,6 +116,11 @@ def _accumulate_chunk(bucket32: Array, planes: Array, n_active: Array, *,
 
 
 def supported(B: int) -> bool:
+    import os
+    if os.environ.get("SPARK_TPU_DISABLE_PALLAS"):
+        # kill switch: lets the bench orchestrator retry a run with the
+        # plain-XLA einsum path if Mosaic lowering breaks on some backend
+        return False
     return HAVE_PALLAS and B <= _MAX_B
 
 

@@ -127,6 +127,51 @@ def psum_arrays(arrays: List[Array], axis: str = DATA_AXIS) -> List[Array]:
     return [lax.psum(a, axis) for a in arrays]
 
 
+def sampled_splitters_multi(keys: List[Array], live: Array, n_shards: int,
+                            samples_per_shard: int = 64,
+                            axis: str = DATA_AXIS) -> List[Array]:
+    """Lexicographic multi-key range splitters (RangePartitioner over the
+    FULL sort key, not just the first column — r1 weak #6): stratified
+    sample of key TUPLES per shard → all_gather → lexsort → quantile
+    tuples.  Returns one (n_shards-1,) array per key column, identical on
+    every shard.  First-key-only splitting is already order-correct
+    (equal first keys co-locate); refining by the remaining keys splits
+    heavy first-key runs across shards instead of hotspotting one."""
+    xp = jnp
+    C = keys[0].shape[0]
+    stride = max(C // samples_per_shard, 1)
+    idx = xp.arange(samples_per_shard) * stride % C
+    big = np.int64(np.iinfo(np.int64).max)
+    cols = []
+    for k in keys:
+        sample = k[idx]
+        sample = xp.where(live[idx], sample, big)
+        cols.append(lax.all_gather(sample, axis, tiled=True))
+    # lexicographic sort of the gathered tuples
+    order = jax.lax.sort(tuple(cols) + (xp.arange(cols[0].shape[0],
+                                                  dtype=np.int32),),
+                         num_keys=len(cols), is_stable=True)[-1]
+    total = samples_per_shard * n_shards
+    pos = (xp.arange(1, n_shards) * total) // n_shards
+    return [c[order][pos] for c in cols]
+
+
+def lex_bucket(keys: List[Array], splitters: List[Array]) -> Array:
+    """bucket[row] = number of splitter tuples <= row's key tuple
+    (lexicographic searchsorted, vectorized over (capacity, n-1))."""
+    xp = jnp
+    n1 = splitters[0].shape[0]
+    gt = xp.zeros((keys[0].shape[0], n1), bool)
+    eq = xp.ones((keys[0].shape[0], n1), bool)
+    for k, s in zip(keys, splitters):
+        kv = k[:, None]
+        sv = s[None, :]
+        gt = gt | (eq & (kv > sv))
+        eq = eq & (kv == sv)
+    ge = gt | eq                      # tuple >= splitter → to its right
+    return ge.sum(axis=1).astype(np.int32)
+
+
 def sampled_splitters(key: Array, live: Array, n_shards: int,
                       samples_per_shard: int = 64, axis: str = DATA_AXIS) -> Array:
     """Range-partition splitters from a global sample of sort keys

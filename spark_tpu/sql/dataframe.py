@@ -283,6 +283,24 @@ class DataFrame:
     def coalesce(self, num: int) -> "DataFrame":
         return self
 
+    def checkpoint(self, eager: bool = True) -> "DataFrame":
+        """Truncate lineage by materializing to reliable storage
+        (``Dataset.checkpoint`` / ReliableRDDCheckpointData): parquet under
+        ``spark.tpu.checkpoint.dir`` (falls back to the warehouse dir);
+        the result reads back from the files, so a driver restart can
+        resume from them."""
+        import os
+        import uuid
+        from .. import config as C
+        base = self.session.conf.get("spark.tpu.checkpoint.dir", None) or \
+            os.path.join(self.session.conf.get(C.WAREHOUSE_DIR),
+                         "_checkpoints")
+        path = os.path.join(base, uuid.uuid4().hex[:12])
+        self.write.parquet(path)
+        return self.session.read.parquet(path)
+
+    localCheckpoint = checkpoint
+
     def cache(self, level: Optional[str] = None) -> "DataFrame":
         """Materialize and register in the session's device cache manager
         (``CacheManager.cacheQuery``); other queries containing this exact

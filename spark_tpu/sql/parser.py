@@ -351,7 +351,9 @@ def _register_breadth():
     )
     out.update({
         "array": lambda a: MakeArray(*a),
-        "split": lambda a: SplitStr(a[0], _litval(a[1], "split")),
+        "split": lambda a: SplitStr(a[0], _litval(a[1], "split"),
+                            int(_litval(a[2], "split"))
+                            if len(a) > 2 else -1),
         "size": lambda a: ArraySize(_one(a, "size")),
         "cardinality": lambda a: ArraySize(_one(a, "cardinality")),
         "element_at": lambda a: ElementAt(
