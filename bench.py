@@ -124,6 +124,9 @@ def orchestrate() -> int:
         rc, out, err = _run_child(platform, disable_pallas=no_pallas)
         obj = _extract_json(out)
         if rc == 0 and obj is not None:
+            if no_pallas:
+                # make a Mosaic regression VISIBLE in the tracked metric
+                obj["backend"] = "tpu-no-pallas"
             if platform == "cpu":
                 obj["backend"] = "cpu-fallback"
             print(json.dumps(obj))

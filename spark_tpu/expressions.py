@@ -2250,3 +2250,28 @@ class ExplodeMarker(Expression):
 
     def __repr__(self):
         return f"explode({self.children[0]!r})"
+
+
+class GroupingCall(Expression):
+    """grouping(col) / grouping_id() inside GROUP BY ROLLUP/CUBE/GROUPING
+    SETS — resolved to per-branch literals by the analyzer's grouping-sets
+    rewrite (`grouping__id` in the reference's Expand output)."""
+
+    def __init__(self, child: Optional[Expression]):
+        self.children = (child,) if child is not None else ()
+
+    @property
+    def name(self):
+        return "grouping_id()" if not self.children \
+            else f"grouping({self.children[0].name})"
+
+    def data_type(self, schema):
+        return T.int64 if not self.children else T.int32
+
+    def eval(self, ctx):
+        raise AnalysisException(
+            "grouping()/grouping_id() are only valid with GROUP BY "
+            "ROLLUP/CUBE/GROUPING SETS")
+
+    def __repr__(self):
+        return self.name

@@ -173,26 +173,8 @@ def lex_bucket(keys: List[Array], splitters: List[Array]) -> Array:
 
 
 def sampled_splitters(key: Array, live: Array, n_shards: int,
-                      samples_per_shard: int = 64, axis: str = DATA_AXIS) -> Array:
-    """Range-partition splitters from a global sample of sort keys
-    (``RangePartitioner.sketch`` analog: sample → gather → quantiles).
-
-    key: int64-comparable sort key per row (nulls/dead pre-sentineled).
-    Returns (n_shards-1,) splitter array, identical on every shard.
-    """
-    xp = jnp
-    C = key.shape[0]
-    # deterministic stratified sample: every k-th live row (sorted sample
-    # would bias; stride sampling is what RangePartitioner's reservoir
-    # approximates for static shapes)
-    stride = max(C // samples_per_shard, 1)
-    idx = xp.arange(samples_per_shard) * stride % C
-    sample = key[idx]
-    sample_live = live[idx]
-    big = np.int64(np.iinfo(np.int64).max)
-    sample = xp.where(sample_live, sample, big)   # dead samples sort last
-    all_samples = lax.all_gather(sample, axis, tiled=True)
-    all_samples = xp.sort(all_samples)
-    total = samples_per_shard * n_shards
-    pos = (xp.arange(1, n_shards) * total) // n_shards
-    return all_samples[pos]
+                      samples_per_shard: int = 64,
+                      axis: str = DATA_AXIS) -> Array:
+    """Single-key convenience wrapper over sampled_splitters_multi."""
+    return sampled_splitters_multi([key], live, n_shards,
+                                   samples_per_shard, axis)[0]

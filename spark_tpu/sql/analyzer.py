@@ -219,8 +219,85 @@ class Analyzer:
         plan = plan.transform_up(self._replace_set_ops)
         plan = plan.transform_up(self._rewrite_node)
         plan = plan.transform_up(self._rewrite_explode)
+        plan = plan.transform_up(self._rewrite_grouping_sets)
         self._validate(plan)
         return plan
+
+    @staticmethod
+    def _rewrite_grouping_sets(node: LogicalPlan) -> LogicalPlan:
+        """GroupingSets → UNION ALL of one Aggregate per grouping set:
+        absent keys project as typed NULLs, grouping()/grouping_id() calls
+        become per-branch literals (Expand-free ROLLUP/CUBE)."""
+        from ..expressions import Cast, GroupingCall, Literal
+        from .logical import GroupingSets, Filter as LFilter, Union as LUnion
+        if not isinstance(node, GroupingSets):
+            return node
+        child_schema = node.children[0].schema()
+        key_reprs = [repr(k) for k in node.keys]
+        key_dts = [k.data_type(child_schema) for k in node.keys]
+        branches = []
+        for s_idx in node.sets:
+            present = set(s_idx)
+            # grouping_id bitmask: bit i set when key i is AGGREGATED away
+            gid = 0
+            for i in range(len(node.keys)):
+                if i not in present:
+                    gid |= 1 << (len(node.keys) - 1 - i)
+
+            def subst(e: Expression) -> Expression:
+                if isinstance(e, GroupingCall):
+                    if not e.children:
+                        return Literal(gid)
+                    r = repr(e.children[0])
+                    if r not in key_reprs:
+                        raise AnalysisException(
+                            f"grouping() argument {e.children[0]!r} is not "
+                            "a grouping key")
+                    return Literal(
+                        0 if key_reprs.index(r) in present else 1)
+                if isinstance(e, AggregateFunction):
+                    # aggregate ARGUMENTS see the original child rows —
+                    # only grouping OUTPUT columns become NULL (the
+                    # reference's Expand nulls the key copies, never the
+                    # aggregate inputs): SUM(k) over ROLLUP(k) totals k
+                    return e
+                r = repr(e)
+                if r in key_reprs and key_reprs.index(r) not in present:
+                    i = key_reprs.index(r)
+                    return Literal(None, key_dts[i])
+                return e.map_children(subst)
+
+            sel = []
+            for e in node.select_list:
+                if isinstance(e, Alias):
+                    sel.append(Alias(subst(e.children[0]), e.name))
+                else:
+                    ne = subst(e)
+                    sel.append(ne if ne.name == e.name
+                               else Alias(ne, e.name))
+            keys_subset = [node.keys[i] for i in s_idx]
+            branch = build_aggregate(keys_subset, sel, node.children[0])
+            # the aggregate also outputs its keys; keep ONLY the select list
+            want = [e.name for e in node.select_list]
+            if branch.schema().names != want:
+                branch = Project([Col(n) for n in want], branch)
+            if node.having is not None:
+                hv = subst(node.having)
+                slots = []
+                resid = split_aggregate_expr(hv, slots)
+                if slots:
+                    # HAVING with aggregates: re-aggregate per branch with
+                    # extra slots, filter, then project the select list
+                    sel_h = sel + [Alias(f, n) for f, n in slots]
+                    b2 = build_aggregate(keys_subset, sel_h,
+                                         node.children[0])
+                    branch = Project([Col(n) for n in want],
+                                     LFilter(resid, b2))
+                else:
+                    branch = LFilter(hv, branch)
+            branches.append(branch)
+        out = branches[0] if len(branches) == 1 else LUnion(branches)
+        return out
 
     @staticmethod
     def _rewrite_explode(node: LogicalPlan) -> LogicalPlan:

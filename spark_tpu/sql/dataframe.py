@@ -288,7 +288,8 @@ class DataFrame:
         (``Dataset.checkpoint`` / ReliableRDDCheckpointData): parquet under
         ``spark.tpu.checkpoint.dir`` (falls back to the warehouse dir);
         the result reads back from the files, so a driver restart can
-        resume from them."""
+        resume from them.  ``eager=False`` defers the write to the first
+        action, matching the reference's lazy-checkpoint contract."""
         import os
         import uuid
         from .. import config as C
@@ -296,10 +297,14 @@ class DataFrame:
             os.path.join(self.session.conf.get(C.WAREHOUSE_DIR),
                          "_checkpoints")
         path = os.path.join(base, uuid.uuid4().hex[:12])
-        self.write.parquet(path)
-        return self.session.read.parquet(path)
+        if eager:
+            self.write.parquet(path)
+            return self.session.read.parquet(path)
+        return DataFrame(self.session,
+                         L.LazyCheckpoint(self._plan, path))
 
-    localCheckpoint = checkpoint
+    def localCheckpoint(self, eager: bool = True) -> "DataFrame":
+        return self.checkpoint(eager)
 
     def cache(self, level: Optional[str] = None) -> "DataFrame":
         """Materialize and register in the session's device cache manager

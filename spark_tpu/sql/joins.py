@@ -59,12 +59,17 @@ def split_equi_condition(
         if isinstance(c, EQ):
             l, r = c.children
             lr, rr = l.references(), r.references()
-            if lr <= left_cols and rr <= right_cols:
-                keys.append((l, r))
-                continue
-            if lr <= right_cols and rr <= left_cols:
-                keys.append((r, l))
-                continue
+            # BOTH sides must reference columns: `lit = col` is a FILTER,
+            # not a join key (a constant 'key' would force cross-side
+            # encoding of unrelated types — and the reference routes such
+            # conjuncts through PushPredicateThroughJoin as filters)
+            if lr and rr:
+                if lr <= left_cols and rr <= right_cols:
+                    keys.append((l, r))
+                    continue
+                if lr <= right_cols and rr <= left_cols:
+                    keys.append((r, l))
+                    continue
         residual.append(c)
     return keys, residual
 
@@ -260,11 +265,26 @@ class PJoin(P.PhysicalPlan):
             pa = p_enc
             p_ok = probe_live if p_val is None else (probe_live & p_val)
         else:
-            # multi-key / unencodable: combined-hash search with sentinels
-            pa, _pb = _join_keys(pctx, [l for l, _ in self.key_pairs],
-                                 _NULL_PROBE, None)
-            ba, _bb = _join_keys(bctx, [r for _, r in self.key_pairs],
-                                 _NULL_BUILD, _DEAD_BUILD)
+            # multi-key / unencodable: combined-hash search with sentinels.
+            # Mixed int/float pairs hash BOTH sides as float64 — int64(-7)
+            # and float64(-7.0) have different hashes otherwise, silently
+            # dropping every cross-typed match
+            from ..expressions import Cast
+            from .. import types as _T
+            lks, rks = [], []
+            for l, r in self.key_pairs:
+                try:
+                    ldt = l.data_type(probe.schema)
+                    rdt = r.data_type(build.schema)
+                    if ldt.is_numeric and rdt.is_numeric \
+                            and ldt.is_fractional != rdt.is_fractional:
+                        l, r = Cast(l, _T.float64), Cast(r, _T.float64)
+                except Exception:
+                    pass
+                lks.append(l)
+                rks.append(r)
+            pa, _pb = _join_keys(pctx, lks, _NULL_PROBE, None)
+            ba, _bb = _join_keys(bctx, rks, _NULL_BUILD, _DEAD_BUILD)
             perm = multi_key_argsort(xp, [ba], build.capacity)
             ba_s = ba[perm]
             p_ok = probe_live

@@ -97,10 +97,61 @@ def push_filter_through_project(node: LogicalPlan) -> LogicalPlan:
     return node
 
 
+def push_filter_through_alias(node: LogicalPlan) -> LogicalPlan:
+    """Filter(SubqueryAlias(x)) → SubqueryAlias(Filter(x)): the alias only
+    renames the scope; by this phase references are resolved, so the
+    filter sees identical columns inside."""
+    if isinstance(node, Filter) and isinstance(node.child, SubqueryAlias):
+        sa = node.child
+        return SubqueryAlias(sa.alias, Filter(node.condition, sa.children[0]))
+    return node
+
+
+def push_filter_through_aggregate(node: LogicalPlan) -> LogicalPlan:
+    """Filter conjuncts referencing only GROUPING KEYS move below the
+    Aggregate (`PushDownPredicate`'s aggregate case): year-over-year CTE
+    self-joins (q4/q11/q74) filter `d_year = N` ABOVE each aggregate — the
+    unfiltered aggregate would be joined 4-ways and explode."""
+    if not (isinstance(node, Filter) and isinstance(node.child, Aggregate)):
+        return node
+    agg = node.child
+    if not agg.keys:
+        return node
+    # key OUTPUT name -> key input expression (only plain/aliased keys)
+    key_map = {}
+    for k in agg.keys:
+        key_map[k.name] = k.children[0] if isinstance(k, Alias) else k
+    push, keep = [], []
+    for c in split_conjuncts(node.condition):
+        refs = c.references()
+        if refs and refs <= set(key_map) and is_deterministic(c):
+            push.append(substitute(c, key_map))
+        else:
+            keep.append(c)
+    if not push:
+        return node
+    new_agg = Aggregate(agg.keys, agg.aggs,
+                        Filter(join_conjuncts(push), agg.children[0]))
+    return Filter(join_conjuncts(keep), new_agg) if keep else new_agg
+
+
 def push_filter_through_union(node: LogicalPlan) -> LogicalPlan:
+    """Union output names come from the FIRST branch; the pushed condition
+    must rebind to each branch's own column names positionally
+    (`PushProjectionThroughUnion`'s rewrite contract)."""
     if isinstance(node, Filter) and isinstance(node.child, Union):
         u = node.child
-        return Union([Filter(node.condition, c) for c in u.children])
+        try:
+            out_names = u.schema().names
+        except AnalysisException:
+            return node
+        new_children = []
+        for c in u.children:
+            bnames = c.schema().names
+            m = {o: Col(b) for o, b in zip(out_names, bnames) if o != b}
+            cond = substitute(node.condition, m) if m else node.condition
+            new_children.append(Filter(cond, c))
+        return Union(new_children)
     return node
 
 
@@ -375,6 +426,8 @@ class Optimizer:
             Batch("operator-pushdown", [
                 combine_filters,
                 push_filter_through_project,
+                push_filter_through_alias,
+                push_filter_through_aggregate,
                 push_filter_through_union,
                 push_filter_through_join,
                 reorder_joins,

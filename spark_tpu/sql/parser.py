@@ -344,6 +344,12 @@ def _register_breadth():
         "crc32": lambda a: StringToInt("crc32", a[0]),
         "randn": lambda a: Randn(int(a[0].value) if a else 42),
         "spark_partition_id": lambda a: SparkPartitionId(),
+        "grouping": lambda a: __import__(
+            "spark_tpu.expressions", fromlist=["GroupingCall"]
+        ).GroupingCall(_one(a, "grouping")),
+        "grouping_id": lambda a: __import__(
+            "spark_tpu.expressions", fromlist=["GroupingCall"]
+        ).GroupingCall(None),
     }
     from ..expressions import (
         ArrayContains, ArraySize, ElementAt, ExplodeMarker, MakeArray,
@@ -851,18 +857,22 @@ class Parser:
             plan = Filter(self.expr(), plan)
 
         group_keys: Optional[List[Expression]] = None
+        grouping_sets = None            # list of index tuples into keys
         if self.accept_kw("GROUP"):
             self.expect_kw("BY")
-            group_keys = []
-            while True:
-                g = self.expr()
-                group_keys.append(g)
-                if not self.accept_op(","):
-                    break
+            group_keys, grouping_sets = self._grouping_spec()
 
         having = None
         if self.accept_kw("HAVING"):
             having = self.expr()
+
+        if grouping_sets is not None:
+            from .logical import GroupingSets
+            plan = GroupingSets(list(select_list), group_keys,
+                                grouping_sets, having, plan)
+            if distinct:
+                plan = Distinct(plan)
+            return plan
 
         plan = self._finish_select(select_list, plan, group_keys, having)
         if distinct:
@@ -870,6 +880,60 @@ class Parser:
         # ORDER BY / LIMIT are parsed by _set_op_query (queryOrganization
         # applies to the whole set operation, not the last SELECT branch)
         return plan
+
+    def _grouping_spec(self):
+        """GROUP BY keys | ROLLUP(..) | CUBE(..) | GROUPING SETS((..)..).
+        Returns (keys, sets) — sets None for plain grouping."""
+        t = self.peek()
+        word = t.value.upper() if t.kind == "IDENT" else None
+        if word in ("ROLLUP", "CUBE"):
+            self.next()
+            self.expect_op("(")
+            keys = [self.expr()]
+            while self.accept_op(","):
+                keys.append(self.expr())
+            self.expect_op(")")
+            n = len(keys)
+            if word == "ROLLUP":
+                sets = [tuple(range(n - i)) for i in range(n + 1)]
+            else:
+                sets = [tuple(j for j in range(n) if (m >> j) & 1)
+                        for m in range((1 << n) - 1, -1, -1)]
+            return keys, sets
+        if word == "GROUPING":
+            self.next()
+            nxt = self.next()
+            if not (nxt.kind == "IDENT" and nxt.value.upper() == "SETS"):
+                raise ParseException("expected SETS after GROUPING")
+            self.expect_op("(")
+            keys: List[Expression] = []
+            key_pos = {}
+            sets = []
+            while True:
+                self.expect_op("(")
+                cur = []
+                if not self.accept_op(")"):
+                    while True:
+                        e = self.expr()
+                        r = repr(e)
+                        if r not in key_pos:
+                            key_pos[r] = len(keys)
+                            keys.append(e)
+                        cur.append(key_pos[r])
+                        if not self.accept_op(","):
+                            break
+                    self.expect_op(")")
+                sets.append(tuple(cur))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return keys, sets
+        group_keys = []
+        while True:
+            group_keys.append(self.expr())
+            if not self.accept_op(","):
+                break
+        return group_keys, None
 
     def _order_limit(self, plan: LogicalPlan, allow: bool) -> LogicalPlan:
         if allow and (self.at_kw("ORDER") or self.at_kw("SORT")):

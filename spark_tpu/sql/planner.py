@@ -107,16 +107,29 @@ class PlannedQuery:
 class Planner:
     """Logical → physical (``SparkPlanner.strategies`` analog)."""
 
-    def __init__(self, session, join_factor_override: Optional[float] = None):
+    def __init__(self, session, join_factor_override=None):
+        #: None | float (every join) | list (per join construction index —
+        #: chained joins must not COMPOUND one overflowing join's growth)
         self.session = session
         self.join_factor_override = join_factor_override
+        self._join_seq = 0
 
     @property
     def join_factor(self) -> float:
-        """Join output capacity factor; the executor overrides it upward
-        when a run reports overflow (adaptive capacity retry)."""
-        if self.join_factor_override is not None:
-            return self.join_factor_override
+        """Join output capacity factor for the NEXT join constructed; the
+        executor overrides factors upward when a run reports overflow
+        (adaptive capacity retry).  List overrides are positional by join
+        construction order, which matches flag (execution) order for the
+        left-deep plans the planner builds."""
+        i = self._join_seq
+        self._join_seq += 1
+        o = self.join_factor_override
+        if isinstance(o, (list, tuple)):
+            if i < len(o) and o[i] is not None:
+                return o[i]
+            return self.session.conf.get(C.JOIN_OUTPUT_FACTOR)
+        if o is not None:
+            return o
         return self.session.conf.get(C.JOIN_OUTPUT_FACTOR)
 
     def plan(self, logical: LogicalPlan) -> PlannedQuery:
@@ -192,6 +205,17 @@ class Planner:
         if isinstance(node, Sample):
             return P.PSample(node.fraction, node.seed,
                              self._to_physical(node.child, leaves))
+        from .logical import LazyCheckpoint
+        if isinstance(node, LazyCheckpoint):
+            if not node.state["done"]:
+                from .dataframe import DataFrame as _DF
+                _DF(self.session, node.child).write.parquet(node.path)
+                node.state["done"] = True
+            from .logical import FileRelation as _FR
+            from ..io import read_file_relation
+            rel = self.session.read.parquet(node.path)._plan
+            batch = read_file_relation(rel, self.session)
+            return self._scan(batch, leaves)
         from .logical import Explode
         if isinstance(node, Explode):
             return P.PExplode(node.pre_exprs, node.array_expr, node.out_name,
@@ -314,29 +338,38 @@ class QueryExecution:
             return mb.execute()
 
         base_key = "local:" + self.planned.physical.key()
-        factor: Optional[float] = \
-            self.session._adapted_factors.get(base_key)
+        factors = self.session._adapted_factors.get(base_key)
         for attempt in range(self.MAX_ADAPT + 1):
-            pq = self.planned if factor is None \
-                else Planner(self.session, join_factor_override=factor) \
+            pq = self.planned if factors is None \
+                else Planner(self.session, join_factor_override=factors) \
                 .plan(self.optimized)
             result, ratio = self._run_planned(pq)
             if ratio <= 0.0:
-                if factor is not None:
-                    self.session._adapted_factors[base_key] = factor
+                if factors is not None:
+                    self.session._adapted_factors[base_key] = factors
                 return result
-            base = factor if factor is not None else self.session.conf.get(
-                C.JOIN_OUTPUT_FACTOR)
             if attempt == self.MAX_ADAPT:
                 raise RuntimeError(
                     f"join output still overflows after {attempt} adaptive "
-                    f"retries (factor {base}); raise "
+                    f"retries (factors {factors}); raise "
                     f"{C.JOIN_OUTPUT_FACTOR.key} explicitly")
-            factor = grow_capacity_factor(base, ratio)
+            # grow ONLY the joins that overflowed (positional): a chained
+            # plan must not compound one hot join's factor into every join
+            base_f = self.session.conf.get(C.JOIN_OUTPUT_FACTOR)
+            join_ratios = getattr(self, "_last_join_ratios", [])
+            cur = list(factors) if isinstance(factors, (list, tuple)) \
+                else [None] * len(join_ratios)
+            while len(cur) < len(join_ratios):
+                cur.append(None)
+            for i, r in enumerate(join_ratios):
+                if r > 0:
+                    prev = cur[i] if cur[i] is not None else base_f
+                    cur[i] = grow_capacity_factor(prev, r)
+            factors = cur
             _log.warning(
                 "join output overflowed its static capacity by %.0f%%; "
-                "replanning with %s=%.2f", ratio * 100,
-                C.JOIN_OUTPUT_FACTOR.key, factor)
+                "replanning with per-join factors %s", ratio * 100,
+                ["%.2f" % f if f else "-" for f in factors])
 
     def _run_planned(self, pq: PlannedQuery) -> Tuple[ColumnBatch, float]:
         """One execution attempt → (host result, worst overflow ratio).
@@ -381,6 +414,10 @@ class QueryExecution:
             out = pq.physical.run(ctx)
             ratio = _overflow_ratio(
                 [int(f) for f in ctx.flags], ctx.flag_caps)
+            self._last_join_ratios = [
+                int(f) / max(c, 1)
+                for f, c, k in zip(ctx.flags, ctx.flag_caps, ctx.flag_kinds)
+                if k == "join"]
             self.metrics = {(oid, lbl): int(v)
                             for oid, lbl, v in ctx.metrics}
             return compact(np, out.to_host()), ratio
@@ -399,6 +436,7 @@ class QueryExecution:
                 # different static flag capacities / metric keys
                 shape_key = tuple(b.capacity for b in leaves)
                 meta[shape_key] = (list(ctx.flag_caps),
+                                   list(ctx.flag_kinds),
                                    [(oid, lbl)
                                     for oid, lbl, _v in ctx.metrics])
                 return c, c.num_rows(), ctx.flags, \
@@ -410,9 +448,14 @@ class QueryExecution:
         dev_leaves = tuple(b.to_device() for b in pq.leaves)
         result, n_rows, flags, metric_vals = fn(dev_leaves)
         shape_key = tuple(b.capacity for b in pq.leaves)
-        flag_caps, metric_keys = meta.get(shape_key, ([], []))
-        ratio = _overflow_ratio([int(np.asarray(f)) for f in flags],
-                                flag_caps)
+        flag_caps, flag_kinds, metric_keys = meta.get(shape_key,
+                                                      ([], [], []))
+        int_flags = [int(np.asarray(f)) for f in flags]
+        ratio = _overflow_ratio(int_flags, flag_caps)
+        self._last_join_ratios = [
+            f / max(c, 1)
+            for f, c, k in zip(int_flags, flag_caps, flag_kinds)
+            if k == "join"]
         self.metrics = {k: int(np.asarray(v))
                         for k, v in zip(metric_keys, metric_vals)}
         return _slice_to_host(result, int(np.asarray(n_rows))), ratio

@@ -262,6 +262,50 @@ def _rewrite_existence(child: LogicalPlan, value: Expression,
     return joined, Coalesce(Col(flag), Literal(False))
 
 
+def _rewrite_exists_existence(child: LogicalPlan, sub: LogicalPlan
+                              ) -> Tuple[LogicalPlan, Expression]:
+    """Correlated EXISTS anywhere in an expression (q10/q35's
+    `EXISTS(..) OR EXISTS(..)`) → ExistenceJoin: left join the DISTINCT
+    correlation-key set with a match flag replacing the predicate."""
+    from ..expressions import Coalesce, Literal
+    from .logical import Limit
+    sub = _strip_alias(sub)
+    while isinstance(sub, (Project, Distinct, SubqueryAlias, Limit)):
+        if isinstance(sub, Limit) and sub.n < 1:
+            return child, Literal(False)
+        sub = sub.children[0]
+    sub, pulled = _pull_correlated(sub)
+    if not pulled:
+        raise AnalysisException(
+            "uncorrelated EXISTS under OR is not supported; lift it to a "
+            "scalar COUNT comparison")
+    keys: List[Expression] = []
+    on: List[Expression] = []
+    for c, scope in pulled:
+        if not isinstance(c, EQ):
+            raise AnalysisException(
+                f"EXISTS under OR supports only equality correlation, "
+                f"got {c!r}")
+        a, b = c.children
+        if a.references() <= scope:
+            inner, outer = a, b
+        elif b.references() <= scope:
+            inner, outer = b, a
+        else:
+            raise AnalysisException(
+                f"cannot split correlated predicate {c!r}")
+        fresh_k = _fresh_name(inner.name.split(".")[-1])
+        keys.append(Alias(inner, fresh_k))
+        on.append(EQ(outer, Col(fresh_k)))
+    flag = _fresh_name("exists")
+    keyed = Distinct(Project(keys, sub))
+    flagged = Project([Col(k.name) for k in keys]
+                      + [Alias(Literal(True), flag)], keyed)
+    from .optimizer import join_conjuncts
+    joined = Join(child, flagged, "left", join_conjuncts(on), None)
+    return joined, Coalesce(Col(flag), Literal(False))
+
+
 def _rewrite_scalar(child: LogicalPlan, sub: LogicalPlan
                     ) -> Tuple[LogicalPlan, Expression]:
     """Returns (new child with the join attached, replacement expression)."""
@@ -416,6 +460,10 @@ def rewrite_subqueries(plan: LogicalPlan, resolve) -> LogicalPlan:
                 if isinstance(e, InSubquery):
                     child, ref = _rewrite_existence(
                         child, e.children[0], prep(e.plan))
+                    return ref
+                if isinstance(e, ExistsSubquery):
+                    child, ref = _rewrite_exists_existence(
+                        child, prep(e.plan))
                     return ref
                 if isinstance(e, SubqueryExpr):
                     raise AnalysisException(

@@ -11,4 +11,5 @@ comparison is exact).
 
 from .schema import TABLES                        # noqa: F401
 from .datagen import generate                     # noqa: F401
-from .queries import QUERIES, RUNNABLE, PENDING   # noqa: F401
+from .queries import (QUERIES, ORACLE_OVERRIDES, RUNNABLE,  # noqa: F401
+                      PENDING)

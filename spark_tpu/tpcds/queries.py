@@ -1284,6 +1284,549 @@ ORDER BY cs1.product_name, cs1.store_name, cs1.store_zip, cnt2,
 LIMIT 100
 """
 
+QUERIES["q10"] = """
+SELECT cd_gender, cd_marital_status, cd_education_status, COUNT(*) cnt1,
+       cd_purchase_estimate, COUNT(*) cnt2, cd_credit_rating, COUNT(*) cnt3
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND ca_state IN ('TX', 'OH', 'CA')
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT * FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk
+                AND d_year = 2001 AND d_moy BETWEEN 1 AND 4)
+  AND (EXISTS (SELECT * FROM web_sales, date_dim
+               WHERE c.c_customer_sk = ws_bill_customer_sk
+                 AND ws_sold_date_sk = d_date_sk
+                 AND d_year = 2001 AND d_moy BETWEEN 1 AND 4)
+       OR EXISTS (SELECT * FROM catalog_sales, date_dim
+                  WHERE c.c_customer_sk = cs_ship_customer_sk
+                    AND cs_sold_date_sk = d_date_sk
+                    AND d_year = 2001 AND d_moy BETWEEN 1 AND 4))
+GROUP BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+ORDER BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+LIMIT 100
+"""
+
+QUERIES["q11"] = """
+WITH year_total AS (
+  SELECT c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name, d_year dyear,
+         SUM(ss_ext_list_price - ss_ext_discount_amt) year_total,
+         's' sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk AND ss_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+  UNION ALL
+  SELECT c_customer_id, c_first_name, c_last_name, d_year,
+         SUM(ws_ext_list_price - ws_ext_discount_amt), 'w'
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk AND ws_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year)
+SELECT t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.sale_type = 's' AND t_w_firstyear.sale_type = 'w'
+  AND t_s_secyear.sale_type = 's' AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.dyear = 2000 AND t_s_secyear.dyear = 2001
+  AND t_w_firstyear.dyear = 2000 AND t_w_secyear.dyear = 2001
+  AND t_s_firstyear.year_total > 0 AND t_w_firstyear.year_total > 0
+  AND CASE WHEN t_w_firstyear.year_total > 0
+           THEN t_w_secyear.year_total * 1.0 / t_w_firstyear.year_total
+           ELSE 0.0 END
+      > CASE WHEN t_s_firstyear.year_total > 0
+             THEN t_s_secyear.year_total * 1.0 / t_s_firstyear.year_total
+             ELSE 0.0 END
+ORDER BY t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+         t_s_secyear.customer_last_name
+LIMIT 100
+"""
+
+QUERIES["q21"] = """
+SELECT w_warehouse_name, i_item_id,
+       SUM(CASE WHEN d_date < '2000-03-11' THEN inv_quantity_on_hand
+                ELSE 0 END) AS inv_before,
+       SUM(CASE WHEN d_date >= '2000-03-11' THEN inv_quantity_on_hand
+                ELSE 0 END) AS inv_after
+FROM inventory, warehouse, item, date_dim
+WHERE i_current_price BETWEEN 0.99 AND 50.49
+  AND i_item_sk = inv_item_sk AND inv_warehouse_sk = w_warehouse_sk
+  AND inv_date_sk = d_date_sk
+  AND d_date BETWEEN '2000-02-10' AND '2000-04-10'
+GROUP BY w_warehouse_name, i_item_id
+HAVING SUM(CASE WHEN d_date < '2000-03-11' THEN inv_quantity_on_hand
+                ELSE 0 END) > 0
+   AND SUM(CASE WHEN d_date >= '2000-03-11' THEN inv_quantity_on_hand
+                ELSE 0 END) * 1.0 /
+       SUM(CASE WHEN d_date < '2000-03-11' THEN inv_quantity_on_hand
+                ELSE 0 END) BETWEEN 0.5 AND 2.0
+ORDER BY w_warehouse_name, i_item_id
+LIMIT 100
+"""
+
+QUERIES["q22"] = """
+SELECT i_product_name, i_brand, i_class, i_category,
+       AVG(inv_quantity_on_hand) AS qoh
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+GROUP BY ROLLUP(i_product_name, i_brand, i_class, i_category)
+ORDER BY qoh, i_product_name, i_brand, i_class, i_category
+LIMIT 100
+"""
+
+QUERIES["q27"] = """
+SELECT i_item_id, s_state, grouping(s_state) AS g_state,
+       AVG(ss_quantity) AS agg1, AVG(ss_list_price) AS agg2,
+       AVG(ss_coupon_amt) AS agg3, AVG(ss_sales_price) AS agg4
+FROM store_sales, customer_demographics, date_dim, store, item
+WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+  AND ss_store_sk = s_store_sk AND ss_cdemo_sk = cd_demo_sk
+  AND cd_gender = 'M' AND cd_marital_status = 'S'
+  AND cd_education_status = 'College'
+  AND d_year = 2002 AND s_state IN ('TX', 'OH', 'CA')
+GROUP BY ROLLUP(i_item_id, s_state)
+ORDER BY i_item_id NULLS LAST, s_state NULLS LAST
+LIMIT 100
+"""
+
+QUERIES["q28"] = """
+SELECT *
+FROM (SELECT SUM(ss_list_price) * 1.0 / COUNT(ss_list_price) B1_LP,
+             COUNT(ss_list_price) B1_CNT,
+             COUNT(DISTINCT ss_list_price) B1_CNTD
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 0 AND 5
+        AND (ss_list_price BETWEEN 8 AND 18
+             OR ss_coupon_amt BETWEEN 0 AND 100)) B1,
+     (SELECT SUM(ss_list_price) * 1.0 / COUNT(ss_list_price) B2_LP,
+             COUNT(ss_list_price) B2_CNT,
+             COUNT(DISTINCT ss_list_price) B2_CNTD
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 6 AND 10
+        AND (ss_list_price BETWEEN 90 AND 100
+             OR ss_coupon_amt BETWEEN 0 AND 200)) B2,
+     (SELECT SUM(ss_list_price) * 1.0 / COUNT(ss_list_price) B3_LP,
+             COUNT(ss_list_price) B3_CNT,
+             COUNT(DISTINCT ss_list_price) B3_CNTD
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 11 AND 15
+        AND (ss_list_price BETWEEN 1 AND 30
+             OR ss_coupon_amt BETWEEN 0 AND 300)) B3
+LIMIT 100
+"""
+
+QUERIES["q31"] = """
+WITH ss AS (
+  SELECT ca_county, d_qoy, d_year, SUM(ss_ext_sales_price) AS store_sales
+  FROM store_sales, date_dim, customer_address
+  WHERE ss_sold_date_sk = d_date_sk AND ss_addr_sk = ca_address_sk
+  GROUP BY ca_county, d_qoy, d_year),
+ws AS (
+  SELECT ca_county, d_qoy, d_year, SUM(ws_ext_sales_price) AS web_sales
+  FROM web_sales, date_dim, customer_address
+  WHERE ws_sold_date_sk = d_date_sk AND ws_bill_addr_sk = ca_address_sk
+  GROUP BY ca_county, d_qoy, d_year)
+SELECT ss1.ca_county, ss1.d_year,
+       ws2.web_sales * 1.0 / ws1.web_sales AS web_q1_q2_increase,
+       ss2.store_sales * 1.0 / ss1.store_sales AS store_q1_q2_increase
+FROM ss ss1, ss ss2, ws ws1, ws ws2
+WHERE ss1.d_qoy = 1 AND ss1.d_year = 2000
+  AND ss1.ca_county = ss2.ca_county
+  AND ss2.d_qoy = 2 AND ss2.d_year = 2000
+  AND ss1.ca_county = ws1.ca_county
+  AND ws1.d_qoy = 1 AND ws1.d_year = 2000
+  AND ws1.ca_county = ws2.ca_county
+  AND ws2.d_qoy = 2 AND ws2.d_year = 2000
+  AND CASE WHEN ws1.web_sales > 0
+           THEN ws2.web_sales * 1.0 / ws1.web_sales ELSE NULL END
+      > CASE WHEN ss1.store_sales > 0
+             THEN ss2.store_sales * 1.0 / ss1.store_sales ELSE NULL END
+ORDER BY ss1.ca_county, ss1.d_year
+LIMIT 100
+"""
+
+QUERIES["q34"] = """
+SELECT c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+FROM (SELECT ss_ticket_number, ss_customer_sk, COUNT(*) cnt
+      FROM store_sales, date_dim, store, household_demographics
+      WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+        AND ss_hdemo_sk = hd_demo_sk
+        AND (d_dom BETWEEN 1 AND 3 OR d_dom BETWEEN 25 AND 28)
+        AND (hd_buy_potential = '>10000' OR hd_buy_potential = 'Unknown')
+        AND hd_vehicle_count > 0
+        AND d_year IN (2000, 2001, 2002)
+      GROUP BY ss_ticket_number, ss_customer_sk) dn, customer
+WHERE ss_customer_sk = c_customer_sk AND cnt BETWEEN 1 AND 20
+ORDER BY c_last_name, c_first_name, c_salutation, c_preferred_cust_flag DESC,
+         ss_ticket_number
+LIMIT 100
+"""
+
+QUERIES["q36"] = """
+SELECT SUM(ss_net_profit) / SUM(ss_ext_sales_price) AS gross_margin,
+       i_category, i_class,
+       grouping(i_category) + grouping(i_class) AS lochierarchy
+FROM store_sales, date_dim, item, store
+WHERE d_year = 2001 AND d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+  AND s_state = 'TX'
+GROUP BY ROLLUP(i_category, i_class)
+ORDER BY lochierarchy DESC, i_category NULLS LAST, i_class NULLS LAST,
+         gross_margin
+LIMIT 100
+"""
+
+QUERIES["q37"] = """
+SELECT i_item_id, i_item_desc, i_current_price
+FROM item, inventory, date_dim, catalog_sales
+WHERE i_current_price BETWEEN 20 AND 50
+  AND inv_item_sk = i_item_sk AND d_date_sk = inv_date_sk
+  AND d_year = 2000
+  AND i_manufact_id IN (10, 20, 30, 40, 50, 60, 70, 80)
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+  AND cs_item_sk = i_item_sk
+GROUP BY i_item_id, i_item_desc, i_current_price
+ORDER BY i_item_id
+LIMIT 100
+"""
+
+QUERIES["q40"] = """
+SELECT w_state, i_item_id,
+       SUM(CASE WHEN d_date < '2000-03-11'
+                THEN cs_sales_price - COALESCE(cr_refunded_cash, 0)
+                ELSE 0 END) AS sales_before,
+       SUM(CASE WHEN d_date >= '2000-03-11'
+                THEN cs_sales_price - COALESCE(cr_refunded_cash, 0)
+                ELSE 0 END) AS sales_after
+FROM catalog_sales
+     LEFT OUTER JOIN catalog_returns
+         ON (cs_order_number = cr_order_number AND cs_item_sk = cr_item_sk),
+     warehouse, item, date_dim
+WHERE i_current_price BETWEEN 0.99 AND 50.49
+  AND i_item_sk = cs_item_sk AND cs_warehouse_sk = w_warehouse_sk
+  AND cs_sold_date_sk = d_date_sk
+  AND d_date BETWEEN '2000-02-10' AND '2000-04-10'
+GROUP BY w_state, i_item_id
+ORDER BY w_state, i_item_id
+LIMIT 100
+"""
+
+QUERIES["q50"] = """
+SELECT s_store_name, s_company_id, s_street_number, s_street_name,
+       SUM(CASE WHEN (sr_returned_date_sk - ss_sold_date_sk <= 30)
+                THEN 1 ELSE 0 END) AS d30,
+       SUM(CASE WHEN (sr_returned_date_sk - ss_sold_date_sk > 30)
+                 AND (sr_returned_date_sk - ss_sold_date_sk <= 60)
+                THEN 1 ELSE 0 END) AS d60,
+       SUM(CASE WHEN (sr_returned_date_sk - ss_sold_date_sk > 60)
+                THEN 1 ELSE 0 END) AS dmore
+FROM store_sales, store_returns, store, date_dim d2
+WHERE ss_ticket_number = sr_ticket_number AND ss_item_sk = sr_item_sk
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND d2.d_year = 2001 AND d2.d_moy = 8
+  AND ss_store_sk = s_store_sk
+GROUP BY s_store_name, s_company_id, s_street_number, s_street_name
+ORDER BY s_store_name, s_company_id, s_street_number, s_street_name
+LIMIT 100
+"""
+
+QUERIES["q53"] = """
+SELECT i_manufact_id, sum_sales, avg_quarterly_sales
+FROM (SELECT i_manufact_id,
+             SUM(ss_sales_price) AS sum_sales,
+             AVG(SUM(ss_sales_price)) OVER (PARTITION BY i_manufact_id)
+                 AS avg_quarterly_sales
+      FROM item, store_sales, date_dim, store
+      WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+        AND ss_store_sk = s_store_sk
+        AND d_month_seq IN (1200, 1201, 1202, 1203, 1204, 1205, 1206, 1207,
+                            1208, 1209, 1210, 1211)
+        AND i_category IN ('Books', 'Children', 'Electronics')
+      GROUP BY i_manufact_id, d_qoy) tmp1
+WHERE CASE WHEN avg_quarterly_sales > 0
+           THEN abs(sum_sales - avg_quarterly_sales) / avg_quarterly_sales
+           ELSE 0 END > 0.1
+ORDER BY avg_quarterly_sales, sum_sales, i_manufact_id
+LIMIT 100
+"""
+
+QUERIES["q63"] = """
+SELECT i_manager_id, sum_sales, avg_monthly_sales
+FROM (SELECT i_manager_id,
+             SUM(ss_sales_price) AS sum_sales,
+             AVG(SUM(ss_sales_price)) OVER (PARTITION BY i_manager_id)
+                 AS avg_monthly_sales
+      FROM item, store_sales, date_dim, store
+      WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+        AND ss_store_sk = s_store_sk
+        AND d_month_seq IN (1200, 1201, 1202, 1203, 1204, 1205, 1206, 1207,
+                            1208, 1209, 1210, 1211)
+        AND i_category IN ('Books', 'Children', 'Electronics')
+      GROUP BY i_manager_id, d_moy) tmp1
+WHERE CASE WHEN avg_monthly_sales > 0
+           THEN abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           ELSE 0 END > 0.1
+ORDER BY i_manager_id, avg_monthly_sales, sum_sales
+LIMIT 100
+"""
+
+QUERIES["q82"] = """
+SELECT i_item_id, i_item_desc, i_current_price
+FROM item, inventory, date_dim, store_sales
+WHERE i_current_price BETWEEN 30 AND 60
+  AND inv_item_sk = i_item_sk AND d_date_sk = inv_date_sk
+  AND d_year = 2000
+  AND i_manufact_id IN (15, 25, 35, 45, 55, 65, 75, 85)
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+  AND ss_item_sk = i_item_sk
+GROUP BY i_item_id, i_item_desc, i_current_price
+ORDER BY i_item_id
+LIMIT 100
+"""
+
+QUERIES["q84"] = """
+SELECT c_customer_id AS customer_id,
+       c_last_name AS customername
+FROM customer, customer_address, customer_demographics,
+     household_demographics, income_band, store_returns
+WHERE ca_city = 'Fairview'
+  AND c_current_addr_sk = ca_address_sk
+  AND ib_lower_bound >= 10000 AND ib_upper_bound <= 70000
+  AND ib_income_band_sk = hd_income_band_sk
+  AND cd_demo_sk = c_current_cdemo_sk
+  AND hd_demo_sk = c_current_hdemo_sk
+  AND sr_cdemo_sk = cd_demo_sk
+ORDER BY c_customer_id, customername
+LIMIT 100
+"""
+
+QUERIES["q86"] = """
+SELECT SUM(ws_net_paid) AS total_sum, i_category, i_class,
+       grouping(i_category) + grouping(i_class) AS lochierarchy
+FROM web_sales, date_dim d1, item
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ws_sold_date_sk AND i_item_sk = ws_item_sk
+GROUP BY ROLLUP(i_category, i_class)
+ORDER BY lochierarchy DESC, i_category NULLS LAST, i_class NULLS LAST,
+         total_sum
+LIMIT 100
+"""
+
+QUERIES["q89"] = """
+SELECT i_category, i_class, i_brand, s_store_name, s_company_name, d_moy,
+       sum_sales, avg_monthly_sales
+FROM (SELECT i_category, i_class, i_brand, s_store_name, s_company_name,
+             d_moy, SUM(ss_sales_price) AS sum_sales,
+             AVG(SUM(ss_sales_price)) OVER (PARTITION BY i_category,
+                 i_brand, s_store_name, s_company_name) AS avg_monthly_sales
+      FROM item, store_sales, date_dim, store
+      WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+        AND ss_store_sk = s_store_sk AND d_year = 2000
+        AND ((i_category IN ('Books', 'Electronics', 'Sports')
+              AND i_class IN ('fiction', 'portable', 'fitness'))
+             OR (i_category IN ('Men', 'Jewelry', 'Women')
+                 AND i_class IN ('accent', 'estate', 'dresses')))
+      GROUP BY i_category, i_class, i_brand, s_store_name, s_company_name,
+               d_moy) tmp1
+WHERE CASE WHEN avg_monthly_sales <> 0
+           THEN abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           ELSE 0 END > 0.1
+ORDER BY sum_sales - avg_monthly_sales, i_category, i_class, i_brand,
+         s_store_name, s_company_name, d_moy
+LIMIT 100
+"""
+
+QUERIES["q91"] = """
+SELECT cc_call_center_id, cc_name, cc_manager,
+       SUM(cr_net_loss) AS returns_loss
+FROM call_center, catalog_returns, date_dim, customer,
+     customer_address, customer_demographics, household_demographics
+WHERE cr_call_center_sk = cc_call_center_sk
+  AND cr_returned_date_sk = d_date_sk
+  AND cr_returning_customer_sk = c_customer_sk
+  AND cd_demo_sk = c_current_cdemo_sk
+  AND hd_demo_sk = c_current_hdemo_sk
+  AND ca_address_sk = c_current_addr_sk
+  AND d_year = 2000
+  AND ((cd_marital_status = 'M' AND cd_education_status = 'Unknown')
+       OR (cd_marital_status = 'W'
+           AND cd_education_status = 'Advanced Degree'))
+  AND hd_buy_potential LIKE 'Unknown%'
+  AND ca_gmt_offset = -7
+GROUP BY cc_call_center_id, cc_name, cc_manager
+ORDER BY returns_loss DESC, cc_call_center_id, cc_name
+LIMIT 100
+"""
+
+QUERIES["q93"] = """
+SELECT ss_customer_sk, SUM(act_sales) AS sumsales
+FROM (SELECT ss_item_sk, ss_ticket_number, ss_customer_sk,
+             CASE WHEN sr_return_quantity IS NOT NULL
+                  THEN (ss_quantity - sr_return_quantity) * ss_sales_price
+                  ELSE ss_quantity * ss_sales_price END AS act_sales
+      FROM store_sales
+           LEFT OUTER JOIN store_returns
+               ON (sr_item_sk = ss_item_sk
+                   AND sr_ticket_number = ss_ticket_number),
+           reason
+      WHERE sr_reason_sk = r_reason_sk AND r_reason_sk = 2) t
+GROUP BY ss_customer_sk
+ORDER BY sumsales, ss_customer_sk
+LIMIT 100
+"""
+
+QUERIES["q97"] = """
+WITH ssci AS (
+  SELECT ss_customer_sk customer_sk, ss_item_sk item_sk
+  FROM store_sales, date_dim
+  WHERE ss_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 1200 AND 1211
+  GROUP BY ss_customer_sk, ss_item_sk),
+csci AS (
+  SELECT cs_bill_customer_sk customer_sk, cs_item_sk item_sk
+  FROM catalog_sales, date_dim
+  WHERE cs_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 1200 AND 1211
+  GROUP BY cs_bill_customer_sk, cs_item_sk)
+SELECT SUM(CASE WHEN ssci.customer_sk IS NOT NULL
+                 AND csci.customer_sk IS NULL THEN 1 ELSE 0 END)
+           AS store_only,
+       SUM(CASE WHEN ssci.customer_sk IS NULL
+                 AND csci.customer_sk IS NOT NULL THEN 1 ELSE 0 END)
+           AS catalog_only,
+       SUM(CASE WHEN ssci.customer_sk IS NOT NULL
+                 AND csci.customer_sk IS NOT NULL THEN 1 ELSE 0 END)
+           AS store_and_catalog
+FROM ssci FULL OUTER JOIN csci
+     ON (ssci.customer_sk = csci.customer_sk
+         AND ssci.item_sk = csci.item_sk)
+LIMIT 100
+"""
+
+#: sqlite lacks ROLLUP / grouping(); these queries validate against a
+#: hand-expanded UNION ALL oracle text producing identical rows
+ORACLE_OVERRIDES = {}
+
+ORACLE_OVERRIDES["q22"] = """
+SELECT i_product_name, i_brand, i_class, i_category,
+       AVG(inv_quantity_on_hand) AS qoh
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+GROUP BY i_product_name, i_brand, i_class, i_category
+UNION ALL
+SELECT i_product_name, i_brand, i_class, NULL, AVG(inv_quantity_on_hand)
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+GROUP BY i_product_name, i_brand, i_class
+UNION ALL
+SELECT i_product_name, i_brand, NULL, NULL, AVG(inv_quantity_on_hand)
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+GROUP BY i_product_name, i_brand
+UNION ALL
+SELECT i_product_name, NULL, NULL, NULL, AVG(inv_quantity_on_hand)
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+GROUP BY i_product_name
+UNION ALL
+SELECT NULL, NULL, NULL, NULL, AVG(inv_quantity_on_hand)
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+ORDER BY qoh, i_product_name, i_brand, i_class, i_category
+LIMIT 100
+"""
+
+ORACLE_OVERRIDES["q27"] = """
+SELECT i_item_id, s_state, 0 AS g_state,
+       AVG(ss_quantity) AS agg1, AVG(ss_list_price) AS agg2,
+       AVG(ss_coupon_amt) AS agg3, AVG(ss_sales_price) AS agg4
+FROM store_sales, customer_demographics, date_dim, store, item
+WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+  AND ss_store_sk = s_store_sk AND ss_cdemo_sk = cd_demo_sk
+  AND cd_gender = 'M' AND cd_marital_status = 'S'
+  AND cd_education_status = 'College'
+  AND d_year = 2002 AND s_state IN ('TX', 'OH', 'CA')
+GROUP BY i_item_id, s_state
+UNION ALL
+SELECT i_item_id, NULL, 1, AVG(ss_quantity), AVG(ss_list_price),
+       AVG(ss_coupon_amt), AVG(ss_sales_price)
+FROM store_sales, customer_demographics, date_dim, store, item
+WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+  AND ss_store_sk = s_store_sk AND ss_cdemo_sk = cd_demo_sk
+  AND cd_gender = 'M' AND cd_marital_status = 'S'
+  AND cd_education_status = 'College'
+  AND d_year = 2002 AND s_state IN ('TX', 'OH', 'CA')
+GROUP BY i_item_id
+UNION ALL
+SELECT NULL, NULL, 1, AVG(ss_quantity), AVG(ss_list_price),
+       AVG(ss_coupon_amt), AVG(ss_sales_price)
+FROM store_sales, customer_demographics, date_dim, store, item
+WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+  AND ss_store_sk = s_store_sk AND ss_cdemo_sk = cd_demo_sk
+  AND cd_gender = 'M' AND cd_marital_status = 'S'
+  AND cd_education_status = 'College'
+  AND d_year = 2002 AND s_state IN ('TX', 'OH', 'CA')
+ORDER BY i_item_id NULLS LAST, s_state NULLS LAST
+LIMIT 100
+"""
+
+ORACLE_OVERRIDES["q36"] = """
+SELECT SUM(ss_net_profit) / SUM(ss_ext_sales_price) AS gross_margin,
+       i_category, i_class, 0 AS lochierarchy
+FROM store_sales, date_dim, item, store
+WHERE d_year = 2001 AND d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk AND s_state = 'TX'
+GROUP BY i_category, i_class
+UNION ALL
+SELECT SUM(ss_net_profit) / SUM(ss_ext_sales_price), i_category, NULL, 1
+FROM store_sales, date_dim, item, store
+WHERE d_year = 2001 AND d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk AND s_state = 'TX'
+GROUP BY i_category
+UNION ALL
+SELECT SUM(ss_net_profit) / SUM(ss_ext_sales_price), NULL, NULL, 2
+FROM store_sales, date_dim, item, store
+WHERE d_year = 2001 AND d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk AND s_state = 'TX'
+ORDER BY lochierarchy DESC, i_category NULLS LAST, i_class NULLS LAST,
+         gross_margin
+LIMIT 100
+"""
+
+ORACLE_OVERRIDES["q86"] = """
+SELECT SUM(ws_net_paid) AS total_sum, i_category, i_class, 0 AS lochierarchy
+FROM web_sales, date_dim d1, item
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ws_sold_date_sk AND i_item_sk = ws_item_sk
+GROUP BY i_category, i_class
+UNION ALL
+SELECT SUM(ws_net_paid), i_category, NULL, 1
+FROM web_sales, date_dim d1, item
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ws_sold_date_sk AND i_item_sk = ws_item_sk
+GROUP BY i_category
+UNION ALL
+SELECT SUM(ws_net_paid), NULL, NULL, 2
+FROM web_sales, date_dim d1, item
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ws_sold_date_sk AND i_item_sk = ws_item_sk
+ORDER BY lochierarchy DESC, i_category NULLS LAST, i_class NULLS LAST,
+         total_sum
+LIMIT 100
+"""
+
+
 #: queries that execute end-to-end and are oracle-validated
 RUNNABLE = sorted(QUERIES.keys(), key=lambda q: int(q[1:]))
 

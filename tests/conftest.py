@@ -26,7 +26,10 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compile cache: this jax build pays ~0.8s per jit and ~20ms per
 # uncached eager op; caching across pytest runs keeps the suite usable.
-jax.config.update("jax_compilation_cache_dir", "/tmp/spark_tpu_jax_cache")
+# OWN directory, never shared with bench.py/TPU runs: the axon remote
+# compile helper emits CPU AOT code for ITS machine's features, and
+# loading those artifacts here SIGILLs (cpu_aot_loader feature mismatch).
+jax.config.update("jax_compilation_cache_dir", "/tmp/spark_tpu_jax_cache_cpu")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
@@ -55,3 +58,13 @@ def spark():
     s = SparkSession.builder.appName("tests").getOrCreate()
     s.conf.set("spark.tpu.mesh.shards", "1")
     return s
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """The full 600-test suite accumulates thousands of live XLA:CPU
+    executables in one process and eventually segfaults inside a CPU
+    kernel; dropping compiled programs between modules keeps the working
+    set bounded (the persistent on-disk cache makes recompiles cheap)."""
+    yield
+    jax.clear_caches()

@@ -174,3 +174,40 @@ def test_residual_condition_null_extends_outer(spark):
     got_full = rows(left.join(right, cond, "full").select("k", "v", "k2", "w"))
     assert got_full == [(1, 5, 1, 10), (2, 50, None, None),
                         (None, None, 2, 10)]
+
+
+def test_multikey_join_mixed_int_float_keys(spark):
+    """int64=float64 key pairs must match cross-typed values (review find:
+    the combined hash hashed raw bits per side, dropping every match)."""
+    import numpy as np
+    import pandas as pd
+    a = spark.createDataFrame(pd.DataFrame({
+        "k1": np.array([1, 2, 3], np.int64),
+        "k2": np.array([10, 20, 30], np.int64)}))
+    b = spark.createDataFrame(pd.DataFrame({
+        "j1": np.array([1.0, 2.0, 9.0], np.float64),
+        "j2": np.array([10.0, 20.0, 90.0], np.float64),
+        "v": np.array([100, 200, 900], np.int64)}))
+    a.createOrReplaceTempView("mixa")
+    b.createOrReplaceTempView("mixb")
+    rows = spark.sql(
+        "SELECT k1, v FROM mixa JOIN mixb ON k1 = j1 AND k2 = j2 "
+        "ORDER BY k1").collect()
+    assert [(r["k1"], r["v"]) for r in rows] == [(1, 100), (2, 200)]
+
+
+def test_literal_equality_is_filter_not_join_key(spark):
+    """`col = -7` in an ON clause is a filter conjunct; it must not become
+    a constant 'join key' (review find via TPC-DS q91)."""
+    import numpy as np
+    import pandas as pd
+    a = spark.createDataFrame(pd.DataFrame({"x": np.arange(4, dtype=np.int64)}))
+    b = spark.createDataFrame(pd.DataFrame({
+        "y": np.arange(4, dtype=np.int64),
+        "g": np.array([-7.0, -7.0, -5.0, -5.0])}))
+    a.createOrReplaceTempView("lita")
+    b.createOrReplaceTempView("litb")
+    rows = spark.sql(
+        "SELECT x FROM lita JOIN litb ON x = y AND g = -7 ORDER BY x"
+    ).collect()
+    assert [r["x"] for r in rows] == [0, 1]

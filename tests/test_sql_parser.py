@@ -277,3 +277,28 @@ def test_count_null_literal(tables):
 def test_range_table_function(spark):
     out = rows(spark.sql("SELECT id * 2 AS x FROM range(2, 5)"))
     assert out == [(4,), (6,), (8,)]
+
+
+def test_rollup_cube_grouping_sets(spark):
+    spark.sql("SELECT 1 AS a, 10 AS b, 5 AS v UNION ALL SELECT 1, 20, 7 "
+              "UNION ALL SELECT 2, 10, 1").createOrReplaceTempView("gs_t")
+    r = spark.sql("SELECT a, b, SUM(v) AS s, grouping(a) AS ga, "
+                  "grouping_id() AS gid FROM gs_t GROUP BY ROLLUP(a, b) "
+                  "ORDER BY a NULLS LAST, b NULLS LAST").collect()
+    rows = [(x["a"], x["b"], x["s"], x["ga"], x["gid"]) for x in r]
+    assert (1, 10, 5, 0, 0) in rows
+    assert (1, None, 12, 0, 1) in rows
+    assert (None, None, 13, 1, 3) in rows
+    # SUM over the rolled-up key keeps ORIGINAL values (review find)
+    r2 = spark.sql("SELECT a, SUM(a) AS s FROM gs_t GROUP BY ROLLUP(a) "
+                   "ORDER BY a NULLS LAST").collect()
+    assert r2[-1]["a"] is None and r2[-1]["s"] == 4
+    # CUBE produces all subsets
+    r3 = spark.sql("SELECT a, b, COUNT(*) AS c FROM gs_t GROUP BY CUBE(a, b)"
+                   ).collect()
+    assert len(r3) == 3 + 2 + 2 + 1
+    # explicit GROUPING SETS
+    r4 = spark.sql("SELECT a, SUM(v) AS s FROM gs_t "
+                   "GROUP BY GROUPING SETS ((a), ()) ORDER BY a NULLS LAST"
+                   ).collect()
+    assert [(x["a"], x["s"]) for x in r4] == [(1, 12), (2, 1), (None, 13)]
