@@ -67,7 +67,8 @@ class DataFrame:
         from .planner import QueryExecution
         qe = QueryExecution(self.session, self._plan)
         print(qe.explain_string() if extended else
-              "== Physical Plan ==\n" + qe.planned.physical.tree_string())
+              "== Physical Plan ==\n"
+              + qe.planned_preview().physical.tree_string())
 
     def __getitem__(self, item) -> Column:
         if isinstance(item, str):

@@ -515,7 +515,7 @@ def plan_join_raw(planner, node: Join, leaves) -> P.PhysicalPlan:
         return PJoin(left_p, right_p, "cross", [], residual, raw_schema, 1.0)
 
     return PJoin(left_p, right_p, node.how, key_pairs, residual, raw_schema,
-                 planner.join_factor)
+                 planner.next_join_factor())
 
 
 class _JoinOutput(P.PhysicalPlan):

@@ -635,6 +635,12 @@ class GroupingSets(LogicalPlan):
         return list(self.select_list) + list(self.keys) + (
             [self.having] if self.having is not None else [])
 
+    def map_expressions(self, fn):
+        return GroupingSets([fn(e) for e in self.select_list],
+                            [fn(k) for k in self.keys], self.sets,
+                            None if self.having is None
+                            else fn(self.having), self.children[0])
+
     def schema(self) -> T.StructType:
         # representative schema: every key present (the full grouping
         # set), fields in SELECT-LIST order — exactly what the rewrite's

@@ -344,16 +344,12 @@ def _register_breadth():
         "crc32": lambda a: StringToInt("crc32", a[0]),
         "randn": lambda a: Randn(int(a[0].value) if a else 42),
         "spark_partition_id": lambda a: SparkPartitionId(),
-        "grouping": lambda a: __import__(
-            "spark_tpu.expressions", fromlist=["GroupingCall"]
-        ).GroupingCall(_one(a, "grouping")),
-        "grouping_id": lambda a: __import__(
-            "spark_tpu.expressions", fromlist=["GroupingCall"]
-        ).GroupingCall(None),
+        "grouping": lambda a: GroupingCall(_one(a, "grouping")),
+        "grouping_id": lambda a: GroupingCall(None),
     }
     from ..expressions import (
-        ArrayContains, ArraySize, ElementAt, ExplodeMarker, MakeArray,
-        SplitStr,
+        ArrayContains, ArraySize, ElementAt, ExplodeMarker, GroupingCall,
+        MakeArray, SplitStr,
     )
     out.update({
         "array": lambda a: MakeArray(*a),

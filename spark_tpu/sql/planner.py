@@ -107,20 +107,23 @@ class PlannedQuery:
 class Planner:
     """Logical → physical (``SparkPlanner.strategies`` analog)."""
 
-    def __init__(self, session, join_factor_override=None):
+    def __init__(self, session, join_factor_override=None,
+                 for_execution: bool = True):
         #: None | float (every join) | list (per join construction index —
         #: chained joins must not COMPOUND one overflowing join's growth)
         self.session = session
         self.join_factor_override = join_factor_override
+        #: False for explain/inspection: planning must not run side
+        #: effects (lazy-checkpoint materialization)
+        self.for_execution = for_execution
         self._join_seq = 0
 
-    @property
-    def join_factor(self) -> float:
-        """Join output capacity factor for the NEXT join constructed; the
-        executor overrides factors upward when a run reports overflow
-        (adaptive capacity retry).  List overrides are positional by join
-        construction order, which matches flag (execution) order for the
-        left-deep plans the planner builds."""
+    def next_join_factor(self) -> float:
+        """Output capacity factor for the NEXT join constructed — an
+        EXPLICIT method (not a property) because each call consumes one
+        position; list overrides are positional by join construction
+        order, which matches flag (execution) order for the plans the
+        planner builds.  ``plan()`` resets the sequence."""
         i = self._join_seq
         self._join_seq += 1
         o = self.join_factor_override
@@ -133,6 +136,7 @@ class Planner:
         return self.session.conf.get(C.JOIN_OUTPUT_FACTOR)
 
     def plan(self, logical: LogicalPlan) -> PlannedQuery:
+        self._join_seq = 0            # positional factors restart per plan
         leaves: List[ColumnBatch] = []
         phys = self._to_physical(logical, leaves)
         self._assign_op_ids(phys, [1])
@@ -208,10 +212,13 @@ class Planner:
         from .logical import LazyCheckpoint
         if isinstance(node, LazyCheckpoint):
             if not node.state["done"]:
+                if not self.for_execution:
+                    # explain/inspection is not an action: show the plan
+                    # WITHOUT materializing the checkpoint
+                    return self._to_physical(node.child, leaves)
                 from .dataframe import DataFrame as _DF
                 _DF(self.session, node.child).write.parquet(node.path)
                 node.state["done"] = True
-            from .logical import FileRelation as _FR
             from ..io import read_file_relation
             rel = self.session.read.parquet(node.path)._plan
             batch = read_file_relation(rel, self.session)
@@ -460,8 +467,14 @@ class QueryExecution:
                         for k, v in zip(metric_keys, metric_vals)}
         return _slice_to_host(result, int(np.asarray(n_rows))), ratio
 
+    def planned_preview(self) -> PlannedQuery:
+        """Side-effect-free plan for explain(): lazy checkpoints are NOT
+        materialized (uncached — execution re-plans normally)."""
+        return Planner(self.session, for_execution=False).plan(self.optimized)
+
     def explain_string(self) -> str:
         s = "== Analyzed Logical Plan ==\n" + self.analyzed.tree_string()
         s += "== Optimized Logical Plan ==\n" + self.optimized.tree_string()
-        s += "== Physical Plan ==\n" + self.planned.physical.tree_string()
+        s += "== Physical Plan ==\n" + \
+            self.planned_preview().physical.tree_string()
         return s

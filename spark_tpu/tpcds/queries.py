@@ -1709,9 +1709,1500 @@ FROM ssci FULL OUTER JOIN csci
 LIMIT 100
 """
 
+QUERIES["q4"] = """
+WITH year_total AS (
+  SELECT c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name, d_year dyear,
+         SUM(((ss_ext_list_price - ss_ext_wholesale_cost
+               - ss_ext_discount_amt) + ss_ext_sales_price) / 2) year_total,
+         's' sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk AND ss_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+  UNION ALL
+  SELECT c_customer_id, c_first_name, c_last_name, d_year,
+         SUM(((cs_ext_list_price - cs_ext_wholesale_cost
+               - cs_ext_discount_amt) + cs_ext_sales_price) / 2), 'c'
+  FROM customer, catalog_sales, date_dim
+  WHERE c_customer_sk = cs_bill_customer_sk AND cs_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+  UNION ALL
+  SELECT c_customer_id, c_first_name, c_last_name, d_year,
+         SUM(((ws_ext_list_price - ws_ext_wholesale_cost
+               - ws_ext_discount_amt) + ws_ext_sales_price) / 2), 'w'
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk AND ws_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year)
+SELECT t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_c_firstyear, year_total t_c_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_c_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_c_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.sale_type = 's' AND t_c_firstyear.sale_type = 'c'
+  AND t_w_firstyear.sale_type = 'w' AND t_s_secyear.sale_type = 's'
+  AND t_c_secyear.sale_type = 'c' AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.dyear = 2000 AND t_s_secyear.dyear = 2001
+  AND t_c_firstyear.dyear = 2000 AND t_c_secyear.dyear = 2001
+  AND t_w_firstyear.dyear = 2000 AND t_w_secyear.dyear = 2001
+  AND t_s_firstyear.year_total > 0 AND t_c_firstyear.year_total > 0
+  AND t_w_firstyear.year_total > 0
+  AND CASE WHEN t_c_firstyear.year_total > 0
+           THEN t_c_secyear.year_total * 1.0 / t_c_firstyear.year_total
+           ELSE NULL END
+      > CASE WHEN t_s_firstyear.year_total > 0
+             THEN t_s_secyear.year_total * 1.0 / t_s_firstyear.year_total
+             ELSE NULL END
+  AND CASE WHEN t_c_firstyear.year_total > 0
+           THEN t_c_secyear.year_total * 1.0 / t_c_firstyear.year_total
+           ELSE NULL END
+      > CASE WHEN t_w_firstyear.year_total > 0
+             THEN t_w_secyear.year_total * 1.0 / t_w_firstyear.year_total
+             ELSE NULL END
+ORDER BY t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+         t_s_secyear.customer_last_name
+LIMIT 100
+"""
+
+QUERIES["q5"] = """
+WITH ssr AS (
+  SELECT s_store_id, SUM(sales_price) AS sales, SUM(profit) AS profit,
+         SUM(return_amt) AS returns_, SUM(net_loss) AS profit_loss
+  FROM (SELECT ss_store_sk AS store_sk, ss_sold_date_sk AS date_sk,
+               ss_ext_sales_price AS sales_price, ss_net_profit AS profit,
+               0.0 AS return_amt, 0.0 AS net_loss
+        FROM store_sales
+        UNION ALL
+        SELECT sr_store_sk, sr_returned_date_sk, 0.0, 0.0,
+               sr_return_amt, sr_net_loss
+        FROM store_returns) salesreturns, date_dim, store
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-06'
+    AND store_sk = s_store_sk
+  GROUP BY s_store_id),
+csr AS (
+  SELECT cp_catalog_page_id, SUM(sales_price) AS sales,
+         SUM(profit) AS profit, SUM(return_amt) AS returns_,
+         SUM(net_loss) AS profit_loss
+  FROM (SELECT cs_catalog_page_sk AS page_sk,
+               cs_sold_date_sk AS date_sk,
+               cs_ext_sales_price AS sales_price,
+               cs_net_profit AS profit, 0.0 AS return_amt, 0.0 AS net_loss
+        FROM catalog_sales
+        UNION ALL
+        SELECT cr_catalog_page_sk, cr_returned_date_sk, 0.0, 0.0,
+               cr_return_amount, cr_net_loss
+        FROM catalog_returns) salesreturns, date_dim, catalog_page
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-06'
+    AND page_sk = cp_catalog_page_sk
+  GROUP BY cp_catalog_page_id),
+wsr AS (
+  SELECT web_site_id, SUM(sales_price) AS sales, SUM(profit) AS profit,
+         SUM(return_amt) AS returns_, SUM(net_loss) AS profit_loss
+  FROM (SELECT ws_web_site_sk AS wsr_web_site_sk,
+               ws_sold_date_sk AS date_sk,
+               ws_ext_sales_price AS sales_price,
+               ws_net_profit AS profit, 0.0 AS return_amt, 0.0 AS net_loss
+        FROM web_sales
+        UNION ALL
+        SELECT ws_web_site_sk, wr_returned_date_sk, 0.0, 0.0,
+               wr_return_amt, wr_net_loss
+        FROM web_returns
+             LEFT OUTER JOIN web_sales
+                 ON (wr_item_sk = ws_item_sk
+                     AND wr_order_number = ws_order_number))
+       salesreturns, date_dim, web_site
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-06'
+    AND wsr_web_site_sk = web_site_sk
+  GROUP BY web_site_id)
+SELECT channel, id, SUM(sales) AS sales, SUM(returns_) AS returns_,
+       SUM(profit - profit_loss) AS profit
+FROM (SELECT 'store channel' AS channel, s_store_id AS id, sales,
+             returns_, profit, profit_loss
+      FROM ssr
+      UNION ALL
+      SELECT 'catalog channel', cp_catalog_page_id, sales, returns_,
+             profit, profit_loss
+      FROM csr
+      UNION ALL
+      SELECT 'web channel', web_site_id, sales, returns_, profit,
+             profit_loss
+      FROM wsr) x
+GROUP BY ROLLUP(channel, id)
+ORDER BY channel NULLS LAST, id NULLS LAST, sales
+LIMIT 100
+"""
+
+QUERIES["q8"] = """
+SELECT s_store_name, SUM(ss_net_profit) AS total
+FROM store_sales, date_dim, store,
+     (SELECT ca_zip FROM customer_address
+      WHERE substr(ca_zip, 1, 5) IN
+            (SELECT substr(ca_zip, 1, 5) FROM customer_address, customer
+             WHERE ca_address_sk = c_current_addr_sk
+               AND c_preferred_cust_flag = 'Y'
+             GROUP BY ca_zip HAVING COUNT(*) > 1)) v1
+WHERE ss_store_sk = s_store_sk AND ss_sold_date_sk = d_date_sk
+  AND d_qoy = 2 AND d_year = 2000
+  AND substr(s_zip, 1, 2) = substr(v1.ca_zip, 1, 2)
+GROUP BY s_store_name
+ORDER BY s_store_name, total
+LIMIT 100
+"""
+
+QUERIES["q18"] = """
+SELECT i_item_id, ca_country, ca_state, ca_county,
+       AVG(cs_quantity * 1.0) agg1,
+       AVG(cs_list_price * 1.0) agg2,
+       AVG(cs_coupon_amt * 1.0) agg3,
+       AVG(cs_sales_price * 1.0) agg4,
+       AVG(cs_net_profit * 1.0) agg5,
+       AVG(c_birth_year * 1.0) agg6,
+       AVG(cd_dep_count * 1.0) agg7
+FROM catalog_sales,
+     (SELECT cd_demo_sk AS cd1_demo_sk, cd_dep_count,
+             cd_gender AS cd1_gender, cd_education_status AS cd1_edu
+      FROM customer_demographics) cd1,
+     customer, customer_address, date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd1_demo_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cd1_gender = 'F' AND cd1_edu = 'Unknown'
+  AND c_current_addr_sk = ca_address_sk
+  AND d_year = 2001
+  AND c_birth_month IN (1, 2, 3, 4, 5, 6)
+GROUP BY ROLLUP(i_item_id, ca_country, ca_state, ca_county)
+ORDER BY ca_country NULLS LAST, ca_state NULLS LAST, ca_county NULLS LAST,
+         i_item_id NULLS LAST
+LIMIT 100
+"""
+
+QUERIES["q35"] = """
+SELECT ca_state, cd_gender, cd_marital_status, cd_dep_count,
+       COUNT(*) cnt1, AVG(cd_dep_count) a1,
+       MAX(cd_dep_count) m1, SUM(cd_dep_count) s1
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT * FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk
+                AND d_year = 2001 AND d_qoy < 4)
+  AND (EXISTS (SELECT * FROM web_sales, date_dim
+               WHERE c.c_customer_sk = ws_bill_customer_sk
+                 AND ws_sold_date_sk = d_date_sk
+                 AND d_year = 2001 AND d_qoy < 4)
+       OR EXISTS (SELECT * FROM catalog_sales, date_dim
+                  WHERE c.c_customer_sk = cs_ship_customer_sk
+                    AND cs_sold_date_sk = d_date_sk
+                    AND d_year = 2001 AND d_qoy < 4))
+GROUP BY ca_state, cd_gender, cd_marital_status, cd_dep_count
+ORDER BY ca_state, cd_gender, cd_marital_status, cd_dep_count
+LIMIT 100
+"""
+
+QUERIES["q39"] = """
+WITH inv AS (
+  SELECT w_warehouse_sk, i_item_sk, d_moy, stdev, mean,
+         CASE mean WHEN 0 THEN NULL ELSE stdev * 1.0 / mean END cov
+  FROM (SELECT w_warehouse_sk, i_item_sk, d_moy,
+               STDDEV_SAMP(inv_quantity_on_hand) stdev,
+               AVG(inv_quantity_on_hand * 1.0) mean
+        FROM inventory, item, warehouse, date_dim
+        WHERE inv_item_sk = i_item_sk
+          AND inv_warehouse_sk = w_warehouse_sk
+          AND inv_date_sk = d_date_sk AND d_year = 2001
+        GROUP BY w_warehouse_sk, i_item_sk, d_moy) foo
+  WHERE CASE mean WHEN 0 THEN 0 ELSE stdev * 1.0 / mean END > 1)
+SELECT inv1.w_warehouse_sk AS wsk1, inv1.i_item_sk AS isk1,
+       inv1.d_moy AS moy1, inv1.mean AS mean1, inv1.cov AS cov1,
+       inv2.w_warehouse_sk AS wsk2, inv2.i_item_sk AS isk2,
+       inv2.d_moy AS moy2, inv2.mean AS mean2, inv2.cov AS cov2
+FROM inv inv1, inv inv2
+WHERE inv1.i_item_sk = inv2.i_item_sk
+  AND inv1.w_warehouse_sk = inv2.w_warehouse_sk
+  AND inv1.d_moy = 1 AND inv2.d_moy = 2
+ORDER BY wsk1, isk1, moy1, mean1, cov1
+LIMIT 100
+"""
+
+QUERIES["q44"] = """
+SELECT asceding.rnk, i1.i_product_name best_performing,
+       i2.i_product_name worst_performing
+FROM (SELECT rnk, item_sk FROM (
+        SELECT item_sk, RANK() OVER (ORDER BY rank_col ASC, item_sk ASC) rnk
+        FROM (SELECT ss_item_sk item_sk, AVG(ss_net_profit) rank_col
+              FROM store_sales
+              WHERE ss_store_sk = 4
+              GROUP BY ss_item_sk) v1) v11
+      WHERE rnk < 11) asceding,
+     (SELECT rnk, item_sk FROM (
+        SELECT item_sk, RANK() OVER (ORDER BY rank_col DESC, item_sk ASC) rnk
+        FROM (SELECT ss_item_sk item_sk, AVG(ss_net_profit) rank_col
+              FROM store_sales
+              WHERE ss_store_sk = 4
+              GROUP BY ss_item_sk) v2) v21
+      WHERE rnk < 11) descending,
+     item i1, item i2
+WHERE asceding.rnk = descending.rnk
+  AND i1.i_item_sk = asceding.item_sk
+  AND i2.i_item_sk = descending.item_sk
+ORDER BY asceding.rnk
+LIMIT 100
+"""
+
+QUERIES["q46"] = """
+SELECT c_last_name, c_first_name, current_city, bought_city,
+       ss_ticket_number, amt, profit
+FROM (SELECT ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             SUM(ss_coupon_amt) amt, SUM(ss_net_profit) profit
+      FROM store_sales, date_dim, store, household_demographics,
+           customer_address
+      WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+        AND ss_hdemo_sk = hd_demo_sk AND ss_addr_sk = ca_address_sk
+        AND (hd_dep_count = 2 OR hd_vehicle_count = 1)
+        AND d_dow IN (6, 0) AND d_year IN (2000, 2001, 2002)
+      GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer,
+     (SELECT ca_address_sk AS cur_addr_sk, ca_city AS current_city
+      FROM customer_address) ca2
+WHERE ss_customer_sk = c_customer_sk
+  AND c_current_addr_sk = cur_addr_sk
+  AND current_city <> bought_city
+ORDER BY c_last_name, c_first_name, current_city, bought_city,
+         ss_ticket_number, amt, profit
+LIMIT 100
+"""
+
+QUERIES["q47"] = """
+WITH v1 AS (
+  SELECT i_category, i_brand, s_store_name, s_company_name, d_year, d_moy,
+         SUM(ss_sales_price) sum_sales,
+         AVG(SUM(ss_sales_price)) OVER (PARTITION BY i_category, i_brand,
+             s_store_name, s_company_name, d_year) avg_monthly_sales,
+         RANK() OVER (PARTITION BY i_category, i_brand, s_store_name,
+             s_company_name ORDER BY d_year, d_moy) rn
+  FROM item, store_sales, date_dim, store
+  WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND ss_store_sk = s_store_sk
+    AND (d_year = 2000 OR (d_year = 1999 AND d_moy = 12)
+         OR (d_year = 2001 AND d_moy = 1))
+  GROUP BY i_category, i_brand, s_store_name, s_company_name, d_year,
+           d_moy),
+v2 AS (
+  SELECT i_category, i_brand, s_store_name, s_company_name,
+         d_year, d_moy, avg_monthly_sales, sum_sales,
+         lag_sum AS psum, lead_sum AS nsum
+  FROM v1,
+       (SELECT i_category AS lag_cat, i_brand AS lag_brand,
+               s_store_name AS lag_store, s_company_name AS lag_comp,
+               rn AS lag_rn, sum_sales AS lag_sum FROM v1) v1_lag,
+       (SELECT i_category AS lead_cat, i_brand AS lead_brand,
+               s_store_name AS lead_store, s_company_name AS lead_comp,
+               rn AS lead_rn, sum_sales AS lead_sum FROM v1) v1_lead
+  WHERE i_category = lag_cat AND i_brand = lag_brand
+    AND s_store_name = lag_store AND s_company_name = lag_comp
+    AND i_category = lead_cat AND i_brand = lead_brand
+    AND s_store_name = lead_store AND s_company_name = lead_comp
+    AND rn = lag_rn + 1 AND rn = lead_rn - 1)
+SELECT * FROM v2
+WHERE d_year = 2000
+  AND avg_monthly_sales > 0
+  AND CASE WHEN avg_monthly_sales > 0
+           THEN abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           ELSE NULL END > 0.1
+ORDER BY sum_sales - avg_monthly_sales, d_moy, i_category, i_brand,
+         s_store_name, s_company_name
+LIMIT 100
+"""
+
+QUERIES["q49"] = """
+SELECT channel, item, return_ratio, return_rank, currency_rank
+FROM (SELECT 'web' AS channel, item, return_ratio,
+             RANK() OVER (ORDER BY return_ratio, item) return_rank,
+             RANK() OVER (ORDER BY currency_ratio, item)
+                 currency_rank
+      FROM (SELECT ws_item_sk item,
+                   SUM(COALESCE(wr_return_quantity, 0)) * 1.0 /
+                   SUM(COALESCE(ws_quantity, 0)) return_ratio,
+                   SUM(COALESCE(wr_return_amt, 0)) * 1.0 /
+                   SUM(COALESCE(ws_net_paid, 0)) currency_ratio
+            FROM web_sales LEFT OUTER JOIN web_returns
+                 ON (ws_order_number = wr_order_number
+                     AND ws_item_sk = wr_item_sk), date_dim
+            WHERE wr_return_amt > 100 AND ws_net_profit > 1
+              AND ws_net_paid > 0 AND ws_quantity > 0
+              AND ws_sold_date_sk = d_date_sk
+              AND d_year = 2000
+            GROUP BY ws_item_sk) web) t
+WHERE return_rank <= 10 OR currency_rank <= 10
+ORDER BY return_rank, currency_rank, item, channel
+LIMIT 100
+"""
+
+QUERIES["q51"] = """
+WITH web_v1 AS (
+  SELECT ws_item_sk item_sk, d_date,
+         SUM(SUM(ws_sales_price)) OVER (PARTITION BY ws_item_sk
+             ORDER BY d_date ROWS BETWEEN UNBOUNDED PRECEDING
+             AND CURRENT ROW) cume_sales
+  FROM web_sales, date_dim
+  WHERE ws_sold_date_sk = d_date_sk
+    AND d_month_seq BETWEEN 1200 AND 1205
+    AND ws_item_sk IS NOT NULL
+  GROUP BY ws_item_sk, d_date),
+store_v1 AS (
+  SELECT ss_item_sk item_sk, d_date,
+         SUM(SUM(ss_sales_price)) OVER (PARTITION BY ss_item_sk
+             ORDER BY d_date ROWS BETWEEN UNBOUNDED PRECEDING
+             AND CURRENT ROW) cume_sales
+  FROM store_sales, date_dim
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_month_seq BETWEEN 1200 AND 1205
+    AND ss_item_sk IS NOT NULL
+  GROUP BY ss_item_sk, d_date)
+SELECT item_sk, d_date, web_sales, store_sales
+FROM (SELECT CASE WHEN web.item_sk IS NOT NULL THEN web.item_sk
+                  ELSE store.item_sk END item_sk,
+             CASE WHEN web.d_date IS NOT NULL THEN web.d_date
+                  ELSE store.d_date END d_date,
+             web.cume_sales web_sales, store.cume_sales store_sales
+      FROM web_v1 web FULL OUTER JOIN store_v1 store
+           ON (web.item_sk = store.item_sk AND web.d_date = store.d_date)) x
+WHERE web_sales > store_sales
+ORDER BY item_sk, d_date, web_sales, store_sales
+LIMIT 100
+"""
+
+QUERIES["q56"] = """
+WITH ss AS (
+  SELECT i_item_id, SUM(ss_ext_sales_price) total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('red', 'blue', 'green'))
+    AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year = 2000 AND d_moy = 2
+    AND ss_addr_sk = ca_address_sk AND ca_gmt_offset = -5
+  GROUP BY i_item_id),
+cs AS (
+  SELECT i_item_id, SUM(cs_ext_sales_price) total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('red', 'blue', 'green'))
+    AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND d_year = 2000 AND d_moy = 2
+    AND cs_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5
+  GROUP BY i_item_id),
+ws AS (
+  SELECT i_item_id, SUM(ws_ext_sales_price) total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('red', 'blue', 'green'))
+    AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+    AND d_year = 2000 AND d_moy = 2
+    AND ws_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5
+  GROUP BY i_item_id)
+SELECT i_item_id, SUM(total_sales) total_sales
+FROM (SELECT * FROM ss UNION ALL SELECT * FROM cs
+      UNION ALL SELECT * FROM ws) tmp1
+GROUP BY i_item_id
+ORDER BY total_sales, i_item_id
+LIMIT 100
+"""
+
+QUERIES["q57"] = """
+WITH v1 AS (
+  SELECT i_category, i_brand, cc_name, d_year, d_moy,
+         SUM(cs_sales_price) sum_sales,
+         AVG(SUM(cs_sales_price)) OVER (PARTITION BY i_category, i_brand,
+             cc_name, d_year) avg_monthly_sales,
+         RANK() OVER (PARTITION BY i_category, i_brand, cc_name
+                      ORDER BY d_year, d_moy) rn
+  FROM item, catalog_sales, date_dim, call_center
+  WHERE cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND cc_call_center_sk = cs_call_center_sk
+    AND (d_year = 2000 OR (d_year = 1999 AND d_moy = 12)
+         OR (d_year = 2001 AND d_moy = 1))
+  GROUP BY i_category, i_brand, cc_name, d_year, d_moy),
+v2 AS (
+  SELECT i_category, i_brand, cc_name, d_year, d_moy,
+         avg_monthly_sales, sum_sales,
+         lag_sum AS psum, lead_sum AS nsum
+  FROM v1,
+       (SELECT i_category AS lag_cat, i_brand AS lag_brand,
+               cc_name AS lag_cc, rn AS lag_rn,
+               sum_sales AS lag_sum FROM v1) v1_lag,
+       (SELECT i_category AS lead_cat, i_brand AS lead_brand,
+               cc_name AS lead_cc, rn AS lead_rn,
+               sum_sales AS lead_sum FROM v1) v1_lead
+  WHERE i_category = lag_cat AND i_brand = lag_brand
+    AND cc_name = lag_cc
+    AND i_category = lead_cat AND i_brand = lead_brand
+    AND cc_name = lead_cc
+    AND rn = lag_rn + 1 AND rn = lead_rn - 1)
+SELECT * FROM v2
+WHERE d_year = 2000
+  AND avg_monthly_sales > 0
+  AND CASE WHEN avg_monthly_sales > 0
+           THEN abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           ELSE NULL END > 0.1
+ORDER BY sum_sales - avg_monthly_sales, d_moy, i_category, i_brand, cc_name
+LIMIT 100
+"""
+
+QUERIES["q59"] = """
+WITH wss AS (
+  SELECT d_week_seq, ss_store_sk,
+         SUM(CASE WHEN d_day_name = 'Sunday' THEN ss_sales_price
+                  ELSE NULL END) sun_sales,
+         SUM(CASE WHEN d_day_name = 'Monday' THEN ss_sales_price
+                  ELSE NULL END) mon_sales,
+         SUM(CASE WHEN d_day_name = 'Wednesday' THEN ss_sales_price
+                  ELSE NULL END) wed_sales,
+         SUM(CASE WHEN d_day_name = 'Friday' THEN ss_sales_price
+                  ELSE NULL END) fri_sales
+  FROM store_sales, date_dim
+  WHERE d_date_sk = ss_sold_date_sk
+  GROUP BY d_week_seq, ss_store_sk)
+SELECT s_store_name1, s_store_id1, d_week_seq1,
+       sun_sales1 / sun_sales2 AS r1, mon_sales1 / mon_sales2 AS r2,
+       wed_sales1 / wed_sales2 AS r3, fri_sales1 / fri_sales2 AS r4
+FROM (SELECT s_store_name s_store_name1, wss.d_week_seq d_week_seq1,
+             s_store_id s_store_id1, sun_sales sun_sales1,
+             mon_sales mon_sales1, wed_sales wed_sales1,
+             fri_sales fri_sales1
+      FROM wss, store, date_dim d
+      WHERE d.d_week_seq = wss.d_week_seq AND ss_store_sk = s_store_sk
+        AND d_month_seq BETWEEN 1200 AND 1205 AND d_dow = 0) y,
+     (SELECT s_store_name s_store_name2, wss.d_week_seq d_week_seq2,
+             s_store_id s_store_id2, sun_sales sun_sales2,
+             mon_sales mon_sales2, wed_sales wed_sales2,
+             fri_sales fri_sales2
+      FROM wss, store, date_dim d
+      WHERE d.d_week_seq = wss.d_week_seq AND ss_store_sk = s_store_sk
+        AND d_month_seq BETWEEN 1212 AND 1217 AND d_dow = 0) x
+WHERE s_store_id1 = s_store_id2 AND d_week_seq1 = d_week_seq2 - 52
+ORDER BY s_store_name1, s_store_id1, d_week_seq1
+LIMIT 100
+"""
+
+QUERIES["q60"] = """
+WITH ss AS (
+  SELECT i_item_id, SUM(ss_ext_sales_price) total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category = 'Children')
+    AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year = 2000 AND d_moy = 9
+    AND ss_addr_sk = ca_address_sk AND ca_gmt_offset = -5
+  GROUP BY i_item_id),
+cs AS (
+  SELECT i_item_id, SUM(cs_ext_sales_price) total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category = 'Children')
+    AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND d_year = 2000 AND d_moy = 9
+    AND cs_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5
+  GROUP BY i_item_id),
+ws AS (
+  SELECT i_item_id, SUM(ws_ext_sales_price) total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category = 'Children')
+    AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+    AND d_year = 2000 AND d_moy = 9
+    AND ws_bill_addr_sk = ca_address_sk AND ca_gmt_offset = -5
+  GROUP BY i_item_id)
+SELECT i_item_id, SUM(total_sales) total_sales
+FROM (SELECT * FROM ss UNION ALL SELECT * FROM cs
+      UNION ALL SELECT * FROM ws) tmp1
+GROUP BY i_item_id
+ORDER BY i_item_id, total_sales
+LIMIT 100
+"""
+
+QUERIES["q66"] = """
+SELECT w_warehouse_name, w_warehouse_sq_ft, w_city, w_county, w_state,
+       SUM(jan_sales) jan_sales, SUM(feb_sales) feb_sales,
+       SUM(mar_sales) mar_sales, SUM(jan_net) jan_net,
+       SUM(feb_net) feb_net, SUM(mar_net) mar_net
+FROM (SELECT w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+             w_state,
+             SUM(CASE WHEN d_moy = 1 THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) jan_sales,
+             SUM(CASE WHEN d_moy = 2 THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) feb_sales,
+             SUM(CASE WHEN d_moy = 3 THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) mar_sales,
+             SUM(CASE WHEN d_moy = 1 THEN ws_net_paid * ws_quantity
+                      ELSE 0 END) jan_net,
+             SUM(CASE WHEN d_moy = 2 THEN ws_net_paid * ws_quantity
+                      ELSE 0 END) feb_net,
+             SUM(CASE WHEN d_moy = 3 THEN ws_net_paid * ws_quantity
+                      ELSE 0 END) mar_net
+      FROM web_sales, warehouse, date_dim, time_dim, ship_mode
+      WHERE ws_warehouse_sk = w_warehouse_sk
+        AND ws_sold_date_sk = d_date_sk AND ws_sold_time_sk = t_time_sk
+        AND ws_ship_mode_sk = sm_ship_mode_sk AND d_year = 2000
+        AND t_time BETWEEN 30838 AND 59838
+        AND sm_carrier IN ('DHL', 'BARIAN')
+      GROUP BY w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+               w_state
+      UNION ALL
+      SELECT w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+             w_state,
+             SUM(CASE WHEN d_moy = 1 THEN cs_ext_sales_price * cs_quantity
+                      ELSE 0 END) jan_sales,
+             SUM(CASE WHEN d_moy = 2 THEN cs_ext_sales_price * cs_quantity
+                      ELSE 0 END) feb_sales,
+             SUM(CASE WHEN d_moy = 3 THEN cs_ext_sales_price * cs_quantity
+                      ELSE 0 END) mar_sales,
+             SUM(CASE WHEN d_moy = 1 THEN cs_net_paid * cs_quantity
+                      ELSE 0 END) jan_net,
+             SUM(CASE WHEN d_moy = 2 THEN cs_net_paid * cs_quantity
+                      ELSE 0 END) feb_net,
+             SUM(CASE WHEN d_moy = 3 THEN cs_net_paid * cs_quantity
+                      ELSE 0 END) mar_net
+      FROM catalog_sales, warehouse, date_dim, time_dim, ship_mode
+      WHERE cs_warehouse_sk = w_warehouse_sk
+        AND cs_sold_date_sk = d_date_sk AND cs_sold_time_sk = t_time_sk
+        AND cs_ship_mode_sk = sm_ship_mode_sk AND d_year = 2000
+        AND t_time BETWEEN 30838 AND 59838
+        AND sm_carrier IN ('DHL', 'BARIAN')
+      GROUP BY w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+               w_state) x
+GROUP BY w_warehouse_name, w_warehouse_sq_ft, w_city, w_county, w_state
+ORDER BY w_warehouse_name
+LIMIT 100
+"""
+
+QUERIES["q67"] = """
+SELECT * FROM (
+  SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_id, sumsales,
+         RANK() OVER (PARTITION BY i_category
+                      ORDER BY sumsales DESC, i_product_name,
+                               d_year, d_qoy, d_moy, s_store_id) rk
+  FROM (SELECT i_category, i_class, i_brand, i_product_name, d_year,
+               d_qoy, d_moy, s_store_id,
+               SUM(COALESCE(ss_sales_price * ss_quantity, 0)) sumsales
+        FROM store_sales, date_dim, store, item
+        WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+          AND ss_store_sk = s_store_sk
+          AND d_month_seq BETWEEN 1200 AND 1211
+        GROUP BY ROLLUP(i_category, i_class, i_brand, i_product_name,
+                        d_year, d_qoy, d_moy, s_store_id)) dw1) dw2
+WHERE rk <= 10
+ORDER BY i_category NULLS LAST, i_class NULLS LAST, i_brand NULLS LAST,
+         i_product_name NULLS LAST, d_year NULLS LAST, d_qoy NULLS LAST,
+         d_moy NULLS LAST, s_store_id NULLS LAST, sumsales, rk
+LIMIT 100
+"""
+
+QUERIES["q70"] = """
+SELECT SUM(ss_net_profit) AS total_sum, s_state, s_county,
+       grouping(s_state) + grouping(s_county) AS lochierarchy
+FROM store_sales, date_dim d1, store
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ss_sold_date_sk AND s_store_sk = ss_store_sk
+  AND s_state IN (SELECT s_state FROM
+                  (SELECT s_state AS s_state,
+                          RANK() OVER (PARTITION BY s_state
+                                       ORDER BY SUM(ss_net_profit) DESC)
+                              ranking
+                   FROM store_sales, store, date_dim
+                   WHERE d_month_seq BETWEEN 1200 AND 1211
+                     AND d_date_sk = ss_sold_date_sk
+                     AND s_store_sk = ss_store_sk
+                   GROUP BY s_state) tmp1
+                  WHERE ranking <= 5)
+GROUP BY ROLLUP(s_state, s_county)
+ORDER BY lochierarchy DESC, s_state NULLS LAST, s_county NULLS LAST,
+         total_sum
+LIMIT 100
+"""
+
+QUERIES["q72"] = """
+SELECT i_item_desc, w_warehouse_name, d1_week_seq,
+       SUM(CASE WHEN p_promo_sk IS NULL THEN 1 ELSE 0 END) no_promo,
+       SUM(CASE WHEN p_promo_sk IS NOT NULL THEN 1 ELSE 0 END) promo,
+       COUNT(*) total_cnt
+FROM (SELECT cs_item_sk, cs_quantity, cs_promo_sk,
+             d_week_seq AS d1_week_seq
+      FROM catalog_sales, date_dim, household_demographics,
+           customer_demographics
+      WHERE cs_sold_date_sk = d_date_sk AND d_year = 2000
+        AND cs_bill_hdemo_sk = hd_demo_sk
+        AND cs_bill_cdemo_sk = cd_demo_sk
+        AND hd_buy_potential = '>10000'
+        AND cd_marital_status = 'D') cs_dated
+     JOIN (SELECT inv_item_sk, inv_warehouse_sk, inv_quantity_on_hand,
+                  d_week_seq AS d2_week_seq
+           FROM inventory, date_dim
+           WHERE inv_date_sk = d_date_sk) inv_dated
+          ON (cs_item_sk = inv_item_sk AND d1_week_seq = d2_week_seq)
+     JOIN warehouse ON (w_warehouse_sk = inv_warehouse_sk)
+     JOIN item ON (i_item_sk = cs_item_sk)
+     LEFT OUTER JOIN promotion ON (cs_promo_sk = p_promo_sk)
+WHERE inv_quantity_on_hand < cs_quantity
+GROUP BY i_item_desc, w_warehouse_name, d1_week_seq
+ORDER BY total_cnt DESC, i_item_desc, w_warehouse_name, d1_week_seq
+LIMIT 100
+"""
+
+QUERIES["q74"] = """
+WITH year_total AS (
+  SELECT c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name, d_year dyear,
+         SUM(ss_net_paid) year_total, 's' sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year IN (2000, 2001)
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+  UNION ALL
+  SELECT c_customer_id, c_first_name, c_last_name, d_year,
+         SUM(ws_net_paid), 'w'
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk AND ws_sold_date_sk = d_date_sk
+    AND d_year IN (2000, 2001)
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year)
+SELECT t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.sale_type = 's' AND t_w_firstyear.sale_type = 'w'
+  AND t_s_secyear.sale_type = 's' AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.dyear = 2000 AND t_s_secyear.dyear = 2001
+  AND t_w_firstyear.dyear = 2000 AND t_w_secyear.dyear = 2001
+  AND t_s_firstyear.year_total > 0 AND t_w_firstyear.year_total > 0
+  AND CASE WHEN t_w_firstyear.year_total > 0
+           THEN t_w_secyear.year_total * 1.0 / t_w_firstyear.year_total
+           ELSE NULL END
+      > CASE WHEN t_s_firstyear.year_total > 0
+             THEN t_s_secyear.year_total * 1.0 / t_s_firstyear.year_total
+             ELSE NULL END
+ORDER BY t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+         t_s_secyear.customer_last_name
+LIMIT 100
+"""
+
+QUERIES["q75"] = """
+WITH all_sales AS (
+  SELECT d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+         SUM(sales_cnt) AS sales_cnt, SUM(sales_amt) AS sales_amt
+  FROM (SELECT d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id,
+               cs_quantity - COALESCE(cr_return_quantity, 0) AS sales_cnt,
+               cs_ext_sales_price - COALESCE(cr_return_amount, 0.0)
+                   AS sales_amt
+        FROM catalog_sales
+             JOIN item ON i_item_sk = cs_item_sk
+             JOIN date_dim ON d_date_sk = cs_sold_date_sk
+             LEFT JOIN catalog_returns
+                 ON (cs_order_number = cr_order_number
+                     AND cs_item_sk = cr_item_sk)
+        WHERE i_category = 'Books'
+        UNION ALL
+        SELECT d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id,
+               ss_quantity - COALESCE(sr_return_quantity, 0) AS sales_cnt,
+               ss_ext_sales_price - COALESCE(sr_return_amt, 0.0)
+                   AS sales_amt
+        FROM store_sales
+             JOIN item ON i_item_sk = ss_item_sk
+             JOIN date_dim ON d_date_sk = ss_sold_date_sk
+             LEFT JOIN store_returns
+                 ON (ss_ticket_number = sr_ticket_number
+                     AND ss_item_sk = sr_item_sk)
+        WHERE i_category = 'Books'
+        UNION ALL
+        SELECT d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id,
+               ws_quantity - COALESCE(wr_return_quantity, 0) AS sales_cnt,
+               ws_ext_sales_price - COALESCE(wr_return_amt, 0.0)
+                   AS sales_amt
+        FROM web_sales
+             JOIN item ON i_item_sk = ws_item_sk
+             JOIN date_dim ON d_date_sk = ws_sold_date_sk
+             LEFT JOIN web_returns
+                 ON (ws_order_number = wr_order_number
+                     AND ws_item_sk = wr_item_sk)
+        WHERE i_category = 'Books') sales_detail
+  GROUP BY d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id)
+SELECT prev_yr.d_year AS prev_year, curr_yr.d_year AS year_,
+       curr_yr.i_brand_id, curr_yr.i_class_id, curr_yr.i_category_id,
+       curr_yr.i_manufact_id, prev_yr.sales_cnt AS prev_yr_cnt,
+       curr_yr.sales_cnt AS curr_yr_cnt,
+       curr_yr.sales_cnt - prev_yr.sales_cnt AS sales_cnt_diff,
+       curr_yr.sales_amt - prev_yr.sales_amt AS sales_amt_diff
+FROM all_sales curr_yr, all_sales prev_yr
+WHERE curr_yr.i_brand_id = prev_yr.i_brand_id
+  AND curr_yr.i_class_id = prev_yr.i_class_id
+  AND curr_yr.i_category_id = prev_yr.i_category_id
+  AND curr_yr.i_manufact_id = prev_yr.i_manufact_id
+  AND curr_yr.d_year = 2001 AND prev_yr.d_year = 2000
+  AND curr_yr.sales_cnt * 1.0 / prev_yr.sales_cnt < 0.9
+ORDER BY sales_cnt_diff, sales_amt_diff, curr_yr.i_brand_id,
+         curr_yr.i_class_id, curr_yr.i_category_id, curr_yr.i_manufact_id
+LIMIT 100
+"""
+
+QUERIES["q76"] = """
+SELECT channel, col_name, d_year, d_qoy, i_category, COUNT(*) sales_cnt,
+       SUM(ext_sales_price) sales_amt
+FROM (SELECT 'store' AS channel, 'ss_customer_sk' col_name, d_year, d_qoy,
+             i_category, ss_ext_sales_price ext_sales_price
+      FROM store_sales, item, date_dim
+      WHERE ss_customer_sk IS NULL
+        AND ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+      UNION ALL
+      SELECT 'web' AS channel, 'ws_ship_customer_sk' col_name, d_year,
+             d_qoy, i_category, ws_ext_sales_price ext_sales_price
+      FROM web_sales, item, date_dim
+      WHERE ws_ship_customer_sk IS NULL
+        AND ws_sold_date_sk = d_date_sk AND ws_item_sk = i_item_sk
+      UNION ALL
+      SELECT 'catalog' AS channel, 'cs_ship_addr_sk' col_name, d_year,
+             d_qoy, i_category, cs_ext_sales_price ext_sales_price
+      FROM catalog_sales, item, date_dim
+      WHERE cs_ship_addr_sk IS NULL
+        AND cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk) foo
+GROUP BY channel, col_name, d_year, d_qoy, i_category
+ORDER BY channel, col_name, d_year, d_qoy, i_category
+LIMIT 100
+"""
+
+QUERIES["q77"] = """
+WITH ss AS (
+  SELECT s_store_sk, SUM(ss_ext_sales_price) AS sales,
+         SUM(ss_net_profit) AS profit
+  FROM store_sales, date_dim, store
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-03' AND '2000-09-02'
+    AND ss_store_sk = s_store_sk
+  GROUP BY s_store_sk),
+sr AS (
+  SELECT s_store_sk AS sr_store_sk, SUM(sr_return_amt) AS returns_,
+         SUM(sr_net_loss) AS profit_loss
+  FROM store_returns, date_dim, store
+  WHERE sr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-03' AND '2000-09-02'
+    AND sr_store_sk = s_store_sk
+  GROUP BY s_store_sk),
+cs AS (
+  SELECT cs_call_center_sk, SUM(cs_ext_sales_price) AS sales,
+         SUM(cs_net_profit) AS profit
+  FROM catalog_sales, date_dim
+  WHERE cs_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-03' AND '2000-09-02'
+  GROUP BY cs_call_center_sk),
+cr AS (
+  SELECT cr_call_center_sk, SUM(cr_return_amount) AS returns_,
+         SUM(cr_net_loss) AS profit_loss
+  FROM catalog_returns, date_dim
+  WHERE cr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-03' AND '2000-09-02'
+  GROUP BY cr_call_center_sk),
+ws AS (
+  SELECT wp_web_page_sk, SUM(ws_ext_sales_price) AS sales,
+         SUM(ws_net_profit) AS profit
+  FROM web_sales, date_dim, web_page
+  WHERE ws_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-03' AND '2000-09-02'
+    AND ws_web_page_sk = wp_web_page_sk
+  GROUP BY wp_web_page_sk),
+wr AS (
+  SELECT wp_web_page_sk AS wr_web_page_sk, SUM(wr_return_amt) AS returns_,
+         SUM(wr_net_loss) AS profit_loss
+  FROM web_returns, date_dim, web_page
+  WHERE wr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-03' AND '2000-09-02'
+    AND wr_web_page_sk = wp_web_page_sk
+  GROUP BY wp_web_page_sk)
+SELECT channel, id, SUM(sales) AS sales, SUM(returns_) AS returns_,
+       SUM(profit) AS profit
+FROM (SELECT 'store channel' AS channel, ss.s_store_sk AS id, sales,
+             COALESCE(returns_, 0.0) AS returns_,
+             profit - COALESCE(profit_loss, 0.0) AS profit
+      FROM ss LEFT JOIN sr ON ss.s_store_sk = sr.sr_store_sk
+      UNION ALL
+      SELECT 'catalog channel', cs_call_center_sk, sales,
+             COALESCE(returns_, 0.0),
+             profit - COALESCE(profit_loss, 0.0)
+      FROM cs LEFT JOIN cr ON cs.cs_call_center_sk = cr.cr_call_center_sk
+      UNION ALL
+      SELECT 'web channel', wp_web_page_sk, sales,
+             COALESCE(returns_, 0.0),
+             profit - COALESCE(profit_loss, 0.0)
+      FROM ws LEFT JOIN wr ON ws.wp_web_page_sk = wr.wr_web_page_sk) x
+GROUP BY ROLLUP(channel, id)
+ORDER BY channel NULLS LAST, id NULLS LAST, sales
+LIMIT 100
+"""
+
+QUERIES["q78"] = """
+WITH ws AS (
+  SELECT d_year AS ws_sold_year, ws_item_sk,
+         ws_bill_customer_sk ws_customer_sk,
+         SUM(ws_quantity) ws_qty, SUM(ws_wholesale_cost) ws_wc,
+         SUM(ws_sales_price) ws_sp
+  FROM web_sales
+       LEFT JOIN web_returns ON wr_order_number = ws_order_number
+            AND ws_item_sk = wr_item_sk
+       JOIN date_dim ON ws_sold_date_sk = d_date_sk
+  WHERE wr_order_number IS NULL
+  GROUP BY d_year, ws_item_sk, ws_bill_customer_sk),
+ss AS (
+  SELECT d_year AS ss_sold_year, ss_item_sk,
+         ss_customer_sk,
+         SUM(ss_quantity) ss_qty, SUM(ss_wholesale_cost) ss_wc,
+         SUM(ss_sales_price) ss_sp
+  FROM store_sales
+       LEFT JOIN store_returns ON sr_ticket_number = ss_ticket_number
+            AND ss_item_sk = sr_item_sk
+       JOIN date_dim ON ss_sold_date_sk = d_date_sk
+  WHERE sr_ticket_number IS NULL
+  GROUP BY d_year, ss_item_sk, ss_customer_sk)
+SELECT ss_item_sk, ROUND(ss_qty * 1.0 / COALESCE(ws_qty, 1), 2) ratio,
+       ss_qty store_qty, ss_wc store_wholesale_cost,
+       ss_sp store_sales_price
+FROM ss LEFT JOIN ws
+     ON (ws_sold_year = ss_sold_year AND ws_item_sk = ss_item_sk
+         AND ws_customer_sk = ss_customer_sk)
+WHERE COALESCE(ws_qty, 0) > 0 AND ss_sold_year = 2000
+ORDER BY ss_item_sk, ss_qty DESC, ss_wc DESC, ss_sp DESC, ratio
+LIMIT 100
+"""
+
+QUERIES["q80"] = """
+WITH ssr AS (
+  SELECT s_store_id AS store_id,
+         SUM(ss_ext_sales_price) AS sales,
+         SUM(COALESCE(sr_return_amt, 0.0)) AS returns_,
+         SUM(ss_net_profit - COALESCE(sr_net_loss, 0.0)) AS profit
+  FROM store_sales
+       LEFT OUTER JOIN store_returns
+           ON (ss_item_sk = sr_item_sk
+               AND ss_ticket_number = sr_ticket_number),
+       date_dim, store, item, promotion
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-22'
+    AND ss_store_sk = s_store_sk AND ss_item_sk = i_item_sk
+    AND i_current_price > 50 AND ss_promo_sk = p_promo_sk
+    AND p_channel_tv = 'N'
+  GROUP BY s_store_id),
+csr AS (
+  SELECT cp_catalog_page_id AS catalog_page_id,
+         SUM(cs_ext_sales_price) AS sales,
+         SUM(COALESCE(cr_return_amount, 0.0)) AS returns_,
+         SUM(cs_net_profit - COALESCE(cr_net_loss, 0.0)) AS profit
+  FROM catalog_sales
+       LEFT OUTER JOIN catalog_returns
+           ON (cs_item_sk = cr_item_sk
+               AND cs_order_number = cr_order_number),
+       date_dim, catalog_page, item, promotion
+  WHERE cs_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-22'
+    AND cs_catalog_page_sk = cp_catalog_page_sk
+    AND cs_item_sk = i_item_sk AND i_current_price > 50
+    AND cs_promo_sk = p_promo_sk AND p_channel_tv = 'N'
+  GROUP BY cp_catalog_page_id),
+wsr AS (
+  SELECT web_site_id,
+         SUM(ws_ext_sales_price) AS sales,
+         SUM(COALESCE(wr_return_amt, 0.0)) AS returns_,
+         SUM(ws_net_profit - COALESCE(wr_net_loss, 0.0)) AS profit
+  FROM web_sales
+       LEFT OUTER JOIN web_returns
+           ON (ws_item_sk = wr_item_sk
+               AND ws_order_number = wr_order_number),
+       date_dim, web_site, item, promotion
+  WHERE ws_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-22'
+    AND ws_web_site_sk = web_site_sk
+    AND ws_item_sk = i_item_sk AND i_current_price > 50
+    AND ws_promo_sk = p_promo_sk AND p_channel_tv = 'N'
+  GROUP BY web_site_id)
+SELECT channel, id, SUM(sales) AS sales, SUM(returns_) AS returns_,
+       SUM(profit) AS profit
+FROM (SELECT 'store channel' AS channel, store_id AS id, sales, returns_,
+             profit
+      FROM ssr
+      UNION ALL
+      SELECT 'catalog channel', catalog_page_id, sales, returns_, profit
+      FROM csr
+      UNION ALL
+      SELECT 'web channel', web_site_id, sales, returns_, profit
+      FROM wsr) x
+GROUP BY ROLLUP(channel, id)
+ORDER BY channel NULLS LAST, id NULLS LAST, sales
+LIMIT 100
+"""
+
+QUERIES["q85"] = """
+SELECT substr(r_reason_desc, 1, 20) AS r, AVG(ws_quantity * 1.0) AS q,
+       AVG(wr_refunded_cash * 1.0) AS rc, AVG(wr_fee * 1.0) AS f
+FROM web_sales, web_returns, web_page, customer_demographics cd1,
+     (SELECT cd_demo_sk AS cd2_demo_sk,
+             cd_marital_status AS cd2_marital_status,
+             cd_education_status AS cd2_education_status
+      FROM customer_demographics) cd2,
+     customer_address, date_dim, reason
+WHERE ws_web_page_sk = wp_web_page_sk AND ws_item_sk = wr_item_sk
+  AND ws_order_number = wr_order_number
+  AND ws_sold_date_sk = d_date_sk AND d_year = 2000
+  AND cd1.cd_demo_sk = wr_refunded_cdemo_sk
+  AND cd2_demo_sk = wr_returning_cdemo_sk
+  AND ca_address_sk = wr_refunded_addr_sk
+  AND r_reason_sk = wr_reason_sk
+  AND cd1.cd_marital_status = cd2_marital_status
+  AND cd1.cd_education_status = cd2_education_status
+  AND cd1.cd_marital_status IN ('M', 'S', 'W')
+  AND ca_state IN ('TX', 'OH', 'CA', 'KY', 'GA', 'NM')
+GROUP BY r_reason_desc
+ORDER BY r, q, rc, f
+LIMIT 100
+"""
+
 #: sqlite lacks ROLLUP / grouping(); these queries validate against a
 #: hand-expanded UNION ALL oracle text producing identical rows
 ORACLE_OVERRIDES = {}
+
+ORACLE_OVERRIDES["q5"] = """
+WITH ssr AS (
+  SELECT s_store_id, SUM(sales_price) AS sales, SUM(profit) AS profit,
+         SUM(return_amt) AS returns_, SUM(net_loss) AS profit_loss
+  FROM (SELECT ss_store_sk AS store_sk, ss_sold_date_sk AS date_sk,
+               ss_ext_sales_price AS sales_price, ss_net_profit AS profit,
+               0.0 AS return_amt, 0.0 AS net_loss
+        FROM store_sales
+        UNION ALL
+        SELECT sr_store_sk, sr_returned_date_sk, 0.0, 0.0,
+               sr_return_amt, sr_net_loss
+        FROM store_returns) salesreturns, date_dim, store
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-06'
+    AND store_sk = s_store_sk
+  GROUP BY s_store_id),
+csr AS (
+  SELECT cp_catalog_page_id, SUM(sales_price) AS sales,
+         SUM(profit) AS profit, SUM(return_amt) AS returns_,
+         SUM(net_loss) AS profit_loss
+  FROM (SELECT cs_catalog_page_sk AS page_sk,
+               cs_sold_date_sk AS date_sk,
+               cs_ext_sales_price AS sales_price,
+               cs_net_profit AS profit, 0.0 AS return_amt, 0.0 AS net_loss
+        FROM catalog_sales
+        UNION ALL
+        SELECT cr_catalog_page_sk, cr_returned_date_sk, 0.0, 0.0,
+               cr_return_amount, cr_net_loss
+        FROM catalog_returns) salesreturns, date_dim, catalog_page
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-06'
+    AND page_sk = cp_catalog_page_sk
+  GROUP BY cp_catalog_page_id),
+wsr AS (
+  SELECT web_site_id, SUM(sales_price) AS sales, SUM(profit) AS profit,
+         SUM(return_amt) AS returns_, SUM(net_loss) AS profit_loss
+  FROM (SELECT ws_web_site_sk AS wsr_web_site_sk,
+               ws_sold_date_sk AS date_sk,
+               ws_ext_sales_price AS sales_price,
+               ws_net_profit AS profit, 0.0 AS return_amt, 0.0 AS net_loss
+        FROM web_sales
+        UNION ALL
+        SELECT ws_web_site_sk, wr_returned_date_sk, 0.0, 0.0,
+               wr_return_amt, wr_net_loss
+        FROM web_returns
+             LEFT OUTER JOIN web_sales
+                 ON (wr_item_sk = ws_item_sk
+                     AND wr_order_number = ws_order_number))
+       salesreturns, date_dim, web_site
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-06'
+    AND wsr_web_site_sk = web_site_sk
+  GROUP BY web_site_id)
+SELECT * FROM (
+SELECT channel, id, SUM(sales) AS sales, SUM(returns_) AS returns_,
+       SUM(profit - profit_loss) AS profit
+FROM (SELECT 'store channel' AS channel, s_store_id AS id, sales,
+             returns_, profit, profit_loss
+      FROM ssr
+      UNION ALL
+      SELECT 'catalog channel', cp_catalog_page_id, sales, returns_,
+             profit, profit_loss
+      FROM csr
+      UNION ALL
+      SELECT 'web channel', web_site_id, sales, returns_, profit,
+             profit_loss
+      FROM wsr) x
+GROUP BY channel, id
+UNION ALL
+SELECT channel, NULL, SUM(sales) AS sales, SUM(returns_) AS returns_,
+       SUM(profit - profit_loss) AS profit
+FROM (SELECT 'store channel' AS channel, s_store_id AS id, sales,
+             returns_, profit, profit_loss
+      FROM ssr
+      UNION ALL
+      SELECT 'catalog channel', cp_catalog_page_id, sales, returns_,
+             profit, profit_loss
+      FROM csr
+      UNION ALL
+      SELECT 'web channel', web_site_id, sales, returns_, profit,
+             profit_loss
+      FROM wsr) x
+GROUP BY channel
+UNION ALL
+SELECT NULL, NULL, SUM(sales) AS sales, SUM(returns_) AS returns_,
+       SUM(profit - profit_loss) AS profit
+FROM (SELECT 'store channel' AS channel, s_store_id AS id, sales,
+             returns_, profit, profit_loss
+      FROM ssr
+      UNION ALL
+      SELECT 'catalog channel', cp_catalog_page_id, sales, returns_,
+             profit, profit_loss
+      FROM csr
+      UNION ALL
+      SELECT 'web channel', web_site_id, sales, returns_, profit,
+             profit_loss
+      FROM wsr) x
+) t
+
+ORDER BY channel NULLS LAST, id NULLS LAST, sales
+LIMIT 100
+"""
+
+ORACLE_OVERRIDES["q18"] = """
+SELECT * FROM (
+SELECT i_item_id, ca_country, ca_state, ca_county,
+       AVG(cs_quantity * 1.0) agg1, AVG(cs_list_price * 1.0) agg2,
+       AVG(cs_coupon_amt * 1.0) agg3, AVG(cs_sales_price * 1.0) agg4,
+       AVG(cs_net_profit * 1.0) agg5, AVG(c_birth_year * 1.0) agg6,
+       AVG(cd_dep_count * 1.0) agg7
+FROM catalog_sales,
+     (SELECT cd_demo_sk AS cd1_demo_sk, cd_dep_count,
+             cd_gender AS cd1_gender, cd_education_status AS cd1_edu
+      FROM customer_demographics) cd1,
+     customer, customer_address, date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd1_demo_sk AND cs_bill_customer_sk = c_customer_sk
+  AND cd1_gender = 'F' AND cd1_edu = 'Unknown'
+  AND c_current_addr_sk = ca_address_sk AND d_year = 2001
+  AND c_birth_month IN (1, 2, 3, 4, 5, 6)
+GROUP BY i_item_id, ca_country, ca_state, ca_county
+UNION ALL
+SELECT i_item_id, ca_country, ca_state, NULL,
+       AVG(cs_quantity * 1.0), AVG(cs_list_price * 1.0),
+       AVG(cs_coupon_amt * 1.0), AVG(cs_sales_price * 1.0),
+       AVG(cs_net_profit * 1.0), AVG(c_birth_year * 1.0),
+       AVG(cd_dep_count * 1.0)
+FROM catalog_sales,
+     (SELECT cd_demo_sk AS cd1_demo_sk, cd_dep_count,
+             cd_gender AS cd1_gender, cd_education_status AS cd1_edu
+      FROM customer_demographics) cd1,
+     customer, customer_address, date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd1_demo_sk AND cs_bill_customer_sk = c_customer_sk
+  AND cd1_gender = 'F' AND cd1_edu = 'Unknown'
+  AND c_current_addr_sk = ca_address_sk AND d_year = 2001
+  AND c_birth_month IN (1, 2, 3, 4, 5, 6)
+GROUP BY i_item_id, ca_country, ca_state
+UNION ALL
+SELECT i_item_id, ca_country, NULL, NULL,
+       AVG(cs_quantity * 1.0), AVG(cs_list_price * 1.0),
+       AVG(cs_coupon_amt * 1.0), AVG(cs_sales_price * 1.0),
+       AVG(cs_net_profit * 1.0), AVG(c_birth_year * 1.0),
+       AVG(cd_dep_count * 1.0)
+FROM catalog_sales,
+     (SELECT cd_demo_sk AS cd1_demo_sk, cd_dep_count,
+             cd_gender AS cd1_gender, cd_education_status AS cd1_edu
+      FROM customer_demographics) cd1,
+     customer, customer_address, date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd1_demo_sk AND cs_bill_customer_sk = c_customer_sk
+  AND cd1_gender = 'F' AND cd1_edu = 'Unknown'
+  AND c_current_addr_sk = ca_address_sk AND d_year = 2001
+  AND c_birth_month IN (1, 2, 3, 4, 5, 6)
+GROUP BY i_item_id, ca_country
+UNION ALL
+SELECT i_item_id, NULL, NULL, NULL,
+       AVG(cs_quantity * 1.0), AVG(cs_list_price * 1.0),
+       AVG(cs_coupon_amt * 1.0), AVG(cs_sales_price * 1.0),
+       AVG(cs_net_profit * 1.0), AVG(c_birth_year * 1.0),
+       AVG(cd_dep_count * 1.0)
+FROM catalog_sales,
+     (SELECT cd_demo_sk AS cd1_demo_sk, cd_dep_count,
+             cd_gender AS cd1_gender, cd_education_status AS cd1_edu
+      FROM customer_demographics) cd1,
+     customer, customer_address, date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd1_demo_sk AND cs_bill_customer_sk = c_customer_sk
+  AND cd1_gender = 'F' AND cd1_edu = 'Unknown'
+  AND c_current_addr_sk = ca_address_sk AND d_year = 2001
+  AND c_birth_month IN (1, 2, 3, 4, 5, 6)
+GROUP BY i_item_id
+UNION ALL
+SELECT NULL, NULL, NULL, NULL,
+       AVG(cs_quantity * 1.0), AVG(cs_list_price * 1.0),
+       AVG(cs_coupon_amt * 1.0), AVG(cs_sales_price * 1.0),
+       AVG(cs_net_profit * 1.0), AVG(c_birth_year * 1.0),
+       AVG(cd_dep_count * 1.0)
+FROM catalog_sales,
+     (SELECT cd_demo_sk AS cd1_demo_sk, cd_dep_count,
+             cd_gender AS cd1_gender, cd_education_status AS cd1_edu
+      FROM customer_demographics) cd1,
+     customer, customer_address, date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd1_demo_sk AND cs_bill_customer_sk = c_customer_sk
+  AND cd1_gender = 'F' AND cd1_edu = 'Unknown'
+  AND c_current_addr_sk = ca_address_sk AND d_year = 2001
+  AND c_birth_month IN (1, 2, 3, 4, 5, 6)
+) t
+ORDER BY ca_country NULLS LAST, ca_state NULLS LAST, ca_county NULLS LAST,
+         i_item_id NULLS LAST
+LIMIT 100
+"""
+
+ORACLE_OVERRIDES["q67"] = """
+SELECT * FROM (
+  SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_id, sumsales,
+         RANK() OVER (PARTITION BY i_category
+                      ORDER BY sumsales DESC, i_product_name,
+                               d_year, d_qoy, d_moy, s_store_id) rk
+  FROM (SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy, d_moy, s_store_id, SUM(COALESCE(ss_sales_price * ss_quantity, 0)) sumsales
+        FROM store_sales, date_dim, store, item
+        WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+          AND ss_store_sk = s_store_sk
+          AND d_month_seq BETWEEN 1200 AND 1211
+        GROUP BY i_category, i_class, i_brand, i_product_name, d_year, d_qoy, d_moy, s_store_id
+        UNION ALL
+        SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy, d_moy, NULL, SUM(COALESCE(ss_sales_price * ss_quantity, 0)) sumsales
+        FROM store_sales, date_dim, store, item
+        WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+          AND ss_store_sk = s_store_sk
+          AND d_month_seq BETWEEN 1200 AND 1211
+        GROUP BY i_category, i_class, i_brand, i_product_name, d_year, d_qoy, d_moy
+        UNION ALL
+        SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy, NULL, NULL, SUM(COALESCE(ss_sales_price * ss_quantity, 0)) sumsales
+        FROM store_sales, date_dim, store, item
+        WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+          AND ss_store_sk = s_store_sk
+          AND d_month_seq BETWEEN 1200 AND 1211
+        GROUP BY i_category, i_class, i_brand, i_product_name, d_year, d_qoy
+        UNION ALL
+        SELECT i_category, i_class, i_brand, i_product_name, d_year, NULL, NULL, NULL, SUM(COALESCE(ss_sales_price * ss_quantity, 0)) sumsales
+        FROM store_sales, date_dim, store, item
+        WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+          AND ss_store_sk = s_store_sk
+          AND d_month_seq BETWEEN 1200 AND 1211
+        GROUP BY i_category, i_class, i_brand, i_product_name, d_year
+        UNION ALL
+        SELECT i_category, i_class, i_brand, i_product_name, NULL, NULL, NULL, NULL, SUM(COALESCE(ss_sales_price * ss_quantity, 0)) sumsales
+        FROM store_sales, date_dim, store, item
+        WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+          AND ss_store_sk = s_store_sk
+          AND d_month_seq BETWEEN 1200 AND 1211
+        GROUP BY i_category, i_class, i_brand, i_product_name
+        UNION ALL
+        SELECT i_category, i_class, i_brand, NULL, NULL, NULL, NULL, NULL, SUM(COALESCE(ss_sales_price * ss_quantity, 0)) sumsales
+        FROM store_sales, date_dim, store, item
+        WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+          AND ss_store_sk = s_store_sk
+          AND d_month_seq BETWEEN 1200 AND 1211
+        GROUP BY i_category, i_class, i_brand
+        UNION ALL
+        SELECT i_category, i_class, NULL, NULL, NULL, NULL, NULL, NULL, SUM(COALESCE(ss_sales_price * ss_quantity, 0)) sumsales
+        FROM store_sales, date_dim, store, item
+        WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+          AND ss_store_sk = s_store_sk
+          AND d_month_seq BETWEEN 1200 AND 1211
+        GROUP BY i_category, i_class
+        UNION ALL
+        SELECT i_category, NULL, NULL, NULL, NULL, NULL, NULL, NULL, SUM(COALESCE(ss_sales_price * ss_quantity, 0)) sumsales
+        FROM store_sales, date_dim, store, item
+        WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+          AND ss_store_sk = s_store_sk
+          AND d_month_seq BETWEEN 1200 AND 1211
+        GROUP BY i_category
+        UNION ALL
+        SELECT NULL, NULL, NULL, NULL, NULL, NULL, NULL, NULL, SUM(COALESCE(ss_sales_price * ss_quantity, 0)) sumsales
+        FROM store_sales, date_dim, store, item
+        WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+          AND ss_store_sk = s_store_sk
+          AND d_month_seq BETWEEN 1200 AND 1211) dw1) dw2
+WHERE rk <= 10
+ORDER BY i_category NULLS LAST, i_class NULLS LAST, i_brand NULLS LAST,
+         i_product_name NULLS LAST, d_year NULLS LAST, d_qoy NULLS LAST,
+         d_moy NULLS LAST, s_store_id NULLS LAST, sumsales, rk
+LIMIT 100
+"""
+
+ORACLE_OVERRIDES["q70"] = """
+SELECT * FROM (
+SELECT SUM(ss_net_profit) AS total_sum, s_state, s_county, 0 AS lochierarchy
+FROM store_sales, date_dim d1, store
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ss_sold_date_sk AND s_store_sk = ss_store_sk
+  AND s_state IN (SELECT s_state FROM
+                  (SELECT s_state AS s_state,
+                          RANK() OVER (PARTITION BY s_state
+                                       ORDER BY SUM(ss_net_profit) DESC)
+                              ranking
+                   FROM store_sales, store, date_dim
+                   WHERE d_month_seq BETWEEN 1200 AND 1211
+                     AND d_date_sk = ss_sold_date_sk
+                     AND s_store_sk = ss_store_sk
+                   GROUP BY s_state) tmp1
+                  WHERE ranking <= 5)
+GROUP BY s_state, s_county
+UNION ALL
+SELECT SUM(ss_net_profit), s_state, NULL, 1
+FROM store_sales, date_dim d1, store
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ss_sold_date_sk AND s_store_sk = ss_store_sk
+  AND s_state IN (SELECT s_state FROM
+                  (SELECT s_state AS s_state,
+                          RANK() OVER (PARTITION BY s_state
+                                       ORDER BY SUM(ss_net_profit) DESC)
+                              ranking
+                   FROM store_sales, store, date_dim
+                   WHERE d_month_seq BETWEEN 1200 AND 1211
+                     AND d_date_sk = ss_sold_date_sk
+                     AND s_store_sk = ss_store_sk
+                   GROUP BY s_state) tmp1
+                  WHERE ranking <= 5)
+GROUP BY s_state
+UNION ALL
+SELECT SUM(ss_net_profit), NULL, NULL, 2
+FROM store_sales, date_dim d1, store
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ss_sold_date_sk AND s_store_sk = ss_store_sk
+  AND s_state IN (SELECT s_state FROM
+                  (SELECT s_state AS s_state,
+                          RANK() OVER (PARTITION BY s_state
+                                       ORDER BY SUM(ss_net_profit) DESC)
+                              ranking
+                   FROM store_sales, store, date_dim
+                   WHERE d_month_seq BETWEEN 1200 AND 1211
+                     AND d_date_sk = ss_sold_date_sk
+                     AND s_store_sk = ss_store_sk
+                   GROUP BY s_state) tmp1
+                  WHERE ranking <= 5)
+) t
+ORDER BY lochierarchy DESC, s_state NULLS LAST, s_county NULLS LAST,
+         total_sum
+LIMIT 100
+"""
+
+ORACLE_OVERRIDES["q77"] = """
+WITH ss AS (
+  SELECT s_store_sk, SUM(ss_ext_sales_price) AS sales,
+         SUM(ss_net_profit) AS profit
+  FROM store_sales, date_dim, store
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-03' AND '2000-09-02'
+    AND ss_store_sk = s_store_sk
+  GROUP BY s_store_sk),
+sr AS (
+  SELECT s_store_sk AS sr_store_sk, SUM(sr_return_amt) AS returns_,
+         SUM(sr_net_loss) AS profit_loss
+  FROM store_returns, date_dim, store
+  WHERE sr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-03' AND '2000-09-02'
+    AND sr_store_sk = s_store_sk
+  GROUP BY s_store_sk),
+cs AS (
+  SELECT cs_call_center_sk, SUM(cs_ext_sales_price) AS sales,
+         SUM(cs_net_profit) AS profit
+  FROM catalog_sales, date_dim
+  WHERE cs_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-03' AND '2000-09-02'
+  GROUP BY cs_call_center_sk),
+cr AS (
+  SELECT cr_call_center_sk, SUM(cr_return_amount) AS returns_,
+         SUM(cr_net_loss) AS profit_loss
+  FROM catalog_returns, date_dim
+  WHERE cr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-03' AND '2000-09-02'
+  GROUP BY cr_call_center_sk),
+ws AS (
+  SELECT wp_web_page_sk, SUM(ws_ext_sales_price) AS sales,
+         SUM(ws_net_profit) AS profit
+  FROM web_sales, date_dim, web_page
+  WHERE ws_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-03' AND '2000-09-02'
+    AND ws_web_page_sk = wp_web_page_sk
+  GROUP BY wp_web_page_sk),
+wr AS (
+  SELECT wp_web_page_sk AS wr_web_page_sk, SUM(wr_return_amt) AS returns_,
+         SUM(wr_net_loss) AS profit_loss
+  FROM web_returns, date_dim, web_page
+  WHERE wr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-03' AND '2000-09-02'
+    AND wr_web_page_sk = wp_web_page_sk
+  GROUP BY wp_web_page_sk)
+SELECT * FROM (
+SELECT channel, id, SUM(sales) AS sales, SUM(returns_) AS returns_,
+       SUM(profit) AS profit
+FROM (SELECT 'store channel' AS channel, ss.s_store_sk AS id, sales,
+             COALESCE(returns_, 0.0) AS returns_,
+             profit - COALESCE(profit_loss, 0.0) AS profit
+      FROM ss LEFT JOIN sr ON ss.s_store_sk = sr.sr_store_sk
+      UNION ALL
+      SELECT 'catalog channel', cs_call_center_sk, sales,
+             COALESCE(returns_, 0.0),
+             profit - COALESCE(profit_loss, 0.0)
+      FROM cs LEFT JOIN cr ON cs.cs_call_center_sk = cr.cr_call_center_sk
+      UNION ALL
+      SELECT 'web channel', wp_web_page_sk, sales,
+             COALESCE(returns_, 0.0),
+             profit - COALESCE(profit_loss, 0.0)
+      FROM ws LEFT JOIN wr ON ws.wp_web_page_sk = wr.wr_web_page_sk) x
+GROUP BY channel, id
+UNION ALL
+SELECT channel, NULL, SUM(sales) AS sales, SUM(returns_) AS returns_,
+       SUM(profit) AS profit
+FROM (SELECT 'store channel' AS channel, ss.s_store_sk AS id, sales,
+             COALESCE(returns_, 0.0) AS returns_,
+             profit - COALESCE(profit_loss, 0.0) AS profit
+      FROM ss LEFT JOIN sr ON ss.s_store_sk = sr.sr_store_sk
+      UNION ALL
+      SELECT 'catalog channel', cs_call_center_sk, sales,
+             COALESCE(returns_, 0.0),
+             profit - COALESCE(profit_loss, 0.0)
+      FROM cs LEFT JOIN cr ON cs.cs_call_center_sk = cr.cr_call_center_sk
+      UNION ALL
+      SELECT 'web channel', wp_web_page_sk, sales,
+             COALESCE(returns_, 0.0),
+             profit - COALESCE(profit_loss, 0.0)
+      FROM ws LEFT JOIN wr ON ws.wp_web_page_sk = wr.wr_web_page_sk) x
+GROUP BY channel
+UNION ALL
+SELECT NULL, NULL, SUM(sales) AS sales, SUM(returns_) AS returns_,
+       SUM(profit) AS profit
+FROM (SELECT 'store channel' AS channel, ss.s_store_sk AS id, sales,
+             COALESCE(returns_, 0.0) AS returns_,
+             profit - COALESCE(profit_loss, 0.0) AS profit
+      FROM ss LEFT JOIN sr ON ss.s_store_sk = sr.sr_store_sk
+      UNION ALL
+      SELECT 'catalog channel', cs_call_center_sk, sales,
+             COALESCE(returns_, 0.0),
+             profit - COALESCE(profit_loss, 0.0)
+      FROM cs LEFT JOIN cr ON cs.cs_call_center_sk = cr.cr_call_center_sk
+      UNION ALL
+      SELECT 'web channel', wp_web_page_sk, sales,
+             COALESCE(returns_, 0.0),
+             profit - COALESCE(profit_loss, 0.0)
+      FROM ws LEFT JOIN wr ON ws.wp_web_page_sk = wr.wr_web_page_sk) x
+) t
+
+ORDER BY channel NULLS LAST, id NULLS LAST, sales
+LIMIT 100
+"""
+
+ORACLE_OVERRIDES["q80"] = """
+WITH ssr AS (
+  SELECT s_store_id AS store_id,
+         SUM(ss_ext_sales_price) AS sales,
+         SUM(COALESCE(sr_return_amt, 0.0)) AS returns_,
+         SUM(ss_net_profit - COALESCE(sr_net_loss, 0.0)) AS profit
+  FROM store_sales
+       LEFT OUTER JOIN store_returns
+           ON (ss_item_sk = sr_item_sk
+               AND ss_ticket_number = sr_ticket_number),
+       date_dim, store, item, promotion
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-22'
+    AND ss_store_sk = s_store_sk AND ss_item_sk = i_item_sk
+    AND i_current_price > 50 AND ss_promo_sk = p_promo_sk
+    AND p_channel_tv = 'N'
+  GROUP BY s_store_id),
+csr AS (
+  SELECT cp_catalog_page_id AS catalog_page_id,
+         SUM(cs_ext_sales_price) AS sales,
+         SUM(COALESCE(cr_return_amount, 0.0)) AS returns_,
+         SUM(cs_net_profit - COALESCE(cr_net_loss, 0.0)) AS profit
+  FROM catalog_sales
+       LEFT OUTER JOIN catalog_returns
+           ON (cs_item_sk = cr_item_sk
+               AND cs_order_number = cr_order_number),
+       date_dim, catalog_page, item, promotion
+  WHERE cs_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-22'
+    AND cs_catalog_page_sk = cp_catalog_page_sk
+    AND cs_item_sk = i_item_sk AND i_current_price > 50
+    AND cs_promo_sk = p_promo_sk AND p_channel_tv = 'N'
+  GROUP BY cp_catalog_page_id),
+wsr AS (
+  SELECT web_site_id,
+         SUM(ws_ext_sales_price) AS sales,
+         SUM(COALESCE(wr_return_amt, 0.0)) AS returns_,
+         SUM(ws_net_profit - COALESCE(wr_net_loss, 0.0)) AS profit
+  FROM web_sales
+       LEFT OUTER JOIN web_returns
+           ON (ws_item_sk = wr_item_sk
+               AND ws_order_number = wr_order_number),
+       date_dim, web_site, item, promotion
+  WHERE ws_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-22'
+    AND ws_web_site_sk = web_site_sk
+    AND ws_item_sk = i_item_sk AND i_current_price > 50
+    AND ws_promo_sk = p_promo_sk AND p_channel_tv = 'N'
+  GROUP BY web_site_id)
+SELECT * FROM (
+SELECT channel, id, SUM(sales) AS sales, SUM(returns_) AS returns_,
+       SUM(profit) AS profit
+FROM (SELECT 'store channel' AS channel, store_id AS id, sales, returns_,
+             profit
+      FROM ssr
+      UNION ALL
+      SELECT 'catalog channel', catalog_page_id, sales, returns_, profit
+      FROM csr
+      UNION ALL
+      SELECT 'web channel', web_site_id, sales, returns_, profit
+      FROM wsr) x
+GROUP BY channel, id
+UNION ALL
+SELECT channel, NULL, SUM(sales) AS sales, SUM(returns_) AS returns_,
+       SUM(profit) AS profit
+FROM (SELECT 'store channel' AS channel, store_id AS id, sales, returns_,
+             profit
+      FROM ssr
+      UNION ALL
+      SELECT 'catalog channel', catalog_page_id, sales, returns_, profit
+      FROM csr
+      UNION ALL
+      SELECT 'web channel', web_site_id, sales, returns_, profit
+      FROM wsr) x
+GROUP BY channel
+UNION ALL
+SELECT NULL, NULL, SUM(sales) AS sales, SUM(returns_) AS returns_,
+       SUM(profit) AS profit
+FROM (SELECT 'store channel' AS channel, store_id AS id, sales, returns_,
+             profit
+      FROM ssr
+      UNION ALL
+      SELECT 'catalog channel', catalog_page_id, sales, returns_, profit
+      FROM csr
+      UNION ALL
+      SELECT 'web channel', web_site_id, sales, returns_, profit
+      FROM wsr) x
+) t
+
+ORDER BY channel NULLS LAST, id NULLS LAST, sales
+LIMIT 100
+"""
+
 
 ORACLE_OVERRIDES["q22"] = """
 SELECT i_product_name, i_brand, i_class, i_category,

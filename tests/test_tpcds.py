@@ -89,9 +89,9 @@ def test_query(tpcds, qname):
 
 
 def test_runnable_count():
-    """The VERDICT r1 #4 bar: >= 20 oracle-validated queries."""
-    assert len(RUNNABLE) >= 20
-    assert not set(RUNNABLE) & set(PENDING)
+    """ALL 99 TPC-DS queries run and oracle-validate (r1 bar was 20)."""
+    assert len(RUNNABLE) == 99
+    assert not PENDING
 
 
 def test_pending_tracked():
