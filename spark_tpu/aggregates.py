@@ -464,3 +464,38 @@ class CollectSet(CollectList):
 
     def __repr__(self):
         return f"collect_set({self.children[0]!r})"
+
+
+class PercentileApprox(AggregateFunction):
+    """percentile_approx(x, p): the reference's ApproximatePercentile
+    (t-digest sketch); this engine computes the EXACT per-group
+    percentile — the sort-based group path already has sorted values in
+    hand, so exactness is free (a better answer than the contract asks).
+    Interpolation is nearest-rank at floor(p * (n-1)), matching the
+    accuracy=1 behavior."""
+
+    is_percentile = True
+
+    def __init__(self, child, percentage: float):
+        super().__init__(child)
+        if not (0.0 <= float(percentage) <= 1.0):
+            raise AnalysisException(
+                f"percentile must be in [0, 1], got {percentage}")
+        self.percentage = float(percentage)
+
+    def map_children(self, fn):
+        return PercentileApprox(fn(self.children[0]), self.percentage)
+
+    def data_type(self, schema):
+        return self.children[0].data_type(schema)
+
+    def num_buffers(self):
+        return 0
+
+    def make_buffers(self, ctx, contribute):
+        raise AnalysisException(
+            "percentile_approx only runs on the sort-based aggregation "
+            "path")
+
+    def __repr__(self):
+        return f"percentile_approx({self.children[0]!r}, {self.percentage})"

@@ -305,7 +305,22 @@ def _sorted_grouped_aggregate(
                                         v.dictionary))
 
     contribute = live
+    # all percentile slots over one child share ONE value-sort
+    pct_slots = [(f, n) for f, n in agg_slots
+                 if getattr(f, "is_percentile", False)]
+    pct_results = {}
+    if pct_slots:
+        by_child = {}
+        for f, n in pct_slots:
+            by_child.setdefault(repr(f.children[0]), []).append((f, n))
+        for group in by_child.values():
+            pct_results.update(_percentile_groups(
+                xp, ctx, group, sort_cols, live, capacity))
     for func, name in agg_slots:
+        if getattr(func, "is_percentile", False):
+            out_names.append(name)
+            out_vectors.append(pct_results[name])
+            continue
         if getattr(func, "is_collect", False):
             out_names.append(name)
             out_vectors.append(_collect_into_arrays(
@@ -349,6 +364,68 @@ def _sorted_grouped_aggregate(
         for v in out_vectors
     ]
     return ColumnBatch(out_names, out_vectors, None, 1)
+
+
+def _percentile_groups(xp, ctx, slots, sort_cols, live, capacity: int
+                       ) -> dict:
+    """Exact nearest-rank percentiles per group, ONE value-sort for every
+    requested percentage over the same child: re-sort by (keys, value) so
+    each group's values are ordered, then gather the row whose
+    position-in-group equals floor(p * (n_valid - 1)).  Returns
+    {slot_name: ColumnVector}."""
+    func = slots[0][0]
+    v = ctx.broadcast(func.children[0].eval(ctx))
+    vdata = v.data
+    np_dt = np.asarray(vdata).dtype if _is_np(xp) else \
+        np.dtype(str(vdata.dtype))
+    if np_dt == np.bool_:
+        vdata = vdata.astype(np.int8)
+        np_dt = np.dtype(np.int8)
+    keep = live if v.valid is None else (live & v.valid)
+    # NULL/dead values sort to the end of their group (max-identity key)
+    ident = IDENTITY["max"](np_dt)
+    vkey = xp.where(keep, vdata, np.asarray(ident, vdata.dtype))
+    vnull = xp.where(keep, np.int8(0), np.int8(1))
+    perm = multi_key_argsort(xp, sort_cols + [vnull, vkey], capacity)
+    live_s = live[perm]
+    keep_s = keep[perm]
+    # recompute segments over the value-sorted order
+    change = xp.zeros(capacity, bool)
+    for c0 in sort_cols:
+        c = c0[perm]
+        shifted = xp.concatenate([c[:1], c[:-1]])
+        change = change | (c != shifted)
+    if _is_np(xp):
+        change = change.copy()
+        change[0] = True
+    else:
+        change = change.at[0].set(True)
+    is_start = change & live_s
+    seg_ids = xp.cumsum(is_start.astype(np.int64)) - 1
+    seg_ids = xp.where(live_s, seg_ids, np.int64(capacity - 1))
+    n_valid = segment_reduce(xp, keep_s.astype(np.int64), seg_ids,
+                             capacity, "sum")
+    ck = xp.cumsum(keep_s.astype(np.int64))
+    seg_base = segment_reduce(xp, xp.where(keep_s, ck - 1,
+                                           np.int64(1 << 62)),
+                              seg_ids, capacity, "min")
+    pos = ck - 1 - seg_base[seg_ids]
+    got = n_valid > 0
+    vdata_s = vdata[perm]
+    out = {}
+    for f, name in slots:
+        target = xp.floor(np.float64(f.percentage)
+                          * (n_valid - 1).astype(np.float64)
+                          ).astype(np.int64)
+        win = keep_s & (pos == target[seg_ids])
+        # max over exactly-one-winner IS the gather; empty groups -> NULL
+        masked = xp.where(win, vdata_s, np.asarray(ident, vdata.dtype))
+        red = segment_reduce(xp, masked, seg_ids, capacity, "max")
+        dt = f.data_type(ctx.batch.schema)
+        data = red.astype(np.bool_) if np.dtype(dt.np_dtype) == np.bool_ \
+            else red.astype(dt.np_dtype)
+        out[name] = ColumnVector(data, dt, got, v.dictionary)
+    return out
 
 
 def _collect_into_arrays(xp, ctx, func, perm, sort_cols, seg_ids, is_start,

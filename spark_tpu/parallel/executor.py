@@ -149,6 +149,16 @@ def _estimate_rows(node: LogicalPlan) -> Optional[int]:
         return node.batch.capacity
     if isinstance(node, RangeRelation):
         return node.num_rows()
+    from ..sql.logical import FileRelation
+    if isinstance(node, FileRelation):
+        # datasource stats (SparkStrategies.scala:116): a small parquet
+        # dimension table must take the broadcast path, not a shuffle —
+        # parquet answers from metadata without loading data
+        from ..io import file_row_count
+        try:
+            return file_row_count(node)
+        except Exception:
+            return None
     if isinstance(node, (Project, SubqueryAlias, Filter, Sample)):
         return _estimate_rows(node.children[0])
     if isinstance(node, Limit):

@@ -569,3 +569,17 @@ def collect_set(c: ColumnOrName) -> Column:
 
 
 __all__ += ["collect_list", "collect_set"]
+
+
+def percentile_approx(c: ColumnOrName, percentage: float,
+                      accuracy: int = 10000) -> Column:
+    """Exact per-group percentile (the reference sketches; see
+    aggregates.PercentileApprox). ``accuracy`` accepted for API parity."""
+    return Column(A.PercentileApprox(_e(c), percentage))
+
+
+def median(c: ColumnOrName) -> Column:
+    return percentile_approx(c, 0.5)
+
+
+__all__ += ["percentile_approx", "median"]

@@ -147,7 +147,11 @@ def _decompose(optimized: L.LogicalPlan) -> Optional[_Decomposed]:
         if isinstance(breaker, L.Aggregate):
             for f, _n in breaker.aggs:
                 if isinstance(f, (First, Last)) \
-                        or getattr(f, "is_distinct", False):
+                        or getattr(f, "is_distinct", False) \
+                        or getattr(f, "is_collect", False) \
+                        or getattr(f, "is_percentile", False):
+                    # no fixed-width mergeable partial form: these run on
+                    # the eager single-batch sort path
                     return None
         for op in above:
             if _with_child(op, leaf) is None:

@@ -373,6 +373,7 @@ SCALAR_FUNCTIONS.update(_register_breadth())
 
 AGG_FUNCTIONS = {
     "collect_list": lambda e: A.CollectList(e),
+    "median": lambda e: A.PercentileApprox(e, 0.5),
     "collect_set": lambda e: A.CollectSet(e),
     "sum": lambda e: A.Sum(e),
     "avg": lambda e: A.Avg(e),
@@ -1378,6 +1379,14 @@ class Parser:
             out = A.CountDistinct(args[0])
         elif lname in ("sum",) and distinct:
             out = A.SumDistinct(_one(args, "sum"))
+        elif lname in ("percentile_approx", "approx_percentile"):
+            if distinct:
+                raise ParseException(f"DISTINCT not supported for {lname}")
+            if len(args) not in (2, 3):
+                raise ParseException(
+                    "percentile_approx expects (col, percentage[, accuracy])")
+            out = A.PercentileApprox(
+                args[0], float(_litval(args[1], "percentile_approx")))
         elif lname in AGG_FUNCTIONS:
             if distinct:
                 raise ParseException(f"DISTINCT not supported for {lname}")

@@ -83,7 +83,8 @@ def _needs_local_fallback(plan: LogicalPlan) -> bool:
     def walk(node: LogicalPlan):
         if isinstance(node, Aggregate):
             for f, _n in node.aggs:
-                if getattr(f, "is_collect", False):
+                if getattr(f, "is_collect", False) \
+                        or getattr(f, "is_percentile", False):
                     found.append("collect")
         try:
             if any(isinstance(f.dataType, T.ArrayType)

@@ -363,3 +363,31 @@ def test_distributed_first_ignorenulls_false(dspark):
         Column(Last(F.col("v")._e, ignore_nulls=False)).alias("l")
     ).collect()}
     assert got == {1: (None, 5), 2: (7, None)}
+
+
+def test_file_backed_dimension_broadcasts(dspark, tmp_path):
+    """A small parquet dimension table takes the BROADCAST path (r1 weak
+    #4: file relations had no row estimate and always shuffled)."""
+    import numpy as np
+    import pandas as pd
+    spark = dspark
+    dim = pd.DataFrame({"k": np.arange(20, dtype=np.int64),
+                        "name": [f"n{i}" for i in range(20)]})
+    path = str(tmp_path / "dim")
+    spark.createDataFrame(dim).write.parquet(path)
+    fact = spark.createDataFrame(pd.DataFrame({
+        "k": np.arange(500, dtype=np.int64) % 20,
+        "v": np.arange(500, dtype=np.int64)}))
+    spark.read.parquet(path).createOrReplaceTempView("dimt")
+    fact.createOrReplaceTempView("factt")
+    df = spark.sql("SELECT name, SUM(v) AS s FROM factt JOIN dimt "
+                   "ON factt.k = dimt.k GROUP BY name")
+    # plan inspection: the physical tree must contain a broadcast node
+    from spark_tpu.sql.planner import QueryExecution
+    from spark_tpu.parallel.executor import DistributedPlanner
+    qe = QueryExecution(spark, df._plan)
+    leaves = []
+    phys = DistributedPlanner(spark, 8)._to_physical(qe.optimized, leaves)
+    assert "Broadcast" in phys.tree_string()
+    rows = {r["name"]: r["s"] for r in df.collect()}
+    assert rows["n0"] == sum(range(0, 500, 20))

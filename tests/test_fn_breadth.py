@@ -202,3 +202,28 @@ def test_dual_path_consistency(spark):
     rows = [(r["m"], r["r"]) for r in q.collect()]
     assert rows == [(dt.date(2021, 2, 28), "@lph@"),
                     (dt.date(2022, 7, 15), "bet@")]
+
+
+def test_percentile_approx_and_median(spark):
+    import pandas as pd
+    df = spark.createDataFrame(pd.DataFrame({
+        "k": [1] * 5 + [2] * 4,
+        "v": [10, 20, 30, 40, 50, 7, 8, 9, 100]}))
+    df.createOrReplaceTempView("pct_t")
+    out = {r["k"]: (r["p50"], r["p90"]) for r in spark.sql(
+        "SELECT k, percentile_approx(v, 0.5) p50, "
+        "percentile_approx(v, 0.9) p90 FROM pct_t GROUP BY k").collect()}
+    assert out[1] == (30, 40)      # floor(.9*4)=3 -> 4th smallest
+    assert out[2] == (8, 9)
+    m = spark.sql("SELECT median(v) m FROM pct_t").collect()[0]["m"]
+    assert m == 20                 # 9 values, floor(.5*8)=4 -> 5th smallest
+    # NULLs skipped; all-null group -> NULL
+    from spark_tpu import types as T
+    df2 = spark.createDataFrame(
+        [(1, 5), (1, None), (2, None)],
+        T.StructType([T.StructField("k", T.int64, False),
+                      T.StructField("v", T.int64, True)]))
+    from spark_tpu.sql import functions as F
+    got = {r["k"]: r["p"] for r in df2.groupBy("k").agg(
+        F.percentile_approx("v", 0.5).alias("p")).collect()}
+    assert got == {1: 5, 2: None}

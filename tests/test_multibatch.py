@@ -302,3 +302,29 @@ def test_multibatch_checkpoint_resume(tmp_path, spark):
         spark.conf.unset("spark.tpu.scan.maxBatchRows")
         spark.conf.unset("spark.tpu.multibatch.checkpointInterval")
         spark.conf.unset("spark.tpu.multibatch.enabled")
+
+
+def test_multibatch_rejects_collect_and_percentile(tmp_path, spark):
+    """collect/percentile have no mergeable partial form; big file scans
+    must take the eager path, not crash in DPartialAggregate."""
+    import numpy as np
+    import pandas as pd
+    from spark_tpu.sql import functions as F
+    pdf = pd.DataFrame({"k": np.arange(600, dtype=np.int64) % 5,
+                        "v": np.arange(600, dtype=np.int64)})
+    path = str(tmp_path / "p")
+    spark.createDataFrame(pdf).write.parquet(path)
+    spark.conf.set("spark.tpu.multibatch.enabled", "true")
+    spark.conf.set("spark.tpu.scan.maxBatchRows", "100")
+    try:
+        df = spark.read.parquet(path)
+        got = {r["k"]: r["p"] for r in df.groupBy("k").agg(
+            F.percentile_approx("v", 0.5).alias("p")).collect()}
+        exp = {int(k): int(g["v"].sort_values().iloc[(len(g) - 1) // 2])
+               for k, g in pdf.groupby("k")}
+        assert got == exp
+        lst = df.groupBy("k").agg(F.collect_set("v").alias("s")).collect()
+        assert all(len(r["s"]) == 120 for r in lst)
+    finally:
+        spark.conf.unset("spark.tpu.multibatch.enabled")
+        spark.conf.unset("spark.tpu.scan.maxBatchRows")
