@@ -1257,20 +1257,33 @@ class TimeWindow(Expression):
 
     def __init__(self, child: Expression, duration_us: int,
                  slide_us: Optional[int] = None, field: str = "start"):
-        if slide_us is not None and slide_us != duration_us:
-            raise AnalysisException(
-                "sliding windows (slide != duration) are not supported yet")
         if int(duration_us) <= 0:
             raise AnalysisException(
                 f"window duration must be positive, got {duration_us}us")
+        slide = int(slide_us) if slide_us is not None else int(duration_us)
+        if slide <= 0 or int(duration_us) % slide != 0:
+            raise AnalysisException(
+                "window slide must be positive and divide the duration "
+                f"evenly; got duration={duration_us}us slide={slide}us")
+        if int(duration_us) // slide > 512:
+            # each event expands into duration/slide rows (static shapes);
+            # an unbounded ratio would explode analysis and batch capacity
+            raise AnalysisException(
+                f"window duration/slide ratio {duration_us // slide} "
+                "exceeds the supported maximum of 512 windows per event")
         assert field in ("start", "end"), field
         self.duration_us = int(duration_us)
+        self.slide_us = slide
         self.field = field
         self.children = (child,)
 
+    @property
+    def is_sliding(self) -> bool:
+        return self.slide_us != self.duration_us
+
     def map_children(self, fn):
         return TimeWindow(fn(self.children[0]), self.duration_us,
-                          None, self.field)
+                          self.slide_us, self.field)
 
     @property
     def name(self):
@@ -1284,6 +1297,11 @@ class TimeWindow(Expression):
         return T.timestamp
 
     def eval(self, ctx):
+        if self.is_sliding:
+            raise AnalysisException(
+                "sliding window() must be a grouping key (the analyzer "
+                "expands events into their windows); it cannot be "
+                "evaluated as a plain expression")
         xp = ctx.xp
         v = self.children[0].eval(ctx)
         d = np.int64(self.duration_us)
@@ -1292,7 +1310,9 @@ class TimeWindow(Expression):
         return ExprValue(out, v.valid)
 
     def __repr__(self):
-        return f"window({self.children[0]!r}, {self.duration_us}us).{self.field}"
+        return (f"window({self.children[0]!r}, {self.duration_us}us"
+                + (f", slide={self.slide_us}us" if self.is_sliding else "")
+                + f").{self.field}")
 
 
 class ExtractDatePart(Expression):

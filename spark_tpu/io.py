@@ -304,18 +304,30 @@ def read_file_relation(rel: L.FileRelation, session) -> ColumnBatch:
 # streamed (multi-batch) scans — FileScanRDD.scala analog
 # ---------------------------------------------------------------------------
 
+_ROW_COUNT_CACHE: dict = {}
+
+
 def file_row_count(rel: L.FileRelation) -> Optional[int]:
     """Total rows WITHOUT loading data when possible (parquet metadata);
-    other formats load (host-cached) and count."""
+    other formats load (host-cached) and count.  Memoized per resolved
+    file list + mtimes — multi-join planning probes the same dimension
+    files repeatedly."""
+    import os
     try:
         files = _resolve_paths(rel.paths)
     except AnalysisException:
         return None
+    key = tuple((f, os.path.getmtime(f)) for f in files)
+    if key in _ROW_COUNT_CACHE:
+        return _ROW_COUNT_CACHE[key]
     if rel.fmt == "parquet":
         import pyarrow.parquet as pq
-        return sum(pq.ParquetFile(f).metadata.num_rows for f in files)
-    batch = _load_batch(rel.fmt, rel.paths, rel.options)
-    return int(np.asarray(batch.num_rows()))
+        n = sum(pq.ParquetFile(f).metadata.num_rows for f in files)
+    else:
+        batch = _load_batch(rel.fmt, rel.paths, rel.options)
+        n = int(np.asarray(batch.num_rows()))
+    _ROW_COUNT_CACHE[key] = n
+    return n
 
 
 def scan_file_batches(rel: L.FileRelation, batch_rows: int):

@@ -220,8 +220,60 @@ class Analyzer:
         plan = plan.transform_up(self._rewrite_node)
         plan = plan.transform_up(self._rewrite_explode)
         plan = plan.transform_up(self._rewrite_grouping_sets)
+        plan = plan.transform_up(self._rewrite_sliding_window)
         self._validate(plan)
         return plan
+
+    @staticmethod
+    def _rewrite_sliding_window(node: LogicalPlan) -> LogicalPlan:
+        """Sliding window() grouping keys (slide < duration) expand each
+        event into its duration/slide windows BELOW the aggregate (the
+        reference's Expand in TimeWindowing): static expansion factor
+        r = duration // slide, so shapes stay compile-time constant."""
+        from ..expressions import (
+            Add, Alias, Cast, Col, Literal, MakeArray, Sub, TimeWindow,
+        )
+        from .logical import Explode
+        if not isinstance(node, Aggregate):
+            return node
+
+        def base(k):
+            return k.children[0] if isinstance(k, Alias) else k
+
+        sliding = [k for k in node.keys
+                   if isinstance(base(k), TimeWindow)
+                   and base(k).is_sliding]
+        if not sliding:
+            return node
+        specs = {(base(k).duration_us, base(k).slide_us,
+                  repr(base(k).children[0])) for k in sliding}
+        if len(specs) > 1:
+            raise AnalysisException(
+                "one sliding window spec per aggregation is supported")
+        tw = base(sliding[0])
+        d, s_us = tw.duration_us, tw.slide_us
+        r = d // s_us
+        ts = tw.children[0]
+        # i-th containing window start = floor(ts / slide) * slide - i*slide
+        last = Cast(TimeWindow(ts, s_us), T.int64)
+        starts = [Sub(last, Literal(i * s_us)) for i in range(r)]
+        tmp = "__win_start"
+        child = node.children[0]
+        pre = [Col(n) for n in child.schema().names]
+        expansion = Explode(pre, MakeArray(*starts), tmp, False, "pos",
+                            child, insert_at=len(pre))
+        new_keys = []
+        for k in node.keys:
+            b = base(k)
+            if isinstance(b, TimeWindow) and b.is_sliding:
+                if b.field == "start":
+                    e = Cast(Col(tmp), T.timestamp)
+                else:
+                    e = Cast(Add(Col(tmp), Literal(d)), T.timestamp)
+                new_keys.append(Alias(e, k.name))
+            else:
+                new_keys.append(k)
+        return Aggregate(new_keys, node.aggs, expansion)
 
     @staticmethod
     def _rewrite_grouping_sets(node: LogicalPlan) -> LogicalPlan:

@@ -203,10 +203,13 @@ def _if_fn(args):
 
 def _time_window(args, field):
     from ..expressions import TimeWindow, parse_duration
-    if len(args) != 2 or not isinstance(args[1], Literal):
+    if len(args) not in (2, 3) \
+            or any(not isinstance(a, Literal) for a in args[1:]):
         raise ParseException(
-            "window expects (timeColumn, 'duration literal')")
-    return TimeWindow(args[0], parse_duration(args[1].value), None, field)
+            "window expects (timeColumn, 'duration literal'"
+            "[, 'slide literal'])")
+    slide = parse_duration(args[2].value) if len(args) > 2 else None
+    return TimeWindow(args[0], parse_duration(args[1].value), slide, field)
 
 
 def _count(args, distinct):

@@ -882,6 +882,14 @@ class StreamExecution:
                     "sorting a streaming aggregation is only supported in "
                     "complete output mode")
             node = node.children[0]
+        self._reject_sliding(agg)
+        for f, _n in agg.aggs:
+            if getattr(f, "is_percentile", False) \
+                    or getattr(f, "is_collect", False):
+                raise AnalysisException(
+                    f"{f!r} has no mergeable partial form; streaming "
+                    "aggregations support sum/count/avg/min/max/first/"
+                    "last/variance")
         self._event_key = self._find_event_key(agg)
         if self.mode == "append" and self._event_key is None:
             # append over an aggregate needs a watermark on a group key to
@@ -893,6 +901,15 @@ class StreamExecution:
                 "(withWatermark + window()/the event column in groupBy)")
         self._agg_node = agg
         return AggregationState(agg.keys, agg.aggs, agg.child.schema())
+
+    def _reject_sliding(self, agg: L.Aggregate) -> None:
+        from ..expressions import Alias, TimeWindow
+        for k in agg.keys:
+            b = k.children[0] if isinstance(k, Alias) else k
+            if isinstance(b, TimeWindow) and b.is_sliding:
+                raise AnalysisException(
+                    "sliding windows on streams are not supported yet; "
+                    "use a tumbling window (slide == duration)")
 
     def _find_event_key(self, agg: L.Aggregate):
         """(key index, window duration) of the event-time grouping key tied

@@ -232,3 +232,63 @@ def test_dedup_watermark_eviction_and_recovery(spark, tmp_path):
     q2.processAllAvailable()
     assert sink_rows(spark, "dd4") == [(dt(21), "c", 3)]
     q2.stop()
+
+
+def test_sliding_window_batch_aggregation(spark):
+    """window(ts, '10 min', '5 min'): each event lands in duration/slide
+    windows (Expand-style static expansion below the aggregate)."""
+    import numpy as np
+    import pandas as pd
+    from spark_tpu.sql import functions as F
+    rng = np.random.default_rng(5)
+    secs = rng.integers(0, 3600, 300)
+    vals = rng.integers(1, 100, 300)
+    df = spark.createDataFrame(pd.DataFrame({
+        "ts": pd.to_datetime(secs, unit="s"), "v": vals}))
+    out = {r["w"]: r["s"] for r in
+           df.groupBy(F.window("ts", "10 minutes", "5 minutes").alias("w"))
+             .agg(F.sum("v").alias("s")).collect()}
+    import collections
+    exp = collections.Counter()
+    for t, v in zip(secs.tolist(), vals.tolist()):
+        last = (t // 300) * 300
+        for i in range(2):
+            exp[last - i * 300] += v
+    import datetime as dt
+    expected = {dt.datetime.utcfromtimestamp(k): v for k, v in exp.items()}
+    assert out == expected
+
+
+def test_sliding_window_end_field_and_sql(spark):
+    rows = spark.sql(
+        "SELECT window(t, '4 seconds', '2 seconds') AS w, COUNT(*) AS c "
+        "FROM (SELECT to_timestamp('1970-01-01 00:00:05') AS t) x "
+        "GROUP BY window(t, '4 seconds', '2 seconds') ORDER BY w").collect()
+    import datetime as dt
+    assert [r["w"] for r in rows] == [
+        dt.datetime(1970, 1, 1, 0, 0, 2), dt.datetime(1970, 1, 1, 0, 0, 4)]
+
+
+def test_sliding_window_rejects_bad_slide(spark):
+    import pytest
+    from spark_tpu.expressions import AnalysisException
+    from spark_tpu.sql import functions as F
+    with pytest.raises(AnalysisException, match="divide"):
+        F.window("ts", "10 minutes", "3 minutes")
+
+
+def test_sliding_window_streaming_rejected(spark):
+    import pytest
+    from spark_tpu import types as T
+    from spark_tpu.expressions import AnalysisException
+    from spark_tpu.streaming.core import MemoryStream
+    from spark_tpu.sql import functions as F
+    src = MemoryStream(T.StructType([
+        T.StructField("ts", T.TimestampType()),
+        T.StructField("v", T.int64)]), session=spark)
+    sdf = (src.to_df(spark).withWatermark("ts", "1 minute")
+           .groupBy(F.window("ts", "10 minutes", "5 minutes").alias("w"))
+           .agg(F.sum("v").alias("s")))
+    with pytest.raises(AnalysisException, match="sliding"):
+        (sdf.writeStream.format("memory").queryName("slidefail")
+         .outputMode("complete").start())
