@@ -1,4 +1,12 @@
 """PageRank over the graphx analog (examples/graphx/PageRankExample)."""
+
+import os
+import sys
+
+# runnable BOTH ways: `bin/spark-tpu-submit examples/x.py` and plain
+# `python examples/x.py` (the repo root is the import root)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from spark_tpu.graphx import Graph, page_rank

@@ -1,4 +1,12 @@
 """SparkPi analog: Monte-Carlo pi over the RDD API (examples/SparkPi)."""
+
+import os
+import sys
+
+# runnable BOTH ways: `bin/spark-tpu-submit examples/x.py` and plain
+# `python examples/x.py` (the repo root is the import root)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import random
 import sys
 

@@ -1,4 +1,12 @@
 """DataFrame + SQL basics (examples/sql/basic.py analog)."""
+
+import os
+import sys
+
+# runnable BOTH ways: `bin/spark-tpu-submit examples/x.py` and plain
+# `python examples/x.py` (the repo root is the import root)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import pandas as pd
 
 from spark_tpu.sql.session import SparkSession

@@ -1,5 +1,13 @@
 """Event-time windowed aggregation with a watermark (structured streaming
 examples analog)."""
+
+import os
+import sys
+
+# runnable BOTH ways: `bin/spark-tpu-submit examples/x.py` and plain
+# `python examples/x.py` (the repo root is the import root)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from spark_tpu import types as T
 from spark_tpu.sql import functions as F
 from spark_tpu.streaming import MemoryStream

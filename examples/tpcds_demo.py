@@ -1,4 +1,12 @@
 """Run a few TPC-DS queries on generated data (TPCDSQueryBenchmark analog)."""
+
+import os
+import sys
+
+# runnable BOTH ways: `bin/spark-tpu-submit examples/x.py` and plain
+# `python examples/x.py` (the repo root is the import root)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import time
 
 from spark_tpu.sql.session import SparkSession
