@@ -42,6 +42,8 @@ import time
 
 BASELINE_AGG_ROWS_PER_S = 93.5e6    # AggregateBenchmark.scala:125-131
 BASELINE_JOIN_ROWS_PER_S = 65.3e6   # JoinBenchmark.scala:42-47
+BASELINE_SORT_ROWS_PER_S = 188.4e6  # SortBenchmark.scala:120-128 (radix)
+BASELINE_SCAN_ROWS_PER_S = 73.0e6   # ParquetReadBenchmark.scala:140-143
 
 N = 1 << 22          # rows per iteration for the agg bench (static batch)
 ITERS = 20
@@ -52,6 +54,13 @@ J_FACT = 1 << 21     # q3-shape: fact rows per iteration
 J_DIM = 2048         # q3-shape: dimension rows (broadcast side)
 J_BRANDS = 64
 J_ITERS = 10
+
+S_ROWS = 1 << 22     # sort lane: rows per iteration (25M-longs baseline shape)
+S_ITERS = 10
+
+P_ROWS = 1 << 22     # parquet scan lane: rows in the generated file
+P_COLS = 10          # wide file; pruning must read only the summed column
+P_REPS = 4
 
 #: cold axon compiles of the fused agg/join programs run several minutes
 #: (f64/i64 emulation); the persistent jax compile cache under /tmp makes
@@ -349,6 +358,93 @@ def _bench_q3_join(jax, jnp, np, session):
     return J_FACT * J_ITERS / dt
 
 
+def _bench_sort(jax, jnp, np, session):
+    """Global sort of S_ROWS random int64 keys through the planner, vs the
+    reference radix sort at 188.4 M rows/s (`SortBenchmark.scala:120-128`).
+    """
+    from spark_tpu.columnar import ColumnBatch, ColumnVector
+    from spark_tpu.sql import functions as F
+    from spark_tpu.sql import physical as P
+    from spark_tpu.sql.planner import QueryExecution
+
+    rng = np.random.default_rng(13)
+    xs = rng.integers(np.iinfo(np.int64).min, np.iinfo(np.int64).max,
+                      S_ROWS, dtype=np.int64)
+    df = session.createDataFrame({"x": xs}).orderBy(F.col("x"))
+    pq = QueryExecution(session, df._plan).planned
+    physical = pq.physical
+
+    def step(leaves, bump):
+        perturbed = []
+        for b in leaves:
+            vecs = [ColumnVector(v.data ^ bump, v.dtype, v.valid,
+                                 v.dictionary) for v in b.vectors]
+            perturbed.append(ColumnBatch(b.names, vecs, b.row_valid,
+                                         b.capacity))
+        ctx = P.ExecContext(jnp, perturbed)
+        out = physical.run(ctx)
+        return out.vectors[0].data
+
+    def run_loop(leaves):
+        def body(i, acc):
+            s = step(leaves, i.astype(jnp.int64))
+            # every 64k-th element of the SORTED output feeds the carry:
+            # the whole permutation is live, nothing hoists
+            return acc + s[:: 1 << 16].sum() + s[0] + s[-1]
+        return jax.lax.fori_loop(0, S_ITERS, body, jnp.int64(0))
+
+    dev_leaves = tuple(b.to_device() for b in pq.leaves)
+
+    # correctness gate
+    s0 = np.asarray(jax.jit(lambda l: step(l, jnp.int64(0)))(dev_leaves))
+    assert np.array_equal(s0, np.sort(xs)), "sort mismatch vs numpy"
+
+    loop = jax.jit(run_loop)
+    _ = int(np.asarray(loop(dev_leaves)))
+    t0 = time.perf_counter()
+    _ = int(np.asarray(loop(dev_leaves)))
+    dt = time.perf_counter() - t0
+    return S_ROWS * S_ITERS / dt
+
+
+def _bench_parquet_scan(np, session):
+    """End-to-end parquet scan+sum of one int column out of a P_COLS-wide
+    file (pruned read), vs the vectorized reader at 73 M rows/s
+    (`ParquetReadBenchmark.scala:140-143`).  Wall-clock includes file IO —
+    the relation cache is cleared per repetition."""
+    import pandas as pd
+
+    from spark_tpu import io as tio
+    from spark_tpu.sql import functions as F
+
+    path = f"/tmp/spark_tpu_bench_scan_{P_ROWS}x{P_COLS}.parquet"
+    marker = os.path.join(path, "_SUCCESS")
+    if not os.path.exists(marker):
+        rng = np.random.default_rng(17)
+        cols = {"x": rng.integers(0, 1 << 30, P_ROWS).astype(np.int64)}
+        for i in range(P_COLS - 1):
+            cols[f"pad{i}"] = rng.integers(0, 1000, P_ROWS).astype(np.int64)
+        os.makedirs(path, exist_ok=True)
+        pd.DataFrame(cols).to_parquet(
+            os.path.join(path, "part-000.parquet"), index=False,
+            row_group_size=1 << 20)
+        open(marker, "w").close()
+
+    df = session.read.parquet(path).agg(F.sum("x").alias("s"))
+    expect = None
+    t0 = None
+    for rep in range(P_REPS + 1):
+        tio._relation_cache.clear()
+        (s,), = df.collect()
+        if rep == 0:
+            expect = s                      # warm-up + self-consistency
+            t0 = time.perf_counter()
+        else:
+            assert s == expect
+    dt = time.perf_counter() - t0
+    return P_ROWS * P_REPS / dt
+
+
 def child_main() -> None:
     import numpy as np
     import jax
@@ -365,8 +461,9 @@ def child_main() -> None:
             # down; scale the workload so it finishes inside the timeout,
             # and use the sort-based aggregation (the MXU one-hot matmul
             # kernel is a systolic-array design — pathological on CPU).
-            global N, ITERS, J_FACT, J_ITERS
+            global N, ITERS, J_FACT, J_ITERS, S_ROWS, S_ITERS, P_ROWS, P_REPS
             N, ITERS, J_FACT, J_ITERS = 1 << 19, 5, 1 << 18, 3
+            S_ROWS, S_ITERS, P_ROWS, P_REPS = 1 << 19, 3, 1 << 20, 2
 
     platform = _preflight()
 
@@ -376,16 +473,27 @@ def child_main() -> None:
 
     agg_rows_per_s = _bench_hash_agg(jax, jnp, np, session)
 
-    try:
-        join_rows_per_s = _bench_q3_join(jax, jnp, np, session)
-        q3 = {
-            "q3_join_agg_sort_rows_per_sec": round(join_rows_per_s, 1),
-            "q3_vs_join_baseline": round(
-                join_rows_per_s / BASELINE_JOIN_ROWS_PER_S, 3),
-        }
-    except Exception as e:   # secondary must not sink the primary number
-        print(f"[bench-child] q3 bench failed: {e}", file=sys.stderr)
-        q3 = {"q3_error": str(e)[:300]}
+    extras = {}
+
+    def lane(label, fn, baseline, value_key, ratio_key):
+        try:
+            rps = fn()
+            extras[value_key] = round(rps, 1)
+            extras[ratio_key] = round(rps / baseline, 3)
+        except Exception as e:   # secondary must not sink the primary
+            print(f"[bench-child] {label} bench failed: {e}",
+                  file=sys.stderr)
+            extras[f"{label}_error"] = str(e)[:300]
+
+    lane("q3", lambda: _bench_q3_join(jax, jnp, np, session),
+         BASELINE_JOIN_ROWS_PER_S,
+         "q3_join_agg_sort_rows_per_sec", "q3_vs_join_baseline")
+    lane("sort", lambda: _bench_sort(jax, jnp, np, session),
+         BASELINE_SORT_ROWS_PER_S,
+         "sort_rows_per_sec", "sort_vs_baseline")
+    lane("scan", lambda: _bench_parquet_scan(np, session),
+         BASELINE_SCAN_ROWS_PER_S,
+         "parquet_scan_rows_per_sec", "scan_vs_baseline")
 
     print(json.dumps({
         "metric": "hash_agg_keys_rows_per_sec",
@@ -393,7 +501,7 @@ def child_main() -> None:
         "unit": "rows/s",
         "vs_baseline": round(agg_rows_per_s / BASELINE_AGG_ROWS_PER_S, 3),
         "backend": platform,
-        **q3,
+        **extras,
     }))
 
 

@@ -1010,7 +1010,10 @@ class Cast(Expression):
                     float(np.iinfo(np.int32).max)
                 sat = np.int64(np.iinfo(np.int32).max)
             out = xp.clip(t, lo, hi).astype(np.int64)
-            out = xp.where(t >= hi, sat, out)    # exact top-of-range value
+            # ONLY above-range values saturate: hi itself (e.g. the
+            # exactly-representable nextafter(2^63) for int64) converts
+            # exactly via astype, matching JVM (long)f
+            out = xp.where(t > hi, sat, out)
             return ExprValue(out.astype(to.np_dtype), v.valid)
         # numeric/bool → numeric: plain astype (truncating float→int like Spark)
         return ExprValue(v.data.astype(to.np_dtype), v.valid)

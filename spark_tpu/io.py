@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from . import types as T
-from .columnar import ColumnBatch
+from .columnar import ColumnBatch, ColumnVector
 from .expressions import AnalysisException
 from .sql import logical as L
 
@@ -201,10 +201,97 @@ def _infer_partition_column(raw: List[str]):
 # format readers (host side, arrow-backed)
 # ---------------------------------------------------------------------------
 
-def _read_parquet(paths: List[str], options) -> "Any":
+#: observable scan counters (ParquetReadBenchmark-style evidence that
+#: pruning/pushdown actually narrowed the read); reset freely in tests
+SCAN_STATS = {"files": 0, "row_groups": 0, "row_groups_skipped": 0,
+              "rows": 0, "columns_read": 0}
+
+
+def _rg_keep(pf, pushed: Optional[List[tuple]]) -> Optional[List[int]]:
+    """Row groups that MAY contain matching rows, by footer min/max stats.
+
+    ``pushed`` holds advisory ``(col, op, value)`` conjuncts; a row group
+    is skipped only when its stats PROVE no row satisfies a conjunct
+    (``ParquetFilters.scala`` + ``VectorizedParquetRecordReader`` role).
+    Returns None when nothing can be skipped (avoids the per-group read
+    path)."""
+    if not pushed:
+        return None
+    md = pf.metadata
+    name_to_idx = {md.schema.column(i).path: i
+                   for i in range(md.num_columns)}
+    keep: List[int] = []
+    skipped = 0
+    for rg in range(md.num_row_groups):
+        alive = True
+        for col, op, val in pushed:
+            ci = name_to_idx.get(col)
+            if ci is None:
+                continue
+            st = md.row_group(rg).column(ci).statistics
+            if st is None or not st.has_min_max:
+                continue
+            try:
+                lo, hi = st.min, st.max
+                if isinstance(val, str) and isinstance(lo, bytes):
+                    lo, hi = lo.decode("utf-8", "replace"), \
+                        hi.decode("utf-8", "replace")
+                if type(lo) is not type(val) and not (
+                        isinstance(lo, (int, float))
+                        and isinstance(val, (int, float))):
+                    continue
+                if (op == "==" and (val < lo or val > hi)) \
+                        or (op == "<" and lo >= val) \
+                        or (op == "<=" and lo > val) \
+                        or (op == ">" and hi <= val) \
+                        or (op == ">=" and hi < val):
+                    alive = False
+                    break
+            except Exception:
+                continue
+        if alive:
+            keep.append(rg)
+        else:
+            skipped += 1
+    SCAN_STATS["row_groups_skipped"] += skipped
+    return keep if skipped else None
+
+
+def _open_pruned(path: str, columns, pushed):
+    """Open one parquet file for a pruned/pushed read: returns
+    ``(pf, present, keep)`` and updates SCAN_STATS — the single definition
+    behind both the eager and streaming scan paths."""
+    import pyarrow.parquet as pq
+    pf = pq.ParquetFile(path)
+    present = None
+    if columns is not None:
+        names = set(pf.schema_arrow.names)
+        present = [c for c in columns if c in names]
+    SCAN_STATS["files"] += 1
+    SCAN_STATS["columns_read"] += len(present) if present is not None \
+        else pf.metadata.num_columns
+    keep = _rg_keep(pf, pushed)
+    SCAN_STATS["row_groups"] += pf.metadata.num_row_groups
+    return pf, present, keep
+
+
+def _read_parquet(paths: List[str], options, columns=None,
+                  pushed=None) -> "Any":
     import pyarrow.parquet as pq
     import pyarrow as pa
-    tables = [pq.read_table(p) for p in paths]
+    tables = []
+    for p in paths:
+        pf, present, keep = _open_pruned(p, columns, pushed)
+        if keep is None:
+            t = pq.read_table(p, columns=present)
+        elif keep:
+            t = pf.read_row_groups(keep, columns=present)
+        else:
+            t = pf.schema_arrow.empty_table()
+            if present is not None:
+                t = t.select(present)
+        SCAN_STATS["rows"] += t.num_rows
+        tables.append(t)
     return pa.concat_tables(tables, promote_options="permissive")
 
 
@@ -255,19 +342,61 @@ _READERS = {
 }
 
 
+def _parquet_schema(raw_paths: List[str]) -> T.StructType:
+    """Engine schema from parquet FOOTERS + partition directories — no data
+    pages are read (the lazy half of ``DataSource.resolveRelation``)."""
+    import pyarrow.parquet as pq
+    files = _resolve_paths(raw_paths)
+    base = raw_paths[0] if isinstance(raw_paths, list) else raw_paths
+    base = base if os.path.isdir(base) else os.path.dirname(base)
+    fields: List[T.StructField] = []
+    seen: set = set()
+    for f in files:
+        for af in pq.ParquetFile(f).schema_arrow:
+            if af.name not in seen:
+                seen.add(af.name)
+                fields.append(T.StructField(af.name,
+                                            _arrow_to_engine(af.type), True))
+    part_vals: Dict[str, List[str]] = {}
+    for f in files:
+        for k, v in _partition_values(f, base).items():
+            part_vals.setdefault(k, []).append(v)
+    for k, vals in part_vals.items():
+        if k in seen:
+            continue
+        inferred = _infer_partition_column(vals)
+        dt = T.np_dtype_to_engine(inferred.dtype) \
+            if isinstance(inferred, np.ndarray) else T.string
+        fields.append(T.StructField(k, dt, True))
+    return T.StructType(fields)
+
+
 _relation_cache: Dict[Any, ColumnBatch] = {}
 
 
-def _load_batch(fmt: str, raw_paths: List[str], options: Dict[str, str]
-                ) -> ColumnBatch:
+def _load_batch(fmt: str, raw_paths: List[str], options: Dict[str, str],
+                columns: Optional[List[str]] = None,
+                pushed: Optional[List[tuple]] = None) -> ColumnBatch:
     files = _resolve_paths(raw_paths)
     key = (fmt, tuple(files), tuple(sorted(options.items())),
-           tuple(os.path.getmtime(f) for f in files))
+           tuple(os.path.getmtime(f) for f in files),
+           None if columns is None else tuple(columns),
+           None if pushed is None else tuple(pushed))
     if key in _relation_cache:
         return _relation_cache[key]
-    reader = _READERS.get(fmt)
-    if reader is None:
+    base_reader = _READERS.get(fmt)
+    if base_reader is None:
         raise AnalysisException(f"unsupported format: {fmt}")
+    if fmt == "parquet":
+        def reader(paths, opts):
+            return _read_parquet(paths, opts, columns=columns, pushed=pushed)
+    elif columns is not None:
+        def reader(paths, opts):
+            t = base_reader(paths, opts)
+            sel = [c for c in columns if c in t.column_names]
+            return t.select(sel)
+    else:
+        reader = base_reader
     # group files by partition values (from the first existing base dir)
     base = raw_paths[0] if isinstance(raw_paths, list) else raw_paths
     base = base if os.path.isdir(base) else os.path.dirname(base)
@@ -275,7 +404,7 @@ def _load_batch(fmt: str, raw_paths: List[str], options: Dict[str, str]
     part_keys: List[str] = []
     for f in files:
         for k in part_of[f]:
-            if k not in part_keys:
+            if k not in part_keys and (columns is None or k in columns):
                 part_keys.append(k)
     table = reader(files, options)
     extra = None
@@ -297,7 +426,9 @@ def _load_batch(fmt: str, raw_paths: List[str], options: Dict[str, str]
 
 
 def read_file_relation(rel: L.FileRelation, session) -> ColumnBatch:
-    return _load_batch(rel.fmt, rel.paths, rel.options)
+    return _load_batch(rel.fmt, rel.paths, rel.options,
+                       columns=getattr(rel, "columns", None),
+                       pushed=getattr(rel, "pushed_filters", None))
 
 
 # ---------------------------------------------------------------------------
@@ -340,19 +471,34 @@ def scan_file_batches(rel: L.FileRelation, batch_rows: int):
     files = _resolve_paths(rel.paths)
     base = rel.paths[0] if isinstance(rel.paths, list) else rel.paths
     base = base if os.path.isdir(base) else os.path.dirname(base)
+    columns = getattr(rel, "columns", None)
+    pushed = getattr(rel, "pushed_filters", None)
     if rel.fmt == "parquet":
         import pyarrow as pa
         import pyarrow.parquet as pq
+        yielded = False
         for f in files:
             pvals = _partition_values(f, base)
-            pf = pq.ParquetFile(f)
-            for rb in pf.iter_batches(batch_size=batch_rows):
+            if columns is not None:
+                pvals = {k: v for k, v in pvals.items() if k in columns}
+            pf, present, keep = _open_pruned(f, columns, pushed)
+            kw = {} if keep is None else {"row_groups": keep}
+            if keep == []:
+                continue
+            for rb in pf.iter_batches(batch_size=batch_rows,
+                                      columns=present, **kw):
                 table = pa.Table.from_batches([rb])
+                SCAN_STATS["rows"] += table.num_rows
                 extra = {k: _infer_partition_column([v] * table.num_rows)
                          for k, v in pvals.items()} or None
+                yielded = True
                 yield _table_to_batch(table, extra)
+        if not yielded:
+            # every row group was skipped: emit one empty batch so stage
+            # runners still see the (pruned) schema
+            yield ColumnBatch.empty(rel.schema())
         return
-    whole = _load_batch(rel.fmt, rel.paths, rel.options)
+    whole = _load_batch(rel.fmt, rel.paths, rel.options, columns=columns)
     n = int(np.asarray(whole.num_rows()))
     # the cached batch is compacted on load (row_valid all-true prefix)
     for start in range(0, max(n, 1), batch_rows):
@@ -489,8 +635,15 @@ class DataFrameReader:
         if path is None:
             raise AnalysisException("load() requires a path")
         paths = [path] if isinstance(path, str) else list(path)
-        batch = _load_batch(self._fmt, paths, self._options)
-        rel = L.FileRelation(self._fmt, paths, batch.schema, self._options)
+        if self._schema is not None:
+            schema = self._schema
+        elif self._fmt == "parquet":
+            # schema from footers only — a wide table must not be READ to
+            # be *referenced*; pruning decides what the query's scan loads
+            schema = _parquet_schema(paths)
+        else:
+            schema = _load_batch(self._fmt, paths, self._options).schema
+        rel = L.FileRelation(self._fmt, paths, schema, self._options)
         return DataFrame(self._session, rel)
 
     def parquet(self, *paths) -> "Any":

@@ -258,15 +258,19 @@ class DeviceCacheManager:
                 if entry.batch is None:       # decompress ONCE; keep the
                     entry.batch = _decompress_batch(entry.blocks)  # host copy
                 batch = entry.batch
-                # promote back toward the requested level opportunistically
-                if entry.requested == StorageLevel.DEVICE and \
-                        self._memory.try_acquire_storage(key, batch_nbytes(batch)):
-                    entry.batch = batch.to_device()
-                    entry.blocks = None
-                    entry.level = StorageLevel.DEVICE
-                    entry.nbytes = batch_nbytes(batch)
-                    batch = entry.batch
             else:
+                batch = entry.batch
+            # promote back toward the requested level opportunistically —
+            # BOTH for decompressed blocks and for entries that were put()
+            # straight to HOST because HBM was full at the time
+            if entry.level != StorageLevel.DEVICE \
+                    and entry.requested == StorageLevel.DEVICE \
+                    and self._memory.try_acquire_storage(
+                        key, batch_nbytes(batch)):
+                entry.batch = batch.to_device()
+                entry.blocks = None
+                entry.level = StorageLevel.DEVICE
+                entry.nbytes = batch_nbytes(batch)
                 batch = entry.batch
             # every object served under this key carries the SAME uid, so
             # plan keys built over a cached batch (cache-on-cache) stay

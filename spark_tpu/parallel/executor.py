@@ -268,23 +268,27 @@ class DistributedExecution:
 
 
     def _shard_leaf(self, batch: ColumnBatch) -> ColumnBatch:
-        """Pad a host batch so rows split evenly over shards, then device_put
-        with row sharding."""
-        per = pad_capacity(max(-(-batch.capacity // self.n), 1))
-        total = per * self.n
-        sharding = NamedSharding(self.mesh, PartitionSpec(DATA_AXIS))
+        return shard_leaf(self.mesh, self.n, batch)
 
-        def pad_and_put(arr, fill=0):
-            a = np.asarray(arr)
-            if len(a) < total:
-                pad = np.full(total - len(a), fill, dtype=a.dtype)
-                a = np.concatenate([a, pad])
-            return jax.device_put(a, sharding)
 
-        vectors = []
-        for v in batch.vectors:
-            data = pad_and_put(v.data)
-            valid = None if v.valid is None else pad_and_put(v.valid, False)
-            vectors.append(ColumnVector(data, v.dtype, valid, v.dictionary))
-        rv = pad_and_put(np.asarray(batch.row_valid_or_true()), False)
-        return ColumnBatch(batch.names, vectors, rv, total)
+def shard_leaf(mesh: Mesh, n: int, batch: ColumnBatch) -> ColumnBatch:
+    """Pad a host batch so rows split evenly over shards, then device_put
+    with row sharding."""
+    per = pad_capacity(max(-(-batch.capacity // n), 1))
+    total = per * n
+    sharding = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+
+    def pad_and_put(arr, fill=0):
+        a = np.asarray(arr)
+        if len(a) < total:
+            pad = np.full(total - len(a), fill, dtype=a.dtype)
+            a = np.concatenate([a, pad])
+        return jax.device_put(a, sharding)
+
+    vectors = []
+    for v in batch.vectors:
+        data = pad_and_put(v.data)
+        valid = None if v.valid is None else pad_and_put(v.valid, False)
+        vectors.append(ColumnVector(data, v.dtype, valid, v.dictionary))
+    rv = pad_and_put(np.asarray(batch.row_valid_or_true()), False)
+    return ColumnBatch(batch.names, vectors, rv, total)

@@ -466,8 +466,11 @@ class MultiBatchExecution:
         self.capacity = pad_capacity(batch_rows)
 
     # -- per-batch device step -------------------------------------------
-    def _build_step(self, template: ColumnBatch):
-        """(jitted step fn, spine output schema) for one padded scan batch."""
+    def _step_physical(self, template: ColumnBatch
+                       ) -> Tuple[P.PhysicalPlan, T.StructType]:
+        """Physical spine + breaker-partial for one scan batch — ONE
+        definition shared by the local and sharded steps so the two paths
+        cannot diverge in breaker mapping."""
         planner = Planner(self.session)
         node: L.LogicalPlan = L.LocalRelation(template)
         for op in self.dec.spine:
@@ -490,6 +493,11 @@ class MultiBatchExecution:
         elif isinstance(breaker, L.Limit):
             phys = P.PLimit(breaker.n, phys)
         planner._assign_op_ids(phys, [1])
+        return phys, spine_schema
+
+    def _build_step(self, template: ColumnBatch):
+        """(jitted step fn, spine output schema) for one padded scan batch."""
+        phys, spine_schema = self._step_physical(template)
 
         def step(leaf):
             ctx = P.ExecContext(jnp, [leaf])
@@ -498,6 +506,11 @@ class MultiBatchExecution:
             return c, c.num_rows()
 
         return jax.jit(step), spine_schema
+
+    # -- per-batch transfer + host-ification (overridden when sharded) ---
+    def _run_batch(self, jstep, b: ColumnBatch) -> List[ColumnBatch]:
+        out_dev, n = jstep(b.to_device())
+        return [_slice_to_host(out_dev, int(np.asarray(n)))]
 
     # -- merger selection ------------------------------------------------
     def _make_merger(self, spine_schema: T.StructType,
@@ -627,9 +640,12 @@ class MultiBatchExecution:
                 n_batches += 1
                 if n_batches <= skip:
                     continue             # already folded into the merger
-                out_dev, n = jstep(b.to_device())
-                host = _slice_to_host(out_dev, int(np.asarray(n)))
-                if not merger.add(host):
+                more = True
+                for host in self._run_batch(jstep, b):
+                    if not merger.add(host):
+                        more = False
+                        break
+                if not more:
                     _log.info("multi-batch scan early exit after %d batches",
                               n_batches)
                     break
@@ -685,12 +701,67 @@ class MultiBatchExecution:
         return compact(np, out.to_host())
 
 
-def plan_multibatch(session, optimized: L.LogicalPlan
+class DistributedMultiBatchExecution(MultiBatchExecution):
+    """Multi-batch streaming COMPOSED with the data mesh: every scan batch
+    is row-sharded over the mesh and runs the spine + breaker-partial step
+    as one ``shard_map`` program; per-shard results merge across batches
+    through the same host mergers.
+
+    The reference analog is a ``ShuffledRowRDD`` stage that is
+    simultaneously out-of-core and distributed
+    (``execution/exchange/ShuffleExchange.scala:38`` over
+    ``ShuffledRowRDD:113``): here the scan streams (out-of-core), the
+    per-batch compute is SPMD over the mesh, and the cross-batch merge
+    happens in host memory.  Per-shard breaker outputs (sorted runs,
+    partial-agg buffers, per-shard distincts/limits) are added to the
+    merger as INDEPENDENT runs, which every merger already supports."""
+
+    def __init__(self, session, dec: _Decomposed, batch_rows: int, mesh):
+        super().__init__(session, dec, batch_rows)
+        from ..parallel.mesh import mesh_shards
+        self.mesh = mesh
+        self.n = mesh_shards(mesh)
+
+    def _build_step(self, template: ColumnBatch):
+        from jax.sharding import PartitionSpec
+        from jax import shard_map
+        from ..parallel.mesh import DATA_AXIS
+
+        phys, spine_schema = self._step_physical(template)
+
+        def shard_fn(leaf):
+            ctx = P.ExecContext(jnp, [leaf])
+            out = phys.run(ctx)
+            return compact(jnp, out)
+
+        wrapped = shard_map(
+            shard_fn, mesh=self.mesh,
+            in_specs=(PartitionSpec(DATA_AXIS),),
+            out_specs=PartitionSpec(DATA_AXIS),
+            check_vma=False,
+        )
+        return jax.jit(wrapped), spine_schema
+
+    def _run_batch(self, jstep, b: ColumnBatch) -> List[ColumnBatch]:
+        from ..io import _slice_rows
+        from ..parallel.executor import shard_leaf
+        out = jstep(shard_leaf(self.mesh, self.n, b)).to_host()
+        per = out.capacity // self.n
+        runs = []
+        for i in range(self.n):
+            run = _slice_rows(out, i * per, (i + 1) * per)
+            if int(np.asarray(run.num_rows())):
+                runs.append(run)
+        return runs
+
+
+def plan_multibatch(session, optimized: L.LogicalPlan, mesh=None
                     ) -> Optional[MultiBatchExecution]:
     """Decide whether a query takes the multi-batch path.
 
     Conditions: enabled, the plan decomposes into scan→spine→breaker→above
-    over a single FileRelation, and the file exceeds one batch."""
+    over a single FileRelation, and the file exceeds one batch.  With a
+    ``mesh``, the per-batch step runs sharded over it."""
     if not session.conf.get(C.MULTIBATCH_ENABLED):
         return None
     dec = _decompose(optimized)
@@ -704,6 +775,9 @@ def plan_multibatch(session, optimized: L.LogicalPlan
         return None
     if total is None or total <= batch_rows:
         return None
-    _log.info("multi-batch path: %d rows > %d rows/batch (%s)",
-              total, batch_rows, dec.rel)
+    _log.info("multi-batch path: %d rows > %d rows/batch (%s)%s",
+              total, batch_rows, dec.rel,
+              "" if mesh is None else f" sharded over {mesh}")
+    if mesh is not None:
+        return DistributedMultiBatchExecution(session, dec, batch_rows, mesh)
     return MultiBatchExecution(session, dec, batch_rows)

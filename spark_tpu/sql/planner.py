@@ -334,8 +334,16 @@ class QueryExecution:
         if n_shards > 1:
             from ..parallel.executor import DistributedExecution
             from ..parallel.mesh import get_mesh
+            mesh = get_mesh(n_shards)
+            # out-of-core × distributed: oversized linear file chains
+            # stream per-batch through a shard_map step (ShuffledRowRDD
+            # stages are simultaneously out-of-core and distributed)
+            from .multibatch import plan_multibatch
+            mb = plan_multibatch(self.session, self.optimized, mesh=mesh)
+            if mb is not None:
+                return mb.execute()
             return DistributedExecution(
-                self.session, get_mesh(n_shards)).execute(self.optimized)
+                self.session, mesh).execute(self.optimized)
 
         # out-of-core path: file scans larger than one device batch stream
         # through the multi-batch stage runner (FileScanRDD/ExternalSorter
@@ -344,6 +352,17 @@ class QueryExecution:
         mb = plan_multibatch(self.session, self.optimized)
         if mb is not None:
             return mb.execute()
+
+        # multi-relation out-of-core path: plans with joins over oversized
+        # file relations stream through the stage DAG (grace hash joins +
+        # broadcast-fused streams); non-streamable shapes fall back here
+        from .stages import NotStreamable, plan_stages
+        st = plan_stages(self.session, self.optimized)
+        if st is not None:
+            try:
+                return st.execute()
+            except NotStreamable as e:
+                _log.info("stage runner fallback to eager: %s", e)
 
         base_key = "local:" + self.planned.physical.key()
         factors = self.session._adapted_factors.get(base_key)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import logging
 import os
 import pickle
 import threading
@@ -37,6 +38,8 @@ from ..aggregates import (
 from ..columnar import ColumnBatch, ColumnVector
 from ..expressions import AnalysisException, Col, EvalContext
 from ..kernels import compact, union_all
+
+_log = logging.getLogger("spark_tpu.streaming")
 from ..sql import logical as L
 
 __all__ = [
@@ -89,6 +92,10 @@ class Source:
     def restore_offset_metadata(self, start: Optional[int], end: int,
                                 meta: dict) -> None:
         """Rebuild in-memory offset state from a WAL entry on recovery."""
+
+    def commit(self, end: int) -> None:
+        """Offsets ≤ end are durably committed; the source may release
+        buffered data below them (``Source.commit`` in the reference)."""
 
 
 class MemoryStream(Source):
@@ -1036,6 +1043,10 @@ class StreamExecution:
             "processedRowsPerSecond": n_rows / max(time.time() - t0, 1e-9),
         })
         self.committed_offset = end
+        try:
+            self.source.commit(end)
+        except Exception:
+            _log.warning("source.commit(%s) failed", end, exc_info=True)
         self.batch_id += 1
         return True
 
@@ -1284,6 +1295,7 @@ class SocketSource(Source):
         import socket as _socket
         self._schema = T.StructType([T.StructField("value", T.string)])
         self._lines: List[str] = []
+        self._base = 0              # absolute offset of _lines[0]
         self._lock = threading.Lock()
         self._sock = _socket.create_connection((host, port), timeout=10)
         self._stopped = threading.Event()
@@ -1312,15 +1324,24 @@ class SocketSource(Source):
 
     def get_offset(self) -> Optional[int]:
         with self._lock:
-            return len(self._lines) or None
+            return (self._base + len(self._lines)) or None
 
     def get_batch(self, start, end) -> ColumnBatch:
         s = start or 0
         with self._lock:
-            rows = self._lines[s:end]
+            rows = self._lines[max(s - self._base, 0):end - self._base]
         return ColumnBatch.from_arrays(
             {"value": rows}, schema=self._schema) if rows \
             else ColumnBatch.empty(self._schema)
+
+    def commit(self, end: int) -> None:
+        """Drop committed lines — a long-running socket stream must not
+        grow host memory without bound; offsets stay absolute via _base."""
+        with self._lock:
+            drop = min(max(end - self._base, 0), len(self._lines))
+            if drop:
+                del self._lines[:drop]
+                self._base += drop
 
     def stop(self) -> None:
         self._stopped.set()

@@ -34,11 +34,13 @@ class GroupState:
     wall-clock timers don't replay deterministically; event-time ones do)."""
 
     def __init__(self, value: Any = None, exists: bool = False,
-                 timed_out: bool = False, watermark_us: Optional[int] = None):
+                 timed_out: bool = False, watermark_us: Optional[int] = None,
+                 timeout_conf: str = NO_TIMEOUT):
         self._value = value
         self._exists = exists
         self._timed_out = timed_out
         self._watermark_us = watermark_us
+        self._timeout_conf = timeout_conf
         self._removed = False
         self._updated = False
         self._timeout_us: Optional[int] = None
@@ -78,7 +80,17 @@ class GroupState:
 
     def setTimeoutTimestamp(self, timestamp_us: int) -> None:
         """Event-time timeout: once the watermark passes this, the function
-        is invoked with hasTimedOut=True and no rows."""
+        is invoked with hasTimedOut=True and no rows.
+
+        Rejected unless the query enabled EventTimeTimeout — the reference
+        throws UnsupportedOperationException here rather than persisting a
+        timeout that can never fire (`GroupStateImpl.scala`)."""
+        if self._timeout_conf != EVENT_TIME_TIMEOUT:
+            raise AnalysisException(
+                "setTimeoutTimestamp requires "
+                "timeoutConf=GroupStateTimeout.EventTimeTimeout on "
+                "flatMapGroupsWithState; this query was started with "
+                f"{self._timeout_conf}")
         if self._watermark_us is not None and timestamp_us <= self._watermark_us:
             raise ValueError(
                 f"timeout timestamp {timestamp_us} must be later than the "
@@ -125,7 +137,8 @@ def run_flat_map_groups(
     def invoke(key, rows, timed_out):
         value, _old_to = states.get(key, (None, None))
         st = GroupState(value=value, exists=key in states,
-                        timed_out=timed_out, watermark_us=watermark_us)
+                        timed_out=timed_out, watermark_us=watermark_us,
+                        timeout_conf=timeout_conf)
         result = func(key, rows, st)
         for row in (result or []):
             row = tuple(row)

@@ -1,0 +1,137 @@
+"""Multi-batch streaming COMPOSED with the 8-device mesh (VERDICT r2 #3).
+
+Every scan batch is row-sharded over the virtual mesh and runs the
+spine + breaker-partial step as one shard_map program; per-shard partials
+merge across batches host-side — the ShuffledRowRDD property of being
+simultaneously out-of-core and distributed
+(`execution/exchange/ShuffleExchange.scala:38`, `ShuffledRowRDD:113`).
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+
+import spark_tpu.config as C
+from spark_tpu.sql import functions as F
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+BATCH = 256
+N = 2000
+
+
+@pytest.fixture(scope="module")
+def bigfile(tmp_path_factory):
+    rng = np.random.default_rng(21)
+    pdf = pd.DataFrame({
+        "id": np.arange(N, dtype=np.int64),
+        "grp": rng.choice(["ash", "oak", "elm", "fir"], N),
+        "x": rng.normal(10.0, 5.0, N),
+        "k": rng.integers(0, 50, N).astype(np.int64),
+    })
+    d = tmp_path_factory.mktemp("mbd") / "big.parquet"
+    os.makedirs(d)
+    step = N // 4
+    for i in range(4):
+        pdf.iloc[i * step:(i + 1) * step].to_parquet(
+            d / f"part-{i:03d}.parquet", index=False)
+    return str(d), pdf
+
+
+@pytest.fixture()
+def dmb(spark):
+    old = spark.conf.get(C.SCAN_MAX_BATCH_ROWS)
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(BATCH))
+    spark.conf.set("spark.tpu.mesh.shards", "8")
+    yield spark
+    spark.conf.set("spark.tpu.mesh.shards", "1")
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(old))
+
+
+def test_uses_sharded_multibatch(dmb, bigfile):
+    from spark_tpu.parallel.mesh import get_mesh
+    from spark_tpu.sql.multibatch import (
+        DistributedMultiBatchExecution, plan_multibatch,
+    )
+    from spark_tpu.sql.planner import QueryExecution
+    path, _ = bigfile
+    df = dmb.read.parquet(path).groupBy("grp").agg(F.sum("x"))
+    qe = QueryExecution(dmb, df._plan)
+    mb = plan_multibatch(dmb, qe.optimized, mesh=get_mesh(8))
+    assert isinstance(mb, DistributedMultiBatchExecution)
+
+
+def test_sharded_groupby_agg(dmb, bigfile):
+    path, pdf = bigfile
+    df = (dmb.read.parquet(path).groupBy("grp")
+          .agg(F.sum("x").alias("sx"), F.count("x").alias("c"),
+               F.min("k").alias("mn"), F.max("x").alias("mx")))
+    got = {r[0]: r[1:] for r in df.collect()}
+    exp = pdf.groupby("grp").agg(sx=("x", "sum"), c=("x", "count"),
+                                 mn=("k", "min"), mx=("x", "max"))
+    assert set(got) == set(exp.index)
+    for g, row in exp.iterrows():
+        np.testing.assert_allclose(got[g], row.to_numpy(), rtol=1e-12)
+
+
+def test_sharded_global_agg(dmb, bigfile):
+    path, pdf = bigfile
+    (s, c), = dmb.read.parquet(path).agg(
+        F.sum("k").alias("s"), F.count("x").alias("c")).collect()
+    assert (s, c) == (int(pdf.k.sum()), N)
+
+
+def test_sharded_string_minmax(dmb, bigfile):
+    path, pdf = bigfile
+    df = dmb.read.parquet(path).groupBy("k").agg(
+        F.min("grp").alias("mn"), F.max("grp").alias("mx"))
+    got = {r[0]: (r[1], r[2]) for r in df.collect()}
+    exp = pdf.groupby("k").agg(mn=("grp", "min"), mx=("grp", "max"))
+    assert got == {k: (r.mn, r.mx) for k, r in exp.iterrows()}
+
+
+def test_sharded_sort_topk(dmb, bigfile):
+    path, pdf = bigfile
+    df = dmb.read.parquet(path).orderBy(F.col("x").desc()).limit(23)
+    got = [r[0] for r in df.collect()]
+    exp = pdf.sort_values("x", ascending=False).head(23).id.tolist()
+    assert got == exp
+
+
+def test_sharded_global_sort(dmb, bigfile):
+    path, pdf = bigfile
+    got = [r[0] for r in
+           dmb.read.parquet(path).select("id").orderBy(
+               F.col("id").desc()).collect()]
+    assert got == sorted(pdf.id.tolist(), reverse=True)
+
+
+def test_sharded_distinct(dmb, bigfile):
+    path, pdf = bigfile
+    got = sorted(r[0] for r in
+                 dmb.read.parquet(path).select("grp").distinct().collect())
+    assert got == sorted(pdf.grp.unique())
+
+
+def test_sharded_limit(dmb, bigfile):
+    path, _ = bigfile
+    assert len(dmb.read.parquet(path).limit(37).collect()) == 37
+
+
+def test_sharded_matches_local(dmb, bigfile):
+    """Same query, sharded-multibatch vs single-shard multibatch."""
+    path, _ = bigfile
+    q = (dmb.read.parquet(path).filter(F.col("k") < 25)
+         .groupBy("grp").agg(F.avg("x").alias("a"),
+                             F.sum("k").alias("sk")))
+    got_dist = sorted(map(tuple, q.collect()))
+    dmb.conf.set("spark.tpu.mesh.shards", "1")
+    got_local = sorted(map(tuple, q.collect()))
+    assert [g[0] for g in got_dist] == [g[0] for g in got_local]
+    np.testing.assert_allclose(
+        [g[1:] for g in got_dist], [g[1:] for g in got_local], rtol=1e-12)

@@ -1,0 +1,147 @@
+"""Parquet column pruning + row-group predicate pushdown (VERDICT r2 #4).
+
+The FileSourceStrategy/ParquetFilters story: plans read ONLY the columns
+they consume (`execution/datasources/FileSourceStrategy.scala`), and
+`col op literal` conjuncts skip row groups by footer min/max stats
+(`parquet/ParquetFilters.scala`) — asserted through io.SCAN_STATS, with
+results validated against the unpruned path.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_tpu.config as C
+from spark_tpu import io as tio
+from spark_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def wide(tmp_path):
+    """A 12-column table written with small row groups, sorted by `ord` so
+    min/max stats are selective."""
+    n = 4000
+    rng = np.random.default_rng(3)
+    pdf = pd.DataFrame({"ord": np.arange(n, dtype=np.int64)})
+    for i in range(8):
+        pdf[f"pad{i}"] = rng.normal(size=n)
+    pdf["grp"] = rng.choice(["a", "b", "c"], n)
+    pdf["val"] = rng.integers(0, 100, n).astype(np.int64)
+    d = tmp_path / "wide.parquet"
+    os.makedirs(d)
+    pdf.to_parquet(d / "part-000.parquet", index=False, row_group_size=500)
+    return str(d), pdf
+
+
+def _reset():
+    for k in tio.SCAN_STATS:
+        tio.SCAN_STATS[k] = 0
+
+
+def test_column_pruning_eager(spark, wide):
+    path, pdf = wide
+    tio._relation_cache.clear()
+    _reset()
+    df = (spark.read.parquet(path)
+          .groupBy("grp").agg(F.sum("val").alias("sv")))
+    got = {r[0]: r[1] for r in df.collect()}
+    exp = pdf.groupby("grp").val.sum()
+    assert got == exp.to_dict()
+    # the scan must have read only grp+val, not the 12-column table
+    assert tio.SCAN_STATS["columns_read"] == 2
+
+
+def test_pruned_plan_marks_relation(spark, wide):
+    from spark_tpu.sql.logical import FileRelation
+    from spark_tpu.sql.planner import QueryExecution
+    path, _ = wide
+    df = spark.read.parquet(path).select("ord").filter(F.col("ord") < 10)
+    qe = QueryExecution(spark, df._plan)
+
+    rels = []
+
+    def walk(n):
+        if isinstance(n, FileRelation):
+            rels.append(n)
+        for c in n.children:
+            walk(c)
+    walk(qe.optimized)
+    assert rels and rels[0].columns == ["ord"]
+    assert ("ord", "<", 10) in (rels[0].pushed_filters or [])
+
+
+def test_rowgroup_skip_eager(spark, wide):
+    path, pdf = wide
+    tio._relation_cache.clear()
+    _reset()
+    df = spark.read.parquet(path).filter(F.col("ord") >= 3500) \
+        .agg(F.count("ord").alias("n"), F.sum("val").alias("s"))
+    (n, s), = df.collect()
+    assert n == 500
+    assert s == int(pdf[pdf.ord >= 3500].val.sum())
+    assert tio.SCAN_STATS["row_groups_skipped"] == 7
+    assert tio.SCAN_STATS["rows"] == 500
+
+
+def test_rowgroup_skip_streamed(spark, wide):
+    path, pdf = wide
+    old = spark.conf.get(C.SCAN_MAX_BATCH_ROWS)
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, "600")
+    try:
+        tio._relation_cache.clear()
+        _reset()
+        df = spark.read.parquet(path).filter(F.col("ord") < 1000) \
+            .groupBy("grp").agg(F.count("val").alias("n"))
+        got = {r[0]: r[1] for r in df.collect()}
+        sub = pdf[pdf.ord < 1000]
+        assert got == sub.groupby("grp").val.count().to_dict()
+        assert tio.SCAN_STATS["row_groups_skipped"] == 6
+        # streamed scan read only the pruned columns
+        assert tio.SCAN_STATS["columns_read"] == 3
+    finally:
+        spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(old))
+
+
+def test_pushdown_never_changes_results(spark, wide):
+    """Stats skipping is advisory; equality band + string filter survive."""
+    path, pdf = wide
+    df = (spark.read.parquet(path)
+          .filter((F.col("ord") >= 777) & (F.col("ord") < 1234)
+                  & (F.col("grp") == "b"))
+          .agg(F.count("ord").alias("n")))
+    (n,), = df.collect()
+    exp = pdf[(pdf.ord >= 777) & (pdf.ord < 1234) & (pdf.grp == "b")]
+    assert n == len(exp)
+
+
+def test_all_groups_skipped(spark, wide):
+    path, _ = wide
+    df = spark.read.parquet(path).filter(F.col("ord") < 0)
+    assert df.count() == 0
+
+
+def test_window_inputs_survive_pruning(spark, wide):
+    """WindowExpression refs live in sub_expressions(), not children —
+    pruning must keep the window's partition/order/input columns."""
+    from spark_tpu.sql.window import Window
+    path, pdf = wide
+    df = (spark.read.parquet(path)
+          .select(F.col("ord"),
+                  F.sum("val").over(
+                      Window.partitionBy("grp")).alias("sv"))
+          .orderBy("ord").limit(5))
+    got = [(r[0], r[1]) for r in df.collect()]
+    gsum = pdf.groupby("grp").val.sum()
+    exp = [(int(r.ord), int(gsum[r.grp]))
+           for r in pdf.sort_values("ord").head(5).itertuples()]
+    assert got == exp
+
+
+def test_count_star_reads_narrow_column(spark, wide):
+    path, pdf = wide
+    tio._relation_cache.clear()
+    _reset()
+    assert spark.read.parquet(path).count() == len(pdf)
+    assert tio.SCAN_STATS["columns_read"] == 1

@@ -1,0 +1,238 @@
+"""Multi-stage out-of-core execution: grace joins + broadcast-fused streams.
+
+VERDICT r2 #2: joins and multi-stage plans over datasets several times one
+device batch must match a pandas oracle — the DAGScheduler/SortMergeJoin/
+ExternalAppendOnlyMap story (`scheduler/DAGScheduler.scala:114`,
+`execution/joins/SortMergeJoinExec.scala:36`) at the stage-runner level.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_tpu.config as C
+from spark_tpu.sql import functions as F
+
+BATCH = 256          # rows per streamed batch (tiny for tests)
+NFACT = 1100         # > 4 batches
+
+
+def _fact(seed=11, n=NFACT):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "sk": np.arange(n, dtype=np.int64),
+        "item_k": rng.integers(0, 40, n).astype(np.int64),
+        "date_k": rng.integers(0, 30, n).astype(np.int64),
+        "qty": rng.integers(1, 9, n).astype(np.int64),
+        "price": rng.normal(25.0, 9.0, n),
+    })
+
+
+def _write(dirpath, pdf, parts=4):
+    os.makedirs(dirpath)
+    step = (len(pdf) + parts - 1) // parts
+    for i in range(parts):
+        pdf.iloc[i * step:(i + 1) * step].to_parquet(
+            os.path.join(dirpath, f"part-{i:03d}.parquet"), index=False)
+    return str(dirpath)
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    base = tmp_path_factory.mktemp("stages")
+    fact = _fact()
+    rng = np.random.default_rng(5)
+    item = pd.DataFrame({
+        "item_k": np.arange(40, dtype=np.int64),
+        "brand": [f"brand#{i % 7}" for i in range(40)],
+        "cat": rng.choice(["sports", "music", "home"], 40),
+    })
+    date = pd.DataFrame({
+        "date_k": np.arange(30, dtype=np.int64),
+        "moy": (np.arange(30, dtype=np.int64) % 12) + 1,
+        "year": 2000 + (np.arange(30, dtype=np.int64) // 12),
+    })
+    rets = pd.DataFrame({
+        "ret_sk": _fact(seed=23, n=900).sk.sample(
+            900, random_state=3).to_numpy()[:900],
+        "ret_qty": np.random.default_rng(9).integers(1, 5, 900).astype(
+            np.int64),
+    })
+    paths = {
+        "fact": _write(base / "fact.parquet", fact),
+        "item": _write(base / "item.parquet", item, parts=1),
+        "date": _write(base / "date.parquet", date, parts=1),
+        "rets": _write(base / "rets.parquet", rets, parts=2),
+    }
+    return paths, {"fact": fact, "item": item, "date": date, "rets": rets}
+
+
+@pytest.fixture()
+def st(spark):
+    old = spark.conf.get(C.SCAN_MAX_BATCH_ROWS)
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(BATCH))
+    yield spark
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(old))
+
+
+def test_uses_stage_path(st, data):
+    from spark_tpu.sql.planner import QueryExecution
+    from spark_tpu.sql.stages import plan_stages
+    paths, _ = data
+    fact = st.read.parquet(paths["fact"])
+    item = st.read.parquet(paths["item"])
+    df = fact.join(item, on="item_k").groupBy("brand").agg(F.sum("qty"))
+    qe = QueryExecution(st, df._plan)
+    assert plan_stages(st, qe.optimized) is not None
+
+
+def test_q3_shape_star_join(st, data):
+    """fact ⋈ item ⋈ date + filter + group + order/limit — the q3 pattern
+    through broadcast-fused streams (TPCDSQueryBenchmark's q3 shape)."""
+    paths, pdfs = data
+    fact = st.read.parquet(paths["fact"])
+    item = st.read.parquet(paths["item"])
+    date = st.read.parquet(paths["date"])
+    df = (fact.join(item, on="item_k").join(date, on="date_k")
+          .filter(F.col("moy") == 11)
+          .groupBy("brand", "year")
+          .agg(F.sum(F.col("price") * F.col("qty")).alias("rev"))
+          .orderBy(F.col("rev").desc())
+          .limit(10))
+    got = df.collect()
+
+    m = (pdfs["fact"].merge(pdfs["item"], on="item_k")
+         .merge(pdfs["date"], on="date_k"))
+    m = m[m.moy == 11]
+    m["rev"] = m.price * m.qty
+    exp = (m.groupby(["brand", "year"], as_index=False).rev.sum()
+           .sort_values("rev", ascending=False).head(10))
+    assert [(r[0], r[1]) for r in got] == \
+        list(zip(exp.brand.tolist(), exp.year.tolist()))
+    np.testing.assert_allclose([r[2] for r in got], exp.rev.to_numpy(),
+                               rtol=1e-12)
+
+
+def _grace_sessions(spark):
+    """Force the grace path by making every relation oversized."""
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(BATCH))
+    return spark
+
+
+@pytest.mark.parametrize("how,phow", [
+    ("inner", "inner"), ("left", "left"), ("right", "right"),
+    ("full", "outer"),
+])
+def test_grace_join_big_big(st, data, how, phow):
+    """Both sides exceed a batch → grace hash join, all outer variants."""
+    paths, pdfs = data
+    fact = st.read.parquet(paths["fact"])
+    rets = st.read.parquet(paths["rets"])
+    df = fact.join(rets, on=F.col("sk") == F.col("ret_sk"), how=how)
+    got = sorted(df.collect(), key=lambda r: (
+        (r[0] is None, r[0]), (r[5] is None, r[5]), (r[6] is None, r[6])))
+
+    exp = pdfs["fact"].merge(pdfs["rets"], left_on="sk", right_on="ret_sk",
+                             how=phow)
+    exp = exp.sort_values(
+        ["sk", "ret_sk", "ret_qty"], na_position="last",
+        key=lambda s: s).reset_index(drop=True)
+    assert len(got) == len(exp)
+    got_sk = [r[0] for r in got]
+    exp_sk = [None if pd.isna(v) else int(v) for v in exp.sk]
+    assert got_sk == exp_sk
+    got_rq = [r[6] for r in got]
+    exp_rq = [None if pd.isna(v) else int(v) for v in exp.ret_qty]
+    assert got_rq == exp_rq
+
+
+@pytest.mark.parametrize("how", ["left_semi", "left_anti"])
+def test_grace_semi_anti(st, data, how):
+    paths, pdfs = data
+    fact = st.read.parquet(paths["fact"])
+    rets = st.read.parquet(paths["rets"])
+    df = fact.join(rets, on=F.col("sk") == F.col("ret_sk"), how=how)
+    got = sorted(r[0] for r in df.collect())
+    in_rets = pdfs["fact"].sk.isin(pdfs["rets"].ret_sk)
+    exp = pdfs["fact"].sk[in_rets if how == "left_semi" else ~in_rets]
+    assert got == sorted(exp.tolist())
+
+
+def test_grace_join_then_agg(st, data):
+    """q17 shape: big ⋈ big ⋈ small dims, then aggregate — the VERDICT r2
+    acceptance case (3-way join over >4× batch capacity vs oracle)."""
+    paths, pdfs = data
+    fact = st.read.parquet(paths["fact"])
+    rets = st.read.parquet(paths["rets"])
+    item = st.read.parquet(paths["item"])
+    df = (fact.join(rets, on=F.col("sk") == F.col("ret_sk"))
+          .join(item, on="item_k")
+          .groupBy("cat")
+          .agg(F.sum("ret_qty").alias("rq"), F.count("sk").alias("n"),
+               F.avg("price").alias("ap")))
+    got = {r[0]: r[1:] for r in df.collect()}
+
+    m = (pdfs["fact"].merge(pdfs["rets"], left_on="sk", right_on="ret_sk")
+         .merge(pdfs["item"], on="item_k"))
+    exp = m.groupby("cat").agg(rq=("ret_qty", "sum"), n=("sk", "count"),
+                               ap=("price", "mean"))
+    assert set(got) == set(exp.index)
+    for k, row in exp.iterrows():
+        np.testing.assert_allclose(got[k], row.to_numpy(), rtol=1e-12)
+
+
+def test_grace_skewed_single_key(st, data, tmp_path):
+    """Every row shares ONE join key on both sides: salting cannot split,
+    the chunked probe/build fallback must engage and stay exact."""
+    n = 600
+    left = pd.DataFrame({"k": np.zeros(n, np.int64),
+                         "a": np.arange(n, dtype=np.int64)})
+    right = pd.DataFrame({"k2": np.zeros(300, np.int64),
+                          "b": np.arange(300, dtype=np.int64)})
+    lp = _write(tmp_path / "skl.parquet", left)
+    rp = _write(tmp_path / "skr.parquet", right)
+    df = (st.read.parquet(lp)
+          .join(st.read.parquet(rp), on=F.col("k") == F.col("k2"))
+          .agg(F.count("a").alias("n"), F.sum("b").alias("sb")))
+    (cnt, sb), = df.collect()
+    assert cnt == n * 300
+    assert sb == n * int(right.b.sum())
+
+
+def test_grace_string_keys(st, data, tmp_path):
+    """String join keys across batch-local dictionaries."""
+    rng = np.random.default_rng(2)
+    n = 700
+    left = pd.DataFrame({
+        "w": rng.choice([f"word{i:03d}" for i in range(80)], n),
+        "a": np.arange(n, dtype=np.int64)})
+    right = pd.DataFrame({
+        "w2": [f"word{i:03d}" for i in range(0, 120, 2)],
+        "b": np.arange(60, dtype=np.int64)})
+    right = pd.concat([right] * 12, ignore_index=True)   # 720 rows: big side
+    lp = _write(tmp_path / "stl.parquet", left)
+    rp = _write(tmp_path / "str.parquet", right, parts=3)
+    df = (st.read.parquet(lp)
+          .join(st.read.parquet(rp), on=F.col("w") == F.col("w2")))
+    got = sorted((r[0], r[3]) for r in df.collect())
+    exp = left.merge(right, left_on="w", right_on="w2")
+    assert got == sorted(zip(exp.w.tolist(), exp.b.tolist()))
+
+
+def test_stream_above_breaker_filter(st, data):
+    """HAVING-style filter above the aggregation over a joined stream."""
+    paths, pdfs = data
+    fact = st.read.parquet(paths["fact"])
+    item = st.read.parquet(paths["item"])
+    df = (fact.join(item, on="item_k").groupBy("brand")
+          .agg(F.sum("qty").alias("q"))
+          .filter(F.col("q") > 100)
+          .orderBy("brand"))
+    got = df.collect()
+    m = pdfs["fact"].merge(pdfs["item"], on="item_k")
+    exp = m.groupby("brand", as_index=False).qty.sum()
+    exp = exp[exp.qty > 100].sort_values("brand")
+    assert [(r[0], r[1]) for r in got] == \
+        list(zip(exp.brand.tolist(), exp.qty.tolist()))
