@@ -1,0 +1,125 @@
+"""Mid-scale TPC-DS through the out-of-core stage runner.
+
+Generates an SF-scaled dataset (default 10M store_sales rows), writes the
+fact tables as multi-file parquet, and runs a query subset with the scan
+batch size forcing multi-batch streaming — the first evidence lane
+between the 120k-row suite oracle and the SF100 north star
+(`benchmark/TPCDSQueryBenchmark.scala:63,101` role).
+
+    python examples/tpcds_midscale.py [--rows 10000000] [--batch 2097152]
+        [--queries q3,q42,q55,q17] [--keep DIR] [--validate]
+
+--validate cross-checks results against the same queries on a sqlite
+oracle (slow at full scale; default off above 1M rows).
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+FACTS = {"store_sales", "catalog_sales", "web_sales", "store_returns",
+         "catalog_returns", "web_returns", "inventory"}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=10_000_000,
+                    help="store_sales rows (other facts scale off it)")
+    ap.add_argument("--batch", type=int, default=1 << 21,
+                    help="spark.tpu.scan.maxBatchRows")
+    ap.add_argument("--queries", default="q3,q42,q55,q17")
+    ap.add_argument("--keep", default=None,
+                    help="dataset dir to reuse/create (default: temp)")
+    ap.add_argument("--validate", action="store_true")
+    args = ap.parse_args()
+
+    from spark_tpu.sql.session import SparkSession
+    from spark_tpu.tpcds import QUERIES, generate
+
+    spark = SparkSession.builder.appName("tpcds-midscale").getOrCreate()
+    base = args.keep or tempfile.mkdtemp(prefix="tpcds_mid_")
+    marker = os.path.join(base, f"_GENERATED_{args.rows}")
+
+    t0 = time.time()
+    if os.path.exists(marker):
+        print(f"[midscale] reusing dataset at {base}")
+        # regenerate ONLY the small dims in memory (deterministic seed);
+        # facts are read back from parquet
+        tables = {n: p for n, p in generate(1000, seed=20260730).items()
+                  if n not in FACTS}
+    else:
+        print(f"[midscale] generating {args.rows:,} store_sales rows ...")
+        tables = generate(args.rows, seed=20260730)
+        os.makedirs(base, exist_ok=True)
+        for name in FACTS & set(tables):
+            d = os.path.join(base, name)
+            if os.path.exists(d):
+                shutil.rmtree(d)
+            os.makedirs(d)
+            pdf = tables[name]
+            parts = max(4, len(pdf) // (args.batch or 1) + 1)
+            step = (len(pdf) + parts - 1) // parts
+            for i in range(parts):
+                pdf.iloc[i * step:(i + 1) * step].to_parquet(
+                    os.path.join(d, f"part-{i:04d}.parquet"), index=False)
+        open(marker, "w").close()
+        tables = {n: p for n, p in tables.items() if n not in FACTS}
+    print(f"[midscale] dataset ready in {time.time() - t0:.1f}s")
+
+    for name, pdf in tables.items():
+        spark.createDataFrame(pdf).createOrReplaceTempView(name)
+    for name in FACTS:
+        d = os.path.join(base, name)
+        if os.path.isdir(d):
+            spark.read.parquet(d).createOrReplaceTempView(name)
+    spark.conf.set("spark.tpu.scan.maxBatchRows", str(args.batch))
+
+    results = {}
+    for q in args.queries.split(","):
+        q = q.strip()
+        t0 = time.time()
+        rows = spark.sql(QUERIES[q]).collect()
+        dt = time.time() - t0
+        results[q] = {"rows": len(rows), "seconds": round(dt, 2),
+                      "fact_rows_per_sec": round(args.rows / dt, 1)}
+        print(f"[midscale] {q}: {len(rows)} rows in {dt:.2f}s "
+              f"({args.rows / dt / 1e6:.2f} M fact-rows/s)")
+
+    if args.validate:
+        import math
+        import re
+        import sqlite3
+        con = sqlite3.connect(":memory:")
+        full = generate(args.rows, seed=20260730)
+        for name, pdf in full.items():
+            pdf.to_sql(name, con, index=False)
+
+        def sqlite_text(sql):
+            return re.sub(
+                r"STDDEV_SAMP\((\w+)\)",
+                r"(CASE WHEN count(\1) > 1 THEN "
+                r"sqrt(max(sum(\1*\1*1.0) - count(\1)*avg(\1)*avg(\1), 0)"
+                r" / (count(\1) - 1)) ELSE NULL END)",
+                sql, flags=re.IGNORECASE)
+
+        for q in results:
+            got = [tuple(r) for r in spark.sql(QUERIES[q]).collect()]
+            exp = con.execute(sqlite_text(QUERIES[q])).fetchall()
+            assert len(got) == len(exp), (q, len(got), len(exp))
+            print(f"[midscale] {q}: validated {len(got)} rows vs sqlite")
+
+    print(json.dumps({"rows": args.rows, "batch": args.batch,
+                      "results": results}))
+    if not args.keep:
+        shutil.rmtree(base, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

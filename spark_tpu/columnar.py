@@ -357,16 +357,32 @@ def _ingest_column(raw: Any, num_rows: int, cap: int,
     if (not isinstance(raw, np.ndarray) and len(raw)
             and isinstance(next((v for v in raw if v is not None), None),
                            (list, tuple, np.ndarray))):
-        values = [([0.0] if v is None else list(v)) for v in raw]
-        width = max(len(v) for v in values)
+        values = [([] if v is None else list(v)) for v in raw]
+        width = max((len(v) for v in values), default=1) or 1
         nulls = np.fromiter((v is None for v in raw), bool, count=len(values))
-        mat = np.zeros((len(values), width), np.float64)
+        if isinstance(dtype, T.ArrayType):
+            ed = dtype.element_type
+        else:
+            all_int = all(
+                isinstance(x, (int, np.integer))
+                and not isinstance(x, bool)
+                for v in values for x in v if x is not None)
+            ed = T.int64 if all_int and any(len(v) for v in values) \
+                else T.float64
+        dt = dtype if isinstance(dtype, T.ArrayType) else T.ArrayType(ed)
+        # ragged tails / None elements carry the ELEMENT SENTINEL (NaN for
+        # fractional, element_sentinel() for integral) — the device layout
+        # to_pylist/array kernels treat as dead, never silent zeros
+        sent = np.nan if ed.is_fractional else dt.element_sentinel()
+        mat = np.full((len(values), width), sent, ed.np_dtype)
         for i, v in enumerate(values):
-            mat[i, :len(v)] = v
-        dt = dtype if isinstance(dtype, T.ArrayType) else T.ArrayType(T.float64)
+            for j, x in enumerate(v):
+                if x is not None and not (isinstance(x, float)
+                                          and np.isnan(x)):
+                    mat[i, j] = x
         if len(mat) < cap:
             mat = np.concatenate(
-                [mat, np.zeros((cap - len(mat), width), np.float64)])
+                [mat, np.full((cap - len(mat), width), sent, ed.np_dtype)])
         valid = None if not nulls.any() else np.concatenate(
             [~nulls, np.zeros(cap - len(values), bool)])
         return ColumnVector(mat, dt, valid, None)

@@ -461,6 +461,58 @@ def file_row_count(rel: L.FileRelation) -> Optional[int]:
     return n
 
 
+_COLUMN_STATS_CACHE: dict = {}
+
+
+def file_column_stats(rel: L.FileRelation) -> Dict[str, dict]:
+    """Per-column {min, max, null_count, total} from parquet FOOTERS — the
+    free column statistics the reference's CBO keeps in
+    `catalyst/.../plans/logical/statsEstimation/` (there gathered by
+    ANALYZE TABLE; here always available because parquet already wrote
+    them).  Empty for non-parquet or stat-less files; memoized per file
+    list + mtimes."""
+    if rel.fmt != "parquet":
+        return {}
+    try:
+        files = _resolve_paths(rel.paths)
+    except AnalysisException:
+        return {}
+    key = tuple((f, os.path.getmtime(f)) for f in files)
+    if key in _COLUMN_STATS_CACHE:
+        return _COLUMN_STATS_CACHE[key]
+    import pyarrow.parquet as pq
+    out: Dict[str, dict] = {}
+    for f in files:
+        md = pq.ParquetFile(f).metadata
+        names = {md.schema.column(i).path: i
+                 for i in range(md.num_columns)}
+        for name, ci in names.items():
+            rec = out.setdefault(name, {"min": None, "max": None,
+                                        "null_count": 0, "total": 0})
+            rec["total"] += md.num_rows
+            for rg in range(md.num_row_groups):
+                st = md.row_group(rg).column(ci).statistics
+                if st is None:
+                    continue
+                if st.null_count is not None:
+                    rec["null_count"] += st.null_count
+                if not st.has_min_max:
+                    continue
+                lo, hi = st.min, st.max
+                if isinstance(lo, bytes):
+                    lo = lo.decode("utf-8", "replace")
+                    hi = hi.decode("utf-8", "replace")
+                try:
+                    if rec["min"] is None or lo < rec["min"]:
+                        rec["min"] = lo
+                    if rec["max"] is None or hi > rec["max"]:
+                        rec["max"] = hi
+                except TypeError:
+                    pass
+    _COLUMN_STATS_CACHE[key] = out
+    return out
+
+
 def scan_file_batches(rel: L.FileRelation, batch_rows: int):
     """Yield host ColumnBatches of ≤ batch_rows rows each.
 

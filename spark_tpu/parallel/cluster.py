@@ -79,13 +79,19 @@ def init_cluster(coordinator_address: Optional[str] = None,
 
     On managed TPU pods jax.distributed autodetects everything; explicit
     args cover manual/standalone deployment (the spark-standalone analog:
-    coordinator = master URL, process_id = executor id)."""
-    if jax.process_count() == 1 and (coordinator_address or
-                                     num_processes not in (None, 1)):
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id)
+    coordinator = master URL, process_id = executor id).
+
+    NB: must not touch the XLA backend (jax.process_count/jax.devices)
+    before initialize — backend init makes jax.distributed.initialize
+    impossible.  Already-initialized state is detected via the
+    distributed client, which is backend-free."""
+    if coordinator_address or num_processes not in (None, 1):
+        from jax._src import distributed as _dist
+        if getattr(_dist.global_state, "client", None) is None:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id)
     return ClusterInfo()
 
 

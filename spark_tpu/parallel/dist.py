@@ -626,6 +626,28 @@ class DGatherOne(DNode):
         return "GatherToOne"
 
 
+class DKeepShardZero(DNode):
+    """Mask output rows to shard 0 — for operators (keyless aggregates)
+    that produce an ALWAYS-VALID row on every shard even over all-dead
+    gathered input; without the mask the shard_map concatenation would
+    emit one duplicate row per shard."""
+
+    def __init__(self, child: P.PhysicalPlan):
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def run(self, ctx):
+        out = self.children[0].run(ctx)
+        shard = lax.axis_index(DATA_AXIS)
+        rv = out.row_valid_or_true() & (shard == 0)
+        return ColumnBatch(out.names, out.vectors, rv, out.capacity)
+
+    def __repr__(self):
+        return "KeepShardZero"
+
+
 class DShardSort(DNode):
     """Per-shard local sort (used after a range exchange)."""
 

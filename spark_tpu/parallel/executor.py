@@ -63,6 +63,18 @@ class DistributedPlanner(Planner):
                             node.num_rows(), n)
         if isinstance(node, Aggregate):
             child = self._to_physical(node.child, leaves)
+            if any(getattr(f, "is_collect", False)
+                   or getattr(f, "is_percentile", False)
+                   for f, _n in node.aggs):
+                # no fixed-width mergeable partial form: gather rows to one
+                # shard and aggregate there (the reference's
+                # ObjectHashAggregate runs such aggs on a single partition
+                # after the shuffle) — everything BELOW stays sharded.
+                # Keyless aggregation emits an always-valid global row on
+                # EVERY shard, so mask the result to shard 0
+                agg = P.PAggregate(node.keys, node.aggs,
+                                   D.DGatherOne(child))
+                return agg if node.keys else D.DKeepShardZero(agg)
             if not node.keys:
                 return D.DGlobalAggregate(node.aggs, child)
             partial_agg = D.DPartialAggregate(node.keys, node.aggs, child)
@@ -281,7 +293,9 @@ def shard_leaf(mesh: Mesh, n: int, batch: ColumnBatch) -> ColumnBatch:
     def pad_and_put(arr, fill=0):
         a = np.asarray(arr)
         if len(a) < total:
-            pad = np.full(total - len(a), fill, dtype=a.dtype)
+            # arrays may be 2-D (ArrayType element planes): pad rows only
+            pad = np.full((total - len(a),) + a.shape[1:], fill,
+                          dtype=a.dtype)
             a = np.concatenate([a, pad])
         return jax.device_put(a, sharding)
 

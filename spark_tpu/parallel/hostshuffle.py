@@ -1,0 +1,129 @@
+"""Host-side cross-slice block exchange over a shared filesystem.
+
+The DCN data-plane analog of the reference's external shuffle service
+(`common/network-shuffle/.../ExternalShuffleBlockResolver.java:57`,
+`ShuffleBlockFetcherIterator`): when data must cross SLICES (no ICI), the
+engine stages per-receiver blocks on the cluster filesystem every
+multi-host TPU deployment already mounts for checkpoints, instead of a
+Netty transfer service.  Within a slice, exchanges stay XLA collectives
+(`parallel/collective.py`) — this service is only for the DCN hop, where
+disaggregated filesystem bandwidth is on the same order as DCN itself
+and survives process restarts (the property the reference's external
+service exists to provide).
+
+Protocol per exchange id:
+    <root>/<exchange>/s<sender>-r<receiver>.part   one pickled batch list
+    <root>/<exchange>/s<sender>.done               sender's commit marker
+Writers publish blocks with atomic renames, mark done, then all
+participants barrier on the full marker set; readers then see a
+consistent, complete block set.  Stragglers fail the barrier loudly
+(heartbeat timeouts abort the step rather than hanging the collective).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..columnar import ColumnBatch
+
+__all__ = ["HostShuffleService"]
+
+
+class HostShuffleService:
+    def __init__(self, root: str, process_id: int, n_processes: int,
+                 timeout_s: float = 120.0,
+                 poll_s: float = 0.05):
+        self.root = root
+        self.pid = process_id
+        self.n = n_processes
+        self.timeout_s = timeout_s
+        self.poll_s = poll_s
+        os.makedirs(root, exist_ok=True)
+
+    # -- paths -----------------------------------------------------------
+    def _dir(self, exchange: str) -> str:
+        return os.path.join(self.root, exchange)
+
+    def _part(self, exchange: str, sender: int, receiver: int) -> str:
+        return os.path.join(self._dir(exchange),
+                            f"s{sender:04d}-r{receiver:04d}.part")
+
+    def _done(self, exchange: str, sender: int) -> str:
+        return os.path.join(self._dir(exchange), f"s{sender:04d}.done")
+
+    # -- write side ------------------------------------------------------
+    def put(self, exchange: str, receiver: int,
+            batches: Sequence[ColumnBatch]) -> None:
+        """Stage this process's blocks for one receiver (atomic publish)."""
+        d = self._dir(exchange)
+        os.makedirs(d, exist_ok=True)
+        path = self._part(exchange, self.pid, receiver)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump([b.to_host() for b in batches], f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+
+    def commit(self, exchange: str) -> None:
+        """All of this sender's blocks are published."""
+        os.makedirs(self._dir(exchange), exist_ok=True)
+        path = self._done(exchange, self.pid)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(time.time()))
+        os.replace(tmp, path)
+
+    # -- barrier + read side --------------------------------------------
+    def barrier(self, exchange: str) -> None:
+        """Wait until every sender committed; loud on stragglers."""
+        deadline = time.monotonic() + self.timeout_s
+        missing = list(range(self.n))
+        while time.monotonic() < deadline:
+            missing = [s for s in range(self.n)
+                       if not os.path.exists(self._done(exchange, s))]
+            if not missing:
+                return
+            time.sleep(self.poll_s)
+        raise TimeoutError(
+            f"host shuffle {exchange!r}: senders {missing} did not commit "
+            f"within {self.timeout_s}s — aborting step (restart from "
+            "checkpoint)")
+
+    def collect(self, exchange: str,
+                receiver: Optional[int] = None) -> List[ColumnBatch]:
+        """All blocks addressed to `receiver` (default: this process),
+        in sender order."""
+        r = self.pid if receiver is None else receiver
+        out: List[ColumnBatch] = []
+        for s in range(self.n):
+            path = self._part(exchange, s, r)
+            if not os.path.exists(path):
+                continue
+            with open(path, "rb") as f:
+                out.extend(pickle.load(f))
+        return out
+
+    def exchange(self, exchange: str,
+                 per_receiver: Dict[int, Sequence[ColumnBatch]]
+                 ) -> List[ColumnBatch]:
+        """One full all-to-all hop: publish, commit, barrier, collect."""
+        for r, batches in per_receiver.items():
+            self.put(exchange, r, batches)
+        self.commit(exchange)
+        self.barrier(exchange)
+        return self.collect(exchange)
+
+    def cleanup(self, exchange: str) -> None:
+        d = self._dir(exchange)
+        try:
+            for name in os.listdir(d):
+                try:
+                    os.remove(os.path.join(d, name))
+                except OSError:
+                    pass
+            os.rmdir(d)
+        except OSError:
+            pass

@@ -233,9 +233,85 @@ def rows_estimate(node: LogicalPlan) -> int:
         return sum(rows_estimate(c) for c in node.children)
     if isinstance(node, LJoin):
         return max(rows_estimate(c) for c in node.children)
+    if isinstance(node, Filter):
+        child = node.children[0]
+        base = child
+        while isinstance(base, SubqueryAlias):
+            base = base.children[0]
+        est = rows_estimate(child)
+        from .logical import FileRelation
+        if isinstance(base, FileRelation):
+            sel = filter_selectivity(split_conjuncts(node.condition), base)
+            return max(int(est * sel), 1)
+        return est
     if node.children:
         return max(rows_estimate(c) for c in node.children)
     return 1 << 10
+
+
+def filter_selectivity(conjuncts: List[Expression], rel) -> float:
+    """Combined selectivity of filter conjuncts over a file relation, from
+    parquet footer min/max/null-count column stats (`FilterEstimation.scala`
+    role over the stats `statsEstimation/` keeps; here the footers ARE the
+    stats).  Unknown shapes contribute 1.0 — estimates only ever shrink
+    when the stats justify it."""
+    from ..io import file_column_stats
+    try:
+        stats = file_column_stats(rel)
+    except Exception:
+        return 1.0
+    if not stats:
+        return 1.0
+
+    def one(c: Expression) -> float:
+        op = type(c).__name__
+        if op not in ("EQ", "LT", "LE", "GT", "GE"):
+            return 1.0
+        l, r = c.children
+        flip = {"EQ": "EQ", "LT": "GT", "LE": "GE",
+                "GT": "LT", "GE": "LE"}
+        if isinstance(l, Col) and isinstance(r, Literal):
+            col, lit = l, r
+        elif isinstance(r, Col) and isinstance(l, Literal):
+            col, lit, op = r, l, flip[op]
+        else:
+            return 1.0
+        st = stats.get(col.name)
+        if st is None or st["min"] is None or lit.value is None:
+            return 1.0
+        lo, hi, total = st["min"], st["max"], max(st["total"], 1)
+        nn = max(1.0 - st["null_count"] / total, 0.0)
+        v = lit.value
+        try:
+            if isinstance(lo, (int, float)) \
+                    and isinstance(v, (int, float)) \
+                    and not isinstance(v, bool):
+                if v < lo or v > hi:
+                    return 1.0 / total if op == "EQ" else \
+                        (nn if (op in ("GT", "GE")) == (v < lo) else
+                         1.0 / total)
+                if op == "EQ":
+                    # integral domains: uniform 1/(hi-lo+1); fractional:
+                    # the reference's default 1/ndv with unknown ndv
+                    width = (hi - lo + 1) if isinstance(lo, int) else 0
+                    return nn / width if width > 1 else \
+                        (nn if width == 1 else 0.1 * nn)
+                span = float(hi) - float(lo)
+                if span <= 0:
+                    return nn
+                frac = (float(v) - float(lo)) / span
+                frac = min(max(frac, 0.0), 1.0)
+                return nn * (frac if op in ("LT", "LE") else 1.0 - frac)
+            if isinstance(lo, str) and isinstance(v, str) and op == "EQ":
+                return 0.1 * nn if lo <= v <= hi else 1.0 / total
+        except Exception:
+            return 1.0
+        return 1.0
+
+    sel = 1.0
+    for c in conjuncts:
+        sel *= one(c)
+    return max(sel, 1e-4)
 
 
 def reorder_joins(node: LogicalPlan) -> LogicalPlan:
@@ -265,8 +341,23 @@ def reorder_joins(node: LogicalPlan) -> LogicalPlan:
 
     # the base relation becomes the probe side of every join in the
     # left-deep tree, and join output capacity scales with PROBE capacity —
-    # so start from the largest relation (usually the fact table)
-    base = max(range(len(rels)), key=lambda i: rows_estimate(rels[i]))
+    # so start from the largest relation (usually the fact table),
+    # measured AFTER single-relation filter conjuncts by footer column
+    # stats (CostBasedJoinReorder's stats-driven pick, CBO-lite)
+    def effective_rows(i: int) -> float:
+        est = float(rows_estimate(rels[i]))
+        base_rel = rels[i]
+        while isinstance(base_rel, SubqueryAlias):
+            base_rel = base_rel.children[0]
+        from .logical import FileRelation
+        if isinstance(base_rel, FileRelation):
+            mine = [c_ for c_ in conds
+                    if c_.references() <= schemas[i]]
+            if mine:
+                est *= filter_selectivity(mine, base_rel)
+        return est
+
+    base = max(range(len(rels)), key=effective_rows)
     joined = rels[base]
     joined_cols = set(schemas[base])
     remaining = [i for i in range(len(rels)) if i != base]

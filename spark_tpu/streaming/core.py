@@ -889,7 +889,6 @@ class StreamExecution:
                     "sorting a streaming aggregation is only supported in "
                     "complete output mode")
             node = node.children[0]
-        self._reject_sliding(agg)
         for f, _n in agg.aggs:
             if getattr(f, "is_percentile", False) \
                     or getattr(f, "is_collect", False):
@@ -897,7 +896,18 @@ class StreamExecution:
                     f"{f!r} has no mergeable partial form; streaming "
                     "aggregations support sum/count/avg/min/max/first/"
                     "last/variance")
+        # sliding window() keys: apply the analyzer's batch Expand rewrite
+        # (each event replicated into its duration/slide windows BELOW the
+        # agg) so the incremental state machinery only ever sees tumbling
+        # window-start keys — `TimeWindowing`'s Expand, incrementalized
+        self._agg_anchor = agg
+        sliding_key = self._sliding_event_key(agg)
+        if sliding_key is not None or self._has_sliding(agg):
+            from ..sql.analyzer import Analyzer
+            agg = Analyzer._rewrite_sliding_window(agg)
         self._event_key = self._find_event_key(agg)
+        if self._event_key is None:
+            self._event_key = sliding_key
         if self.mode == "append" and self._event_key is None:
             # append over an aggregate needs a watermark on a group key to
             # know when groups are final (EventTimeWatermarkExec); without
@@ -909,14 +919,32 @@ class StreamExecution:
         self._agg_node = agg
         return AggregationState(agg.keys, agg.aggs, agg.child.schema())
 
-    def _reject_sliding(self, agg: L.Aggregate) -> None:
+    @staticmethod
+    def _has_sliding(agg: L.Aggregate) -> bool:
         from ..expressions import Alias, TimeWindow
         for k in agg.keys:
             b = k.children[0] if isinstance(k, Alias) else k
             if isinstance(b, TimeWindow) and b.is_sliding:
-                raise AnalysisException(
-                    "sliding windows on streams are not supported yet; "
-                    "use a tumbling window (slide == duration)")
+                return True
+        return False
+
+    def _sliding_event_key(self, agg: L.Aggregate):
+        """(key index, window duration) when a SLIDING window key is tied
+        to the watermark column — the rewrite turns it into a plain
+        window-start key, so the link must be captured BEFORE rewriting.
+        Eviction semantics are unchanged: a sliding window [start,
+        start+d) is final once the watermark passes start + d."""
+        from ..expressions import Alias, TimeWindow
+        if self._wm_col is None:
+            return None
+        for i, k in enumerate(agg.keys):
+            base = k.children[0] if isinstance(k, Alias) else k
+            if isinstance(base, TimeWindow) and base.is_sliding \
+                    and base.field == "start" \
+                    and isinstance(base.children[0], Col) \
+                    and base.children[0].name.split(".")[-1] == self._wm_col:
+                return i, base.duration_us
+        return None
 
     def _find_event_key(self, agg: L.Aggregate):
         """(key index, window duration) of the event-time grouping key tied
@@ -1179,9 +1207,11 @@ class StreamExecution:
         return plan.transform_up(fn)
 
     def _rebuild_above(self, finished: ColumnBatch) -> L.LogicalPlan:
-        """Re-apply any nodes sitting above the Aggregate."""
-        return self._rebuild_above_plan(self._agg_node,
-                                        L.LocalRelation(finished))
+        """Re-apply any nodes sitting above the Aggregate (anchored on the
+        ORIGINAL node — _agg_node may be the sliding-rewrite clone)."""
+        return self._rebuild_above_plan(
+            getattr(self, "_agg_anchor", self._agg_node) or self._agg_node,
+            L.LocalRelation(finished))
 
     def _rebuild_above_plan(self, anchor: L.LogicalPlan,
                             plan: L.LogicalPlan) -> L.LogicalPlan:

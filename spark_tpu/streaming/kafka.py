@@ -1,0 +1,184 @@
+"""Kafka source: offset-range micro-batches over a pluggable client.
+
+The mechanics of the reference's `connector/kafka-0-10-sql/.../
+KafkaSource.scala`: each micro-batch is an OFFSET RANGE per topic
+partition, the range endpoints are persisted in the offset WAL before
+compute (exactly-once replay), and the batch materializes as the
+standard kafka schema (key, value, topic, partition, offset, timestamp).
+
+This image ships no Kafka client library, so the broker protocol is
+behind `KafkaClient` — a three-method interface.  A real client (e.g.
+kafka-python, if installed) plugs in via ``set_client_factory``; tests
+drive the full offset/WAL/replay machinery with an in-memory fake.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..columnar import ColumnBatch
+from ..expressions import AnalysisException
+from .core import Source
+
+__all__ = ["KafkaClient", "KafkaSource", "set_client_factory"]
+
+KAFKA_SCHEMA = T.StructType([
+    T.StructField("key", T.string),
+    T.StructField("value", T.string),
+    T.StructField("topic", T.string),
+    T.StructField("partition", T.int32),
+    T.StructField("offset", T.int64),
+    T.StructField("timestamp", T.timestamp),
+])
+
+
+class KafkaClient:
+    """Minimal broker interface (KafkaConsumer's three relevant calls)."""
+
+    def partitions(self, topic: str) -> List[int]:
+        raise NotImplementedError
+
+    def latest_offsets(self, topic: str) -> Dict[int, int]:
+        """partition -> next offset to be written (end of log)."""
+        raise NotImplementedError
+
+    def fetch(self, topic: str, partition: int, start: int, end: int
+              ) -> List[Tuple[Optional[str], str, int]]:
+        """Records [start, end) as (key, value, timestamp_us)."""
+        raise NotImplementedError
+
+
+_client_factory: Optional[Callable[[Dict[str, str]], KafkaClient]] = None
+
+
+def set_client_factory(factory: Optional[Callable]) -> None:
+    """Install the broker client factory (options dict -> KafkaClient).
+    Tests install an in-memory fake; deployments wrap a real consumer."""
+    global _client_factory
+    _client_factory = factory
+
+
+def _default_factory(options: Dict[str, str]) -> KafkaClient:
+    try:
+        import kafka  # noqa: F401  (kafka-python, not in this image)
+    except ImportError:
+        raise AnalysisException(
+            "kafka source: no client installed and no client factory "
+            "registered; install kafka-python or call "
+            "spark_tpu.streaming.kafka.set_client_factory(...)")
+    raise AnalysisException(
+        "kafka-python detected but no adapter registered; wrap your "
+        "consumer in a KafkaClient and set_client_factory(...)")
+
+
+class KafkaSource(Source):
+    """Offset-range micro-batches from one subscribed topic.
+
+    The engine's Source protocol speaks ONE monotone int offset; Kafka
+    speaks per-partition offsets.  The bridge is the reference's own
+    trick (KafkaSourceOffset → JSON in the WAL): the public offset is
+    the CUMULATIVE record count across partitions, and the per-partition
+    map behind each public offset rides the offset WAL via
+    offset_metadata/restore_offset_metadata, so a logged-but-uncommitted
+    batch replays the exact same ranges after restart."""
+
+    def __init__(self, options: Dict[str, str]):
+        topic = options.get("subscribe")
+        if not topic:
+            raise AnalysisException("kafka source requires the "
+                                    "'subscribe' option (one topic)")
+        self.topic = topic
+        factory = _client_factory or _default_factory
+        self.client = factory(options)
+        starting = options.get("startingoffsets", "earliest")
+        if starting not in ("earliest", "latest"):
+            raise AnalysisException(
+                f"startingOffsets must be earliest|latest, got {starting}")
+        if starting == "latest":
+            base = dict(self.client.latest_offsets(topic))
+        else:
+            base = {p: 0 for p in self.client.partitions(topic)}
+        #: public offset -> per-partition offset map
+        self._snapshots: Dict[int, Dict[int, int]] = {0: base}
+        self._base = base
+
+    def schema(self) -> T.StructType:
+        return KAFKA_SCHEMA
+
+    def _total(self, offsets: Dict[int, int]) -> int:
+        return sum(max(offsets.get(p, 0) - self._base.get(p, 0), 0)
+                   for p in offsets)
+
+    def get_offset(self) -> Optional[int]:
+        latest = dict(self.client.latest_offsets(self.topic))
+        for p in self._base:
+            latest.setdefault(p, self._base[p])
+        total = self._total(latest)
+        if total == 0:
+            return None
+        self._snapshots[total] = latest
+        return total
+
+    def offset_metadata(self, start: Optional[int], end: int
+                        ) -> Optional[dict]:
+        return {"end_offsets": {str(p): o for p, o in
+                                self._snapshots[end].items()},
+                "base": {str(p): o for p, o in self._base.items()}}
+
+    def restore_offset_metadata(self, start: Optional[int], end: int,
+                                meta: dict) -> None:
+        self._base = {int(p): o for p, o in meta["base"].items()}
+        self._snapshots[0] = dict(self._base)
+        self._snapshots[end] = {int(p): o
+                                for p, o in meta["end_offsets"].items()}
+
+    def commit(self, end: int) -> None:
+        """Offsets ≤ end are durable: prune snapshots below the committed
+        public offset (the reference purges KafkaSourceOffset state the
+        same way) — a long-running stream must not accumulate one offset
+        map per trigger."""
+        floor = self._snapshots.get(end)
+        if floor is None:
+            return
+        self._snapshots = {k: v for k, v in self._snapshots.items()
+                           if k >= end}
+        self._snapshots[0] = dict(self._base)
+        self._snapshots[end] = floor
+
+    def get_batch(self, start: Optional[int], end: int) -> ColumnBatch:
+        s_map = self._snapshots.get(start or 0)
+        e_map = self._snapshots.get(end)
+        if s_map is None or e_map is None:
+            raise AnalysisException(
+                f"kafka offset snapshot missing for range ({start}, {end}] "
+                "— WAL metadata not restored?")
+        keys: List[Optional[str]] = []
+        vals: List[str] = []
+        parts: List[int] = []
+        offs: List[int] = []
+        tss: List[int] = []
+        for p in sorted(e_map):
+            lo = s_map.get(p, self._base.get(p, 0))
+            hi = e_map[p]
+            if hi <= lo:
+                continue
+            for i, (k, v, ts) in enumerate(
+                    self.client.fetch(self.topic, p, lo, hi)):
+                keys.append(k)
+                vals.append(v)
+                parts.append(p)
+                offs.append(lo + i)
+                tss.append(ts)
+        if not vals:
+            return ColumnBatch.empty(KAFKA_SCHEMA)
+        return ColumnBatch.from_arrays({
+            "key": keys,
+            "value": vals,
+            "topic": [self.topic] * len(vals),
+            "partition": np.asarray(parts, np.int32),
+            "offset": np.asarray(offs, np.int64),
+            "timestamp": np.asarray(tss, np.int64),
+        }, schema=KAFKA_SCHEMA)

@@ -1,0 +1,48 @@
+"""Two-PROCESS distributed smoke test (VERDICT r2 #7).
+
+`jax.distributed.initialize` with two real OS processes (4 virtual CPU
+devices each → an 8-device (dcn=2, data=4) hybrid mesh), exercising
+init_cluster, a cross-process all-reduce, one all_to_all exchange, and
+heartbeat death detection across real process boundaries — the
+`deploy/LocalSparkCluster.scala:36` idiom (in-process cluster with real
+boundaries), upgraded to actual processes.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "twoproc_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.timeout(180)
+def test_two_process_cluster(tmp_path):
+    port = _free_port()
+    beat_dir = str(tmp_path / "beats")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+
+    def launch(pid):
+        return subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), str(port), beat_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+
+    p0 = launch(0)
+    p1 = launch(1)
+    out1, _ = p1.communicate(timeout=120)
+    out0, _ = p0.communicate(timeout=120)
+    assert p1.returncode == 0, f"p1 failed:\n{out1[-3000:]}"
+    assert p0.returncode == 0, f"p0 failed:\n{out0[-3000:]}"
+    assert "allreduce sum ok" in out0 and "allreduce sum ok" in out1
+    assert "all_to_all ok" in out0
+    assert "DEATH-DETECTED-OK" in out0

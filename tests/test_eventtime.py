@@ -277,18 +277,46 @@ def test_sliding_window_rejects_bad_slide(spark):
         F.window("ts", "10 minutes", "3 minutes")
 
 
-def test_sliding_window_streaming_rejected(spark):
-    import pytest
-    from spark_tpu import types as T
-    from spark_tpu.expressions import AnalysisException
-    from spark_tpu.streaming.core import MemoryStream
-    from spark_tpu.sql import functions as F
-    src = MemoryStream(T.StructType([
-        T.StructField("ts", T.TimestampType()),
-        T.StructField("v", T.int64)]), session=spark)
-    sdf = (src.to_df(spark).withWatermark("ts", "1 minute")
-           .groupBy(F.window("ts", "10 minutes", "5 minutes").alias("w"))
-           .agg(F.sum("v").alias("s")))
-    with pytest.raises(AnalysisException, match="sliding"):
-        (sdf.writeStream.format("memory").queryName("slidefail")
-         .outputMode("complete").start())
+def test_sliding_window_streaming_complete(spark):
+    """Sliding window() on a STREAM: the Expand rewrite incrementalizes —
+    each event lands in duration/slide windows and sums accumulate across
+    micro-batches exactly as the batch path computes them."""
+    src = MemoryStream(SCHEMA, spark)
+    q = (src.toDF(spark)
+         .groupBy(F.window("ts", "4 seconds", "2 seconds").alias("w"))
+         .agg(F.sum("v").alias("s"))
+         .writeStream.format("memory").queryName("slidec")
+         .outputMode("complete").trigger(once=True).start())
+    src.addData([(sec(1), "a", 1), (sec(3), "a", 10)])
+    q.processAllAvailable()
+    # windows: ts=1 → [-2,2),[0,4); ts=3 → [0,4),[2,6)
+    assert sink_rows(spark, "slidec") == [
+        (dt(-2), 1), (dt(0), 11), (dt(2), 10)]
+    src.addData([(sec(2), "b", 100)])     # → [0,4),[2,6)
+    q.processAllAvailable()
+    assert sink_rows(spark, "slidec") == [
+        (dt(-2), 1), (dt(0), 111), (dt(2), 110)]
+    q.stop()
+
+
+def test_sliding_window_streaming_append_watermark(spark):
+    """Append mode: a sliding window emits once, when the watermark passes
+    its END; late-arriving contributions to open windows still merge."""
+    src = MemoryStream(SCHEMA, spark)
+    q = (src.toDF(spark).withWatermark("ts", "2 seconds")
+         .groupBy(F.window("ts", "4 seconds", "2 seconds").alias("w"))
+         .agg(F.sum("v").alias("s"))
+         .writeStream.format("memory").queryName("slidea")
+         .outputMode("append").trigger(once=True).start())
+    src.addData([(sec(1), "a", 1), (sec(3), "a", 10)])
+    q.processAllAvailable()
+    assert sink_rows(spark, "slidea") == []      # wm=1: nothing final
+    src.addData([(sec(9), "a", 5)])              # wm → 7: ends 2,4,6 final
+    q.processAllAvailable()
+    assert sink_rows(spark, "slidea") == [
+        (dt(-2), 1), (dt(0), 11), (dt(2), 10)]
+    src.addData([(sec(14), "a", 2)])             # wm → 12: ends ≤12 final
+    q.processAllAvailable()
+    assert sink_rows(spark, "slidea") == [
+        (dt(-2), 1), (dt(0), 11), (dt(2), 10), (dt(6), 5), (dt(8), 5)]
+    q.stop()

@@ -178,3 +178,46 @@ def test_cached_result_feeds_downstream_query():
     total = doubled.agg(F.sum("y").alias("t")).collect()[0]["t"]
     assert total == 2 * sum(range(100))
     doubled.unpersist()
+
+
+def test_join_output_preflight_enforced(spark):
+    """r2 weak #5: the reservation now pre-flights the join's STATIC
+    output buffer, so a join that cannot fit the budget raises
+    HBMOutOfMemoryError BEFORE dispatch — never an XLA allocator crash."""
+    import pandas as pd
+    from spark_tpu.sql import functions as F
+    n = 4096
+    left = spark.createDataFrame(pd.DataFrame({
+        "k": np.arange(n, dtype=np.int64) % 64,
+        "a": np.arange(n, dtype=np.int64)}))
+    right = spark.createDataFrame(pd.DataFrame({
+        "k2": np.arange(n, dtype=np.int64) % 64,
+        "b": np.arange(n, dtype=np.int64)}))
+    df = left.join(right, on=F.col("k") == F.col("k2"))
+    q = df.agg(F.count("a"))
+    old_budget = spark._memory.budget
+    try:
+        spark._memory.budget = 200_000     # far below the join buffer
+        with pytest.raises(HBMOutOfMemoryError, match="query:"):
+            q.collect()
+    finally:
+        spark._memory.budget = old_budget
+    (cnt,), = q.collect()                  # restored budget: runs fine
+    assert cnt == n * (n // 64)
+
+
+def test_preflight_estimates_join_buffer(spark):
+    """The reservation grows with the planned join output capacity."""
+    import pandas as pd
+    from spark_tpu.sql import functions as F
+    from spark_tpu.sql.planner import QueryExecution, _plan_reserve_bytes
+    n = 2048
+    left = spark.createDataFrame(pd.DataFrame({
+        "k": np.arange(n, dtype=np.int64)}))
+    right = spark.createDataFrame(pd.DataFrame({
+        "k2": np.arange(n, dtype=np.int64)}))
+    plain = QueryExecution(
+        spark, left.filter(F.col("k") >= 0)._plan).planned
+    joined = QueryExecution(
+        spark, left.join(right, on=F.col("k") == F.col("k2"))._plan).planned
+    assert _plan_reserve_bytes(joined) > 1.5 * _plan_reserve_bytes(plain)

@@ -145,3 +145,33 @@ def test_count_star_reads_narrow_column(spark, wide):
     _reset()
     assert spark.read.parquet(path).count() == len(pdf)
     assert tio.SCAN_STATS["columns_read"] == 1
+
+
+def test_footer_column_stats(spark, wide):
+    from spark_tpu.io import file_column_stats
+    from spark_tpu.sql.logical import FileRelation
+    path, pdf = wide
+    rel = spark.read.parquet(path)._plan
+    assert isinstance(rel, FileRelation)
+    st = file_column_stats(rel)
+    assert st["ord"]["min"] == 0 and st["ord"]["max"] == len(pdf) - 1
+    assert st["ord"]["null_count"] == 0
+    assert st["ord"]["total"] == len(pdf)
+    assert st["grp"]["min"] == "a" and st["grp"]["max"] == "c"
+
+
+def test_filter_selectivity_shrinks_estimates(spark, wide):
+    from spark_tpu.sql.optimizer import rows_estimate
+    from spark_tpu.sql.planner import QueryExecution
+    path, pdf = wide
+    full = spark.read.parquet(path)
+    n = len(pdf)
+    filtered = full.filter(F.col("ord") < n // 10)
+    est_full = rows_estimate(QueryExecution(spark, full._plan).analyzed)
+    qe = QueryExecution(spark, filtered._plan)
+    # estimate on the ANALYZED plan (optimizer would push the filter into
+    # the pruned relation)
+    est_f = rows_estimate(qe.analyzed)
+    assert est_full == n
+    assert est_f < n // 5            # ~10% with footer-range selectivity
+    assert est_f >= 1

@@ -236,3 +236,22 @@ def test_stream_above_breaker_filter(st, data):
     exp = exp[exp.qty > 100].sort_values("brand")
     assert [(r[0], r[1]) for r in got] == \
         list(zip(exp.brand.tolist(), exp.qty.tolist()))
+
+
+def test_nonmergeable_agg_over_stream(st, data):
+    """percentile/collect have no mergeable partial: the stage runner
+    streams the spine (filter reduces rows) and aggregates the
+    materialized remainder — the query works past one batch instead of
+    being rejected (VERDICT r2 #9)."""
+    paths, pdfs = data
+    fact = st.read.parquet(paths["fact"])
+    df = (fact.filter(F.col("qty") >= 3)
+          .groupBy("item_k")
+          .agg(F.collect_list("qty").alias("qs"),
+               F.percentile_approx("price", 0.5).alias("mp")))
+    got = {r[0]: (sorted(r[1]), r[2]) for r in df.collect()}
+    sub = pdfs["fact"][pdfs["fact"].qty >= 3]
+    exp_groups = sub.groupby("item_k")
+    assert set(got) == set(exp_groups.groups)
+    for k, g in exp_groups:
+        assert got[k][0] == sorted(g.qty.tolist())

@@ -1,0 +1,106 @@
+"""TPC-DS through the OUT-OF-CORE paths: parquet-backed tables several
+times one batch capacity (VERDICT r2 #5's in-suite slice).
+
+The 99-query sweep (`test_tpcds.py`) runs on in-memory views where every
+table fits one device batch; this module writes the fact tables to
+parquet and lowers `spark.tpu.scan.maxBatchRows` so real query texts
+stream through the stage runner (grace joins, broadcast-fused streams,
+pruned scans) and still match the sqlite oracle — the
+`TPCDSQueryBenchmark.scala:63` shape at test scale.  The standalone
+`examples/tpcds_midscale.py` runs the same harness at 10M+ rows.
+"""
+
+import math
+import os
+import re
+import sqlite3
+
+import numpy as np
+import pytest
+
+import spark_tpu.config as C
+from spark_tpu.tpcds import QUERIES, generate
+
+SF_ROWS = 120_000       # store_sales rows; catalog_sales 60k, web 30k
+BATCH = 1 << 14         # 16k rows/batch → store_sales streams in 8 batches
+
+#: queries chosen to cover the three streamed shapes: star join over one
+#: big fact (q3, q42), fact⋈fact⋈fact grace joins (q17), and a
+#: big-fact semi-ish filter pipeline (q55)
+MID_QUERIES = ["q3", "q42", "q55", "q17"]
+
+
+def _sqlite_text(sql: str) -> str:
+    return re.sub(
+        r"STDDEV_SAMP\((\w+)\)",
+        r"(CASE WHEN count(\1) > 1 THEN "
+        r"sqrt(max(sum(\1*\1*1.0) - count(\1)*avg(\1)*avg(\1), 0)"
+        r" / (count(\1) - 1)) ELSE NULL END)",
+        sql, flags=re.IGNORECASE)
+
+
+@pytest.fixture(scope="module")
+def mid(spark, tmp_path_factory):
+    tables = generate(SF_ROWS, seed=20260730)
+    base = tmp_path_factory.mktemp("tpcds_mid")
+    facts = {"store_sales", "catalog_sales", "web_sales", "store_returns",
+             "catalog_returns", "web_returns", "inventory"}
+    for name, pdf in tables.items():
+        if name in facts:
+            d = base / name
+            os.makedirs(d)
+            parts = 4
+            step = (len(pdf) + parts - 1) // parts
+            for i in range(parts):
+                pdf.iloc[i * step:(i + 1) * step].to_parquet(
+                    d / f"part-{i:03d}.parquet", index=False)
+            spark.read.parquet(str(d)).createOrReplaceTempView(name)
+        else:
+            spark.createDataFrame(pdf).createOrReplaceTempView(name)
+    con = sqlite3.connect(":memory:")
+    for name, pdf in tables.items():
+        pdf.to_sql(name, con, index=False)
+    old = spark.conf.get(C.SCAN_MAX_BATCH_ROWS)
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(BATCH))
+    yield spark, con
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(old))
+    con.close()
+    for name in tables:
+        spark.catalog.dropTempView(name)
+
+
+def _norm(v):
+    if v is None:
+        return None
+    if isinstance(v, (bool, np.bool_)):
+        return bool(v)
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        f = float(v)
+        return None if math.isnan(f) else round(f, 6)
+    return str(v)
+
+
+def _key(row):
+    return tuple("\0" if x is None else str(x) for x in row)
+
+
+@pytest.mark.parametrize("qname", MID_QUERIES)
+def test_midscale_query(mid, qname):
+    spark, con = mid
+    sql = QUERIES[qname]
+    got = [tuple(r) for r in spark.sql(sql).collect()]
+    exp = con.execute(_sqlite_text(sql)).fetchall()
+    assert exp, f"{qname}: oracle returned no rows — weak test, fix params"
+    got = sorted((tuple(_norm(v) for v in r) for r in got), key=_key)
+    exp = sorted((tuple(_norm(v) for v in r) for r in exp), key=_key)
+    assert len(got) == len(exp), \
+        f"{qname}: {len(got)} rows != oracle {len(exp)}"
+    for i, (g, e) in enumerate(zip(got, exp)):
+        for j, (a, b) in enumerate(zip(g, e)):
+            if isinstance(a, float) and isinstance(b, float):
+                assert math.isclose(a, b, rel_tol=1e-6, abs_tol=1e-6), \
+                    f"{qname} row {i} col {j}: {a} != {b}"
+            else:
+                assert a == b, f"{qname} row {i} col {j}: {a!r} != {b!r}"

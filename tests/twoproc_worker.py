@@ -1,0 +1,95 @@
+"""Worker for the two-process jax.distributed smoke test (not a test
+module itself — launched as a subprocess by test_cluster_twoproc.py).
+
+argv: <process_id> <coordinator_port> <beat_dir>
+"""
+
+import os
+import sys
+import time
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+beat_dir = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+from jax import lax, shard_map  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec  # noqa: E402
+
+from spark_tpu import config as C  # noqa: E402
+from spark_tpu.parallel.cluster import (  # noqa: E402
+    HeartbeatMonitor, hybrid_mesh, init_cluster,
+)
+
+info = init_cluster(f"localhost:{port}", num_processes=2, process_id=pid)
+assert info.process_count == 2, info
+assert info.process_index == pid, info
+assert len(info.global_devices) == 8, info
+assert len(info.local_devices) == 4, info
+print(f"[p{pid}] {info}", flush=True)
+
+mesh = hybrid_mesh()
+assert mesh.axis_names == ("dcn", "data")
+assert mesh.devices.shape == (2, 4), mesh.devices.shape
+
+# one cross-process all-reduce: global sum of a (dcn,data)-sharded array
+sh = NamedSharding(mesh, PartitionSpec(("dcn", "data")))
+arr = jax.make_array_from_callback(
+    (32,), sh, lambda idx: np.arange(32.0)[idx])
+s = jax.jit(lambda x: x.sum(),
+            out_shardings=NamedSharding(mesh, PartitionSpec()))(arr)
+got = float(np.asarray(jax.device_get(s.addressable_shards[0].data)))
+assert got == 496.0, got
+print(f"[p{pid}] allreduce sum ok", flush=True)
+
+# one all_to_all exchange over the intra-slice axis through shard_map
+f = shard_map(
+    lambda x: lax.all_to_all(x.reshape(4, -1), "data", 0, 0).reshape(-1),
+    mesh=mesh, in_specs=PartitionSpec(("dcn", "data")),
+    out_specs=PartitionSpec(("dcn", "data")), check_vma=False)
+y = jax.jit(f)(arr)
+assert len(y.addressable_shards) == 4
+print(f"[p{pid}] all_to_all ok", flush=True)
+
+# heartbeat death detection across REAL process boundaries: both beat,
+# then p1 stops beating and exits; p0 must observe host-1 die
+conf = C.Conf()
+conf.set("spark.tpu.cluster.heartbeatIntervalMs", "100")
+conf.set("spark.tpu.cluster.heartbeatTimeoutMs", "1200")
+mon = HeartbeatMonitor(beat_dir, conf=conf, clock=time.time)
+mon.start()
+
+if pid == 1:
+    time.sleep(0.5)                    # a few beats, then vanish
+    mon.stop()
+    print("[p1] exiting without farewell", flush=True)
+    os._exit(0)                        # simulate a crash: no cleanup
+
+deaths = []
+mon.on_failure(deaths.append)
+deadline = time.time() + 15
+while time.time() < deadline:
+    dead = mon.dead_hosts()
+    if dead:
+        break
+    time.sleep(0.1)
+assert dead == ["host-1"], dead
+assert deaths == ["host-1"], deaths
+try:
+    mon.check_or_raise()
+except RuntimeError as e:
+    assert "host-1" in str(e)
+else:
+    raise AssertionError("check_or_raise did not raise for a dead host")
+mon.stop()
+print("[p0] DEATH-DETECTED-OK", flush=True)
+os._exit(0)                            # skip jax.distributed atexit barrier
