@@ -340,11 +340,39 @@ class ColumnBatch:
         return f"ColumnBatch({self.schema.simpleString()}, capacity={self.capacity})"
 
 
+class PrebuiltColumn:
+    """Already-decoded column (data array + engine type + validity) — the
+    vectorized readers hand these to ``from_arrays`` so nullable numeric
+    columns never round-trip through Python objects."""
+
+    __slots__ = ("data", "dtype", "valid")
+
+    def __init__(self, data: np.ndarray, dtype: T.DataType,
+                 valid: Optional[np.ndarray]):
+        self.data = data
+        self.dtype = dtype
+        self.valid = valid
+
+    def __len__(self):
+        return len(self.data)
+
+
 def _ingest_column(raw: Any, num_rows: int, cap: int,
                    dtype: Optional[T.DataType]) -> ColumnVector:
     """Convert one host column (list/ndarray) into a padded ColumnVector."""
     dictionary: Optional[Tuple[str, ...]] = None
     valid: Optional[np.ndarray] = None
+
+    if isinstance(raw, PrebuiltColumn):
+        data = raw.data
+        valid = raw.valid
+        if len(data) < cap:
+            data = np.concatenate(
+                [data, np.zeros(cap - len(data), data.dtype)])
+            if valid is not None:
+                valid = np.concatenate(
+                    [valid, np.zeros(cap - len(raw.valid), bool)])
+        return ColumnVector(data, raw.dtype, valid, None)
 
     # fixed-width vector column (ML feature vectors): 2D data, ArrayType
     if isinstance(raw, np.ndarray) and raw.ndim == 2:

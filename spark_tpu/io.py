@@ -29,7 +29,8 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from . import types as T
-from .columnar import ColumnBatch, ColumnVector
+from .columnar import ColumnBatch, ColumnVector, PrebuiltColumn as \
+    _PrebuiltColumn
 from .expressions import AnalysisException
 from .sql import logical as L
 
@@ -101,7 +102,14 @@ def _engine_to_arrow(dt: T.DataType):
 
 def _table_to_batch(table, extra_cols: Optional[Dict[str, Any]] = None
                     ) -> ColumnBatch:
-    """Arrow table → host ColumnBatch (+appended partition columns)."""
+    """Arrow table → host ColumnBatch (+appended partition columns).
+
+    Numeric/temporal columns convert VECTORIZED (arrow fill_null + numpy
+    view), including nullable ones — the per-value pylist lane is only
+    for strings (dictionary encoding needs the words) and decimals.
+    This is the `VectorizedParquetRecordReader.java` half of the scan
+    hot path; the pylist fallback was 10× the whole scan cost at 2M+
+    rows."""
     import pyarrow as pa
     data: Dict[str, Any] = {}
     fields: List[T.StructField] = []
@@ -120,16 +128,24 @@ def _table_to_batch(table, extra_cols: Optional[Dict[str, Any]] = None
             # nulls handled below via pylist path when present
             if arr.null_count:
                 data[col_name] = scaled
-        elif isinstance(dt, (T.DateType, T.TimestampType)):
-            unit = "D" if isinstance(dt, T.DateType) else "us"
-            pd_arr = arr.cast(pa.timestamp("us") if unit == "us"
-                              else pa.date32())
-            data[col_name] = pd_arr.to_pylist()
         else:
-            if arr.null_count:
-                data[col_name] = arr.to_pylist()
+            if isinstance(dt, T.DateType):
+                arr = arr.cast(pa.date32()).cast(pa.int32())
+                np_dtype = np.int32
+            elif isinstance(dt, T.TimestampType):
+                arr = arr.cast(pa.timestamp("us")).cast(pa.int64())
+                np_dtype = np.int64
             else:
-                data[col_name] = arr.to_numpy(zero_copy_only=False)
+                np_dtype = np.dtype(dt.np_dtype)
+            valid = None
+            if arr.null_count:
+                valid = ~np.asarray(arr.is_null())
+                fill = pa.scalar(False) if np_dtype == np.bool_ \
+                    else pa.scalar(0, arr.type)
+                arr = arr.fill_null(fill)
+            vals = arr.to_numpy(zero_copy_only=False).astype(np_dtype,
+                                                             copy=False)
+            data[col_name] = _PrebuiltColumn(vals, dt, valid)
         fields.append(T.StructField(col_name, dt, True))
     if extra_cols:
         for k, v in extra_cols.items():

@@ -71,3 +71,5 @@ def _sign(lib: ctypes.CDLL) -> None:
     lib.cms_estimate_longs.argtypes = [p_i64, i32, i32, p_i64, i64, p_i64]
     lib.merge_sorted_runs.restype = None
     lib.merge_sorted_runs.argtypes = [p_i64, p_i64, i32, p_i64]
+    lib.partition_permutation.restype = None
+    lib.partition_permutation.argtypes = [p_i64, i64, i64, p_i64, p_i64]

@@ -1,0 +1,36 @@
+"""Native host partitioner for the grace-join partition phase.
+
+Stable counting sort of row indices by bucket id — the host half of the
+hash partition step (`ShuffleExternalSorter.java`'s role on the
+spill path).  C++ single pass when available, stable argsort fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Tuple
+
+import numpy as np
+
+from .build import load_library
+
+
+def partition_permutation(bucket_ids: np.ndarray, n_buckets: int
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """(perm, bounds): ``perm`` orders row indices by bucket (stable);
+    bucket b's rows are ``perm[bounds[b]:bounds[b+1]]``."""
+    ids = np.ascontiguousarray(np.asarray(bucket_ids, np.int64))
+    n = len(ids)
+    lib = load_library()
+    if lib is not None:
+        perm = np.zeros(n, np.int64)
+        bounds = np.zeros(n_buckets + 1, np.int64)
+        p = ctypes.POINTER(ctypes.c_int64)
+        lib.partition_permutation(
+            ids.ctypes.data_as(p), n, n_buckets,
+            perm.ctypes.data_as(p), bounds.ctypes.data_as(p))
+        return perm, bounds
+    order = np.argsort(ids, kind="stable").astype(np.int64)
+    bounds = np.searchsorted(ids[order],
+                             np.arange(n_buckets + 1)).astype(np.int64)
+    return order, bounds

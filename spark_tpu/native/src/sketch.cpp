@@ -192,3 +192,34 @@ void merge_sorted_runs(const int64_t* keys, const int64_t* offsets,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------
+// Grace-join host partitioner: stable counting sort of row indices by
+// bucket id (the host half of the grace hash join's partition phase,
+// `sql/stages.py`; the role ShuffleExchange's hash partitioner plays in
+// `core/.../shuffle/sort/ShuffleExternalSorter.java`).  O(n + buckets)
+// vs argsort's O(n log n), one pass over the ids.
+// ---------------------------------------------------------------------
+
+extern "C" void partition_permutation(const int64_t* bucket_ids, int64_t n,
+                                      int64_t n_buckets, int64_t* perm,
+                                      int64_t* bounds /* n_buckets+1 */) {
+    for (int64_t b = 0; b <= n_buckets; ++b) bounds[b] = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t b = bucket_ids[i];
+        if (b < 0) b = 0;
+        if (b >= n_buckets) b = n_buckets - 1;
+        bounds[b + 1]++;
+    }
+    for (int64_t b = 0; b < n_buckets; ++b) bounds[b + 1] += bounds[b];
+    // cursor starts at each bucket's begin offset; stable fill
+    int64_t* cursor = new int64_t[n_buckets];
+    for (int64_t b = 0; b < n_buckets; ++b) cursor[b] = bounds[b];
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t b = bucket_ids[i];
+        if (b < 0) b = 0;
+        if (b >= n_buckets) b = n_buckets - 1;
+        perm[cursor[b]++] = i;
+    }
+    delete[] cursor;
+}

@@ -414,6 +414,17 @@ class QueryExecution:
             mb = plan_multibatch(self.session, self.optimized, mesh=mesh)
             if mb is not None:
                 return mb.execute()
+            # join plans over oversized files: streamed stage DAG with the
+            # per-batch step sharded over the mesh (bucket joins inside
+            # the grace phase re-enter this executor and run distributed)
+            from .stages import NotStreamable, plan_stages
+            st = plan_stages(self.session, self.optimized, mesh=mesh)
+            if st is not None:
+                try:
+                    return st.execute()
+                except NotStreamable as e:
+                    _log.info("stage runner fallback to distributed "
+                              "eager: %s", e)
             return DistributedExecution(
                 self.session, mesh).execute(self.optimized)
 

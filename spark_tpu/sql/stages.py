@@ -222,14 +222,21 @@ class _MappedStream(BatchStream):
     build sides enter as extra constant device leaves.  Join-capacity
     overflow inside the step triggers the same positional adaptive factor
     growth as the eager executor (``planner.py``), then the batch re-runs
-    through the recompiled step."""
+    through the recompiled step.
+
+    With a ``mesh``, the step compiles as ONE shard_map program: the scan
+    batch is row-sharded, broadcast build sides are replicated to every
+    shard (BroadcastHashJoinExec over the mesh), and per-shard compacted
+    outputs merge host-side — the streamed counterpart of the
+    distributed executor's whole-plan shard_map."""
 
     def __init__(self, session, child: BatchStream, ops: List,
-                 schema: T.StructType):
+                 schema: T.StructType, mesh=None):
         self.session = session
         self.child = child
         self.ops = list(ops)
         self.schema = schema
+        self.mesh = mesh
         self.batch_rows = child.batch_rows
         self.capacity = child.capacity
         self.est_rows = child.est_rows
@@ -237,7 +244,7 @@ class _MappedStream(BatchStream):
 
     def with_op(self, builder, schema: T.StructType) -> "_MappedStream":
         return _MappedStream(self.session, self.child,
-                             self.ops + [builder], schema)
+                             self.ops + [builder], schema, self.mesh)
 
     def compose(self, leaf: L.LogicalPlan) -> L.LogicalPlan:
         node = leaf
@@ -260,32 +267,85 @@ class _MappedStream(BatchStream):
                                 "leaf; cannot swap batches per step")
         meta: Dict[tuple, tuple] = {}
 
-        def step(all_leaves):
+        if self.mesh is None:
+            def step(all_leaves):
+                ctx = P.ExecContext(jnp, list(all_leaves))
+                out = phys.run(ctx)
+                c = compact(jnp, out)
+                # host-side capture at trace time, keyed by capacities
+                meta[tuple(b.capacity for b in all_leaves)] = (
+                    list(ctx.flag_caps), list(ctx.flag_kinds))
+                return c, c.num_rows(), ctx.flags
+
+            extra = [b.to_device() for b in leaves[1:]]
+            return jax.jit(step), extra, meta
+
+        from jax import lax, shard_map
+        from jax.sharding import PartitionSpec
+        from ..parallel.mesh import DATA_AXIS
+        n_extra = len(leaves) - 1
+
+        def shard_fn(all_leaves):
             ctx = P.ExecContext(jnp, list(all_leaves))
+            ctx.shard_offset = lax.axis_index(DATA_AXIS).astype(
+                np.int64) << 48
             out = phys.run(ctx)
             c = compact(jnp, out)
-            # host-side capture at trace time, keyed by input capacities
             meta[tuple(b.capacity for b in all_leaves)] = (
                 list(ctx.flag_caps), list(ctx.flag_kinds))
-            return c, c.num_rows(), ctx.flags
+            # worst per-shard overflow drives the adaptive retry
+            flags = [lax.pmax(f, DATA_AXIS) for f in ctx.flags]
+            return c, lax.psum(c.num_rows(), DATA_AXIS), flags
 
+        wrapped = shard_map(
+            shard_fn, mesh=self.mesh,
+            in_specs=([PartitionSpec(DATA_AXIS)]
+                      + [PartitionSpec()] * n_extra,),
+            out_specs=(PartitionSpec(DATA_AXIS), PartitionSpec(),
+                       PartitionSpec()),
+            check_vma=False,
+        )
         extra = [b.to_device() for b in leaves[1:]]
-        return jax.jit(step), extra, meta
+        return jax.jit(wrapped), extra, meta
+
+    def _to_runs(self, out, n) -> List[ColumnBatch]:
+        """Host batches from one step output: the live prefix locally, or
+        one compacted run per shard under a mesh."""
+        from .planner import _slice_to_host
+        if self.mesh is None:
+            return [_slice_to_host(out, int(np.asarray(n)))]
+        from ..io import _slice_rows
+        from ..parallel.mesh import mesh_shards
+        host = out.to_host()
+        per = host.capacity // mesh_shards(self.mesh)
+        runs = []
+        for i in range(mesh_shards(self.mesh)):
+            run = _slice_rows(host, i * per, (i + 1) * per)
+            if int(np.asarray(run.num_rows())):
+                runs.append(run)
+        return runs
+
+    def _leaf_to_device(self, b: ColumnBatch):
+        if self.mesh is None:
+            return b.to_device()
+        from ..parallel.executor import shard_leaf
+        from ..parallel.mesh import mesh_shards
+        return shard_leaf(self.mesh, mesh_shards(self.mesh), b)
 
     def _run_step(self, compiled, b: ColumnBatch, phys_wrap=None):
         """Run one batch; on join overflow grow the positional factors,
-        recompile, and retry THIS batch.  Returns (host batch, compiled)."""
-        from .planner import _slice_to_host, grow_capacity_factor
+        recompile, and retry THIS batch.  Returns (host runs, compiled)."""
+        from .planner import grow_capacity_factor
         jstep, extra, meta = compiled
         base_f = self.session.conf.get(C.JOIN_OUTPUT_FACTOR)
         for _attempt in range(6):
-            out, n, flags = jstep([b.to_device()] + extra)
-            caps, kinds = meta.get(
-                tuple(x.capacity for x in [b] + extra), ([], []))
+            out, n, flags = jstep([self._leaf_to_device(b)] + extra)
+            meta_key = next(iter(meta)) if len(meta) == 1 else \
+                tuple(x.capacity for x in [b] + extra)
+            caps, kinds = meta.get(meta_key, ([], []))
             int_flags = [int(np.asarray(f)) for f in flags]
             if not any(f > 0 for f in int_flags):
-                return _slice_to_host(out, int(np.asarray(n))), \
-                    (jstep, extra, meta)
+                return self._to_runs(out, n), (jstep, extra, meta)
             cur = list(self._factors) if self._factors else []
             n_joins = sum(1 for k in kinds if k == "join")
             while len(cur) < n_joins:
@@ -311,8 +371,10 @@ class _MappedStream(BatchStream):
         for b in self.child.batches():
             if compiled is None:
                 compiled = self._compile(b)
-            host, compiled = self._run_step(compiled, b)
-            yield from _emit_pieces(host, self.batch_rows, self.capacity)
+            runs, compiled = self._run_step(compiled, b)
+            for host in runs:
+                yield from _emit_pieces(host, self.batch_rows,
+                                        self.capacity)
 
     def host_probe(self, template: ColumnBatch, rows: int = 8
                    ) -> ColumnBatch:
@@ -330,10 +392,10 @@ class _MappedStream(BatchStream):
         return phys.run(P.ExecContext(np, [b.to_host() for b in leaves]))
 
 
-def _as_mapped(session, stream: BatchStream) -> _MappedStream:
+def _as_mapped(session, stream: BatchStream, mesh=None) -> _MappedStream:
     if isinstance(stream, _MappedStream):
         return stream
-    return _MappedStream(session, stream, [], stream.schema)
+    return _MappedStream(session, stream, [], stream.schema, mesh)
 
 
 # ---------------------------------------------------------------------------
@@ -357,10 +419,9 @@ class _BucketStore:
 
     def add(self, live: ColumnBatch, bucket_ids: np.ndarray) -> None:
         """Distribute the rows of a LIVE batch (capacity == rows) to their
-        buckets."""
-        order = np.argsort(bucket_ids, kind="stable")
-        sorted_ids = bucket_ids[order]
-        bounds = np.searchsorted(sorted_ids, np.arange(self.n + 1))
+        buckets (native counting-sort partitioner; argsort fallback)."""
+        from ..native.partition import partition_permutation
+        order, bounds = partition_permutation(bucket_ids, self.n)
         for b in range(self.n):
             lo, hi = int(bounds[b]), int(bounds[b + 1])
             if hi <= lo:
@@ -714,14 +775,14 @@ def _mergeable_agg(agg: L.Aggregate) -> bool:
 
 
 def _run_breaker(session, stream: BatchStream, breaker: L.LogicalPlan,
-                 topk: Optional[int]) -> ColumnBatch:
+                 topk: Optional[int], mesh=None) -> ColumnBatch:
     """Stream → merger → one materialized host result, reusing the
     cross-batch mergers of ``multibatch.py`` (AggUtils partial/final split,
     ExternalSorter sorted-run merge)."""
     from .multibatch import (
         _AggMerger, _ConcatMerger, _DistinctMerger, _SortMerger,
     )
-    mapped = _as_mapped(session, stream)
+    mapped = _as_mapped(session, stream, mesh)
     conf = session.conf
 
     def make_spill():
@@ -763,8 +824,13 @@ def _run_breaker(session, stream: BatchStream, breaker: L.LogicalPlan,
             else:
                 raise NotStreamable(f"unsupported breaker {breaker!r}")
             compiled = mapped._compile(b, phys_wrap)
-        host, compiled = mapped._run_step(compiled, b, phys_wrap)
-        if not merger.add(host):
+        runs, compiled = mapped._run_step(compiled, b, phys_wrap)
+        more = True
+        for host in runs:
+            if not merger.add(host):
+                more = False
+                break
+        if not more:
             _log.info("stage breaker early exit")
             break
     if merger is None:
@@ -802,9 +868,10 @@ def _string_minmax_dicts(session, mapped: _MappedStream, agg: L.Aggregate,
 # ---------------------------------------------------------------------------
 
 class _Builder:
-    def __init__(self, session, batch_rows: int):
+    def __init__(self, session, batch_rows: int, mesh=None):
         self.session = session
         self.batch_rows = batch_rows
+        self.mesh = mesh
 
     # .. helpers ..........................................................
     def _oversized(self, node: L.LogicalPlan) -> bool:
@@ -840,7 +907,7 @@ class _Builder:
             if isinstance(src, ColumnBatch):
                 return _eager(self.session,
                               _rebase(node, L.LocalRelation(src)))
-            mapped = _as_mapped(self.session, src)
+            mapped = _as_mapped(self.session, src, self.mesh)
             return mapped.with_op(lambda n, op=node: _rebase(op, n),
                                   node.schema())
         if isinstance(node, L.Limit) and isinstance(node.children[0], L.Sort):
@@ -882,7 +949,7 @@ class _Builder:
             if topk is not None:
                 plan = L.Limit(topk, plan)
             return _eager(self.session, plan)
-        return _run_breaker(self.session, src, breaker, topk)
+        return _run_breaker(self.session, src, breaker, topk, self.mesh)
 
     def _join(self, node: L.Join):
         self._det(node)
@@ -903,14 +970,14 @@ class _Builder:
         # a constant build leaf (BroadcastHashJoinExec analog)
         if rmat and not lmat and fits(rsrc):
             if how in ("inner", "left", "left_semi", "left_anti"):
-                mapped = _as_mapped(self.session, lsrc)
+                mapped = _as_mapped(self.session, lsrc, self.mesh)
                 rel = L.LocalRelation(rsrc)
                 return mapped.with_op(
                     lambda n, rel=rel: L.Join(n, rel, how, node.on,
                                               node.using),
                     node.schema())
             if how == "cross" and rsrc.capacity * lsrc.capacity <= 1 << 24:
-                mapped = _as_mapped(self.session, lsrc)
+                mapped = _as_mapped(self.session, lsrc, self.mesh)
                 rel = L.LocalRelation(rsrc)
                 return mapped.with_op(
                     lambda n, rel=rel: L.Join(n, rel, "cross", node.on,
@@ -920,7 +987,7 @@ class _Builder:
             if how == "right":
                 # plan_join swaps right-outer internally, visiting the
                 # streamed right side first — fusable as-is
-                mapped = _as_mapped(self.session, rsrc)
+                mapped = _as_mapped(self.session, rsrc, self.mesh)
                 rel = L.LocalRelation(lsrc)
                 return mapped.with_op(
                     lambda n, rel=rel: L.Join(rel, n, "right", node.on,
@@ -928,7 +995,7 @@ class _Builder:
                     node.schema())
             if how == "inner":
                 # swap so the stream is the probe; restore column order
-                mapped = _as_mapped(self.session, rsrc)
+                mapped = _as_mapped(self.session, rsrc, self.mesh)
                 rel = L.LocalRelation(lsrc)
                 out_names = list(node.schema().names)
                 return mapped.with_op(
@@ -957,19 +1024,21 @@ def _rebase(op: L.LogicalPlan, child: L.LogicalPlan) -> L.LogicalPlan:
 # ---------------------------------------------------------------------------
 
 class StageExecution:
-    def __init__(self, session, optimized: L.LogicalPlan, batch_rows: int):
+    def __init__(self, session, optimized: L.LogicalPlan, batch_rows: int,
+                 mesh=None):
         self.session = session
         self.optimized = optimized
         self.batch_rows = batch_rows
+        self.mesh = mesh
 
     def execute(self) -> ColumnBatch:
-        builder = _Builder(self.session, self.batch_rows)
+        builder = _Builder(self.session, self.batch_rows, self.mesh)
         src = builder.build(self.optimized)
         result = builder._materialize(src)
         return compact(np, result.to_host())
 
 
-def plan_stages(session, optimized: L.LogicalPlan
+def plan_stages(session, optimized: L.LogicalPlan, mesh=None
                 ) -> Optional[StageExecution]:
     """Multi-relation out-of-core path: plans with multi-child nodes over
     at least one file relation larger than a device batch.
@@ -988,4 +1057,4 @@ def plan_stages(session, optimized: L.LogicalPlan
     # checkpoint/resume); reaching here linear means multibatch could not
     # decompose (e.g. non-mergeable aggregates) — the builder still
     # streams the spine and materializes only the breaker input
-    return StageExecution(session, optimized, batch_rows)
+    return StageExecution(session, optimized, batch_rows, mesh)

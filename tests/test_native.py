@@ -108,3 +108,26 @@ def test_approx_count_distinct(spark):
         "SELECT approx_count_distinct(v) AS d FROM acd_t").collect()
     assert got2[0][0] == 3
     spark.catalog.dropTempView("acd_t")
+
+
+def test_partition_permutation_native_vs_fallback():
+    from spark_tpu.native.partition import partition_permutation
+    from spark_tpu.native.build import native_available
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, 13, 5000).astype(np.int64)
+    perm, bounds = partition_permutation(ids, 13)
+    # exact stable counting-sort semantics
+    exp_perm = np.argsort(ids, kind="stable")
+    assert np.array_equal(perm, exp_perm)
+    exp_bounds = np.searchsorted(ids[exp_perm], np.arange(14))
+    assert np.array_equal(bounds, exp_bounds)
+    assert native_available()      # the image ships g++; must not fall back
+
+
+def test_partition_permutation_empty_and_single():
+    from spark_tpu.native.partition import partition_permutation
+    perm, bounds = partition_permutation(np.zeros(0, np.int64), 4)
+    assert len(perm) == 0 and list(bounds) == [0, 0, 0, 0, 0]
+    perm, bounds = partition_permutation(np.array([2, 2, 2], np.int64), 4)
+    assert list(perm) == [0, 1, 2]
+    assert list(bounds) == [0, 0, 0, 3, 3]

@@ -255,3 +255,46 @@ def test_nonmergeable_agg_over_stream(st, data):
     assert set(got) == set(exp_groups.groups)
     for k, g in exp_groups:
         assert got[k][0] == sorted(g.qty.tolist())
+
+
+@pytest.fixture()
+def stm(spark):
+    """Stage runner COMPOSED with the 8-device mesh."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    old = spark.conf.get(C.SCAN_MAX_BATCH_ROWS)
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(BATCH))
+    spark.conf.set("spark.tpu.mesh.shards", "8")
+    yield spark
+    spark.conf.set("spark.tpu.mesh.shards", "1")
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(old))
+
+
+def test_sharded_stage_star_join(stm, data):
+    """Broadcast-fused streamed join with the per-batch step running as
+    one shard_map program over the mesh (build sides replicated)."""
+    paths, pdfs = data
+    fact = stm.read.parquet(paths["fact"])
+    item = stm.read.parquet(paths["item"])
+    df = (fact.join(item, on="item_k").groupBy("brand")
+          .agg(F.sum("qty").alias("q"), F.count("sk").alias("n"))
+          .orderBy("brand"))
+    got = [tuple(r) for r in df.collect()]
+    m = pdfs["fact"].merge(pdfs["item"], on="item_k")
+    exp = m.groupby("brand", as_index=False).agg(
+        q=("qty", "sum"), n=("sk", "count")).sort_values("brand")
+    assert got == list(zip(exp.brand, exp.q, exp.n))
+
+
+def test_sharded_stage_grace_join(stm, data):
+    """Grace join under a distributed session: bucket-pair joins re-enter
+    the distributed executor; results match the single-shard path."""
+    paths, pdfs = data
+    fact = stm.read.parquet(paths["fact"])
+    rets = stm.read.parquet(paths["rets"])
+    q = (fact.join(rets, on=F.col("sk") == F.col("ret_sk"))
+         .agg(F.count("sk").alias("n"), F.sum("ret_qty").alias("s")))
+    (n, s), = q.collect()
+    exp = pdfs["fact"].merge(pdfs["rets"], left_on="sk", right_on="ret_sk")
+    assert (n, s) == (len(exp), int(exp.ret_qty.sum()))
