@@ -254,3 +254,10 @@ AGG_FOLD_ROWS = conf("spark.tpu.multibatch.aggFoldRows").doc(
     "Accumulated partial-aggregate rows that trigger an intermediate "
     "buffer-merge fold during a multi-batch aggregation."
 ).int(1 << 18)
+
+DEBUG_NANS = conf("spark.tpu.debug.nanChecks").doc(
+    "Enable jax_debug_nans for the session's process: XLA computations "
+    "fail loudly on NaN/Inf production instead of propagating them — the "
+    "numeric-debugging layer SURVEY §5 notes the reference lacks. Off by "
+    "default (SQL semantics legitimately produce NaN, e.g. 0.0/0.0)."
+).boolean(False)

@@ -140,6 +140,12 @@ class MemoryManager:
         with self._lock:
             self._execution.pop(owner, None)
 
+    def execution_held(self, owner: str) -> int:
+        """Bytes an owner still holds (0 = clean) — the post-task leak
+        check's locked accessor."""
+        with self._lock:
+            return self._execution.get(owner, 0)
+
     # -- storage pool -------------------------------------------------------
     def try_acquire_storage(self, key: str, nbytes: int) -> bool:
         with self._lock:

@@ -376,6 +376,8 @@ class QueryExecution:
         self.session._post_event({
             "event": "SQLExecutionStart", "time": t0,
             "plan": repr(self.optimized)[:500]})
+        self.session._query_count = \
+            getattr(self.session, "_query_count", 0) + 1
         try:
             result = self._execute_inner()
         except BaseException as e:
@@ -384,12 +386,29 @@ class QueryExecution:
                 "durationMs": (_time.time() - t0) * 1000,
                 "error": f"{type(e).__name__}: {e}"[:300]})
             raise
+        finally:
+            self._leak_check()
         self.session._post_event({
             "event": "SQLExecutionEnd", "time": _time.time(),
             "durationMs": (_time.time() - t0) * 1000,
             "metrics": {f"{oid}:{lbl}": v
                         for (oid, lbl), v in self.metrics.items()}})
         return result
+
+    def _leak_check(self) -> None:
+        """Post-query reservation leak check (`Executor.scala:342-357`
+        "Managed memory leak detected" idiom): every execution reservation
+        this query made must be released by now; a leak is released
+        loudly rather than starving later queries."""
+        mem = getattr(self.session, "_memory", None)
+        if mem is None:
+            return
+        owner = f"query:{id(self)}"
+        leaked = mem.execution_held(owner)
+        if leaked:
+            _log.warning("managed HBM leak detected: %s held %d B after "
+                         "execution; releasing", owner, leaked)
+            mem.release_execution(owner)
 
     def _execute_inner(self) -> ColumnBatch:
         self.session._last_qe = self      # metrics/explain introspection

@@ -289,6 +289,15 @@ class SparkSession:
         from ..memory import DeviceCacheManager, MemoryManager
         self._memory = MemoryManager(self.conf_obj)
         self._cache = DeviceCacheManager(self._memory, self.conf_obj)
+        self._query_count = 0
+        from ..metrics import MetricsSystem, default_sources
+        self._metrics_system = MetricsSystem(self.conf_obj)
+        for src in default_sources(self):
+            self._metrics_system.register_source(src)
+        self._metrics_system.start()
+        if self.conf_obj.get(C.DEBUG_NANS):
+            import jax
+            jax.config.update("jax_debug_nans", True)
         # pyspark semantics: constructing a session makes it the active one
         SparkSession._active = self
 
@@ -296,6 +305,12 @@ class SparkSession:
     def memoryManager(self):
         """HBM execution/storage accounting (UnifiedMemoryManager analog)."""
         return self._memory
+
+    @property
+    def metricsSystem(self):
+        """Process-gauge sources × sinks (`metrics/MetricsSystem.scala`
+        analog); `report()` snapshots on demand."""
+        return self._metrics_system
 
     @property
     def cacheManager(self):
@@ -346,6 +361,7 @@ class SparkSession:
 
     def stop(self) -> None:
         SparkSession._active = None
+        self._metrics_system.stop()
         self._jit_cache.clear()
         self._adapted_factors.clear()
         self._cache.clear()

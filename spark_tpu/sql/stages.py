@@ -332,6 +332,17 @@ class _MappedStream(BatchStream):
         from ..parallel.mesh import mesh_shards
         return shard_leaf(self.mesh, mesh_shards(self.mesh), b)
 
+    def _meta_key(self, b: ColumnBatch, extra) -> tuple:
+        """The capacities the compiled step traced with: under a mesh the
+        leaf is row-sharded, so the trace sees the PER-SHARD capacity."""
+        if self.mesh is None:
+            leaf_cap = b.capacity
+        else:
+            from ..parallel.mesh import mesh_shards
+            n = mesh_shards(self.mesh)
+            leaf_cap = pad_capacity(max(-(-b.capacity // n), 1))
+        return (leaf_cap,) + tuple(x.capacity for x in extra)
+
     def _run_step(self, compiled, b: ColumnBatch, phys_wrap=None):
         """Run one batch; on join overflow grow the positional factors,
         recompile, and retry THIS batch.  Returns (host runs, compiled)."""
@@ -340,9 +351,7 @@ class _MappedStream(BatchStream):
         base_f = self.session.conf.get(C.JOIN_OUTPUT_FACTOR)
         for _attempt in range(6):
             out, n, flags = jstep([self._leaf_to_device(b)] + extra)
-            meta_key = next(iter(meta)) if len(meta) == 1 else \
-                tuple(x.capacity for x in [b] + extra)
-            caps, kinds = meta.get(meta_key, ([], []))
+            caps, kinds = meta.get(self._meta_key(b, extra), ([], []))
             int_flags = [int(np.asarray(f)) for f in flags]
             if not any(f > 0 for f in int_flags):
                 return self._to_runs(out, n), (jstep, extra, meta)

@@ -1,0 +1,48 @@
+"""Shared oracle-comparison helpers for the TPC-DS harnesses.
+
+One source of truth for the sqlite dialect rewrite, value normalization,
+and the fact-table list — used by the in-memory sweep, the file-backed
+sweeps, the sharded smoke, and the mid-scale example (previously four
+divergent copies)."""
+
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as np
+
+__all__ = ["FACT_TABLES", "sqlite_text", "norm_value", "row_key"]
+
+FACT_TABLES = {"store_sales", "catalog_sales", "web_sales",
+               "store_returns", "catalog_returns", "web_returns",
+               "inventory"}
+
+
+def sqlite_text(sql: str) -> str:
+    """Adapt engine SQL to sqlite: expand STDDEV_SAMP via moments."""
+    return re.sub(
+        r"STDDEV_SAMP\((\w+)\)",
+        r"(CASE WHEN count(\1) > 1 THEN "
+        r"sqrt(max(sum(\1*\1*1.0) - count(\1)*avg(\1)*avg(\1), 0)"
+        r" / (count(\1) - 1)) ELSE NULL END)",
+        sql, flags=re.IGNORECASE)
+
+
+def norm_value(v):
+    """Engine/sqlite value → comparable canonical form."""
+    if v is None:
+        return None
+    if isinstance(v, (bool, np.bool_)):
+        return bool(v)
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        f = float(v)
+        return None if math.isnan(f) else round(f, 6)
+    return str(v)
+
+
+def row_key(row):
+    """NULL-stable sort key for order-insensitive row comparison."""
+    return tuple("\0" if x is None else str(x) for x in row)

@@ -124,3 +124,41 @@ def test_history_cli_main(spark, mdf, tmp_path, capsys):
     assert ui.main([d]) == 0
     printed = capsys.readouterr().out.strip()
     assert printed.endswith("history.html") and os.path.exists(printed)
+
+
+def test_metrics_system_sources_and_sinks(spark, mdf, tmp_path):
+    """MetricsSystem analog: process gauges snapshot on demand, console
+    and CSV sinks record them (`metrics/MetricsSystem.scala`)."""
+    import io as _io
+    from spark_tpu.metrics import ConsoleSink, CsvSink, Source
+    ms = spark.metricsSystem
+    before = ms.report().get("queries", {}).get("executed", 0)
+    mdf.count()
+    snaps = ms.report()
+    assert snaps["queries"]["executed"] >= before + 1
+    assert snaps["memory"]["hbm_budget_bytes"] > 0
+    # explicit sinks
+    buf = _io.StringIO()
+    ms.register_sink(ConsoleSink(buf))
+    csv_dir = str(tmp_path / "metrics_csv")
+    ms.register_sink(CsvSink(csv_dir))
+    ms.report()
+    ms.report()
+    assert "memory" in buf.getvalue()
+    rows = open(os.path.join(csv_dir, "queries.csv")).read().splitlines()
+    assert rows[0].startswith("timestamp") and len(rows) == 3
+    # custom source
+    ms.register_source(Source("custom", {"answer": lambda: 42}))
+    assert ms.report()["custom"]["answer"] == 42
+    ms._sinks = [s for s in ms._sinks
+                 if not isinstance(s, (ConsoleSink, CsvSink))]
+
+
+def test_memory_leak_check_releases(spark, mdf):
+    """Executor.scala's 'managed memory leak detected' idiom: a leaked
+    execution reservation is detected and released after the query."""
+    from spark_tpu.sql.planner import QueryExecution
+    qe = QueryExecution(spark, mdf._plan)
+    spark._memory.acquire_execution(f"query:{id(qe)}", 1234)
+    qe.execute()
+    assert f"query:{id(qe)}" not in spark._memory._execution

@@ -7,7 +7,6 @@ for sqlite, which lacks it.
 """
 
 import math
-import re
 import sqlite3
 
 import numpy as np
@@ -15,18 +14,10 @@ import pytest
 
 from spark_tpu.tpcds import (QUERIES, ORACLE_OVERRIDES, RUNNABLE,
                              PENDING, generate)
+from spark_tpu.tpcds.oracle import norm_value as _norm, row_key as _key, \
+    sqlite_text as _sqlite_text
 
 SF_ROWS = 20_000
-
-
-def _sqlite_text(sql: str) -> str:
-    """Adapt engine SQL to sqlite: expand STDDEV_SAMP via moments."""
-    return re.sub(
-        r"STDDEV_SAMP\((\w+)\)",
-        r"(CASE WHEN count(\1) > 1 THEN "
-        r"sqrt(max(sum(\1*\1*1.0) - count(\1)*avg(\1)*avg(\1), 0)"
-        r" / (count(\1) - 1)) ELSE NULL END)",
-        sql, flags=re.IGNORECASE)
 
 
 @pytest.fixture(scope="module")
@@ -41,23 +32,6 @@ def tpcds(spark):
     con.close()
     for name in tables:
         spark.catalog.dropTempView(name)
-
-
-def _norm(v):
-    if v is None:
-        return None
-    if isinstance(v, (bool, np.bool_)):
-        return bool(v)
-    if isinstance(v, (int, np.integer)):
-        return int(v)
-    if isinstance(v, (float, np.floating)):
-        f = float(v)
-        return None if math.isnan(f) else round(f, 6)
-    return str(v)
-
-
-def _key(row):
-    return tuple("\0" if x is None else str(x) for x in row)
 
 
 def _compare(got, exp, qname):

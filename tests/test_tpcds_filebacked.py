@@ -17,14 +17,15 @@ in the suite.
 
 import math
 import os
-import re
 import sqlite3
 
-import numpy as np
 import pytest
 
 import spark_tpu.config as C
 from spark_tpu.tpcds import ORACLE_OVERRIDES, QUERIES, RUNNABLE, generate
+from spark_tpu.tpcds.oracle import (FACT_TABLES as FACTS,
+                                    norm_value as _norm, row_key as _key,
+                                    sqlite_text as _sqlite_text)
 
 SF_ROWS = 20_000
 BATCH = 4096            # facts stream in ~5 batches
@@ -33,19 +34,6 @@ FULL = os.environ.get("SPARK_TPU_FILE_SWEEP", "") == "1"
 SMOKE = ["q3", "q7", "q17", "q19", "q25", "q42", "q52", "q55", "q68",
          "q79", "q96", "q98"]
 SWEEP = RUNNABLE if FULL else SMOKE
-
-FACTS = {"store_sales", "catalog_sales", "web_sales", "store_returns",
-         "catalog_returns", "web_returns", "inventory"}
-
-
-def _sqlite_text(sql: str) -> str:
-    return re.sub(
-        r"STDDEV_SAMP\((\w+)\)",
-        r"(CASE WHEN count(\1) > 1 THEN "
-        r"sqrt(max(sum(\1*\1*1.0) - count(\1)*avg(\1)*avg(\1), 0)"
-        r" / (count(\1) - 1)) ELSE NULL END)",
-        sql, flags=re.IGNORECASE)
-
 
 @pytest.fixture(scope="module")
 def fb(spark, tmp_path_factory):
@@ -73,23 +61,6 @@ def fb(spark, tmp_path_factory):
     con.close()
     for name in tables:
         spark.catalog.dropTempView(name)
-
-
-def _norm(v):
-    if v is None:
-        return None
-    if isinstance(v, (bool, np.bool_)):
-        return bool(v)
-    if isinstance(v, (int, np.integer)):
-        return int(v)
-    if isinstance(v, (float, np.floating)):
-        f = float(v)
-        return None if math.isnan(f) else round(f, 6)
-    return str(v)
-
-
-def _key(row):
-    return tuple("\0" if x is None else str(x) for x in row)
 
 
 @pytest.mark.parametrize("qname", SWEEP)

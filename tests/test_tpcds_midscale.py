@@ -12,14 +12,14 @@ pruned scans) and still match the sqlite oracle — the
 
 import math
 import os
-import re
 import sqlite3
 
-import numpy as np
 import pytest
 
 import spark_tpu.config as C
 from spark_tpu.tpcds import QUERIES, generate
+from spark_tpu.tpcds.oracle import (norm_value as _norm, row_key as _key,
+                                    sqlite_text as _sqlite_text)
 
 SF_ROWS = 120_000       # store_sales rows; catalog_sales 60k, web 30k
 BATCH = 1 << 14         # 16k rows/batch → store_sales streams in 8 batches
@@ -28,15 +28,6 @@ BATCH = 1 << 14         # 16k rows/batch → store_sales streams in 8 batches
 #: big fact (q3, q42), fact⋈fact⋈fact grace joins (q17), and a
 #: big-fact semi-ish filter pipeline (q55)
 MID_QUERIES = ["q3", "q42", "q55", "q17"]
-
-
-def _sqlite_text(sql: str) -> str:
-    return re.sub(
-        r"STDDEV_SAMP\((\w+)\)",
-        r"(CASE WHEN count(\1) > 1 THEN "
-        r"sqrt(max(sum(\1*\1*1.0) - count(\1)*avg(\1)*avg(\1), 0)"
-        r" / (count(\1) - 1)) ELSE NULL END)",
-        sql, flags=re.IGNORECASE)
 
 
 @pytest.fixture(scope="module")
@@ -67,23 +58,6 @@ def mid(spark, tmp_path_factory):
     con.close()
     for name in tables:
         spark.catalog.dropTempView(name)
-
-
-def _norm(v):
-    if v is None:
-        return None
-    if isinstance(v, (bool, np.bool_)):
-        return bool(v)
-    if isinstance(v, (int, np.integer)):
-        return int(v)
-    if isinstance(v, (float, np.floating)):
-        f = float(v)
-        return None if math.isnan(f) else round(f, 6)
-    return str(v)
-
-
-def _key(row):
-    return tuple("\0" if x is None else str(x) for x in row)
 
 
 @pytest.mark.parametrize("qname", MID_QUERIES)
