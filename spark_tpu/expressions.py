@@ -2195,6 +2195,212 @@ class ElementAt(Expression):
         return f"element_at({self.children[0]!r}, {self.index})"
 
 
+class LambdaVar(Expression):
+    """Lambda placeholder bound by a higher-order array function to the
+    ELEMENT PLANE (`higherOrderFunctions.scala`'s NamedLambdaVariable).
+
+    Evaluates to the whole ``(capacity, max_len)`` plane — element-wise
+    lambdas become plain vectorized ops over it, which is exactly the
+    TPU-friendly shape.  ``dtype`` is bound by the enclosing function at
+    type-resolution time (deterministic, planning-only mutation)."""
+
+    _counter = [0]
+
+    def __init__(self, name: str = "x"):
+        self.children = ()
+        LambdaVar._counter[0] += 1
+        self._name = f"{name}#{LambdaVar._counter[0]}"
+        self.dtype: Optional[T.DataType] = None
+        self.dictionary = None
+
+    @property
+    def name(self):
+        return self._name
+
+    def references(self) -> set:
+        return set()                   # bound, not a column reference
+
+    def data_type(self, schema):
+        if self.dtype is None:
+            raise AnalysisException(
+                f"lambda variable {self._name} used outside its "
+                "higher-order function")
+        return self.dtype
+
+    def eval(self, ctx):
+        bound = getattr(ctx, "lambda_bindings", {}).get(self._name)
+        if bound is None:
+            raise AnalysisException(
+                f"lambda variable {self._name} evaluated without a "
+                "binding")
+        return bound
+
+    def __repr__(self):
+        return self._name.split("#")[0]
+
+
+class _HigherOrder(Expression):
+    """Shared machinery: bind the element plane, evaluate the body
+    vectorized over it."""
+
+    def __init__(self, child: Expression, var: LambdaVar, body: Expression):
+        self.children = (child,)
+        self.var = var
+        self.body = body
+        extra = body.references()
+        if extra:
+            raise AnalysisException(
+                f"lambda body may reference only the lambda variable and "
+                f"literals in this engine (vectorized element-plane "
+                f"evaluation); found column refs {sorted(extra)}")
+
+    def map_children(self, fn):
+        return type(self)(fn(self.children[0]), self.var, self.body)
+
+    def _array_type(self, schema) -> "T.ArrayType":
+        ct = self.children[0].data_type(schema)
+        if not isinstance(ct, T.ArrayType):
+            raise AnalysisException(
+                f"{type(self).__name__} expects an array, got {ct}")
+        self.var.dtype = ct.element_type
+        return ct
+
+    def _plane(self, ctx):
+        """(value ExprValue over the plane, element mask, array ExprValue)."""
+        xp = ctx.xp
+        dt = self.children[0].data_type(ctx.batch.schema)
+        self.var.dtype = dt.element_type
+        v = self.children[0].eval(ctx)
+        mask = _array_elem_mask(xp, dt, v.data)
+        bound = ExprValue(v.data, None, v.dictionary)
+        bindings = dict(getattr(ctx, "lambda_bindings", {}))
+        bindings[self.var._name] = bound
+        sub = EvalContext(ctx.batch, xp)
+        sub.lambda_bindings = bindings
+        out = self.body.eval(sub)
+        return out, mask, v
+
+
+class ArrayTransform(_HigherOrder):
+    """transform(arr, x -> expr): elementwise map over the plane."""
+
+    @property
+    def name(self):
+        return f"transform({self.children[0].name}, " \
+               f"{self.var!r} -> {self.body.name})"
+
+    def data_type(self, schema):
+        self._array_type(schema)
+        et = self.body.data_type(schema)
+        if et.is_string:
+            raise AnalysisException(
+                "transform to string elements is not supported yet")
+        if isinstance(et, T.BooleanType):
+            et = T.int32           # bool arrays have no sentinel; widen
+        return T.ArrayType(et)
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        out, mask, v = self._plane(ctx)
+        odt = self.data_type(ctx.batch.schema)
+        sent = odt.element_sentinel()
+        data = xp.asarray(out.data).astype(odt.element_type.np_dtype)
+        ok = mask if out.valid is None else (mask & out.valid)
+        data = xp.where(ok, data, sent)
+        return ExprValue(data, v.valid)
+
+    def __repr__(self):
+        return f"transform({self.children[0]!r}, {self.var!r} -> " \
+               f"{self.body!r})"
+
+
+class ArrayFilterFn(_HigherOrder):
+    """filter(arr, x -> pred): keep matching elements, COMPACTED to a
+    prefix (positional ops like element_at assume live-prefix layout)."""
+
+    @property
+    def name(self):
+        return f"filter({self.children[0].name}, " \
+               f"{self.var!r} -> {self.body.name})"
+
+    def data_type(self, schema):
+        ct = self._array_type(schema)
+        bt = self.body.data_type(schema)
+        if not isinstance(bt, T.BooleanType):
+            raise AnalysisException(
+                f"filter lambda must return boolean, got {bt} "
+                f"({self.body!r})")
+        return ct
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        out, mask, v = self._plane(ctx)
+        dt = self.children[0].data_type(ctx.batch.schema)
+        sent = dt.element_sentinel()
+        pred = xp.asarray(out.data).astype(bool)
+        if out.valid is not None:
+            pred = pred & out.valid
+        keep = mask & pred
+        # stable compaction: live elements first, original order kept
+        if xp is np:
+            order = np.argsort(~keep, axis=-1, kind="stable")
+        else:
+            order = xp.argsort(~keep, axis=-1, stable=True)
+        data = xp.take_along_axis(v.data, order, axis=-1)
+        kept = xp.take_along_axis(keep, order, axis=-1)
+        data = xp.where(kept, data, sent)
+        return ExprValue(data, v.valid, v.dictionary)
+
+    def __repr__(self):
+        return f"filter({self.children[0]!r}, {self.var!r} -> " \
+               f"{self.body!r})"
+
+
+class ArrayExists(_HigherOrder):
+    """exists(arr, x -> pred) / forall(arr, x -> pred)."""
+
+    def __init__(self, child, var, body, require_all: bool = False):
+        super().__init__(child, var, body)
+        self.require_all = require_all
+
+    def map_children(self, fn):
+        return ArrayExists(fn(self.children[0]), self.var, self.body,
+                           self.require_all)
+
+    @property
+    def name(self):
+        kind = "forall" if self.require_all else "exists"
+        return f"{kind}({self.children[0].name}, " \
+               f"{self.var!r} -> {self.body.name})"
+
+    def data_type(self, schema):
+        self._array_type(schema)
+        bt = self.body.data_type(schema)
+        if not isinstance(bt, T.BooleanType):
+            kind = "forall" if self.require_all else "exists"
+            raise AnalysisException(
+                f"{kind} lambda must return boolean, got {bt} "
+                f"({self.body!r})")
+        return T.boolean
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        out, mask, v = self._plane(ctx)
+        pred = xp.asarray(out.data).astype(bool)
+        if out.valid is not None:
+            pred = pred & out.valid
+        if self.require_all:
+            res = xp.all(pred | ~mask, axis=-1)
+        else:
+            res = xp.any(pred & mask, axis=-1)
+        return ExprValue(res, v.valid)
+
+    def __repr__(self):
+        kind = "forall" if self.require_all else "exists"
+        return f"{kind}({self.children[0]!r}, {self.var!r} -> " \
+               f"{self.body!r})"
+
+
 class ArrayContains(Expression):
     """array_contains(arr, literal)."""
 

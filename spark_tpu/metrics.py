@@ -79,12 +79,21 @@ class CsvSink:
         now = time.time()
         for source, gauges in snapshots.items():
             path = os.path.join(self.dir, f"{source}.csv")
-            fresh = not os.path.exists(path)
             keys = sorted(gauges)
+            header = ["timestamp"] + keys
+            fresh = not os.path.exists(path)
+            if not fresh:
+                with open(path, newline="") as f:
+                    old = next(csv.reader(f), None)
+                if old != header:
+                    # gauge set changed (new engine version / source
+                    # redefinition): rotate rather than misalign columns
+                    os.replace(path, f"{path}.{int(now)}.old")
+                    fresh = True
             with open(path, "a", newline="") as f:
                 w = csv.writer(f)
                 if fresh:
-                    w.writerow(["timestamp"] + keys)
+                    w.writerow(header)
                 w.writerow([round(now, 3)] + [gauges[k] for k in keys])
 
 
@@ -125,6 +134,7 @@ class MetricsSystem:
     def start(self) -> None:
         if self._thread is not None or not self._sinks:
             return
+        self._stop.clear()             # start() after stop() must restart
         period = self.conf.get(METRICS_PERIOD) if self.conf else 10
 
         def loop():

@@ -548,6 +548,39 @@ def array_contains(c: ColumnOrName, value: Any) -> Column:
     return Column(E.ArrayContains(_e(c), value))
 
 
+def _lambda_body(f) -> tuple:
+    """(LambdaVar, body expression) from a Python ``lambda x: Column``
+    (the DataFrame-API half of `higherOrderFunctions.scala`)."""
+    var = E.LambdaVar("x")
+    out = f(Column(var))
+    if not isinstance(out, Column):
+        raise E.AnalysisException(
+            "higher-order function lambda must return a Column")
+    return var, _e(out)
+
+
+def transform(c: ColumnOrName, f) -> Column:
+    """transform(arr, x -> expr): elementwise map — the lambda evaluates
+    VECTORIZED over the whole (capacity, max_len) element plane."""
+    var, body = _lambda_body(f)
+    return Column(E.ArrayTransform(_e(c), var, body))
+
+
+def filter(c: ColumnOrName, f) -> Column:     # noqa: A001 (pyspark name)
+    var, body = _lambda_body(f)
+    return Column(E.ArrayFilterFn(_e(c), var, body))
+
+
+def exists(c: ColumnOrName, f) -> Column:
+    var, body = _lambda_body(f)
+    return Column(E.ArrayExists(_e(c), var, body))
+
+
+def forall(c: ColumnOrName, f) -> Column:
+    var, body = _lambda_body(f)
+    return Column(E.ArrayExists(_e(c), var, body, require_all=True))
+
+
 def explode(c: ColumnOrName) -> Column:
     return Column(E.ExplodeMarker(_e(c)))
 
@@ -557,7 +590,8 @@ def posexplode(c: ColumnOrName) -> Column:
 
 
 __all__ += ["array", "split", "size", "element_at", "array_contains",
-            "explode", "posexplode"]
+            "explode", "posexplode", "transform", "filter", "exists",
+            "forall"]
 
 
 def collect_list(c: ColumnOrName) -> Column:

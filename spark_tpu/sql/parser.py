@@ -65,7 +65,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<string>'(?:[^'\\]|\\.|'')*'|"(?:[^"\\]|\\.)*")
   | (?P<bq>`[^`]*`)
   | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
-  | (?P<op><=>|<>|!=|<=|>=|==|\|\||[=<>+\-*/%(),.])
+  | (?P<op><=>|<>|!=|<=|>=|==|->|\|\||[=<>+\-*/%(),.])
 """, re.VERBOSE)
 
 KEYWORDS = {
@@ -1284,11 +1284,21 @@ class Parser:
             return Cast(e, to)
         if t.kind == "KW" and t.value == "EXISTS":
             self.next()
-            from .subquery import ExistsSubquery
             self.expect_op("(")
-            sub = self.parse_query()
+            if self.at_kw("SELECT") or self.at_kw("WITH"):
+                from .subquery import ExistsSubquery
+                sub = self.parse_query()
+                self.expect_op(")")
+                return ExistsSubquery(sub)
+            # exists(arr, x -> pred): the higher-order array function
+            # (SubqueryExpression vs higherOrderFunctions disambiguate
+            # the same way in the reference grammar)
+            from ..expressions import ArrayExists
+            arr = self.expr()
+            self.expect_op(",")
+            var, body = self._lambda_arg()
             self.expect_op(")")
-            return ExistsSubquery(sub)
+            return ArrayExists(arr, var, body)
         if self.accept_op("("):
             if self.at_kw("SELECT") or self.at_kw("WITH"):
                 from .subquery import ScalarSubquery
@@ -1351,9 +1361,47 @@ class Parser:
             raise ParseException("CASE requires at least one WHEN branch")
         return CaseWhen(branches, otherwise)
 
+    _HOF_NAMES = {"transform": "transform", "filter": "filter",
+                  "exists": "exists", "forall": "forall"}
+
+    def _lambda_arg(self):
+        """`x -> expr` (higherOrderFunctions.scala lambda syntax)."""
+        from ..expressions import LambdaVar
+        t = self.peek()
+        if t.kind != "IDENT":
+            raise ParseException(
+                f"expected lambda variable, got {t.value!r}")
+        self.next()
+        self.expect_op("->")
+        var = LambdaVar(t.value)
+        # the body may reference the variable by its SOURCE name: parse,
+        # then substitute Col(name) -> the bound LambdaVar
+        body = self.expr()
+
+        def sub(e):
+            if isinstance(e, Col) and e.name.lower() == t.value.lower():
+                return var
+            return e.map_children(sub)
+
+        return var, sub(body)
+
     def _function_call(self, name: str) -> Expression:
         self.expect_op("(")
         lname = name.lower()
+        if lname in self._HOF_NAMES:
+            from ..expressions import (
+                ArrayExists, ArrayFilterFn, ArrayTransform,
+            )
+            arr = self.expr()
+            self.expect_op(",")
+            var, body = self._lambda_arg()
+            self.expect_op(")")
+            if lname == "transform":
+                return ArrayTransform(arr, var, body)
+            if lname == "filter":
+                return ArrayFilterFn(arr, var, body)
+            return ArrayExists(arr, var, body,
+                               require_all=(lname == "forall"))
         distinct = False
         args: List[Expression] = []
         if not self.accept_op(")"):

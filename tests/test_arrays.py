@@ -162,3 +162,105 @@ def test_float_cast_saturates(spark):
     assert rows["i"] == (1 << 31) - 1
     assert rows["nb"] == -(1 << 63)
     assert rows["t"] == 44    # (byte)(int)300.5: 300 % 256 = 44
+
+
+# ---------------------------------------------------------------------------
+# higher-order functions (higherOrderFunctions.scala analog): lambdas run
+# VECTORIZED over the (capacity, max_len) element plane
+# ---------------------------------------------------------------------------
+
+def _hof_df(spark):
+    return spark.createDataFrame(
+        [(1, [1, 2, 3]), (2, [10]), (3, []), (4, [5, -5, 7])],
+        ["id", "xs"])
+
+
+def test_transform_elementwise(spark):
+    df = _hof_df(spark)
+    got = {r["id"]: r["ys"] for r in
+           df.select("id", F.transform("xs", lambda x: x * 2 + 1)
+                     .alias("ys")).collect()}
+    assert got == {1: [3, 5, 7], 2: [21], 3: [], 4: [11, -9, 15]}
+
+
+def test_transform_to_float(spark):
+    df = _hof_df(spark)
+    got = {r["id"]: r["ys"] for r in
+           df.select("id", F.transform("xs", lambda x: x / 2.0)
+                     .alias("ys")).collect()}
+    assert got[1] == [0.5, 1.0, 1.5] and got[3] == []
+
+
+def test_filter_compacts(spark):
+    df = _hof_df(spark)
+    sel = df.select("id", F.filter("xs", lambda x: x > 0).alias("ys"))
+    got = {r["id"]: r["ys"] for r in sel.collect()}
+    assert got == {1: [1, 2, 3], 2: [10], 3: [], 4: [5, 7]}
+    # positional ops stay correct after compaction
+    got2 = {r["id"]: r["e"] for r in
+            sel.select("id", F.element_at("ys", 2).alias("e")).collect()}
+    assert got2 == {1: 2, 2: None, 3: None, 4: 7}
+
+
+def test_exists_forall(spark):
+    df = _hof_df(spark)
+    got = {r["id"]: (r["any_neg"], r["all_pos"]) for r in df.select(
+        "id",
+        F.exists("xs", lambda x: x < 0).alias("any_neg"),
+        F.forall("xs", lambda x: x > 0).alias("all_pos")).collect()}
+    assert got == {1: (False, True), 2: (False, True),
+                   3: (False, True), 4: (True, False)}
+
+
+def test_transform_bool_body_widens(spark):
+    df = _hof_df(spark)
+    got = {r["id"]: r["ys"] for r in
+           df.select("id", F.transform("xs", lambda x: x > 2)
+                     .alias("ys")).collect()}
+    assert got[1] == [0, 0, 1] and got[4] == [1, 0, 1]
+
+
+def test_lambda_body_rejects_column_refs(spark):
+    df = _hof_df(spark)
+    import pytest
+    from spark_tpu.expressions import AnalysisException
+    with pytest.raises(AnalysisException, match="lambda body"):
+        df.select(F.transform("xs", lambda x: x + F.col("id"))).collect()
+
+
+def test_hof_under_jit_and_interpreted(spark):
+    import spark_tpu.config as C
+    df = _hof_df(spark)
+    q = df.select(F.size(F.filter("xs", lambda x: x % 2 == 1))
+                  .alias("n")).orderBy("n")
+    jit_rows = [r["n"] for r in q.collect()]
+    spark.conf.set(C.CODEGEN_ENABLED.key, "false")
+    try:
+        interp_rows = [r["n"] for r in q.collect()]
+    finally:
+        spark.conf.set(C.CODEGEN_ENABLED.key, "true")
+    assert jit_rows == interp_rows == [0, 0, 2, 2]
+
+
+def test_hof_sql_lambda_syntax(spark):
+    _hof_df(spark).createOrReplaceTempView("hof")
+    rows = spark.sql(
+        "SELECT id, transform(xs, x -> x * 10) AS t, "
+        "size(filter(xs, e -> e > 1)) AS nf, "
+        "exists(xs, y -> y < 0) AS neg, "
+        "forall(xs, z -> z > 0) AS pos "
+        "FROM hof ORDER BY id").collect()
+    got = {r["id"]: (r["t"], r["nf"], r["neg"], r["pos"]) for r in rows}
+    assert got[1] == ([10, 20, 30], 2, False, True)
+    assert got[4] == ([50, -50, 70], 2, True, False)
+    spark.catalog.dropTempView("hof")
+
+
+def test_filter_lambda_must_be_boolean(spark):
+    import pytest
+    from spark_tpu.expressions import AnalysisException
+    df = _hof_df(spark)
+    with pytest.raises(AnalysisException, match="boolean"):
+        df.select(F.filter("xs", lambda x: x + 1)).collect()
+    with pytest.raises(AnalysisException, match="boolean"):
+        df.select(F.exists("xs", lambda x: x * 2)).collect()

@@ -16,7 +16,7 @@ import jax
 import spark_tpu.config as C
 from spark_tpu.tpcds import QUERIES, generate
 from spark_tpu.tpcds.oracle import FACT_TABLES as FACTS, \
-    norm_value as _norm
+    norm_value as _norm, row_key as _key
 
 pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs 8 virtual devices")
@@ -56,11 +56,9 @@ def test_sharded_filebacked_query(sh, qname):
     spark, con = sh
     sql = QUERIES[qname]
     got = sorted((tuple(_norm(v) for v in r)
-                  for r in spark.sql(sql).collect()),
-                 key=lambda t: tuple(map(str, t)))
+                  for r in spark.sql(sql).collect()), key=_key)
     exp = sorted((tuple(_norm(v) for v in r)
-                  for r in con.execute(sql).fetchall()),
-                 key=lambda t: tuple(map(str, t)))
+                  for r in con.execute(sql).fetchall()), key=_key)
     assert exp, f"{qname}: oracle returned no rows"
     assert len(got) == len(exp), (qname, len(got), len(exp))
     for g, e in zip(got, exp):
