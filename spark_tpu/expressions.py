@@ -2342,10 +2342,8 @@ class ArrayFilterFn(_HigherOrder):
             pred = pred & out.valid
         keep = mask & pred
         # stable compaction: live elements first, original order kept
-        if xp is np:
-            order = np.argsort(~keep, axis=-1, kind="stable")
-        else:
-            order = xp.argsort(~keep, axis=-1, stable=True)
+        # (same idiom as MakeArray's null compaction)
+        order = xp.argsort(~keep, axis=-1, stable=True)
         data = xp.take_along_axis(v.data, order, axis=-1)
         kept = xp.take_along_axis(keep, order, axis=-1)
         data = xp.where(kept, data, sent)

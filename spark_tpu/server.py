@@ -1,0 +1,159 @@
+"""SQL-over-HTTP serving endpoint.
+
+The serving role of the reference's `sql/hive-thriftserver` (71.7k LoC
+of HiveServer2 protocol) re-based on the one wire format every client
+already speaks: POST a SQL string, receive JSON rows.  Sessions execute
+serially under a lock (the engine's jit/plan caches are per-session
+state, exactly like a Thrift session handle); the server is a thin
+stateless shell over one SparkSession, matching the
+"filesystem-catalog + CLI" Hive divergence recorded in
+docs/DECISIONS.md.
+
+    python -m spark_tpu.server --port 8123 &
+    curl -d 'SELECT 1 AS x' localhost:8123/sql
+
+Endpoints:
+    POST /sql      body = SQL text (or JSON {"query": ...}) → JSON
+                   {"columns", "rows", "rowCount", "durationMs"}
+    GET  /status   engine version, query counter, metrics snapshot
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+__all__ = ["SQLServer"]
+
+
+def _json_safe(v: Any):
+    if isinstance(v, float):
+        # RFC 8259 has no NaN/Infinity literals; strict clients reject them
+        if v != v:
+            return None
+        if v in (float("inf"), float("-inf")):
+            return str(v)
+        return v
+    if v is None or isinstance(v, (bool, int, str)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    return str(v)
+
+
+class SQLServer:
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 8123):
+        self.session = session
+        self.host = host
+        self.port = port
+        self._lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request handling ------------------------------------------------
+    def _run_sql(self, text: str) -> dict:
+        t0 = time.time()
+        with self._lock:                 # session state is single-writer
+            df = self.session.sql(text)
+            columns = list(df.schema.names)
+            rows = [[_json_safe(v) for v in r] for r in df.collect()]
+        return {"columns": columns, "rows": rows, "rowCount": len(rows),
+                "durationMs": round((time.time() - t0) * 1000, 1)}
+
+    def _status(self) -> dict:
+        return {
+            "version": self.session.version,
+            "queriesExecuted": getattr(self.session, "_query_count", 0),
+            "metrics": self.session.metricsSystem.snapshots(),
+        }
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *_a):      # quiet by default
+                pass
+
+            def _reply(self, code: int, payload: dict):
+                body = json.dumps(payload, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.rstrip("/") in ("", "/status"):
+                    self._reply(200, server._status())
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path.rstrip("/") != "/sql":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n).decode("utf-8", "replace")
+                text = raw
+                if raw.lstrip().startswith("{"):
+                    try:
+                        text = json.loads(raw).get("query", "")
+                    except json.JSONDecodeError:
+                        pass
+                if not text.strip():
+                    self._reply(400, {"error": "empty query"})
+                    return
+                try:
+                    self._reply(200, server._run_sql(text))
+                except Exception as e:    # noqa: BLE001 — surface to client
+                    self._reply(400, {
+                        "error": f"{type(e).__name__}: {e}"[:2000]})
+
+        return Handler
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "SQLServer":
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          self._make_handler())
+        self.port = self._httpd.server_address[1]     # resolve port 0
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"sql-server-{self.port}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8123)
+    args = ap.parse_args(argv)
+
+    from .sql.session import SparkSession
+    session = SparkSession.builder.appName("sql-server").getOrCreate()
+    srv = SQLServer(session, args.host, args.port).start()
+    print(f"spark_tpu SQL server on http://{srv.host}:{srv.port} "
+          f"(POST /sql, GET /status)")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1361,8 +1361,9 @@ class Parser:
             raise ParseException("CASE requires at least one WHEN branch")
         return CaseWhen(branches, otherwise)
 
-    _HOF_NAMES = {"transform": "transform", "filter": "filter",
-                  "exists": "exists", "forall": "forall"}
+    # `exists` is a KEYWORD (subquery predicate) and reaches the HOF
+    # path through the dedicated EXISTS branch in _primary, never here
+    _HOF_NAMES = frozenset({"transform", "filter", "forall"})
 
     def _lambda_arg(self):
         """`x -> expr` (higherOrderFunctions.scala lambda syntax)."""

@@ -1,0 +1,73 @@
+"""SQL-over-HTTP server (the thrift-server serving role, DECISIONS.md
+Hive divergence): POST SQL → JSON rows, GET /status → gauges."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from spark_tpu.server import SQLServer
+
+
+@pytest.fixture()
+def server(spark):
+    srv = SQLServer(spark, port=0).start()
+    yield srv
+    srv.stop()
+
+
+def _post(srv, body: str):
+    req = urllib.request.Request(
+        f"http://{srv.host}:{srv.port}/sql", data=body.encode(),
+        method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_sql_roundtrip(server):
+    out = _post(server, "SELECT id, id * 2 AS y FROM range(4) ORDER BY id")
+    assert out["columns"] == ["id", "y"]
+    assert out["rows"] == [[0, 0], [1, 2], [2, 4], [3, 6]]
+    assert out["rowCount"] == 4 and out["durationMs"] >= 0
+
+
+def test_sql_json_body_and_views(server, spark):
+    spark.sql("SELECT 7 AS seven").createOrReplaceTempView("sv")
+    out = _post(server, json.dumps({"query": "SELECT seven + 1 FROM sv"}))
+    assert out["rows"] == [[8]]
+    spark.catalog.dropTempView("sv")
+
+
+def test_sql_error_is_json_400(server):
+    req = urllib.request.Request(
+        f"http://{server.host}:{server.port}/sql",
+        data=b"SELECT FROM nothing", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 400
+    assert "error" in json.loads(ei.value.read())
+
+
+def test_status(server):
+    with urllib.request.urlopen(
+            f"http://{server.host}:{server.port}/status", timeout=30) as r:
+        st = json.loads(r.read())
+    assert st["queriesExecuted"] >= 0
+    assert "memory" in st["metrics"]
+
+
+def test_concurrent_posts(server):
+    import concurrent.futures as cf
+    with cf.ThreadPoolExecutor(8) as ex:
+        outs = list(ex.map(
+            lambda i: _post(server,
+                            f"SELECT SUM(id) AS s FROM range({i + 1})"),
+            range(8)))
+    assert [o["rows"][0][0] for o in outs] == \
+        [sum(range(i + 1)) for i in range(8)]
+
+
+def test_nan_results_are_valid_json(server):
+    out = _post(server, "SELECT 0.0 / 0.0 AS x, 1.0 AS y")
+    assert out["rows"] == [[None, 1.0]]      # NaN -> JSON null
