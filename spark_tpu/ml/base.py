@@ -97,9 +97,9 @@ class Params:
     # -- persistence ------------------------------------------------------
     def _save_params(self, path: str, extra: Optional[dict] = None) -> None:
         os.makedirs(path, exist_ok=True)
-        payload = {"class": type(self).__name__,
-                   "params": {k: v for k, v in self._values.items()
-                              if _json_ok(v)}}
+        ok = {k: v for k, v in self._values.items() if _json_ok(v)}
+        payload = {"class": type(self).__name__, "params": ok,
+                   "dropped": sorted(set(self._values) - set(ok))}
         if extra:
             payload.update(extra)
         with open(os.path.join(path, "metadata.json"), "w") as f:
@@ -107,6 +107,24 @@ class Params:
 
     def save(self, path: str) -> None:
         self._save_params(path)
+
+    @classmethod
+    def load(cls, path: str):
+        """Rebuild from a saved metadata.json (MLReader analog).  Numpy
+        params round-trip as lists; transform paths re-asarray them."""
+        with open(os.path.join(path, "metadata.json")) as f:
+            payload = json.load(f)
+        saved = payload.get("class")
+        if saved and saved != cls.__name__:
+            raise AnalysisException(
+                f"{path} holds a {saved}, not a {cls.__name__}")
+        dropped = payload.get("dropped") or []
+        if dropped:
+            raise AnalysisException(
+                f"{saved or cls.__name__} at {path} was saved WITHOUT "
+                f"non-JSON params {dropped}; it cannot be reconstructed "
+                "by load() (save such models via pickle or refit)")
+        return cls(**payload.get("params", {}))
 
     def write(self):
         return _Writer(self)

@@ -20,7 +20,10 @@ from .base import (
 )
 
 __all__ = ["LogisticRegression", "LogisticRegressionModel", "LinearSVC",
-           "LinearSVCModel", "NaiveBayes", "NaiveBayesModel"]
+           "LinearSVCModel", "NaiveBayes", "NaiveBayesModel",
+           "DecisionTreeClassifier", "DecisionTreeClassificationModel",
+           "RandomForestClassifier", "RandomForestClassificationModel",
+           "GBTClassifier", "GBTClassificationModel"]
 
 
 class LogisticRegression(Estimator):
@@ -202,8 +205,139 @@ class NaiveBayesModel(Model):
 
     def transform(self, df):
         X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
-        scores = np.asarray(X) @ self.getOrDefault("logLikelihood").T \
-            + self.getOrDefault("logPrior")
+        scores = np.asarray(X) @ np.asarray(
+            self.getOrDefault("logLikelihood")).T \
+            + np.asarray(self.getOrDefault("logPrior"))
         pred = np.asarray(self.getOrDefault("classes"))[scores.argmax(axis=1)]
         return append_prediction(df, batch, n, pred.astype(np.float64),
                                  self.getOrDefault("predictionCol"), T.float64)
+
+
+class DecisionTreeClassifier(Estimator):
+    """Gini-impurity tree (`ml/classification/DecisionTreeClassifier.scala`
+    over the shared `tree.py` grower)."""
+
+    maxDepth = Param("maxDepth", "max depth", 5)
+    minInstancesPerNode = Param("minInstancesPerNode", "", 1)
+
+    def _fit(self, df):
+        from .tree import grow_tree
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        y = np.asarray(extract_column(batch, self.getOrDefault("labelCol"),
+                                      n))
+        tree = grow_tree(np.asarray(X), y, self.getOrDefault("maxDepth"),
+                         self.getOrDefault("minInstancesPerNode"),
+                         impurity="gini")
+        return DecisionTreeClassificationModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"), tree=tree)
+
+
+class DecisionTreeClassificationModel(Model):
+    tree = Param("tree", "", None)
+
+    def transform(self, df):
+        from .tree import cached_flat, predict_flat
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        pred = predict_flat(cached_flat(self), np.asarray(X))
+        return append_prediction(df, batch, n, pred.astype(np.float64),
+                                 self.getOrDefault("predictionCol"),
+                                 T.float64)
+
+
+class RandomForestClassifier(Estimator):
+    """Majority-vote forest of gini trees (`RandomForest.scala:82`)."""
+
+    maxDepth = Param("maxDepth", "max depth", 5)
+    minInstancesPerNode = Param("minInstancesPerNode", "", 1)
+    numTrees = Param("numTrees", "ensemble size", 20)
+    subsamplingRate = Param("subsamplingRate", "bootstrap fraction", 1.0)
+    featureSubsetStrategy = Param(
+        "featureSubsetStrategy", "all|sqrt|onethird", "sqrt")
+    seed = Param("seed", "", 42)
+
+    def _fit(self, df):
+        from .tree import fit_forest as _fit_forest
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        y = np.asarray(extract_column(batch, self.getOrDefault("labelCol"),
+                                      n))
+        trees = _fit_forest(
+            np.asarray(X), y, "gini", self.getOrDefault("numTrees"),
+            self.getOrDefault("maxDepth"),
+            self.getOrDefault("minInstancesPerNode"),
+            self.getOrDefault("subsamplingRate"),
+            self.getOrDefault("featureSubsetStrategy"),
+            self.getOrDefault("seed"))
+        return RandomForestClassificationModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"), trees=trees)
+
+
+class RandomForestClassificationModel(Model):
+    trees = Param("trees", "", None)
+
+    def transform(self, df):
+        from .tree import cached_flats, predict_forest
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        votes = predict_forest(cached_flats(self), np.asarray(X))
+        # vectorized per-row majority over the tree axis (no per-row
+        # Python loop, exact for ANY label values)
+        vals, inv = np.unique(votes, return_inverse=True)
+        inv = inv.reshape(votes.shape)
+        n_rows, k = votes.shape[1], len(vals)
+        flat = inv + np.arange(n_rows)[None, :] * k
+        counts = np.bincount(flat.ravel(), minlength=n_rows * k)
+        pred = vals[counts.reshape(n_rows, k).argmax(axis=1)]
+        return append_prediction(df, batch, n, pred.astype(np.float64),
+                                 self.getOrDefault("predictionCol"),
+                                 T.float64)
+
+
+class GBTClassifier(Estimator):
+    """Binary gradient-boosted trees with logistic loss
+    (`GBTClassifier.scala`): trees fit the gradient residual
+    y - sigmoid(F), prediction thresholds sigmoid(F) at 0.5."""
+
+    maxDepth = Param("maxDepth", "max depth", 3)
+    maxIter = Param("maxIter", "boosting rounds", 20)
+    stepSize = Param("stepSize", "shrinkage", 0.1)
+    minInstancesPerNode = Param("minInstancesPerNode", "", 1)
+
+    def _fit(self, df):
+        from .tree import flatten_tree, grow_tree, predict_flat
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        y = np.asarray(extract_column(batch, self.getOrDefault("labelCol"),
+                                      n)).astype(np.float64)
+        X = np.asarray(X)
+        step = self.getOrDefault("stepSize")
+        p0 = float(np.clip(y.mean(), 1e-6, 1 - 1e-6))
+        f0 = float(np.log(p0 / (1 - p0)))
+        F = np.full(len(y), f0)
+        trees = []
+        for _ in range(self.getOrDefault("maxIter")):
+            resid = y - 1.0 / (1.0 + np.exp(-F))
+            tree = grow_tree(X, resid, self.getOrDefault("maxDepth"),
+                             self.getOrDefault("minInstancesPerNode"),
+                             impurity="variance")
+            trees.append(tree)
+            F = F + step * predict_flat(flatten_tree(tree), X)
+        return GBTClassificationModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"),
+            trees=trees, init=f0, stepSize=step)
+
+
+class GBTClassificationModel(Model):
+    trees = Param("trees", "", None)
+    init = Param("init", "", 0.0)
+    stepSize = Param("stepSize", "", 0.1)
+
+    def transform(self, df):
+        from .tree import cached_flats, predict_forest
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        F = self.getOrDefault("init") + self.getOrDefault("stepSize") \
+            * predict_forest(cached_flats(self), np.asarray(X)).sum(axis=0)
+        pred = (1.0 / (1.0 + np.exp(-F)) > 0.5).astype(np.float64)
+        return append_prediction(df, batch, n, pred,
+                                 self.getOrDefault("predictionCol"),
+                                 T.float64)

@@ -11,7 +11,9 @@ from .base import (
 )
 
 __all__ = ["LinearRegression", "LinearRegressionModel",
-           "DecisionTreeRegressor", "DecisionTreeRegressionModel"]
+           "DecisionTreeRegressor", "DecisionTreeRegressionModel",
+           "RandomForestRegressor", "RandomForestRegressionModel",
+           "GBTRegressor", "GBTRegressionModel"]
 
 
 class LinearRegression(Estimator):
@@ -68,56 +70,109 @@ class DecisionTreeRegressor(Estimator):
     minInstancesPerNode = Param("minInstancesPerNode", "", 1)
 
     def _fit(self, df):
+        from .tree import grow_tree
         X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
         y = np.asarray(extract_column(batch, self.getOrDefault("labelCol"), n))
-        X = np.asarray(X)
-        tree = _grow_tree(X, y, 0, self.getOrDefault("maxDepth"),
-                          self.getOrDefault("minInstancesPerNode"))
+        tree = grow_tree(np.asarray(X), y,
+                         self.getOrDefault("maxDepth"),
+                         self.getOrDefault("minInstancesPerNode"),
+                         impurity="variance")
         return DecisionTreeRegressionModel(
             featuresCol=self.getOrDefault("featuresCol"),
             predictionCol=self.getOrDefault("predictionCol"), tree=tree)
-
-
-def _grow_tree(X, y, depth, max_depth, min_rows):
-    """Variance-reduction splits on feature quantiles (`ml/tree/` approach
-    of binned candidate splits, host-side for small data)."""
-    if depth >= max_depth or len(y) <= min_rows or np.all(y == y[0]):
-        return {"leaf": float(y.mean()) if len(y) else 0.0}
-    best = None
-    base = ((y - y.mean()) ** 2).sum()
-    for j in range(X.shape[1]):
-        for q in (0.25, 0.5, 0.75):
-            t = np.quantile(X[:, j], q)
-            left = X[:, j] <= t
-            if left.all() or not left.any():
-                continue
-            yl, yr = y[left], y[~left]
-            cost = ((yl - yl.mean()) ** 2).sum() + ((yr - yr.mean()) ** 2).sum()
-            if best is None or cost < best[0]:
-                best = (cost, j, t, left)
-    if best is None or best[0] >= base:
-        return {"leaf": float(y.mean())}
-    _, j, t, left = best
-    return {"feature": j, "threshold": float(t),
-            "left": _grow_tree(X[left], y[left], depth + 1, max_depth, min_rows),
-            "right": _grow_tree(X[~left], y[~left], depth + 1, max_depth,
-                                min_rows)}
-
-
-def _predict_tree(tree, x):
-    while "leaf" not in tree:
-        tree = tree["left"] if x[tree["feature"]] <= tree["threshold"] \
-            else tree["right"]
-    return tree["leaf"]
 
 
 class DecisionTreeRegressionModel(Model):
     tree = Param("tree", "", None)
 
     def transform(self, df):
+        from .tree import cached_flat, predict_flat
         X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
-        X = np.asarray(X)
-        tree = self.getOrDefault("tree")
-        pred = np.array([_predict_tree(tree, X[i]) for i in range(len(X))])
+        pred = predict_flat(cached_flat(self), np.asarray(X))
         return append_prediction(df, batch, n, pred,
                                  self.getOrDefault("predictionCol"), T.float64)
+
+
+class RandomForestRegressor(Estimator):
+    """Bootstrap-aggregated variance trees (`ml/tree/RandomForest.scala:82`
+    re-based on the shared host tree grower)."""
+
+    maxDepth = Param("maxDepth", "max depth", 5)
+    minInstancesPerNode = Param("minInstancesPerNode", "", 1)
+    numTrees = Param("numTrees", "ensemble size", 20)
+    subsamplingRate = Param("subsamplingRate", "bootstrap fraction", 1.0)
+    featureSubsetStrategy = Param(
+        "featureSubsetStrategy", "all|sqrt|onethird", "onethird")
+    seed = Param("seed", "", 42)
+
+    def _fit(self, df):
+        from .tree import fit_forest
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        y = np.asarray(extract_column(batch, self.getOrDefault("labelCol"), n))
+        X = np.asarray(X)
+        trees = fit_forest(
+            X, y, "variance", self.getOrDefault("numTrees"),
+            self.getOrDefault("maxDepth"),
+            self.getOrDefault("minInstancesPerNode"),
+            self.getOrDefault("subsamplingRate"),
+            self.getOrDefault("featureSubsetStrategy"),
+            self.getOrDefault("seed"))
+        return RandomForestRegressionModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"), trees=trees)
+
+
+class RandomForestRegressionModel(Model):
+    trees = Param("trees", "", None)
+
+    def transform(self, df):
+        from .tree import cached_flats, predict_forest
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        pred = predict_forest(cached_flats(self), np.asarray(X)).mean(axis=0)
+        return append_prediction(df, batch, n, pred,
+                                 self.getOrDefault("predictionCol"), T.float64)
+
+
+class GBTRegressor(Estimator):
+    """Gradient-boosted variance trees on residuals
+    (`ml/tree/GradientBoostedTrees.scala`, squared-error loss)."""
+
+    maxDepth = Param("maxDepth", "max depth", 3)
+    maxIter = Param("maxIter", "boosting rounds", 20)
+    stepSize = Param("stepSize", "shrinkage", 0.1)
+    minInstancesPerNode = Param("minInstancesPerNode", "", 1)
+
+    def _fit(self, df):
+        from .tree import flatten_tree, grow_tree, predict_flat
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        y = np.asarray(extract_column(batch, self.getOrDefault("labelCol"), n))
+        X = np.asarray(X)
+        step = self.getOrDefault("stepSize")
+        f0 = float(y.mean())
+        pred = np.full(len(y), f0)
+        trees = []
+        for _ in range(self.getOrDefault("maxIter")):
+            tree = grow_tree(X, y - pred, self.getOrDefault("maxDepth"),
+                             self.getOrDefault("minInstancesPerNode"),
+                             impurity="variance")
+            trees.append(tree)
+            pred = pred + step * predict_flat(flatten_tree(tree), X)
+        return GBTRegressionModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"),
+            trees=trees, init=f0, stepSize=step)
+
+
+class GBTRegressionModel(Model):
+    trees = Param("trees", "", None)
+    init = Param("init", "", 0.0)
+    stepSize = Param("stepSize", "", 0.1)
+
+    def transform(self, df):
+        from .tree import cached_flats, predict_forest
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        pred = self.getOrDefault("init") + self.getOrDefault("stepSize") \
+            * predict_forest(cached_flats(self), np.asarray(X)).sum(axis=0)
+        return append_prediction(df, batch, n, pred,
+                                 self.getOrDefault("predictionCol"), T.float64)
+

@@ -2,6 +2,7 @@
 cross-validation)."""
 
 import numpy as np
+import pandas as pd
 import pytest
 
 from spark_tpu.ml.base import Pipeline
@@ -232,3 +233,94 @@ def test_model_save(spark, tmp_path):
     import json, os
     meta = json.load(open(os.path.join(p, "metadata.json")))
     assert meta["class"] == "LogisticRegressionModel"
+
+
+# ---------------------------------------------------------------------------
+# tree ensembles (RandomForest.scala:82 / GradientBoostedTrees.scala)
+# ---------------------------------------------------------------------------
+
+def _nonlinear_reg_df(spark, n=400, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2, 2, (n, 3))
+    y = np.where(X[:, 0] > 0, 5.0, -5.0) + X[:, 1] ** 2 \
+        + rng.normal(0, 0.3, n)
+    pdf = pd.DataFrame({"f0": X[:, 0], "f1": X[:, 1], "f2": X[:, 2],
+                        "label": y})
+    df = spark.createDataFrame(pdf)
+    from spark_tpu.ml.feature import VectorAssembler
+    return VectorAssembler(inputCols=["f0", "f1", "f2"],
+                           outputCol="features").transform(df), pdf
+
+
+def _mse(df, pdf):
+    rows = df.select("label", "prediction").collect()
+    err = np.array([r["label"] - r["prediction"] for r in rows])
+    return float((err ** 2).mean())
+
+
+def test_random_forest_regressor_generalizes(spark):
+    """Bagging reduces TEST variance vs one tree of the same depth."""
+    from spark_tpu.ml.regression import (
+        DecisionTreeRegressor, RandomForestRegressor,
+    )
+    train, _ = _nonlinear_reg_df(spark, n=300, seed=3)
+    test, test_pdf = _nonlinear_reg_df(spark, n=300, seed=44)
+    tree = DecisionTreeRegressor(maxDepth=4).fit(train)
+    rf = RandomForestRegressor(numTrees=30, maxDepth=4,
+                               subsamplingRate=0.7,
+                               featureSubsetStrategy="all").fit(train)
+    tree_mse = _mse(tree.transform(test), test_pdf)
+    rf_mse = _mse(rf.transform(test), test_pdf)
+    assert rf_mse < tree_mse * 1.02
+    assert rf_mse < 2.0                 # and it actually fits the signal
+
+
+def test_gbt_regressor_improves_with_rounds(spark):
+    from spark_tpu.ml.regression import GBTRegressor
+    df, pdf = _nonlinear_reg_df(spark)
+    short = _mse(GBTRegressor(maxIter=2).fit(df).transform(df), pdf)
+    long = _mse(GBTRegressor(maxIter=40).fit(df).transform(df), pdf)
+    assert long < short * 0.5           # boosting reduces training error
+
+
+def _classif_df(spark, n=400, seed=9):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2, 2, (n, 2))
+    y = ((X[:, 0] * X[:, 1]) > 0).astype(np.float64)   # XOR-ish quadrants
+    pdf = pd.DataFrame({"f0": X[:, 0], "f1": X[:, 1], "label": y})
+    df = spark.createDataFrame(pdf)
+    from spark_tpu.ml.feature import VectorAssembler
+    return VectorAssembler(inputCols=["f0", "f1"],
+                           outputCol="features").transform(df), pdf
+
+
+def _accuracy(df):
+    rows = df.select("label", "prediction").collect()
+    return float(np.mean([r["label"] == r["prediction"] for r in rows]))
+
+
+def test_tree_classifiers_solve_xor(spark):
+    """Linear models cannot separate XOR quadrants; trees must."""
+    from spark_tpu.ml.classification import (
+        DecisionTreeClassifier, GBTClassifier, RandomForestClassifier,
+    )
+    df, _pdf = _classif_df(spark)
+    assert _accuracy(DecisionTreeClassifier(maxDepth=4)
+                     .fit(df).transform(df)) > 0.9
+    assert _accuracy(RandomForestClassifier(numTrees=15, maxDepth=4)
+                     .fit(df).transform(df)) > 0.9
+    assert _accuracy(GBTClassifier(maxIter=25, maxDepth=3)
+                     .fit(df).transform(df)) > 0.9
+
+
+def test_forest_model_persistence(spark, tmp_path):
+    from spark_tpu.ml.regression import RandomForestRegressor
+    df, pdf = _nonlinear_reg_df(spark, n=120)
+    model = RandomForestRegressor(numTrees=5, maxDepth=3).fit(df)
+    path = str(tmp_path / "rf_model")
+    model.save(path)
+    from spark_tpu.ml.regression import RandomForestRegressionModel
+    loaded = RandomForestRegressionModel.load(path)
+    a = [r["prediction"] for r in model.transform(df).collect()]
+    b = [r["prediction"] for r in loaded.transform(df).collect()]
+    np.testing.assert_allclose(a, b)
