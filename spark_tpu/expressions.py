@@ -2195,6 +2195,236 @@ class ElementAt(Expression):
         return f"element_at({self.children[0]!r}, {self.index})"
 
 
+class ArrayReduce(Expression):
+    """array_max / array_min: sentinel-aware reduction over the plane."""
+
+    def __init__(self, child: Expression, op: str):
+        self.children = (child,)
+        self.op = op                      # "max" | "min"
+
+    def map_children(self, fn):
+        return ArrayReduce(fn(self.children[0]), self.op)
+
+    @property
+    def name(self):
+        return f"array_{self.op}({self.children[0].name})"
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not isinstance(ct, T.ArrayType):
+            raise AnalysisException(
+                f"array_{self.op} expects an array, got {ct}")
+        return ct.element_type
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        dt = self.children[0].data_type(ctx.batch.schema)
+        v = self.children[0].eval(ctx)
+        mask = _array_elem_mask(xp, dt, v.data)
+        et = dt.element_type
+        if et.is_fractional:
+            lo, hi = -np.inf, np.inf
+        else:
+            info = np.iinfo(et.np_dtype)
+            lo, hi = info.min, info.max
+        fill = lo if self.op == "max" else hi
+        red = xp.max if self.op == "max" else xp.min
+        out = red(xp.where(mask, v.data, fill), axis=-1)
+        nonempty = mask.any(axis=-1)
+        return ExprValue(out, and_valid(xp, v.valid, nonempty),
+                         v.dictionary)
+
+    def __repr__(self):
+        return f"array_{self.op}({self.children[0]!r})"
+
+
+class SortArray(Expression):
+    """sort_array(arr[, asc]): per-row element sort, dead slots kept as a
+    trailing sentinel block (live-prefix layout contract)."""
+
+    def __init__(self, child: Expression, asc: bool = True):
+        self.children = (child,)
+        self.asc = bool(asc)
+
+    def map_children(self, fn):
+        return SortArray(fn(self.children[0]), self.asc)
+
+    @property
+    def name(self):
+        return f"sort_array({self.children[0].name}, {self.asc})"
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not isinstance(ct, T.ArrayType):
+            raise AnalysisException(f"sort_array expects an array, got {ct}")
+        return ct
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        dt = self.children[0].data_type(ctx.batch.schema)
+        v = self.children[0].eval(ctx)
+        mask = _array_elem_mask(xp, dt, v.data)
+        et = dt.element_type
+        # string codes sort lexicographically BY CONSTRUCTION (sorted
+        # dictionaries).  Ascending: dead slots carry the MAX extreme so
+        # they sink; descending: dead slots carry the MIN extreme, sort
+        # ascending, then flip the row — dead slots land last either way
+        # with no negation (which would overflow int64 / lose exactness).
+        if et.is_fractional:
+            info_lo, info_hi = -np.inf, np.inf
+        else:
+            info = np.iinfo(et.np_dtype)
+            info_lo, info_hi = info.min, info.max
+        fill = info_hi if self.asc else info_lo
+        order = xp.argsort(xp.where(mask, v.data, fill), axis=-1,
+                           stable=True)
+        if not self.asc:
+            order = xp.flip(order, axis=-1)
+        data = xp.take_along_axis(v.data, order, axis=-1)
+        smask = xp.take_along_axis(mask, order, axis=-1)
+        data = xp.where(smask, data, dt.element_sentinel())
+        return ExprValue(data, v.valid, v.dictionary)
+
+    def __repr__(self):
+        return f"sort_array({self.children[0]!r}, asc={self.asc})"
+
+
+class ArrayDistinct(Expression):
+    """array_distinct(arr): first occurrence of each element kept, order
+    preserved, result compacted to the live prefix."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def name(self):
+        return f"array_distinct({self.children[0].name})"
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not isinstance(ct, T.ArrayType):
+            raise AnalysisException(
+                f"array_distinct expects an array, got {ct}")
+        return ct
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        dt = self.children[0].data_type(ctx.batch.schema)
+        v = self.children[0].eval(ctx)
+        mask = _array_elem_mask(xp, dt, v.data)
+        # first-occurrence: element j survives iff no earlier equal live
+        # element exists — O(L^2) pairwise plane, L is small and static
+        eq = v.data[..., :, None] == v.data[..., None, :]
+        earlier = xp.tril(xp.ones(eq.shape[-2:], bool), k=-1)
+        dup = (eq & earlier & mask[..., None, :]
+               & mask[..., :, None]).any(axis=-1)
+        keep = mask & ~dup
+        order = xp.argsort(~keep, axis=-1, stable=True)
+        data = xp.take_along_axis(v.data, order, axis=-1)
+        kept = xp.take_along_axis(keep, order, axis=-1)
+        data = xp.where(kept, data, dt.element_sentinel())
+        return ExprValue(data, v.valid, v.dictionary)
+
+    def __repr__(self):
+        return f"array_distinct({self.children[0]!r})"
+
+
+class ArraySlice(Expression):
+    """slice(arr, start, length): 1-based, negative start from the end."""
+
+    def __init__(self, child: Expression, start: int, length: int):
+        if start == 0:
+            raise AnalysisException("slice start is 1-based; got 0")
+        if length < 0:
+            raise AnalysisException("slice length must be >= 0")
+        self.children = (child,)
+        self.start = int(start)
+        self.length = int(length)
+
+    def map_children(self, fn):
+        return ArraySlice(fn(self.children[0]), self.start, self.length)
+
+    @property
+    def name(self):
+        return f"slice({self.children[0].name}, {self.start}, {self.length})"
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not isinstance(ct, T.ArrayType):
+            raise AnalysisException(f"slice expects an array, got {ct}")
+        return ct
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        dt = self.children[0].data_type(ctx.batch.schema)
+        v = self.children[0].eval(ctx)
+        mask = _array_elem_mask(xp, dt, v.data)
+        lengths = mask.sum(axis=-1)
+        width = v.data.shape[-1]
+        begin = np.int64(self.start)
+        eff = xp.where(begin > 0, begin - 1, lengths + begin)
+        # Spark: a negative start reaching before element 0 yields the
+        # EMPTY array (never a partial tail), and live elements must land
+        # on the output PREFIX (layout contract)
+        valid_start = (eff >= 0) & (eff < lengths)
+        pos = xp.arange(width, dtype=np.int64)
+        idx = eff[..., None] + pos
+        in_range = valid_start[..., None] & (pos < self.length) \
+            & (idx < lengths[..., None])
+        gathered = xp.take_along_axis(
+            v.data, xp.clip(idx, 0, width - 1), axis=-1)
+        data = xp.where(in_range, gathered, dt.element_sentinel())
+        return ExprValue(data, v.valid, v.dictionary)
+
+    def __repr__(self):
+        return f"slice({self.children[0]!r}, {self.start}, {self.length})"
+
+
+class ArrayPosition(Expression):
+    """array_position(arr, value): 1-based first index, 0 when absent."""
+
+    def __init__(self, child: Expression, value: Any):
+        self.children = (child,)
+        self.value = value
+
+    def map_children(self, fn):
+        return ArrayPosition(fn(self.children[0]), self.value)
+
+    @property
+    def name(self):
+        return f"array_position({self.children[0].name}, {self.value!r})"
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not isinstance(ct, T.ArrayType):
+            raise AnalysisException(
+                f"array_position expects an array, got {ct}")
+        return T.int64
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        dt = self.children[0].data_type(ctx.batch.schema)
+        v = self.children[0].eval(ctx)
+        mask = _array_elem_mask(xp, dt, v.data)
+        if dt.element_type.is_string:
+            if v.dictionary is None or self.value not in v.dictionary:
+                hit = xp.zeros(v.data.shape, bool)
+            else:
+                hit = v.data == v.dictionary.index(self.value)
+        else:
+            hit = v.data == np.asarray(self.value).astype(
+                dt.element_type.np_dtype)
+        hit = hit & mask
+        width = v.data.shape[-1]
+        first = xp.where(hit, xp.arange(width, dtype=np.int64),
+                         np.int64(width)).min(axis=-1)
+        pos = xp.where(first < width, first + 1, 0)
+        return ExprValue(pos, v.valid)
+
+    def __repr__(self):
+        return f"array_position({self.children[0]!r}, {self.value!r})"
+
+
 class LambdaVar(Expression):
     """Lambda placeholder bound by a higher-order array function to the
     ELEMENT PLANE (`higherOrderFunctions.scala`'s NamedLambdaVariable).

@@ -308,6 +308,12 @@ class GBTClassifier(Estimator):
         X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
         y = np.asarray(extract_column(batch, self.getOrDefault("labelCol"),
                                       n)).astype(np.float64)
+        bad = set(np.unique(y)) - {0.0, 1.0}
+        if bad:
+            from ..expressions import AnalysisException
+            raise AnalysisException(
+                f"GBTClassifier requires binary labels in {{0, 1}}; "
+                f"found {sorted(bad)}")
         X = np.asarray(X)
         step = self.getOrDefault("stepSize")
         p0 = float(np.clip(y.mean(), 1e-6, 1 - 1e-6))

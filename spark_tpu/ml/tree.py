@@ -65,8 +65,7 @@ def grow_tree(X: np.ndarray, y: np.ndarray, max_depth: int,
     best = None
     for j in feats:
         col = X[:, j]
-        for q in _SPLIT_QUANTILES:
-            t = np.quantile(col, q)
+        for t in np.quantile(col, _SPLIT_QUANTILES):
             left = col <= t
             nl = int(left.sum())
             if nl == 0 or nl == len(y):

@@ -548,6 +548,30 @@ def array_contains(c: ColumnOrName, value: Any) -> Column:
     return Column(E.ArrayContains(_e(c), value))
 
 
+def array_max(c: ColumnOrName) -> Column:
+    return Column(E.ArrayReduce(_e(c), "max"))
+
+
+def array_min(c: ColumnOrName) -> Column:
+    return Column(E.ArrayReduce(_e(c), "min"))
+
+
+def sort_array(c: ColumnOrName, asc: bool = True) -> Column:
+    return Column(E.SortArray(_e(c), asc))
+
+
+def array_distinct(c: ColumnOrName) -> Column:
+    return Column(E.ArrayDistinct(_e(c)))
+
+
+def slice(c: ColumnOrName, start: int, length: int) -> Column:  # noqa: A001
+    return Column(E.ArraySlice(_e(c), start, length))
+
+
+def array_position(c: ColumnOrName, value: Any) -> Column:
+    return Column(E.ArrayPosition(_e(c), value))
+
+
 def _lambda_body(f) -> tuple:
     """(LambdaVar, body expression) from a Python ``lambda x: Column``
     (the DataFrame-API half of `higherOrderFunctions.scala`)."""
@@ -591,7 +615,8 @@ def posexplode(c: ColumnOrName) -> Column:
 
 __all__ += ["array", "split", "size", "element_at", "array_contains",
             "explode", "posexplode", "transform", "filter", "exists",
-            "forall"]
+            "forall", "array_max", "array_min", "sort_array",
+            "array_distinct", "slice", "array_position"]
 
 
 def collect_list(c: ColumnOrName) -> Column:

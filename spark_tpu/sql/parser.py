@@ -224,6 +224,40 @@ def _count(args, distinct):
     return A.Count(e)
 
 
+def _array_reduce(args, op):
+    from ..expressions import ArrayReduce
+    return ArrayReduce(_one(args, f"array_{op}"), op)
+
+
+def _sort_array(args):
+    from ..expressions import SortArray
+    if len(args) == 1:
+        return SortArray(args[0], True)
+    if len(args) == 2 and isinstance(args[1], Literal):
+        return SortArray(args[0], bool(args[1].value))
+    raise ParseException("sort_array expects (arr[, asc literal])")
+
+
+def _array_distinct(arr):
+    from ..expressions import ArrayDistinct
+    return ArrayDistinct(arr)
+
+
+def _array_slice(args):
+    from ..expressions import ArraySlice
+    if len(args) != 3 or not all(isinstance(a, Literal) for a in args[1:]):
+        raise ParseException("slice expects (arr, start literal, "
+                             "length literal)")
+    return ArraySlice(args[0], int(args[1].value), int(args[2].value))
+
+
+def _array_position(args):
+    from ..expressions import ArrayPosition
+    if len(args) != 2:
+        raise ParseException("array_position expects (arr, value)")
+    return ArrayPosition(args[0], _litval(args[1], "array_position"))
+
+
 SCALAR_FUNCTIONS = {
     "abs": _fn_unary("abs"), "sqrt": _fn_unary("sqrt"), "exp": _fn_unary("exp"),
     "ln": _fn_unary("ln"), "log": _fn_unary("ln"), "log10": _fn_unary("log10"),
@@ -365,6 +399,12 @@ def _register_breadth():
             a[0], int(_litval(a[1], "element_at"))),
         "array_contains": lambda a: ArrayContains(
             a[0], _litval(a[1], "array_contains")),
+        "array_max": lambda a: _array_reduce(a, "max"),
+        "array_min": lambda a: _array_reduce(a, "min"),
+        "sort_array": lambda a: _sort_array(a),
+        "array_distinct": lambda a: _array_distinct(_one(a, "array_distinct")),
+        "slice": lambda a: _array_slice(a),
+        "array_position": lambda a: _array_position(a),
         "explode": lambda a: ExplodeMarker(_one(a, "explode")),
         "posexplode": lambda a: ExplodeMarker(_one(a, "posexplode"),
                                               with_pos=True),

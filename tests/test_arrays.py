@@ -264,3 +264,84 @@ def test_filter_lambda_must_be_boolean(spark):
         df.select(F.filter("xs", lambda x: x + 1)).collect()
     with pytest.raises(AnalysisException, match="boolean"):
         df.select(F.exists("xs", lambda x: x * 2)).collect()
+
+
+def test_array_breadth_functions(spark):
+    df = _hof_df(spark)
+    rows = {r["id"]: r for r in df.select(
+        "id",
+        F.array_max("xs").alias("mx"),
+        F.array_min("xs").alias("mn"),
+        F.sort_array("xs").alias("sa"),
+        F.sort_array("xs", asc=False).alias("sd"),
+        F.slice("xs", 2, 2).alias("sl"),
+        F.array_position("xs", 7).alias("p7")).collect()}
+    assert (rows[1]["mx"], rows[1]["mn"]) == (3, 1)
+    assert rows[3]["mx"] is None and rows[3]["mn"] is None
+    assert rows[4]["sa"] == [-5, 5, 7] and rows[4]["sd"] == [7, 5, -5]
+    assert rows[1]["sl"] == [2, 3] and rows[2]["sl"] == []
+    assert rows[4]["p7"] == 3 and rows[1]["p7"] == 0
+
+
+def test_array_distinct_preserves_order(spark):
+    df = spark.createDataFrame(
+        [(1, [3, 1, 3, 2, 1]), (2, [5, 5, 5]), (3, [])], ["id", "xs"])
+    got = {r["id"]: r["d"] for r in
+           df.select("id", F.array_distinct("xs").alias("d")).collect()}
+    assert got == {1: [3, 1, 2], 2: [5], 3: []}
+
+
+def test_array_breadth_sql(spark):
+    _hof_df(spark).createOrReplaceTempView("abf")
+    rows = spark.sql(
+        "SELECT id, array_max(xs) AS mx, sort_array(xs, false) AS sd, "
+        "array_distinct(xs) AS ad, slice(xs, 1, 2) AS sl, "
+        "array_position(xs, 10) AS p FROM abf ORDER BY id").collect()
+    by = {r["id"]: r for r in rows}
+    assert by[1]["mx"] == 3 and by[1]["sl"] == [1, 2]
+    assert by[2]["p"] == 1 and by[1]["p"] == 0
+    assert by[4]["sd"] == [7, 5, -5]
+    spark.catalog.dropTempView("abf")
+
+
+def test_array_fn_jit_cache_distinguishes_variants(spark):
+    """max-then-min (and asc-then-desc, different slice/position args) on
+    the SAME input must not collide in the plan-keyed jit cache — reprs
+    carry the scalar state."""
+    df = spark.createDataFrame([(1, [4, 1, 9])], ["id", "xs"])
+    assert df.select(F.array_max("xs").alias("v")).collect()[0]["v"] == 9
+    assert df.select(F.array_min("xs").alias("v")).collect()[0]["v"] == 1
+    assert df.select(F.sort_array("xs").alias("v")).collect()[0]["v"] \
+        == [1, 4, 9]
+    assert df.select(F.sort_array("xs", asc=False).alias("v")
+                     ).collect()[0]["v"] == [9, 4, 1]
+    assert df.select(F.slice("xs", 1, 1).alias("v")).collect()[0]["v"] == [4]
+    assert df.select(F.slice("xs", 2, 2).alias("v")).collect()[0]["v"] \
+        == [1, 9]
+    assert df.select(F.array_position("xs", 9).alias("v")
+                     ).collect()[0]["v"] == 3
+    assert df.select(F.array_position("xs", 1).alias("v")
+                     ).collect()[0]["v"] == 2
+
+
+def test_slice_negative_start_beyond_length_is_empty(spark):
+    df = spark.createDataFrame([(1, [1, 2, 3])], ["id", "xs"])
+    sel = df.select(F.slice("xs", -5, 5).alias("v"),
+                    F.slice("xs", -2, 2).alias("w"))
+    row = sel.collect()[0]
+    assert row["v"] == []              # Spark: out-of-range start -> empty
+    assert row["w"] == [2, 3]
+    # live-prefix contract holds for positional ops downstream
+    assert sel.select(F.element_at("v", 1).alias("e")
+                      ).collect()[0]["e"] is None
+
+
+def test_gbt_rejects_nonbinary_labels(spark):
+    import pytest
+    from spark_tpu.expressions import AnalysisException
+    from spark_tpu.ml.classification import GBTClassifier
+    from spark_tpu.ml.feature import VectorAssembler
+    df = VectorAssembler(inputCols=["f0"], outputCol="features").transform(
+        spark.createDataFrame([(0.1, 1.0), (0.2, 2.0)], ["f0", "label"]))
+    with pytest.raises(AnalysisException, match="binary labels"):
+        GBTClassifier(maxIter=2).fit(df)
