@@ -2629,6 +2629,189 @@ class ArrayExists(_HigherOrder):
                f"{self.body!r})"
 
 
+class ArrayAggregate(Expression):
+    """aggregate(arr, init, (acc, x) -> merge[, acc -> finish]): fold over
+    the element plane.  The fold unrolls over the STATIC max_len (one
+    masked select per slot — compiler-friendly, no data-dependent loop)."""
+
+    def __init__(self, child: Expression, init: Expression,
+                 acc_var: "LambdaVar", x_var: "LambdaVar",
+                 merge: Expression,
+                 finish_var: Optional["LambdaVar"] = None,
+                 finish: Optional[Expression] = None):
+        self.children = (child, init)
+        self.acc_var = acc_var
+        self.x_var = x_var
+        self.merge = merge
+        self.finish_var = finish_var
+        self.finish = finish
+        for body in (merge, finish):
+            if body is not None and body.references():
+                raise AnalysisException(
+                    "lambda body may reference only its lambda variables "
+                    "and literals in this engine; found column refs "
+                    f"{sorted(body.references())}")
+
+    def map_children(self, fn):
+        return ArrayAggregate(fn(self.children[0]), fn(self.children[1]),
+                              self.acc_var, self.x_var, self.merge,
+                              self.finish_var, self.finish)
+
+    @property
+    def name(self):
+        return f"aggregate({self.children[0].name})"
+
+    def _bind_types(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not isinstance(ct, T.ArrayType):
+            raise AnalysisException(f"aggregate expects an array, got {ct}")
+        self.x_var.dtype = ct.element_type
+        self.acc_var.dtype = self.children[1].data_type(schema)
+        return ct
+
+    def data_type(self, schema):
+        self._bind_types(schema)
+        if self.acc_var.dtype.is_string:
+            raise AnalysisException(
+                "aggregate with a string accumulator is not supported "
+                "yet (dictionary state cannot thread through the fold)")
+        mt = self.merge.data_type(schema)
+        if mt.is_string:
+            raise AnalysisException(
+                "aggregate merge producing strings is not supported yet")
+        if self.finish is not None:
+            self.finish_var.dtype = mt
+            return self.finish.data_type(schema)
+        return mt
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        dt = self._bind_types(ctx.batch.schema)
+        v = self.children[0].eval(ctx)
+        mask = _array_elem_mask(xp, dt, v.data)
+        init = ctx.broadcast(self.children[1].eval(ctx))
+        acc_data = init.data
+        acc_valid = init.valid
+        width = v.data.shape[-1]
+        for i in range(width):
+            sub = EvalContext(ctx.batch, xp)
+            sub.lambda_bindings = dict(getattr(ctx, "lambda_bindings", {}))
+            sub.lambda_bindings[self.acc_var._name] = \
+                ExprValue(acc_data, acc_valid)
+            sub.lambda_bindings[self.x_var._name] = \
+                ExprValue(v.data[..., i], None, v.dictionary)
+            merged = sub.broadcast(self.merge.eval(sub))
+            live = mask[..., i]
+            acc_data = xp.where(live, merged.data, acc_data)
+            if merged.valid is not None or acc_valid is not None:
+                mv = merged.valid if merged.valid is not None \
+                    else xp.ones_like(live)
+                av = acc_valid if acc_valid is not None \
+                    else xp.ones_like(live)
+                acc_valid = xp.where(live, mv, av)
+        out = ExprValue(acc_data, and_valid(xp, v.valid, acc_valid)
+                        if acc_valid is not None else v.valid)
+        if self.finish is not None:
+            self.finish_var.dtype = self.merge.data_type(ctx.batch.schema)
+            sub = EvalContext(ctx.batch, xp)
+            sub.lambda_bindings = {self.finish_var._name: out}
+            fin = sub.broadcast(self.finish.eval(sub))
+            out = ExprValue(fin.data,
+                            and_valid(xp, out.valid, fin.valid)
+                            if fin.valid is not None else out.valid)
+        return out
+
+    def __repr__(self):
+        fin = f", {self.finish_var!r} -> {self.finish!r}" \
+            if self.finish is not None else ""
+        return (f"aggregate({self.children[0]!r}, {self.children[1]!r}, "
+                f"({self.acc_var!r}, {self.x_var!r}) -> "
+                f"{self.merge!r}{fin})")
+
+
+class ZipWith(Expression):
+    """zip_with(a, b, (x, y) -> expr): elementwise combine of two arrays.
+    The shorter side's missing tail enters the lambda as NULL (validity
+    propagation), matching the reference's null-padded zip."""
+
+    def __init__(self, left: Expression, right: Expression,
+                 x_var: "LambdaVar", y_var: "LambdaVar", body: Expression):
+        self.children = (left, right)
+        self.x_var = x_var
+        self.y_var = y_var
+        self.body = body
+        if body.references():
+            raise AnalysisException(
+                "lambda body may reference only its lambda variables and "
+                f"literals; found column refs {sorted(body.references())}")
+
+    def map_children(self, fn):
+        return ZipWith(fn(self.children[0]), fn(self.children[1]),
+                       self.x_var, self.y_var, self.body)
+
+    @property
+    def name(self):
+        return f"zip_with({self.children[0].name}, {self.children[1].name})"
+
+    def _bind_types(self, schema):
+        lt = self.children[0].data_type(schema)
+        rt = self.children[1].data_type(schema)
+        if not isinstance(lt, T.ArrayType) or not isinstance(rt, T.ArrayType):
+            raise AnalysisException(
+                f"zip_with expects two arrays, got {lt} and {rt}")
+        self.x_var.dtype = lt.element_type
+        self.y_var.dtype = rt.element_type
+        return lt, rt
+
+    def data_type(self, schema):
+        self._bind_types(schema)
+        et = self.body.data_type(schema)
+        if et.is_string:
+            raise AnalysisException(
+                "zip_with to string elements is not supported yet")
+        if isinstance(et, T.BooleanType):
+            et = T.int32
+        return T.ArrayType(et)
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        lt, rt = self._bind_types(ctx.batch.schema)
+        a = self.children[0].eval(ctx)
+        b = self.children[1].eval(ctx)
+        am = _array_elem_mask(xp, lt, a.data)
+        bm = _array_elem_mask(xp, rt, b.data)
+        wa, wb = a.data.shape[-1], b.data.shape[-1]
+        w = max(wa, wb)
+
+        def widen(data, mask, width, fill):
+            if width == w:
+                return data, mask
+            pad = [(0, 0)] * (data.ndim - 1) + [(0, w - width)]
+            return (xp.pad(data, pad, constant_values=fill),
+                    xp.pad(mask, pad, constant_values=False))
+
+        ad, am = widen(a.data, am, wa, 0)
+        bd, bm = widen(b.data, bm, wb, 0)
+        sub = EvalContext(ctx.batch, xp)
+        sub.lambda_bindings = dict(getattr(ctx, "lambda_bindings", {}))
+        sub.lambda_bindings[self.x_var._name] = \
+            ExprValue(ad, am, a.dictionary)
+        sub.lambda_bindings[self.y_var._name] = \
+            ExprValue(bd, bm, b.dictionary)
+        out = self.body.eval(sub)
+        odt = self.data_type(ctx.batch.schema)
+        sent = odt.element_sentinel()
+        live = am | bm
+        ok = live if out.valid is None else (live & out.valid)
+        data = xp.where(ok, xp.asarray(out.data).astype(
+            odt.element_type.np_dtype), sent)
+        return ExprValue(data, and_valid(xp, a.valid, b.valid))
+
+    def __repr__(self):
+        return (f"zip_with({self.children[0]!r}, {self.children[1]!r}, "
+                f"({self.x_var!r}, {self.y_var!r}) -> {self.body!r})")
+
+
 class ArrayContains(Expression):
     """array_contains(arr, literal)."""
 

@@ -605,6 +605,32 @@ def forall(c: ColumnOrName, f) -> Column:
     return Column(E.ArrayExists(_e(c), var, body, require_all=True))
 
 
+def aggregate(c: ColumnOrName, initialValue, merge, finish=None) -> Column:
+    """aggregate(arr, init, (acc, x) -> merge[, acc -> finish])."""
+    acc, x = E.LambdaVar("acc"), E.LambdaVar("x")
+    merged = merge(Column(acc), Column(x))
+    if not isinstance(merged, Column):
+        raise E.AnalysisException("aggregate merge must return a Column")
+    fvar = fbody = None
+    if finish is not None:
+        fvar = E.LambdaVar("acc")
+        fout = finish(Column(fvar))
+        if not isinstance(fout, Column):
+            raise E.AnalysisException(
+                "aggregate finish must return a Column")
+        fbody = _e(fout)
+    return Column(E.ArrayAggregate(_e(c), _ev(initialValue), acc, x,
+                                   _e(merged), fvar, fbody))
+
+
+def zip_with(a: ColumnOrName, b: ColumnOrName, f) -> Column:
+    x, y = E.LambdaVar("x"), E.LambdaVar("y")
+    out = f(Column(x), Column(y))
+    if not isinstance(out, Column):
+        raise E.AnalysisException("zip_with lambda must return a Column")
+    return Column(E.ZipWith(_e(a), _e(b), x, y, _e(out)))
+
+
 def explode(c: ColumnOrName) -> Column:
     return Column(E.ExplodeMarker(_e(c)))
 
@@ -615,8 +641,8 @@ def posexplode(c: ColumnOrName) -> Column:
 
 __all__ += ["array", "split", "size", "element_at", "array_contains",
             "explode", "posexplode", "transform", "filter", "exists",
-            "forall", "array_max", "array_min", "sort_array",
-            "array_distinct", "slice", "array_position"]
+            "forall", "aggregate", "zip_with", "array_max", "array_min",
+            "sort_array", "array_distinct", "slice", "array_position"]
 
 
 def collect_list(c: ColumnOrName) -> Column:

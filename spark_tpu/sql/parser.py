@@ -1403,38 +1403,80 @@ class Parser:
 
     # `exists` is a KEYWORD (subquery predicate) and reaches the HOF
     # path through the dedicated EXISTS branch in _primary, never here
-    _HOF_NAMES = frozenset({"transform", "filter", "forall"})
+    _HOF_NAMES = frozenset({"transform", "filter", "forall",
+                            "aggregate", "zip_with"})
 
-    def _lambda_arg(self):
-        """`x -> expr` (higherOrderFunctions.scala lambda syntax)."""
+    def _lambda_arg(self, n_vars: int = 1):
+        """`x -> expr` or `(a, b) -> expr` (higherOrderFunctions.scala
+        lambda syntax)."""
         from ..expressions import LambdaVar
-        t = self.peek()
-        if t.kind != "IDENT":
+        names = []
+        if self.accept_op("("):
+            while True:
+                t = self.peek()
+                if t.kind != "IDENT":
+                    raise ParseException(
+                        f"expected lambda variable, got {t.value!r}")
+                self.next()
+                names.append(t.value)
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        else:
+            t = self.peek()
+            if t.kind != "IDENT":
+                raise ParseException(
+                    f"expected lambda variable, got {t.value!r}")
+            self.next()
+            names.append(t.value)
+        if len(names) != n_vars:
             raise ParseException(
-                f"expected lambda variable, got {t.value!r}")
-        self.next()
+                f"lambda expects {n_vars} variable(s), got {names}")
+        if len({nm.lower() for nm in names}) != len(names):
+            raise ParseException(
+                f"duplicate lambda variable names {names}")
         self.expect_op("->")
-        var = LambdaVar(t.value)
-        # the body may reference the variable by its SOURCE name: parse,
+        variables = [LambdaVar(nm) for nm in names]
+        by_name = {nm.lower(): v for nm, v in zip(names, variables)}
+        # the body may reference variables by their SOURCE names: parse,
         # then substitute Col(name) -> the bound LambdaVar
         body = self.expr()
 
         def sub(e):
-            if isinstance(e, Col) and e.name.lower() == t.value.lower():
-                return var
+            if isinstance(e, Col) and e.name.lower() in by_name:
+                return by_name[e.name.lower()]
             return e.map_children(sub)
 
-        return var, sub(body)
+        body = sub(body)
+        if n_vars == 1:
+            return variables[0], body
+        return variables, body
 
     def _function_call(self, name: str) -> Expression:
         self.expect_op("(")
         lname = name.lower()
         if lname in self._HOF_NAMES:
             from ..expressions import (
-                ArrayExists, ArrayFilterFn, ArrayTransform,
+                ArrayAggregate, ArrayExists, ArrayFilterFn, ArrayTransform,
+                ZipWith,
             )
             arr = self.expr()
             self.expect_op(",")
+            if lname == "aggregate":
+                init = self.expr()
+                self.expect_op(",")
+                (acc, x), merge = self._lambda_arg(2)
+                fvar = fbody = None
+                if self.accept_op(","):
+                    fvar, fbody = self._lambda_arg(1)
+                self.expect_op(")")
+                return ArrayAggregate(arr, init, acc, x, merge, fvar, fbody)
+            if lname == "zip_with":
+                other = self.expr()
+                self.expect_op(",")
+                (x, y), body = self._lambda_arg(2)
+                self.expect_op(")")
+                return ZipWith(arr, other, x, y, body)
             var, body = self._lambda_arg()
             self.expect_op(")")
             if lname == "transform":

@@ -485,6 +485,81 @@ class _BucketStore:
         self._mem = [[] for _ in range(self.n)]
 
 
+class _UnionStream(BatchStream):
+    """Concatenation of child streams (UNION ALL): children drain in
+    order, every batch re-encoded onto the union's shared string
+    dictionaries so one downstream jitted step serves all of them."""
+
+    def __init__(self, session, children: List[BatchStream],
+                 schema: T.StructType):
+        self.session = session
+        self.children_streams = children
+        self.schema = schema
+        self.batch_rows = children[0].batch_rows
+        self.capacity = max(c.capacity for c in children)
+        self.est_rows = sum(c.est_rows for c in children)
+        for c in children[1:]:
+            for a, b in zip(schema.fields, c.schema.fields):
+                if type(a.dataType) is not type(b.dataType):
+                    raise NotStreamable(
+                        f"streamed UNION needs identical column types; "
+                        f"{a.name}: {a.dataType} vs {b.dataType}")
+
+    def batches(self) -> Iterator[ColumnBatch]:
+        from ..io import reencode_strings
+        # shared dictionaries: union of every child's fixed dicts, built
+        # from the first batch of each child (dicts are fixed per stream)
+        names = self.schema.names
+        for child in self.children_streams:
+            for b in child.batches():
+                b = ColumnBatch(list(names), list(b.vectors), b.row_valid,
+                                b.capacity)      # positional rename
+                b = reencode_strings(b, self._shared_dicts(b))
+                yield normalize_valids(pad_to_capacity(b, self.capacity))
+
+    def _shared_dicts(self, batch: ColumnBatch) -> Dict[str, tuple]:
+        if not hasattr(self, "_dicts"):
+            # sorted union over ALL children's dictionaries, probed from
+            # their scan-level fixed dicts and materialized batches
+            merged: Dict[str, set] = {}
+            for c in self.children_streams:
+                child_dicts = getattr(c, "_dicts", None)
+                if child_dicts is None and hasattr(c, "child"):
+                    child_dicts = getattr(c.child, "_dicts", None)
+                if child_dicts is None and hasattr(c, "_batch"):
+                    child_dicts = _batch_dicts(c._batch)   # singleton
+                for name, f in zip(self.schema.names, c.schema.fields):
+                    if f.dataType.is_string:
+                        merged.setdefault(name, set())
+                        if child_dicts:
+                            # positional: child column name may differ
+                            cname = c.schema.names[
+                                self.schema.names.index(name)]
+                            merged[name] |= set(child_dicts.get(cname, ()))
+            self._dicts = {k: tuple(sorted(v)) for k, v in merged.items()}
+            self._seen_dict_tuples: set = set()
+        # a batch carrying words the pre-pass missed (computed strings)
+        # CANNOT extend the shared dicts mid-stream: downstream consumers
+        # (string min/max buffers, grace partitions) captured them from
+        # the first batch under the fixed-dictionary invariant, and a
+        # sorted extension shifts every existing code.  Fall back loudly.
+        for name, v in zip(batch.names, batch.vectors):
+            if v.dictionary is None:
+                continue
+            key = (name, v.dictionary)
+            if key in self._seen_dict_tuples:
+                continue
+            extra = set(v.dictionary) - set(self._dicts.get(name, ()))
+            if extra:
+                raise NotStreamable(
+                    f"streamed UNION column {name!r} produced dictionary "
+                    f"words outside the scan-level union "
+                    f"({sorted(extra)[:5]}...); the fixed-dictionary "
+                    "invariant cannot hold — falling back to eager")
+            self._seen_dict_tuples.add(key)
+        return self._dicts
+
+
 class _GraceJoinStream(BatchStream):
     """Grace hash join of two streams (``SortMergeJoinExec.scala:36`` role
     at out-of-core scale; the partition-then-join plan of Hybrid/Grace
@@ -938,6 +1013,12 @@ class _Builder:
             return self._breaker(node.children[0], node, topk=None)
         if isinstance(node, L.Join):
             return self._join(node)
+        if isinstance(node, L.Union):
+            kids = [self.build(c) for c in node.children]
+            streams = [k if isinstance(k, BatchStream)
+                       else _SingletonStream(k, self.batch_rows)
+                       for k in kids]
+            return _UnionStream(self.session, streams, node.schema())
         raise NotStreamable(f"{type(node).__name__} over an oversized "
                             "file relation is not streamable")
 

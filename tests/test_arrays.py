@@ -345,3 +345,58 @@ def test_gbt_rejects_nonbinary_labels(spark):
         spark.createDataFrame([(0.1, 1.0), (0.2, 2.0)], ["f0", "label"]))
     with pytest.raises(AnalysisException, match="binary labels"):
         GBTClassifier(maxIter=2).fit(df)
+
+
+def test_aggregate_hof(spark):
+    df = _hof_df(spark)
+    got = {r["id"]: (r["s"], r["p"]) for r in df.select(
+        "id",
+        F.aggregate("xs", F.lit(0), lambda acc, x: acc + x).alias("s"),
+        F.aggregate("xs", F.lit(0), lambda acc, x: acc + x,
+                    lambda acc: acc * 10).alias("p")).collect()}
+    assert got == {1: (6, 60), 2: (10, 100), 3: (0, 0), 4: (7, 70)}
+
+
+def test_zip_with_hof(spark):
+    df = spark.createDataFrame(
+        [(1, [1, 2, 3], [10, 20, 30]), (2, [5], [7, 9])],
+        ["id", "a", "b"])
+    got = {r["id"]: r["z"] for r in df.select(
+        "id", F.zip_with("a", "b", lambda x, y: x + y).alias("z")
+    ).collect()}
+    assert got[1] == [11, 22, 33]
+    assert got[2] == [12]           # null-padded short side -> null out
+
+
+def test_aggregate_zip_with_sql(spark):
+    _hof_df(spark).createOrReplaceTempView("aggv")
+    rows = spark.sql(
+        "SELECT id, aggregate(xs, 0, (acc, x) -> acc + x) AS s, "
+        "aggregate(xs, 1, (a, x) -> a * x, a -> a + 1000) AS p "
+        "FROM aggv ORDER BY id").collect()
+    got = {r["id"]: (r["s"], r["p"]) for r in rows}
+    assert got[1] == (6, 1006)      # product 1*2*3=6 -> +1000
+    assert got[3] == (0, 1001)      # empty: init survives
+    zw = spark.sql(
+        "SELECT zip_with(xs, xs, (x, y) -> x * y) AS z FROM aggv "
+        "WHERE id = 4").collect()
+    assert zw[0]["z"] == [25, 25, 49]
+    spark.catalog.dropTempView("aggv")
+
+
+def test_aggregate_rejects_string_acc(spark):
+    import pytest
+    from spark_tpu.expressions import AnalysisException
+    df = _hof_df(spark)
+    with pytest.raises(AnalysisException, match="string accumulator"):
+        df.select(F.aggregate("xs", F.lit("a"),
+                              lambda acc, x: acc)).collect()
+
+
+def test_duplicate_lambda_vars_rejected(spark):
+    import pytest
+    from spark_tpu.sql.parser import ParseException
+    _hof_df(spark).createOrReplaceTempView("dupv")
+    with pytest.raises(ParseException, match="duplicate"):
+        spark.sql("SELECT aggregate(xs, 0, (x, x) -> x + x) FROM dupv")
+    spark.catalog.dropTempView("dupv")

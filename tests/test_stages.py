@@ -298,3 +298,66 @@ def test_sharded_stage_grace_join(stm, data):
     (n, s), = q.collect()
     exp = pdfs["fact"].merge(pdfs["rets"], left_on="sk", right_on="ret_sk")
     assert (n, s) == (len(exp), int(exp.ret_qty.sum()))
+
+
+def test_streamed_union_of_big_facts(st, data, tmp_path):
+    """UNION ALL of two oversized relations streams (q2/q5/q71 shape)
+    instead of falling back to one eager whole-file batch."""
+    other = _fact(seed=101, n=900)
+    op = _write(tmp_path / "fact2.parquet", other, parts=3)
+    paths, pdfs = data
+    a = st.read.parquet(paths["fact"])
+    b = st.read.parquet(op)
+    df = (a.union(b).groupBy("item_k")
+          .agg(F.count("sk").alias("n"), F.sum("qty").alias("q"))
+          .orderBy("item_k"))
+    got = [tuple(r) for r in df.collect()]
+    both = pd.concat([pdfs["fact"], other], ignore_index=True)
+    exp = both.groupby("item_k", as_index=False).agg(
+        n=("sk", "count"), q=("qty", "sum")).sort_values("item_k")
+    assert got == list(zip(exp.item_k, exp.n, exp.q))
+
+
+def test_streamed_union_with_strings_and_join(st, data, tmp_path):
+    """Union of streams carrying STRING columns re-encodes onto shared
+    dictionaries, then joins a broadcast side downstream."""
+    rng = np.random.default_rng(31)
+    t1 = pd.DataFrame({"w": rng.choice(["ash", "oak", "elm"], 700),
+                       "v": rng.integers(0, 9, 700).astype(np.int64)})
+    t2 = pd.DataFrame({"w": rng.choice(["elm", "fir", "yew"], 600),
+                       "v": rng.integers(0, 9, 600).astype(np.int64)})
+    p1 = _write(tmp_path / "u1.parquet", t1, parts=3)
+    p2 = _write(tmp_path / "u2.parquet", t2, parts=3)
+    dim = st.createDataFrame(pd.DataFrame(
+        {"w": ["ash", "oak", "elm", "fir", "yew"],
+         "score": [1, 2, 3, 4, 5]}))
+    df = (st.read.parquet(p1).union(st.read.parquet(p2))
+          .join(dim, on="w")
+          .groupBy("w").agg(F.sum("v").alias("sv"),
+                            F.max("score").alias("sc"))
+          .orderBy("w"))
+    got = [tuple(r) for r in df.collect()]
+    both = pd.concat([t1, t2], ignore_index=True)
+    dimp = pd.DataFrame({"w": ["ash", "oak", "elm", "fir", "yew"],
+                         "score": [1, 2, 3, 4, 5]})
+    exp = (both.merge(dimp, on="w").groupby("w", as_index=False)
+           .agg(sv=("v", "sum"), sc=("score", "max")).sort_values("w"))
+    assert got == list(zip(exp.w, exp.sv, exp.sc))
+
+
+def test_streamed_union_unknown_words_falls_back(st, data, tmp_path):
+    """A union branch COMPUTING strings outside the scan dictionaries
+    must fall back loudly-but-correctly, never shift dictionary codes."""
+    rng = np.random.default_rng(41)
+    t1 = pd.DataFrame({"w": rng.choice(["ash", "oak"], 700),
+                       "v": rng.integers(0, 9, 700).astype(np.int64)})
+    p1 = _write(tmp_path / "uf1.parquet", t1, parts=3)
+    a = st.read.parquet(p1)
+    # upper() rewrites the dictionary at trace time: words OUTSIDE the
+    # scan-level union ("ASH"/"OAK") flow through the union stream
+    b = st.read.parquet(p1).select(F.upper("w").alias("w"), "v")
+    df = a.union(b).groupBy("w").agg(F.sum("v").alias("s")).orderBy("w")
+    got = {r["w"]: r["s"] for r in df.collect()}
+    sv = t1.groupby("w").v.sum()
+    assert got == {"ash": sv["ash"], "oak": sv["oak"],
+                   "ASH": sv["ash"], "OAK": sv["oak"]}
