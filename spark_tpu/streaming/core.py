@@ -711,10 +711,23 @@ class StreamExecution:
         self.id = str(uuid.uuid4())
 
         sources = _find_streaming(plan)
-        if len(sources) != 1:
+        self._ssjoin_node = None
+        if len(sources) == 2:
+            # stream-stream JOIN: both join subtrees read a stream (the
+            # reference 2.3 flagship, `StreamingSymmetricHashJoinExec`);
+            # validated + anchored here, executed incrementally below
+            self._ssjoin_node = self._find_ssjoin(plan)
+            if output_mode != "append":
+                raise AnalysisException(
+                    "stream-stream joins support append output mode only")
+        elif len(sources) != 1:
             raise AnalysisException(
-                f"exactly one streaming source supported, got {len(sources)}")
-        self.source = sources[0].source
+                f"at most two streaming sources supported, "
+                f"got {len(sources)}")
+        self.sources = [s.source for s in sources]
+        self.source = self.sources[0]
+        self._multi = len(self.sources) > 1
+        self._ss_buf = [None, None]          # per-side joined-row buffers
 
         self.offset_log = MetadataLog(os.path.join(checkpoint, "offsets")) \
             if checkpoint else _MemLog()
@@ -724,18 +737,23 @@ class StreamExecution:
             else None
 
         self.batch_id = 0
-        self.committed_offset: Optional[int] = None
+        self.committed_offset = [None] * len(sources) \
+            if len(sources) > 1 else None
         # event-time watermark (EventTimeWatermarkExec accumulation)
         wms = _find_nodes(plan, L.EventTimeWatermark)
         if len(wms) > 1:
             raise AnalysisException("multiple watermarks are not supported")
         self._wm_col: Optional[str] = wms[0].col_name if wms else None
         self._wm_delay: int = wms[0].delay_us if wms else 0
-        if self._wm_col is not None \
-                and self._wm_col not in self.source.schema().names:
-            raise AnalysisException(
-                f"watermark column {self._wm_col!r} must come from the "
-                "streaming source schema")
+        self._wm_src = 0
+        if self._wm_col is not None:
+            owners = [i for i, s in enumerate(self.sources)
+                      if self._wm_col in s.schema().names]
+            if not owners:
+                raise AnalysisException(
+                    f"watermark column {self._wm_col!r} must come from a "
+                    "streaming source schema")
+            self._wm_src = owners[0]
         self.watermark_us: Optional[int] = None
         self._max_event_us: Optional[int] = None
         self._dedup_state: Optional[DedupState] = None
@@ -758,7 +776,88 @@ class StreamExecution:
     # `catalyst/.../analysis/UnsupportedOperationChecker.scala`): find ALL
     # aggregates in the plan and reject shapes the incremental path cannot
     # run, instead of silently falling back to per-batch execution.
+    def _check_stateless_path(self, anchor, what: str,
+                              allowed=(L.Project, L.Filter)) -> None:
+        """Root→anchor must cross only stateless single-child operators
+        the finish step can re-apply per batch (shared by the agg/dedup/
+        fmgws/stream-stream-join anchors)."""
+        node = self.plan
+        while node is not anchor:
+            if not isinstance(node, allowed) or len(node.children) != 1:
+                raise AnalysisException(
+                    f"{what} under {type(node).__name__} cannot run "
+                    "incrementally")
+            node = node.children[0]
+
+    def _find_ssjoin(self, plan: L.LogicalPlan) -> L.Join:
+        """Locate + validate the stream-stream join anchor: one INNER
+        join whose BOTH subtrees read exactly one stream, reachable from
+        the root through stateless single-child operators."""
+        joins = [j for j in _find_nodes(plan, L.Join)
+                 if _find_streaming(j.left) and _find_streaming(j.right)]
+        if len(joins) != 1:
+            raise AnalysisException(
+                "exactly one stream-stream join is supported per query")
+        j = joins[0]
+        if j.how != "inner":
+            raise AnalysisException(
+                f"stream-stream {j.how} joins are not supported yet; "
+                "inner joins only (outer needs watermark-finalized "
+                "unmatched-row tracking)")
+        if len(_find_streaming(j.left)) != 1 \
+                or len(_find_streaming(j.right)) != 1:
+            raise AnalysisException(
+                "each stream-stream join side must read exactly one "
+                "stream")
+        self._check_stateless_path(j, "stream-stream join")
+        return j
+
+    # -- stream-stream join state ----------------------------------------
+    def _ssjoin_snapshot(self, batch_id: int) -> None:
+        if not self.state_dir:
+            return
+        d = os.path.join(self.state_dir, "ssjoin")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"state-{batch_id}.pkl")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(self._ss_buf, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+        stale = os.path.join(d, f"state-{batch_id - 2}.pkl")
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
+
+    def _ssjoin_restore(self, batch_id: int) -> None:
+        if not self.state_dir:
+            return
+        path = os.path.join(self.state_dir, "ssjoin",
+                            f"state-{batch_id}.pkl")
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                self._ss_buf = pickle.load(f)
+
     def _build_agg_state(self) -> Optional[AggregationState]:
+        if self._ssjoin_node is not None:
+            stateful = (
+                [a for a in _find_nodes(self.plan, L.Aggregate)
+                 if _find_streaming(a)]
+                + [d for d in _find_nodes(self.plan, L.Distinct)
+                   if _find_streaming(d)]
+                + [f for f in _find_nodes(self.plan,
+                                          L.FlatMapGroupsWithState)
+                   if _find_streaming(f)])
+            if stateful:
+                raise AnalysisException(
+                    "aggregation/deduplication over a stream-stream join "
+                    "is not supported yet")
+            self._fmgws_node = None
+            self._fmgws_provider = None
+            self._fmgws_states = {}
+            self._dedup_node = None
+            self._agg_node = None
+            return None
         # arbitrary stateful processing (FlatMapGroupsWithStateExec)
         fmgws = [n for n in _find_nodes(self.plan, L.FlatMapGroupsWithState)
                  if _find_streaming(n)]
@@ -776,14 +875,7 @@ class StreamExecution:
                 raise AnalysisException(
                     "flatMapGroupsWithState cannot be combined with "
                     "streaming aggregation/deduplication in one query")
-            walk = self.plan
-            while walk is not node:
-                if not isinstance(walk, (L.Project, L.Filter)) \
-                        or len(walk.children) != 1:
-                    raise AnalysisException(
-                        f"flatMapGroupsWithState under "
-                        f"{type(walk).__name__} cannot run incrementally")
-                walk = walk.children[0]
+            self._check_stateless_path(node, "flatMapGroupsWithState")
             if node.timeout_conf == "EventTimeTimeout" \
                     and self._wm_col is None:
                 raise AnalysisException(
@@ -834,14 +926,7 @@ class StreamExecution:
                 raise AnalysisException(
                     "deduplicating the output of a streaming aggregation "
                     "cannot be executed incrementally")
-            walk = self.plan
-            while walk is not node:
-                if not isinstance(walk, (L.Project, L.Filter)) \
-                        or len(walk.children) != 1:
-                    raise AnalysisException(
-                        f"streaming deduplication under "
-                        f"{type(walk).__name__} cannot run incrementally")
-                walk = walk.children[0]
+            self._check_stateless_path(node, "streaming deduplication")
             if isinstance(node, L.Aggregate):
                 for f, n in node.aggs:
                     if not (isinstance(f.children[0], Col)
@@ -978,8 +1063,16 @@ class StreamExecution:
         for b in range(last_offset_batch + 1):
             entry = self.offset_log.get(b)
             if entry is not None and entry.get("meta") is not None:
-                self.source.restore_offset_metadata(
-                    entry.get("start"), entry["end"], entry["meta"])
+                if self._multi:
+                    metas = entry["meta"]
+                    for src, st, e, m in zip(self.sources,
+                                             entry.get("start"),
+                                             entry["end"], metas):
+                        if m is not None:
+                            src.restore_offset_metadata(st, e, m)
+                else:
+                    self.source.restore_offset_metadata(
+                        entry.get("start"), entry["end"], entry["meta"])
             if entry is not None and entry.get("wm") is not None:
                 if self.watermark_us is None \
                         or entry["wm"] > self.watermark_us:
@@ -990,6 +1083,8 @@ class StreamExecution:
         if last_commit is not None and self._dedup_state is not None \
                 and self.state_dir:
             self._dedup_state.restore(self.state_dir, last_commit)
+        if last_commit is not None and self._ssjoin_node is not None:
+            self._ssjoin_restore(last_commit)
         if last_commit is not None and self._fmgws_node is not None \
                 and self._fmgws_provider is not None:
             # state after committed batch b lives at version b+1
@@ -1003,7 +1098,8 @@ class StreamExecution:
             self.batch_id = last_offset_batch
             prev = self.offset_log.get(last_offset_batch - 1) \
                 if last_offset_batch > 0 else None
-            self.committed_offset = prev["end"] if prev else None
+            self.committed_offset = prev["end"] if prev else (
+                [None] * len(self.sources) if self._multi else None)
 
     # -- the loop ---------------------------------------------------------
     def process_all_available(self) -> None:
@@ -1017,6 +1113,8 @@ class StreamExecution:
             return self._run_one_batch_locked()
 
     def _run_one_batch_locked(self) -> bool:
+        if self._multi:
+            return self._run_one_batch_multi()
         # replay path: offsets already logged for this batch id
         logged = self.offset_log.get(self.batch_id)
         if logged is not None:
@@ -1117,6 +1215,142 @@ class StreamExecution:
                     batch = ColumnBatch(batch.names, batch.vectors, keep,
                                         batch.capacity)
         return batch
+
+    def _run_one_batch_multi(self) -> bool:
+        """One micro-batch over TWO sources (stream-stream join): offsets
+        for both sides ride one WAL entry, each side's NEW rows run its
+        join subplan, and the incremental inner join emits
+        Δ(A⋈B) = ΔA⋈(B∪ΔB)  ∪  A⋈ΔB
+        against the buffered past rows (the symmetric hash join's two
+        probes, state in host batches).  A watermark declared on a side
+        bounds that side's buffer: rows older than the watermark are
+        evicted, which — exactly like the reference's watermarked
+        stream-stream join — DEFINES the result as pairs arriving within
+        the watermark window."""
+        logged = self.offset_log.get(self.batch_id)
+        if logged is not None:
+            starts, ends = logged["start"], logged["end"]
+            if "wm" in logged:
+                self.watermark_us = logged["wm"]
+            metas = logged.get("meta") or [None] * len(self.sources)
+            for src, st, e, m in zip(self.sources, starts, ends, metas):
+                if m is not None:
+                    src.restore_offset_metadata(st, e, m)
+        else:
+            starts = list(self.committed_offset)
+            ends = []
+            progressed = False
+            for i, src in enumerate(self.sources):
+                e = src.get_offset()
+                if e is None:
+                    e = starts[i]
+                if e != starts[i]:
+                    progressed = True
+                ends.append(e)
+            if not progressed:
+                return False
+            payload = {"start": starts, "end": ends}
+            if self._wm_col is not None:
+                payload["wm"] = self.watermark_us
+            metas = [src.offset_metadata(st, e)
+                     if e is not None and e != st else None
+                     for src, st, e in zip(self.sources, starts, ends)]
+            if any(m is not None for m in metas):
+                payload["meta"] = metas
+            self.offset_log.add(self.batch_id, payload)
+
+        t0 = time.time()
+        batches = []
+        for i, (src, st, e) in enumerate(zip(self.sources, starts, ends)):
+            if e is None or e == st:
+                b = ColumnBatch.empty(src.schema())
+            else:
+                b = src.get_batch(st, e)
+            if self._wm_col is not None and i == self._wm_src:
+                b = self._apply_watermark_input(b)
+            batches.append(b)
+
+        out = self._execute_ssjoin(batches)
+        self.sink.add_batch(self.batch_id, out, self.mode)
+        self._ssjoin_snapshot(self.batch_id)
+        commit_payload = {"ts": time.time()}
+        if self._wm_col is not None:
+            commit_payload["max_event"] = self._max_event_us
+            commit_payload["wm"] = self.watermark_us
+        self.commit_log.add(self.batch_id, commit_payload)
+        n_rows = sum(int(np.asarray(b.num_rows())) for b in batches)
+        self.progress.append({
+            "batchId": self.batch_id, "numInputRows": n_rows,
+            "processedRowsPerSecond":
+                n_rows / max(time.time() - t0, 1e-9),
+        })
+        self.committed_offset = list(ends)
+        for src, e in zip(self.sources, ends):
+            if e is not None:
+                try:
+                    src.commit(e)
+                except Exception:
+                    _log.warning("source.commit(%s) failed", e,
+                                 exc_info=True)
+        self.batch_id += 1
+        return True
+
+    def _execute_ssjoin(self, batches: List[ColumnBatch]) -> ColumnBatch:
+        from ..sql.planner import QueryExecution
+        j = self._ssjoin_node
+        rels = [_find_streaming(j.left)[0], _find_streaming(j.right)[0]]
+        # route each batch to ITS side (source identity, not position)
+        order = [self.sources.index(r.source) for r in rels]
+        new_sides = []
+        for side_plan, r, src_idx in zip((j.left, j.right), rels, order):
+            below = self._replace_source(side_plan, batches[src_idx])
+            new_sides.append(QueryExecution(self.session, below).execute())
+        new_wm = self._advance_watermark()
+
+        def join_of(a: ColumnBatch, b: ColumnBatch) -> ColumnBatch:
+            plan = L.Join(L.LocalRelation(a), L.LocalRelation(b),
+                          "inner", j.on, j.using)
+            return QueryExecution(self.session, plan).execute()
+
+        old_a, old_b = self._ss_buf
+        new_a, new_b = new_sides
+        all_b = new_b if old_b is None else union_all([old_b, new_b])
+        parts = [join_of(new_a, all_b)]
+        if old_a is not None:
+            parts.append(join_of(old_a, new_b))
+        parts = [p for p in parts
+                 if int(np.asarray(p.num_rows()))]
+        if parts:
+            out = compact(np, union_all(parts)) if len(parts) > 1 \
+                else parts[0]
+        else:
+            out = ColumnBatch.empty(j.schema())
+
+        # fold the new rows into the buffers; evict by watermark where the
+        # side carries the event-time column
+        # which SIDE the watermark was declared on (source identity):
+        # only that side's buffer is event-time bounded
+        wm_side = None
+        if self._wm_col is not None:
+            wm_side = order.index(self._wm_src) \
+                if self._wm_src in order else None
+
+        def fold(side, old, new):
+            buf = new if old is None else union_all([old, new])
+            buf = compact(np, buf)
+            if new_wm is not None and side == wm_side \
+                    and self._wm_col in buf.names:
+                kv, kvalid = _numeric_event_col(
+                    buf.column(self._wm_col), buf.capacity)
+                keep = np.asarray(buf.row_valid_or_true()) \
+                    & (~kvalid | (kv >= new_wm))
+                buf = compact(np, ColumnBatch(buf.names, buf.vectors,
+                                              keep, buf.capacity))
+            return buf
+
+        self._ss_buf = [fold(0, old_a, new_a), fold(1, old_b, new_b)]
+        above = self._rebuild_above_plan(j, L.LocalRelation(out))
+        return QueryExecution(self.session, above).execute()
 
     def _advance_watermark(self) -> Optional[int]:
         """Monotonic watermark update from the max event time seen so far.
