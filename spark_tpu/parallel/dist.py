@@ -321,8 +321,15 @@ class DPartialAggregate(DNode):
                 v = ectx.broadcast(func.children[0].eval(ectx))
                 contrib = live if (v.valid is None or not func.ignore_nulls) \
                     else (live & v.valid)
-                shard = _lax.axis_index(DATA_AXIS).astype(np.int64) \
-                    if xp is jnp else np.int64(0)
+                if xp is jnp:
+                    try:
+                        shard = _lax.axis_index(DATA_AXIS).astype(np.int64)
+                    except NameError:
+                        # plain jit outside shard_map (the multi-batch
+                        # per-batch step): single logical shard
+                        shard = np.int64(0)
+                else:
+                    shard = np.int64(0)
                 rank = (shard << np.int64(48)) \
                     + xp.arange(capacity, dtype=np.int64)
                 dead_rank = np.int64(-1) if is_last else np.int64(1 << 62)
@@ -525,6 +532,116 @@ class DFinalAggregate(DNode):
 
     def __repr__(self):
         return (f"FinalAggregate keys=[{', '.join(map(repr, self.keys))}] "
+                f"aggs=[{', '.join(n for _, n in self.slots)}]")
+
+
+class DMergePartial(DNode):
+    """Merge partial-aggregate states into a MERGED PARTIAL (not finished)
+    batch: re-groups by keys and reduces every buffer with its own kind,
+    emitting the result under the same buffer names/schema as the partial.
+
+    This is the cross-batch fold of the multi-batch runner (mode=PartialMerge
+    of the reference's ``AggUtils.scala`` — the one aggregation mode the
+    partial/final pair did not cover): fold(partials) is itself a valid
+    partial, so folds can chain without finishing, and first/last value-carry
+    triples merge by the exact `_first_last_reduce` the final stage uses."""
+
+    def __init__(self, keys, slots, partial: DPartialAggregate, child):
+        self.keys = list(keys)
+        self.slots = list(slots)
+        self.partial = partial
+        self.children = (child,)
+
+    def schema(self):
+        return self.partial.schema()
+
+    def run(self, ctx):
+        xp = ctx.xp
+        batch = self.children[0].run(ctx)
+        ectx = EvalContext(batch, xp)
+        live = batch.row_valid_or_true()
+        capacity = batch.capacity
+
+        key_refs = [Col(k.name) for k in self.keys]
+        key_vals = [ectx.broadcast(k.eval(ectx)) for k in key_refs]
+        sort_cols = [(~live).astype(np.int8)]
+        for v in key_vals:
+            data = v.data.astype(np.int8) if str(v.data.dtype) == "bool" else v.data
+            if v.valid is None:
+                sort_cols += [xp.zeros(capacity, np.int8), data]
+            else:
+                sort_cols += [xp.where(v.valid, np.int8(0), np.int8(-1)),
+                              xp.where(v.valid, data, xp.zeros((), data.dtype))]
+        perm = multi_key_argsort(xp, sort_cols, capacity)
+        sorted_cols = [c[perm] for c in sort_cols]
+        live_s = live[perm]
+
+        if self.keys:
+            change = xp.zeros(capacity, bool)
+            for c in sorted_cols:
+                change = change | (c != xp.concatenate([c[:1], c[:-1]]))
+            is_start = change.at[0].set(True) if xp is jnp else _np_set0(change)
+            is_start = is_start & live_s
+            seg_ids = xp.cumsum(is_start.astype(np.int64)) - 1
+            seg_ids = xp.where(live_s, seg_ids, np.int64(capacity - 1))
+            num_groups = xp.sum(is_start.astype(np.int64))
+        else:
+            seg_ids = xp.zeros(capacity, np.int64)
+            is_start = None
+            num_groups = None
+
+        from ..kernels import _scatter_starts
+        cs_child = self.partial.children[0].schema()
+        names, vectors = [], []
+        for k, v in zip(self.keys, key_vals):
+            dt = k.data_type(cs_child)
+            kd = _scatter_starts(xp, v.data[perm], seg_ids, is_start, capacity)
+            kv = None if v.valid is None else _scatter_starts(
+                xp, v.valid[perm], seg_ids, is_start, capacity)
+            names.append(k.name)
+            vectors.append(ColumnVector(kd.astype(dt.np_dtype), dt, kv,
+                                        v.dictionary))
+
+        from ..aggregates import IDENTITY
+        for i, (func, _n) in enumerate(self.slots):
+            if isinstance(func, First):
+                is_last = getattr(func, "ARGREDUCE", "first") == "last"
+                dead_rank = np.int64(-1) if is_last else np.int64(1 << 62)
+                bn_rank, bn_val, bn_valid = self.partial.buffer_names(i, func)
+                rank_col = batch.column(bn_rank).data
+                val_col = batch.column(bn_val)
+                validplane = batch.column(bn_valid).data != 0
+                rank_m = xp.where(live, rank_col, dead_rank)
+                r_red, v_red, valid_red = _first_last_reduce(
+                    xp, rank_m[perm], dead_rank, val_col.data[perm],
+                    validplane[perm], seg_ids, is_last, capacity)
+                names += [bn_rank, bn_val, bn_valid]
+                vectors.append(ColumnVector(r_red, T.int64, None, None))
+                vectors.append(ColumnVector(v_red, val_col.dtype, None,
+                                            val_col.dictionary))
+                vectors.append(ColumnVector(valid_red.astype(np.int8),
+                                            T.int8, None, None))
+                continue
+            for j, kind in enumerate(DFinalAggregate._buffer_kinds(func)):
+                bname = self.partial.buffer_names(i, func)[j]
+                col = batch.column(bname)
+                np_dt = np.dtype(str(col.data.dtype))
+                ident = IDENTITY[kind](np_dt)
+                masked = xp.where(live, col.data, np.asarray(ident, np_dt))
+                reduced = segment_reduce(xp, masked[perm], seg_ids, capacity,
+                                         kind)
+                names.append(bname)
+                vectors.append(ColumnVector(reduced, col.dtype, None,
+                                            col.dictionary))
+
+        if self.keys:
+            rv = xp.arange(capacity, dtype=np.int64) < num_groups
+        else:
+            rv = xp.arange(capacity, dtype=np.int64) < 1
+        return ColumnBatch(names, vectors, rv, capacity)
+
+    def __repr__(self):
+        return (f"MergePartial keys=[{', '.join(map(repr, self.keys))}] "
                 f"aggs=[{', '.join(n for _, n in self.slots)}]")
 
 

@@ -35,14 +35,14 @@ import jax.numpy as jnp
 
 from .. import config as C
 from .. import types as T
-from ..aggregates import MERGE_BY_KIND, First, Last, Max, Min, buffer_kinds
+from ..aggregates import First, Last, Max, Min
 from ..columnar import (
     ColumnBatch, ColumnVector, normalize_valids, pad_capacity,
     pad_to_capacity,
 )
 from ..expressions import Col, EvalContext, Expression
 from ..kernels import (
-    _sorted_grouped_aggregate, compact, distinct as k_distinct, union_all,
+    compact, distinct as k_distinct, union_all,
 )
 from . import logical as L
 from . import physical as P
@@ -62,6 +62,13 @@ MULTIBATCH_CKPT_INTERVAL = C.conf("spark.tpu.multibatch.checkpointInterval"
     "Scan batches between checkpoints when checkpointDir is set."
 ).int(32)
 
+GRACE_AGG_BUCKETS = C.conf("spark.tpu.graceAgg.buckets").doc(
+    "Key-hash spill buckets for grace hash aggregation (collect_list/"
+    "collect_set/percentile over a streamed scan).  Expected per-bucket "
+    "size is total rows / buckets; each bucket is aggregated eagerly "
+    "host-side at finish."
+).int(32)
+
 
 # ---------------------------------------------------------------------------
 # plan decomposition
@@ -73,6 +80,8 @@ class _Decomposed(NamedTuple):
     breaker: Optional[L.LogicalPlan]  # Aggregate | Sort | Distinct | Limit
     topk: Optional[int]               # Limit fused into a Sort breaker
     above: List[L.LogicalPlan]        # ops above the breaker, top-down
+    grace: bool = False               # Aggregate breaker w/o mergeable
+                                      # partial: grace hash aggregation
 
 
 def _with_child(op: L.LogicalPlan, child: L.LogicalPlan):
@@ -134,6 +143,7 @@ def _decompose(optimized: L.LogicalPlan) -> Optional[_Decomposed]:
     breaker: Optional[L.LogicalPlan] = None
     topk: Optional[int] = None
     above: List[L.LogicalPlan] = []
+    grace = False
     if rest:
         cand = rest[-1]
         if not isinstance(cand, (L.Aggregate, L.Sort, L.Distinct, L.Limit)):
@@ -146,17 +156,30 @@ def _decompose(optimized: L.LogicalPlan) -> Optional[_Decomposed]:
             above = above[:-1]
         if isinstance(breaker, L.Aggregate):
             for f, _n in breaker.aggs:
-                if isinstance(f, (First, Last)) \
-                        or getattr(f, "is_distinct", False) \
-                        or getattr(f, "is_collect", False) \
-                        or getattr(f, "is_percentile", False):
-                    # no fixed-width mergeable partial form: these run on
-                    # the eager single-batch sort path
+                if getattr(f, "is_distinct", False):
+                    # the analyzer rewrites distinct aggs into two-level
+                    # aggregation; a raw one here would merge WRONG (its
+                    # partial ignores distinctness) — keep it eager
                     return None
+                if getattr(f, "is_collect", False) \
+                        or getattr(f, "is_percentile", False):
+                    # no fixed-width mergeable partial: grace hash
+                    # aggregation (spill rows bucketed by key hash, then
+                    # aggregate each bucket eagerly — exact, since groups
+                    # never straddle buckets)
+                    grace = True
         for op in above:
             if _with_child(op, leaf) is None:
                 return None
-    return _Decomposed(leaf, spine, breaker, topk, above)
+    return _Decomposed(leaf, spine, breaker, topk, above, grace)
+
+
+def default_spill_dir(conf) -> str:
+    """The one definition of where mergers spill (configured dir, or a
+    per-process tmp dir) — shared by the linear runner and the stage
+    runner so every spill store lands in the same place."""
+    return conf.get(C.SPILL_DIR) or os.path.join(
+        tempfile.gettempdir(), f"spark_tpu_spill_{os.getpid()}")
 
 
 # ---------------------------------------------------------------------------
@@ -397,15 +420,37 @@ class _AggMerger:
         self.fold_rows = fold_rows
         self._acc: List[ColumnBatch] = []
         self._rows = 0
-        # slot_idx -> dictionary for string-typed min/max value buffers
+        # slot_idx -> dictionary for string-typed min/max/first value buffers
         self._str_dicts = str_minmax_dicts
+        self._first_slots = [i for i, (f, _n) in enumerate(self.slots)
+                             if isinstance(f, First)]
+        self._batch_ord = -1   # bumped by next_batch() before each scan batch
+
+    def __setstate__(self, state):
+        # checkpoints pickled by builds that predate the first/last rank
+        # rebase lack these fields; default them (such checkpoints cannot
+        # contain First slots — the old guard excluded them)
+        self.__dict__.update(state)
+        self.__dict__.setdefault("_first_slots", [
+            i for i, (f, _n) in enumerate(self.slots)
+            if isinstance(f, First)])
+        self.__dict__.setdefault("_batch_ord", -1)
+
+    def next_batch(self) -> None:
+        """Called once per scan batch (before its runs are added): advances
+        the scan ordinal used to rebase first/last ranks across batches."""
+        self._batch_ord += 1
 
     def _attach_dicts(self, pbatch: ColumnBatch) -> ColumnBatch:
         if not self._str_dicts:
             return pbatch
         vectors = list(pbatch.vectors)
         for i, d in self._str_dicts.items():
-            bname = self.partial.buffer_names(i, self.slots[i][0])[0]
+            func = self.slots[i][0]
+            # First/Last carry (rank, value, valid): the VALUE buffer is
+            # index 1; min/max value buffers are index 0
+            bidx = 1 if isinstance(func, First) else 0
+            bname = self.partial.buffer_names(i, func)[bidx]
             j = pbatch.names.index(bname)
             v = vectors[j]
             # typed as STRING (codes + dictionary) so union_all's fold path
@@ -415,28 +460,57 @@ class _AggMerger:
         return ColumnBatch(list(pbatch.names), vectors, pbatch.row_valid,
                            pbatch.capacity)
 
-    def _merge_slots(self):
-        out = []
-        for i, (f, _n) in enumerate(self.slots):
-            kinds = buffer_kinds(f, self.child_schema)
-            for j, kind in enumerate(kinds):
-                bname = self.partial.buffer_names(i, f)[j]
-                out.append((MERGE_BY_KIND[kind](Col(bname)), bname))
-        return out
+    def _rebase_ranks(self, pbatch: ColumnBatch) -> ColumnBatch:
+        """Re-encode first/last rank buffers from per-batch coordinates
+        (shard << 48 | row) into scan-global (batch_ord, shard, row)
+        lexicographic int64s, so the cross-batch min/max picks the
+        scan-order-first (or -last) contributing row — the determinism the
+        single-batch path already provides."""
+        if not self._first_slots:
+            return pbatch
+        if pbatch.capacity > (1 << 24):
+            raise RuntimeError(
+                f"first/last rank rebase requires batch capacity <= 2^24 "
+                f"rows, got {pbatch.capacity}")
+        if self._batch_ord >= (1 << 29):
+            raise RuntimeError("first/last rank rebase overflow: > 2^29 "
+                               "scan batches")
+        live = np.asarray(pbatch.row_valid_or_true())
+        names = list(pbatch.names)
+        vectors = list(pbatch.vectors)
+        for i in self._first_slots:
+            func = self.slots[i][0]
+            is_last = getattr(func, "ARGREDUCE", "first") == "last"
+            dead = np.int64(-1) if is_last else np.int64(1 << 62)
+            bname = self.partial.buffer_names(i, func)[0]
+            j = names.index(bname)
+            v = vectors[j]
+            rank = np.asarray(v.data).astype(np.int64)
+            mask = live & (rank != dead)
+            shard = rank >> np.int64(48)
+            row = rank & np.int64((1 << 48) - 1)
+            if mask.any() and int(shard[mask].max()) >= 256:
+                raise RuntimeError("first/last rank rebase supports at most "
+                                   "256 shards per batch")
+            enc = (np.int64(self._batch_ord) << np.int64(32)) \
+                | (shard << np.int64(24)) | row
+            vectors[j] = ColumnVector(np.where(mask, enc, dead), v.dtype,
+                                      v.valid, v.dictionary)
+        return ColumnBatch(names, vectors, pbatch.row_valid, pbatch.capacity)
 
     def _fold(self) -> None:
         if len(self._acc) <= 1:
             return
+        from ..parallel.dist import DMergePartial
         allp = union_all(self._acc)
-        key_cols = [Col(k.name) for k in self.keys]
-        merged = _sorted_grouped_aggregate(
-            np, allp, key_cols, self._merge_slots())
-        folded = compact(np, merged)
+        merge = DMergePartial(self.keys, self.slots, self.partial,
+                              P.PScan(0, allp.schema))
+        folded = compact(np, merge.run(P.ExecContext(np, [allp])))
         self._acc = [folded]
         self._rows = int(np.asarray(folded.num_rows()))
 
     def add(self, pbatch: ColumnBatch) -> bool:
-        pbatch = self._attach_dicts(pbatch)
+        pbatch = self._rebase_ranks(self._attach_dicts(pbatch))
         self._acc.append(pbatch)
         self._rows += int(np.asarray(pbatch.num_rows()))
         if self._rows > self.fold_rows:
@@ -452,6 +526,88 @@ class _AggMerger:
         final = DFinalAggregate(self.keys, self.slots, self.partial,
                                 P.PScan(0, state.schema))
         return compact(np, final.run(P.ExecContext(np, [state])))
+
+
+class _GraceAggMerger:
+    """Grace hash aggregation for aggregates with no fixed-width mergeable
+    partial (collect_list/collect_set, percentile — and any mix of them
+    with ordinary slots): raw spine rows stream into spill buckets by
+    group-key hash (the grace join's ``_BucketStore``: shared RAM budget,
+    native counting-sort partitioner), and each bucket is aggregated
+    EAGERLY host-side at finish.  Groups never straddle buckets, so
+    per-bucket results are exact and disjoint — the
+    ``ObjectHashAggregateExec`` + ``SortAggregateExec`` fallback role
+    (``ObjectHashAggregateExec.scala``)."""
+
+    def __init__(self, session, agg, spine_schema: T.StructType,
+                 n_buckets: int, budget_rows: int, spill_dir: str):
+        from .stages import _BucketStore
+        self.session = session
+        self.keys = list(agg.keys)
+        self.aggs = list(agg.aggs)
+        self.spine_schema = spine_schema
+        self.n_buckets = max(1, n_buckets) if self.keys else 1
+        self.store = _BucketStore(self.n_buckets, budget_rows, spill_dir)
+
+    def __getstate__(self):
+        # the session holds locks and is process-local; a resumed merger
+        # reattaches to the active session at finish time
+        d = dict(self.__dict__)
+        d["session"] = None
+        return d
+
+    def add(self, batch: ColumnBatch) -> bool:
+        from .stages import _live
+        live = _live(compact(np, batch.to_host()))
+        if live.capacity == 0:
+            return True
+        if self.n_buckets == 1:
+            bucket = np.zeros(live.capacity, np.int64)
+        else:
+            from ..expressions import Hash64
+            ectx = EvalContext(live, np)
+            h = ectx.broadcast(Hash64(*self.keys).eval(ectx)).data
+            bucket = (np.asarray(h).astype(np.uint64)
+                      % np.uint64(self.n_buckets)).astype(np.int64)
+        self.store.add(live, bucket)
+        return True
+
+    def _eager_agg(self, bucket_batch: ColumnBatch) -> ColumnBatch:
+        session = self.session
+        if session is None:
+            from .session import SparkSession
+            session = SparkSession.getActiveSession()
+            if session is None:
+                raise RuntimeError(
+                    "grace aggregation resumed without an active session")
+        node = L.Aggregate(self.keys, self.aggs,
+                           L.LocalRelation(bucket_batch))
+        planner = Planner(session)
+        leaves: List[ColumnBatch] = []
+        phys = planner._to_physical(node, leaves)
+        planner._assign_op_ids(phys, [1])
+        out = phys.run(P.ExecContext(np, [b.to_host() for b in leaves]))
+        return compact(np, out.to_host())
+
+    def finish(self) -> ColumnBatch:
+        outs: List[ColumnBatch] = []
+        for b in range(self.n_buckets):
+            runs = self.store.load(b)
+            if not runs:
+                continue
+            out = self._eager_agg(
+                union_all(runs) if len(runs) > 1 else runs[0])
+            if int(np.asarray(out.num_rows())):
+                outs.append(out)
+        self.close_spills()
+        if not outs:
+            # zero input rows: aggregate an empty relation so a global
+            # aggregate still produces its single (empty/NULL) row
+            return self._eager_agg(ColumnBatch.empty(self.spine_schema))
+        return union_all(outs) if len(outs) > 1 else outs[0]
+
+    def close_spills(self) -> None:
+        self.store.close()
 
 
 # ---------------------------------------------------------------------------
@@ -480,8 +636,12 @@ class MultiBatchExecution:
         spine_schema = phys.schema()
         breaker = self.dec.breaker
         if isinstance(breaker, L.Aggregate):
-            from ..parallel.dist import DPartialAggregate
-            phys = DPartialAggregate(breaker.keys, breaker.aggs, phys)
+            if self.dec.grace:
+                pass   # grace hash agg: stream raw spine rows; the merger
+                       # buckets them host-side by key hash
+            else:
+                from ..parallel.dist import DPartialAggregate
+                phys = DPartialAggregate(breaker.keys, breaker.aggs, phys)
         elif isinstance(breaker, L.Sort):
             orders = [(o.child, o.ascending, o.nulls_first)
                       for o in breaker.orders]
@@ -517,14 +677,17 @@ class MultiBatchExecution:
                      template: ColumnBatch):
         conf = self.session.conf
         breaker = self.dec.breaker
+        spill_dir = default_spill_dir(conf)
         if isinstance(breaker, L.Aggregate):
+            if self.dec.grace:
+                return _GraceAggMerger(
+                    self.session, breaker, spine_schema,
+                    conf.get(GRACE_AGG_BUCKETS),
+                    conf.get(C.SPILL_MEMORY_ROWS), spill_dir)
             str_dicts = self._string_minmax_dicts(
                 breaker, spine_schema, template)
             return _AggMerger(breaker.keys, breaker.aggs, spine_schema,
                               conf.get(C.AGG_FOLD_ROWS), str_dicts)
-        spill_dir = conf.get(C.SPILL_DIR) or \
-            os.path.join(tempfile.gettempdir(),
-                         f"spark_tpu_spill_{os.getpid()}")
         spill = SpilledRuns(conf.get(C.SPILL_MEMORY_ROWS), spill_dir)
         if isinstance(breaker, L.Sort):
             orders = [(o.child, o.ascending, o.nulls_first)
@@ -547,7 +710,7 @@ class MultiBatchExecution:
         rows)."""
         needed = [
             i for i, (f, _n) in enumerate(agg.aggs)
-            if isinstance(f, (Min, Max)) and f.children
+            if isinstance(f, (Min, Max, First)) and f.children
             and f.children[0].data_type(spine_schema).is_string
         ]
         if not needed:
@@ -640,6 +803,8 @@ class MultiBatchExecution:
                 n_batches += 1
                 if n_batches <= skip:
                     continue             # already folded into the merger
+                if hasattr(merger, "next_batch"):
+                    merger.next_batch()
                 more = True
                 for host in self._run_batch(jstep, b):
                     if not merger.add(host):
@@ -665,6 +830,9 @@ class MultiBatchExecution:
             spill = getattr(merger, "spill", None)
             if spill is not None and (not ckpt or completed):
                 spill.close()          # crash-safe: no leaked run files
+            if not completed and hasattr(merger, "close_spills") \
+                    and (not ckpt):
+                merger.close_spills()  # grace buckets: same crash cleanup
         if ckpt and os.path.exists(ckpt):
             try:
                 os.remove(ckpt)        # completed: cursor is obsolete

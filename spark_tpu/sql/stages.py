@@ -847,15 +847,20 @@ def _null_extend(probe: ColumnBatch, out_schema: T.StructType,
 # breakers over a stream (shared mergers)
 # ---------------------------------------------------------------------------
 
-def _mergeable_agg(agg: L.Aggregate) -> bool:
-    from ..aggregates import First, Last
+def _agg_mode(agg: L.Aggregate) -> Optional[str]:
+    """'partial' (mergeable fixed-width buffers, incl. first/last value-
+    carry), 'grace' (collect/percentile: bucket-spill + eager per bucket),
+    or None (raw distinct agg — the analyzer normally rewrites these;
+    an unrewritten one must stay on the eager path, its partial would
+    silently ignore distinctness)."""
+    grace = False
     for f, _n in agg.aggs:
-        if isinstance(f, (First, Last)) \
-                or getattr(f, "is_distinct", False) \
-                or getattr(f, "is_collect", False) \
+        if getattr(f, "is_distinct", False):
+            return None
+        if getattr(f, "is_collect", False) \
                 or getattr(f, "is_percentile", False):
-            return False
-    return True
+            grace = True
+    return "grace" if grace else "partial"
 
 
 def _run_breaker(session, stream: BatchStream, breaker: L.LogicalPlan,
@@ -870,60 +875,77 @@ def _run_breaker(session, stream: BatchStream, breaker: L.LogicalPlan,
     conf = session.conf
 
     def make_spill():
-        spill_dir = conf.get(C.SPILL_DIR) or os.path.join(
-            tempfile.gettempdir(), f"spark_tpu_spill_{os.getpid()}")
-        from .multibatch import SpilledRuns
-        return SpilledRuns(conf.get(C.SPILL_MEMORY_ROWS), spill_dir)
+        from .multibatch import SpilledRuns, default_spill_dir
+        return SpilledRuns(conf.get(C.SPILL_MEMORY_ROWS),
+                           default_spill_dir(conf))
 
     compiled = None
     merger = None
     phys_wrap = None
     spine_schema = stream.schema
-    for b in mapped.child.batches():
-        if compiled is None:
-            # build the fused step: mapped chain + breaker partial
-            if isinstance(breaker, L.Aggregate):
-                from ..parallel.dist import DPartialAggregate
-                phys_wrap = (lambda p: DPartialAggregate(
-                    breaker.keys, breaker.aggs, p))
-                merger = _AggMerger(
-                    breaker.keys, breaker.aggs, spine_schema,
-                    conf.get(C.AGG_FOLD_ROWS),
-                    _string_minmax_dicts(session, mapped, breaker, b))
-            elif isinstance(breaker, L.Sort):
-                orders = [(o.child, o.ascending, o.nulls_first)
-                          for o in breaker.orders]
+    try:
+        for b in mapped.child.batches():
+            if compiled is None:
+                # build the fused step: mapped chain + breaker partial
+                if isinstance(breaker, L.Aggregate) \
+                        and _agg_mode(breaker) == "grace":
+                    from .multibatch import (
+                        GRACE_AGG_BUCKETS, _GraceAggMerger, default_spill_dir,
+                    )
+                    phys_wrap = None   # stream raw spine rows
+                    merger = _GraceAggMerger(
+                        session, breaker, spine_schema,
+                        conf.get(GRACE_AGG_BUCKETS),
+                        conf.get(C.SPILL_MEMORY_ROWS),
+                        default_spill_dir(conf))
+                elif isinstance(breaker, L.Aggregate):
+                    from ..parallel.dist import DPartialAggregate
+                    phys_wrap = (lambda p: DPartialAggregate(
+                        breaker.keys, breaker.aggs, p))
+                    merger = _AggMerger(
+                        breaker.keys, breaker.aggs, spine_schema,
+                        conf.get(C.AGG_FOLD_ROWS),
+                        _string_minmax_dicts(session, mapped, breaker, b))
+                elif isinstance(breaker, L.Sort):
+                    orders = [(o.child, o.ascending, o.nulls_first)
+                              for o in breaker.orders]
 
-                def phys_wrap(p, orders=orders):
-                    p = P.PSort(orders, p)
-                    return P.PLimit(topk, p) if topk is not None else p
-                merger = _SortMerger(make_spill(), orders, topk)
-            elif isinstance(breaker, L.Distinct):
-                phys_wrap = P.PDistinct
-                merger = _DistinctMerger(make_spill(),
-                                         conf.get(C.AGG_FOLD_ROWS))
-            elif isinstance(breaker, L.Limit):
-                phys_wrap = (lambda p: P.PLimit(breaker.n, p))
-                merger = _ConcatMerger(make_spill(), limit=breaker.n)
-            else:
-                raise NotStreamable(f"unsupported breaker {breaker!r}")
-            compiled = mapped._compile(b, phys_wrap)
-        runs, compiled = mapped._run_step(compiled, b, phys_wrap)
-        more = True
-        for host in runs:
-            if not merger.add(host):
-                more = False
+                    def phys_wrap(p, orders=orders):
+                        p = P.PSort(orders, p)
+                        return P.PLimit(topk, p) if topk is not None else p
+                    merger = _SortMerger(make_spill(), orders, topk)
+                elif isinstance(breaker, L.Distinct):
+                    phys_wrap = P.PDistinct
+                    merger = _DistinctMerger(make_spill(),
+                                             conf.get(C.AGG_FOLD_ROWS))
+                elif isinstance(breaker, L.Limit):
+                    phys_wrap = (lambda p: P.PLimit(breaker.n, p))
+                    merger = _ConcatMerger(make_spill(), limit=breaker.n)
+                else:
+                    raise NotStreamable(f"unsupported breaker {breaker!r}")
+                compiled = mapped._compile(b, phys_wrap)
+            if hasattr(merger, "next_batch"):
+                merger.next_batch()
+            runs, compiled = mapped._run_step(compiled, b, phys_wrap)
+            more = True
+            for host in runs:
+                if not merger.add(host):
+                    more = False
+                    break
+            if not more:
+                _log.info("stage breaker early exit")
                 break
-        if not more:
-            _log.info("stage breaker early exit")
-            break
-    if merger is None:
-        return ColumnBatch.empty(breaker.schema())
-    result = merger.finish()
-    spill = getattr(merger, "spill", None)
-    if spill is not None:
-        spill.close()
-    return compact(np, result.to_host())
+        if merger is None:
+            return ColumnBatch.empty(breaker.schema())
+        result = merger.finish()
+        return compact(np, result.to_host())
+    finally:
+        if merger is not None:
+            spill = getattr(merger, "spill", None)
+            if spill is not None:
+                spill.close()
+            if hasattr(merger, "close_spills"):
+                merger.close_spills()
 
 
 def _string_minmax_dicts(session, mapped: _MappedStream, agg: L.Aggregate,
@@ -932,11 +954,11 @@ def _string_minmax_dicts(session, mapped: _MappedStream, agg: L.Aggregate,
     value buffer holds codes; the dictionary is trace-time-static because
     stream dictionaries are fixed) — multibatch.py's probe, re-based on
     the mapped chain."""
-    from ..aggregates import Max, Min
+    from ..aggregates import First, Max, Min
     spine_schema = mapped.schema
     needed = [
         i for i, (f, _n) in enumerate(agg.aggs)
-        if isinstance(f, (Min, Max)) and f.children
+        if isinstance(f, (Min, Max, First)) and f.children
         and f.children[0].data_type(spine_schema).is_string
     ]
     if not needed:
@@ -1000,9 +1022,9 @@ class _Builder:
             return self._breaker(sort.children[0], sort, topk=node.n)
         if isinstance(node, (L.Aggregate, L.Sort, L.Distinct, L.Limit)):
             self._det(node)
-            if isinstance(node, L.Aggregate) and not _mergeable_agg(node):
-                # First/Last/distinct/collect/percentile have no fixed-width
-                # mergeable partial: materialize the stream, run eagerly
+            if isinstance(node, L.Aggregate) and _agg_mode(node) is None:
+                # raw distinct agg (analyzer rewrite bypassed): no safe
+                # streamed form — materialize the stream, run eagerly
                 src = self.build(node.children[0])
                 mat = self._materialize(src)
                 _log.info("non-mergeable aggregate: materialized %d rows "

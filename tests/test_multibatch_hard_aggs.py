@@ -1,0 +1,216 @@
+"""Streamed (out-of-core) execution of the 'hard' aggregates.
+
+VERDICT r3 item 4: first/last, count/sum DISTINCT, collect_list/set and
+percentile used to force the eager single-batch path (multibatch.py
+guard); each breaks the moment data exceeds one batch.  Now:
+
+* first/last stream through the (rank, value, valid) value-carry triple of
+  ``DPartialAggregate`` with a host-side scan-order rank rebase, merged by
+  ``DMergePartial`` (mode=PartialMerge of the reference's AggUtils.scala);
+* distinct aggs stream via the analyzer's two-level expansion
+  (``RewriteDistinctAggregates.scala`` analog) whose inner aggregate is a
+  plain mergeable breaker;
+* collect/percentile stream through grace hash aggregation (key-hash spill
+  buckets + per-bucket eager host aggregation —
+  ``ObjectHashAggregateExec.scala``'s role).
+
+Data is ≥4x batch capacity; every result is checked against a pandas
+oracle computed over the full dataset.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_tpu.config as C
+from spark_tpu.sql import functions as F
+
+BATCH = 256
+N = 2000             # ~8 scan batches of BATCH rows
+
+
+def _pdf(seed=13):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(50.0, 20.0, N)
+    x[rng.random(N) < 0.07] = np.nan          # NULL measures
+    return pd.DataFrame({
+        "id": np.arange(N, dtype=np.int64),
+        "grp": rng.choice(["ash", "beech", "cedar", "doum", "elm"], N),
+        "x": x,
+        "k": rng.integers(0, 40, N).astype(np.int64),
+    })
+
+
+@pytest.fixture(scope="module")
+def bigfile(tmp_path_factory):
+    d = tmp_path_factory.mktemp("mbh") / "big.parquet"
+    os.makedirs(d)
+    pdf = _pdf()
+    step = N // 4
+    for i in range(4):
+        pdf.iloc[i * step:(i + 1) * step].to_parquet(
+            d / f"part-{i:03d}.parquet", index=False)
+    return str(d), pdf
+
+
+@pytest.fixture()
+def mb(spark):
+    old = spark.conf.get(C.SCAN_MAX_BATCH_ROWS)
+    old_len = spark.conf.get(C.COLLECT_MAX_LEN)
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(BATCH))
+    # groups here run ~N/5 elements; raise the static collect cap so the
+    # oracle comparison is exact (the cap itself is a documented deviation)
+    spark.conf.set(C.COLLECT_MAX_LEN.key, str(1024))
+    yield spark
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(old))
+    spark.conf.set(C.COLLECT_MAX_LEN.key, str(old_len))
+
+
+def _uses_multibatch(session, df) -> bool:
+    from spark_tpu.sql.multibatch import plan_multibatch
+    from spark_tpu.sql.planner import QueryExecution
+    qe = QueryExecution(session, df._plan)
+    return plan_multibatch(session, qe.optimized) is not None
+
+
+# ---------------------------------------------------------------------------
+# first / last
+# ---------------------------------------------------------------------------
+
+def test_first_last_stream_scan_order(mb, bigfile):
+    path, pdf = bigfile
+    df = mb.read.parquet(path).groupBy("grp").agg(
+        F.first("id").alias("f"), F.last("id").alias("l"))
+    assert _uses_multibatch(mb, df)
+    got = {r[0]: (r[1], r[2]) for r in df.collect()}
+    exp = pdf.groupby("grp").agg(f=("id", "first"), l=("id", "last"))
+    assert got == {g: (int(r.f), int(r.l)) for g, r in exp.iterrows()}
+
+
+def test_first_last_ignore_nulls(mb, bigfile):
+    path, pdf = bigfile
+    df = mb.read.parquet(path).groupBy("grp").agg(
+        F.first("x").alias("f"), F.last("x").alias("l"))
+    got = {r[0]: (r[1], r[2]) for r in df.collect()}
+    sub = pdf.dropna(subset=["x"])
+    exp = sub.groupby("grp").agg(f=("x", "first"), l=("x", "last"))
+    for g, r in exp.iterrows():
+        np.testing.assert_allclose(got[g], (r.f, r.l), rtol=1e-12)
+
+
+def test_first_string_stream(mb, bigfile):
+    path, pdf = bigfile
+    df = mb.read.parquet(path).groupBy("k").agg(F.first("grp").alias("f"))
+    got = {r[0]: r[1] for r in df.collect()}
+    exp = pdf.groupby("k").agg(f=("grp", "first"))
+    assert got == {int(k): r.f for k, r in exp.iterrows()}
+
+
+def test_global_first_last(mb, bigfile):
+    path, pdf = bigfile
+    (f, l), = mb.read.parquet(path).agg(
+        F.first("id").alias("f"), F.last("id").alias("l")).collect()
+    assert (f, l) == (0, N - 1)
+
+
+# ---------------------------------------------------------------------------
+# distinct aggregates (analyzer two-level expansion over the stream)
+# ---------------------------------------------------------------------------
+
+def test_count_distinct_stream(mb, bigfile):
+    path, pdf = bigfile
+    df = mb.read.parquet(path).groupBy("grp").agg(
+        F.countDistinct("k").alias("cd"))
+    got = {r[0]: r[1] for r in df.collect()}
+    exp = pdf.groupby("grp").k.nunique()
+    assert got == {g: int(v) for g, v in exp.items()}
+
+
+def test_sum_distinct_stream(mb, bigfile):
+    path, pdf = bigfile
+    df = mb.read.parquet(path).groupBy("grp").agg(
+        F.sumDistinct("k").alias("sd"))
+    got = {r[0]: r[1] for r in df.collect()}
+    exp = pdf.groupby("grp").k.agg(lambda s: s.unique().sum())
+    assert got == {g: int(v) for g, v in exp.items()}
+
+
+# ---------------------------------------------------------------------------
+# collect_list / collect_set / percentile (grace hash aggregation)
+# ---------------------------------------------------------------------------
+
+def test_collect_list_stream(mb, bigfile):
+    path, pdf = bigfile
+    df = mb.read.parquet(path).groupBy("grp").agg(
+        F.collect_list("k").alias("vals"))
+    got = {r[0]: sorted(r[1]) for r in df.collect()}
+    exp = pdf.groupby("grp").k.apply(lambda s: sorted(s.tolist()))
+    assert got == {g: v for g, v in exp.items()}
+
+
+def test_collect_set_stream(mb, bigfile):
+    path, pdf = bigfile
+    df = mb.read.parquet(path).groupBy("grp").agg(
+        F.collect_set("k").alias("vals"))
+    got = {r[0]: sorted(r[1]) for r in df.collect()}
+    exp = pdf.groupby("grp").k.apply(lambda s: sorted(set(s.tolist())))
+    assert got == {g: v for g, v in exp.items()}
+
+
+def test_percentile_stream(mb, bigfile):
+    path, pdf = bigfile
+    df = mb.read.parquet(path).groupBy("grp").agg(
+        F.percentile_approx("k", 0.5).alias("med"))
+    got = {r[0]: r[1] for r in df.collect()}
+    # engine semantics: nearest-rank at floor(p * (n-1)) over sorted values
+    exp = pdf.groupby("grp").k.apply(
+        lambda s: int(np.sort(s.to_numpy())[int(0.5 * (len(s) - 1))]))
+    assert got == {g: v for g, v in exp.items()}
+
+
+def test_grace_mixed_with_plain_aggs(mb, bigfile):
+    """collect alongside sum/count in one aggregate — the whole slot set
+    runs on the grace path, exactly."""
+    path, pdf = bigfile
+    df = mb.read.parquet(path).groupBy("grp").agg(
+        F.collect_set("k").alias("vals"), F.sum("k").alias("s"),
+        F.count("id").alias("c"))
+    got = {r[0]: (sorted(r[1]), r[2], r[3]) for r in df.collect()}
+    for g, sub in pdf.groupby("grp"):
+        vals, s, c = got[g]
+        assert vals == sorted(set(sub.k.tolist()))
+        assert s == int(sub.k.sum())
+        assert c == len(sub)
+
+
+def test_global_collect(mb, bigfile):
+    path, pdf = bigfile
+    (vals,), = mb.read.parquet(path).agg(
+        F.collect_set("grp").alias("vals")).collect()
+    assert sorted(vals) == sorted(pdf.grp.unique())
+
+
+# ---------------------------------------------------------------------------
+# the same shapes through the stage runner (joins force stages.py routing)
+# ---------------------------------------------------------------------------
+
+def test_stage_runner_first_and_collect(mb, bigfile, tmp_path):
+    path, pdf = bigfile
+    dim = pd.DataFrame({
+        "grp": ["ash", "beech", "cedar", "doum", "elm"],
+        "tag": [1, 2, 3, 4, 5],
+    })
+    dpath = str(tmp_path / "dim.parquet")
+    dim.to_parquet(dpath, index=False)
+    fact = mb.read.parquet(path)
+    d = mb.read.parquet(dpath)
+    df = (fact.join(d, on="grp")
+          .groupBy("tag")
+          .agg(F.first("id").alias("f"), F.collect_set("k").alias("vals")))
+    got = {r[0]: (r[1], sorted(r[2])) for r in df.collect()}
+    merged = pdf.merge(dim, on="grp")
+    exp_f = merged.groupby("tag").id.first()
+    exp_v = merged.groupby("tag").k.apply(lambda s: sorted(set(s.tolist())))
+    assert got == {int(t): (int(exp_f[t]), exp_v[t]) for t in exp_f.index}
