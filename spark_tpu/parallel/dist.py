@@ -38,8 +38,9 @@ from ..aggregates import AggregateFunction, First
 from ..columnar import ColumnBatch, ColumnVector, pad_capacity
 from ..expressions import Col, EvalContext, Expression, Hash64
 from ..kernels import (
-    apply_limit, compact, grouped_aggregate, multi_key_argsort,
-    segment_reduce, sort_batch, sort_key_transform, take_batch,
+    _scatter_starts, apply_limit, compact, grouped_aggregate,
+    multi_key_argsort, segment_reduce, sort_batch, sort_key_transform,
+    take_batch,
 )
 from ..sql import physical as P
 from ..sql.planner import Planner, PlannedQuery
@@ -242,6 +243,55 @@ class DBroadcast(DNode):
         return "BroadcastExchange"
 
 
+def _group_by_keys(xp, key_vals, live, capacity):
+    """The grouping prologue shared VERBATIM by the partial, partial-merge
+    and final aggregation stages (so key grouping can never desynchronize
+    between them): sort rows by (liveness, per-key null flag, key value),
+    derive segment ids.  Returns (perm, seg_ids, is_start, num_groups);
+    is_start/num_groups are None for the global (no keys) case."""
+    sort_cols = [(~live).astype(np.int8)]
+    for v in key_vals:
+        data = v.data.astype(np.int8) if str(v.data.dtype) == "bool" \
+            else v.data
+        if v.valid is None:
+            sort_cols += [xp.zeros(capacity, np.int8), data]
+        else:
+            sort_cols += [xp.where(v.valid, np.int8(0), np.int8(-1)),
+                          xp.where(v.valid, data, xp.zeros((), data.dtype))]
+    perm = multi_key_argsort(xp, sort_cols, capacity)
+    sorted_cols = [c[perm] for c in sort_cols]
+    live_s = live[perm]
+    if key_vals:
+        change = xp.zeros(capacity, bool)
+        for c in sorted_cols:
+            change = change | (c != xp.concatenate([c[:1], c[:-1]]))
+        is_start = change.at[0].set(True) if xp is jnp else _np_set0(change)
+        is_start = is_start & live_s
+        seg_ids = xp.cumsum(is_start.astype(np.int64)) - 1
+        seg_ids = xp.where(live_s, seg_ids, np.int64(capacity - 1))
+        num_groups = xp.sum(is_start.astype(np.int64))
+    else:
+        seg_ids = xp.zeros(capacity, np.int64)
+        is_start = None
+        num_groups = None
+    return perm, seg_ids, is_start, num_groups
+
+
+def _emit_group_keys(xp, keys, key_dts, key_vals, perm, seg_ids, is_start,
+                     capacity):
+    """Scatter each group's key value to its segment-start slot; returns
+    (names, vectors) for the output key columns."""
+    names, vectors = [], []
+    for k, dt, v in zip(keys, key_dts, key_vals):
+        kd = _scatter_starts(xp, v.data[perm], seg_ids, is_start, capacity)
+        kv = None if v.valid is None else _scatter_starts(
+            xp, v.valid[perm], seg_ids, is_start, capacity)
+        names.append(k.name)
+        vectors.append(ColumnVector(kd.astype(dt.np_dtype), dt, kv,
+                                    v.dictionary))
+    return names, vectors
+
+
 class DPartialAggregate(DNode):
     """Per-shard partial aggregation: emits group keys + RAW buffer columns
     (mode=Partial of the reference's two-phase aggregation)."""
@@ -271,43 +321,11 @@ class DPartialAggregate(DNode):
         capacity = batch.capacity
 
         key_vals = [ectx.broadcast(k.eval(ectx)) for k in self.keys]
-        sort_cols = [(~live).astype(np.int8)]
-        for v in key_vals:
-            data = v.data.astype(np.int8) if str(v.data.dtype) == "bool" else v.data
-            if v.valid is None:
-                sort_cols += [xp.zeros(capacity, np.int8), data]
-            else:
-                sort_cols += [xp.where(v.valid, np.int8(0), np.int8(-1)),
-                              xp.where(v.valid, data, xp.zeros((), data.dtype))]
-        perm = multi_key_argsort(xp, sort_cols, capacity)
-        sorted_cols = [c[perm] for c in sort_cols]
-        live_s = live[perm]
-
-        if self.keys:
-            change = xp.zeros(capacity, bool)
-            for c in sorted_cols:
-                change = change | (c != xp.concatenate([c[:1], c[:-1]]))
-            is_start = change.at[0].set(True) if xp is jnp else _np_set0(change)
-            is_start = is_start & live_s
-            seg_ids = xp.cumsum(is_start.astype(np.int64)) - 1
-            seg_ids = xp.where(live_s, seg_ids, np.int64(capacity - 1))
-            num_groups = xp.sum(is_start.astype(np.int64))
-        else:
-            seg_ids = xp.zeros(capacity, np.int64)
-            is_start = None
-            num_groups = None
-
-        names: List[str] = []
-        vectors: List[ColumnVector] = []
-        from ..kernels import _scatter_starts
-        for k, v in zip(self.keys, key_vals):
-            dt = k.data_type(batch.schema)
-            data_s = v.data[perm]
-            valid_s = None if v.valid is None else v.valid[perm]
-            kd = _scatter_starts(xp, data_s, seg_ids, is_start, capacity)
-            kv = None if valid_s is None else _scatter_starts(xp, valid_s, seg_ids, is_start, capacity)
-            names.append(k.name)
-            vectors.append(ColumnVector(kd.astype(dt.np_dtype), dt, kv, v.dictionary))
+        perm, seg_ids, is_start, num_groups = _group_by_keys(
+            xp, key_vals, live, capacity)
+        names, vectors = _emit_group_keys(
+            xp, self.keys, [k.data_type(batch.schema) for k in self.keys],
+            key_vals, perm, seg_ids, is_start, capacity)
 
         for i, (func, n) in enumerate(self.slots):
             if isinstance(func, First):
@@ -424,43 +442,12 @@ class DFinalAggregate(DNode):
 
         key_refs = [Col(k.name) for k in self.keys]
         key_vals = [ectx.broadcast(k.eval(ectx)) for k in key_refs]
-        sort_cols = [(~live).astype(np.int8)]
-        for v in key_vals:
-            data = v.data.astype(np.int8) if str(v.data.dtype) == "bool" else v.data
-            if v.valid is None:
-                sort_cols += [xp.zeros(capacity, np.int8), data]
-            else:
-                sort_cols += [xp.where(v.valid, np.int8(0), np.int8(-1)),
-                              xp.where(v.valid, data, xp.zeros((), data.dtype))]
-        perm = multi_key_argsort(xp, sort_cols, capacity)
-        sorted_cols = [c[perm] for c in sort_cols]
-        live_s = live[perm]
-
-        if self.keys:
-            change = xp.zeros(capacity, bool)
-            for c in sorted_cols:
-                change = change | (c != xp.concatenate([c[:1], c[:-1]]))
-            is_start = change.at[0].set(True) if xp is jnp else _np_set0(change)
-            is_start = is_start & live_s
-            seg_ids = xp.cumsum(is_start.astype(np.int64)) - 1
-            seg_ids = xp.where(live_s, seg_ids, np.int64(capacity - 1))
-            num_groups = xp.sum(is_start.astype(np.int64))
-        else:
-            seg_ids = xp.zeros(capacity, np.int64)
-            is_start = None
-            num_groups = None
-
-        from ..kernels import _scatter_starts
-        names, vectors = [], []
+        perm, seg_ids, is_start, num_groups = _group_by_keys(
+            xp, key_vals, live, capacity)
         cs_child = self.partial.children[0].schema()
-        for k, kref, v in zip(self.keys, key_refs, key_vals):
-            dt = k.data_type(cs_child)
-            data_s = v.data[perm]
-            valid_s = None if v.valid is None else v.valid[perm]
-            kd = _scatter_starts(xp, data_s, seg_ids, is_start, capacity)
-            kv = None if valid_s is None else _scatter_starts(xp, valid_s, seg_ids, is_start, capacity)
-            names.append(k.name)
-            vectors.append(ColumnVector(kd.astype(dt.np_dtype), dt, kv, v.dictionary))
+        names, vectors = _emit_group_keys(
+            xp, self.keys, [k.data_type(cs_child) for k in self.keys],
+            key_vals, perm, seg_ids, is_start, capacity)
 
         for i, (func, n) in enumerate(self.slots):
             if isinstance(func, First):
@@ -564,43 +551,12 @@ class DMergePartial(DNode):
 
         key_refs = [Col(k.name) for k in self.keys]
         key_vals = [ectx.broadcast(k.eval(ectx)) for k in key_refs]
-        sort_cols = [(~live).astype(np.int8)]
-        for v in key_vals:
-            data = v.data.astype(np.int8) if str(v.data.dtype) == "bool" else v.data
-            if v.valid is None:
-                sort_cols += [xp.zeros(capacity, np.int8), data]
-            else:
-                sort_cols += [xp.where(v.valid, np.int8(0), np.int8(-1)),
-                              xp.where(v.valid, data, xp.zeros((), data.dtype))]
-        perm = multi_key_argsort(xp, sort_cols, capacity)
-        sorted_cols = [c[perm] for c in sort_cols]
-        live_s = live[perm]
-
-        if self.keys:
-            change = xp.zeros(capacity, bool)
-            for c in sorted_cols:
-                change = change | (c != xp.concatenate([c[:1], c[:-1]]))
-            is_start = change.at[0].set(True) if xp is jnp else _np_set0(change)
-            is_start = is_start & live_s
-            seg_ids = xp.cumsum(is_start.astype(np.int64)) - 1
-            seg_ids = xp.where(live_s, seg_ids, np.int64(capacity - 1))
-            num_groups = xp.sum(is_start.astype(np.int64))
-        else:
-            seg_ids = xp.zeros(capacity, np.int64)
-            is_start = None
-            num_groups = None
-
-        from ..kernels import _scatter_starts
+        perm, seg_ids, is_start, num_groups = _group_by_keys(
+            xp, key_vals, live, capacity)
         cs_child = self.partial.children[0].schema()
-        names, vectors = [], []
-        for k, v in zip(self.keys, key_vals):
-            dt = k.data_type(cs_child)
-            kd = _scatter_starts(xp, v.data[perm], seg_ids, is_start, capacity)
-            kv = None if v.valid is None else _scatter_starts(
-                xp, v.valid[perm], seg_ids, is_start, capacity)
-            names.append(k.name)
-            vectors.append(ColumnVector(kd.astype(dt.np_dtype), dt, kv,
-                                        v.dictionary))
+        names, vectors = _emit_group_keys(
+            xp, self.keys, [k.data_type(cs_child) for k in self.keys],
+            key_vals, perm, seg_ids, is_start, capacity)
 
         from ..aggregates import IDENTITY
         for i, (func, _n) in enumerate(self.slots):

@@ -155,19 +155,16 @@ def _decompose(optimized: L.LogicalPlan) -> Optional[_Decomposed]:
             topk = above[-1].n
             above = above[:-1]
         if isinstance(breaker, L.Aggregate):
-            for f, _n in breaker.aggs:
-                if getattr(f, "is_distinct", False):
-                    # the analyzer rewrites distinct aggs into two-level
-                    # aggregation; a raw one here would merge WRONG (its
-                    # partial ignores distinctness) — keep it eager
-                    return None
-                if getattr(f, "is_collect", False) \
-                        or getattr(f, "is_percentile", False):
-                    # no fixed-width mergeable partial: grace hash
-                    # aggregation (spill rows bucketed by key hash, then
-                    # aggregate each bucket eagerly — exact, since groups
-                    # never straddle buckets)
-                    grace = True
+            # ONE classification shared with the stage runner (stages.py)
+            # so the two paths can never route the same aggregate
+            # differently: None = raw distinct (eager only — its partial
+            # would silently drop distinctness), 'grace' = bucket-spill +
+            # eager per bucket, 'partial' = mergeable buffers
+            from .stages import _agg_mode
+            mode = _agg_mode(breaker)
+            if mode is None:
+                return None
+            grace = mode == "grace"
         for op in above:
             if _with_child(op, leaf) is None:
                 return None
@@ -468,10 +465,6 @@ class _AggMerger:
         single-batch path already provides."""
         if not self._first_slots:
             return pbatch
-        if pbatch.capacity > (1 << 24):
-            raise RuntimeError(
-                f"first/last rank rebase requires batch capacity <= 2^24 "
-                f"rows, got {pbatch.capacity}")
         if self._batch_ord >= (1 << 29):
             raise RuntimeError("first/last rank rebase overflow: > 2^29 "
                                "scan batches")
@@ -489,9 +482,18 @@ class _AggMerger:
             mask = live & (rank != dead)
             shard = rank >> np.int64(48)
             row = rank & np.int64((1 << 48) - 1)
-            if mask.any() and int(shard[mask].max()) >= 256:
-                raise RuntimeError("first/last rank rebase supports at most "
-                                   "256 shards per batch")
+            # bounds on the OBSERVED fields (the scan-batch capacity the
+            # row indices were drawn from is bigger than this compacted
+            # partial batch — checking pbatch.capacity would pass silently)
+            if mask.any():
+                if int(row[mask].max()) >= (1 << 24):
+                    raise RuntimeError(
+                        "first/last rank rebase requires scan batches "
+                        "<= 2^24 rows")
+                if int(shard[mask].max()) >= 256:
+                    raise RuntimeError(
+                        "first/last rank rebase supports at most 256 "
+                        "shards per batch")
             enc = (np.int64(self._batch_ord) << np.int64(32)) \
                 | (shard << np.int64(24)) | row
             vectors[j] = ColumnVector(np.where(mask, enc, dead), v.dtype,

@@ -458,6 +458,31 @@ class _BucketStore:
                   self._mem_rows, self._dir)
         self._mem_rows = 0
 
+    def __getstate__(self):
+        # checkpoint support: spill files are APPENDED in place, so a
+        # resumed store must truncate them back to their pickled sizes —
+        # otherwise rows spilled after the checkpoint are double-counted
+        # when the scan replays (SpilledRuns sidesteps this with fresh
+        # run files per spill; bucket files are per-bucket by design)
+        d = dict(self.__dict__)
+        d["_file_sizes"] = [
+            os.path.getsize(p) if p is not None else 0 for p in self._files
+        ]
+        return d
+
+    def __setstate__(self, state):
+        sizes = state.pop("_file_sizes", None)
+        self.__dict__.update(state)
+        if sizes is None:
+            return
+        for p, sz in zip(self._files, sizes):
+            if p is None:
+                continue
+            if not os.path.exists(p):       # spill files vanished: the
+                raise FileNotFoundError(p)  # checkpoint is unusable
+            with open(p, "ab") as f:
+                f.truncate(sz)
+
     def load(self, b: int) -> List[ColumnBatch]:
         out: List[ColumnBatch] = []
         path = self._files[b]

@@ -193,6 +193,42 @@ def test_global_collect(mb, bigfile):
 
 
 # ---------------------------------------------------------------------------
+# checkpoint safety of the grace store
+# ---------------------------------------------------------------------------
+
+def test_bucket_store_pickle_truncates_appended_spills(tmp_path):
+    """_BucketStore spill files are APPENDED in place; a restored pickle
+    must truncate them back to their pickled sizes or a resumed scan
+    double-counts every row spilled after the checkpoint."""
+    import pickle as pkl
+
+    from spark_tpu.columnar import ColumnBatch, ColumnVector
+    from spark_tpu.sql.stages import _BucketStore
+    import spark_tpu.types as T
+
+    def batch(vals):
+        arr = np.asarray(vals, np.int64)
+        return ColumnBatch(["x"], [ColumnVector(arr, T.int64)], None,
+                           len(arr))
+
+    store = _BucketStore(2, budget_rows=2, spill_dir=str(tmp_path))
+    store.add(batch([1, 2, 3]), np.array([0, 0, 1]))   # spills (3 > 2)
+    blob = pkl.dumps(store)
+    store.add(batch([4, 5, 6]), np.array([0, 1, 1]))   # appends post-ckpt
+    store._spill()
+    assert sum(len(np.asarray(b.vectors[0].data))
+               for b in store.load(0)) == 3             # 1,2,4
+
+    resumed = pkl.loads(blob)
+    rows0 = [int(v) for b in resumed.load(0)
+             for v in np.asarray(b.vectors[0].data)]
+    rows1 = [int(v) for b in resumed.load(1)
+             for v in np.asarray(b.vectors[0].data)]
+    assert sorted(rows0 + rows1) == [1, 2, 3]           # post-ckpt rows gone
+    store.close()
+
+
+# ---------------------------------------------------------------------------
 # the same shapes through the stage runner (joins force stages.py routing)
 # ---------------------------------------------------------------------------
 
