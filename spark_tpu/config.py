@@ -187,8 +187,23 @@ MESH_SHARDS = conf("spark.tpu.mesh.shards").doc(
 ).int(0)
 
 ADAPTIVE_ENABLED = conf("spark.sql.adaptive.enabled").doc(
-    "Coalesce small post-exchange partitions (ExchangeCoordinator analog)."
+    "Adaptive exchanges (ExchangeCoordinator analog, in-program): hash "
+    "exchanges route through a measured balanced fine-bucket→shard "
+    "assignment (coalescing + balancing), and shuffled joins split hot "
+    "keys (probe rows spread, build rows replicate)."
 ).boolean(True)
+
+EXCHANGE_FINE_BUCKETS = conf("spark.tpu.exchange.fineBucketsPerShard").doc(
+    "Fine buckets PER SHARD for adaptive hash exchanges; their psum'd "
+    "counts drive the balanced bucket→shard assignment.  More buckets = "
+    "flatter balance, slightly more assignment work."
+).int(32)
+
+EXCHANGE_SPREAD_FRAC = conf("spark.tpu.exchange.spreadThreshold").doc(
+    "A fine bucket whose probe-side row count exceeds this fraction of "
+    "the per-shard even share is HOT in a shuffled join: its probe rows "
+    "spread round-robin and its build rows replicate to every shard."
+).float(0.5)
 
 CODEGEN_ENABLED = conf("spark.sql.codegen.wholeStage").doc(
     "Fuse operator pipelines into a single jitted XLA program (WholeStage"

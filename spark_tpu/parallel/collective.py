@@ -88,6 +88,72 @@ def hash_exchange(batch: ColumnBatch, bucket: Array, n_shards: int,
     return out, overflow
 
 
+def fine_bucket_histogram(h: Array, live: Array, n_fine: int,
+                          axis: str = DATA_AXIS) -> Tuple[Array, Array]:
+    """(fine bucket id per row, GLOBAL live-row count per fine bucket).
+
+    The measurement half of the adaptive exchange (the role of the
+    reference's ``MapOutputStatistics`` feeding ``ExchangeCoordinator``):
+    per-shard counts scatter-add locally, one ``psum`` makes them global —
+    no host round-trip, the whole measurement stays inside the program."""
+    xp = jnp
+    fine = (h.astype(np.uint64) % np.uint64(n_fine)).astype(np.int32)
+    local = xp.zeros(n_fine, np.int64).at[fine].add(
+        live.astype(np.int64), mode="drop")
+    return fine, lax.psum(local, axis)
+
+
+def balanced_assignment(counts: Array, n_shards: int) -> Tuple[Array, Array]:
+    """Greedy LPT packing of fine buckets onto shards: heaviest bucket
+    first, always onto the least-loaded shard.  Pure function of the
+    (psum'd, therefore shard-identical) counts, so every shard computes
+    the SAME assignment with no extra collective.  Returns
+    (assignment (B,) int32, predicted per-shard loads (n_shards,)).
+
+    This subsumes both halves of ``ExchangeCoordinator.scala:85,118``:
+    undersized buckets coalesce onto the same shard, oversized ones get a
+    shard (nearly) to themselves."""
+    order = jnp.argsort(-counts)                    # heavy first
+
+    def body(i, carry):
+        loads, assign = carry
+        j = order[i]
+        s = jnp.argmin(loads).astype(np.int32)
+        return loads.at[s].add(counts[j]), assign.at[j].set(s)
+
+    loads0 = jnp.zeros(n_shards, counts.dtype)
+    assign0 = jnp.zeros(counts.shape[0], np.int32)
+    loads, assign = lax.fori_loop(0, counts.shape[0], body, (loads0, assign0))
+    return assign, loads
+
+
+def replicate_selected(batch: ColumnBatch, mask: Array, hot_cap: int,
+                       axis: str = DATA_AXIS) -> Tuple[ColumnBatch, Array]:
+    """Every shard receives ALL rows where ``mask`` (from every shard):
+    selected rows pack into a ``hot_cap`` send buffer, one ``all_gather``
+    replicates them.  Returns (batch of capacity n_shards*hot_cap,
+    overflow count of selected rows beyond hot_cap)."""
+    xp = jnp
+    C = batch.capacity
+    hot_cap = min(hot_cap, C)       # a slice can't exceed the source batch
+    live = batch.row_valid_or_true()
+    sel = mask & live
+    perm = multi_key_argsort(xp, [xp.where(sel, np.int8(0), np.int8(1))], C)
+    sb = take_batch(xp, batch, perm)
+    sel_s = sel[perm]
+    n_sel = xp.sum(sel.astype(np.int64))
+    overflow = xp.maximum(n_sel - np.int64(hot_cap), np.int64(0))
+
+    def cut(a):
+        return a[:hot_cap]
+
+    vectors = [ColumnVector(cut(v.data), v.dtype,
+                            None if v.valid is None else cut(v.valid),
+                            v.dictionary) for v in sb.vectors]
+    packed = ColumnBatch(batch.names, vectors, cut(sel_s), hot_cap)
+    return broadcast_all(packed, axis), overflow
+
+
 def round_robin_exchange(batch: ColumnBatch, n_shards: int,
                          axis: str = DATA_AXIS) -> ColumnBatch:
     """Spread rows evenly round-robin (RoundRobinPartitioning analog).

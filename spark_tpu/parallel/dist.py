@@ -43,6 +43,7 @@ from ..kernels import (
     take_batch,
 )
 from ..sql import physical as P
+from ..sql.joins import PJoin
 from ..sql.planner import Planner, PlannedQuery
 from ..sql.logical import (
     Aggregate, Distinct, Filter, Join, Limit, LocalRelation, LogicalPlan,
@@ -130,28 +131,75 @@ class DRange(P.PRange):
         return f"DRange({self.start},{self.end},{self.step} x{self.n_shards})"
 
 
+def exchange_cap(child_cap: int, n_shards: int, skew_factor: float) -> int:
+    """Per-destination send-bucket capacity of an all_to_all exchange:
+    the even split times the skew headroom factor — ONE definition for
+    every exchange so capacity sizing can never diverge between them."""
+    even = -(-child_cap // n_shards)
+    return pad_capacity(max(int(even * skew_factor), 1))
+
+
+def _routing_key_pairs(key_pairs, probe_schema, build_schema):
+    """Normalize join-key pairs for ROUTING hashes: a mixed int/float pair
+    hashes BOTH sides as float64 — ``Hash64(int64 7) != Hash64(float64
+    7.0)``, so without this every cross-typed match routes to two
+    different shards and silently vanishes.  The same rule PJoin._run_on
+    applies to its own search keys (``joins.py`` mixed-pair Cast)."""
+    from ..expressions import Cast
+    lks, rks = [], []
+    for l, r in key_pairs:
+        try:
+            ldt = l.data_type(probe_schema)
+            rdt = r.data_type(build_schema)
+            if ldt.is_numeric and rdt.is_numeric \
+                    and ldt.is_fractional != rdt.is_fractional:
+                l, r = Cast(l, T.float64), Cast(r, T.float64)
+        except Exception:
+            pass
+        lks.append(l)
+        rks.append(r)
+    return lks, rks
+
+
 class DExchangeHash(DNode):
-    """all_to_all repartition on key hash (ShuffleExchange)."""
+    """all_to_all repartition on key hash (ShuffleExchange).
+
+    With ``fine_buckets > 0`` (adaptive, the default): rows hash into
+    fine_buckets >> n_shards fine buckets, their psum'd global counts feed
+    a greedy balanced bucket→shard assignment computed ON DEVICE inside
+    the same program — measured-size coalescing/balancing with no host
+    round-trip and no stage break (``ExchangeCoordinator.scala:85,118``
+    re-designed for one fused SPMD program).  Same-key rows still land on
+    one shard (assignment is per fine bucket)."""
 
     def __init__(self, keys: Sequence[Expression], n_shards: int,
-                 skew_factor: float, child: P.PhysicalPlan):
+                 skew_factor: float, child: P.PhysicalPlan,
+                 fine_buckets: int = 0):
         self.keys = list(keys)
         self.n_shards = n_shards
         self.skew_factor = skew_factor
+        self.fine_buckets = fine_buckets
         self.children = (child,)
 
     def schema(self):
         return self.children[0].schema()
 
     def cap_out(self, child_cap: int) -> int:
-        even = -(-child_cap // self.n_shards)
-        return pad_capacity(max(int(even * self.skew_factor), 1))
+        return exchange_cap(child_cap, self.n_shards, self.skew_factor)
 
     def run(self, ctx):
         batch = self.children[0].run(ctx)
         ectx = EvalContext(batch, ctx.xp)
         h = ectx.broadcast(Hash64(*self.keys).eval(ectx)).data
-        bucket = (h.astype(np.uint64) % np.uint64(self.n_shards)).astype(np.int32)
+        if self.fine_buckets > 0:
+            from .collective import balanced_assignment, fine_bucket_histogram
+            live = batch.row_valid_or_true()
+            fine, counts = fine_bucket_histogram(h, live, self.fine_buckets)
+            assign, _loads = balanced_assignment(counts, self.n_shards)
+            bucket = assign[fine]
+        else:
+            bucket = (h.astype(np.uint64)
+                      % np.uint64(self.n_shards)).astype(np.int32)
         cap_out = self.cap_out(batch.capacity)
         out, overflow = hash_exchange(batch, bucket, self.n_shards, cap_out)
         ctx.add_flag(overflow, "exchange", cap_out)  # per-shard; executor reduces
@@ -162,7 +210,9 @@ class DExchangeHash(DNode):
         return HashPartitioning(kn) if kn is not None else UNKNOWN
 
     def __repr__(self):
-        return f"ExchangeHash [{', '.join(map(repr, self.keys))}] x{self.n_shards} f={self.skew_factor}"
+        return (f"ExchangeHash [{', '.join(map(repr, self.keys))}] "
+                f"x{self.n_shards} f={self.skew_factor} "
+                f"fine={self.fine_buckets}")
 
 
 class DExchangeRange(DNode):
@@ -207,8 +257,8 @@ class DExchangeRange(DNode):
         from .collective import lex_bucket, sampled_splitters_multi
         splitters = sampled_splitters_multi(keys64, live, self.n_shards)
         bucket = lex_bucket(keys64, splitters)
-        even = -(-batch.capacity // self.n_shards)
-        cap_out = pad_capacity(max(int(even * self.skew_factor), 1))
+        cap_out = exchange_cap(batch.capacity, self.n_shards,
+                               self.skew_factor)
         out, overflow = hash_exchange(batch, bucket, self.n_shards, cap_out)
         ctx.add_flag(overflow, "exchange", cap_out)  # per-shard; executor reduces
         return out
@@ -241,6 +291,130 @@ class DBroadcast(DNode):
 
     def __repr__(self):
         return "BroadcastExchange"
+
+
+class DSkewJoin(PJoin):
+    """Shuffled hash join with measured routing + hot-key splitting.
+
+    Both sides co-partition through ONE balanced bucket→shard assignment
+    (computed from the psum'd fine-bucket histograms of both sides, on
+    device).  Fine buckets whose probe-side count exceeds
+    ``spread_frac x even-share`` are HOT: their probe rows spread
+    round-robin over all shards while their build rows replicate to every
+    shard, so the join stays exact with per-shard load bounded near the
+    even share — the auto skew-join SURVEY §2.12 asks for, which the
+    reference's 2.3-era ``ExchangeCoordinator.scala`` lacks (it only
+    coalesces).  Spreading is enabled only for join types whose build side
+    never emits unmatched rows (inner/left/semi/anti): replicated build
+    rows would otherwise produce duplicate unmatched output.
+
+    Deliberately a PJoin so the local join kernel (exact-encoded
+    sorted-build + searchsorted) is inherited, not duplicated."""
+
+    def __init__(self, left, right, how, key_pairs, residual, schema,
+                 factor, n_shards, skew_factor, fine_buckets,
+                 spread_frac, allow_spread):
+        PJoin.__init__(self, left, right, how, key_pairs, residual,
+                       schema, factor)
+        self.n_shards = n_shards
+        self.skew_factor = skew_factor
+        self.fine_buckets = fine_buckets
+        self.spread_frac = spread_frac
+        self.allow_spread = allow_spread
+
+    def partitioning(self):
+        return UNKNOWN
+
+    def run(self, ctx):
+        from .collective import (
+            balanced_assignment, fine_bucket_histogram, replicate_selected,
+        )
+        xp = ctx.xp
+        probe = self.children[0].run(ctx)
+        build = self.children[1].run(ctx)
+        n = self.n_shards
+        B = self.fine_buckets
+        lkeys, rkeys = _routing_key_pairs(self.key_pairs, probe.schema,
+                                          build.schema)
+
+        pctx = EvalContext(probe, xp)
+        bctx = EvalContext(build, xp)
+        ph = pctx.broadcast(Hash64(*lkeys).eval(pctx)).data
+        bh = bctx.broadcast(Hash64(*rkeys).eval(bctx)).data
+        plive = probe.row_valid_or_true()
+        blive = build.row_valid_or_true()
+
+        pfine, pcounts = fine_bucket_histogram(ph, plive, B)
+        bfine, bcounts = fine_bucket_histogram(bh, blive, B)
+
+        cap_p = exchange_cap(probe.capacity, n, self.skew_factor)
+        cap_b = exchange_cap(build.capacity, n, self.skew_factor)
+
+        if not self.allow_spread:
+            # balanced assignment only (e.g. full outer, where replicated
+            # build rows would duplicate unmatched-build output); no
+            # replication machinery traced at all
+            assign, _loads = balanced_assignment(pcounts + bcounts, n)
+            p_ex, p_ov = hash_exchange(probe, assign[pfine], n, cap_p)
+            b_ex, b_ov = hash_exchange(build, assign[bfine], n, cap_b)
+            ctx.add_flag(p_ov + b_ov, "exchange", max(cap_p, cap_b))
+            return self._run_on(ctx, p_ex, b_ex)
+
+        # hot = a fine bucket that alone exceeds spread_frac of the
+        # per-shard even share of GLOBAL probe rows
+        total = jnp.sum(pcounts)
+        threshold = (total.astype(jnp.float32)
+                     * np.float32(self.spread_frac / n))
+        hot = pcounts.astype(jnp.float32) > threshold
+
+        # balanced assignment over the NON-hot load of both sides (hot
+        # probe rows spread; their build rows replicate — neither follows
+        # the assignment)
+        routed_counts = jnp.where(hot, 0, pcounts + bcounts)
+        assign, _loads = balanced_assignment(routed_counts, n)
+
+        shard = lax.axis_index(DATA_AXIS).astype(np.int32)
+        p_hot = hot[pfine] & plive
+        rr = (jnp.arange(probe.capacity, dtype=np.int32) + shard) % n
+        pbucket = jnp.where(p_hot, rr, assign[pfine])
+        p_ex, p_ov = hash_exchange(probe, pbucket, n, cap_p)
+
+        b_hot = hot[bfine] & blive
+        # hot build rows leave the routed path (bucket n == dropped) and
+        # travel the replication path instead
+        bbucket = jnp.where(b_hot, np.int32(n), assign[bfine])
+        b_ex, b_ov = hash_exchange(build, bbucket, n, cap_b)
+        hot_b, hot_ov = replicate_selected(build, b_hot, cap_b)
+
+        build_all = _concat_batches(b_ex, hot_b)
+        ctx.add_flag(p_ov + b_ov + hot_ov, "exchange", max(cap_p, cap_b))
+        return self._run_on(ctx, p_ex, build_all)
+
+    def __repr__(self):
+        return (f"SkewJoin {self.how} "
+                f"[{', '.join(f'{l!r}={r!r}' for l, r in self.key_pairs)}] "
+                f"x{self.n_shards} f={self.skew_factor} "
+                f"fine={self.fine_buckets} "
+                f"spread={self.spread_frac if self.allow_spread else 'off'}")
+
+
+def _concat_batches(a: ColumnBatch, b: ColumnBatch) -> ColumnBatch:
+    """Row-concatenate two same-schema batches inside the traced program."""
+    vectors = []
+    for va, vb in zip(a.vectors, b.vectors):
+        data = jnp.concatenate([va.data, vb.data])
+        if va.valid is None and vb.valid is None:
+            valid = None
+        else:
+            la = va.valid if va.valid is not None \
+                else jnp.ones(a.capacity, bool)
+            lb = vb.valid if vb.valid is not None \
+                else jnp.ones(b.capacity, bool)
+            valid = jnp.concatenate([la, lb])
+        vectors.append(ColumnVector(data, va.dtype, valid,
+                                    va.dictionary or vb.dictionary))
+    rv = jnp.concatenate([a.row_valid_or_true(), b.row_valid_or_true()])
+    return ColumnBatch(a.names, vectors, rv, a.capacity + b.capacity)
 
 
 def _group_by_keys(xp, key_vals, live, capacity):

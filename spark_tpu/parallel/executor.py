@@ -56,6 +56,13 @@ class DistributedPlanner(Planner):
             return self.skew_override
         return self.session.conf.get(C.EXCHANGE_SKEW_FACTOR)
 
+    @property
+    def fine(self) -> int:
+        """Fine buckets for adaptive exchanges (0 = static hash%n)."""
+        if not self.session.conf.get(C.ADAPTIVE_ENABLED):
+            return 0
+        return self.n_shards * self.session.conf.get(C.EXCHANGE_FINE_BUCKETS)
+
     def _to_physical(self, node: LogicalPlan, leaves) -> P.PhysicalPlan:
         n = self.n_shards
         if isinstance(node, RangeRelation):
@@ -79,13 +86,15 @@ class DistributedPlanner(Planner):
                 return D.DGlobalAggregate(node.aggs, child)
             partial_agg = D.DPartialAggregate(node.keys, node.aggs, child)
             key_refs = [Col(k.name) for k in node.keys]
-            exchanged = D.DExchangeHash(key_refs, n, self.skew, partial_agg)
+            exchanged = D.DExchangeHash(key_refs, n, self.skew, partial_agg,
+                                        fine_buckets=self.fine)
             return D.DFinalAggregate(node.keys, node.aggs, partial_agg, exchanged)
         if isinstance(node, Distinct):
             child = self._to_physical(node.child, leaves)
             keys = [Col(nm) for nm in node.child.schema().names]
             partial_agg = D.DPartialAggregate(keys, [], child)
-            exchanged = D.DExchangeHash(keys, n, self.skew, partial_agg)
+            exchanged = D.DExchangeHash(keys, n, self.skew, partial_agg,
+                                        fine_buckets=self.fine)
             return D.DFinalAggregate(keys, [], partial_agg, exchanged)
         if isinstance(node, Sort):
             child = self._to_physical(node.child, leaves)
@@ -114,7 +123,7 @@ class DistributedPlanner(Planner):
         pb = node.wexprs[0][0].spec.partition_by
         if pb:
             exchanged = D.DExchangeHash(list(pb), self.n_shards, self.skew,
-                                        child)
+                                        child, fine_buckets=self.fine)
         else:
             exchanged = D.DGatherOne(child)
         return P.PWindow(node.wexprs, exchanged)
@@ -136,10 +145,25 @@ class DistributedPlanner(Planner):
                 inner = PJoin(raw.children[0], D.DBroadcast(raw.children[1]),
                               raw.how, raw.key_pairs, raw.residual,
                               raw._schema, raw.factor)
+            elif self.fine > 0:
+                # adaptive shuffled hash join: one balanced assignment for
+                # both sides; hot probe buckets spread + build replicate
+                # (only where build-side unmatched rows are never emitted)
+                allow_spread = raw.how in ("inner", "left", "left_semi",
+                                           "left_anti")
+                inner = D.DSkewJoin(
+                    raw.children[0], raw.children[1], raw.how,
+                    raw.key_pairs, raw.residual, raw._schema, raw.factor,
+                    n, self.skew, self.fine,
+                    self.session.conf.get(C.EXCHANGE_SPREAD_FRAC),
+                    allow_spread)
             else:
                 # shuffled hash join: co-partition both sides on key hash
-                lkeys = [l for l, _ in raw.key_pairs]
-                rkeys = [r for _, r in raw.key_pairs]
+                # (pairs normalized so a mixed int/float pair routes both
+                # sides identically)
+                lkeys, rkeys = D._routing_key_pairs(
+                    raw.key_pairs, raw.children[0].schema(),
+                    raw.children[1].schema())
                 ex_l = D.DExchangeHash(lkeys, n, self.skew, raw.children[0])
                 ex_r = D.DExchangeHash(rkeys, n, self.skew, raw.children[1])
                 inner = PJoin(ex_l, ex_r, raw.how, raw.key_pairs, raw.residual,

@@ -1,0 +1,213 @@
+"""Adaptive exchanges: measured balancing + hot-key splitting on the mesh.
+
+VERDICT r3 item 3 — replace the static ``skew_factor`` + whole-step retry
+with (a) a balanced fine-bucket→shard assignment from psum'd measured
+counts (``ExchangeCoordinator.scala:85,118`` re-designed to run INSIDE the
+one fused SPMD program) and (b) hot-key splitting for shuffled joins
+(probe rows spread round-robin, build rows replicate — the skew handling
+SURVEY §2.12 notes Spark 2.3 lacks).
+
+Acceptance here: Zipf-skewed aggregation and a 50%-hot-key join run on
+the 8-shard mesh with a MODEST capacity factor and ZERO adaptive
+whole-step retries (asserted via the executor's overflow warning log),
+matching the pandas oracle exactly.
+"""
+
+import logging
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import spark_tpu.config as C
+import spark_tpu.sql.functions as F
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+
+@pytest.fixture()
+def dspark(spark):
+    spark.conf.set("spark.tpu.mesh.shards", "8")
+    # modest factor: the static hash%n routing overflows under the skew
+    # below at this factor; the adaptive path must not
+    old = spark.conf.get(C.EXCHANGE_SKEW_FACTOR)
+    spark.conf.set(C.EXCHANGE_SKEW_FACTOR.key, "2.0")
+    yield spark
+    spark.conf.set(C.EXCHANGE_SKEW_FACTOR.key, str(old))
+    spark.conf.set("spark.tpu.mesh.shards", "1")
+
+
+def _no_retry(caplog):
+    assert not [r for r in caplog.records
+                if "capacity overflow" in r.getMessage()], \
+        "adaptive exchange still fell back to whole-step retry"
+
+
+def test_balanced_assignment_flattens_loads():
+    from spark_tpu.parallel.collective import balanced_assignment
+    rng = np.random.default_rng(5)
+    # zipf-ish bucket histogram: a few heavy buckets, a long tail
+    counts = jnp.asarray(
+        np.sort(rng.zipf(1.5, 256).astype(np.int64) * 100)[::-1].copy())
+    assign, loads = jax.jit(
+        balanced_assignment, static_argnums=1)(counts, 8)
+    loads = np.asarray(loads)
+    assert int(loads.sum()) == int(np.asarray(counts).sum())
+    # greedy LPT: max load within max(mean, heaviest bucket) + slack
+    mean = loads.sum() / 8
+    heaviest = int(np.asarray(counts).max())
+    assert loads.max() <= max(mean * 1.35, heaviest * 1.05)
+
+
+def test_zipf_group_agg_no_retry(dspark, caplog):
+    rng = np.random.default_rng(23)
+    n = 40_000
+    # heavy Zipf over many keys: hash%8 hotspots a shard, balanced
+    # assignment must flatten it
+    keys = rng.zipf(1.3, n).astype(np.int64) % 997
+    vals = rng.integers(0, 100, n).astype(np.int64)
+    df = dspark.createDataFrame({"k": keys, "v": vals})
+    with caplog.at_level(logging.WARNING, logger="spark_tpu.execution"):
+        out = {r.k: (r.s, r.c) for r in
+               df.groupBy("k").agg(F.sum("v").alias("s"),
+                                   F.count("*").alias("c")).collect()}
+    _no_retry(caplog)
+    exp = pd.DataFrame({"k": keys, "v": vals}).groupby("k").agg(
+        s=("v", "sum"), c=("v", "count"))
+    assert out == {int(k): (int(r.s), int(r.c)) for k, r in exp.iterrows()}
+
+
+def test_hot_key_join_no_retry(dspark, caplog):
+    """50% of probe rows share ONE key: with hash%n routing that key's
+    shard needs >= n/2 x even capacity (overflow at factor 2); the skew
+    join spreads the hot bucket's probe rows and replicates its build
+    rows, so per-shard load stays bounded near the even share."""
+    rng = np.random.default_rng(29)
+    n = 32_768
+    n_keys = 512
+    keys = rng.integers(0, n_keys, n).astype(np.int64)
+    keys[: n // 2] = 7                      # the hot key
+    vals = rng.integers(0, 1000, n).astype(np.int64)
+    # build side ABOVE the broadcast threshold is unnecessary — force the
+    # shuffled path by lowering the threshold instead of inflating data
+    old_thr = dspark.conf.get(C.AUTO_BROADCAST_JOIN_THRESHOLD)
+    dspark.conf.set(C.AUTO_BROADCAST_JOIN_THRESHOLD.key, "16")
+    try:
+        fact = dspark.createDataFrame({"k": keys, "v": vals})
+        dim = dspark.createDataFrame({
+            "dk": np.arange(n_keys, dtype=np.int64),
+            "tag": (np.arange(n_keys, dtype=np.int64) * 3) % 11,
+        })
+        with caplog.at_level(logging.WARNING, logger="spark_tpu.execution"):
+            out = (fact.join(dim, fact["k"] == dim["dk"])
+                   .groupBy("tag").agg(F.sum("v").alias("s"),
+                                       F.count("*").alias("c"))
+                   .collect())
+        _no_retry(caplog)
+    finally:
+        dspark.conf.set(C.AUTO_BROADCAST_JOIN_THRESHOLD.key, str(old_thr))
+    got = {r.tag: (r.s, r.c) for r in out}
+    pdf = pd.DataFrame({"k": keys, "v": vals}).merge(
+        pd.DataFrame({"dk": np.arange(n_keys),
+                      "tag": (np.arange(n_keys) * 3) % 11}),
+        left_on="k", right_on="dk")
+    exp = pdf.groupby("tag").agg(s=("v", "sum"), c=("v", "count"))
+    assert got == {int(t): (int(r.s), int(r.c)) for t, r in exp.iterrows()}
+
+
+def test_hot_key_left_join_matches_oracle(dspark):
+    """Left outer with a hot key AND unmatched probe rows: spread probe
+    rows must still emit their unmatched-left rows exactly once."""
+    rng = np.random.default_rng(31)
+    n = 8192
+    keys = rng.integers(0, 64, n).astype(np.int64)
+    keys[: n // 2] = 3
+    keys[n - 256:] = 1000                   # unmatched in dim
+    old_thr = dspark.conf.get(C.AUTO_BROADCAST_JOIN_THRESHOLD)
+    dspark.conf.set(C.AUTO_BROADCAST_JOIN_THRESHOLD.key, "16")
+    try:
+        fact = dspark.createDataFrame({"k": keys,
+                                       "v": np.arange(n, dtype=np.int64)})
+        dim = dspark.createDataFrame({
+            "dk": np.arange(64, dtype=np.int64),
+            "w": np.arange(64, dtype=np.int64) * 10,
+        })
+        out = (fact.join(dim, fact["k"] == dim["dk"], "left")
+               .agg(F.count("*").alias("c"), F.sum("w").alias("sw"))
+               .collect())
+    finally:
+        dspark.conf.set(C.AUTO_BROADCAST_JOIN_THRESHOLD.key, str(old_thr))
+    pdf = pd.DataFrame({"k": keys, "v": np.arange(n)}).merge(
+        pd.DataFrame({"dk": np.arange(64), "w": np.arange(64) * 10}),
+        left_on="k", right_on="dk", how="left")
+    assert out[0].c == len(pdf)
+    assert out[0].sw == int(pdf.w.sum())
+
+
+def test_full_outer_join_skew_safe(dspark):
+    """Full outer takes the balanced-assignment path with spreading OFF
+    (replicated build rows would duplicate unmatched-build output)."""
+    rng = np.random.default_rng(37)
+    n = 4096
+    keys = rng.integers(0, 96, n).astype(np.int64)
+    keys[: n // 2] = 11
+    old_thr = dspark.conf.get(C.AUTO_BROADCAST_JOIN_THRESHOLD)
+    dspark.conf.set(C.AUTO_BROADCAST_JOIN_THRESHOLD.key, "16")
+    try:
+        left = dspark.createDataFrame({"k": keys,
+                                       "v": np.arange(n, dtype=np.int64)})
+        right = dspark.createDataFrame({
+            "rk": np.arange(64, 160, dtype=np.int64),
+            "w": np.arange(96, dtype=np.int64),
+        })
+        out = (left.join(right, left["k"] == right["rk"], "outer")
+               .agg(F.count("*").alias("c")).collect())
+    finally:
+        dspark.conf.set(C.AUTO_BROADCAST_JOIN_THRESHOLD.key, str(old_thr))
+    pdf = pd.DataFrame({"k": keys, "v": np.arange(n)}).merge(
+        pd.DataFrame({"rk": np.arange(64, 160), "w": np.arange(96)}),
+        left_on="k", right_on="rk", how="outer")
+    assert out[0].c == len(pdf)
+
+
+def test_mixed_type_join_keys_route_together(dspark):
+    """int64 fact key vs float64 dim key: Hash64 of 7 and 7.0 differ, so
+    routing must hash BOTH sides as float64 (the PJoin search-key rule)
+    or every cross-typed match silently vanishes."""
+    old_thr = dspark.conf.get(C.AUTO_BROADCAST_JOIN_THRESHOLD)
+    dspark.conf.set(C.AUTO_BROADCAST_JOIN_THRESHOLD.key, "16")
+    try:
+        n = 4096
+        rng = np.random.default_rng(43)
+        keys = rng.integers(0, 64, n).astype(np.int64)
+        fact = dspark.createDataFrame({"k": keys})
+        dim = dspark.createDataFrame({
+            "dk": np.arange(64, dtype=np.float64),
+            "w": np.arange(64, dtype=np.int64),
+        })
+        out = (fact.join(dim, fact["k"] == dim["dk"])
+               .agg(F.count("*").alias("c")).collect())
+        assert out[0].c == n
+    finally:
+        dspark.conf.set(C.AUTO_BROADCAST_JOIN_THRESHOLD.key, str(old_thr))
+
+
+def test_adaptive_off_falls_back_to_static(dspark):
+    """The escape hatch: adaptive disabled reproduces the old behavior
+    (static hash%n + capacity-growth retry) and still gets the answer."""
+    old = dspark.conf.get(C.ADAPTIVE_ENABLED)
+    dspark.conf.set(C.ADAPTIVE_ENABLED.key, "false")
+    try:
+        rng = np.random.default_rng(41)
+        keys = rng.zipf(1.3, 20_000).astype(np.int64) % 997
+        df = dspark.createDataFrame({"k": keys})
+        out = {r.k: r.c for r in
+               df.groupBy("k").agg(F.count("*").alias("c")).collect()}
+        exp = pd.Series(keys).value_counts()
+        assert out == {int(k): int(v) for k, v in exp.items()}
+    finally:
+        dspark.conf.set(C.ADAPTIVE_ENABLED.key, str(old))
