@@ -2138,6 +2138,8 @@ class ArraySize(Expression):
 
     def data_type(self, schema):
         ct = self.children[0].data_type(schema)
+        if isinstance(ct, T.MapType):
+            return T.int32        # size(map): rewritten to its keys plane
         if not isinstance(ct, T.ArrayType):
             raise AnalysisException(f"size expects an array, got {ct}")
         return T.int32
@@ -2172,6 +2174,8 @@ class ElementAt(Expression):
 
     def data_type(self, schema):
         ct = self.children[0].data_type(schema)
+        if isinstance(ct, T.MapType):
+            return ct.value_type  # element_at(map, k): rewritten to MapGet
         if not isinstance(ct, T.ArrayType):
             raise AnalysisException(f"element_at expects an array, got {ct}")
         return ct.element_type
@@ -2915,3 +2919,310 @@ class GroupingCall(Expression):
 
     def __repr__(self):
         return self.name
+
+
+# ---------------------------------------------------------------------------
+# complex types: struct + map (the object layer)
+# ---------------------------------------------------------------------------
+#
+# Maps and structs are OBJECT-LAYER values, exactly as in the reference
+# (`complexTypeCreator.scala:164` CreateMap/CreateNamedStruct never got a
+# Tungsten-vectorized layout): every consumer is rewritten by the optimizer
+# into flat array/scalar expressions (`SimplifyExtractValueOps`-style,
+# `complexTypeExtractors.scala`), so nothing below ever materializes a
+# nested value on device.  Only a COLLECTED map/struct column materializes,
+# as its flat planes (docs/DECISIONS.md pair-of-planes design), zipped into
+# Python dicts/Rows host-side by the DataFrame layer.
+
+_COMPLEX_EVAL_HINT = (
+    " survived to execution: complex values are consumed via "
+    "getField/map_keys/map_values/element_at/size (rewritten to flat "
+    "columns by the optimizer) or collected at the top level.  A map/"
+    "struct flowing through an operator that is neither is unsupported — "
+    "as are maps/structs read from files (docs/DECISIONS.md)."
+)
+
+
+class CreateStruct(Expression):
+    """struct(...) / named_struct(...) — `complexTypeCreator.scala:164`."""
+
+    def __init__(self, field_names, *children: Expression):
+        if not children or len(field_names) != len(children):
+            raise AnalysisException("struct() needs one name per field")
+        self.field_names = tuple(field_names)
+        self.children = tuple(children)
+
+    def map_children(self, fn):
+        return CreateStruct(self.field_names,
+                            *[fn(c) for c in self.children])
+
+    @property
+    def name(self):
+        return f"struct({', '.join(c.name for c in self.children)})"
+
+    def data_type(self, schema):
+        return T.StructType([T.StructField(n, c.data_type(schema))
+                             for n, c in zip(self.field_names,
+                                             self.children)])
+
+    def eval(self, ctx):
+        raise AnalysisException(f"{self!r}" + _COMPLEX_EVAL_HINT)
+
+    def __repr__(self):
+        parts = [f"{n}={c!r}" for n, c in zip(self.field_names,
+                                              self.children)]
+        return f"named_struct({', '.join(parts)})"
+
+
+class GetField(Expression):
+    """struct.field — `complexTypeExtractors.scala` GetStructField."""
+
+    def __init__(self, child: Expression, field: str):
+        self.children = (child,)
+        self.field = field
+
+    def map_children(self, fn):
+        return GetField(fn(self.children[0]), self.field)
+
+    @property
+    def name(self):
+        return self.field
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not isinstance(ct, T.StructType):
+            raise AnalysisException(
+                f"getField expects a struct, got {ct}")
+        for f in ct.fields:
+            if f.name == self.field:
+                return f.dataType
+        raise AnalysisException(
+            f"no field {self.field!r} in {ct.names}")
+
+    def eval(self, ctx):
+        raise AnalysisException(f"{self!r}" + _COMPLEX_EVAL_HINT)
+
+    def __repr__(self):
+        return f"{self.children[0]!r}.{self.field}"
+
+
+class CreateMap(Expression):
+    """map(k1, v1, k2, v2, ...) — `complexTypeCreator.scala` CreateMap."""
+
+    def __init__(self, *children: Expression):
+        if not children or len(children) % 2:
+            raise AnalysisException(
+                "map() needs an even, positive number of arguments "
+                "(alternating keys and values)")
+        self.children = tuple(children)
+
+    def map_children(self, fn):
+        return CreateMap(*[fn(c) for c in self.children])
+
+    @property
+    def keys(self):
+        return self.children[0::2]
+
+    @property
+    def values(self):
+        return self.children[1::2]
+
+    @property
+    def name(self):
+        return f"map({', '.join(c.name for c in self.children)})"
+
+    def _common(self, exprs, schema, what):
+        dt = exprs[0].data_type(schema)
+        for e in exprs[1:]:
+            nxt = T.common_type(dt, e.data_type(schema))
+            if nxt is None:
+                raise AnalysisException(
+                    f"map {what} types are incompatible: {dt} vs "
+                    f"{e.data_type(schema)}")
+            dt = nxt
+        return dt
+
+    def data_type(self, schema):
+        return T.MapType(self._common(self.keys, schema, "key"),
+                         self._common(self.values, schema, "value"))
+
+    def eval(self, ctx):
+        raise AnalysisException(f"{self!r}" + _COMPLEX_EVAL_HINT)
+
+    def __repr__(self):
+        return f"map({', '.join(repr(c) for c in self.children)})"
+
+
+class MapFromArrays(Expression):
+    """map_from_arrays(keys_array, values_array)."""
+
+    def __init__(self, keys: Expression, values: Expression):
+        self.children = (keys, values)
+
+    def map_children(self, fn):
+        return MapFromArrays(fn(self.children[0]), fn(self.children[1]))
+
+    @property
+    def name(self):
+        return (f"map_from_arrays({self.children[0].name}, "
+                f"{self.children[1].name})")
+
+    def data_type(self, schema):
+        kt = self.children[0].data_type(schema)
+        vt = self.children[1].data_type(schema)
+        if not isinstance(kt, T.ArrayType) or not isinstance(vt, T.ArrayType):
+            raise AnalysisException(
+                f"map_from_arrays expects two arrays, got {kt}, {vt}")
+        return T.MapType(kt.element_type, vt.element_type)
+
+    def eval(self, ctx):
+        raise AnalysisException(f"{self!r}" + _COMPLEX_EVAL_HINT)
+
+    def __repr__(self):
+        return (f"map_from_arrays({self.children[0]!r}, "
+                f"{self.children[1]!r})")
+
+
+class _MapExtract(Expression):
+    """Shared shape of map_keys/map_values."""
+
+    WHICH = "keys"
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def map_children(self, fn):
+        return type(self)(fn(self.children[0]))
+
+    @property
+    def name(self):
+        return f"map_{self.WHICH}({self.children[0].name})"
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not isinstance(ct, T.MapType):
+            raise AnalysisException(
+                f"map_{self.WHICH} expects a map, got {ct}")
+        return T.ArrayType(ct.key_type if self.WHICH == "keys"
+                           else ct.value_type)
+
+    def eval(self, ctx):
+        raise AnalysisException(f"{self!r}" + _COMPLEX_EVAL_HINT)
+
+    def __repr__(self):
+        return f"map_{self.WHICH}({self.children[0]!r})"
+
+
+class MapKeys(_MapExtract):
+    WHICH = "keys"
+
+
+class MapValues(_MapExtract):
+    WHICH = "values"
+
+
+class MapGet(Expression):
+    """map[key] / element_at(map, key) — GetMapValue: NULL when absent."""
+
+    def __init__(self, child: Expression, key: Expression):
+        self.children = (child, key)
+
+    def map_children(self, fn):
+        return MapGet(fn(self.children[0]), fn(self.children[1]))
+
+    @property
+    def name(self):
+        return f"element_at({self.children[0].name}, {self.children[1].name})"
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if isinstance(ct, T.ArrayType):
+            return ct.element_type    # dynamic element_at(arr, expr):
+        if not isinstance(ct, T.MapType):  # rewritten to ArrayGather
+            raise AnalysisException(f"element_at on {ct} needs a map")
+        return ct.value_type
+
+    def eval(self, ctx):
+        raise AnalysisException(f"{self!r}" + _COMPLEX_EVAL_HINT)
+
+    def __repr__(self):
+        return f"element_at({self.children[0]!r}, {self.children[1]!r})"
+
+
+class GetItem(Expression):
+    """Column.getItem(key): 0-based position for arrays, key for maps —
+    `complexTypeExtractors.scala` ExtractValue dispatch, resolved by the
+    optimizer's complex-type rewrite once the child's type is known."""
+
+    def __init__(self, child: Expression, key):
+        self.children = (child,)
+        self.key = key
+
+    def map_children(self, fn):
+        return GetItem(fn(self.children[0]), self.key)
+
+    @property
+    def name(self):
+        return f"{self.children[0].name}[{self.key!r}]"
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if isinstance(ct, T.ArrayType):
+            return ct.element_type
+        if isinstance(ct, T.MapType):
+            return ct.value_type
+        if isinstance(ct, T.StructType) and isinstance(self.key, str):
+            return GetField(self.children[0], self.key).data_type(schema)
+        raise AnalysisException(f"getItem on {ct} is not supported")
+
+    def eval(self, ctx):
+        raise AnalysisException(f"{self!r}" + _COMPLEX_EVAL_HINT)
+
+    def __repr__(self):
+        return f"{self.children[0]!r}[{self.key!r}]"
+
+
+class ArrayGather(Expression):
+    """1-based dynamic-position gather from an array plane; position 0 or
+    out of bounds -> NULL.  The flat form MapGet(map_from_arrays(k, v), x)
+    rewrites into (via array_position) — and a real dual-path eval, since
+    it is what actually executes."""
+
+    def __init__(self, arr: Expression, pos: Expression):
+        self.children = (arr, pos)
+
+    def map_children(self, fn):
+        return ArrayGather(fn(self.children[0]), fn(self.children[1]))
+
+    @property
+    def name(self):
+        return f"element_at({self.children[0].name}, {self.children[1].name})"
+
+    def data_type(self, schema):
+        ct = self.children[0].data_type(schema)
+        if not isinstance(ct, T.ArrayType):
+            raise AnalysisException(f"array gather expects an array, got {ct}")
+        return ct.element_type
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        dt = self.children[0].data_type(ctx.batch.schema)
+        v = self.children[0].eval(ctx)
+        p = ctx.broadcast(self.children[1].eval(ctx))
+        if v.data.shape[-1] == 0:      # all-empty plane: gather of nothing
+            out_dt = self.data_type(ctx.batch.schema).np_dtype
+            return ExprValue(xp.zeros(ctx.capacity, out_dt),
+                             xp.zeros(ctx.capacity, bool), v.dictionary)
+        mask = _array_elem_mask(xp, dt, v.data)
+        lengths = mask.sum(axis=-1)
+        idx = p.data.astype(np.int64)
+        eff = xp.where(idx > 0, idx - 1, lengths + idx)   # -1 = last
+        ok = (idx != 0) & (eff >= 0) & (eff < lengths)
+        gathered = xp.take_along_axis(
+            v.data, xp.clip(eff, 0, v.data.shape[-1] - 1)[..., None],
+            axis=-1)[..., 0]
+        valid = and_valid(xp, and_valid(xp, v.valid, p.valid), ok)
+        return ExprValue(gathered, valid, v.dictionary)
+
+    def __repr__(self):
+        return f"array_gather({self.children[0]!r}, {self.children[1]!r})"

@@ -457,14 +457,25 @@ class Analyzer:
         # literal column name, e.g. after a previous rewrite)
         try:
             plain = {n for c in node.children for n in c.schema().names}
+            structs = {f.name: f.dataType
+                       for c in node.children for f in c.schema().fields
+                       if isinstance(f.dataType, T.StructType)}
         except AnalysisException:
             return node
-        if not qmap:
+        if not qmap and not structs:
             return node
 
         def rewrite(e: Expression) -> Expression:
-            if isinstance(e, Col) and e.name not in plain and e.name in qmap:
-                return Col(qmap[e.name])
+            if isinstance(e, Col) and e.name not in plain:
+                if e.name in qmap:
+                    return Col(qmap[e.name])
+                # s.field on a struct-typed column (qualifiers take
+                # precedence — an alias named like a struct column shadows
+                # its fields, same as the reference's resolution order)
+                base, dot, fld = e.name.partition(".")
+                if dot and base in structs and fld in structs[base].names:
+                    from ..expressions import GetField
+                    return GetField(Col(base), fld)
             if isinstance(e, AggregateFunction) or e.children:
                 return e.map_children(rewrite)
             return e

@@ -40,6 +40,17 @@ class Column:
 
     astype = cast
 
+    # -- complex-type access ----------------------------------------------
+    def getField(self, name: str) -> "Column":
+        from ..expressions import GetField
+        return Column(GetField(self._e, name))
+
+    def getItem(self, key) -> "Column":
+        from ..expressions import GetItem
+        return Column(GetItem(self._e, key))
+
+    __getitem__ = getItem
+
     # -- arithmetic -------------------------------------------------------
     def __add__(self, o): return Column(self._e + _expr(o))
     def __radd__(self, o): return Column(_expr(o) + self._e)

@@ -343,10 +343,83 @@ class DataFrame:
         from .planner import QueryExecution
         return QueryExecution(self.session, self._plan).execute()
 
+    # -- complex-type output (maps/structs) -------------------------------
+    def _flatten_complex(self):
+        """(flat DataFrame, assembly spec | None).
+
+        Top-level map/struct output columns cannot materialize on device
+        (object-layer contract, docs/DECISIONS.md): they are replaced by
+        their PLANE columns (map → keys/values arrays via the pair-of-
+        planes layout; struct → one column per field) for execution, and
+        the spec rebuilds Python dicts / Rows per row at collect."""
+        try:
+            # analyzed schema: a raw SQL plan still holds unresolved
+            # relations whose schema() raises
+            schema = self._qe_analyzed().schema()
+        except Exception:
+            return self, None
+        if not any(isinstance(f.dataType, (T.MapType, T.StructType))
+                   for f in schema.fields):
+            return self, None
+        from ..expressions import GetField, MapKeys, MapValues
+        exprs: List[Any] = []
+        spec: List[tuple] = []
+
+        def flatten(expr, dtype, prefix, name):
+            """Recursive spec node: structs flatten per field, maps emit
+            their two planes; complex-typed map keys/values have no plane
+            representation — loud error, not silent wrongness."""
+            if isinstance(dtype, T.MapType):
+                if isinstance(dtype.key_type, (T.MapType, T.StructType)) \
+                        or isinstance(dtype.value_type,
+                                      (T.MapType, T.StructType)):
+                    raise AnalysisException(
+                        "maps with map/struct keys or values cannot be "
+                        "collected (no plane layout — docs/DECISIONS.md)")
+                ki, vi = len(exprs), len(exprs) + 1
+                exprs.append(Alias(MapKeys(expr), f"{prefix}__mkeys"))
+                exprs.append(Alias(MapValues(expr), f"{prefix}__mvals"))
+                return ("map", ki, vi, name)
+            if isinstance(dtype, T.StructType):
+                subs = [flatten(GetField(expr, sf.name), sf.dataType,
+                                f"{prefix}__{sf.name}", sf.name)
+                        for sf in dtype.fields]
+                return ("struct", subs, name)
+            idx = len(exprs)
+            exprs.append(Alias(expr, f"{prefix}__v")
+                         if prefix.startswith("__") else expr)
+            return ("plain", idx, name)
+
+        for f in schema.fields:
+            if isinstance(f.dataType, (T.MapType, T.StructType)):
+                spec.append(flatten(Col(f.name), f.dataType,
+                                    f"__{f.name}", f.name))
+            else:
+                spec.append(("plain", len(exprs), f.name))
+                exprs.append(Col(f.name))
+        flat = DataFrame(self.session, L.Project(exprs, self._plan))
+        return flat, spec
+
+    @staticmethod
+    def _assemble_rows(rows, spec) -> List[Row]:
+        def build(s, r):
+            if s[0] == "plain":
+                return r[s[1]]
+            if s[0] == "map":
+                ks, vs = r[s[1]], r[s[2]]
+                return None if ks is None else dict(zip(ks, vs or []))
+            return Row([build(sub, r) for sub in s[1]],
+                       [sub[-1] for sub in s[1]])
+
+        names = [s[-1] for s in spec]
+        return [Row([build(s, r) for s in spec], names) for r in rows]
+
     def collect(self) -> List[Row]:
-        batch = self._execute()
-        names = batch.names
-        return [Row(r, names) for r in batch.to_pylist()]
+        flat, spec = self._flatten_complex()
+        batch = flat._execute()
+        if spec is None:
+            return [Row(r, batch.names) for r in batch.to_pylist()]
+        return self._assemble_rows(batch.to_pylist(), spec)
 
     def count(self) -> int:
         agg = L.Aggregate([], [(CountStar(), "count")], self._plan)
@@ -366,15 +439,27 @@ class DataFrame:
         return self.limit(n).collect()
 
     def toPandas(self):
-        return self._execute().to_pandas()
+        flat, spec = self._flatten_complex()
+        if spec is None:
+            return flat._execute().to_pandas()
+        import pandas as pd
+        rows = self._assemble_rows(flat._execute().to_pylist(), spec)
+        return pd.DataFrame([list(r) for r in rows],
+                            columns=[s[-1] for s in spec])
 
     def toLocalIterator(self):
         return iter(self.collect())
 
     def show(self, n: int = 20, truncate: bool = True) -> None:
-        batch = self.limit(n)._execute()
-        names = batch.names
-        rows = batch.to_pylist()
+        flat, spec = self.limit(n)._flatten_complex()
+        batch = flat._execute()
+        if spec is None:
+            names = batch.names
+            rows = batch.to_pylist()
+        else:
+            names = [s[-1] for s in spec]
+            rows = [list(r) for r in
+                    self._assemble_rows(batch.to_pylist(), spec)]
         cells = [[_fmt(v, truncate) for v in r] for r in rows]
         widths = [max([len(nm)] + [len(c[i]) for c in cells])
                   for i, nm in enumerate(names)]

@@ -353,8 +353,35 @@ def desc(name: str):
     return col(name).desc()
 
 
-def struct(*cols):
-    raise NotImplementedError("struct columns arrive with nested-type support")
+def struct(*cols) -> Column:
+    exprs = [_e(c) for c in cols]
+    names = [getattr(e, "name", None) or f"col{i + 1}"
+             for i, e in enumerate(exprs)]
+    return Column(E.CreateStruct(names, *exprs))
+
+
+def named_struct(*name_col_pairs) -> Column:
+    if len(name_col_pairs) % 2:
+        raise ValueError("named_struct needs alternating name, column")
+    names = [str(n) for n in name_col_pairs[0::2]]
+    exprs = [_e(c) for c in name_col_pairs[1::2]]
+    return Column(E.CreateStruct(names, *exprs))
+
+
+def create_map(*cols) -> Column:
+    return Column(E.CreateMap(*[_e(c) for c in cols]))
+
+
+def map_from_arrays(keys: ColumnOrName, values: ColumnOrName) -> Column:
+    return Column(E.MapFromArrays(_e(keys), _e(values)))
+
+
+def map_keys(c: ColumnOrName) -> Column:
+    return Column(E.MapKeys(_e(c)))
+
+
+def map_values(c: ColumnOrName) -> Column:
+    return Column(E.MapValues(_e(c)))
 
 
 # ---------------------------------------------------------------------------
@@ -540,8 +567,16 @@ def size(c: ColumnOrName) -> Column:
     return Column(E.ArraySize(_e(c)))
 
 
-def element_at(c: ColumnOrName, index: int) -> Column:
-    return Column(E.ElementAt(_e(c), index))
+def element_at(c: ColumnOrName, index) -> Column:
+    """1-based array index (int) or map key (anything else); the
+    optimizer's complex-type rewrite dispatches map cases.  Index 0 is
+    invalid for arrays, so it routes to the map path (a map may have the
+    integer key 0); element_at(array, 0) then yields NULL rather than the
+    reference's error — documented deviation."""
+    if isinstance(index, int) and not isinstance(index, bool) and index != 0:
+        return Column(E.ElementAt(_e(c), index))
+    return Column(E.MapGet(_e(c), _e(index) if isinstance(index, Column)
+                           else E.Literal(index)))
 
 
 def array_contains(c: ColumnOrName, value: Any) -> Column:

@@ -60,6 +60,140 @@ def _alias_map(p: Project) -> Optional[Dict[str, Expression]]:
 # rules — each: LogicalPlan -> LogicalPlan (identity when not applicable)
 # ---------------------------------------------------------------------------
 
+def simplify_complex_ops(node: LogicalPlan) -> LogicalPlan:
+    """Rewrite map/struct consumers over their creators into flat array/
+    scalar expressions (``SimplifyExtractValueOps`` over
+    ``complexTypeExtractors.scala``): after collapse_projects has put
+    extractor and creator in the same expression tree,
+
+    * ``getField(struct(...), f)``        → the field expression
+    * ``map_keys/values(map(...))``       → ``array(...)`` of that side
+    * ``map_keys/values(map_from_arrays)``→ the plane array
+    * ``element_at(map(...), k)``         → first-match If chain
+    * ``element_at(map_from_arrays, lit)``→ plane gather by array_position
+    * ``size(map)``                       → size of the keys plane
+
+    Complex values never materialize on device — whatever survives these
+    rewrites raises loudly at eval (docs/DECISIONS.md object-layer
+    contract, same as the reference's non-Tungsten map/struct values)."""
+    from ..expressions import (
+        ArrayGather, ArrayPosition, ArraySize, CreateMap, CreateStruct,
+        ElementAt, GetField, GetItem, If, Literal, MakeArray, MapFromArrays,
+        MapGet, MapKeys, MapValues,
+    )
+    from .. import types as T
+
+    # child schema computed LAZILY, only when a complex-type candidate is
+    # actually met — eager computation here is O(plan^2) per fixpoint
+    # iteration for every query, complex-typed or not
+    _unset = object()
+    state = {"schema": _unset}
+
+    def get_schema():
+        if state["schema"] is _unset:
+            if len(node.children) == 1:
+                try:
+                    state["schema"] = node.children[0].schema()
+                except Exception:
+                    state["schema"] = None
+            else:
+                state["schema"] = None
+        return state["schema"]
+
+    def dtype_of(e):
+        schema = get_schema()
+        if schema is None:
+            return None
+        try:
+            return e.data_type(schema)
+        except Exception:
+            return None
+
+    from ..expressions import Alias as _Alias
+
+    def creator(x):
+        """The creator behind optional Alias wrapping (struct fields built
+        with .alias(...) wrap their CreateStruct/CreateMap in an Alias)."""
+        while isinstance(x, _Alias):
+            x = x.children[0]
+        return x
+
+    def rw(e):
+        e = e.map_children(rw)
+        if isinstance(e, (MapKeys, MapValues)):
+            c = creator(e.children[0])
+            if isinstance(c, CreateMap):
+                parts = c.keys if e.WHICH == "keys" else c.values
+                return MakeArray(*parts)
+            if isinstance(c, MapFromArrays):
+                return c.children[0 if e.WHICH == "keys" else 1]
+        if isinstance(e, GetField) \
+                and isinstance(creator(e.children[0]), CreateStruct):
+            s = creator(e.children[0])
+            if e.field in s.field_names:
+                return s.children[s.field_names.index(e.field)]
+        if isinstance(e, GetItem):
+            ct = dtype_of(e.children[0])
+            if isinstance(ct, T.ArrayType):         # 0-based position
+                if isinstance(e.key, int):
+                    if e.key < 0:
+                        # GetArrayItem: negative ordinals are NULL (only
+                        # element_at does from-the-end indexing)
+                        return Literal(None, ct.element_type)
+                    return ElementAt(e.children[0], e.key + 1)
+            elif isinstance(ct, T.MapType):
+                return rw(MapGet(e.children[0], Literal(e.key)))
+            elif isinstance(ct, T.StructType) and isinstance(e.key, str):
+                return rw(GetField(e.children[0], e.key))
+        if isinstance(e, MapGet):
+            m, k = e.children
+            if isinstance(dtype_of(m), T.ArrayType):
+                # dynamic element_at(arr, expr): 1-based gather
+                return ArrayGather(m, k)
+            m = creator(m)
+            if isinstance(m, CreateMap):
+                # the NULL terminal of the If chain needs the map's value
+                # type; without it (e.g. schema unavailable under a
+                # multi-child node) leave the MapGet for a loud eval error
+                # rather than mistype the chain
+                vt = dtype_of(m)
+                if not isinstance(vt, T.MapType):
+                    try:
+                        vt = m.data_type(None)  # literal-only maps resolve
+                    except Exception:           # without a schema
+                        return e
+                    if not isinstance(vt, T.MapType):
+                        return e
+                out = Literal(None, vt.value_type)
+                # GetMapValue scans pairs in order, first match wins:
+                # build the chain inside-out so pair 1 ends outermost
+                for kk, vv in reversed(list(zip(m.keys, m.values))):
+                    out = If(kk == k, vv, out)
+                return out
+            if isinstance(m, MapFromArrays) and isinstance(k, Literal):
+                ka, va = m.children
+                return ArrayGather(va, ArrayPosition(ka, k.value))
+        if isinstance(e, ArraySize) \
+                and isinstance(dtype_of(e.children[0]), T.MapType):
+            return rw(ArraySize(MapKeys(e.children[0])))
+        if isinstance(e, ElementAt) \
+                and isinstance(dtype_of(e.children[0]), T.MapType):
+            return rw(MapGet(e.children[0], Literal(e.index)))
+        return e
+
+    return node.map_expressions(rw)
+
+
+def eliminate_subquery_aliases(node: LogicalPlan) -> LogicalPlan:
+    """Drop SubqueryAlias after analysis (``EliminateSubqueryAliases``):
+    qualifiers are fully resolved by then, and the bare tree lets
+    CollapseProject bring complex-type extractors face to face with their
+    creators across view/alias boundaries."""
+    if isinstance(node, SubqueryAlias):
+        return node.children[0]
+    return node
+
+
 def collapse_projects(node: LogicalPlan) -> LogicalPlan:
     """Project(Project(x)) → Project(x) with substitution
     (``CollapseProject`` in the reference)."""
@@ -75,6 +209,17 @@ def collapse_projects(node: LogicalPlan) -> LogicalPlan:
                 sub = Alias(sub, e.name)
             new_exprs.append(sub)
         return Project(new_exprs, inner.child)
+    return node
+
+
+def push_project_through_limit(node: LogicalPlan) -> LogicalPlan:
+    """Project(Limit(x)) → Limit(Project(x)): projection is row-wise, so
+    it commutes with Limit — and it lets CollapseProject reach a creator
+    project below the limit (complex-type extractors need the meeting)."""
+    if isinstance(node, Project) and isinstance(node.child, Limit) \
+            and all(is_deterministic(e) for e in node.exprs):
+        lim = node.child
+        return Limit(lim.n, Project(node.exprs, lim.children[0]))
     return node
 
 
@@ -699,7 +844,8 @@ class Optimizer:
     def __init__(self, conf=None):
         self.conf = conf
         self.batches = [
-            Batch("finish-analysis", [constant_folding], once=True),
+            Batch("finish-analysis", [eliminate_subquery_aliases,
+                                      constant_folding], once=True),
             Batch("operator-pushdown", [
                 combine_filters,
                 push_filter_through_project,
@@ -710,7 +856,9 @@ class Optimizer:
                 reorder_joins,
                 push_filter_into_join,
                 prune_filters,
+                push_project_through_limit,
                 collapse_projects,
+                simplify_complex_ops,
                 push_limit,
             ]),
         ]

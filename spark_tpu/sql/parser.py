@@ -385,9 +385,46 @@ def _register_breadth():
         "grouping_id": lambda a: GroupingCall(None),
     }
     from ..expressions import (
-        ArrayContains, ArraySize, ElementAt, ExplodeMarker, GroupingCall,
-        MakeArray, SplitStr,
+        ArrayContains, ArraySize, CreateMap, CreateStruct, ElementAt,
+        ExplodeMarker, GroupingCall, Literal, MakeArray, MapFromArrays,
+        MapGet, MapKeys, MapValues, SplitStr,
     )
+
+    def _element_at(a):
+        if len(a) != 2:
+            raise ParseException("element_at expects (col, index_or_key)")
+        try:
+            v = _litval(a[1], "element_at")   # folds e.g. unary minus
+        except Exception:
+            v = None
+        if isinstance(v, int) and not isinstance(v, bool) and v != 0:
+            return ElementAt(a[0], int(v))
+        return MapGet(a[0], a[1])   # map key (incl. int 0) / dynamic index
+
+    def _create_map(a):
+        return CreateMap(*a)
+
+    def _struct(a):
+        names = [getattr(e, "name", None) or f"col{i + 1}"
+                 for i, e in enumerate(a)]
+        return CreateStruct(names, *a)
+
+    def _named_struct(a):
+        if len(a) % 2:
+            raise ParseException(
+                "named_struct expects alternating name, value")
+        names = [str(_litval(e, "named_struct")) for e in a[0::2]]
+        return CreateStruct(names, *a[1::2])
+
+    def _map_extract(a, which):
+        cls = MapKeys if which == "keys" else MapValues
+        return cls(_one(a, f"map_{which}"))
+
+    def _map_from_arrays(a):
+        if len(a) != 2:
+            raise ParseException("map_from_arrays expects (keys, values)")
+        return MapFromArrays(a[0], a[1])
+
     out.update({
         "array": lambda a: MakeArray(*a),
         "split": lambda a: SplitStr(a[0], _litval(a[1], "split"),
@@ -395,8 +432,13 @@ def _register_breadth():
                             if len(a) > 2 else -1),
         "size": lambda a: ArraySize(_one(a, "size")),
         "cardinality": lambda a: ArraySize(_one(a, "cardinality")),
-        "element_at": lambda a: ElementAt(
-            a[0], int(_litval(a[1], "element_at"))),
+        "element_at": lambda a: _element_at(a),
+        "map": lambda a: _create_map(a),
+        "named_struct": lambda a: _named_struct(a),
+        "struct": lambda a: _struct(a),
+        "map_keys": lambda a: _map_extract(a, "keys"),
+        "map_values": lambda a: _map_extract(a, "values"),
+        "map_from_arrays": lambda a: _map_from_arrays(a),
         "array_contains": lambda a: ArrayContains(
             a[0], _litval(a[1], "array_contains")),
         "array_max": lambda a: _array_reduce(a, "max"),

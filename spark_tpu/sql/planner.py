@@ -378,6 +378,13 @@ class QueryExecution:
             "plan": repr(self.optimized)[:500]})
         self.session._query_count = \
             getattr(self.session, "_query_count", 0) + 1
+        # the EXECUTING session is the active one for the duration of the
+        # query (SparkSession.setActiveSession in the reference): kernels
+        # that read conf via getActiveSession (e.g. the collect_list cap)
+        # must see THIS session's conf, not whichever session was created
+        # last in the process
+        prev_active = type(self.session)._active
+        type(self.session)._active = self.session
         try:
             result = self._execute_inner()
         except BaseException as e:
@@ -387,6 +394,7 @@ class QueryExecution:
                 "error": f"{type(e).__name__}: {e}"[:300]})
             raise
         finally:
+            type(self.session)._active = prev_active
             self._leak_check()
         self.session._post_event({
             "event": "SQLExecutionEnd", "time": _time.time(),

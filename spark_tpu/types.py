@@ -265,6 +265,51 @@ class StructField:
         return hash((self.name, self.dataType, self.nullable))
 
 
+class MapType(DataType):
+    """Map column type (``types/MapType.scala``).
+
+    Device layout is the PAIR-OF-PLANES design from docs/DECISIONS.md:
+    a map value is its (keys, values) ArrayType planes.  Map columns are
+    object-layer values (exactly the reference, where maps never got a
+    Tungsten-vectorized layout): the optimizer rewrites every consumer
+    (map_keys/map_values/element_at/size) into flat array/scalar
+    expressions, and only a COLLECTED map column materializes — as the
+    two planes, zipped into Python dicts host-side."""
+
+    name = "map"
+
+    def __init__(self, key_type: DataType, value_type: DataType,
+                 value_contains_null: bool = True):
+        self.key_type = key_type
+        self.value_type = value_type
+        self.value_contains_null = value_contains_null
+
+    @property
+    def np_dtype(self):
+        raise TypeError(
+            "map columns have no single device dtype; consume them with "
+            "map_keys/map_values/element_at or collect()")
+
+    @property
+    def is_string(self):
+        return False
+
+    def simpleString(self) -> str:
+        return (f"map<{self.key_type.simpleString()},"
+                f"{self.value_type.simpleString()}>")
+
+    def __repr__(self):
+        return f"MapType({self.key_type!r}, {self.value_type!r})"
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, MapType) \
+            and other.key_type == self.key_type \
+            and other.value_type == self.value_type
+
+    def __hash__(self) -> int:
+        return hash(("map", self.key_type, self.value_type))
+
+
 class StructType(DataType):
     """Schema: ordered fields (reference ``types/StructType.scala``)."""
 
