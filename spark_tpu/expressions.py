@@ -2155,6 +2155,23 @@ class ArraySize(Expression):
         return f"size({self.children[0]!r})"
 
 
+def _gather_1based_plane(xp, dt, v, idx, capacity, out_np_dtype):
+    """ONE definition of the 1-based (negative = from-the-end, 0/out of
+    bounds = NULL) array-plane gather, shared by ElementAt (static index)
+    and ArrayGather (dynamic index) so their semantics cannot diverge.
+    Returns (gathered data, ok mask)."""
+    if v.data.shape[-1] == 0:        # all-empty plane: nothing to gather
+        return xp.zeros(capacity, out_np_dtype), xp.zeros(capacity, bool)
+    mask = _array_elem_mask(xp, dt, v.data)
+    lengths = mask.sum(axis=-1)
+    eff = xp.where(idx > 0, idx - 1, lengths + idx)
+    ok = (idx != 0) & (eff >= 0) & (eff < lengths)
+    gathered = xp.take_along_axis(
+        v.data, xp.clip(eff, 0, v.data.shape[-1] - 1)[..., None],
+        axis=-1)[..., 0]
+    return gathered, ok
+
+
 class ElementAt(Expression):
     """element_at(arr, i): 1-based; negative indexes from the end; out of
     bounds -> NULL (Spark's non-ANSI behavior)."""
@@ -2184,14 +2201,9 @@ class ElementAt(Expression):
         xp = ctx.xp
         dt = self.children[0].data_type(ctx.batch.schema)
         v = self.children[0].eval(ctx)
-        mask = _array_elem_mask(xp, dt, v.data)
-        lengths = mask.sum(axis=-1)
-        idx = np.int64(self.index)
-        eff = xp.where(idx > 0, idx - 1, lengths + idx)
-        ok = (eff >= 0) & (eff < lengths)
-        gathered = xp.take_along_axis(
-            v.data, xp.clip(eff, 0, v.data.shape[-1] - 1)[..., None],
-            axis=-1)[..., 0]
+        out_dt = self.data_type(ctx.batch.schema).np_dtype
+        gathered, ok = _gather_1based_plane(
+            xp, dt, v, np.int64(self.index), ctx.capacity, out_dt)
         return ExprValue(gathered, and_valid(xp, v.valid, ok),
                          v.dictionary)
 
@@ -3209,18 +3221,9 @@ class ArrayGather(Expression):
         dt = self.children[0].data_type(ctx.batch.schema)
         v = self.children[0].eval(ctx)
         p = ctx.broadcast(self.children[1].eval(ctx))
-        if v.data.shape[-1] == 0:      # all-empty plane: gather of nothing
-            out_dt = self.data_type(ctx.batch.schema).np_dtype
-            return ExprValue(xp.zeros(ctx.capacity, out_dt),
-                             xp.zeros(ctx.capacity, bool), v.dictionary)
-        mask = _array_elem_mask(xp, dt, v.data)
-        lengths = mask.sum(axis=-1)
-        idx = p.data.astype(np.int64)
-        eff = xp.where(idx > 0, idx - 1, lengths + idx)   # -1 = last
-        ok = (idx != 0) & (eff >= 0) & (eff < lengths)
-        gathered = xp.take_along_axis(
-            v.data, xp.clip(eff, 0, v.data.shape[-1] - 1)[..., None],
-            axis=-1)[..., 0]
+        out_dt = self.data_type(ctx.batch.schema).np_dtype
+        gathered, ok = _gather_1based_plane(
+            xp, dt, v, p.data.astype(np.int64), ctx.capacity, out_dt)
         valid = and_valid(xp, and_valid(xp, v.valid, p.valid), ok)
         return ExprValue(gathered, valid, v.dictionary)
 

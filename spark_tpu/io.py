@@ -350,11 +350,35 @@ def _read_text(paths: List[str], options) -> "Any":
     return pa.table({"value": pa.array(lines, pa.string())})
 
 
+def _read_orc(paths: List[str], options, columns=None) -> "Any":
+    """ORC via pyarrow.orc (`sql/hive/.../orc/OrcFileFormat.scala` role):
+    column pruning pushes into the stripe reader; stats-based stripe
+    skipping stays parquet-only (documented)."""
+    import pyarrow as pa
+    import pyarrow.orc as paorc
+    tables = []
+    strip = False
+    for p in paths:
+        f = paorc.ORCFile(p)
+        cols = None if columns is None else \
+            [c for c in columns if c in f.schema.names]
+        if cols == []:
+            # partition-dir-only projection: both ORCFile.read(columns=[])
+            # and concat_tables of 0-column tables DROP the row count, so
+            # carry one narrow column through the concat and strip after
+            cols = [f.schema.names[0]]
+            strip = True
+        tables.append(f.read(columns=cols))
+    out = pa.concat_tables(tables, promote_options="permissive")
+    return out.select([]) if strip else out
+
+
 _READERS = {
     "parquet": _read_parquet,
     "csv": _read_csv,
     "json": _read_json,
     "text": _read_text,
+    "orc": _read_orc,
 }
 
 
@@ -373,6 +397,14 @@ def _parquet_schema(raw_paths: List[str]) -> T.StructType:
                 seen.add(af.name)
                 fields.append(T.StructField(af.name,
                                             _arrow_to_engine(af.type), True))
+    _append_partition_fields(files, base, seen, fields)
+    return T.StructType(fields)
+
+
+def _append_partition_fields(files, base, seen: set,
+                             fields: List["T.StructField"]) -> None:
+    """Partition-directory (k=v) columns, shared by every metadata-only
+    schema reader (parquet footers, ORC metadata)."""
     part_vals: Dict[str, List[str]] = {}
     for f in files:
         for k, v in _partition_values(f, base).items():
@@ -384,6 +416,23 @@ def _parquet_schema(raw_paths: List[str]) -> T.StructType:
         dt = T.np_dtype_to_engine(inferred.dtype) \
             if isinstance(inferred, np.ndarray) else T.string
         fields.append(T.StructField(k, dt, True))
+
+
+def _orc_schema(raw_paths: List[str]) -> T.StructType:
+    """Engine schema from ORC file metadata — no stripes read."""
+    import pyarrow.orc as paorc
+    files = _resolve_paths(raw_paths)
+    base = raw_paths[0] if isinstance(raw_paths, list) else raw_paths
+    base = base if os.path.isdir(base) else os.path.dirname(base)
+    fields: List[T.StructField] = []
+    seen: set = set()
+    for f in files:
+        for af in paorc.ORCFile(f).schema:
+            if af.name not in seen:
+                seen.add(af.name)
+                fields.append(T.StructField(af.name,
+                                            _arrow_to_engine(af.type), True))
+    _append_partition_fields(files, base, seen, fields)
     return T.StructType(fields)
 
 
@@ -406,6 +455,9 @@ def _load_batch(fmt: str, raw_paths: List[str], options: Dict[str, str],
     if fmt == "parquet":
         def reader(paths, opts):
             return _read_parquet(paths, opts, columns=columns, pushed=pushed)
+    elif fmt == "orc" and columns is not None:
+        def reader(paths, opts):
+            return _read_orc(paths, opts, columns=columns)
     elif columns is not None:
         def reader(paths, opts):
             t = base_reader(paths, opts)
@@ -709,6 +761,8 @@ class DataFrameReader:
             # schema from footers only — a wide table must not be READ to
             # be *referenced*; pruning decides what the query's scan loads
             schema = _parquet_schema(paths)
+        elif self._fmt == "orc":
+            schema = _orc_schema(paths)
         else:
             schema = _load_batch(self._fmt, paths, self._options).schema
         rel = L.FileRelation(self._fmt, paths, schema, self._options)
@@ -717,6 +771,10 @@ class DataFrameReader:
     def parquet(self, *paths) -> "Any":
         return self.format("parquet").load(list(paths) if len(paths) > 1
                                            else paths[0])
+
+    def orc(self, *paths) -> "Any":
+        return self.format("orc").load(list(paths) if len(paths) > 1
+                                       else paths[0])
 
     def csv(self, path, header=None, sep=None, inferSchema=None,
             nullValue=None) -> "Any":
@@ -823,12 +881,15 @@ class DataFrameWriter:
             with open(out, "w", encoding="utf-8") as f:
                 for v in table.columns[0].to_pylist():
                     f.write(("" if v is None else str(v)) + "\n")
+        elif self._fmt == "orc":
+            import pyarrow.orc as paorc
+            paorc.write_table(table, out)
         else:
             raise AnalysisException(f"unsupported format: {self._fmt}")
 
     def save(self, path: str) -> None:
         ext = {"parquet": ".parquet", "csv": ".csv",
-               "json": ".json", "text": ".txt"}[self._fmt]
+               "json": ".json", "text": ".txt", "orc": ".orc"}[self._fmt]
         if not self._prepare_dir(path):
             return
         table = self._arrow_table(self._df)
@@ -861,6 +922,9 @@ class DataFrameWriter:
 
     def parquet(self, path: str) -> None:
         self.format("parquet").save(path)
+
+    def orc(self, path: str) -> None:
+        self.format("orc").save(path)
 
     def csv(self, path: str, header=None) -> None:
         if header is not None:

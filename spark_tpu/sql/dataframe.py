@@ -353,9 +353,13 @@ class DataFrame:
         planes layout; struct → one column per field) for execution, and
         the spec rebuilds Python dicts / Rows per row at collect."""
         try:
-            # analyzed schema: a raw SQL plan still holds unresolved
-            # relations whose schema() raises
-            schema = self._qe_analyzed().schema()
+            # API-built plans answer schema() directly (fast path, no
+            # second analysis); raw SQL plans hold unresolved relations
+            # whose schema() raises — analyze only then
+            try:
+                schema = self._plan.schema()
+            except Exception:
+                schema = self._qe_analyzed().schema()
         except Exception:
             return self, None
         if not any(isinstance(f.dataType, (T.MapType, T.StructType))
@@ -407,7 +411,11 @@ class DataFrame:
                 return r[s[1]]
             if s[0] == "map":
                 ks, vs = r[s[1]], r[s[2]]
-                return None if ks is None else dict(zip(ks, vs or []))
+                if ks is None:
+                    return None
+                # reversed so the FIRST occurrence of a duplicate key wins
+                # — consistent with element_at's GetMapValue scan order
+                return dict(zip(reversed(ks), reversed(vs or [])))
             return Row([build(sub, r) for sub in s[1]],
                        [sub[-1] for sub in s[1]])
 

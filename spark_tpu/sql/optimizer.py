@@ -223,6 +223,58 @@ def push_project_through_limit(node: LogicalPlan) -> LogicalPlan:
     return node
 
 
+def _referenced_cols(e: Expression, out: set) -> None:
+    if isinstance(e, Col):
+        out.add(e.name)
+    for c in e.children:
+        _referenced_cols(c, out)
+
+
+def push_project_through_sort(node: LogicalPlan) -> LogicalPlan:
+    """Project(Sort(x)) → Sort(Project(x)) when the projection passes
+    every column the sort orders reference straight through — row-wise
+    projection commutes with ordering.  This lets the complex-type
+    flatten projection reach a creator below an ORDER BY on plain
+    columns (sorting BY a complex value stays unsupported and loud)."""
+    if not (isinstance(node, Project) and isinstance(node.child, Sort)
+            and all(is_deterministic(e) for e in node.exprs)):
+        return node
+    sort = node.child
+    needed: set = set()
+    for o in sort.orders:
+        _referenced_cols(o.child, needed)
+    passed = set()
+    for e in node.exprs:
+        base = e.children[0] if isinstance(e, Alias) else e
+        if isinstance(base, Col) and (not isinstance(e, Alias)
+                                      or e.name == base.name):
+            passed.add(base.name)
+    if not needed <= passed:
+        return node
+    return Sort(sort.orders, Project(node.exprs, sort.children[0]),
+                sort.is_global)
+
+
+def prune_project_under_aggregate(node: LogicalPlan) -> LogicalPlan:
+    """Aggregate(Project(x)): drop project columns the aggregate never
+    references (``ColumnPruning`` restricted to the schema-discarding
+    parent).  Matters doubly for complex types: an unconsumed map/struct
+    column below count() must not be evaluated at all."""
+    if not (isinstance(node, Aggregate) and isinstance(node.child, Project)):
+        return node
+    proj = node.child
+    needed: set = set()
+    for e in list(node.keys) + [f for f, _n in node.aggs]:
+        _referenced_cols(e, needed)
+    keep = [e for e in proj.exprs if e.name in needed]
+    if len(keep) == len(proj.exprs):
+        return node
+    if not keep:
+        # count(*)-style: rows matter, values don't — keep one cheap col
+        keep = [Alias(Literal(1), "__one")]
+    return Aggregate(node.keys, node.aggs, Project(keep, proj.children[0]))
+
+
 def combine_filters(node: LogicalPlan) -> LogicalPlan:
     """Filter(Filter(x)) → Filter(a AND b) (``CombineFilters``)."""
     if isinstance(node, Filter) and isinstance(node.child, Filter):
@@ -857,6 +909,8 @@ class Optimizer:
                 push_filter_into_join,
                 prune_filters,
                 push_project_through_limit,
+                push_project_through_sort,
+                prune_project_under_aggregate,
                 collapse_projects,
                 simplify_complex_ops,
                 push_limit,

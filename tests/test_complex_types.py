@@ -206,6 +206,30 @@ def test_map_int_key_zero(spark, df):
     assert [r.v for r in rows] == [1, 2, 3]
 
 
+def test_count_over_unconsumed_map(mdf):
+    """A merely-present complex column must not block aggregation: the
+    projection under the aggregate prunes it away."""
+    assert mdf.count() == 3
+    rows = mdf.groupBy().agg(F.sum("id").alias("s")).collect()
+    assert rows[0].s == 6
+
+
+def test_collect_through_sort_on_plain_column(mdf):
+    """ORDER BY a scalar while a map column rides along: the flatten
+    projection pushes through the sort to reach the creator."""
+    rows = mdf.orderBy(F.col("id").desc()).collect()
+    assert [r.id for r in rows] == [3, 2, 1]
+    assert rows[0].m == {"k1": 3, "k2": 30}
+
+
+def test_duplicate_key_collect_first_wins(spark, df):
+    df.createOrReplaceTempView("base")
+    rows = spark.sql(
+        "SELECT map('a', id, 'a', id * 100) AS m FROM base").collect()
+    # consistent with element_at's GetMapValue first-match scan order
+    assert [r.m for r in rows] == [{"a": 1}, {"a": 2}, {"a": 3}]
+
+
 # ---------------------------------------------------------------------------
 # loud errors, not silent wrongness
 # ---------------------------------------------------------------------------
