@@ -1,0 +1,149 @@
+"""Cross-process query execution over the host shuffle service.
+
+The DCN-axis exchange of the hybrid mesh made REAL: a groupBy whose
+aggregation state crosses process boundaries moves through
+``HostShuffleService`` filesystem blocks (the
+``ExternalShuffleBlockResolver.java:57`` role) instead of XLA
+collectives, which only reach within a slice.
+
+The shape is the engine's standard two-phase aggregation, with the
+exchange hop swapped out:
+
+    local child plan → DPartialAggregate (device/host, THIS process's
+    rows) → key-hash partition across processes → HostShuffleService
+    all-to-all (atomic-rename blocks + barrier) → DMergePartial over the
+    received state → DFinalAggregate
+
+Every process ends with the final rows for its key range; the ranges are
+disjoint and cover the key space (same contract as one in-slice hash
+exchange, `parallel/dist.py` DExchangeHash — so in-slice and cross-slice
+aggregation produce identical merges by construction, they share the
+partial/merge/final nodes).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .. import types as T
+from ..columnar import ColumnBatch, ColumnVector
+from ..expressions import Col, EvalContext, Hash64
+from ..kernels import compact, union_all
+from ..sql import physical as P
+from .hostshuffle import HostShuffleService
+
+__all__ = ["host_exchange_group_agg"]
+
+
+def _mask_rows(batch: ColumnBatch, keep: np.ndarray) -> ColumnBatch:
+    idx = np.nonzero(keep)[0]
+    vectors = [
+        ColumnVector(np.asarray(v.data)[idx], v.dtype,
+                     None if v.valid is None else np.asarray(v.valid)[idx],
+                     v.dictionary)
+        for v in batch.vectors
+    ]
+    return ColumnBatch(list(batch.names), vectors, None, len(idx))
+
+
+def host_exchange_group_agg(session, df, svc: HostShuffleService,
+                            exchange_id: str) -> ColumnBatch:
+    """Run ``df`` (whose plan must root in a groupBy aggregate) with the
+    aggregation exchange crossing PROCESS boundaries through ``svc``.
+
+    Each process contributes its local rows and returns the final
+    aggregated rows for its hash range of the keys."""
+    from ..sql import logical as L
+    from ..sql.planner import QueryExecution
+    from .dist import DFinalAggregate, DPartialAggregate
+
+    qe = QueryExecution(session, df._plan)
+    plan = qe.optimized
+    above: List[L.LogicalPlan] = []      # Projects over the aggregate
+    while isinstance(plan, (L.SubqueryAlias, L.Project)):
+        if isinstance(plan, L.Project):
+            above.append(plan)
+        plan = plan.children[0]
+    if not isinstance(plan, L.Aggregate):
+        raise ValueError(
+            f"host_exchange_group_agg needs a groupBy aggregate at the "
+            f"root, got {type(plan).__name__}")
+    if not plan.keys:
+        raise ValueError("global aggregates have no key range to "
+                         "exchange; run them per-process and psum")
+    from ..aggregates import First, Max, Min
+    child_schema_pre = plan.children[0].schema()
+    for f, _n in plan.aggs:
+        if isinstance(f, (Min, Max, First)) and f.children \
+                and f.children[0].data_type(child_schema_pre).is_string:
+            raise ValueError(
+                f"{f!r}: string-valued min/max/first buffers hold "
+                "per-process dictionary CODES, which cannot merge across "
+                "processes — cast to a comparable type or aggregate "
+                "in-slice")
+
+    # 1. THIS process's child rows → local partial state.  The child runs
+    # on the INTERPRETED host path: each process holds different rows,
+    # and under jax.distributed a device_put of per-process-different
+    # values trips the global-consistency check (device execution is the
+    # in-slice engine's job; this module exists for the cross-slice hop)
+    from .. import config as C
+    old_codegen = session.conf._overrides.get(C.CODEGEN_ENABLED.key)
+    old_shards = session.conf._overrides.get(C.MESH_SHARDS.key)
+    session.conf.set(C.CODEGEN_ENABLED.key, "false")
+    session.conf.set(C.MESH_SHARDS.key, "1")
+    try:
+        child_batch = QueryExecution(session, plan.children[0]).execute()
+    finally:
+        for key, old in ((C.CODEGEN_ENABLED.key, old_codegen),
+                         (C.MESH_SHARDS.key, old_shards)):
+            if old is None:
+                session.conf.unset(key)
+            else:
+                session.conf.set(key, old)
+    child_schema = plan.children[0].schema()
+    partial_node = DPartialAggregate(plan.keys, plan.aggs,
+                                     P.PScan(0, child_schema))
+    partial = compact(np, partial_node.run(
+        P.ExecContext(np, [child_batch])))
+
+    # 2. route each group's partial row to its owner process by key hash
+    key_refs = [Col(k.name) for k in plan.keys]
+    ectx = EvalContext(partial, np)
+    h = ectx.broadcast(Hash64(*key_refs).eval(ectx)).data
+    live = np.asarray(partial.row_valid_or_true())
+    receiver = (np.asarray(h).astype(np.uint64)
+                % np.uint64(svc.n)).astype(np.int64)
+    per_receiver = {
+        r: [_mask_rows(partial, live & (receiver == r))]
+        for r in range(svc.n)
+    }
+
+    # 3. the DCN hop: filesystem blocks, atomic publish, barrier
+    received = svc.exchange(exchange_id, per_receiver)
+    received = [b for b in received
+                if int(np.asarray(b.num_rows()))] or \
+        [_mask_rows(partial, np.zeros(partial.capacity, bool))]
+    state = union_all(received) if len(received) > 1 else received[0]
+
+    # 4. merge colliding partials + finish, with the SAME final node the
+    # in-slice path uses, so the two exchange flavors cannot diverge.
+    # (String GROUP KEYS re-encode onto merged dictionaries in union_all;
+    # string-valued min/max/first aggregates share the in-slice path's
+    # fixed-dictionary assumption and are not supported cross-process.)
+    final = DFinalAggregate(plan.keys, plan.aggs, partial_node,
+                            P.PScan(0, state.schema)).run(
+        P.ExecContext(np, [state]))
+    result = compact(np, final)
+    # projections above the aggregate run host-interpreted on the result
+    from ..sql.planner import Planner
+    for proj in reversed(above):
+        node = L.Project(proj.exprs, L.LocalRelation(result))
+        planner = Planner(session)
+        leaves: List[ColumnBatch] = []
+        phys = planner._to_physical(node, leaves)
+        planner._assign_op_ids(phys, [1])
+        result = compact(np, phys.run(P.ExecContext(np, [result])))
+    return result

@@ -109,12 +109,26 @@ class HostShuffleService:
     def exchange(self, exchange: str,
                  per_receiver: Dict[int, Sequence[ColumnBatch]]
                  ) -> List[ColumnBatch]:
-        """One full all-to-all hop: publish, commit, barrier, collect."""
+        """One full all-to-all hop: publish, commit, barrier, collect.
+
+        Exchange ids are SINGLE-USE: a reused id would let the barrier
+        see stale commit markers and hand a reader the previous run's
+        blocks — detected loudly here.  The caller owns directory
+        cleanup once every participant is done with the result (an
+        in-band cleanup would race other processes' reads)."""
+        if os.path.exists(self._done(exchange, self.pid)):
+            raise ValueError(
+                f"host shuffle exchange id {exchange!r} was already used "
+                "by this process; ids are single-use (stale commit "
+                "markers would unblock the barrier early)")
+        own = per_receiver.get(self.pid, [])
         for r, batches in per_receiver.items():
-            self.put(exchange, r, batches)
+            if r != self.pid:      # own partition never touches the disk
+                self.put(exchange, r, batches)
         self.commit(exchange)
         self.barrier(exchange)
-        return self.collect(exchange)
+        remote = self.collect(exchange)
+        return list(own) + remote
 
     def cleanup(self, exchange: str) -> None:
         d = self._dir(exchange)

@@ -28,12 +28,14 @@ def _free_port() -> int:
 def test_two_process_cluster(tmp_path):
     port = _free_port()
     beat_dir = str(tmp_path / "beats")
+    shuffle_dir = str(tmp_path / "shuffle")
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
 
     def launch(pid):
         return subprocess.Popen(
-            [sys.executable, _WORKER, str(pid), str(port), beat_dir],
+            [sys.executable, _WORKER, str(pid), str(port), beat_dir,
+             shuffle_dir],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env=env)
 
@@ -45,4 +47,6 @@ def test_two_process_cluster(tmp_path):
     assert p0.returncode == 0, f"p0 failed:\n{out0[-3000:]}"
     assert "allreduce sum ok" in out0 and "allreduce sum ok" in out1
     assert "all_to_all ok" in out0
+    assert "crossproc agg:" in out0 and "crossproc agg:" in out1
+    assert "CROSSPROC-QUERY-OK" in out0
     assert "DEATH-DETECTED-OK" in out0

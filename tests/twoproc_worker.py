@@ -60,6 +60,62 @@ y = jax.jit(f)(arr)
 assert len(y.addressable_shards) == 4
 print(f"[p{pid}] all_to_all ok", flush=True)
 
+# a REAL query through the host shuffle service (VERDICT r3 #6): each
+# process holds half the rows of one table; the groupBy's aggregation
+# state crosses the process boundary via filesystem blocks
+shuffle_dir = sys.argv[4]
+from spark_tpu.parallel.crossproc import host_exchange_group_agg  # noqa: E402
+from spark_tpu.parallel.hostshuffle import HostShuffleService  # noqa: E402
+from spark_tpu.sql.session import SparkSession  # noqa: E402
+import spark_tpu.sql.functions as F  # noqa: E402
+
+rng = np.random.default_rng(47)            # both processes draw the SAME
+keys = rng.integers(0, 57, 4000).astype(np.int64)     # full dataset...
+vals = rng.integers(0, 1000, 4000).astype(np.int64)
+gnames = np.array(["ash", "oak", "fir"])[keys % 3]
+half = slice(pid * 2000, (pid + 1) * 2000)            # ...and keep a half
+
+session = SparkSession.builder.appName(f"xproc-{pid}").getOrCreate()
+# every ENGINE query in this worker is process-local (shards=1): under
+# jax.distributed an engine run on the auto (global) mesh would be a
+# collective program that the OTHER process never joins — asymmetric
+# work deadlocks the coordination service.  The cross-process hop under
+# test is the HostShuffleService, not the in-slice mesh.
+session.conf.set(C.MESH_SHARDS.key, "1")
+local = session.createDataFrame({
+    "k": keys[half], "g": gnames[half], "v": vals[half]})
+q = local.groupBy("k", "g").agg(F.sum("v").alias("s"),
+                                F.count("*").alias("c"),
+                                F.min("v").alias("lo"))
+svc = HostShuffleService(shuffle_dir, process_id=pid, n_processes=2,
+                         timeout_s=60.0)
+mine = host_exchange_group_agg(session, q, svc, "agg-hop-1")
+rows = {tuple(r[:2]): tuple(r[2:]) for r in mine.to_pylist()}
+print(f"[p{pid}] crossproc agg: {len(rows)} groups", flush=True)
+
+# every process owns a DISJOINT key range; p0 gathers p1's final rows
+# through a second hop and checks the UNION against the single-process
+# oracle over the full dataset
+gathered = svc.exchange("agg-hop-2", {0: [mine]})
+if pid == 0:
+    both = {}
+    for b in gathered:
+        for r in b.to_pylist():
+            key = tuple(r[:2])
+            assert key not in both, f"key {key} owned by both processes"
+            both[key] = tuple(r[2:])
+    oracle_df = session.createDataFrame({"k": keys, "g": gnames, "v": vals})
+    oracle = {
+        tuple(r[:2]): tuple(r[2:])
+        for r in (oracle_df.groupBy("k", "g")
+                  .agg(F.sum("v").alias("s"), F.count("*").alias("c"),
+                       F.min("v").alias("lo")).collect())
+    }
+    assert both == oracle, (
+        f"crossproc={len(both)} oracle={len(oracle)} "
+        f"diff={set(both) ^ set(oracle)}")
+    print("[p0] CROSSPROC-QUERY-OK", flush=True)
+
 # heartbeat death detection across REAL process boundaries: both beat,
 # then p1 stops beating and exits; p0 must observe host-1 die
 conf = C.Conf()
