@@ -23,11 +23,10 @@ partial/merge/final nodes).
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 
-from .. import types as T
 from ..columnar import ColumnBatch, ColumnVector
 from ..expressions import Col, EvalContext, Hash64
 from ..kernels import compact, union_all
@@ -74,15 +73,27 @@ def host_exchange_group_agg(session, df, svc: HostShuffleService,
         raise ValueError("global aggregates have no key range to "
                          "exchange; run them per-process and psum")
     from ..aggregates import First, Max, Min
-    child_schema_pre = plan.children[0].schema()
+    child_schema = plan.children[0].schema()
     for f, _n in plan.aggs:
         if isinstance(f, (Min, Max, First)) and f.children \
-                and f.children[0].data_type(child_schema_pre).is_string:
+                and f.children[0].data_type(child_schema).is_string:
             raise ValueError(
                 f"{f!r}: string-valued min/max/first buffers hold "
                 "per-process dictionary CODES, which cannot merge across "
                 "processes — cast to a comparable type or aggregate "
                 "in-slice")
+    inner = plan.children[0]
+    while isinstance(inner, (L.SubqueryAlias, L.Project)):
+        inner = inner.children[0]
+    if isinstance(inner, L.Aggregate):
+        # the analyzer's distinct-agg expansion (Aggregate over Aggregate):
+        # running the inner dedup PER PROCESS would keep one copy of a
+        # value per process and double-count it in the merge — needs a
+        # two-hop exchange, which this helper does not do
+        raise ValueError(
+            "nested aggregation (e.g. the DISTINCT-aggregate expansion) "
+            "would dedup per process and double-count across them; "
+            "exchange the inner aggregation first")
 
     # 1. THIS process's child rows → local partial state.  The child runs
     # on the INTERPRETED host path: each process holds different rows,
@@ -103,7 +114,6 @@ def host_exchange_group_agg(session, df, svc: HostShuffleService,
                 session.conf.unset(key)
             else:
                 session.conf.set(key, old)
-    child_schema = plan.children[0].schema()
     partial_node = DPartialAggregate(plan.keys, plan.aggs,
                                      P.PScan(0, child_schema))
     partial = compact(np, partial_node.run(

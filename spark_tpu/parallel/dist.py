@@ -585,7 +585,8 @@ def _first_last_reduce(xp, rank_s, dead_rank, value_s, validplane_s, seg_ids,
 
 def _np_set0(change):
     change = change.copy()
-    change[0] = True
+    if len(change):          # a capacity-0 host batch has no first row
+        change[0] = True
     return change
 
 

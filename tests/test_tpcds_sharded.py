@@ -16,14 +16,17 @@ import jax
 import spark_tpu.config as C
 from spark_tpu.tpcds import QUERIES, generate
 from spark_tpu.tpcds.oracle import FACT_TABLES as FACTS, \
-    norm_value as _norm, row_key as _key
+    norm_value as _norm, row_key as _key, sqlite_text
 
 pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs 8 virtual devices")
 
 SF_ROWS = 20_000
 BATCH = 4096
-SWEEP = ["q3", "q42", "q55", "q96"]
+# every breaker type crosses the mesh: plain agg+sort (q3/q42/q55),
+# semi-join (q96), grace multi-fact join (q17), windows over aggregates
+# (q53/q98), sort+limit scan shapes (q62/q93)
+SWEEP = ["q3", "q17", "q42", "q53", "q55", "q62", "q93", "q96", "q98"]
 
 @pytest.fixture(scope="module")
 def sh(spark, tmp_path_factory):
@@ -58,7 +61,8 @@ def test_sharded_filebacked_query(sh, qname):
     got = sorted((tuple(_norm(v) for v in r)
                   for r in spark.sql(sql).collect()), key=_key)
     exp = sorted((tuple(_norm(v) for v in r)
-                  for r in con.execute(sql).fetchall()), key=_key)
+                  for r in con.execute(sqlite_text(sql)).fetchall()),
+                 key=_key)
     assert exp, f"{qname}: oracle returned no rows"
     assert len(got) == len(exp), (qname, len(got), len(exp))
     for g, e in zip(got, exp):
