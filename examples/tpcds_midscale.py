@@ -26,55 +26,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 FACTS = {"store_sales", "catalog_sales", "web_sales", "store_returns",
          "catalog_returns", "web_returns", "inventory"}
 
-#: fact join-key columns resampled under --skew (domain preserved, so
-#: referential integrity and the sqlite oracle both stay valid)
-_SKEW_KEYS = {
-    "store_sales": ["ss_item_sk", "ss_store_sk", "ss_cdemo_sk"],
-    "catalog_sales": ["cs_item_sk", "cs_bill_customer_sk"],
-    "web_sales": ["ws_item_sk"],
-}
-_NULL_MEASURES = {
-    "store_sales": ["ss_sales_price", "ss_ext_sales_price", "ss_quantity"],
-    "catalog_sales": ["cs_quantity"],
-}
-
-
-def _apply_skew(tables, alpha: float, null_frac: float = 0.05,
-                seed: int = 77) -> None:
-    """Zipf-resample fact join keys over their existing domains + inject
-    NULLs into measures — hostile distributions the uniform generator
-    cannot produce (hot keys stress the grace-join salting/chunking and
-    the adaptive capacity retry)."""
-    import numpy as np
-    rng = np.random.default_rng(seed)
-    for tname, cols in _SKEW_KEYS.items():
-        pdf = tables.get(tname)
-        if pdf is None:
-            continue
-        n = len(pdf)
-        for c in cols:
-            if c not in pdf.columns:
-                continue
-            domain = pdf[c].dropna().unique()
-            if len(domain) < 2:
-                continue
-            ranks = np.arange(1, len(domain) + 1, dtype=np.float64)
-            w = ranks ** (-alpha)
-            w /= w.sum()
-            pdf[c] = rng.choice(domain, size=n, p=w)
-    for tname, cols in _NULL_MEASURES.items():
-        pdf = tables.get(tname)
-        if pdf is None:
-            continue
-        n = len(pdf)
-        for c in cols:
-            if c not in pdf.columns:
-                continue
-            mask = rng.random(n) < null_frac
-            col = pdf[c].astype("float64")
-            col[mask] = np.nan
-            pdf[c] = col
-
 
 def main() -> int:
     ap = argparse.ArgumentParser()
@@ -82,19 +33,20 @@ def main() -> int:
                     help="store_sales rows (other facts scale off it)")
     ap.add_argument("--batch", type=int, default=1 << 21,
                     help="spark.tpu.scan.maxBatchRows")
-    ap.add_argument("--queries", default="q3,q42,q55,q17")
+    ap.add_argument("--queries", default="q3,q42,q55,q17",
+                    help="comma list, or 'all' for every RUNNABLE query")
     ap.add_argument("--keep", default=None,
                     help="dataset dir to reuse/create (default: temp)")
     ap.add_argument("--validate", action="store_true")
     ap.add_argument("--skew", type=float, default=0.0,
-                    help="Zipf exponent for fact join keys (0 = uniform); "
-                    "also injects ~5%% NULLs into fact measures — the "
-                    "hostile-distribution lane the uniform generator "
-                    "cannot provide")
+                    help="Zipf exponent for the generator's dsdgen-like "
+                    "marginals (0 = uniform): hot items/customers/stores, "
+                    "seasonal dates, category price levels, ~5%% NULL "
+                    "measures (datagen.SkewDists)")
     args = ap.parse_args()
 
     from spark_tpu.sql.session import SparkSession
-    from spark_tpu.tpcds import QUERIES, generate
+    from spark_tpu.tpcds import QUERIES, RUNNABLE, generate
 
     spark = SparkSession.builder.appName("tpcds-midscale").getOrCreate()
     base = args.keep or tempfile.mkdtemp(prefix="tpcds_mid_")
@@ -110,9 +62,9 @@ def main() -> int:
                   if n not in FACTS}
     else:
         print(f"[midscale] generating {args.rows:,} store_sales rows ...")
-        tables = generate(args.rows, seed=20260730)
-        if args.skew > 0:
-            _apply_skew(tables, args.skew)
+        tables = generate(args.rows, seed=20260730,
+                          skew=args.skew or None,
+                          measure_null_frac=0.05 if args.skew > 0 else 0.0)
         os.makedirs(base, exist_ok=True)
         for name in FACTS & set(tables):
             d = os.path.join(base, name)
@@ -138,8 +90,9 @@ def main() -> int:
     spark.conf.set("spark.tpu.scan.maxBatchRows", str(args.batch))
 
     results = {}
-    for q in args.queries.split(","):
-        q = q.strip()
+    qlist = list(RUNNABLE) if args.queries.strip().lower() == "all" \
+        else [q.strip() for q in args.queries.split(",")]
+    for q in qlist:
         t0 = time.time()
         rows = spark.sql(QUERIES[q]).collect()
         dt = time.time() - t0
@@ -151,18 +104,33 @@ def main() -> int:
     if args.validate:
         import sqlite3
         con = sqlite3.connect(":memory:")
-        full = generate(args.rows, seed=20260730)
-        if args.skew > 0:
-            _apply_skew(full, args.skew)     # oracle sees the SAME data
+        full = generate(args.rows, seed=20260730,
+                        skew=args.skew or None,
+                        measure_null_frac=0.05 if args.skew > 0 else 0.0)
         for name, pdf in full.items():
             pdf.to_sql(name, con, index=False)
 
-        from spark_tpu.tpcds.oracle import sqlite_text
+        import math
+
+        from spark_tpu.tpcds import ORACLE_OVERRIDES
+        from spark_tpu.tpcds.oracle import norm_value, row_key, sqlite_text
 
         for q in results:
-            got = [tuple(r) for r in spark.sql(QUERIES[q]).collect()]
-            exp = con.execute(sqlite_text(QUERIES[q])).fetchall()
+            got = sorted((tuple(norm_value(v) for v in r)
+                          for r in spark.sql(QUERIES[q]).collect()),
+                         key=row_key)
+            osql = ORACLE_OVERRIDES.get(q, QUERIES[q])
+            exp = sorted((tuple(norm_value(v) for v in r)
+                          for r in con.execute(sqlite_text(osql))),
+                         key=row_key)
             assert len(got) == len(exp), (q, len(got), len(exp))
+            for g, e in zip(got, exp):
+                for a, b in zip(g, e):
+                    if isinstance(a, float) and isinstance(b, float):
+                        assert math.isclose(a, b, rel_tol=1e-6,
+                                            abs_tol=1e-6), (q, a, b)
+                    else:
+                        assert a == b, (q, a, b)
             print(f"[midscale] {q}: validated {len(got)} rows vs sqlite")
 
     print(json.dumps({"rows": args.rows, "batch": args.batch,

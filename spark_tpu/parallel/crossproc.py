@@ -82,18 +82,26 @@ def host_exchange_group_agg(session, df, svc: HostShuffleService,
                 "per-process dictionary CODES, which cannot merge across "
                 "processes — cast to a comparable type or aggregate "
                 "in-slice")
-    inner = plan.children[0]
-    while isinstance(inner, (L.SubqueryAlias, L.Project)):
-        inner = inner.children[0]
-    if isinstance(inner, L.Aggregate):
-        # the analyzer's distinct-agg expansion (Aggregate over Aggregate):
-        # running the inner dedup PER PROCESS would keep one copy of a
-        # value per process and double-count it in the merge — needs a
-        # two-hop exchange, which this helper does not do
-        raise ValueError(
-            "nested aggregation (e.g. the DISTINCT-aggregate expansion) "
-            "would dedup per process and double-count across them; "
-            "exchange the inner aggregation first")
+    # the child runs PER PROCESS on local rows, so any operator whose
+    # result depends on the GLOBAL multiset is wrong below this point:
+    # inner aggregates (incl. the DISTINCT expansion) double-count,
+    # distinct dedups per process, limits/samples draw per process,
+    # windows rank per process.  Scan the whole subtree — Filter/HAVING
+    # wrapping must not hide them.  (Joins are allowed: their non-local
+    # side must be a REPLICATED relation, identical in every process.)
+    from ..sql.window import WindowNode
+
+    def _reject_global_ops(node):
+        if isinstance(node, (L.Aggregate, L.Distinct, L.Limit, L.Sample)) \
+                or isinstance(node, WindowNode):
+            raise ValueError(
+                f"{type(node).__name__} below the cross-process exchange "
+                "would compute per-process over a partitioned input "
+                "(e.g. an inner DISTINCT dedup double-counts); exchange "
+                "that operator's input first")
+        for c in node.children:
+            _reject_global_ops(c)
+    _reject_global_ops(plan.children[0])
 
     # 1. THIS process's child rows → local partial state.  The child runs
     # on the INTERPRETED host path: each process holds different rows,

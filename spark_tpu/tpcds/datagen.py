@@ -103,7 +103,14 @@ def _time_dim() -> pd.DataFrame:
 def _items(rng, n) -> pd.DataFrame:
     sk = np.arange(1, n + 1)
     cat_id = rng.integers(1, 11, n)
-    class_id = rng.integers(1, 11, n)
+    # classes NEST within categories (3 per category), as in dsdgen's
+    # hierarchy — category and class are correlated, so conjunctive
+    # filters like q54's (i_category AND i_class) select real item sets.
+    # The raw draw keeps the SAME rng stream shape as the historical
+    # independent draw, so every downstream column (manufact, manager,
+    # colors...) and the fixed query parameters keyed to them survive.
+    class_raw = rng.integers(1, 11, n)
+    class_id = ((cat_id - 1) * 3 + class_raw % 3) % 10 + 1
     manufact = rng.integers(1, 101, n)
     brand_id = cat_id * 1000000 + class_id * 10000 + rng.integers(1, 100, n)
     manager = rng.integers(1, 101, n)
@@ -274,11 +281,69 @@ def _promotions(rng, n, n_items) -> pd.DataFrame:
     })
 
 
+class SkewDists:
+    """dsdgen-like marginals for the sales facts (VERDICT r3 item 7):
+
+    * Zipf(alpha) item/customer popularity over PERMUTED domains (hot
+      ids scattered, not clustered at low sks),
+    * a few hot stores,
+    * seasonal dates (holiday-quarter ramp + weekend lift),
+    * item-category price levels (price correlates with category).
+
+    Uniform generation remains the default (``skew=None``)."""
+
+    def __init__(self, rng, alpha, n_items, n_cust, n_store, date_n,
+                 item_cat_ids, date_moy, date_dow):
+        self.rng = rng
+        self._items = self._zipf(n_items, alpha)
+        self._custs = self._zipf(n_cust, alpha)
+        self._stores = self._zipf(n_store, max(alpha * 0.75, 0.5))
+        dow = date_dow[:date_n]
+        w = (1.0 + 1.5 * (date_moy[:date_n] >= 11)
+             + 0.3 * ((dow == 0) | (dow == 6)))   # 0=Sun, 6=Sat
+
+        self._date_w = w / w.sum()
+        self.date_n = date_n
+        # category price level: Books cheap → Jewelry dear, 0.6x..1.5x
+        self.price_mult = 0.6 + 0.1 * item_cat_ids.astype(np.float64)
+
+    def _zipf(self, domain_n, alpha):
+        ranks = np.arange(1, domain_n + 1, dtype=np.float64)
+        w = ranks ** -alpha
+        w /= w.sum()
+        perm = self.rng.permutation(domain_n)
+        return (w, perm)
+
+    def _draw(self, spec, n):
+        w, perm = spec
+        return (perm[self.rng.choice(len(w), size=n, p=w)] + 1
+                ).astype(np.int64)
+
+    def items(self, n):
+        return self._draw(self._items, n)
+
+    def customers(self, n):
+        return self._draw(self._custs, n)
+
+    def stores(self, n):
+        return self._draw(self._stores, n)
+
+    def dates(self, n):
+        return self.rng.choice(self.date_n, size=n, p=self._date_w)
+
+
 def _sales(rng, n, pre, date_n, n_items, n_cust, n_addr, n_cdemo, n_hdemo,
-           n_store, n_promo, with_ship=False, extra=None) -> pd.DataFrame:
+           n_store, n_promo, with_ship=False, extra=None,
+           dists: "SkewDists | None" = None) -> pd.DataFrame:
     """Generic sales fact; `pre` is the column prefix data ('ss'...)."""
     qty = rng.integers(1, 101, n)
+    # skewed draws happen up front; the UNIFORM path must draw item_sk at
+    # its historical position inside the dict below — the rng stream
+    # shape is load-bearing (fixed query parameters key to it)
+    item_sk = dists.items(n) if dists is not None else None
     wholesale = np.round(rng.uniform(1.0, 100.0, n), 2)
+    if dists is not None:
+        wholesale = np.round(wholesale * dists.price_mult[item_sk - 1], 2)
     list_price = np.round(wholesale * rng.uniform(1.0, 2.0, n), 2)
     sales_price = np.round(list_price * rng.uniform(0.2, 1.0, n), 2)
     ext_discount = np.round((list_price - sales_price) * qty, 2)
@@ -290,7 +355,8 @@ def _sales(rng, n, pre, date_n, n_items, n_cust, n_addr, n_cdemo, n_hdemo,
     net_paid = np.round(ext_sales - coupon, 2)
     net_paid_tax = np.round(net_paid + ext_tax, 2)
     profit = np.round(net_paid - ext_wholesale, 2)
-    sold_date = DATE0_SK + rng.integers(0, date_n, n)
+    sold_date = DATE0_SK + (dists.dates(n) if dists is not None
+                            else rng.integers(0, date_n, n))
 
     def null_some(arr, frac=0.04):
         a = arr.astype(object)
@@ -301,12 +367,17 @@ def _sales(rng, n, pre, date_n, n_items, n_cust, n_addr, n_cdemo, n_hdemo,
     base = {
         "sold_date_sk": null_some(sold_date),
         "sold_time_sk": rng.integers(0, 86400, n).astype(np.int64),
-        "item_sk": rng.integers(1, n_items + 1, n).astype(np.int64),
-        "customer_sk": null_some(rng.integers(1, n_cust + 1, n)),
+        "item_sk": (item_sk if item_sk is not None
+                    else rng.integers(1, n_items + 1, n).astype(np.int64)),
+        "customer_sk": null_some(
+            dists.customers(n) if dists is not None
+            else rng.integers(1, n_cust + 1, n)),
         "cdemo_sk": rng.integers(1, n_cdemo + 1, n).astype(np.int64),
         "hdemo_sk": rng.integers(1, n_hdemo + 1, n).astype(np.int64),
         "addr_sk": rng.integers(1, n_addr + 1, n).astype(np.int64),
-        "store_sk": null_some(rng.integers(1, n_store + 1, n)),
+        "store_sk": null_some(
+            dists.stores(n) if dists is not None
+            else rng.integers(1, n_store + 1, n)),
         "promo_sk": rng.integers(1, n_promo + 1, n).astype(np.int64),
         "ticket_number": np.arange(1, n + 1, dtype=np.int64),
         "quantity": qty.astype(np.int32),
@@ -322,9 +393,15 @@ def _sales(rng, n, pre, date_n, n_items, n_cust, n_addr, n_cdemo, n_hdemo,
     return base
 
 
-def generate(sf_rows: int = 40_000, seed: int = 20260729
-             ) -> Dict[str, pd.DataFrame]:
-    """All 24 tables; `sf_rows` sizes store_sales, other facts scale off it."""
+def generate(sf_rows: int = 40_000, seed: int = 20260729,
+             skew: "float | None" = None,
+             measure_null_frac: float = 0.0) -> Dict[str, pd.DataFrame]:
+    """All 24 tables; `sf_rows` sizes store_sales, other facts scale off it.
+
+    ``skew`` switches the fact marginals from uniform to dsdgen-like
+    (Zipf item/customer/store popularity, seasonal dates, category price
+    levels — see SkewDists); ``measure_null_frac`` additionally NULLs a
+    fraction of the price/quantity measures on the sales facts."""
     rng = np.random.default_rng(seed)
     n_items, n_cust, n_addr = 1000, 2000, 1000
     n_cdemo, n_hdemo, n_store, n_promo = 1920, 720, 12, 300
@@ -434,10 +511,20 @@ def generate(sf_rows: int = 40_000, seed: int = 20260729
         "cp_description": [f"catalog page {x}" for x in cp],
         "cp_type": np.array(["bi-annual", "quarterly", "monthly"])[cp % 3]})
 
+    # skewed fact marginals share one distribution set so cross-channel
+    # identities (hot items are hot EVERYWHERE) hold like dsdgen's
+    dists = None
+    if skew is not None:
+        dd = out["date_dim"]
+        dists = SkewDists(
+            rng, float(skew), n_items, n_cust, n_store, N_DAYS,
+            out["item"]["i_category_id"].to_numpy(),
+            dd["d_moy"].to_numpy(), dd["d_dow"].to_numpy())
+
     # ---- store_sales + store_returns -----------------------------------
     n_ss = sf_rows
     ss = _sales(rng, n_ss, "ss", N_DAYS, n_items, n_cust, n_addr, n_cdemo,
-                n_hdemo, n_store, n_promo)
+                n_hdemo, n_store, n_promo, dists=dists)
     out["store_sales"] = pd.DataFrame({
         "ss_sold_date_sk": ss["sold_date_sk"],
         "ss_sold_time_sk": ss["sold_time_sk"],
@@ -497,7 +584,7 @@ def generate(sf_rows: int = 40_000, seed: int = 20260729
     # ---- catalog_sales + catalog_returns -------------------------------
     n_cs = sf_rows // 2
     cs = _sales(rng, n_cs, "cs", N_DAYS, n_items, n_cust, n_addr, n_cdemo,
-                n_hdemo, n_store, n_promo)
+                n_hdemo, n_store, n_promo, dists=dists)
     ship_cost = np.round(np.asarray(cs["ext_sales_price"]) * 0.05, 2)
     out["catalog_sales"] = pd.DataFrame({
         "cs_sold_date_sk": cs["sold_date_sk"],
@@ -595,7 +682,7 @@ def generate(sf_rows: int = 40_000, seed: int = 20260729
     # ---- web_sales + web_returns ---------------------------------------
     n_ws = sf_rows // 4
     ws = _sales(rng, n_ws, "ws", N_DAYS, n_items, n_cust, n_addr, n_cdemo,
-                n_hdemo, n_store, n_promo)
+                n_hdemo, n_store, n_promo, dists=dists)
     wship_cost = np.round(np.asarray(ws["ext_sales_price"]) * 0.05, 2)
     out["web_sales"] = pd.DataFrame({
         "ws_sold_date_sk": ws["sold_date_sk"],
@@ -687,6 +774,24 @@ def generate(sf_rows: int = 40_000, seed: int = 20260729
         "inv_quantity_on_hand": rng.integers(0, 1000,
                                              n_inv).astype(np.int32),
     })
+
+    if measure_null_frac > 0.0:
+        # NULL densities on the price/quantity measures (dsdgen leaves
+        # sparse measures; aggregates must honor NULL-skipping at scale)
+        measures = {
+            "store_sales": ["ss_sales_price", "ss_ext_sales_price",
+                            "ss_quantity", "ss_net_profit"],
+            "catalog_sales": ["cs_quantity", "cs_sales_price"],
+            "web_sales": ["ws_sales_price", "ws_quantity"],
+        }
+        for tname, cols in measures.items():
+            pdf = out[tname]
+            n = len(pdf)
+            for c in cols:
+                mask = rng.random(n) < measure_null_frac
+                col = pdf[c].astype("float64").to_numpy(copy=True)
+                col[mask] = np.nan
+                pdf[c] = col
 
     # column order exactly per schema
     for name, cols in TABLES.items():

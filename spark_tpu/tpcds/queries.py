@@ -23,9 +23,10 @@ Dialect adaptations (documented per the harness contract in
   engine preserves qualifiers in CTE output schemas); q64 renames the
   date-dim instance's columns inside its derived table for the same
   reason;
-- q54 drops the i_class conjunct and extends the revenue window to 12
-  months (the scaled-down generator draws class and category
-  independently, so the conjunction selects ~2 customers).
+- q54 keeps its (i_category AND i_class) conjunction — classes nest
+  within categories in the generator, as in dsdgen ('pants' is one of
+  the Women classes at this parameterization) — and extends the revenue
+  window to 12 months (a scale adaptation that remains).
 
 ``RUNNABLE`` queries execute end-to-end; ``PENDING`` maps query name →
 the construct still missing.
@@ -1213,7 +1214,7 @@ WITH my_customers AS (
                ws_item_sk AS item_sk
         FROM web_sales) cs_or_ws_sales, item, date_dim, customer
   WHERE sold_date_sk = d_date_sk AND item_sk = i_item_sk
-    AND i_category = 'Women'
+    AND i_category = 'Women' AND i_class = 'pants'
     AND d_moy = 12 AND d_year = 1998
     AND c_customer_sk = cs_or_ws_sales.customer_sk),
 my_revenue AS (
