@@ -20,15 +20,25 @@ import sys
 from typing import List, Optional
 
 
+def _parse_conf_pair(pair: str):
+    if "=" not in pair:
+        raise SystemExit(f"--conf expects key=value, got {pair!r}")
+    return pair.split("=", 1)
+
+
+def _run_script(script: str, script_args) -> int:
+    sys.argv = [script] + list(script_args)
+    runpy.run_path(script, run_name="__main__")
+    return 0
+
+
 def _session(conf_pairs: List[str]):
     from spark_tpu.sql.session import SparkSession
     # --conf must flow through the BUILDER: SparkSession.__init__ reads
     # config (HBM budget, storage fraction) during construction
     b = SparkSession.builder.appName("spark-tpu-cli")
     for pair in conf_pairs or []:
-        if "=" not in pair:
-            raise SystemExit(f"--conf expects key=value, got {pair!r}")
-        k, v = pair.split("=", 1)
+        k, v = _parse_conf_pair(pair)
         b = b.config(k, v)
     return b.getOrCreate()
 
@@ -109,9 +119,82 @@ def cmd_submit(args) -> int:
     """Run a user script with sys.argv rewritten (SparkSubmit.runMain:
     the script builds its own session via SparkSession.builder)."""
     _session(args.conf)     # pre-warm the active session with --conf
-    sys.argv = [args.script] + list(args.script_args)
-    runpy.run_path(args.script, run_name="__main__")
-    return 0
+    return _run_script(args.script, args.script_args)
+
+
+def cmd_launch(args) -> int:
+    """Multi-process launcher (the SparkSubmit → Master/Worker role,
+    `deploy/SparkSubmit.scala:66` + `master/Master.scala:41`, collapsed
+    onto jax.distributed: no Master daemon — a coordinator address and a
+    process index are the entire control plane; docs/DEPLOY.md).
+
+    Modes:
+    * fan-out (no --process-id): spawn --processes local workers, each
+      re-entering this command with its own index — the local-cluster
+      dev mode;
+    * worker (--process-id given): export the cluster coordinates via
+      SPARK_TPU_* env and run the script, which joins by calling
+      ``init_cluster()`` with no arguments.  On a multi-host deployment
+      the operator (or the GKE JobSet) runs THIS mode once per host."""
+    import os
+    import socket
+    import subprocess
+
+    if args.process_id is None:
+        coord = args.coordinator
+        if coord is None:
+            # ephemeral-port probe: closed before process 0's coordinator
+            # rebinds it — a small TOCTOU window another process could
+            # steal the port in (kernels rarely reassign a just-released
+            # ephemeral port, and jax's coordinator sets SO_REUSEADDR);
+            # pass --coordinator explicitly on busy shared hosts
+            with socket.socket() as s:
+                s.bind(("localhost", 0))
+                coord = f"localhost:{s.getsockname()[1]}"
+        procs = []
+        for i in range(args.processes):
+            argv = [sys.executable, "-m", "spark_tpu.cli", "launch",
+                    "--coordinator", coord,
+                    "--processes", str(args.processes),
+                    "--process-id", str(i)]
+            for c in args.conf:
+                argv += ["--conf", c]
+            argv += [args.script] + list(args.script_args)
+            procs.append(subprocess.Popen(argv))
+        # any worker failing (incl. SIGNAL deaths, which report negative)
+        # fails the launch, and kills the siblings — otherwise survivors
+        # spin at the jax.distributed rendezvous for its full timeout
+        rc = 0
+        pending = set(procs)
+        while pending:
+            for pr in list(pending):
+                status = pr.poll()
+                if status is None:
+                    continue
+                pending.discard(pr)
+                if status != 0:
+                    rc = max(rc, abs(status))
+                    for other in pending:
+                        other.terminate()
+            if pending:
+                import time as _t
+                _t.sleep(0.1)
+        return rc
+
+    env_coord = args.coordinator
+    if env_coord is not None:
+        os.environ["SPARK_TPU_COORDINATOR"] = env_coord
+    if args.processes:
+        os.environ["SPARK_TPU_NUM_PROCESSES"] = str(args.processes)
+    os.environ["SPARK_TPU_PROCESS_ID"] = str(args.process_id)
+    # UNLIKE cmd_submit, no session pre-warm here: touching the XLA
+    # backend before the script's init_cluster() would make
+    # jax.distributed.initialize impossible.  --conf pairs ride the
+    # environment and apply when the script builds its session.
+    if args.conf:
+        pairs = ["=".join(_parse_conf_pair(p)) for p in args.conf]
+        os.environ["SPARK_TPU_LAUNCH_CONF"] = "\x1f".join(pairs)
+    return _run_script(args.script, args.script_args)
 
 
 def cmd_sql(args) -> int:
@@ -171,6 +254,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     ps.add_argument("script")
     ps.add_argument("script_args", nargs=argparse.REMAINDER)
     ps.set_defaults(fn=cmd_submit)
+
+    pl = sub.add_parser(
+        "launch", help="multi-process launcher (spark-submit --deploy)")
+    pl.add_argument("--processes", type=int, default=1,
+                    help="total processes in the cluster")
+    pl.add_argument("--coordinator", default=None,
+                    help="host:port of process 0 (auto for local fan-out)")
+    pl.add_argument("--process-id", type=int, default=None,
+                    help="this process's index; omit to fan out locally")
+    pl.add_argument("--conf", action="append", default=[])
+    pl.add_argument("script")
+    pl.add_argument("script_args", nargs=argparse.REMAINDER)
+    pl.set_defaults(fn=cmd_launch)
 
     pq = sub.add_parser("sql", help="SQL shell (spark-sql)")
     pq.add_argument("-e", help="execute one statement and exit")

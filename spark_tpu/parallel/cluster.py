@@ -85,6 +85,16 @@ def init_cluster(coordinator_address: Optional[str] = None,
     before initialize — backend init makes jax.distributed.initialize
     impossible.  Already-initialized state is detected via the
     distributed client, which is backend-free."""
+    if coordinator_address is None and num_processes is None \
+            and process_id is None:
+        # launcher contract (bin/spark-tpu-launch, docs/DEPLOY.md):
+        # workers receive their coordinates via environment — the
+        # spark-submit → executor handoff, without a Master process
+        coordinator_address = os.environ.get("SPARK_TPU_COORDINATOR")
+        if os.environ.get("SPARK_TPU_NUM_PROCESSES"):
+            num_processes = int(os.environ["SPARK_TPU_NUM_PROCESSES"])
+        if os.environ.get("SPARK_TPU_PROCESS_ID"):
+            process_id = int(os.environ["SPARK_TPU_PROCESS_ID"])
     if coordinator_address or num_processes not in (None, 1):
         from jax._src import distributed as _dist
         if getattr(_dist.global_state, "client", None) is None:

@@ -257,10 +257,23 @@ class Builder:
         return self
 
     def getOrCreate(self) -> "SparkSession":
+        import os
+        opts = dict(self._options)
         if SparkSession._active is None:
-            SparkSession._active = SparkSession(C.Conf(self._options))
+            # --conf pairs handed down by bin/spark-tpu-launch ride the
+            # environment (the launcher must not build a session itself:
+            # backend init would break the worker's init_cluster).  They
+            # SEED the session only — re-applying them on later
+            # getOrCreate() calls would silently revert runtime
+            # conf.set overrides.
+            launch_conf = os.environ.get("SPARK_TPU_LAUNCH_CONF")
+            if launch_conf:
+                for pair in launch_conf.split("\x1f"):
+                    k, _, v = pair.partition("=")
+                    opts.setdefault(k, v)
+            SparkSession._active = SparkSession(C.Conf(opts))
         else:
-            for k, v in self._options.items():
+            for k, v in opts.items():
                 SparkSession._active.conf.set(k, v)
         return SparkSession._active
 
