@@ -152,19 +152,28 @@ def cmd_launch(args) -> int:
                 s.bind(("localhost", 0))
                 coord = f"localhost:{s.getsockname()[1]}"
         procs = []
-        for i in range(args.processes):
-            argv = [sys.executable, "-m", "spark_tpu.cli", "launch",
-                    "--coordinator", coord,
-                    "--processes", str(args.processes),
-                    "--process-id", str(i)]
-            for c in args.conf:
-                argv += ["--conf", c]
-            argv += [args.script] + list(args.script_args)
-            procs.append(subprocess.Popen(argv))
+        try:
+            for i in range(args.processes):
+                argv = [sys.executable, "-m", "spark_tpu.cli", "launch",
+                        "--coordinator", coord,
+                        "--processes", str(args.processes),
+                        "--process-id", str(i)]
+                for c in args.conf:
+                    argv += ["--conf", c]
+                argv += [args.script] + list(args.script_args)
+                procs.append(subprocess.Popen(argv))
+        except Exception:
+            # partial spawn: the already-started workers would spin at
+            # the rendezvous for jax's whole init timeout
+            for pr in procs:
+                pr.terminate()
+            raise
         # any worker failing (incl. SIGNAL deaths, which report negative)
-        # fails the launch, and kills the siblings — otherwise survivors
-        # spin at the jax.distributed rendezvous for its full timeout
-        rc = 0
+        # fails the launch and kills the siblings — otherwise survivors
+        # spin at the jax.distributed rendezvous for its full timeout.
+        # The REPORTED code is the FIRST failure's (the cause), not the
+        # SIGTERM this launcher then sends to the others.
+        first_rc = 0
         pending = set(procs)
         while pending:
             for pr in list(pending):
@@ -172,14 +181,15 @@ def cmd_launch(args) -> int:
                 if status is None:
                     continue
                 pending.discard(pr)
-                if status != 0:
-                    rc = max(rc, abs(status))
+                if status != 0 and first_rc == 0:
+                    first_rc = 128 + abs(status) if status < 0 \
+                        else status
                     for other in pending:
                         other.terminate()
             if pending:
                 import time as _t
                 _t.sleep(0.1)
-        return rc
+        return first_rc
 
     env_coord = args.coordinator
     if env_coord is not None:

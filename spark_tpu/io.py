@@ -581,6 +581,52 @@ def file_column_stats(rel: L.FileRelation) -> Dict[str, dict]:
     return out
 
 
+_NDV_CACHE: Dict[Any, Dict[str, float]] = {}
+
+
+def file_column_ndv(rel: L.FileRelation, columns) -> Dict[str, float]:
+    """Estimated distinct-value counts for ``columns`` (the NDV half of
+    the reference's CBO statistics, `statsEstimation/` — gathered there
+    by ANALYZE TABLE, here by a one-row-group sample at plan time).
+
+    Estimator: distinct count over the first row group of the first
+    file; if the sample's distinct ratio is saturated (<90% unique) the
+    domain is assumed reached (dimension keys, enums), otherwise the
+    count scales linearly with the table (near-unique keys).  Memoized
+    per (files, mtimes, columns)."""
+    if rel.fmt != "parquet":
+        return {}
+    try:
+        files = _resolve_paths(rel.paths)
+    except AnalysisException:
+        return {}
+    # ONE cache entry per file set, extended per newly-requested column —
+    # reorder_joins probes one key column at a time, and per-(files,
+    # column) keys would re-open footers for every probe
+    key = tuple((f, os.path.getmtime(f)) for f in files)
+    cached = _NDV_CACHE.setdefault(key, {})
+    missing = [c for c in columns if c not in cached]
+    if not missing:
+        return cached
+    import pyarrow.parquet as pq
+    try:
+        pf = pq.ParquetFile(files[0])
+        present = [c for c in missing if c in pf.schema_arrow.names]
+        if present:
+            sample = pf.read_row_group(0, columns=present)
+            total = file_row_count(rel) or sample.num_rows  # memoized sum
+            n = max(sample.num_rows, 1)
+            for c in present:
+                uniq = len(sample.column(c).unique())
+                if uniq < 0.9 * n:
+                    cached[c] = float(uniq)            # saturated domain
+                else:
+                    cached[c] = float(uniq) * total / n  # near-unique key
+    except Exception:
+        pass
+    return cached
+
+
 def scan_file_batches(rel: L.FileRelation, batch_rows: int):
     """Yield host ColumnBatches of ≤ batch_rows rows each.
 

@@ -392,7 +392,33 @@ def push_filter_through_join(node: LogicalPlan) -> LogicalPlan:
 
 def _collect_cross_inner(node: LogicalPlan, rels: List[LogicalPlan],
                          conds: List[Expression]) -> None:
-    """Flatten a tree of cross/inner joins into (relations, conjuncts)."""
+    """Flatten a tree of cross/inner joins into (relations, conjuncts).
+
+    Filters INSIDE the chain are hoisted into the conjunct pool — the
+    pushdown rules run before reorder_joins in each batch iteration and
+    park conjuncts on inner joins/relations, which would otherwise hide
+    the chain (a Filter-wrapped join reads as ONE relation and a 3-way
+    chain shrinks below the reorder threshold).  Hoisted single-relation
+    conjuncts still drive effective_rows selectivity and re-attach (or
+    re-push next iteration) after ordering."""
+    if isinstance(node, Filter) and isinstance(
+            node.children[0], (Join, Filter)):
+        conds.extend(split_conjuncts(node.condition))
+        _collect_cross_inner(node.children[0], rels, conds)
+        return
+    if isinstance(node, Filter):
+        base = node.children[0]
+        while isinstance(base, SubqueryAlias):
+            base = base.children[0]
+        from .logical import FileRelation
+        if isinstance(base, FileRelation):
+            # hoist so footer-stat selectivity feeds the ordering; the
+            # conjunct re-attaches at this relation's join (or on top)
+            conds.extend(split_conjuncts(node.condition))
+            rels.append(node.children[0])
+            return
+        rels.append(node)
+        return
     if isinstance(node, Join) and node.how in ("inner", "cross") \
             and not node.using:
         if node.on is not None:
@@ -554,26 +580,60 @@ def reorder_joins(node: LogicalPlan) -> LogicalPlan:
                 est *= filter_selectivity(mine, base_rel)
         return est
 
+    def key_ndv(i: int, key_col: str) -> float:
+        """NDV of a candidate's join-key column (sampled parquet stats;
+        falls back to the relation's row estimate — a PK assumption)."""
+        base_rel = rels[i]
+        while isinstance(base_rel, SubqueryAlias):
+            base_rel = base_rel.children[0]
+        from .logical import FileRelation
+        if isinstance(base_rel, FileRelation):
+            from ..io import file_column_ndv
+            ndv = file_column_ndv(base_rel, [key_col]).get(key_col)
+            if ndv:
+                return ndv
+        return max(float(rows_estimate(rels[i])), 1.0)
+
     base = max(range(len(rels)), key=effective_rows)
     joined = rels[base]
     joined_cols = set(schemas[base])
     remaining = [i for i in range(len(rels)) if i != base]
     unused = list(conds)
+    cur_rows = max(effective_rows(base), 1.0)
     made_progress = base != 0
     while remaining:
-        pick = None
+        # among CONNECTED candidates, estimate each join's output with
+        # the textbook equi-join cardinality |L||R| / max(ndv(keys)) and
+        # take the smallest — CostBasedJoinReorder-lite.  On a star
+        # schema this orders the dimensions most-selective-first around
+        # the fact base (the StarSchemaDetection role falls out: dims
+        # join on their near-PK keys, so selective filtered dims shrink
+        # the running cardinality earliest).
+        best = None                  # (est_out, idx)
         for idx in remaining:
             cand_cols = schemas[idx]
-            for c_ in unused:
-                refs = c_.references()
-                if (refs & joined_cols) and (refs & cand_cols) \
-                        and refs <= (joined_cols | cand_cols):
-                    pick = idx
-                    break
-            if pick is not None:
-                break
-        if pick is None:
+            connecting = [
+                c_ for c_ in unused
+                if (c_.references() & joined_cols)
+                and (c_.references() & cand_cols)
+                and c_.references() <= (joined_cols | cand_cols)
+            ]
+            if not connecting:
+                continue
+            cand_rows = max(effective_rows(idx), 1.0)
+            ndv = 1.0
+            for c_ in connecting:
+                for col in (c_.references() & cand_cols):
+                    ndv = max(ndv, key_ndv(idx, col))
+            est_out = cur_rows * cand_rows / ndv
+            if best is None or est_out < best[0]:
+                best = (est_out, idx)
+        if best is not None:
+            pick = best[1]
+            cur_rows = max(best[0], 1.0)
+        else:
             pick = remaining[0]      # genuinely unconnected: cross join
+            cur_rows *= max(effective_rows(pick), 1.0)
         cand_cols = schemas[pick]
         new_cols = joined_cols | cand_cols
         attach = [c_ for c_ in unused if c_.references() <= new_cols

@@ -67,15 +67,15 @@ def _clear_jax_caches_between_modules():
     kernel; dropping compiled programs between modules keeps the working
     set bounded (the persistent on-disk cache makes recompiles cheap).
 
-    Root-cause picture (for anyone running a different subset): the
-    crash reproduces only after O(1000) distinct compiled executables
-    are alive in one process, with the fault inside generated XLA:CPU
-    code — consistent with jitted-code memory exhaustion / reuse in the
-    CPU client's code cache rather than anything in this engine (pure
-    Python + numpy/jax; no native extension of ours is on the stack).
-    It does NOT reproduce on small subsets, under the TPU backend, or
-    when caches are cleared per module.  If you run a custom large
-    subset WITHOUT this conftest (e.g. via a bare unittest runner),
-    call jax.clear_caches() periodically or expect a late segfault."""
+    ROOT CAUSE (confirmed via the engine-free reproducer
+    tests/repro_xla_cpu_segfault.py, 2026-07-31): XLA:CPU's LLVM JIT
+    code arena exhausts after ~2,250 live executables —
+    `execution_engine.cc:54 LLVM compilation error: Cannot allocate
+    memory` repeats, the failure is not surfaced to Python, and the
+    next executable use SIGSEGVs (rc=139).  Pure jax + numpy; no
+    spark_tpu code involved, so this fixture is a workaround for an
+    upstream XLA:CPU condition, not a mask over an engine bug.  If you
+    run a custom large subset WITHOUT this conftest, call
+    jax.clear_caches() periodically or expect the late segfault."""
     yield
     jax.clear_caches()

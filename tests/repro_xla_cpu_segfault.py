@@ -18,6 +18,17 @@ the fault lies in the XLA:CPU client's code handling, not in spark_tpu.
 The engine-side mitigation (bounding live executables per module) lives
 in tests/conftest.py and is therefore a WORKAROUND for an upstream
 condition, not a mask over an engine bug.
+
+CONFIRMED (2026-07-31, this image): rc=139 (SIGSEGV) after ~2,250 live
+executables, immediately preceded by repeated
+
+    execution_engine.cc:54] LLVM compilation error: Cannot allocate memory
+
+from XLA:CPU's JIT engine — the generated-code allocation arena
+exhausts, the failed compilation is not surfaced as a Python error, and
+the next executable use faults.  Root cause: unhandled LLVM JIT
+code-memory exhaustion in the XLA:CPU client under executable
+accumulation.  The same workload with ``--clear-every 500`` completes.
 """
 
 import os

@@ -1,0 +1,117 @@
+"""CBO depth: sampled NDV statistics + cost-based join ordering
+(VERDICT r3 missing #7; `statsEstimation/`, `CostBasedJoinReorder.scala`,
+`StarSchemaDetection.scala` roles)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_tpu.sql.functions as F
+
+
+@pytest.fixture()
+def star(spark, tmp_path):
+    """A small star: fact(20k) + a clean PK dim + an EXPLODING dim
+    (1000 rows but only 5 distinct join keys — joining it early
+    multiplies the fact 200x)."""
+    rng = np.random.default_rng(7)
+    n = 4096
+    fact = pd.DataFrame({
+        "k_good": rng.integers(0, 500, n),
+        "k_bad": rng.integers(0, 5, n),
+        "v": rng.integers(0, 100, n),
+    })
+    dim_good = pd.DataFrame({
+        "g_k": np.arange(500, dtype=np.int64),
+        "g_tag": np.arange(500, dtype=np.int64) % 7,
+    })
+    dim_bad = pd.DataFrame({
+        "b_k": rng.integers(0, 5, 60).astype(np.int64),
+        "b_w": np.arange(60, dtype=np.int64),
+    })
+    paths = {}
+    for name, pdf in [("fact", fact), ("dim_good", dim_good),
+                      ("dim_bad", dim_bad)]:
+        p = str(tmp_path / f"{name}.parquet")
+        pdf.to_parquet(p, index=False)
+        paths[name] = p
+        spark.read.parquet(p).createOrReplaceTempView(name)
+    return spark, fact, dim_good, dim_bad
+
+
+def test_ndv_estimates(spark, tmp_path):
+    from spark_tpu.io import file_column_ndv
+    from spark_tpu.sql import logical as L
+    rng = np.random.default_rng(5)
+    pdf = pd.DataFrame({
+        "unique_id": np.arange(50_000, dtype=np.int64),
+        "enum": rng.integers(0, 12, 50_000),
+    })
+    p = str(tmp_path / "nd.parquet")
+    pdf.to_parquet(p, index=False, row_group_size=8192)
+    rel = spark.read.parquet(p)._plan
+    assert isinstance(rel, L.FileRelation)
+    ndv = file_column_ndv(rel, ["unique_id", "enum", "missing"])
+    assert 10 <= ndv["enum"] <= 14                       # saturated domain
+    assert 25_000 <= ndv["unique_id"] <= 100_000         # scales to total
+    assert "missing" not in ndv
+
+
+def _join_order(spark, sql):
+    """Names of base relations in left-deep join order of the optimized
+    plan (leftmost/base first)."""
+    from spark_tpu.sql import logical as L
+    from spark_tpu.sql.planner import QueryExecution
+    plan = QueryExecution(spark, spark.sql(sql)._plan).optimized
+    order = []
+
+    def walk(n):
+        if isinstance(n, L.Join):
+            walk(n.children[0])
+            walk(n.children[1])
+        elif isinstance(n, L.FileRelation):
+            path = n.paths[0] if isinstance(n.paths, list) else n.paths
+            order.append(path.rsplit("/", 1)[-1].split(".")[0])
+        else:
+            for c in n.children:
+                walk(c)
+    walk(plan)
+    return order
+
+
+def test_join_reorder_prefers_low_fanout_dim(star):
+    spark, fact, dim_good, dim_bad = star
+    sql = """
+        SELECT g_tag, SUM(v) AS s, COUNT(*) AS c
+        FROM fact, dim_bad, dim_good
+        WHERE k_bad = b_k AND k_good = g_k
+        GROUP BY g_tag ORDER BY g_tag
+    """
+    order = _join_order(spark, sql)
+    # base = fact (largest); the clean PK dim must attach BEFORE the
+    # 200x-fanout dim regardless of FROM-clause order
+    assert order[0] == "fact", order
+    assert order.index("dim_good") < order.index("dim_bad"), order
+
+    got = [(r.g_tag, r.s, r.c) for r in spark.sql(sql).collect()]
+    joined = fact.merge(dim_bad, left_on="k_bad", right_on="b_k") \
+                 .merge(dim_good, left_on="k_good", right_on="g_k")
+    exp = joined.groupby("g_tag").agg(s=("v", "sum"), c=("v", "count"))
+    assert got == [(int(t), int(r.s), int(r.c))
+                   for t, r in exp.sort_index().iterrows()]
+
+
+def test_filtered_dim_attaches_first(star):
+    """A dim filtered to a sliver (by footer stats) beats an unfiltered
+    one — selective dims shrink the running cardinality earliest."""
+    spark, *_ = star
+    sql = """
+        SELECT SUM(v) AS s
+        FROM fact, dim_bad, dim_good
+        WHERE k_bad = b_k AND k_good = g_k AND b_w < 3
+    """
+    order = _join_order(spark, sql)
+    assert order[0] == "fact", order
+    # dim_bad filtered to ~3 of 1000 rows: est out = cur*3/5 < cur,
+    # so it now attaches before dim_good (est cur*1000/1000 = cur)
+    assert order.index("dim_bad") < order.index("dim_good"), order

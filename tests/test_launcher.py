@@ -43,8 +43,11 @@ def test_launch_fanout_two_workers(tmp_path):
     """ % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    env["PYTHONPATH"] = os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # APPEND to PYTHONPATH: overwriting would drop the axon site dir
+    # (memory: axon-tpu-environment-gotchas)
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     r = subprocess.run(
         [sys.executable, "-m", "spark_tpu.cli", "launch",
          "--processes", "2", "--conf", "spark.app.name=launched",

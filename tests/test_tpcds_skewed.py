@@ -69,6 +69,21 @@ def test_skew_actually_skews():
     assert u["store_sales"]["ss_sales_price"].isna().mean() == 0.0
 
 
+#: queries whose OUTPUT columns are ROUND(ratio, 2) expressions: their
+#: half-ties legitimately land on different cents across engines (the
+#: tie direction depends on the binary neighborhood of x.xx5).  The
+#: allowance is per-query and one cent — price/min/max/sum columns
+#: elsewhere stay exact, so a wrong rounding MODE still fails broadly.
+_ROUND2_TIE_OK = {"q78"}
+
+
+def _round2_tie(a: float, b: float, qname: str) -> bool:
+    return (qname in _ROUND2_TIE_OK
+            and abs(a - b) <= 0.01 + 1e-9
+            and abs(a * 100 - round(a * 100)) < 1e-6
+            and abs(b * 100 - round(b * 100)) < 1e-6)
+
+
 def _compare(got, exp, qname):
     got = sorted((tuple(_norm(v) for v in r) for r in got), key=_key)
     exp = sorted((tuple(_norm(v) for v in r) for r in exp), key=_key)
@@ -77,7 +92,8 @@ def _compare(got, exp, qname):
     for i, (g, e) in enumerate(zip(got, exp)):
         for j, (a, b) in enumerate(zip(g, e)):
             if isinstance(a, float) and isinstance(b, float):
-                assert math.isclose(a, b, rel_tol=1e-6, abs_tol=1e-6), \
+                assert math.isclose(a, b, rel_tol=1e-6, abs_tol=1e-6) \
+                    or _round2_tie(a, b, qname), \
                     f"{qname} row {i} col {j}: {a} != {b}"
             else:
                 assert a == b, f"{qname} row {i} col {j}: {a!r} != {b!r}"
