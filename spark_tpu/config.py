@@ -176,6 +176,15 @@ JOIN_OUTPUT_FACTOR = conf("spark.sql.join.outputCapacityFactor").doc(
     "capacity; overflow is detected and reported (dynamic-shape escape hatch)."
 ).float(1.0)
 
+JOIN_OUTPUT_MAX_ROWS = conf("spark.sql.join.maxOutputRows").doc(
+    "Upper bound on an ADAPTIVELY GROWN join output allocation (probe "
+    "capacity x grown factor, in rows): beyond it the query fails with "
+    "an actionable error instead of attempting an allocation that "
+    "exhausts memory (hot-key fanout joins belong on the out-of-core "
+    "grace path).  A small factor on a big batch and a huge factor on a "
+    "tiny batch are both fine — absolute size is what kills."
+).int(1 << 27)
+
 EXCHANGE_SKEW_FACTOR = conf("spark.sql.exchange.skewFactor").doc(
     "Per-destination bucket capacity of an all_to_all exchange as a multiple "
     "of the even split (capacity/num_shards); overflow detected at runtime."

@@ -32,7 +32,7 @@ from ..sql.logical import (
 )
 from ..sql.planner import (
     ADAPT_MAX_RETRIES, Planner, PlannedQuery, _slice_to_host,
-    grow_capacity_factor,
+    check_factor_cap, grow_capacity_factor,
 )
 from . import dist as D
 from .mesh import DATA_AXIS, get_mesh, mesh_shards
@@ -243,6 +243,8 @@ class DistributedExecution:
                 skew = grow_capacity_factor(base_skew, ex_ratio)
             if join_ratio > 0.0:
                 jf = grow_capacity_factor(base_jf, join_ratio)
+                check_factor_cap(jf, self._last_probe_rows, self.session,
+                                 "distributed join")
             _log.warning(
                 "capacity overflow (exchange %.0f%%, join %.0f%%); "
                 "replanning with skew=%s join_factor=%s",
@@ -292,6 +294,8 @@ class DistributedExecution:
             fn = jax.jit(wrapped)
             self.session._jit_cache[key] = fn
 
+        self._last_probe_rows = max((b.capacity for b in pq.leaves),
+                                    default=1)
         dev_leaves = tuple(self._shard_leaf(b) for b in pq.leaves)
         result, n_rows, ex_r, join_r = fn(dev_leaves)
         ex_ratio = float(np.asarray(ex_r))

@@ -42,6 +42,28 @@ def grow_capacity_factor(base: float, ratio: float) -> float:
     return base * max(2.0, (1.0 + ratio) * 1.25)
 
 
+def check_factor_cap(factor: float, probe_rows: int, session,
+                     where: str = "join") -> None:
+    """ONE guard for every adaptive join-factor growth site: an output
+    allocation of factor x probe capacity beyond
+    spark.sql.join.maxOutputRows means the join fans out into something
+    that would exhaust memory long before the retry loop gives up (the
+    q14-under-skew failure asked XLA for ~275 GB) — fail with the
+    actionable story instead.  The bound is ABSOLUTE rows: a huge factor
+    on a tiny batch (grace-join chunk skew) is fine."""
+    cap = session.conf.get(C.JOIN_OUTPUT_MAX_ROWS)
+    est = factor * max(probe_rows, 1)
+    if est > cap:
+        raise RuntimeError(
+            f"{where} output needs ~{est:,.0f} rows of static capacity "
+            f"(factor {factor:.0f}x over {probe_rows:,} probe rows; > "
+            f"{C.JOIN_OUTPUT_MAX_ROWS.key}={cap}): the join fans out too "
+            "much for eager in-memory execution.  Route it out-of-core "
+            f"(file-backed inputs larger than {C.SCAN_MAX_BATCH_ROWS.key} "
+            "stream through the grace-join stage runner), reduce the "
+            "hot-key fanout, or raise the cap explicitly")
+
+
 def _overflow_ratio(flags: List[int], caps: List[int]) -> float:
     """Worst lost-rows / static-capacity ratio across all overflow flags.
 
@@ -489,7 +511,8 @@ class QueryExecution:
                 raise RuntimeError(
                     f"join output still overflows after {attempt} adaptive "
                     f"retries (factors {factors}); raise "
-                    f"{C.JOIN_OUTPUT_FACTOR.key} explicitly")
+                    f"{C.JOIN_OUTPUT_FACTOR.key} explicitly (growth is "
+                    f"bounded by {C.JOIN_OUTPUT_MAX_ROWS.key})")
             # grow ONLY the joins that overflowed (positional): a chained
             # plan must not compound one hot join's factor into every join
             base_f = self.session.conf.get(C.JOIN_OUTPUT_FACTOR)
@@ -498,10 +521,12 @@ class QueryExecution:
                 else [None] * len(join_ratios)
             while len(cur) < len(join_ratios):
                 cur.append(None)
+            probe_rows = max((b.capacity for b in pq.leaves), default=1)
             for i, r in enumerate(join_ratios):
                 if r > 0:
                     prev = cur[i] if cur[i] is not None else base_f
                     cur[i] = grow_capacity_factor(prev, r)
+                    check_factor_cap(cur[i], probe_rows, self.session)
             factors = cur
             _log.warning(
                 "join output overflowed its static capacity by %.0f%%; "

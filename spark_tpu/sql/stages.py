@@ -360,11 +360,14 @@ class _MappedStream(BatchStream):
             while len(cur) < n_joins:
                 cur.append(None)
             ji = 0
+            from .planner import check_factor_cap
             for f, c, k in zip(int_flags, caps, kinds):
                 if k == "join":
                     if f > 0:
                         prev = cur[ji] if cur[ji] is not None else base_f
                         cur[ji] = grow_capacity_factor(prev, f / max(c, 1))
+                        check_factor_cap(cur[ji], b.capacity, self.session,
+                                         "streamed join")
                     ji += 1
             self._factors = cur
             _log.warning("streamed step join overflow; recompiling with "
@@ -373,7 +376,8 @@ class _MappedStream(BatchStream):
             jstep, extra, meta = self._compile(b, phys_wrap)
         raise RuntimeError(
             "streamed join output still overflows after 6 adaptive "
-            f"retries; raise {C.JOIN_OUTPUT_FACTOR.key} explicitly")
+            f"retries; raise {C.JOIN_OUTPUT_FACTOR.key} explicitly "
+            f"(growth is bounded by {C.JOIN_OUTPUT_MAX_ROWS.key})")
 
     def batches(self) -> Iterator[ColumnBatch]:
         compiled = None

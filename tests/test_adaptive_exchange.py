@@ -211,3 +211,25 @@ def test_adaptive_off_falls_back_to_static(dspark):
         assert out == {int(k): int(v) for k, v in exp.items()}
     finally:
         dspark.conf.set(C.ADAPTIVE_ENABLED.key, str(old))
+
+
+def test_join_output_cap_is_actionable(spark):
+    """A hot-key fanout join whose adaptively grown output allocation
+    explodes past the ABSOLUTE row bound must fail with the out-of-core
+    guidance, not attempt the allocation (the q14-under-skew failure
+    mode: a 15,000x factor asked XLA for hundreds of GB)."""
+    rng = np.random.default_rng(11)
+    n = 4096
+    left = spark.createDataFrame({
+        "k": np.zeros(n, dtype=np.int64),       # ONE key both sides
+        "v": rng.integers(0, 9, n)})
+    right = spark.createDataFrame({
+        "k": np.zeros(n, dtype=np.int64),
+        "w": rng.integers(0, 9, n)})
+    old = spark.conf.get(C.JOIN_OUTPUT_MAX_ROWS)
+    spark.conf.set(C.JOIN_OUTPUT_MAX_ROWS.key, str(64 * 1024))
+    try:
+        with pytest.raises(RuntimeError, match="out-of-core|fans out"):
+            left.join(right, on="k").agg(F.count("*").alias("c")).collect()
+    finally:
+        spark.conf.set(C.JOIN_OUTPUT_MAX_ROWS.key, str(old))
