@@ -32,7 +32,7 @@ from ..sql.logical import (
 )
 from ..sql.planner import (
     ADAPT_MAX_RETRIES, Planner, PlannedQuery, _slice_to_host,
-    check_factor_cap, grow_capacity_factor,
+    check_planned_join_capacities, grow_capacity_factor,
 )
 from . import dist as D
 from .mesh import DATA_AXIS, get_mesh, mesh_shards
@@ -223,8 +223,10 @@ class DistributedExecution:
         adaptation (which coalesces partitions; here capacities grow)."""
         base_key = f"dist{self.n}:adapt:" + optimized.tree_string()
         skew, jf = self.session._adapted_factors.get(base_key, (None, None))
+        grew = False
         for attempt in range(self.MAX_ADAPT + 1):
-            result, ex_ratio, join_ratio = self._run_once(optimized, skew, jf)
+            result, ex_ratio, join_ratio = self._run_once(
+                optimized, skew, jf, check_caps=grew)
             if ex_ratio <= 0.0 and join_ratio <= 0.0:
                 if skew is not None or jf is not None:
                     self.session._adapted_factors[base_key] = (skew, jf)
@@ -243,19 +245,25 @@ class DistributedExecution:
                 skew = grow_capacity_factor(base_skew, ex_ratio)
             if join_ratio > 0.0:
                 jf = grow_capacity_factor(base_jf, join_ratio)
-                check_factor_cap(jf, self._last_probe_rows, self.session,
-                                 "distributed join")
+                grew = True
             _log.warning(
                 "capacity overflow (exchange %.0f%%, join %.0f%%); "
                 "replanning with skew=%s join_factor=%s",
                 ex_ratio * 100, join_ratio * 100, skew, jf)
 
     def _run_once(self, optimized: LogicalPlan, skew: Optional[float],
-                  jf: Optional[float]) -> Tuple[ColumnBatch, float, float]:
+                  jf: Optional[float], check_caps: bool = False
+                  ) -> Tuple[ColumnBatch, float, float]:
         planner = DistributedPlanner(self.session, self.n,
                                      skew_override=skew,
                                      join_factor_override=jf)
         pq = planner.plan(optimized)
+        if check_caps:
+            # exact per-join allocation guard after growth in THIS
+            # execution (attributes the violation to the join owning the
+            # buffer); cached factors already proved they fit
+            check_planned_join_capacities(pq, self.session,
+                                          "distributed join")
         key = f"dist{self.n}:" + pq.physical.key()
 
         fn = self.session._jit_cache.get(key)
@@ -294,8 +302,6 @@ class DistributedExecution:
             fn = jax.jit(wrapped)
             self.session._jit_cache[key] = fn
 
-        self._last_probe_rows = max((b.capacity for b in pq.leaves),
-                                    default=1)
         dev_leaves = tuple(self._shard_leaf(b) for b in pq.leaves)
         result, n_rows, ex_r, join_r = fn(dev_leaves)
         ex_ratio = float(np.asarray(ex_r))

@@ -42,26 +42,42 @@ def grow_capacity_factor(base: float, ratio: float) -> float:
     return base * max(2.0, (1.0 + ratio) * 1.25)
 
 
+class JoinFanoutError(RuntimeError):
+    """An adaptive join-capacity growth asked for an output buffer beyond
+    ``spark.sql.join.maxOutputRows``.  Typed so the stage builder can
+    catch it and re-route the offending join through the grace spill
+    path (where per-bucket capacities stay small) instead of dying."""
+
+
+def _fanout_error(where: str, est_rows: float, factor: float,
+                  probe_rows: int, cap: int) -> JoinFanoutError:
+    """The ONE failure message for every fanout guard, so the guidance
+    cannot drift between the eager, streamed and distributed sites."""
+    return JoinFanoutError(
+        f"{where} output needs ~{est_rows:,.0f} rows of static capacity "
+        f"(factor {factor:.2f}x over {probe_rows:,} probe rows; > "
+        f"{C.JOIN_OUTPUT_MAX_ROWS.key}={cap}): the join fans out too "
+        "much for eager in-memory execution.  Route it out-of-core "
+        f"(file-backed inputs larger than {C.SCAN_MAX_BATCH_ROWS.key} "
+        "stream through the grace-join stage runner), reduce the "
+        "hot-key fanout, or raise the cap explicitly")
+
+
 def check_factor_cap(factor: float, probe_rows: int, session,
                      where: str = "join") -> None:
-    """ONE guard for every adaptive join-factor growth site: an output
-    allocation of factor x probe capacity beyond
-    spark.sql.join.maxOutputRows means the join fans out into something
-    that would exhaust memory long before the retry loop gives up (the
-    q14-under-skew failure asked XLA for ~275 GB) — fail with the
-    actionable story instead.  The bound is ABSOLUTE rows: a huge factor
-    on a tiny batch (grace-join chunk skew) is fine."""
+    """Fanout guard for growth sites where the probe capacity is known
+    directly (the streamed step passes each join's OWN static probe base;
+    planned queries use ``check_planned_join_capacities`` instead): an
+    output allocation beyond spark.sql.join.maxOutputRows means the join
+    fans out into something that would exhaust memory long before the
+    retry loop gives up (the q14-under-skew failure asked XLA for
+    ~275 GB) — fail with the actionable story instead.  The bound is
+    ABSOLUTE rows: a huge factor on a tiny batch (grace-join chunk skew)
+    is fine."""
     cap = session.conf.get(C.JOIN_OUTPUT_MAX_ROWS)
     est = factor * max(probe_rows, 1)
     if est > cap:
-        raise RuntimeError(
-            f"{where} output needs ~{est:,.0f} rows of static capacity "
-            f"(factor {factor:.0f}x over {probe_rows:,} probe rows; > "
-            f"{C.JOIN_OUTPUT_MAX_ROWS.key}={cap}): the join fans out too "
-            "much for eager in-memory execution.  Route it out-of-core "
-            f"(file-backed inputs larger than {C.SCAN_MAX_BATCH_ROWS.key} "
-            "stream through the grace-join stage runner), reduce the "
-            "hot-key fanout, or raise the cap explicitly")
+        raise _fanout_error(where, est, factor, probe_rows, cap)
 
 
 def _overflow_ratio(flags: List[int], caps: List[int]) -> float:
@@ -108,17 +124,17 @@ def _row_nbytes(schema: T.StructType) -> int:
     return total
 
 
-def _plan_reserve_bytes(pq: PlannedQuery) -> int:
-    """Upper-bound device bytes for one execution attempt: the leaf
-    working set (input + one fused intermediate) plus the STATIC output
-    buffers of every capacity-growing operator.  Static shapes make this
-    exact arithmetic, not a heuristic — join output capacity is
-    ``pad_capacity(probe × factor)`` by construction (joins.py)."""
+def _walk_plan_caps(pq: PlannedQuery):
+    """(root_cap, extra_bytes, join_caps) over the physical plan's STATIC
+    output capacities — exact arithmetic, not a heuristic: join output
+    capacity is ``pad_capacity(probe × factor)`` by construction
+    (joins.py).  ``join_caps`` lists ``(PJoin, probe_rows, out_rows)``
+    for every join with an adaptive (factor-sized) output buffer."""
     from ..columnar import pad_capacity
-    from ..memory import batch_nbytes
     from .joins import PJoin
 
     extra = 0
+    join_caps: List[tuple] = []
 
     def cap(node: P.PhysicalPlan) -> int:
         nonlocal extra
@@ -142,6 +158,7 @@ def _plan_reserve_bytes(pq: PlannedQuery) -> int:
                 out = pad_capacity(int(probe * max(node.factor, 0.1)))
                 if node.how == "full":
                     out += build
+                join_caps.append((node, probe, out))
             extra += out * _row_nbytes(node.schema())
             return out
         if isinstance(node, P.PUnion):
@@ -150,9 +167,35 @@ def _plan_reserve_bytes(pq: PlannedQuery) -> int:
             return out
         return max(ch) if ch else 1
 
+    root_cap = cap(pq.physical)
+    extra += root_cap * _row_nbytes(pq.physical.schema())
+    return root_cap, extra, join_caps
+
+
+def check_planned_join_capacities(pq: PlannedQuery, session,
+                                  where: str = "join") -> None:
+    """EXACT successor of the factor-x-probe estimate for planned
+    queries: walk the physical plan and fail any join whose STATIC output
+    buffer exceeds ``spark.sql.join.maxOutputRows`` — attributing the
+    violation to the join that owns the allocation, not to whichever
+    leaf happens to be largest."""
+    cap = session.conf.get(C.JOIN_OUTPUT_MAX_ROWS)
     try:
-        root_cap = cap(pq.physical)
-        extra += root_cap * _row_nbytes(pq.physical.schema())
+        join_caps = _walk_plan_caps(pq)[2]
+    except Exception:
+        return                  # estimation must never sink a query
+    for node, probe, out in join_caps:
+        if out > cap:
+            raise _fanout_error(where, out, node.factor, probe, cap)
+
+
+def _plan_reserve_bytes(pq: PlannedQuery) -> int:
+    """Upper-bound device bytes for one execution attempt: the leaf
+    working set (input + one fused intermediate) plus the STATIC output
+    buffers of every capacity-growing operator (``_walk_plan_caps``)."""
+    from ..memory import batch_nbytes
+    try:
+        _root, extra, _joins = _walk_plan_caps(pq)
         return 2 * sum(batch_nbytes(b) for b in pq.leaves) + extra
     except Exception:
         # estimation must never sink a runnable query
@@ -498,10 +541,18 @@ class QueryExecution:
 
         base_key = "local:" + self.planned.physical.key()
         factors = self.session._adapted_factors.get(base_key)
+        grew = False
         for attempt in range(self.MAX_ADAPT + 1):
             pq = self.planned if factors is None \
                 else Planner(self.session, join_factor_override=factors) \
                 .plan(self.optimized)
+            if grew:
+                # exact per-join allocation guard (replaces the old
+                # factor x max-leaf estimate, which mis-blamed small
+                # joins in plans with one large leaf).  Only GROWTH in
+                # THIS execution is guarded — factors cached from a
+                # previous successful run already proved they fit.
+                check_planned_join_capacities(pq, self.session)
             result, ratio = self._run_planned(pq)
             if ratio <= 0.0:
                 if factors is not None:
@@ -521,13 +572,12 @@ class QueryExecution:
                 else [None] * len(join_ratios)
             while len(cur) < len(join_ratios):
                 cur.append(None)
-            probe_rows = max((b.capacity for b in pq.leaves), default=1)
             for i, r in enumerate(join_ratios):
                 if r > 0:
                     prev = cur[i] if cur[i] is not None else base_f
                     cur[i] = grow_capacity_factor(prev, r)
-                    check_factor_cap(cur[i], probe_rows, self.session)
             factors = cur
+            grew = True
             _log.warning(
                 "join output overflowed its static capacity by %.0f%%; "
                 "replanning with per-join factors %s", ratio * 100,

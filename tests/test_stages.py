@@ -361,3 +361,91 @@ def test_streamed_union_unknown_words_falls_back(st, data, tmp_path):
     sv = t1.groupby("w").v.sum()
     assert got == {"ash": sv["ash"], "oak": sv["oak"],
                    "ASH": sv["ash"], "OAK": sv["oak"]}
+
+
+def test_fanout_intermediate_join_reroutes_to_grace(st, tmp_path, caplog):
+    """The q14/q23 failure shape: a join of two MATERIALIZED intermediate
+    results whose hot-key fanout exceeds ``spark.sql.join.maxOutputRows``
+    on the eager path.  The eager allocation is worst-bucket-factor x the
+    whole probe capacity; the fix re-routes the join through the grace
+    spill path, where per-bucket static capacities stay small and only
+    true matches are emitted (stages.py ``_Builder._join``)."""
+    import logging
+    nkeys, dup_l, dup_r = 16, 200, 8
+    left = pd.DataFrame({
+        "k": np.repeat(np.arange(nkeys, dtype=np.int64), dup_l),
+        "v": np.tile(np.arange(dup_l, dtype=np.int64), nkeys),
+    })
+    right = pd.DataFrame({
+        "k": np.repeat(np.arange(nkeys, dtype=np.int64), dup_r),
+        "w": np.tile(np.arange(dup_r, dtype=np.int64), nkeys),
+    })
+    lp = _write(tmp_path / "fan_l.parquet", left, parts=4)
+    rp = _write(tmp_path / "fan_r.parquet", right, parts=1)
+    total = nkeys * dup_l * dup_r          # 25,600 true output rows
+    old_cap = st.conf.get(C.JOIN_OUTPUT_MAX_ROWS)
+    # eager needs ~dup_r x 3,200 probe rows = 25,600 > cap;
+    # grace per-chunk needs <= factor x pad(BATCH) ~ 4k < cap
+    st.conf.set(C.JOIN_OUTPUT_MAX_ROWS.key, "10000")
+    try:
+        # .distinct() makes each side a materialized breaker result
+        # (duplicate keys preserved: (k, v) pairs are unique)
+        l = st.read.parquet(lp).distinct()
+        r = st.read.parquet(rp).distinct()
+        df = l.join(r, on="k")
+        with caplog.at_level(logging.WARNING, logger="spark_tpu.stages"):
+            got = df.collect()
+        assert len(got) == total
+        exp = left.merge(right, on="k")
+        assert sorted((r["k"], r["v"], r["w"]) for r in got) == \
+            sorted(zip(exp.k, exp.v, exp.w))
+        assert any("grace spill path" in m for m in caplog.messages)
+    finally:
+        st.conf.set(C.JOIN_OUTPUT_MAX_ROWS.key, str(old_cap))
+
+
+def test_factor_cap_guard_is_typed(st):
+    """The adaptive-growth guard raises the TYPED JoinFanoutError (the
+    stage builder's reroute depends on catching exactly this class) and
+    keeps its actionable guidance.  Non-equi joins plan as static
+    cross-products (no adaptive factor), so the guard only ever fires on
+    equi joins — where the grace reroute above applies."""
+    from spark_tpu.sql.planner import JoinFanoutError, check_factor_cap
+    old_cap = st.conf.get(C.JOIN_OUTPUT_MAX_ROWS)
+    st.conf.set(C.JOIN_OUTPUT_MAX_ROWS.key, "10000")
+    try:
+        check_factor_cap(4.0, 2000, st)                  # 8k rows: fine
+        with pytest.raises(JoinFanoutError, match="maxOutputRows"):
+            check_factor_cap(8.0, 2000, st)              # 16k > cap
+    finally:
+        st.conf.set(C.JOIN_OUTPUT_MAX_ROWS.key, str(old_cap))
+
+
+def test_grace_bucket_fanout_chunks_instead_of_dying(st, tmp_path, caplog):
+    """A grace bucket pair that FITS in a batch but whose join output
+    fans out past spark.sql.join.maxOutputRows must chunk the bucket
+    (recursive build-side splitting) and still produce the exact result
+    — the q14-under-skew failure at the bucket level."""
+    import logging
+    nkeys, dup = 64, 20
+    left = pd.DataFrame({
+        "k": np.repeat(np.arange(nkeys, dtype=np.int64), dup),
+        "v": np.tile(np.arange(dup, dtype=np.int64), nkeys)})
+    right = pd.DataFrame({
+        "k": np.repeat(np.arange(nkeys, dtype=np.int64), dup),
+        "w": np.tile(np.arange(dup, dtype=np.int64) * 7, nkeys)})
+    lp = _write(tmp_path / "bf_l.parquet", left, parts=4)
+    rp = _write(tmp_path / "bf_r.parquet", right, parts=4)
+    old_cap = st.conf.get(C.JOIN_OUTPUT_MAX_ROWS)
+    st.conf.set(C.JOIN_OUTPUT_MAX_ROWS.key, "4000")
+    try:
+        df = st.read.parquet(lp).join(st.read.parquet(rp), on="k")
+        with caplog.at_level(logging.WARNING, logger="spark_tpu.stages"):
+            got = df.collect()
+        assert len(got) == nkeys * dup * dup
+        exp = left.merge(right, on="k")
+        assert sorted((r["k"], r["v"], r["w"]) for r in got) == \
+            sorted(zip(exp.k, exp.v, exp.w))
+        assert any("chunking the bucket pair" in m for m in caplog.messages)
+    finally:
+        st.conf.set(C.JOIN_OUTPUT_MAX_ROWS.key, str(old_cap))
