@@ -441,7 +441,25 @@ _relation_cache: Dict[Any, ColumnBatch] = {}
 
 def _load_batch(fmt: str, raw_paths: List[str], options: Dict[str, str],
                 columns: Optional[List[str]] = None,
-                pushed: Optional[List[tuple]] = None) -> ColumnBatch:
+                pushed: Optional[List[tuple]] = None,
+                engine_schema: Optional[T.StructType] = None) -> ColumnBatch:
+    if fmt == "jdbc":
+        # database relations: no filesystem paths, and NEVER cached (a
+        # mutable store has no mtime-like invalidation token).  The
+        # relation's resolved engine schema (user-declared or
+        # sample-inferred) is the scan's cast target.
+        import pyarrow as pa
+        from . import jdbc as _jdbc
+        urls = [raw_paths] if isinstance(raw_paths, str) else list(raw_paths)
+        target = None
+        if engine_schema is not None:
+            target = pa.schema([pa.field(f.name,
+                                         _engine_to_arrow(f.dataType))
+                                for f in engine_schema.fields])
+        return _table_to_batch(_jdbc.read_table(urls, options,
+                                                columns=columns,
+                                                pushed=pushed,
+                                                target=target))
     files = _resolve_paths(raw_paths)
     key = (fmt, tuple(files), tuple(sorted(options.items())),
            tuple(os.path.getmtime(f) for f in files),
@@ -496,7 +514,8 @@ def _load_batch(fmt: str, raw_paths: List[str], options: Dict[str, str],
 def read_file_relation(rel: L.FileRelation, session) -> ColumnBatch:
     return _load_batch(rel.fmt, rel.paths, rel.options,
                        columns=getattr(rel, "columns", None),
-                       pushed=getattr(rel, "pushed_filters", None))
+                       pushed=getattr(rel, "pushed_filters", None),
+                       engine_schema=getattr(rel, "_schema", None))
 
 
 # ---------------------------------------------------------------------------
@@ -512,6 +531,9 @@ def file_row_count(rel: L.FileRelation) -> Optional[int]:
     file list + mtimes — multi-join planning probes the same dimension
     files repeatedly."""
     import os
+    if rel.fmt == "jdbc":
+        from . import jdbc as _jdbc
+        return _jdbc.count_rows(rel.paths[0], rel.options)  # never cached
     try:
         files = _resolve_paths(rel.paths)
     except AnalysisException:
@@ -634,11 +656,21 @@ def scan_file_batches(rel: L.FileRelation, batch_rows: int):
     VectorizedParquetRecordReader path — bounded host memory); other
     formats slice the host-cached table.  Partition-directory columns are
     appended per file."""
+    columns = getattr(rel, "columns", None)
+    pushed = getattr(rel, "pushed_filters", None)
+    if rel.fmt == "jdbc":
+        # database relation: one partitioned read (WHERE pushdown + column
+        # pruning applied in SQL), sliced host-side like csv/json
+        whole = _load_batch(rel.fmt, rel.paths, rel.options,
+                            columns=columns, pushed=pushed,
+                            engine_schema=getattr(rel, "_schema", None))
+        n = int(np.asarray(whole.num_rows()))
+        for start in range(0, max(n, 1), batch_rows):
+            yield _slice_rows(whole, start, min(start + batch_rows, n))
+        return
     files = _resolve_paths(rel.paths)
     base = rel.paths[0] if isinstance(rel.paths, list) else rel.paths
     base = base if os.path.isdir(base) else os.path.dirname(base)
-    columns = getattr(rel, "columns", None)
-    pushed = getattr(rel, "pushed_filters", None)
     if rel.fmt == "parquet":
         import pyarrow as pa
         import pyarrow.parquet as pq
@@ -686,7 +718,7 @@ def scan_string_dictionaries(rel: L.FileRelation,
     if not str_cols:
         return {}
     uniques: Dict[str, set] = {c: set() for c in str_cols}
-    files = _resolve_paths(rel.paths)
+    files = [] if rel.fmt == "jdbc" else _resolve_paths(rel.paths)
     if rel.fmt == "parquet":
         import pyarrow.compute as pc
         import pyarrow.parquet as pq
@@ -704,7 +736,11 @@ def scan_string_dictionaries(rel: L.FileRelation,
                         v for v in pc.unique(col).to_pylist()
                         if v is not None)
     else:
-        whole = _load_batch(rel.fmt, rel.paths, rel.options)
+        # jdbc: prune the (uncached) SELECT to the string columns only
+        cols = str_cols if rel.fmt == "jdbc" else None
+        whole = _load_batch(rel.fmt, rel.paths, rel.options, columns=cols,
+                            engine_schema=getattr(rel, "_schema", None)
+                            if rel.fmt == "jdbc" else None)
         for c in str_cols:
             if c in whole.names:
                 vec = whole.column(c)
@@ -809,6 +845,9 @@ class DataFrameReader:
             schema = _parquet_schema(paths)
         elif self._fmt == "orc":
             schema = _orc_schema(paths)
+        elif self._fmt == "jdbc":
+            from . import jdbc as _jdbc
+            schema = _jdbc.table_schema(paths[0], self._options)
         else:
             schema = _load_batch(self._fmt, paths, self._options).schema
         rel = L.FileRelation(self._fmt, paths, schema, self._options)
@@ -839,6 +878,33 @@ class DataFrameReader:
 
     def text(self, path) -> "Any":
         return self.format("text").load(path)
+
+    def jdbc(self, url: str, table: str = None, column: str = None,
+             lowerBound=None, upperBound=None, numPartitions=None,
+             predicates=None, properties=None) -> "Any":
+        """Relational source over DB-API connections
+        (`DataFrameReader.jdbc`, `JDBCRelation.columnPartition` stride
+        partitioning).  `predicates` is a list of SQL strings, one read
+        partition each; or (`column`, `lowerBound`, `upperBound`,
+        `numPartitions`) stride-partitions a numeric column."""
+        self.format("jdbc").option("url", url)
+        if table is not None:
+            self.option("dbtable", table)
+        if column is not None:
+            if lowerBound is None or upperBound is None \
+                    or numPartitions is None:
+                raise AnalysisException(
+                    "jdbc partitioning requires column, lowerBound, "
+                    "upperBound and numPartitions together")
+            self.option("partitioncolumn", column)
+            self.option("lowerbound", int(lowerBound))
+            self.option("upperbound", int(upperBound))
+            self.option("numpartitions", int(numPartitions))
+        if predicates:
+            self.option("predicates", "\x1f".join(predicates))
+        for k, v in (properties or {}).items():
+            self.option(k, v)
+        return self.load(url)
 
     def table(self, name: str) -> "Any":
         return self._session.table(name)
@@ -982,6 +1048,20 @@ class DataFrameWriter:
 
     def text(self, path: str) -> None:
         self.format("text").save(path)
+
+    def jdbc(self, url: str, table: str, mode: str = None,
+             properties=None) -> None:
+        """Write into a relational table over a DB-API connection
+        (`DataFrameWriter.jdbc` / `JdbcUtils.saveTable`): DDL derived
+        from the schema, rows in one batched-INSERT transaction."""
+        from . import jdbc as _jdbc
+        if mode is not None:
+            self.mode(mode)
+        opts = dict(self._options)
+        for k, v in (properties or {}).items():
+            opts[str(k).lower()] = str(v)
+        _jdbc.write_table(self._arrow_table(self._df), url, table,
+                          self._mode, opts)
 
     def saveAsTable(self, name: str) -> None:
         """Persist as a catalog table under the warehouse dir

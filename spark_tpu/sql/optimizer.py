@@ -901,14 +901,16 @@ _FLIP = {"==": "==", "<": ">", "<=": ">=", ">": "<", ">=": "<="}
 
 
 def push_scan_filters(node: LogicalPlan) -> LogicalPlan:
-    """Filter directly over a parquet FileRelation: extract `col op literal`
-    conjuncts on integer/string columns as ADVISORY row-group skip
-    predicates (footer min/max stats, ``ParquetFilters.scala`` role).  The
-    exact Filter stays in the plan, so pushdown can only skip row groups
-    whose stats PROVE emptiness — never change results."""
+    """Filter directly over a parquet or jdbc FileRelation: extract
+    `col op literal` conjuncts on integer/string columns as ADVISORY skip
+    predicates — row-group skipping from footer min/max stats for parquet
+    (``ParquetFilters.scala`` role), WHERE-clause conjuncts for jdbc
+    (``JDBCRDD.compileFilter`` role).  The exact Filter stays in the
+    plan, so pushdown can only reduce rows that provably cannot match —
+    never change results."""
     from .logical import FileRelation as FR
     if not (isinstance(node, Filter) and isinstance(node.child, FR)
-            and node.child.fmt == "parquet"
+            and node.child.fmt in ("parquet", "jdbc")
             and node.child.pushed_filters is None):
         return node
     rel = node.child
