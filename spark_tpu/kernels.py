@@ -209,6 +209,20 @@ def _mxu_agg_on() -> bool:
         return False
 
 
+def _on_tpu_device() -> bool:
+    """True when the default device is a real TPU.  Checked via the
+    DEVICE platform, not ``jax.default_backend()``: tunnel plugins (the
+    axon backend) register under their own backend name while exposing
+    ``platform == "tpu"`` devices, and Mosaic kernels key off the
+    hardware, not the transport."""
+    try:
+        if jax.default_backend() == "tpu":
+            return True
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
 def grouped_aggregate(
     xp,
     batch: ColumnBatch,
@@ -714,7 +728,7 @@ def _mxu_grouped_aggregate(xp, batch, key_exprs, agg_slots, bucket_cap):
         P = len(planes)
         plane_mat = xp.stack(planes, axis=-1)                # (n, P)
 
-        if pallas_agg.supported(B) and jax.default_backend() == "tpu":
+        if pallas_agg.supported(B) and _on_tpu_device():
             # Pallas accumulate: one-hot tiles built in VMEM, (B, P) int32
             # accumulator in scratch, bucket chunks beyond the runtime key
             # range skipped — HBM traffic is one pass over the planes

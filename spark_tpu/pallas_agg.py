@@ -94,14 +94,19 @@ def _accumulate_chunk(bucket32: Array, planes: Array, n_active: Array, *,
     T = n_pad // L
     BCH = B_pad // BB
 
+    # index maps must stay i32: under jax_enable_x64 a bare Python 0
+    # lowers as an i64 constant, which Mosaic refuses to legalize
+    # ("failed to legalize operation 'func.func'", first seen on real
+    # v5e hardware 2026-07-31 — interpret mode never catches this)
+    zero = np.int32(0)          # numpy scalar: untraced, keeps i32 dtype
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(T, BCH),
         in_specs=[
-            pl.BlockSpec((1, L), lambda t, bj, n: (0, t)),
-            pl.BlockSpec((L, P), lambda t, bj, n: (t, 0)),
+            pl.BlockSpec((1, L), lambda t, bj, n: (zero, t)),
+            pl.BlockSpec((L, P), lambda t, bj, n: (t, zero)),
         ],
-        out_specs=pl.BlockSpec((B_pad, P), lambda t, bj, n: (0, 0)),
+        out_specs=pl.BlockSpec((B_pad, P), lambda t, bj, n: (zero, zero)),
         scratch_shapes=[pltpu.VMEM((B_pad, P), jnp.int32)],
     )
     out = pl.pallas_call(
