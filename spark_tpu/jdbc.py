@@ -50,11 +50,17 @@ def _normalize_url(url: str) -> str:
 
 
 def _sqlite_path(url: str) -> str:
-    """``sqlite:///abs/path`` / ``sqlite:/abs/path`` / ``sqlite:rel`` /
-    ``:memory:`` → filesystem path for sqlite3.connect."""
+    """``sqlite:///abs/path`` / ``sqlite:/abs/path`` / ``sqlite:rel`` →
+    filesystem path for sqlite3.connect.  ``:memory:`` is rejected: each
+    connect() would open a DISTINCT empty database (this module opens a
+    fresh connection per operation), so in-memory writes would always be
+    silently lost."""
     rest = url.split(":", 1)[1]
-    if rest == ":memory:" or rest == "memory:":
-        return ":memory:"
+    if rest in (":memory:", "memory:"):
+        raise AnalysisException(
+            "jdbc:sqlite::memory: is not supported: every read/write "
+            "opens its own connection, and an in-memory sqlite database "
+            "dies with its connection — use a file-backed database")
     while rest.startswith("//"):
         rest = rest[1:]
     return rest
@@ -72,7 +78,7 @@ def connect(url: str, options: Dict[str, str], create: bool = False):
     if driver is None and scheme in ("sqlite", "sqlite3", ""):
         import sqlite3
         path = _sqlite_path(url) if ":" in url else url
-        if not create and path != ":memory:" and not os.path.exists(path):
+        if not create and not os.path.exists(path):
             raise AnalysisException(f"sqlite database not found: {path}")
         return sqlite3.connect(path), "qmark"
     mod_name = driver or scheme
@@ -239,8 +245,12 @@ def _arrow_schema(url: str, options: Dict[str, str], sample_rows: int = 200):
     conn, _style = connect(url, options)
     try:
         cur = conn.cursor()
-        cur.execute(_select_sql(options, None, None, None,
-                                limit=sample_rows))
+        sql = _select_sql(options, None, None, None, limit=sample_rows)
+        try:
+            cur.execute(sql)
+        except Exception as e:
+            raise AnalysisException(
+                f"jdbc schema probe failed ({e}); query was: {sql}") from e
         names = [d[0] for d in cur.description]
         rows = cur.fetchall()
     finally:
@@ -269,7 +279,12 @@ def read_table(urls: List[str], options: Dict[str, str], columns=None,
         tables = []
         names: Optional[List[str]] = None
         for pred in partition_predicates(options):
-            cur.execute(_select_sql(options, columns, pushed, pred))
+            sql = _select_sql(options, columns, pushed, pred)
+            try:
+                cur.execute(sql)
+            except Exception as e:
+                raise AnalysisException(
+                    f"jdbc scan failed ({e}); query was: {sql}") from e
             if names is None:
                 names = [d[0] for d in cur.description]
             fetch = int(options.get("fetchsize", "10000") or 10000)
@@ -396,7 +411,11 @@ def write_table(table, url: str, name: str, mode: str,
                 for f in table.schema)
             cur.execute(f'CREATE TABLE "{name}" ({cols})')
         ph = _placeholders(style, table.num_columns)
-        sql = f'INSERT INTO "{name}" VALUES ({ph})'
+        # explicit column list: append mode must bind by NAME against a
+        # pre-existing table whose column order may differ (the silent
+        # positional-scramble JdbcUtils.getInsertStatement also avoids)
+        collist = ", ".join(f'"{c}"' for c in table.column_names)
+        sql = f'INSERT INTO "{name}" ({collist}) VALUES ({ph})'
         pydict = table.to_pydict()
         rows = list(zip(*[pydict[c] for c in table.column_names])) \
             if table.num_rows else []

@@ -221,3 +221,31 @@ def test_write_bootstraps_new_database(spark, tmp_path):
     back = spark.read.jdbc(f"jdbc:sqlite:{out}", "t")
     assert sorted((r["n"], r["s"]) for r in back.collect()) == \
         [(1, "a"), (2, "b")]
+
+
+def test_error_discipline(spark, db, tmp_path):
+    """User mistakes surface as AnalysisException with context, never raw
+    driver exceptions; :memory: urls are rejected up front."""
+    from spark_tpu.expressions import AnalysisException
+    url, _ = db
+    with pytest.raises(AnalysisException, match="no such table"):
+        spark.read.jdbc(url, "emp_typo")
+    with pytest.raises(AnalysisException, match="memory"):
+        spark.read.jdbc("jdbc:sqlite::memory:", "t")
+
+
+def test_append_binds_by_column_name(spark, tmp_path):
+    """Append into a pre-existing table whose column ORDER differs from
+    the DataFrame's must bind by name, not position."""
+    db = tmp_path / "order.db"
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (id INTEGER, name TEXT)")
+    conn.execute("INSERT INTO t VALUES (1, 'a')")
+    conn.commit(); conn.close()
+    url = f"jdbc:sqlite:{db}"
+    # DataFrame columns deliberately reversed: (name, id)
+    df = spark.createDataFrame([("b", 2)], ["name", "id"])
+    df.write.jdbc(url, "t", mode="append")
+    got = sorted((r["id"], r["name"])
+                 for r in spark.read.jdbc(url, "t").collect())
+    assert got == [(1, "a"), (2, "b")]
