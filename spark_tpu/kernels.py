@@ -175,6 +175,25 @@ def _np_segment_reduce(data: np.ndarray, seg: np.ndarray, num: int, kind: str,
     return out
 
 
+def _global_reduce(xp, data: Array, kind: str, capacity: int) -> Array:
+    """One-segment reduction: the whole (already contribute-masked)
+    buffer collapses to slot 0; remaining slots hold the identity, as
+    segment_reduce would leave them.  No sort, no scatter."""
+    np_dt = np.asarray(data).dtype if _is_np(xp) else np.dtype(str(data.dtype))
+    ident = IDENTITY[kind](np_dt)
+    if capacity == 0:
+        # capacity-0 host batches: segment_reduce returned shape (0,)
+        return xp.zeros(0, np_dt)
+    if kind == "sum":
+        val = data.sum()
+    elif kind == "min":
+        val = data.min()
+    else:
+        val = data.max()
+    rest = xp.full(capacity - 1, ident, np_dt)
+    return xp.concatenate([xp.asarray(val).reshape(1).astype(np_dt), rest])
+
+
 def segment_reduce(xp, data: Array, seg_ids: Array, num_segments: int,
                    kind: str) -> Array:
     np_dt = np.asarray(data).dtype if _is_np(xp) else np.dtype(str(data.dtype))
@@ -257,6 +276,12 @@ def _sorted_grouped_aggregate(
 ) -> ColumnBatch:
     """Sort-based grouping: multi-key sort → segment boundaries → segment
     reduce (the general path; also the numpy oracle)."""
+    if not key_exprs and batch.capacity == 0:
+        # the global row exists even over an empty input (COUNT=0, SUM
+        # NULL); pad to one all-dead row so the ordinary no-live-rows
+        # machinery produces it (a capacity-0 batch cannot hold it)
+        from .columnar import pad_to_capacity
+        batch = pad_to_capacity(batch, 1)
     ctx = EvalContext(batch, xp)
     capacity = batch.capacity
     live = batch.row_valid_or_true()
@@ -275,10 +300,15 @@ def _sorted_grouped_aggregate(
             # NULL forms its own group; rank it before all values
             sort_cols += [xp.where(v.valid, np.int8(0), np.int8(-1)),
                           xp.where(v.valid, data, xp.zeros((), data.dtype))]
-    perm = multi_key_argsort(xp, sort_cols, capacity)
+    # keyless (global) aggregation needs NO sort: every buffer reduces
+    # over one segment, and the reductions are order-independent (First
+    # reduces original-row indices).  The sort was the dominant cost of
+    # every global aggregate — a full O(n log^2 n) bitonic pass on TPU
+    # for a single output row.
+    perm = multi_key_argsort(xp, sort_cols, capacity) if key_exprs else None
 
-    sorted_cols = [c[perm] for c in sort_cols]
-    live_s = live[perm]
+    sorted_cols = sort_cols if perm is None else [c[perm] for c in sort_cols]
+    live_s = live if perm is None else live[perm]
 
     # ---- segment boundaries --------------------------------------------
     if key_exprs:
@@ -336,15 +366,21 @@ def _sorted_grouped_aggregate(
             out_vectors.append(pct_results[name])
             continue
         if getattr(func, "is_collect", False):
+            cperm = perm if perm is not None \
+                else xp.arange(capacity, dtype=np.int64)
             out_names.append(name)
             out_vectors.append(_collect_into_arrays(
-                xp, ctx, func, perm, sort_cols, seg_ids, is_start, group_pos,
-                live_s, capacity))
+                xp, ctx, func, cperm, sort_cols, seg_ids, is_start,
+                group_pos, live_s, capacity))
             continue
         specs = func.make_buffers(ctx, contribute)
-        sorted_bufs = [s.data[perm] for s in specs]
-        reduced = [segment_reduce(xp, b, seg_ids, capacity, s.kind)
-                   for b, s in zip(sorted_bufs, specs)]
+        if perm is None:
+            reduced = [_global_reduce(xp, s.data, s.kind, capacity)
+                       for s in specs]
+        else:
+            sorted_bufs = [s.data[perm] for s in specs]
+            reduced = [segment_reduce(xp, b, seg_ids, capacity, s.kind)
+                       for b, s in zip(sorted_bufs, specs)]
         dt = func.data_type(schema)
         if isinstance(func, First):
             # argmin/argmax of row index → gather the value column

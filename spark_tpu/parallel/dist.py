@@ -423,6 +423,12 @@ def _group_by_keys(xp, key_vals, live, capacity):
     between them): sort rows by (liveness, per-key null flag, key value),
     derive segment ids.  Returns (perm, seg_ids, is_start, num_groups);
     is_start/num_groups are None for the global (no keys) case."""
+    if not key_vals:
+        # keyless (global): no sort, no segments — every buffer reduces
+        # whole-array (order-independent; First reduces rank values).
+        # perm=None tells the stages to skip permutation and use
+        # _reduce_buf's global path instead of a segment scatter.
+        return None, xp.zeros(capacity, np.int64), None, None
     sort_cols = [(~live).astype(np.int8)]
     for v in key_vals:
         data = v.data.astype(np.int8) if str(v.data.dtype) == "bool" \
@@ -435,20 +441,25 @@ def _group_by_keys(xp, key_vals, live, capacity):
     perm = multi_key_argsort(xp, sort_cols, capacity)
     sorted_cols = [c[perm] for c in sort_cols]
     live_s = live[perm]
-    if key_vals:
-        change = xp.zeros(capacity, bool)
-        for c in sorted_cols:
-            change = change | (c != xp.concatenate([c[:1], c[:-1]]))
-        is_start = change.at[0].set(True) if xp is jnp else _np_set0(change)
-        is_start = is_start & live_s
-        seg_ids = xp.cumsum(is_start.astype(np.int64)) - 1
-        seg_ids = xp.where(live_s, seg_ids, np.int64(capacity - 1))
-        num_groups = xp.sum(is_start.astype(np.int64))
-    else:
-        seg_ids = xp.zeros(capacity, np.int64)
-        is_start = None
-        num_groups = None
+    change = xp.zeros(capacity, bool)
+    for c in sorted_cols:
+        change = change | (c != xp.concatenate([c[:1], c[:-1]]))
+    is_start = change.at[0].set(True) if xp is jnp else _np_set0(change)
+    is_start = is_start & live_s
+    seg_ids = xp.cumsum(is_start.astype(np.int64)) - 1
+    seg_ids = xp.where(live_s, seg_ids, np.int64(capacity - 1))
+    num_groups = xp.sum(is_start.astype(np.int64))
     return perm, seg_ids, is_start, num_groups
+
+
+def _reduce_buf(xp, data, perm, seg_ids, capacity, kind):
+    """One aggregation-buffer reduction: segment scatter in SORTED
+    coordinates with keys, whole-array reduce without (perm=None — the
+    global case must pay neither the sort nor a scatter)."""
+    if perm is None:
+        from ..kernels import _global_reduce
+        return _global_reduce(xp, data, kind, capacity)
+    return segment_reduce(xp, data[perm], seg_ids, capacity, kind)
 
 
 def _emit_group_keys(xp, keys, key_dts, key_vals, perm, seg_ids, is_start,
@@ -528,9 +539,14 @@ class DPartialAggregate(DNode):
                 rank = xp.where(contrib, rank, dead_rank)
                 validplane = v.valid if v.valid is not None \
                     else xp.ones(capacity, bool)
+                if perm is None:
+                    r_s, v_s, vp_s = rank, v.data, validplane
+                else:
+                    r_s, v_s, vp_s = rank[perm], v.data[perm], \
+                        validplane[perm]
                 r_red, v_red, valid_red = _first_last_reduce(
-                    xp, rank[perm], dead_rank, v.data[perm],
-                    validplane[perm], seg_ids, is_last, capacity)
+                    xp, r_s, dead_rank, v_s, vp_s, seg_ids, is_last,
+                    capacity, global_mode=perm is None)
                 bn_rank, bn_val, bn_valid = self.buffer_names(i, func)
                 names += [bn_rank, bn_val, bn_valid]
                 np_v = np.dtype(str(v_red.dtype)) if xp is jnp \
@@ -542,8 +558,8 @@ class DPartialAggregate(DNode):
                 continue
             specs = func.make_buffers(ectx, live)
             for j, (bn, spec) in enumerate(zip(self.buffer_names(i, func), specs)):
-                reduced = segment_reduce(xp, spec.data[perm], seg_ids, capacity,
-                                         spec.kind)
+                reduced = _reduce_buf(xp, spec.data, perm, seg_ids, capacity,
+                                      spec.kind)
                 names.append(bn)
                 vectors.append(ColumnVector(reduced, T.np_dtype_to_engine(spec.np_dtype)
                                             if spec.np_dtype != np.bool_ else T.boolean,
@@ -561,15 +577,23 @@ class DPartialAggregate(DNode):
 
 
 def _first_last_reduce(xp, rank_s, dead_rank, value_s, validplane_s, seg_ids,
-                       is_last, capacity):
+                       is_last, capacity, global_mode=False):
     """Shared (rank, value, validity) segment merge for first/last value-
     carry buffers — used identically by the partial and final stages so
-    the rank encoding can never desynchronize.  All inputs are in SORTED
-    coordinates; returns (rank_red, value_red, valid_red int8)."""
+    the rank encoding can never desynchronize.  With keys the inputs are
+    in SORTED coordinates; ``global_mode`` (keyless) reduces whole-array
+    with unsorted inputs.  Returns (rank_red, value_red, valid_red int8)."""
     from ..aggregates import IDENTITY
+    from ..kernels import _global_reduce
     kind = "max" if is_last else "min"
-    r_red = segment_reduce(xp, rank_s, seg_ids, capacity, kind)
-    win = (rank_s == r_red[seg_ids]) & (rank_s != dead_rank)
+
+    def red(d, k):
+        return _global_reduce(xp, d, k, capacity) if global_mode \
+            else segment_reduce(xp, d, seg_ids, capacity, k)
+
+    r_red = red(rank_s, kind)
+    r_mine = r_red[0] if global_mode else r_red[seg_ids]
+    win = (rank_s == r_mine) & (rank_s != dead_rank)
     np_dt = np.dtype(str(value_s.dtype)) if xp is jnp \
         else np.asarray(value_s).dtype
     if np_dt == np.bool_:
@@ -577,9 +601,9 @@ def _first_last_reduce(xp, rank_s, dead_rank, value_s, validplane_s, seg_ids,
         np_dt = np.dtype(np.int8)
     ident = IDENTITY["max"](np_dt)
     masked = xp.where(win, value_s, np.asarray(ident, value_s.dtype))
-    v_red = segment_reduce(xp, masked, seg_ids, capacity, "max")
+    v_red = red(masked, "max")
     masked_valid = xp.where(win, validplane_s.astype(np.int8), np.int8(0))
-    valid_red = segment_reduce(xp, masked_valid, seg_ids, capacity, "max")
+    valid_red = red(masked_valid, "max")
     return r_red, v_red, valid_red
 
 
@@ -633,9 +657,14 @@ class DFinalAggregate(DNode):
                 val_col = batch.column(bn_val)
                 validplane = batch.column(bn_valid).data != 0
                 rank_m = xp.where(live, rank_col, dead_rank)
+                if perm is None:
+                    r_s, v_s, vp_s = rank_m, val_col.data, validplane
+                else:
+                    r_s, v_s, vp_s = rank_m[perm], val_col.data[perm], \
+                        validplane[perm]
                 r_red, v_red, valid_red = _first_last_reduce(
-                    xp, rank_m[perm], dead_rank, val_col.data[perm],
-                    validplane[perm], seg_ids, is_last, capacity)
+                    xp, r_s, dead_rank, v_s, vp_s, seg_ids, is_last,
+                    capacity, global_mode=perm is None)
                 got = (r_red != dead_rank) & (valid_red != 0)
                 dt = func.data_type(cs_child)
                 data = v_red.astype(np.bool_) \
@@ -655,7 +684,8 @@ class DFinalAggregate(DNode):
                 np_dt = np.dtype(str(masked.dtype))
                 ident = IDENTITY[kind](np_dt)
                 masked = xp.where(live, masked, np.asarray(ident, np_dt))
-                reduced = segment_reduce(xp, masked[perm], seg_ids, capacity, kind)
+                reduced = _reduce_buf(xp, masked, perm, seg_ids, capacity,
+                                      kind)
                 bufs.append(reduced)
             out = func.finish(xp, bufs)
             dt = func.data_type(cs_child)
@@ -743,9 +773,14 @@ class DMergePartial(DNode):
                 val_col = batch.column(bn_val)
                 validplane = batch.column(bn_valid).data != 0
                 rank_m = xp.where(live, rank_col, dead_rank)
+                if perm is None:
+                    r_s, v_s, vp_s = rank_m, val_col.data, validplane
+                else:
+                    r_s, v_s, vp_s = rank_m[perm], val_col.data[perm], \
+                        validplane[perm]
                 r_red, v_red, valid_red = _first_last_reduce(
-                    xp, rank_m[perm], dead_rank, val_col.data[perm],
-                    validplane[perm], seg_ids, is_last, capacity)
+                    xp, r_s, dead_rank, v_s, vp_s, seg_ids, is_last,
+                    capacity, global_mode=perm is None)
                 names += [bn_rank, bn_val, bn_valid]
                 vectors.append(ColumnVector(r_red, T.int64, None, None))
                 vectors.append(ColumnVector(v_red, val_col.dtype, None,
@@ -759,8 +794,8 @@ class DMergePartial(DNode):
                 np_dt = np.dtype(str(col.data.dtype))
                 ident = IDENTITY[kind](np_dt)
                 masked = xp.where(live, col.data, np.asarray(ident, np_dt))
-                reduced = segment_reduce(xp, masked[perm], seg_ids, capacity,
-                                         kind)
+                reduced = _reduce_buf(xp, masked, perm, seg_ids, capacity,
+                                      kind)
                 names.append(bname)
                 vectors.append(ColumnVector(reduced, col.dtype, None,
                                             col.dictionary))

@@ -616,6 +616,31 @@ class _GraceAggMerger:
 # the runner
 # ---------------------------------------------------------------------------
 
+def _prefix_live(phys: P.PhysicalPlan) -> bool:
+    """True when `phys`'s output provably carries all live rows in a
+    prefix, so the per-batch step can skip the sort-based ``compact``:
+
+    - the sort-grouped aggregation stages scatter groups to slots
+      0..k-1 (``parallel/dist.py`` rv = arange < num_groups);
+    - PSort pushes dead rows past the end (leading dead-key);
+    - scan pieces arrive compacted+padded (``_emit_pieces``);
+    - projects/limits preserve a prefix-live child.
+
+    PDistinct is NOT prefix-live: its MXU bucket path leaves holes in
+    the bucket table (grow mask).  Default to False when unsure —
+    compact is correct either way, just slower."""
+    from ..parallel.dist import (DFinalAggregate, DMergePartial,
+                                 DPartialAggregate)
+    if isinstance(phys, (DPartialAggregate, DFinalAggregate,
+                         DMergePartial, P.PSort)):
+        return True
+    if isinstance(phys, (P.PScan, P.PRange)):
+        return True
+    if isinstance(phys, (P.PProject, P.PLimit)):
+        return _prefix_live(phys.children[0])
+    return False
+
+
 class MultiBatchExecution:
     def __init__(self, session, dec: _Decomposed, batch_rows: int):
         self.session = session
@@ -660,11 +685,17 @@ class MultiBatchExecution:
     def _build_step(self, template: ColumnBatch):
         """(jitted step fn, spine output schema) for one padded scan batch."""
         phys, spine_schema = self._step_physical(template)
+        skip_compact = _prefix_live(phys)
 
         def step(leaf):
             ctx = P.ExecContext(jnp, [leaf])
             out = phys.run(ctx)
-            c = compact(jnp, out)
+            # compact = a full sort; skip it when the spine provably
+            # emits live rows as a prefix already (aggregation stages
+            # scatter groups to slots 0..k-1; sorted/limited outputs are
+            # prefix-compacted by construction) — on TPU this sort was
+            # the single largest cost of every streamed agg/scan step
+            c = out if skip_compact else compact(jnp, out)
             return c, c.num_rows()
 
         return jax.jit(step), spine_schema

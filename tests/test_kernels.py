@@ -234,3 +234,25 @@ def test_sort_jit_matches_numpy():
     ref = do_sort(np, b.to_host()).to_pylist()
     out = jax.jit(lambda bt: do_sort(jnp, bt))(b.to_device()).to_host().to_pylist()
     assert ref == out
+
+
+def test_keyless_agg_capacity_zero():
+    """Keyless aggregation over a capacity-0 batch (empty streamed
+    source): the no-sort global path must behave like segment_reduce did
+    — shape-(0,) buffers, one all-NULL/zero output row after finish."""
+    import numpy as np
+    from spark_tpu import types as T
+    from spark_tpu.aggregates import Min, Sum, CountStar
+    from spark_tpu.columnar import ColumnBatch, ColumnVector
+    from spark_tpu.expressions import Col
+    from spark_tpu.kernels import grouped_aggregate
+    empty = ColumnBatch(
+        ["v"], [ColumnVector(np.zeros(0, np.int64), T.int64, None, None)],
+        np.zeros(0, bool), 0)
+    out = grouped_aggregate(np, empty, [],
+                            [(Sum(Col("v")), "s"), (Min(Col("v")), "m"),
+                             (CountStar(), "c")])
+    assert out.capacity == 1
+    assert int(np.asarray(out.column("c").data)[0]) == 0
+    sv = out.column("s")
+    assert sv.valid is not None and not bool(np.asarray(sv.valid)[0])
