@@ -592,7 +592,9 @@ def _first_last_reduce(xp, rank_s, dead_rank, value_s, validplane_s, seg_ids,
             else segment_reduce(xp, d, seg_ids, capacity, k)
 
     r_red = red(rank_s, kind)
-    r_mine = r_red[0] if global_mode else r_red[seg_ids]
+    # [:1] not [0]: broadcasts identically for capacity>0 and stays
+    # shape-(0,)-safe for capacity-0 host batches
+    r_mine = r_red[:1] if global_mode else r_red[seg_ids]
     win = (rank_s == r_mine) & (rank_s != dead_rank)
     np_dt = np.dtype(str(value_s.dtype)) if xp is jnp \
         else np.asarray(value_s).dtype

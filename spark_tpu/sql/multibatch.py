@@ -683,8 +683,18 @@ class MultiBatchExecution:
         return phys, spine_schema
 
     def _build_step(self, template: ColumnBatch):
-        """(jitted step fn, spine output schema) for one padded scan batch."""
+        """(jitted step fn, spine output schema) for one padded scan batch.
+
+        The jitted step is cached on the session by the plan's structural
+        fingerprint (same discipline as the eager executor's jit cache):
+        a fresh ``jax.jit`` object per execution would re-trace — and on
+        remote-compile backends re-COMPILE — the identical program for
+        every run of the same query."""
         phys, spine_schema = self._step_physical(template)
+        ck = f"mb:{self.capacity}:" + phys.key()
+        cached = self.session._jit_cache.get(ck)
+        if cached is not None:
+            return cached, spine_schema
         skip_compact = _prefix_live(phys)
 
         def step(leaf):
@@ -698,7 +708,9 @@ class MultiBatchExecution:
             c = out if skip_compact else compact(jnp, out)
             return c, c.num_rows()
 
-        return jax.jit(step), spine_schema
+        jitted = jax.jit(step)
+        self.session._jit_cache[ck] = jitted
+        return jitted, spine_schema
 
     # -- per-batch transfer + host-ification (overridden when sharded) ---
     def _run_batch(self, jstep, b: ColumnBatch) -> List[ColumnBatch]:
@@ -929,11 +941,21 @@ class DistributedMultiBatchExecution(MultiBatchExecution):
         from ..parallel.mesh import DATA_AXIS
 
         phys, spine_schema = self._step_physical(template)
+        ck = f"mbdist{self.n}:{self.capacity}:" + phys.key()
+        cached = self.session._jit_cache.get(ck)
+        if cached is not None:
+            return cached, spine_schema
+
+        skip_compact = _prefix_live(phys)
 
         def shard_fn(leaf):
             ctx = P.ExecContext(jnp, [leaf])
             out = phys.run(ctx)
-            return compact(jnp, out)
+            # same skip as the local step: per-shard outputs of the
+            # aggregation stages are prefix-live by construction, and
+            # _run_batch passes whole shard slices (mergers consume
+            # row_valid), so layout requirements are unchanged
+            return out if skip_compact else compact(jnp, out)
 
         wrapped = shard_map(
             shard_fn, mesh=self.mesh,
@@ -941,7 +963,9 @@ class DistributedMultiBatchExecution(MultiBatchExecution):
             out_specs=PartitionSpec(DATA_AXIS),
             check_vma=False,
         )
-        return jax.jit(wrapped), spine_schema
+        jitted = jax.jit(wrapped)
+        self.session._jit_cache[ck] = jitted
+        return jitted, spine_schema
 
     def _run_batch(self, jstep, b: ColumnBatch) -> List[ColumnBatch]:
         from ..io import _slice_rows

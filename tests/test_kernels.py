@@ -256,3 +256,30 @@ def test_keyless_agg_capacity_zero():
     assert int(np.asarray(out.column("c").data)[0]) == 0
     sv = out.column("s")
     assert sv.valid is not None and not bool(np.asarray(sv.valid)[0])
+
+
+def test_keyless_first_last_capacity_zero():
+    """Keyless first/last partials over a capacity-0 batch (empty shard
+    slice) must not crash in the global reduce path."""
+    import numpy as np
+    from spark_tpu import types as T
+    from spark_tpu.aggregates import First
+    from spark_tpu.columnar import ColumnBatch, ColumnVector
+    from spark_tpu.expressions import Col
+    from spark_tpu.parallel.dist import DPartialAggregate
+    from spark_tpu.sql import physical as P
+
+    class _Leaf(P.PhysicalPlan):
+        def __init__(self, b):
+            self.b = b
+            self.children = ()
+
+        def run(self, ctx):
+            return self.b
+
+    empty = ColumnBatch(
+        ["v"], [ColumnVector(np.zeros(0, np.int64), T.int64, None, None)],
+        np.zeros(0, bool), 0)
+    node = DPartialAggregate([], [(First(Col("v")), "f")], _Leaf(empty))
+    out = node.run(P.ExecContext(np, []))
+    assert out.capacity == 0
