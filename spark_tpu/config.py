@@ -176,6 +176,17 @@ JOIN_OUTPUT_FACTOR = conf("spark.sql.join.outputCapacityFactor").doc(
     "capacity; overflow is detected and reported (dynamic-shape escape hatch)."
 ).float(1.0)
 
+AGG_OUTPUT_ROWS = conf("spark.sql.agg.outputCapacity").doc(
+    "Static output capacity of keyed aggregate/distinct results when the "
+    "input batch is larger: the group table is sliced to this many rows "
+    "so a downstream sort/join does not pay full-input-capacity work for "
+    "a handful of live groups (q3: 64 brands in a 4M-row batch).  Safe "
+    "by construction — the sorted path emits groups as a prefix and the "
+    "MXU path confines them to the first bucket_cap slots — and a traced "
+    "overflow flag + adaptive retry grows it when the true group count "
+    "exceeds it (the join-output-factor discipline)."
+).int(1 << 16)
+
 JOIN_OUTPUT_MAX_ROWS = conf("spark.sql.join.maxOutputRows").doc(
     "Upper bound on an ADAPTIVELY GROWN join output allocation (probe "
     "capacity x grown factor, in rows): beyond it the query fails with "

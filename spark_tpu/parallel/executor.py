@@ -45,8 +45,10 @@ class DistributedPlanner(Planner):
 
     def __init__(self, session, n_shards: int,
                  skew_override: Optional[float] = None,
-                 join_factor_override: Optional[float] = None):
-        super().__init__(session, join_factor_override)
+                 join_factor_override: Optional[float] = None,
+                 agg_shrink_override: Optional[int] = None):
+        super().__init__(session, join_factor_override,
+                         agg_shrink_override=agg_shrink_override)
         self.n_shards = n_shards
         self.skew_override = skew_override
 
@@ -88,14 +90,19 @@ class DistributedPlanner(Planner):
             key_refs = [Col(k.name) for k in node.keys]
             exchanged = D.DExchangeHash(key_refs, n, self.skew, partial_agg,
                                         fine_buckets=self.fine)
-            return D.DFinalAggregate(node.keys, node.aggs, partial_agg, exchanged)
+            # per-shard group tables are prefix-live (rv = arange <
+            # num_groups), so the eager shrink applies per shard; its
+            # overflow flag rides the shard_map's shrink channel
+            return self._shrunk(D.DFinalAggregate(
+                node.keys, node.aggs, partial_agg, exchanged))
         if isinstance(node, Distinct):
             child = self._to_physical(node.child, leaves)
             keys = [Col(nm) for nm in node.child.schema().names]
             partial_agg = D.DPartialAggregate(keys, [], child)
             exchanged = D.DExchangeHash(keys, n, self.skew, partial_agg,
                                         fine_buckets=self.fine)
-            return D.DFinalAggregate(keys, [], partial_agg, exchanged)
+            return self._shrunk(D.DFinalAggregate(
+                keys, [], partial_agg, exchanged))
         if isinstance(node, Sort):
             child = self._to_physical(node.child, leaves)
             orders = [(o.child, o.ascending, o.nulls_first) for o in node.orders]
@@ -222,14 +229,17 @@ class DistributedExecution:
         static-shape answer to `ExchangeCoordinator.scala:85`-style
         adaptation (which coalesces partitions; here capacities grow)."""
         base_key = f"dist{self.n}:adapt:" + optimized.tree_string()
-        skew, jf = self.session._adapted_factors.get(base_key, (None, None))
+        adapted = self.session._adapted_factors.get(base_key, (None, None))
+        skew, jf = adapted[0], adapted[1]
+        shrink = adapted[2] if len(adapted) > 2 else None
         grew = False
         for attempt in range(self.MAX_ADAPT + 1):
-            result, ex_ratio, join_ratio = self._run_once(
-                optimized, skew, jf, check_caps=grew)
-            if ex_ratio <= 0.0 and join_ratio <= 0.0:
-                if skew is not None or jf is not None:
-                    self.session._adapted_factors[base_key] = (skew, jf)
+            result, ex_ratio, join_ratio, shrink_need = self._run_once(
+                optimized, skew, jf, shrink, check_caps=grew)
+            if ex_ratio <= 0.0 and join_ratio <= 0.0 and shrink_need <= 0:
+                if skew is not None or jf is not None or shrink is not None:
+                    self.session._adapted_factors[base_key] = \
+                        (skew, jf, shrink)
                 return result
             base_skew = skew if skew is not None \
                 else self.session.conf.get(C.EXCHANGE_SKEW_FACTOR)
@@ -237,26 +247,37 @@ class DistributedExecution:
                 else self.session.conf.get(C.JOIN_OUTPUT_FACTOR)
             if attempt == self.MAX_ADAPT:
                 raise RuntimeError(
-                    f"exchange/join still overflows after {attempt} adaptive "
-                    f"retries (skew={base_skew}, join factor={base_jf}); "
-                    f"raise {C.EXCHANGE_SKEW_FACTOR.key} / "
-                    f"{C.JOIN_OUTPUT_FACTOR.key} explicitly")
+                    f"exchange/join/agg still overflows after {attempt} "
+                    f"adaptive retries (skew={base_skew}, join "
+                    f"factor={base_jf}, agg capacity={shrink}); raise "
+                    f"{C.EXCHANGE_SKEW_FACTOR.key} / "
+                    f"{C.JOIN_OUTPUT_FACTOR.key} / "
+                    f"{C.AGG_OUTPUT_ROWS.key} explicitly")
             if ex_ratio > 0.0:
                 skew = grow_capacity_factor(base_skew, ex_ratio)
             if join_ratio > 0.0:
                 jf = grow_capacity_factor(base_jf, join_ratio)
                 grew = True
+            if shrink_need > 0:
+                from ..columnar import pad_capacity
+                base_s = shrink if shrink is not None \
+                    else self.session.conf.get(C.AGG_OUTPUT_ROWS)
+                shrink = pad_capacity(
+                    max(int(shrink_need * 1.25), 2 * int(base_s)))
             _log.warning(
-                "capacity overflow (exchange %.0f%%, join %.0f%%); "
-                "replanning with skew=%s join_factor=%s",
-                ex_ratio * 100, join_ratio * 100, skew, jf)
+                "capacity overflow (exchange %.0f%%, join %.0f%%, agg "
+                "need %d); replanning with skew=%s join_factor=%s "
+                "agg_capacity=%s", ex_ratio * 100, join_ratio * 100,
+                shrink_need, skew, jf, shrink)
 
     def _run_once(self, optimized: LogicalPlan, skew: Optional[float],
-                  jf: Optional[float], check_caps: bool = False
-                  ) -> Tuple[ColumnBatch, float, float]:
+                  jf: Optional[float], shrink: Optional[int] = None,
+                  check_caps: bool = False
+                  ) -> Tuple[ColumnBatch, float, float, int]:
         planner = DistributedPlanner(self.session, self.n,
                                      skew_override=skew,
-                                     join_factor_override=jf)
+                                     join_factor_override=jf,
+                                     agg_shrink_override=shrink)
         pq = planner.plan(optimized)
         if check_caps:
             # exact per-join allocation guard after growth in THIS
@@ -281,8 +302,19 @@ class DistributedExecution:
                 # pmax'd over shards — sizes the adaptive retry
                 ex_r = jnp.zeros((), jnp.float32)
                 join_r = jnp.zeros((), jnp.float32)
+                # agg-shrink: absolute NEEDED capacity (lost + bound), 0
+                # when nothing overflowed — growth is a row count, not a
+                # factor
+                shr_need = jnp.zeros((), jnp.int64)
                 for f, kind, cap in zip(ctx.flags, ctx.flag_kinds,
                                         ctx.flag_caps):
+                    if kind == "shrink":
+                        lost = f.astype(jnp.int64)
+                        shr_need = jnp.maximum(
+                            shr_need,
+                            jnp.where(lost > 0, lost + np.int64(cap),
+                                      np.int64(0)))
+                        continue
                     r = f.astype(jnp.float32) / np.float32(max(cap, 1))
                     if kind == "exchange":
                         ex_r = jnp.maximum(ex_r, r)
@@ -290,26 +322,29 @@ class DistributedExecution:
                         join_r = jnp.maximum(join_r, r)
                 ex_r = lax.pmax(ex_r, DATA_AXIS)
                 join_r = lax.pmax(join_r, DATA_AXIS)
-                return out, n_rows, ex_r, join_r
+                shr_need = lax.pmax(shr_need, DATA_AXIS)
+                return out, n_rows, ex_r, join_r, shr_need
 
             wrapped = shard_map(
                 shard_fn, mesh=mesh,
                 in_specs=(PartitionSpec(DATA_AXIS),),
                 out_specs=(PartitionSpec(DATA_AXIS), PartitionSpec(),
-                           PartitionSpec(), PartitionSpec()),
+                           PartitionSpec(), PartitionSpec(),
+                           PartitionSpec()),
                 check_vma=False,
             )
             fn = jax.jit(wrapped)
             self.session._jit_cache[key] = fn
 
         dev_leaves = tuple(self._shard_leaf(b) for b in pq.leaves)
-        result, n_rows, ex_r, join_r = fn(dev_leaves)
+        result, n_rows, ex_r, join_r, shr_need = fn(dev_leaves)
         ex_ratio = float(np.asarray(ex_r))
         join_ratio = float(np.asarray(join_r))
-        if ex_ratio > 0.0 or join_ratio > 0.0:
-            return result, ex_ratio, join_ratio
+        shrink_need = int(np.asarray(shr_need))
+        if ex_ratio > 0.0 or join_ratio > 0.0 or shrink_need > 0:
+            return result, ex_ratio, join_ratio, shrink_need
         host = result.to_host()
-        return compact(np, host), 0.0, 0.0
+        return compact(np, host), 0.0, 0.0, 0
 
 
 

@@ -584,7 +584,9 @@ class _GraceAggMerger:
                     "grace aggregation resumed without an active session")
         node = L.Aggregate(self.keys, self.aggs,
                            L.LocalRelation(bucket_batch))
-        planner = Planner(session)
+        # shrink_aggs=False: this call site never inspects ctx.flags, and
+        # the shrink's overflow flag is its only correctness escape hatch
+        planner = Planner(session, shrink_aggs=False)
         leaves: List[ColumnBatch] = []
         phys = planner._to_physical(node, leaves)
         planner._assign_op_ids(phys, [1])
@@ -903,7 +905,8 @@ class MultiBatchExecution:
         Sort/concat result must not be forced back into HBM whole."""
         if not self.dec.above:
             return compact(np, result.to_host())
-        planner = Planner(self.session)
+        # shrink_aggs=False: flags are not inspected here (see _eager_agg)
+        planner = Planner(self.session, shrink_aggs=False)
         node: L.LogicalPlan = L.LocalRelation(result)
         for op in reversed(self.dec.above):
             node = _with_child(op, node)

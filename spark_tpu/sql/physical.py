@@ -229,6 +229,47 @@ class PAggregate(PhysicalPlan):
                 f"aggs=[{', '.join(f'{f!r} AS {n}' for f, n in self.slots)}]")
 
 
+class PAggShrink(PhysicalPlan):
+    """Slice a keyed aggregate/distinct output to a bounded static
+    capacity (``spark.sql.agg.outputCapacity``).
+
+    Keyed aggregation keeps the INPUT capacity (worst case: every live
+    row its own group), so a downstream sort/join pays full-capacity
+    work for a handful of live groups.  The slice is lossless whenever
+    the true group count fits: the sorted path emits groups at slots
+    0..k-1 and the MXU path confines live buckets to the first
+    bucket_cap (< out_rows) slots.  A traced flag reports any groups
+    lost past the bound; the executor's adaptive retry then grows the
+    capacity, exactly like join-output factors.  Reference analog:
+    `HashAggregateExec` outputs are naturally |groups|-sized; static
+    shapes force the bound-and-grow formulation."""
+
+    def __init__(self, out_rows: int, child: PhysicalPlan):
+        self.out_rows = int(out_rows)
+        self.children = (child,)
+
+    def schema(self):
+        return self.children[0].schema()
+
+    def run(self, ctx):
+        xp = ctx.xp
+        b = self.children[0].run(ctx)
+        S = self.out_rows
+        if S >= b.capacity:
+            return b
+        live = b.row_valid_or_true()
+        total = xp.sum(live.astype(np.int64))
+        kept = xp.sum(live[:S].astype(np.int64))
+        ctx.add_flag(total - kept, "shrink", S)
+        vecs = [ColumnVector(v.data[:S], v.dtype,
+                             None if v.valid is None else v.valid[:S],
+                             v.dictionary) for v in b.vectors]
+        return ColumnBatch(b.names, vecs, live[:S], S)
+
+    def __repr__(self):
+        return f"AggShrink({self.out_rows})"
+
+
 class PSort(PhysicalPlan):
     def __init__(self, orders: Sequence[Tuple[Expression, bool, bool]],
                  child: PhysicalPlan):

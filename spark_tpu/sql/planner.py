@@ -145,6 +145,8 @@ def _walk_plan_caps(pq: PlannedQuery):
         ch = [cap(c) for c in node.children]
         if isinstance(node, P.PAggregate) and not node.keys:
             return 1            # global aggregate: capacity-1 output
+        if isinstance(node, P.PAggShrink):
+            return min(ch[0] if ch else 1, node.out_rows)
         if isinstance(node, PJoin):
             probe = ch[0] if ch else 1
             build = ch[1] if len(ch) > 1 else 1
@@ -246,15 +248,32 @@ class Planner:
     """Logical → physical (``SparkPlanner.strategies`` analog)."""
 
     def __init__(self, session, join_factor_override=None,
-                 for_execution: bool = True):
+                 for_execution: bool = True, agg_shrink_override=None,
+                 shrink_aggs: bool = True):
         #: None | float (every join) | list (per join construction index —
         #: chained joins must not COMPOUND one overflowing join's growth)
         self.session = session
         self.join_factor_override = join_factor_override
+        #: None | int rows: adaptively grown keyed-agg output capacity
+        #: (replaces spark.sql.agg.outputCapacity after a shrink overflow)
+        self.agg_shrink_override = agg_shrink_override
+        #: False for call sites that execute plans WITHOUT inspecting
+        #: ctx.flags: the shrink's overflow flag is its only correctness
+        #: escape hatch, so flag-blind execution must not shrink
+        self.shrink_aggs = shrink_aggs
         #: False for explain/inspection: planning must not run side
         #: effects (lazy-checkpoint materialization)
         self.for_execution = for_execution
         self._join_seq = 0
+
+    def _shrunk(self, agg: "P.PhysicalPlan") -> "P.PhysicalPlan":
+        from ..columnar import pad_capacity
+        if not self.shrink_aggs:
+            return agg
+        rows = self.agg_shrink_override
+        if rows is None:
+            rows = self.session.conf.get(C.AGG_OUTPUT_ROWS)
+        return P.PAggShrink(pad_capacity(int(rows)), agg)
 
     def next_join_factor(self) -> float:
         """Output capacity factor for the NEXT join constructed — an
@@ -328,15 +347,17 @@ class Planner:
         if isinstance(node, Filter):
             return P.PFilter(node.condition, self._to_physical(node.child, leaves))
         if isinstance(node, Aggregate):
-            return P.PAggregate(node.keys, node.aggs,
-                                self._to_physical(node.child, leaves))
+            agg = P.PAggregate(node.keys, node.aggs,
+                               self._to_physical(node.child, leaves))
+            return self._shrunk(agg) if node.keys else agg
         if isinstance(node, Sort):
             orders = [(o.child, o.ascending, o.nulls_first) for o in node.orders]
             return P.PSort(orders, self._to_physical(node.child, leaves))
         if isinstance(node, Limit):
             return P.PLimit(node.n, self._to_physical(node.child, leaves))
         if isinstance(node, Distinct):
-            return P.PDistinct(self._to_physical(node.child, leaves))
+            return self._shrunk(
+                P.PDistinct(self._to_physical(node.child, leaves)))
         from .window import WindowNode
         if isinstance(node, WindowNode):
             return P.PWindow(node.wexprs,
@@ -540,11 +561,16 @@ class QueryExecution:
                 _log.info("stage runner fallback to eager: %s", e)
 
         base_key = "local:" + self.planned.physical.key()
-        factors = self.session._adapted_factors.get(base_key)
+        adapted = self.session._adapted_factors.get(base_key)
+        if isinstance(adapted, dict):
+            factors, shrink = adapted.get("join"), adapted.get("shrink")
+        else:                      # legacy entries: bare per-join list
+            factors, shrink = adapted, None
         grew = False
         for attempt in range(self.MAX_ADAPT + 1):
-            pq = self.planned if factors is None \
-                else Planner(self.session, join_factor_override=factors) \
+            pq = self.planned if factors is None and shrink is None \
+                else Planner(self.session, join_factor_override=factors,
+                             agg_shrink_override=shrink) \
                 .plan(self.optimized)
             if grew:
                 # exact per-join allocation guard (replaces the old
@@ -555,14 +581,16 @@ class QueryExecution:
                 check_planned_join_capacities(pq, self.session)
             result, ratio = self._run_planned(pq)
             if ratio <= 0.0:
-                if factors is not None:
-                    self.session._adapted_factors[base_key] = factors
+                if factors is not None or shrink is not None:
+                    self.session._adapted_factors[base_key] = {
+                        "join": factors, "shrink": shrink}
                 return result
             if attempt == self.MAX_ADAPT:
                 raise RuntimeError(
-                    f"join output still overflows after {attempt} adaptive "
-                    f"retries (factors {factors}); raise "
-                    f"{C.JOIN_OUTPUT_FACTOR.key} explicitly (growth is "
+                    f"join/agg output still overflows after {attempt} "
+                    f"adaptive retries (factors {factors}, agg capacity "
+                    f"{shrink}); raise {C.JOIN_OUTPUT_FACTOR.key} / "
+                    f"{C.AGG_OUTPUT_ROWS.key} explicitly (join growth is "
                     f"bounded by {C.JOIN_OUTPUT_MAX_ROWS.key})")
             # grow ONLY the joins that overflowed (positional): a chained
             # plan must not compound one hot join's factor into every join
@@ -577,11 +605,25 @@ class QueryExecution:
                     prev = cur[i] if cur[i] is not None else base_f
                     cur[i] = grow_capacity_factor(prev, r)
             factors = cur
+            # grow the keyed-agg output capacity past the measured group
+            # count (ONE bound for all aggs in the plan: capacity growth
+            # cannot corrupt results, only spend memory)
+            lost = getattr(self, "_last_shrink", [])
+            if any(l > 0 for l, _c in lost):
+                from ..columnar import pad_capacity
+                # 2x floor: MXU bucket tables can spread live groups
+                # across [0, bucket_cap), so growth must make geometric
+                # progress even when the measured lost count is small
+                need = max(max(c + l, 2 * c) for l, c in lost if l > 0)
+                shrink = pad_capacity(int(need * 1.25))
+                _log.warning("agg output capacity overflowed; growing to "
+                             "%d rows", shrink)
             grew = True
             _log.warning(
-                "join output overflowed its static capacity by %.0f%%; "
-                "replanning with per-join factors %s", ratio * 100,
-                ["%.2f" % f if f else "-" for f in factors])
+                "join/agg output overflowed its static capacity by "
+                "%.0f%%; replanning with per-join factors %s, agg "
+                "capacity %s", ratio * 100,
+                ["%.2f" % f if f else "-" for f in factors], shrink)
 
     def _run_planned(self, pq: PlannedQuery) -> Tuple[ColumnBatch, float]:
         """One execution attempt → (host result, worst overflow ratio).
@@ -630,6 +672,10 @@ class QueryExecution:
                 int(f) / max(c, 1)
                 for f, c, k in zip(ctx.flags, ctx.flag_caps, ctx.flag_kinds)
                 if k == "join"]
+            self._last_shrink = [
+                (int(f), c)
+                for f, c, k in zip(ctx.flags, ctx.flag_caps, ctx.flag_kinds)
+                if k == "shrink"]
             self.metrics = {(oid, lbl): int(v)
                             for oid, lbl, v in ctx.metrics}
             return compact(np, out.to_host()), ratio
@@ -668,6 +714,9 @@ class QueryExecution:
             f / max(c, 1)
             for f, c, k in zip(int_flags, flag_caps, flag_kinds)
             if k == "join"]
+        self._last_shrink = [
+            (f, c) for f, c, k in zip(int_flags, flag_caps, flag_kinds)
+            if k == "shrink"]
         self.metrics = {k: int(np.asarray(v))
                         for k, v in zip(metric_keys, metric_vals)}
         return _slice_to_host(result, int(np.asarray(n_rows))), ratio

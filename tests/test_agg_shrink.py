@@ -1,0 +1,96 @@
+"""Adaptive keyed-aggregate output capacity (PAggShrink).
+
+Keyed agg/distinct outputs are sliced to `spark.sql.agg.outputCapacity`
+rows so downstream sorts/joins stop paying full-input-capacity work for
+a handful of groups (q3: 64 brands in a 4M batch); a traced overflow
+flag + adaptive retry grows the bound when the true group count exceeds
+it — the join-output-factor discipline applied to aggregation
+(`HashAggregateExec` outputs are naturally |groups|-sized; static
+shapes force bound-and-grow)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_tpu.config as C
+from spark_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def tiny_cap(spark):
+    old = spark.conf.get(C.AGG_OUTPUT_ROWS)
+    spark.conf.set(C.AGG_OUTPUT_ROWS.key, "64")
+    # adapted capacities are cached per plan: clear so each test measures
+    spark._adapted_factors.clear()
+    yield spark
+    spark.conf.set(C.AGG_OUTPUT_ROWS.key, str(old))
+
+
+def _table(spark, n=5000, nkeys=500, seed=3):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, nkeys, n).astype(np.int64)
+    v = rng.integers(0, 100, n).astype(np.int64)
+    return spark.createDataFrame({"k": k, "v": v}), \
+        pd.DataFrame({"k": k, "v": v})
+
+
+def test_shrink_overflow_grows_and_stays_exact(tiny_cap):
+    """500 groups against a 64-row bound: the retry loop must grow the
+    capacity and deliver the exact group table."""
+    df, pdf = _table(tiny_cap)
+    got = {r["k"]: r["s"] for r in
+           df.groupBy("k").agg(F.sum("v").alias("s")).collect()}
+    exp = pdf.groupby("k").v.sum()
+    assert len(got) == len(exp)
+    assert all(got[k] == v for k, v in exp.items())
+
+
+def test_shrunk_agg_feeds_sort_and_limit(tiny_cap):
+    """The q3 shape: groupBy → orderBy desc → limit over a shrunk (and
+    re-grown) group table."""
+    df, pdf = _table(tiny_cap)
+    got = [(r["k"], r["s"]) for r in
+           (df.groupBy("k").agg(F.sum("v").alias("s"))
+            .orderBy(F.col("s").desc(), F.col("k")).limit(10).collect())]
+    exp = (pdf.groupby("k", as_index=False).v.sum()
+           .rename(columns={"v": "s"})
+           .sort_values(["s", "k"], ascending=[False, True]).head(10))
+    assert got == list(zip(exp.k, exp.s))
+
+
+def test_distinct_shrinks_and_grows(tiny_cap):
+    df, pdf = _table(tiny_cap, n=3000, nkeys=400)
+    got = sorted(r["k"] for r in df.select("k").distinct().collect())
+    assert got == sorted(pdf.k.unique())
+
+
+def test_no_overflow_when_groups_fit(spark):
+    """Group counts under the default bound must not trigger any retry
+    (the shrink is lossless when groups fit)."""
+    df, pdf = _table(spark, n=2000, nkeys=30)
+    got = {r["k"]: r["s"] for r in
+           df.groupBy("k").agg(F.count("*").alias("s")).collect()}
+    exp = pdf.groupby("k").size()
+    assert all(got[k] == v for k, v in exp.items())
+
+
+def test_distributed_shrink_grows_and_stays_exact(spark):
+    """The same bound-and-grow on the 8-device mesh: per-shard group
+    tables shrink, the overflow rides the shard_map's shrink channel,
+    and the retry grows the capacity."""
+    spark.conf.set("spark.tpu.mesh.shards", "8")
+    old = spark.conf.get(C.AGG_OUTPUT_ROWS)
+    spark.conf.set(C.AGG_OUTPUT_ROWS.key, "64")
+    spark._adapted_factors.clear()
+    try:
+        df, pdf = _table(spark, n=4000, nkeys=300, seed=9)
+        got = {r["k"]: r["s"] for r in
+               df.groupBy("k").agg(F.sum("v").alias("s")).collect()}
+        exp = pdf.groupby("k").v.sum()
+        assert len(got) == len(exp)
+        assert all(got[k] == v for k, v in exp.items())
+        got_d = sorted(r["k"] for r in df.select("k").distinct().collect())
+        assert got_d == sorted(pdf.k.unique())
+    finally:
+        spark.conf.set(C.AGG_OUTPUT_ROWS.key, str(old))
+        spark.conf.set("spark.tpu.mesh.shards", "1")
