@@ -141,54 +141,72 @@ def cmd_launch(args) -> int:
     import subprocess
 
     if args.process_id is None:
-        coord = args.coordinator
-        if coord is None:
-            # ephemeral-port probe: closed before process 0's coordinator
-            # rebinds it — a small TOCTOU window another process could
-            # steal the port in (kernels rarely reassign a just-released
-            # ephemeral port, and jax's coordinator sets SO_REUSEADDR);
-            # pass --coordinator explicitly on busy shared hosts
-            with socket.socket() as s:
-                s.bind(("localhost", 0))
-                coord = f"localhost:{s.getsockname()[1]}"
-        procs = []
-        try:
-            for i in range(args.processes):
-                argv = [sys.executable, "-m", "spark_tpu.cli", "launch",
-                        "--coordinator", coord,
-                        "--processes", str(args.processes),
-                        "--process-id", str(i)]
-                for c in args.conf:
-                    argv += ["--conf", c]
-                argv += [args.script] + list(args.script_args)
-                procs.append(subprocess.Popen(argv))
-        except Exception:
-            # partial spawn: the already-started workers would spin at
-            # the rendezvous for jax's whole init timeout
-            for pr in procs:
-                pr.terminate()
-            raise
-        # any worker failing (incl. SIGNAL deaths, which report negative)
-        # fails the launch and kills the siblings — otherwise survivors
-        # spin at the jax.distributed rendezvous for its full timeout.
-        # The REPORTED code is the FIRST failure's (the cause), not the
-        # SIGTERM this launcher then sends to the others.
-        first_rc = 0
-        pending = set(procs)
-        while pending:
-            for pr in list(pending):
-                status = pr.poll()
-                if status is None:
-                    continue
-                pending.discard(pr)
-                if status != 0 and first_rc == 0:
-                    first_rc = 128 + abs(status) if status < 0 \
-                        else status
-                    for other in pending:
-                        other.terminate()
-            if pending:
-                import time as _t
-                _t.sleep(0.1)
+        # negatives clamp to 0 (no infinite-restart mode: a crash-looping
+        # gang burns the TPU reservation; supervise with a real orchestrator
+        # if unbounded restarts are wanted)
+        max_restarts = max(0, getattr(args, "max_restarts", 0) or 0)
+        for attempt in range(max_restarts + 1):
+            coord = args.coordinator
+            if coord is None:
+                # ephemeral-port probe: closed before process 0's
+                # coordinator rebinds it — a small TOCTOU window another
+                # process could steal the port in (kernels rarely
+                # reassign a just-released ephemeral port, and jax's
+                # coordinator sets SO_REUSEADDR); pass --coordinator
+                # explicitly on busy shared hosts.  Re-probed per attempt:
+                # a crashed gang can leave the old port in TIME_WAIT.
+                with socket.socket() as s:
+                    s.bind(("localhost", 0))
+                    coord = f"localhost:{s.getsockname()[1]}"
+            procs = []
+            try:
+                for i in range(args.processes):
+                    argv = [sys.executable, "-m", "spark_tpu.cli",
+                            "launch", "--coordinator", coord,
+                            "--processes", str(args.processes),
+                            "--process-id", str(i)]
+                    for c in args.conf:
+                        argv += ["--conf", c]
+                    argv += [args.script] + list(args.script_args)
+                    procs.append(subprocess.Popen(argv))
+            except Exception:
+                # partial spawn: the already-started workers would spin
+                # at the rendezvous for jax's whole init timeout
+                for pr in procs:
+                    pr.terminate()
+                raise
+            # any worker failing (incl. SIGNAL deaths, which report
+            # negative) fails the attempt and kills the siblings —
+            # otherwise survivors spin at the jax.distributed rendezvous
+            # for its full timeout.  The REPORTED code is the FIRST
+            # failure's (the cause), not the SIGTERM this launcher then
+            # sends to the others.
+            first_rc = 0
+            pending = set(procs)
+            while pending:
+                for pr in list(pending):
+                    status = pr.poll()
+                    if status is None:
+                        continue
+                    pending.discard(pr)
+                    if status != 0 and first_rc == 0:
+                        first_rc = 128 + abs(status) if status < 0 \
+                            else status
+                        for other in pending:
+                            other.terminate()
+                if pending:
+                    import time as _t
+                    _t.sleep(0.1)
+            if first_rc == 0:
+                return 0
+            if attempt < max_restarts:
+                # WHOLE-gang restart (collectives cannot survive a lost
+                # member): checkpointed queries resume from their WAL /
+                # multibatch checkpoints — `spark-submit --supervise`
+                # (deploy/Client.scala) semantics at gang granularity
+                print(f"[spark-tpu-launch] gang failed (rc={first_rc}); "
+                      f"restart {attempt + 1}/{max_restarts}",
+                      file=sys.stderr)
         return first_rc
 
     env_coord = args.coordinator
@@ -273,6 +291,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="host:port of process 0 (auto for local fan-out)")
     pl.add_argument("--process-id", type=int, default=None,
                     help="this process's index; omit to fan out locally")
+    pl.add_argument("--max-restarts", type=int, default=0,
+                    help="supervise: relaunch the WHOLE gang up to N "
+                         "times after a failure (checkpointed queries "
+                         "resume); the spark-submit --supervise role")
     pl.add_argument("--conf", action="append", default=[])
     pl.add_argument("script")
     pl.add_argument("script_args", nargs=argparse.REMAINDER)

@@ -228,18 +228,19 @@ class DistributedExecution:
         sized from the MEASURED worst-shard overflow and rerun — the
         static-shape answer to `ExchangeCoordinator.scala:85`-style
         adaptation (which coalesces partitions; here capacities grow)."""
+        # same adapted-parameter dict shape as the local executor
         base_key = f"dist{self.n}:adapt:" + optimized.tree_string()
-        adapted = self.session._adapted_factors.get(base_key, (None, None))
-        skew, jf = adapted[0], adapted[1]
-        shrink = adapted[2] if len(adapted) > 2 else None
+        adapted = self.session._adapted_factors.get(base_key) or {}
+        skew, jf = adapted.get("skew"), adapted.get("join")
+        shrink = adapted.get("shrink")
         grew = False
         for attempt in range(self.MAX_ADAPT + 1):
             result, ex_ratio, join_ratio, shrink_need = self._run_once(
                 optimized, skew, jf, shrink, check_caps=grew)
             if ex_ratio <= 0.0 and join_ratio <= 0.0 and shrink_need <= 0:
                 if skew is not None or jf is not None or shrink is not None:
-                    self.session._adapted_factors[base_key] = \
-                        (skew, jf, shrink)
+                    self.session._adapted_factors[base_key] = {
+                        "skew": skew, "join": jf, "shrink": shrink}
                 return result
             base_skew = skew if skew is not None \
                 else self.session.conf.get(C.EXCHANGE_SKEW_FACTOR)

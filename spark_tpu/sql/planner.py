@@ -560,12 +560,11 @@ class QueryExecution:
             except NotStreamable as e:
                 _log.info("stage runner fallback to eager: %s", e)
 
+        # ONE adapted-parameter shape for every executor:
+        # {"skew": float|None, "join": factors|None, "shrink": rows|None}
         base_key = "local:" + self.planned.physical.key()
-        adapted = self.session._adapted_factors.get(base_key)
-        if isinstance(adapted, dict):
-            factors, shrink = adapted.get("join"), adapted.get("shrink")
-        else:                      # legacy entries: bare per-join list
-            factors, shrink = adapted, None
+        adapted = self.session._adapted_factors.get(base_key) or {}
+        factors, shrink = adapted.get("join"), adapted.get("shrink")
         grew = False
         for attempt in range(self.MAX_ADAPT + 1):
             pq = self.planned if factors is None and shrink is None \
