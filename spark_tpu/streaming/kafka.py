@@ -97,9 +97,13 @@ class KafkaPythonClient(KafkaClient):
         if not servers:
             raise AnalysisException(
                 "kafka source requires kafka.bootstrap.servers")
+        # auto_offset_reset="none": the default ("latest") silently
+        # RESETS position past a retention-expired range — the WAL
+        # already committed to [start, end), so truncation must raise
         self._consumer = KafkaConsumer(
             bootstrap_servers=servers.split(","),
-            enable_auto_commit=False)
+            enable_auto_commit=False,
+            auto_offset_reset="none")
 
     def partitions(self, topic: str) -> List[int]:
         parts = self._consumer.partitions_for_topic(topic)
@@ -119,10 +123,28 @@ class KafkaPythonClient(KafkaClient):
         self._consumer.seek(tp, start)
         out: List[Tuple[int, Optional[str], str, int]] = []
         empty_polls = 0
+
+        def _text(b) -> str:
+            # surrogateescape is LOSSLESS: binary payloads (Avro,
+            # protobuf) arrive surrogate-escaped and re-encode back to
+            # the original bytes — never a mid-batch UnicodeDecodeError
+            # wedging the stream on a poison record
+            return b.decode("utf-8", "surrogateescape")
+
         # position(tp) advances past compacted/transactional gaps, so
         # reaching `end` is the loop invariant — NOT record count
         while self._consumer.position(tp) < end:
-            polled = self._consumer.poll(timeout_ms=2000)
+            try:
+                polled = self._consumer.poll(timeout_ms=2000)
+            except Exception as e:
+                if "OffsetOutOfRange" in type(e).__name__:
+                    raise AnalysisException(
+                        f"kafka offsets [{start}, {end}) for "
+                        f"{topic}/{partition} expired from broker "
+                        "retention but are committed in the offset WAL "
+                        "— exactly-once replay is impossible; reset the "
+                        "checkpoint or extend broker retention") from e
+                raise
             recs = polled.get(tp, [])
             if not recs:
                 empty_polls += 1
@@ -138,8 +160,8 @@ class KafkaPythonClient(KafkaClient):
             for rec in recs:
                 if rec.offset >= end:
                     break
-                key = rec.key.decode() if rec.key is not None else None
-                val = rec.value.decode() if rec.value is not None else ""
+                key = _text(rec.key) if rec.key is not None else None
+                val = _text(rec.value) if rec.value is not None else ""
                 out.append((rec.offset, key, val,
                             int(rec.timestamp) * 1000))        # ms→us
         return out

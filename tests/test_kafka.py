@@ -158,9 +158,13 @@ class _FakeConsumer:
     ENDS = {0: 2, 1: 1, 2: 6}
     STALL = set()          # partitions whose polls always come back empty
 
-    def __init__(self, bootstrap_servers=None, enable_auto_commit=True):
+    def __init__(self, bootstrap_servers=None, enable_auto_commit=True,
+                 auto_offset_reset="latest"):
         assert enable_auto_commit is False, \
             "adapter must disable auto-commit: offsets belong to the WAL"
+        assert auto_offset_reset == "none", \
+            "adapter must not let the consumer silently reset expired " \
+            "offsets (the WAL already committed to the range)"
         self._pos = {}
 
     def partitions_for_topic(self, topic):

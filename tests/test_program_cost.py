@@ -172,3 +172,108 @@ def test_sort_program_traffic(one_shard):
     assert ratio <= 12.0, f"sort HBM traffic regressed: {ratio:.1f}x input"
     assert flops_per_row <= 60.0, \
         f"sort flops regressed: {flops_per_row:.0f}/row"
+
+
+def test_global_agg_program_has_no_sort(spark):
+    """The keyless (global) aggregate program must contain NO sort HLO:
+    the whole point of the _global_reduce path (a full bitonic pass per
+    streamed batch was the scan lane's dominant cost)."""
+    import spark_tpu.kernels as K
+    old = K.MXU_AGG_ENABLED
+    K.MXU_AGG_ENABLED = False          # force the portable lane
+    try:
+        df = (spark.createDataFrame(
+            {"x": np.arange(1 << 14, dtype=np.int64)})
+            .agg(F.sum("x").alias("s"), F.min("x").alias("m")))
+        pq = QueryExecution(spark, df._plan).planned
+        phys = pq.physical
+
+        def step(leaves):
+            out = phys.run(P.ExecContext(jnp, leaves))
+            return out.vectors[0].data
+
+        dev = tuple(b.to_device() for b in pq.leaves)
+        hlo = jax.jit(step).lower(dev).compile().as_text()
+        assert " sort(" not in hlo and "sort.1" not in hlo, \
+            "global aggregate re-grew a sort"
+    finally:
+        K.MXU_AGG_ENABLED = old
+
+
+def test_multibatch_agg_step_has_no_sort_for_global(spark, tmp_path):
+    """The streamed per-batch step for scan→global-agg (the parquet scan
+    bench lane) must be sort-free END TO END: no compact (prefix-live
+    skip) and no keyless grouping sort."""
+    import pandas as pd
+    import spark_tpu.config as C
+    import spark_tpu.kernels as K
+    from spark_tpu.sql import multibatch as mb
+    from spark_tpu import io as tio
+    p = tmp_path / "t.parquet"
+    p.mkdir()
+    pd.DataFrame({"x": np.arange(4096, dtype=np.int64)}).to_parquet(
+        p / "part-0.parquet", index=False)
+    old_batch = spark.conf.get(C.SCAN_MAX_BATCH_ROWS)
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, "1024")
+    old_mxu = K.MXU_AGG_ENABLED
+    K.MXU_AGG_ENABLED = False
+    try:
+        df = spark.read.parquet(str(p)).agg(F.sum("x").alias("s"))
+        qe = QueryExecution(spark, df._plan)
+        ex = mb.plan_multibatch(spark, qe.optimized)
+        assert ex is not None
+        tmpl = next(iter(tio.scan_file_batches(
+            getattr(ex.dec, "relation", getattr(ex.dec, "rel", None)),
+            1024)))
+        jstep, _schema = ex._build_step(tmpl)
+        hlo = jstep.lower(tmpl.to_device()).compile().as_text()
+        assert " sort(" not in hlo, \
+            "streamed global-agg step re-grew a sort (compact skip or " \
+            "keyless fast path regressed)"
+    finally:
+        K.MXU_AGG_ENABLED = old_mxu
+        spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(old_batch))
+
+
+def test_shrunk_agg_bounds_downstream_sort(spark):
+    """groupBy→orderBy: the sort must run over the SHRUNK group table
+    (spark.sql.agg.outputCapacity), not the input capacity — q3's sort
+    was a full-input-capacity bitonic for 64 live groups."""
+    import spark_tpu.config as C
+    n = 1 << 18                         # input capacity 262144
+    cap = spark.conf.get(C.AGG_OUTPUT_ROWS)
+    assert cap < n
+    rng = np.random.default_rng(5)
+    df = (spark.createDataFrame(
+        {"k": rng.integers(0, 64, n).astype(np.int64),
+         "v": rng.integers(0, 100, n).astype(np.int64)})
+        .groupBy("k").agg(F.sum("v").alias("s"))
+        .orderBy(F.col("s").desc()))
+    import re
+    from spark_tpu.sql.planner import Planner
+
+    def full_width_sorts(shrink_aggs: bool) -> tuple:
+        pq = Planner(spark, shrink_aggs=shrink_aggs).plan(
+            QueryExecution(spark, df._plan).optimized)
+        phys = pq.physical
+
+        def step(leaves):
+            out = phys.run(P.ExecContext(jnp, leaves))
+            return out.vectors[0].data
+
+        dev = tuple(b.to_device() for b in pq.leaves)
+        hlo = jax.jit(step).lower(dev).compile().as_text()
+        widths = [int(w) for w in
+                  re.findall(r"sort\.?\d* = [^\n]*?\[(\d+)", hlo)]
+        return widths, sum(1 for w in widths if w >= n)
+
+    # the aggregation itself owns full-width sorts (the cond's compiled
+    # slow branch); the SHRUNK plan must run the orderBy at the bounded
+    # capacity, removing at least one full-width sort vs the unshrunk
+    widths_on, full_on = full_width_sorts(True)
+    widths_off, full_off = full_width_sorts(False)
+    assert any(w <= cap for w in widths_on), \
+        "expected the orderBy sort at the shrunk capacity"
+    assert full_on < full_off, \
+        (f"agg shrink no longer bounds the downstream sort: "
+         f"{widths_on} vs unshrunk {widths_off}")
