@@ -1013,7 +1013,18 @@ def _run_breaker(session, stream: BatchStream, breaker: L.LogicalPlan,
                 _log.info("stage breaker early exit")
                 break
         if merger is None:
-            return ColumnBatch.empty(breaker.schema())
+            # ZERO input batches (e.g. a streamed UNION whose branches
+            # all filtered empty): the breaker still aggregates the
+            # empty input — a keyless Aggregate emits its one global row
+            # (SUM=NULL, COUNT=0), keyed/sort/distinct/limit stay empty.
+            # Evaluating the breaker over an empty relation gets every
+            # case right instead of hand-special-casing them.
+            empty = _empty_side(stream.schema,
+                                getattr(stream, "_dicts", {}) or {})
+            plan: L.LogicalPlan = _rebase(breaker, L.LocalRelation(empty))
+            if topk is not None:
+                plan = L.Limit(topk, plan)
+            return _eager(session, plan)
         result = merger.finish()
         return compact(np, result.to_host())
     finally:

@@ -449,3 +449,21 @@ def test_grace_bucket_fanout_chunks_instead_of_dying(st, tmp_path, caplog):
         assert any("chunking the bucket pair" in m for m in caplog.messages)
     finally:
         st.conf.set(C.JOIN_OUTPUT_MAX_ROWS.key, str(old_cap))
+
+
+def test_empty_streamed_union_global_agg(st, tmp_path):
+    """A global aggregate over a streamed UNION whose branches ALL filter
+    empty must still emit its one global row (SUM=NULL, COUNT=0) — the
+    q23 shape at small scale.  Keyed/sort/limit breakers stay empty."""
+    t = pd.DataFrame({"k": np.arange(1100, dtype=np.int64),
+                      "v": np.ones(1100, np.int64)})
+    pa_ = _write(tmp_path / "ea.parquet", t)
+    pb_ = _write(tmp_path / "eb.parquet", t)
+    a = st.read.parquet(pa_).filter(F.col("k") < 0)
+    b = st.read.parquet(pb_).filter(F.col("k") < 0)
+    u = a.union(b)
+    got = u.agg(F.sum("v").alias("s"), F.count("*").alias("c")).collect()
+    assert len(got) == 1
+    assert got[0]["s"] is None and got[0]["c"] == 0
+    assert u.groupBy("k").agg(F.sum("v")).collect() == []
+    assert u.orderBy("v").limit(5).collect() == []
