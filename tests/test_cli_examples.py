@@ -49,7 +49,7 @@ def test_submit_runs_script(tmp_path):
 
 @pytest.mark.parametrize("example", [
     "pi.py", "sql_basic.py", "streaming_window_agg.py",
-    "graphx_pagerank.py", "ml_pipeline.py",
+    "graphx_pagerank.py", "ml_pipeline.py", "jdbc_etl.py",
 ])
 def test_example(example):
     r = run([os.path.join("examples", example)])
