@@ -545,10 +545,61 @@ def file_row_count(rel: L.FileRelation) -> Optional[int]:
         import pyarrow.parquet as pq
         n = sum(pq.ParquetFile(f).metadata.num_rows for f in files)
     else:
-        batch = _load_batch(rel.fmt, rel.paths, rel.options)
-        n = int(np.asarray(batch.num_rows()))
+        st = analyzed_stats(rel)
+        if st and st.get("rows") is not None:
+            n = int(st["rows"])     # ANALYZE result: no data load needed
+        else:
+            batch = _load_batch(rel.fmt, rel.paths, rel.options)
+            n = int(np.asarray(batch.num_rows()))
     _ROW_COUNT_CACHE[key] = n
     return n
+
+
+#: ANALYZE TABLE results, keyed by the relation's identity at ANALYZE
+#: time (files+mtimes, or the jdbc url+table).  The CBO fallback for
+#: formats without free footer statistics (csv/json/text/orc/jdbc) —
+#: parquet keeps its exact, always-fresh footer path.  Mirrors the
+#: reference's ANALYZE-gathered `statsEstimation/` stats, including
+#: their staleness model (here: invalidated when file mtimes change).
+_ANALYZED_STATS: Dict[Any, dict] = {}
+
+
+def _rel_stats_key(rel: L.FileRelation):
+    """Identity of a relation FOR STATS PURPOSES: format + read options
+    (header/schema options change the logical table over the same bytes)
+    + files with mtimes (staleness token); jdbc: url + table/query."""
+    opts = tuple(sorted((str(k), str(v))
+                        for k, v in (rel.options or {}).items()))
+    if rel.fmt == "jdbc":
+        return ("jdbc", rel.paths[0], rel.options.get("dbtable"),
+                rel.options.get("query"))
+    try:
+        files = _resolve_paths(rel.paths)
+    except AnalysisException:
+        return None
+    return (rel.fmt, opts) + tuple(
+        (f, os.path.getmtime(f)) for f in files)
+
+
+def stats_key_token(rel: L.FileRelation):
+    """JSON-round-tripped form of the stats key, captured at ANALYZE
+    time and persisted with the stats: a catalog load re-registers them
+    ONLY when the current key still matches — the staleness gate."""
+    import json as _json
+    k = _rel_stats_key(rel)
+    return None if k is None else _json.loads(_json.dumps(k))
+
+
+def register_analyzed_stats(rel: L.FileRelation, stats: dict) -> None:
+    """Install ANALYZE TABLE results for this relation's current files."""
+    key = _rel_stats_key(rel)
+    if key is not None:
+        _ANALYZED_STATS[key] = stats
+
+
+def analyzed_stats(rel: L.FileRelation) -> Optional[dict]:
+    key = _rel_stats_key(rel)
+    return None if key is None else _ANALYZED_STATS.get(key)
 
 
 _COLUMN_STATS_CACHE: dict = {}
@@ -559,10 +610,11 @@ def file_column_stats(rel: L.FileRelation) -> Dict[str, dict]:
     free column statistics the reference's CBO keeps in
     `catalyst/.../plans/logical/statsEstimation/` (there gathered by
     ANALYZE TABLE; here always available because parquet already wrote
-    them).  Empty for non-parquet or stat-less files; memoized per file
-    list + mtimes."""
+    them).  Non-parquet formats fall back to ANALYZE TABLE results
+    (``analyzed_stats``); memoized per file list + mtimes."""
     if rel.fmt != "parquet":
-        return {}
+        st = analyzed_stats(rel)
+        return st.get("columns", {}) if st else {}
     try:
         files = _resolve_paths(rel.paths)
     except AnalysisException:
@@ -615,9 +667,14 @@ def file_column_ndv(rel: L.FileRelation, columns) -> Dict[str, float]:
     file; if the sample's distinct ratio is saturated (<90% unique) the
     domain is assumed reached (dimension keys, enums), otherwise the
     count scales linearly with the table (near-unique keys).  Memoized
-    per (files, mtimes, columns)."""
+    per (files, mtimes, columns).  Non-parquet formats use ANALYZE TABLE
+    results when present."""
     if rel.fmt != "parquet":
-        return {}
+        st = analyzed_stats(rel)
+        if not st:
+            return {}
+        return {c: rec["ndv"] for c, rec in st.get("columns", {}).items()
+                if c in columns and rec.get("ndv") is not None}
     try:
         files = _resolve_paths(rel.paths)
     except AnalysisException:

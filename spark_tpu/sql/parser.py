@@ -567,6 +567,15 @@ class SetCommand(Command):
         self.key, self.value = key, value
 
 
+class AnalyzeTableCommand(Command):
+    """ANALYZE TABLE t COMPUTE STATISTICS [FOR {ALL COLUMNS|COLUMNS a,b}]
+    (`AnalyzeTableCommand.scala` / `AnalyzeColumnCommand.scala` role).
+    ``columns``: None = row count only; [] = every column; else names."""
+
+    def __init__(self, name: str, columns):
+        self.name, self.columns = name, columns
+
+
 class CreateDatabaseCommand(Command):
     def __init__(self, name: str, if_not_exists: bool):
         self.name, self.if_not_exists = name, if_not_exists
@@ -675,7 +684,38 @@ class Parser:
             f"expected identifier at position {t.pos}, found {t.value!r}")
 
     # -- statements -------------------------------------------------------
+    def _at_word(self, word: str) -> bool:
+        """Case-insensitive match of a NON-RESERVED statement word (kept
+        out of the keyword set so user identifiers never break)."""
+        t = self.peek()
+        return t.kind == "IDENT" and t.value.upper() == word
+
+    def _expect_word(self, word: str) -> None:
+        if not self._at_word(word):
+            t = self.peek()
+            raise ParseException(
+                f"expected {word} at position {t.pos}, found {t.value!r}")
+        self.next()
+
     def parse_statement(self):
+        if self._at_word("ANALYZE"):
+            self.next()
+            self.expect_kw("TABLE")
+            name = self.ident()
+            self._expect_word("COMPUTE")
+            self._expect_word("STATISTICS")
+            columns = None
+            if self._at_word("FOR"):
+                self.next()
+                if self.accept_kw("ALL"):
+                    self._expect_word("COLUMNS")
+                    columns = []
+                else:
+                    self._expect_word("COLUMNS")
+                    columns = [self.ident()]
+                    while self.accept_op(","):
+                        columns.append(self.ident())
+            return AnalyzeTableCommand(name, columns)
         if self.at_kw("CREATE"):
             return self._create()
         if self.at_kw("DROP"):

@@ -152,6 +152,23 @@ class Catalog:
         with open(os.path.join(path, "_meta.json"), "w") as f:
             json.dump(meta, f)
 
+    def save_table_stats(self, name: str, stats: dict) -> bool:
+        """Persist ANALYZE TABLE results into the table's _meta.json.
+        Returns False when `name` is not a persistent table (temp views
+        keep session-only stats)."""
+        import json
+        import os
+        path = self.table_path(name)
+        meta_p = os.path.join(path, "_meta.json")
+        if not os.path.isfile(meta_p):
+            return False
+        with open(meta_p) as f:
+            meta = json.load(f)
+        meta["stats"] = stats
+        with open(meta_p, "w") as f:
+            json.dump(meta, f, default=str)
+        return True
+
     def create_empty_table(self, name: str, schema: T.StructType,
                            fmt: str = "parquet") -> None:
         import json
@@ -197,8 +214,17 @@ class Catalog:
             ("_", "."))]
         if not has_data:
             return L.LocalRelation(ColumnBatch.empty(schema))
-        return L.FileRelation(fmt, [path], schema,
-                              dict(meta.get("options") or {}))
+        rel = L.FileRelation(fmt, [path], schema,
+                             dict(meta.get("options") or {}))
+        if meta.get("stats"):
+            # ANALYZE TABLE results persisted with the table: re-register
+            # ONLY if the files are unchanged since ANALYZE (the stats
+            # carry the files+mtimes key they were gathered under; an
+            # append/rewrite makes them stale and they are dropped)
+            from .. import io as _tio
+            if meta["stats"].get("key") == _tio.stats_key_token(rel):
+                _tio.register_analyzed_stats(rel, meta["stats"])
+        return rel
 
     # -- unified lookup -----------------------------------------------------
     def lookup(self, name: str) -> L.LogicalPlan:
@@ -439,6 +465,85 @@ class SparkSession:
             return DataFrame(self, st)
         return self._run_command(st)
 
+    def _analyze_table(self, cmd, string_df) -> DataFrame:
+        """ANALYZE TABLE … COMPUTE STATISTICS [FOR COLUMNS …]: gather
+        row count and per-column min/max/null_count/NDV through the
+        engine's own (streamed, if oversized) scan, register them for
+        the CBO, and persist them with catalog tables.  The analog of
+        `AnalyzeTableCommand` / `AnalyzeColumnCommand` — the reference
+        stores these in the metastore; here they complete the stats
+        story for formats without free parquet footers (csv/json/orc/
+        text/jdbc)."""
+        from .. import io as tio
+        from . import functions as F
+        df = self.table(cmd.name)
+        node = self.catalog.lookup(cmd.name)   # resolved backing plan
+        while isinstance(node, L.SubqueryAlias):
+            node = node.children[0]
+        if not isinstance(node, L.FileRelation):
+            raise AnalysisException(
+                f"ANALYZE TABLE {cmd.name}: only file- or jdbc-backed "
+                "tables/views carry statistics (views over computed "
+                "plans re-derive them at query time)")
+        rows = df.count()
+        stats: dict = {"rows": int(rows), "columns": {},
+                       "key": tio.stats_key_token(node)}
+        if cmd.columns is None:
+            # rows-only refresh PRESERVES previously gathered column
+            # stats (the reference's AnalyzeTableCommand does the same)
+            prev = tio.analyzed_stats(node)
+            if prev:
+                stats["columns"] = prev.get("columns", {})
+        if cmd.columns is not None:
+            names = [f.name for f in node.schema().fields]
+            selected = names if cmd.columns == [] else list(cmd.columns)
+            aggs = []
+            for c in selected:
+                if c not in names:
+                    raise AnalysisException(
+                        f"ANALYZE TABLE: no such column {c!r}")
+                aggs += [F.min(c).alias(f"__mn_{c}"),
+                         F.max(c).alias(f"__mx_{c}"),
+                         F.count(c).alias(f"__ct_{c}")]
+            row = df.agg(*aggs).collect()[0]
+            # NDV separately per column: one aggregate may carry only
+            # one distinct column (engine limitation; the reference's
+            # AnalyzeColumnCommand likewise scans per column set)
+            ndvs = {}
+            for c in selected:
+                ndvs[c] = float(df.agg(
+                    F.approx_count_distinct(c).alias("nd")).collect()[0]["nd"])
+
+            def plain(v):
+                # only JSON-native types survive: stringified timestamps/
+                # decimals would change type across a persist/reload and
+                # silently alter selectivity estimation between sessions
+                v = v.item() if hasattr(v, "item") else v
+                return v if isinstance(v, (int, float, str, bool)) \
+                    or v is None else None
+
+            for c in selected:
+                stats["columns"][c] = {
+                    "min": plain(row[f"__mn_{c}"]),
+                    "max": plain(row[f"__mx_{c}"]),
+                    "null_count": int(rows) - int(row[f"__ct_{c}"]),
+                    "total": int(rows),
+                    "ndv": ndvs[c],
+                }
+        tio.register_analyzed_stats(node, stats)
+        # persist ONLY when the name resolves to the persistent table —
+        # a temp view shadowing a same-named table must not plant its
+        # stats in the table's _meta.json
+        persisted = False
+        if cmd.name.lower() not in self.catalog._views:
+            persisted = self.catalog.save_table_stats(cmd.name, stats)
+        return string_df({
+            "table": [cmd.name],
+            "rows": [str(rows)],
+            "columns_analyzed": [str(len(stats["columns"]))],
+            "persisted": [str(persisted).lower()],
+        })
+
     def _run_command(self, cmd) -> DataFrame:
         from . import parser as P
         from ..columnar import ColumnBatch
@@ -453,6 +558,8 @@ class SparkSession:
             return DataFrame(
                 self, L.LocalRelation(ColumnBatch.from_arrays(cols, schema=struct)))
 
+        if isinstance(cmd, P.AnalyzeTableCommand):
+            return self._analyze_table(cmd, string_df)
         if isinstance(cmd, P.CreateViewCommand):
             # conflict-check TEMP VIEWS only: a temp view may shadow a
             # persistent table of the same name

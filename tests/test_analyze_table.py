@@ -1,0 +1,164 @@
+"""ANALYZE TABLE … COMPUTE STATISTICS — CBO stats for footer-less formats.
+
+Reference: `sql/core/.../command/AnalyzeTableCommand.scala` and
+`AnalyzeColumnCommand.scala` (stats into the metastore, consumed by
+`statsEstimation/`).  Here: row count + per-column min/max/null/NDV
+gathered through the engine's own scan, registered for the optimizer's
+selectivity/NDV probes (parquet keeps its exact footer path), persisted
+into catalog tables' _meta.json."""
+
+import os
+import sqlite3
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_tpu import io as tio
+from spark_tpu.expressions import AnalysisException
+
+
+@pytest.fixture()
+def csv_view(spark, tmp_path):
+    pdf = pd.DataFrame({
+        "k": np.arange(500, dtype=np.int64) % 40,
+        "v": np.arange(500, dtype=np.int64) * 3,
+    })
+    d = tmp_path / "t.csv"
+    d.mkdir()
+    pdf.to_csv(d / "part-0.csv", index=False)
+    df = (spark.read.option("header", "true")
+          .option("inferschema", "true").csv(str(d)))
+    df.createOrReplaceTempView("analyze_me")
+    return df, pdf
+
+
+def test_analyze_collects_and_registers(spark, csv_view):
+    df, pdf = csv_view
+    out = spark.sql(
+        "ANALYZE TABLE analyze_me COMPUTE STATISTICS FOR ALL COLUMNS"
+    ).collect()
+    assert out[0]["rows"] == "500"
+    rel = df._plan
+    from spark_tpu.sql.logical import SubqueryAlias
+    while isinstance(rel, SubqueryAlias):
+        rel = rel.children[0]
+    st = tio.analyzed_stats(rel)
+    assert st["rows"] == 500
+    assert st["columns"]["k"]["min"] == 0
+    assert st["columns"]["k"]["max"] == 39
+    assert st["columns"]["v"]["max"] == 499 * 3
+    assert st["columns"]["k"]["null_count"] == 0
+    assert abs(st["columns"]["k"]["ndv"] - 40) <= 4       # approx
+    # the optimizer's stats probes now see the csv relation
+    assert tio.file_column_stats(rel)["k"]["max"] == 39
+    assert 30 <= tio.file_column_ndv(rel, ["k"])["k"] <= 50
+    assert tio.file_row_count(rel) == 500
+
+
+def test_analyze_specific_columns(spark, csv_view):
+    df, _ = csv_view
+    spark.sql("ANALYZE TABLE analyze_me COMPUTE STATISTICS FOR COLUMNS k")
+    rel = df._plan
+    from spark_tpu.sql.logical import SubqueryAlias
+    while isinstance(rel, SubqueryAlias):
+        rel = rel.children[0]
+    st = tio.analyzed_stats(rel)
+    assert list(st["columns"]) == ["k"]
+    with pytest.raises(AnalysisException, match="no such column"):
+        spark.sql("ANALYZE TABLE analyze_me COMPUTE STATISTICS "
+                  "FOR COLUMNS nope")
+
+
+def test_analyze_persists_with_catalog_table(spark, tmp_path):
+    pdf = pd.DataFrame({"a": np.arange(100, dtype=np.int64)})
+    df = spark.createDataFrame(pdf)
+    spark.catalog.save_table("an_tbl", df, fmt="csv", mode="overwrite",
+                             options={"header": "true",
+                                      "inferschema": "true"})
+    out = spark.sql("ANALYZE TABLE an_tbl COMPUTE STATISTICS "
+                    "FOR ALL COLUMNS").collect()
+    assert out[0]["persisted"] == "true"
+    # a fresh lookup (fresh stats registry) re-registers from _meta.json
+    tio._ANALYZED_STATS.clear()
+    rel = spark.catalog.lookup("an_tbl")
+    st = tio.analyzed_stats(rel)
+    assert st is not None and st["rows"] == 100
+    assert st["columns"]["a"]["max"] == 99
+    spark.catalog.drop_table("an_tbl")
+
+
+def test_analyze_jdbc_relation(spark, tmp_path):
+    db = tmp_path / "an.db"
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (x INTEGER)")
+    conn.executemany("INSERT INTO t VALUES (?)",
+                     [(i,) for i in range(200)])
+    conn.commit(); conn.close()
+    spark.read.jdbc(f"jdbc:sqlite:{db}", "t").createOrReplaceTempView(
+        "jdbc_t")
+    spark.sql("ANALYZE TABLE jdbc_t COMPUTE STATISTICS FOR ALL COLUMNS")
+    rel = spark.catalog.lookup("jdbc_t")
+    from spark_tpu.sql.logical import SubqueryAlias
+    while isinstance(rel, SubqueryAlias):
+        rel = rel.children[0]
+    st = tio.analyzed_stats(rel)
+    assert st["rows"] == 200 and st["columns"]["x"]["max"] == 199
+    assert tio.file_column_stats(rel)["x"]["min"] == 0
+
+
+def test_stale_stats_dropped_on_file_change(spark, tmp_path):
+    pdf = pd.DataFrame({"a": np.arange(50, dtype=np.int64)})
+    df = spark.createDataFrame(pdf)
+    spark.catalog.save_table("an_stale", df, fmt="csv", mode="overwrite",
+                             options={"header": "true",
+                                      "inferschema": "true"})
+    spark.sql("ANALYZE TABLE an_stale COMPUTE STATISTICS FOR ALL COLUMNS")
+    # append more data AFTER analyze: files (and mtimes) change
+    import time
+    time.sleep(0.05)
+    spark.createDataFrame(
+        pd.DataFrame({"a": np.arange(50, 500, dtype=np.int64)})
+    ).write.mode("append").option("header", "true").format("csv").save(
+        spark.catalog.table_path("an_stale"))
+    tio._ANALYZED_STATS.clear()
+    rel = spark.catalog.lookup("an_stale")
+    assert tio.analyzed_stats(rel) is None, \
+        "stale ANALYZE stats must not be re-registered after file changes"
+    spark.catalog.drop_table("an_stale")
+
+
+def test_rows_only_refresh_preserves_column_stats(spark, csv_view):
+    df, _ = csv_view
+    spark.sql("ANALYZE TABLE analyze_me COMPUTE STATISTICS FOR COLUMNS k")
+    spark.sql("ANALYZE TABLE analyze_me COMPUTE STATISTICS")
+    rel = spark.catalog.lookup("analyze_me")
+    from spark_tpu.sql.logical import SubqueryAlias
+    while isinstance(rel, SubqueryAlias):
+        rel = rel.children[0]
+    st = tio.analyzed_stats(rel)
+    assert st["rows"] == 500 and "k" in st["columns"]
+
+
+def test_shadow_view_does_not_persist_into_table(spark, tmp_path):
+    spark.catalog.save_table(
+        "an_shadow", spark.createDataFrame(
+            pd.DataFrame({"a": np.arange(10, dtype=np.int64)})),
+        fmt="csv", mode="overwrite",
+        options={"header": "true", "inferschema": "true"})
+    # a temp view SHADOWS the table with different data
+    pdf = pd.DataFrame({"z": np.arange(7, dtype=np.int64)})
+    d = tmp_path / "shadow.csv"
+    d.mkdir()
+    pdf.to_csv(d / "p.csv", index=False)
+    (spark.read.option("header", "true").option("inferschema", "true")
+     .csv(str(d)).createOrReplaceTempView("an_shadow"))
+    out = spark.sql("ANALYZE TABLE an_shadow COMPUTE STATISTICS "
+                    "FOR ALL COLUMNS").collect()
+    assert out[0]["persisted"] == "false"
+    import json
+    meta = json.load(open(os.path.join(
+        spark.catalog.table_path("an_shadow"), "_meta.json")))
+    assert "stats" not in meta
+    spark.catalog.drop("an_shadow")
+    spark.catalog.drop_table("an_shadow")
