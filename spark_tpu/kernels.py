@@ -116,11 +116,25 @@ def take_batch(xp, batch: ColumnBatch, perm: Array) -> ColumnBatch:
 
 
 def compact(xp, batch: ColumnBatch) -> ColumnBatch:
-    """Move live rows to the front, preserving order (stable)."""
+    """Move live rows to the front, preserving order (stable).
+
+    Device path: ONE single-operand uint32 sort — the dead flag rides
+    the iota's top bit (capacity < 2^31 always), so the sorted values
+    ARE the permutation: live rows (bit clear) sort first in original
+    order, dead rows after.  Half the comparator/permute work of the
+    two-operand (flag, iota) formulation on the TPU's bitonic sort."""
     if batch.row_valid is None:
         return batch
-    dead = (~batch.row_valid).astype(np.int8)
-    perm = multi_key_argsort(xp, [dead], batch.capacity)
+    if _is_np(xp):
+        dead = (~batch.row_valid).astype(np.int8)
+        perm = multi_key_argsort(xp, [dead], batch.capacity)
+        return take_batch(xp, batch, perm)
+    import jax
+    dead = ~batch.row_valid
+    iota = xp.arange(batch.capacity, dtype=np.uint32)
+    packed = iota | (dead.astype(np.uint32) << np.uint32(31))
+    (packed_s,) = jax.lax.sort((packed,), num_keys=1, is_stable=False)
+    perm = (packed_s & np.uint32(0x7FFFFFFF)).astype(np.int32)
     return take_batch(xp, batch, perm)
 
 
