@@ -558,8 +558,8 @@ class ShowTablesCommand(Command):
 
 
 class DescribeCommand(Command):
-    def __init__(self, name: str):
-        self.name = name
+    def __init__(self, name: str, extended: bool = False):
+        self.name, self.extended = name, extended
 
 
 class SetCommand(Command):
@@ -733,8 +733,17 @@ class Parser:
             return ShowTablesCommand()
         if self.at_kw("DESCRIBE"):
             self.next()
+            # Spark's grammar is DESCRIBE [TABLE] [EXTENDED] name, but
+            # DESCRIBE EXTENDED name (no TABLE) is the common form —
+            # accept EXTENDED on either side of the optional TABLE
+            extended = self._at_word("EXTENDED")
+            if extended:
+                self.next()
             self.accept_kw("TABLE")
-            return DescribeCommand(self.ident())
+            if not extended and self._at_word("EXTENDED"):
+                self.next()
+                extended = True
+            return DescribeCommand(self.ident(), extended)
         if self.at_kw("EXPLAIN"):
             self.next()
             extended = False

@@ -465,6 +465,12 @@ class SparkSession:
             return DataFrame(self, st)
         return self._run_command(st)
 
+    @staticmethod
+    def _unwrap_aliases(node):
+        while isinstance(node, L.SubqueryAlias):
+            node = node.children[0]
+        return node
+
     def _analyze_table(self, cmd, string_df) -> DataFrame:
         """ANALYZE TABLE … COMPUTE STATISTICS [FOR COLUMNS …]: gather
         row count and per-column min/max/null_count/NDV through the
@@ -477,9 +483,7 @@ class SparkSession:
         from .. import io as tio
         from . import functions as F
         df = self.table(cmd.name)
-        node = self.catalog.lookup(cmd.name)   # resolved backing plan
-        while isinstance(node, L.SubqueryAlias):
-            node = node.children[0]
+        node = self._unwrap_aliases(self.catalog.lookup(cmd.name))
         if not isinstance(node, L.FileRelation):
             raise AnalysisException(
                 f"ANALYZE TABLE {cmd.name}: only file- or jdbc-backed "
@@ -652,11 +656,36 @@ class SparkSession:
                 "isTemporary": ["false" if n in persistent else "true"
                                 for n in names]})
         if isinstance(cmd, P.DescribeCommand):
-            schema = DataFrame(self, self.catalog.lookup(cmd.name)).schema
-            return string_df({
-                "col_name": [f.name for f in schema.fields],
-                "data_type": [f.dataType.simpleString() for f in schema.fields],
-                "comment": [""] * len(schema.fields)})
+            plan = self.catalog.lookup(cmd.name)
+            schema = DataFrame(self, plan).schema
+            if not cmd.extended:
+                return string_df({
+                    "col_name": [f.name for f in schema.fields],
+                    "data_type": [f.dataType.simpleString()
+                                  for f in schema.fields],
+                    "comment": [""] * len(schema.fields)})
+            # DESCRIBE EXTENDED: append ANALYZE TABLE statistics when
+            # registered (DescribeTableCommand's stats section)
+            from .. import io as tio
+            node = self._unwrap_aliases(plan)
+            st = tio.analyzed_stats(node) \
+                if isinstance(node, L.FileRelation) else None
+            cols = st.get("columns", {}) if st else {}
+
+            def fmt_stats(name):
+                rec = cols.get(name)
+                if not rec:
+                    return ""
+                return (f"min={rec.get('min')} max={rec.get('max')} "
+                        f"nulls={rec.get('null_count')} "
+                        f"ndv={rec.get('ndv')}")
+
+            names = [f.name for f in schema.fields] + ["# rows"]
+            dts = [f.dataType.simpleString() for f in schema.fields] + [""]
+            comments = [fmt_stats(f.name) for f in schema.fields] + [
+                str(st["rows"]) if st else "<not analyzed>"]
+            return string_df({"col_name": names, "data_type": dts,
+                              "comment": comments})
         if isinstance(cmd, P.SetCommand):
             if cmd.key is not None and cmd.value is not None:
                 self.conf.set(cmd.key, cmd.value)

@@ -162,3 +162,22 @@ def test_shadow_view_does_not_persist_into_table(spark, tmp_path):
     assert "stats" not in meta
     spark.catalog.drop("an_shadow")
     spark.catalog.drop_table("an_shadow")
+
+
+def test_describe_extended_shows_stats(spark, csv_view):
+    spark.sql("ANALYZE TABLE analyze_me COMPUTE STATISTICS FOR ALL COLUMNS")
+    rows = spark.sql("DESCRIBE EXTENDED analyze_me").collect()
+    by_name = {r["col_name"]: r["comment"] for r in rows}
+    assert by_name["# rows"] == "500"
+    assert "min=0" in by_name["k"] and "max=39" in by_name["k"]
+    plain = spark.sql("DESCRIBE analyze_me").collect()
+    assert all(r["comment"] == "" for r in plain)
+
+
+def test_describe_table_extended_order(spark, csv_view):
+    """Both DESCRIBE EXTENDED t and DESCRIBE TABLE EXTENDED t parse."""
+    spark.sql("ANALYZE TABLE analyze_me COMPUTE STATISTICS")
+    for stmt in ("DESCRIBE EXTENDED analyze_me",
+                 "DESCRIBE TABLE EXTENDED analyze_me"):
+        rows = spark.sql(stmt).collect()
+        assert rows[-1]["col_name"] == "# rows"
