@@ -281,8 +281,12 @@ def _bench_hash_agg(jax, jnp, np, session):
     return N * ITERS / dt
 
 
-def _bench_q3_join(jax, jnp, np, session):
-    """TPC-DS q3 shape: fact ⋈ dim (broadcast) → filter → group-sum → sort."""
+def _bench_q3_join(jax, jnp, np, session, with_sort: bool = True):
+    """TPC-DS q3 shape: fact ⋈ dim (broadcast) → filter → group-sum → sort.
+
+    ``with_sort=False`` drops the final orderBy — the fallback program
+    when the full plan crashes a remote compiler (round-1 HTTP 500), so
+    the lane still lands a join+agg number with the failure on record."""
     from spark_tpu.columnar import ColumnBatch, ColumnVector
     from spark_tpu.kernels import compact
     from spark_tpu.sql import functions as F
@@ -301,8 +305,9 @@ def _bench_q3_join(jax, jnp, np, session):
                                    "year": d_year})
     q = (fact.join(dim, fact["sk"] == dim["d_sk"])
              .filter(dim["year"] == 2000)
-             .groupBy("brand").agg(F.sum("price").alias("rev"))
-             .orderBy(F.col("rev").desc()))
+             .groupBy("brand").agg(F.sum("price").alias("rev")))
+    if with_sort:
+        q = q.orderBy(F.col("rev").desc())
     pq = QueryExecution(session, q._plan).planned
     physical = pq.physical
 
@@ -488,6 +493,16 @@ def child_main() -> None:
     lane("q3", lambda: _bench_q3_join(jax, jnp, np, session),
          BASELINE_JOIN_ROWS_PER_S,
          "q3_join_agg_sort_rows_per_sec", "q3_vs_join_baseline")
+    if "q3_error" in extras:
+        # full q3 crashed (remote-compile HTTP 500 class): land the
+        # join+agg number without the final sort, keep the error on
+        # record so the regression stays visible
+        lane("q3_nosort",
+             lambda: _bench_q3_join(jax, jnp, np, session,
+                                    with_sort=False),
+             BASELINE_JOIN_ROWS_PER_S,
+             "q3_join_agg_rows_per_sec_nosort",
+             "q3_nosort_vs_join_baseline")
     lane("sort", lambda: _bench_sort(jax, jnp, np, session),
          BASELINE_SORT_ROWS_PER_S,
          "sort_rows_per_sec", "sort_vs_baseline")
