@@ -1,0 +1,62 @@
+"""Bisect the q3 remote-compile HTTP 500: compile the q3 program piece
+by piece on the TPU and report the first stage that fails.  Run only
+when the tunnel is up."""
+import sys, time, traceback
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import spark_tpu  # noqa
+import jax
+import jax.numpy as jnp
+
+print("devices:", jax.devices())
+
+from spark_tpu.sql.session import SparkSession
+from spark_tpu.sql import functions as F
+from spark_tpu.sql import physical as P
+from spark_tpu.sql.planner import QueryExecution
+
+J_FACT, J_DIM, J_BRANDS = 1 << 21, 2048, 64
+rng = np.random.default_rng(11)
+spark = SparkSession.builder.getOrCreate()
+fact = spark.createDataFrame({
+    "sk": rng.integers(0, J_DIM, J_FACT).astype(np.int64),
+    "price": rng.integers(1, 1000, J_FACT).astype(np.int64)})
+dim = spark.createDataFrame({
+    "d_sk": np.arange(J_DIM, dtype=np.int64),
+    "brand": rng.integers(0, J_BRANDS, J_DIM).astype(np.int64),
+    "year": rng.integers(1998, 2003, J_DIM).astype(np.int64)})
+
+stages = {
+    "join": lambda: fact.join(dim, fact["sk"] == dim["d_sk"]),
+    "join+filter": lambda: fact.join(dim, fact["sk"] == dim["d_sk"])
+        .filter(dim["year"] == 2000),
+    "join+filter+agg": lambda: fact.join(dim, fact["sk"] == dim["d_sk"])
+        .filter(dim["year"] == 2000)
+        .groupBy("brand").agg(F.sum("price").alias("rev")),
+    "full_q3": lambda: fact.join(dim, fact["sk"] == dim["d_sk"])
+        .filter(dim["year"] == 2000)
+        .groupBy("brand").agg(F.sum("price").alias("rev"))
+        .orderBy(F.col("rev").desc()),
+}
+
+for name, build in stages.items():
+    q = build()
+    pq = QueryExecution(spark, q._plan).planned
+    physical = pq.physical
+
+    def run(leaves):
+        ctx = P.ExecContext(jnp, list(leaves))
+        out = physical.run(ctx)
+        return out.vectors[0].data, out.num_rows()
+
+    t0 = time.perf_counter()
+    try:
+        lowered = jax.jit(run).lower(tuple(b.to_device() for b in pq.leaves))
+        compiled = lowered.compile()
+        print(f"[OK]   {name}: compiled in {time.perf_counter()-t0:.1f}s")
+    except Exception as e:
+        print(f"[FAIL] {name} after {time.perf_counter()-t0:.1f}s: "
+              f"{str(e)[:500]}")
+        traceback.print_exc(limit=3)
+        # keep going: later stages may fail differently / identically
+print("bisect done")
