@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..columnar import ColumnBatch, ColumnVector
-from ..kernels import multi_key_argsort, take_batch
+from ..kernels import multi_key_argsort, searchsorted, take_batch
 from .mesh import DATA_AXIS
 
 Array = Any
@@ -53,7 +53,7 @@ def hash_exchange(batch: ColumnBatch, bucket: Array, n_shards: int,
     bs = b[perm]
     sorted_batch = take_batch(xp, batch, perm)
 
-    starts = xp.searchsorted(bs, xp.arange(n_shards, dtype=np.int32))
+    starts = searchsorted(xp, bs, xp.arange(n_shards, dtype=np.int32))
     slot = xp.arange(C) - starts[xp.clip(bs, 0, n_shards - 1)]
     ok = (bs < n_shards) & (slot < cap_out)
     overflow = xp.sum((bs < n_shards).astype(np.int64)) - xp.sum(ok.astype(np.int64))

@@ -39,7 +39,7 @@ from ..columnar import ColumnBatch, ColumnVector, pad_capacity
 from ..expressions import (
     AnalysisException, Col, EQ, EvalContext, Expression, Hash64, and_valid,
 )
-from ..kernels import multi_key_argsort, take_batch
+from ..kernels import multi_key_argsort, searchsorted, take_batch
 from .logical import Join
 from . import physical as P
 
@@ -290,8 +290,8 @@ class PJoin(P.PhysicalPlan):
             p_ok = probe_live
         build_s = take_batch(xp, build, perm)
 
-        lo = xp.searchsorted(ba_s, pa, side="left")
-        hi = xp.searchsorted(ba_s, pa, side="right")
+        lo = searchsorted(xp, ba_s, pa, side="left")
+        hi = searchsorted(xp, ba_s, pa, side="right")
         counts = xp.where(p_ok, (hi - lo).astype(np.int64), 0)
         matched_hash = counts > 0
 
@@ -306,7 +306,7 @@ class PJoin(P.PhysicalPlan):
 
         # output slot j → probe row i and duplicate index d
         slot = xp.arange(out_cap, dtype=np.int64)
-        i = xp.searchsorted(offsets + counts_eff, slot, side="right")
+        i = searchsorted(xp, offsets + counts_eff, slot, side="right")
         i = xp.clip(i, 0, probe.capacity - 1)
         d = slot - offsets[i]
         in_range = slot < total

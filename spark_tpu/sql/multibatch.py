@@ -715,8 +715,14 @@ class MultiBatchExecution:
         return jitted, spine_schema
 
     # -- per-batch transfer + host-ification (overridden when sharded) ---
-    def _run_batch(self, jstep, b: ColumnBatch) -> List[ColumnBatch]:
-        out_dev, n = jstep(b.to_device())
+    def _place(self, b: ColumnBatch):
+        """Device placement for one prepared scan batch.  Runs on the
+        prefetch thread so the H2D copy overlaps the previous batch's
+        device step."""
+        return b.to_device()
+
+    def _run_batch(self, jstep, leaf) -> List[ColumnBatch]:
+        out_dev, n = jstep(leaf)
         return [_slice_to_host(out_dev, int(np.asarray(n)))]
 
     # -- merger selection ------------------------------------------------
@@ -829,7 +835,8 @@ class MultiBatchExecution:
 
     def execute(self) -> ColumnBatch:
         from ..io import (
-            reencode_strings, scan_file_batches, scan_string_dictionaries,
+            prefetch_iter, reencode_strings, scan_file_batches,
+            scan_prefetch_depth, scan_string_dictionaries,
         )
         rel = self.dec.rel
         fixed_dicts = scan_string_dictionaries(rel, self.batch_rows)
@@ -839,10 +846,26 @@ class MultiBatchExecution:
         jstep = None
         n_batches = 0
         completed = False
+
+        prep_idx = [0]
+
+        def _prep(raw):
+            # runs on the prefetch thread: Arrow decode → re-encode → pad
+            # → H2D, overlapped with the consumer's device step.  Only the
+            # first batch's host form is kept (step build + merger
+            # template); checkpoint-skipped batches don't pay the device
+            # transfer (scan order is deterministic, idx == n_batches-1).
+            idx = prep_idx[0]
+            prep_idx[0] += 1
+            b = normalize_valids(pad_to_capacity(
+                reencode_strings(raw, fixed_dicts), self.capacity))
+            return (b if idx == 0 else None,
+                    self._place(b) if idx >= skip else None)
+
         try:
-            for raw in scan_file_batches(rel, self.batch_rows):
-                b = reencode_strings(raw, fixed_dicts)
-                b = normalize_valids(pad_to_capacity(b, self.capacity))
+            for b, leaf in prefetch_iter(
+                    scan_file_batches(rel, self.batch_rows), _prep,
+                    scan_prefetch_depth(self.session.conf)):
                 if jstep is None:
                     jstep, spine_schema = self._build_step(b)
                     if merger is None:
@@ -853,7 +876,7 @@ class MultiBatchExecution:
                 if hasattr(merger, "next_batch"):
                     merger.next_batch()
                 more = True
-                for host in self._run_batch(jstep, b):
+                for host in self._run_batch(jstep, leaf):
                     if not merger.add(host):
                         more = False
                         break
@@ -970,10 +993,13 @@ class DistributedMultiBatchExecution(MultiBatchExecution):
         self.session._jit_cache[ck] = jitted
         return jitted, spine_schema
 
-    def _run_batch(self, jstep, b: ColumnBatch) -> List[ColumnBatch]:
-        from ..io import _slice_rows
+    def _place(self, b: ColumnBatch):
         from ..parallel.executor import shard_leaf
-        out = jstep(shard_leaf(self.mesh, self.n, b)).to_host()
+        return shard_leaf(self.mesh, self.n, b)
+
+    def _run_batch(self, jstep, leaf) -> List[ColumnBatch]:
+        from ..io import _slice_rows
+        out = jstep(leaf).to_host()
         per = out.capacity // self.n
         runs = []
         for i in range(self.n):

@@ -192,10 +192,20 @@ class _FileStream(BatchStream):
         self._dicts = scan_string_dictionaries(rel, batch_rows)
 
     def batches(self) -> Iterator[ColumnBatch]:
-        from ..io import reencode_strings, scan_file_batches
-        for raw in scan_file_batches(self.rel, self.batch_rows):
+        from ..io import (
+            prefetch_iter, reencode_strings, scan_file_batches,
+            scan_prefetch_depth,
+        )
+
+        def _prep(raw):
             b = reencode_strings(raw, self._dicts)
-            yield normalize_valids(pad_to_capacity(b, self.capacity))
+            return normalize_valids(pad_to_capacity(b, self.capacity))
+
+        # decode/pad batch N+1 on a background thread while the stage's
+        # device step runs on batch N (double-buffered scan)
+        yield from prefetch_iter(
+            scan_file_batches(self.rel, self.batch_rows), _prep,
+            scan_prefetch_depth(self.session.conf))
 
 
 class _SingletonStream(BatchStream):

@@ -1,23 +1,94 @@
-"""Bisect the q3 remote-compile HTTP 500: compile the q3 program piece
-by piece on the TPU and report the first stage that fails.  Run only
-when the tunnel is up."""
-import sys, time, traceback
-sys.path.insert(0, "/root/repo")
+"""Bisect the q3 remote-compile HTTP 500 on real TPU hardware.
+
+Three layers, coarsest first, so even a short tunnel window produces a
+verdict:
+
+1. PRIMITIVES — each join building block compiled alone (sorts of every
+   arity the engine emits, searchsorted in both lowerings, i64 cumsum,
+   gathers, scatters).  The round-5 off-hardware analysis found exactly
+   one structural feature unique to the q3 program vs the TPU-compiling
+   agg/sort programs: ``stablehlo.while`` from jnp.searchsorted's default
+   binary-search scan.  primitives[searchsorted_scan] failing while
+   [searchsorted_unrolled] compiles would confirm it in one step.
+2. STAGES — the planner's q3 program cut after join / +filter / +agg /
+   full, compiled with the engine default (unrolled on TPU since r5).
+3. STAGES x scan — the same stages with SPARK_TPU_SEARCHSORTED=scan
+   forcing the historical while-loop form, to reproduce the original
+   crash for the record.
+
+Run only when the tunnel is up (bench.py runs this automatically after a
+successful TPU bench).
+"""
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
-import spark_tpu  # noqa
+import spark_tpu  # noqa: F401  (enables x64, pins platform handling)
 import jax
 import jax.numpy as jnp
 
-print("devices:", jax.devices())
+print("devices:", jax.devices(), flush=True)
 
-from spark_tpu.sql.session import SparkSession
+C = 1 << 21
+D = 2048
+
+
+def try_compile(name, fn, *args):
+    t0 = time.perf_counter()
+    try:
+        jax.jit(fn).lower(*args).compile()
+        print(f"[OK]   {name}: {time.perf_counter() - t0:.1f}s", flush=True)
+        return True
+    except Exception as e:
+        print(f"[FAIL] {name} after {time.perf_counter() - t0:.1f}s: "
+              f"{str(e)[:400]}", flush=True)
+        traceback.print_exc(limit=2)
+        return False
+
+
+# ---------------------------------------------------------------- layer 1
+print("\n=== layer 1: primitives ===", flush=True)
+rng = np.random.default_rng(3)
+big_i64 = jnp.asarray(rng.integers(0, D, C).astype(np.int64))
+small_i64 = jnp.asarray(np.sort(rng.integers(0, D, D)).astype(np.int64))
+flags_i8 = jnp.asarray((rng.integers(0, 2, D)).astype(np.int8))
+
+try_compile("sort1_i64", lambda x: jax.lax.sort(x), big_i64)
+try_compile("sort2_i8_i64_iota",
+            lambda f, k: jax.lax.sort(
+                (f, k, jnp.arange(D, dtype=np.int32)), num_keys=2,
+                is_stable=True)[-1], flags_i8, small_i64)
+try_compile("sort3_i64x2_iota",
+            lambda k: jax.lax.sort(
+                (k, k + 1, jnp.arange(C, dtype=np.int32)), num_keys=2,
+                is_stable=True)[-1], big_i64)
+try_compile("searchsorted_scan (while loop)",
+            lambda a, v: jnp.searchsorted(a, v, method="scan"),
+            small_i64, big_i64)
+try_compile("searchsorted_unrolled",
+            lambda a, v: jnp.searchsorted(a, v, method="scan_unrolled"),
+            small_i64, big_i64)
+try_compile("searchsorted_scan_big_target",
+            lambda a, v: jnp.searchsorted(a, v, method="scan"),
+            big_i64, jnp.arange(C, dtype=np.int64))
+try_compile("cumsum_i64", lambda x: jnp.cumsum(x), big_i64)
+try_compile("gather_i64",
+            lambda x, i: x[jnp.clip(i, 0, C - 1)], big_i64, big_i64)
+try_compile("scatter_add_i64",
+            lambda x, i: jnp.zeros(D, np.int64).at[
+                jnp.clip(i, 0, D - 1)].add(x), big_i64, big_i64)
+
+# ---------------------------------------------------------------- layer 2+3
 from spark_tpu.sql import functions as F
 from spark_tpu.sql import physical as P
 from spark_tpu.sql.planner import QueryExecution
 
 J_FACT, J_DIM, J_BRANDS = 1 << 21, 2048, 64
 rng = np.random.default_rng(11)
-spark = SparkSession.builder.getOrCreate()
+spark = spark_tpu.sql.session.SparkSession.builder.getOrCreate()
 fact = spark.createDataFrame({
     "sk": rng.integers(0, J_DIM, J_FACT).astype(np.int64),
     "price": rng.integers(1, 1000, J_FACT).astype(np.int64)})
@@ -39,7 +110,8 @@ stages = {
         .orderBy(F.col("rev").desc()),
 }
 
-for name, build in stages.items():
+
+def compile_stage(name, build):
     q = build()
     pq = QueryExecution(spark, q._plan).planned
     physical = pq.physical
@@ -49,14 +121,20 @@ for name, build in stages.items():
         out = physical.run(ctx)
         return out.vectors[0].data, out.num_rows()
 
-    t0 = time.perf_counter()
-    try:
-        lowered = jax.jit(run).lower(tuple(b.to_device() for b in pq.leaves))
-        compiled = lowered.compile()
-        print(f"[OK]   {name}: compiled in {time.perf_counter()-t0:.1f}s")
-    except Exception as e:
-        print(f"[FAIL] {name} after {time.perf_counter()-t0:.1f}s: "
-              f"{str(e)[:500]}")
-        traceback.print_exc(limit=3)
-        # keep going: later stages may fail differently / identically
-print("bisect done")
+    return try_compile(name, run, tuple(b.to_device() for b in pq.leaves))
+
+
+print("\n=== layer 2: planner stages (engine-default searchsorted) ===",
+      flush=True)
+spark._jit_cache.clear()
+for name, build in stages.items():
+    compile_stage(name, build)
+
+print("\n=== layer 3: planner stages with the historical while-loop "
+      "searchsorted (expected to reproduce the HTTP 500) ===", flush=True)
+os.environ["SPARK_TPU_SEARCHSORTED"] = "scan"
+spark._jit_cache.clear()
+for name, build in stages.items():
+    compile_stage(name + " [scan]", build)
+os.environ.pop("SPARK_TPU_SEARCHSORTED", None)
+print("bisect done", flush=True)
