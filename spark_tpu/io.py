@@ -769,8 +769,12 @@ def scan_prefetch_depth(conf) -> int:
     d = conf.get(C.SCAN_PREFETCH_BATCHES)
     if d >= 0:
         return d
-    from .kernels import _on_tpu_device
-    return 2 if _on_tpu_device() else 0
+    try:
+        import jax
+        accel = jax.devices()[0].platform != "cpu"
+    except Exception:
+        accel = False
+    return 2 if accel else 0
 
 
 def prefetch_iter(inner, prep=None, depth: int = 2):

@@ -1,0 +1,130 @@
+"""Multilayer perceptron classifier (`ml/ann/Layer.scala`,
+`ml/classification/MultilayerPerceptronClassifier.scala:132` analog).
+
+The reference trains a sigmoid-hidden / softmax-output MLP with LBFGS
+over RDD-partitioned batch gradients.  The TPU-native form is the same
+network as one jit-compiled full-batch Adam loop (`lax.scan`): the
+forward, loss, backward, and update all fuse into a single XLA program
+whose matmuls land on the MXU — there is no per-partition aggregation to
+replicate because the full batch lives on device.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .. import types as T
+from .base import (
+    Estimator, Model, Param, append_prediction, extract_column,
+    extract_matrix,
+)
+
+__all__ = ["MultilayerPerceptronClassifier",
+           "MultilayerPerceptronClassificationModel"]
+
+
+def _forward(params, X, jnp, jax):
+    """Sigmoid hidden layers + linear output logits (the reference's
+    FunctionalLayer(sigmoid) stack with a SoftmaxLayerWithCrossEntropyLoss
+    head — softmax itself folds into the loss)."""
+    h = X
+    for i, (W, b) in enumerate(params):
+        z = h @ W + b
+        h = jax.nn.sigmoid(z) if i < len(params) - 1 else z
+    return h
+
+
+class MultilayerPerceptronClassifier(Estimator):
+    layers = Param("layers", "sizes incl. input and output", None)
+    maxIter = Param("maxIter", "max iterations", 200)
+    stepSize = Param("stepSize", "Adam learning rate", 0.03)
+    seed = Param("seed", "init seed", 11)
+    tol = Param("tol", "convergence tolerance (reserved)", 1e-6)
+    blockSize = Param("blockSize", "ignored: full-batch on device", 128)
+
+    def _fit(self, df):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        y = extract_column(batch, self.getOrDefault("labelCol"), n)
+        classes = np.unique(np.asarray(y))
+        sizes: List[int] = list(self.getOrDefault("layers") or [])
+        if len(sizes) < 2:
+            raise ValueError("layers must list >=2 sizes (input..output)")
+        if sizes[0] != X.shape[1]:
+            raise ValueError(
+                f"layers[0]={sizes[0]} != feature dim {X.shape[1]}")
+        if sizes[-1] < len(classes):
+            raise ValueError(
+                f"layers[-1]={sizes[-1]} < {len(classes)} classes")
+
+        y_idx = jnp.asarray(np.searchsorted(classes, np.asarray(y)))
+        onehot = jax.nn.one_hot(y_idx, sizes[-1])
+
+        key = jax.random.PRNGKey(self.getOrDefault("seed"))
+        params = []
+        for din, dout in zip(sizes[:-1], sizes[1:]):
+            key, k1 = jax.random.split(key)
+            # Glorot init, float32: MLP weights do not need f64 and the
+            # narrower dtype keeps the matmuls MXU-shaped
+            scale = np.sqrt(6.0 / (din + dout))
+            params.append((
+                jax.random.uniform(k1, (din, dout), jnp.float32,
+                                   -scale, scale),
+                jnp.zeros((dout,), jnp.float32)))
+        Xf = X.astype(jnp.float32)
+        of = onehot.astype(jnp.float32)
+
+        opt = optax.adam(self.getOrDefault("stepSize"))
+
+        def loss_fn(ps):
+            logits = _forward(ps, Xf, jnp, jax)
+            return -jnp.mean(jnp.sum(
+                of * jax.nn.log_softmax(logits, axis=1), axis=1))
+
+        def step(carry, _):
+            ps, opt_state = carry
+            loss, grads = jax.value_and_grad(loss_fn)(ps)
+            updates, opt_state = opt.update(grads, opt_state)
+            return (optax.apply_updates(ps, updates), opt_state), loss
+
+        (trained, _), losses = jax.lax.scan(
+            step, (params, opt.init(params)), None,
+            length=self.getOrDefault("maxIter"))
+
+        return MultilayerPerceptronClassificationModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"),
+            weights=[(np.asarray(W), np.asarray(b)) for W, b in trained],
+            classes=classes.tolist(),
+            objectiveHistory=np.asarray(losses).tolist())
+
+
+class MultilayerPerceptronClassificationModel(Model):
+    weights = Param("weights", "list of (W, b) per layer", None)
+    classes = Param("classes", "sorted label values", None)
+    probabilityCol = Param("probabilityCol", "", "probability")
+    objectiveHistory = Param("objectiveHistory", "training loss curve", None)
+
+    def transform(self, df):
+        import jax
+        import jax.numpy as jnp
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        params = [(jnp.asarray(np.asarray(W), jnp.float32),
+                   jnp.asarray(np.asarray(b), jnp.float32))
+                  for W, b in self.getOrDefault("weights")]
+        logits = _forward(params, X.astype(jnp.float32), jnp, jax)
+        prob = np.asarray(jax.nn.softmax(logits, axis=1), np.float64)
+        classes = np.asarray(self.getOrDefault("classes"), np.float64)
+        kidx = np.argmax(prob[:, :len(classes)], axis=1)
+        pred = classes[kidx]
+        out = append_prediction(df, batch, n, pred.astype(np.float64),
+                                self.getOrDefault("predictionCol"), T.float64)
+        b2 = out._execute().to_host()
+        return append_prediction(out, b2, n, prob,
+                                 self.getOrDefault("probabilityCol"),
+                                 T.ArrayType(T.float64))

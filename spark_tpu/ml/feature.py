@@ -19,6 +19,7 @@ __all__ = [
     "MinMaxScaler", "MinMaxScalerModel", "StringIndexer", "StringIndexerModel",
     "IndexToString", "OneHotEncoder", "Tokenizer", "HashingTF", "Binarizer",
     "Bucketizer", "SQLTransformer", "PCA", "PCAModel",
+    "CountVectorizer", "CountVectorizerModel", "Word2Vec", "Word2VecModel",
 ]
 
 
@@ -340,5 +341,243 @@ class PCAModel(Model):
         X = np.asarray(batch.column(self.getOrDefault("inputCol")).data)[:n]
         out = (X - self.getOrDefault("mean")) @ self.getOrDefault("components").T
         return append_prediction(df, batch, n, out,
+                                 self.getOrDefault("outputCol"),
+                                 T.ArrayType(T.float64))
+
+
+class CountVectorizer(Estimator):
+    """Vocabulary-based term-count vectors (`ml/feature/CountVectorizer.scala:136`
+    analog).  Input: a \x00-joined token string column (Tokenizer
+    convention); output: a dense count vector per row over the fitted
+    vocabulary (vocab ordered by descending corpus frequency, ties by
+    term, like the reference's sortBy(-count))."""
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    vocabSize = Param("vocabSize", "max vocabulary size", 1 << 18)
+    minDF = Param("minDF", "min documents containing a term (count if >=1, "
+                  "fraction if <1)", 1.0)
+    minTF = Param("minTF", "per-row min term count (count if >=1, fraction "
+                  "of row tokens if <1)", 1.0)
+    binary = Param("binary", "0/1 presence instead of counts", False)
+
+    def _fit(self, df):
+        batch, n = _exec_host(df)
+        vals = batch.column(self.getOrDefault("inputCol")).to_pylist(
+            np.asarray(batch.row_valid_or_true()))
+        doc_freq: dict = {}
+        corpus_freq: dict = {}
+        for v in vals[:n]:
+            toks = [t for t in str(v).split("\x00") if t] \
+                if v is not None else []
+            for t in toks:
+                corpus_freq[t] = corpus_freq.get(t, 0) + 1
+            for t in set(toks):
+                doc_freq[t] = doc_freq.get(t, 0) + 1
+        min_df = self.getOrDefault("minDF")
+        need = min_df if min_df >= 1 else min_df * max(n, 1)
+        terms = [t for t, c in doc_freq.items() if c >= need]
+        terms.sort(key=lambda t: (-corpus_freq[t], t))
+        vocab = terms[: self.getOrDefault("vocabSize")]
+        return CountVectorizerModel(
+            inputCol=self.getOrDefault("inputCol"),
+            outputCol=self.getOrDefault("outputCol"),
+            minTF=self.getOrDefault("minTF"),
+            binary=self.getOrDefault("binary"),
+            vocabulary=vocab)
+
+
+class CountVectorizerModel(Model):
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    minTF = Param("minTF", "", 1.0)
+    binary = Param("binary", "", False)
+    vocabulary = Param("vocabulary", "fitted terms, frequency-descending",
+                       None)
+
+    def transform(self, df):
+        batch, n = _exec_host(df)
+        vals = batch.column(self.getOrDefault("inputCol")).to_pylist(
+            np.asarray(batch.row_valid_or_true()))
+        vocab = list(self.getOrDefault("vocabulary") or [])
+        index = {t: i for i, t in enumerate(vocab)}
+        min_tf = self.getOrDefault("minTF")
+        binary = self.getOrDefault("binary")
+        mat = np.zeros((n, max(len(vocab), 1)), np.float64)
+        for i, v in enumerate(vals[:n]):
+            toks = [t for t in str(v).split("\x00") if t] \
+                if v is not None else []
+            for t in toks:
+                j = index.get(t)
+                if j is not None:
+                    mat[i, j] += 1.0
+            thresh = min_tf if min_tf >= 1 else min_tf * max(len(toks), 1)
+            mat[i] = np.where(mat[i] >= max(thresh, 1e-300), mat[i], 0.0)
+            if binary:
+                mat[i] = (mat[i] > 0).astype(np.float64)
+        return append_prediction(df, batch, n, mat,
+                                 self.getOrDefault("outputCol"),
+                                 T.ArrayType(T.float64))
+
+
+class Word2Vec(Estimator):
+    """Skip-gram word embeddings (`ml/feature/Word2Vec.scala:119` /
+    `mllib/feature/Word2Vec.scala:42` analog).
+
+    The reference trains hierarchical-softmax skip-gram with per-partition
+    Hogwild updates.  The TPU-native form is skip-gram with NEGATIVE
+    SAMPLING as one jit-compiled Adam loop over the (center, context)
+    pair array: each step is two embedding gathers + a batched dot — a
+    dense program XLA fuses, instead of sparse async host updates.  Same
+    objective family, same embedding quality contract (similar words
+    cluster), deterministic under the seed."""
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    vectorSize = Param("vectorSize", "embedding dimension", 100)
+    windowSize = Param("windowSize", "context window", 5)
+    minCount = Param("minCount", "min corpus occurrences", 5)
+    maxIter = Param("maxIter", "training epochs", 1)
+    stepSize = Param("stepSize", "Adam learning rate", 0.025)
+    seed = Param("seed", "", 42)
+    negative = Param("negative", "negative samples per pair", 5)
+    maxSentenceLength = Param("maxSentenceLength", "tokens per row cap",
+                              1000)
+
+    def _fit(self, df):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        batch, n = _exec_host(df)
+        vals = batch.column(self.getOrDefault("inputCol")).to_pylist(
+            np.asarray(batch.row_valid_or_true()))
+        cap_len = self.getOrDefault("maxSentenceLength")
+        sents = [[t for t in str(v).split("\x00") if t][:cap_len]
+                 for v in vals[:n] if v is not None]
+        freq: dict = {}
+        for s in sents:
+            for t in s:
+                freq[t] = freq.get(t, 0) + 1
+        vocab = sorted((t for t, c in freq.items()
+                        if c >= self.getOrDefault("minCount")),
+                       key=lambda t: (-freq[t], t))
+        if not vocab:
+            raise AnalysisException("Word2Vec: empty vocabulary (minCount "
+                                    "filtered every token)")
+        index = {t: i for i, t in enumerate(vocab)}
+        V = len(vocab)
+        win = self.getOrDefault("windowSize")
+        centers, contexts = [], []
+        for s in sents:
+            ids = [index[t] for t in s if t in index]
+            for i, c in enumerate(ids):
+                for j in range(max(0, i - win), min(len(ids), i + win + 1)):
+                    if j != i:
+                        centers.append(c)
+                        contexts.append(ids[j])
+        if not centers:
+            raise AnalysisException("Word2Vec: no (center, context) pairs "
+                                    "(rows shorter than 2 tokens?)")
+        centers_a = jnp.asarray(np.array(centers, np.int32))
+        contexts_a = jnp.asarray(np.array(contexts, np.int32))
+        # unigram^(3/4) negative-sampling distribution (word2vec paper)
+        counts = np.array([freq[t] for t in vocab], np.float64) ** 0.75
+        neg_logits = jnp.asarray(np.log(counts / counts.sum()))
+
+        dim = self.getOrDefault("vectorSize")
+        k_neg = self.getOrDefault("negative")
+        key = jax.random.PRNGKey(self.getOrDefault("seed"))
+        key, k1 = jax.random.split(key)
+        W_in = jax.random.uniform(k1, (V, dim), jnp.float32,
+                                  -0.5 / dim, 0.5 / dim)
+        W_out = jnp.zeros((V, dim), jnp.float32)
+
+        opt = optax.adam(self.getOrDefault("stepSize"))
+
+        def loss_fn(params, kk):
+            wi, wo = params
+            ce = wi[centers_a]                        # (P, dim) gather
+            co = wo[contexts_a]
+            pos = jnp.sum(ce * co, axis=1)
+            negs = jax.random.categorical(
+                kk, neg_logits, shape=(centers_a.shape[0], k_neg))
+            cn = wo[negs]                             # (P, k, dim)
+            neg = jnp.einsum("pd,pkd->pk", ce, cn)
+            return -(jnp.mean(jax.nn.log_sigmoid(pos))
+                     + jnp.mean(jnp.sum(jax.nn.log_sigmoid(-neg), axis=1)))
+
+        def step(carry, kk):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, kk)
+            updates, opt_state = opt.update(grads, opt_state)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        epochs = max(self.getOrDefault("maxIter"), 1) * 40
+        keys = jax.random.split(key, epochs)
+        (trained, _), _losses = jax.lax.scan(
+            step, ((W_in, W_out), opt.init((W_in, W_out))), keys)
+        vectors = np.asarray(trained[0], np.float64)
+        return Word2VecModel(
+            inputCol=self.getOrDefault("inputCol"),
+            outputCol=self.getOrDefault("outputCol"),
+            vocabulary=vocab, vectors=vectors)
+
+
+class Word2VecModel(Model):
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    vocabulary = Param("vocabulary", "", None)
+    vectors = Param("vectors", "(V, dim) embedding matrix", None)
+
+    def _vecs(self):
+        return (list(self.getOrDefault("vocabulary") or []),
+                np.asarray(self.getOrDefault("vectors"), np.float64))
+
+    def getVectors(self, session):
+        """DataFrame(word, vector) of the fitted embeddings."""
+        from ..columnar import ColumnBatch, ColumnVector, encode_strings
+        from ..sql import logical as L
+        from ..sql.dataframe import DataFrame
+        vocab, vecs = self._vecs()
+        cap = max(len(vocab), 1)
+        codes, dic = encode_strings(vocab + [None] * (cap - len(vocab)))
+        batch = ColumnBatch(
+            ["word", "vector"],
+            [ColumnVector(np.where(codes < 0, 0, codes).astype(np.int32),
+                          T.string, codes >= 0, dic),
+             ColumnVector(vecs if len(vocab) else np.zeros((1, 1)),
+                          T.ArrayType(T.float64), None, None)],
+            np.arange(cap) < len(vocab), cap)
+        return DataFrame(session, L.LocalRelation(batch))
+
+    def findSynonyms(self, word: str, num: int):
+        """[(word, cosine similarity)] of the num nearest terms."""
+        vocab, vecs = self._vecs()
+        if word not in vocab:
+            raise AnalysisException(f"word {word!r} not in vocabulary")
+        q = vecs[vocab.index(word)]
+        norms = np.linalg.norm(vecs, axis=1) * max(np.linalg.norm(q), 1e-300)
+        sims = vecs @ q / np.where(norms > 0, norms, 1e-300)
+        order = np.argsort(-sims)
+        out = [(vocab[i], float(sims[i])) for i in order
+               if vocab[i] != word][:num]
+        return out
+
+    def transform(self, df):
+        """Row vector = mean of its tokens' embeddings (document vector,
+        `ml/feature/Word2Vec.scala:289` transform contract)."""
+        batch, n = _exec_host(df)
+        vals = batch.column(self.getOrDefault("inputCol")).to_pylist(
+            np.asarray(batch.row_valid_or_true()))
+        vocab, vecs = self._vecs()
+        index = {t: i for i, t in enumerate(vocab)}
+        dim = vecs.shape[1] if vecs.ndim == 2 else 1
+        mat = np.zeros((n, dim), np.float64)
+        for i, v in enumerate(vals[:n]):
+            toks = [t for t in str(v).split("\x00") if t] \
+                if v is not None else []
+            ids = [index[t] for t in toks if t in index]
+            if ids:
+                mat[i] = vecs[ids].mean(axis=0)
+        return append_prediction(df, batch, n, mat,
                                  self.getOrDefault("outputCol"),
                                  T.ArrayType(T.float64))
