@@ -624,8 +624,14 @@ class _GraceJoinStream(BatchStream):
         rcols = set(right.schema.names)
         if node.using:
             pairs = [(Col(n), Col(n)) for n in node.using]
+            res_list: List[Expression] = []
         else:
-            pairs, _res = split_equi_condition(node.on, lcols, rcols)
+            pairs, res_list = split_equi_condition(node.on, lcols, rcols)
+        self._residual: Optional[Expression] = None
+        for conj in res_list:              # conjuncts → one AND expression
+            from ..expressions import And
+            self._residual = conj if self._residual is None \
+                else And(self._residual, conj)
         if not pairs:
             raise NotStreamable(
                 f"{node.how} join of two oversized relations without "
@@ -663,6 +669,7 @@ class _GraceJoinStream(BatchStream):
                           ) -> _BucketStore:
         store = self._make_store(n_buckets)
         for b in stream.batches():
+            self.session.raise_if_cancelled()
             live = _live(compact(np, b))
             if not dicts_out:
                 dicts_out.update(_batch_dicts(live))
@@ -770,9 +777,18 @@ class _GraceJoinStream(BatchStream):
                 _emit_pieces(cat, self.batch_rows, self.capacity)]
 
     def _chunked_join(self, lbs, rbs) -> Iterator[ColumnBatch]:
-        """Probe/build chunk loop with host-side match tracking — the last
-        resort when one key value exceeds device capacity on both sides
-        (``ExternalAppendOnlyMap.scala`` spill-loop role).
+        """Hot-bucket join — a bucket that salting cannot split (all rows
+        share one key) or whose output fans out past the eager bound.
+
+        Primary path: a host-side SORT-MERGE EMIT (both sides sorted on
+        the exact-encoded key, duplicate-key runs matched once, match
+        tiles emitted by rolling window) — O((L+R)·log + |output|), the
+        ``SortMergeJoinExec.scala:36`` merge-loop structure.  The chunked
+        probe/build device loop below remains as the fallback for shapes
+        the merge path does not cover (multi-key, unencodable keys, USING
+        inner/outer output assembly); it is O(L·R/cap²) device joins —
+        quadratic in the hot key (``ExternalAppendOnlyMap.scala``
+        spill-loop role).
 
         Orientation is normalized so the probe is the outer-preserved side
         (``right`` probes the right side); FULL OUTER cannot chunk (both
@@ -786,6 +802,10 @@ class _GraceJoinStream(BatchStream):
         swap = how == "right"
         probe_bs, build_bs = (rbs, lbs) if swap else (lbs, rbs)
         how2 = "left" if swap else how
+        merged = self._merge_emit(probe_bs, build_bs, swap, how2)
+        if merged is not None:
+            yield from merged
+            return
         out_names = list(self.schema.names)
 
         def tag(batch: ColumnBatch) -> ColumnBatch:
@@ -827,6 +847,131 @@ class _GraceJoinStream(BatchStream):
                 yield _mask_rows(pchunk, matched)
             elif how2 == "left_anti":
                 yield _mask_rows(pchunk, ~matched)
+
+    # -- sort-merge emit (primary hot-bucket path) -----------------------
+    def _merge_emit(self, probe_bs, build_bs, swap: bool, how2: str
+                    ) -> Optional[Iterator[ColumnBatch]]:
+        """Sort-merge join of one hot bucket, host-side.
+
+        Both sides sort once on the exact int64 key encoding (the device
+        join's ``_exact_encode_pair``, numpy lane — NaN/-0.0/dictionary
+        normalization identical, so match semantics are bit-for-bit the
+        device join's).  Equal-key runs are matched by one merge over the
+        distinct keys; each matched run pair emits its cross product in
+        ≤ batch_rows tiles.  Returns None when the shape isn't covered
+        (multi-key, unencodable key, USING-join inner/outer output
+        assembly) — caller falls back to the chunked device loop."""
+        from .joins import _exact_encode_pair
+        node = self.node
+        if len(self._lkeys) != 1:
+            return None
+        if node.using and how2 in ("inner", "left"):
+            # USING output coalesces the key columns — only the eager
+            # join assembles that; semi/anti outputs are probe-only
+            return None
+
+        probe_cat = _concat_live(probe_bs)
+        if probe_cat is None:
+            return iter(())               # no probe rows: nothing to emit
+        build_cat = _concat_live(build_bs)
+
+        pkey = (self._rkeys if swap else self._lkeys)[0]
+        bkey = (self._lkeys if swap else self._rkeys)[0]
+        other_schema, other_dicts = (
+            (self.left.schema, self._ldicts) if swap
+            else (self.right.schema, self._rdicts))
+
+        if build_cat is None:
+            def _no_build():
+                if how2 == "left":
+                    yield _null_extend(probe_cat, self.schema, other_schema,
+                                       other_dicts)
+                elif how2 == "left_anti":
+                    yield probe_cat
+            return _no_build()
+
+        pctx = EvalContext(probe_cat, np)
+        bctx = EvalContext(build_cat, np)
+        enc = _exact_encode_pair(pctx, bctx, pkey, bkey)
+        if enc is None:
+            return None
+        p_enc, p_val, b_enc, b_val = enc
+        residual = self._residual
+
+        def _run():
+            pe = np.asarray(p_enc)
+            be = np.asarray(b_enc)
+            p_idx = np.nonzero(np.asarray(p_val, bool))[0] \
+                if p_val is not None else np.arange(len(pe))
+            b_idx = np.nonzero(np.asarray(b_val, bool))[0] \
+                if b_val is not None else np.arange(len(be))
+            p_sorted = p_idx[np.argsort(pe[p_idx], kind="stable")]
+            b_sorted = b_idx[np.argsort(be[b_idx], kind="stable")]
+            pk = pe[p_sorted]
+            bk = be[b_sorted]
+            pu = np.flatnonzero(np.r_[True, pk[1:] != pk[:-1]]) \
+                if len(pk) else np.empty(0, np.int64)
+            bu = np.flatnonzero(np.r_[True, bk[1:] != bk[:-1]]) \
+                if len(bk) else np.empty(0, np.int64)
+            pu_end = np.r_[pu[1:], len(pk)].astype(np.int64)
+            bu_end = np.r_[bu[1:], len(bk)].astype(np.int64)
+            pu_vals = pk[pu] if len(pk) else np.empty(0, np.int64)
+            bu_vals = bk[bu] if len(bk) else np.empty(0, np.int64)
+            # one vectorized merge over the distinct keys of both sides
+            pos = np.searchsorted(bu_vals, pu_vals)
+            pos_c = np.clip(pos, 0, max(len(bu_vals) - 1, 0))
+            has = (pos < len(bu_vals)) & \
+                (bu_vals[pos_c] == pu_vals) if len(bu_vals) else \
+                np.zeros(len(pu_vals), bool)
+
+            matched = np.zeros(probe_cat.capacity, bool)
+            emit_tiles = how2 in ("inner", "left") or residual is not None
+            for j in np.flatnonzero(has):
+                p_rows = p_sorted[pu[j]:pu_end[j]]
+                b_rows = b_sorted[bu[pos[j]]:bu_end[pos[j]]]
+                if residual is None:
+                    matched[p_rows] = True
+                if not emit_tiles:
+                    continue
+                bblock = int(min(len(b_rows), self.batch_rows))
+                pblock = max(1, self.batch_rows // bblock)
+                for bs_ in range(0, len(b_rows), bblock):
+                    br = b_rows[bs_:bs_ + bblock]
+                    for ps_ in range(0, len(p_rows), pblock):
+                        pr = p_rows[ps_:ps_ + pblock]
+                        pi = np.repeat(pr, len(br))
+                        bi = np.tile(br, len(pr))
+                        pout = take_batch(np, probe_cat, pi)
+                        bout = take_batch(np, build_cat, bi)
+                        lo, ro = (bout, pout) if swap else (pout, bout)
+                        comb = ColumnBatch(
+                            list(lo.names) + list(ro.names),
+                            list(lo.vectors) + list(ro.vectors),
+                            None, len(pi))
+                        if residual is not None:
+                            rctx = EvalContext(comb, np)
+                            rv = rctx.broadcast(residual.eval(rctx))
+                            keep = np.asarray(rv.data).astype(bool)
+                            if rv.valid is not None:
+                                keep = keep & np.asarray(rv.valid)
+                            matched[pi[keep]] = True
+                            if how2 not in ("inner", "left"):
+                                continue
+                            comb = _mask_rows(comb, keep)
+                        if how2 in ("inner", "left") \
+                                and int(np.asarray(comb.num_rows())):
+                            yield comb
+            if how2 == "left":
+                rest = _mask_rows(probe_cat, ~matched)
+                if int(np.asarray(rest.num_rows())):
+                    yield _null_extend(rest, self.schema, other_schema,
+                                       other_dicts)
+            elif how2 == "left_semi":
+                yield _mask_rows(probe_cat, matched)
+            elif how2 == "left_anti":
+                yield _mask_rows(probe_cat, ~matched)
+
+        return _run()
 
     def _probe_chunk(self, tagged: ColumnBatch, bchunk: ColumnBatch,
                      inner_how: str) -> Iterator[ColumnBatch]:
@@ -972,6 +1117,7 @@ def _run_breaker(session, stream: BatchStream, breaker: L.LogicalPlan,
     spine_schema = stream.schema
     try:
         for b in mapped.child.batches():
+            session.raise_if_cancelled()
             if compiled is None:
                 # build the fused step: mapped chain + breaker partial
                 if isinstance(breaker, L.Aggregate) \
