@@ -58,9 +58,11 @@ class MultilayerPerceptronClassifier(Estimator):
         if sizes[0] != X.shape[1]:
             raise ValueError(
                 f"layers[0]={sizes[0]} != feature dim {X.shape[1]}")
-        if sizes[-1] < len(classes):
+        if sizes[-1] != len(classes):
+            # width mismatch would leak softmax mass onto phantom output
+            # units (reference requires layers.last == numClasses too)
             raise ValueError(
-                f"layers[-1]={sizes[-1]} < {len(classes)} classes")
+                f"layers[-1]={sizes[-1]} != {len(classes)} label classes")
 
         y_idx = jnp.asarray(np.searchsorted(classes, np.asarray(y)))
         onehot = jax.nn.one_hot(y_idx, sizes[-1])

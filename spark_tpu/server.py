@@ -1,30 +1,46 @@
-"""SQL-over-HTTP serving endpoint.
+"""SQL-over-HTTP serving endpoint with multi-session support.
 
-The serving role of the reference's `sql/hive-thriftserver` (71.7k LoC
-of HiveServer2 protocol) re-based on the one wire format every client
-already speaks: POST a SQL string, receive JSON rows.  Sessions execute
-serially under a lock (the engine's jit/plan caches are per-session
-state, exactly like a Thrift session handle); the server is a thin
-stateless shell over one SparkSession, matching the
-"filesystem-catalog + CLI" Hive divergence recorded in
-docs/DECISIONS.md.
+The serving role of the reference's `sql/hive-thriftserver` (HiveServer2:
+`HiveThriftServer2.scala`, per-connection session handles in
+`SparkSQLSessionManager.scala`, statement lifecycle + cancellation in
+`SparkExecuteStatementOperation.scala:77`) re-based on the one wire
+format every client already speaks: POST a SQL string, receive JSON rows.
 
-    python -m spark_tpu.server --port 8123 &
+Concurrency model: a bounded worker pool executes statements; each
+server session wraps its own ``SparkSession.newSession()`` (isolated
+temp views / conf / plan caches — the Thrift session handle analog) with
+a per-session lock making it single-writer, so DIFFERENT sessions run in
+parallel while one session's statements stay serial.  Cancellation is
+cooperative, like the reference's task interruption: streamed executions
+check a session flag between batches.
+
+    python -m spark_tpu.server --port 8123 --workers 4 &
     curl -d 'SELECT 1 AS x' localhost:8123/sql
 
-Endpoints:
-    POST /sql      body = SQL text (or JSON {"query": ...}) → JSON
-                   {"columns", "rows", "rowCount", "durationMs"}
-    GET  /status   engine version, query counter, metrics snapshot
+Endpoints (Authorization: Bearer <token> required when a token is set
+via --token or SPARK_TPU_SERVER_TOKEN):
+    POST   /session             → {"sessionId"} (isolated temp views)
+    DELETE /session/<id>        close a session
+    POST   /sql                 body = SQL text or JSON {"query", ...,
+                                "session": sid, "id": statement-id}
+                                (or X-Session-Id / X-Statement-Id
+                                headers) → {"columns", "rows",
+                                "rowCount", "durationMs", "statementId"}
+    POST   /cancel              {"id": statement-id} → cooperative cancel
+    GET    /statement/<id>      statement status (running/done/...)
+    GET    /status              engine version, sessions, statements
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 __all__ = ["SQLServer"]
 
@@ -44,32 +60,157 @@ def _json_safe(v: Any):
     return str(v)
 
 
-class SQLServer:
-    def __init__(self, session, host: str = "127.0.0.1", port: int = 8123):
+class _ServerSession:
+    """One Thrift-session-handle analog: an isolated SparkSession plus the
+    lock that makes it single-writer."""
+
+    def __init__(self, session):
         self.session = session
+        self.lock = threading.Lock()
+        self.created = time.time()
+        self.last_used = self.created
+
+
+class _Statement:
+    def __init__(self, stmt_id: str, session_id: str, query: str):
+        self.id = stmt_id
+        self.session_id = session_id
+        self.query = query
+        self.status = "queued"          # queued|running|done|error|cancelled
+        self.cancel_requested = False
+        self.submitted = time.time()
+
+
+class SQLServer:
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 8123,
+                 workers: int = 4, token: Optional[str] = None,
+                 max_sessions: int = 64):
+        self.session = session           # default/shared session
         self.host = host
         self.port = port
-        self._lock = threading.Lock()
+        self.token = token if token is not None \
+            else os.environ.get("SPARK_TPU_SERVER_TOKEN") or None
+        self.max_sessions = max_sessions
+        self._default = _ServerSession(session)
+        self._sessions: Dict[str, _ServerSession] = {}
+        self._statements: Dict[str, _Statement] = {}
+        self._reg_lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=max(workers, 1),
+                                        thread_name_prefix="sql-worker")
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
-    # -- request handling ------------------------------------------------
-    def _run_sql(self, text: str) -> dict:
-        t0 = time.time()
-        with self._lock:                 # session state is single-writer
-            df = self.session.sql(text)
-            columns = list(df.schema.names)
-            rows = [[_json_safe(v) for v in r] for r in df.collect()]
-        return {"columns": columns, "rows": rows, "rowCount": len(rows),
-                "durationMs": round((time.time() - t0) * 1000, 1)}
+    # -- session registry ------------------------------------------------
+    def _open_session(self) -> str:
+        with self._reg_lock:
+            if len(self._sessions) >= self.max_sessions:
+                raise RuntimeError(
+                    f"session limit {self.max_sessions} reached")
+            sid = uuid.uuid4().hex[:16]
+            self._sessions[sid] = _ServerSession(self.session.newSession())
+        return sid
+
+    def _close_session(self, sid: str) -> bool:
+        with self._reg_lock:
+            ss = self._sessions.pop(sid, None)
+        if ss is None:
+            return False
+        ss.session.cancelAllQueries()
+        return True
+
+    def _resolve(self, sid: Optional[str]) -> _ServerSession:
+        if not sid:
+            return self._default
+        ss = self._sessions.get(sid)
+        if ss is None:
+            raise KeyError(f"no such session {sid!r}")
+        return ss
+
+    # -- statement execution ---------------------------------------------
+    def _run_sql(self, text: str, sid: Optional[str],
+                 stmt_id: Optional[str]) -> dict:
+        ss = self._resolve(sid)          # unknown session → 404, nothing
+        stmt = _Statement(stmt_id or uuid.uuid4().hex[:16],  # registered
+                          sid or "", text)
+        with self._reg_lock:
+            if stmt.id in self._statements and \
+                    self._statements[stmt.id].status in ("queued", "running"):
+                raise RuntimeError(f"statement id {stmt.id!r} already active")
+            self._statements[stmt.id] = stmt
+            self._evict_statements()
+
+        def work() -> dict:
+            with ss.lock:                # session state is single-writer
+                # order matters vs /cancel: the flag clears BEFORE the
+                # status becomes observable as "running", and a cancel
+                # that raced in is honored by the re-check after — a
+                # /cancel acknowledged with 200 is never lost
+                ss.session.clear_cancel()
+                stmt.status = "running"
+                if stmt.cancel_requested:
+                    stmt.status = "cancelled"
+                    raise QueryCancelled("cancelled before execution")
+                ss.last_used = time.time()
+                t0 = time.time()
+                df = ss.session.sql(stmt.query)
+                columns = list(df.schema.names)
+                rows = [[_json_safe(v) for v in r] for r in df.collect()]
+                return {"columns": columns, "rows": rows,
+                        "rowCount": len(rows),
+                        "durationMs": round((time.time() - t0) * 1000, 1),
+                        "statementId": stmt.id}
+
+        from .sql.session import QueryCancelled
+        future = self._pool.submit(work)
+        try:
+            out = future.result()
+            stmt.status = "done"
+            return out
+        except QueryCancelled:
+            stmt.status = "cancelled"
+            raise
+        except Exception:
+            if stmt.status != "cancelled":
+                stmt.status = "error"
+            raise
+
+    _MAX_FINISHED_STATEMENTS = 1000
+
+    def _evict_statements(self) -> None:
+        """Cap the registry: drop oldest TERMINAL statements beyond the
+        bound (caller holds _reg_lock) — a serving process must not leak
+        one entry per request."""
+        done = [s for s in self._statements.values()
+                if s.status not in ("queued", "running")]
+        excess = len(done) - self._MAX_FINISHED_STATEMENTS
+        if excess > 0:
+            for s in sorted(done, key=lambda s: s.submitted)[:excess]:
+                self._statements.pop(s.id, None)
+
+    def _cancel(self, stmt_id: str) -> dict:
+        stmt = self._statements.get(stmt_id)
+        if stmt is None:
+            raise KeyError(f"no such statement {stmt_id!r}")
+        stmt.cancel_requested = True
+        if stmt.status == "running":
+            self._resolve(stmt.session_id or None).session.cancelAllQueries()
+        return {"statementId": stmt_id, "status": stmt.status,
+                "cancelRequested": True}
 
     def _status(self) -> dict:
+        with self._reg_lock:
+            stmts = {s.id: s.status for s in self._statements.values()
+                     if s.status in ("queued", "running")}
+            n_sessions = len(self._sessions)
         return {
             "version": self.session.version,
             "queriesExecuted": getattr(self.session, "_query_count", 0),
+            "sessions": n_sessions,
+            "activeStatements": stmts,
             "metrics": self.session.metricsSystem.snapshots(),
         }
 
+    # -- http plumbing ---------------------------------------------------
     def _make_handler(self):
         server = self
 
@@ -85,29 +226,90 @@ class SQLServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _authed(self) -> bool:
+                if server.token is None:
+                    return True
+                got = self.headers.get("Authorization", "")
+                if got == f"Bearer {server.token}":
+                    return True
+                self._reply(401, {"error": "missing or bad bearer token"})
+                return False
+
             def do_GET(self):
-                if self.path.rstrip("/") in ("", "/status"):
+                if not self._authed():
+                    return
+                path = self.path.rstrip("/")
+                if path in ("", "/status"):
                     self._reply(200, server._status())
+                elif path.startswith("/statement/"):
+                    stmt = server._statements.get(path.rsplit("/", 1)[1])
+                    if stmt is None:
+                        self._reply(404, {"error": "no such statement"})
+                    else:
+                        self._reply(200, {
+                            "statementId": stmt.id, "status": stmt.status,
+                            "submitted": stmt.submitted})
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_DELETE(self):
+                if not self._authed():
+                    return
+                path = self.path.rstrip("/")
+                if path.startswith("/session/"):
+                    sid = path.rsplit("/", 1)[1]
+                    if server._close_session(sid):
+                        self._reply(200, {"closed": sid})
+                    else:
+                        self._reply(404, {"error": f"no session {sid!r}"})
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
-                if self.path.rstrip("/") != "/sql":
-                    self._reply(404, {"error": f"no route {self.path}"})
+                if not self._authed():
                     return
+                path = self.path.rstrip("/")
                 n = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(n).decode("utf-8", "replace")
-                text = raw
+                payload: Dict[str, Any] = {}
                 if raw.lstrip().startswith("{"):
                     try:
-                        text = json.loads(raw).get("query", "")
+                        payload = json.loads(raw)
                     except json.JSONDecodeError:
-                        pass
-                if not text.strip():
-                    self._reply(400, {"error": "empty query"})
+                        payload = {}
+                if path == "/session":
+                    try:
+                        self._reply(200, {"sessionId": server._open_session()})
+                    except RuntimeError as e:
+                        self._reply(429, {"error": str(e)})
                     return
+                if path == "/cancel":
+                    sid = payload.get("id") or \
+                        self.headers.get("X-Statement-Id")
+                    try:
+                        self._reply(200, server._cancel(sid or ""))
+                    except KeyError as e:
+                        self._reply(404, {"error": str(e)})
+                    return
+                if path != "/sql":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                text = payload.get("query", "") if payload else raw
+                sid = (payload.get("session")
+                       or self.headers.get("X-Session-Id"))
+                stmt_id = (payload.get("id")
+                           or self.headers.get("X-Statement-Id"))
+                if not isinstance(text, str) or not text.strip():
+                    self._reply(400, {"error": "empty or non-string query"})
+                    return
+                from .sql.session import QueryCancelled
                 try:
-                    self._reply(200, server._run_sql(text))
+                    self._reply(200, server._run_sql(text, sid, stmt_id))
+                except QueryCancelled as e:
+                    self._reply(499, {"error": f"cancelled: {e}",
+                                      "statementId": stmt_id})
+                except KeyError as e:
+                    self._reply(404, {"error": str(e)})
                 except Exception as e:    # noqa: BLE001 — surface to client
                     self._reply(400, {
                         "error": f"{type(e).__name__}: {e}"[:2000]})
@@ -133,6 +335,7 @@ class SQLServer:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+        self._pool.shutdown(wait=False, cancel_futures=True)
 
 
 def main(argv=None) -> int:
@@ -141,13 +344,21 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8123)
+    ap.add_argument("--workers", type=int, default=4,
+                    help="bounded statement worker pool size")
+    ap.add_argument("--token", default=None,
+                    help="shared-secret bearer token (or "
+                    "SPARK_TPU_SERVER_TOKEN)")
     args = ap.parse_args(argv)
 
     from .sql.session import SparkSession
     session = SparkSession.builder.appName("sql-server").getOrCreate()
-    srv = SQLServer(session, args.host, args.port).start()
+    srv = SQLServer(session, args.host, args.port, workers=args.workers,
+                    token=args.token).start()
+    auth = "token-protected" if srv.token else "no auth"
     print(f"spark_tpu SQL server on http://{srv.host}:{srv.port} "
-          f"(POST /sql, GET /status)")
+          f"({args.workers} workers, {auth}; POST /sql, /session, "
+          f"/cancel; GET /status)")
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

@@ -866,6 +866,7 @@ class MultiBatchExecution:
             for b, leaf in prefetch_iter(
                     scan_file_batches(rel, self.batch_rows), _prep,
                     scan_prefetch_depth(self.session.conf)):
+                self.session.raise_if_cancelled()
                 if jstep is None:
                     jstep, spine_schema = self._build_step(b)
                     if merger is None:

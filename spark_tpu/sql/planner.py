@@ -469,8 +469,9 @@ class QueryExecution:
         # that read conf via getActiveSession (e.g. the collect_list cap)
         # must see THIS session's conf, not whichever session was created
         # last in the process
-        prev_active = type(self.session)._active
-        type(self.session)._active = self.session
+        cls = type(self.session)
+        prev_active = getattr(cls._tls, "active", None)
+        cls._set_thread_active(self.session)
         try:
             result = self._execute_inner()
         except BaseException as e:
@@ -480,7 +481,7 @@ class QueryExecution:
                 "error": f"{type(e).__name__}: {e}"[:300]})
             raise
         finally:
-            type(self.session)._active = prev_active
+            cls._set_thread_active(prev_active)
             self._leak_check()
         self.session._post_event({
             "event": "SQLExecutionEnd", "time": _time.time(),

@@ -7,6 +7,7 @@ distributed mode) the device mesh (see ``spark_tpu.parallel``).
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -17,6 +18,12 @@ from ..columnar import ColumnBatch
 from ..expressions import AnalysisException
 from . import logical as L
 from .dataframe import DataFrame
+
+
+class QueryCancelled(Exception):
+    """Raised inside a streamed execution loop after
+    ``session.cancelAllQueries()`` — the cooperative analog of the
+    reference's ``SparkContext.cancelJobGroup`` task interruption."""
 
 
 class _ListenerManager:
@@ -234,7 +241,24 @@ class Catalog:
         plan = self._persistent_plan(name)
         if plan is not None:
             return plan
+        plan = self._file_format_plan(name)
+        if plan is not None:
+            return plan
         raise AnalysisException(f"Table or view not found: {name}")
+
+    def _file_format_plan(self, name: str) -> Optional[L.LogicalPlan]:
+        """``SELECT * FROM parquet.`/path``` — querying a file directly by
+        format-qualified path (`rules/ResolveSQLOnFile.scala:44` analog).
+        The parser delivers the identifier as ``<format>.<path>``."""
+        import os
+        fmt, dot, path = name.partition(".")
+        fmt = fmt.lower()
+        if not dot or fmt not in ("parquet", "orc", "csv", "json", "text"):
+            return None
+        if not os.path.exists(path):
+            return None
+        from ..io import DataFrameReader
+        return DataFrameReader(self._session).format(fmt).load(path)._plan
 
     def list_persistent_tables(self, db: Optional[str] = None) -> List[str]:
         import os
@@ -306,6 +330,7 @@ class Builder:
 
 class SparkSession:
     _active: Optional["SparkSession"] = None
+    _tls = threading.local()         # per-thread executing session
 
     class _BuilderAccessor:
         def __get__(self, obj, objtype=None) -> Builder:
@@ -384,7 +409,34 @@ class SparkSession:
 
     @classmethod
     def getActiveSession(cls) -> Optional["SparkSession"]:
-        return cls._active
+        # the EXECUTING session on this thread wins (set per query by
+        # QueryExecution): with the server's worker pool running DIFFERENT
+        # sessions concurrently, a process-global here would hand kernel
+        # conf reads (collect_list cap, multibatch fallback) to whichever
+        # session started a query last on ANY thread
+        tls = getattr(cls._tls, "active", None)
+        return tls if tls is not None else cls._active
+
+    @classmethod
+    def _set_thread_active(cls, session) -> None:
+        cls._tls.active = session
+
+    # -- cooperative statement cancellation (cancelJobGroup analog) ------
+    #
+    # XLA programs are uninterruptible once dispatched, exactly like a
+    # running Spark task; cancellation lands at the same granularity the
+    # reference's does — between units of scheduled work.  Long queries
+    # are streamed (multibatch / stage runner), and those loops call
+    # raise_if_cancelled() between batches.
+    def cancelAllQueries(self) -> None:
+        self._cancel_requested = True
+
+    def clear_cancel(self) -> None:
+        self._cancel_requested = False
+
+    def raise_if_cancelled(self) -> None:
+        if getattr(self, "_cancel_requested", False):
+            raise QueryCancelled("query cancelled by user request")
 
     @property
     def sparkContext(self):
@@ -720,4 +772,9 @@ class SparkSession:
         return StreamingQueryManager.get(self)
 
     def newSession(self) -> "SparkSession":
+        """A sibling session: same conf VALUES and warehouse (persistent
+        tables are shared through the filesystem catalog, like sessions
+        sharing one SparkContext), but isolated temp views, conf object,
+        jit/plan caches, and cancellation state
+        (`SparkSession.scala:236 newSession`)."""
         return SparkSession(self.conf_obj.clone())

@@ -219,3 +219,14 @@ def test_prefetch_runs_ahead_bounded():
     time.sleep(0.2)
     assert 3 <= len(produced) <= 5
     assert list(it) == list(range(1, 100))
+
+
+def test_sql_on_file_format_qualified(spark, tmp_path):
+    """SELECT ... FROM parquet.`/path` (ResolveSQLOnFile analog)."""
+    df = spark.createDataFrame({"a": np.arange(10, dtype=np.int64)})
+    p = str(tmp_path / "direct.parquet")
+    df.write.parquet(p)
+    out = spark.sql(f"SELECT sum(a) AS s FROM parquet.`{p}`").collect()
+    assert out[0]["s"] == 45
+    with pytest.raises(AnalysisException, match="not found"):
+        spark.sql("SELECT 1 FROM parquet.`/no/such/path`").collect()
