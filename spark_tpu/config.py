@@ -267,6 +267,13 @@ SCAN_PREFETCH_BATCHES = conf("spark.tpu.scan.prefetchBatches").doc(
     "for the same cores — measured ~3% loss, vs overlap win on TPU)."
 ).int(-1)
 
+CROSSPROC_DEDUP_REPLICATED = conf("spark.tpu.crossproc.dedupReplicated").doc(
+    "On the cross-process generic path, collapse leaf relations that are "
+    "byte-identical across processes to ONE copy (replicated broadcast "
+    "tables need no annotation). Set false when partitions may be "
+    "legitimately duplicate data, to force union semantics."
+).boolean(True)
+
 SPILL_MEMORY_ROWS = conf("spark.tpu.spill.hostMemoryRows").doc(
     "Host-RAM row budget for multi-batch intermediates (sorted runs, "
     "concatenated spine output); beyond it, runs spill to disk under "

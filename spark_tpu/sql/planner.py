@@ -507,6 +507,13 @@ class QueryExecution:
 
     def _execute_inner(self) -> ColumnBatch:
         self.session._last_qe = self      # metrics/explain introspection
+        svc = getattr(self.session, "_crossproc_svc", None)
+        if svc is not None:
+            # the session's registered DCN data plane makes the exchange a
+            # planner decision: the hop is placed here, on the normal
+            # session.sql path (ShuffleExchangeExec placement role)
+            from ..parallel.crossproc import crossproc_execute
+            return crossproc_execute(self.session, self.optimized, svc)
         n_shards = self.session.conf.get(C.MESH_SHARDS)
         if n_shards == 0:
             n_shards = len(jax.devices())

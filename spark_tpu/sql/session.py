@@ -778,3 +778,28 @@ class SparkSession:
         jit/plan caches, and cancellation state
         (`SparkSession.scala:236 newSession`)."""
         return SparkSession(self.conf_obj.clone())
+
+    def enableHostShuffle(self, root: str, process_id: Optional[int] = None,
+                          n_processes: Optional[int] = None,
+                          timeout_s: float = 120.0):
+        """Register the DCN host-shuffle data plane on this session: from
+        now on every query PLANS its cross-process exchange through a
+        ``HostShuffleService`` at ``root`` (the planner-citizen form of
+        the reference's external shuffle service registration,
+        `ExternalShuffleBlockResolver.java:57`).  Leaf DataFrames/scans
+        are per-process partitions; byte-identical leaves are detected as
+        replicated.  Defaults identify the process via jax.distributed."""
+        from ..parallel.hostshuffle import HostShuffleService
+        if process_id is None or n_processes is None:
+            import jax
+            process_id = jax.process_index() if process_id is None \
+                else process_id
+            n_processes = jax.process_count() if n_processes is None \
+                else n_processes
+        self._crossproc_svc = HostShuffleService(
+            root, process_id=process_id, n_processes=n_processes,
+            timeout_s=timeout_s)
+        return self._crossproc_svc
+
+    def disableHostShuffle(self) -> None:
+        self._crossproc_svc = None

@@ -49,4 +49,8 @@ def test_two_process_cluster(tmp_path):
     assert "all_to_all ok" in out0
     assert "crossproc agg:" in out0 and "crossproc agg:" in out1
     assert "CROSSPROC-QUERY-OK" in out0
+    assert "PLANNER-CITIZEN-Q3-OK" in out0 and "PLANNER-CITIZEN-Q3-OK" in out1
+    assert "GENERIC-PATH-DISTINCT-OK" in out0
+    assert "GENERIC-PATH-DISTINCT-OK" in out1
+    assert "PARTITIONED-JOIN-OK" in out0 and "PARTITIONED-JOIN-OK" in out1
     assert "DEATH-DETECTED-OK" in out0

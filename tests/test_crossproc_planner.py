@@ -1,0 +1,73 @@
+"""Planner-citizen cross-process execution, single-process degenerate
+form (n=1: every exchange is a self-loop).  The REAL two-process
+validation lives in test_cluster_twoproc.py (PLANNER-CITIZEN-Q3-OK /
+GENERIC-PATH-DISTINCT-OK); this file keeps the routing, fast-path /
+generic-path split, and above-op replay covered in the plain suite."""
+
+import numpy as np
+import pytest
+
+import spark_tpu.sql.functions as F
+
+
+@pytest.fixture()
+def xs(spark, tmp_path):
+    s = spark.newSession()
+    s.conf.set("spark.tpu.mesh.shards", "1")
+    s.enableHostShuffle(str(tmp_path / "hs"), process_id=0, n_processes=1,
+                        timeout_s=30.0)
+    yield s
+    s.disableHostShuffle()
+
+
+def _mk(xs):
+    rng = np.random.default_rng(3)
+    xs.createDataFrame({
+        "sk": rng.integers(0, 16, 500).astype(np.int64),
+        "price": rng.integers(1, 100, 500).astype(np.int64),
+    }).createOrReplaceTempView("fact")
+    xs.createDataFrame({
+        "d_sk": np.arange(16, dtype=np.int64),
+        "brand": (np.arange(16, dtype=np.int64) % 5),
+        "year": np.where(np.arange(16) % 2 == 0, 2000, 2001).astype(np.int64),
+    }).createOrReplaceTempView("dim")
+
+
+def test_fast_path_full_q3(xs, spark):
+    _mk(xs)
+    q = ("SELECT brand, sum(price) AS rev FROM fact JOIN dim ON sk = d_sk "
+         "WHERE year = 2000 GROUP BY brand ORDER BY rev DESC, brand")
+    got = [tuple(r) for r in xs.sql(q).collect()]
+    _mk(spark)  # same data, no crossproc routing
+    exp = [tuple(r) for r in spark.sql(q).collect()]
+    assert got == exp and len(got) > 0
+
+
+def test_generic_path_distinct_window_limit(xs, spark):
+    _mk(xs)
+    _mk(spark)
+    for q in [
+        "SELECT DISTINCT sk FROM fact WHERE sk < 6 ORDER BY sk",
+        ("SELECT sk, price, rank() OVER "
+         "(PARTITION BY sk ORDER BY price) AS r FROM fact "
+         "WHERE sk = 3 ORDER BY price, r LIMIT 5"),
+        "SELECT sk FROM fact ORDER BY sk LIMIT 7",
+    ]:
+        got = [tuple(r) for r in xs.sql(q).collect()]
+        exp = [tuple(r) for r in spark.sql(q).collect()]
+        assert got == exp, q
+
+
+def test_global_agg_routes(xs, spark):
+    _mk(xs)
+    _mk(spark)
+    q = "SELECT sum(price) AS s, count(*) AS c FROM fact"
+    assert [tuple(r) for r in xs.sql(q).collect()] == \
+        [tuple(r) for r in spark.sql(q).collect()]
+
+
+def test_disable_restores_local_path(xs):
+    _mk(xs)
+    xs.disableHostShuffle()
+    out = xs.sql("SELECT count(*) AS c FROM fact").collect()
+    assert out[0]["c"] == 500

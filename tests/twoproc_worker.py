@@ -116,6 +116,67 @@ if pid == 0:
         f"diff={set(both) ^ set(oracle)}")
     print("[p0] CROSSPROC-QUERY-OK", flush=True)
 
+# FULL q3 (scan → broadcast join → filter → agg → sort) via the NORMAL
+# session.sql path: enableHostShuffle registers the DCN data plane on the
+# session and the PLANNER places the cross-process exchange (VERDICT r4
+# #5 — the hop is a planner citizen, not a side-door helper).  The fact
+# table is partitioned (half per process); the dim table is replicated.
+xs = session.newSession()
+xs.conf.set(C.MESH_SHARDS.key, "1")
+xs.enableHostShuffle(shuffle_dir + "-q3", process_id=pid, n_processes=2,
+                     timeout_s=60.0)
+rng2 = np.random.default_rng(91)
+f_sk = rng2.integers(0, 64, 6000).astype(np.int64)
+f_price = rng2.integers(1, 500, 6000).astype(np.int64)
+d_sk = np.arange(64, dtype=np.int64)
+d_brand = rng2.integers(0, 11, 64).astype(np.int64)
+d_year = rng2.integers(1998, 2003, 64).astype(np.int64)
+half2 = slice(pid * 3000, (pid + 1) * 3000)
+xs.createDataFrame({"sk": f_sk[half2], "price": f_price[half2]}) \
+    .createOrReplaceTempView("fact")
+xs.createDataFrame({"d_sk": d_sk, "brand": d_brand, "year": d_year}) \
+    .createOrReplaceTempView("dim")
+Q3 = ("SELECT brand, sum(price) AS rev FROM fact JOIN dim ON sk = d_sk "
+      "WHERE year = 2000 GROUP BY brand ORDER BY rev DESC, brand")
+got_q3 = [tuple(r) for r in xs.sql(Q3).collect()]
+
+# single-process oracle over the FULL dataset
+os_ = session.newSession()
+os_.conf.set(C.MESH_SHARDS.key, "1")
+os_.createDataFrame({"sk": f_sk, "price": f_price}) \
+    .createOrReplaceTempView("fact")
+os_.createDataFrame({"d_sk": d_sk, "brand": d_brand, "year": d_year}) \
+    .createOrReplaceTempView("dim")
+exp_q3 = [tuple(r) for r in os_.sql(Q3).collect()]
+assert got_q3 == exp_q3, (
+    f"planner-citizen q3 mismatch: got {got_q3[:5]}... exp {exp_q3[:5]}...")
+print(f"[p{pid}] PLANNER-CITIZEN-Q3-OK ({len(got_q3)} rows)", flush=True)
+
+# generic path — a shape the old side-door REFUSED (_reject_global_ops):
+# DISTINCT over the partitioned fact, then a sort above it.  Partitioned
+# leaves gather through the service (the replicated dim is detected
+# byte-identical and kept single) and the plan runs locally, identically
+# in both processes.
+QD = ("SELECT DISTINCT sk FROM fact WHERE sk < 8 ORDER BY sk")
+got_d = [tuple(r) for r in xs.sql(QD).collect()]
+exp_d = [tuple(r) for r in os_.sql(QD).collect()]
+assert got_d == exp_d, (got_d, exp_d)
+print(f"[p{pid}] GENERIC-PATH-DISTINCT-OK ({len(got_d)} rows)", flush=True)
+
+# a join of TWO partitioned tables: the digest exchange must classify
+# both fact leaves as partitioned, reject the fast path (local joins
+# would miss every cross-process match), and gather-then-compute exactly
+xs.createDataFrame({"k2": f_sk[half2], "bonus": f_price[half2] * 2}) \
+    .createOrReplaceTempView("fact2")
+os_.createDataFrame({"k2": f_sk, "bonus": f_price * 2}) \
+    .createOrReplaceTempView("fact2")
+QJ = ("SELECT sk, count(*) AS c, sum(bonus) AS sb FROM fact "
+      "JOIN fact2 ON sk = k2 WHERE sk < 4 GROUP BY sk ORDER BY sk")
+got_j = [tuple(r) for r in xs.sql(QJ).collect()]
+exp_j = [tuple(r) for r in os_.sql(QJ).collect()]
+assert got_j == exp_j, (got_j, exp_j)
+print(f"[p{pid}] PARTITIONED-JOIN-OK ({len(got_j)} rows)", flush=True)
+
 # heartbeat death detection across REAL process boundaries: both beat,
 # then p1 stops beating and exits; p0 must observe host-1 die
 conf = C.Conf()
