@@ -280,11 +280,17 @@ def _leaf_batches(session, node, out: List[ColumnBatch]) -> None:
 
 
 def _leaf_partition_flags(session, node, svc: HostShuffleService,
-                          xid: str) -> List[bool]:
+                          xid: str,
+                          batches_out: Optional[List[ColumnBatch]] = None
+                          ) -> List[bool]:
     """One digest exchange classifying every leaf: True = partitioned
-    (content differs across processes), False = replicated."""
+    (content differs across processes), False = replicated.  The
+    materialized leaf batches land in ``batches_out`` so a follow-up
+    gather never re-reads them from disk."""
     batches: List[ColumnBatch] = []
     _leaf_batches(session, node, batches)
+    if batches_out is not None:
+        batches_out.extend(batches)
     if not batches:
         return []
     from .. import types as T
@@ -319,10 +325,12 @@ def _gather_all(svc: HostShuffleService, xid: str, batch: ColumnBatch,
 
 
 def _gather_leaf_relations(session, plan, svc: HostShuffleService,
-                           xid: str, dedup: bool):
+                           xid: str, dedup: bool,
+                           preloaded: Optional[List[ColumnBatch]] = None):
     """Replace every leaf relation with the gathered union of all
     processes' copies (byte-identical leaves keep one copy when
-    ``dedup``)."""
+    ``dedup``).  ``preloaded`` supplies already-materialized local leaf
+    batches in ``_leaf_batches`` order (the digest probe's reads)."""
     from ..sql import logical as L
     counter = [0]
 
@@ -333,14 +341,16 @@ def _gather_leaf_relations(session, plan, svc: HostShuffleService,
             node = _copy.copy(node)
             node.children = new_children
         if isinstance(node, (L.LocalRelation, L.FileRelation)):
-            if isinstance(node, L.LocalRelation):
+            i = counter[0]
+            counter[0] += 1
+            if preloaded is not None and i < len(preloaded):
+                local = preloaded[i]
+            elif isinstance(node, L.LocalRelation):
                 local = compact(np, node.batch.to_host())
             else:
                 from ..io import read_file_relation
                 local = compact(np, read_file_relation(node,
                                                        session).to_host())
-            i = counter[0]
-            counter[0] += 1
             full = _gather_all(svc, f"{xid}-leaf{i}", local, dedup=dedup)
             return L.LocalRelation(full)
         return node
@@ -371,13 +381,18 @@ def crossproc_execute(session, optimized, svc: HostShuffleService
             and not _has_global_ops(node.children[0])
             and _joins_all_inner(node.children[0])
             and _agg_strings_ok(node))
+    leaf_cache: List[ColumnBatch] = []
     if fast:
-        # one digest exchange proves the fast-path precondition: at most
-        # ONE partitioned leaf (the fact); all join sides beyond it are
-        # replicated, so local inner joins see every global match once
+        # one digest exchange proves the fast-path precondition: EXACTLY
+        # one partitioned leaf (the fact); all join sides beyond it are
+        # replicated, so local inner joins see every global match once.
+        # All-replicated (zero partitioned) must NOT take this path: every
+        # process would contribute identical partials and the merge would
+        # multiply results by the process count — the generic path's
+        # dedup gather computes that case correctly.
         flags = _leaf_partition_flags(session, node.children[0], svc,
-                                      f"{xid}-digest")
-        fast = sum(flags) <= 1
+                                      f"{xid}-digest", leaf_cache)
+        fast = sum(flags) == 1
 
     if fast:
         child_batch = _run_local(session, node.children[0])
@@ -387,9 +402,11 @@ def crossproc_execute(session, optimized, svc: HostShuffleService
         full = _gather_all(svc, f"{xid}-gather", mine, dedup=False)
     else:
         # generic path: centralize partitioned leaves, then run the whole
-        # remaining plan locally (identical everywhere)
+        # remaining plan locally (identical everywhere).  Leaves already
+        # materialized for the digest probe are reused, not re-read.
         dedup = session.conf.get(C.CROSSPROC_DEDUP_REPLICATED)
-        plan2 = _gather_leaf_relations(session, node, svc, xid, dedup)
+        plan2 = _gather_leaf_relations(session, node, svc, xid, dedup,
+                                       leaf_cache or None)
         full = compact(np, _run_local(session, plan2).to_host())
 
     node2 = L.LocalRelation(full)

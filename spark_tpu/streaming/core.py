@@ -728,6 +728,8 @@ class StreamExecution:
         self.source = self.sources[0]
         self._multi = len(self.sources) > 1
         self._ss_buf = [None, None]          # per-side joined-row buffers
+        self._ss_matched: set = set()        # preserved-side matched rids
+        self._ss_rid_next = 0                # monotonic preserved-row ids
 
         self.offset_log = MetadataLog(os.path.join(checkpoint, "offsets")) \
             if checkpoint else _MemLog()
@@ -799,11 +801,12 @@ class StreamExecution:
             raise AnalysisException(
                 "exactly one stream-stream join is supported per query")
         j = joins[0]
-        if j.how != "inner":
+        if j.how not in ("inner", "left", "right"):
             raise AnalysisException(
-                f"stream-stream {j.how} joins are not supported yet; "
-                "inner joins only (outer needs watermark-finalized "
-                "unmatched-row tracking)")
+                f"stream-stream {j.how} joins are not supported; "
+                "inner/left/right only (full outer needs watermark "
+                "finalization on BOTH sides, and this engine carries one "
+                "watermark per query)")
         if len(_find_streaming(j.left)) != 1 \
                 or len(_find_streaming(j.right)) != 1:
             raise AnalysisException(
@@ -821,7 +824,10 @@ class StreamExecution:
         path = os.path.join(d, f"state-{batch_id}.pkl")
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump(self._ss_buf, f, protocol=pickle.HIGHEST_PROTOCOL)
+            pickle.dump({"bufs": self._ss_buf,
+                         "matched": self._ss_matched,
+                         "rid_next": self._ss_rid_next}, f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
         os.replace(tmp, path)
         stale = os.path.join(d, f"state-{batch_id - 2}.pkl")
         try:
@@ -836,9 +842,39 @@ class StreamExecution:
                             f"state-{batch_id}.pkl")
         if os.path.exists(path):
             with open(path, "rb") as f:
-                self._ss_buf = pickle.load(f)
+                payload = pickle.load(f)
+            if isinstance(payload, dict):
+                self._ss_buf = payload["bufs"]
+                self._ss_matched = payload["matched"]
+                self._ss_rid_next = payload["rid_next"]
+            else:                      # pre-outer-join snapshot layout
+                self._ss_buf = payload
+
+    def _validate_outer_ssjoin(self) -> None:
+        """LEFT/RIGHT outer stream-stream joins finalize unmatched rows
+        only when the watermark evicts them (`StreamingSymmetricHashJoinExec`
+        one-sided outer contract): the PRESERVED side must carry the
+        query's watermark, and its event-time column must survive to the
+        join input."""
+        j = self._ssjoin_node
+        if j is None or j.how == "inner":
+            return
+        pres_plan = j.left if j.how == "left" else j.right
+        pres_rel = _find_streaming(pres_plan)[0]
+        pres_src = self.sources.index(pres_rel.source)
+        if self._wm_col is None or self._wm_src != pres_src:
+            raise AnalysisException(
+                f"stream-stream {j.how} outer joins require withWatermark "
+                "on the PRESERVED side: unmatched rows can only "
+                "null-extend once the watermark proves no future match")
+        if self._wm_col not in pres_plan.schema().names:
+            raise AnalysisException(
+                f"the watermark column {self._wm_col!r} must survive to "
+                f"the {j.how} outer join input (it drives unmatched-row "
+                "finalization)")
 
     def _build_agg_state(self) -> Optional[AggregationState]:
+        self._validate_outer_ssjoin()
         if self._ssjoin_node is not None:
             stateful = (
                 [a for a in _find_nodes(self.plan, L.Aggregate)
@@ -1295,7 +1331,22 @@ class StreamExecution:
         self.batch_id += 1
         return True
 
+    _SS_RID = "__ss_rid__"
+
     def _execute_ssjoin(self, batches: List[ColumnBatch]) -> ColumnBatch:
+        """One micro-batch of the symmetric stream-stream join
+        (`StreamingSymmetricHashJoinExec` role).  Inner matches emit the
+        trigger they occur; for LEFT/RIGHT outer, the preserved side's
+        buffered rows ride a monotonic row id, matches are recorded via
+        semi joins, and rows the watermark evicts while still unmatched
+        null-extend into the same trigger's output (the one-sided outer
+        contract: a row finalizes exactly when no future match exists).
+
+        Known bound (documented limitation, as in the reference before
+        time-range conditions): only the watermark side's buffer evicts.
+        For outer joins the watermark sits on the preserved side, so the
+        NON-preserved buffer grows with the stream — bounding it needs
+        time-range join conditions (interval joins), not yet wired."""
         from ..sql.planner import QueryExecution
         j = self._ssjoin_node
         rels = [_find_streaming(j.left)[0], _find_streaming(j.right)[0]]
@@ -1306,34 +1357,77 @@ class StreamExecution:
             below = self._replace_source(side_plan, batches[src_idx])
             new_sides.append(QueryExecution(self.session, below).execute())
         new_wm = self._advance_watermark()
+        how = j.how
+        pres = None if how == "inner" else (0 if how == "left" else 1)
+        RID = self._SS_RID
 
-        def join_of(a: ColumnBatch, b: ColumnBatch) -> ColumnBatch:
+        def tag(b: ColumnBatch) -> ColumnBatch:
+            rids = np.arange(self._ss_rid_next,
+                             self._ss_rid_next + b.capacity, dtype=np.int64)
+            self._ss_rid_next += b.capacity
+            return ColumnBatch(
+                list(b.names) + [RID],
+                list(b.vectors) + [ColumnVector(rids, T.int64, None, None)],
+                b.row_valid, b.capacity)
+
+        def untag(b: ColumnBatch) -> ColumnBatch:
+            if RID not in b.names:
+                return b
+            i = b.names.index(RID)
+            return ColumnBatch(
+                [n for k, n in enumerate(b.names) if k != i],
+                [v for k, v in enumerate(b.vectors) if k != i],
+                b.row_valid, b.capacity)
+
+        def join_of(a: ColumnBatch, b: ColumnBatch,
+                    how2: str = "inner") -> ColumnBatch:
             plan = L.Join(L.LocalRelation(a), L.LocalRelation(b),
-                          "inner", j.on, j.using)
+                          how2, j.on, j.using)
             return QueryExecution(self.session, plan).execute()
 
         old_a, old_b = self._ss_buf
         new_a, new_b = new_sides
+        if pres == 0:
+            new_a = tag(new_a)
+        elif pres == 1:
+            new_b = tag(new_b)
         all_b = new_b if old_b is None else union_all([old_b, new_b])
-        parts = [join_of(new_a, all_b)]
+        parts = [join_of(untag(new_a), untag(all_b))]
         if old_a is not None:
-            parts.append(join_of(old_a, new_b))
+            parts.append(join_of(untag(old_a), untag(new_b)))
+
+        if pres is not None:
+            # record which preserved rows matched: semi joins on the
+            # tagged side, against exactly the pairings the inner emit saw
+            if pres == 0:
+                semis = [(new_a, untag(all_b))]
+                if old_a is not None:
+                    semis.append((old_a, untag(new_b)))
+            else:
+                all_a = new_a if old_a is None \
+                    else union_all([old_a, new_a])
+                semis = [(new_b, untag(all_a))]
+                if old_b is not None:
+                    semis.append((old_b, untag(new_a)))
+            for tagged, other in semis:
+                m = compact(np, join_of(tagged, other, "left_semi"))
+                nr = int(np.asarray(m.num_rows()))
+                rids = np.asarray(m.column(RID).data)[:nr]
+                self._ss_matched.update(int(r) for r in rids)
+
         parts = [p for p in parts
                  if int(np.asarray(p.num_rows()))]
-        if parts:
-            out = compact(np, union_all(parts)) if len(parts) > 1 \
-                else parts[0]
-        else:
-            out = ColumnBatch.empty(j.schema())
 
         # fold the new rows into the buffers; evict by watermark where the
-        # side carries the event-time column
-        # which SIDE the watermark was declared on (source identity):
-        # only that side's buffer is event-time bounded
+        # side carries the event-time column.  For outer joins the
+        # watermark side IS the preserved side (validated), and eviction
+        # is where unmatched rows finalize.
         wm_side = None
         if self._wm_col is not None:
             wm_side = order.index(self._wm_src) \
                 if self._wm_src in order else None
+
+        null_parts: List[ColumnBatch] = []
 
         def fold(side, old, new):
             buf = new if old is None else union_all([old, new])
@@ -1342,13 +1436,43 @@ class StreamExecution:
                     and self._wm_col in buf.names:
                 kv, kvalid = _numeric_event_col(
                     buf.column(self._wm_col), buf.capacity)
-                keep = np.asarray(buf.row_valid_or_true()) \
-                    & (~kvalid | (kv >= new_wm))
+                live = np.asarray(buf.row_valid_or_true())
+                drop = live & np.asarray(kvalid) & (np.asarray(kv) < new_wm)
+                if side == pres and drop.any():
+                    rids = np.asarray(buf.column(RID).data)
+                    matched = np.isin(
+                        rids, np.fromiter(self._ss_matched, np.int64,
+                                          len(self._ss_matched)))
+                    un = drop & ~matched
+                    if un.any():
+                        rows = compact(np, ColumnBatch(
+                            buf.names, buf.vectors, un, buf.capacity))
+                        other_plan = j.right if pres == 0 else j.left
+                        other_schema = other_plan.schema()
+                        other_b = (all_b if pres == 0 else
+                                   untag(new_a)).to_host()
+                        other_dicts = {
+                            n: v.dictionary for n, v in
+                            zip(other_b.names, other_b.vectors)
+                            if v.dictionary}
+                        from ..sql.stages import _null_extend
+                        null_parts.append(_null_extend(
+                            untag(rows), j.schema(), other_schema,
+                            other_dicts))
+                    # evicted rids can never be asked about again
+                    for r in rids[drop]:
+                        self._ss_matched.discard(int(r))
                 buf = compact(np, ColumnBatch(buf.names, buf.vectors,
-                                              keep, buf.capacity))
+                                              live & ~drop, buf.capacity))
             return buf
 
         self._ss_buf = [fold(0, old_a, new_a), fold(1, old_b, new_b)]
+        parts += [p for p in null_parts if int(np.asarray(p.num_rows()))]
+        if parts:
+            out = compact(np, union_all(parts)) if len(parts) > 1 \
+                else parts[0]
+        else:
+            out = ColumnBatch.empty(j.schema())
         above = self._rebuild_above_plan(j, L.LocalRelation(out))
         return QueryExecution(self.session, above).execute()
 

@@ -53,4 +53,5 @@ def test_two_process_cluster(tmp_path):
     assert "GENERIC-PATH-DISTINCT-OK" in out0
     assert "GENERIC-PATH-DISTINCT-OK" in out1
     assert "PARTITIONED-JOIN-OK" in out0 and "PARTITIONED-JOIN-OK" in out1
+    assert "REPLICATED-AGG-OK" in out0 and "REPLICATED-AGG-OK" in out1
     assert "DEATH-DETECTED-OK" in out0

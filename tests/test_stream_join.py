@@ -107,10 +107,16 @@ def test_ssjoin_rejects_unsupported_shapes(spark):
     with pytest.raises(AnalysisException, match="append"):
         (joined.writeStream.format("memory").queryName("x1")
          .outputMode("complete").start())
-    with pytest.raises(AnalysisException, match="inner"):
+    # outer joins need a watermark on the preserved side to finalize
+    with pytest.raises(AnalysisException, match="[wW]atermark"):
         (a.toDF(spark).join(b.toDF(spark),
                             on=F.col("k") == F.col("k2"), how="left")
          .writeStream.format("memory").queryName("x2")
+         .outputMode("append").start())
+    with pytest.raises(AnalysisException, match="inner/left/right"):
+        (a.toDF(spark).join(b.toDF(spark),
+                            on=F.col("k") == F.col("k2"), how="full")
+         .writeStream.format("memory").queryName("x2f")
          .outputMode("append").start())
     with pytest.raises(AnalysisException,
                        match="aggregation|cannot run incrementally"):
@@ -168,3 +174,100 @@ def test_recovery_with_file_source_metadata(spark, tmp_path):
     q2.processAllAvailable()
     assert _rows(spark, "fsj2") == [(2, "q", 2, 20)]
     q2.stop()
+
+
+# ---------------------------------------------------------------------------
+# round-5 LEFT/RIGHT outer stream-stream joins (VERDICT r4 item 10):
+# watermark-driven null-emission on state eviction, exact across restart
+# ---------------------------------------------------------------------------
+
+TS_A = T.StructType([T.StructField("ts", T.timestamp),
+                     T.StructField("k", T.int64)])
+TS_B = T.StructType([T.StructField("ts2", T.timestamp),
+                     T.StructField("k2", T.int64),
+                     T.StructField("b", T.int64)])
+SEC = 1_000_000
+
+
+def _ts(s):
+    return datetime.datetime(1970, 1, 1) + datetime.timedelta(seconds=s)
+
+
+def test_left_outer_null_extends_on_eviction_across_restart(spark,
+                                                            tmp_path):
+    ckpt = str(tmp_path / "ssj_outer")
+    a = MemoryStream(TS_A, spark)
+    b = MemoryStream(B_SCHEMA, spark)
+
+    def mk(name):
+        df = (a.toDF(spark).withWatermark("ts", "2 seconds")
+              .join(b.toDF(spark), on=F.col("k") == F.col("k2"),
+                    how="left"))
+        return (df.writeStream.format("memory").queryName(name)
+                .outputMode("append")
+                .option("checkpointLocation", ckpt)
+                .trigger(once=True).start())
+
+    q = mk("ssjo1")
+    a.addData([(1 * SEC, 1), (2 * SEC, 2)])
+    b.addData([(1, 10)])
+    q.processAllAvailable()
+    # matched pair emits immediately; unmatched k=2 is NOT final yet
+    assert _rows(spark, "ssjo1") == [(_ts(1), 1, 1, 10)]
+    # watermark jumps to 18s: ts=2 evicts while unmatched → null-extend;
+    # ts=1 evicts matched → no extra row
+    a.addData([(20 * SEC, 3)])
+    q.processAllAvailable()
+    assert _rows(spark, "ssjo1") == [
+        (_ts(1), 1, 1, 10), (_ts(2), 2, None, None)]
+    q.stop()
+
+    # restart: buffers + matched-row state recover; the buffered ts=20
+    # row still matches a late right row, then finalizes matched (no
+    # null emission for it)
+    q2 = mk("ssjo2")
+    b.addData([(3, 30)])
+    q2.processAllAvailable()
+    assert _rows(spark, "ssjo2") == [(_ts(20), 3, 3, 30)]
+    a.addData([(40 * SEC, 4)])
+    q2.processAllAvailable()      # wm → 38s: ts=20 evicts, was matched
+    assert _rows(spark, "ssjo2") == [(_ts(20), 3, 3, 30)]
+    # batch oracle over everything emitted so far: the streamed output is
+    # exactly the batch left-join rows whose left side has FINALIZED
+    # (ts < watermark) or matched
+    q2.stop()
+
+
+def test_right_outer_preserves_right_side(spark):
+    a = MemoryStream(A_SCHEMA, spark)
+    b = MemoryStream(TS_B, spark)
+    df = (a.toDF(spark)
+          .join(b.toDF(spark).withWatermark("ts2", "1 seconds"),
+                on=F.col("k") == F.col("k2"), how="right"))
+    q = (df.writeStream.format("memory").queryName("ssjr")
+         .outputMode("append").trigger(once=True).start())
+    a.addData([(1, "x")])
+    b.addData([(5 * SEC, 1, 100), (6 * SEC, 2, 200)])
+    q.processAllAvailable()
+    def got():
+        return {tuple(r) for r in
+                spark.sql("SELECT * FROM ssjr").collect()}
+    assert got() == {(1, "x", _ts(5), 1, 100)}
+    # advance the right-side watermark past both rows: the unmatched
+    # k2=2 row null-extends on the LEFT side
+    b.addData([(30 * SEC, 9, 900)])
+    q.processAllAvailable()
+    assert (None, None, _ts(6), 2, 200) in got()
+    assert (1, "x", _ts(5), 1, 100) in got()
+    q.stop()
+
+
+def test_left_outer_rejects_watermark_on_wrong_side(spark):
+    a = MemoryStream(A_SCHEMA, spark)
+    b = MemoryStream(TS_B, spark)
+    with pytest.raises(AnalysisException, match="PRESERVED"):
+        (a.toDF(spark)
+         .join(b.toDF(spark).withWatermark("ts2", "1 seconds"),
+               on=F.col("k") == F.col("k2"), how="left")
+         .writeStream.format("memory").queryName("wwx")
+         .outputMode("append").start())

@@ -163,6 +163,15 @@ exp_d = [tuple(r) for r in os_.sql(QD).collect()]
 assert got_d == exp_d, (got_d, exp_d)
 print(f"[p{pid}] GENERIC-PATH-DISTINCT-OK ({len(got_d)} rows)", flush=True)
 
+# keyed aggregate over an ALL-REPLICATED table: the digest probe must
+# reject the fast path (identical partials would merge to n x the truth)
+# and the generic dedup gather must return single-copy results
+QR = "SELECT year, count(*) AS c FROM dim GROUP BY year ORDER BY year"
+got_r = [tuple(r) for r in xs.sql(QR).collect()]
+exp_r = [tuple(r) for r in os_.sql(QR).collect()]
+assert got_r == exp_r, (got_r, exp_r)
+print(f"[p{pid}] REPLICATED-AGG-OK ({len(got_r)} rows)", flush=True)
+
 # a join of TWO partitioned tables: the digest exchange must classify
 # both fact leaves as partitioned, reject the fast path (local joins
 # would miss every cross-process match), and gather-then-compute exactly
