@@ -9,9 +9,11 @@ preserve — a regression to a materialized one-hot round-trip
 (rows x groups bytes in HBM) blows these bounds by an order of magnitude.
 
 Bounds were measured on the XLA:CPU lowering (the platform the suite
-runs on) and padded ~60%: tight enough that the known failure mode
-(one-hot materialization: >= 64x input bytes for the agg shape, ~2048
-flops/row) trips them, loose enough to survive XLA version drift.
+runs on) and anchored at ~1.35x the round-5 measurement (VERDICT r4
+item 9: a 2x HBM-traffic regression must fail off-hardware).  A bound
+tripping after an XLA upgrade with an engine diff that clearly cannot
+change traffic IS allowed to be re-anchored — re-measure, update the
+recorded value and the bound together.
 
 Reference bench shapes: ``AggregateBenchmark.scala:125-131``,
 ``JoinBenchmark.scala:42-47``, ``SortBenchmark.scala:120-128``.
@@ -83,10 +85,12 @@ def test_agg_program_traffic(one_shard):
     input_bytes = N * 16
     ratio = d["bytes accessed"] / input_bytes
     flops_per_row = d["flops"] / N
-    # measured (XLA:CPU, 2026-07): ratio 15.6, flops/row 91
-    assert ratio <= 25.0, f"agg HBM traffic regressed: {ratio:.1f}x input"
+    # measured (XLA:CPU, r5 2026-07-31): ratio 12.6, flops/row 67 —
+    # bounds anchored at ~1.35x measured (VERDICT r4 item 9: a 2x HBM
+    # regression must fail off-hardware)
+    assert ratio <= 17.0, f"agg HBM traffic regressed: {ratio:.1f}x input"
     assert ratio >= 1.0, "inputs not read? cost model broke"
-    assert flops_per_row <= 400.0, \
+    assert flops_per_row <= 95.0, \
         f"agg flops regressed: {flops_per_row:.0f}/row"
 
 
@@ -115,9 +119,11 @@ def test_q3_program_traffic(one_shard):
     input_bytes = J_FACT * 16
     ratio = d["bytes accessed"] / input_bytes
     flops_per_row = d["flops"] / J_FACT
-    # measured (XLA:CPU, 2026-07): ratio 58.3, flops/row 270
-    assert ratio <= 90.0, f"q3 HBM traffic regressed: {ratio:.1f}x fact"
-    assert flops_per_row <= 550.0, \
+    # measured (XLA:CPU, r5 2026-07-31): ratio 52.4, flops/row 225 —
+    # ~1.35x anchors (r4 values 58.3/270 improved by the searchsorted
+    # and compact work)
+    assert ratio <= 71.0, f"q3 HBM traffic regressed: {ratio:.1f}x fact"
+    assert flops_per_row <= 305.0, \
         f"q3 flops regressed: {flops_per_row:.0f}/row"
 
 
@@ -168,9 +174,10 @@ def test_sort_program_traffic(one_shard):
     input_bytes = S * 8
     ratio = d["bytes accessed"] / input_bytes
     flops_per_row = d["flops"] / S
-    # measured (XLA:CPU, 2026-07): ratio 6.6, flops/row 23
-    assert ratio <= 12.0, f"sort HBM traffic regressed: {ratio:.1f}x input"
-    assert flops_per_row <= 60.0, \
+    # measured (XLA:CPU, r5 2026-07-31): ratio 6.6, flops/row 23 —
+    # ~1.35x anchors
+    assert ratio <= 9.0, f"sort HBM traffic regressed: {ratio:.1f}x input"
+    assert flops_per_row <= 31.0, \
         f"sort flops regressed: {flops_per_row:.0f}/row"
 
 
@@ -277,3 +284,43 @@ def test_shrunk_agg_bounds_downstream_sort(spark):
     assert full_on < full_off, \
         (f"agg shrink no longer bounds the downstream sort: "
          f"{widths_on} vs unshrunk {widths_off}")
+
+
+def test_streamed_scan_step_traffic(spark, tmp_path):
+    """The per-batch jitted step of the streamed scan→sum pipeline (the
+    parquet bench lane with prefetch overlap): bytes accessed bounded at
+    a small multiple of one batch, flops ~1/row.  A compact regrowth or
+    accidental wide materialization trips this off-hardware."""
+    import pandas as pd
+    import spark_tpu.config as C
+    import spark_tpu.kernels as K
+    from spark_tpu import io as tio
+    from spark_tpu.sql import multibatch as mb
+    p = tmp_path / "scan.parquet"
+    p.mkdir()
+    pd.DataFrame({"x": np.arange(8192, dtype=np.int64)}).to_parquet(
+        p / "part-0.parquet", index=False)
+    old_batch = spark.conf.get(C.SCAN_MAX_BATCH_ROWS)
+    spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, "1024")
+    old_mxu = K.MXU_AGG_ENABLED
+    K.MXU_AGG_ENABLED = False
+    try:
+        df = spark.read.parquet(str(p)).agg(F.sum("x").alias("s"))
+        qe = QueryExecution(spark, df._plan)
+        ex = mb.plan_multibatch(spark, qe.optimized)
+        assert ex is not None
+        tmpl = next(iter(tio.scan_file_batches(ex.dec.rel, 1024)))
+        from spark_tpu.columnar import normalize_valids, pad_to_capacity
+        tmpl = normalize_valids(pad_to_capacity(tmpl, ex.capacity))
+        jstep, _schema = ex._build_step(tmpl)
+        ca = jstep.lower(tmpl.to_device()).compile().cost_analysis()
+        d = ca[0] if isinstance(ca, (list, tuple)) else ca
+        batch_bytes = ex.capacity * 8
+        ratio = d["bytes accessed"] / batch_bytes
+        # measured (XLA:CPU, r5 2026-07-31): ratio 9.8 (tiny 1024-row
+        # batch: padded result buffers amortize poorly) — ~1.35x anchor
+        assert ratio <= 13.0, \
+            f"streamed scan step traffic regressed: {ratio:.1f}x batch"
+    finally:
+        K.MXU_AGG_ENABLED = old_mxu
+        spark.conf.set(C.SCAN_MAX_BATCH_ROWS.key, str(old_batch))
