@@ -13,7 +13,9 @@ from .base import (
 __all__ = ["LinearRegression", "LinearRegressionModel",
            "DecisionTreeRegressor", "DecisionTreeRegressionModel",
            "RandomForestRegressor", "RandomForestRegressionModel",
-           "GBTRegressor", "GBTRegressionModel"]
+           "GBTRegressor", "GBTRegressionModel",
+           "IsotonicRegression", "IsotonicRegressionModel",
+           "AFTSurvivalRegression", "AFTSurvivalRegressionModel"]
 
 
 class LinearRegression(Estimator):
@@ -176,3 +178,185 @@ class GBTRegressionModel(Model):
         return append_prediction(df, batch, n, pred,
                                  self.getOrDefault("predictionCol"), T.float64)
 
+
+
+class IsotonicRegression(Estimator):
+    """Pool-adjacent-violators isotonic fit
+    (`ml/regression/IsotonicRegression.scala:163` analog).
+
+    PAV is an inherently sequential merge of adjacent pools — like the
+    reference (which runs parallel PAV per partition and a final host
+    pass), the merge itself is host-side; prediction is a vectorized
+    searchsorted interpolation on device-friendly arrays."""
+    isotonic = Param("isotonic", "increasing (True) or decreasing", True)
+    weightCol = Param("weightCol", "optional weight column", None)
+
+    def _fit(self, df):
+        from .base import extract_column, extract_matrix
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        x = np.asarray(X[:, 0], np.float64)
+        y = np.asarray(extract_column(
+            batch, self.getOrDefault("labelCol"), n), np.float64)
+        wc = self.getOrDefault("weightCol")
+        w = np.asarray(extract_column(batch, wc, n), np.float64) \
+            if wc else np.ones(n)
+        inc = self.getOrDefault("isotonic")
+        order = np.argsort(x, kind="stable")
+        xs, ys, ws = x[order], y[order], w[order]
+        # pool tied feature values first (weighted label mean, summed
+        # weight) — Spark/sklearn semantics: predict(x) at a duplicated x
+        # is the pooled average, not an interpolation between duplicates
+        starts = np.flatnonzero(np.r_[True, xs[1:] != xs[:-1]])
+        ends = np.r_[starts[1:], len(xs)]
+        if len(starts) != len(xs):
+            wsum = np.add.reduceat(ws, starts)
+            safe = np.maximum(wsum, 1e-300)
+            ysum = np.add.reduceat(ys * ws, starts)
+            cnt = ends - starts
+            ys = np.where(wsum > 0, ysum / safe,
+                          np.add.reduceat(ys, starts) / cnt)
+            xs = xs[starts]
+            ws = wsum
+        if not inc:
+            ys = -ys
+        # pool-adjacent-violators over the sorted sequence; each pool
+        # keeps its x extent so prediction holds constant INSIDE a pool
+        # and interpolates only BETWEEN pools (sklearn/reference
+        # thresholds semantics)
+        vals: list = []
+        wts: list = []
+        xmin: list = []
+        xmax: list = []
+        for xi, yi, wi in zip(xs, ys, ws):
+            vals.append(yi)
+            wts.append(wi)
+            xmin.append(xi)
+            xmax.append(xi)
+            while len(vals) > 1 and vals[-2] > vals[-1]:
+                wtot = wts[-1] + wts[-2]
+                if wtot > 0:
+                    vals[-2] = (vals[-1] * wts[-1]
+                                + vals[-2] * wts[-2]) / wtot
+                else:        # two zero-weight pools: plain average
+                    vals[-2] = 0.5 * (vals[-1] + vals[-2])
+                wts[-2] = wtot
+                xmax[-2] = xmax[-1]
+                vals.pop(); wts.pop(); xmin.pop(); xmax.pop()
+        bx: list = []
+        by: list = []
+        for v, lo, hi in zip(vals, xmin, xmax):
+            fv = v if inc else -v
+            bx.append(lo)
+            by.append(fv)
+            if hi > lo:
+                bx.append(hi)
+                by.append(fv)
+        return IsotonicRegressionModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"),
+            boundaries=np.asarray(bx), predictions=np.asarray(by),
+            isotonic=inc)
+
+
+class IsotonicRegressionModel(Model):
+    boundaries = Param("boundaries", "pool left boundaries (sorted x)",
+                       None)
+    predictions = Param("predictions", "pool fitted values", None)
+    isotonic = Param("isotonic", "", True)
+
+    def transform(self, df):
+        from .base import append_prediction, extract_matrix
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        x = np.asarray(X[:, 0], np.float64)
+        bx = np.asarray(self.getOrDefault("boundaries"), np.float64)
+        by = np.asarray(self.getOrDefault("predictions"), np.float64)
+        if len(bx) == 0:
+            pred = np.zeros_like(x)
+        else:
+            # piecewise-linear interpolation between pool boundaries,
+            # clamped at the ends (reference predict() contract)
+            pred = np.interp(x, bx, by)
+        return append_prediction(df, batch, n, pred,
+                                 self.getOrDefault("predictionCol"),
+                                 T.float64)
+
+
+class AFTSurvivalRegression(Estimator):
+    """Accelerated-failure-time survival regression with Weibull
+    (log-extreme-value) noise (`ml/regression/AFTSurvivalRegression.scala:88`
+    analog): censored log-likelihood maximized by one jit-compiled Adam
+    loop over the full device batch (the reference uses per-partition
+    gradient aggregation under LBFGS)."""
+    censorCol = Param("censorCol", "1.0 = event occurred, 0.0 = censored",
+                      "censor")
+    maxIter = Param("maxIter", "Adam iterations", 500)
+    stepSize = Param("stepSize", "Adam learning rate", 0.05)
+    fitIntercept = Param("fitIntercept", "", True)
+
+    def _fit(self, df):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from .base import extract_column, extract_matrix
+
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        X = X.astype(jnp.float64)
+        y = extract_column(batch, self.getOrDefault("labelCol"), n)
+        c = extract_column(batch, self.getOrDefault("censorCol"), n)
+        if bool(np.asarray((y <= 0).any())):
+            # one log(0) residual would silently dominate the likelihood
+            raise ValueError(
+                "AFTSurvivalRegression requires strictly positive labels "
+                "(survival times); found label <= 0")
+        logy = jnp.log(y)
+        if self.getOrDefault("fitIntercept"):
+            X = jnp.concatenate([X, jnp.ones((X.shape[0], 1))], axis=1)
+        d = X.shape[1]
+
+        def nll(params):
+            beta, log_sigma = params[:d], params[d]
+            sigma = jnp.exp(log_sigma)
+            eps = (logy - X @ beta) / sigma
+            # Weibull AFT: event → log pdf of extreme value, censored →
+            # log survival  S(eps) = exp(-e^eps)
+            log_pdf = eps - jnp.exp(eps) - log_sigma
+            log_surv = -jnp.exp(eps)
+            return -jnp.sum(jnp.where(c > 0.5, log_pdf, log_surv)) / n
+
+        opt = optax.adam(self.getOrDefault("stepSize"))
+        p0 = jnp.zeros(d + 1)
+
+        def step(carry, _):
+            p, s = carry
+            loss, g = jax.value_and_grad(nll)(p)
+            up, s = opt.update(g, s)
+            return (optax.apply_updates(p, up), s), loss
+
+        (p, _), _ = jax.lax.scan(step, (p0, opt.init(p0)), None,
+                                 length=self.getOrDefault("maxIter"))
+        p = np.asarray(p)
+        if self.getOrDefault("fitIntercept"):
+            coef, intercept = p[:d - 1], float(p[d - 1])
+        else:
+            coef, intercept = p[:d], 0.0
+        return AFTSurvivalRegressionModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"),
+            coefficients=coef, intercept=intercept,
+            scale=float(np.exp(p[-1])))
+
+
+class AFTSurvivalRegressionModel(Model):
+    coefficients = Param("coefficients", "", None)
+    intercept = Param("intercept", "", 0.0)
+    scale = Param("scale", "Weibull scale sigma", 1.0)
+
+    def transform(self, df):
+        from .base import append_prediction, extract_matrix
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        w = np.asarray(self.getOrDefault("coefficients"), np.float64)
+        pred = np.exp(np.asarray(X, np.float64) @ w
+                      + self.getOrDefault("intercept"))
+        return append_prediction(df, batch, n, pred,
+                                 self.getOrDefault("predictionCol"),
+                                 T.float64)

@@ -273,3 +273,108 @@ def test_fpgrowth_association_rules_confidence_filter(spark):
     assert ("y", "x") in rules
     assert rules[("y", "x")] == pytest.approx(1.0)
     assert ("x", "y") not in rules
+
+
+# ---------------------------------------------------------------------------
+# GaussianMixture / IsotonicRegression / AFTSurvivalRegression (round-5
+# second wave of ml/ breadth)
+# ---------------------------------------------------------------------------
+
+def test_gaussian_mixture_vs_sklearn(spark):
+    from sklearn.mixture import GaussianMixture as SkGMM
+    from spark_tpu.ml.clustering import GaussianMixture
+    rng = np.random.default_rng(4)
+    X = np.vstack([rng.normal([-3, 0], [0.5, 0.5], (150, 2)),
+                   rng.normal([3, 1], [0.7, 0.3], (150, 2))])
+    df = spark.createDataFrame({"features": X})
+    model = GaussianMixture(k=2, maxIter=80, seed=3).fit(df)
+    ours = np.array([r["prediction"]
+                     for r in model.transform(df).collect()])
+    sk = SkGMM(2, random_state=0).fit(X)
+    skp = sk.predict(X)
+    # same partition up to label permutation
+    agree = max((ours == skp).mean(), (ours == 1 - skp).mean())
+    assert agree >= 0.98, agree
+    # means match the true centers (sorted by x)
+    mu = np.asarray(model.getOrDefault("means"))
+    mu = mu[np.argsort(mu[:, 0])]
+    np.testing.assert_allclose(mu[0], [-3, 0], atol=0.2)
+    np.testing.assert_allclose(mu[1], [3, 1], atol=0.2)
+    probs = np.array([r["probability"]
+                      for r in model.transform(df).collect()])
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-9)
+
+
+def test_isotonic_vs_sklearn(spark):
+    from sklearn.isotonic import IsotonicRegression as SkIso
+    from spark_tpu.ml.regression import IsotonicRegression
+    rng = np.random.default_rng(6)
+    x = np.sort(rng.uniform(0, 10, 120))
+    y = np.log1p(x) + rng.normal(0, 0.15, 120)
+    df = spark.createDataFrame({"features": x[:, None], "label": y})
+    model = IsotonicRegression().fit(df)
+    got = np.array([r["prediction"]
+                    for r in model.transform(df).collect()])
+    sk = SkIso(out_of_bounds="clip").fit(x, y).predict(x)
+    np.testing.assert_allclose(got, sk, atol=1e-9)
+    # monotone by construction
+    assert np.all(np.diff(got) >= -1e-12)
+
+
+def test_isotonic_decreasing(spark):
+    from spark_tpu.ml.regression import IsotonicRegression
+    x = np.arange(10, dtype=np.float64)
+    y = -x + np.array([0.5, -0.5] * 5)
+    df = spark.createDataFrame({"features": x[:, None], "label": y})
+    got = np.array([r["prediction"] for r in
+                    IsotonicRegression(isotonic=False).fit(df)
+                    .transform(df).collect()])
+    assert np.all(np.diff(got) <= 1e-12)
+
+
+def test_aft_survival_recovers_scale(spark):
+    """Weibull AFT on synthetic censored data: the fitted acceleration
+    coefficients recover the generating model's direction and the
+    prediction is monotone in the covariate."""
+    from spark_tpu.ml.regression import AFTSurvivalRegression
+    rng = np.random.default_rng(8)
+    n = 600
+    x = rng.normal(0, 1, (n, 1))
+    # true: log T = 1.0 + 0.8 x + 0.5 * Gumbel(min)
+    eps = np.log(rng.exponential(1.0, n))       # extreme-value noise
+    logt = 1.0 + 0.8 * x[:, 0] + 0.5 * eps
+    t = np.exp(logt)
+    cens_time = rng.exponential(np.e ** 2.2, n)
+    y = np.minimum(t, cens_time)
+    c = (t <= cens_time).astype(np.float64)
+    assert 0.2 < c.mean() < 0.95                # real censoring present
+    df = spark.createDataFrame({"features": x, "label": y, "censor": c})
+    model = AFTSurvivalRegression(maxIter=800).fit(df)
+    coef = np.asarray(model.getOrDefault("coefficients"))
+    assert coef[0] == pytest.approx(0.8, abs=0.15)
+    assert model.getOrDefault("intercept") == pytest.approx(1.0, abs=0.2)
+    assert model.getOrDefault("scale") == pytest.approx(0.5, abs=0.15)
+    rows = model.transform(df).collect()
+    preds = np.array([r["prediction"] for r in rows])
+    assert np.corrcoef(preds, np.exp(1.0 + 0.8 * x[:, 0]))[0, 1] > 0.99
+
+
+def test_isotonic_ties_pool_like_sklearn(spark):
+    from sklearn.isotonic import IsotonicRegression as SkIso
+    from spark_tpu.ml.regression import IsotonicRegression
+    x = np.array([1.0, 1.0, 2.0, 2.0, 3.0])
+    y = np.array([0.0, 1.0, 2.0, 0.0, 3.0])
+    df = spark.createDataFrame({"features": x[:, None], "label": y})
+    got = np.array([r["prediction"] for r in
+                    IsotonicRegression().fit(df).transform(df).collect()])
+    sk = SkIso(out_of_bounds="clip").fit(x, y).predict(x)
+    np.testing.assert_allclose(got, sk, atol=1e-9)
+
+
+def test_aft_rejects_nonpositive_labels(spark):
+    from spark_tpu.ml.regression import AFTSurvivalRegression
+    df = spark.createDataFrame({
+        "features": np.ones((3, 1)), "label": np.array([1.0, 0.0, 2.0]),
+        "censor": np.ones(3)})
+    with pytest.raises(ValueError, match="positive"):
+        AFTSurvivalRegression().fit(df)
