@@ -192,7 +192,6 @@ class IsotonicRegression(Estimator):
     weightCol = Param("weightCol", "optional weight column", None)
 
     def _fit(self, df):
-        from .base import extract_column, extract_matrix
         X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
         x = np.asarray(X[:, 0], np.float64)
         y = np.asarray(extract_column(
@@ -265,7 +264,6 @@ class IsotonicRegressionModel(Model):
     isotonic = Param("isotonic", "", True)
 
     def transform(self, df):
-        from .base import append_prediction, extract_matrix
         X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
         x = np.asarray(X[:, 0], np.float64)
         bx = np.asarray(self.getOrDefault("boundaries"), np.float64)
@@ -297,7 +295,6 @@ class AFTSurvivalRegression(Estimator):
         import jax
         import jax.numpy as jnp
         import optax
-        from .base import extract_column, extract_matrix
 
         X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
         X = X.astype(jnp.float64)
@@ -352,7 +349,6 @@ class AFTSurvivalRegressionModel(Model):
     scale = Param("scale", "Weibull scale sigma", 1.0)
 
     def transform(self, df):
-        from .base import append_prediction, extract_matrix
         X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
         w = np.asarray(self.getOrDefault("coefficients"), np.float64)
         pred = np.exp(np.asarray(X, np.float64) @ w

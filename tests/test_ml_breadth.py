@@ -378,3 +378,48 @@ def test_aft_rejects_nonpositive_labels(spark):
         "censor": np.ones(3)})
     with pytest.raises(ValueError, match="positive"):
         AFTSurvivalRegression().fit(df)
+
+
+def test_lda_recovers_topics(spark):
+    """Two disjoint vocabularies: LDA must separate them into two topics
+    and assign each doc's dominant topic correctly (same contract a
+    sklearn LatentDirichletAllocation run satisfies on this corpus)."""
+    from spark_tpu.ml.clustering import LDA
+    rng = np.random.default_rng(12)
+    V = 20
+    n = 120
+    C = np.zeros((n, V))
+    truth = []
+    for i in range(n):
+        topic = i % 2
+        words = rng.integers(0, 10, 30) + (10 * topic)
+        np.add.at(C[i], words, 1.0)
+        truth.append(topic)
+    truth = np.array(truth)
+    df = spark.createDataFrame({"features": C})
+    model = LDA(k=2, maxIter=40, seed=5).fit(df)
+
+    # topic-word: each learned topic concentrates on one half-vocab
+    tm = model.topicsMatrix()                      # (V, k)
+    mass_low = tm[:10].sum(axis=0)                 # per-topic mass on 0..9
+    assert (mass_low.max() > 0.9) and (mass_low.min() < 0.1), mass_low
+    low_topic = int(np.argmax(mass_low))
+
+    # describeTopics exposes the top terms of the right half
+    topics = model.describeTopics(5)
+    top_terms = set(topics[low_topic][1])
+    assert top_terms <= set(range(10)), topics
+
+    # per-doc topic distribution puts docs on their generating topic
+    rows = model.transform(df).collect()
+    dist = np.array([r["topicDistribution"] for r in rows])
+    np.testing.assert_allclose(dist.sum(axis=1), 1.0, atol=1e-9)
+    pred_is_low = dist[:, low_topic] > 0.5
+    want_low = truth == 0
+    assert (pred_is_low == want_low).mean() >= 0.95
+
+    # sklearn on the same corpus meets the same separation bar
+    from sklearn.decomposition import LatentDirichletAllocation as SkLDA
+    sk = SkLDA(2, random_state=0).fit(C)
+    sk_low = sk.components_[:, :10].sum(1) / sk.components_.sum(1)
+    assert sk_low.max() > 0.9 and sk_low.min() < 0.1
