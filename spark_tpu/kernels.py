@@ -74,6 +74,47 @@ def searchsorted(xp, a: Array, v: Array, side: str = "left") -> Array:
     return xp.searchsorted(a, v, side=side, method=method)
 
 
+def radix_argsort(xp, keys: Array, bits: int = 4) -> Array:
+    """Stable LSD radix argsort of int64 keys — the TPU-native candidate
+    replacement for the bitonic ``lax.sort`` (`SortBenchmark.scala:120`
+    radix baseline role).
+
+    Per digit pass: a (n, 2^bits) one-hot, column sums for the global
+    digit starts, an exclusive cumsum down the rows for stable
+    within-digit ranks, and one scatter to invert the placement — all
+    dense, fusable ops (the one-hot contraction is MXU-shaped), no
+    compare network.  ``bits=4`` keeps the per-pass working set at
+    n x 16 x 4B; 16 passes cover 64 bits.  CPU lane: np.argsort
+    (XLA:CPU executes the dense formulation slower than its built-in
+    sort — this path exists for TPU, A/B'd by tools/prof_agg2.py in a
+    hardware window before it takes over any default)."""
+    if _is_np(xp):
+        return np.argsort(np.asarray(keys), kind="stable")
+    if 64 % bits != 0:
+        raise ValueError(f"radix_argsort bits={bits} must divide 64 "
+                         "(uncovered top bits would silently mis-sort)")
+    import jax
+    import jax.numpy as jnp
+    n = keys.shape[0]
+    R = 1 << bits
+    k = keys.astype(jnp.uint64) ^ jnp.uint64(1 << 63)   # signed → biased
+    perm = jnp.arange(n, dtype=jnp.int32)
+    for p in range(64 // bits):
+        digit = ((k >> jnp.uint64(p * bits))
+                 & jnp.uint64(R - 1)).astype(jnp.int32)
+        oh = jax.nn.one_hot(digit, R, dtype=jnp.int32)          # (n, R)
+        counts = oh.sum(axis=0)
+        starts = jnp.cumsum(counts) - counts                    # (R,)
+        ranks = jnp.cumsum(oh, axis=0) - oh                     # exclusive
+        pos = starts[digit] + jnp.take_along_axis(
+            ranks, digit[:, None], axis=1)[:, 0]
+        inv = jnp.zeros(n, jnp.int32).at[pos].set(
+            jnp.arange(n, dtype=jnp.int32))
+        k = k[inv]
+        perm = perm[inv]
+    return perm
+
+
 def sort_key_transform(xp, data: Array, valid: Optional[Array], dtype: T.DataType,
                        ascending: bool, nulls_first: bool) -> List[Array]:
     """Turn one sort column into (null_rank, comparable_key) arrays.

@@ -215,9 +215,14 @@ class GaussianMixtureModel(Model):
                                  T.ArrayType(T.float64))
 
 
-def _lda_e_step(C, lam, alpha, inner, jnp, jsp):
+def _lda_e_step(C, lam, alpha, inner, jnp, jsp, with_stats: bool = True):
     """Batch variational E-step (Hoffman online-LDA update, vectorized
-    over all docs): returns (gamma (n,k), expElogtheta, phinorm)."""
+    over all docs).
+
+    Returns ``(gamma, expElogtheta, expElogbeta, phinorm)``; with
+    ``with_stats=False`` the trailing sufficient-statistics recompute
+    (the (n,V) phinorm matmul only the M-step needs) is skipped and the
+    last three slots are None — the transform path's cheap form."""
     Elogbeta = jsp.digamma(lam) - jsp.digamma(lam.sum(1, keepdims=True))
     expElogbeta = jnp.exp(Elogbeta)                      # (k, V)
     n = C.shape[0]
@@ -234,6 +239,8 @@ def _lda_e_step(C, lam, alpha, inner, jnp, jsp):
 
     import jax
     gamma, _ = jax.lax.scan(one, gamma0, None, length=inner)
+    if not with_stats:
+        return gamma, None, None, None
     Elogtheta = jsp.digamma(gamma) \
         - jsp.digamma(gamma.sum(1, keepdims=True))
     expElogtheta = jnp.exp(Elogtheta)
@@ -270,8 +277,10 @@ class LDA(Estimator):
         C = X                       # already a float64 device matrix
         k = self.getOrDefault("k")
         V = C.shape[1]
-        alpha = self.getOrDefault("docConcentration") or 1.0 / k
-        eta = self.getOrDefault("topicConcentration") or 1.0 / k
+        alpha_p = self.getOrDefault("docConcentration")
+        eta_p = self.getOrDefault("topicConcentration")
+        alpha = alpha_p if alpha_p is not None else 1.0 / k
+        eta = eta_p if eta_p is not None else 1.0 / k
         key = jax.random.PRNGKey(self.getOrDefault("seed"))
         lam0 = jax.random.gamma(key, 100.0, (k, V)) / 100.0 * \
             (C.sum() / (k * V) + 1.0)
@@ -320,7 +329,8 @@ class LDAModel(Model):
         C = X                       # already a float64 device matrix
         lam = jnp.asarray(np.asarray(self.getOrDefault("topics")))
         gamma, _t, _b, _p = _lda_e_step(
-            C, lam, self.getOrDefault("docConcentration"), 30, jnp, jsp)
+            C, lam, self.getOrDefault("docConcentration"), 30, jnp, jsp,
+            with_stats=False)
         g = np.asarray(gamma)
         dist = g / g.sum(axis=1, keepdims=True)
         return append_prediction(df, batch, n, dist,

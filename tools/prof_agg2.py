@@ -19,22 +19,24 @@ kd = jnp.asarray(rng.integers(0, GROUPS, N).astype(np.int64))
 vd = jnp.asarray(rng.integers(0, 100, N).astype(np.int64))
 
 
-def loop_time(name, step, *args):
+def loop_time(name, step, *args, iters=None):
     """step(i, *args) -> scalar contribution; fori_loop of ITERS.
 
     Each variant is isolated: a compile failure (e.g. a Mosaic
     regression in the Pallas step) must not abort the remaining
     measurements — a rare tunnel window has to yield the full profile."""
+    it = iters or ITERS
+
     def run(args):
         def body(i, acc):
             return acc + step(i.astype(jnp.int64), *args)
-        return jax.lax.fori_loop(0, ITERS, body, jnp.int64(0))
+        return jax.lax.fori_loop(0, it, body, jnp.int64(0))
     try:
         f = jax.jit(run)
         _ = int(np.asarray(f(args)))          # compile+warm
         t0 = time.perf_counter()
         acc = int(np.asarray(f(args)))
-        dt = (time.perf_counter() - t0) / ITERS
+        dt = (time.perf_counter() - t0) / it
         print(f"{name:44s} {dt*1e3:9.2f} ms/iter {N/dt/1e6:9.1f} Mrows/s",
               flush=True)
         return dt
@@ -104,4 +106,19 @@ loop_time("argsort int64",
 loop_time("2-col sort (key+perm) int64",
           lambda i, k, v: jax.lax.sort((v + i, k))[1][0] & jnp.int64(1),
           kd, vd)
+
+# 6. radix argsort candidate vs the bitonic (the sort-lane decision
+# point: 0.22x baseline today; radix is dense one-hot/cumsum/scatter)
+loop_time("radix_argsort bits=4",
+          lambda i, k, v: kernels.radix_argsort(
+              jnp, v + i).astype(jnp.int64)[0] & jnp.int64(1), kd, vd,
+          iters=3)
+loop_time("radix_argsort bits=8",
+          lambda i, k, v: kernels.radix_argsort(
+              jnp, v + i, bits=8).astype(jnp.int64)[0] & jnp.int64(1),
+          kd, vd, iters=3)
+loop_time("lax.sort argsort baseline (2-op)",
+          lambda i, k, v: jax.lax.sort(
+              (v + i, jnp.arange(N, dtype=jnp.int32)),
+              num_keys=1)[1][0].astype(jnp.int64) & jnp.int64(1), kd, vd)
 print("done")
