@@ -20,6 +20,10 @@ __all__ = [
     "IndexToString", "OneHotEncoder", "Tokenizer", "HashingTF", "Binarizer",
     "Bucketizer", "SQLTransformer", "PCA", "PCAModel",
     "CountVectorizer", "CountVectorizerModel", "Word2Vec", "Word2VecModel",
+    "IDF", "IDFModel", "Normalizer", "MaxAbsScaler", "MaxAbsScalerModel",
+    "StopWordsRemover", "NGram", "QuantileDiscretizer", "Imputer",
+    "ImputerModel", "PolynomialExpansion", "ElementwiseProduct",
+    "VectorSlicer",
 ]
 
 
@@ -29,6 +33,21 @@ def _exec_host(df):
     batch = compact(np, batch)
     n = int(np.asarray(batch.num_rows()))
     return batch, n
+
+
+def _append_string_column(df, batch, n, rows, name):
+    """Append one string column (``rows``: n python strings/None) to an
+    executed host batch — the shared tail of every token transformer."""
+    from ..columnar import ColumnBatch, ColumnVector, encode_strings
+    from ..sql import logical as L
+    from ..sql.dataframe import DataFrame
+    codes, dic = encode_strings(list(rows) + [None] * (batch.capacity - n))
+    vec = ColumnVector(np.where(codes < 0, 0, codes).astype(np.int32),
+                       T.string, codes >= 0, dic)
+    out = ColumnBatch(list(batch.names) + [name],
+                      list(batch.vectors) + [vec], batch.row_valid,
+                      batch.capacity)
+    return DataFrame(df.session, L.LocalRelation(out))
 
 
 class VectorAssembler(Transformer):
@@ -230,16 +249,8 @@ class Tokenizer(Transformer):
             np.asarray(batch.row_valid_or_true()))
         joined = ["\x00".join(str(v).lower().split()) if v is not None else None
                   for v in vals]
-        from ..columnar import ColumnBatch, ColumnVector, encode_strings
-        from ..sql import logical as L
-        from ..sql.dataframe import DataFrame
-        codes, dic = encode_strings(joined + [None] * (batch.capacity - n))
-        vec = ColumnVector(np.where(codes < 0, 0, codes).astype(np.int32),
-                           T.string, codes >= 0, dic)
-        out = ColumnBatch(list(batch.names) + [self.getOrDefault("outputCol")],
-                          list(batch.vectors) + [vec], batch.row_valid,
-                          batch.capacity)
-        return DataFrame(df.session, L.LocalRelation(out))
+        return _append_string_column(df, batch, n, joined[:n],
+                                     self.getOrDefault("outputCol"))
 
 
 class HashingTF(Transformer):
@@ -579,5 +590,297 @@ class Word2VecModel(Model):
             if ids:
                 mat[i] = vecs[ids].mean(axis=0)
         return append_prediction(df, batch, n, mat,
+                                 self.getOrDefault("outputCol"),
+                                 T.ArrayType(T.float64))
+
+
+class IDF(Estimator):
+    """Inverse document frequency over count vectors
+    (`ml/feature/IDF.scala:68`): idf = log((m+1)/(df+1)), the reference's
+    smoothed formula; fit is one column-wise device reduction."""
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    minDocFreq = Param("minDocFreq", "zero idf below this df", 0)
+
+    def _fit(self, df):
+        X, batch, n = extract_matrix(df, self.getOrDefault("inputCol"))
+        # (X > 0).sum is the one column-wise device reduction
+        dfreq = np.asarray((X > 0).sum(axis=0), np.float64)
+        idf = np.log((n + 1.0) / (dfreq + 1.0))
+        idf[dfreq < self.getOrDefault("minDocFreq")] = 0.0
+        return IDFModel(inputCol=self.getOrDefault("inputCol"),
+                        outputCol=self.getOrDefault("outputCol"),
+                        idf=idf)
+
+
+class IDFModel(Model):
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    idf = Param("idf", "(V,) idf vector", None)
+
+    def transform(self, df):
+        X, batch, n = extract_matrix(df, self.getOrDefault("inputCol"))
+        out = np.asarray(X) * np.asarray(self.getOrDefault("idf"))
+        return append_prediction(df, batch, n, out,
+                                 self.getOrDefault("outputCol"),
+                                 T.ArrayType(T.float64))
+
+
+class Normalizer(Transformer):
+    """Row p-norm scaling (`ml/feature/Normalizer.scala:39`)."""
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    p = Param("p", "norm order", 2.0)
+
+    def transform(self, df):
+        X, batch, n = extract_matrix(df, self.getOrDefault("inputCol"))
+        Xn = np.asarray(X, np.float64)
+        p = self.getOrDefault("p")
+        if np.isinf(p):
+            norms = np.abs(Xn).max(axis=1)
+        else:
+            norms = (np.abs(Xn) ** p).sum(axis=1) ** (1.0 / p)
+        out = Xn / np.where(norms > 0, norms, 1.0)[:, None]
+        return append_prediction(df, batch, n, out,
+                                 self.getOrDefault("outputCol"),
+                                 T.ArrayType(T.float64))
+
+
+class MaxAbsScaler(Estimator):
+    """Per-feature division by max |x| (`ml/feature/MaxAbsScaler.scala:62`):
+    preserves sparsity/sign, lands in [-1, 1]."""
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+
+    def _fit(self, df):
+        X, batch, n = extract_matrix(df, self.getOrDefault("inputCol"))
+        return MaxAbsScalerModel(
+            inputCol=self.getOrDefault("inputCol"),
+            outputCol=self.getOrDefault("outputCol"),
+            maxAbs=np.abs(np.asarray(X, np.float64)).max(axis=0))
+
+
+class MaxAbsScalerModel(Model):
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    maxAbs = Param("maxAbs", "", None)
+
+    def transform(self, df):
+        X, batch, n = extract_matrix(df, self.getOrDefault("inputCol"))
+        m = np.asarray(self.getOrDefault("maxAbs"), np.float64)
+        out = np.asarray(X, np.float64) / np.where(m > 0, m, 1.0)
+        return append_prediction(df, batch, n, out,
+                                 self.getOrDefault("outputCol"),
+                                 T.ArrayType(T.float64))
+
+
+#: `ml/feature/StopWordsRemover.scala` default english list (abridged to
+#: the reference's most common members; loadDefaultStopWords analog)
+_ENGLISH_STOP_WORDS = frozenset("""a about above after again against all am
+an and any are as at be because been before being below between both but
+by could did do does doing down during each few for from further had has
+have having he her here hers herself him himself his how i if in into is
+it its itself me more most my myself no nor not of off on once only or
+other ought our ours ourselves out over own same she should so some such
+than that the their theirs them themselves then there these they this
+those through to too under until up very was we were what when where which
+while who whom why with would you your yours yourself yourselves""".split())
+
+
+class StopWordsRemover(Transformer):
+    """Filter stop words out of a token column
+    (`ml/feature/StopWordsRemover.scala:43`); \\x00-joined Tokenizer
+    convention in and out."""
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    stopWords = Param("stopWords", "None = english default", None)
+    caseSensitive = Param("caseSensitive", "", False)
+
+    def transform(self, df):
+        batch, n = _exec_host(df)
+        vals = batch.column(self.getOrDefault("inputCol")).to_pylist(
+            np.asarray(batch.row_valid_or_true()))
+        sw = self.getOrDefault("stopWords")
+        stop = set(sw) if sw is not None else set(_ENGLISH_STOP_WORDS)
+        cs = self.getOrDefault("caseSensitive")
+        if not cs:
+            stop = {w.lower() for w in stop}
+        out_rows = []
+        for v in vals[:n]:
+            if v is None:
+                out_rows.append(None)
+                continue
+            toks = [t for t in str(v).split("\x00") if t]
+            kept = [t for t in toks
+                    if (t if cs else t.lower()) not in stop]
+            out_rows.append("\x00".join(kept))
+        return _append_string_column(df, batch, n, out_rows,
+                                     self.getOrDefault("outputCol"))
+
+
+class NGram(Transformer):
+    """Token n-grams (`ml/feature/NGram.scala:38`): space-joined grams,
+    \\x00-separated gram list (Tokenizer convention)."""
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    n = Param("n", "gram size", 2)
+
+    def transform(self, df):
+        batch, nrows = _exec_host(df)
+        vals = batch.column(self.getOrDefault("inputCol")).to_pylist(
+            np.asarray(batch.row_valid_or_true()))
+        g = self.getOrDefault("n")
+        out_rows = []
+        for v in vals[:nrows]:
+            if v is None:
+                out_rows.append(None)
+                continue
+            toks = [t for t in str(v).split("\x00") if t]
+            grams = [" ".join(toks[i:i + g])
+                     for i in range(len(toks) - g + 1)]
+            out_rows.append("\x00".join(grams))
+        return _append_string_column(df, batch, nrows, out_rows,
+                                     self.getOrDefault("outputCol"))
+
+
+class QuantileDiscretizer(Estimator):
+    """Quantile-boundary binning (`ml/feature/QuantileDiscretizer.scala:93`):
+    fit computes numBuckets quantile splits, producing a Bucketizer."""
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    numBuckets = Param("numBuckets", "", 2)
+
+    def _fit(self, df):
+        batch, n = _exec_host(df)
+        x = np.asarray(batch.column(self.getOrDefault("inputCol"))
+                       .data)[:n].astype(np.float64)
+        if np.isnan(x).any():
+            # NaN poisons every quantile and un-sorts the splits; the
+            # reference errors under default handleInvalid too
+            raise AnalysisException(
+                "QuantileDiscretizer: input column contains NaN; impute "
+                "or filter first")
+        nb = self.getOrDefault("numBuckets")
+        qs = np.quantile(x, np.linspace(0, 1, nb + 1)[1:-1])
+        splits = [-np.inf] + sorted(set(qs.tolist())) + [np.inf]
+        return Bucketizer(inputCol=self.getOrDefault("inputCol"),
+                          outputCol=self.getOrDefault("outputCol"),
+                          splits=splits)
+
+
+class Imputer(Estimator):
+    """Missing-value imputation by mean/median
+    (`ml/feature/Imputer.scala:88`).  NULL (invalid) cells and an
+    optional sentinel (missingValue, default NaN) impute per column."""
+    inputCols = Param("inputCols", "", None)
+    outputCols = Param("outputCols", "", None)
+    strategy = Param("strategy", "mean|median", "mean")
+    missingValue = Param("missingValue", "", float("nan"))
+
+    def _fit(self, df):
+        strategy = self.getOrDefault("strategy")
+        if strategy not in ("mean", "median"):
+            raise AnalysisException(
+                f"Imputer strategy must be 'mean' or 'median', got "
+                f"{strategy!r}")
+        batch, n = _exec_host(df)
+        mv = self.getOrDefault("missingValue")
+        stats = {}
+        for c in self.getOrDefault("inputCols"):
+            vec = batch.column(c)
+            x = np.asarray(vec.data)[:n].astype(np.float64)
+            ok = np.ones(n, bool) if vec.valid is None \
+                else np.asarray(vec.valid)[:n].copy()
+            ok &= ~np.isnan(x) if np.isnan(mv) else (x != mv)
+            vals = x[ok]
+            if len(vals) == 0:
+                raise AnalysisException(f"Imputer: column {c!r} has no "
+                                        "non-missing values")
+            stats[c] = float(np.median(vals) if strategy == "median"
+                             else vals.mean())
+        return ImputerModel(inputCols=self.getOrDefault("inputCols"),
+                            outputCols=self.getOrDefault("outputCols"),
+                            missingValue=mv, surrogates=stats)
+
+
+class ImputerModel(Model):
+    inputCols = Param("inputCols", "", None)
+    outputCols = Param("outputCols", "", None)
+    missingValue = Param("missingValue", "", float("nan"))
+    surrogates = Param("surrogates", "col → fill value", None)
+
+    def transform(self, df):
+        from ..columnar import ColumnBatch, ColumnVector
+        from ..sql import logical as L
+        from ..sql.dataframe import DataFrame
+        mv = self.getOrDefault("missingValue")
+        sur = self.getOrDefault("surrogates")
+        batch, n = _exec_host(df)          # ONE execution for all columns
+        names = list(batch.names)
+        vectors = list(batch.vectors)
+        for c, o in zip(self.getOrDefault("inputCols"),
+                        self.getOrDefault("outputCols")):
+            vec = batch.column(c)
+            x = np.asarray(vec.data)[:n].astype(np.float64)
+            bad = np.isnan(x) if np.isnan(mv) else (x == mv)
+            if vec.valid is not None:
+                bad |= ~np.asarray(vec.valid)[:n]
+            full = np.zeros(batch.capacity, np.float64)
+            full[:n] = np.where(bad, sur[c], x)
+            names.append(o)
+            vectors.append(ColumnVector(full, T.float64, None, None))
+        out = ColumnBatch(names, vectors, batch.row_valid, batch.capacity)
+        return DataFrame(df.session, L.LocalRelation(out))
+
+
+class PolynomialExpansion(Transformer):
+    """Polynomial feature expansion (`ml/feature/PolynomialExpansion.scala:42`):
+    all monomials of total degree 1..degree, sklearn term order
+    (include_bias=False)."""
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    degree = Param("degree", "", 2)
+
+    def transform(self, df):
+        import itertools as it
+        X, batch, n = extract_matrix(df, self.getOrDefault("inputCol"))
+        Xn = np.asarray(X, np.float64)
+        d = Xn.shape[1]
+        cols = []
+        for deg in range(1, self.getOrDefault("degree") + 1):
+            for combo in it.combinations_with_replacement(range(d), deg):
+                cols.append(np.prod(Xn[:, combo], axis=1))
+        return append_prediction(df, batch, n, np.stack(cols, axis=1),
+                                 self.getOrDefault("outputCol"),
+                                 T.ArrayType(T.float64))
+
+
+class ElementwiseProduct(Transformer):
+    """Hadamard product with a fixed scaling vector
+    (`ml/feature/ElementwiseProduct.scala:36`)."""
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    scalingVec = Param("scalingVec", "", None)
+
+    def transform(self, df):
+        X, batch, n = extract_matrix(df, self.getOrDefault("inputCol"))
+        w = np.asarray(self.getOrDefault("scalingVec"), np.float64)
+        return append_prediction(df, batch, n, np.asarray(X) * w,
+                                 self.getOrDefault("outputCol"),
+                                 T.ArrayType(T.float64))
+
+
+class VectorSlicer(Transformer):
+    """Select vector sub-features by index
+    (`ml/feature/VectorSlicer.scala:41`)."""
+    inputCol = Param("inputCol", "", None)
+    outputCol = Param("outputCol", "", None)
+    indices = Param("indices", "", None)
+
+    def transform(self, df):
+        X, batch, n = extract_matrix(df, self.getOrDefault("inputCol"))
+        idx = list(self.getOrDefault("indices"))
+        return append_prediction(df, batch, n,
+                                 np.asarray(X, np.float64)[:, idx],
                                  self.getOrDefault("outputCol"),
                                  T.ArrayType(T.float64))

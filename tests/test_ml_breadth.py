@@ -423,3 +423,114 @@ def test_lda_recovers_topics(spark):
     sk = SkLDA(2, random_state=0).fit(C)
     sk_low = sk.components_[:, :10].sum(1) / sk.components_.sum(1)
     assert sk_low.max() > 0.9 and sk_low.min() < 0.1
+
+
+# ---------------------------------------------------------------------------
+# round-5 feature-stage parity wave: IDF, Normalizer, MaxAbsScaler,
+# StopWordsRemover, NGram, QuantileDiscretizer, Imputer,
+# PolynomialExpansion, ElementwiseProduct, VectorSlicer
+# ---------------------------------------------------------------------------
+
+def test_idf_vs_sklearn(spark):
+    from sklearn.feature_extraction.text import TfidfTransformer
+    from spark_tpu.ml.feature import IDF
+    C = np.array([[3.0, 0, 1], [2, 0, 0], [3, 0, 2], [4, 0, 3]])
+    df = spark.createDataFrame({"tf": C})
+    model = IDF(inputCol="tf", outputCol="tfidf").fit(df)
+    got = np.array([r["tfidf"] for r in model.transform(df).collect()])
+    sk = TfidfTransformer(norm=None, smooth_idf=True, sublinear_tf=False)
+    exp = sk.fit_transform(C).toarray() - C          # sklearn idf = log+1
+    np.testing.assert_allclose(got, exp, atol=1e-12)
+
+
+def test_normalizer_and_maxabs(spark):
+    from sklearn.preprocessing import MaxAbsScaler as SkMA, normalize
+    from spark_tpu.ml.feature import MaxAbsScaler, Normalizer
+    rng = np.random.default_rng(3)
+    X = rng.normal(0, 3, (40, 4))
+    df = spark.createDataFrame({"features": X})
+    got = np.array([r["norm"] for r in Normalizer(
+        inputCol="features", outputCol="norm").transform(df).collect()])
+    np.testing.assert_allclose(got, normalize(X, "l2"), atol=1e-12)
+    got1 = np.array([r["n1"] for r in Normalizer(
+        inputCol="features", outputCol="n1", p=1.0)
+        .transform(df).collect()])
+    np.testing.assert_allclose(got1, normalize(X, "l1"), atol=1e-12)
+    m = MaxAbsScaler(inputCol="features", outputCol="s").fit(df)
+    got2 = np.array([r["s"] for r in m.transform(df).collect()])
+    np.testing.assert_allclose(got2, SkMA().fit_transform(X), atol=1e-12)
+
+
+def test_stopwords_and_ngram(spark):
+    from spark_tpu.ml.feature import NGram, StopWordsRemover, Tokenizer
+    df = spark.createDataFrame({"text": ["the quick brown fox",
+                                         "I saw the saw"]})
+    toks = Tokenizer(inputCol="text", outputCol="t").transform(df)
+    out = StopWordsRemover(inputCol="t", outputCol="f").transform(toks)
+    rows = [r["f"].split("\x00") for r in out.collect()]
+    assert rows[0] == ["quick", "brown", "fox"]
+    assert rows[1] == ["saw", "saw"]
+    custom = StopWordsRemover(inputCol="t", outputCol="f2",
+                              stopWords=["fox"]).transform(toks)
+    assert [r["f2"].split("\x00") for r in custom.collect()][0] == \
+        ["the", "quick", "brown"]
+    grams = NGram(inputCol="t", outputCol="g", n=2).transform(toks)
+    assert [r["g"] for r in grams.collect()][0] == \
+        "the quick\x00quick brown\x00brown fox"
+
+
+def test_quantile_discretizer(spark):
+    from spark_tpu.ml.feature import QuantileDiscretizer
+    x = np.arange(100, dtype=np.float64)
+    df = spark.createDataFrame({"v": x})
+    buck = QuantileDiscretizer(inputCol="v", outputCol="b",
+                               numBuckets=4).fit(df)
+    got = np.array([r["b"] for r in buck.transform(df).collect()])
+    # near-equal mass per bucket
+    counts = np.bincount(got.astype(int))
+    assert len(counts) == 4 and counts.min() >= 20
+
+
+def test_imputer_mean_median(spark):
+    from spark_tpu.ml.feature import Imputer
+    df = spark.createDataFrame({
+        "a": np.array([1.0, np.nan, 3.0, np.nan]),
+        "b": np.array([10.0, 20.0, np.nan, 40.0])})
+    m = Imputer(inputCols=["a", "b"], outputCols=["ai", "bi"]).fit(df)
+    rows = m.transform(df).collect()
+    ai = [r["ai"] for r in rows]
+    bi = [r["bi"] for r in rows]
+    assert ai == [1.0, 2.0, 3.0, 2.0]
+    assert bi == [10.0, 20.0, pytest.approx(70.0 / 3), 40.0]
+    med = Imputer(inputCols=["a"], outputCols=["am"],
+                  strategy="median").fit(df)
+    assert [r["am"] for r in med.transform(df).collect()][1] == 2.0
+
+
+def test_polynomial_expansion_vs_sklearn(spark):
+    from sklearn.preprocessing import PolynomialFeatures
+    from spark_tpu.ml.feature import PolynomialExpansion
+    rng = np.random.default_rng(5)
+    X = rng.normal(0, 1, (20, 3))
+    df = spark.createDataFrame({"features": X})
+    got = np.array([r["p"] for r in PolynomialExpansion(
+        inputCol="features", outputCol="p", degree=3)
+        .transform(df).collect()])
+    exp = PolynomialFeatures(3, include_bias=False).fit_transform(X)
+    # same monomial set — compare as sorted columns per row
+    np.testing.assert_allclose(np.sort(got, axis=1), np.sort(exp, axis=1),
+                               atol=1e-12)
+
+
+def test_elementwise_product_and_slicer(spark):
+    from spark_tpu.ml.feature import ElementwiseProduct, VectorSlicer
+    X = np.arange(12, dtype=np.float64).reshape(4, 3)
+    df = spark.createDataFrame({"features": X})
+    got = np.array([r["e"] for r in ElementwiseProduct(
+        inputCol="features", outputCol="e",
+        scalingVec=[2.0, 0.0, -1.0]).transform(df).collect()])
+    np.testing.assert_allclose(got, X * np.array([2.0, 0.0, -1.0]))
+    got2 = np.array([r["s"] for r in VectorSlicer(
+        inputCol="features", outputCol="s",
+        indices=[2, 0]).transform(df).collect()])
+    np.testing.assert_allclose(got2, X[:, [2, 0]])
