@@ -23,7 +23,8 @@ __all__ = [
     "IDF", "IDFModel", "Normalizer", "MaxAbsScaler", "MaxAbsScalerModel",
     "StopWordsRemover", "NGram", "QuantileDiscretizer", "Imputer",
     "ImputerModel", "PolynomialExpansion", "ElementwiseProduct",
-    "VectorSlicer",
+    "VectorSlicer", "ChiSqSelector", "ChiSqSelectorModel",
+    "RFormula", "RFormulaModel",
 ]
 
 
@@ -884,3 +885,186 @@ class VectorSlicer(Transformer):
                                  np.asarray(X, np.float64)[:, idx],
                                  self.getOrDefault("outputCol"),
                                  T.ArrayType(T.float64))
+
+
+class ChiSqSelector(Estimator):
+    """Top-k feature selection by chi-square statistic against the label
+    (`ml/feature/ChiSqSelector.scala:56`, numTopFeatures mode)."""
+    featuresCol = Param("featuresCol", "", "features")
+    labelCol = Param("labelCol", "", "label")
+    outputCol = Param("outputCol", "", None)
+    numTopFeatures = Param("numTopFeatures", "", 50)
+
+    def _fit(self, df):
+        from .stat import ChiSquareTest
+        row, = ChiSquareTest.test(
+            df, self.getOrDefault("featuresCol"),
+            self.getOrDefault("labelCol")).collect()
+        stats = np.asarray(row["statistics"], np.float64)
+        k = min(self.getOrDefault("numTopFeatures"), len(stats))
+        selected = sorted(np.argsort(-stats)[:k].tolist())
+        return ChiSqSelectorModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            outputCol=self.getOrDefault("outputCol"),
+            selectedFeatures=selected)
+
+
+class ChiSqSelectorModel(Model):
+    featuresCol = Param("featuresCol", "", "features")
+    outputCol = Param("outputCol", "", None)
+    selectedFeatures = Param("selectedFeatures", "sorted kept indices",
+                             None)
+
+    def transform(self, df):
+        X, batch, n = extract_matrix(df, self.getOrDefault("featuresCol"))
+        idx = list(self.getOrDefault("selectedFeatures"))
+        return append_prediction(df, batch, n,
+                                 np.asarray(X, np.float64)[:, idx],
+                                 self.getOrDefault("outputCol"),
+                                 T.ArrayType(T.float64))
+
+
+class RFormula(Estimator):
+    """R model formulas (`ml/feature/RFormula.scala:88` / RFormulaParser):
+    ``label ~ term + term``, ``.`` (all other columns), ``-`` removal
+    (incl. ``- 1`` no-intercept, accepted and recorded), ``a:b`` numeric
+    interactions.  String terms one-hot encode through
+    StringIndexer+OneHotEncoder (reference-order dummy coding); the label
+    string-indexes when non-numeric."""
+    formula = Param("formula", "", None)
+    featuresCol = Param("featuresCol", "", "features")
+    labelCol = Param("labelCol", "", "label")
+
+    def _parse(self, schema_names):
+        f = self.getOrDefault("formula")
+        if not f or "~" not in f:
+            raise AnalysisException(f"RFormula needs 'label ~ terms', "
+                                    f"got {f!r}")
+        lhs, rhs = [side.strip() for side in f.split("~", 1)]
+        terms: List = []
+        removed: set = set()
+        intercept = True
+        for raw in rhs.split("+"):
+            for piece in raw.split("-")[0:1]:
+                piece = piece.strip()
+                if piece == ".":
+                    terms.extend(c for c in schema_names
+                                 if c != lhs and c not in terms)
+                elif piece:
+                    terms.append(piece)
+            for neg in raw.split("-")[1:]:
+                neg = neg.strip()
+                if neg == "1":
+                    intercept = False
+                elif neg:
+                    removed.add(neg)
+        deduped: List[str] = []
+        for t in terms:                     # explicit repeats collapse too
+            if t not in removed and t not in deduped:
+                deduped.append(t)
+        return lhs, deduped, intercept
+
+    def _fit(self, df):
+        names = df.schema.names
+        label, terms, intercept = self._parse(names)
+        batch, n = _exec_host(df)
+        stages: List = []
+        for t in terms:
+            if ":" in t:
+                a, b = [p.strip() for p in t.split(":", 1)]
+                for side in (a, b):
+                    if batch.column(side).dtype.is_string:
+                        raise AnalysisException(
+                            f"RFormula interaction {t!r}: categorical "
+                            "interactions are not supported (string "
+                            "dictionary codes are not numeric values)")
+                stages.append(("interact", (a, b)))
+                continue
+            vec = batch.column(t)
+            if vec.dtype.is_string:
+                stages.append(("onehot", t))
+            else:
+                stages.append(("num", t))
+        label_is_string = label in names and \
+            batch.column(label).dtype.is_string
+        model = RFormulaModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            labelCol=self.getOrDefault("labelCol"),
+            label=label, stages=stages, hasIntercept=intercept,
+            labelIsString=label_is_string)
+        # fit sub-models (string indexers) on this data
+        model._fit_encoders(df)
+        return model
+
+
+class RFormulaModel(Model):
+    label = Param("label", "", None)
+    stages = Param("stages", "[(kind, spec)]", None)
+    hasIntercept = Param("hasIntercept", "", True)
+    labelIsString = Param("labelIsString", "", False)
+    encoders = Param("encoders", "col → fitted StringIndexerModel", None)
+    labelIndexer = Param("labelIndexer", "", None)
+
+    def _fit_encoders(self, df):
+        enc = {}
+        for kind, spec in self.getOrDefault("stages"):
+            if kind == "onehot":
+                enc[spec] = StringIndexer(
+                    inputCol=spec, outputCol=f"{spec}_si").fit(df)
+        self.set("encoders", enc)
+        if self.getOrDefault("labelIsString"):
+            self.set("labelIndexer", StringIndexer(
+                inputCol=self.getOrDefault("label"),
+                outputCol="__rf_label__").fit(df))
+
+    def transform(self, df):
+        batch, n = _exec_host(df)          # ONE execution covers all terms
+        parts = []
+        enc = self.getOrDefault("encoders") or {}
+        for kind, spec in self.getOrDefault("stages"):
+            if kind == "num":
+                parts.append(np.asarray(batch.column(spec).data)[:n]
+                             .astype(np.float64)[:, None])
+            elif kind == "interact":
+                a, b = spec
+                parts.append(
+                    (np.asarray(batch.column(a).data)[:n].astype(np.float64)
+                     * np.asarray(batch.column(b).data)[:n]
+                     .astype(np.float64))[:, None])
+            else:                          # onehot from the fitted labels
+                labels = enc[spec].getOrDefault("labels")
+                lookup = {v: i for i, v in enumerate(labels)}
+                vals = batch.column(spec).to_pylist(
+                    np.asarray(batch.row_valid_or_true()))[:n]
+                k = len(labels)
+                # dummy coding: drop the last category (reference
+                # OneHotEncoder default dropLast=true); unseen → zeros
+                oh = np.zeros((n, max(k - 1, 0)))
+                for i, v in enumerate(vals):
+                    j = lookup.get(v, k)
+                    if j < k - 1:
+                        oh[i, j] = 1.0
+                parts.append(oh)
+        mat = np.concatenate(parts, axis=1) if parts else np.zeros((n, 0))
+        out = append_prediction(df, batch, n, mat,
+                                self.getOrDefault("featuresCol"),
+                                T.ArrayType(T.float64))
+        # the label column is OPTIONAL at scoring time (the reference
+        # appends it only when present — unlabeled data must transform)
+        label = self.getOrDefault("label")
+        if label not in batch.names:
+            return out
+        li = self.getOrDefault("labelIndexer")
+        if li is not None:
+            labels = li.getOrDefault("labels")
+            lookup = {v: float(i) for i, v in enumerate(labels)}
+            vals = batch.column(label).to_pylist(
+                np.asarray(batch.row_valid_or_true()))[:n]
+            lab = np.array([lookup.get(v, float(len(labels)))
+                            for v in vals], np.float64)
+        else:
+            lab = np.asarray(batch.column(label).data)[:n] \
+                .astype(np.float64)
+        b3 = out._execute().to_host()
+        return append_prediction(out, b3, n, lab,
+                                 self.getOrDefault("labelCol"), T.float64)

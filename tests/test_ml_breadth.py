@@ -534,3 +534,75 @@ def test_elementwise_product_and_slicer(spark):
         inputCol="features", outputCol="s",
         indices=[2, 0]).transform(df).collect()])
     np.testing.assert_allclose(got2, X[:, [2, 0]])
+
+
+def test_chisq_selector_keeps_dependent_features(spark):
+    from spark_tpu.ml.feature import ChiSqSelector
+    rng = np.random.default_rng(11)
+    n = 400
+    y = rng.integers(0, 2, n).astype(np.float64)
+    dep = np.where(rng.uniform(size=n) < 0.2 + 0.6 * y, 1.0, 0.0)
+    noise1 = rng.integers(0, 2, n).astype(np.float64)
+    noise2 = rng.integers(0, 3, n).astype(np.float64)
+    X = np.stack([noise1, dep, noise2], axis=1)
+    df = spark.createDataFrame({"features": X, "label": y})
+    model = ChiSqSelector(numTopFeatures=1, outputCol="sel").fit(df)
+    assert model.getOrDefault("selectedFeatures") == [1]
+    got = np.array([r["sel"] for r in model.transform(df).collect()])
+    np.testing.assert_allclose(got[:, 0], dep)
+
+
+def test_rformula_numeric_string_interaction(spark):
+    from spark_tpu.ml.feature import RFormula
+    df = spark.createDataFrame({
+        "y": np.array([1.0, 2.0, 3.0, 4.0]),
+        "a": np.array([10.0, 20.0, 30.0, 40.0]),
+        "b": np.array([2.0, 3.0, 4.0, 5.0]),
+        "g": ["x", "y", "x", "z"],
+    })
+    model = RFormula(formula="y ~ a + g + a:b").fit(df)
+    rows = model.transform(df).collect()
+    feats = np.array([r["features"] for r in rows])
+    labels = [r["label"] for r in rows]
+    assert labels == [1.0, 2.0, 3.0, 4.0]
+    # columns: a, g one-hot (k-1 dummy, frequency-then-alpha order), a*b
+    np.testing.assert_allclose(feats[:, 0], [10, 20, 30, 40])
+    np.testing.assert_allclose(feats[:, -1], [20, 60, 120, 200])
+    # g: labels ordered x(2), then y/z(1 each alphabetical) → dummies
+    # for (x, y); z encodes as all-zeros
+    np.testing.assert_allclose(feats[:, 1], [1, 0, 1, 0])
+    np.testing.assert_allclose(feats[:, 2], [0, 1, 0, 0])
+
+
+def test_rformula_dot_minus_and_string_label(spark):
+    from spark_tpu.ml.feature import RFormula
+    df = spark.createDataFrame({
+        "cls": ["p", "q", "p", "p"],
+        "u": np.array([1.0, 2.0, 3.0, 4.0]),
+        "v": np.array([5.0, 6.0, 7.0, 8.0]),
+        "w": np.array([9.0, 9.0, 9.0, 9.0]),
+    })
+    model = RFormula(formula="cls ~ . - w").fit(df)
+    rows = model.transform(df).collect()
+    feats = np.array([r["features"] for r in rows])
+    assert feats.shape == (4, 2)            # u, v — w removed
+    labels = [r["label"] for r in rows]
+    assert labels == [0.0, 1.0, 0.0, 0.0]   # p most frequent → 0
+
+
+def test_rformula_transform_without_label_and_rejections(spark):
+    from spark_tpu.ml.feature import RFormula
+    from spark_tpu.expressions import AnalysisException
+    train = spark.createDataFrame({
+        "y": np.array([1.0, 2.0]), "a": np.array([3.0, 4.0]),
+        "g": ["u", "v"]})
+    model = RFormula(formula="y ~ a + g").fit(train)
+    test = spark.createDataFrame({"a": np.array([5.0]), "g": ["u"]})
+    rows = model.transform(test).collect()      # unlabeled scoring works
+    assert "label" not in model.transform(test).columns
+    assert len(rows[0]["features"]) == 2
+    with pytest.raises(AnalysisException, match="interaction"):
+        RFormula(formula="y ~ g:a").fit(train)
+    # duplicated terms collapse
+    m2 = RFormula(formula="y ~ a + a").fit(train)
+    assert len(m2.transform(train).collect()[0]["features"]) == 1
