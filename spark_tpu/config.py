@@ -309,6 +309,45 @@ AGG_FOLD_ROWS = conf("spark.tpu.multibatch.aggFoldRows").doc(
     "buffer-merge fold during a multi-batch aggregation."
 ).int(1 << 18)
 
+SHUFFLE_IO_MAX_RETRIES = conf("spark.tpu.shuffle.io.maxRetries").doc(
+    "Re-read attempts for a missing/partial DCN host-shuffle block before "
+    "it is declared lost (spark.shuffle.io.maxRetries analog).  Shared "
+    "filesystems lose block visibility transiently (list-after-write "
+    "consistency, NFS attribute caches); a bounded retry rides those out "
+    "without hanging a dead peer's query."
+).check(lambda v: v >= 0).int(3)
+
+SHUFFLE_IO_RETRY_WAIT_MS = conf("spark.tpu.shuffle.io.retryWaitMs").doc(
+    "Base wait between block re-read attempts; grows exponentially per "
+    "attempt with deterministic per-block jitter so a pod's readers do "
+    "not stampede the filesystem in lockstep (spark.shuffle.io.retryWait "
+    "analog)."
+).check(lambda v: v >= 0).int(100)
+
+SHUFFLE_IO_ATTEMPT_TIMEOUT_MS = conf(
+    "spark.tpu.shuffle.io.attemptTimeoutMs").doc(
+    "Cap on a SINGLE block retry cycle (backoff + re-read); the "
+    "exponential backoff never sleeps longer than this, so late attempts "
+    "still poll often enough to see a block heal before the total "
+    "deadline."
+).check(lambda v: v > 0).int(2000)
+
+SHUFFLE_FETCH_RETRY_ENABLED = conf(
+    "spark.tpu.shuffle.fetchRetryEnabled").doc(
+    "Allow the keyed-aggregate fast path to re-request a lost peer's "
+    "partials once after a re-barrier (the peer may have committed "
+    "before dying — filesystem blocks survive process death).  Off = "
+    "every lost block fails the query immediately with "
+    "ExchangeFetchFailed."
+).boolean(True)
+
+SHUFFLE_BLACKLIST_ENABLED = conf("spark.tpu.shuffle.blacklistEnabled").doc(
+    "Exclude heartbeat-confirmed-dead peers from exchange barriers and "
+    "remember them for the rest of the query (scheduler/HealthTracker "
+    "executor-blacklist analog): later steps fail fast with the lost "
+    "hosts named instead of re-paying the barrier timeout."
+).boolean(True)
+
 DEBUG_NANS = conf("spark.tpu.debug.nanChecks").doc(
     "Enable jax_debug_nans for the session's process: XLA computations "
     "fail loudly on NaN/Inf production instead of propagating them — the "

@@ -174,4 +174,10 @@ def default_sources(session) -> List[Source]:
     srcs.append(Source("queries", {
         "executed": lambda: getattr(session, "_query_count", 0),
     }))
+    svc = getattr(session, "_crossproc_svc", None)
+    if svc is not None and hasattr(svc, "metrics_source"):
+        # DCN exchange retry/blacklist counters (RetryingBlockReader +
+        # peer blacklist; the shuffle-metrics Source of the reference's
+        # ExternalShuffleServiceSource)
+        srcs.append(svc.metrics_source())
     return srcs

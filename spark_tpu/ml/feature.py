@@ -901,8 +901,13 @@ class ChiSqSelector(Estimator):
             df, self.getOrDefault("featuresCol"),
             self.getOrDefault("labelCol")).collect()
         stats = np.asarray(row["statistics"], np.float64)
+        pvals = np.asarray(row["pValues"], np.float64)
         k = min(self.getOrDefault("numTopFeatures"), len(stats))
-        selected = sorted(np.argsort(-stats)[:k].tolist())
+        # rank by ascending p-value (the reference's numTopFeatures mode
+        # sorts the ChiSqTestResult by pValue); break p-value ties on the
+        # larger statistic so saturated-small p's still order sensibly
+        order = np.lexsort((-stats, pvals))
+        selected = sorted(order[:k].tolist())
         return ChiSqSelectorModel(
             featuresCol=self.getOrDefault("featuresCol"),
             outputCol=self.getOrDefault("outputCol"),

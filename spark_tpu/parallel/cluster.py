@@ -124,6 +124,16 @@ def hybrid_mesh(ici_axis: str = "data", dcn_axis: str = "dcn",
 # heartbeats / failure detection
 # ---------------------------------------------------------------------------
 
+def default_host_name(process_id: Optional[int] = None) -> str:
+    """Canonical host id for a process index — the ONE naming convention
+    shared by heartbeat beats and the shuffle-exchange blacklist, so
+    ``HeartbeatMonitor.dead_hosts()`` entries resolve to exchange peers
+    without a registry."""
+    if process_id is None:
+        process_id = jax.process_index()
+    return f"host-{process_id}"
+
+
 class HeartbeatMonitor:
     """File-based liveness beats over a shared directory.
 
@@ -138,7 +148,7 @@ class HeartbeatMonitor:
         conf = conf or C.Conf()
         self.beat_dir = beat_dir
         self.host_id = host_id if host_id is not None else \
-            f"host-{jax.process_index()}"
+            default_host_name()
         self.interval_s = conf.get(HEARTBEAT_INTERVAL) / 1000.0
         self.timeout_s = conf.get(HEARTBEAT_TIMEOUT) / 1000.0
         self._clock = clock

@@ -58,9 +58,10 @@ from ..columnar import ColumnBatch, ColumnVector
 from ..expressions import Col, EvalContext, Hash64
 from ..kernels import compact, union_all
 from ..sql import physical as P
-from .hostshuffle import HostShuffleService
+from .hostshuffle import ExchangeFetchFailed, HostShuffleService
 
-__all__ = ["host_exchange_group_agg", "crossproc_execute"]
+__all__ = ["host_exchange_group_agg", "crossproc_execute",
+           "ExchangeFetchFailed"]
 
 
 def _mask_rows(batch: ColumnBatch, keep: np.ndarray) -> ColumnBatch:
@@ -138,10 +139,20 @@ def _route_exchange_merge(session, plan, partial_node, partial: ColumnBatch,
     live = np.asarray(partial.row_valid_or_true())
     receiver = (np.asarray(h).astype(np.uint64)
                 % np.uint64(svc.n)).astype(np.int64)
-    received = svc.exchange(xid, {
-        r: [_mask_rows(partial, live & (receiver == r))]
-        for r in range(svc.n)
-    })
+    routed = {r: [_mask_rows(partial, live & (receiver == r))]
+              for r in range(svc.n)}
+    try:
+        received = svc.exchange(xid, routed)
+    except ExchangeFetchFailed:
+        if not svc.refetch_enabled:
+            raise
+        # keyed-aggregate fast path: re-request the lost peer's partials
+        # ONCE after a re-barrier — a peer that committed before dying
+        # left its state on the shared filesystem, and a straggler the
+        # heartbeat wrongly condemned gets one more window to arrive.
+        # A second loss is final: the structured failure (which hosts,
+        # which blocks) propagates within the 2x-deadline bound.
+        received = svc.refetch(xid, routed)
     received = [b for b in received
                 if int(np.asarray(b.num_rows()))] or \
         [_mask_rows(partial, np.zeros(partial.capacity, bool))]

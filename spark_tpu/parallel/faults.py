@@ -1,0 +1,207 @@
+"""Deterministic fault injection for the DCN host-shuffle data plane.
+
+The chaos layer the reference exercises with `FaultToleranceTest.scala`
+and Netty-level packet games, filesystem-shaped: because the exchange
+protocol is plain files (``hostshuffle.py``), every distributed failure
+mode reduces to a file-level perturbation that CI can inject exactly —
+no real hardware, no timing races beyond the ones under test.
+
+``FaultInjector.attach(svc)`` wraps a ``HostShuffleService``'s write
+side; rules fire when a matching block is published:
+
+- ``drop``      the published block vanishes (lost write / lost fs node);
+                with ``heal_after_s`` it REAPPEARS later, modeling
+                list-after-write eventual consistency — the case the
+                retrying reader exists for.
+- ``truncate``  the block is cut short (torn write / partial flush);
+                optionally heals to the full bytes later.
+- ``delay``     the block stays invisible for a window, then appears.
+- ``skip_commit``  the sender publishes blocks but never writes its
+                commit marker (killed between put and commit).
+- ``die_after_put``  the PROCESS exits hard right after publishing
+                (peer killed mid-exchange); used via the env plan by
+                subprocess workers.
+
+Rules are matched by (exchange, receiver) for this service's own writes;
+healing is driven by daemon timers (wall-clock, generous vs CI retry
+windows) so the recovery paths run deterministically.
+
+``FaultPlan`` carries the same rules across a process boundary through
+``SPARK_TPU_FAULT_PLAN`` (a JSON list), so multi-process chaos tests can
+arm a worker without plumbing new argv through every harness.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["FaultInjector", "FaultPlan", "FAULT_PLAN_ENV"]
+
+FAULT_PLAN_ENV = "SPARK_TPU_FAULT_PLAN"
+
+_KINDS = ("drop", "truncate", "delay", "skip_commit", "die_after_put")
+
+
+class _Rule:
+    def __init__(self, kind: str, exchange: Optional[str] = None,
+                 receiver: Optional[int] = None, once: bool = True,
+                 heal_after_s: Optional[float] = None,
+                 keep_bytes: int = 16):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; one of {_KINDS}")
+        self.kind = kind
+        self.exchange = exchange          # None = any exchange
+        self.receiver = receiver          # None = any receiver
+        self.once = once
+        self.heal_after_s = heal_after_s
+        self.keep_bytes = keep_bytes
+        self.fired = 0
+
+    def matches(self, exchange: str, receiver: Optional[int]) -> bool:
+        if self.once and self.fired:
+            return False
+        if self.exchange is not None and self.exchange != exchange:
+            return False
+        if self.receiver is not None and receiver is not None \
+                and self.receiver != receiver:
+            return False
+        return True
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "exchange": self.exchange,
+                "receiver": self.receiver, "once": self.once,
+                "heal_after_s": self.heal_after_s,
+                "keep_bytes": self.keep_bytes}
+
+
+class FaultPlan:
+    """A serializable bag of fault rules (env-portable for subprocesses)."""
+
+    def __init__(self, rules: Optional[Sequence[_Rule]] = None):
+        self.rules: List[_Rule] = list(rules or [])
+
+    # -- construction ----------------------------------------------------
+    def drop(self, exchange: Optional[str] = None,
+             receiver: Optional[int] = None, once: bool = True,
+             heal_after_s: Optional[float] = None) -> "FaultPlan":
+        self.rules.append(_Rule("drop", exchange, receiver, once,
+                                heal_after_s))
+        return self
+
+    def truncate(self, exchange: Optional[str] = None,
+                 receiver: Optional[int] = None, once: bool = True,
+                 heal_after_s: Optional[float] = None,
+                 keep_bytes: int = 16) -> "FaultPlan":
+        self.rules.append(_Rule("truncate", exchange, receiver, once,
+                                heal_after_s, keep_bytes))
+        return self
+
+    def delay(self, seconds: float, exchange: Optional[str] = None,
+              receiver: Optional[int] = None,
+              once: bool = True) -> "FaultPlan":
+        self.rules.append(_Rule("delay", exchange, receiver, once,
+                                heal_after_s=seconds))
+        return self
+
+    def skip_commit(self, exchange: Optional[str] = None,
+                    once: bool = True) -> "FaultPlan":
+        self.rules.append(_Rule("skip_commit", exchange, None, once))
+        return self
+
+    def die_after_put(self, exchange: Optional[str] = None,
+                      commit_first: bool = False) -> "FaultPlan":
+        r = _Rule("die_after_put", exchange, None, once=True)
+        r.keep_bytes = 1 if commit_first else 0   # reuse slot as the flag
+        self.rules.append(r)
+        return self
+
+    # -- env transport ---------------------------------------------------
+    def to_env(self) -> str:
+        return json.dumps([r.to_dict() for r in self.rules])
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "FaultPlan":
+        raw = (env or os.environ).get(FAULT_PLAN_ENV, "")
+        if not raw:
+            return cls()
+        rules = [_Rule(**d) for d in json.loads(raw)]
+        return cls(rules)
+
+
+class FaultInjector:
+    """Arms a ``HostShuffleService`` with a ``FaultPlan``.
+
+    Wraps ``svc.put``/``svc.commit``; after each real write, matching
+    rules perturb the just-published file.  Healing rules capture the
+    original bytes and restore them on a daemon timer, so 'the
+    filesystem got it back' is reproducible."""
+
+    def __init__(self, plan: Optional[FaultPlan] = None):
+        self.plan = plan if plan is not None else FaultPlan.from_env()
+        self.injected: List[str] = []        # audit log of fired faults
+        self._timers: List[threading.Timer] = []
+
+    # -- file perturbations ---------------------------------------------
+    def _heal_later(self, path: str, payload: bytes, delay: float) -> None:
+        def heal():
+            tmp = f"{path}.heal.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+        t = threading.Timer(delay, heal)
+        t.daemon = True
+        t.start()
+        self._timers.append(t)
+
+    def _apply(self, rule: _Rule, path: str, label: str) -> None:
+        rule.fired += 1
+        with open(path, "rb") as f:
+            payload = f.read()
+        if rule.kind in ("drop", "delay"):
+            os.remove(path)
+        elif rule.kind == "truncate":
+            with open(path, "wb") as f:
+                f.write(payload[: rule.keep_bytes])
+        if rule.heal_after_s is not None:
+            self._heal_later(path, payload, rule.heal_after_s)
+        self.injected.append(f"{rule.kind}:{label}")
+
+    # -- service wrapping ------------------------------------------------
+    def attach(self, svc) -> "FaultInjector":
+        orig_put, orig_commit = svc.put, svc.commit
+        injector = self
+
+        def put(exchange, receiver, batches):
+            orig_put(exchange, receiver, batches)
+            path = svc._part(exchange, svc.pid, receiver)
+            for rule in injector.plan.rules:
+                if rule.kind in ("drop", "truncate", "delay") \
+                        and rule.matches(exchange, receiver):
+                    injector._apply(rule, path,
+                                    f"{exchange}/s{svc.pid}-r{receiver}")
+            for rule in injector.plan.rules:
+                if rule.kind == "die_after_put" \
+                        and rule.matches(exchange, None):
+                    rule.fired += 1
+                    injector.injected.append(f"die_after_put:{exchange}")
+                    if rule.keep_bytes:          # commit_first flag
+                        orig_commit(exchange)
+                    print(f"[faults] dying after put in {exchange!r}",
+                          flush=True)
+                    os._exit(43)
+
+        def commit(exchange):
+            for rule in injector.plan.rules:
+                if rule.kind == "skip_commit" \
+                        and rule.matches(exchange, None):
+                    rule.fired += 1
+                    injector.injected.append(f"skip_commit:{exchange}")
+                    return                        # marker never written
+            orig_commit(exchange)
+
+        svc.put = put
+        svc.commit = commit
+        return self

@@ -33,6 +33,7 @@ via --token or SPARK_TPU_SERVER_TOKEN):
 
 from __future__ import annotations
 
+import hmac
 import json
 import os
 import threading
@@ -69,6 +70,11 @@ class _ServerSession:
         self.lock = threading.Lock()
         self.created = time.time()
         self.last_used = self.created
+        # id of the statement currently executing on this session, guarded
+        # by the server's _reg_lock: /cancel must only interrupt the
+        # session when ITS target is the one running, not whatever
+        # statement happens to hold the session lock by then
+        self.running_stmt: Optional[str] = None
 
 
 class _Statement:
@@ -146,19 +152,28 @@ class SQLServer:
                 # that raced in is honored by the re-check after — a
                 # /cancel acknowledged with 200 is never lost
                 ss.session.clear_cancel()
-                stmt.status = "running"
-                if stmt.cancel_requested:
-                    stmt.status = "cancelled"
-                    raise QueryCancelled("cancelled before execution")
-                ss.last_used = time.time()
-                t0 = time.time()
-                df = ss.session.sql(stmt.query)
-                columns = list(df.schema.names)
-                rows = [[_json_safe(v) for v in r] for r in df.collect()]
-                return {"columns": columns, "rows": rows,
-                        "rowCount": len(rows),
-                        "durationMs": round((time.time() - t0) * 1000, 1),
-                        "statementId": stmt.id}
+                with self._reg_lock:
+                    stmt.status = "running"
+                    ss.running_stmt = stmt.id
+                try:
+                    if stmt.cancel_requested:
+                        stmt.status = "cancelled"
+                        raise QueryCancelled("cancelled before execution")
+                    ss.last_used = time.time()
+                    t0 = time.time()
+                    df = ss.session.sql(stmt.query)
+                    columns = list(df.schema.names)
+                    rows = [[_json_safe(v) for v in r]
+                            for r in df.collect()]
+                    return {"columns": columns, "rows": rows,
+                            "rowCount": len(rows),
+                            "durationMs":
+                                round((time.time() - t0) * 1000, 1),
+                            "statementId": stmt.id}
+                finally:
+                    with self._reg_lock:
+                        if ss.running_stmt == stmt.id:
+                            ss.running_stmt = None
 
         from .sql.session import QueryCancelled
         future = self._pool.submit(work)
@@ -193,7 +208,16 @@ class SQLServer:
             raise KeyError(f"no such statement {stmt_id!r}")
         stmt.cancel_requested = True
         if stmt.status == "running":
-            self._resolve(stmt.session_id or None).session.cancelAllQueries()
+            ss = self._resolve(stmt.session_id or None)
+            with self._reg_lock:
+                # only interrupt the session if OUR statement is the one
+                # on it right now — between reading status and firing the
+                # cancel the target may have finished and a DIFFERENT
+                # statement started, and interrupting that innocent one
+                # would be the cancel-the-wrong-statement race
+                fire = ss.running_stmt == stmt_id
+            if fire:
+                ss.session.cancelAllQueries()
         return {"statementId": stmt_id, "status": stmt.status,
                 "cancelRequested": True}
 
@@ -230,7 +254,10 @@ class SQLServer:
                 if server.token is None:
                     return True
                 got = self.headers.get("Authorization", "")
-                if got == f"Bearer {server.token}":
+                want = f"Bearer {server.token}"
+                # constant-time compare: a == on secrets leaks a timing
+                # oracle over the token prefix to anyone who can POST
+                if hmac.compare_digest(got.encode(), want.encode()):
                     return True
                 self._reply(401, {"error": "missing or bad bearer token"})
                 return False

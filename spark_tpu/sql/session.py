@@ -781,14 +781,21 @@ class SparkSession:
 
     def enableHostShuffle(self, root: str, process_id: Optional[int] = None,
                           n_processes: Optional[int] = None,
-                          timeout_s: float = 120.0):
+                          timeout_s: float = 120.0, heartbeat=None):
         """Register the DCN host-shuffle data plane on this session: from
         now on every query PLANS its cross-process exchange through a
         ``HostShuffleService`` at ``root`` (the planner-citizen form of
         the reference's external shuffle service registration,
         `ExternalShuffleBlockResolver.java:57`).  Leaf DataFrames/scans
         are per-process partitions; byte-identical leaves are detected as
-        replicated.  Defaults identify the process via jax.distributed."""
+        replicated.  Defaults identify the process via jax.distributed.
+
+        ``heartbeat`` (a ``parallel.cluster.HeartbeatMonitor``) arms the
+        exchange's failure detector: confirmed-dead peers are excluded
+        from barriers and blacklisted for the rest of the query instead
+        of timing every step out.  Retry knobs come from this session's
+        conf (``spark.tpu.shuffle.io.*``); the service's retry/blacklist
+        counters register as the ``shuffle`` metrics source."""
         from ..parallel.hostshuffle import HostShuffleService
         if process_id is None or n_processes is None:
             import jax
@@ -798,7 +805,10 @@ class SparkSession:
                 else n_processes
         self._crossproc_svc = HostShuffleService(
             root, process_id=process_id, n_processes=n_processes,
-            timeout_s=timeout_s)
+            timeout_s=timeout_s, conf=self.conf_obj, heartbeat=heartbeat)
+        ms = self.metricsSystem
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]
+        ms.register_source(self._crossproc_svc.metrics_source())
         return self._crossproc_svc
 
     def disableHostShuffle(self) -> None:

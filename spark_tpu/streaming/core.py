@@ -872,6 +872,38 @@ class StreamExecution:
                 f"the watermark column {self._wm_col!r} must survive to "
                 f"the {j.how} outer join input (it drives unmatched-row "
                 "finalization)")
+        # the condition must BOUND future matches: some conjunct has to
+        # compare the preserved side's event time against the other side
+        # (time-range / interval-join constraint, equality included).
+        # Without one, a row null-extended on watermark eviction could
+        # still match a later arrival on the other side — the stream
+        # would emit both the null-extended row and the match, which the
+        # batch oracle never produces (the reference rejects this in
+        # UnsupportedOperationChecker's one-sided outer conditions).
+        from ..expressions import EQ, GE, GT, LE, LT
+        from ..sql.optimizer import split_conjuncts
+        other_plan = j.right if j.how == "left" else j.left
+        pres_cols = set(pres_plan.schema().names)
+        other_cols = set(other_plan.schema().names)
+        bound = False
+        for c in (split_conjuncts(j.on) if j.on is not None else []):
+            if not isinstance(c, (EQ, GE, GT, LE, LT)):
+                continue
+            l, r = c.children
+            for mine, theirs in ((l.references(), r.references()),
+                                 (r.references(), l.references())):
+                if mine and theirs and mine <= pres_cols \
+                        and self._wm_col in mine \
+                        and theirs <= other_cols:
+                    bound = True
+        if not bound:
+            raise AnalysisException(
+                f"stream-stream {j.how} outer join condition cannot "
+                "bound future matches: add a time-range constraint "
+                "between both sides' event times involving the watermark "
+                f"column {self._wm_col!r} (e.g. ts <= ts2), or an "
+                "event-time equality — without it, a null-extended row "
+                "could still match a future arrival")
 
     def _build_agg_state(self) -> Optional[AggregationState]:
         self._validate_outer_ssjoin()

@@ -1,0 +1,309 @@
+"""Chaos suite for the fault-tolerant DCN exchange (ISSUE: retrying
+host-shuffle fetches, peer blacklisting, bounded-time failure).
+
+Every recovery path of ``parallel/hostshuffle.py`` runs here under the
+deterministic fault injector (``parallel/faults.py``) — no hardware, no
+uncontrolled timing:
+
+- transiently missing / truncated blocks heal and the retrying reader
+  completes the exchange (retry counters prove retries happened);
+- permanent loss raises a structured ``ExchangeFetchFailed`` naming the
+  lost host and block, within the configured time bound;
+- a confirmed-dead peer is excluded from the barrier and blacklisted
+  for subsequent exchanges (fast failure, not repeated timeouts);
+- a peer killed mid-exchange (real subprocess, ``die_after_put``)
+  either completes (it committed first — blocks survive the process)
+  or fails structured within 2x the deadline, never hangs;
+- the keyed-aggregate refetch path re-reads a recovered peer's blocks
+  after a re-barrier;
+- counters surface through the session metrics system.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from spark_tpu import config as C
+from spark_tpu.columnar import ColumnBatch
+from spark_tpu.parallel.cluster import HeartbeatMonitor
+from spark_tpu.parallel.faults import FAULT_PLAN_ENV, FaultInjector, FaultPlan
+from spark_tpu.parallel.hostshuffle import (
+    BlockFetchError, ExchangeFetchFailed, HostShuffleService,
+    RetryingBlockReader,
+)
+
+
+def _batch(vals):
+    return ColumnBatch.from_arrays({"v": np.asarray(vals, np.int64)})
+
+
+def _values(batches):
+    return sorted(int(x) for b in batches
+                  for x, ok in zip(np.asarray(b.column("v").data),
+                                   np.asarray(b.row_valid_or_true()))
+                  if ok)
+
+
+def _pair(tmp_path, **kw):
+    """Two services on one shared root (pids 0/1), test-speed retries."""
+    defaults = dict(timeout_s=5.0, poll_s=0.02, max_retries=8,
+                    retry_wait_s=0.05, attempt_timeout_s=1.0)
+    defaults.update(kw)
+    return (HostShuffleService(str(tmp_path), 0, 2, **defaults),
+            HostShuffleService(str(tmp_path), 1, 2, **defaults))
+
+
+# ---------------------------------------------------------------------------
+# retrying reader: transient faults heal, permanent loss is structured
+# ---------------------------------------------------------------------------
+
+def test_delayed_block_retried_to_success(tmp_path):
+    svc0, svc1 = _pair(tmp_path)
+    FaultInjector(FaultPlan().delay(0.25, exchange="e")).attach(svc1)
+    svc1.put("e", 0, [_batch([7, 8])])   # delay rule hides the block...
+    svc1.commit("e")                     # ...but the manifest names it
+    got = svc0.exchange("e", {0: [_batch([1])], 1: [_batch([2])]})
+    assert _values(got) == [1, 7, 8]
+    assert svc0.counters["block_retries"] > 0
+    assert svc0.counters["blocks_lost"] == 0
+
+
+def test_truncated_block_retried_to_success(tmp_path):
+    svc0, svc1 = _pair(tmp_path)
+    FaultInjector(FaultPlan().truncate(exchange="e",
+                                       heal_after_s=0.25)).attach(svc1)
+    svc1.put("e", 0, [_batch([5, 6])])
+    svc1.commit("e")
+    got = svc0.exchange("e", {0: [], 1: []})
+    assert _values(got) == [5, 6]
+    assert svc0.counters["block_retries"] > 0
+
+
+def test_permanent_drop_fails_structured_and_bounded(tmp_path):
+    svc0, svc1 = _pair(tmp_path, timeout_s=3.0, max_retries=2)
+    FaultInjector(FaultPlan().drop(exchange="e")).attach(svc1)
+    svc1.put("e", 0, [_batch([9])])
+    svc1.commit("e")
+    t0 = time.monotonic()
+    with pytest.raises(ExchangeFetchFailed) as ei:
+        svc0.exchange("e", {0: [], 1: []})
+    assert time.monotonic() - t0 < 2 * 3.0       # bounded-time failure
+    assert ei.value.lost_hosts == ["host-1"]
+    assert ei.value.lost_blocks == ["s0001-r0000.part"]
+    assert "host-1" in str(ei.value)             # names the host loudly
+    assert svc0.counters["blocks_lost"] == 1
+    assert svc0.counters["fetch_failures"] == 1
+
+
+def test_reader_respects_deadline(tmp_path):
+    """With a tight deadline the reader gives up early instead of
+    sleeping through all its retries."""
+    reader = RetryingBlockReader(max_retries=50, retry_wait_s=0.2)
+    t0 = time.monotonic()
+    with pytest.raises(BlockFetchError):
+        reader.read(str(tmp_path / "never.part"),
+                    deadline=time.monotonic() + 0.3)
+    assert time.monotonic() - t0 < 1.5
+
+
+# ---------------------------------------------------------------------------
+# heartbeat-driven exclusion + blacklist persistence
+# ---------------------------------------------------------------------------
+
+def _stale_peer_heartbeat(tmp_path):
+    """A monitor for host-0 that sees host-1's only beat as stale."""
+    conf = (C.Conf()
+            .set("spark.tpu.cluster.heartbeatIntervalMs", "50")
+            .set("spark.tpu.cluster.heartbeatTimeoutMs", "100"))
+    beats = str(tmp_path / "beats")
+    hb1 = HeartbeatMonitor(beats, host_id="host-1", conf=conf,
+                           clock=time.time)
+    hb1.beat()
+    hb0 = HeartbeatMonitor(beats, host_id="host-0", conf=conf,
+                           clock=time.time)
+    time.sleep(0.15)                    # host-1's beat goes stale
+    return hb0
+
+
+def test_dead_peer_excluded_and_blacklist_persists(tmp_path):
+    hb0 = _stale_peer_heartbeat(tmp_path)
+    assert hb0.dead_hosts() == ["host-1"]
+    svc0 = HostShuffleService(str(tmp_path / "shuf"), 0, 2, timeout_s=5.0,
+                              poll_s=0.02, heartbeat=hb0, max_retries=1,
+                              retry_wait_s=0.02)
+    # peer 1 never commits anything: without the heartbeat this would be
+    # a full 5s barrier timeout; with it the dead peer is excluded fast
+    t0 = time.monotonic()
+    with pytest.raises(ExchangeFetchFailed) as ei:
+        svc0.exchange("e1", {0: [_batch([1])], 1: [_batch([2])]})
+    first = time.monotonic() - t0
+    assert first < 2.5
+    assert ei.value.lost_hosts == ["host-1"]
+    assert svc0.blacklist == {1: "heartbeat-dead during 'e1'"}
+    assert svc0.counters["peers_blacklisted"] == 1
+
+    # the blacklist PERSISTS across exchanges of the query: the second
+    # step fails immediately (no re-detection wait at all)
+    t0 = time.monotonic()
+    with pytest.raises(ExchangeFetchFailed):
+        svc0.exchange("e2", {0: [_batch([3])], 1: [_batch([4])]})
+    assert time.monotonic() - t0 < 1.0
+    assert svc0.counters["fetch_failures"] == 2
+
+
+def test_dead_but_committed_peer_is_recovered(tmp_path):
+    """The property the filesystem data plane exists for: a peer that
+    COMMITTED before dying loses nothing — its blocks outlive it."""
+    hb0 = _stale_peer_heartbeat(tmp_path)
+    root = str(tmp_path / "shuf")
+    svc1 = HostShuffleService(root, 1, 2, timeout_s=5.0)
+    svc1.put("e", 0, [_batch([41, 42])])
+    svc1.commit("e")                     # ...then host-1 "dies"
+    svc0 = HostShuffleService(root, 0, 2, timeout_s=5.0, poll_s=0.02,
+                              heartbeat=hb0)
+    got = svc0.exchange("e", {0: [_batch([1])], 1: [_batch([2])]})
+    assert _values(got) == [1, 41, 42]
+    assert svc0.counters["blocks_lost"] == 0
+
+
+def test_blacklist_can_be_disabled_by_conf(tmp_path):
+    hb0 = _stale_peer_heartbeat(tmp_path)
+    conf = C.Conf().set("spark.tpu.shuffle.blacklistEnabled", "false")
+    svc0 = HostShuffleService(str(tmp_path / "shuf"), 0, 2, timeout_s=0.3,
+                              poll_s=0.02, conf=conf, heartbeat=hb0)
+    svc0.commit("e")
+    # without blacklisting, a dead straggler is just a straggler: the
+    # barrier stays loud-timeout (the seed behavior, opt-out preserved)
+    with pytest.raises(TimeoutError, match=r"senders \[1\]"):
+        svc0.barrier("e")
+    assert svc0.blacklist == {}
+
+
+# ---------------------------------------------------------------------------
+# refetch: the keyed-aggregate fast path's one re-request
+# ---------------------------------------------------------------------------
+
+def test_refetch_recovers_republished_blocks(tmp_path):
+    svc0, svc1 = _pair(tmp_path, timeout_s=2.0, max_retries=1,
+                       retry_wait_s=0.02)
+    FaultInjector(FaultPlan().drop(exchange="e")).attach(svc1)
+    svc1.put("e", 0, [_batch([11, 12])])
+    svc1.commit("e")
+    t0 = time.monotonic()
+    per = {0: [_batch([1])], 1: [_batch([2])]}
+    with pytest.raises(ExchangeFetchFailed):
+        svc0.exchange("e", per)
+    # the peer (restarted / fs healed) re-publishes the same block; the
+    # single refetch re-barriers and recovers it under a fresh deadline
+    svc1.put("e", 0, [_batch([11, 12])])
+    got = svc0.refetch("e", per)
+    assert time.monotonic() - t0 < 2 * 2.0       # exchange + refetch ≤ 2x
+    assert _values(got) == [1, 11, 12]
+    assert svc0.counters["refetches"] == 1
+
+
+def test_refetch_disabled_by_conf(tmp_path):
+    conf = C.Conf().set("spark.tpu.shuffle.fetchRetryEnabled", "false")
+    svc = HostShuffleService(str(tmp_path), 0, 1, timeout_s=1.0, conf=conf)
+    with pytest.raises(ExchangeFetchFailed, match="refetch disabled"):
+        svc.refetch("e")
+
+
+# ---------------------------------------------------------------------------
+# injector plumbing
+# ---------------------------------------------------------------------------
+
+def test_skip_commit_keeps_barrier_loud(tmp_path):
+    svc0, svc1 = _pair(tmp_path, timeout_s=0.3)
+    FaultInjector(FaultPlan().skip_commit(exchange="e")).attach(svc1)
+    svc1.put("e", 0, [_batch([1])])
+    svc1.commit("e")                     # suppressed by the fault
+    svc0.commit("e")
+    with pytest.raises(TimeoutError, match=r"senders \[1\]"):
+        svc0.barrier("e")
+
+
+def test_fault_plan_env_roundtrip(tmp_path):
+    plan = (FaultPlan().drop(exchange="a", receiver=1)
+            .truncate(heal_after_s=0.5, keep_bytes=3)
+            .delay(0.2, exchange="b")
+            .die_after_put(exchange="c", commit_first=True))
+    env = {FAULT_PLAN_ENV: plan.to_env()}
+    back = FaultPlan.from_env(env)
+    assert [r.to_dict() for r in back.rules] \
+        == [r.to_dict() for r in plan.rules]
+    assert FaultPlan.from_env({}).rules == []
+
+
+# ---------------------------------------------------------------------------
+# observability: counters reach the session metrics system
+# ---------------------------------------------------------------------------
+
+def test_counters_visible_via_session_metrics(spark, tmp_path):
+    prev = getattr(spark, "_crossproc_svc", None)
+    ms = spark.metricsSystem
+    try:
+        svc = spark.enableHostShuffle(str(tmp_path), process_id=0,
+                                      n_processes=1, timeout_s=5.0)
+        svc.exchange("e", {0: [_batch([1])]})
+        svc.blacklist[7] = "test"
+        snap = ms.snapshots()["shuffle"]
+        assert snap["exchanges"] == 1
+        assert snap["block_retries"] == 0
+        assert snap["blacklisted_peers"] == 1
+        assert snap["blacklist"] == "host-7"
+    finally:
+        spark._crossproc_svc = prev
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]
+
+
+# ---------------------------------------------------------------------------
+# the real thing: a peer process killed mid-exchange
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("commit_first", [False, True])
+def test_peer_killed_mid_exchange(tmp_path, commit_first):
+    """Worker 1 dies (os._exit) right after publishing its block.  If it
+    committed first, worker 0 COMPLETES — the blocks survive the
+    process.  If not, worker 0 gets a structured ``ExchangeFetchFailed``
+    naming host-1 within 2x the deadline.  Either way: no hang."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "faults_worker.py")
+    root, beats = str(tmp_path / "shuf"), str(tmp_path / "beats")
+    victim_plan = FaultPlan().die_after_put("ex", commit_first=commit_first)
+
+    def spawn(pid, plan):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop(FAULT_PLAN_ENV, None)
+        if plan is not None:
+            env[FAULT_PLAN_ENV] = plan.to_env()
+        return subprocess.Popen(
+            [sys.executable, worker, str(pid), root, beats],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+
+    t0 = time.monotonic()
+    survivor, victim = spawn(0, None), spawn(1, victim_plan)
+    out0 = survivor.communicate(timeout=60)[0]
+    out1 = victim.communicate(timeout=60)[0]
+    elapsed = time.monotonic() - t0
+    assert victim.returncode == 43, out1            # died where planned
+    assert "dying after put in 'ex'" in out1
+    assert survivor.returncode == 0, out0
+    line = [ln for ln in out0.splitlines()
+            if ln.startswith(("OK", "FAILED"))][-1]
+    if commit_first:
+        # sender's blocks + marker landed before death → full recovery
+        evens = sorted(v for v in list(range(10)) + list(range(100, 110))
+                       if v % 2 == 0)
+        assert line == f"OK {evens}", out0
+    else:
+        assert line.startswith("FAILED"), out0
+        assert "host-1" in line
+        # within 2x the worker's configured deadline (8s), plus heartbeat
+        # detection + process startup slack — and far from a hang
+        assert elapsed < 2 * 8.0 + 10, elapsed

@@ -197,11 +197,16 @@ def test_left_outer_null_extends_on_eviction_across_restart(spark,
                                                             tmp_path):
     ckpt = str(tmp_path / "ssj_outer")
     a = MemoryStream(TS_A, spark)
-    b = MemoryStream(B_SCHEMA, spark)
+    b = MemoryStream(TS_B, spark)
 
     def mk(name):
+        # the ts2 >= ts conjunct is the time-range constraint outer
+        # stream-stream joins REQUIRE: it lets eviction prove no future
+        # match for a null-extended row
         df = (a.toDF(spark).withWatermark("ts", "2 seconds")
-              .join(b.toDF(spark), on=F.col("k") == F.col("k2"),
+              .join(b.toDF(spark),
+                    on=(F.col("k") == F.col("k2"))
+                    & (F.col("ts2") >= F.col("ts")),
                     how="left"))
         return (df.writeStream.format("memory").queryName(name)
                 .outputMode("append")
@@ -210,28 +215,28 @@ def test_left_outer_null_extends_on_eviction_across_restart(spark,
 
     q = mk("ssjo1")
     a.addData([(1 * SEC, 1), (2 * SEC, 2)])
-    b.addData([(1, 10)])
+    b.addData([(1 * SEC, 1, 10)])
     q.processAllAvailable()
     # matched pair emits immediately; unmatched k=2 is NOT final yet
-    assert _rows(spark, "ssjo1") == [(_ts(1), 1, 1, 10)]
+    assert _rows(spark, "ssjo1") == [(_ts(1), 1, _ts(1), 1, 10)]
     # watermark jumps to 18s: ts=2 evicts while unmatched → null-extend;
     # ts=1 evicts matched → no extra row
     a.addData([(20 * SEC, 3)])
     q.processAllAvailable()
     assert _rows(spark, "ssjo1") == [
-        (_ts(1), 1, 1, 10), (_ts(2), 2, None, None)]
+        (_ts(1), 1, _ts(1), 1, 10), (_ts(2), 2, None, None, None)]
     q.stop()
 
     # restart: buffers + matched-row state recover; the buffered ts=20
     # row still matches a late right row, then finalizes matched (no
     # null emission for it)
     q2 = mk("ssjo2")
-    b.addData([(3, 30)])
+    b.addData([(25 * SEC, 3, 30)])
     q2.processAllAvailable()
-    assert _rows(spark, "ssjo2") == [(_ts(20), 3, 3, 30)]
+    assert _rows(spark, "ssjo2") == [(_ts(20), 3, _ts(25), 3, 30)]
     a.addData([(40 * SEC, 4)])
     q2.processAllAvailable()      # wm → 38s: ts=20 evicts, was matched
-    assert _rows(spark, "ssjo2") == [(_ts(20), 3, 3, 30)]
+    assert _rows(spark, "ssjo2") == [(_ts(20), 3, _ts(25), 3, 30)]
     # batch oracle over everything emitted so far: the streamed output is
     # exactly the batch left-join rows whose left side has FINALIZED
     # (ts < watermark) or matched
@@ -239,27 +244,41 @@ def test_left_outer_null_extends_on_eviction_across_restart(spark,
 
 
 def test_right_outer_preserves_right_side(spark):
-    a = MemoryStream(A_SCHEMA, spark)
+    a = MemoryStream(TS_A, spark)
     b = MemoryStream(TS_B, spark)
     df = (a.toDF(spark)
           .join(b.toDF(spark).withWatermark("ts2", "1 seconds"),
-                on=F.col("k") == F.col("k2"), how="right"))
+                on=(F.col("k") == F.col("k2"))
+                & (F.col("ts") <= F.col("ts2")), how="right"))
     q = (df.writeStream.format("memory").queryName("ssjr")
          .outputMode("append").trigger(once=True).start())
-    a.addData([(1, "x")])
+    a.addData([(1 * SEC, 1)])
     b.addData([(5 * SEC, 1, 100), (6 * SEC, 2, 200)])
     q.processAllAvailable()
     def got():
         return {tuple(r) for r in
                 spark.sql("SELECT * FROM ssjr").collect()}
-    assert got() == {(1, "x", _ts(5), 1, 100)}
+    assert got() == {(_ts(1), 1, _ts(5), 1, 100)}
     # advance the right-side watermark past both rows: the unmatched
     # k2=2 row null-extends on the LEFT side
     b.addData([(30 * SEC, 9, 900)])
     q.processAllAvailable()
     assert (None, None, _ts(6), 2, 200) in got()
-    assert (1, "x", _ts(5), 1, 100) in got()
+    assert (_ts(1), 1, _ts(5), 1, 100) in got()
     q.stop()
+
+
+def test_outer_ssjoin_rejects_unbounded_condition(spark):
+    """Equality on keys alone cannot prove a null-extended row will not
+    match a future arrival — the planner must refuse loudly, not emit
+    rows the batch oracle never would."""
+    a = MemoryStream(TS_A, spark)
+    b = MemoryStream(B_SCHEMA, spark)
+    with pytest.raises(AnalysisException, match="bound future matches"):
+        (a.toDF(spark).withWatermark("ts", "2 seconds")
+         .join(b.toDF(spark), on=F.col("k") == F.col("k2"), how="left")
+         .writeStream.format("memory").queryName("ssju")
+         .outputMode("append").start())
 
 
 def test_left_outer_rejects_watermark_on_wrong_side(spark):
