@@ -63,6 +63,11 @@ P_ROWS = 1 << 22     # parquet scan lane: rows in the generated file
 P_COLS = 10          # wide file; pruning must read only the summed column
 P_REPS = 4
 
+SH_CAP = 1 << 18     # shuffle lane: rows per source batch
+SH_BATCHES = 8       # source batches per exchange pass
+SH_RECEIVERS = 8     # fan-out (the repo's 8-process world)
+SH_THREADS = 4       # fetch-pool width (shuffle.io.fetchThreads default)
+
 #: cold axon compiles of the fused agg/join programs run several minutes
 #: (f64/i64 emulation); the persistent jax compile cache under /tmp makes
 #: warm runs fast, but the timeout must cover a cold one
@@ -508,6 +513,114 @@ def _bench_parquet_scan(np, session):
     return _median_rate(timed, P_ROWS * P_REPS)
 
 
+def _bench_shuffle(np):
+    """Shuffle data-plane lane: one routed exchange, new plane vs seed.
+
+    SH_BATCHES source batches route to SH_RECEIVERS receivers.  The NEW
+    plane buckets each source batch once (``kernels.partition_bucket``,
+    untimed here — it rides the device exchange step in production),
+    then times encode→write→read→decode of the compact slices through
+    a ``SH_THREADS``-wide pool (the wire codec + fetch-pool path of
+    ``hostshuffle``).  The SEED plane is timed over the SAME logical
+    rows the way the old ``put()``/``collect()`` shipped them: pickle
+    of fully-padded static-capacity batches, written and read serially.
+    Rows/sec counts live rows for both, so the ratio is a pure
+    data-plane speedup for identical exchange content."""
+    import pickle
+    import shutil
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from spark_tpu import kernels, types as T, wire
+    from spark_tpu.columnar import ColumnBatch, ColumnVector
+
+    rng = np.random.default_rng(23)
+    routed, padded = [], []
+    for _ in range(SH_BATCHES):
+        vecs = [
+            ColumnVector(rng.integers(0, 1024, SH_CAP).astype(np.int64),
+                         T.int64, None, None),
+            ColumnVector(rng.integers(0, 100, SH_CAP).astype(np.int64),
+                         T.int64, None, None),
+            ColumnVector(rng.random(SH_CAP), T.float64, None, None),
+            ColumnVector(rng.integers(0, 8, SH_CAP).astype(np.int32),
+                         T.string, None,
+                         tuple(f"cat{j}" for j in range(8))),
+        ]
+        src = ColumnBatch(["k", "v", "f", "s"], vecs, None, SH_CAP)
+        pids = (np.asarray(src.vectors[0].data)
+                % SH_RECEIVERS).astype(np.int32)
+        b, off, cnt = kernels.partition_bucket(np, src, pids, SH_RECEIVERS)
+        b = b.to_host()
+        for r in range(SH_RECEIVERS):
+            sl = kernels.slice_rows(b, int(off[r]), int(cnt[r]))
+            routed.append(sl)
+            # the same rows as the seed plane shipped them: padded back
+            # to the full static capacity with a row-validity mask
+            rv = np.zeros(SH_CAP, bool)
+            rv[: int(cnt[r])] = True
+            pv = [ColumnVector(np.resize(np.asarray(v.data), SH_CAP),
+                               v.dtype, None, v.dictionary)
+                  for v in sl.vectors]
+            padded.append(ColumnBatch(list(sl.names), pv, rv, SH_CAP))
+    live = sum(b.capacity for b in routed)
+    raw_bytes = wire.raw_nbytes(routed)
+
+    d = tempfile.mkdtemp(prefix="spark_tpu_bench_shuffle_")
+    pool = ThreadPoolExecutor(SH_THREADS)
+    try:
+        def wire_write(i):
+            buf = wire.encode_batches([wire.trim_host(routed[i])])
+            path = os.path.join(d, f"w{i:03d}.blk")
+            with open(path, "wb") as f:
+                f.write(buf)
+            return path, len(buf)
+
+        def wire_read(path):
+            with open(path, "rb") as f:
+                data = f.read()
+            return wire.decode_batches(data)
+
+        def wire_pass():
+            written = list(pool.map(wire_write, range(len(routed))))
+            for out in pool.map(wire_read, (p for p, _ in written)):
+                assert out[0].capacity >= 0
+            return sum(n for _, n in written)
+
+        def pickle_pass():
+            for i, b in enumerate(padded):
+                with open(os.path.join(d, f"p{i:03d}.blk"), "wb") as f:
+                    pickle.dump([b], f, protocol=pickle.HIGHEST_PROTOCOL)
+            for i in range(len(padded)):
+                with open(os.path.join(d, f"p{i:03d}.blk"), "rb") as f:
+                    pickle.load(f)
+
+        wire_bytes = wire_pass()            # also the warm-up
+        pickle_pass()
+        pickle_bytes = sum(
+            os.path.getsize(os.path.join(d, f"p{i:03d}.blk"))
+            for i in range(len(padded)))
+        wire_rate = _median_rate(wire_pass, live)
+        pickle_rate = _median_rate(pickle_pass, live)
+    finally:
+        pool.shutdown()
+        shutil.rmtree(d, ignore_errors=True)
+    return {
+        "shuffle_rows_per_sec": round(wire_rate, 1),
+        "shuffle_bytes_per_sec": round(wire_rate * wire_bytes / live, 1),
+        "shuffle_vs_scan_baseline": round(
+            wire_rate / BASELINE_SCAN_ROWS_PER_S, 3),
+        "shuffle_pickle_rows_per_sec": round(pickle_rate, 1),
+        "shuffle_vs_pickle": round(wire_rate / pickle_rate, 2),
+        "shuffle_wire_bytes": wire_bytes,
+        "shuffle_pickle_bytes": pickle_bytes,
+        "shuffle_wire_vs_pickle_bytes": round(
+            pickle_bytes / max(1, wire_bytes), 2),
+        "shuffle_compression_ratio": round(raw_bytes / max(1, wire_bytes),
+                                           3),
+    }
+
+
 def child_main() -> None:
     import numpy as np
     import jax
@@ -525,8 +638,10 @@ def child_main() -> None:
             # and use the sort-based aggregation (the MXU one-hot matmul
             # kernel is a systolic-array design — pathological on CPU).
             global N, ITERS, J_FACT, J_ITERS, S_ROWS, S_ITERS, P_ROWS, P_REPS
+            global SH_CAP, SH_BATCHES
             N, ITERS, J_FACT, J_ITERS = 1 << 19, 5, 1 << 18, 3
             S_ROWS, S_ITERS, P_ROWS, P_REPS = 1 << 19, 3, 1 << 20, 2
+            SH_CAP, SH_BATCHES = 1 << 17, 4
 
     platform = _preflight()
 
@@ -567,6 +682,14 @@ def child_main() -> None:
     lane("scan", lambda: _bench_parquet_scan(np, session),
          BASELINE_SCAN_ROWS_PER_S,
          "parquet_scan_rows_per_sec", "scan_vs_baseline")
+    try:
+        # host-side data plane: one lane, several figures (wire vs the
+        # seed pickle plane in the same run, so the ratio is apples to
+        # apples on this machine's filesystem)
+        extras.update(_bench_shuffle(np))
+    except Exception as e:   # secondary must not sink the primary
+        print(f"[bench-child] shuffle bench failed: {e}", file=sys.stderr)
+        extras["shuffle_error"] = str(e)[:300]
 
     try:
         load_1m = round(os.getloadavg()[0], 2)

@@ -332,6 +332,40 @@ SHUFFLE_IO_ATTEMPT_TIMEOUT_MS = conf(
     "deadline."
 ).check(lambda v: v > 0).int(2000)
 
+SHUFFLE_WIRE_CODEC = conf("spark.tpu.shuffle.wire.codec").doc(
+    "Per-column byte codec for the framed columnar shuffle wire format "
+    "(and SpilledRuns spill files): one of codec.CODECS ('none', 'zlib', "
+    "'lzma', 'bz2', plus lz4/zstd when their wheels are importable).  "
+    "Applied per column buffer above compressThreshold, kept only when "
+    "it actually shrinks the buffer (spark.shuffle.compress analog)."
+).string("zlib")
+
+SHUFFLE_WIRE_COMPRESS_THRESHOLD = conf(
+    "spark.tpu.shuffle.wire.compressThreshold").doc(
+    "Column buffers at or above this many bytes are candidates for wire "
+    "compression; smaller ones skip the codec call entirely — zlib-1 "
+    "moves ~100 MB/s while the local filesystem moves GB/s, so "
+    "compression only pays once a buffer is large enough that DCN/"
+    "shared-fs bandwidth (not codec CPU) is the bottleneck "
+    "(spark.shuffle.spill.compress threshold role).  The 1 MiB default "
+    "keeps typical exchange blocks raw → zero-copy decode."
+).check(lambda v: v >= 0).int(1 << 20)
+
+SHUFFLE_IO_ASYNC_WRITE = conf("spark.tpu.shuffle.io.asyncWrite").doc(
+    "Stage shuffle blocks through a background writer thread so encode+"
+    "disk I/O overlaps the device's next exchange step; commit() drains "
+    "the queue before publishing the manifest, so the protocol's "
+    "atomic-rename/commit-marker ordering is unchanged.  Off = every "
+    "put() writes synchronously (the pre-overlap behavior)."
+).boolean(True)
+
+SHUFFLE_IO_FETCH_THREADS = conf("spark.tpu.shuffle.io.fetchThreads").doc(
+    "Concurrent block fetch+decode workers per exchange read: blocks "
+    "from multiple senders stream through a small thread pool instead "
+    "of a serial loop (zlib/file I/O release the GIL, so decode "
+    "genuinely parallelizes).  1 = serial reads."
+).check(lambda v: v >= 1).int(4)
+
 SHUFFLE_FETCH_RETRY_ENABLED = conf(
     "spark.tpu.shuffle.fetchRetryEnabled").doc(
     "Allow the keyed-aggregate fast path to re-request a lost peer's "

@@ -160,6 +160,52 @@ def sort_batch(xp, batch: ColumnBatch,
     return take_batch(xp, batch, perm)
 
 
+def partition_bucket(xp, batch: ColumnBatch, part_ids: Array,
+                     n_parts: int) -> Tuple[ColumnBatch, Array, Array]:
+    """Bucket rows by partition id in ONE device sort (the exchange-side
+    replacement for per-receiver host mask/compact passes).
+
+    Dead rows fold into a virtual partition ``n_parts`` so a single-key
+    stable sort (riding ``multi_key_argsort``'s lax.sort path) groups
+    live rows contiguously by destination with padding at the tail.
+    Returns ``(bucketed, offsets, counts)``: partition ``p``'s rows are
+    ``bucketed[offsets[p] : offsets[p] + counts[p]]``, so the sender
+    does one compacted D2H transfer and slices per-receiver host VIEWS
+    out of it — padding never crosses DCN.  Jittable on the jnp path
+    (``n_parts`` static); numpy path is the host fallback.
+    """
+    live = batch.row_valid_or_true()
+    pid = xp.where(live, xp.asarray(part_ids).astype(np.int32),
+                   np.int32(n_parts))
+    perm = multi_key_argsort(xp, [pid], batch.capacity)
+    bucketed = take_batch(xp, batch, perm)
+    if _is_np(xp):
+        counts = np.bincount(np.asarray(pid)[np.asarray(live)],
+                             minlength=n_parts).astype(np.int32)
+    else:
+        # dead rows carry pid == n_parts; out-of-bounds scatter adds drop
+        counts = xp.zeros(n_parts, np.int32).at[pid].add(
+            np.int32(1), mode="drop")
+    offsets = xp.concatenate(
+        [xp.zeros(1, np.int32), xp.cumsum(counts)[:-1].astype(np.int32)])
+    return bucketed, offsets, counts
+
+
+def slice_rows(batch: ColumnBatch, start: int, count: int) -> ColumnBatch:
+    """A zero-copy HOST view of rows ``[start, start + count)`` — numpy
+    basic slicing, every column shares the parent's buffers.  Rows in the
+    window are assumed live (``partition_bucket`` guarantees it), so the
+    view drops the row mask."""
+    vectors = [
+        ColumnVector(np.asarray(v.data)[start:start + count], v.dtype,
+                     None if v.valid is None
+                     else np.asarray(v.valid)[start:start + count],
+                     v.dictionary)
+        for v in batch.vectors
+    ]
+    return ColumnBatch(list(batch.names), vectors, None, count)
+
+
 def take_batch(xp, batch: ColumnBatch, perm: Array) -> ColumnBatch:
     """Gather all columns (and masks) through an index array.
 

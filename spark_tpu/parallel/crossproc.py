@@ -56,7 +56,7 @@ import numpy as np
 
 from ..columnar import ColumnBatch, ColumnVector
 from ..expressions import Col, EvalContext, Hash64
-from ..kernels import compact, union_all
+from ..kernels import compact, partition_bucket, slice_rows, union_all
 from ..sql import physical as P
 from .hostshuffle import ExchangeFetchFailed, HostShuffleService
 
@@ -136,10 +136,17 @@ def _route_exchange_merge(session, plan, partial_node, partial: ColumnBatch,
     key_refs = [Col(k.name) for k in plan.keys]
     ectx = EvalContext(partial, np)
     h = ectx.broadcast(Hash64(*key_refs).eval(ectx)).data
-    live = np.asarray(partial.row_valid_or_true())
     receiver = (np.asarray(h).astype(np.uint64)
-                % np.uint64(svc.n)).astype(np.int64)
-    routed = {r: [_mask_rows(partial, live & (receiver == r))]
+                % np.uint64(svc.n)).astype(np.int32)
+    # one bucketing kernel instead of n per-receiver mask/compact passes:
+    # rows sort by receiver id (dead rows to the tail), then each block
+    # is a zero-copy contiguous slice of the single bucketed batch
+    bucketed, offsets, counts = partition_bucket(np, partial, receiver,
+                                                 svc.n)
+    bucketed = bucketed.to_host()
+    off = np.asarray(offsets)
+    cnt = np.asarray(counts)
+    routed = {r: [slice_rows(bucketed, int(off[r]), int(cnt[r]))]
               for r in range(svc.n)}
     try:
         received = svc.exchange(xid, routed)

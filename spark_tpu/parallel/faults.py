@@ -15,6 +15,9 @@ side; rules fire when a matching block is published:
                 retrying reader exists for.
 - ``truncate``  the block is cut short (torn write / partial flush);
                 optionally heals to the full bytes later.
+- ``corrupt``   one payload byte is flipped IN PLACE — the block keeps
+                its manifested size, so only the wire checksum can tell
+                (bit rot / torn sector); optionally heals later.
 - ``delay``     the block stays invisible for a window, then appears.
 - ``skip_commit``  the sender publishes blocks but never writes its
                 commit marker (killed between put and commit).
@@ -42,7 +45,8 @@ __all__ = ["FaultInjector", "FaultPlan", "FAULT_PLAN_ENV"]
 
 FAULT_PLAN_ENV = "SPARK_TPU_FAULT_PLAN"
 
-_KINDS = ("drop", "truncate", "delay", "skip_commit", "die_after_put")
+_KINDS = ("drop", "truncate", "corrupt", "delay", "skip_commit",
+          "die_after_put")
 
 
 class _Rule:
@@ -97,6 +101,13 @@ class FaultPlan:
                  keep_bytes: int = 16) -> "FaultPlan":
         self.rules.append(_Rule("truncate", exchange, receiver, once,
                                 heal_after_s, keep_bytes))
+        return self
+
+    def corrupt(self, exchange: Optional[str] = None,
+                receiver: Optional[int] = None, once: bool = True,
+                heal_after_s: Optional[float] = None) -> "FaultPlan":
+        self.rules.append(_Rule("corrupt", exchange, receiver, once,
+                                heal_after_s))
         return self
 
     def delay(self, seconds: float, exchange: Optional[str] = None,
@@ -165,6 +176,11 @@ class FaultInjector:
         elif rule.kind == "truncate":
             with open(path, "wb") as f:
                 f.write(payload[: rule.keep_bytes])
+        elif rule.kind == "corrupt":
+            # flip the LAST byte: size unchanged, frame intact, only the
+            # crc32 over header+payload can notice
+            with open(path, "wb") as f:
+                f.write(payload[:-1] + bytes([payload[-1] ^ 0xFF]))
         if rule.heal_after_s is not None:
             self._heal_later(path, payload, rule.heal_after_s)
         self.injected.append(f"{rule.kind}:{label}")
@@ -176,9 +192,12 @@ class FaultInjector:
 
         def put(exchange, receiver, batches):
             orig_put(exchange, receiver, batches)
+            flush = getattr(svc, "flush", None)
+            if flush is not None:      # async writer: the rule perturbs
+                flush(exchange)        # a file, so it must exist first
             path = svc._part(exchange, svc.pid, receiver)
             for rule in injector.plan.rules:
-                if rule.kind in ("drop", "truncate", "delay") \
+                if rule.kind in ("drop", "truncate", "corrupt", "delay") \
                         and rule.matches(exchange, receiver):
                     injector._apply(rule, path,
                                     f"{exchange}/s{svc.pid}-r{receiver}")

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from .. import config as C
 from .. import types as T
+from .. import wire
 from ..aggregates import First, Last, Max, Min
 from ..columnar import (
     ColumnBatch, ColumnVector, normalize_valids, pad_capacity,
@@ -187,8 +188,10 @@ class SpilledRuns:
     """Run batches held in host RAM up to a row budget, then on disk.
 
     The ``Spillable`` threshold idiom (`util/collection/Spillable.scala`)
-    with pickle files as the spill format (host batches are numpy arrays +
-    dictionaries — self-describing and compact enough for intermediates)."""
+    with the columnar wire format (``wire.py``) as the spill format: the
+    same framed raw-buffer + checksum encoding shuffle blocks use, so a
+    torn spill is detected on read instead of deserializing garbage.
+    Pre-wire pickle spill files still load (magic-byte sniff)."""
 
     def __init__(self, budget_rows: int, spill_dir: str):
         self.budget_rows = budget_rows
@@ -214,7 +217,7 @@ class SpilledRuns:
         path = os.path.join(self._dir, f"run-{self._n_spilled:05d}.spill")
         self._n_spilled += 1
         with open(path, "wb") as f:
-            pickle.dump(self._mem, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(wire.encode_batches([b.to_host() for b in self._mem]))
         _log.info("spilled %d rows in %d runs to %s",
                   self._mem_rows, len(self._mem), path)
         self._disk.append(path)
@@ -226,7 +229,11 @@ class SpilledRuns:
         runs: List[ColumnBatch] = []
         for path in self._disk:
             with open(path, "rb") as f:
-                runs.extend(pickle.load(f))
+                data = f.read()
+            if data[:4] == wire.MAGIC:
+                runs.extend(wire.decode_batches(data))
+            else:                      # legacy pickle spill
+                runs.extend(pickle.loads(data))
             os.remove(path)
         runs.extend(self._mem)
         self._disk = []

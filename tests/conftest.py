@@ -42,6 +42,13 @@ from spark_tpu import kernels as _kernels  # noqa: E402
 _kernels.MXU_AGG_ENABLED = True
 
 
+def pytest_configure(config):
+    # the tier-1 sweep runs `-m 'not slow'`; heavy subprocess/thread-pool
+    # suites (chaos, stress-scale wire round-trips) opt out via this mark
+    config.addinivalue_line(
+        "markers", "slow: >~5s test, excluded from the tier-1 sweep")
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)

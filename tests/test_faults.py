@@ -28,6 +28,7 @@ import numpy as np
 import pytest
 
 from spark_tpu import config as C
+from spark_tpu import wire
 from spark_tpu.columnar import ColumnBatch
 from spark_tpu.parallel.cluster import HeartbeatMonitor
 from spark_tpu.parallel.faults import FAULT_PLAN_ENV, FaultInjector, FaultPlan
@@ -108,6 +109,90 @@ def test_reader_respects_deadline(tmp_path):
         reader.read(str(tmp_path / "never.part"),
                     deadline=time.monotonic() + 0.3)
     assert time.monotonic() - t0 < 1.5
+
+
+# ---------------------------------------------------------------------------
+# wire-format error classes: each transient shape retries, foreign
+# frames fail fast (ISSUE 2: checksum-mismatch and short-frame are
+# retryable partial writes, same backoff path as EOFError/Unpickling)
+# ---------------------------------------------------------------------------
+
+def _wire_frame(vals):
+    return wire.encode_batches([_batch(vals).to_host()])
+
+
+def _healing_reader(path, good, retries):
+    """A reader whose backoff sleep 'heals' the block on disk — the
+    torn-write-then-completed-write sequence, made deterministic."""
+    def heal(_wait):
+        with open(path, "wb") as f:
+            f.write(good)
+    return RetryingBlockReader(max_retries=3, retry_wait_s=0.01,
+                               sleep=heal, on_retry=retries.append)
+
+
+def test_checksum_mismatch_retried_per_class(tmp_path):
+    """Size-preserving corruption passes the manifest size check — only
+    the frame checksum can see it.  ``wire.ChecksumError`` must ride the
+    same backoff path as a missing file."""
+    good = _wire_frame([21, 22])
+    path = str(tmp_path / "b.part")
+    with open(path, "wb") as f:
+        f.write(good[:-1] + bytes([good[-1] ^ 0xFF]))
+    retries = []
+    got = _healing_reader(path, good, retries).read(
+        path, expect_size=len(good))
+    assert _values(got) == [21, 22]
+    assert retries == [path]
+
+
+def test_short_frame_retried_per_class(tmp_path):
+    """A frame cut mid-payload raises ``wire.TruncatedBlockError`` and
+    retries even with no manifest size to compare against — the frame's
+    own length fields are the classifier."""
+    good = _wire_frame([31, 32, 33])
+    path = str(tmp_path / "b.part")
+    with open(path, "wb") as f:
+        f.write(good[:len(good) - 5])
+    retries = []
+    got = _healing_reader(path, good, retries).read(path)  # expect_size=None
+    assert _values(got) == [31, 32, 33]
+    assert retries == [path]
+
+
+def test_foreign_frame_fails_fast_without_retry(tmp_path):
+    """Good magic + unsupported version with a full-length file is not a
+    partial write; re-reading cannot fix it, so the reader must not burn
+    its retry budget (plain ``WireFormatError`` → immediate failure)."""
+    good = _wire_frame([1])
+    bad = bytearray(good)
+    bad[4] = 99                          # version byte; prefix is unchecksummed
+    path = str(tmp_path / "b.part")
+    with open(path, "wb") as f:
+        f.write(bytes(bad))
+    retries = []
+    reader = RetryingBlockReader(max_retries=5, retry_wait_s=0.01,
+                                 on_retry=retries.append)
+    with pytest.raises(BlockFetchError) as ei:
+        reader.read(path, expect_size=len(good))
+    assert ei.value.attempts == 1
+    assert retries == []
+
+
+def test_corrupted_block_detected_by_checksum_and_recovered(tmp_path):
+    """End-to-end: the injector's size-preserving ``corrupt`` fault flips
+    one payload byte in a committed block.  The manifest size matches, so
+    ONLY the wire checksum can detect the tear; the fetch retries and
+    completes once the rule heals."""
+    svc0, svc1 = _pair(tmp_path)
+    FaultInjector(FaultPlan().corrupt(exchange="e",
+                                      heal_after_s=0.25)).attach(svc1)
+    svc1.put("e", 0, [_batch([51, 52])])
+    svc1.commit("e")
+    got = svc0.exchange("e", {0: [_batch([1])], 1: [_batch([2])]})
+    assert _values(got) == [1, 51, 52]
+    assert svc0.counters["block_retries"] > 0
+    assert svc0.counters["blocks_lost"] == 0
 
 
 # ---------------------------------------------------------------------------
@@ -230,6 +315,7 @@ def test_skip_commit_keeps_barrier_loud(tmp_path):
 def test_fault_plan_env_roundtrip(tmp_path):
     plan = (FaultPlan().drop(exchange="a", receiver=1)
             .truncate(heal_after_s=0.5, keep_bytes=3)
+            .corrupt(exchange="d", heal_after_s=0.1)
             .delay(0.2, exchange="b")
             .die_after_put(exchange="c", commit_first=True))
     env = {FAULT_PLAN_ENV: plan.to_env()}

@@ -340,3 +340,65 @@ def test_radix_argsort_matches_lax_sort():
                    np.iinfo(np.int64).max, 0], np.int64)
     np.testing.assert_array_equal(
         np.asarray(radix_argsort(np, xs)), np.argsort(xs, kind="stable"))
+
+
+def test_partition_bucket_numpy_oracle():
+    from spark_tpu.kernels import partition_bucket, slice_rows
+    rng = np.random.default_rng(9)
+    cap, n_parts = 64, 5
+    vals = rng.integers(-100, 100, cap).astype(np.int64)
+    rv = rng.random(cap) < 0.6
+    pids = rng.integers(0, n_parts, cap).astype(np.int32)
+    b = ColumnBatch.from_arrays({"v": vals})
+    b = ColumnBatch(b.names, b.vectors, rv, b.capacity)
+    bucketed, off, cnt = partition_bucket(np, b, pids, n_parts)
+    off, cnt = np.asarray(off), np.asarray(cnt)
+    assert cnt.sum() == rv.sum()
+    assert off[0] == 0
+    np.testing.assert_array_equal(off[1:], np.cumsum(cnt)[:-1])
+    data = np.asarray(bucketed.vectors[0].data)
+    for p in range(n_parts):
+        # partition p's window holds exactly the live rows routed to p,
+        # in original order (stable sort)
+        want = vals[rv & (pids == p)]
+        got = data[off[p]: off[p] + cnt[p]]
+        np.testing.assert_array_equal(got, want)
+        sl = slice_rows(bucketed, int(off[p]), int(cnt[p]))
+        assert sl.capacity == cnt[p] and sl.row_valid is None
+        np.testing.assert_array_equal(np.asarray(sl.vectors[0].data), want)
+    # everything past the live region is dead padding
+    assert np.asarray(bucketed.row_valid)[: cnt.sum()].all()
+    assert not np.asarray(bucketed.row_valid)[cnt.sum():].any()
+
+
+def test_partition_bucket_jit_matches_numpy():
+    from spark_tpu.kernels import partition_bucket
+    rng = np.random.default_rng(11)
+    cap, n_parts = 32, 4
+    vals = rng.integers(0, 50, cap).astype(np.int64)
+    rv = rng.random(cap) < 0.5
+    pids = (vals % n_parts).astype(np.int32)
+    host = ColumnBatch.from_arrays({"v": vals})
+    host = ColumnBatch(host.names, host.vectors, rv, host.capacity)
+    nb, noff, ncnt = partition_bucket(np, host, pids, n_parts)
+
+    dev = host.to_device()
+    f = jax.jit(lambda b, p: partition_bucket(jnp, b, p, n_parts))
+    jb, joff, jcnt = f(dev, jnp.asarray(pids))
+    np.testing.assert_array_equal(np.asarray(jcnt), np.asarray(ncnt))
+    np.testing.assert_array_equal(np.asarray(joff), np.asarray(noff))
+    live = int(np.asarray(ncnt).sum())
+    np.testing.assert_array_equal(
+        np.asarray(jb.vectors[0].data)[:live],
+        np.asarray(nb.vectors[0].data)[:live])
+
+
+def test_slice_rows_is_zero_copy_view():
+    from spark_tpu.kernels import slice_rows
+    b = ColumnBatch.from_arrays({"v": np.arange(16, dtype=np.int64)})
+    sl = slice_rows(b, 4, 8)
+    assert np.shares_memory(np.asarray(sl.vectors[0].data),
+                            np.asarray(b.vectors[0].data))
+    assert sl.capacity == 8
+    np.testing.assert_array_equal(np.asarray(sl.vectors[0].data),
+                                  np.arange(4, 12))
