@@ -68,6 +68,9 @@ SH_BATCHES = 8       # source batches per exchange pass
 SH_RECEIVERS = 8     # fan-out (the repo's 8-process world)
 SH_THREADS = 4       # fetch-pool width (shuffle.io.fetchThreads default)
 
+DJ_ROWS = 1 << 17    # distributed-join lane: rows per table (full dataset)
+DJ_KEYS = 1 << 14    # join-key cardinality (multiplicity 8 per side)
+
 #: cold axon compiles of the fused agg/join programs run several minutes
 #: (f64/i64 emulation); the persistent jax compile cache under /tmp makes
 #: warm runs fast, but the timeout must cover a cold one
@@ -621,6 +624,133 @@ def _bench_shuffle(np):
     }
 
 
+def _bench_dist_join() -> dict:
+    """Distributed-join lane: a 2-process equi-join + group-by through the
+    host-shuffle data plane, shuffled hash join vs the forced gather path.
+
+    Two REAL worker processes (``--distjoin-worker``) share one shuffle
+    root; each holds a strided half of both fact tables and runs the same
+    query twice — ``spark.tpu.crossproc.shuffledJoin`` on, then off on a
+    fresh exchange root.  Each worker reports warm-run wall time and its
+    service's DCN byte/row counters; this parent sums bytes across both
+    workers and cross-checks that the two paths produced identical
+    aggregates.  The byte reduction is structural: the shuffled path runs
+    each side's subtree (pushed-down filters, pruned columns) BEFORE
+    shipping and keeps its own key range in memory, while the gather path
+    ships raw leaves."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="spark_tpu_bench_dj_")
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("SPARK_TPU_FAULT_PLAN", None)
+        env.pop("SPARK_TPU_PLATFORM", None)
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--distjoin-worker", str(pid), d],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for pid in (0, 1)]
+        outs = [p.communicate(timeout=CHILD_TIMEOUT_S) for p in procs]
+        objs = []
+        for p, (out, err) in zip(procs, outs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"distjoin worker rc={p.returncode}: "
+                    f"{(err or out).strip().splitlines()[-3:]}")
+            line = [ln for ln in out.splitlines()
+                    if ln.strip().startswith("{")][-1]
+            objs.append(json.loads(line))
+        # both paths, both processes: byte-identical aggregates
+        sums = {o[m]["checksum"] for o in objs for m in ("shuffled",
+                                                         "gather")}
+        if len(sums) != 1:
+            raise RuntimeError(f"shuffled/gather results diverge: {objs}")
+        if not all(o["shuffled"]["shuffled_joins"] > 0 for o in objs):
+            raise RuntimeError(f"shuffled path did not run: {objs}")
+        if any(o["gather"]["shuffled_joins"] > 0 for o in objs):
+            raise RuntimeError(f"gather run took the shuffled path: {objs}")
+        rows = objs[0]["rows_total"]
+        sh_s = max(o["shuffled"]["seconds"] for o in objs)
+        ga_s = max(o["gather"]["seconds"] for o in objs)
+        sh_b = sum(o["shuffled"]["bytes_written"] for o in objs)
+        ga_b = sum(o["gather"]["bytes_written"] for o in objs)
+        return {
+            "distjoin_rows_per_sec": round(rows / sh_s, 1),
+            "distjoin_gather_rows_per_sec": round(rows / ga_s, 1),
+            "distjoin_speedup_vs_gather": round(ga_s / sh_s, 3),
+            "distjoin_dcn_bytes": sh_b,
+            "distjoin_gather_dcn_bytes": ga_b,
+            "distjoin_dcn_byte_reduction": round(ga_b / max(1, sh_b), 2),
+            "distjoin_rows_shipped": sum(
+                o["shuffled"]["rows_shipped"] for o in objs),
+            "distjoin_gather_rows_shipped": sum(
+                o["gather"]["rows_shipped"] for o in objs),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def distjoin_worker_main() -> None:
+    """One process of the distributed-join lane (see ``_bench_dist_join``).
+
+    argv: --distjoin-worker <pid> <root>.  Prints ONE JSON line with warm
+    wall-clock and service counters for the shuffled and gather modes."""
+    i = sys.argv.index("--distjoin-worker")
+    pid, root = int(sys.argv[i + 1]), sys.argv[i + 2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from spark_tpu import config as C
+    from spark_tpu.sql.session import SparkSession
+
+    # both workers draw the SAME dataset, keep a strided half: every key
+    # range lives on both processes (worst case for a local join)
+    rng = np.random.default_rng(31)
+    sk = rng.integers(0, DJ_KEYS, DJ_ROWS).astype(np.int64)
+    price = rng.integers(1, 201, DJ_ROWS).astype(np.int64)
+    k2 = rng.integers(0, DJ_KEYS, DJ_ROWS).astype(np.int64)
+    bonus = rng.integers(1, 101, DJ_ROWS).astype(np.int64)
+    mine = slice(pid, None, 2)
+    Q = ("SELECT sk, count(*) AS c, sum(bonus) AS sb FROM fact "
+         "JOIN fact2 ON sk = k2 WHERE price < 100 AND bonus < 50 "
+         "GROUP BY sk")
+
+    session = SparkSession.builder.appName(f"bench-dj-{pid}").getOrCreate()
+    out = {"pid": pid, "rows_total": int(2 * DJ_ROWS)}
+    for mode in ("shuffled", "gather"):
+        xs = session.newSession()
+        xs.conf.set(C.MESH_SHARDS.key, "1")
+        xs.conf.set(C.CROSSPROC_SHUFFLED_JOIN.key,
+                    "true" if mode == "shuffled" else "false")
+        svc = xs.enableHostShuffle(os.path.join(root, mode),
+                                   process_id=pid, n_processes=2,
+                                   timeout_s=300.0)
+        xs.createDataFrame({"sk": sk[mine], "price": price[mine]}) \
+            .createOrReplaceTempView("fact")
+        xs.createDataFrame({"k2": k2[mine], "bonus": bonus[mine]}) \
+            .createOrReplaceTempView("fact2")
+        xs.sql(Q).collect()                  # warm: compile + caches
+        base_bytes = int(svc.counters["bytes_written"])
+        base_rows = int(svc.counters["rows_shipped"])
+        t0 = time.perf_counter()
+        rows = xs.sql(Q).collect()
+        elapsed = time.perf_counter() - t0
+        out[mode] = {
+            "seconds": round(elapsed, 3),
+            "bytes_written": int(svc.counters["bytes_written"]) - base_bytes,
+            "rows_shipped": int(svc.counters["rows_shipped"]) - base_rows,
+            "groups": len(rows),
+            "checksum": int(sum(int(r[1]) * 7 + int(r[2]) for r in rows)),
+            "shuffled_joins": int(svc.counters["shuffled_joins"]),
+        }
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
 def child_main() -> None:
     import numpy as np
     import jax
@@ -690,6 +820,13 @@ def child_main() -> None:
     except Exception as e:   # secondary must not sink the primary
         print(f"[bench-child] shuffle bench failed: {e}", file=sys.stderr)
         extras["shuffle_error"] = str(e)[:300]
+    try:
+        # distributed join: 2 real worker processes (always CPU — they
+        # must not contend for the accelerator), shuffled vs gather
+        extras.update(_bench_dist_join())
+    except Exception as e:   # secondary must not sink the primary
+        print(f"[bench-child] distjoin bench failed: {e}", file=sys.stderr)
+        extras["distjoin_error"] = str(e)[:300]
 
     try:
         load_1m = round(os.getloadavg()[0], 2)
@@ -713,7 +850,9 @@ def child_main() -> None:
 
 
 if __name__ == "__main__":
-    if "--child" in sys.argv:
+    if "--distjoin-worker" in sys.argv:
+        distjoin_worker_main()
+    elif "--child" in sys.argv:
         child_main()
     else:
         sys.exit(orchestrate())

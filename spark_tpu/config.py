@@ -375,6 +375,31 @@ SHUFFLE_FETCH_RETRY_ENABLED = conf(
     "ExchangeFetchFailed."
 ).boolean(True)
 
+CROSSPROC_SHUFFLED_JOIN = conf("spark.tpu.crossproc.shuffledJoin").doc(
+    "Cross-process shuffled hash join (ShuffledHashJoinExec placement "
+    "analog): an equi-join whose two sides BOTH hold partitioned leaves "
+    "co-partitions both sides by join-key hash through the host shuffle "
+    "service and joins each disjoint key range locally, instead of "
+    "centralizing every leaf to every process (the generic-path "
+    "O(total-data x processes) gather).  Off = always gather."
+).boolean(True)
+
+SHUFFLE_TARGET_PARTITION_BYTES = conf(
+    "spark.tpu.shuffle.targetPartitionBytes").doc(
+    "Advisory reduce-partition size for cross-process shuffles "
+    "(spark.sql.adaptive.advisoryPartitionSizeInBytes analog): after "
+    "map-side size manifests are published, adjacent fine partitions "
+    "below this byte count coalesce into one reducer, chosen adaptively "
+    "per exchange.  0 = static contiguous assignment, no coalescing."
+).check(lambda v: v >= 0).int(1 << 22)
+
+SHUFFLE_FINE_PARTITIONS = conf("spark.tpu.shuffle.finePartitionsPerProc").doc(
+    "Fine hash partitions PER PROCESS for cross-process shuffled joins; "
+    "the manifest-driven coordinator coalesces these into at most "
+    "n_processes contiguous reducer ranges.  More = finer coalescing/"
+    "skew resolution, slightly larger size manifests."
+).check(lambda v: v >= 1).int(8)
+
 SHUFFLE_BLACKLIST_ENABLED = conf("spark.tpu.shuffle.blacklistEnabled").doc(
     "Exclude heartbeat-confirmed-dead peers from exchange barriers and "
     "remember them for the rest of the query (scheduler/HealthTracker "

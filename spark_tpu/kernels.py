@@ -191,6 +191,24 @@ def partition_bucket(xp, batch: ColumnBatch, part_ids: Array,
     return bucketed, offsets, counts
 
 
+def partition_host_slices(xp, batch: ColumnBatch, part_ids: Array,
+                          n_parts: int
+                          ) -> Tuple[ColumnBatch, Array, Array]:
+    """``partition_bucket`` + one D2H transfer + host offset/count arrays.
+
+    The shared front half of every DCN route (aggregate-state exchange,
+    shuffled-join co-partitioning): callers carve zero-copy per-receiver
+    views out of the returned host batch with ``slice_rows``.  Because
+    the bucketing sort is stable and partition ids ascend, any CONTIGUOUS
+    range of partitions is itself one contiguous slice — which is what
+    lets the manifest coordinator coalesce adjacent fine partitions into
+    a single receiver block without re-bucketing.
+    """
+    bucketed, offsets, counts = partition_bucket(xp, batch, part_ids,
+                                                 n_parts)
+    return (bucketed.to_host(), np.asarray(offsets), np.asarray(counts))
+
+
 def slice_rows(batch: ColumnBatch, start: int, count: int) -> ColumnBatch:
     """A zero-copy HOST view of rows ``[start, start + count)`` — numpy
     basic slicing, every column shares the parent's buffers.  Rows in the

@@ -29,18 +29,32 @@ Execution shapes:
 
 1. keyed-aggregate fast path — root (under Project/Sort/Limit) is a
    keyed Aggregate, the child subtree has no global operators, every
-   child join is INNER/CROSS, and the leaf digests show at most ONE
-   partitioned leaf (the fact).  Then: per-process DEVICE partials →
-   key-hash state exchange → disjoint merge+final per process → gather →
-   above-ops locally.  Each fact row is processed exactly once globally
-   and every dim is complete per process, so the partials merge exactly.
-   (Outer/semi/anti joins or 2+ partitioned leaves fall through: a
-   replicated preserved side would null-extend once PER PROCESS, and two
-   partitioned join inputs never meet locally.)
-2. generic path — everything else (window/distinct/limit/sample,
-   joins of two partitioned tables, string min/max aggs): partitioned
-   leaves gather through the service first, then the full plan runs
-   locally, identically in every process.  This LIFTS the old
+   child join is partition-safe (INNER/CROSS always; LEFT SEMI/ANTI
+   when the digest flags show the build side replicated), and the leaf
+   digests show exactly ONE partitioned leaf (the fact).  Then:
+   per-process DEVICE partials → key-hash state exchange → disjoint
+   merge+final per process → gather → above-ops locally.  Each fact row
+   is processed exactly once globally and every dim is complete per
+   process, so the partials merge exactly.  (Outer joins or 2+
+   partitioned leaves fall through: a replicated preserved side would
+   null-extend once PER PROCESS, and two partitioned join inputs never
+   meet locally — shape 2 handles the equi-join case.)
+2. shuffled hash join — the plan's per-row spine (optionally under a
+   keyed Aggregate) roots in an equi-join whose two sides BOTH hold a
+   partitioned leaf.  Both sides co-partition by join-key hash through
+   the service (device bucketing → zero-copy host slices → wire
+   blocks), with the reducer assignment chosen ADAPTIVELY from
+   manifest-published per-fine-partition byte counts (adjacent tiny
+   partitions coalesce below ``spark.tpu.shuffle.targetPartitionBytes``
+   — the ExchangeCoordinator analog); each process then joins one
+   disjoint key range locally with the ordinary ``PJoin`` and
+   contributes exactly its shard.  A keyed Aggregate above merges via
+   the partial→route→merge pipeline, so each joined row crosses the
+   DCN once.  Gated by ``spark.tpu.crossproc.shuffledJoin``.
+3. generic path — everything else (window/distinct/limit/sample,
+   non-equi joins of partitioned tables, string min/max aggs):
+   partitioned leaves gather through the service first, then the full
+   plan runs locally, identically in every process.  This LIFTS the old
    ``_reject_global_ops`` refusal: shapes that were errors now execute
    exactly (centralize-then-compute), while the hot aggregate shape
    keeps the state-sized exchange.
@@ -56,8 +70,11 @@ import numpy as np
 
 from ..columnar import ColumnBatch, ColumnVector
 from ..expressions import Col, EvalContext, Hash64
-from ..kernels import compact, partition_bucket, slice_rows, union_all
+from ..kernels import (
+    compact, partition_host_slices, slice_rows, union_all,
+)
 from ..sql import physical as P
+from .. import wire
 from .hostshuffle import ExchangeFetchFailed, HostShuffleService
 
 __all__ = ["host_exchange_group_agg", "crossproc_execute",
@@ -73,6 +90,20 @@ def _mask_rows(batch: ColumnBatch, keep: np.ndarray) -> ColumnBatch:
         for v in batch.vectors
     ]
     return ColumnBatch(list(batch.names), vectors, None, len(idx))
+
+
+def _one_dead_row(batch: ColumnBatch) -> ColumnBatch:
+    """A capacity-1 batch of ``batch``'s schema whose single row is DEAD
+    (row_valid False).  Stands in for an empty exchange shard: the join
+    and aggregate kernels size their gathers off ``capacity``, and a
+    capacity-0 input makes every gather ill-formed — a dead row flows
+    through the live masks and contributes nothing."""
+    vectors = [
+        ColumnVector(np.zeros(1, np.asarray(v.data).dtype), v.dtype,
+                     np.zeros(1, bool), v.dictionary)
+        for v in batch.vectors
+    ]
+    return ColumnBatch(list(batch.names), vectors, np.zeros(1, bool), 1)
 
 
 # ---------------------------------------------------------------------------
@@ -104,11 +135,82 @@ def _agg_strings_ok(plan) -> bool:
     return True
 
 
-def _joins_all_inner(node) -> bool:
+def _joins_maybe_safe(node) -> bool:
+    """Cheap pre-filter (no digest knowledge yet): join types that can
+    NEVER be partition-safe below a per-process partial aggregate —
+    outer joins null-extend once per process — reject before paying the
+    digest exchange.  SEMI/ANTI stay candidates; whether they qualify
+    depends on the replication flags (``_joins_partition_safe``)."""
     from ..sql import logical as L
-    if isinstance(node, L.Join) and node.how not in ("inner", "cross"):
+    if isinstance(node, L.Join) and node.how not in (
+            "inner", "cross", "left_semi", "left_anti"):
         return False
-    return all(_joins_all_inner(c) for c in node.children)
+    return all(_joins_maybe_safe(c) for c in node.children)
+
+
+def _n_leaves(node) -> int:
+    from ..sql import logical as L
+    n = sum(_n_leaves(c) for c in node.children)
+    if isinstance(node, (L.LocalRelation, L.FileRelation)):
+        n += 1
+    return n
+
+
+def _joins_partition_safe(node, flags: List[bool], base: int = 0) -> bool:
+    """Flag-aware join guard for per-process local execution: INNER and
+    CROSS joins are always safe (each local row meets every global
+    match exactly once when the other inputs are complete); LEFT
+    SEMI/ANTI are safe when the non-preserved (right) side is fully
+    REPLICATED — the existence probe then runs against the complete
+    build side in every process, so each preserved row is kept/dropped
+    exactly once globally.  ``flags`` is the digest-probe partition
+    classification in ``_leaf_batches`` order; ``base`` is this
+    subtree's first leaf index."""
+    from ..sql import logical as L
+    if isinstance(node, L.Join):
+        nl = _n_leaves(node.children[0])
+        nr = _n_leaves(node.children[1])
+        if node.how not in ("inner", "cross"):
+            right_partitioned = any(flags[base + nl: base + nl + nr])
+            if node.how not in ("left_semi", "left_anti") \
+                    or right_partitioned:
+                return False
+        return (_joins_partition_safe(node.children[0], flags, base)
+                and _joins_partition_safe(node.children[1], flags,
+                                          base + nl))
+    b = base
+    for c in node.children:
+        if not _joins_partition_safe(c, flags, b):
+            return False
+        b += _n_leaves(c)
+    return True
+
+
+def _find_spine_join(node):
+    """The topmost Join reachable from ``node`` through PER-ROW
+    single-child operators only (alias/project/filter): anything on
+    that spine commutes with a union over disjoint row shards, so the
+    shuffled-join result can flow through it per process.  None when a
+    shard-breaking operator (aggregate, distinct, window, …) intervenes."""
+    from ..sql import logical as L
+    while isinstance(node, (L.SubqueryAlias, L.Project, L.Filter)):
+        node = node.children[0]
+    return node if isinstance(node, L.Join) else None
+
+
+def _replace_node(root, target, replacement):
+    """Rebuild ``root`` with the (identity-matched) ``target`` subtree
+    swapped for ``replacement``; untouched subtrees are shared."""
+    if root is target:
+        return replacement
+    new_children = tuple(_replace_node(c, target, replacement)
+                         for c in root.children)
+    if new_children == tuple(root.children):
+        return root
+    import copy as _copy
+    out = _copy.copy(root)
+    out.children = new_children
+    return out
 
 
 def _batch_digest(batch: ColumnBatch) -> int:
@@ -141,11 +243,8 @@ def _route_exchange_merge(session, plan, partial_node, partial: ColumnBatch,
     # one bucketing kernel instead of n per-receiver mask/compact passes:
     # rows sort by receiver id (dead rows to the tail), then each block
     # is a zero-copy contiguous slice of the single bucketed batch
-    bucketed, offsets, counts = partition_bucket(np, partial, receiver,
-                                                 svc.n)
-    bucketed = bucketed.to_host()
-    off = np.asarray(offsets)
-    cnt = np.asarray(counts)
+    bucketed, off, cnt = partition_host_slices(np, partial, receiver,
+                                               svc.n)
     routed = {r: [slice_rows(bucketed, int(off[r]), int(cnt[r]))]
               for r in range(svc.n)}
     try:
@@ -376,6 +475,90 @@ def _gather_leaf_relations(session, plan, svc: HostShuffleService,
     return walk(plan)
 
 
+def _exchange_with_refetch(svc: HostShuffleService, xid: str,
+                           routed: Dict[int, List[ColumnBatch]]
+                           ) -> List[ColumnBatch]:
+    """One exchange hop with the standard loss policy: on a structured
+    fetch failure, ONE refetch after a re-barrier (a peer that committed
+    before dying left its blocks on the shared filesystem); a second
+    loss propagates within the 2x-deadline bound."""
+    try:
+        return svc.exchange(xid, routed)
+    except ExchangeFetchFailed:
+        if not svc.refetch_enabled:
+            raise
+        return svc.refetch(xid, routed)
+
+
+def _shuffled_join_shards(session, join, key_pairs,
+                          svc: HostShuffleService, xid: str
+                          ) -> Tuple[ColumnBatch, ColumnBatch]:
+    """Co-partition BOTH join sides by join-key hash through the host
+    shuffle service; returns this process's disjoint (left, right) key
+    range (the ShuffleExchangeExec placement + ExchangeCoordinator
+    protocol, DCN-shaped):
+
+    1. each side's subtree runs locally (device path) per process;
+    2. rows bucket by ``Hash64(keys) % n_fine`` on device
+       (``partition_bucket``), carved into zero-copy host slices;
+    3. map-side commit is a manifest-ONLY size exchange: per-fine-
+       partition raw byte counts publish with no data blocks, so every
+       process computes the SAME coalesced reducer assignment
+       (``plan_reducers``) from identical manifests — no driver;
+    4. only then do data blocks ship, at RECEIVER granularity (adjacent
+       fine partitions assigned to one reducer ride in one contiguous
+       slice), through the ordinary exchange with its retry/blacklist/
+       refetch machinery; a process's own range never touches the disk.
+
+    Equal keys hash equally on both sides (``Hash64`` hashes dictionary
+    WORDS, not codes, and normalizes floats), so every join match is
+    local after the hop; NULL keys route deterministically and never
+    match, preserving outer/semi/anti semantics per shard."""
+    from .. import config as C
+
+    n_fine = svc.n * session.conf.get(C.SHUFFLE_FINE_PARTITIONS)
+    target = session.conf.get(C.SHUFFLE_TARGET_PARTITION_BYTES)
+
+    # per side: local run -> key hash -> fine bucketing -> host slices
+    sides = []
+    sizes: Dict[int, int] = {}
+    for subtree, exprs in (
+            (join.children[0], [l for l, _ in key_pairs]),
+            (join.children[1], [r for _, r in key_pairs])):
+        local = _run_local(session, subtree).to_host()
+        ectx = EvalContext(local, np)
+        h = ectx.broadcast(Hash64(*exprs).eval(ectx)).data
+        fine = (np.asarray(h).astype(np.uint64)
+                % np.uint64(n_fine)).astype(np.int32)
+        bucketed, off, cnt = partition_host_slices(np, local, fine, n_fine)
+        for p in range(n_fine):
+            if int(cnt[p]):
+                sizes[p] = sizes.get(p, 0) + wire.raw_nbytes(
+                    [slice_rows(bucketed, int(off[p]), int(cnt[p]))])
+        sides.append((bucketed, off, cnt))
+
+    # ONE coordination round covers both sides: the assignment must be
+    # shared or matching keys would land on different processes
+    svc.publish_sizes(f"{xid}-plan", sizes)
+    totals = svc.gather_sizes(f"{xid}-plan", n_fine)
+    bounds = svc.plan_reducers(totals, target)
+
+    shards: List[ColumnBatch] = []
+    for tag, (bucketed, off, cnt) in zip(("jL", "jR"), sides):
+        routed: Dict[int, List[ColumnBatch]] = {}
+        for g, (lo, hi) in enumerate(zip(bounds, bounds[1:])):
+            n_rows = int(cnt[lo:hi].sum())
+            if n_rows:
+                routed[g] = [slice_rows(bucketed, int(off[lo]), n_rows)]
+        received = _exchange_with_refetch(svc, f"{xid}-{tag}", routed)
+        received = [b for b in received
+                    if int(np.asarray(b.num_rows()))] or \
+            [_one_dead_row(bucketed)]
+        shards.append(union_all(received) if len(received) > 1
+                      else received[0])
+    return shards[0], shards[1]
+
+
 def crossproc_execute(session, optimized, svc: HostShuffleService
                       ) -> ColumnBatch:
     """Execute one optimized plan across processes through the host
@@ -395,28 +578,94 @@ def crossproc_execute(session, optimized, svc: HostShuffleService
         above.append(node)
         node = node.children[0]
 
-    fast = (isinstance(node, L.Aggregate) and bool(node.keys)
-            and not _has_global_ops(node.children[0])
-            and _joins_all_inner(node.children[0])
-            and _agg_strings_ok(node))
+    maybe_fast = (isinstance(node, L.Aggregate) and bool(node.keys)
+                  and not _has_global_ops(node.children[0])
+                  and _joins_maybe_safe(node.children[0])
+                  and _agg_strings_ok(node))
+
+    # shuffled-join candidate: the topmost join on the per-row spine
+    # (under a root Aggregate when one is present), with >= 1 equi key
+    join = None
+    key_pairs: List[Tuple] = []
+    if session.conf.get(C.CROSSPROC_SHUFFLED_JOIN):
+        from ..sql.joins import equi_join_keys
+        # search under a root Aggregate ONLY when its partials can merge
+        # across processes (keyed, mergeable buffers) — that is the sole
+        # finishing mode for a join below an aggregate; any other root
+        # must itself sit on the per-row spine
+        if isinstance(node, L.Aggregate):
+            spine = (node.children[0]
+                     if node.keys and _agg_strings_ok(node) else node)
+        else:
+            spine = node
+        join = _find_spine_join(spine)
+        if join is not None:
+            key_pairs = equi_join_keys(join)
+            if not key_pairs:
+                join = None                    # cross/theta: no hash keys
+
     leaf_cache: List[ColumnBatch] = []
-    if fast:
-        # one digest exchange proves the fast-path precondition: EXACTLY
-        # one partitioned leaf (the fact); all join sides beyond it are
-        # replicated, so local inner joins see every global match once.
-        # All-replicated (zero partitioned) must NOT take this path: every
-        # process would contribute identical partials and the merge would
-        # multiply results by the process count — the generic path's
-        # dedup gather computes that case correctly.
-        flags = _leaf_partition_flags(session, node.children[0], svc,
+    flags: Optional[List[bool]] = None
+    if maybe_fast or join is not None:
+        # one digest exchange classifies every leaf (partitioned vs
+        # replicated); both execution shapes key off it, and the generic
+        # fallback reuses the materialized batches
+        flags = _leaf_partition_flags(session, node, svc,
                                       f"{xid}-digest", leaf_cache)
-        fast = sum(flags) == 1
+
+    # fast-path precondition: EXACTLY one partitioned leaf (the fact);
+    # every join beyond it partition-safe given the replication flags
+    # (inner/cross always; left semi/anti when the build side is
+    # replicated).  All-replicated (zero partitioned) must NOT take this
+    # path: every process would contribute identical partials and the
+    # merge would multiply results by the process count — the generic
+    # path's dedup gather computes that case correctly.
+    fast = (maybe_fast and flags is not None and sum(flags) == 1
+            and _joins_partition_safe(node.children[0], flags))
+
+    # shuffled-join precondition: EACH side holds exactly one
+    # partitioned leaf and is itself partition-safe to run locally —
+    # the shape that previously forced the centralize-everything path
+    def _side_ok(side, base: int) -> bool:
+        n = _n_leaves(side)
+        return (sum(flags[base: base + n]) == 1
+                and not _has_global_ops(side)
+                and _joins_partition_safe(side, flags, base))
+
+    use_shuffled = (not fast and join is not None and flags is not None
+                    and _side_ok(join.children[0], 0)
+                    and _side_ok(join.children[1],
+                                 _n_leaves(join.children[0])))
 
     if fast:
+        svc.counters["fast_path_aggs"] += 1
         child_batch = _run_local(session, node.children[0])
         partial_node, partial = _partial_over(node, child_batch)
         mine = _route_exchange_merge(session, node, partial_node, partial,
                                      svc, xid)
+        full = _gather_all(svc, f"{xid}-gather", mine, dedup=False)
+    elif use_shuffled:
+        svc.counters["shuffled_joins"] += 1
+        left_shard, right_shard = _shuffled_join_shards(
+            session, join, key_pairs, svc, xid)
+        join2 = L.Join(L.LocalRelation(left_shard),
+                       L.LocalRelation(right_shard),
+                       join.how, join.on, join.using)
+        if (isinstance(node, L.Aggregate) and bool(node.keys)
+                and _agg_strings_ok(node)):
+            # keyed Aggregate above the join: merge via the existing
+            # partial→route→merge pipeline instead of gathering raw join
+            # output — each joined row crosses the DCN once (as state)
+            child2 = _replace_node(node.children[0], join, join2)
+            child_batch = _run_local(session, child2)
+            partial_node, partial = _partial_over(node, child_batch)
+            mine = _route_exchange_merge(session, node, partial_node,
+                                         partial, svc, f"{xid}-fin")
+        else:
+            # per-row spine above the join commutes with the shard
+            # union: run it per process, gather only the final rows
+            node_r = _replace_node(node, join, join2)
+            mine = compact(np, _run_local(session, node_r).to_host())
         full = _gather_all(svc, f"{xid}-gather", mine, dedup=False)
     else:
         # generic path: centralize partitioned leaves, then run the whole

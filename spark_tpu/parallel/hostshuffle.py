@@ -70,6 +70,8 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from ..columnar import ColumnBatch
 from .. import config as C
 from .. import wire
@@ -246,7 +248,22 @@ class HostShuffleService:
             "fetch_failures": 0, "refetches": 0,
             "blocks_written": 0, "blocks_read": 0,
             "bytes_written": 0, "bytes_raw": 0, "bytes_read": 0,
+            # data-plane accounting: produced = everything the map side
+            # handed to an exchange (own partition included); shipped =
+            # only what was published for OTHER processes, i.e. what
+            # actually crossed the DCN.  produced - shipped = the data
+            # the partitioning kept local.
+            "rows_produced": 0, "rows_shipped": 0, "bytes_own_raw": 0,
+            # manifest-driven reducer coordination (ExchangeCoordinator
+            # analog): fine partitions merged into an under-target
+            # neighbor, and reduce partitions flagged as skewed
+            "partitions_coalesced": 0, "partitions_skewed": 0,
+            # execution-shape counters bumped by crossproc_execute
+            "shuffled_joins": 0, "fast_path_aggs": 0,
         }
+        #: reduce-partition byte sizes of the most recent ``plan_reducers``
+        #: call (manifest-summed), feeding the skew gauges
+        self.last_partition_bytes: Optional[List[int]] = None
         #: wall-clock spent per data-plane stage (seconds, cumulative);
         #: encode/write accrue on the writer thread, decode/fetch on the
         #: reader pool — surfaced as gauges next to the byte counters
@@ -318,6 +335,8 @@ class HostShuffleService:
             self.counters["blocks_written"] += 1
             self.counters["bytes_written"] += len(buf)
             self.counters["bytes_raw"] += wire.raw_nbytes(batches)
+            self.counters["rows_shipped"] += sum(
+                int(b.capacity) for b in batches)
             self.timers["encode_s"] += t1 - t0
             self.timers["write_s"] += t2 - t1
 
@@ -404,6 +423,97 @@ class HostShuffleService:
             return man if isinstance(man, dict) else None
         except (OSError, json.JSONDecodeError):
             return None
+
+    # -- manifest-driven reducer coordination ---------------------------
+    #: a reduce partition this many times the median is flagged skewed
+    #: (spark.sql.adaptive.skewJoin.skewedPartitionFactor's default role)
+    SKEW_FACTOR = 5.0
+
+    def publish_sizes(self, exchange: str, sizes: Dict[int, int]) -> None:
+        """Manifest-ONLY commit: publish this sender's per-fine-partition
+        byte counts with no data blocks (the MapOutputStatistics half of
+        the ExchangeCoordinator protocol).  The map output itself stays
+        in host memory until ``plan_reducers`` fixes the assignment, so
+        rows destined for this process never touch the filesystem —
+        unlike the reference, whose executors must spill map output to
+        local disk before statistics exist."""
+        if os.path.exists(self._done(exchange, self.pid)):
+            raise ValueError(
+                f"host shuffle exchange id {exchange!r} was already used "
+                "by this process; ids are single-use (stale commit "
+                "markers would unblock the barrier early)")
+        os.makedirs(self._dir(exchange), exist_ok=True)
+        path = self._done(exchange, self.pid)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"ts": time.time(),
+                       "host": self.host_name(self.pid),
+                       "blocks": {},
+                       "partitions": {str(p): int(sz)
+                                      for p, sz in sizes.items()}}, f)
+        os.replace(tmp, path)
+
+    def gather_sizes(self, exchange: str, n_partitions: int) -> np.ndarray:
+        """Barrier on the size manifests, then sum every sender's
+        per-fine-partition byte counts.  Every process reads the same
+        manifest set, so every process computes the SAME totals — the
+        property that lets ``plan_reducers`` run decentralized instead
+        of on a driver.  Excluded (blacklisted-dead) senders simply
+        contribute nothing; their data loss surfaces later on the data
+        exchange with the usual structured failure."""
+        self.barrier(exchange)
+        totals = np.zeros(n_partitions, np.int64)
+        for s in range(self.n):
+            man = self._read_manifest(exchange, s)
+            if man is None:
+                continue
+            for p, sz in man.get("partitions", {}).items():
+                if 0 <= int(p) < n_partitions:
+                    totals[int(p)] += int(sz)
+        return totals
+
+    def plan_reducers(self, sizes: np.ndarray,
+                      target_bytes: int) -> List[int]:
+        """Fine-partition → reducer assignment off the manifest totals
+        (the ExchangeCoordinator.doEstimationIfNecessary analog).
+
+        Returns contiguous group BOUNDS ``b`` of length n_groups+1
+        (``b[0]=0``, ``b[-1]=n_fine``); group ``g`` covers fine
+        partitions ``[b[g], b[g+1])`` and is owned by process ``g``,
+        with n_groups ≤ n_processes.  With a positive target, adjacent
+        fine partitions accumulate until the running total reaches the
+        target (tiny neighbors coalesce, counted); with target 0 the
+        split is static and even.  Deterministic in the inputs, so all
+        processes agree without communicating."""
+        sizes = np.asarray(sizes, np.int64)
+        n_fine = len(sizes)
+        if target_bytes <= 0:
+            bounds = sorted({round(g * n_fine / self.n)
+                             for g in range(self.n + 1)})
+            coalesced = 0
+        else:
+            bounds = [0]
+            acc = 0
+            coalesced = 0
+            for i in range(n_fine):
+                if i > bounds[-1]:           # current group is non-empty
+                    if acc >= target_bytes and len(bounds) < self.n:
+                        bounds.append(i)
+                        acc = 0
+                    elif acc < target_bytes:
+                        coalesced += 1       # i merges into a tiny group
+                acc += int(sizes[i])
+            bounds.append(n_fine)
+        group_bytes = [int(sizes[lo:hi].sum())
+                       for lo, hi in zip(bounds, bounds[1:])]
+        med = float(np.median(group_bytes)) if group_bytes else 0.0
+        skewed = sum(1 for b in group_bytes
+                     if med > 0 and b > self.SKEW_FACTOR * med)
+        with self._lock:
+            self.counters["partitions_coalesced"] += coalesced
+            self.counters["partitions_skewed"] += skewed
+            self.last_partition_bytes = group_bytes
+        return bounds
 
     # -- barrier + read side --------------------------------------------
     def barrier(self, exchange: str,
@@ -565,6 +675,13 @@ class HostShuffleService:
         t0 = self._clock()
         self.counters["exchanges"] += 1
         own = self._own(per_receiver)
+        with self._lock:
+            own_rows = sum(int(b.capacity) for b in own)
+            self.counters["rows_produced"] += own_rows + sum(
+                int(np.asarray(b.num_rows()))
+                for r, bs in per_receiver.items()
+                if r != self.pid for b in bs)
+            self.counters["bytes_own_raw"] += wire.raw_nbytes(own)
         for r, batches in per_receiver.items():
             if r != self.pid:      # own partition never touches the disk
                 self.put(exchange, r, batches)
@@ -602,6 +719,21 @@ class HostShuffleService:
         gauges["compression_ratio"] = lambda: round(
             self.counters["bytes_raw"]
             / max(1, self.counters["bytes_written"]), 3)
+        # shipped vs produced: bytes_raw is the raw volume that crossed
+        # the DCN, bytes_own_raw the volume the partitioning kept local
+        gauges["bytes_produced_raw"] = lambda: (
+            self.counters["bytes_raw"] + self.counters["bytes_own_raw"])
+        gauges["bytes_shipped_raw"] = lambda: self.counters["bytes_raw"]
+        gauges["ship_fraction"] = lambda: round(
+            self.counters["bytes_raw"]
+            / max(1, self.counters["bytes_raw"]
+                  + self.counters["bytes_own_raw"]), 3)
+        gauges["partition_bytes_max"] = lambda: (
+            max(self.last_partition_bytes)
+            if self.last_partition_bytes else 0)
+        gauges["partition_bytes_median"] = lambda: (
+            int(np.median(self.last_partition_bytes))
+            if self.last_partition_bytes else 0)
         gauges["blacklisted_peers"] = lambda: len(self.blacklist)
         gauges["blacklist"] = lambda: ",".join(
             self.host_name(p) for p in sorted(self.blacklist)) or ""

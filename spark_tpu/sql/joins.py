@@ -74,6 +74,22 @@ def split_equi_condition(
     return keys, residual
 
 
+def equi_join_keys(node: Join
+                   ) -> List[Tuple[Expression, Expression]]:
+    """Equi-key pairs of a LOGICAL join, oriented (left_expr, right_expr)
+    — the same extraction ``plan_join_raw`` performs, exposed for
+    planners that must decide PLACEMENT before planning (the
+    cross-process shuffled join hashes these on each side to
+    co-partition).  Empty when the join has no equi keys and therefore
+    cannot be hash-partitioned (cross / pure-theta joins)."""
+    if node.using:
+        return [(Col(n), Col(n)) for n in node.using]
+    keys, _residual = split_equi_condition(
+        node.on, set(node.left.schema().names),
+        set(node.right.schema().names))
+    return keys
+
+
 # second, independent mixing constants for match verification
 class _Hash64B(Hash64):
     @staticmethod

@@ -1,0 +1,169 @@
+"""Worker for the shuffled-hash-join parity and fault tests (not a test
+module itself — launched as a subprocess by test_shuffled_join.py and
+test_faults.py).
+
+argv: <process_id> <n_processes> <shuffle_root> <mode> [timeout_s]
+
+mode "parity": run a battery of equi-join plans (inner / left / semi,
+two partitioned leaves, with and without a keyed Aggregate above) twice
+— once with ``spark.tpu.crossproc.shuffledJoin`` on (the new
+co-partitioned path) and once with it off (the generic gather path) —
+and assert both match a full-data single-process oracle exactly.  Also
+asserts the shuffled path actually RAN (``shuffled_joins`` counter), the
+widened semi-join fast path ran (``fast_path_aggs``), and that manifest
+coalescing merged sub-target fine partitions (``partitions_coalesced``).
+
+mode "fault": arm a FaultInjector from SPARK_TPU_FAULT_PLAN and run ONE
+shuffled join.  Prints ``OK <rows>`` when the exchange healed (result
+must equal the oracle — never a partial join), or
+``FAILED <elapsed> <lost>`` on a structured, bounded failure.
+"""
+
+import os
+import sys
+import time
+
+pid = int(sys.argv[1])
+n = int(sys.argv[2])
+root = sys.argv[3]
+mode = sys.argv[4] if len(sys.argv) > 4 else "parity"
+timeout_s = float(sys.argv[5]) if len(sys.argv) > 5 else 45.0
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from spark_tpu import config as C  # noqa: E402
+from spark_tpu.parallel.faults import FAULT_PLAN_ENV, FaultInjector  # noqa: E402
+from spark_tpu.parallel.hostshuffle import ExchangeFetchFailed  # noqa: E402
+from spark_tpu.sql.session import SparkSession  # noqa: E402
+
+# Both processes draw the SAME full dataset and keep a strided 1/n slice,
+# so every process sees every key range (the worst case for a local join:
+# without co-partitioning almost every match is cross-process).
+rng = np.random.default_rng(7)
+N, M = 900, 600
+f_sk = rng.integers(0, 40, N).astype(np.int64)
+f_price = rng.integers(1, 200, N).astype(np.int64)
+f_g = np.array(["ash", "oak", "fir", "elm"])[f_sk % 4]
+k2 = (rng.integers(0, 20, M) * 2).astype(np.int64)   # even keys only →
+b2 = rng.integers(1, 100, M).astype(np.int64)        # LEFT join has misses
+g2 = np.array(["ash", "oak", "fir", "pine"])[k2 % 4]  # dicts only overlap
+d_sk = np.arange(0, 40, 3, dtype=np.int64)           # sparse dim for SEMI
+d_year = (1998 + d_sk % 5).astype(np.int64)
+
+mine = slice(pid, None, n)
+
+session = SparkSession.builder.appName(f"sjoin-{pid}").getOrCreate()
+
+xs = session.newSession()
+xs.conf.set(C.MESH_SHARDS.key, "1")
+svc = xs.enableHostShuffle(root, process_id=pid, n_processes=n,
+                           timeout_s=timeout_s)
+# small advisory target: the test tables are tiny, and with the 4 MiB
+# default every fine partition would coalesce onto process 0 — a few KiB
+# keeps BOTH processes joining while still exercising the coalescer
+xs.conf.set(C.SHUFFLE_TARGET_PARTITION_BYTES.key, "2048")
+xs.createDataFrame({"sk": f_sk[mine], "price": f_price[mine],
+                    "g": f_g[mine]}).createOrReplaceTempView("fact")
+xs.createDataFrame({"k2": k2[mine], "bonus": b2[mine],
+                    "g2": g2[mine]}).createOrReplaceTempView("fact2")
+# dim is REPLICATED: every process holds the identical full table
+xs.createDataFrame({"d_sk": d_sk, "year": d_year}) \
+    .createOrReplaceTempView("dim")
+
+oracle = session.newSession()
+oracle.conf.set(C.MESH_SHARDS.key, "1")
+oracle.createDataFrame({"sk": f_sk, "price": f_price, "g": f_g}) \
+    .createOrReplaceTempView("fact")
+oracle.createDataFrame({"k2": k2, "bonus": b2, "g2": g2}) \
+    .createOrReplaceTempView("fact2")
+oracle.createDataFrame({"d_sk": d_sk, "year": d_year}) \
+    .createOrReplaceTempView("dim")
+
+# (name, sql, counter expected to increment on the distributed run)
+QUERIES = [
+    ("inner-agg",
+     "SELECT sk, count(*) AS c, sum(bonus) AS sb FROM fact "
+     "JOIN fact2 ON sk = k2 GROUP BY sk ORDER BY sk",
+     "shuffled_joins"),
+    ("inner-rows",
+     "SELECT sk, price, bonus FROM fact JOIN fact2 ON sk = k2 "
+     "WHERE bonus > 40 ORDER BY sk, price, bonus",
+     "shuffled_joins"),
+    ("left-agg",
+     "SELECT sk, count(bonus) AS cb, count(*) AS c FROM fact "
+     "LEFT JOIN fact2 ON sk = k2 GROUP BY sk ORDER BY sk",
+     "shuffled_joins"),
+    ("string-key-agg",
+     "SELECT g, count(*) AS c, sum(bonus) AS sb FROM fact "
+     "JOIN fact2 ON g = g2 GROUP BY g ORDER BY g",
+     "shuffled_joins"),
+    ("semi-rows",
+     "SELECT sk, price FROM fact LEFT SEMI JOIN fact2 ON sk = k2 "
+     "ORDER BY sk, price",
+     "shuffled_joins"),
+    # widened fast-path guard: LEFT SEMI against a REPLICATED build side
+    # under a keyed Aggregate stays on the single-exchange fast path
+    ("semi-replicated-fast",
+     "SELECT sk, count(*) AS c FROM fact LEFT SEMI JOIN dim ON sk = d_sk "
+     "GROUP BY sk ORDER BY sk",
+     "fast_path_aggs"),
+]
+
+
+def run(sess, sql):
+    return [tuple(r) for r in sess.sql(sql).collect()]
+
+
+if mode == "fault":
+    FaultInjector().attach(svc)        # plan comes from SPARK_TPU_FAULT_PLAN
+    name, sql, _ = QUERIES[0]
+    exp = run(oracle, sql)
+    t0 = time.time()
+    try:
+        got = run(xs, sql)
+    except (ExchangeFetchFailed, TimeoutError) as e:
+        lost = sorted(getattr(e, "lost_hosts", []) or [])
+        print(f"[p{pid}] FAILED {time.time() - t0:.2f} {lost}", flush=True)
+        os._exit(0)
+    assert svc.counters["shuffled_joins"] > 0, svc.counters
+    if got != exp:
+        print(f"[p{pid}] PARTIAL got={len(got)} exp={len(exp)}", flush=True)
+        os._exit(1)
+    print(f"[p{pid}] OK {len(got)}", flush=True)
+    os._exit(0)
+
+for name, sql, counter in QUERIES:
+    exp = run(oracle, sql)
+    before = dict(svc.counters)
+    got_shuffled = run(xs, sql)
+    assert svc.counters[counter] > before[counter], (
+        f"{name}: expected the {counter} path, counters {svc.counters}")
+    xs.conf.set(C.CROSSPROC_SHUFFLED_JOIN.key, "false")
+    before2 = dict(svc.counters)
+    got_gather = run(xs, sql)
+    xs.conf.set(C.CROSSPROC_SHUFFLED_JOIN.key, "true")
+    assert svc.counters["shuffled_joins"] == before2["shuffled_joins"], (
+        f"{name}: shuffled path ran with the flag off")
+    if got_shuffled != exp or got_gather != exp:
+        print(f"[p{pid}] PARITY-FAIL {name} shuffled={got_shuffled[:4]} "
+              f"gather={got_gather[:4]} exp={exp[:4]}", flush=True)
+        os._exit(1)
+    print(f"[p{pid}] PARITY-OK {name} ({len(exp)} rows)", flush=True)
+
+# manifest-driven coalescing: the battery above ships tiny fine
+# partitions, all far below targetPartitionBytes — the planner must have
+# merged them (and the merge demonstrably did not change any result)
+assert svc.counters["partitions_coalesced"] > 0, svc.counters
+# per-exchange data-plane accounting: produced >= shipped, and the
+# manifest-derived partition-size gauges are populated
+gauges = svc.metrics_source().snapshot()
+assert gauges["bytes_produced_raw"] >= gauges["bytes_shipped_raw"] > 0, gauges
+assert gauges["rows_produced"] >= gauges["rows_shipped"] > 0, gauges
+assert gauges["partition_bytes_max"] >= gauges["partition_bytes_median"], gauges
+print(f"[p{pid}] ALL-OK shuffled={svc.counters['shuffled_joins']} "
+      f"fast={svc.counters['fast_path_aggs']} "
+      f"coalesced={svc.counters['partitions_coalesced']}", flush=True)
+os._exit(0)

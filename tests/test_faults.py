@@ -393,3 +393,72 @@ def test_peer_killed_mid_exchange(tmp_path, commit_first):
         # within 2x the worker's configured deadline (8s), plus heartbeat
         # detection + process startup slack — and far from a hang
         assert elapsed < 2 * 8.0 + 10, elapsed
+
+
+# ---------------------------------------------------------------------------
+# the shuffled-join data exchange under faults: a join-side block lost
+# mid-exchange heals through the same retry/refetch machinery, or the
+# query fails structured and bounded — NEVER a partial join result
+# ---------------------------------------------------------------------------
+
+def _spawn_join_fault_worker(pid, root, plan, timeout_s):
+    """One process of the 2-process shuffled-join fault scenario; the
+    join data exchanges have deterministic ids (first query → exchanges
+    ``xq000001-jL`` / ``-jR``), so rules can target one side's blocks."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "shuffled_join_worker.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(FAULT_PLAN_ENV, None)
+    if plan is not None:
+        env[FAULT_PLAN_ENV] = plan.to_env()
+    return subprocess.Popen(
+        [sys.executable, worker, str(pid), "2", root, "fault",
+         str(timeout_s)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+
+
+def test_join_side_block_dropped_then_heals(tmp_path):
+    """p1's LEFT-side block for p0 vanishes right after the put
+    (list-after-write lag) and reappears 1s later — past the inline
+    retry window, inside the refetch re-barrier.  The exchange heals and
+    BOTH processes report the oracle-exact join (the worker itself
+    asserts result == full-data oracle before printing OK)."""
+    plan = FaultPlan().drop(exchange="xq000001-jL", receiver=0,
+                            heal_after_s=1.0)
+    root = str(tmp_path / "shuf")
+    p0 = _spawn_join_fault_worker(0, root, None, 15.0)
+    p1 = _spawn_join_fault_worker(1, root, plan, 15.0)
+    out0 = p0.communicate(timeout=120)[0]
+    out1 = p1.communicate(timeout=120)[0]
+    assert p0.returncode == 0, out0
+    assert p1.returncode == 0, out1
+    assert "[p0] OK " in out0, out0
+    assert "[p1] OK " in out1, out1
+    assert "PARTIAL" not in out0 + out1
+
+
+def test_join_side_block_corrupted_fails_bounded(tmp_path):
+    """Size-preserving corruption of a join-side block with no heal: the
+    wire checksum catches it on every re-read, the victim fails with a
+    structured ``ExchangeFetchFailed`` naming the corrupting host, and
+    its peer times out at the next barrier — bounded, and neither
+    process ever emits a (partial) result."""
+    plan = FaultPlan().corrupt(exchange="xq000001-jL", receiver=0)
+    root = str(tmp_path / "shuf")
+    t0 = time.monotonic()
+    p0 = _spawn_join_fault_worker(0, root, None, 6.0)
+    p1 = _spawn_join_fault_worker(1, root, plan, 6.0)
+    out0 = p0.communicate(timeout=120)[0]
+    out1 = p1.communicate(timeout=120)[0]
+    elapsed = time.monotonic() - t0
+    assert p0.returncode == 0, out0
+    assert p1.returncode == 0, out1
+    line0 = [ln for ln in out0.splitlines() if "[p0]" in ln][-1]
+    assert "FAILED" in line0 and "host-1" in line0, out0
+    assert "FAILED" in out1, out1
+    assert "OK" not in out0 and "OK" not in out1
+    assert "PARTIAL" not in out0 + out1
+    # exchange deadline 6s: victim fails ≤ 2x (exchange + refetch), the
+    # peer's follow-up barrier adds ≤ 1x more, plus jit/startup slack
+    assert elapsed < 3 * 6.0 + 30, elapsed

@@ -1,0 +1,169 @@
+"""Partitioned shuffled hash join over the DCN exchange (tentpole).
+
+Two layers:
+
+- unit tests (single process, service-level): the manifest-only size
+  exchange, the deterministic coalescing reducer planner
+  (ExchangeCoordinator analog), the equi-key extractor, and the
+  single-process degenerate case (flag on, nothing partitioned → the
+  generic path, results unchanged);
+- subprocess parity harness (2 and 3 REAL processes,
+  ``shuffled_join_worker.py``): randomized-but-seeded plans — inner /
+  left / semi joins of two partitioned leaves, with and without a keyed
+  Aggregate above — run through the shuffled path AND the forced gather
+  path, both byte-identical to a full-data single-process oracle; the
+  workers also assert the path counters (``shuffled_joins``,
+  ``fast_path_aggs``) and that coalescing merged sub-target fine
+  partitions without changing any result.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_tpu import config as C
+from spark_tpu.parallel.hostshuffle import HostShuffleService
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "shuffled_join_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# reducer planning: deterministic coalescing from manifest byte counts
+# ---------------------------------------------------------------------------
+
+def _svc(tmp_path, pid=0, n=2, **kw):
+    kw.setdefault("timeout_s", 5.0)
+    kw.setdefault("poll_s", 0.02)
+    return HostShuffleService(str(tmp_path), pid, n, **kw)
+
+
+def test_plan_reducers_static_when_target_zero(tmp_path):
+    svc = _svc(tmp_path)
+    bounds = svc.plan_reducers(np.array([5] * 16, np.int64), 0)
+    assert bounds == [0, 8, 16]
+    assert svc.counters["partitions_coalesced"] == 0
+
+
+def test_plan_reducers_coalesces_tiny_partitions(tmp_path):
+    svc = _svc(tmp_path)
+    sizes = np.array([10, 10, 10, 10, 500, 10, 10, 10], np.int64)
+    bounds = svc.plan_reducers(sizes, 100)
+    assert bounds[0] == 0 and bounds[-1] == len(sizes)
+    assert len(bounds) - 1 <= svc.n                # never more groups than procs
+    assert svc.counters["partitions_coalesced"] > 0
+    # group bytes land in the skew gauge inputs
+    assert sum(svc.last_partition_bytes) == int(sizes.sum())
+
+
+def test_plan_reducers_flags_skewed_groups(tmp_path):
+    svc = _svc(tmp_path, n=4)
+    # one hot key range, three near-empty ones → the hot group exceeds
+    # SKEW_FACTOR x median and must be flagged (not silently absorbed)
+    sizes = np.array([1, 1, 1, 100000, 1, 1, 1, 1], np.int64)
+    svc.plan_reducers(sizes, 2)
+    assert svc.counters["partitions_skewed"] >= 1
+
+
+def test_plan_reducers_deterministic_across_processes(tmp_path):
+    sizes = np.array([37, 0, 12, 900, 4, 4, 4, 250, 0, 66], np.int64)
+    b0 = _svc(tmp_path / "a", pid=0).plan_reducers(sizes, 200)
+    b1 = _svc(tmp_path / "b", pid=1).plan_reducers(sizes, 200)
+    assert b0 == b1                      # no driver: same inputs, same plan
+
+
+def test_publish_and_gather_sizes_roundtrip(tmp_path):
+    svc0, svc1 = _svc(tmp_path, 0), _svc(tmp_path, 1)
+    svc0.publish_sizes("e", {0: 100, 2: 50})
+    svc1.publish_sizes("e", {0: 11, 3: 7})
+    t0 = svc0.gather_sizes("e", 4)
+    t1 = svc1.gather_sizes("e", 4)
+    assert t0.tolist() == t1.tolist() == [111, 0, 50, 7]
+
+
+def test_publish_sizes_is_single_use(tmp_path):
+    svc = _svc(tmp_path)
+    svc.publish_sizes("e", {0: 1})
+    with pytest.raises(ValueError):
+        svc.publish_sizes("e", {0: 1})
+
+
+# ---------------------------------------------------------------------------
+# equi-key extraction mirrors the join planner
+# ---------------------------------------------------------------------------
+
+def test_equi_join_keys_using_and_condition(spark):
+    from spark_tpu.sql import logical as L
+    from spark_tpu.sql.joins import equi_join_keys
+
+    a = spark.createDataFrame({"k": np.arange(4), "v": np.arange(4)})
+    b = spark.createDataFrame({"k2": np.arange(4), "w": np.arange(4)})
+    # explicit equi condition → one (left, right) pair
+    j = a.join(b, on=a["k"] == b["k2"])._plan
+    assert len(equi_join_keys(j)) == 1
+    # USING column → Col(name) on both sides
+    c = spark.createDataFrame({"k": np.arange(4), "w": np.arange(4)})
+    j2 = a.join(c, on="k")._plan
+    [(l2, r2)] = equi_join_keys(j2)
+    assert isinstance(j2, L.Join) and l2.name == r2.name == "k"
+    # cross join: no hash keys → empty (shuffled path must decline)
+    j3 = a.crossJoin(b)._plan
+    assert equi_join_keys(j3) == []
+
+
+def test_shuffled_join_flag_is_safe_single_process(spark, tmp_path):
+    """n=1: every leaf is trivially 'replicated', so the flag must leave
+    results unchanged (generic path) rather than shuffling with itself."""
+    prev = getattr(spark, "_crossproc_svc", None)
+    ms = spark.metricsSystem
+    try:
+        svc = spark.enableHostShuffle(str(tmp_path), process_id=0,
+                                      n_processes=1, timeout_s=5.0)
+        spark.createDataFrame(
+            {"k": np.arange(8) % 3, "v": np.arange(8)}
+        ).createOrReplaceTempView("ta")
+        spark.createDataFrame(
+            {"k2": np.arange(6) % 3, "w": np.arange(6) * 10}
+        ).createOrReplaceTempView("tb")
+        got = [tuple(r) for r in spark.sql(
+            "SELECT k, count(*) AS c, sum(w) AS s FROM ta "
+            "JOIN tb ON k = k2 GROUP BY k ORDER BY k").collect()]
+        assert got == [(0, 6, 90), (1, 6, 150), (2, 4, 140)]
+        assert svc.counters["shuffled_joins"] == 0
+    finally:
+        spark._crossproc_svc = prev
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]
+
+
+# ---------------------------------------------------------------------------
+# the real thing: parity across REAL processes, shuffled vs gather vs oracle
+# ---------------------------------------------------------------------------
+
+def _run_parity(tmp_path, n, timeout_s=90.0):
+    root = str(tmp_path / "shuf")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("SPARK_TPU_FAULT_PLAN", None)
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(pid), str(n), root, "parity",
+         str(timeout_s)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(n)]
+    outs = [p.communicate(timeout=420)[0] for p in procs]
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid}:\n{out}"
+        assert f"[p{pid}] ALL-OK" in out, out
+        # the battery covered both new paths and the coalescer fired
+        assert "shuffled=5" in out and "fast=2" in out, out
+    return outs
+
+
+def test_parity_two_processes(tmp_path):
+    _run_parity(tmp_path, 2)
+
+
+@pytest.mark.slow
+def test_parity_three_processes(tmp_path):
+    _run_parity(tmp_path, 3)
