@@ -384,6 +384,35 @@ CROSSPROC_SHUFFLED_JOIN = conf("spark.tpu.crossproc.shuffledJoin").doc(
     "O(total-data x processes) gather).  Off = always gather."
 ).boolean(True)
 
+CROSSPROC_SORT_MERGE_JOIN = conf("spark.tpu.crossproc.sortMergeJoin").doc(
+    "Cross-process range-partitioned sort-merge join (SortMergeJoinExec "
+    "analog): eligible equi-joins sample their join keys, agree on "
+    "global cut points through a manifest-only sample round, exchange "
+    "rows by key RANGE instead of key hash, and join each contiguous "
+    "key span locally as a streaming sorted merge.  Spans whose sampled "
+    "weight exceeds SKEW_FACTOR x median are split across several "
+    "reducers with the build side replicated only for that span.  "
+    "Requires a single orderable (non-string) equi key; other joins "
+    "fall back to the shuffled hash path.  Off = hash or gather."
+).boolean(True)
+
+CROSSPROC_AUTO_BROADCAST = conf(
+    "spark.tpu.crossproc.autoBroadcastThreshold").doc(
+    "Cross-process broadcast join threshold in bytes "
+    "(spark.sql.autoBroadcastJoinThreshold analog for the DCN layer): "
+    "when the digest probe shows one partitioned join side's global "
+    "size at or below this AND much smaller than the other side's "
+    "per-process share, every process gathers just that side and joins "
+    "locally, skipping the co-partitioning exchange entirely.  "
+    "0 = never broadcast."
+).check(lambda v: v >= 0).int(1 << 20)
+
+SHUFFLE_RANGE_SAMPLE_SIZE = conf("spark.tpu.shuffle.rangeSampleSize").doc(
+    "Per-process, per-side number of join-key sample points published "
+    "in the range-partitioning sample round.  Larger = tighter cut "
+    "points and better balance, linearly larger sample manifests."
+).check(lambda v: v >= 8).int(256)
+
 SHUFFLE_TARGET_PARTITION_BYTES = conf(
     "spark.tpu.shuffle.targetPartitionBytes").doc(
     "Advisory reduce-partition size for cross-process shuffles "

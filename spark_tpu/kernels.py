@@ -160,8 +160,25 @@ def sort_batch(xp, batch: ColumnBatch,
     return take_batch(xp, batch, perm)
 
 
+def range_bucket(xp, keys: Array, cuts: Array) -> Array:
+    """Map orderable int64 join keys to contiguous span ids by binary
+    search against shared cut points (RangePartitioner.getPartition
+    analog, jittable).
+
+    ``cuts`` are the ``n_spans - 1`` strictly-increasing EXCLUSIVE upper
+    bounds every process derived identically from the sample round: span
+    id = number of cut points ≤ the key (``side="right"``), so every
+    duplicate of a value — hot keys included — lands in ONE span on
+    every process.  Composes with ``partition_bucket``: the returned
+    int32 span ids are that kernel's ``part_ids``.
+    """
+    return searchsorted(xp, cuts, keys, side="right").astype(np.int32)
+
+
 def partition_bucket(xp, batch: ColumnBatch, part_ids: Array,
-                     n_parts: int) -> Tuple[ColumnBatch, Array, Array]:
+                     n_parts: int,
+                     tie_keys: Optional[Sequence[Array]] = None,
+                     ) -> Tuple[ColumnBatch, Array, Array]:
     """Bucket rows by partition id in ONE device sort (the exchange-side
     replacement for per-receiver host mask/compact passes).
 
@@ -171,13 +188,17 @@ def partition_bucket(xp, batch: ColumnBatch, part_ids: Array,
     Returns ``(bucketed, offsets, counts)``: partition ``p``'s rows are
     ``bucketed[offsets[p] : offsets[p] + counts[p]]``, so the sender
     does one compacted D2H transfer and slices per-receiver host VIEWS
-    out of it — padding never crosses DCN.  Jittable on the jnp path
-    (``n_parts`` static); numpy path is the host fallback.
+    out of it — padding never crosses DCN.  ``tie_keys`` appends extra
+    sort keys AFTER the partition id, ordering rows WITHIN each bucket
+    (the range exchange ships key-sorted runs this way — same single
+    sort, no extra pass).  Jittable on the jnp path (``n_parts``
+    static); numpy path is the host fallback.
     """
     live = batch.row_valid_or_true()
     pid = xp.where(live, xp.asarray(part_ids).astype(np.int32),
                    np.int32(n_parts))
-    perm = multi_key_argsort(xp, [pid], batch.capacity)
+    sort_keys = [pid] + [xp.asarray(k) for k in (tie_keys or [])]
+    perm = multi_key_argsort(xp, sort_keys, batch.capacity)
     bucketed = take_batch(xp, batch, perm)
     if _is_np(xp):
         counts = np.bincount(np.asarray(pid)[np.asarray(live)],
@@ -192,7 +213,8 @@ def partition_bucket(xp, batch: ColumnBatch, part_ids: Array,
 
 
 def partition_host_slices(xp, batch: ColumnBatch, part_ids: Array,
-                          n_parts: int
+                          n_parts: int,
+                          tie_keys: Optional[Sequence[Array]] = None,
                           ) -> Tuple[ColumnBatch, Array, Array]:
     """``partition_bucket`` + one D2H transfer + host offset/count arrays.
 
@@ -205,7 +227,7 @@ def partition_host_slices(xp, batch: ColumnBatch, part_ids: Array,
     a single receiver block without re-bucketing.
     """
     bucketed, offsets, counts = partition_bucket(xp, batch, part_ids,
-                                                 n_parts)
+                                                 n_parts, tie_keys)
     return (bucketed.to_host(), np.asarray(offsets), np.asarray(counts))
 
 

@@ -51,6 +51,20 @@ Execution shapes:
    contributes exactly its shard.  A keyed Aggregate above merges via
    the partial→route→merge pipeline, so each joined row crosses the
    DCN once.  Gated by ``spark.tpu.crossproc.shuffledJoin``.
+2b. range-partitioned sort-merge join — same placement shape, but an
+   equi-join over ONE orderable (non-string) key exchanges by key RANGE:
+   a manifest-only sample round derives identical cut points everywhere,
+   rows ship as per-span SORTED RUNS, the receiver k-way-merges its
+   build runs and joins with ``PMergeJoin`` (no per-process build sort),
+   and spans above ``SKEW_FACTOR × median`` split across reducers with
+   the build span replicated — skew mitigation, not just a gauge.
+   Gated by ``spark.tpu.crossproc.sortMergeJoin``; preferred over the
+   hash exchange when eligible.
+2c. broadcast join — when the digest probe shows one side's global
+   volume under ``spark.tpu.crossproc.autoBroadcastThreshold`` AND under
+   the other side's per-process share, only that side gathers (its
+   partitioned leaf unions across processes) and the exchange is
+   skipped entirely; the big side never moves.
 3. generic path — everything else (window/distinct/limit/sample,
    non-equi joins of partitioned tables, string min/max aggs):
    partitioned leaves gather through the service first, then the full
@@ -71,7 +85,8 @@ import numpy as np
 from ..columnar import ColumnBatch, ColumnVector
 from ..expressions import Col, EvalContext, Hash64
 from ..kernels import (
-    compact, partition_host_slices, slice_rows, union_all,
+    compact, partition_host_slices, range_bucket, slice_rows, take_batch,
+    union_all,
 )
 from ..sql import physical as P
 from .. import wire
@@ -398,12 +413,17 @@ def _leaf_batches(session, node, out: List[ColumnBatch]) -> None:
 
 def _leaf_partition_flags(session, node, svc: HostShuffleService,
                           xid: str,
-                          batches_out: Optional[List[ColumnBatch]] = None
+                          batches_out: Optional[List[ColumnBatch]] = None,
+                          sizes_out: Optional[List[int]] = None
                           ) -> List[bool]:
     """One digest exchange classifying every leaf: True = partitioned
     (content differs across processes), False = replicated.  The
     materialized leaf batches land in ``batches_out`` so a follow-up
-    gather never re-reads them from disk."""
+    gather never re-reads them from disk.  The probe also carries each
+    leaf's raw byte size, so every process learns every leaf's GLOBAL
+    volume (partitioned: summed across processes; replicated: one copy)
+    — the statistics the broadcast-threshold planner reads;
+    ``sizes_out`` receives them per leaf."""
     batches: List[ColumnBatch] = []
     _leaf_batches(session, node, batches)
     if batches_out is not None:
@@ -412,17 +432,30 @@ def _leaf_partition_flags(session, node, svc: HostShuffleService,
         return []
     from .. import types as T
     digests = np.array([_batch_digest(b) for b in batches], np.int64)
+    nbytes = np.array([wire.raw_nbytes([b]) for b in batches], np.int64)
     probe = ColumnBatch(
-        ["leaf", "digest"],
+        ["leaf", "digest", "bytes"],
         [ColumnVector(np.arange(len(digests), dtype=np.int64), T.int64,
                       None, None),
-         ColumnVector(digests, T.int64, None, None)],
+         ColumnVector(digests, T.int64, None, None),
+         ColumnVector(nbytes, T.int64, None, None)],
         None, len(digests))
     received = svc.exchange(xid, {r: [probe] for r in range(svc.n)})
     flags = np.zeros(len(digests), bool)
+    totals = np.zeros(len(digests), np.int64)
+    n_seen = 0
     for b in received:
-        other = np.asarray(b.to_host().column("digest").data)
+        host = b.to_host()
+        other = np.asarray(host.column("digest").data)
         flags |= other[: len(digests)] != digests
+        totals += np.asarray(host.column("bytes").data)[: len(digests)]
+        n_seen += 1
+    if sizes_out is not None:
+        # replicated leaves contributed one identical size per process
+        sizes_out.extend(
+            int(totals[i]) if flags[i]
+            else int(totals[i]) // max(n_seen, 1)
+            for i in range(len(digests)))
     return flags.tolist()
 
 
@@ -559,6 +592,227 @@ def _shuffled_join_shards(session, join, key_pairs,
     return shards[0], shards[1]
 
 
+#: join types whose RIGHT side may be broadcast (gathered everywhere)
+#: while the left stays partitioned: each left row lives on exactly one
+#: process, so matches/null-extensions/existence emit exactly once
+#: globally.  Broadcasting the preserved side of an outer join would
+#: null-extend once PER PROCESS.
+_BCAST_RIGHT_OK = ("inner", "left", "left_semi", "left_anti")
+_BCAST_LEFT_OK = ("inner", "right")
+
+
+def choose_join_strategy(how: str, range_eligible: bool,
+                         sort_merge_enabled: bool, shuffled_enabled: bool,
+                         broadcast_threshold: int, n_procs: int,
+                         left_bytes: int, right_bytes: int) -> str:
+    """The cross-process equi-join strategy decision, as a PURE function
+    of the statistics (unit-testable without a cluster): one of
+    ``broadcast_left`` / ``broadcast_right`` / ``range`` / ``hash`` /
+    ``gather``.  Both sides are already known to hold exactly one
+    partitioned leaf each (``_side_ok``); the keyed-aggregate fast path
+    was ruled out upstream.
+
+    Broadcast wins first: when one side's GLOBAL volume fits under the
+    threshold AND under the other side's per-process share (the ROADMAP
+    guard — one gather of the small side beats co-partitioning only when
+    |small| << |large| / n), gathering it costs one exchange of the
+    small side instead of two exchanges of everything.  Then range
+    (sorted-merge + skew splitting) when the key is orderable, then the
+    hash exchange, then the centralize-everything gather."""
+    if broadcast_threshold > 0:
+        share = max(n_procs, 1)
+        cand = []
+        if how in _BCAST_RIGHT_OK and right_bytes <= broadcast_threshold \
+                and right_bytes <= left_bytes // share:
+            cand.append(("broadcast_right", right_bytes))
+        if how in _BCAST_LEFT_OK and left_bytes <= broadcast_threshold \
+                and left_bytes <= right_bytes // share:
+            cand.append(("broadcast_left", left_bytes))
+        if cand:
+            return min(cand, key=lambda c: c[1])[0]
+    if range_eligible and sort_merge_enabled:
+        return "range"
+    if shuffled_enabled:
+        return "hash"
+    return "gather"
+
+
+def _range_merge_join_shards(session, join, spec,
+                             svc: HostShuffleService, xid: str
+                             ) -> Tuple[ColumnBatch, ColumnBatch]:
+    """Co-partition BOTH join sides by key RANGE and deliver this
+    process's spans with the build side already globally sorted (the
+    SortMergeJoinExec + RangePartitioner protocol, DCN-shaped):
+
+    1. each side runs locally; join keys get the monotonic
+       process-independent int64 encoding (``range_encode_key`` — the
+       same normalization the local exact join searches on);
+    2. SAMPLE round (manifest-only, strict): every process publishes
+       evenly-spaced points of its sorted key sets with a per-point
+       weight; all processes read the same manifests in the same order
+       and derive IDENTICAL cut points from the weighted quantiles — no
+       driver, no data movement;
+    3. rows bucket into key spans (``range_bucket`` searchsorted) with a
+       (null_flag, key) tie sort, so every per-span host slice is a
+       SORTED RUN; a size round + ``plan_range_reducers`` assigns spans
+       to reducers, SPLITTING spans whose weight exceeds
+       ``SKEW_FACTOR × median`` — the probe side chops a split span into
+       contiguous sub-runs across k owners while the build span
+       replicates to all k (skew mitigation, not just a gauge);
+    4. data ships through the ordinary exchange (wire format, retry,
+       blacklist, refetch unchanged); the receiver k-way-merges its
+       build runs (``native/merge.merge_sorted_runs``) into one globally
+       key-sorted batch, which ``PMergeJoin`` consumes without re-sorting.
+
+    NULL/dead keys fold to the INT64_MIN sentinel → span 0 on every
+    process: probe-side nulls still reach a reducer (left/anti need the
+    rows), build-side nulls sink to each run's tail and stay inert."""
+    from .. import config as C
+    from ..sql.joins import range_encode_key
+    from ..native.merge import merge_sorted_runs
+
+    l_expr, r_expr, l_as_float, r_as_float = spec
+    n_fine = svc.n * session.conf.get(C.SHUFFLE_FINE_PARTITIONS)
+    target = session.conf.get(C.SHUFFLE_TARGET_PARTITION_BYTES)
+    sample_k = session.conf.get(C.SHUFFLE_RANGE_SAMPLE_SIZE)
+
+    # 1. local runs + monotonic key encodings
+    sides = []
+    for subtree, expr, as_f in ((join.children[0], l_expr, l_as_float),
+                                (join.children[1], r_expr, r_as_float)):
+        local = compact(np, _run_local(session, subtree).to_host())
+        ectx = EvalContext(local, np)
+        encoded = range_encode_key(ectx, expr, as_f)
+        if encoded is None:      # guarded by range_key_spec upstream
+            raise RuntimeError("range join key lost its orderable "
+                               "encoding between planning and execution")
+        enc, ok = encoded
+        sides.append((local, np.asarray(enc), np.asarray(ok)))
+
+    # 2. sample round: evenly-spaced points of each side's sorted keys,
+    # weighted by rows-per-point so quantiles track row mass
+    sample = {}
+    for tag, (_local, enc, ok) in zip(("l", "r"), sides):
+        keys = np.sort(enc[ok])
+        if len(keys):
+            idx = np.linspace(0, len(keys) - 1,
+                              num=min(sample_k, len(keys))).astype(np.int64)
+            pts = keys[idx]
+            sample[tag] = {"points": [int(x) for x in pts],
+                           "weight": len(keys) / len(pts)}
+        else:
+            sample[tag] = {"points": [], "weight": 0.0}
+    svc.publish_manifest(f"{xid}-sample", {"sample": sample})
+    mans, man_bytes = svc.gather_manifests(f"{xid}-sample", strict=True)
+    svc.counters["sample_bytes"] += man_bytes
+
+    # cut points: identical manifest set + sorted sender order + stable
+    # sort → every process derives the SAME cuts.  np.unique collapses a
+    # hot key's duplicate quantiles into ONE wide span (split below).
+    pts_all, wts_all = [], []
+    for s in sorted(mans):
+        for tag in ("l", "r"):
+            d = mans[s].get("sample", {}).get(tag, {})
+            if d.get("points"):
+                pts_all.append(np.asarray(d["points"], np.int64))
+                wts_all.append(np.full(len(d["points"]),
+                                       float(d.get("weight", 1.0))))
+    if pts_all:
+        pts = np.concatenate(pts_all)
+        wts = np.concatenate(wts_all)
+        order = np.argsort(pts, kind="stable")
+        pts, wts = pts[order], wts[order]
+        cum = np.cumsum(wts)
+        qs = np.asarray([cum[-1] * j / n_fine for j in range(1, n_fine)])
+        cut_idx = np.clip(np.searchsorted(cum, qs, side="left"),
+                          0, len(pts) - 1)
+        cuts = np.unique(pts[cut_idx])
+    else:
+        cuts = np.zeros(0, np.int64)
+    svc.last_range_cutpoints = [int(c) for c in cuts]
+    n_spans = len(cuts) + 1
+
+    # 3. span bucketing with (null_flag, key) tie sort → sorted runs;
+    # size round + skew-splitting reducer plan
+    bucketed_sides = []
+    sizes: Dict[int, int] = {}
+    for base, (local, enc, ok) in zip((0, n_spans), sides):
+        spans = range_bucket(np, enc, cuts)
+        flag = (~ok).astype(np.int8)
+        bucketed, off, cnt = partition_host_slices(
+            np, local, spans, n_spans, tie_keys=[flag, enc])
+        for p in range(n_spans):
+            if int(cnt[p]):
+                sizes[base + p] = sizes.get(base + p, 0) + wire.raw_nbytes(
+                    [slice_rows(bucketed, int(off[p]), int(cnt[p]))])
+        bucketed_sides.append((bucketed, off, cnt))
+    svc.publish_sizes(f"{xid}-plan", sizes)
+    totals = svc.gather_sizes(f"{xid}-plan", 2 * n_spans)
+    owners = svc.plan_range_reducers(totals[:n_spans], totals[n_spans:],
+                                     target)
+
+    # 4a. probe side: a split span's sorted slice chops into contiguous
+    # sub-runs, one per owner; build side: each span slice replicates to
+    # every owner of that span
+    def route(bucketed, off, cnt, is_build: bool):
+        routed: Dict[int, List[ColumnBatch]] = {}
+        for p in range(n_spans):
+            c, o = int(cnt[p]), int(off[p])
+            if not c:
+                continue
+            ps = owners[p]
+            if is_build or len(ps) == 1:
+                sl = slice_rows(bucketed, o, c)
+                for r in (ps if is_build else ps[:1]):
+                    routed.setdefault(r, []).append(sl)
+            else:
+                k = len(ps)
+                bnds = [o + (c * j) // k for j in range(k + 1)]
+                for j, r in enumerate(ps):
+                    if bnds[j + 1] > bnds[j]:
+                        routed.setdefault(r, []).append(
+                            slice_rows(bucketed, bnds[j],
+                                       bnds[j + 1] - bnds[j]))
+        return routed
+
+    probe_recv = _exchange_with_refetch(
+        svc, f"{xid}-rL", route(*bucketed_sides[0], is_build=False))
+    build_recv = _exchange_with_refetch(
+        svc, f"{xid}-rR", route(*bucketed_sides[1], is_build=True))
+
+    probe_runs = [b for b in probe_recv if int(np.asarray(b.num_rows()))]
+    probe_shard = (union_all(probe_runs) if len(probe_runs) > 1
+                   else probe_runs[0]) if probe_runs \
+        else _one_dead_row(bucketed_sides[0][0])
+
+    # 4b. k-way merge of the build runs: each received run is (flag,
+    # key)-sorted; split off every run's null tail, heap-merge the keyed
+    # prefixes, append the null tails — a batch globally sorted in the
+    # (flag, key) order PMergeJoin's identity-perm search expects
+    build_runs = [b for b in build_recv if int(np.asarray(b.num_rows()))]
+    if not build_runs:
+        build_shard = _one_dead_row(bucketed_sides[1][0])
+    else:
+        keyed, tails, run_keys = [], [], []
+        for b in build_runs:
+            ectx = EvalContext(b, np)
+            enc, ok = range_encode_key(ectx, r_expr, r_as_float)
+            n_ok = int(np.asarray(ok).sum())
+            if n_ok:
+                keyed.append(slice_rows(b, 0, n_ok))
+                run_keys.append(np.asarray(enc)[:n_ok])
+            if n_ok < b.capacity:
+                tails.append(slice_rows(b, n_ok, b.capacity - n_ok))
+        if keyed:
+            cat = union_all(keyed) if len(keyed) > 1 else keyed[0]
+            merged = take_batch(np, cat, merge_sorted_runs(run_keys))
+            parts = [merged] + tails
+        else:
+            parts = tails
+        build_shard = union_all(parts) if len(parts) > 1 else parts[0]
+    return probe_shard, build_shard
+
+
 def crossproc_execute(session, optimized, svc: HostShuffleService
                       ) -> ColumnBatch:
     """Execute one optimized plan across processes through the host
@@ -583,11 +837,14 @@ def crossproc_execute(session, optimized, svc: HostShuffleService
                   and _joins_maybe_safe(node.children[0])
                   and _agg_strings_ok(node))
 
-    # shuffled-join candidate: the topmost join on the per-row spine
+    # exchange-join candidate: the topmost join on the per-row spine
     # (under a root Aggregate when one is present), with >= 1 equi key
+    shuffled_on = session.conf.get(C.CROSSPROC_SHUFFLED_JOIN)
+    smj_on = session.conf.get(C.CROSSPROC_SORT_MERGE_JOIN)
+    bcast_threshold = session.conf.get(C.CROSSPROC_AUTO_BROADCAST)
     join = None
     key_pairs: List[Tuple] = []
-    if session.conf.get(C.CROSSPROC_SHUFFLED_JOIN):
+    if shuffled_on or smj_on or bcast_threshold > 0:
         from ..sql.joins import equi_join_keys
         # search under a root Aggregate ONLY when its partials can merge
         # across processes (keyed, mergeable buffers) — that is the sole
@@ -605,13 +862,16 @@ def crossproc_execute(session, optimized, svc: HostShuffleService
                 join = None                    # cross/theta: no hash keys
 
     leaf_cache: List[ColumnBatch] = []
+    leaf_sizes: List[int] = []
     flags: Optional[List[bool]] = None
     if maybe_fast or join is not None:
         # one digest exchange classifies every leaf (partitioned vs
-        # replicated); both execution shapes key off it, and the generic
+        # replicated) and carries per-leaf global byte sizes (broadcast
+        # statistics); the execution shapes key off it, and the generic
         # fallback reuses the materialized batches
         flags = _leaf_partition_flags(session, node, svc,
-                                      f"{xid}-digest", leaf_cache)
+                                      f"{xid}-digest", leaf_cache,
+                                      leaf_sizes)
 
     # fast-path precondition: EXACTLY one partitioned leaf (the fact);
     # every join beyond it partition-safe given the replication flags
@@ -632,10 +892,29 @@ def crossproc_execute(session, optimized, svc: HostShuffleService
                 and not _has_global_ops(side)
                 and _joins_partition_safe(side, flags, base))
 
-    use_shuffled = (not fast and join is not None and flags is not None
-                    and _side_ok(join.children[0], 0)
-                    and _side_ok(join.children[1],
-                                 _n_leaves(join.children[0])))
+    sides_ok = (not fast and join is not None and flags is not None
+                and _side_ok(join.children[0], 0)
+                and _side_ok(join.children[1],
+                             _n_leaves(join.children[0])))
+
+    # strategy decision off the digest-probe statistics (pure function
+    # of them — unit-tested directly).  Leaf bytes over-approximate each
+    # side's output (filters/projects run after), the conservative
+    # direction for the broadcast threshold.
+    strategy: Optional[str] = None
+    range_spec = None
+    if sides_ok:
+        from ..sql.joins import range_key_spec
+        range_spec = range_key_spec(join, join.children[0].schema(),
+                                    join.children[1].schema())
+        ln = _n_leaves(join.children[0])
+        rn = _n_leaves(join.children[1])
+        strategy = choose_join_strategy(
+            join.how, range_spec is not None, smj_on, shuffled_on,
+            bcast_threshold, svc.n,
+            sum(leaf_sizes[:ln]), sum(leaf_sizes[ln:ln + rn]))
+        if strategy == "gather":
+            strategy = None
 
     if fast:
         svc.counters["fast_path_aggs"] += 1
@@ -644,13 +923,38 @@ def crossproc_execute(session, optimized, svc: HostShuffleService
         mine = _route_exchange_merge(session, node, partial_node, partial,
                                      svc, xid)
         full = _gather_all(svc, f"{xid}-gather", mine, dedup=False)
-    elif use_shuffled:
-        svc.counters["shuffled_joins"] += 1
-        left_shard, right_shard = _shuffled_join_shards(
-            session, join, key_pairs, svc, xid)
-        join2 = L.Join(L.LocalRelation(left_shard),
-                       L.LocalRelation(right_shard),
-                       join.how, join.on, join.using)
+    elif strategy is not None:
+        if strategy in ("broadcast_left", "broadcast_right"):
+            # gather ONLY the small side: its partitioned leaf unions
+            # across processes (replicated leaves dedup), the big side
+            # stays put — one exchange of the small side replaces two
+            # exchanges of everything
+            svc.counters["broadcast_joins"] += 1
+            side_i = 0 if strategy == "broadcast_left" else 1
+            side = join.children[side_i]
+            base = 0 if side_i == 0 else _n_leaves(join.children[0])
+            nl = _n_leaves(side)
+            side2 = _gather_leaf_relations(
+                session, side, svc, xid, dedup=True,
+                preloaded=leaf_cache[base: base + nl] or None)
+            join2 = _replace_node(join, side, side2)
+        elif strategy == "range":
+            svc.counters["range_merge_joins"] += 1
+            left_shard, right_shard = _range_merge_join_shards(
+                session, join, range_spec, svc, xid)
+            join2 = L.Join(L.LocalRelation(left_shard),
+                           L.LocalRelation(right_shard),
+                           join.how, join.on, join.using)
+            # build arrives globally (flag, key)-sorted from the k-way
+            # merge → the planner picks PMergeJoin (no build re-sort)
+            join2._presorted_build = True
+        else:
+            svc.counters["shuffled_joins"] += 1
+            left_shard, right_shard = _shuffled_join_shards(
+                session, join, key_pairs, svc, xid)
+            join2 = L.Join(L.LocalRelation(left_shard),
+                           L.LocalRelation(right_shard),
+                           join.how, join.on, join.using)
         if (isinstance(node, L.Aggregate) and bool(node.keys)
                 and _agg_strings_ok(node)):
             # keyed Aggregate above the join: merge via the existing

@@ -188,6 +188,7 @@ class FaultInjector:
     # -- service wrapping ------------------------------------------------
     def attach(self, svc) -> "FaultInjector":
         orig_put, orig_commit = svc.put, svc.commit
+        orig_publish = getattr(svc, "publish_manifest", None)
         injector = self
 
         def put(exchange, receiver, batches):
@@ -221,6 +222,23 @@ class FaultInjector:
                     return                        # marker never written
             orig_commit(exchange)
 
+        def publish_manifest(exchange, payload=None):
+            n = orig_publish(exchange, payload)
+            # manifest-only rounds (sizes, range key samples) bypass
+            # put/commit, so rules perturb the just-written commit marker
+            # itself; only EXCHANGE-ADDRESSED rules apply — an
+            # any-exchange block rule must not silently retarget the
+            # coordination plane
+            path = svc._done(exchange, svc.pid)
+            for rule in injector.plan.rules:
+                if rule.kind in ("drop", "truncate", "corrupt", "delay") \
+                        and rule.exchange is not None \
+                        and rule.matches(exchange, None):
+                    injector._apply(rule, path, f"{exchange}/s{svc.pid}.done")
+            return n
+
         svc.put = put
         svc.commit = commit
+        if orig_publish is not None:
+            svc.publish_manifest = publish_manifest
         return self

@@ -258,12 +258,20 @@ class HostShuffleService:
             # analog): fine partitions merged into an under-target
             # neighbor, and reduce partitions flagged as skewed
             "partitions_coalesced": 0, "partitions_skewed": 0,
+            # range-partitioned merge join: skewed spans split across
+            # reducers, coordination-plane sample-round manifest bytes
+            "spans_split": 0, "sample_bytes": 0,
             # execution-shape counters bumped by crossproc_execute
             "shuffled_joins": 0, "fast_path_aggs": 0,
+            "range_merge_joins": 0, "broadcast_joins": 0,
         }
         #: reduce-partition byte sizes of the most recent ``plan_reducers``
-        #: call (manifest-summed), feeding the skew gauges
+        #: / ``plan_range_reducers`` call (manifest-summed), feeding the
+        #: skew gauges
         self.last_partition_bytes: Optional[List[int]] = None
+        #: cut points of the most recent range-partitioned exchange
+        #: (int64 orderable encodings), set by the crossproc planner
+        self.last_range_cutpoints: Optional[List[int]] = None
         #: wall-clock spent per data-plane stage (seconds, cumulative);
         #: encode/write accrue on the writer thread, decode/fetch on the
         #: reader pool — surfaced as gauges next to the byte counters
@@ -421,13 +429,89 @@ class HostShuffleService:
             with open(self._done(exchange, sender)) as f:
                 man = json.load(f)
             return man if isinstance(man, dict) else None
-        except (OSError, json.JSONDecodeError):
+        except (OSError, ValueError):
+            # ValueError covers both JSONDecodeError and the
+            # UnicodeDecodeError a bit-flipped marker byte produces
             return None
 
     # -- manifest-driven reducer coordination ---------------------------
     #: a reduce partition this many times the median is flagged skewed
     #: (spark.sql.adaptive.skewJoin.skewedPartitionFactor's default role)
     SKEW_FACTOR = 5.0
+
+    def publish_manifest(self, exchange: str,
+                         payload: Optional[dict] = None) -> int:
+        """Manifest-ONLY commit: publish this sender's commit marker
+        carrying an arbitrary JSON ``payload`` and no data blocks — the
+        generic coordination round under ``publish_sizes`` (size
+        statistics) and the range-join key-sample round.  Single-use
+        like every exchange id.  Returns the marker's byte size, the
+        coordination-plane volume (``sample_bytes`` gauge)."""
+        if os.path.exists(self._done(exchange, self.pid)):
+            raise ValueError(
+                f"host shuffle exchange id {exchange!r} was already used "
+                "by this process; ids are single-use (stale commit "
+                "markers would unblock the barrier early)")
+        os.makedirs(self._dir(exchange), exist_ok=True)
+        doc = {"ts": time.time(), "host": self.host_name(self.pid),
+               "blocks": {}}
+        doc.update(payload or {})
+        buf = json.dumps(doc)
+        path = self._done(exchange, self.pid)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(buf)
+        os.replace(tmp, path)
+        return len(buf)
+
+    def gather_manifests(self, exchange: str, strict: bool = False
+                         ) -> Tuple[Dict[int, dict], int]:
+        """Barrier on the commit markers, then read every sender's
+        manifest.  Returns ``(sender → manifest, total manifest bytes)``;
+        excluded (blacklisted-dead) senders contribute nothing.
+
+        ``strict=True`` is the coordination-round contract of the range
+        sample exchange: a non-excluded sender whose marker exists but
+        will not parse (torn/corrupted write) is re-read until the
+        exchange deadline, then fails STRUCTURED with
+        ``ExchangeFetchFailed`` — silently skipping it would let
+        processes derive DIFFERENT cut points from asymmetric reads and
+        desynchronize the data exchange that follows.  ``strict=False``
+        keeps the lenient size-round behavior: a lost manifest only
+        loses its statistics."""
+        t0 = self._clock()
+        deadline = t0 + self.timeout_s
+        excluded = set(self.barrier(exchange, deadline=deadline))
+        out: Dict[int, dict] = {}
+        nbytes = 0
+        pending = [s for s in range(self.n) if s not in excluded]
+        while True:
+            still: List[int] = []
+            for s in pending:
+                man = self._read_manifest(exchange, s)
+                if man is None:
+                    still.append(s)
+                    continue
+                out[s] = man
+                try:
+                    nbytes += os.path.getsize(self._done(exchange, s))
+                except OSError:
+                    pass
+            if not still or not strict:
+                break
+            if self._clock() >= deadline:
+                self.counters["fetch_failures"] += 1
+                raise ExchangeFetchFailed(
+                    exchange,
+                    [self.host_name(s) for s in still],
+                    [os.path.basename(self._done(exchange, s))
+                     for s in still],
+                    elapsed_s=self._clock() - t0,
+                    detail="unreadable commit manifests on a "
+                           "coordination round")
+            self._sleep(self.poll_s)
+            pending = still
+        return out, nbytes
 
     def publish_sizes(self, exchange: str, sizes: Dict[int, int]) -> None:
         """Manifest-ONLY commit: publish this sender's per-fine-partition
@@ -437,21 +521,8 @@ class HostShuffleService:
         rows destined for this process never touch the filesystem —
         unlike the reference, whose executors must spill map output to
         local disk before statistics exist."""
-        if os.path.exists(self._done(exchange, self.pid)):
-            raise ValueError(
-                f"host shuffle exchange id {exchange!r} was already used "
-                "by this process; ids are single-use (stale commit "
-                "markers would unblock the barrier early)")
-        os.makedirs(self._dir(exchange), exist_ok=True)
-        path = self._done(exchange, self.pid)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump({"ts": time.time(),
-                       "host": self.host_name(self.pid),
-                       "blocks": {},
-                       "partitions": {str(p): int(sz)
-                                      for p, sz in sizes.items()}}, f)
-        os.replace(tmp, path)
+        self.publish_manifest(exchange, {
+            "partitions": {str(p): int(sz) for p, sz in sizes.items()}})
 
     def gather_sizes(self, exchange: str, n_partitions: int) -> np.ndarray:
         """Barrier on the size manifests, then sum every sender's
@@ -461,12 +532,9 @@ class HostShuffleService:
         of on a driver.  Excluded (blacklisted-dead) senders simply
         contribute nothing; their data loss surfaces later on the data
         exchange with the usual structured failure."""
-        self.barrier(exchange)
+        mans, _nbytes = self.gather_manifests(exchange)
         totals = np.zeros(n_partitions, np.int64)
-        for s in range(self.n):
-            man = self._read_manifest(exchange, s)
-            if man is None:
-                continue
+        for man in mans.values():
             for p, sz in man.get("partitions", {}).items():
                 if 0 <= int(p) < n_partitions:
                     totals[int(p)] += int(sz)
@@ -514,6 +582,84 @@ class HostShuffleService:
             self.counters["partitions_skewed"] += skewed
             self.last_partition_bytes = group_bytes
         return bounds
+
+    def plan_range_reducers(self, probe_sizes: np.ndarray,
+                            build_sizes: np.ndarray,
+                            target_bytes: int) -> List[List[int]]:
+        """Key-span → reducer assignment for the RANGE exchange, with
+        skew-span SPLITTING (the OptimizeSkewedJoin mitigation the hash
+        path can only flag).
+
+        Returns ``owners``: for each span, the process ids that reduce
+        it.  A normal span has one owner; a span whose sampled weight
+        exceeds ``SKEW_FACTOR × median`` is split across
+        ``k = min(n, ceil(total / target))`` owners — the PROBE side's
+        rows round-robin over them while the BUILD side is replicated to
+        all k (correct for inner/left/semi/anti: every probe row still
+        sees the complete build span exactly once).  Non-split spans
+        coalesce greedily into contiguous under-target runs, and runs /
+        split shares go to the least-loaded process in span order —
+        deterministic in the inputs, so every process derives the same
+        assignment without a driver."""
+        probe = np.asarray(probe_sizes, np.int64)
+        build = np.asarray(build_sizes, np.int64)
+        totals = probe + build
+        n_spans = len(totals)
+        pos = totals[totals > 0]
+        med = float(np.median(pos)) if len(pos) else 0.0
+        split_target = float(target_bytes) if target_bytes > 0 \
+            else max(med, 1.0)
+        split_set = {s for s in range(n_spans)
+                     if med > 0 and totals[s] > self.SKEW_FACTOR * med}
+
+        # span-order work list: contiguous coalesced runs + split spans
+        work: List[Tuple[str, List[int]]] = []
+        cur: List[int] = []
+        acc = 0
+        coalesced = 0
+        for s in range(n_spans):
+            if s in split_set:
+                if cur:
+                    work.append(("run", cur))
+                    cur, acc = [], 0
+                work.append(("split", [s]))
+                continue
+            if cur and (target_bytes <= 0 or acc >= target_bytes):
+                work.append(("run", cur))
+                cur, acc = [], 0
+            elif cur:
+                coalesced += 1
+            cur.append(s)
+            acc += int(totals[s])
+        if cur:
+            work.append(("run", cur))
+
+        owners: List[List[int]] = [[] for _ in range(n_spans)]
+        loads = [0] * self.n
+
+        def least_loaded(k: int) -> List[int]:
+            return sorted(range(self.n), key=lambda p: (loads[p], p))[:k]
+
+        for kind, spans in work:
+            if kind == "run":
+                p = least_loaded(1)[0]
+                for s in spans:
+                    owners[s] = [p]
+                loads[p] += int(sum(int(totals[s]) for s in spans))
+            else:
+                s = spans[0]
+                k = int(min(self.n, max(
+                    2, int(np.ceil(float(totals[s]) / split_target)))))
+                ps = least_loaded(k)
+                owners[s] = ps
+                for p in ps:                 # probe split + build replica
+                    loads[p] += int(probe[s]) // k + int(build[s])
+        reducer_bytes = [b for b in loads if b > 0]
+        with self._lock:
+            self.counters["partitions_coalesced"] += coalesced
+            self.counters["spans_split"] += len(split_set)
+            self.last_partition_bytes = reducer_bytes or None
+        return owners
 
     # -- barrier + read side --------------------------------------------
     def barrier(self, exchange: str,
@@ -734,6 +880,11 @@ class HostShuffleService:
         gauges["partition_bytes_median"] = lambda: (
             int(np.median(self.last_partition_bytes))
             if self.last_partition_bytes else 0)
+        # range exchange coordination plane: how many cut points the last
+        # sample round agreed on (n_spans - 1; 0 = no range join yet)
+        gauges["range_cutpoints"] = lambda: (
+            len(self.last_range_cutpoints)
+            if self.last_range_cutpoints is not None else 0)
         gauges["blacklisted_peers"] = lambda: len(self.blacklist)
         gauges["blacklist"] = lambda: ",".join(
             self.host_name(p) for p in sorted(self.blacklist)) or ""

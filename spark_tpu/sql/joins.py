@@ -136,6 +136,95 @@ def _bitcast_f64(xp, x):
 _CANON_NAN = np.float64(np.nan).view(np.int64) if hasattr(np.float64(0), "view") \
     else np.int64(0x7FF8000000000000)
 
+# NULL/dead sentinel for RANGE routing keys — only determinism matters
+# (a genuine INT64_MIN key sharing the sentinel's span is harmless: span
+# assignment never decides matches, the local exact join does)
+_RANGE_NULL = np.int64(np.iinfo(np.int64).min)
+
+
+def _orderable_f64(xp, x):
+    """Total-order monotonic int64 encoding of float64 (IEEE-754 sign
+    flip): -0.0 folds to +0.0 and every NaN to one canonical positive
+    pattern (above +inf — Spark's NaN-greatest sort order), then
+    negative bit patterns flip their magnitude bits so the int64s ascend
+    exactly as the floats do.  An equality-preserving bijection, so the
+    exact-join search contract is unchanged; the added monotonicity is
+    what lets range cut points, sender sorts, and the local merge all
+    share one encoding."""
+    x = xp.where(x == 0.0, np.float64(0.0), x)   # -0.0 → +0.0
+    bits = _bitcast_f64(xp, x)
+    bits = xp.where(xp.isnan(x), np.int64(_CANON_NAN), bits)
+    return xp.where(bits < 0, bits ^ np.int64(0x7FFFFFFFFFFFFFFF), bits)
+
+
+def range_encode_key(ctx: EvalContext, expr: Expression,
+                     as_float: bool = False):
+    """Monotonic, PROCESS-INDEPENDENT int64 encoding of one join-key
+    column for range partitioning, or None when no such encoding exists.
+
+    Ints/bools pass through; floats take the ``_orderable_f64`` sign-flip
+    bitcast — the SAME normalization ``_exact_encode_pair`` applies, so
+    span routing and the local exact merge agree on every value.  Pass
+    ``as_float=True`` on the integer side of a mixed int/float pair so
+    both sides encode through float64.  NULL-key and dead rows fold to
+    ``_RANGE_NULL`` (span 0 on every process — deterministic routing;
+    they can never match, the local join's null masks handle them).
+    Dictionary strings return None: their canonical id space is built
+    per-process from the pair's two dictionaries and is NOT comparable
+    across processes, so string keys stay on the hash exchange.
+
+    Returns ``(enc, ok)``: the routing keys and the live-and-non-null
+    mask."""
+    xp = ctx.xp
+    v = ctx.broadcast(expr.eval(ctx))
+    if v.dictionary is not None:
+        return None
+    dt = np.dtype(str(v.data.dtype))
+    if as_float or np.issubdtype(dt, np.floating):
+        enc = _orderable_f64(xp, v.data.astype(np.float64))
+    elif dt == np.bool_ or np.issubdtype(dt, np.integer):
+        enc = v.data.astype(np.int64)
+    else:
+        return None
+    ok = ctx.batch.row_valid_or_true()
+    if v.valid is not None:
+        ok = ok & xp.broadcast_to(v.valid, (ctx.capacity,))
+    return xp.where(ok, enc, _RANGE_NULL), ok
+
+
+def range_key_spec(node: Join, left_schema: T.StructType,
+                   right_schema: T.StructType):
+    """Eligibility gate for the range-partitioned merge join: exactly ONE
+    equi-key pair whose two sides are both orderable non-string types.
+    Returns ``(l_expr, r_expr, l_as_float, r_as_float)`` or None.  Right/
+    full joins are excluded — the skew mitigation replicates the build
+    side per split span, which would double-count build-side
+    null-extension."""
+    if node.how not in ("inner", "left", "left_semi", "left_anti"):
+        return None
+    keys = equi_join_keys(node)
+    if len(keys) != 1:
+        return None
+    l, r = keys[0]
+
+    def _kind(e, schema):
+        try:
+            dt = e.data_type(schema)
+        except Exception:
+            return None
+        if isinstance(dt, T.BooleanType) or dt.is_integral:
+            return "int"
+        if dt.is_fractional:
+            return "float"
+        return None                        # strings, dates, complex types
+
+    lk = _kind(l, left_schema)
+    rk = _kind(r, right_schema)
+    if lk is None or rk is None:
+        return None
+    mixed = lk != rk
+    return l, r, mixed and lk == "int", mixed and rk == "int"
+
 
 def _exact_encode_pair(pctx: EvalContext, bctx: EvalContext,
                        l: Expression, r: Expression):
@@ -163,10 +252,7 @@ def _exact_encode_pair(pctx: EvalContext, bctx: EvalContext,
             return xp.asarray(table)[codes]
         dt = np.dtype(str(v.data.dtype))
         if np.issubdtype(dt, np.floating):
-            x = v.data.astype(np.float64)
-            x = xp.where(x == 0.0, np.float64(0.0), x)   # -0.0 → +0.0
-            bits = _bitcast_f64(xp, x)
-            return xp.where(xp.isnan(x), np.int64(_CANON_NAN), bits)
+            return _orderable_f64(xp, v.data.astype(np.float64))
         if dt == np.bool_ or np.issubdtype(dt, np.integer):
             return v.data.astype(np.int64)
         return None
@@ -228,6 +314,10 @@ def _join_keys(ctx: EvalContext, exprs: Sequence[Expression],
 
 
 class PJoin(P.PhysicalPlan):
+    #: build side already arrives globally (null_flag, key)-sorted —
+    #: PMergeJoin skips the build sort (the merge-join contract)
+    presorted_build = False
+
     def __init__(self, left: P.PhysicalPlan, right: P.PhysicalPlan, how: str,
                  key_pairs: Sequence[Tuple[Expression, Expression]],
                  residual: Optional[Expression],
@@ -275,7 +365,13 @@ class PJoin(P.PhysicalPlan):
             # lexicographic (flag, key) sort puts valid keys first sorted
             # by value; null/dead rows sink into an INT64_MAX-keyed suffix
             b_flag = xp.where(b_ok, np.int8(0), np.int8(1))
-            perm = multi_key_argsort(xp, [b_flag, b_enc], build.capacity)
+            if self.presorted_build:
+                # range exchange delivered the build side already merged
+                # into (flag, key) order — identity perm, no device sort
+                perm = xp.arange(build.capacity, dtype=np.int32)
+            else:
+                perm = multi_key_argsort(xp, [b_flag, b_enc],
+                                         build.capacity)
             b_flag_s = b_flag[perm]
             ba_s = xp.where(b_flag_s == 0, b_enc[perm], _DEAD_BUILD)
             pa = p_enc
@@ -472,6 +568,23 @@ class PJoin(P.PhysicalPlan):
         return f"HashJoin {self.how} keys=[{ks}] residual={self.residual!r} f={self.factor}"
 
 
+class PMergeJoin(PJoin):
+    """Merge join over a pre-sorted build side (SortMergeJoinExec's
+    streaming-merge role, static-shape): the cross-process range exchange
+    ships key-sorted runs and the receiver k-way-merges them
+    (``native/merge.py``), so the per-process build sort — the O(n log n)
+    device step of every PJoin — is already done.  Probe rows
+    binary-search the merged build directly; everything downstream
+    (expansion, exact verification, existence) is inherited unchanged."""
+
+    presorted_build = True
+
+    def __repr__(self):
+        ks = ", ".join(f"{l!r}={r!r}" for l, r in self.key_pairs)
+        return (f"MergeJoin {self.how} keys=[{ks}] "
+                f"residual={self.residual!r} f={self.factor}")
+
+
 def plan_join(planner, node: Join, leaves) -> P.PhysicalPlan:
     ls, rs = node.left.schema(), node.right.schema()
 
@@ -530,8 +643,9 @@ def plan_join_raw(planner, node: Join, leaves) -> P.PhysicalPlan:
             raise AnalysisException(f"{node.how} join requires equi-join keys")
         return PJoin(left_p, right_p, "cross", [], residual, raw_schema, 1.0)
 
-    return PJoin(left_p, right_p, node.how, key_pairs, residual, raw_schema,
-                 planner.next_join_factor())
+    cls = PMergeJoin if getattr(node, "_presorted_build", False) else PJoin
+    return cls(left_p, right_p, node.how, key_pairs, residual, raw_schema,
+               planner.next_join_factor())
 
 
 class _JoinOutput(P.PhysicalPlan):

@@ -1,22 +1,30 @@
-"""Worker for the shuffled-hash-join parity and fault tests (not a test
+"""Worker for the cross-process join parity and fault tests (not a test
 module itself — launched as a subprocess by test_shuffled_join.py and
 test_faults.py).
 
 argv: <process_id> <n_processes> <shuffle_root> <mode> [timeout_s]
 
 mode "parity": run a battery of equi-join plans (inner / left / semi,
-two partitioned leaves, with and without a keyed Aggregate above) twice
-— once with ``spark.tpu.crossproc.shuffledJoin`` on (the new
-co-partitioned path) and once with it off (the generic gather path) —
-and assert both match a full-data single-process oracle exactly.  Also
-asserts the shuffled path actually RAN (``shuffled_joins`` counter), the
-widened semi-join fast path ran (``fast_path_aggs``), and that manifest
-coalescing merged sub-target fine partitions (``partitions_coalesced``).
+two partitioned leaves, with and without a keyed Aggregate above, with a
+deliberately SKEWED hot key) THREE ways — range-partitioned sort-merge
+(``spark.tpu.crossproc.sortMergeJoin``), shuffled hash
+(``spark.tpu.crossproc.shuffledJoin``), and the generic gather — and
+assert every configuration matches a full-data single-process oracle
+exactly.  Also asserts each run took the path it was supposed to
+(``range_merge_joins`` / ``shuffled_joins`` / ``fast_path_aggs``
+counters), that manifest coalescing merged sub-target fine partitions
+(``partitions_coalesced``), and that the hot key actually forced a skew
+split (``spans_split``).
 
 mode "fault": arm a FaultInjector from SPARK_TPU_FAULT_PLAN and run ONE
-shuffled join.  Prints ``OK <rows>`` when the exchange healed (result
-must equal the oracle — never a partial join), or
+shuffled-hash join (sortMergeJoin pinned off so the exchange ids are the
+classic ``-jL``/``-jR``).  Prints ``OK <rows>`` when the exchange healed
+(result must equal the oracle — never a partial join), or
 ``FAILED <elapsed> <lost>`` on a structured, bounded failure.
+
+mode "fault-sample": same contract, but the query runs on the RANGE path
+(sortMergeJoin on) so the plan can target the manifest-only
+``-sample`` coordination round.
 """
 
 import os
@@ -35,16 +43,20 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as np  # noqa: E402
 
 from spark_tpu import config as C  # noqa: E402
-from spark_tpu.parallel.faults import FAULT_PLAN_ENV, FaultInjector  # noqa: E402
+from spark_tpu.parallel.faults import FaultInjector  # noqa: E402
 from spark_tpu.parallel.hostshuffle import ExchangeFetchFailed  # noqa: E402
 from spark_tpu.sql.session import SparkSession  # noqa: E402
 
 # Both processes draw the SAME full dataset and keep a strided 1/n slice,
 # so every process sees every key range (the worst case for a local join:
-# without co-partitioning almost every match is cross-process).
+# without co-partitioning almost every match is cross-process).  Key 8 is
+# a deliberately HOT key (~40% of fact rows): under the small advisory
+# target below its span exceeds SKEW_FACTOR x median, so the range
+# planner must SPLIT it across reducers (and still match the oracle).
 rng = np.random.default_rng(7)
 N, M = 900, 600
 f_sk = rng.integers(0, 40, N).astype(np.int64)
+f_sk[rng.random(N) < 0.4] = 8
 f_price = rng.integers(1, 200, N).astype(np.int64)
 f_g = np.array(["ash", "oak", "fir", "elm"])[f_sk % 4]
 k2 = (rng.integers(0, 20, M) * 2).astype(np.int64)   # even keys only →
@@ -63,8 +75,17 @@ svc = xs.enableHostShuffle(root, process_id=pid, n_processes=n,
                            timeout_s=timeout_s)
 # small advisory target: the test tables are tiny, and with the 4 MiB
 # default every fine partition would coalesce onto process 0 — a few KiB
-# keeps BOTH processes joining while still exercising the coalescer
+# keeps BOTH processes joining while still exercising the coalescer (and
+# makes the hot key's span split into several reducer shares)
 xs.conf.set(C.SHUFFLE_TARGET_PARTITION_BYTES.key, "2048")
+# strategy choice must be pinned per mode below — a tiny side slipping
+# under the broadcast threshold would silently change the path under test
+xs.conf.set(C.CROSSPROC_AUTO_BROADCAST.key, "0")
+# finer quantiles sharpen skew DETECTION: hot-key duplicates collapse
+# into one span either way, but more fine spans shrink the median span
+# the 5x-median test compares against (8/proc would leave the hot span
+# just under threshold on this small table)
+xs.conf.set(C.SHUFFLE_FINE_PARTITIONS.key, "32")
 xs.createDataFrame({"sk": f_sk[mine], "price": f_price[mine],
                     "g": f_g[mine]}).createOrReplaceTempView("fact")
 xs.createDataFrame({"k2": k2[mine], "bonus": b2[mine],
@@ -82,43 +103,64 @@ oracle.createDataFrame({"k2": k2, "bonus": b2, "g2": g2}) \
 oracle.createDataFrame({"d_sk": d_sk, "year": d_year}) \
     .createOrReplaceTempView("dim")
 
-# (name, sql, counter expected to increment on the distributed run)
+# (name, sql, expected counter per mode).  String keys have no
+# cross-process orderable encoding, so "range" mode falls back to the
+# hash exchange for them — exactly the documented "when hash still wins".
 QUERIES = [
     ("inner-agg",
      "SELECT sk, count(*) AS c, sum(bonus) AS sb FROM fact "
      "JOIN fact2 ON sk = k2 GROUP BY sk ORDER BY sk",
-     "shuffled_joins"),
+     {"range": "range_merge_joins", "hash": "shuffled_joins"}),
     ("inner-rows",
      "SELECT sk, price, bonus FROM fact JOIN fact2 ON sk = k2 "
      "WHERE bonus > 40 ORDER BY sk, price, bonus",
-     "shuffled_joins"),
+     {"range": "range_merge_joins", "hash": "shuffled_joins"}),
     ("left-agg",
      "SELECT sk, count(bonus) AS cb, count(*) AS c FROM fact "
      "LEFT JOIN fact2 ON sk = k2 GROUP BY sk ORDER BY sk",
-     "shuffled_joins"),
+     {"range": "range_merge_joins", "hash": "shuffled_joins"}),
     ("string-key-agg",
      "SELECT g, count(*) AS c, sum(bonus) AS sb FROM fact "
      "JOIN fact2 ON g = g2 GROUP BY g ORDER BY g",
-     "shuffled_joins"),
+     {"range": "shuffled_joins", "hash": "shuffled_joins"}),
     ("semi-rows",
      "SELECT sk, price FROM fact LEFT SEMI JOIN fact2 ON sk = k2 "
      "ORDER BY sk, price",
-     "shuffled_joins"),
+     {"range": "range_merge_joins", "hash": "shuffled_joins"}),
     # widened fast-path guard: LEFT SEMI against a REPLICATED build side
-    # under a keyed Aggregate stays on the single-exchange fast path
+    # under a keyed Aggregate stays on the single-exchange fast path in
+    # EVERY mode — exchange strategy flags never reach it
     ("semi-replicated-fast",
      "SELECT sk, count(*) AS c FROM fact LEFT SEMI JOIN dim ON sk = d_sk "
      "GROUP BY sk ORDER BY sk",
-     "fast_path_aggs"),
+     {"range": "fast_path_aggs", "hash": "fast_path_aggs",
+      "gather": "fast_path_aggs"}),
 ]
+
+#: mode → (sortMergeJoin, shuffledJoin) conf values
+MODES = [("range", "true", "true"),
+         ("hash", "false", "true"),
+         ("gather", "false", "false")]
+
+
+def set_mode(m):
+    for name, smj, sh in MODES:
+        if name == m:
+            xs.conf.set(C.CROSSPROC_SORT_MERGE_JOIN.key, smj)
+            xs.conf.set(C.CROSSPROC_SHUFFLED_JOIN.key, sh)
+            return
+    raise ValueError(m)
 
 
 def run(sess, sql):
     return [tuple(r) for r in sess.sql(sql).collect()]
 
 
-if mode == "fault":
+if mode in ("fault", "fault-sample"):
     FaultInjector().attach(svc)        # plan comes from SPARK_TPU_FAULT_PLAN
+    set_mode("range" if mode == "fault-sample" else "hash")
+    join_counter = ("range_merge_joins" if mode == "fault-sample"
+                    else "shuffled_joins")
     name, sql, _ = QUERIES[0]
     exp = run(oracle, sql)
     t0 = time.time()
@@ -128,28 +170,35 @@ if mode == "fault":
         lost = sorted(getattr(e, "lost_hosts", []) or [])
         print(f"[p{pid}] FAILED {time.time() - t0:.2f} {lost}", flush=True)
         os._exit(0)
-    assert svc.counters["shuffled_joins"] > 0, svc.counters
+    assert svc.counters[join_counter] > 0, svc.counters
     if got != exp:
         print(f"[p{pid}] PARTIAL got={len(got)} exp={len(exp)}", flush=True)
         os._exit(1)
     print(f"[p{pid}] OK {len(got)}", flush=True)
     os._exit(0)
 
-for name, sql, counter in QUERIES:
+JOIN_COUNTERS = ("range_merge_joins", "shuffled_joins", "broadcast_joins")
+for name, sql, expected in QUERIES:
     exp = run(oracle, sql)
-    before = dict(svc.counters)
-    got_shuffled = run(xs, sql)
-    assert svc.counters[counter] > before[counter], (
-        f"{name}: expected the {counter} path, counters {svc.counters}")
-    xs.conf.set(C.CROSSPROC_SHUFFLED_JOIN.key, "false")
-    before2 = dict(svc.counters)
-    got_gather = run(xs, sql)
-    xs.conf.set(C.CROSSPROC_SHUFFLED_JOIN.key, "true")
-    assert svc.counters["shuffled_joins"] == before2["shuffled_joins"], (
-        f"{name}: shuffled path ran with the flag off")
-    if got_shuffled != exp or got_gather != exp:
-        print(f"[p{pid}] PARITY-FAIL {name} shuffled={got_shuffled[:4]} "
-              f"gather={got_gather[:4]} exp={exp[:4]}", flush=True)
+    results = {}
+    for m, _smj, _sh in MODES:
+        set_mode(m)
+        before = dict(svc.counters)
+        results[m] = run(xs, sql)
+        want = expected.get(m)
+        if want is not None:
+            assert svc.counters[want] > before[want], (
+                f"{name}/{m}: expected the {want} path, {svc.counters}")
+        # no OTHER exchange-join path may have run for this query
+        for c in JOIN_COUNTERS:
+            if c != want:
+                assert svc.counters[c] == before[c], (
+                    f"{name}/{m}: unexpected {c} bump, {svc.counters}")
+    set_mode("range")
+    bad = [m for m in results if results[m] != exp]
+    if bad:
+        print(f"[p{pid}] PARITY-FAIL {name} modes={bad} "
+              f"got={results[bad[0]][:4]} exp={exp[:4]}", flush=True)
         os._exit(1)
     print(f"[p{pid}] PARITY-OK {name} ({len(exp)} rows)", flush=True)
 
@@ -157,13 +206,20 @@ for name, sql, counter in QUERIES:
 # partitions, all far below targetPartitionBytes — the planner must have
 # merged them (and the merge demonstrably did not change any result)
 assert svc.counters["partitions_coalesced"] > 0, svc.counters
+# the hot key forced the range planner to SPLIT its span across reducers
+# (the skew mitigation), and the sample round actually moved manifests
+assert svc.counters["spans_split"] > 0, svc.counters
+assert svc.counters["sample_bytes"] > 0, svc.counters
 # per-exchange data-plane accounting: produced >= shipped, and the
-# manifest-derived partition-size gauges are populated
+# manifest-derived partition-size and cut-point gauges are populated
 gauges = svc.metrics_source().snapshot()
 assert gauges["bytes_produced_raw"] >= gauges["bytes_shipped_raw"] > 0, gauges
 assert gauges["rows_produced"] >= gauges["rows_shipped"] > 0, gauges
 assert gauges["partition_bytes_max"] >= gauges["partition_bytes_median"], gauges
-print(f"[p{pid}] ALL-OK shuffled={svc.counters['shuffled_joins']} "
+assert gauges["range_cutpoints"] > 0, gauges
+print(f"[p{pid}] ALL-OK range={svc.counters['range_merge_joins']} "
+      f"shuffled={svc.counters['shuffled_joins']} "
       f"fast={svc.counters['fast_path_aggs']} "
-      f"coalesced={svc.counters['partitions_coalesced']}", flush=True)
+      f"coalesced={svc.counters['partitions_coalesced']} "
+      f"split={svc.counters['spans_split']}", flush=True)
 os._exit(0)

@@ -71,3 +71,74 @@ def test_disable_restores_local_path(xs):
     xs.disableHostShuffle()
     out = xs.sql("SELECT count(*) AS c FROM fact").collect()
     assert out[0]["c"] == 500
+
+
+# ---------------------------------------------------------------------------
+# join-strategy decision: a pure function of the digest-probe statistics
+# (broadcast threshold → range eligibility → hash → gather)
+# ---------------------------------------------------------------------------
+
+def _choose(**kw):
+    from spark_tpu.parallel.crossproc import choose_join_strategy
+    base = dict(how="inner", range_eligible=True, sort_merge_enabled=True,
+                shuffled_enabled=True, broadcast_threshold=1 << 20,
+                n_procs=4, left_bytes=1 << 30, right_bytes=1 << 10)
+    base.update(kw)
+    return choose_join_strategy(**base)
+
+
+def test_choose_broadcast_small_side_wins():
+    # tiny right side: one gather beats two full co-partition exchanges
+    assert _choose() == "broadcast_right"
+    # mirrored: inner can broadcast either side — the SMALLER one wins
+    assert _choose(left_bytes=1 << 10, right_bytes=1 << 30) \
+        == "broadcast_left"
+    assert _choose(n_procs=1, left_bytes=100, right_bytes=200) \
+        == "broadcast_left"
+
+
+def test_choose_broadcast_respects_threshold_and_share():
+    # over the absolute threshold → no broadcast
+    assert _choose(right_bytes=2 << 20) == "range"
+    # under the threshold but NOT << left/n (the ROADMAP guard): the
+    # gathered copy would rival each process's own share — don't
+    assert _choose(left_bytes=4000, right_bytes=1500) == "range"
+    # threshold 0 disables the broadcast planner outright
+    assert _choose(broadcast_threshold=0) == "range"
+
+
+def test_choose_broadcast_side_legality_by_how():
+    # LEFT join must keep the left side partitioned: only the right
+    # (build) side may be gathered; a tiny LEFT side can't broadcast
+    assert _choose(how="left", left_bytes=1 << 10,
+                   right_bytes=1 << 30) == "range"
+    assert _choose(how="left") == "broadcast_right"
+    assert _choose(how="left_semi") == "broadcast_right"
+    # RIGHT join is the mirror image
+    assert _choose(how="right", left_bytes=1 << 10,
+                   right_bytes=1 << 30) == "broadcast_left"
+    assert _choose(how="right") == "range"
+
+
+def test_choose_fallback_ladder():
+    big = dict(left_bytes=1 << 30, right_bytes=1 << 30)
+    assert _choose(**big) == "range"
+    assert _choose(range_eligible=False, **big) == "hash"
+    assert _choose(sort_merge_enabled=False, **big) == "hash"
+    assert _choose(range_eligible=False, shuffled_enabled=False,
+                   **big) == "gather"
+    assert _choose(sort_merge_enabled=False, shuffled_enabled=False,
+                   **big) == "gather"
+
+
+def test_broadcast_flag_safe_single_process(xs):
+    """n=1 degenerate: every leaf is 'replicated', the strategy search
+    never engages, and the threshold default changes no result."""
+    _mk(xs)
+    q = ("SELECT brand, count(*) AS c FROM fact JOIN dim ON sk = d_sk "
+         "GROUP BY brand ORDER BY brand")
+    got = [tuple(r) for r in xs.sql(q).collect()]
+    svc = xs._crossproc_svc
+    assert svc.counters["broadcast_joins"] == 0
+    assert svc.counters["range_merge_joins"] == 0
+    assert len(got) == 5

@@ -401,10 +401,12 @@ def test_peer_killed_mid_exchange(tmp_path, commit_first):
 # query fails structured and bounded — NEVER a partial join result
 # ---------------------------------------------------------------------------
 
-def _spawn_join_fault_worker(pid, root, plan, timeout_s):
+def _spawn_join_fault_worker(pid, root, plan, timeout_s, mode="fault"):
     """One process of the 2-process shuffled-join fault scenario; the
     join data exchanges have deterministic ids (first query → exchanges
-    ``xq000001-jL`` / ``-jR``), so rules can target one side's blocks."""
+    ``xq000001-jL`` / ``-jR`` on the hash path, ``xq000001-sample`` /
+    ``-rL`` / ``-rR`` on the range path), so rules can target one side's
+    blocks — or the manifest-only sample round itself."""
     here = os.path.dirname(os.path.abspath(__file__))
     worker = os.path.join(here, "shuffled_join_worker.py")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -412,7 +414,7 @@ def _spawn_join_fault_worker(pid, root, plan, timeout_s):
     if plan is not None:
         env[FAULT_PLAN_ENV] = plan.to_env()
     return subprocess.Popen(
-        [sys.executable, worker, str(pid), "2", root, "fault",
+        [sys.executable, worker, str(pid), "2", root, mode,
          str(timeout_s)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env=env)
@@ -461,4 +463,55 @@ def test_join_side_block_corrupted_fails_bounded(tmp_path):
     assert "PARTIAL" not in out0 + out1
     # exchange deadline 6s: victim fails ≤ 2x (exchange + refetch), the
     # peer's follow-up barrier adds ≤ 1x more, plus jit/startup slack
+    assert elapsed < 3 * 6.0 + 30, elapsed
+
+
+# ---------------------------------------------------------------------------
+# the RANGE path's manifest-only sample round under faults: the cut-point
+# coordination is all-or-nothing — a dropped manifest heals through the
+# barrier/strict-reread machinery, a permanently unreadable one fails the
+# round on EVERY process (bounded), never lets cut points diverge
+# ---------------------------------------------------------------------------
+
+def test_range_sample_manifest_dropped_then_heals(tmp_path):
+    """p1's sample manifest vanishes right after the publish
+    (list-after-write lag) and reappears 2s later — inside the barrier
+    window.  The sample round completes, both processes derive the same
+    cut points, and the range join matches the full-data oracle."""
+    plan = FaultPlan().drop(exchange="xq000001-sample", heal_after_s=2.0)
+    root = str(tmp_path / "shuf")
+    p0 = _spawn_join_fault_worker(0, root, None, 20.0, mode="fault-sample")
+    p1 = _spawn_join_fault_worker(1, root, plan, 20.0, mode="fault-sample")
+    out0 = p0.communicate(timeout=120)[0]
+    out1 = p1.communicate(timeout=120)[0]
+    assert p0.returncode == 0, out0
+    assert p1.returncode == 0, out1
+    assert "[p0] OK " in out0, out0
+    assert "[p1] OK " in out1, out1
+    assert "PARTIAL" not in out0 + out1
+
+
+def test_range_sample_manifest_corrupted_fails_bounded(tmp_path):
+    """p1's sample manifest gets a byte flipped with no heal: it parses
+    on no process, the strict gather re-reads until the deadline, then
+    BOTH processes fail structured naming host-1 — the round can never
+    half-succeed, because asymmetric reads would mean different cut
+    points and a desynchronized data exchange."""
+    plan = FaultPlan().corrupt(exchange="xq000001-sample")
+    root = str(tmp_path / "shuf")
+    t0 = time.monotonic()
+    p0 = _spawn_join_fault_worker(0, root, None, 6.0, mode="fault-sample")
+    p1 = _spawn_join_fault_worker(1, root, plan, 6.0, mode="fault-sample")
+    out0 = p0.communicate(timeout=120)[0]
+    out1 = p1.communicate(timeout=120)[0]
+    elapsed = time.monotonic() - t0
+    assert p0.returncode == 0, out0
+    assert p1.returncode == 0, out1
+    for pid, out in ((0, out0), (1, out1)):
+        line = [ln for ln in out.splitlines() if f"[p{pid}]" in ln][-1]
+        assert "FAILED" in line and "host-1" in line, out
+    assert "OK" not in out0 and "OK" not in out1
+    assert "PARTIAL" not in out0 + out1
+    # strict gather holds until the 6s exchange deadline on each side,
+    # plus jit/startup slack — bounded, and far from a hang
     assert elapsed < 3 * 6.0 + 30, elapsed

@@ -154,6 +154,31 @@ def test_metrics_system_sources_and_sinks(spark, mdf, tmp_path):
                  if not isinstance(s, (ConsoleSink, CsvSink))]
 
 
+def test_shuffle_range_gauges_exported(spark, tmp_path):
+    """The range-exchange coordination plane is observable: cut-point
+    count, skew-span splits, and sample-round manifest bytes surface as
+    gauges on the session's shuffle metrics source."""
+    prev = getattr(spark, "_crossproc_svc", None)
+    ms = spark.metricsSystem
+    try:
+        svc = spark.enableHostShuffle(str(tmp_path), process_id=0,
+                                      n_processes=1, timeout_s=5.0)
+        svc.publish_manifest("s", {"sample": {"points": [1, 2]}})
+        _mans, nbytes = svc.gather_manifests("s")
+        svc.counters["sample_bytes"] += nbytes
+        svc.last_range_cutpoints = [10, 20]
+        svc.plan_range_reducers(np.array([1, 1, 1000, 1], np.int64),
+                                np.zeros(4, np.int64), 10)
+        snap = ms.snapshots()["shuffle"]
+        assert snap["range_cutpoints"] == 2
+        assert snap["spans_split"] == 1          # the hot span was split
+        assert snap["sample_bytes"] == nbytes > 0
+        assert snap["partition_bytes_max"] >= snap["partition_bytes_median"]
+    finally:
+        spark._crossproc_svc = prev
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]
+
+
 def test_memory_leak_check_releases(spark, mdf):
     """Executor.scala's 'managed memory leak detected' idiom: a leaked
     execution reservation is detected and released after the query."""

@@ -10,11 +10,16 @@ Two layers:
 - subprocess parity harness (2 and 3 REAL processes,
   ``shuffled_join_worker.py``): randomized-but-seeded plans — inner /
   left / semi joins of two partitioned leaves, with and without a keyed
-  Aggregate above — run through the shuffled path AND the forced gather
-  path, both byte-identical to a full-data single-process oracle; the
-  workers also assert the path counters (``shuffled_joins``,
-  ``fast_path_aggs``) and that coalescing merged sub-target fine
-  partitions without changing any result.
+  Aggregate above, with a deliberately skewed hot key — run through the
+  RANGE sort-merge path, the shuffled-hash path AND the forced gather
+  path, all byte-identical to a full-data single-process oracle; the
+  workers also assert the path counters (``range_merge_joins``,
+  ``shuffled_joins``, ``fast_path_aggs``), that coalescing merged
+  sub-target fine partitions, and that the hot key forced a skew-span
+  split — without changing any result.
+
+The range-specific service machinery (the strict manifest round and the
+skew-splitting span→reducer planner) gets direct unit tests here too.
 """
 
 import os
@@ -92,6 +97,80 @@ def test_publish_sizes_is_single_use(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# range exchange coordination: strict manifest rounds + span planning
+# ---------------------------------------------------------------------------
+
+def test_publish_and_gather_manifests_roundtrip(tmp_path):
+    svc0, svc1 = _svc(tmp_path, 0), _svc(tmp_path, 1)
+    n0 = svc0.publish_manifest("e", {"sample": {"points": [1, 2]}})
+    n1 = svc1.publish_manifest("e", {"sample": {"points": [9]}})
+    mans, total = svc0.gather_manifests("e")
+    assert mans[0]["sample"]["points"] == [1, 2]
+    assert mans[1]["sample"]["points"] == [9]
+    assert total == n0 + n1 > 0
+
+
+def test_gather_manifests_strict_rejects_unreadable(tmp_path):
+    """The coordination-round contract: a committed-but-unparseable
+    manifest must FAIL the round (bounded), never be silently skipped —
+    skipping would let processes derive DIFFERENT cut points."""
+    from spark_tpu.parallel.hostshuffle import ExchangeFetchFailed
+    svc0, svc1 = _svc(tmp_path, 0, timeout_s=0.5), _svc(tmp_path, 1)
+    svc0.publish_manifest("e")
+    svc1.publish_manifest("e", {"sample": {}})
+    with open(svc1._done("e", 1), "wb") as f:   # torn write, size intact
+        f.write(b"\x82{ not json")
+    with pytest.raises(ExchangeFetchFailed) as ei:
+        svc0.gather_manifests("e", strict=True)
+    assert ei.value.lost_hosts == ["host-1"]
+    # non-strict (size rounds): legacy skip-if-unreadable is preserved
+    mans, _ = svc0.gather_manifests("e")
+    assert 0 in mans and 1 not in mans
+
+
+def test_plan_range_reducers_splits_skewed_span(tmp_path):
+    svc = _svc(tmp_path, n=2)
+    probe = np.array([10, 10, 100000, 10, 10], np.int64)
+    build = np.array([5, 5, 50, 5, 5], np.int64)
+    owners = svc.plan_range_reducers(probe, build, 2048)
+    # hot span 2 is split across BOTH processes, others single-owner
+    assert sorted(owners[2]) == [0, 1]
+    assert all(len(owners[s]) == 1 for s in (0, 1, 3, 4))
+    assert svc.counters["spans_split"] == 1
+    # load model: split probe halves + build REPLICATED to each owner
+    normal = int((probe + build).sum() - probe[2] - build[2])
+    assert sum(svc.last_partition_bytes) \
+        == normal + 2 * (int(probe[2]) // 2 + int(build[2]))
+
+
+def test_plan_range_reducers_coalesces_and_is_deterministic(tmp_path):
+    probe = np.array([7, 7, 7, 7, 7, 7, 7, 7], np.int64)
+    build = np.zeros(8, np.int64)
+    o0 = _svc(tmp_path / "a", pid=0).plan_range_reducers(probe, build, 100)
+    o1 = _svc(tmp_path / "b", pid=1).plan_range_reducers(probe, build, 100)
+    assert o0 == o1                      # no driver: same inputs, same plan
+    assert all(len(ps) == 1 for ps in o0)
+    svc = _svc(tmp_path / "c")
+    svc.plan_range_reducers(probe, build, 100)
+    assert svc.counters["partitions_coalesced"] > 0
+    assert svc.counters["spans_split"] == 0   # uniform → nothing to split
+
+
+def test_range_bucket_spans_and_duplicates():
+    from spark_tpu.kernels import range_bucket
+    cuts = np.array([10, 20], np.int64)
+    keys = np.array([-5, 9, 10, 15, 20, 99, 10, 10], np.int64)
+    spans = range_bucket(np, keys, cuts)
+    assert spans.dtype == np.int32
+    assert spans.tolist() == [0, 0, 1, 1, 2, 2, 1, 1]
+    # all duplicates of a value land in ONE span (hot-key cohesion)
+    assert len({s for k, s in zip(keys.tolist(), spans.tolist())
+                if k == 10}) == 1
+    # no cuts → everything in span 0 (single-span degenerate case)
+    assert range_bucket(np, keys, np.zeros(0, np.int64)).tolist() == [0] * 8
+
+
+# ---------------------------------------------------------------------------
 # equi-key extraction mirrors the join planner
 # ---------------------------------------------------------------------------
 
@@ -155,8 +234,10 @@ def _run_parity(tmp_path, n, timeout_s=90.0):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid}:\n{out}"
         assert f"[p{pid}] ALL-OK" in out, out
-        # the battery covered both new paths and the coalescer fired
-        assert "shuffled=5" in out and "fast=2" in out, out
+        # the battery covered every path and the coalescer + skew
+        # splitter both fired
+        assert "range=4" in out and "shuffled=6" in out, out
+        assert "fast=3" in out, out
     return outs
 
 
