@@ -74,6 +74,8 @@ DS_ROWS = 1 << 18    # distsort lane: probe rows (full dataset, SKEWED keys)
 DS_BUILD = 1 << 16   # distsort lane: build rows (uniform, multiplicity 16)
 DS_KEYS = 1 << 12    # distsort key cardinality; half the probe mass sits
 DS_HOT = 77          # on this ONE hot key (the skew under test)
+DD_ROWS = 24000      # distdict lane: rows per table (low-cardinality keys)
+DD_KEYS = 2500       # distinct fat words (~30 B each: dict ~75 KiB/column)
 
 #: cold axon compiles of the fused agg/join programs run several minutes
 #: (f64/i64 emulation); the persistent jax compile cache under /tmp makes
@@ -761,6 +763,142 @@ def distjoin_worker_main() -> None:
     sys.stdout.flush()
 
 
+def _bench_dist_dict() -> dict:
+    """Distdict lane: encoded execution over the DCN exchange.  A
+    2-process low-cardinality string-key join + group-by runs twice with
+    only ``spark.tpu.shuffle.wire.dictCodes`` toggled: "codes" ships each
+    fat dictionary ONCE per (exchange, sender) in the framed sidecar and
+    the blocks carry int32 codes + an 8-byte fingerprint, "words" inlines
+    the full dictionary into EVERY block frame (the legacy wire).  Same
+    shuffled-hash path, identical results cross-checked; the byte
+    reduction is the dictionary dedup, measured end to end."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="spark_tpu_bench_dd_")
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("SPARK_TPU_FAULT_PLAN", None)
+        env.pop("SPARK_TPU_PLATFORM", None)
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--distdict-worker", str(pid), d],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for pid in (0, 1)]
+        outs = [p.communicate(timeout=CHILD_TIMEOUT_S) for p in procs]
+        objs = []
+        for p, (out, err) in zip(procs, outs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"distdict worker rc={p.returncode}: "
+                    f"{(err or out).strip().splitlines()[-3:]}")
+            line = [ln for ln in out.splitlines()
+                    if ln.strip().startswith("{")][-1]
+            objs.append(json.loads(line))
+        # both wire formats, both processes: byte-identical aggregates
+        sums = {o[m]["checksum"] for o in objs for m in ("codes", "words")}
+        if len(sums) != 1:
+            raise RuntimeError(f"codes/words results diverge: {objs}")
+        if not all(o["codes"]["dict_columns_encoded"] > 0 for o in objs):
+            raise RuntimeError(f"codes run never framed a dictionary: {objs}")
+        rows = objs[0]["rows_total"]
+        co_s = max(o["codes"]["seconds"] for o in objs)
+        wo_s = max(o["words"]["seconds"] for o in objs)
+        co_b = sum(o["codes"]["bytes_written"] for o in objs)
+        wo_b = sum(o["words"]["bytes_written"] for o in objs)
+        return {
+            "distdict_rows_per_sec": round(rows / co_s, 1),
+            "distdict_words_rows_per_sec": round(rows / wo_s, 1),
+            "distdict_speedup_vs_words": round(wo_s / co_s, 3),
+            "distdict_dcn_bytes": co_b,
+            "distdict_words_dcn_bytes": wo_b,
+            "distdict_dcn_byte_reduction": round(wo_b / max(1, co_b), 2),
+            "distdict_dict_bytes_saved": sum(
+                o["codes"]["dict_bytes_saved"] for o in objs),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def distdict_worker_main() -> None:
+    """One process of the distdict lane (see ``_bench_dist_dict``).
+
+    argv: --distdict-worker <pid> <root>.  Prints ONE JSON line with warm
+    wall-clock and service counters for the codes and words wire modes."""
+    i = sys.argv.index("--distdict-worker")
+    pid, root = int(sys.argv[i + 1]), sys.argv[i + 2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import zlib
+
+    from spark_tpu import config as C
+    from spark_tpu.sql.session import SparkSession
+
+    # fat words, low cardinality: the per-column dictionary (~75 KiB)
+    # dwarfs a fine partition's code payload, so inlining it per block
+    # frame vs once per sender is the measured difference
+    words = np.array([f"sku-{j:06d}-lot-{j % 97:02d}-aisle-{j % 13:02d}"
+                      for j in range(DD_KEYS)])
+    rng = np.random.default_rng(53)
+    g = words[rng.integers(0, DD_KEYS, DD_ROWS)]
+    v = rng.integers(1, 100, DD_ROWS).astype(np.int64)
+    g2 = words[rng.integers(0, DD_KEYS, DD_ROWS)]
+    w = rng.integers(1, 100, DD_ROWS).astype(np.int64)
+    mine = slice(pid, None, 2)
+    Q = ("SELECT g, count(*) AS c, sum(w) AS sw FROM fact "
+         "JOIN fact2 ON g = g2 GROUP BY g ORDER BY g")
+
+    session = SparkSession.builder.appName(f"bench-dd-{pid}").getOrCreate()
+    out = {"pid": pid, "rows_total": int(2 * DD_ROWS)}
+    for mode in ("codes", "words"):
+        xs = session.newSession()
+        xs.conf.set(C.MESH_SHARDS.key, "1")
+        xs.conf.set(C.SHUFFLE_WIRE_DICT_CODES.key,
+                    "true" if mode == "codes" else "false")
+        # pin the range sort-merge path both runs (string keys are
+        # range-eligible now): this lane measures the WIRE format, not a
+        # join-strategy difference.  Range routing ships one batch frame
+        # PER SPAN per receiver — the words wire pays the dictionary in
+        # each frame, the codes wire once per sender in the sidecar.
+        xs.conf.set(C.CROSSPROC_SORT_MERGE_JOIN.key, "true")
+        xs.conf.set(C.CROSSPROC_SHUFFLED_JOIN.key, "false")
+        xs.conf.set(C.CROSSPROC_AUTO_BROADCAST.key, "0")
+        xs.conf.set(C.SHUFFLE_FINE_PARTITIONS.key, "32")
+        xs.conf.set(C.SHUFFLE_TARGET_PARTITION_BYTES.key, "4096")
+        svc = xs.enableHostShuffle(os.path.join(root, mode),
+                                   process_id=pid, n_processes=2,
+                                   timeout_s=300.0)
+        xs.createDataFrame({"g": g[mine], "v": v[mine]}) \
+            .createOrReplaceTempView("fact")
+        xs.createDataFrame({"g2": g2[mine], "w": w[mine]}) \
+            .createOrReplaceTempView("fact2")
+        xs.sql(Q).collect()                  # warm: compile + caches
+        base_bytes = int(svc.counters["bytes_written"])
+        base_rows = int(svc.counters["rows_shipped"])
+        t0 = time.perf_counter()
+        rows = xs.sql(Q).collect()
+        elapsed = time.perf_counter() - t0
+        chk = 0
+        for r in rows:                       # order pinned by ORDER BY g
+            chk = (chk * 1000003 + zlib.crc32(str(r[0]).encode())
+                   + 7 * int(r[1]) + int(r[2])) & 0xFFFFFFFF
+        out[mode] = {
+            "seconds": round(elapsed, 3),
+            "bytes_written": int(svc.counters["bytes_written"]) - base_bytes,
+            "rows_shipped": int(svc.counters["rows_shipped"]) - base_rows,
+            "groups": len(rows),
+            "checksum": chk,
+            "dict_columns_encoded": int(
+                svc.counters["dict_columns_encoded"]),
+            "dict_bytes_saved": int(svc.counters["dict_bytes_saved"]),
+        }
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
 def _bench_dist_sort() -> dict:
     """Distsort lane: the SKEWED 2-process equi-join, range-partitioned
     sort-merge (with skew-span splitting) vs the shuffled hash path.
@@ -1014,6 +1152,13 @@ def child_main() -> None:
     except Exception as e:   # secondary must not sink the primary
         print(f"[bench-child] distsort bench failed: {e}", file=sys.stderr)
         extras["distsort_error"] = str(e)[:300]
+    try:
+        # encoded execution: 2 real worker processes, low-cardinality
+        # string-key join, dictionary-dedup wire vs words-per-block
+        extras.update(_bench_dist_dict())
+    except Exception as e:   # secondary must not sink the primary
+        print(f"[bench-child] distdict bench failed: {e}", file=sys.stderr)
+        extras["distdict_error"] = str(e)[:300]
 
     try:
         load_1m = round(os.getloadavg()[0], 2)
@@ -1041,6 +1186,8 @@ if __name__ == "__main__":
         distjoin_worker_main()
     elif "--distsort-worker" in sys.argv:
         distsort_worker_main()
+    elif "--distdict-worker" in sys.argv:
+        distdict_worker_main()
     elif "--child" in sys.argv:
         child_main()
     else:

@@ -90,6 +90,19 @@ def normalize_valids(batch: "ColumnBatch") -> "ColumnBatch":
     return ColumnBatch(list(batch.names), vectors, rv, batch.capacity)
 
 
+#: running total of dictionary codes decoded back into Python words —
+#: the "late materialization" boundary.  Codes that stay codes through
+#: exchange/join/group never show up here; only collect()-style output
+#: does.  Plain module int: metrics-grade accuracy is enough.
+_LATE_MATERIALIZED_ROWS = 0
+
+
+def late_materialized_rows() -> int:
+    """Total dictionary-encoded values decoded to Python objects so far
+    (process-wide; gauge consumers diff against a baseline)."""
+    return _LATE_MATERIALIZED_ROWS
+
+
 def encode_strings(values: Sequence[Optional[str]]) -> Tuple[np.ndarray, Tuple[str, ...]]:
     """Dictionary-encode strings: codes into a SORTED dictionary.
 
@@ -175,6 +188,9 @@ class ColumnVector:
             data, valid = data[sel], valid[sel]
         out: List[Any] = []
         dt = self.dtype
+        if self.dictionary is not None and len(data):
+            global _LATE_MATERIALIZED_ROWS
+            _LATE_MATERIALIZED_ROWS += len(data)
         for i in range(len(data)):
             if not valid[i]:
                 out.append(None)

@@ -351,6 +351,15 @@ SHUFFLE_WIRE_COMPRESS_THRESHOLD = conf(
     "keeps typical exchange blocks raw → zero-copy decode."
 ).check(lambda v: v >= 0).int(1 << 20)
 
+SHUFFLE_WIRE_DICT_CODES = conf("spark.tpu.shuffle.wire.dictCodes").doc(
+    "Ship each dictionary ONCE per (exchange, sender) in a framed "
+    "sidecar and stamp blocks with an 8-byte fingerprint instead of "
+    "repeating the word list in every block header; receivers cache the "
+    "sidecar and operate on int32 codes, late-materializing words only "
+    "at the output boundary.  Off = legacy per-block inline "
+    "dictionaries (still always decodable)."
+).boolean(True)
+
 SHUFFLE_IO_ASYNC_WRITE = conf("spark.tpu.shuffle.io.asyncWrite").doc(
     "Stage shuffle blocks through a background writer thread so encode+"
     "disk I/O overlaps the device's next exchange step; commit() drains "

@@ -1463,8 +1463,11 @@ class Hash64(Expression):
         for c in self.children:
             v = c.eval(ctx)
             if v.dictionary is not None:
+                # clip BOTH ends: NULL (-1) codes and out-of-dictionary
+                # sentinels (e.g. a remap's INT32_MAX) must gather in
+                # bounds; both are masked/never-match downstream
                 table = xp.asarray(self._string_hash_table(v.dictionary))
-                h = table[xp.clip(v.data, 0, None)]
+                h = table[xp.clip(v.data, 0, max(len(v.dictionary) - 1, 0))]
             else:
                 bits = v.data
                 if np.issubdtype(np.dtype(str(bits.dtype)), np.floating):

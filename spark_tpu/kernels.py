@@ -1055,10 +1055,37 @@ def distinct(xp, batch: ColumnBatch) -> ColumnBatch:
     return out
 
 
+def remap_codes(xp, codes, table):
+    """Gather dictionary codes into a merged code space (jittable).
+
+    ``table[old_code] -> new_code`` must be monotone — engine
+    dictionaries are sorted, so ``merge_dictionaries`` remaps are —
+    which keeps sorted runs sorted across the remap (the range-merge
+    path depends on this).  Sentinel-preserving, unlike a clipping
+    gather: codes at or above ``len(table)`` (the min-buffer identity
+    INT32_MAX on a live all-NULL aggregate row) stay INT32_MAX, and
+    negative codes (NULL -1, the max-buffer / first-value identity
+    INT32_MIN) pass through unchanged, so a reduction identity is still
+    an identity after the hop instead of aliasing onto a real word."""
+    codes = xp.asarray(codes)
+    table = xp.asarray(table)
+    dt = codes.dtype
+    n = int(table.shape[0])
+    if n:
+        gathered = table[xp.clip(codes, 0, n - 1)].astype(dt)
+    else:
+        gathered = codes
+    hi = np.asarray(np.iinfo(np.int32).max, dt)
+    out = xp.where(codes >= n, hi, gathered)
+    return xp.where(codes < 0, codes, out).astype(dt)
+
+
 def union_all(batches: Sequence[ColumnBatch]) -> ColumnBatch:
     """Concatenate batches (host-side shape change; capacity = sum).
 
-    String columns re-encode onto a merged dictionary.
+    String columns re-encode onto a merged dictionary via
+    ``remap_codes``; identical dictionaries (the post-exchange common
+    case — the hop already unified code spaces) skip the remap.
     """
     assert batches
     names = batches[0].names
@@ -1069,23 +1096,26 @@ def union_all(batches: Sequence[ColumnBatch]) -> ColumnBatch:
         dtype = vecs[0].dtype
         dicts = [v.dictionary for v in vecs]
         if dtype.is_string or isinstance(dtype, T.BinaryType):
-            merged = dicts[0] or ()
-            remaps = [None] * len(vecs)
-            for i in range(1, len(vecs)):
-                merged, ra, rb = merge_dictionaries(merged, dicts[i] or ())
-                # ra remaps everything merged so far; fold into earlier remaps
-                for j in range(i):
-                    remaps[j] = ra if remaps[j] is None else ra[remaps[j]]
-                remaps[i] = rb
-            datas = []
-            for v, rm in zip(vecs, remaps):
-                d = np.asarray(v.data)
-                # clip BOTH ends: dead rows may carry out-of-dictionary
-                # sentinel codes (e.g. min-buffer identity = int32 max)
-                datas.append(rm[np.clip(d, 0, len(rm) - 1)]
-                             if rm is not None and len(rm) else d)
-            data = np.concatenate(datas)
-            dictionary = merged
+            if len({d or () for d in dicts}) == 1:
+                data = np.concatenate([np.asarray(v.data) for v in vecs])
+                dictionary = dicts[0] or ()
+            else:
+                merged = dicts[0] or ()
+                remaps = [None] * len(vecs)
+                for i in range(1, len(vecs)):
+                    merged, ra, rb = merge_dictionaries(merged, dicts[i] or ())
+                    # ra remaps everything merged so far; fold into
+                    # earlier remaps
+                    for j in range(i):
+                        remaps[j] = ra if remaps[j] is None else ra[remaps[j]]
+                    remaps[i] = rb
+                datas = []
+                for v, rm in zip(vecs, remaps):
+                    d = np.asarray(v.data)
+                    datas.append(remap_codes(np, d, rm)
+                                 if rm is not None else d)
+                data = np.concatenate(datas)
+                dictionary = merged
         else:
             data = np.concatenate([np.asarray(v.data, dtype.np_dtype) for v in vecs])
             dictionary = None
@@ -1111,8 +1141,7 @@ def align_string_columns(a: ColumnBatch, a_col: str, b: ColumnBatch, b_col: str
     merged, ra, rb = merge_dictionaries(va.dictionary or (), vb.dictionary or ())
 
     def remap(batch, name, vec, rm):
-        data = np.asarray(vec.data)
-        new = rm[np.clip(data, 0, len(rm) - 1)] if len(rm) else data
+        new = remap_codes(np, np.asarray(vec.data), rm)
         i = batch.names.index(name)
         vecs = list(batch.vectors)
         vecs[i] = ColumnVector(new.astype(np.int32), vec.dtype, vec.valid, merged)

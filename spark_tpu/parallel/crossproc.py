@@ -52,9 +52,10 @@ Execution shapes:
    the partial→route→merge pipeline, so each joined row crosses the
    DCN once.  Gated by ``spark.tpu.crossproc.shuffledJoin``.
 2b. range-partitioned sort-merge join — same placement shape, but an
-   equi-join over ONE orderable (non-string) key exchanges by key RANGE:
-   a manifest-only sample round derives identical cut points everywhere,
-   rows ship as per-span SORTED RUNS, the receiver k-way-merges its
+   equi-join over ONE orderable key (numeric, or string: dictionary
+   codes order like words, cut points travel as WORDS) exchanges by key
+   RANGE: a manifest-only sample round derives identical cut points
+   everywhere, rows ship as per-span SORTED RUNS, the receiver k-way-merges its
    build runs and joins with ``PMergeJoin`` (no per-process build sort),
    and spans above ``SKEW_FACTOR × median`` split across reducers with
    the build span replicated — skew mitigation, not just a gauge.
@@ -66,7 +67,7 @@ Execution shapes:
    partitioned leaf unions across processes) and the exchange is
    skipped entirely; the big side never moves.
 3. generic path — everything else (window/distinct/limit/sample,
-   non-equi joins of partitioned tables, string min/max aggs):
+   non-equi joins of partitioned tables):
    partitioned leaves gather through the service first, then the full
    plan runs locally, identically in every process.  This LIFTS the old
    ``_reject_global_ops`` refusal: shapes that were errors now execute
@@ -136,18 +137,6 @@ def _has_global_ops(node) -> bool:
             or isinstance(node, WindowNode):
         return True
     return any(_has_global_ops(c) for c in node.children)
-
-
-def _agg_strings_ok(plan) -> bool:
-    """String-valued min/max/first partial buffers hold per-process
-    dictionary CODES, which cannot merge across processes."""
-    from ..aggregates import First, Max, Min
-    child_schema = plan.children[0].schema()
-    for f, _n in plan.aggs:
-        if isinstance(f, (Min, Max, First)) and f.children \
-                and f.children[0].data_type(child_schema).is_string:
-            return False
-    return True
 
 
 def _joins_maybe_safe(node) -> bool:
@@ -248,7 +237,31 @@ def _route_exchange_merge(session, plan, partial_node, partial: ColumnBatch,
     points: key-hash route partial rows → DCN hop → merge colliding
     partials + finish with the SAME final node the in-slice path uses,
     so the two exchange flavors cannot diverge."""
+    from ..aggregates import First
     from .dist import DFinalAggregate
+
+    # the host partial numbered first/last value-carry ranks with
+    # shard=0, so two processes' ranks would collide and the merge would
+    # crown a LOCAL-row winner; rebase live ranks to the mesh encoding
+    # (pid << 48 | row) so "globally first" means the same thing it does
+    # in-slice.  Dead ranks keep their sentinels — offsetting last's -1
+    # would let its max-reduce resurrect a dead row.
+    if svc.n > 1:
+        base = np.int64(svc.pid) << np.int64(48)
+        vecs = list(partial.vectors)
+        names = list(partial.names)
+        for i, (func, _n) in enumerate(partial_node.slots):
+            if not isinstance(func, First):
+                continue
+            is_last = getattr(func, "ARGREDUCE", "first") == "last"
+            dead = np.int64(-1) if is_last else np.int64(1 << 62)
+            bn_rank, _bn_val, _bn_valid = partial_node.buffer_names(i, func)
+            j = names.index(bn_rank)
+            r = np.asarray(vecs[j].data)
+            vecs[j] = ColumnVector(np.where(r == dead, r, r + base),
+                                   vecs[j].dtype, vecs[j].valid, None)
+        partial = ColumnBatch(names, vecs, partial.row_valid,
+                              partial.capacity)
 
     key_refs = [Col(k.name) for k in plan.keys]
     ectx = EvalContext(partial, np)
@@ -325,11 +338,6 @@ def host_exchange_group_agg(session, df, svc: HostShuffleService,
     if not plan.keys:
         raise ValueError("global aggregates have no key range to "
                          "exchange; run them per-process and psum")
-    if not _agg_strings_ok(plan):
-        raise ValueError(
-            "string-valued min/max/first buffers hold per-process "
-            "dictionary CODES, which cannot merge across processes — "
-            "cast to a comparable type or aggregate in-slice")
     if _has_global_ops(plan.children[0]):
         raise ValueError(
             "a global operator below the cross-process exchange would "
@@ -543,10 +551,15 @@ def _shuffled_join_shards(session, join, key_pairs,
        slice), through the ordinary exchange with its retry/blacklist/
        refetch machinery; a process's own range never touches the disk.
 
-    Equal keys hash equally on both sides (``Hash64`` hashes dictionary
-    WORDS, not codes, and normalizes floats), so every join match is
-    local after the hop; NULL keys route deterministically and never
-    match, preserving outer/semi/anti semantics per shard."""
+    Equal keys hash equally on both sides (``Hash64`` gathers each
+    code's WORD hash through a per-dictionary table — value-consistent
+    however the code spaces differ — and normalizes floats), so every
+    join match is local after the hop; NULL keys route deterministically
+    and never match, preserving outer/semi/anti semantics per shard.
+    Dictionary columns ship as bare codes (the dedup wire sends each
+    word list once per sender) and land in ONE unified code space
+    (``HostShuffleService._unify_code_space``), so the local hash join
+    compares int32 codes without touching words."""
     from .. import config as C
 
     n_fine = svc.n * session.conf.get(C.SHUFFLE_FINE_PARTITIONS)
@@ -668,37 +681,44 @@ def _range_merge_join_shards(session, join, spec,
     process: probe-side nulls still reach a reducer (left/anti need the
     rows), build-side nulls sink to each run's tail and stay inert."""
     from .. import config as C
-    from ..sql.joins import range_encode_key
+    from ..sql.joins import range_encode_key, range_encode_key_ex
     from ..native.merge import merge_sorted_runs
 
-    l_expr, r_expr, l_as_float, r_as_float = spec
+    l_expr, r_expr, l_as_float, r_as_float, is_str = spec
     n_fine = svc.n * session.conf.get(C.SHUFFLE_FINE_PARTITIONS)
     target = session.conf.get(C.SHUFFLE_TARGET_PARTITION_BYTES)
     sample_k = session.conf.get(C.SHUFFLE_RANGE_SAMPLE_SIZE)
 
-    # 1. local runs + monotonic key encodings
+    # 1. local runs + monotonic key encodings.  String keys encode as
+    # dictionary CODES — monotone in the words locally (sorted
+    # dictionaries), but each process/side has its own code space, so
+    # the sample round below exchanges WORDS and each process maps the
+    # agreed word cuts back into its local code space.
     sides = []
     for subtree, expr, as_f in ((join.children[0], l_expr, l_as_float),
                                 (join.children[1], r_expr, r_as_float)):
         local = compact(np, _run_local(session, subtree).to_host())
         ectx = EvalContext(local, np)
-        encoded = range_encode_key(ectx, expr, as_f)
+        encoded = range_encode_key_ex(ectx, expr, as_f)
         if encoded is None:      # guarded by range_key_spec upstream
             raise RuntimeError("range join key lost its orderable "
                                "encoding between planning and execution")
-        enc, ok = encoded
-        sides.append((local, np.asarray(enc), np.asarray(ok)))
+        enc, ok, kdict = encoded
+        sides.append((local, np.asarray(enc), np.asarray(ok),
+                      kdict or ()))
 
     # 2. sample round: evenly-spaced points of each side's sorted keys,
     # weighted by rows-per-point so quantiles track row mass
     sample = {}
-    for tag, (_local, enc, ok) in zip(("l", "r"), sides):
+    for tag, (_local, enc, ok, kdict) in zip(("l", "r"), sides):
         keys = np.sort(enc[ok])
         if len(keys):
             idx = np.linspace(0, len(keys) - 1,
                               num=min(sample_k, len(keys))).astype(np.int64)
             pts = keys[idx]
-            sample[tag] = {"points": [int(x) for x in pts],
+            points = [str(kdict[int(c)]) for c in pts] if is_str \
+                else [int(x) for x in pts]
+            sample[tag] = {"points": points,
                            "weight": len(keys) / len(pts)}
         else:
             sample[tag] = {"points": [], "weight": 0.0}
@@ -709,12 +729,15 @@ def _range_merge_join_shards(session, join, spec,
     # cut points: identical manifest set + sorted sender order + stable
     # sort → every process derives the SAME cuts.  np.unique collapses a
     # hot key's duplicate quantiles into ONE wide span (split below).
+    # String cuts stay WORDS (object arrays sort/unique fine) until the
+    # per-side code-space mapping below.
+    pt_dtype = object if is_str else np.int64
     pts_all, wts_all = [], []
     for s in sorted(mans):
         for tag in ("l", "r"):
             d = mans[s].get("sample", {}).get(tag, {})
             if d.get("points"):
-                pts_all.append(np.asarray(d["points"], np.int64))
+                pts_all.append(np.asarray(d["points"], pt_dtype))
                 wts_all.append(np.full(len(d["points"]),
                                        float(d.get("weight", 1.0))))
     if pts_all:
@@ -728,16 +751,25 @@ def _range_merge_join_shards(session, join, spec,
                           0, len(pts) - 1)
         cuts = np.unique(pts[cut_idx])
     else:
-        cuts = np.zeros(0, np.int64)
-    svc.last_range_cutpoints = [int(c) for c in cuts]
+        cuts = np.zeros(0, pt_dtype)
+    svc.last_range_cutpoints = [str(c) for c in cuts] if is_str \
+        else [int(c) for c in cuts]
     n_spans = len(cuts) + 1
 
     # 3. span bucketing with (null_flag, key) tie sort → sorted runs;
-    # size round + skew-splitting reducer plan
+    # size round + skew-splitting reducer plan.  For string keys each
+    # side maps the shared cut WORDS into its local code space first:
+    # searchsorted(dict, cut, "left") is the smallest code whose word
+    # >= the cut, and range_bucket counts cuts <= key (side="right"),
+    # so a row's span depends only on its WORD — identical on every
+    # process/side no matter how the local dictionaries differ.
     bucketed_sides = []
     sizes: Dict[int, int] = {}
-    for base, (local, enc, ok) in zip((0, n_spans), sides):
-        spans = range_bucket(np, enc, cuts)
+    for base, (local, enc, ok, kdict) in zip((0, n_spans), sides):
+        local_cuts = np.searchsorted(
+            np.asarray(kdict, object), np.asarray(cuts, object),
+            side="left").astype(np.int64) if is_str else cuts
+        spans = range_bucket(np, enc, local_cuts)
         flag = (~ok).astype(np.int8)
         bucketed, off, cnt = partition_host_slices(
             np, local, spans, n_spans, tie_keys=[flag, enc])
@@ -834,8 +866,7 @@ def crossproc_execute(session, optimized, svc: HostShuffleService
 
     maybe_fast = (isinstance(node, L.Aggregate) and bool(node.keys)
                   and not _has_global_ops(node.children[0])
-                  and _joins_maybe_safe(node.children[0])
-                  and _agg_strings_ok(node))
+                  and _joins_maybe_safe(node.children[0]))
 
     # exchange-join candidate: the topmost join on the per-row spine
     # (under a root Aggregate when one is present), with >= 1 equi key
@@ -847,12 +878,12 @@ def crossproc_execute(session, optimized, svc: HostShuffleService
     if shuffled_on or smj_on or bcast_threshold > 0:
         from ..sql.joins import equi_join_keys
         # search under a root Aggregate ONLY when its partials can merge
-        # across processes (keyed, mergeable buffers) — that is the sole
-        # finishing mode for a join below an aggregate; any other root
-        # must itself sit on the per-row spine
+        # across processes (keyed buffers — string min/max/first merge
+        # too, on unified dictionary codes) — that is the sole finishing
+        # mode for a join below an aggregate; any other root must itself
+        # sit on the per-row spine
         if isinstance(node, L.Aggregate):
-            spine = (node.children[0]
-                     if node.keys and _agg_strings_ok(node) else node)
+            spine = node.children[0] if node.keys else node
         else:
             spine = node
         join = _find_spine_join(spine)
@@ -955,8 +986,7 @@ def crossproc_execute(session, optimized, svc: HostShuffleService
             join2 = L.Join(L.LocalRelation(left_shard),
                            L.LocalRelation(right_shard),
                            join.how, join.on, join.using)
-        if (isinstance(node, L.Aggregate) and bool(node.keys)
-                and _agg_strings_ok(node)):
+        if isinstance(node, L.Aggregate) and bool(node.keys):
             # keyed Aggregate above the join: merge via the existing
             # partial→route→merge pipeline instead of gathering raw join
             # output — each joined row crosses the DCN once (as state)

@@ -551,16 +551,31 @@ class DPartialAggregate(DNode):
                 names += [bn_rank, bn_val, bn_valid]
                 np_v = np.dtype(str(v_red.dtype)) if xp is jnp \
                     else np.asarray(v_red).dtype
+                # dictionary value buffers keep the STRING dtype so the
+                # codes stay attached to their words across the DCN hop
+                # (the exchange dedups/unifies the dictionaries); plain
+                # values keep the raw engine dtype as before
+                v_dt = func.children[0].data_type(batch.schema) \
+                    if v.dictionary is not None else T.np_dtype_to_engine(np_v)
                 vectors.append(ColumnVector(r_red, T.int64, None, None))
                 vectors.append(ColumnVector(
-                    v_red, T.np_dtype_to_engine(np_v), None, v.dictionary))
+                    v_red, v_dt, None, v.dictionary))
                 vectors.append(ColumnVector(valid_red, T.int8, None, None))
                 continue
             specs = func.make_buffers(ectx, live)
+            odict = func.output_dictionary(ectx)
             for j, (bn, spec) in enumerate(zip(self.buffer_names(i, func), specs)):
                 reduced = _reduce_buf(xp, spec.data, perm, seg_ids, capacity,
                                       spec.kind)
                 names.append(bn)
+                if j == 0 and odict is not None:
+                    # min/max over a dictionary column: the value buffer
+                    # IS codes — type it as the string column it reduces
+                    # so union_all/the exchange carry (and unify) the
+                    # dictionary instead of shipping bare ints
+                    vectors.append(ColumnVector(
+                        reduced, func.data_type(batch.schema), None, odict))
+                    continue
                 vectors.append(ColumnVector(reduced, T.np_dtype_to_engine(spec.np_dtype)
                                             if spec.np_dtype != np.bool_ else T.boolean,
                                             None, None))

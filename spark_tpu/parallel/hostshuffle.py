@@ -68,11 +68,12 @@ import queue
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..columnar import ColumnBatch
+from ..columnar import ColumnBatch, ColumnVector
+from .. import columnar as _col
 from .. import config as C
 from .. import wire
 
@@ -124,11 +125,15 @@ def _jitter(seed: str, attempt: int) -> float:
     return 0.5 + (h % 1024) / 1024.0
 
 
-def _decode_block(data: bytes) -> List[ColumnBatch]:
+def _decode_block(data: bytes,
+                  dict_table: Optional[Dict[str, tuple]] = None
+                  ) -> List[ColumnBatch]:
     """Wire-framed payload → batches; pre-wire pickle blocks (a mixed-
-    version pod mid-upgrade) still decode, keyed off the magic bytes."""
+    version pod mid-upgrade) still decode, keyed off the magic bytes.
+    ``dict_table`` resolves fingerprint-only dictionary references
+    (blocks written with the dedup wire, ``wire.dict_fingerprint``)."""
     if data[:4] == wire.MAGIC or len(data) < wire.PREFIX_LEN:
-        return wire.decode_batches(data)
+        return wire.decode_batches(data, dict_table=dict_table)
     return pickle.loads(data)
 
 
@@ -164,7 +169,8 @@ class RetryingBlockReader:
         self._on_retry = on_retry
         self._on_read = on_read
 
-    def _try_read(self, path: str, expect_size: Optional[int]):
+    def _try_read(self, path: str, expect_size: Optional[int],
+                  decode: Optional[Callable[[bytes], Any]] = None):
         size = os.path.getsize(path)          # FileNotFoundError retries
         if expect_size is not None and size != expect_size:
             raise BlockFetchError(
@@ -172,19 +178,23 @@ class RetryingBlockReader:
         with open(path, "rb") as f:
             data = f.read()
         t0 = time.perf_counter()
-        out = _decode_block(data)
+        out = (decode or _decode_block)(data)
         if self._on_read is not None:
             self._on_read(len(data), time.perf_counter() - t0)
         return out
 
     def read(self, path: str, expect_size: Optional[int] = None,
-             deadline: Optional[float] = None):
+             deadline: Optional[float] = None,
+             decode: Optional[Callable[[bytes], Any]] = None):
         """Decoded payload of ``path``; ``expect_size`` is the sender's
-        manifested byte size (mismatch = partial write, retried)."""
+        manifested byte size (mismatch = partial write, retried).
+        ``decode`` overrides the block decoder (dictionary sidecars and
+        the dedup-aware per-sender closures use this); whatever it
+        raises classifies through the same RETRYABLE/fail-fast split."""
         last: Optional[BaseException] = None
         for attempt in range(self.max_retries + 1):
             try:
-                return self._try_read(path, expect_size)
+                return self._try_read(path, expect_size, decode)
             except self.RETRYABLE as e:
                 last = e
             except wire.WireFormatError as e:
@@ -232,6 +242,7 @@ class HostShuffleService:
         self.fetch_threads = conf.get(C.SHUFFLE_IO_FETCH_THREADS)
         self.wire_codec = conf.get(C.SHUFFLE_WIRE_CODEC)
         self.wire_threshold = conf.get(C.SHUFFLE_WIRE_COMPRESS_THRESHOLD)
+        self.dict_codes = conf.get(C.SHUFFLE_WIRE_DICT_CODES)
         if host_names is None:
             # single-sourced naming convention (lazy: cluster pulls jax)
             from .cluster import default_host_name
@@ -264,6 +275,11 @@ class HostShuffleService:
             # execution-shape counters bumped by crossproc_execute
             "shuffled_joins": 0, "fast_path_aggs": 0,
             "range_merge_joins": 0, "broadcast_joins": 0,
+            # encoded execution: dictionary columns framed as codes with
+            # the word list deduplicated into a once-per-sender sidecar,
+            # and receiver-side remaps into the unified code space
+            "dict_columns_encoded": 0, "dict_bytes_saved": 0,
+            "codes_remapped": 0,
         }
         #: reduce-partition byte sizes of the most recent ``plan_reducers``
         #: / ``plan_range_reducers`` call (manifest-summed), feeding the
@@ -291,6 +307,16 @@ class HostShuffleService:
             clock=clock, sleep=sleep, on_retry=self._count_retry,
             on_read=self._count_read)
         self._staged: Dict[str, Dict[int, int]] = {}
+        #: sender side — every dictionary framed in this exchange's
+        #: blocks, keyed by fingerprint; serialized ONCE into a sidecar
+        #: at commit() instead of inline in every block header
+        self._dict_refs: Dict[str, Dict[str, tuple]] = {}
+        #: receiver side — (exchange, sender) → fingerprint → words,
+        #: loaded lazily from the sender's sidecar on first reference
+        self._dict_tables: Dict[Tuple[str, int], Dict[str, tuple]] = {}
+        #: process-wide late-materialization count at service birth, so
+        #: the gauge reports this service's lifetime only
+        self._latemat_base = _col.late_materialized_rows()
         # background writer: lazily started, drained by commit()/flush()
         self._write_q: "queue.Queue[Optional[Tuple[str, str, List[ColumnBatch]]]]" = queue.Queue()
         self._writer: Optional[threading.Thread] = None
@@ -323,6 +349,9 @@ class HostShuffleService:
     def _done(self, exchange: str, sender: int) -> str:
         return os.path.join(self._dir(exchange), f"s{sender:04d}.done")
 
+    def _dict_path(self, exchange: str, sender: int) -> str:
+        return os.path.join(self._dir(exchange), f"s{sender:04d}.dict")
+
     # -- write side ------------------------------------------------------
     def _write_block(self, exchange: str, receiver: int,
                      batches: List[ColumnBatch]) -> None:
@@ -330,8 +359,17 @@ class HostShuffleService:
         size.  Runs on the writer thread when asyncWrite is on."""
         path = self._part(exchange, self.pid, receiver)
         t0 = time.perf_counter()
+        refs: Optional[Dict[str, tuple]] = None
+        stats: Dict[str, int] = {}
+        if self.dict_codes:
+            with self._lock:
+                refs = self._dict_refs.setdefault(exchange, {})
+        # refs is mutated outside the lock: blocks for one exchange are
+        # encoded by a single thread (the writer loop, or the caller
+        # when asyncWrite is off), so no concurrent writer exists
         buf = wire.encode_batches(batches, codec=self.wire_codec,
-                                  compress_threshold=self.wire_threshold)
+                                  compress_threshold=self.wire_threshold,
+                                  dict_refs=refs, stats=stats)
         t1 = time.perf_counter()
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
@@ -347,6 +385,8 @@ class HostShuffleService:
                 int(b.capacity) for b in batches)
             self.timers["encode_s"] += t1 - t0
             self.timers["write_s"] += t2 - t1
+            for k, v in stats.items():
+                self.counters[k] += v
 
     def _writer_loop(self) -> None:
         while True:
@@ -411,14 +451,29 @@ class HostShuffleService:
         with self._lock:
             self.timers["commit_wait_s"] += time.perf_counter() - t0
             staged = dict(self._staged.get(exchange, {}))
+            refs = dict(self._dict_refs.get(exchange, {}))
         os.makedirs(self._dir(exchange), exist_ok=True)
+        man = {"ts": time.time(),
+               "host": self.host_name(self.pid),
+               "blocks": {str(r): sz for r, sz in staged.items()}}
+        if refs:
+            # dictionary sidecar: every word list this sender's blocks
+            # reference by fingerprint, shipped once — published (atomic
+            # rename) BEFORE the manifest that names its size, the same
+            # ordering the data blocks rely on
+            blob = wire.encode_dict_table(refs)
+            dpath = self._dict_path(exchange, self.pid)
+            dtmp = f"{dpath}.tmp.{os.getpid()}"
+            with open(dtmp, "wb") as f:
+                f.write(blob)
+            os.replace(dtmp, dpath)
+            man["dict_bytes"] = len(blob)
+            with self._lock:
+                self.counters["bytes_written"] += len(blob)
         path = self._done(exchange, self.pid)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            json.dump({"ts": time.time(),
-                       "host": self.host_name(self.pid),
-                       "blocks": {str(r): sz for r, sz in staged.items()}},
-                      f)
+            json.dump(man, f)
         os.replace(tmp, path)
 
     def _read_manifest(self, exchange: str, sender: int) -> Optional[dict]:
@@ -704,6 +759,39 @@ class HostShuffleService:
             max_workers=max(1, min(self.fetch_threads, n_tasks)),
             thread_name_prefix=f"shuffle-fetch-{self.pid}")
 
+    # -- dictionary sidecars (encoded execution) -------------------------
+    def _load_dict_table(self, exchange: str, sender: int,
+                         deadline: Optional[float] = None
+                         ) -> Dict[str, tuple]:
+        """Fetch + cache one sender's dictionary sidecar.  Goes through
+        the retrying reader (a sidecar is a block like any other: it can
+        be transiently invisible, torn, or corrupt); an unrecoverable
+        sidecar surfaces as ``BlockFetchError``, which the enclosing
+        block read classifies as retryable — so the whole lookup stays
+        inside the exchange's bounded fault discipline."""
+        man = self._read_manifest(exchange, sender) or {}
+        table = self._reader.read(
+            self._dict_path(exchange, sender),
+            expect_size=man.get("dict_bytes"), deadline=deadline,
+            decode=wire.decode_dict_table)
+        with self._lock:
+            self._dict_tables[(exchange, sender)] = table
+        return table
+
+    def _decode_with_dicts(self, exchange: str, sender: int, data: bytes,
+                           deadline: Optional[float] = None
+                           ) -> List[ColumnBatch]:
+        """Decode one block, resolving fingerprint-only dictionary
+        references against the sender's cached sidecar (loading it on
+        first miss)."""
+        with self._lock:
+            table = self._dict_tables.get((exchange, sender))
+        try:
+            return _decode_block(data, table)
+        except wire.DictFingerprintError:
+            table = self._load_dict_table(exchange, sender, deadline)
+            return _decode_block(data, table)
+
     def collect(self, exchange: str,
                 receiver: Optional[int] = None) -> List[ColumnBatch]:
         """All blocks addressed to `receiver` (default: this process),
@@ -711,22 +799,23 @@ class HostShuffleService:
         ``refetch`` for manifest-checked loss detection).  Reads+decodes
         run through the fetch pool."""
         r = self.pid if receiver is None else receiver
-        paths = [p for s in range(self.n)
-                 if os.path.exists(p := self._part(exchange, s, r))]
-        if not paths:
+        work = [(s, p) for s in range(self.n)
+                if os.path.exists(p := self._part(exchange, s, r))]
+        if not work:
             return []
 
-        def read_one(path: str) -> List[ColumnBatch]:
+        def read_one(item: Tuple[int, str]) -> List[ColumnBatch]:
+            s, path = item
             with open(path, "rb") as f:
                 data = f.read()
             t0 = time.perf_counter()
-            out = _decode_block(data)
+            out = self._decode_with_dicts(exchange, s, data)
             self._count_read(len(data), time.perf_counter() - t0)
             return out
 
         out: List[ColumnBatch] = []
-        with self._pool(len(paths)) as pool:
-            for batches in pool.map(read_one, paths):
+        with self._pool(len(work)) as pool:
+            for batches in pool.map(read_one, work):
                 out.extend(batches)
         return out
 
@@ -767,8 +856,10 @@ class HostShuffleService:
 
             def fetch_one(item):
                 s, path, size, _host = item
-                return s, self._reader.read(path, expect_size=size,
-                                            deadline=deadline)
+                return s, self._reader.read(
+                    path, expect_size=size, deadline=deadline,
+                    decode=lambda d, s=s: self._decode_with_dicts(
+                        exchange, s, d, deadline))
 
             with self._pool(len(work)) as pool:
                 futures = [pool.submit(fetch_one, item) for item in work]
@@ -803,6 +894,61 @@ class HostShuffleService:
         return [wire.trim_host(b.to_host())
                 for b in per_receiver.get(self.pid, [])]
 
+    def _unify_code_space(self, batches: List[ColumnBatch]
+                          ) -> List[ColumnBatch]:
+        """Merge per-sender dictionaries into ONE sorted global
+        dictionary per column and remap every batch's codes into it.
+
+        After the hop each sender's dictionary columns arrive in their
+        own code space; merging into a single sorted dictionary (code
+        order == lex order, the engine invariant) lets every downstream
+        operator — hash, bucket, compare, merge, reduce — work on int32
+        codes directly, materializing words only at the output boundary.
+        ``kernels.remap_codes`` remaps are MONOTONE, so blocks the
+        sender emitted sorted stay sorted (the range-merge join relies
+        on this).  When all senders already share one dictionary (the
+        common static-dictionary case) nothing is touched."""
+        from ..kernels import remap_codes
+        merged_by_name: Dict[str, tuple] = {}
+        for name in {n for b in batches for n, v in zip(b.names, b.vectors)
+                     if v.dictionary is not None}:
+            dicts = {b.column(name).dictionary for b in batches
+                     if name in b and b.column(name).dictionary is not None}
+            if len(dicts) > 1:
+                merged_by_name[name] = tuple(sorted(set().union(*dicts)))
+        if not merged_by_name:
+            return batches
+        remaps: Dict[Tuple[str, tuple], Optional[np.ndarray]] = {}
+        out: List[ColumnBatch] = []
+        n_remapped = 0
+        for b in batches:
+            vectors = list(b.vectors)
+            changed = False
+            for i, (name, v) in enumerate(zip(b.names, b.vectors)):
+                merged = merged_by_name.get(name)
+                if (merged is None or v.dictionary is None
+                        or v.dictionary == merged):
+                    continue
+                key = (name, v.dictionary)
+                rm = remaps.get(key)
+                if rm is None:
+                    pos = {w: j for j, w in enumerate(merged)}
+                    rm = np.asarray([pos[w] for w in v.dictionary],
+                                    np.int32)
+                    remaps[key] = rm
+                data = remap_codes(np, np.asarray(v.data), rm)
+                vectors[i] = ColumnVector(
+                    data.astype(v.data.dtype, copy=False), v.dtype,
+                    v.valid, merged)
+                n_remapped += int(data.shape[0])
+                changed = True
+            out.append(ColumnBatch(b.names, vectors, b.row_valid,
+                                   b.capacity) if changed else b)
+        if n_remapped:
+            with self._lock:
+                self.counters["codes_remapped"] += n_remapped
+        return out
+
     def exchange(self, exchange: str,
                  per_receiver: Dict[int, Sequence[ColumnBatch]]
                  ) -> List[ColumnBatch]:
@@ -833,7 +979,7 @@ class HostShuffleService:
                 self.put(exchange, r, batches)
         self.commit(exchange)
         remote = self._fetch_remote(exchange, t0)
-        return own + remote
+        return self._unify_code_space(own + remote)
 
     def refetch(self, exchange: str,
                 per_receiver: Optional[Dict[int, Sequence[ColumnBatch]]]
@@ -851,7 +997,7 @@ class HostShuffleService:
         self.counters["refetches"] += 1
         own = self._own(per_receiver or {})
         remote = self._fetch_remote(exchange, self._clock())
-        return own + remote
+        return self._unify_code_space(own + remote)
 
     # -- observability ---------------------------------------------------
     def metrics_source(self):
@@ -885,6 +1031,10 @@ class HostShuffleService:
         gauges["range_cutpoints"] = lambda: (
             len(self.last_range_cutpoints)
             if self.last_range_cutpoints is not None else 0)
+        # encoded execution: rows whose dictionary codes were decoded to
+        # words — only the output boundary (collect) should pay this
+        gauges["late_materialized_rows"] = lambda: (
+            _col.late_materialized_rows() - self._latemat_base)
         gauges["blacklisted_peers"] = lambda: len(self.blacklist)
         gauges["blacklist"] = lambda: ",".join(
             self.host_name(p) for p in sorted(self.blacklist)) or ""
@@ -897,6 +1047,10 @@ class HostShuffleService:
             pass
         d = self._dir(exchange)
         self._staged.pop(exchange, None)
+        with self._lock:
+            self._dict_refs.pop(exchange, None)
+            for key in [k for k in self._dict_tables if k[0] == exchange]:
+                del self._dict_tables[key]
         try:
             for name in os.listdir(d):
                 try:

@@ -159,8 +159,17 @@ def _orderable_f64(xp, x):
 
 def range_encode_key(ctx: EvalContext, expr: Expression,
                      as_float: bool = False):
-    """Monotonic, PROCESS-INDEPENDENT int64 encoding of one join-key
-    column for range partitioning, or None when no such encoding exists.
+    """Monotonic int64 encoding of one join-key column for range
+    partitioning, or None when no such encoding exists — see
+    ``range_encode_key_ex`` (this wrapper drops the dictionary)."""
+    r = range_encode_key_ex(ctx, expr, as_float)
+    return None if r is None else r[:2]
+
+
+def range_encode_key_ex(ctx: EvalContext, expr: Expression,
+                        as_float: bool = False):
+    """Monotonic int64 encoding of one join-key column for range
+    partitioning, or None when no such encoding exists.
 
     Ints/bools pass through; floats take the ``_orderable_f64`` sign-flip
     bitcast — the SAME normalization ``_exact_encode_pair`` applies, so
@@ -169,16 +178,27 @@ def range_encode_key(ctx: EvalContext, expr: Expression,
     both sides encode through float64.  NULL-key and dead rows fold to
     ``_RANGE_NULL`` (span 0 on every process — deterministic routing;
     they can never match, the local join's null masks handle them).
-    Dictionary strings return None: their canonical id space is built
-    per-process from the pair's two dictionaries and is NOT comparable
-    across processes, so string keys stay on the hash exchange.
 
-    Returns ``(enc, ok)``: the routing keys and the live-and-non-null
-    mask."""
+    Dictionary strings encode as their int32 CODES: dictionaries are
+    SORTED (code order == lex order), so codes are monotone in the words
+    — locally orderable, but NOT comparable across processes or sides
+    until the caller maps shared cut WORDS into each local code space
+    (``_range_merge_join_shards``) and the exchange unifies the
+    dictionaries after the hop.  The dictionary rides along in the third
+    tuple slot for exactly that purpose.
+
+    Returns ``(enc, ok, dictionary)``: routing keys, the
+    live-and-non-null mask, and the column's dictionary (None for
+    non-string keys)."""
     xp = ctx.xp
     v = ctx.broadcast(expr.eval(ctx))
+    ok = ctx.batch.row_valid_or_true()
+    if v.valid is not None:
+        ok = ok & xp.broadcast_to(v.valid, (ctx.capacity,))
     if v.dictionary is not None:
-        return None
+        ok = ok & (v.data >= 0)            # NULL code sentinel (-1)
+        enc = v.data.astype(np.int64)
+        return xp.where(ok, enc, _RANGE_NULL), ok, v.dictionary
     dt = np.dtype(str(v.data.dtype))
     if as_float or np.issubdtype(dt, np.floating):
         enc = _orderable_f64(xp, v.data.astype(np.float64))
@@ -186,20 +206,19 @@ def range_encode_key(ctx: EvalContext, expr: Expression,
         enc = v.data.astype(np.int64)
     else:
         return None
-    ok = ctx.batch.row_valid_or_true()
-    if v.valid is not None:
-        ok = ok & xp.broadcast_to(v.valid, (ctx.capacity,))
-    return xp.where(ok, enc, _RANGE_NULL), ok
+    return xp.where(ok, enc, _RANGE_NULL), ok, None
 
 
 def range_key_spec(node: Join, left_schema: T.StructType,
                    right_schema: T.StructType):
     """Eligibility gate for the range-partitioned merge join: exactly ONE
-    equi-key pair whose two sides are both orderable non-string types.
-    Returns ``(l_expr, r_expr, l_as_float, r_as_float)`` or None.  Right/
-    full joins are excluded — the skew mitigation replicates the build
-    side per split span, which would double-count build-side
-    null-extension."""
+    equi-key pair whose two sides are both orderable types — numeric, or
+    string-vs-string (dictionaries are sorted, so codes order like
+    words; cut points travel as WORDS and map into each local code
+    space).  Returns ``(l_expr, r_expr, l_as_float, r_as_float,
+    is_string)`` or None.  Right/full joins are excluded — the skew
+    mitigation replicates the build side per split span, which would
+    double-count build-side null-extension."""
     if node.how not in ("inner", "left", "left_semi", "left_anti"):
         return None
     keys = equi_join_keys(node)
@@ -216,14 +235,19 @@ def range_key_spec(node: Join, left_schema: T.StructType,
             return "int"
         if dt.is_fractional:
             return "float"
-        return None                        # strings, dates, complex types
+        if dt.is_string:
+            return "str"                   # dictionary codes, word cuts
+        return None                        # dates, binary, complex types
 
     lk = _kind(l, left_schema)
     rk = _kind(r, right_schema)
     if lk is None or rk is None:
         return None
+    if (lk == "str") != (rk == "str"):
+        return None                        # str never coerces to numeric
     mixed = lk != rk
-    return l, r, mixed and lk == "int", mixed and rk == "int"
+    return (l, r, mixed and lk == "int", mixed and rk == "int",
+            lk == "str")
 
 
 def _exact_encode_pair(pctx: EvalContext, bctx: EvalContext,

@@ -48,6 +48,7 @@ than the memcpy it protects.
 from __future__ import annotations
 
 import base64
+import hashlib
 import json
 import struct
 import zlib
@@ -62,8 +63,9 @@ from .columnar import ColumnBatch, ColumnVector
 
 __all__ = [
     "MAGIC", "WIRE_VERSION", "WireFormatError", "ChecksumError",
-    "TruncatedBlockError", "encode_batches", "decode_batches",
-    "frame_info", "raw_nbytes", "trim_host",
+    "TruncatedBlockError", "DictFingerprintError", "encode_batches",
+    "decode_batches", "dict_fingerprint", "encode_dict_table",
+    "decode_dict_table", "frame_info", "raw_nbytes", "trim_host",
 ]
 
 MAGIC = b"STCB"
@@ -84,6 +86,17 @@ class TruncatedBlockError(WireFormatError):
 class ChecksumError(WireFormatError):
     """Frame-length bytes arrived but the checksum disagrees (corruption
     or an overlapped torn write that preserved the length)."""
+
+
+class DictFingerprintError(WireFormatError):
+    """A column references a deduplicated dictionary by fingerprint that
+    the caller's dictionary table does not hold.  Not a corruption: the
+    block itself is intact — the reader must fetch the sender's
+    dictionary sidecar and decode again."""
+
+    def __init__(self, msg: str, fingerprint: str = ""):
+        super().__init__(msg)
+        self.fingerprint = fingerprint
 
 
 def default_codec(conf: Optional[C.Conf] = None) -> str:
@@ -126,6 +139,49 @@ def _dict_from_header(h: Optional[dict]) -> Optional[Tuple]:
     if h["enc"] == "b64":
         return tuple(base64.b64decode(v) for v in h["items"])
     return tuple(h["items"])
+
+
+#: fingerprint memo keyed by the (hashable, immutable) dictionary tuple —
+#: a sender re-fingerprints the SAME fat dictionary once per block frame,
+#: and the tuple-equality probe is ~25x cheaper than re-hashing the words
+_FP_MEMO: Dict[Tuple, str] = {}
+
+
+def dict_fingerprint(words: Tuple) -> str:
+    """Content fingerprint of a column dictionary (8-byte blake2b, hex).
+
+    Length-prefixed so (``"ab","c"``) and (``"a","bc"``) differ; the
+    empty dictionary has a well-defined fingerprint too (a zero-length
+    digest input, NOT a missing one — an all-NULL string column ships an
+    empty dictionary, never none)."""
+    fp = _FP_MEMO.get(words)
+    if fp is not None:
+        return fp
+    h = hashlib.blake2b(digest_size=8)
+    for w in words:
+        b = w if isinstance(w, (bytes, bytearray)) else str(w).encode("utf-8")
+        h.update(len(b).to_bytes(4, "little"))
+        h.update(bytes(b))
+    fp = h.hexdigest()
+    if len(_FP_MEMO) >= 1024:            # bound the memo, keep it simple
+        _FP_MEMO.clear()
+    _FP_MEMO[words] = fp
+    return fp
+
+
+_COST_MEMO: Dict[Tuple, int] = {}
+
+
+def _dict_inline_cost(words: Tuple) -> int:
+    """Bytes the inline ``"dict"`` header entry would have cost — the
+    per-occurrence saving the dedup path banks after the first ship."""
+    cost = _COST_MEMO.get(words)
+    if cost is None:
+        cost = len(json.dumps(_dict_to_header(words), separators=(",", ":")))
+        if len(_COST_MEMO) >= 1024:
+            _COST_MEMO.clear()
+        _COST_MEMO[words] = cost
+    return cost
 
 
 # ---------------------------------------------------------------------------
@@ -208,11 +264,23 @@ def raw_nbytes(batches: Sequence[ColumnBatch]) -> int:
 def encode_batches(batches: Sequence[ColumnBatch], *,
                    codec: Optional[str] = None,
                    compress_threshold: Optional[int] = None,
-                   conf: Optional[C.Conf] = None) -> bytes:
+                   conf: Optional[C.Conf] = None,
+                   dict_refs: Optional[Dict[str, Tuple]] = None,
+                   stats: Optional[Dict[str, int]] = None) -> bytes:
     """One framed wire block holding ``batches`` (host arrays; device
     batches are pulled to host first).  Faithful: capacity, row masks,
     validity and dictionaries round-trip exactly — padding removal is the
-    CALLER'S move (``trim_host``), the codec never drops rows."""
+    CALLER'S move (``trim_host``), the codec never drops rows.
+
+    ``dict_refs`` (a mutable {fingerprint: words} registry the caller
+    keeps per exchange/sender) switches dictionary columns to the
+    DEDUPLICATED encoding: the block header carries only an 8-byte
+    ``"dfp"`` fingerprint, the words land in ``dict_refs`` for the
+    caller to ship once in a sidecar (``encode_dict_table``), and
+    ``decode_batches`` needs the matching table back.  ``stats`` (when
+    given with ``dict_refs``) accumulates ``dict_columns_encoded`` and
+    ``dict_bytes_saved`` — the inline header bytes every repeat
+    occurrence no longer pays."""
     codec = codec if codec is not None else default_codec(conf)
     threshold = (compress_threshold if compress_threshold is not None
                  else default_threshold(conf))
@@ -223,7 +291,7 @@ def encode_batches(batches: Sequence[ColumnBatch], *,
         cols: List[dict] = []
         for v in b.vectors:
             data = np.asarray(v.data)
-            cols.append({
+            cm = {
                 "dtype": _dtype_name(v.dtype),
                 "np": data.dtype.str,
                 "shape": list(data.shape),
@@ -232,7 +300,20 @@ def encode_batches(batches: Sequence[ColumnBatch], *,
                 "valid": (None if v.valid is None else
                           w.add(np.packbits(
                               np.asarray(v.valid).astype(bool)).tobytes())),
-            })
+            }
+            if dict_refs is not None and v.dictionary is not None:
+                fp = dict_fingerprint(v.dictionary)
+                if stats is not None:
+                    stats["dict_columns_encoded"] = \
+                        stats.get("dict_columns_encoded", 0) + 1
+                    if fp in dict_refs:
+                        stats["dict_bytes_saved"] = \
+                            stats.get("dict_bytes_saved", 0) \
+                            + _dict_inline_cost(v.dictionary)
+                dict_refs[fp] = v.dictionary
+                cm["dict"] = None
+                cm["dfp"] = fp
+            cols.append(cm)
         metas.append({
             "names": list(b.names),
             "capacity": int(b.capacity),
@@ -294,12 +375,20 @@ def frame_info(buf: bytes) -> dict:
     return header
 
 
-def decode_batches(buf: bytes) -> List[ColumnBatch]:
+def decode_batches(buf: bytes,
+                   dict_table: Optional[Dict[str, Tuple]] = None
+                   ) -> List[ColumnBatch]:
     """Decode one framed block back into host ``ColumnBatch`` objects.
 
     Uncompressed buffers decode as read-only ``np.frombuffer`` views over
     ``buf`` (zero-copy); every downstream kernel is functional, so views
-    are safe — and a consumer that must mutate copies explicitly."""
+    are safe — and a consumer that must mutate copies explicitly.
+
+    Legacy frames carry their dictionaries inline and decode with no
+    table.  A column holding only a ``"dfp"`` fingerprint resolves
+    through ``dict_table``; an unknown fingerprint raises
+    ``DictFingerprintError`` so the reader can fetch the sender's
+    sidecar and retry the (cheap, header-only-so-far) decode."""
     header, payload = _split_frame(buf)
     out: List[ColumnBatch] = []
     for meta in header["batches"]:
@@ -307,16 +396,52 @@ def decode_batches(buf: bytes) -> List[ColumnBatch]:
         vectors: List[ColumnVector] = []
         for cm in meta["columns"]:
             dt = _parse_dtype(cm["dtype"])
+            fp = cm.get("dfp")
+            if cm["dict"] is not None:      # legacy inline dictionary
+                d = _dict_from_header(cm["dict"])
+            elif fp is not None:
+                if dict_table is None or fp not in dict_table:
+                    raise DictFingerprintError(
+                        f"block references unknown dictionary {fp}",
+                        fingerprint=fp)
+                d = dict_table[fp]
+            else:
+                d = None
             data = _decode_array(payload, cm["data"], np.dtype(cm["np"]),
                                  cm["shape"])
             valid = (None if cm["valid"] is None else
                      _decode_bitmask(payload, cm["valid"], cap))
-            vectors.append(ColumnVector(data, dt, valid,
-                                        _dict_from_header(cm["dict"])))
+            vectors.append(ColumnVector(data, dt, valid, d))
         rv = (None if meta["row_valid"] is None else
               _decode_bitmask(payload, meta["row_valid"], cap))
         out.append(ColumnBatch(meta["names"], vectors, rv, cap))
     return out
+
+
+# ---------------------------------------------------------------------------
+# dictionary sidecar (one framed table per exchange x sender)
+# ---------------------------------------------------------------------------
+
+def encode_dict_table(table: Dict[str, Tuple]) -> bytes:
+    """Frame a {fingerprint: words} table as its own checksummed block
+    (the per-sender ``s####.dict`` sidecar).  Same prefix/adler machinery
+    as data blocks, so torn or corrupted sidecars classify as
+    ``TruncatedBlockError``/``ChecksumError`` and ride the exact retry
+    path data blocks do."""
+    header = json.dumps(
+        {"dicts": {fp: _dict_to_header(words)
+                   for fp, words in sorted(table.items())}},
+        separators=(",", ":")).encode("utf-8")
+    cksum = zlib.adler32(header)
+    return _PREFIX.pack(MAGIC, WIRE_VERSION, len(header), 0, cksum) + header
+
+
+def decode_dict_table(buf: bytes) -> Dict[str, Tuple]:
+    header, _ = _split_frame(buf)
+    if "dicts" not in header:
+        raise WireFormatError("not a dictionary sidecar frame")
+    return {fp: _dict_from_header(h)
+            for fp, h in header["dicts"].items()}
 
 
 # ---------------------------------------------------------------------------

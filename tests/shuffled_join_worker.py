@@ -86,10 +86,17 @@ xs.conf.set(C.CROSSPROC_AUTO_BROADCAST.key, "0")
 # the 5x-median test compares against (8/proc would leave the hot span
 # just under threshold on this small table)
 xs.conf.set(C.SHUFFLE_FINE_PARTITIONS.key, "32")
+# tags has a UNIQUE word per row: each process's slice builds a fully
+# DISJOINT dictionary, so the cross-process string min/max below can only
+# be right if the exchange genuinely unifies the code spaces
+t_words = np.array([f"row{i:04d}" for i in range(N)])
+
 xs.createDataFrame({"sk": f_sk[mine], "price": f_price[mine],
                     "g": f_g[mine]}).createOrReplaceTempView("fact")
 xs.createDataFrame({"k2": k2[mine], "bonus": b2[mine],
                     "g2": g2[mine]}).createOrReplaceTempView("fact2")
+xs.createDataFrame({"sk2": f_sk[mine], "t": t_words[mine]}) \
+    .createOrReplaceTempView("tags")
 # dim is REPLICATED: every process holds the identical full table
 xs.createDataFrame({"d_sk": d_sk, "year": d_year}) \
     .createOrReplaceTempView("dim")
@@ -100,12 +107,16 @@ oracle.createDataFrame({"sk": f_sk, "price": f_price, "g": f_g}) \
     .createOrReplaceTempView("fact")
 oracle.createDataFrame({"k2": k2, "bonus": b2, "g2": g2}) \
     .createOrReplaceTempView("fact2")
+oracle.createDataFrame({"sk2": f_sk, "t": t_words}) \
+    .createOrReplaceTempView("tags")
 oracle.createDataFrame({"d_sk": d_sk, "year": d_year}) \
     .createOrReplaceTempView("dim")
 
-# (name, sql, expected counter per mode).  String keys have no
-# cross-process orderable encoding, so "range" mode falls back to the
-# hash exchange for them — exactly the documented "when hash still wins".
+# (name, sql, expected counter per mode).  String keys ride the range
+# exchange too: dictionaries are sorted (codes order like words), the
+# sample round agrees on cut WORDS, and each process maps them into its
+# local code space — so "range" mode takes the sort-merge path for
+# string equi-keys exactly like numeric ones.
 QUERIES = [
     ("inner-agg",
      "SELECT sk, count(*) AS c, sum(bonus) AS sb FROM fact "
@@ -122,7 +133,15 @@ QUERIES = [
     ("string-key-agg",
      "SELECT g, count(*) AS c, sum(bonus) AS sb FROM fact "
      "JOIN fact2 ON g = g2 GROUP BY g ORDER BY g",
-     {"range": "shuffled_joins", "hash": "shuffled_joins"}),
+     {"range": "range_merge_joins", "hash": "shuffled_joins"}),
+    # lifted string aggregates: min/max/first on a dictionary column whose
+    # per-process dictionaries are fully DISJOINT — correct answers require
+    # the receiver-side code-space unification, in every exchange mode
+    ("string-minmax-fast",
+     "SELECT sk2, min(t) AS tlo, max(t) AS thi, count(*) AS c FROM tags "
+     "GROUP BY sk2 ORDER BY sk2",
+     {"range": "fast_path_aggs", "hash": "fast_path_aggs",
+      "gather": "fast_path_aggs"}),
     ("semi-rows",
      "SELECT sk, price FROM fact LEFT SEMI JOIN fact2 ON sk = k2 "
      "ORDER BY sk, price",
@@ -217,6 +236,13 @@ assert gauges["bytes_produced_raw"] >= gauges["bytes_shipped_raw"] > 0, gauges
 assert gauges["rows_produced"] >= gauges["rows_shipped"] > 0, gauges
 assert gauges["partition_bytes_max"] >= gauges["partition_bytes_median"], gauges
 assert gauges["range_cutpoints"] > 0, gauges
+# encoded execution: dictionary columns crossed the wire as codes with the
+# sidecar dedup saving repeat shipments, the disjoint tags dictionaries
+# forced receiver-side remaps, and collected strings late-materialized
+assert gauges["dict_columns_encoded"] > 0, gauges
+assert gauges["dict_bytes_saved"] > 0, gauges
+assert gauges["codes_remapped"] > 0, gauges
+assert gauges["late_materialized_rows"] > 0, gauges
 print(f"[p{pid}] ALL-OK range={svc.counters['range_merge_joins']} "
       f"shuffled={svc.counters['shuffled_joins']} "
       f"fast={svc.counters['fast_path_aggs']} "

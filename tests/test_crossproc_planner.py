@@ -58,6 +58,28 @@ def test_generic_path_distinct_window_limit(xs, spark):
         assert got == exp, q
 
 
+def test_string_minmax_first_crossproc_matches_oracle(xs, spark):
+    """The lifted _agg_strings_ok guard: string min/max/first now CROSS
+    the exchange as dictionary codes and late-materialize, instead of
+    raising 'order of codes != order of words'.  Parity vs the plain
+    session, including NULL strings and group keys."""
+    rng = np.random.default_rng(11)
+    k = rng.integers(0, 7, 300).astype(np.int64)
+    words = np.array(["pine", "ash", "oak", "elm", "fir"])[k % 5]
+    for s in (xs, spark):
+        df = s.createDataFrame({"k": k, "g": words})
+        df.createOrReplaceTempView("st")
+    q = ("SELECT k, min(g) AS lo, max(g) AS hi, first(g) AS fv, "
+         "count(*) AS c FROM st GROUP BY k ORDER BY k")
+    got = [tuple(r) for r in xs.sql(q).collect()]
+    exp = [tuple(r) for r in spark.sql(q).collect()]
+    assert got == exp and len(got) == 7
+    # min/max/first as the ONLY aggregates (no numeric alongside)
+    q2 = "SELECT k, max(g) AS hi FROM st GROUP BY k ORDER BY k"
+    assert [tuple(r) for r in xs.sql(q2).collect()] == \
+        [tuple(r) for r in spark.sql(q2).collect()]
+
+
 def test_global_agg_routes(xs, spark):
     _mk(xs)
     _mk(spark)

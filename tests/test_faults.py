@@ -196,6 +196,92 @@ def test_corrupted_block_detected_by_checksum_and_recovered(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# dictionary sidecar faults: the dedup wire's once-per-sender word list
+# is a block like any other — transient loss heals through the same
+# retrying reader, permanent loss fails structured and bounded
+# ---------------------------------------------------------------------------
+
+def _sbatch(words):
+    return ColumnBatch.from_arrays({"s": list(words)})
+
+
+def _swords(batches):
+    return sorted(w for b in batches for (w,) in b.to_pylist()
+                  if w is not None)
+
+
+def test_dict_sidecar_dropped_then_heals(tmp_path):
+    """The sender's sidecar vanishes after commit (list-after-write lag);
+    the receiver's first block decode trips the fingerprint miss, the
+    sidecar read retries, the backoff 'heals' the file, and the exchange
+    completes with the words intact."""
+    svc1 = HostShuffleService(str(tmp_path), 1, 2, timeout_s=5.0,
+                              poll_s=0.02, max_retries=8,
+                              retry_wait_s=0.05)
+    svc1.put("e", 0, [_sbatch(["ash", "oak", "ash"])])
+    svc1.commit("e")
+    dpath = svc1._dict_path("e", 1)
+    good = open(dpath, "rb").read()
+    assert good[:4] == wire.MAGIC
+    os.remove(dpath)
+
+    def heal(_wait):
+        with open(dpath, "wb") as f:
+            f.write(good)
+
+    svc0 = HostShuffleService(str(tmp_path), 0, 2, timeout_s=5.0,
+                              poll_s=0.02, max_retries=8,
+                              retry_wait_s=0.05, sleep=heal)
+    got = svc0.exchange("e", {0: [_sbatch(["fir"])], 1: []})
+    assert _swords(got) == ["ash", "ash", "fir", "oak"]
+    assert svc0.counters["block_retries"] > 0
+    assert svc0.counters["blocks_lost"] == 0
+
+
+def test_dict_sidecar_corrupted_then_heals(tmp_path):
+    """Size-preserving corruption of the sidecar: only its adler32 can
+    see it (the manifest size still matches); the checksum failure rides
+    the ordinary retry path and the heal completes the exchange."""
+    svc1 = HostShuffleService(str(tmp_path), 1, 2, timeout_s=5.0,
+                              poll_s=0.02, max_retries=8,
+                              retry_wait_s=0.05)
+    svc1.put("e", 0, [_sbatch(["pear", "fig"])])
+    svc1.commit("e")
+    dpath = svc1._dict_path("e", 1)
+    good = open(dpath, "rb").read()
+    with open(dpath, "wb") as f:                 # same size, one bit off
+        f.write(good[:-1] + bytes([good[-1] ^ 0xFF]))
+
+    def heal(_wait):
+        with open(dpath, "wb") as f:
+            f.write(good)
+
+    svc0 = HostShuffleService(str(tmp_path), 0, 2, timeout_s=5.0,
+                              poll_s=0.02, max_retries=8,
+                              retry_wait_s=0.05, sleep=heal)
+    got = svc0.exchange("e", {0: [], 1: []})
+    assert _swords(got) == ["fig", "pear"]
+    assert svc0.counters["block_retries"] > 0
+
+
+def test_dict_sidecar_permanently_lost_fails_bounded(tmp_path):
+    """No heal: the unreadable sidecar makes the sender's blocks
+    undecodable, so the exchange fails with the same structured
+    ``ExchangeFetchFailed`` (naming the host) a lost data block raises —
+    never a silent fallback to wrong codes, never a hang."""
+    svc0, svc1 = _pair(tmp_path, timeout_s=3.0, max_retries=2)
+    svc1.put("e", 0, [_sbatch(["lost", "words"])])
+    svc1.commit("e")
+    os.remove(svc1._dict_path("e", 1))
+    t0 = time.monotonic()
+    with pytest.raises(ExchangeFetchFailed) as ei:
+        svc0.exchange("e", {0: [], 1: []})
+    assert time.monotonic() - t0 < 2 * 3.0
+    assert ei.value.lost_hosts == ["host-1"]
+    assert svc0.counters["blocks_lost"] == 1
+
+
+# ---------------------------------------------------------------------------
 # heartbeat-driven exclusion + blacklist persistence
 # ---------------------------------------------------------------------------
 

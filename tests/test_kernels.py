@@ -402,3 +402,91 @@ def test_slice_rows_is_zero_copy_view():
     assert sl.capacity == 8
     np.testing.assert_array_equal(np.asarray(sl.vectors[0].data),
                                   np.arange(4, 12))
+
+
+# ---------------------------------------------------------------------------
+# remap_codes + code-space range_bucket (encoded execution)
+# ---------------------------------------------------------------------------
+
+def test_remap_codes_basic_and_dtype():
+    from spark_tpu.kernels import remap_codes
+    codes = np.array([0, 2, 1, 0], np.int32)
+    table = np.array([3, 5, 9], np.int32)     # monotone merge remap
+    out = remap_codes(np, codes, table)
+    np.testing.assert_array_equal(out, [3, 9, 5, 3])
+    assert out.dtype == np.int32
+
+
+def test_remap_codes_preserves_null_and_oob_sentinels():
+    from spark_tpu.kernels import remap_codes
+    hi = np.iinfo(np.int32).max
+    codes = np.array([-1, 0, hi, 1, -7], np.int32)
+    out = remap_codes(np, codes, np.array([4, 6], np.int32))
+    # negatives (NULL) pass through; >= len(table) folds to INT32_MAX
+    np.testing.assert_array_equal(out, [-1, 4, hi, 6, -7])
+
+
+def test_remap_codes_empty_inputs():
+    from spark_tpu.kernels import remap_codes
+    hi = np.iinfo(np.int32).max
+    # empty codes
+    out = remap_codes(np, np.zeros(0, np.int32), np.array([1], np.int32))
+    assert out.shape == (0,) and out.dtype == np.int32
+    # empty table: every non-negative code is out of range
+    out = remap_codes(np, np.array([-1, 0, 3], np.int32),
+                      np.zeros(0, np.int32))
+    np.testing.assert_array_equal(out, [-1, hi, hi])
+
+
+def test_remap_codes_jit_matches_numpy():
+    from spark_tpu.kernels import remap_codes
+    codes = np.array([2, -1, 0, 1, 2], np.int32)
+    table = np.array([1, 4, 7], np.int32)
+    want = remap_codes(np, codes, table)
+    got = jax.jit(lambda c, t: remap_codes(jnp, c, t))(codes, table)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_union_all_identical_dictionaries_fast_path():
+    # all senders share one dictionary: codes concatenate untouched
+    words = ("a", "b")
+    b1 = ColumnBatch.from_arrays({"s": ["b", "a"]})
+    b2 = ColumnBatch.from_arrays({"s": ["a", "b"]})
+    assert b1.column("s").dictionary == words
+    u = union_all([b1, b2])
+    assert u.column("s").dictionary == words
+    rows = compact(np, u).to_pylist()
+    assert rows == [("b",), ("a",), ("a",), ("b",)]
+
+
+def test_range_bucket_code_space_matches_word_space():
+    """Mapping shared cut WORDS into each local code space via
+    searchsorted(dict, cut, "left") buckets a row by its WORD alone —
+    identical spans across processes whose dictionaries differ."""
+    from spark_tpu.kernels import range_bucket
+    cuts_w = np.asarray(["dd", "mm"], object)          # shared word cuts
+    dict_a = ("aa", "cc", "dd", "zz")                  # process A
+    dict_b = ("bb", "dd", "ee", "mm", "qq")            # process B
+    for kdict in (dict_a, dict_b):
+        local_cuts = np.searchsorted(
+            np.asarray(kdict, object), cuts_w, side="left").astype(np.int64)
+        codes = np.arange(len(kdict), dtype=np.int64)
+        spans = range_bucket(np, codes, local_cuts)
+        want = [int(np.searchsorted(cuts_w, w, side="right"))
+                for w in kdict]
+        np.testing.assert_array_equal(spans, want)
+
+
+def test_range_bucket_code_space_nonmember_and_empty_cuts():
+    from spark_tpu.kernels import range_bucket
+    kdict = ("ash", "oak")
+    # cut word outside the local dictionary's range → all rows one side
+    local_cuts = np.searchsorted(np.asarray(kdict, object),
+                                 np.asarray(["zzz"], object),
+                                 side="left").astype(np.int64)
+    spans = range_bucket(np, np.array([0, 1], np.int64), local_cuts)
+    np.testing.assert_array_equal(spans, [0, 0])
+    # zero cuts: the single span 0
+    spans = range_bucket(np, np.array([0, 1], np.int64),
+                         np.zeros(0, np.int64))
+    np.testing.assert_array_equal(spans, [0, 0])

@@ -179,6 +179,46 @@ def test_shuffle_range_gauges_exported(spark, tmp_path):
         ms._sources = [s for s in ms._sources if s.name != "shuffle"]
 
 
+def test_shuffle_dict_gauges_exported(spark, tmp_path):
+    """Encoded execution is observable: dictionary columns framed as
+    codes, sidecar bytes saved by the dedup, receiver-side code remaps,
+    and output-boundary late materializations all surface as gauges on
+    the shuffle metrics source."""
+    from spark_tpu.columnar import ColumnBatch
+    prev = getattr(spark, "_crossproc_svc", None)
+    ms = spark.metricsSystem
+    try:
+        svc = spark.enableHostShuffle(str(tmp_path), process_id=0,
+                                      n_processes=1, timeout_s=5.0)
+        snap0 = ms.snapshots()["shuffle"]
+        assert snap0["dict_columns_encoded"] == 0
+        assert snap0["dict_bytes_saved"] == 0
+        assert snap0["codes_remapped"] == 0
+        assert snap0["late_materialized_rows"] == 0
+        # two blocks sharing one dictionary: the second frame dedups it
+        b = ColumnBatch.from_arrays({"s": ["ash", "oak", "ash"]})
+        svc.put("dg1", 0, [b])
+        svc.put("dg1", 0, [b])
+        svc.commit("dg1")
+        # an exchange whose own batches disagree on the dictionary:
+        # the receiver unifies into one sorted code space
+        ba = ColumnBatch.from_arrays({"s": ["ash", "oak"]})
+        bb = ColumnBatch.from_arrays({"s": ["fir", "oak"]})
+        out = svc.exchange("dg2", {0: [ba, bb]})
+        dicts = {v.dictionary for r in out for v in r.vectors}
+        assert dicts == {("ash", "fir", "oak")}
+        # late materialization: decoding codes to words at the boundary
+        out[0].to_pylist()
+        snap = ms.snapshots()["shuffle"]
+        assert snap["dict_columns_encoded"] == 2
+        assert snap["dict_bytes_saved"] > 0
+        assert snap["codes_remapped"] > 0
+        assert snap["late_materialized_rows"] > 0
+    finally:
+        spark._crossproc_svc = prev
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]
+
+
 def test_memory_leak_check_releases(spark, mdf):
     """Executor.scala's 'managed memory leak detected' idiom: a leaked
     execution reservation is detected and released after the query."""

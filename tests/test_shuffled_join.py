@@ -236,8 +236,8 @@ def _run_parity(tmp_path, n, timeout_s=90.0):
         assert f"[p{pid}] ALL-OK" in out, out
         # the battery covered every path and the coalescer + skew
         # splitter both fired
-        assert "range=4" in out and "shuffled=6" in out, out
-        assert "fast=3" in out, out
+        assert "range=5" in out and "shuffled=5" in out, out
+        assert "fast=6" in out, out
     return outs
 
 

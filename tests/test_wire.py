@@ -312,6 +312,96 @@ def test_trimmed_roundtrip_digest_stable():
 
 
 # ---------------------------------------------------------------------------
+# dictionary-deduplicated wire (encoded execution)
+# ---------------------------------------------------------------------------
+
+def _dict_batch(words, codes):
+    v = ColumnVector(np.asarray(codes, np.int32), T.string,
+                     np.asarray(codes) >= 0, tuple(words))
+    return ColumnBatch(["s"], [v], None, len(codes))
+
+
+def test_dict_dedup_ships_fingerprint_not_words():
+    words = tuple(sorted(f"word-{i:04d}" for i in range(64)))
+    b = _dict_batch(words, [0, 5, 63, -1])
+    refs, stats = {}, {}
+    buf1 = wire.encode_batches([b], dict_refs=refs, stats=stats)
+    buf2 = wire.encode_batches([b], dict_refs=refs, stats=stats)
+    inline = wire.encode_batches([b])
+    # the word list left both frames; the repeat frame saved its cost
+    assert len(buf1) < len(inline) and len(buf2) < len(inline)
+    fp = wire.dict_fingerprint(words)
+    assert refs == {fp: words}
+    assert stats["dict_columns_encoded"] == 2
+    assert stats["dict_bytes_saved"] > 0
+    # decoding needs the sidecar table; without it the typed failure
+    # names the missing fingerprint (the reader's reload trigger)
+    with pytest.raises(wire.DictFingerprintError) as ei:
+        wire.decode_batches(buf1)
+    assert ei.value.fingerprint == fp
+    for buf in (buf1, buf2):
+        _assert_batches_equal(
+            wire.decode_batches(buf, dict_table={fp: words}), [b])
+
+
+def test_dict_dedup_first_occurrence_not_counted_saved():
+    words = ("ash", "oak")
+    refs, stats = {}, {}
+    wire.encode_batches([_dict_batch(words, [0, 1])],
+                        dict_refs=refs, stats=stats)
+    # first sighting moves the cost to the sidecar — net zero, not a save
+    assert stats["dict_columns_encoded"] == 1
+    assert stats.get("dict_bytes_saved", 0) == 0
+
+
+def test_dict_dedup_legacy_inline_frames_still_decode():
+    # frames written without dict_refs carry the dictionary inline and
+    # decode with or without a sidecar table (mixed-version pod)
+    b = _dict_batch(("fig", "pear"), [1, 0, -1])
+    buf = wire.encode_batches([b])
+    _assert_batches_equal(wire.decode_batches(buf), [b])
+    _assert_batches_equal(
+        wire.decode_batches(buf, dict_table={"feedface00000000": ()}), [b])
+
+
+def test_dict_dedup_empty_and_zero_length_dictionaries():
+    # () dictionary (a string column that never saw a word) and an
+    # empty batch both survive the dedup path
+    empty_dict = _dict_batch((), [-1, -1])
+    zero_rows = _dict_batch(("a",), [])
+    refs, stats = {}, {}
+    buf = wire.encode_batches([empty_dict, zero_rows],
+                              dict_refs=refs, stats=stats)
+    table = dict(refs)
+    _assert_batches_equal(wire.decode_batches(buf, dict_table=table),
+                          [empty_dict, zero_rows])
+
+
+def test_dict_sidecar_roundtrip():
+    words_a = ("ash", "oak")
+    words_b = (b"\x00raw", b"bytes\x01")   # binary dictionaries too
+    table = {wire.dict_fingerprint(words_a): words_a,
+             wire.dict_fingerprint(words_b): words_b,
+             wire.dict_fingerprint(()): ()}
+    blob = wire.encode_dict_table(table)
+    assert blob[:4] == wire.MAGIC
+    assert wire.decode_dict_table(blob) == table
+    assert wire.decode_dict_table(wire.encode_dict_table({})) == {}
+    # a data frame is not a sidecar (and vice versa): typed refusal
+    with pytest.raises(wire.WireFormatError):
+        wire.decode_dict_table(_frame())
+
+
+def test_dict_fingerprint_length_prefixed():
+    # word-boundary ambiguity must change the fingerprint
+    assert wire.dict_fingerprint(("ab",)) != wire.dict_fingerprint(("a", "b"))
+    assert wire.dict_fingerprint(()) != wire.dict_fingerprint(("",))
+    assert issubclass(wire.DictFingerprintError, wire.WireFormatError)
+    assert not issubclass(wire.DictFingerprintError,
+                          (wire.TruncatedBlockError, wire.ChecksumError))
+
+
+# ---------------------------------------------------------------------------
 # SpilledRuns spill format
 # ---------------------------------------------------------------------------
 

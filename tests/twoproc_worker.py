@@ -22,7 +22,12 @@ jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import numpy as np  # noqa: E402
-from jax import lax, shard_map  # noqa: E402
+from jax import lax  # noqa: E402
+
+try:                                   # top-level export landed post-0.4
+    from jax import shard_map  # noqa: E402
+except ImportError:
+    from jax.experimental.shard_map import shard_map  # noqa: E402
 from jax.sharding import NamedSharding, PartitionSpec  # noqa: E402
 
 from spark_tpu import config as C  # noqa: E402
@@ -41,24 +46,43 @@ mesh = hybrid_mesh()
 assert mesh.axis_names == ("dcn", "data")
 assert mesh.devices.shape == (2, 4), mesh.devices.shape
 
-# one cross-process all-reduce: global sum of a (dcn,data)-sharded array
+# one cross-process all-reduce: global sum of a (dcn,data)-sharded array.
+# Old jaxlib CPU backends refuse multi-process computations outright; the
+# DCN data plane under test below is the host shuffle service, not XLA
+# collectives, so those two demos skip (visibly) rather than fail there.
 sh = NamedSharding(mesh, PartitionSpec(("dcn", "data")))
 arr = jax.make_array_from_callback(
     (32,), sh, lambda idx: np.arange(32.0)[idx])
-s = jax.jit(lambda x: x.sum(),
-            out_shardings=NamedSharding(mesh, PartitionSpec()))(arr)
-got = float(np.asarray(jax.device_get(s.addressable_shards[0].data)))
-assert got == 496.0, got
-print(f"[p{pid}] allreduce sum ok", flush=True)
+try:
+    s = jax.jit(lambda x: x.sum(),
+                out_shardings=NamedSharding(mesh, PartitionSpec()))(arr)
+    got = float(np.asarray(jax.device_get(s.addressable_shards[0].data)))
+    assert got == 496.0, got
+    collectives_ok = True
+    print(f"[p{pid}] allreduce sum ok", flush=True)
+except Exception as e:
+    assert "Multiprocess computations aren't implemented" in str(e), e
+    collectives_ok = False
+    print(f"[p{pid}] allreduce skipped: no multiprocess CPU backend",
+          flush=True)
 
-# one all_to_all exchange over the intra-slice axis through shard_map
-f = shard_map(
-    lambda x: lax.all_to_all(x.reshape(4, -1), "data", 0, 0).reshape(-1),
-    mesh=mesh, in_specs=PartitionSpec(("dcn", "data")),
-    out_specs=PartitionSpec(("dcn", "data")), check_vma=False)
-y = jax.jit(f)(arr)
-assert len(y.addressable_shards) == 4
-print(f"[p{pid}] all_to_all ok", flush=True)
+if collectives_ok:
+    # one all_to_all exchange over the intra-slice axis through shard_map
+    # (the replication-check kwarg was renamed check_rep → check_vma)
+    import inspect  # noqa: E402
+
+    _ck = ("check_vma" if "check_vma"
+           in inspect.signature(shard_map).parameters else "check_rep")
+    f = shard_map(
+        lambda x: lax.all_to_all(x.reshape(4, -1), "data", 0, 0).reshape(-1),
+        mesh=mesh, in_specs=PartitionSpec(("dcn", "data")),
+        out_specs=PartitionSpec(("dcn", "data")), **{_ck: False})
+    y = jax.jit(f)(arr)
+    assert len(y.addressable_shards) == 4
+    print(f"[p{pid}] all_to_all ok", flush=True)
+else:
+    print(f"[p{pid}] all_to_all skipped: no multiprocess CPU backend",
+          flush=True)
 
 # a REAL query through the host shuffle service (VERDICT r3 #6): each
 # process holds half the rows of one table; the groupBy's aggregation
@@ -115,6 +139,36 @@ if pid == 0:
         f"crossproc={len(both)} oracle={len(oracle)} "
         f"diff={set(both) ^ set(oracle)}")
     print("[p0] CROSSPROC-QUERY-OK", flush=True)
+
+# lifted string aggregates cross the process boundary as dictionary
+# CODES: the u words are fully DISJOINT per half, so min/max/first can
+# only be right if the exchange genuinely unifies the two code spaces
+# (and late-materializes the winning words at the output boundary).
+# Contiguous halves make the rebased first-rank order equal global row
+# order, so first is oracle-exact here, not merely deterministic.
+uwords = np.array([f"u{i // 2000}-{keys[i] % 5:02d}" for i in range(4000)])
+slocal = session.createDataFrame({"k": keys[half], "u": uwords[half]})
+sq = slocal.groupBy("k").agg(F.min("u").alias("lo"), F.max("u").alias("hi"),
+                             F.first("u").alias("fv"),
+                             F.count("*").alias("c"))
+mine_s = host_exchange_group_agg(session, sq, svc, "agg-hop-str")
+gathered_s = svc.exchange("agg-hop-str-2", {0: [mine_s]})
+if pid == 0:
+    got_s = {}
+    for b in gathered_s:
+        for r in b.to_pylist():
+            assert r[0] not in got_s, f"key {r[0]} owned by both processes"
+            got_s[r[0]] = tuple(r[1:])
+    odf = session.createDataFrame({"k": keys, "u": uwords})
+    exp_s = {r[0]: tuple(r[1:])
+             for r in odf.groupBy("k").agg(
+                 F.min("u").alias("lo"), F.max("u").alias("hi"),
+                 F.first("u").alias("fv"), F.count("*").alias("c"))
+             .collect()}
+    assert got_s == exp_s, (
+        f"string agg mismatch on keys "
+        f"{[k for k in exp_s if got_s.get(k) != exp_s[k]][:5]}")
+    print("[p0] STRING-AGG-OK", flush=True)
 
 # FULL q3 (scan → broadcast join → filter → agg → sort) via the NORMAL
 # session.sql path: enableHostShuffle registers the DCN data plane on the
