@@ -1069,6 +1069,137 @@ def distsort_worker_main() -> None:
     sys.stdout.flush()
 
 
+def _bench_dist_spill() -> dict:
+    """Distspill lane: the memory-pressure path of the distributed join.
+
+    The distjoin workload reruns with the host budget capped BELOW the
+    input working set and a tiny spill threshold, so map output and
+    fetched blocks take the wire-format spill files instead of RAM.  The
+    lane pins the robustness contract as a number: the capped run must
+    COMPLETE with the same aggregates as the uncapped run, report
+    nonzero spill bytes, keep its ledger peak under the cap — and the
+    wall-clock overhead of spilling is the tracked figure."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="spark_tpu_bench_dspill_")
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("SPARK_TPU_FAULT_PLAN", None)
+        env.pop("SPARK_TPU_PLATFORM", None)
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--distspill-worker", str(pid), d],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for pid in (0, 1)]
+        outs = [p.communicate(timeout=CHILD_TIMEOUT_S) for p in procs]
+        objs = []
+        for p, (out, err) in zip(procs, outs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"distspill worker rc={p.returncode}: "
+                    f"{(err or out).strip().splitlines()[-3:]}")
+            line = [ln for ln in out.splitlines()
+                    if ln.strip().startswith("{")][-1]
+            objs.append(json.loads(line))
+        # under pressure or not: byte-identical aggregates
+        sums = {o[m]["checksum"] for o in objs for m in ("uncapped",
+                                                         "capped")}
+        if len(sums) != 1:
+            raise RuntimeError(f"capped/uncapped results diverge: {objs}")
+        if not all(o["capped"]["spill_bytes"] > 0 for o in objs):
+            raise RuntimeError(f"capped run did not spill: {objs}")
+        for o in objs:
+            if o["capped"]["peak_host_bytes"] > o["capped"]["budget_bytes"]:
+                raise RuntimeError(f"ledger peak blew the cap: {objs}")
+        rows = objs[0]["rows_total"]
+        cap_s = max(o["capped"]["seconds"] for o in objs)
+        unc_s = max(o["uncapped"]["seconds"] for o in objs)
+        return {
+            "distspill_rows_per_sec": round(rows / cap_s, 1),
+            "distspill_overhead_vs_uncapped": round(cap_s / unc_s, 3),
+            "distspill_bytes": sum(
+                o["capped"]["spill_bytes"] for o in objs),
+            "distspill_events": sum(
+                o["capped"]["spill_events"] for o in objs),
+            "distspill_peak_host_bytes": max(
+                o["capped"]["peak_host_bytes"] for o in objs),
+            "distspill_budget_bytes": objs[0]["capped"]["budget_bytes"],
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def distspill_worker_main() -> None:
+    """One process of the distspill lane (see ``_bench_dist_spill``).
+
+    argv: --distspill-worker <pid> <root>.  Runs the distjoin query
+    uncapped, then with the host budget capped below the input working
+    set and a tiny spill threshold; prints ONE JSON line with both warm
+    wall-clocks and the capped run's spill/ledger figures."""
+    i = sys.argv.index("--distspill-worker")
+    pid, root = int(sys.argv[i + 1]), sys.argv[i + 2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from spark_tpu import config as C
+    from spark_tpu.memory import HOST_BUDGET
+    from spark_tpu.sql.session import SparkSession
+
+    rng = np.random.default_rng(31)
+    sk = rng.integers(0, DJ_KEYS, DJ_ROWS).astype(np.int64)
+    price = rng.integers(1, 201, DJ_ROWS).astype(np.int64)
+    k2 = rng.integers(0, DJ_KEYS, DJ_ROWS).astype(np.int64)
+    bonus = rng.integers(1, 101, DJ_ROWS).astype(np.int64)
+    mine = slice(pid, None, 2)
+    Q = ("SELECT sk, count(*) AS c, sum(bonus) AS sb FROM fact "
+         "JOIN fact2 ON sk = k2 WHERE price < 100 AND bonus < 50 "
+         "GROUP BY sk")
+    # below the per-process input working set (2 tables x 2 int64 cols),
+    # above the post-filter resident shards the join must hold to finish
+    budget = DJ_ROWS * 20
+
+    session = SparkSession.builder.appName(
+        f"bench-dspill-{pid}").getOrCreate()
+    out = {"pid": pid, "rows_total": int(2 * DJ_ROWS)}
+    for mode in ("uncapped", "capped"):
+        xs = session.newSession()
+        xs.conf.set(C.MESH_SHARDS.key, "1")
+        xs.conf.set(C.CROSSPROC_SHUFFLED_JOIN.key, "true")
+        xs.conf.set(C.CROSSPROC_SORT_MERGE_JOIN.key, "false")
+        xs.conf.set(C.CROSSPROC_AUTO_BROADCAST.key, "0")
+        if mode == "capped":
+            xs.conf.set(C.SHUFFLE_SPILL_THRESHOLD.key, str(64 << 10))
+            xs.conf.set(HOST_BUDGET.key, str(budget))
+        svc = xs.enableHostShuffle(os.path.join(root, mode),
+                                   process_id=pid, n_processes=2,
+                                   timeout_s=300.0)
+        xs.createDataFrame({"sk": sk[mine], "price": price[mine]}) \
+            .createOrReplaceTempView("fact")
+        xs.createDataFrame({"k2": k2[mine], "bonus": bonus[mine]}) \
+            .createOrReplaceTempView("fact2")
+        xs.sql(Q).collect()                  # warm: compile + caches
+        base_spill = int(svc.counters["spill_bytes"])
+        base_events = int(svc.counters["spill_events"])
+        t0 = time.perf_counter()
+        rows = xs.sql(Q).collect()
+        elapsed = time.perf_counter() - t0
+        out[mode] = {
+            "seconds": round(elapsed, 3),
+            "groups": len(rows),
+            "checksum": int(sum(int(r[1]) * 7 + int(r[2]) for r in rows)),
+            "spill_bytes": int(svc.counters["spill_bytes"]) - base_spill,
+            "spill_events": int(svc.counters["spill_events"]) - base_events,
+            "peak_host_bytes": int(svc.ledger.peak),
+            "budget_bytes": int(svc.ledger.budget),
+        }
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
 def child_main() -> None:
     import numpy as np
     import jax
@@ -1159,6 +1290,14 @@ def child_main() -> None:
     except Exception as e:   # secondary must not sink the primary
         print(f"[bench-child] distdict bench failed: {e}", file=sys.stderr)
         extras["distdict_error"] = str(e)[:300]
+    try:
+        # memory-pressure path: the distjoin workload with the host
+        # budget capped below the working set — must complete, spill,
+        # and match the uncapped aggregates
+        extras.update(_bench_dist_spill())
+    except Exception as e:   # secondary must not sink the primary
+        print(f"[bench-child] distspill bench failed: {e}", file=sys.stderr)
+        extras["distspill_error"] = str(e)[:300]
 
     try:
         load_1m = round(os.getloadavg()[0], 2)
@@ -1188,6 +1327,8 @@ if __name__ == "__main__":
         distsort_worker_main()
     elif "--distdict-worker" in sys.argv:
         distdict_worker_main()
+    elif "--distspill-worker" in sys.argv:
+        distspill_worker_main()
     elif "--child" in sys.argv:
         child_main()
     else:

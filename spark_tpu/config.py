@@ -375,6 +375,23 @@ SHUFFLE_IO_FETCH_THREADS = conf("spark.tpu.shuffle.io.fetchThreads").doc(
     "genuinely parallelizes).  1 = serial reads."
 ).check(lambda v: v >= 1).int(4)
 
+SHUFFLE_SPILL_THRESHOLD = conf("spark.tpu.shuffle.spillThresholdBytes").doc(
+    "Map-side bucketed join output at or above this many raw bytes per "
+    "side spills its fine-partition slices to disk in the wire format "
+    "and ships receivers their byte spans straight from the spill file "
+    "(ExternalSorter spill analog for the exchange).  0 = spill only "
+    "when the host-memory ledger (spark.tpu.memory.hostBudget) cannot "
+    "reserve the side."
+).check(lambda v: v >= 0).int(0)
+
+SHUFFLE_IO_MAX_INFLIGHT = conf("spark.tpu.shuffle.io.maxInFlightBytes").doc(
+    "Bound on the total encoded bytes the fetch/decode pool may hold in "
+    "flight at once (spark.reducer.maxSizeInFlight analog): fetch "
+    "workers wait for room instead of queueing every sender's block in "
+    "host RAM.  A single block larger than the bound still proceeds "
+    "alone (no deadlock).  0 = unbounded."
+).check(lambda v: v >= 0).int(64 << 20)
+
 SHUFFLE_FETCH_RETRY_ENABLED = conf(
     "spark.tpu.shuffle.fetchRetryEnabled").doc(
     "Allow the keyed-aggregate fast path to re-request a lost peer's "

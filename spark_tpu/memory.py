@@ -32,6 +32,7 @@ each run their own).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -59,10 +60,42 @@ CACHE_CODEC = C.conf("spark.tpu.cache.codec").doc(
     "(zlib/lzma/bz2 always; lz4/zstd when their wheels are present)."
 ).string("zlib")
 
+HOST_BUDGET = C.conf("spark.tpu.memory.hostBudget").doc(
+    "Host-RAM budget in bytes for the shuffle path's exchange staging "
+    "(bucketed map output, fetched blocks, drained shards); 0 = discover "
+    "physical RAM via psutil or os.sysconf (fallback 16 GiB).  Sides "
+    "that cannot reserve spill to disk instead of growing unbounded."
+).check(lambda v: v >= 0).int(0)
+
 
 class HBMOutOfMemoryError(MemoryError):
     """Execution reservation cannot fit even after evicting all unpinned
     storage (SparkOutOfMemoryError analog)."""
+
+
+class HostMemoryError(MemoryError):
+    """Host-RAM staging can proceed NEITHER in memory nor via spill
+    (disk error, or the ledger exhausted by concurrent reservers): the
+    query fails bounded with the reserver and exchange named, never
+    partial results (the spill ladder's SparkOutOfMemoryError rung)."""
+
+    def __init__(self, owner: str, requested: int, budget: int,
+                 holders: Optional[Dict[str, int]] = None,
+                 exchange: str = "", detail: str = ""):
+        self.owner = owner
+        self.requested = requested
+        self.budget = budget
+        self.holders = dict(holders or {})
+        self.exchange = exchange
+        self.detail = detail
+        held = sum(self.holders.values())
+        msg = (f"{owner}: cannot stage {requested} B"
+               f"{' for exchange ' + exchange if exchange else ''} "
+               f"(host budget {budget} B, held {held} B by "
+               f"{len(self.holders)} reserver(s))")
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
 
 
 def batch_nbytes(batch: ColumnBatch) -> int:
@@ -159,6 +192,88 @@ class MemoryManager:
     def release_storage(self, key: str) -> None:
         with self._lock:
             self._storage.pop(key, None)
+
+
+def discover_host_budget() -> int:
+    """Physical host RAM in bytes: psutil when its wheel is present, else
+    ``os.sysconf`` (absent on some platforms), else a 16 GiB guess."""
+    try:
+        import psutil
+        return int(psutil.virtual_memory().total)
+    except Exception:
+        pass
+    try:
+        return int(os.sysconf("SC_PAGE_SIZE")) * int(os.sysconf("SC_PHYS_PAGES"))
+    except Exception:
+        pass
+    return 16 << 30
+
+
+class HostMemoryLedger:
+    """Owner-keyed host-RAM reservations for the shuffle staging path.
+
+    The host twin of ``MemoryManager``'s execution pool, minus eviction:
+    there is no storage to demote, so over-budget reservers either spill
+    (``try_reserve`` returns False) or fail structured (``reserve``
+    raises ``HostMemoryError``).  ``peak`` records the high-water mark of
+    accounted bytes for the peak_host_bytes gauge."""
+
+    def __init__(self, conf=None, budget: Optional[int] = None):
+        if budget is None:
+            fixed = conf.get(HOST_BUDGET) if conf is not None else 0
+            budget = fixed or discover_host_budget()
+        self.budget = int(budget)
+        self._lock = threading.Lock()
+        self._held: Dict[str, int] = {}
+        self.peak = 0
+
+    @property
+    def used(self) -> int:
+        with self._lock:
+            return sum(self._held.values())
+
+    @property
+    def free(self) -> int:
+        return self.budget - self.used
+
+    def held(self, owner: str) -> int:
+        with self._lock:
+            return self._held.get(owner, 0)
+
+    def try_reserve(self, owner: str, nbytes: int) -> bool:
+        nbytes = int(nbytes)
+        with self._lock:
+            used = sum(self._held.values())
+            if used + nbytes > self.budget:
+                return False
+            self._held[owner] = self._held.get(owner, 0) + nbytes
+            self.peak = max(self.peak, used + nbytes)
+            return True
+
+    def reserve(self, owner: str, nbytes: int, exchange: str = "") -> None:
+        if not self.try_reserve(owner, nbytes):
+            with self._lock:
+                holders = dict(self._held)
+            raise HostMemoryError(owner, int(nbytes), self.budget,
+                                  holders=holders, exchange=exchange)
+
+    def release(self, owner: str, nbytes: Optional[int] = None) -> None:
+        with self._lock:
+            if nbytes is None:
+                self._held.pop(owner, None)
+                return
+            left = self._held.get(owner, 0) - int(nbytes)
+            if left > 0:
+                self._held[owner] = left
+            else:
+                self._held.pop(owner, None)
+
+    def release_prefix(self, prefix: str) -> None:
+        """Drop every reservation whose owner starts with ``prefix`` —
+        the query-exit safety net against leaks on error paths."""
+        with self._lock:
+            for owner in [o for o in self._held if o.startswith(prefix)]:
+                del self._held[owner]
 
 
 # ---------------------------------------------------------------------------

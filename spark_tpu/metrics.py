@@ -158,11 +158,24 @@ def default_sources(session) -> List[Source]:
     cache = getattr(session, "_cache", None)
     srcs: List[Source] = []
     if mem is not None:
+        def _ledger_gauge(attr):
+            # resolved per read: the host ledger appears only when a
+            # host shuffle is enabled, possibly after source setup
+            def g():
+                ledger = getattr(session, "_host_ledger", None)
+                return int(getattr(ledger, attr)) if ledger is not None \
+                    else 0
+            return g
         srcs.append(Source("memory", {
             "hbm_budget_bytes": lambda: mem.budget,
             "execution_used_bytes": lambda: mem.execution_used,
             "storage_used_bytes": lambda: mem.storage_used,
             "free_bytes": lambda: mem.free,
+            # host-RAM side of the ledger pair (0s until a host shuffle
+            # is enabled and a ledger exists)
+            "host_budget_bytes": _ledger_gauge("budget"),
+            "host_used_bytes": _ledger_gauge("used"),
+            "host_peak_bytes": _ledger_gauge("peak"),
         }))
     if cache is not None:
         srcs.append(Source("cache", {

@@ -78,7 +78,10 @@ Execution shapes:
 from __future__ import annotations
 
 import hashlib
+import os
 import pickle
+import shutil
+import tempfile
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -91,7 +94,7 @@ from ..kernels import (
 )
 from ..sql import physical as P
 from .. import wire
-from .hostshuffle import ExchangeFetchFailed, HostShuffleService
+from .hostshuffle import ExchangeFetchFailed, FetchSink, HostShuffleService
 
 __all__ = ["host_exchange_group_agg", "crossproc_execute",
            "ExchangeFetchFailed"]
@@ -517,18 +520,102 @@ def _gather_leaf_relations(session, plan, svc: HostShuffleService,
 
 
 def _exchange_with_refetch(svc: HostShuffleService, xid: str,
-                           routed: Dict[int, List[ColumnBatch]]
-                           ) -> List[ColumnBatch]:
+                           routed: Dict[int, List[ColumnBatch]],
+                           sink=None) -> List[ColumnBatch]:
     """One exchange hop with the standard loss policy: on a structured
     fetch failure, ONE refetch after a re-barrier (a peer that committed
     before dying left its blocks on the shared filesystem); a second
-    loss propagates within the 2x-deadline bound."""
+    loss propagates within the 2x-deadline bound.  An optional
+    ``FetchSink`` lands fetched blocks under the host-memory ledger
+    (sender deliveries REPLACE on refetch, so retries stay idempotent)."""
     try:
-        return svc.exchange(xid, routed)
+        return svc.exchange(xid, routed, sink=sink)
     except ExchangeFetchFailed:
         if not svc.refetch_enabled:
             raise
-        return svc.refetch(xid, routed)
+        return svc.refetch(xid, routed, sink=sink)
+
+
+def _exchange_spilled_with_refetch(svc: HostShuffleService, xid: str,
+                                   spill_path: str, routed: Dict[int, list],
+                                   meta: Dict[int, Tuple[int, int]],
+                                   sink=None) -> List[ColumnBatch]:
+    """``_exchange_with_refetch`` for a side whose map output lives in a
+    spill file: receivers get byte-span parts of ``spill_path``
+    published without rematerializing a row."""
+    try:
+        return svc.exchange_spilled(xid, spill_path, routed, meta,
+                                    sink=sink)
+    except ExchangeFetchFailed:
+        if not svc.refetch_enabled:
+            raise
+        return svc.refetch_spilled(xid, spill_path, routed, sink=sink)
+
+
+def _exchange_spill_dir(session, xid: str) -> str:
+    """A fresh per-query directory for exchange spill files (map-side
+    partition frames, reduce-side fetch runs), under the same root the
+    sort/aggregate spills use; the caller removes it when the shards
+    are built."""
+    from ..sql.multibatch import default_spill_dir
+    root = default_spill_dir(session.conf)
+    os.makedirs(root, exist_ok=True)
+    return tempfile.mkdtemp(prefix=f"xspill-{xid}-", dir=root)
+
+
+class _StagedSide:
+    """One join side's bucketed map output, staged either in RAM (ledger
+    reservation held) or in a spill file of per-partition wire frames."""
+
+    __slots__ = ("kind", "bucketed", "off", "cnt", "path", "offsets",
+                 "raw", "rows", "dead")
+
+    def __init__(self, kind, bucketed=None, off=None, cnt=None,
+                 path=None, offsets=None, raw=None, rows=None, dead=None):
+        self.kind = kind              # "mem" | "disk"
+        self.bucketed = bucketed
+        self.off = off
+        self.cnt = cnt
+        self.path = path              # spill file ("disk")
+        self.offsets = offsets        # per-partition byte offsets, n+1
+        self.raw = raw                # per-partition raw bytes (int64)
+        self.rows = rows              # per-partition row counts (int64)
+        self.dead = dead              # schema template for empty shards
+
+
+def _stage_map_side(svc: HostShuffleService, exchange: str,
+                    owner: str, bucketed: ColumnBatch, off, cnt,
+                    raw: np.ndarray, spill_dir: str) -> _StagedSide:
+    """Decide where one side's bucketed output lives until the exchange
+    lands: in host RAM under a ledger reservation (the historical
+    behavior, now accounted), or — above
+    ``spark.tpu.shuffle.spillThresholdBytes``, or when the ledger cannot
+    reserve it — spilled to disk as per-partition wire frames, from
+    which receivers are served byte spans directly.  When even the spill
+    write fails (disk full), the query dies bounded with a structured
+    ``HostMemoryError`` naming the reserver and exchange."""
+    side_raw = int(raw.sum())
+    thresh = svc.spill_threshold
+    if not (0 < thresh <= side_raw) \
+            and svc.ledger.try_reserve(owner, side_raw):
+        return _StagedSide("mem", bucketed=bucketed, off=off, cnt=cnt,
+                           raw=raw, dead=bucketed)
+    dead = _one_dead_row(bucketed)
+    path = os.path.join(spill_dir, f"{exchange}.map")
+    n = len(cnt)
+    slices = [slice_rows(bucketed, int(off[p]), int(cnt[p]))
+              if int(cnt[p]) else None for p in range(n)]
+    try:
+        offsets = svc.spill_map_partitions(exchange, slices, path)
+    except OSError as e:
+        from ..memory import HostMemoryError
+        raise HostMemoryError(
+            owner, side_raw, svc.ledger.budget,
+            holders={owner: svc.ledger.held(owner)}, exchange=exchange,
+            detail=f"map-side spill failed: {e}")
+    rows = np.asarray(cnt, np.int64)
+    return _StagedSide("disk", path=path, offsets=offsets, raw=raw,
+                       rows=rows, dead=dead)
 
 
 def _shuffled_join_shards(session, join, key_pairs,
@@ -564,45 +651,82 @@ def _shuffled_join_shards(session, join, key_pairs,
 
     n_fine = svc.n * session.conf.get(C.SHUFFLE_FINE_PARTITIONS)
     target = session.conf.get(C.SHUFFLE_TARGET_PARTITION_BYTES)
+    sdir = _exchange_spill_dir(session, xid)
+    try:
+        # per side: local run -> key hash -> fine bucketing -> host
+        # slices, staged in RAM (ledger-reserved) or a spill file
+        sides: List[_StagedSide] = []
+        sizes: Dict[int, int] = {}
+        for tag, (subtree, exprs) in zip(("jL", "jR"), (
+                (join.children[0], [l for l, _ in key_pairs]),
+                (join.children[1], [r for _, r in key_pairs]))):
+            local = _run_local(session, subtree).to_host()
+            ectx = EvalContext(local, np)
+            h = ectx.broadcast(Hash64(*exprs).eval(ectx)).data
+            fine = (np.asarray(h).astype(np.uint64)
+                    % np.uint64(n_fine)).astype(np.int32)
+            bucketed, off, cnt = partition_host_slices(np, local, fine,
+                                                       n_fine)
+            raw = np.zeros(n_fine, np.int64)
+            for p in range(n_fine):
+                if int(cnt[p]):
+                    raw[p] = wire.raw_nbytes(
+                        [slice_rows(bucketed, int(off[p]), int(cnt[p]))])
+                    sizes[p] = sizes.get(p, 0) + int(raw[p])
+            sides.append(_stage_map_side(
+                svc, f"{xid}-{tag}", f"shuffle:{xid}:{tag}-map",
+                bucketed, off, cnt, raw, sdir))
+            del bucketed, local    # a spilled side frees its rows here
 
-    # per side: local run -> key hash -> fine bucketing -> host slices
-    sides = []
-    sizes: Dict[int, int] = {}
-    for subtree, exprs in (
-            (join.children[0], [l for l, _ in key_pairs]),
-            (join.children[1], [r for _, r in key_pairs])):
-        local = _run_local(session, subtree).to_host()
-        ectx = EvalContext(local, np)
-        h = ectx.broadcast(Hash64(*exprs).eval(ectx)).data
-        fine = (np.asarray(h).astype(np.uint64)
-                % np.uint64(n_fine)).astype(np.int32)
-        bucketed, off, cnt = partition_host_slices(np, local, fine, n_fine)
-        for p in range(n_fine):
-            if int(cnt[p]):
-                sizes[p] = sizes.get(p, 0) + wire.raw_nbytes(
-                    [slice_rows(bucketed, int(off[p]), int(cnt[p]))])
-        sides.append((bucketed, off, cnt))
+        # ONE coordination round covers both sides: the assignment must
+        # be shared or matching keys would land on different processes
+        svc.publish_sizes(f"{xid}-plan", sizes)
+        totals = svc.gather_sizes(f"{xid}-plan", n_fine)
+        bounds = svc.plan_reducers(totals, target)
 
-    # ONE coordination round covers both sides: the assignment must be
-    # shared or matching keys would land on different processes
-    svc.publish_sizes(f"{xid}-plan", sizes)
-    totals = svc.gather_sizes(f"{xid}-plan", n_fine)
-    bounds = svc.plan_reducers(totals, target)
-
-    shards: List[ColumnBatch] = []
-    for tag, (bucketed, off, cnt) in zip(("jL", "jR"), sides):
-        routed: Dict[int, List[ColumnBatch]] = {}
-        for g, (lo, hi) in enumerate(zip(bounds, bounds[1:])):
-            n_rows = int(cnt[lo:hi].sum())
-            if n_rows:
-                routed[g] = [slice_rows(bucketed, int(off[lo]), n_rows)]
-        received = _exchange_with_refetch(svc, f"{xid}-{tag}", routed)
-        received = [b for b in received
-                    if int(np.asarray(b.num_rows()))] or \
-            [_one_dead_row(bucketed)]
-        shards.append(union_all(received) if len(received) > 1
-                      else received[0])
-    return shards[0], shards[1]
+        shards: List[ColumnBatch] = []
+        for tag, side in zip(("jL", "jR"), sides):
+            sink = FetchSink(svc, f"shuffle:{xid}:{tag}-fetch",
+                             f"{xid}-{tag}", sdir)
+            try:
+                if side.kind == "mem":
+                    routed: Dict[int, List[ColumnBatch]] = {}
+                    for g, (lo, hi) in enumerate(zip(bounds, bounds[1:])):
+                        n_rows = int(side.cnt[lo:hi].sum())
+                        if n_rows:
+                            routed[g] = [slice_rows(
+                                side.bucketed, int(side.off[lo]), n_rows)]
+                    received = _exchange_with_refetch(
+                        svc, f"{xid}-{tag}", routed, sink=sink)
+                else:
+                    # ship straight from the spill file: a reducer's
+                    # contiguous fine range is one contiguous byte span
+                    parts_routed: Dict[int, list] = {}
+                    meta: Dict[int, Tuple[int, int]] = {}
+                    for g, (lo, hi) in enumerate(zip(bounds, bounds[1:])):
+                        length = side.offsets[hi] - side.offsets[lo]
+                        if length:
+                            parts_routed[g] = [(side.offsets[lo], length)]
+                            meta[g] = (int(side.raw[lo:hi].sum()),
+                                       int(side.rows[lo:hi].sum()))
+                    received = _exchange_spilled_with_refetch(
+                        svc, f"{xid}-{tag}", side.path, parts_routed,
+                        meta, sink=sink)
+                received = [b for b in received
+                            if int(np.asarray(b.num_rows()))] or \
+                    [_one_dead_row(side.dead)]
+                shards.append(union_all(received) if len(received) > 1
+                              else received[0])
+                # the shipped bucketed output is gone (remote shares on
+                # disk, the own share re-accounted by the sink): the
+                # map-side reservation must not keep inflating the
+                # ledger while the OTHER side stages
+                svc.ledger.release(f"shuffle:{xid}:{tag}-map")
+            finally:
+                sink.close()
+        return shards[0], shards[1]
+    finally:
+        shutil.rmtree(sdir, ignore_errors=True)
 
 
 #: join types whose RIGHT side may be broadcast (gathered everywhere)
@@ -762,87 +886,163 @@ def _range_merge_join_shards(session, join, spec,
     # searchsorted(dict, cut, "left") is the smallest code whose word
     # >= the cut, and range_bucket counts cuts <= key (side="right"),
     # so a row's span depends only on its WORD — identical on every
-    # process/side no matter how the local dictionaries differ.
-    bucketed_sides = []
-    sizes: Dict[int, int] = {}
-    for base, (local, enc, ok, kdict) in zip((0, n_spans), sides):
-        local_cuts = np.searchsorted(
-            np.asarray(kdict, object), np.asarray(cuts, object),
-            side="left").astype(np.int64) if is_str else cuts
-        spans = range_bucket(np, enc, local_cuts)
-        flag = (~ok).astype(np.int8)
-        bucketed, off, cnt = partition_host_slices(
-            np, local, spans, n_spans, tie_keys=[flag, enc])
-        for p in range(n_spans):
-            if int(cnt[p]):
-                sizes[base + p] = sizes.get(base + p, 0) + wire.raw_nbytes(
-                    [slice_rows(bucketed, int(off[p]), int(cnt[p]))])
-        bucketed_sides.append((bucketed, off, cnt))
-    svc.publish_sizes(f"{xid}-plan", sizes)
-    totals = svc.gather_sizes(f"{xid}-plan", 2 * n_spans)
-    owners = svc.plan_range_reducers(totals[:n_spans], totals[n_spans:],
-                                     target)
+    # process/side no matter how the local dictionaries differ.  Each
+    # side stages in RAM under the host-memory ledger or spills its
+    # span runs to disk (the runs stay sorted through the round trip).
+    sdir = _exchange_spill_dir(session, xid)
+    try:
+        staged_sides: List[_StagedSide] = []
+        sizes: Dict[int, int] = {}
+        for (base, tag), (local, enc, ok, kdict) in zip(
+                ((0, "rL"), (n_spans, "rR")), sides):
+            local_cuts = np.searchsorted(
+                np.asarray(kdict, object), np.asarray(cuts, object),
+                side="left").astype(np.int64) if is_str else cuts
+            spans = range_bucket(np, enc, local_cuts)
+            flag = (~ok).astype(np.int8)
+            bucketed, off, cnt = partition_host_slices(
+                np, local, spans, n_spans, tie_keys=[flag, enc])
+            raw = np.zeros(n_spans, np.int64)
+            for p in range(n_spans):
+                if int(cnt[p]):
+                    raw[p] = wire.raw_nbytes(
+                        [slice_rows(bucketed, int(off[p]), int(cnt[p]))])
+                    sizes[base + p] = sizes.get(base + p, 0) + int(raw[p])
+            staged_sides.append(_stage_map_side(
+                svc, f"{xid}-{tag}", f"shuffle:{xid}:{tag}-map",
+                bucketed, off, cnt, raw, sdir))
+            del bucketed
+        svc.publish_sizes(f"{xid}-plan", sizes)
+        totals = svc.gather_sizes(f"{xid}-plan", 2 * n_spans)
+        owners = svc.plan_range_reducers(totals[:n_spans],
+                                         totals[n_spans:], target)
 
-    # 4a. probe side: a split span's sorted slice chops into contiguous
-    # sub-runs, one per owner; build side: each span slice replicates to
-    # every owner of that span
-    def route(bucketed, off, cnt, is_build: bool):
-        routed: Dict[int, List[ColumnBatch]] = {}
-        for p in range(n_spans):
-            c, o = int(cnt[p]), int(off[p])
-            if not c:
-                continue
-            ps = owners[p]
-            if is_build or len(ps) == 1:
-                sl = slice_rows(bucketed, o, c)
-                for r in (ps if is_build else ps[:1]):
-                    routed.setdefault(r, []).append(sl)
-            else:
-                k = len(ps)
-                bnds = [o + (c * j) // k for j in range(k + 1)]
-                for j, r in enumerate(ps):
-                    if bnds[j + 1] > bnds[j]:
-                        routed.setdefault(r, []).append(
-                            slice_rows(bucketed, bnds[j],
-                                       bnds[j + 1] - bnds[j]))
-        return routed
+        # 4a. probe side: a split span's sorted slice chops into
+        # contiguous sub-runs, one per owner; build side: each span
+        # slice replicates to every owner of that span
+        def route(side: _StagedSide, is_build: bool
+                  ) -> Dict[int, List[ColumnBatch]]:
+            bucketed, off, cnt = side.bucketed, side.off, side.cnt
+            routed: Dict[int, List[ColumnBatch]] = {}
+            for p in range(n_spans):
+                c, o = int(cnt[p]), int(off[p])
+                if not c:
+                    continue
+                ps = owners[p]
+                if is_build or len(ps) == 1:
+                    sl = slice_rows(bucketed, o, c)
+                    for r in (ps if is_build else ps[:1]):
+                        routed.setdefault(r, []).append(sl)
+                else:
+                    k = len(ps)
+                    bnds = [o + (c * j) // k for j in range(k + 1)]
+                    for j, r in enumerate(ps):
+                        if bnds[j + 1] > bnds[j]:
+                            routed.setdefault(r, []).append(
+                                slice_rows(bucketed, bnds[j],
+                                           bnds[j + 1] - bnds[j]))
+            return routed
 
-    probe_recv = _exchange_with_refetch(
-        svc, f"{xid}-rL", route(*bucketed_sides[0], is_build=False))
-    build_recv = _exchange_with_refetch(
-        svc, f"{xid}-rR", route(*bucketed_sides[1], is_build=True))
+        def route_spilled(side: _StagedSide, exch: str, is_build: bool):
+            """Spilled-side routing: whole spans ship as spill-file byte
+            spans (a build span's bytes replicate to every owner at zero
+            decode cost); a skew-SPLIT probe span — and only that one
+            hot span, bounded — rematerializes to chop into contiguous
+            sub-runs, re-encoded as ready frames."""
+            routed: Dict[int, list] = {}
+            meta: Dict[int, List[int]] = {}
 
-    probe_runs = [b for b in probe_recv if int(np.asarray(b.num_rows()))]
-    probe_shard = (union_all(probe_runs) if len(probe_runs) > 1
-                   else probe_runs[0]) if probe_runs \
-        else _one_dead_row(bucketed_sides[0][0])
+            def add(r, part, rb, rw):
+                routed.setdefault(r, []).append(part)
+                m = meta.setdefault(r, [0, 0])
+                m[0] += rb
+                m[1] += rw
 
-    # 4b. k-way merge of the build runs: each received run is (flag,
-    # key)-sorted; split off every run's null tail, heap-merge the keyed
-    # prefixes, append the null tails — a batch globally sorted in the
-    # (flag, key) order PMergeJoin's identity-perm search expects
-    build_runs = [b for b in build_recv if int(np.asarray(b.num_rows()))]
-    if not build_runs:
-        build_shard = _one_dead_row(bucketed_sides[1][0])
-    else:
-        keyed, tails, run_keys = [], [], []
-        for b in build_runs:
-            ectx = EvalContext(b, np)
-            enc, ok = range_encode_key(ectx, r_expr, r_as_float)
-            n_ok = int(np.asarray(ok).sum())
-            if n_ok:
-                keyed.append(slice_rows(b, 0, n_ok))
-                run_keys.append(np.asarray(enc)[:n_ok])
-            if n_ok < b.capacity:
-                tails.append(slice_rows(b, n_ok, b.capacity - n_ok))
-        if keyed:
-            cat = union_all(keyed) if len(keyed) > 1 else keyed[0]
-            merged = take_batch(np, cat, merge_sorted_runs(run_keys))
-            parts = [merged] + tails
+            for p in range(n_spans):
+                length = side.offsets[p + 1] - side.offsets[p]
+                if not length:
+                    continue
+                ps = owners[p]
+                if is_build or len(ps) == 1:
+                    for r in (ps if is_build else ps[:1]):
+                        add(r, (side.offsets[p], length),
+                            int(side.raw[p]), int(side.rows[p]))
+                else:
+                    span = svc.decode_spilled(
+                        exch, side.path, [(side.offsets[p], length)])
+                    sb = span[0] if len(span) == 1 else union_all(span)
+                    c = int(sb.capacity)
+                    k = len(ps)
+                    bnds = [(c * j) // k for j in range(k + 1)]
+                    for j, r in enumerate(ps):
+                        nrows = bnds[j + 1] - bnds[j]
+                        if nrows:
+                            sub = slice_rows(sb, bnds[j], nrows)
+                            add(r, svc.encode_frames(exch, [sub]),
+                                wire.raw_nbytes([sub]), nrows)
+            return routed, {r: (m[0], m[1]) for r, m in meta.items()}
+
+        recvs: List[List[ColumnBatch]] = []
+        for side, tag, is_build in ((staged_sides[0], "rL", False),
+                                    (staged_sides[1], "rR", True)):
+            exch = f"{xid}-{tag}"
+            sink = FetchSink(svc, f"shuffle:{xid}:{tag}-fetch", exch,
+                             sdir)
+            try:
+                if side.kind == "mem":
+                    recvs.append(_exchange_with_refetch(
+                        svc, exch, route(side, is_build), sink=sink))
+                else:
+                    parts_routed, meta = route_spilled(side, exch,
+                                                       is_build)
+                    recvs.append(_exchange_spilled_with_refetch(
+                        svc, exch, side.path, parts_routed, meta,
+                        sink=sink))
+                # shipped: stop charging the map-side staging for this
+                # tag while the other side exchanges
+                svc.ledger.release(f"shuffle:{xid}:{tag}-map")
+            finally:
+                sink.close()
+        probe_recv, build_recv = recvs
+
+        probe_runs = [b for b in probe_recv
+                      if int(np.asarray(b.num_rows()))]
+        probe_shard = (union_all(probe_runs) if len(probe_runs) > 1
+                       else probe_runs[0]) if probe_runs \
+            else _one_dead_row(staged_sides[0].dead)
+
+        # 4b. k-way merge of the build runs: each received run is (flag,
+        # key)-sorted; split off every run's null tail, heap-merge the
+        # keyed prefixes, append the null tails — a batch globally
+        # sorted in the (flag, key) order PMergeJoin's identity-perm
+        # search expects.  Runs that spilled reduce-side drained back as
+        # the same sorted runs, so nothing changes here.
+        build_runs = [b for b in build_recv
+                      if int(np.asarray(b.num_rows()))]
+        if not build_runs:
+            build_shard = _one_dead_row(staged_sides[1].dead)
         else:
-            parts = tails
-        build_shard = union_all(parts) if len(parts) > 1 else parts[0]
-    return probe_shard, build_shard
+            keyed, tails, run_keys = [], [], []
+            for b in build_runs:
+                ectx = EvalContext(b, np)
+                enc, ok = range_encode_key(ectx, r_expr, r_as_float)
+                n_ok = int(np.asarray(ok).sum())
+                if n_ok:
+                    keyed.append(slice_rows(b, 0, n_ok))
+                    run_keys.append(np.asarray(enc)[:n_ok])
+                if n_ok < b.capacity:
+                    tails.append(slice_rows(b, n_ok, b.capacity - n_ok))
+            if keyed:
+                cat = union_all(keyed) if len(keyed) > 1 else keyed[0]
+                merged = take_batch(np, cat, merge_sorted_runs(run_keys))
+                parts = [merged] + tails
+            else:
+                parts = tails
+            build_shard = union_all(parts) if len(parts) > 1 \
+                else parts[0]
+        return probe_shard, build_shard
+    finally:
+        shutil.rmtree(sdir, ignore_errors=True)
 
 
 def crossproc_execute(session, optimized, svc: HostShuffleService
@@ -850,13 +1050,24 @@ def crossproc_execute(session, optimized, svc: HostShuffleService
     """Execute one optimized plan across processes through the host
     shuffle service; every process returns the SAME complete result (the
     single-controller collect() contract)."""
-    from .. import config as C
-    from ..sql import logical as L
-    from ..sql.multibatch import _with_child
-
     seq = getattr(session, "_crossproc_seq", 0) + 1
     session._crossproc_seq = seq
     xid = f"xq{seq:06d}"
+    try:
+        return _crossproc_execute(session, optimized, svc, xid)
+    finally:
+        # every host-memory reservation this query staged (map-side
+        # bucketed output, fetched blocks) is scoped to the query: on
+        # success the shards have been consumed, on failure nothing may
+        # leak into the next statement's budget
+        svc.ledger.release_prefix(f"shuffle:{xid}")
+
+
+def _crossproc_execute(session, optimized, svc: HostShuffleService,
+                       xid: str) -> ColumnBatch:
+    from .. import config as C
+    from ..sql import logical as L
+    from ..sql.multibatch import _with_child
 
     above = []
     node = optimized

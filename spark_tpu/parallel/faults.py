@@ -24,6 +24,11 @@ side; rules fire when a matching block is published:
 - ``die_after_put``  the PROCESS exits hard right after publishing
                 (peer killed mid-exchange); used via the env plan by
                 subprocess workers.
+- ``disk_full``  spill writes (``svc.spill_write``) raise
+                ``OSError(ENOSPC)`` once this process has spilled
+                ``after_bytes`` cumulative bytes — the disk backing the
+                spill directory filling up mid-query; the memory-pressure
+                paths must fail BOUNDED, never emit partial results.
 
 Rules are matched by (exchange, receiver) for this service's own writes;
 healing is driven by daemon timers (wall-clock, generous vs CI retry
@@ -46,14 +51,14 @@ __all__ = ["FaultInjector", "FaultPlan", "FAULT_PLAN_ENV"]
 FAULT_PLAN_ENV = "SPARK_TPU_FAULT_PLAN"
 
 _KINDS = ("drop", "truncate", "corrupt", "delay", "skip_commit",
-          "die_after_put")
+          "die_after_put", "disk_full")
 
 
 class _Rule:
     def __init__(self, kind: str, exchange: Optional[str] = None,
                  receiver: Optional[int] = None, once: bool = True,
                  heal_after_s: Optional[float] = None,
-                 keep_bytes: int = 16):
+                 keep_bytes: int = 16, after_bytes: int = 0):
         if kind not in _KINDS:
             raise ValueError(f"unknown fault kind {kind!r}; one of {_KINDS}")
         self.kind = kind
@@ -62,6 +67,7 @@ class _Rule:
         self.once = once
         self.heal_after_s = heal_after_s
         self.keep_bytes = keep_bytes
+        self.after_bytes = after_bytes    # disk_full: free bytes left
         self.fired = 0
 
     def matches(self, exchange: str, receiver: Optional[int]) -> bool:
@@ -78,7 +84,8 @@ class _Rule:
         return {"kind": self.kind, "exchange": self.exchange,
                 "receiver": self.receiver, "once": self.once,
                 "heal_after_s": self.heal_after_s,
-                "keep_bytes": self.keep_bytes}
+                "keep_bytes": self.keep_bytes,
+                "after_bytes": self.after_bytes}
 
 
 class FaultPlan:
@@ -127,6 +134,16 @@ class FaultPlan:
         r = _Rule("die_after_put", exchange, None, once=True)
         r.keep_bytes = 1 if commit_first else 0   # reuse slot as the flag
         self.rules.append(r)
+        return self
+
+    def disk_full(self, after_bytes: int = 0,
+                  exchange: Optional[str] = None,
+                  once: bool = False) -> "FaultPlan":
+        """Spill writes fail with ENOSPC once this process has written
+        ``after_bytes`` cumulative spill bytes (0 = the very first spill
+        write fails).  ``once=False``: a full disk stays full."""
+        self.rules.append(_Rule("disk_full", exchange, None, once,
+                                after_bytes=after_bytes))
         return self
 
     # -- env transport ---------------------------------------------------
@@ -222,6 +239,23 @@ class FaultInjector:
                     return                        # marker never written
             orig_commit(exchange)
 
+        orig_spill = getattr(svc, "spill_write", None)
+        spilled_total = [0]
+
+        def spill_write(path, data, append=False, exchange=""):
+            for rule in injector.plan.rules:
+                if rule.kind == "disk_full" \
+                        and rule.matches(exchange, None) \
+                        and (rule.fired         # a full disk STAYS full
+                             or spilled_total[0] + len(data)
+                             > rule.after_bytes):
+                    rule.fired += 1
+                    injector.injected.append(
+                        f"disk_full:{exchange or path}")
+                    raise OSError(28, "No space left on device (injected)")
+            spilled_total[0] += len(data)
+            orig_spill(path, data, append=append, exchange=exchange)
+
         def publish_manifest(exchange, payload=None):
             n = orig_publish(exchange, payload)
             # manifest-only rounds (sizes, range key samples) bypass
@@ -241,4 +275,6 @@ class FaultInjector:
         svc.commit = commit
         if orig_publish is not None:
             svc.publish_manifest = publish_manifest
+        if orig_spill is not None:
+            svc.spill_write = spill_write
         return self

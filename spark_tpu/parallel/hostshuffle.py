@@ -78,7 +78,7 @@ from .. import config as C
 from .. import wire
 
 __all__ = ["HostShuffleService", "RetryingBlockReader", "BlockFetchError",
-           "ExchangeFetchFailed"]
+           "ExchangeFetchFailed", "FetchSink"]
 
 
 class BlockFetchError(OSError):
@@ -131,10 +131,164 @@ def _decode_block(data: bytes,
     """Wire-framed payload → batches; pre-wire pickle blocks (a mixed-
     version pod mid-upgrade) still decode, keyed off the magic bytes.
     ``dict_table`` resolves fingerprint-only dictionary references
-    (blocks written with the dedup wire, ``wire.dict_fingerprint``)."""
+    (blocks written with the dedup wire, ``wire.dict_fingerprint``).
+    A block may hold SEVERAL back-to-back frames (map-side spill spans
+    copied straight from a spill file) — all of them decode."""
     if data[:4] == wire.MAGIC or len(data) < wire.PREFIX_LEN:
-        return wire.decode_batches(data, dict_table=dict_table)
+        return wire.decode_frames(data, dict_table=dict_table)
     return pickle.loads(data)
+
+
+class _InflightGate:
+    """Bounded in-flight-bytes admission for the fetch/decode pool
+    (``spark.tpu.shuffle.io.maxInFlightBytes``): a fetch worker waits
+    for room instead of letting every sender's block pile up in host
+    RAM at once.  A single block larger than the whole bound is
+    admitted as soon as it is ALONE (no deadlock); ``max_bytes <= 0``
+    disables the gate entirely."""
+
+    def __init__(self, max_bytes: int,
+                 on_wait: Optional[Callable[[], None]] = None):
+        self.max_bytes = max_bytes
+        self._on_wait = on_wait
+        self._inflight = 0
+        self._cv = threading.Condition()
+
+    def acquire(self, nbytes: int) -> None:
+        if self.max_bytes <= 0:
+            return
+        nbytes = int(nbytes)
+        with self._cv:
+            waited = False
+            while self._inflight > 0 \
+                    and self._inflight + nbytes > self.max_bytes:
+                if not waited and self._on_wait is not None:
+                    self._on_wait()
+                waited = True
+                self._cv.wait()
+            self._inflight += nbytes
+
+    def release(self, nbytes: int) -> None:
+        if self.max_bytes <= 0:
+            return
+        with self._cv:
+            self._inflight -= int(nbytes)
+            self._cv.notify_all()
+
+
+class FetchSink:
+    """Reduce-side landing zone for fetched blocks under the host-memory
+    ledger: each decoded batch either reserves its raw bytes and stays
+    in RAM, or spills to a local run file in the wire format (the
+    ExternalAppendOnlyMap insert-spill analog).  Batch boundaries
+    survive the round trip — a spilled presorted run drains back as the
+    same presorted run, which is what lets the range lane k-way-merge
+    spilled runs unchanged.
+
+    ``add`` REPLACES a sender's previous delivery (releasing its
+    reservation and dropping its run file), so a ``refetch`` that
+    re-reads a sender after a failed attempt stays idempotent.  Own
+    batches arrive keyed at sender -1, so ``drain`` returns own-first,
+    sorted-sender order — the exact batch order the in-memory path has
+    always produced."""
+
+    def __init__(self, svc: "HostShuffleService", owner: str,
+                 exchange: str, spill_dir: str,
+                 spill_threshold: Optional[int] = None):
+        self.svc = svc
+        self.owner = owner
+        self.exchange = exchange
+        self.spill_dir = spill_dir
+        self.spill_threshold = (svc.spill_threshold
+                                if spill_threshold is None
+                                else spill_threshold)
+        self._lock = threading.Lock()
+        #: sender → (ordered entries, run-file path or None, file end)
+        #: entry: ("mem", batch, nbytes) | ("disk", start, length, raw)
+        self._senders: Dict[int, Tuple[list, Optional[str], int]] = {}
+
+    def _run_path(self, sender: int) -> str:
+        return os.path.join(self.spill_dir,
+                            f"{self.exchange}-s{sender:04d}.fetch")
+
+    def _evict_sender(self, sender: int) -> None:
+        entries, path, _end = self._senders.pop(
+            sender, ([], None, 0))
+        mem_held = sum(e[2] for e in entries if e[0] == "mem")
+        if mem_held:
+            self.svc.ledger.release(self.owner, mem_held)
+        if path is not None:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def add(self, sender: int, batches: Sequence[ColumnBatch]) -> None:
+        ledger = self.svc.ledger
+        with self._lock:
+            self._evict_sender(sender)
+            entries: list = []
+            path: Optional[str] = None
+            end = 0
+            for b in batches:
+                nb = wire.raw_nbytes([b])
+                force = 0 < self.spill_threshold <= nb
+                if not force and ledger.try_reserve(self.owner, nb):
+                    entries.append(("mem", b, nb))
+                    continue
+                # over threshold or no ledger room: land as a run file
+                # frame (inline dictionaries — fetched batches already
+                # resolved theirs, so the frame is self-contained)
+                buf = wire.encode_batches(
+                    [b], codec=self.svc.wire_codec,
+                    compress_threshold=self.svc.wire_threshold)
+                if path is None:
+                    path = self._run_path(sender)
+                try:
+                    self.svc.spill_write(path, buf, append=end > 0,
+                                         exchange=self.exchange)
+                except OSError as e:
+                    from ..memory import HostMemoryError
+                    raise HostMemoryError(
+                        self.owner, nb, ledger.budget,
+                        holders={self.owner: ledger.held(self.owner)},
+                        exchange=self.exchange,
+                        detail=f"spill failed: {e}")
+                entries.append(("disk", end, len(buf), nb))
+                end += len(buf)
+            self._senders[sender] = (entries, path, end)
+
+    def drain(self) -> List[ColumnBatch]:
+        """Everything delivered, own-first then sorted sender order,
+        spilled runs loaded back under a HARD ledger reservation (by
+        now the in-flight fetches are done; if the drained shard itself
+        cannot fit, that is a structured ``HostMemoryError``, not an
+        opaque OOM)."""
+        out: List[ColumnBatch] = []
+        with self._lock:
+            for sender in sorted(self._senders):
+                entries, path, _end = self._senders[sender]
+                for entry in entries:
+                    if entry[0] == "mem":
+                        out.append(entry[1])
+                        continue
+                    _kind, start, length, raw = entry
+                    self.svc.ledger.reserve(self.owner, raw,
+                                            exchange=self.exchange)
+                    with open(path, "rb") as f:
+                        f.seek(start)
+                        data = f.read(length)
+                    if len(data) != length:
+                        raise OSError(
+                            f"spill run {path}: short read {len(data)} "
+                            f"of {length} B at {start}")
+                    out.extend(wire.decode_frames(data))
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            for sender in list(self._senders):
+                self._evict_sender(sender)
 
 
 class RetryingBlockReader:
@@ -228,7 +382,8 @@ class HostShuffleService:
                  retry_wait_s: Optional[float] = None,
                  attempt_timeout_s: Optional[float] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 ledger=None):
         conf = conf or C.Conf()
         self.root = root
         self.pid = process_id
@@ -280,6 +435,11 @@ class HostShuffleService:
             # and receiver-side remaps into the unified code space
             "dict_columns_encoded": 0, "dict_bytes_saved": 0,
             "codes_remapped": 0,
+            # memory-pressure ladder: bytes/events spilled to disk on
+            # either side of an exchange, and fetch workers that had to
+            # wait for in-flight-bytes room
+            "spill_bytes": 0, "spill_events": 0,
+            "fetch_backpressure_waits": 0,
         }
         #: reduce-partition byte sizes of the most recent ``plan_reducers``
         #: / ``plan_range_reducers`` call (manifest-summed), feeding the
@@ -296,6 +456,17 @@ class HostShuffleService:
             "fetch_s": 0.0, "commit_wait_s": 0.0,
         }
         self._lock = threading.Lock()
+        if ledger is None:
+            from ..memory import HostMemoryLedger
+            ledger = HostMemoryLedger(conf)
+        #: host-RAM reservations for exchange staging (bucketed map
+        #: output, fetched blocks, drained shards); sides that cannot
+        #: reserve spill to disk through ``spill_write``
+        self.ledger = ledger
+        self.spill_threshold = conf.get(C.SHUFFLE_SPILL_THRESHOLD)
+        self.max_inflight_bytes = conf.get(C.SHUFFLE_IO_MAX_INFLIGHT)
+        self._gate = _InflightGate(self.max_inflight_bytes,
+                                   on_wait=self._count_backpressure)
         self._reader = RetryingBlockReader(
             max_retries=(max_retries if max_retries is not None
                          else conf.get(C.SHUFFLE_IO_MAX_RETRIES)),
@@ -334,6 +505,10 @@ class HostShuffleService:
             self.counters["blocks_read"] += 1
             self.counters["bytes_read"] += nbytes
             self.timers["decode_s"] += seconds
+
+    def _count_backpressure(self) -> None:
+        with self._lock:
+            self.counters["fetch_backpressure_waits"] += 1
 
     def host_name(self, pid: int) -> str:
         return self._host_names(pid)
@@ -475,6 +650,121 @@ class HostShuffleService:
         with open(tmp, "w") as f:
             json.dump(man, f)
         os.replace(tmp, path)
+
+    # -- spill side (memory-pressure ladder) ----------------------------
+    def spill_write(self, path: str, data: bytes, append: bool = False,
+                    exchange: str = "") -> None:
+        """The ONE primitive every spill byte goes through: append/write
+        ``data`` to a local spill file and account it.  Fault injection
+        (``faults.FaultInjector``) shadows this method to simulate a
+        full disk (``disk_full``), so both the map-side and reduce-side
+        spill paths are chaos-testable at a single seam."""
+        with open(path, "ab" if append else "wb") as f:
+            f.write(data)
+        with self._lock:
+            self.counters["spill_bytes"] += len(data)
+            self.counters["spill_events"] += 1
+
+    def encode_frames(self, exchange: str,
+                      batches: Sequence[ColumnBatch]) -> bytes:
+        """Encode host batches into one wire frame under this exchange's
+        dictionary-dedup refs (same refs the data blocks use, so spilled
+        frames and their ``commit``-published sidecar agree).  The frame
+        is byte-identical to what ``_write_block`` would publish —
+        receivers cannot tell a spilled span from an in-memory one."""
+        refs: Optional[Dict[str, tuple]] = None
+        stats: Dict[str, int] = {}
+        if self.dict_codes:
+            with self._lock:
+                refs = self._dict_refs.setdefault(exchange, {})
+        t0 = time.perf_counter()
+        buf = wire.encode_batches(list(batches), codec=self.wire_codec,
+                                  compress_threshold=self.wire_threshold,
+                                  dict_refs=refs, stats=stats)
+        with self._lock:
+            self.timers["encode_s"] += time.perf_counter() - t0
+            for k, v in stats.items():
+                self.counters[k] += v
+        return buf
+
+    def spill_map_partitions(self, exchange: str,
+                             slices: Sequence[Optional[ColumnBatch]],
+                             path: str) -> List[int]:
+        """Spill a side's fine-partition (or span) slices to ONE file as
+        back-to-back wire frames, one frame per non-empty slice.
+
+        Returns byte ``offsets`` of length ``len(slices)+1``: slice
+        ``p`` occupies ``[offsets[p], offsets[p+1])`` (empty slices get
+        equal adjacent offsets), so any CONTIGUOUS slice range maps to
+        one contiguous byte span — the unit ``put_frames`` ships to a
+        receiver without rematerializing a single row."""
+        offsets = [0]
+        for sl in slices:
+            if sl is None or int(sl.capacity) == 0:
+                offsets.append(offsets[-1])
+                continue
+            buf = self.encode_frames(exchange, [sl])
+            self.spill_write(path, buf, append=os.path.exists(path),
+                             exchange=exchange)
+            offsets.append(offsets[-1] + len(buf))
+        return offsets
+
+    def _read_parts(self, spill_path: Optional[str], parts) -> bytes:
+        """Concatenate a receiver's parts: ``(start, length)`` ranges of
+        ``spill_path`` and/or ready ``bytes`` frames, in order.  A range
+        that reads short is an ``OSError`` — a spill file is local and
+        fully written before anything ships, so short means disk
+        trouble, not visibility lag."""
+        chunks: List[bytes] = []
+        f = None
+        try:
+            for part in parts:
+                if isinstance(part, (bytes, bytearray, memoryview)):
+                    chunks.append(bytes(part))
+                    continue
+                start, length = part
+                if length <= 0:
+                    continue
+                if f is None:
+                    f = open(spill_path, "rb")
+                f.seek(start)
+                data = f.read(length)
+                if len(data) != length:
+                    raise OSError(
+                        f"spill file {spill_path}: short read "
+                        f"{len(data)} of {length} B at {start}")
+                chunks.append(data)
+        finally:
+            if f is not None:
+                f.close()
+        return b"".join(chunks)
+
+    def put_frames(self, exchange: str, receiver: int, parts,
+                   spill_path: Optional[str], raw_bytes: int,
+                   rows: int) -> None:
+        """Publish one receiver's block STRAIGHT from spill-file byte
+        spans (plus any already-encoded frames): copy the spans into the
+        block file and atomically rename — no decode, no re-encode, no
+        row ever rematerialized.  ``raw_bytes``/``rows`` carry the
+        pre-encode accounting ``_write_block`` would have derived from
+        live batches.  Synchronous (the data is already on disk; there
+        is no device step to overlap)."""
+        d = self._dir(exchange)
+        os.makedirs(d, exist_ok=True)
+        path = self._part(exchange, self.pid, receiver)
+        t0 = time.perf_counter()
+        buf = self._read_parts(spill_path, parts)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(buf)
+        os.replace(tmp, path)
+        with self._lock:
+            self._staged.setdefault(exchange, {})[receiver] = len(buf)
+            self.counters["blocks_written"] += 1
+            self.counters["bytes_written"] += len(buf)
+            self.counters["bytes_raw"] += int(raw_bytes)
+            self.counters["rows_shipped"] += int(rows)
+            self.timers["write_s"] += time.perf_counter() - t0
 
     def _read_manifest(self, exchange: str, sender: int) -> Optional[dict]:
         """The sender's commit manifest, or None when the marker is the
@@ -819,12 +1109,19 @@ class HostShuffleService:
                 out.extend(batches)
         return out
 
-    def _fetch_remote(self, exchange: str, t0: float) -> List[ColumnBatch]:
+    def _fetch_remote(self, exchange: str, t0: float,
+                      sink=None) -> List[ColumnBatch]:
         """One bounded fetch attempt: barrier, then manifest-driven reads
         with per-block retry, CONCURRENTLY across senders through the
         fetch pool.  Raises ``ExchangeFetchFailed`` naming every lost
         host/block; the whole attempt shares ONE ``timeout_s`` deadline
-        so failure is never slower than the configured bound."""
+        so failure is never slower than the configured bound.
+
+        Workers admit each block through the in-flight-bytes gate
+        (bounded backpressure) and, when a ``FetchSink`` is given, hand
+        decoded batches to ``sink.add(sender, batches)`` — which may
+        land them on disk — instead of accumulating them here (the
+        return value is then empty; drain the sink)."""
         deadline = self._clock() + self.timeout_s
         excluded = set(self.barrier(exchange, deadline=deadline))
         lost_hosts: List[str] = []
@@ -856,10 +1153,19 @@ class HostShuffleService:
 
             def fetch_one(item):
                 s, path, size, _host = item
-                return s, self._reader.read(
-                    path, expect_size=size, deadline=deadline,
-                    decode=lambda d, s=s: self._decode_with_dicts(
-                        exchange, s, d, deadline))
+                held = int(size or 0)
+                self._gate.acquire(held)
+                try:
+                    batches = self._reader.read(
+                        path, expect_size=size, deadline=deadline,
+                        decode=lambda d, s=s: self._decode_with_dicts(
+                            exchange, s, d, deadline))
+                    if sink is not None:
+                        sink.add(s, batches)
+                        batches = []
+                finally:
+                    self._gate.release(held)
+                return s, batches
 
             with self._pool(len(work)) as pool:
                 futures = [pool.submit(fetch_one, item) for item in work]
@@ -949,9 +1255,22 @@ class HostShuffleService:
                 self.counters["codes_remapped"] += n_remapped
         return out
 
+    def _gather(self, exchange: str, own: List[ColumnBatch], t0: float,
+                sink=None) -> List[ColumnBatch]:
+        """Shared read tail of every exchange shape: fetch remote blocks
+        (optionally landing them in a ``FetchSink`` under the ledger),
+        then unify code spaces over own-first, sorted-sender-order
+        batches — the order every shape has always produced."""
+        if sink is not None:
+            sink.add(-1, own)           # own partition sorts first
+            self._fetch_remote(exchange, t0, sink=sink)
+            return self._unify_code_space(sink.drain())
+        remote = self._fetch_remote(exchange, t0)
+        return self._unify_code_space(own + remote)
+
     def exchange(self, exchange: str,
-                 per_receiver: Dict[int, Sequence[ColumnBatch]]
-                 ) -> List[ColumnBatch]:
+                 per_receiver: Dict[int, Sequence[ColumnBatch]],
+                 sink=None) -> List[ColumnBatch]:
         """One full all-to-all hop: publish, commit, barrier, collect.
 
         Exchange ids are SINGLE-USE: a reused id would let the barrier
@@ -978,12 +1297,62 @@ class HostShuffleService:
             if r != self.pid:      # own partition never touches the disk
                 self.put(exchange, r, batches)
         self.commit(exchange)
-        remote = self._fetch_remote(exchange, t0)
-        return self._unify_code_space(own + remote)
+        return self._gather(exchange, own, t0, sink=sink)
+
+    def exchange_spilled(self, exchange: str, spill_path: str,
+                         routed: Dict[int, list],
+                         meta: Dict[int, Tuple[int, int]],
+                         sink=None) -> List[ColumnBatch]:
+        """The ``exchange`` hop for a side whose map output lives in a
+        spill file: each receiver's block is byte-span parts of
+        ``spill_path`` (see ``spill_map_partitions``) published via
+        ``put_frames`` — rows ship without ever being rematerialized.
+        ``meta[r] = (raw_bytes, rows)`` carries the accounting the live
+        path derives from batches; the own partition decodes from the
+        file only here, at reduce time."""
+        if os.path.exists(self._done(exchange, self.pid)):
+            raise ValueError(
+                f"host shuffle exchange id {exchange!r} was already used "
+                "by this process; ids are single-use (stale commit "
+                "markers would unblock the barrier early)")
+        t0 = self._clock()
+        self.counters["exchanges"] += 1
+        own = self._decode_spilled_own(exchange, spill_path, routed)
+        with self._lock:
+            own_rows = sum(int(b.capacity) for b in own)
+            self.counters["rows_produced"] += own_rows + sum(
+                int(meta.get(r, (0, 0))[1]) for r in routed
+                if r != self.pid)
+            self.counters["bytes_own_raw"] += wire.raw_nbytes(own)
+        for r, parts in routed.items():
+            if r != self.pid:
+                raw, rows = meta.get(r, (0, 0))
+                self.put_frames(exchange, r, parts, spill_path, raw, rows)
+        self.commit(exchange)
+        return self._gather(exchange, own, t0, sink=sink)
+
+    def decode_spilled(self, exchange: str, spill_path: Optional[str],
+                       parts) -> List[ColumnBatch]:
+        """Decode spill-file parts this process encoded itself (own
+        partition at reduce time, or a skew-split span that must
+        rematerialize to chop).  Frames were encoded under this
+        exchange's dict refs, which double as the decoder's fingerprint
+        table."""
+        with self._lock:
+            table = dict(self._dict_refs.get(exchange) or {}) or None
+        return wire.decode_frames(self._read_parts(spill_path, parts),
+                                  dict_table=table)
+
+    def _decode_spilled_own(self, exchange: str, spill_path: str,
+                            routed: Dict[int, list]) -> List[ColumnBatch]:
+        parts = routed.get(self.pid) or []
+        if not parts:
+            return []
+        return self.decode_spilled(exchange, spill_path, parts)
 
     def refetch(self, exchange: str,
                 per_receiver: Optional[Dict[int, Sequence[ColumnBatch]]]
-                = None) -> List[ColumnBatch]:
+                = None, sink=None) -> List[ColumnBatch]:
         """ONE more fetch attempt after an ``ExchangeFetchFailed``: a
         fresh re-barrier + re-read under a fresh ``timeout_s`` deadline
         (so exchange + refetch ≤ 2× the configured bound).  A dead peer
@@ -996,8 +1365,21 @@ class HostShuffleService:
                 f"{C.SHUFFLE_FETCH_RETRY_ENABLED.key}")
         self.counters["refetches"] += 1
         own = self._own(per_receiver or {})
-        remote = self._fetch_remote(exchange, self._clock())
-        return self._unify_code_space(own + remote)
+        return self._gather(exchange, own, self._clock(), sink=sink)
+
+    def refetch_spilled(self, exchange: str, spill_path: str,
+                        routed: Dict[int, list],
+                        sink=None) -> List[ColumnBatch]:
+        """``refetch`` for a spilled map side: own partition re-decodes
+        from the spill file (still on local disk), remote blocks are
+        re-fetched under a fresh deadline."""
+        if not self.refetch_enabled:
+            raise ExchangeFetchFailed(
+                exchange, [], [], detail="refetch disabled by "
+                f"{C.SHUFFLE_FETCH_RETRY_ENABLED.key}")
+        self.counters["refetches"] += 1
+        own = self._decode_spilled_own(exchange, spill_path, routed)
+        return self._gather(exchange, own, self._clock(), sink=sink)
 
     # -- observability ---------------------------------------------------
     def metrics_source(self):
@@ -1038,6 +1420,10 @@ class HostShuffleService:
         gauges["blacklisted_peers"] = lambda: len(self.blacklist)
         gauges["blacklist"] = lambda: ",".join(
             self.host_name(p) for p in sorted(self.blacklist)) or ""
+        # memory-pressure ladder: the ledger's high-water mark of
+        # accounted exchange-staging bytes, against its budget
+        gauges["peak_host_bytes"] = lambda: int(self.ledger.peak)
+        gauges["host_budget_bytes"] = lambda: int(self.ledger.budget)
         return Source("shuffle", gauges)
 
     def cleanup(self, exchange: str) -> None:

@@ -33,13 +33,14 @@ via --token or SPARK_TPU_SERVER_TOKEN):
 
 from __future__ import annotations
 
+import collections
 import hmac
 import json
 import os
 import threading
 import time
 import uuid
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import Future, ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
@@ -75,6 +76,13 @@ class _ServerSession:
         # session when ITS target is the one running, not whatever
         # statement happens to hold the session lock by then
         self.running_stmt: Optional[str] = None
+        # FIFO of (future, work) pairs waiting on this session, guarded by
+        # the server's _reg_lock.  A busy session drains its queue on ONE
+        # pool slot (``draining`` marks the drainer alive) — N statements
+        # stacked on one session must never pin N workers while other
+        # sessions starve
+        self.queue: collections.deque = collections.deque()
+        self.draining = False
 
 
 class _Statement:
@@ -176,7 +184,19 @@ class SQLServer:
                             ss.running_stmt = None
 
         from .sql.session import QueryCancelled
-        future = self._pool.submit(work)
+        # one pool slot per BUSY SESSION, not per statement: the work unit
+        # joins the session's FIFO, and a drainer task is spawned only if
+        # none is already running this session's queue.  The HTTP handler
+        # thread (not a pool thread) blocks on the future, so a session
+        # with a deep backlog cannot exhaust the worker pool.
+        future: Future = Future()
+        with self._reg_lock:
+            ss.queue.append((future, work))
+            spawn = not ss.draining
+            if spawn:
+                ss.draining = True
+        if spawn:
+            self._pool.submit(self._drain_session, ss)
         try:
             out = future.result()
             stmt.status = "done"
@@ -188,6 +208,24 @@ class SQLServer:
             if stmt.status != "cancelled":
                 stmt.status = "error"
             raise
+
+    def _drain_session(self, ss: _ServerSession) -> None:
+        """Run one session's queued statements serially on this single
+        worker slot; exits (clearing ``draining``) when the FIFO empties,
+        holding ``_reg_lock`` for the check so no enqueue slips between
+        'queue is empty' and 'drainer gone'."""
+        while True:
+            with self._reg_lock:
+                if not ss.queue:
+                    ss.draining = False
+                    return
+                future, work = ss.queue.popleft()
+            if not future.set_running_or_notify_cancel():
+                continue
+            try:
+                future.set_result(work())
+            except BaseException as e:  # noqa: BLE001 — deliver to waiter
+                future.set_exception(e)
 
     _MAX_FINISHED_STATEMENTS = 1000
 

@@ -193,8 +193,12 @@ class SpilledRuns:
     torn spill is detected on read instead of deserializing garbage.
     Pre-wire pickle spill files still load (magic-byte sniff)."""
 
-    def __init__(self, budget_rows: int, spill_dir: str):
+    def __init__(self, budget_rows: int, spill_dir: str,
+                 budget_bytes: int = 0):
         self.budget_rows = budget_rows
+        # optional second trigger: raw bytes held in RAM (the host-memory
+        # ledger's unit), so wide rows spill before the row budget trips
+        self.budget_bytes = budget_bytes
         # a fresh subdirectory per accumulator: concurrent queries (or two
         # mergers in one query) must never collide on run file names
         os.makedirs(spill_dir, exist_ok=True)
@@ -203,6 +207,7 @@ class SpilledRuns:
         self._disk: List[str] = []
         self.total_rows = 0
         self._mem_rows = 0
+        self._mem_bytes = 0
         self._n_spilled = 0
 
     def add(self, batch: ColumnBatch) -> None:
@@ -210,7 +215,10 @@ class SpilledRuns:
         self.total_rows += rows
         self._mem.append(batch)
         self._mem_rows += rows
-        if self._mem_rows > self.budget_rows:
+        if self.budget_bytes > 0:
+            self._mem_bytes += wire.raw_nbytes([batch])
+        if (self._mem_rows > self.budget_rows
+                or 0 < self.budget_bytes < self._mem_bytes):
             self._spill()
 
     def _spill(self) -> None:
@@ -223,6 +231,7 @@ class SpilledRuns:
         self._disk.append(path)
         self._mem = []
         self._mem_rows = 0
+        self._mem_bytes = 0
 
     def drain(self) -> List[ColumnBatch]:
         """All runs (disk runs loaded back); clears the accumulator."""
@@ -239,6 +248,7 @@ class SpilledRuns:
         self._disk = []
         self._mem = []
         self._mem_rows = 0
+        self._mem_bytes = 0
         self.total_rows = 0
         return runs
 
@@ -748,7 +758,9 @@ class MultiBatchExecution:
                 breaker, spine_schema, template)
             return _AggMerger(breaker.keys, breaker.aggs, spine_schema,
                               conf.get(C.AGG_FOLD_ROWS), str_dicts)
-        spill = SpilledRuns(conf.get(C.SPILL_MEMORY_ROWS), spill_dir)
+        spill = SpilledRuns(
+            conf.get(C.SPILL_MEMORY_ROWS), spill_dir,
+            budget_bytes=conf.get(C.SHUFFLE_SPILL_THRESHOLD))
         if isinstance(breaker, L.Sort):
             orders = [(o.child, o.ascending, o.nulls_first)
                       for o in breaker.orders]

@@ -803,9 +803,16 @@ class SparkSession:
                 else process_id
             n_processes = jax.process_count() if n_processes is None \
                 else n_processes
+        if getattr(self, "_host_ledger", None) is None:
+            # one ledger per session-process: re-enabling the shuffle
+            # (fault recovery, reconfiguration) keeps the same budget
+            # accounting instead of forgetting what is already held
+            from ..memory import HostMemoryLedger
+            self._host_ledger = HostMemoryLedger(self.conf_obj)
         self._crossproc_svc = HostShuffleService(
             root, process_id=process_id, n_processes=n_processes,
-            timeout_s=timeout_s, conf=self.conf_obj, heartbeat=heartbeat)
+            timeout_s=timeout_s, conf=self.conf_obj, heartbeat=heartbeat,
+            ledger=self._host_ledger)
         ms = self.metricsSystem
         ms._sources = [s for s in ms._sources if s.name != "shuffle"]
         ms.register_source(self._crossproc_svc.metrics_source())

@@ -1109,7 +1109,8 @@ def _run_breaker(session, stream: BatchStream, breaker: L.LogicalPlan,
     def make_spill():
         from .multibatch import SpilledRuns, default_spill_dir
         return SpilledRuns(conf.get(C.SPILL_MEMORY_ROWS),
-                           default_spill_dir(conf))
+                           default_spill_dir(conf),
+                           budget_bytes=conf.get(C.SHUFFLE_SPILL_THRESHOLD))
 
     compiled = None
     merger = None

@@ -64,8 +64,9 @@ from .columnar import ColumnBatch, ColumnVector
 __all__ = [
     "MAGIC", "WIRE_VERSION", "WireFormatError", "ChecksumError",
     "TruncatedBlockError", "DictFingerprintError", "encode_batches",
-    "decode_batches", "dict_fingerprint", "encode_dict_table",
-    "decode_dict_table", "frame_info", "raw_nbytes", "trim_host",
+    "decode_batches", "decode_frames", "dict_fingerprint",
+    "encode_dict_table", "decode_dict_table", "frame_info",
+    "frame_length", "raw_nbytes", "trim_host",
 ]
 
 MAGIC = b"STCB"
@@ -375,6 +376,26 @@ def frame_info(buf: bytes) -> dict:
     return header
 
 
+def frame_length(buf) -> int:
+    """Total byte length of the frame at the START of ``buf`` (prefix +
+    header + payload), from the prefix alone — the walk primitive for
+    spill files holding several frames back to back.  Error split
+    matches ``_split_frame``: a magic-prefixed short buffer is a torn
+    write (``TruncatedBlockError``), anything else malformed is a
+    ``WireFormatError``."""
+    if len(buf) < PREFIX_LEN:
+        if bytes(buf[:4]) == MAGIC[:min(4, len(buf))] and len(buf) > 0:
+            raise TruncatedBlockError(
+                f"frame prefix truncated: {len(buf)} of {PREFIX_LEN} bytes")
+        raise WireFormatError("not a wire block: shorter than the prefix")
+    magic, ver, hlen, plen, _ = _PREFIX.unpack_from(buf)
+    if magic != MAGIC:
+        raise WireFormatError(f"bad magic {bytes(magic)!r}")
+    if ver != WIRE_VERSION:
+        raise WireFormatError(f"unsupported wire version {ver}")
+    return PREFIX_LEN + hlen + plen
+
+
 def decode_batches(buf: bytes,
                    dict_table: Optional[Dict[str, Tuple]] = None
                    ) -> List[ColumnBatch]:
@@ -415,6 +436,31 @@ def decode_batches(buf: bytes,
         rv = (None if meta["row_valid"] is None else
               _decode_bitmask(payload, meta["row_valid"], cap))
         out.append(ColumnBatch(meta["names"], vectors, rv, cap))
+    return out
+
+
+def decode_frames(buf: bytes,
+                  dict_table: Optional[Dict[str, Tuple]] = None
+                  ) -> List[ColumnBatch]:
+    """Decode EVERY frame in a buffer of back-to-back wire blocks (a
+    spill file, or several map-side spans concatenated into one shuffle
+    block) into one flat batch list, preserving frame order.
+
+    A buffer holding exactly one frame behaves identically to
+    ``decode_batches`` — including its error classification — so
+    single-frame callers can switch over without changing retry
+    semantics."""
+    mv = memoryview(buf)
+    out: List[ColumnBatch] = []
+    off = 0
+    while off < len(mv) or off == 0:
+        ln = frame_length(mv[off:])
+        # decode_batches ignores trailing bytes past its first frame, so
+        # handing it the whole tail decodes just the frame at `off`
+        out.extend(decode_batches(mv[off:], dict_table=dict_table))
+        off += ln
+        if off >= len(mv):
+            break
     return out
 
 

@@ -25,6 +25,19 @@ classic ``-jL``/``-jR``).  Prints ``OK <rows>`` when the exchange healed
 mode "fault-sample": same contract, but the query runs on the RANGE path
 (sortMergeJoin on) so the plan can target the manifest-only
 ``-sample`` coordination round.
+
+mode "spill": the full parity battery again, but with a tiny forced
+``spark.tpu.shuffle.spillThresholdBytes`` and a capped host-memory
+budget, so every join exchange stages its map output AND its fetched
+blocks through the disk-spill path — spilled results must equal the
+in-memory results must equal the oracle, spill gauges must be nonzero,
+and the ledger's peak must stay under the budget.  Final line
+``SPILL-OK ...``.
+
+mode "spill-fault": forced-spill conf plus a ``disk_full`` FaultInjector
+rule from SPARK_TPU_FAULT_PLAN: the spill write fails with ENOSPC, and
+the query must fail BOUNDED with a structured ``HostMemoryError`` (the
+peer fails bounded on its exchange timeout) — never partial results.
 """
 
 import os
@@ -43,6 +56,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as np  # noqa: E402
 
 from spark_tpu import config as C  # noqa: E402
+from spark_tpu.memory import HOST_BUDGET, HostMemoryError  # noqa: E402
 from spark_tpu.parallel.faults import FaultInjector  # noqa: E402
 from spark_tpu.parallel.hostshuffle import ExchangeFetchFailed  # noqa: E402
 from spark_tpu.sql.session import SparkSession  # noqa: E402
@@ -71,6 +85,13 @@ session = SparkSession.builder.appName(f"sjoin-{pid}").getOrCreate()
 
 xs = session.newSession()
 xs.conf.set(C.MESH_SHARDS.key, "1")
+if mode in ("spill", "spill-fault"):
+    # a threshold far below any join side's bytes forces the map output
+    # of EVERY join exchange (and, via the FetchSink's force rule, every
+    # fetched block) through the spill files; the budget cap must be set
+    # BEFORE enableHostShuffle (the ledger reads it at construction)
+    xs.conf.set(C.SHUFFLE_SPILL_THRESHOLD.key, "1024")
+    xs.conf.set(HOST_BUDGET.key, str(32 << 20))
 svc = xs.enableHostShuffle(root, process_id=pid, n_processes=n,
                            timeout_s=timeout_s)
 # small advisory target: the test tables are tiny, and with the 4 MiB
@@ -196,6 +217,28 @@ if mode in ("fault", "fault-sample"):
     print(f"[p{pid}] OK {len(got)}", flush=True)
     os._exit(0)
 
+if mode == "spill-fault":
+    FaultInjector().attach(svc)        # disk_full plan from the env
+    set_mode("hash")
+    _name, sql, _ = QUERIES[0]
+    t0 = time.time()
+    try:
+        got = run(xs, sql)
+    except HostMemoryError as e:
+        # the faulted process: spill hit injected ENOSPC, and the error
+        # names the reserver and the exchange — structured and bounded
+        assert e.owner and "spill failed" in str(e), e
+        print(f"[p{pid}] FAILED-HOSTMEM {time.time() - t0:.2f} "
+              f"{e.owner}", flush=True)
+        os._exit(0)
+    except (ExchangeFetchFailed, TimeoutError):
+        # the healthy peer: its partner aborted mid-exchange, so it
+        # fails bounded on the fetch/barrier timeout — never partial
+        print(f"[p{pid}] FAILED {time.time() - t0:.2f} []", flush=True)
+        os._exit(0)
+    print(f"[p{pid}] PARTIAL rows={len(got)}", flush=True)
+    os._exit(1)
+
 JOIN_COUNTERS = ("range_merge_joins", "shuffled_joins", "broadcast_joins")
 for name, sql, expected in QUERIES:
     exp = run(oracle, sql)
@@ -243,6 +286,18 @@ assert gauges["dict_columns_encoded"] > 0, gauges
 assert gauges["dict_bytes_saved"] > 0, gauges
 assert gauges["codes_remapped"] > 0, gauges
 assert gauges["late_materialized_rows"] > 0, gauges
+if mode == "spill":
+    # every join exchange was forced through the spill path, results
+    # above matched the oracle anyway, and the ledger never exceeded the
+    # capped budget
+    assert svc.counters["spill_bytes"] > 0, svc.counters
+    assert svc.counters["spill_events"] > 0, svc.counters
+    assert 0 < gauges["peak_host_bytes"] <= gauges["host_budget_bytes"], \
+        gauges
+    print(f"[p{pid}] SPILL-OK bytes={svc.counters['spill_bytes']} "
+          f"events={svc.counters['spill_events']} "
+          f"peak={gauges['peak_host_bytes']}", flush=True)
+    os._exit(0)
 print(f"[p{pid}] ALL-OK range={svc.counters['range_merge_joins']} "
       f"shuffled={svc.counters['shuffled_joins']} "
       f"fast={svc.counters['fast_path_aggs']} "

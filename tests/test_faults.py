@@ -398,12 +398,36 @@ def test_skip_commit_keeps_barrier_loud(tmp_path):
         svc0.barrier("e")
 
 
+def test_disk_full_fails_spill_writes_after_budget(tmp_path):
+    """The ``disk_full`` rule models the spill disk filling mid-query:
+    ``svc.spill_write`` succeeds until the cumulative injected budget is
+    exhausted, then raises ENOSPC on every further write (a full disk
+    stays full) — and successful writes still count into the spill
+    gauges while failed ones do not."""
+    svc = HostShuffleService(str(tmp_path), 0, 1, timeout_s=5.0)
+    inj = FaultInjector(FaultPlan().disk_full(after_bytes=150)).attach(svc)
+    path = str(tmp_path / "run.spill")
+    svc.spill_write(path, b"x" * 100)
+    assert svc.counters["spill_bytes"] == 100
+    assert svc.counters["spill_events"] == 1
+    with pytest.raises(OSError) as ei:
+        svc.spill_write(path, b"x" * 100, append=True)
+    assert ei.value.errno == 28
+    with pytest.raises(OSError):           # still full on the next write
+        svc.spill_write(path, b"x" * 10, append=True)
+    assert svc.counters["spill_bytes"] == 100, svc.counters
+    assert any(f.startswith("disk_full:") for f in inj.injected), \
+        inj.injected
+    assert os.path.getsize(path) == 100    # no torn partial append
+
+
 def test_fault_plan_env_roundtrip(tmp_path):
     plan = (FaultPlan().drop(exchange="a", receiver=1)
             .truncate(heal_after_s=0.5, keep_bytes=3)
             .corrupt(exchange="d", heal_after_s=0.1)
             .delay(0.2, exchange="b")
-            .die_after_put(exchange="c", commit_first=True))
+            .die_after_put(exchange="c", commit_first=True)
+            .disk_full(after_bytes=4096, exchange="e"))
     env = {FAULT_PLAN_ENV: plan.to_env()}
     back = FaultPlan.from_env(env)
     assert [r.to_dict() for r in back.rules] \
@@ -601,3 +625,36 @@ def test_range_sample_manifest_corrupted_fails_bounded(tmp_path):
     # strict gather holds until the 6s exchange deadline on each side,
     # plus jit/startup slack — bounded, and far from a hang
     assert elapsed < 3 * 6.0 + 30, elapsed
+
+
+# ---------------------------------------------------------------------------
+# memory pressure meets disk pressure: when a forced spill hits ENOSPC
+# the query fails with a structured HostMemoryError naming the reserver,
+# the peer fails bounded on its exchange deadline — never partial output
+# ---------------------------------------------------------------------------
+
+def test_spill_disk_full_fails_bounded(tmp_path):
+    """p1 runs with a tiny forced spill threshold AND a disk_full rule:
+    its very first map-side spill write raises ENOSPC, so the join
+    aborts with ``HostMemoryError`` before p1 publishes anything; p0
+    (healthy, also in forced-spill mode) times out at the exchange.
+    Both processes fail STRUCTURED and bounded — no partial join rows
+    ever reach a client."""
+    plan = FaultPlan().disk_full(after_bytes=0)
+    root = str(tmp_path / "shuf")
+    t0 = time.monotonic()
+    p0 = _spawn_join_fault_worker(0, root, None, 8.0, mode="spill-fault")
+    p1 = _spawn_join_fault_worker(1, root, plan, 8.0, mode="spill-fault")
+    out0 = p0.communicate(timeout=120)[0]
+    out1 = p1.communicate(timeout=120)[0]
+    elapsed = time.monotonic() - t0
+    assert p0.returncode == 0, out0
+    assert p1.returncode == 0, out1
+    line1 = [ln for ln in out1.splitlines() if "[p1]" in ln][-1]
+    assert "FAILED-HOSTMEM" in line1, out1
+    assert "FAILED" in out0, out0
+    assert "PARTIAL" not in out0 + out1
+    assert "OK" not in out0 and "OK" not in out1
+    # p1 fails immediately at the spill; p0 holds only to its exchange
+    # deadline (+ refetch), plus jit/startup slack
+    assert elapsed < 3 * 8.0 + 30, elapsed

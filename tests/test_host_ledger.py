@@ -1,0 +1,250 @@
+"""Host-memory ledger + spill-aware exchange staging (ISSUE:
+memory-pressure-safe distributed joins).
+
+Unit layer for the tentpole's building blocks:
+
+- ``HostMemoryLedger``: budget discovery, reserve/release accounting,
+  peak tracking, the structured ``HostMemoryError`` a failed hard
+  reservation raises (naming reserver, exchange, and current holders);
+- ``FetchSink``: fetched blocks land in RAM under the ledger or spill
+  to wire-format run files, drain preserves the own-first sorted-sender
+  batch order and batch boundaries, re-adding a sender is idempotent
+  (the refetch contract), and a failed spill surfaces as a structured
+  ``HostMemoryError`` — never a partial delivery;
+- ``spill_map_partitions`` + ``exchange_spilled``: map output spilled as
+  per-partition frames ships receivers their byte spans straight from
+  the spill file, byte-identical to the in-memory exchange.
+
+The 2- and 3-process end-to-end parity lives in test_shuffled_join.py
+(mode "spill") and the disk-full chaos in test_faults.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from spark_tpu import config as C
+from spark_tpu import wire
+from spark_tpu.columnar import ColumnBatch
+from spark_tpu.memory import (
+    HOST_BUDGET, HostMemoryError, HostMemoryLedger, discover_host_budget,
+)
+from spark_tpu.parallel.hostshuffle import FetchSink, HostShuffleService
+
+
+def _batch(vals):
+    return ColumnBatch.from_arrays({"v": np.asarray(vals, np.int64)})
+
+
+def _values(batches):
+    return [int(x) for b in batches
+            for x, ok in zip(np.asarray(b.column("v").data),
+                             np.asarray(b.row_valid_or_true())) if ok]
+
+
+# ---------------------------------------------------------------------------
+# the ledger
+# ---------------------------------------------------------------------------
+
+def test_discover_host_budget_positive():
+    assert discover_host_budget() > 0
+
+
+def test_ledger_budget_from_conf_and_discovery():
+    conf = C.Conf()
+    conf.set(HOST_BUDGET.key, "12345")
+    assert HostMemoryLedger(conf).budget == 12345
+    # unset (0) → discovered machine total
+    assert HostMemoryLedger(C.Conf()).budget == discover_host_budget()
+    assert HostMemoryLedger(budget=77).budget == 77
+
+
+def test_ledger_reserve_release_accounting():
+    led = HostMemoryLedger(budget=1000)
+    assert led.try_reserve("a", 400)
+    assert led.try_reserve("b", 500)
+    assert not led.try_reserve("c", 200)       # 900 + 200 > 1000
+    assert led.used == 900 and led.free == 100
+    assert led.held("a") == 400 and led.held("c") == 0
+    led.release("a", 150)                      # partial
+    assert led.held("a") == 250 and led.used == 750
+    led.release("a")                           # remainder
+    assert led.held("a") == 0
+    led.release("b")
+    assert led.used == 0 and led.free == 1000
+    assert led.peak == 900                     # high-water mark survives
+
+
+def test_ledger_release_prefix_scopes_by_query():
+    led = HostMemoryLedger(budget=1000)
+    led.reserve("shuffle:xq000001:jL-map", 100)
+    led.reserve("shuffle:xq000001:jL-fetch", 200)
+    led.reserve("shuffle:xq000002:jL-map", 300)
+    led.release_prefix("shuffle:xq000001")
+    assert led.used == 300
+    assert led.held("shuffle:xq000002:jL-map") == 300
+
+
+def test_hard_reserve_raises_structured_host_memory_error():
+    led = HostMemoryLedger(budget=1000)
+    led.reserve("shuffle:q:jL-map", 800)
+    with pytest.raises(HostMemoryError) as ei:
+        led.reserve("shuffle:q:jR-map", 400, exchange="q-jR")
+    e = ei.value
+    assert isinstance(e, MemoryError)          # catchable as the stdlib kind
+    assert e.owner == "shuffle:q:jR-map"
+    assert e.requested == 400 and e.budget == 1000
+    assert e.exchange == "q-jR"
+    assert e.holders == {"shuffle:q:jL-map": 800}
+    msg = str(e)
+    assert "shuffle:q:jR-map" in msg and "q-jR" in msg and "1000" in msg
+    # the failed reserve left no residue
+    assert led.used == 800
+
+
+# ---------------------------------------------------------------------------
+# FetchSink: ledger-gated landing zone for fetched blocks
+# ---------------------------------------------------------------------------
+
+def _svc(tmp_path, budget, pid=0, n=1):
+    return HostShuffleService(str(tmp_path / "root"), pid, n,
+                              timeout_s=5.0, poll_s=0.02,
+                              ledger=HostMemoryLedger(budget=budget))
+
+
+def test_fetch_sink_in_memory_order_and_release(tmp_path):
+    svc = _svc(tmp_path, budget=1 << 20)
+    sink = FetchSink(svc, "shuffle:q:fetch", "q", str(tmp_path))
+    sink.add(2, [_batch([20, 21])])
+    sink.add(0, [_batch([0])])
+    sink.add(-1, [_batch([9, 9])])             # own batches
+    assert svc.ledger.used > 0
+    out = sink.drain()
+    assert _values(out) == [9, 9, 0, 20, 21]   # own first, then senders
+    sink.close()
+    assert svc.ledger.used == 0
+    assert svc.counters["spill_events"] == 0   # everything fit in RAM
+
+
+def test_fetch_sink_spills_and_drains_identically(tmp_path):
+    b_own, b1, b2 = _batch([1, 2, 3]), _batch([10] * 64), _batch([7] * 64)
+    raw = wire.raw_nbytes([b1])
+    svc = _svc(tmp_path, budget=1 << 20)
+    # the force rule: any fetched batch at/above the threshold goes to
+    # its sender's run file without ever occupying the ledger
+    sink = FetchSink(svc, "shuffle:q:fetch", "q", str(tmp_path),
+                     spill_threshold=raw)
+    sink.add(1, [b1])                          # forced to disk
+    sink.add(2, [b2])                          # forced to disk
+    sink.add(-1, [b_own])                      # small → stays in RAM
+    assert svc.counters["spill_events"] >= 2
+    assert svc.counters["spill_bytes"] > 0
+    assert any(f.endswith(".fetch") for f in os.listdir(str(tmp_path)))
+    out = sink.drain()                         # disk runs re-reserved hard
+    assert svc.ledger.peak >= 2 * raw          # drain accounted the reads
+    assert _values(out) == [1, 2, 3] + [10] * 64 + [7] * 64
+    # batch boundaries survive the spill round trip
+    assert [b.capacity for b in out] \
+        == [b_own.capacity, b1.capacity, b2.capacity]
+    sink.close()
+    # the drained runs stay accounted to the query owner until the
+    # query-scope release (crossproc_execute's release_prefix)
+    assert svc.ledger.used == 2 * raw
+    svc.ledger.release_prefix("shuffle:q")
+    assert svc.ledger.used == 0
+
+
+def test_fetch_sink_drain_over_budget_fails_bounded(tmp_path):
+    """When the drained whole no longer fits the budget (a shuffled-hash
+    shard must be fully resident to join), the hard reserve at drain
+    raises the structured error instead of returning a PARTIAL shard."""
+    b1, b2 = _batch([10] * 64), _batch([7] * 64)
+    raw = wire.raw_nbytes([b1])
+    svc = _svc(tmp_path, budget=raw + raw // 2)   # fits ONE big batch
+    sink = FetchSink(svc, "shuffle:q:fetch", "q", str(tmp_path))
+    sink.add(1, [b1])                          # reserved in RAM
+    sink.add(2, [b2])                          # budget blown → run file
+    assert svc.counters["spill_events"] >= 1
+    with pytest.raises(HostMemoryError) as ei:
+        sink.drain()
+    assert ei.value.owner == "shuffle:q:fetch"
+    sink.close()
+    assert svc.ledger.used == 0
+
+
+def test_fetch_sink_readd_is_idempotent(tmp_path):
+    """The refetch path re-reads a sender after a failed attempt: the
+    second delivery must REPLACE the first (reservation and run file),
+    not double-count it."""
+    svc = _svc(tmp_path, budget=1 << 20)
+    sink = FetchSink(svc, "shuffle:q:fetch", "q", str(tmp_path))
+    sink.add(1, [_batch([5, 6])])
+    held = svc.ledger.used
+    sink.add(1, [_batch([5, 6])])
+    assert svc.ledger.used == held
+    assert _values(sink.drain()) == [5, 6]
+    sink.close()
+
+
+def test_fetch_sink_spill_failure_is_structured(tmp_path):
+    svc = _svc(tmp_path, budget=64)            # nothing fits in RAM
+    def broken(path, data, append=False, exchange=""):
+        raise OSError(28, "No space left on device")
+    svc.spill_write = broken
+    sink = FetchSink(svc, "shuffle:q:fetch", "q", str(tmp_path))
+    with pytest.raises(HostMemoryError) as ei:
+        sink.add(1, [_batch([1] * 64)])
+    assert "spill failed" in str(ei.value)
+    assert ei.value.owner == "shuffle:q:fetch"
+    sink.close()
+    assert svc.ledger.used == 0
+
+
+# ---------------------------------------------------------------------------
+# map-side spill: per-partition frames, shipped as byte spans
+# ---------------------------------------------------------------------------
+
+def test_spill_map_partitions_offsets_and_spans(tmp_path):
+    svc = _svc(tmp_path, budget=1 << 20)
+    slices = [_batch([0, 1]), None, _batch([7, 8, 9])]
+    path = str(tmp_path / "q.map")
+    offs = svc.spill_map_partitions("q-x", slices, path)
+    assert len(offs) == 4 and offs[0] == 0
+    assert offs[1] == offs[2]                  # empty slice: zero-length
+    assert offs[3] == os.path.getsize(path)
+    # a single partition's span decodes to exactly that slice
+    got = svc.decode_spilled("q-x", path, [(offs[2], offs[3] - offs[2])])
+    assert _values(got) == [7, 8, 9]
+    # a multi-partition span walks both frames
+    got2 = svc.decode_spilled("q-x", path, [(0, offs[3])])
+    assert _values(got2) == [0, 1, 7, 8, 9]
+
+
+def test_exchange_spilled_matches_in_memory_exchange(tmp_path):
+    b0, b1 = _batch([1, 2, 3]), _batch([40, 50])
+    mem = _svc(tmp_path / "m", budget=1 << 20)
+    want = mem.exchange("q", {0: [b0, b1]})
+    svc = _svc(tmp_path / "s", budget=1 << 20)
+    path = str(tmp_path / "s" / "q.map")
+    offs = svc.spill_map_partitions("q", [b0, b1], path)
+    routed = {0: [(offs[0], offs[2] - offs[0])]}
+    got = svc.exchange_spilled("q", path, routed, {})
+    assert _values(got) == _values(want) == [1, 2, 3, 40, 50]
+    # single-use contract holds for the spilled form too
+    with pytest.raises(ValueError):
+        svc.exchange_spilled("q", path, routed, {})
+
+
+def test_exchange_spilled_dictionary_codes_roundtrip(tmp_path):
+    """The encoded-execution lane survives the spill: dictionary columns
+    spill as codes + sidecar refs, and the own-partition decode resolves
+    them from the sender's local ref table."""
+    b = ColumnBatch.from_arrays({"s": ["ash", "oak", "ash", "fir"]})
+    svc = _svc(tmp_path, budget=1 << 20)
+    path = str(tmp_path / "q.map")
+    offs = svc.spill_map_partitions("qd", [b], path)
+    got = svc.exchange_spilled("qd", path,
+                               {0: [(0, offs[1])]}, {})
+    assert got[0].column("s").dictionary == ("ash", "fir", "oak")
+    assert got[0].to_pylist() == b.to_pylist()

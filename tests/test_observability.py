@@ -219,6 +219,54 @@ def test_shuffle_dict_gauges_exported(spark, tmp_path):
         ms._sources = [s for s in ms._sources if s.name != "shuffle"]
 
 
+def test_spill_and_ledger_gauges_exported(spark, tmp_path):
+    """Memory-pressure handling is observable: spill bytes/events, fetch
+    backpressure waits, and the host ledger's peak/budget surface as
+    gauges on the shuffle source — and the session memory source mirrors
+    the same ledger."""
+    import threading
+
+    from spark_tpu.parallel.hostshuffle import _InflightGate
+    prev = getattr(spark, "_crossproc_svc", None)
+    prev_ledger = getattr(spark, "_host_ledger", None)
+    ms = spark.metricsSystem
+    try:
+        svc = spark.enableHostShuffle(str(tmp_path), process_id=0,
+                                      n_processes=1, timeout_s=5.0)
+        snap0 = ms.snapshots()["shuffle"]
+        assert snap0["spill_bytes"] == 0
+        assert snap0["spill_events"] == 0
+        assert snap0["fetch_backpressure_waits"] == 0
+        assert snap0["host_budget_bytes"] > 0
+        # a spill write counts bytes and events
+        svc.spill_write(str(tmp_path / "r.spill"), b"z" * 2048)
+        # a ledger reservation moves the peak (and releases cleanly)
+        svc.ledger.reserve("shuffle:test", 4096)
+        svc.ledger.release("shuffle:test")
+        # the in-flight gate reports each wait through the service hook
+        gate = _InflightGate(16, on_wait=svc._count_backpressure)
+        gate.acquire(10)
+        t = threading.Timer(0.05, lambda: gate.release(10))
+        t.start()
+        gate.acquire(10)                   # must wait for the release
+        gate.release(10)
+        t.join()
+        snap = ms.snapshots()["shuffle"]
+        assert snap["spill_bytes"] == 2048
+        assert snap["spill_events"] == 1
+        assert snap["fetch_backpressure_waits"] == 1
+        assert snap["peak_host_bytes"] >= 4096
+        # the session memory source reads the SAME ledger
+        memsnap = ms.snapshots()["memory"]
+        assert memsnap["host_budget_bytes"] == snap["host_budget_bytes"]
+        assert memsnap["host_peak_bytes"] == snap["peak_host_bytes"]
+        assert memsnap["host_used_bytes"] == 0
+    finally:
+        spark._crossproc_svc = prev
+        spark._host_ledger = prev_ledger
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]
+
+
 def test_memory_leak_check_releases(spark, mdf):
     """Executor.scala's 'managed memory leak detected' idiom: a leaked
     execution reservation is detected and released after the query."""

@@ -190,6 +190,53 @@ def test_cancel_slow_statement(server, spark, tmp_path):
     assert r["rows"] == [[5]]
 
 
+def test_busy_session_does_not_starve_pool(spark):
+    """Statements stacked on ONE busy session must hold at most one
+    worker slot (the per-session FIFO drainer): with a 2-worker pool,
+    session A wedged mid-statement and THREE more statements queued
+    behind it, session B's statement still runs promptly — before the
+    fix each queued statement blocked a pool thread on A's session lock
+    and B starved until A finished."""
+    import threading
+    import time
+    srv = SQLServer(spark, port=0, workers=2).start()
+    try:
+        _, sa = _req(srv, "/session", "POST")
+        _, sb = _req(srv, "/session", "POST")
+        sida, sidb = sa["sessionId"], sb["sessionId"]
+        ssa = srv._sessions[sida]
+        # wedge session A as if a long statement held it mid-execution
+        ssa.lock.acquire()
+        unwedge = threading.Timer(8.0, ssa.lock.release)
+        unwedge.start()
+        codes = []
+
+        def post_a():
+            _, r = _req(srv, "/sql", "POST", json.dumps(
+                {"query": "SELECT 1", "session": sida}))
+            codes.append(r["rows"][0][0])
+
+        backlog = [threading.Thread(target=post_a) for _ in range(3)]
+        for t in backlog:
+            t.start()
+        time.sleep(0.5)                  # let the backlog enqueue
+        t0 = time.monotonic()
+        _, rb = _req(srv, "/sql", "POST", json.dumps(
+            {"query": "SELECT 42", "session": sidb}))
+        elapsed = time.monotonic() - t0
+        assert rb["rows"] == [[42]]
+        assert elapsed < 5.0, f"session B starved for {elapsed:.1f}s"
+        # A's backlog drains fine once the wedge lifts (FIFO, no losses)
+        unwedge.cancel()
+        if ssa.lock.locked():
+            ssa.lock.release()
+        for t in backlog:
+            t.join(60)
+        assert codes == [1, 1, 1]
+    finally:
+        srv.stop()
+
+
 def test_bearer_token_auth(spark):
     srv = SQLServer(spark, port=0, token="sekrit").start()
     try:

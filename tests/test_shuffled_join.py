@@ -248,3 +248,35 @@ def test_parity_two_processes(tmp_path):
 @pytest.mark.slow
 def test_parity_three_processes(tmp_path):
     _run_parity(tmp_path, 3)
+
+
+# ---------------------------------------------------------------------------
+# spill parity: the same battery forced through the disk-spill path
+# ---------------------------------------------------------------------------
+
+def _run_spill_parity(tmp_path, n, timeout_s=90.0):
+    root = str(tmp_path / "shuf")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("SPARK_TPU_FAULT_PLAN", None)
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(pid), str(n), root, "spill",
+         str(timeout_s)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(n)]
+    outs = [p.communicate(timeout=420)[0] for p in procs]
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid}:\n{out}"
+        # the full battery passed against the oracle AND the spill path
+        # demonstrably ran under the capped ledger
+        assert f"[p{pid}] SPILL-OK" in out, out
+        assert "PARITY-FAIL" not in out, out
+    return outs
+
+
+def test_spill_parity_two_processes(tmp_path):
+    _run_spill_parity(tmp_path, 2)
+
+
+@pytest.mark.slow
+def test_spill_parity_three_processes(tmp_path):
+    _run_spill_parity(tmp_path, 3)

@@ -433,3 +433,70 @@ def test_spilled_runs_reads_legacy_pickle(tmp_path):
     np.testing.assert_array_equal(np.asarray(runs[0].vectors[0].data),
                                   [0, 1, 2, 3])
     s.close()
+
+
+# ---------------------------------------------------------------------------
+# multi-frame buffers: frame_length + decode_frames (spill-span reads)
+# ---------------------------------------------------------------------------
+
+def test_frame_length_matches_encoded_size():
+    b = ColumnBatch.from_arrays({"v": np.arange(5, dtype=np.int64)})
+    buf = wire.encode_batches([b])
+    assert wire.frame_length(buf) == len(buf)
+    # trailing garbage does not change the first frame's length
+    assert wire.frame_length(buf + b"garbage") == len(buf)
+
+
+def test_frame_length_error_classification():
+    b = ColumnBatch.from_arrays({"v": np.arange(5, dtype=np.int64)})
+    buf = wire.encode_batches([b])
+    with pytest.raises(wire.TruncatedBlockError):
+        wire.frame_length(buf[:10])       # magic present, prefix cut short
+    with pytest.raises(wire.WireFormatError):
+        wire.frame_length(b"")
+    with pytest.raises(wire.WireFormatError):
+        wire.frame_length(b"NOPE" + buf[4:])
+
+
+def test_decode_frames_concatenated_spill_spans():
+    """Spilled map partitions append one frame per slice; a receiver's
+    byte span is several back-to-back frames — decode_frames walks them
+    all where decode_batches would silently stop at the first."""
+    b1 = ColumnBatch.from_arrays({"v": np.arange(4, dtype=np.int64)})
+    b2 = ColumnBatch.from_arrays({"v": np.arange(7, dtype=np.int64)})
+    b3 = ColumnBatch.from_arrays({"v": np.arange(2, dtype=np.int64)})
+    buf = (wire.encode_batches([b1]) + wire.encode_batches([b2, b3])
+           + wire.encode_batches([b3]))
+    out = wire.decode_frames(buf)
+    _assert_batches_equal(out, [b1, b2, b3, b3])
+    # single frame: identical to decode_batches
+    single = wire.encode_batches([b1])
+    _assert_batches_equal(wire.decode_frames(single),
+                          wire.decode_batches(single))
+
+
+def test_decode_frames_error_in_later_frame():
+    b = ColumnBatch.from_arrays({"v": np.arange(4, dtype=np.int64)})
+    f1, f2 = wire.encode_batches([b]), bytearray(wire.encode_batches([b]))
+    f2[-1] ^= 0xFF                        # corrupt the SECOND frame
+    with pytest.raises(wire.ChecksumError):
+        wire.decode_frames(f1 + bytes(f2))
+    with pytest.raises(wire.TruncatedBlockError):
+        wire.decode_frames(f1 + f1[: len(f1) // 2])
+
+
+def test_spilled_runs_byte_budget_triggers_spill(tmp_path):
+    """The byte-based second trigger: rows far under the row budget
+    still spill once the raw bytes held in RAM exceed budget_bytes."""
+    from spark_tpu.sql.multibatch import SpilledRuns
+    b = ColumnBatch.from_arrays({"v": np.arange(64, dtype=np.int64)})
+    nb = wire.raw_nbytes([b])
+    s = SpilledRuns(budget_rows=10_000, spill_dir=str(tmp_path),
+                    budget_bytes=nb + 1)
+    s.add(b)
+    assert not s._disk                    # under both budgets
+    s.add(b)                              # bytes budget exceeded
+    assert len(s._disk) == 1 and s._mem_bytes == 0
+    runs = s.drain()
+    assert sum(b.capacity for b in runs) == 128
+    s.close()
