@@ -39,6 +39,7 @@ import os
 import statistics
 import subprocess
 import sys
+import threading
 import time
 
 BASELINE_AGG_ROWS_PER_S = 93.5e6    # AggregateBenchmark.scala:125-131
@@ -1200,6 +1201,163 @@ def distspill_worker_main() -> None:
     sys.stdout.flush()
 
 
+def _bench_servebench() -> dict:
+    """Servebench lane: multi-tenant serving throughput, plan cache on/off.
+
+    One CPU worker process runs an in-process SQL server twice — plan
+    cache disabled, then enabled — with 4 concurrent HTTP sessions each
+    replaying the same mix of parameterized query variants.  Cache off,
+    every (session, literal-variant) pays its own trace+compile; cache
+    on, literal slotting folds all variants of a template into ONE
+    shared executable, so the first session's compile serves everyone.
+    The lane pins result equality across modes and reports the
+    throughput/latency delta the cache buys."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="spark_tpu_bench_serve_")
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("SPARK_TPU_FAULT_PLAN", None)
+        env.pop("SPARK_TPU_PLATFORM", None)
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--servebench-worker", d],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        out, err = p.communicate(timeout=CHILD_TIMEOUT_S)
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"servebench worker rc={p.returncode}: "
+                f"{(err or out).strip().splitlines()[-3:]}")
+        o = json.loads([ln for ln in out.splitlines()
+                        if ln.strip().startswith("{")][-1])
+        if o["off"]["checksum"] != o["on"]["checksum"]:
+            raise RuntimeError(f"cache on/off results diverge: {o}")
+        if o["on"]["cache_hits"] <= 0:
+            raise RuntimeError(f"plan cache never hit: {o}")
+        return {
+            "servebench_sessions": o["sessions"],
+            "servebench_statements": o["off"]["statements"],
+            "servebench_stmts_per_sec_cache_off":
+                o["off"]["stmts_per_sec"],
+            "servebench_stmts_per_sec_cache_on":
+                o["on"]["stmts_per_sec"],
+            "servebench_cache_speedup": round(
+                o["on"]["stmts_per_sec"]
+                / max(o["off"]["stmts_per_sec"], 1e-9), 3),
+            "servebench_p50_ms_cache_off": o["off"]["p50_ms"],
+            "servebench_p95_ms_cache_off": o["off"]["p95_ms"],
+            "servebench_p50_ms_cache_on": o["on"]["p50_ms"],
+            "servebench_p95_ms_cache_on": o["on"]["p95_ms"],
+            "servebench_cache_hits": o["on"]["cache_hits"],
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def servebench_worker_main() -> None:
+    """The servebench lane's single worker (see ``_bench_servebench``).
+
+    argv: --servebench-worker <root>.  Starts an in-process SQLServer on
+    a loopback port, opens 4 HTTP sessions, and replays 2 query
+    templates x 3 literal variants per session, cache off then on.
+    Prints ONE JSON line with per-mode throughput, latency percentiles,
+    a result checksum, and the cache-on hit count."""
+    import tempfile
+    import urllib.request
+
+    i = sys.argv.index("--servebench-worker")
+    root = sys.argv[i + 1]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    # fresh compilation cache: the persistent one would hand cache-off
+    # its compiles back and fake the comparison
+    jax.config.update("jax_compilation_cache_dir",
+                      tempfile.mkdtemp(prefix="jaxcache_", dir=root))
+
+    from spark_tpu.server import SQLServer
+    from spark_tpu.sql.session import SparkSession
+
+    def _http(port, method, path, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=(json.dumps(body).encode() if body is not None else None),
+            method=method)
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return json.loads(resp.read().decode())
+
+    N_SESSIONS, N_VARIANTS = 4, 3
+    TEMPLATES = [
+        "SELECT k % 10 AS g, sum(v) AS sv, count(*) AS c FROM f "
+        "WHERE v < {lit} GROUP BY k % 10 ORDER BY g",
+        "SELECT count(*) AS c, sum(v) AS sv FROM f WHERE k % 7 = {lit}",
+    ]
+    base = SparkSession.builder.appName("servebench").getOrCreate()
+    out = {"sessions": N_SESSIONS}
+    for mode in ("off", "on"):
+        srv_sess = base.newSession()
+        srv_sess.conf.set("spark.tpu.mesh.shards", "1")
+        srv_sess.conf.set("spark.sql.warehouse.dir",
+                          os.path.join(root, f"wh_{mode}"))
+        srv_sess.conf.set("spark.tpu.server.planCache.enabled",
+                          "true" if mode == "on" else "false")
+        srv_sess.sql("CREATE TABLE f AS SELECT id AS k, "
+                     "(id * 7) % 1000 AS v FROM range(65536)")
+        srv = SQLServer(srv_sess, port=0, workers=N_SESSIONS).start()
+        try:
+            lat_ms, sums, errs = [], [], []
+            lock = threading.Lock()
+
+            def client(_cid):
+                try:
+                    sid = _http(srv.port, "POST", "/session")["sessionId"]
+                    for rep in range(N_VARIANTS):
+                        for t_i, tpl in enumerate(TEMPLATES):
+                            q = tpl.format(lit=101 + 13 * rep + t_i)
+                            t0 = time.perf_counter()
+                            r = _http(srv.port, "POST", "/sql",
+                                      {"query": q, "session": sid})
+                            dt = (time.perf_counter() - t0) * 1000
+                            s = sum(c for row in r["rows"] for c in row
+                                    if isinstance(c, int))
+                            with lock:
+                                lat_ms.append(dt)
+                                sums.append(s)
+                    _http(srv.port, "DELETE", f"/session/{sid}")
+                except Exception as e:   # noqa: BLE001 — report, not hang
+                    with lock:
+                        errs.append(f"{type(e).__name__}: {e}")
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(N_SESSIONS)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errs:
+                raise RuntimeError(f"servebench {mode}: {errs[:3]}")
+            lat_ms.sort()
+            pc = srv._plan_cache.stats() if srv._plan_cache else {}
+            out[mode] = {
+                "statements": len(lat_ms),
+                "stmts_per_sec": round(len(lat_ms) / wall, 2),
+                "p50_ms": round(lat_ms[len(lat_ms) // 2], 1),
+                "p95_ms": round(lat_ms[int(len(lat_ms) * 0.95)
+                                       - 1], 1),
+                "checksum": int(sum(sums)),
+                "cache_hits": int(pc.get("hits", 0)),
+            }
+        finally:
+            srv.stop()
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
 def child_main() -> None:
     import numpy as np
     import jax
@@ -1298,6 +1456,13 @@ def child_main() -> None:
     except Exception as e:   # secondary must not sink the primary
         print(f"[bench-child] distspill bench failed: {e}", file=sys.stderr)
         extras["distspill_error"] = str(e)[:300]
+    try:
+        # multi-tenant serving: concurrent HTTP sessions replaying a
+        # parameterized query mix, shared plan cache off vs on
+        extras.update(_bench_servebench())
+    except Exception as e:   # secondary must not sink the primary
+        print(f"[bench-child] servebench failed: {e}", file=sys.stderr)
+        extras["servebench_error"] = str(e)[:300]
 
     try:
         load_1m = round(os.getloadavg()[0], 2)
@@ -1329,6 +1494,8 @@ if __name__ == "__main__":
         distdict_worker_main()
     elif "--distspill-worker" in sys.argv:
         distspill_worker_main()
+    elif "--servebench-worker" in sys.argv:
+        servebench_worker_main()
     elif "--child" in sys.argv:
         child_main()
     else:

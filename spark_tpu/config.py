@@ -468,3 +468,61 @@ DEBUG_NANS = conf("spark.tpu.debug.nanChecks").doc(
     "numeric-debugging layer SURVEY §5 notes the reference lacks. Off by "
     "default (SQL semantics legitimately produce NaN, e.g. 0.0/0.0)."
 ).boolean(False)
+
+# -- multi-tenant serving (spark_tpu.serving: admission + plan cache) -------
+
+SERVER_MAX_CONCURRENT_STATEMENTS = conf(
+    "spark.tpu.server.maxConcurrentStatements").doc(
+    "Global cap on statements admitted and not yet finished (queued + "
+    "running) across ALL server sessions (the thriftserver's session-pool "
+    "backpressure role).  Over the cap, POST /sql fails fast with a "
+    "structured 429 + Retry-After instead of queueing unboundedly.  "
+    "0 = unlimited."
+).int(0)
+
+SERVER_MAX_QUEUED_PER_SESSION = conf(
+    "spark.tpu.server.maxQueuedPerSession").doc(
+    "Cap on statements waiting on ONE server session's FIFO (running + "
+    "queued).  A client hammering a single busy session gets 429s once "
+    "its backlog is this deep, instead of growing an unbounded queue.  "
+    "0 = unlimited."
+).int(64)
+
+SERVER_MIN_HOST_HEADROOM = conf(
+    "spark.tpu.server.admission.minHostHeadroomBytes").doc(
+    "Host-memory-aware admission: when the session has a HostMemoryLedger "
+    "(enableHostShuffle) and its free budget is below this many bytes, new "
+    "statements are rejected with 429 until pressure clears.  0 = off."
+).int(0)
+
+SERVER_STATEMENT_TIMEOUT = conf("spark.tpu.server.statementTimeout").doc(
+    "Per-statement deadline in SECONDS, riding the cooperative cancel "
+    "machinery: a statement still queued past its deadline is dropped, a "
+    "running one is cancelled at its next cancellation checkpoint "
+    "(between streamed batches).  0 = no deadline."
+).float(0.0)
+
+SERVER_SESSION_TIMEOUT = conf("spark.tpu.server.sessionTimeout").doc(
+    "Idle server-session TTL in SECONDS: sessions with no activity for "
+    "this long are closed by the reaper so abandoned clients cannot "
+    "exhaust max_sessions.  0 = sessions never expire."
+).float(3600.0)
+
+SERVER_PLAN_CACHE_ENABLED = conf("spark.tpu.server.planCache.enabled").doc(
+    "Cross-session plan→executable cache for the SQL server: optimized "
+    "logical plans are fingerprinted (literals slotted out) and their "
+    "compiled jit executables shared across ALL server sessions — the "
+    "serving analog of the reference's Janino codegen cache "
+    "(CodeGenerator.compile's Guava cache)."
+).boolean(True)
+
+SERVER_PLAN_CACHE_MAX_ENTRIES = conf(
+    "spark.tpu.server.planCache.maxEntries").doc(
+    "Entry bound of the serving plan cache (LRU beyond it)."
+).int(256)
+
+SERVER_PLAN_CACHE_MAX_BYTES = conf("spark.tpu.server.planCache.maxBytes").doc(
+    "Byte bound of the serving plan cache: estimated held bytes (pinned "
+    "local input batches + per-entry executable overhead) stay under this "
+    "via LRU eviction."
+).int(256 << 20)

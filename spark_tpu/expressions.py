@@ -23,6 +23,7 @@ Aggregate functions live in ``spark_tpu.aggregates``.
 from __future__ import annotations
 
 import math
+import threading
 from typing import Any, Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
@@ -211,6 +212,22 @@ class Col(Expression):
         return self._name
 
 
+class _SlotBindings(threading.local):
+    """Per-thread Literal→parameter bindings for the serving plan cache.
+
+    Parameterized plan sharing (serving/plancache.py) traces ONE jit
+    program per plan SHAPE and feeds literal values in as runtime scalar
+    arguments.  The binding is thread-local and keyed by Literal object
+    identity — never object mutation — so a concurrent execution of a
+    plan that happens to share Literal objects (optimizer rules reuse
+    untouched subtrees) can never observe another thread's tracers."""
+
+    map: Optional[dict] = None
+
+
+_slot_bindings = _SlotBindings()
+
+
 class Literal(Expression):
     def __init__(self, value: Any, dtype: Optional[T.DataType] = None):
         self.value = value
@@ -225,6 +242,13 @@ class Literal(Expression):
 
     def eval(self, ctx: EvalContext) -> ExprValue:
         xp = ctx.xp
+        bindings = _slot_bindings.map
+        if bindings is not None:
+            bound = bindings.get(id(self))
+            if bound is not None:
+                # slotted parameter: the VALUE arrives as a traced scalar
+                # argument of the cached executable, not a baked constant
+                return ExprValue(xp.asarray(bound), None)
         if self.value is None:
             return ExprValue(xp.zeros((), self.dtype.np_dtype),
                              xp.zeros((), bool))

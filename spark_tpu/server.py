@@ -8,11 +8,25 @@ format every client already speaks: POST a SQL string, receive JSON rows.
 
 Concurrency model: a bounded worker pool executes statements; each
 server session wraps its own ``SparkSession.newSession()`` (isolated
-temp views / conf / plan caches — the Thrift session handle analog) with
-a per-session lock making it single-writer, so DIFFERENT sessions run in
-parallel while one session's statements stay serial.  Cancellation is
+temp views / conf — the Thrift session handle analog) with a per-session
+lock making it single-writer, so DIFFERENT sessions run in parallel
+while one session's statements stay serial.  Cancellation is
 cooperative, like the reference's task interruption: streamed executions
 check a session flag between batches.
+
+Multi-tenancy guards (serving/ package):
+
+* every submission passes an ``AdmissionController`` BEFORE anything is
+  registered — over global-concurrency, per-session-queue, or
+  host-memory limits the client gets a structured 429 with Retry-After,
+  never an unbounded queue entry;
+* all server sessions share one ``PlanCache`` mapping optimized-plan
+  fingerprints to compiled executables, so session B skips trace+compile
+  for a statement session A already ran (responses carry ``cacheHit`` /
+  ``planningSkippedMs``);
+* per-statement deadlines (``spark.tpu.server.statementTimeout``) ride
+  the cooperative-cancel machinery, and idle sessions are reaped after
+  ``spark.tpu.server.sessionTimeout`` seconds.
 
     python -m spark_tpu.server --port 8123 --workers 4 &
     curl -d 'SELECT 1 AS x' localhost:8123/sql
@@ -25,10 +39,16 @@ via --token or SPARK_TPU_SERVER_TOKEN):
                                 "session": sid, "id": statement-id}
                                 (or X-Session-Id / X-Statement-Id
                                 headers) → {"columns", "rows",
-                                "rowCount", "durationMs", "statementId"}
-    POST   /cancel              {"id": statement-id} → cooperative cancel
+                                "rowCount", "durationMs", "statementId",
+                                "cacheHit", "planningSkippedMs"};
+                                429 + Retry-After when admission rejects
+    POST   /cancel              {"id": statement-id} → cooperative
+                                cancel; queued statements are removed
+                                from their session FIFO immediately
     GET    /statement/<id>      statement status (running/done/...)
-    GET    /status              engine version, sessions, statements
+    GET    /status              version, sessions, statements, per-
+                                session queue depths, admission counters,
+                                plan-cache stats
 """
 
 from __future__ import annotations
@@ -43,6 +63,10 @@ import uuid
 from concurrent.futures import Future, ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
+
+from . import config as C
+from .metrics import Source
+from .serving import AdmissionController, AdmissionRejected, PlanCache
 
 __all__ = ["SQLServer"]
 
@@ -76,11 +100,11 @@ class _ServerSession:
         # session when ITS target is the one running, not whatever
         # statement happens to hold the session lock by then
         self.running_stmt: Optional[str] = None
-        # FIFO of (future, work) pairs waiting on this session, guarded by
-        # the server's _reg_lock.  A busy session drains its queue on ONE
-        # pool slot (``draining`` marks the drainer alive) — N statements
-        # stacked on one session must never pin N workers while other
-        # sessions starve
+        # FIFO of (stmt, future, work) triples waiting on this session,
+        # guarded by the server's _reg_lock.  A busy session drains its
+        # queue on ONE pool slot (``draining`` marks the drainer alive) —
+        # N statements stacked on one session must never pin N workers
+        # while other sessions starve
         self.queue: collections.deque = collections.deque()
         self.draining = False
 
@@ -113,6 +137,31 @@ class SQLServer:
                                         thread_name_prefix="sql-worker")
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # -- multi-tenant serving core: shared across ALL sessions -------
+        self._admission = AdmissionController(
+            session.conf_obj,
+            lambda: getattr(session, "_host_ledger", None))
+        self._plan_cache: Optional[PlanCache] = None
+        if session.conf_obj.get(C.SERVER_PLAN_CACHE_ENABLED):
+            self._plan_cache = PlanCache(session.conf_obj)
+        # the default session executes through the shared cache too
+        session._plan_cache = self._plan_cache
+        self._sessions_expired = 0
+        self._reaper_stop = threading.Event()
+        self._reaper: Optional[threading.Thread] = None
+        self._register_metrics()
+
+    def _register_metrics(self) -> None:
+        gauges = dict(self._admission.metrics_source())
+        if self._plan_cache is not None:
+            gauges.update(self._plan_cache.metrics_source())
+        gauges["sessions_open"] = lambda: len(self._sessions)
+        gauges["sessions_expired"] = lambda: self._sessions_expired
+        ms = self.session.metricsSystem
+        # re-registering (e.g. a second SQLServer on the same session)
+        # replaces rather than duplicates the source
+        ms._sources = [s for s in ms._sources if s.name != "serving"]
+        ms.register_source(Source("serving", gauges))
 
     # -- session registry ------------------------------------------------
     def _open_session(self) -> str:
@@ -120,8 +169,10 @@ class SQLServer:
             if len(self._sessions) >= self.max_sessions:
                 raise RuntimeError(
                     f"session limit {self.max_sessions} reached")
+            sess = self.session.newSession()
+            sess._plan_cache = self._plan_cache   # shared plan→executable
             sid = uuid.uuid4().hex[:16]
-            self._sessions[sid] = _ServerSession(self.session.newSession())
+            self._sessions[sid] = _ServerSession(sess)
         return sid
 
     def _close_session(self, sid: str) -> bool:
@@ -130,6 +181,7 @@ class SQLServer:
         if ss is None:
             return False
         ss.session.cancelAllQueries()
+        ss.session._plan_cache = None
         return True
 
     def _resolve(self, sid: Optional[str]) -> _ServerSession:
@@ -140,18 +192,65 @@ class SQLServer:
             raise KeyError(f"no such session {sid!r}")
         return ss
 
+    def _expire_idle_sessions(self, now: Optional[float] = None) -> int:
+        """Evict sessions idle longer than spark.tpu.server.sessionTimeout
+        seconds.  Sessions with queued or running work are never touched —
+        eviction must not lose admitted statements.  Returns the count."""
+        ttl = float(self.session.conf_obj.get(C.SERVER_SESSION_TIMEOUT))
+        if ttl <= 0:
+            return 0
+        if now is None:
+            now = time.time()
+        with self._reg_lock:
+            victims = [(sid, ss) for sid, ss in self._sessions.items()
+                       if not ss.queue and not ss.draining
+                       and ss.running_stmt is None
+                       and now - ss.last_used > ttl]
+            for sid, _ss in victims:
+                self._sessions.pop(sid, None)
+            self._sessions_expired += len(victims)
+        for _sid, ss in victims:
+            ss.session.cancelAllQueries()
+            ss.session._plan_cache = None
+        return len(victims)
+
+    def _reap_loop(self) -> None:
+        while not self._reaper_stop.wait(5.0):
+            try:
+                self._expire_idle_sessions()
+            except Exception:   # noqa: BLE001 — the reaper must survive
+                pass
+
     # -- statement execution ---------------------------------------------
     def _run_sql(self, text: str, sid: Optional[str],
                  stmt_id: Optional[str]) -> dict:
         ss = self._resolve(sid)          # unknown session → 404, nothing
-        stmt = _Statement(stmt_id or uuid.uuid4().hex[:16],  # registered
-                          sid or "", text)
+        # admission BEFORE registration: a rejected statement leaves no
+        # trace — no registry entry, no queue slot, no partial execution
+        with self._reg_lock:
+            depth = len(ss.queue) + \
+                (1 if (ss.running_stmt or ss.draining) else 0)
+        self._admission.admit(depth)     # raises AdmissionRejected → 429
+        admit_t = time.time()
+        try:
+            return self._run_admitted(ss, text, sid, stmt_id)
+        finally:
+            # release feeds the EWMA behind Retry-After with end-to-end
+            # (queue + execute) latency — what a retrying client sees
+            self._admission.release(time.time() - admit_t)
+
+    def _run_admitted(self, ss: _ServerSession, text: str,
+                      sid: Optional[str], stmt_id: Optional[str]) -> dict:
+        from .sql.session import QueryCancelled
+
+        stmt = _Statement(stmt_id or uuid.uuid4().hex[:16], sid or "", text)
         with self._reg_lock:
             if stmt.id in self._statements and \
                     self._statements[stmt.id].status in ("queued", "running"):
                 raise RuntimeError(f"statement id {stmt.id!r} already active")
             self._statements[stmt.id] = stmt
             self._evict_statements()
+        ss.last_used = time.time()
 
         def work() -> dict:
             with ss.lock:                # session state is single-writer
@@ -163,27 +262,59 @@ class SQLServer:
                 with self._reg_lock:
                     stmt.status = "running"
                     ss.running_stmt = stmt.id
+                timer: Optional[threading.Timer] = None
                 try:
                     if stmt.cancel_requested:
                         stmt.status = "cancelled"
                         raise QueryCancelled("cancelled before execution")
+                    timeout_s = float(
+                        ss.session.conf_obj.get(C.SERVER_STATEMENT_TIMEOUT))
+                    if timeout_s > 0:
+                        waited = time.time() - stmt.submitted
+                        if waited >= timeout_s:
+                            stmt.status = "cancelled"
+                            raise QueryCancelled(
+                                f"statement deadline {timeout_s:.1f}s "
+                                f"exceeded while queued ({waited:.1f}s)")
+                        # the deadline rides the cooperative-cancel
+                        # machinery: when it fires mid-execution the next
+                        # raise_if_cancelled checkpoint aborts the query
+
+                        def _deadline():
+                            with self._reg_lock:
+                                fire = ss.running_stmt == stmt.id
+                            if fire:
+                                stmt.cancel_requested = True
+                                ss.session.cancelAllQueries()
+
+                        timer = threading.Timer(timeout_s - waited,
+                                                _deadline)
+                        timer.daemon = True
+                        timer.start()
                     ss.last_used = time.time()
                     t0 = time.time()
+                    ss.session._last_plan_cache_info = None
                     df = ss.session.sql(stmt.query)
                     columns = list(df.schema.names)
                     rows = [[_json_safe(v) for v in r]
                             for r in df.collect()]
+                    info = getattr(ss.session,
+                                   "_last_plan_cache_info", None) or {}
                     return {"columns": columns, "rows": rows,
                             "rowCount": len(rows),
                             "durationMs":
                                 round((time.time() - t0) * 1000, 1),
-                            "statementId": stmt.id}
+                            "statementId": stmt.id,
+                            "cacheHit": bool(info.get("hit")),
+                            "planningSkippedMs":
+                                round(float(info.get("skippedMs", 0.0)), 1)}
                 finally:
+                    if timer is not None:
+                        timer.cancel()
                     with self._reg_lock:
                         if ss.running_stmt == stmt.id:
                             ss.running_stmt = None
 
-        from .sql.session import QueryCancelled
         # one pool slot per BUSY SESSION, not per statement: the work unit
         # joins the session's FIFO, and a drainer task is spawned only if
         # none is already running this session's queue.  The HTTP handler
@@ -191,7 +322,7 @@ class SQLServer:
         # with a deep backlog cannot exhaust the worker pool.
         future: Future = Future()
         with self._reg_lock:
-            ss.queue.append((future, work))
+            ss.queue.append((stmt, future, work))
             spawn = not ss.draining
             if spawn:
                 ss.draining = True
@@ -219,7 +350,7 @@ class SQLServer:
                 if not ss.queue:
                     ss.draining = False
                     return
-                future, work = ss.queue.popleft()
+                _stmt, future, work = ss.queue.popleft()
             if not future.set_running_or_notify_cancel():
                 continue
             try:
@@ -241,21 +372,44 @@ class SQLServer:
                 self._statements.pop(s.id, None)
 
     def _cancel(self, stmt_id: str) -> dict:
+        from .sql.session import QueryCancelled
+
         stmt = self._statements.get(stmt_id)
         if stmt is None:
             raise KeyError(f"no such statement {stmt_id!r}")
         stmt.cancel_requested = True
-        if stmt.status == "running":
-            ss = self._resolve(stmt.session_id or None)
+        try:
+            ss: Optional[_ServerSession] = \
+                self._resolve(stmt.session_id or None)
+        except KeyError:      # session already closed; flag alone suffices
+            ss = None
+        removed = None
+        fire = False
+        if ss is not None:
             with self._reg_lock:
-                # only interrupt the session if OUR statement is the one
-                # on it right now — between reading status and firing the
-                # cancel the target may have finished and a DIFFERENT
-                # statement started, and interrupting that innocent one
-                # would be the cancel-the-wrong-statement race
-                fire = ss.running_stmt == stmt_id
-            if fire:
-                ss.session.cancelAllQueries()
+                # a QUEUED statement is cancelled synchronously: pulled
+                # out of the FIFO here, its waiter resolved below — no
+                # worker slot is ever spent on it
+                for item in ss.queue:
+                    if item[0] is stmt:
+                        removed = item
+                        break
+                if removed is not None:
+                    ss.queue.remove(removed)
+                else:
+                    # only interrupt the session if OUR statement is the
+                    # one on it right now — between reading status and
+                    # firing the cancel the target may have finished and
+                    # a DIFFERENT statement started, and interrupting
+                    # that innocent one would be the
+                    # cancel-the-wrong-statement race
+                    fire = ss.running_stmt == stmt_id
+        if removed is not None:
+            stmt.status = "cancelled"
+            removed[1].set_exception(
+                QueryCancelled("cancelled while queued"))
+        elif fire:
+            ss.session.cancelAllQueries()
         return {"statementId": stmt_id, "status": stmt.status,
                 "cancelRequested": True}
 
@@ -264,13 +418,22 @@ class SQLServer:
             stmts = {s.id: s.status for s in self._statements.values()
                      if s.status in ("queued", "running")}
             n_sessions = len(self._sessions)
-        return {
+            queues = {sid: {"queued": len(ss.queue),
+                            "running": ss.running_stmt is not None}
+                      for sid, ss in self._sessions.items()}
+        out = {
             "version": self.session.version,
             "queriesExecuted": getattr(self.session, "_query_count", 0),
             "sessions": n_sessions,
+            "sessionsExpired": self._sessions_expired,
             "activeStatements": stmts,
+            "sessionQueues": queues,
+            "admission": self._admission.stats(),
             "metrics": self.session.metricsSystem.snapshots(),
         }
+        if self._plan_cache is not None:
+            out["planCache"] = self._plan_cache.stats()
+        return out
 
     # -- http plumbing ---------------------------------------------------
     def _make_handler(self):
@@ -280,11 +443,14 @@ class SQLServer:
             def log_message(self, *_a):      # quiet by default
                 pass
 
-            def _reply(self, code: int, payload: dict):
+            def _reply(self, code: int, payload: dict,
+                       headers: Optional[Dict[str, str]] = None):
                 body = json.dumps(payload, default=str).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -370,6 +536,10 @@ class SQLServer:
                 from .sql.session import QueryCancelled
                 try:
                     self._reply(200, server._run_sql(text, sid, stmt_id))
+                except AdmissionRejected as e:
+                    self._reply(429, e.to_json(), headers={
+                        "Retry-After": str(max(1, int(e.retry_after_s
+                                                      + 0.999)))})
                 except QueryCancelled as e:
                     self._reply(499, {"error": f"cancelled: {e}",
                                       "statementId": stmt_id})
@@ -390,9 +560,18 @@ class SQLServer:
             target=self._httpd.serve_forever, daemon=True,
             name=f"sql-server-{self.port}")
         self._thread.start()
+        self._reaper_stop.clear()
+        self._reaper = threading.Thread(
+            target=self._reap_loop, daemon=True,
+            name=f"sql-server-reaper-{self.port}")
+        self._reaper.start()
         return self
 
     def stop(self) -> None:
+        self._reaper_stop.set()
+        if self._reaper is not None:
+            self._reaper.join(timeout=2.0)
+            self._reaper = None
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -401,6 +580,13 @@ class SQLServer:
             self._thread.join(timeout=2.0)
             self._thread = None
         self._pool.shutdown(wait=False, cancel_futures=True)
+        with self._reg_lock:
+            sessions = list(self._sessions.values())
+        for ss in sessions:
+            ss.session._plan_cache = None
+        self.session._plan_cache = None
+        ms = self.session.metricsSystem
+        ms._sources = [s for s in ms._sources if s.name != "serving"]
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,13 @@
+"""Multi-tenant serving core: admission control + plan→executable cache.
+
+The serving-side counterpart of the exchange work in the parallel/
+package: ``plancache`` amortizes jit trace+compile across sessions (the
+Janino codegen-cache analog), ``admission`` bounds what a shared server
+accepts (the thriftserver pool-backpressure analog).  ``server.py``
+wires both into the HTTP statement path."""
+
+from .admission import AdmissionController, AdmissionRejected
+from .plancache import PLANNING_CONF_KEYS, PlanCache, fingerprint
+
+__all__ = ["AdmissionController", "AdmissionRejected", "PlanCache",
+           "PLANNING_CONF_KEYS", "fingerprint"]

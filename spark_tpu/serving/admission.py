@@ -1,0 +1,124 @@
+"""Admission control for the multi-tenant SQL server.
+
+The reference bounds serving load at two layers: the thriftserver's
+session/operation pools and the scheduler's backpressure.  Here the
+whole policy lives in front of the statement queue: a submission is
+either ADMITTED (and will run to completion or cooperative cancel) or
+REJECTED immediately with a structured ``AdmissionRejected`` naming the
+exhausted limit — never parked in an unbounded queue, never deadlocked
+on a session lock, never partially executed.
+
+Three limits, all read live from the server session's conf (so SET
+tunes a running server):
+
+* ``spark.tpu.server.maxConcurrentStatements`` — global cap on admitted
+  and unfinished statements across all sessions;
+* ``spark.tpu.server.maxQueuedPerSession`` — cap on one session's FIFO
+  depth (running + queued), bounding a single hot client;
+* ``spark.tpu.server.admission.minHostHeadroomBytes`` — when the session
+  carries a PR-7 ``HostMemoryLedger``, statements are rejected while its
+  free budget is below the floor (memory-pressure shedding).
+
+The Retry-After hint is an EWMA of recent statement durations scaled by
+the current backlog — a serving-quality answer, not a constant."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from .. import config as C
+
+__all__ = ["AdmissionController", "AdmissionRejected"]
+
+
+class AdmissionRejected(RuntimeError):
+    """Structured fast-fail: which limit, what was observed, the cap,
+    and a retry hint.  The HTTP layer maps this to 429 + Retry-After."""
+
+    def __init__(self, limit: str, observed, cap, retry_after_s: float):
+        super().__init__(
+            f"admission rejected: {limit} exhausted "
+            f"(observed {observed}, limit {cap}); retry after "
+            f"~{retry_after_s:.0f}s")
+        self.limit = limit
+        self.observed = observed
+        self.cap = cap
+        self.retry_after_s = retry_after_s
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"error": str(self), "limit": self.limit,
+                "observed": self.observed, "cap": self.cap,
+                "retryAfterSeconds": round(self.retry_after_s, 1)}
+
+
+class AdmissionController:
+    def __init__(self, conf,
+                 ledger_supplier: Optional[Callable[[], Any]] = None):
+        self._conf = conf
+        self._ledger = ledger_supplier or (lambda: None)
+        self._lock = threading.Lock()
+        self.active = 0                # admitted, not yet released
+        self.peak_active = 0
+        self.admitted = 0
+        self.rejected = 0
+        self.rejected_by: Dict[str, int] = {}
+        self._ewma_s = 0.05            # recent statement duration estimate
+
+    # -- policy --------------------------------------------------------
+    def admit(self, session_queue_depth: int) -> None:
+        """Admit one statement or raise ``AdmissionRejected``.  Callers
+        MUST pair a successful admit with exactly one ``release``."""
+        conf = self._conf
+        with self._lock:
+            cap = int(conf.get(C.SERVER_MAX_CONCURRENT_STATEMENTS))
+            if cap > 0 and self.active >= cap:
+                self._reject("maxConcurrentStatements", self.active, cap)
+            qcap = int(conf.get(C.SERVER_MAX_QUEUED_PER_SESSION))
+            if qcap > 0 and session_queue_depth >= qcap:
+                self._reject("maxQueuedPerSession",
+                             session_queue_depth, qcap)
+            floor = int(conf.get(C.SERVER_MIN_HOST_HEADROOM))
+            if floor > 0:
+                ledger = self._ledger()
+                if ledger is not None and ledger.free < floor:
+                    self._reject("hostMemoryHeadroom",
+                                 int(ledger.free), floor)
+            self.active += 1
+            self.admitted += 1
+            self.peak_active = max(self.peak_active, self.active)
+
+    def _reject(self, limit: str, observed, cap) -> None:
+        self.rejected += 1
+        self.rejected_by[limit] = self.rejected_by.get(limit, 0) + 1
+        raise AdmissionRejected(limit, observed, cap,
+                                self._retry_after_locked())
+
+    def release(self, duration_s: Optional[float] = None) -> None:
+        with self._lock:
+            self.active = max(0, self.active - 1)
+            if duration_s is not None and duration_s >= 0:
+                self._ewma_s = 0.8 * self._ewma_s + 0.2 * duration_s
+
+    def _retry_after_locked(self) -> float:
+        # expected wait ≈ statements ahead of you × recent duration;
+        # floor of 1s keeps well-behaved clients from hammering
+        return max(1.0, self._ewma_s * max(1, self.active))
+
+    # -- introspection -------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "admitted": self.admitted, "rejected": self.rejected,
+                "active": self.active, "peakActive": self.peak_active,
+                "rejectedBy": dict(self.rejected_by),
+                "avgStatementMs": round(self._ewma_s * 1000, 1),
+            }
+
+    def metrics_source(self) -> Dict[str, Callable[[], Any]]:
+        return {
+            "admission_admitted": lambda: self.stats()["admitted"],
+            "admission_rejected": lambda: self.stats()["rejected"],
+            "admission_active": lambda: self.stats()["active"],
+            "admission_peak_active": lambda: self.stats()["peakActive"],
+        }

@@ -239,9 +239,18 @@ def _needs_local_fallback(plan: LogicalPlan) -> bool:
 
 
 class PlannedQuery:
-    def __init__(self, physical: P.PhysicalPlan, leaves: List[ColumnBatch]):
+    def __init__(self, physical: P.PhysicalPlan, leaves: List[ColumnBatch],
+                 leaf_recipes=None):
         self.physical = physical
         self.leaves = leaves
+        #: how each leaf batch was obtained, in PScan index order:
+        #: ("local", LocalRelation) | ("file", FileRelation) |
+        #: ("opaque", None) — the serving plan cache re-materializes
+        #: leaves from these on a hit (files re-read → data freshness);
+        #: any opaque leaf (side-effecting source) makes the plan
+        #: uncacheable.  None when the planner predates recipe capture
+        #: (callers constructing PlannedQuery directly).
+        self.leaf_recipes = leaf_recipes
 
 
 class Planner:
@@ -265,6 +274,7 @@ class Planner:
         #: effects (lazy-checkpoint materialization)
         self.for_execution = for_execution
         self._join_seq = 0
+        self._leaf_recipes: list = []
 
     def _shrunk(self, agg: "P.PhysicalPlan") -> "P.PhysicalPlan":
         from ..columnar import pad_capacity
@@ -294,12 +304,13 @@ class Planner:
 
     def plan(self, logical: LogicalPlan) -> PlannedQuery:
         self._join_seq = 0            # positional factors restart per plan
+        self._leaf_recipes = []
         leaves: List[ColumnBatch] = []
         phys = self._to_physical(logical, leaves)
         self._assign_op_ids(phys, [1])
         if self.session.conf.get(C.METRICS_ENABLED):
             phys = self._wrap_metrics(phys)
-        return PlannedQuery(phys, leaves)
+        return PlannedQuery(phys, leaves, leaf_recipes=self._leaf_recipes)
 
     def _wrap_metrics(self, node: P.PhysicalPlan) -> P.PhysicalPlan:
         node.children = tuple(self._wrap_metrics(c) for c in node.children)
@@ -311,20 +322,29 @@ class Planner:
         for c in node.children:
             self._assign_op_ids(c, counter)
 
-    def _scan(self, batch: ColumnBatch, leaves: List[ColumnBatch]) -> P.PScan:
+    def _scan(self, batch: ColumnBatch, leaves: List[ColumnBatch],
+              source=None) -> P.PScan:
         leaves.append(batch)
+        # leaf provenance for the serving plan cache: a re-materializable
+        # source node, or opaque (side-effecting producers — cache hits
+        # must NOT skip re-running those)
+        if isinstance(source, (LocalRelation, FileRelation)):
+            kind = "local" if isinstance(source, LocalRelation) else "file"
+            self._leaf_recipes.append((kind, source))
+        else:
+            self._leaf_recipes.append(("opaque", None))
         return P.PScan(len(leaves) - 1, batch.schema)
 
     def _to_physical(self, node: LogicalPlan, leaves) -> P.PhysicalPlan:
         if isinstance(node, LocalRelation):
-            return self._scan(node.batch, leaves)
+            return self._scan(node.batch, leaves, source=node)
         if isinstance(node, RangeRelation):
             return P.PRange(node.start, node.end, node.step, node.name,
                             node.num_rows())
         if isinstance(node, FileRelation):
             from ..io import read_file_relation
             batch = read_file_relation(node, self.session)
-            return self._scan(batch, leaves)
+            return self._scan(batch, leaves, source=node)
         if isinstance(node, SubqueryAlias):
             return self._to_physical(node.child, leaves)
         from .logical import FlatMapGroupsWithState
@@ -381,6 +401,9 @@ class Planner:
             from ..io import read_file_relation
             rel = self.session.read.parquet(node.path)._plan
             batch = read_file_relation(rel, self.session)
+            # deliberately opaque to the plan cache: the checkpoint node's
+            # mutable done-state would churn fingerprints, and correctness
+            # requires the materialization side effect to run
             return self._scan(batch, leaves)
         from .logical import Explode
         if isinstance(node, Explode):
@@ -567,6 +590,16 @@ class QueryExecution:
                 return st.execute()
             except NotStreamable as e:
                 _log.info("stage runner fallback to eager: %s", e)
+
+        # serving plan cache (spark_tpu.serving.plancache): attached to
+        # server sessions, shared across all of them.  A usable entry
+        # skips plan+trace+compile entirely; None falls through to the
+        # normal adaptive path (uncacheable plan, overflow, jit off).
+        plan_cache = getattr(self.session, "_plan_cache", None)
+        if plan_cache is not None:
+            cached_out = plan_cache.try_execute(self)
+            if cached_out is not None:
+                return cached_out
 
         # ONE adapted-parameter shape for every executor:
         # {"skew": float|None, "join": factors|None, "shrink": rows|None}

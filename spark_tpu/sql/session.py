@@ -600,6 +600,21 @@ class SparkSession:
             "persisted": [str(persisted).lower()],
         })
 
+    def _invalidate_plan_cache(self, path: Optional[str] = None,
+                               conf_key: Optional[str] = None,
+                               old: Any = None, new: Any = None) -> None:
+        """Serving plan-cache hook (spark_tpu.serving.plancache): catalog
+        mutations evict entries reading the mutated table/database path;
+        a SET of a planning-relevant conf evicts entries built under this
+        session's old value.  No-op outside a serving deployment."""
+        cache = getattr(self, "_plan_cache", None)
+        if cache is None:
+            return
+        if path is not None:
+            cache.invalidate_paths(path)
+        if conf_key is not None:
+            cache.invalidate_conf(conf_key, old, new)
+
     def _run_command(self, cmd) -> DataFrame:
         from . import parser as P
         from ..columnar import ColumnBatch
@@ -615,7 +630,15 @@ class SparkSession:
                 self, L.LocalRelation(ColumnBatch.from_arrays(cols, schema=struct)))
 
         if isinstance(cmd, P.AnalyzeTableCommand):
-            return self._analyze_table(cmd, string_df)
+            out = self._analyze_table(cmd, string_df)
+            # fresh stats change what the planner would build (CBO sides,
+            # capacities): entries over this table are stale plans now
+            try:
+                self._invalidate_plan_cache(
+                    path=self.catalog.table_path(cmd.name))
+            except Exception:
+                pass                   # path-based targets have no entry
+            return out
         if isinstance(cmd, P.CreateViewCommand):
             # conflict-check TEMP VIEWS only: a temp view may shadow a
             # persistent table of the same name
@@ -634,12 +657,16 @@ class SparkSession:
             if self.catalog.drop(cmd.name):
                 return string_df({})
             self.catalog.drop_table(cmd.name, cmd.if_exists)
+            self._invalidate_plan_cache(
+                path=self.catalog.table_path(cmd.name))
             return string_df({})
         if isinstance(cmd, P.CreateDatabaseCommand):
             self.catalog.create_database(cmd.name, cmd.if_not_exists)
             return string_df({})
         if isinstance(cmd, P.DropDatabaseCommand):
+            db_dir = self.catalog._db_dir(cmd.name.lower())
             self.catalog.drop_database(cmd.name, cmd.if_exists)
+            self._invalidate_plan_cache(path=db_dir)
             return string_df({})
         if isinstance(cmd, P.UseDatabaseCommand):
             self.catalog.setCurrentDatabase(cmd.name)
@@ -665,6 +692,8 @@ class SparkSession:
                     T.StructField(n, T.type_for_name(t))
                     for n, t in cmd.columns])
                 self.catalog.create_empty_table(cmd.name, schema, cmd.fmt)
+            self._invalidate_plan_cache(
+                path=self.catalog.table_path(cmd.name))
             return string_df({})
         if isinstance(cmd, P.InsertIntoCommand):
             import json
@@ -699,6 +728,7 @@ class SparkSession:
                 # overwrite clears the dir, including the metadata: rewrite
                 with open(meta_p, "w") as f:
                     json.dump(meta, f)
+            self._invalidate_plan_cache(path=path)
             return string_df({})
         if isinstance(cmd, P.ShowTablesCommand):
             persistent = set(self.catalog.list_persistent_tables())
@@ -740,7 +770,12 @@ class SparkSession:
                               "comment": comments})
         if isinstance(cmd, P.SetCommand):
             if cmd.key is not None and cmd.value is not None:
+                old = self.conf.get(cmd.key, None)
                 self.conf.set(cmd.key, cmd.value)
+                new = self.conf.get(cmd.key, None)
+                if new != old:
+                    self._invalidate_plan_cache(conf_key=cmd.key,
+                                                old=old, new=new)
             key = cmd.key if cmd.key is not None else ""
             value = str(self.conf.get(cmd.key, "<undefined>")) \
                 if cmd.key is not None else ""

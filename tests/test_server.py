@@ -55,6 +55,10 @@ def test_status(server):
         st = json.loads(r.read())
     assert st["queriesExecuted"] >= 0
     assert "memory" in st["metrics"]
+    # the multi-tenant serving core registers its own gauges (r8)
+    assert "serving" in st["metrics"]
+    assert st["admission"]["admitted"] >= 0
+    assert "hits" in st["planCache"]
 
 
 def test_concurrent_posts(server):

@@ -1,0 +1,454 @@
+"""Multi-tenant serving core: admission control + cross-session plan
+cache (serving/admission.py, serving/plancache.py, server.py wiring).
+
+The contract under test: identical (or literal-slotted) statements from
+DIFFERENT server sessions share one compiled executable; catalog
+mutations and planning-conf changes invalidate affected entries with
+oracle-exact results; over-limit submissions fail fast with a structured
+429 naming the exhausted limit — never an unbounded queue, never a lost
+statement."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from spark_tpu import config as C
+from spark_tpu.server import SQLServer
+from spark_tpu.serving import (AdmissionController, AdmissionRejected,
+                               PlanCache)
+
+
+@pytest.fixture()
+def serve_root(spark, tmp_path):
+    """A dedicated root session per test: server-side conf experiments
+    (caps, timeouts, warehouse) must not leak into the shared fixture."""
+    s = spark.newSession()
+    s.conf.set("spark.sql.warehouse.dir", str(tmp_path / "wh"))
+    return s
+
+
+def _req(srv, path, method="GET", body=None, headers=None):
+    req = urllib.request.Request(
+        f"http://{srv.host}:{srv.port}{path}",
+        data=body.encode() if isinstance(body, str) else body,
+        method=method, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.status, json.loads(r.read())
+
+
+def _sql(srv, query, sid=None, stmt_id=None):
+    body = {"query": query}
+    if sid:
+        body["session"] = sid
+    if stmt_id:
+        body["id"] = stmt_id
+    return _req(srv, "/sql", "POST", json.dumps(body))[1]
+
+
+# ---------------------------------------------------------------------------
+# plan cache: cross-session sharing + literal slotting
+# ---------------------------------------------------------------------------
+
+def test_plan_cache_shared_across_sessions(serve_root):
+    srv = SQLServer(serve_root, port=0).start()
+    try:
+        _, s1 = _req(srv, "/session", "POST")
+        _, s2 = _req(srv, "/session", "POST")
+        q = "SELECT id, id * 2 AS y FROM range(64) ORDER BY id"
+        r1 = _sql(srv, q, s1["sessionId"])
+        assert r1["cacheHit"] is False
+        r2 = _sql(srv, q, s2["sessionId"])
+        assert r2["cacheHit"] is True, \
+            "session 2 must reuse session 1's compiled plan"
+        assert r2["planningSkippedMs"] > 0
+        assert r2["rows"] == r1["rows"]
+        _, st = _req(srv, "/status")
+        assert st["planCache"]["hits"] >= 1
+        assert st["planCache"]["entries"] >= 1
+        # the gauges ride the session metrics system as a Source
+        assert st["metrics"]["serving"]["plan_cache_hits"] >= 1
+    finally:
+        srv.stop()
+
+
+def test_literal_variants_share_one_entry(serve_root):
+    cache = PlanCache(serve_root.conf_obj)
+    s = serve_root.newSession()
+    s._plan_cache = cache
+    r1 = [tuple(r) for r in
+          s.sql("SELECT id FROM range(30) WHERE id < 10").collect()]
+    r2 = [tuple(r) for r in
+          s.sql("SELECT id FROM range(30) WHERE id < 20").collect()]
+    assert len(r1) == 10 and len(r2) == 20
+    st = cache.stats()
+    # the literal is slotted out of the fingerprint: ONE entry, and the
+    # second variant is a hit re-executed with a different parameter
+    assert st["entries"] == 1, st
+    assert st["hits"] == 1 and st["misses"] == 1, st
+
+
+def test_plan_cache_invalidation_oracle_exact(serve_root):
+    cache = PlanCache(serve_root.conf_obj)
+    s1 = serve_root.newSession()
+    s2 = serve_root.newSession()
+    s1._plan_cache = cache
+    s2._plan_cache = cache
+    s1.sql("CREATE TABLE pcinv_t AS "
+           "SELECT id AS k, id * 3 AS v FROM range(50)")
+    q = ("SELECT k % 5 AS g, sum(v) AS sv FROM pcinv_t "
+         "WHERE v < 120 GROUP BY k % 5 ORDER BY g")
+    a1 = [tuple(r) for r in s1.sql(q).collect()]
+    a2 = [tuple(r) for r in s2.sql(q).collect()]
+    assert a1 == a2 and cache.stats()["hits"] >= 1
+
+    # INSERT must evict entries scanning the table; the next run over
+    # the cache must see the new rows, byte-for-byte vs a fresh session
+    s2.sql("INSERT INTO pcinv_t SELECT id AS k, id AS v FROM range(5)")
+    assert cache.stats()["invalidations"] >= 1
+    a3 = [tuple(r) for r in s1.sql(q).collect()]
+    oracle = [tuple(r) for r in serve_root.newSession().sql(q).collect()]
+    assert a3 == oracle and a3 != a1
+
+    # a planning-relevant conf change evicts entries built under the
+    # old value (the fingerprint's conf component is the backstop)
+    before = cache.stats()["invalidations"]
+    s1.sql("SET spark.tpu.crossproc.autoBroadcastThreshold=12345")
+    assert cache.stats()["invalidations"] > before
+    a4 = [tuple(r) for r in s1.sql(q).collect()]
+    assert a4 == oracle
+
+    s1.sql("DROP TABLE pcinv_t")
+    with pytest.raises(Exception):
+        s1.sql(q).collect()
+
+
+def test_response_cache_fields_on_repeat(serve_root):
+    srv = SQLServer(serve_root, port=0).start()
+    try:
+        _, s = _req(srv, "/session", "POST")
+        sid = s["sessionId"]
+        q = "SELECT sum(id) AS s FROM range(100) WHERE id < 77"
+        first = _sql(srv, q, sid)
+        again = _sql(srv, q, sid)
+        assert first["cacheHit"] is False
+        assert again["cacheHit"] is True
+        assert again["rows"] == first["rows"] == [[sum(range(77))]]
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_admission_rejects_over_global_cap(serve_root):
+    serve_root.conf.set(C.SERVER_MAX_CONCURRENT_STATEMENTS.key, "1")
+    srv = SQLServer(serve_root, port=0, workers=2).start()
+    try:
+        _, sa = _req(srv, "/session", "POST")
+        _, sb = _req(srv, "/session", "POST")
+        ssa = srv._sessions[sa["sessionId"]]
+        ssa.lock.acquire()               # wedge A mid-statement
+        try:
+            done = {}
+
+            def post_a():
+                done["a"] = _sql(srv, "SELECT 1", sa["sessionId"])
+
+            th = threading.Thread(target=post_a)
+            th.start()
+            time.sleep(0.5)              # let A's statement be admitted
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _sql(srv, "SELECT 2", sb["sessionId"])
+            assert ei.value.code == 429
+            body = json.loads(ei.value.read())
+            assert body["limit"] == "maxConcurrentStatements"
+            assert body["cap"] == 1 and body["retryAfterSeconds"] >= 1
+            assert int(ei.value.headers["Retry-After"]) >= 1
+        finally:
+            ssa.lock.release()
+        th.join(60)
+        assert done["a"]["rows"] == [[1]]    # the admitted one completed
+        _, st = _req(srv, "/status")
+        assert st["admission"]["rejected"] >= 1
+        assert st["admission"]["rejectedBy"]["maxConcurrentStatements"] >= 1
+        # capacity freed: the next statement is admitted again
+        assert _sql(srv, "SELECT 3", sb["sessionId"])["rows"] == [[3]]
+    finally:
+        srv.stop()
+
+
+def test_admission_rejects_deep_session_queue(serve_root):
+    serve_root.conf.set(C.SERVER_MAX_QUEUED_PER_SESSION.key, "2")
+    srv = SQLServer(serve_root, port=0, workers=2).start()
+    try:
+        _, sa = _req(srv, "/session", "POST")
+        sid = sa["sessionId"]
+        ssa = srv._sessions[sid]
+        ssa.lock.acquire()
+        try:
+            codes = []
+
+            def post():
+                try:
+                    _sql(srv, "SELECT 1", sid)
+                    codes.append(200)
+                except urllib.error.HTTPError as e:
+                    codes.append(e.code)
+
+            backlog = [threading.Thread(target=post) for _ in range(2)]
+            for t in backlog:
+                t.start()
+                time.sleep(0.25)         # deterministic queue depths
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _sql(srv, "SELECT 9", sid)
+            assert ei.value.code == 429
+            assert json.loads(ei.value.read())["limit"] == \
+                "maxQueuedPerSession"
+        finally:
+            ssa.lock.release()
+        for t in backlog:
+            t.join(60)
+        assert codes == [200, 200]       # admitted statements all ran
+    finally:
+        srv.stop()
+
+
+def test_admission_host_headroom_unit(serve_root):
+    class Ledger:
+        free = 10
+
+    serve_root.conf.set(C.SERVER_MIN_HOST_HEADROOM.key, "100")
+    ac = AdmissionController(serve_root.conf_obj, lambda: Ledger())
+    with pytest.raises(AdmissionRejected) as ei:
+        ac.admit(0)
+    assert ei.value.limit == "hostMemoryHeadroom"
+    assert ei.value.observed == 10 and ei.value.cap == 100
+    Ledger.free = 1000
+    ac.admit(0)                          # headroom restored → admitted
+    ac.release(0.01)
+    st = ac.stats()
+    assert st["admitted"] == 1 and st["rejected"] == 1
+    assert st["active"] == 0
+
+
+# ---------------------------------------------------------------------------
+# statement lifecycle: queued cancel, deadlines, idle sessions
+# ---------------------------------------------------------------------------
+
+def test_cancel_removes_queued_statement(serve_root):
+    srv = SQLServer(serve_root, port=0, workers=2).start()
+    try:
+        _, sa = _req(srv, "/session", "POST")
+        sid = sa["sessionId"]
+        ssa = srv._sessions[sid]
+        ssa.lock.acquire()               # first statement blocks running
+        try:
+            codes = {}
+
+            def run(name, stmt_id):
+                try:
+                    _sql(srv, "SELECT 1", sid, stmt_id)
+                    codes[name] = 200
+                except urllib.error.HTTPError as e:
+                    codes[name] = e.code
+
+            t1 = threading.Thread(target=run, args=("head", "stmt-head"))
+            t1.start()
+            time.sleep(0.3)
+            t2 = threading.Thread(target=run, args=("tail", "stmt-tail"))
+            t2.start()
+            time.sleep(0.3)              # tail is parked in the FIFO
+            _, c = _req(srv, "/cancel", "POST",
+                        json.dumps({"id": "stmt-tail"}))
+            # a queued statement cancels SYNCHRONOUSLY: status flips
+            # in the cancel response, no worker slot is ever spent
+            assert c["status"] == "cancelled"
+            t2.join(10)
+            assert codes["tail"] == 499
+            with srv._reg_lock:
+                assert all(item[0].id != "stmt-tail"
+                           for item in ssa.queue)
+        finally:
+            ssa.lock.release()
+        t1.join(60)
+        assert codes["head"] == 200      # the head was untouched
+        _, st = _req(srv, "/statement/stmt-tail")
+        assert st["status"] == "cancelled"
+    finally:
+        srv.stop()
+
+
+def test_statement_deadline_cancels_long_run(serve_root, tmp_path):
+    import numpy as np
+    import pandas as pd
+
+    p = str(tmp_path / "slow.parquet")
+    pd.DataFrame({"x": np.arange(1_500_000, dtype=np.int64)}).to_parquet(
+        p, index=False)
+    srv = SQLServer(serve_root, port=0).start()
+    try:
+        _, s = _req(srv, "/session", "POST")
+        sid = s["sessionId"]
+        _sql(srv, "SET spark.tpu.scan.maxBatchRows=1024", sid)
+        _sql(srv, f"CREATE TEMP VIEW slow AS SELECT * FROM parquet.`{p}`",
+             sid)
+        _sql(srv, "SET spark.tpu.server.statementTimeout=0.3", sid)
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _sql(srv, "SELECT sum(x) FROM slow", sid, "stmt-deadline")
+        assert ei.value.code == 499
+        assert time.monotonic() - t0 < 45
+        _, st = _req(srv, "/statement/stmt-deadline")
+        assert st["status"] == "cancelled"
+        # the deadline is per-statement: the session still works
+        _sql(srv, "SET spark.tpu.server.statementTimeout=0", sid)
+        assert _sql(srv, "SELECT 5", sid)["rows"] == [[5]]
+    finally:
+        srv.stop()
+
+
+def test_idle_session_ttl_eviction(serve_root):
+    serve_root.conf.set(C.SERVER_SESSION_TIMEOUT.key, "10")
+    srv = SQLServer(serve_root, port=0).start()
+    try:
+        _, s1 = _req(srv, "/session", "POST")
+        _, s2 = _req(srv, "/session", "POST")
+        sid1, sid2 = s1["sessionId"], s2["sessionId"]
+        _sql(srv, "SELECT 1", sid1)
+        # wedge s2 with queued work: busy sessions are never reaped
+        ss2 = srv._sessions[sid2]
+        ss2.lock.acquire()
+        try:
+            th = threading.Thread(
+                target=lambda: _sql(srv, "SELECT 1", sid2))
+            th.start()
+            time.sleep(0.3)
+            n = srv._expire_idle_sessions(now=time.time() + 60)
+            assert n == 1                # only the idle one went
+            assert sid2 in srv._sessions
+            assert sid1 not in srv._sessions
+        finally:
+            ss2.lock.release()
+        th.join(60)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _sql(srv, "SELECT 1", sid1)
+        assert ei.value.code == 404
+        _, st = _req(srv, "/status")
+        assert st["sessionsExpired"] == 1
+        assert st["metrics"]["serving"]["sessions_expired"] == 1
+    finally:
+        srv.stop()
+
+
+def test_status_exposes_serving_state(serve_root):
+    srv = SQLServer(serve_root, port=0).start()
+    try:
+        _, s = _req(srv, "/session", "POST")
+        sid = s["sessionId"]
+        _sql(srv, "SELECT 1", sid)
+        _, st = _req(srv, "/status")
+        assert st["sessionQueues"][sid] == {"queued": 0, "running": False}
+        adm = st["admission"]
+        assert adm["admitted"] >= 1 and adm["active"] == 0
+        assert "rejectedBy" in adm and "avgStatementMs" in adm
+        pc = st["planCache"]
+        for k in ("hits", "misses", "evictions", "invalidations",
+                  "entries", "bytes"):
+            assert k in pc
+        serving = st["metrics"]["serving"]
+        for k in ("plan_cache_hits", "plan_cache_misses",
+                  "plan_cache_bytes", "admission_admitted",
+                  "admission_rejected", "sessions_open"):
+            assert k in serving
+    finally:
+        srv.stop()
+
+
+def test_plan_cache_disabled_by_conf(serve_root):
+    serve_root.conf.set(C.SERVER_PLAN_CACHE_ENABLED.key, "false")
+    srv = SQLServer(serve_root, port=0).start()
+    try:
+        _, s = _req(srv, "/session", "POST")
+        q = "SELECT id FROM range(8)"
+        r1 = _sql(srv, q, s["sessionId"])
+        r2 = _sql(srv, q, s["sessionId"])
+        assert r1["cacheHit"] is False and r2["cacheHit"] is False
+        _, st = _req(srv, "/status")
+        assert "planCache" not in st
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# stress: small pool + tight caps under many clients
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_admission_stress_bounded_and_conserving(serve_root):
+    """16 clients hammer 4 sessions through a 2-worker pool with tight
+    caps: every response is 200 or a structured 429, every 200 is
+    correct, no statement runs twice or vanishes, and stop() returns."""
+    serve_root.conf.set(C.SERVER_MAX_CONCURRENT_STATEMENTS.key, "4")
+    serve_root.conf.set(C.SERVER_MAX_QUEUED_PER_SESSION.key, "2")
+    srv = SQLServer(serve_root, port=0, workers=2).start()
+    try:
+        sids = [_req(srv, "/session", "POST")[1]["sessionId"]
+                for _ in range(4)]
+        lock = threading.Lock()
+        outcomes = []                    # (stmt_id, code, value)
+
+        def client(cid):
+            for k in range(6):
+                stmt_id = f"stress-{cid}-{k}"
+                try:
+                    r = _sql(srv,
+                             f"SELECT sum(id) + {cid} AS s "
+                             f"FROM range(2000)",
+                             sids[cid % 4], stmt_id)
+                    with lock:
+                        outcomes.append((stmt_id, 200, r["rows"][0][0]))
+                except urllib.error.HTTPError as e:
+                    body = json.loads(e.read())
+                    with lock:
+                        outcomes.append((stmt_id, e.code,
+                                         body.get("limit")))
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not any(t.is_alive() for t in threads), "client hung"
+        assert len(outcomes) == 16 * 6
+        codes = {code for _sid, code, _v in outcomes}
+        assert codes <= {200, 429}, codes
+        assert 200 in codes
+        ok = [(sid, v) for sid, code, v in outcomes if code == 200]
+        expect = sum(range(2000))
+        for stmt_id, v in ok:
+            cid = int(stmt_id.split("-")[1])
+            assert v == expect + cid, (stmt_id, v)
+        rejected = [(sid, v) for sid, code, v in outcomes if code == 429]
+        for _sid, limit in rejected:
+            assert limit in ("maxConcurrentStatements",
+                             "maxQueuedPerSession"), limit
+        # conservation: exactly the admitted statements are registered,
+        # each terminal exactly once; rejected ones left no trace
+        ok_ids = {sid for sid, _v in ok}
+        reg = {s.id: s.status for s in srv._statements.values()
+               if s.id.startswith("stress-")}
+        assert set(reg) == ok_ids
+        assert all(st == "done" for st in reg.values())
+        _, st = _req(srv, "/status")
+        assert st["admission"]["rejected"] == len(rejected)
+        assert st["admission"]["active"] == 0
+    finally:
+        t0 = time.monotonic()
+        srv.stop()
+        assert time.monotonic() - t0 < 10, "stop() must not hang"
