@@ -13,14 +13,12 @@ spill-merge, and streaming-state merge one shared code path.
 
 from __future__ import annotations
 
-from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Any, List, NamedTuple, Tuple
 
 import numpy as np
 
 from . import types as T
-from .expressions import (
-    AnalysisException, EvalContext, Expression, ExprValue, Literal, and_valid,
-)
+from .expressions import AnalysisException, EvalContext, Expression, ExprValue, and_valid
 
 __all__ = [
     "AggregateFunction", "BufferSpec", "Sum", "Count", "CountStar", "Avg",

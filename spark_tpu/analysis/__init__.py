@@ -1,0 +1,34 @@
+"""Static analysis for the engine — two heads, one package.
+
+Head 1 (``verifier`` + ``runtime``): ``verify_plan``, a post-optimizer
+pass that walks logical/physical plans checking schema/dtype propagation
+node-by-node, plus execution-time invariant checks the crossproc join
+lanes call at their decision points (join-strategy legality, hash
+co-partitioning, range cut points / span ownership / presorted-run
+claims, dictionary code-space unification, host-ledger release scoping).
+All failures are a structured ``PlanInvariantError`` naming the node and
+the broken property.  Gated by ``spark.tpu.analysis.verifyPlans``
+(default ``auto``: on under pytest, off in production).
+
+Head 2 (``lint`` + ``confcheck``): an AST-based hazard linter over the
+repo's own source (``python -m spark_tpu.analysis.lint``) with
+repo-specific rules — host materialization inside jitted code, ledger
+``reserve`` without a ``release`` in a ``finally``, unlocked shared
+state in threaded classes, blocking I/O under a lock, planning-relevant
+conf reads missing from the plan cache fingerprint, dead imports,
+builtin shadowing.  Justified exceptions live in
+``tools/lint_waivers.toml``.
+
+The checked invariants are catalogued in ``docs/INVARIANTS.md``.
+"""
+
+from .errors import PlanInvariantError
+from .verifier import (
+    maybe_verify_physical, maybe_verify_plan, runtime_checks_enabled,
+    verify_physical, verify_plan,
+)
+
+__all__ = [
+    "PlanInvariantError", "verify_plan", "verify_physical",
+    "maybe_verify_plan", "maybe_verify_physical", "runtime_checks_enabled",
+]

@@ -1,0 +1,248 @@
+"""Execution-time exchange invariants for the crossproc join lanes.
+
+These checks need values that only exist while a distributed query
+runs — the digest-probe statistics a strategy decision consumed, the
+reducer bounds both sides must share, the received shards themselves —
+so they live here rather than in the static ``verifier`` walk.  The
+crossproc lanes call them at their decision points when
+``runtime_checks_enabled`` (same gate as ``verify_plan``); every
+violation is a structured ``PlanInvariantError``.
+
+What each check pins down (docs/INVARIANTS.md has the catalogue):
+
+* ``verify_join_strategy`` — the chosen strategy is legal for the join
+  type and the statistics (broadcasting a preserved outer side would
+  null-extend once per process; range needs an orderable key; range and
+  hash need equi keys).
+* ``verify_hash_copartition`` — after the hash exchange, every live row
+  of BOTH local shards hashes into this process's fine-partition range
+  under the shared reducer bounds.  Rows outside it mean the two sides
+  disagreed on the assignment and matching keys landed on different
+  processes — silent row loss.
+* ``verify_range_cutpoints`` / ``verify_span_owners`` — the sampled cut
+  points are strictly increasing and every key span has a valid,
+  duplicate-free owner set; a SPLIT span is only legal when replicating
+  the build side is (not for right/full joins, which the range lane
+  excludes upstream).
+* ``verify_presorted_build`` — the ``_presorted_build`` claim the range
+  lane hands the local planner: the k-way-merged build shard really is
+  (null-flag, key)-sorted, keyed rows a prefix, so ``PMergeJoin`` may
+  skip its own sort.
+* ``verify_unified_dictionaries`` — after an exchange, every dictionary
+  column's code space is a single sorted dictionary and all live codes
+  index into it (the encoded-execution contract of
+  ``_unify_code_space``).
+* ``verify_ledger_scope`` — every ``HostMemoryLedger`` reservation a
+  query's exchanges made is scoped under ``shuffle:<xid>`` so the
+  query-exit ``release_prefix`` pairs with it; a stray owner would leak
+  budget into the next statement.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from .errors import PlanInvariantError
+from .verifier import runtime_checks_enabled
+
+__all__ = [
+    "runtime_checks_enabled", "verify_join_strategy",
+    "verify_hash_copartition", "verify_range_cutpoints",
+    "verify_span_owners", "verify_skew_split", "verify_presorted_build",
+    "verify_unified_dictionaries", "verify_ledger_scope",
+]
+
+_STRATEGIES = ("broadcast_left", "broadcast_right", "range", "hash",
+               "gather")
+
+
+def verify_join_strategy(join, strategy: str, range_eligible: bool,
+                         key_pairs: Sequence[Tuple]) -> None:
+    from ..parallel import crossproc as X
+
+    if strategy not in _STRATEGIES:
+        raise PlanInvariantError(
+            join, "join-strategy", f"unknown strategy {strategy!r}")
+    if strategy == "broadcast_right" and join.how not in X._BCAST_RIGHT_OK:
+        raise PlanInvariantError(
+            join, "broadcast-legality",
+            f"broadcasting the right side of a {join.how!r} join would "
+            "null-extend its preserved rows once per process")
+    if strategy == "broadcast_left" and join.how not in X._BCAST_LEFT_OK:
+        raise PlanInvariantError(
+            join, "broadcast-legality",
+            f"broadcasting the left side of a {join.how!r} join would "
+            "null-extend its preserved rows once per process")
+    if strategy == "range" and not range_eligible:
+        raise PlanInvariantError(
+            join, "range-eligibility",
+            "range lane chosen but the join key has no orderable "
+            "single-key encoding")
+    if strategy in ("range", "hash") and not key_pairs:
+        raise PlanInvariantError(
+            join, "equi-keys",
+            f"{strategy} exchange chosen for a join with no equi keys")
+
+
+def _live_mask(host) -> np.ndarray:
+    rv = host.row_valid
+    return np.ones(host.capacity, bool) if rv is None \
+        else np.asarray(rv).astype(bool)
+
+
+def verify_hash_copartition(join, key_pairs, bounds, n_fine: int,
+                            pid: int, left_shard, right_shard) -> None:
+    from ..expressions import EvalContext, Hash64
+
+    b = np.asarray(bounds, np.int64)
+    if b.size < 2 or int(b[0]) != 0 or int(b[-1]) != n_fine \
+            or np.any(np.diff(b) < 0):
+        raise PlanInvariantError(
+            join, "reducer-bounds",
+            f"shared reducer bounds {[int(x) for x in b]} do not cover "
+            f"[0, {n_fine}) monotonically")
+    lo, hi = int(b[pid]), int(b[pid + 1])
+    for side, shard, exprs in (
+            ("left", left_shard, [l for l, _ in key_pairs]),
+            ("right", right_shard, [r for _, r in key_pairs])):
+        host = shard.to_host()
+        mask = _live_mask(host)
+        if not mask.any():
+            continue
+        ectx = EvalContext(host, np)
+        h = ectx.broadcast(Hash64(*exprs).eval(ectx)).data
+        fine = (np.asarray(h).astype(np.uint64)
+                % np.uint64(n_fine)).astype(np.int64)[mask]
+        bad = fine[(fine < lo) | (fine >= hi)]
+        if bad.size:
+            raise PlanInvariantError(
+                join, "hash-co-partitioning",
+                f"{side} shard holds {bad.size} live row(s) outside "
+                f"process {pid}'s fine range [{lo}, {hi}) — e.g. fine "
+                f"partition {int(bad[0])}; the sides did not share one "
+                "reducer assignment")
+
+
+def verify_range_cutpoints(join, cuts, is_str: bool) -> None:
+    vals = list(cuts)
+    for a, b in zip(vals, vals[1:]):
+        if not a < b:
+            raise PlanInvariantError(
+                join, "range-cutpoints",
+                f"cut points not strictly increasing: {a!r} !< {b!r} "
+                f"(of {len(vals)} cuts)")
+
+
+def verify_span_owners(join, owners: Sequence[Sequence[int]],
+                       n_spans: int, n_procs: int) -> None:
+    if len(owners) != n_spans:
+        raise PlanInvariantError(
+            join, "span-ownership",
+            f"{len(owners)} owner sets for {n_spans} key spans")
+    for p, ps in enumerate(owners):
+        ps = list(ps)
+        if not ps:
+            raise PlanInvariantError(
+                join, "span-ownership", f"key span {p} has no owner — "
+                "its rows would be dropped by routing")
+        if len(set(ps)) != len(ps):
+            raise PlanInvariantError(
+                join, "span-ownership",
+                f"key span {p} lists duplicate owners {ps} — the build "
+                "span would replicate twice to one process")
+        if any(r < 0 or r >= n_procs for r in ps):
+            raise PlanInvariantError(
+                join, "span-ownership",
+                f"key span {p} owned by {ps}, outside [0, {n_procs})")
+
+
+def verify_skew_split(join, owners: Sequence[Sequence[int]]) -> None:
+    """Skew-split legality: splitting a span replicates its BUILD slice
+    to every owner, which is only sound when the build side is the
+    non-preserved one (right/full joins would null-extend per owner)."""
+    if any(len(ps) > 1 for ps in owners) and join.how in ("right", "full"):
+        raise PlanInvariantError(
+            join, "skew-split-legality",
+            f"skew-split with build replication under a {join.how!r} "
+            "join: each owner would null-extend the preserved build rows")
+
+
+def verify_presorted_build(join, build_shard, r_expr,
+                           as_float: bool) -> None:
+    from ..expressions import EvalContext
+    from ..sql.joins import range_encode_key
+
+    host = build_shard.to_host()
+    ectx = EvalContext(host, np)
+    encoded = range_encode_key(ectx, r_expr, as_float)
+    if encoded is None:
+        raise PlanInvariantError(
+            join, "presorted-build",
+            "the build key lost its orderable encoding at the receiver")
+    enc, ok = (np.asarray(a) for a in encoded)
+    ok = ok.astype(bool)
+    if ok.size and np.any(np.diff(ok.astype(np.int8)) > 0):
+        i = int(np.argmax(np.diff(ok.astype(np.int8)) > 0)) + 1
+        raise PlanInvariantError(
+            join, "presorted-build",
+            f"keyed rows are not a prefix: row {i} is keyed after a "
+            "null/dead row — PMergeJoin's null-tail contract is broken")
+    keys = enc[ok]
+    if keys.size > 1:
+        drops = np.diff(keys) < 0
+        if np.any(drops):
+            i = int(np.argmax(drops))
+            raise PlanInvariantError(
+                join, "presorted-build",
+                f"build shard is not key-sorted: row {i} has key "
+                f"{int(keys[i])} > row {i + 1}'s {int(keys[i + 1])} — "
+                "the _presorted_build claim would make PMergeJoin "
+                "silently drop matches")
+
+
+def verify_unified_dictionaries(node, batches: Sequence) -> None:
+    for b in batches:
+        host = b.to_host()
+        rv = _live_mask(host)
+        for name, v in zip(host.names, host.vectors):
+            d = v.dictionary
+            if not d:
+                continue
+            words = list(d)
+            for a, w in zip(words, words[1:]):
+                if not a < w:
+                    raise PlanInvariantError(
+                        node, "dictionary-order",
+                        f"column {name!r}: post-exchange dictionary is "
+                        f"not strictly sorted ({a!r} !< {w!r}) — code "
+                        "order no longer equals word order")
+            codes = np.asarray(v.data)
+            if codes.ndim != 1:
+                continue              # array-of-string planes: 2-D codes
+            mask = rv if v.valid is None \
+                else rv & np.asarray(v.valid).astype(bool)
+            live = codes[mask[:codes.shape[0]]] if codes.size else codes
+            if live.size and (int(live.min()) < 0
+                              or int(live.max()) >= len(words)):
+                off = int(live.min()) if int(live.min()) < 0 \
+                    else int(live.max())
+                raise PlanInvariantError(
+                    node, "dictionary-code-space",
+                    f"column {name!r}: live code {off} outside the "
+                    f"unified dictionary of {len(words)} words — the "
+                    "code spaces were not unified across the exchange")
+
+
+def verify_ledger_scope(ledger, pre_owners, xid: str) -> None:
+    scope = f"shuffle:{xid}"
+    pre = set(pre_owners)
+    stray = sorted(o for o in ledger.owners()
+                   if o not in pre and not o.startswith(scope))
+    if stray:
+        raise PlanInvariantError(
+            "HostMemoryLedger", "ledger-scope-pairing",
+            f"exchange reservation(s) {stray} survive the query outside "
+            f"the release scope {scope!r} — release_prefix cannot pair "
+            "them and the bytes leak into the next statement's budget")

@@ -1,0 +1,67 @@
+"""Waiver file for the hazard linter (``tools/lint_waivers.toml``).
+
+Python 3.10 has no stdlib ``tomllib``, and the container policy forbids
+new dependencies, so this is a minimal parser for exactly the subset the
+waiver file uses: ``[[waiver]]`` array-of-table headers followed by
+``key = "string"`` pairs.  Anything else is a loud error — the waiver
+file is part of the lint contract and must not half-parse.
+
+Every waiver MUST carry a ``reason`` (the one-line justification the
+checked-in file promises) and a ``rule``; ``path`` / ``symbol`` /
+``contains`` narrow the match.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+__all__ = ["load_waivers", "is_waived"]
+
+_KV = re.compile(r'^([A-Za-z_][A-Za-z0-9_-]*)\s*=\s*"((?:[^"\\]|\\.)*)"\s*$')
+
+
+def _unescape(s: str) -> str:
+    return s.replace('\\"', '"').replace("\\\\", "\\")
+
+
+def load_waivers(path: str) -> List[Dict[str, str]]:
+    waivers: List[Dict[str, str]] = []
+    cur: Dict[str, str] = {}
+    with open(path, encoding="utf-8") as f:
+        for i, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line == "[[waiver]]":
+                cur = {}
+                waivers.append(cur)
+                continue
+            m = _KV.match(line)
+            if m is None or not waivers:
+                raise ValueError(
+                    f"{path}:{i}: unsupported waiver syntax {line!r} "
+                    "(expected [[waiver]] tables of key = \"value\")")
+            cur[m.group(1)] = _unescape(m.group(2))
+    for i, w in enumerate(waivers):
+        if not w.get("rule"):
+            raise ValueError(f"{path}: waiver #{i + 1} has no rule")
+        if not w.get("reason"):
+            raise ValueError(
+                f"{path}: waiver #{i + 1} ({w.get('rule')}) has no "
+                "reason — every waiver needs a one-line justification")
+    return waivers
+
+
+def is_waived(finding, waivers: List[Dict[str, str]]) -> bool:
+    for w in waivers:
+        if w["rule"] != finding.rule:
+            continue
+        if w.get("path") and not finding.path.endswith(w["path"]):
+            continue
+        if w.get("symbol") and w["symbol"] != finding.symbol:
+            continue
+        if w.get("contains") and w["contains"] not in finding.message:
+            continue
+        return True
+    return False

@@ -225,6 +225,13 @@ EXCHANGE_SPREAD_FRAC = conf("spark.tpu.exchange.spreadThreshold").doc(
     "spread round-robin and its build rows replicate to every shard."
 ).float(0.5)
 
+ANALYSIS_VERIFY_PLANS = conf("spark.tpu.analysis.verifyPlans").doc(
+    "Plan-invariant verification (analysis.verify_plan) plus the "
+    "crossproc exchange runtime checks. auto = on under pytest (tier-1 "
+    "suites and the subprocess parity harnesses), off otherwise; "
+    "on/off = explicit."
+).string("auto")
+
 CODEGEN_ENABLED = conf("spark.sql.codegen.wholeStage").doc(
     "Fuse operator pipelines into a single jitted XLA program (WholeStage"
     "Codegen analog). Off = eager per-op numpy execution (debug path)."

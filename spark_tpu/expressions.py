@@ -29,7 +29,7 @@ from typing import Any, Callable, List, NamedTuple, Optional, Sequence, Tuple
 import numpy as np
 
 from . import types as T
-from .columnar import ColumnBatch, encode_strings
+from .columnar import ColumnBatch
 
 __all__ = [
     "ExprValue", "EvalContext", "Expression", "Col", "Literal", "Alias",

@@ -24,9 +24,9 @@ from typing import Any, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import types as T
-from .aggregates import AggregateFunction, BufferSpec, First, IDENTITY
+from .aggregates import AggregateFunction, First, IDENTITY
 from .columnar import ColumnBatch, ColumnVector, merge_dictionaries
-from .expressions import Alias, EvalContext, Expression, ExprValue
+from .expressions import EvalContext, Expression, ExprValue
 
 Array = Any
 

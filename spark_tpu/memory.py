@@ -35,13 +35,12 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from . import codec as codec_mod
 from . import config as C
-from . import types as T
 from .columnar import ColumnBatch, ColumnVector
 
 HBM_BUDGET = C.conf("spark.tpu.memory.hbmBudget").doc(
@@ -239,6 +238,12 @@ class HostMemoryLedger:
     def held(self, owner: str) -> int:
         with self._lock:
             return self._held.get(owner, 0)
+
+    def owners(self) -> List[str]:
+        """Snapshot of every owner currently holding a reservation (the
+        analysis ledger-scope check diffs this across a query)."""
+        with self._lock:
+            return list(self._held)
 
     def try_reserve(self, owner: str, nbytes: int) -> bool:
         nbytes = int(nbytes)

@@ -187,6 +187,13 @@ def default_sources(session) -> List[Source]:
     srcs.append(Source("queries", {
         "executed": lambda: getattr(session, "_query_count", 0),
     }))
+    srcs.append(Source("analysis", {
+        # plan-invariant verifier accounting (analysis.maybe_verify_*)
+        "plans_verified": lambda: getattr(
+            session, "_analysis_stats", {}).get("plans_verified", 0),
+        "plan_verify_ms": lambda: getattr(
+            session, "_analysis_stats", {}).get("plan_verify_ms", 0.0),
+    }))
     svc = getattr(session, "_crossproc_svc", None)
     if svc is not None and hasattr(svc, "metrics_source"):
         # DCN exchange retry/blacklist counters (RetryingBlockReader +

@@ -11,7 +11,7 @@ replicate because the full batch lives on device.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 

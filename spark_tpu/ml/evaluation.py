@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .base import Params, Param, extract_column
+from .base import Params, Param
 from ..expressions import AnalysisException
 
 __all__ = ["RegressionEvaluator", "BinaryClassificationEvaluator",

@@ -3,16 +3,13 @@ text features.  All numeric paths are vectorized numpy/jax."""
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 
 from .. import types as T
 from ..expressions import AnalysisException
-from .base import (
-    Estimator, Model, Param, Params, Transformer, append_prediction,
-    extract_matrix,
-)
+from .base import Estimator, Model, Param, Transformer, append_prediction, extract_matrix
 
 __all__ = [
     "VectorAssembler", "StandardScaler", "StandardScalerModel",

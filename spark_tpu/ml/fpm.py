@@ -11,7 +11,6 @@ the Tokenizer convention: a string column of \x00-joined items (see
 
 from __future__ import annotations
 
-import itertools
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
 

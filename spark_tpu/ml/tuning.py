@@ -6,7 +6,7 @@ from typing import Dict, List
 
 import numpy as np
 
-from .base import Estimator, Model, Param, Params
+from .base import Estimator, Model, Param
 
 __all__ = ["ParamGridBuilder", "CrossValidator", "CrossValidatorModel",
            "TrainValidationSplit", "TrainValidationSplitModel"]

@@ -7,7 +7,7 @@ mergesort fallback (stable across runs in offset order either way)."""
 from __future__ import annotations
 
 import ctypes
-from typing import List, Sequence
+from typing import Sequence
 
 import numpy as np
 

@@ -724,6 +724,11 @@ def _shuffled_join_shards(session, join, key_pairs,
                 svc.ledger.release(f"shuffle:{xid}:{tag}-map")
             finally:
                 sink.close()
+        from ..analysis import runtime as _az
+        if _az.runtime_checks_enabled(session):
+            _az.verify_hash_copartition(join, key_pairs, bounds, n_fine,
+                                        svc.pid, shards[0], shards[1])
+            _az.verify_unified_dictionaries(join, shards)
         return shards[0], shards[1]
     finally:
         shutil.rmtree(sdir, ignore_errors=True)
@@ -879,6 +884,10 @@ def _range_merge_join_shards(session, join, spec,
     svc.last_range_cutpoints = [str(c) for c in cuts] if is_str \
         else [int(c) for c in cuts]
     n_spans = len(cuts) + 1
+    from ..analysis import runtime as _az
+    checks = _az.runtime_checks_enabled(session)
+    if checks:
+        _az.verify_range_cutpoints(join, list(cuts), is_str)
 
     # 3. span bucketing with (null_flag, key) tie sort → sorted runs;
     # size round + skew-splitting reducer plan.  For string keys each
@@ -916,6 +925,9 @@ def _range_merge_join_shards(session, join, spec,
         totals = svc.gather_sizes(f"{xid}-plan", 2 * n_spans)
         owners = svc.plan_range_reducers(totals[:n_spans],
                                          totals[n_spans:], target)
+        if checks:
+            _az.verify_span_owners(join, owners, n_spans, svc.n)
+            _az.verify_skew_split(join, owners)
 
         # 4a. probe side: a split span's sorted slice chops into
         # contiguous sub-runs, one per owner; build side: each span
@@ -1040,6 +1052,11 @@ def _range_merge_join_shards(session, join, spec,
                 parts = tails
             build_shard = union_all(parts) if len(parts) > 1 \
                 else parts[0]
+        if checks:
+            _az.verify_presorted_build(join, build_shard, r_expr,
+                                       r_as_float)
+            _az.verify_unified_dictionaries(join, (probe_shard,
+                                                   build_shard))
         return probe_shard, build_shard
     finally:
         shutil.rmtree(sdir, ignore_errors=True)
@@ -1053,8 +1070,17 @@ def crossproc_execute(session, optimized, svc: HostShuffleService
     seq = getattr(session, "_crossproc_seq", 0) + 1
     session._crossproc_seq = seq
     xid = f"xq{seq:06d}"
+    from ..analysis import runtime as _az
+    checks = _az.runtime_checks_enabled(session)
+    pre_owners = set(svc.ledger.owners()) if checks else set()
     try:
-        return _crossproc_execute(session, optimized, svc, xid)
+        result = _crossproc_execute(session, optimized, svc, xid)
+        if checks:
+            # on SUCCESS only (the finally below releases either way):
+            # every reservation the exchanges staged must sit under the
+            # shuffle:<xid> scope, or release_prefix cannot pair it
+            _az.verify_ledger_scope(svc.ledger, pre_owners, xid)
+        return result
     finally:
         # every host-memory reservation this query staged (map-side
         # bucketed output, fetched blocks) is scoped to the query: on
@@ -1155,6 +1181,10 @@ def _crossproc_execute(session, optimized, svc: HostShuffleService,
             join.how, range_spec is not None, smj_on, shuffled_on,
             bcast_threshold, svc.n,
             sum(leaf_sizes[:ln]), sum(leaf_sizes[ln:ln + rn]))
+        from ..analysis import runtime as _az
+        if _az.runtime_checks_enabled(session):
+            _az.verify_join_strategy(join, strategy,
+                                     range_spec is not None, key_pairs)
         if strategy == "gather":
             strategy = None
 

@@ -27,32 +27,18 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec
 
-from .. import config as C
 from .. import types as T
 from ..aggregates import AggregateFunction, First
 from ..columnar import ColumnBatch, ColumnVector, pad_capacity
 from ..expressions import Col, EvalContext, Expression, Hash64
-from ..kernels import (
-    _scatter_starts, apply_limit, compact, grouped_aggregate,
-    multi_key_argsort, segment_reduce, sort_batch, sort_key_transform,
-    take_batch,
-)
+from ..kernels import _scatter_starts, compact, multi_key_argsort, segment_reduce, sort_batch, sort_key_transform
 from ..sql import physical as P
 from ..sql.joins import PJoin
-from ..sql.planner import Planner, PlannedQuery
-from ..sql.logical import (
-    Aggregate, Distinct, Filter, Join, Limit, LocalRelation, LogicalPlan,
-    Project, RangeRelation, Sample, Sort, SubqueryAlias, Union,
-)
-from .collective import (
-    broadcast_all, hash_exchange, psum_arrays,
-)
-from .mesh import DATA_AXIS, get_mesh, mesh_shards
+from .collective import broadcast_all, hash_exchange
+from .mesh import DATA_AXIS
 
 Array = Any
 

@@ -8,8 +8,7 @@ single XLA program whose collectives are the stage boundaries).
 from __future__ import annotations
 
 import logging
-from functools import partial
-from typing import Any, List, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -20,22 +19,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from jax import shard_map
 
 from .. import config as C
-from .. import types as T
 from ..columnar import ColumnBatch, ColumnVector, pad_capacity
-from ..expressions import AnalysisException, Col
+from ..expressions import Col
 from ..kernels import compact
 from ..sql import physical as P
 from ..sql.joins import PJoin, plan_join_raw, _JoinOutput
-from ..sql.logical import (
-    Aggregate, Distinct, FileRelation, Filter, Join, Limit, LocalRelation,
-    LogicalPlan, Project, RangeRelation, Sample, Sort, SubqueryAlias, Union,
-)
-from ..sql.planner import (
-    ADAPT_MAX_RETRIES, Planner, PlannedQuery, _slice_to_host,
-    check_planned_join_capacities, grow_capacity_factor,
-)
+from ..sql.logical import Aggregate, Distinct, FileRelation, Filter, Join, Limit, LocalRelation, LogicalPlan, Project, RangeRelation, Sample, Sort, SubqueryAlias
+from ..sql.planner import ADAPT_MAX_RETRIES, Planner, check_planned_join_capacities, grow_capacity_factor
 from . import dist as D
-from .mesh import DATA_AXIS, get_mesh, mesh_shards
+from .mesh import DATA_AXIS, mesh_shards
 
 _log = logging.getLogger("spark_tpu.execution")
 

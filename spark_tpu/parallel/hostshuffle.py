@@ -845,7 +845,8 @@ class HostShuffleService:
             if not still or not strict:
                 break
             if self._clock() >= deadline:
-                self.counters["fetch_failures"] += 1
+                with self._lock:
+                    self.counters["fetch_failures"] += 1
                 raise ExchangeFetchFailed(
                     exchange,
                     [self.host_name(s) for s in still],
@@ -1024,7 +1025,8 @@ class HostShuffleService:
                        if not os.path.exists(self._done(exchange, s))]
             waiting = [s for s in missing if s not in self.blacklist]
             if not waiting:
-                self.counters["barrier_excluded"] += len(missing)
+                with self._lock:
+                    self.counters["barrier_excluded"] += len(missing)
                 return missing
             if self.heartbeat is not None and self.blacklist_enabled:
                 dead = set(self.heartbeat.dead_hosts())
@@ -1040,7 +1042,9 @@ class HostShuffleService:
             self._sleep(self.poll_s)
 
     def _blacklist_peer(self, pid: int, reason: str) -> None:
-        if pid not in self.blacklist:
+        with self._lock:
+            if pid in self.blacklist:
+                return
             self.blacklist[pid] = reason
             self.counters["peers_blacklisted"] += 1
 
@@ -1363,7 +1367,8 @@ class HostShuffleService:
             raise ExchangeFetchFailed(
                 exchange, [], [], detail="refetch disabled by "
                 f"{C.SHUFFLE_FETCH_RETRY_ENABLED.key}")
-        self.counters["refetches"] += 1
+        with self._lock:
+            self.counters["refetches"] += 1
         own = self._own(per_receiver or {})
         return self._gather(exchange, own, self._clock(), sink=sink)
 
@@ -1377,7 +1382,8 @@ class HostShuffleService:
             raise ExchangeFetchFailed(
                 exchange, [], [], detail="refetch disabled by "
                 f"{C.SHUFFLE_FETCH_RETRY_ENABLED.key}")
-        self.counters["refetches"] += 1
+        with self._lock:
+            self.counters["refetches"] += 1
         own = self._decode_spilled_own(exchange, spill_path, routed)
         return self._gather(exchange, own, self._clock(), sink=sink)
 

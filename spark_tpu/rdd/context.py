@@ -14,9 +14,9 @@ import itertools
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, Iterable, List, Optional
+from typing import Any, Callable, Iterable, List, Optional
 
-from .rdd import RDD, StatCounter
+from .rdd import RDD
 
 __all__ = ["SparkContext", "Broadcast", "Accumulator", "AccumulatorParam"]
 

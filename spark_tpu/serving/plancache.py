@@ -100,6 +100,11 @@ PLANNING_CONF_ENTRIES = (
     C.CROSSPROC_SHUFFLED_JOIN, C.CROSSPROC_SORT_MERGE_JOIN,
     C.ADAPTIVE_ENABLED, C.METRICS_ENABLED, C.WAREHOUSE_DIR,
     C.AGG_FOLD_ROWS, C.CROSS_JOIN_ENABLED, C.EXCHANGE_SKEW_FACTOR,
+    # crossproc exchange shaping: fine-partition count, reducer
+    # coalescing target and range-sample density move work between
+    # processes; dedupReplicated changes the gather plan
+    C.SHUFFLE_FINE_PARTITIONS, C.SHUFFLE_TARGET_PARTITION_BYTES,
+    C.SHUFFLE_RANGE_SAMPLE_SIZE, C.CROSSPROC_DEDUP_REPLICATED,
 )
 
 PLANNING_CONF_KEYS = frozenset(e.key for e in PLANNING_CONF_ENTRIES)

@@ -17,21 +17,14 @@ plus these structural rewrites:
 
 from __future__ import annotations
 
-import itertools
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 from .. import types as T
-from ..aggregates import (
-    AggregateFunction, Count, CountDistinct, CountStar, Sum, SumDistinct,
-)
+from ..aggregates import AggregateFunction, Count, CountDistinct, CountStar, Sum
 from ..expressions import (
     Alias, And, AnalysisException, Col, EQ, Expression, Literal,
 )
-from .logical import (
-    Aggregate, Distinct, Filter, Join, Limit, LocalRelation, LogicalPlan,
-    Project, Sample, Sort, SortOrder, SubqueryAlias, Union,
-    UnresolvedRelation,
-)
+from .logical import Aggregate, Distinct, Filter, Join, Limit, LogicalPlan, Project, Sample, Sort, SortOrder, SubqueryAlias, UnresolvedRelation
 
 def fresh_name(prefix: str, basis: str, index: int) -> str:
     """DETERMINISTIC generated names: derived from the expression text and

@@ -3,14 +3,10 @@ pyspark's ``Column``), a thin wrapper over the expression IR."""
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Union
+from typing import Any, Union
 
 from .. import types as T
-from ..expressions import (
-    Alias, Between, Cast, CaseWhen, Coalesce, EqNullSafe, Expression, In,
-    IsNaN, IsNotNull, IsNull, Literal, Not, StringPredicate, Substring,
-    _wrap,
-)
+from ..expressions import Alias, Between, Cast, CaseWhen, EqNullSafe, Expression, In, IsNaN, IsNotNull, IsNull, StringPredicate, Substring, _wrap
 from ..logicalutils import sort_order  # re-exported helper (see below)
 
 __all__ = ["Column", "ColumnOrName"]

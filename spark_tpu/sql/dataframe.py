@@ -8,7 +8,7 @@ builds a new plan, and actions run it through QueryExecution
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, List, Optional, Tuple, Union
 
 from .. import types as T
 from ..aggregates import Avg, Count, CountStar, Max, Min, Sum

@@ -33,12 +33,9 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import config as C
 from .. import types as T
 from ..columnar import ColumnBatch, ColumnVector, pad_capacity
-from ..expressions import (
-    AnalysisException, Col, EQ, EvalContext, Expression, Hash64, and_valid,
-)
+from ..expressions import AnalysisException, Col, EQ, EvalContext, Expression, Hash64
 from ..kernels import multi_key_argsort, searchsorted, take_batch
 from .logical import Join
 from . import physical as P

@@ -10,14 +10,12 @@ and automatic uniquification at join time.
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from .. import types as T
-from ..aggregates import AggregateFunction, is_aggregate
+from ..aggregates import AggregateFunction
 from ..columnar import ColumnBatch
-from ..expressions import (
-    Alias, AnalysisException, Col, Expression, Literal,
-)
+from ..expressions import AnalysisException, Expression
 
 __all__ = [
     "LogicalPlan", "LocalRelation", "RangeRelation", "Project", "Filter",

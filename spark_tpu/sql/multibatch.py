@@ -36,12 +36,12 @@ import jax.numpy as jnp
 from .. import config as C
 from .. import types as T
 from .. import wire
-from ..aggregates import First, Last, Max, Min
+from ..aggregates import First, Max, Min
 from ..columnar import (
     ColumnBatch, ColumnVector, normalize_valids, pad_capacity,
     pad_to_capacity,
 )
-from ..expressions import Col, EvalContext, Expression
+from ..expressions import Col, EvalContext
 from ..kernels import (
     compact, distinct as k_distinct, union_all,
 )

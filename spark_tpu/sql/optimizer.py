@@ -13,12 +13,8 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from .. import config as C
 from ..columnar import ColumnBatch
-from ..expressions import (
-    Alias, And, Cast, Col, EvalContext, Expression, Literal, Or, Not, Rand,
-    RowIndex,
-)
+from ..expressions import Alias, And, Col, Expression, Literal, Rand, RowIndex
 from ..aggregates import AggregateFunction
 from .logical import (
     Aggregate, Distinct, Filter, Join, Limit, LocalRelation, LogicalPlan,

@@ -31,13 +31,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 from .. import types as T
 from .. import aggregates as A
-from ..expressions import (
-    Add, Alias, AnalysisException, And, Between, CaseWhen, Cast, Coalesce,
-    Col, Concat, Div, EQ, Expression, ExtractDatePart, GE, GT, Greatest,
-    Hash64, If, In, IntDiv, IsNaN, IsNull, IsNotNull, LE, LT, Least, Literal,
-    Mod, Mul, NE, Neg, Not, Or, Pow, Rand, RoundExpr, StringLength,
-    StringPredicate, StringTransform, Sub, Substring, UnaryMath,
-)
+from ..expressions import Add, Alias, AnalysisException, And, Between, CaseWhen, Cast, Coalesce, Col, Concat, Div, EQ, Expression, ExtractDatePart, GE, GT, Greatest, Hash64, If, In, IsNaN, IsNull, IsNotNull, LE, LT, Least, Literal, Mod, Mul, NE, Neg, Not, Or, Pow, Rand, RoundExpr, StringLength, StringPredicate, StringTransform, Sub, Substring, UnaryMath
 from .logical import (
     Aggregate, Distinct, Except, Filter, Intersect, Join, Limit, LogicalPlan,
     Project, RangeRelation, Sort, SortOrder, SubqueryAlias, Union,

@@ -15,16 +15,14 @@ baked into the program as constants.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .. import types as T
 from ..aggregates import AggregateFunction
 from ..columnar import ColumnBatch, ColumnVector, merge_dictionaries, pad_capacity
-from ..expressions import (
-    AnalysisException, Col, EvalContext, Expression, LT, Rand,
-)
+from ..expressions import EvalContext, Expression, LT, Rand
 from ..kernels import (
     apply_filter, apply_limit, apply_project, distinct as k_distinct,
     grouped_aggregate, sort_batch,

@@ -530,6 +530,8 @@ class QueryExecution:
 
     def _execute_inner(self) -> ColumnBatch:
         self.session._last_qe = self      # metrics/explain introspection
+        from ..analysis import maybe_verify_plan
+        maybe_verify_plan(self.session, self.optimized)
         svc = getattr(self.session, "_crossproc_svc", None)
         if svc is not None:
             # the session's registered DCN data plane makes the exchange a
@@ -678,6 +680,8 @@ class QueryExecution:
         are compile-time constants) on top of the leaf working set, so a
         join whose output buffer cannot fit fails BEFORE dispatch (r2
         weak #5: estimate-based accounting was not enforcement)."""
+        from ..analysis import maybe_verify_physical
+        maybe_verify_physical(self.session, pq)
         mem = getattr(self.session, "_memory", None)
         owner = f"query:{id(self)}"
         if mem is not None:

@@ -8,9 +8,8 @@ distributed mode) the device mesh (see ``spark_tpu.parallel``).
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Union
 
-import numpy as np
 
 from .. import config as C
 from .. import types as T

@@ -39,7 +39,7 @@ import math
 import os
 import pickle
 import tempfile
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 

@@ -25,7 +25,7 @@ own scope is pulled up to the join level.
 from __future__ import annotations
 
 import itertools
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 from ..expressions import (
     AnalysisException, Alias, Col, EQ, Expression, Not,

@@ -23,7 +23,7 @@ shard in distributed plans).
 from __future__ import annotations
 
 import datetime
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 

@@ -24,9 +24,7 @@ import numpy as np
 from .. import types as T
 from ..aggregates import AggregateFunction, Avg, Count, CountStar, Max, Min, Sum
 from ..columnar import ColumnBatch, ColumnVector
-from ..expressions import (
-    AnalysisException, Col, EvalContext, Expression, ExprValue, Literal,
-)
+from ..expressions import AnalysisException, Col, EvalContext, Expression
 from ..kernels import multi_key_argsort, sort_key_transform
 from .logical import LogicalPlan, SortOrder
 

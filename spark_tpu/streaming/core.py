@@ -19,7 +19,6 @@ merge exactly.  Snapshots are written per batch under
 
 from __future__ import annotations
 
-import itertools
 import json
 import logging
 import os
@@ -32,9 +31,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import types as T
-from ..aggregates import (
-    AggregateFunction, Avg, Count, CountStar, First, Last, Max, Min, Sum,
-)
+from ..aggregates import AggregateFunction, First, Last
 from ..columnar import ColumnBatch, ColumnVector
 from ..expressions import AnalysisException, Col, EvalContext
 from ..kernels import compact, union_all

@@ -7,7 +7,7 @@ most common idioms importable, executing them as structured queries.
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+from typing import Callable, List
 
 
 class DStream:

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
-import numpy as np
 
 from .. import types as T
 from ..columnar import ColumnBatch

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import os
 import pickle
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Dict, Iterator, Optional, Tuple
 
 from .. import config as C
 

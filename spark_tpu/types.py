@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import datetime
 import decimal
-from typing import Any, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Iterator, List, Optional, Sequence
 
 import numpy as np
 

@@ -1,0 +1,406 @@
+"""Static-analysis subsystem: the plan-invariant verifier (golden broken
+plans rejected with structured ``PlanInvariantError``), the crossproc
+runtime invariant checks, the hazard linter's rules on synthetic
+snippets, the planning-conf coverage rule against the live planner code,
+and the repo's own lint-clean status (tier-1 gate for bin/planlint)."""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from spark_tpu import types as T
+from spark_tpu.analysis import PlanInvariantError, verify_plan
+from spark_tpu.analysis import runtime as az_rt
+from spark_tpu.analysis.confcheck import (missing_planning_confs,
+                                          planning_conf_reads)
+from spark_tpu.analysis.lint import lint_paths, lint_source, main
+from spark_tpu.analysis.waivers import is_waived, load_waivers
+from spark_tpu.columnar import ColumnBatch, ColumnVector
+from spark_tpu.expressions import Col
+from spark_tpu.sql import logical as L
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "spark_tpu")
+WAIVERS = os.path.join(REPO, "tools", "lint_waivers.toml")
+
+
+def _batch(values, name="k", dtype=None, dictionary=None, valid=None):
+    arr = np.asarray(values)
+    v = ColumnVector(arr, dtype or T.LongType(), valid, dictionary)
+    return ColumnBatch([name], [v], np.ones(len(arr), bool), len(arr))
+
+
+def _rel(values, **kw):
+    return L.LocalRelation(_batch(values, **kw))
+
+
+# ---------------------------------------------------------------------------
+# golden broken plans → verify_plan rejects each, naming the property
+# ---------------------------------------------------------------------------
+
+def test_broken_plan_leaf_dtype():
+    """Wrong dtype propagation: a leaf whose vector no longer matches
+    the schema it claims (the classic hand-mutated-plan accident)."""
+    rel = _rel([1, 2, 3])
+    rel.batch.vectors[0].data = rel.batch.vectors[0].data.astype(np.int32)
+    with pytest.raises(PlanInvariantError) as e:
+        verify_plan(rel)
+    assert e.value.property == "leaf-dtype"
+    assert "LocalRelation" in str(e.value)
+
+
+def test_broken_plan_filter_condition_not_boolean():
+    plan = L.Filter(Col("k"), _rel([1, 2, 3]))
+    with pytest.raises(PlanInvariantError) as e:
+        verify_plan(plan)
+    assert e.value.property == "filter-condition-dtype"
+
+
+def test_broken_plan_project_unresolvable_column():
+    plan = L.Project([Col("nope")], _rel([1, 2]))
+    with pytest.raises(PlanInvariantError) as e:
+        verify_plan(plan)
+    assert e.value.property in ("expr-dtype", "schema-propagation")
+
+
+def test_broken_plan_unknown_join_type():
+    j = L.Join(_rel([1]), _rel([1]), "inner",
+               on=Col("k") == Col("k"))
+    j.how = "sideways"                       # post-construction mutation
+    with pytest.raises(PlanInvariantError) as e:
+        verify_plan(j)
+    assert e.value.property == "join-type"
+
+
+def test_valid_plans_pass_end_to_end(spark):
+    """ZERO false positives on real optimized plans: verify_plan is on
+    under pytest (verifyPlans=auto) and these queries must not trip it,
+    while the session accounting proves it actually ran."""
+    before = dict(getattr(spark, "_analysis_stats", {}))
+    df = spark.createDataFrame(
+        [(1, "a", 1.5), (2, "b", -0.5), (3, "a", 2.25)], ["k", "w", "x"])
+    df.createOrReplaceTempView("az_t")
+    spark.sql("SELECT w, count(*) c, sum(x) sx FROM az_t "
+              "GROUP BY w ORDER BY w").collect()
+    spark.sql("SELECT a.k, b.w FROM az_t a JOIN az_t b ON a.k = b.k "
+              "WHERE a.x > 0").collect()
+    st = spark._analysis_stats
+    assert st["plans_verified"] > before.get("plans_verified", 0)
+    assert st["plan_verify_ms"] >= before.get("plan_verify_ms", 0.0)
+
+
+# ---------------------------------------------------------------------------
+# crossproc runtime invariants on synthetic exchange state
+# ---------------------------------------------------------------------------
+
+def _join(how="inner"):
+    return L.Join(_rel([1]), _rel([1]), how, on=Col("k") == Col("k"))
+
+
+def test_runtime_hash_copartition_rejects_foreign_rows():
+    """Un-co-partitioned hash join: received rows hashing outside this
+    process's fine range mean the sides disagreed on the assignment."""
+    shard = _batch([1, 2, 3, 4, 5])
+    pairs = [(Col("k"), Col("k"))]
+    # bounds [0,0,4]: process 0 owns the EMPTY range, so every live row
+    # is foreign
+    with pytest.raises(PlanInvariantError) as e:
+        az_rt.verify_hash_copartition(_join(), pairs, [0, 0, 4], 4, 0,
+                                      shard, shard)
+    assert e.value.property == "hash-co-partitioning"
+    # the true owner's view of the same shards passes
+    az_rt.verify_hash_copartition(_join(), pairs, [0, 0, 4], 4, 1,
+                                  shard, shard)
+
+
+def test_runtime_reducer_bounds_malformed():
+    with pytest.raises(PlanInvariantError) as e:
+        az_rt.verify_hash_copartition(_join(), [(Col("k"), Col("k"))],
+                                      [0, 3], 4, 0, _batch([1]),
+                                      _batch([1]))
+    assert e.value.property == "reducer-bounds"
+
+
+def test_runtime_range_cutpoints_unsorted():
+    az_rt.verify_range_cutpoints(_join(), [1, 5, 9], False)
+    with pytest.raises(PlanInvariantError) as e:
+        az_rt.verify_range_cutpoints(_join(), [1, 5, 5], False)
+    assert e.value.property == "range-cutpoints"
+    with pytest.raises(PlanInvariantError):
+        az_rt.verify_range_cutpoints(_join(), ["b", "a"], True)
+
+
+def test_runtime_span_owners():
+    az_rt.verify_span_owners(_join(), [[0], [1], [0, 1]], 3, 2)
+    for bad, prop in (([[0], [1]], "span-ownership"),         # count
+                      ([[0], [], [1]], "span-ownership"),     # empty
+                      ([[0], [1, 1], [0]], "span-ownership"), # dup
+                      ([[0], [5], [1]], "span-ownership")):   # range
+        with pytest.raises(PlanInvariantError) as e:
+            az_rt.verify_span_owners(_join(), bad, 3, 2)
+        assert e.value.property == prop
+
+
+def test_runtime_skew_split_legality():
+    az_rt.verify_skew_split(_join("left"), [[0], [0, 1]])
+    with pytest.raises(PlanInvariantError) as e:
+        az_rt.verify_skew_split(_join("full"), [[0], [0, 1]])
+    assert e.value.property == "skew-split-legality"
+
+
+def test_runtime_presorted_build_unsorted_span():
+    """The range lane's sorted-run claim: an unsorted build shard would
+    make PMergeJoin silently drop matches."""
+    az_rt.verify_presorted_build(_join(), _batch([1, 2, 9]),
+                                 Col("k"), False)
+    with pytest.raises(PlanInvariantError) as e:
+        az_rt.verify_presorted_build(_join(), _batch([3, 1, 2]),
+                                     Col("k"), False)
+    assert e.value.property == "presorted-build"
+
+
+def test_runtime_dictionary_invariants():
+    good = _batch([0, 1, 0], dtype=T.StringType(),
+                  dictionary=("apple", "pear"))
+    az_rt.verify_unified_dictionaries(_join(), [good])
+    unsorted = _batch([0, 1], dtype=T.StringType(),
+                      dictionary=("pear", "apple"))
+    with pytest.raises(PlanInvariantError) as e:
+        az_rt.verify_unified_dictionaries(_join(), [unsorted])
+    assert e.value.property == "dictionary-order"
+    oob = _batch([0, 7], dtype=T.StringType(), dictionary=("a", "b"))
+    with pytest.raises(PlanInvariantError) as e:
+        az_rt.verify_unified_dictionaries(_join(), [oob])
+    assert e.value.property == "dictionary-code-space"
+
+
+def test_runtime_ledger_scope_pairing():
+    from spark_tpu.memory import HostMemoryLedger
+    ledger = HostMemoryLedger(budget=1 << 20)
+    ledger.reserve("shuffle:xq000001:jL-map", 100)
+    az_rt.verify_ledger_scope(ledger, set(), "xq000001")   # scoped: fine
+    ledger.reserve("stray-owner", 50)
+    with pytest.raises(PlanInvariantError) as e:
+        az_rt.verify_ledger_scope(ledger, set(), "xq000001")
+    assert e.value.property == "ledger-scope-pairing"
+    assert "stray-owner" in str(e.value)
+    # pre-existing owners (another query's cache) are not strays
+    az_rt.verify_ledger_scope(ledger, {"stray-owner"}, "xq000001")
+
+
+# ---------------------------------------------------------------------------
+# hazard-lint rules on synthetic snippets
+# ---------------------------------------------------------------------------
+
+def _lint(src):
+    return lint_source(textwrap.dedent(src))
+
+
+def _rules(src):
+    return sorted({f.rule for f in _lint(src)})
+
+
+def test_lint_jit_host_materialization():
+    bad = """
+        import numpy as np
+        from jax import jit
+
+        @jit
+        def f(x):
+            return np.asarray(x) + x.item()
+    """
+    fs = [f for f in _lint(bad) if f.rule == "HZ101"]
+    assert len(fs) == 2 and fs[0].symbol == "f"
+    ok = """
+        import numpy as np
+
+        def g(x):
+            return np.asarray(x)
+    """
+    assert "HZ101" not in _rules(ok)
+
+
+def test_lint_jit_detects_partial_form():
+    src = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=0)
+        def f(n, x):
+            return x.item()
+    """
+    assert "HZ101" in _rules(src)
+
+
+def test_lint_reserve_without_release():
+    bad = """
+        def stage(svc):
+            svc.ledger.reserve("owner", 100)
+            return 1
+    """
+    assert "HZ102" in _rules(bad)
+    ok = """
+        def stage(svc):
+            svc.ledger.reserve("owner", 100)
+            try:
+                return 1
+            finally:
+                svc.ledger.release("owner")
+    """
+    assert "HZ102" not in _rules(ok)
+
+
+def test_lint_unlocked_shared_state():
+    bad = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                self.count += 1
+    """
+    fs = [f for f in _lint(bad) if f.rule == "HZ103"]
+    assert len(fs) == 1 and fs[0].symbol == "S.bump"
+    ok = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+    """
+    assert "HZ103" not in _rules(ok)
+
+
+def test_lint_condition_attr_counts_as_lock():
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._drained = threading.Condition()
+                self.pending = 0
+
+            def bump(self):
+                with self._drained:
+                    self.pending += 1
+    """
+    assert "HZ103" not in _rules(src)
+
+
+def test_lint_blocking_io_under_lock():
+    bad = """
+        import time
+
+        def f(lock):
+            with lock:
+                time.sleep(1)
+    """
+    assert "HZ104" in _rules(bad)
+    ok = """
+        import time
+
+        def f(lock):
+            with lock:
+                pass
+            time.sleep(1)
+    """
+    assert "HZ104" not in _rules(ok)
+
+
+def test_lint_unused_import():
+    assert "HZ106" in _rules("import os\n\nx = 1\n")
+    assert "HZ106" not in _rules("import os\n\nx = os.getpid()\n")
+    # __all__ re-exports are used
+    assert "HZ106" not in _rules(
+        "from collections import OrderedDict\n"
+        "__all__ = ['OrderedDict']\n")
+
+
+def test_lint_shadowed_builtin():
+    assert "HZ107" in _rules("def f(id):\n    return id\n")
+    assert "HZ107" in _rules("type = 'x'\n")
+    assert "HZ107" not in _rules("def f(uid):\n    return uid\n")
+
+
+def test_waiver_file_parses_and_matches():
+    waivers = load_waivers(WAIVERS)
+    assert waivers and all(w.get("reason") for w in waivers)
+    f = lint_source("def f(lock):\n    with lock:\n        open('x')\n")[0]
+    assert not is_waived(f, waivers)      # synthetic path never waived
+
+
+def test_waiver_requires_reason(tmp_path):
+    p = tmp_path / "w.toml"
+    p.write_text('[[waiver]]\nrule = "HZ104"\n')
+    with pytest.raises(ValueError, match="reason"):
+        load_waivers(str(p))
+
+
+def test_waiver_rejects_unsupported_syntax(tmp_path):
+    p = tmp_path / "w.toml"
+    p.write_text("[[waiver]]\nrule = [1, 2]\n")
+    with pytest.raises(ValueError, match="unsupported"):
+        load_waivers(str(p))
+
+
+# ---------------------------------------------------------------------------
+# the repo itself: conf coverage + lint-clean (tier-1 gates)
+# ---------------------------------------------------------------------------
+
+def test_planning_conf_coverage_complete():
+    """Every conf the planning files read is in the plan cache's
+    fingerprint — the silently-stale-cache bug class, closed statically
+    against the LIVE planner code."""
+    reads = planning_conf_reads()
+    assert reads, "conf-read scan found nothing: scanner broken?"
+    assert missing_planning_confs() == []
+
+
+def test_repo_is_lint_clean():
+    unwaived, waived = lint_paths([PKG], WAIVERS)
+    assert unwaived == [], "\n".join(str(f) for f in unwaived)
+    # waivers stay justified, not a dumping ground
+    assert len(waived) <= 16
+
+
+def test_lint_cli_main_exit_codes(tmp_path, capsys):
+    assert main([PKG, "--waivers", WAIVERS]) == 0
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\n\nx = 1\n")
+    assert main([str(bad), "--no-waivers"]) == 1
+    out = capsys.readouterr().out
+    assert "HZ106" in out
+
+
+# ---------------------------------------------------------------------------
+# satellite: SET of a newly-covered planning conf invalidates the cache
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("key,val", [
+    ("spark.tpu.shuffle.finePartitionsPerProc", "9"),
+    ("spark.tpu.crossproc.dedupReplicated", "false"),
+])
+def test_set_planning_conf_invalidates_plan_cache(spark, key, val):
+    from spark_tpu.serving.plancache import PlanCache
+    s = spark.newSession()
+    cache = PlanCache(s.conf_obj)
+    s._plan_cache = cache
+    q = ("SELECT id % 7 AS g, count(*) AS c FROM range(64) "
+         "GROUP BY id % 7 ORDER BY g")
+    r1 = [tuple(r) for r in s.sql(q).collect()]
+    assert cache.stats()["entries"] >= 1
+    before = cache.stats()["invalidations"]
+    s.sql(f"SET {key}={val}")
+    assert cache.stats()["invalidations"] > before, \
+        f"SET {key} must evict entries built under the old value"
+    assert [tuple(r) for r in s.sql(q).collect()] == r1

@@ -275,3 +275,16 @@ def test_memory_leak_check_releases(spark, mdf):
     spark._memory.acquire_execution(f"query:{id(qe)}", 1234)
     qe.execute()
     assert f"query:{id(qe)}" not in spark._memory._execution
+
+
+def test_analysis_verifier_gauges(spark, mdf):
+    """The plan verifier's accounting rides the session metrics system:
+    plans_verified increments per verified plan (verifyPlans=auto is ON
+    under pytest) and plan_verify_ms accumulates wall time."""
+    ms = spark.metricsSystem
+    before = ms.report()["analysis"]
+    mdf.filter(F.col("v") < 10).count()
+    after = ms.report()["analysis"]
+    assert after["plans_verified"] > before["plans_verified"]
+    assert after["plan_verify_ms"] >= before["plan_verify_ms"]
+    assert after["plan_verify_ms"] < 60_000  # sanity: ms, not seconds
