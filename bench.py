@@ -77,6 +77,12 @@ DS_KEYS = 1 << 12    # distsort key cardinality; half the probe mass sits
 DS_HOT = 77          # on this ONE hot key (the skew under test)
 DD_ROWS = 24000      # distdict lane: rows per table (low-cardinality keys)
 DD_KEYS = 2500       # distinct fat words (~30 B each: dict ~75 KiB/column)
+DA_ROWS = 1 << 20    # distadapt lane: rows per table (full dataset)
+DA_KEYS = 1 << 13    # join-key cardinality
+DA_CUT = 3           # right-side filter: bonus < 3 keeps ~2% of rows, a
+                     # ~50x misestimate vs the plan-time raw-leaf probe
+DA_PAY = 12          # left payload columns: the mass the frozen hash
+                     # shuffle ships and the demoted broadcast never does
 
 #: cold axon compiles of the fused agg/join programs run several minutes
 #: (f64/i64 emulation); the persistent jax compile cache under /tmp makes
@@ -764,6 +770,163 @@ def distjoin_worker_main() -> None:
     sys.stdout.flush()
 
 
+def _bench_dist_adapt() -> dict:
+    """Distadapt lane: adaptive re-planning from observed exchange stats.
+
+    A 2-process join whose RIGHT side the plan-time probe misestimates
+    by ~20x: the leaf is ~5 MB raw, but a selective pushed-down filter
+    keeps ~5% of its rows, far under the broadcast threshold.  Each
+    worker runs the same query with ``adaptiveReplan`` off (frozen: the
+    full hash shuffle ships the fat left side) and on (the stats
+    barrier demotes to a broadcast before any data block ships).  The
+    parent cross-checks byte-identical aggregates, that the adaptive
+    run actually demoted (and the frozen run actually shuffled), and
+    reports wall-clock speedup + DCN byte reduction."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="spark_tpu_bench_da_")
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("SPARK_TPU_FAULT_PLAN", None)
+        env.pop("SPARK_TPU_PLATFORM", None)
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--distadapt-worker", str(pid), d],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for pid in (0, 1)]
+        outs = [p.communicate(timeout=CHILD_TIMEOUT_S) for p in procs]
+        objs = []
+        for p, (out, err) in zip(procs, outs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"distadapt worker rc={p.returncode}: "
+                    f"{(err or out).strip().splitlines()[-3:]}")
+            line = [ln for ln in out.splitlines()
+                    if ln.strip().startswith("{")][-1]
+            objs.append(json.loads(line))
+        sums = {o[m]["checksum"] for o in objs for m in ("adaptive",
+                                                         "frozen")}
+        if len(sums) != 1:
+            raise RuntimeError(f"adaptive/frozen results diverge: {objs}")
+        if not all(o["adaptive"]["strategy_demotions"] > 0 for o in objs):
+            raise RuntimeError(f"adaptive run did not demote: {objs}")
+        if not all(o["frozen"]["shuffled_joins"] > 0
+                   and o["frozen"]["strategy_demotions"] == 0
+                   for o in objs):
+            raise RuntimeError(f"frozen run did not hash-shuffle: {objs}")
+        rows = objs[0]["rows_total"]
+        ad_s = max(o["adaptive"]["seconds"] for o in objs)
+        fz_s = max(o["frozen"]["seconds"] for o in objs)
+        ad_b = sum(o["adaptive"]["bytes_written"] for o in objs)
+        fz_b = sum(o["frozen"]["bytes_written"] for o in objs)
+        return {
+            "distadapt_rows_per_sec": round(rows / ad_s, 1),
+            "distadapt_frozen_rows_per_sec": round(rows / fz_s, 1),
+            "distadapt_speedup_vs_frozen": round(fz_s / ad_s, 3),
+            "distadapt_dcn_bytes": ad_b,
+            "distadapt_frozen_dcn_bytes": fz_b,
+            "distadapt_dcn_byte_reduction": round(fz_b / max(1, ad_b), 2),
+            "distadapt_demotions": sum(
+                o["adaptive"]["strategy_demotions"] for o in objs),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def distadapt_worker_main() -> None:
+    """One process of the distadapt lane (see ``_bench_dist_adapt``).
+
+    argv: --distadapt-worker <pid> <root>.  Prints ONE JSON line with
+    warm wall-clock and service counters for the adaptive and frozen
+    modes.  The measured adaptive run must exercise the DEMOTION (the
+    stats barrier), not the feedback shortcut, so the warm run's
+    recorded cardinalities are cleared before timing."""
+    i = sys.argv.index("--distadapt-worker")
+    pid, root = int(sys.argv[i + 1]), sys.argv[i + 2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from spark_tpu import config as C
+    from spark_tpu.sql.session import SparkSession
+
+    # both workers draw the SAME dataset, keep a strided half.  The left
+    # side is WIDE (five payload columns, all live in the output) — the
+    # mass the frozen hash shuffle ships and the demoted broadcast keeps
+    # local.  The right side's filter keeps ~5% of its rows.
+    rng = np.random.default_rng(47)
+    sk = rng.integers(0, DA_KEYS, DA_ROWS).astype(np.int64)
+    pay = [rng.integers(1, 201, DA_ROWS).astype(np.int64)
+           for _ in range(DA_PAY)]
+    k2 = rng.integers(0, DA_KEYS, DA_ROWS).astype(np.int64)
+    bonus = rng.integers(1, 101, DA_ROWS).astype(np.int64)
+    mine = slice(pid, None, 2)
+    spay = " + ".join(f"p{j}" for j in range(DA_PAY))
+    Q = ("SELECT sk, count(*) AS c, "
+         f"sum({spay}) AS sp, sum(bonus) AS sb "
+         f"FROM fact JOIN fact2 ON sk = k2 WHERE bonus < {DA_CUT} "
+         "GROUP BY sk")
+
+    session = SparkSession.builder.appName(f"bench-da-{pid}").getOrCreate()
+    out = {"pid": pid, "rows_total": int(2 * DA_ROWS)}
+    for mode in ("adaptive", "frozen"):
+        xs = session.newSession()
+        xs.conf.set(C.MESH_SHARDS.key, "1")
+        xs.conf.set(C.CROSSPROC_SHUFFLED_JOIN.key, "true")
+        xs.conf.set(C.CROSSPROC_SORT_MERGE_JOIN.key, "false")
+        # between the observed right side (~5% of the leaf) and the
+        # plan-time probe (the raw leaf): freeze hash, observe broadcast
+        xs.conf.set(C.CROSSPROC_AUTO_BROADCAST.key, str(1 << 20))
+        xs.conf.set(C.CROSSPROC_ADAPTIVE_REPLAN.key,
+                    "true" if mode == "adaptive" else "false")
+        svc = xs.enableHostShuffle(os.path.join(root, mode),
+                                   process_id=pid, n_processes=2,
+                                   timeout_s=300.0)
+        xs.createDataFrame(dict(
+            {"sk": sk[mine]},
+            **{f"p{j}": p[mine] for j, p in enumerate(pay)})) \
+            .createOrReplaceTempView("fact")
+        xs.createDataFrame({"k2": k2[mine], "bonus": bonus[mine]}) \
+            .createOrReplaceTempView("fact2")
+        xs.sql(Q).collect()                  # warm: compile + caches
+        xs.statsFeedback.clear()             # measure the demotion path
+        base_bytes = int(svc.counters["bytes_written"])
+        base_rows = int(svc.counters["rows_shipped"])
+        base_dem = int(svc.counters["strategy_demotions"])
+        base_shj = int(svc.counters["shuffled_joins"])
+        # median-of-3: filesystem-barrier jitter dominates run-to-run
+        # variance, and both processes must repeat in lockstep anyway
+        # (every iteration is a fresh exchange round)
+        iters = []
+        for _ in range(3):
+            xs.statsFeedback.clear()         # re-demote, don't shortcut
+            it_bytes = int(svc.counters["bytes_written"])
+            it_rows = int(svc.counters["rows_shipped"])
+            t0 = time.perf_counter()
+            rows = xs.sql(Q).collect()
+            iters.append((time.perf_counter() - t0,
+                          int(svc.counters["bytes_written"]) - it_bytes,
+                          int(svc.counters["rows_shipped"]) - it_rows))
+        elapsed, it_bytes, it_rows = sorted(iters)[1]
+        out[mode] = {
+            "seconds": round(elapsed, 3),
+            "bytes_written": it_bytes,
+            "rows_shipped": it_rows,
+            "groups": len(rows),
+            "checksum": int(sum(int(r[1]) * 7 + int(r[2]) * 3 + int(r[3])
+                                for r in rows)),
+            "strategy_demotions":
+                int(svc.counters["strategy_demotions"]) - base_dem,
+            "shuffled_joins": int(svc.counters["shuffled_joins"]) - base_shj,
+            "adaptive_replans": int(svc.counters["adaptive_replans"]),
+        }
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
 def _bench_dist_dict() -> dict:
     """Distdict lane: encoded execution over the DCN exchange.  A
     2-process low-cardinality string-key join + group-by runs twice with
@@ -1442,6 +1605,14 @@ def child_main() -> None:
         print(f"[bench-child] distsort bench failed: {e}", file=sys.stderr)
         extras["distsort_error"] = str(e)[:300]
     try:
+        # adaptive execution: 2 real worker processes, a ~20x
+        # misestimated join side, frozen hash shuffle vs the observed-
+        # stats demotion to broadcast
+        extras.update(_bench_dist_adapt())
+    except Exception as e:   # secondary must not sink the primary
+        print(f"[bench-child] distadapt bench failed: {e}", file=sys.stderr)
+        extras["distadapt_error"] = str(e)[:300]
+    try:
         # encoded execution: 2 real worker processes, low-cardinality
         # string-key join, dictionary-dedup wire vs words-per-block
         extras.update(_bench_dist_dict())
@@ -1488,6 +1659,8 @@ def child_main() -> None:
 if __name__ == "__main__":
     if "--distjoin-worker" in sys.argv:
         distjoin_worker_main()
+    elif "--distadapt-worker" in sys.argv:
+        distadapt_worker_main()
     elif "--distsort-worker" in sys.argv:
         distsort_worker_main()
     elif "--distdict-worker" in sys.argv:

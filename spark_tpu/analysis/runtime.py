@@ -13,7 +13,11 @@ What each check pins down (docs/INVARIANTS.md has the catalogue):
 * ``verify_join_strategy`` — the chosen strategy is legal for the join
   type and the statistics (broadcasting a preserved outer side would
   null-extend once per process; range needs an orderable key; range and
-  hash need equi keys).
+  hash need equi keys).  At the adaptive stats barrier the same check
+  also recomputes ``adaptive_join_decision`` from the gathered
+  manifests — a mismatch means this process diverged from its peers —
+  and rejects any adaptive strategy change that is not a demotion to
+  broadcast.
 * ``verify_hash_copartition`` — after the hash exchange, every live row
   of BOTH local shards hashes into this process's fine-partition range
   under the shared reducer bounds.  Rows outside it mean the two sides
@@ -59,7 +63,17 @@ _STRATEGIES = ("broadcast_left", "broadcast_right", "range", "hash",
 
 
 def verify_join_strategy(join, strategy: str, range_eligible: bool,
-                         key_pairs: Sequence[Tuple]) -> None:
+                         key_pairs: Sequence[Tuple], frozen=None,
+                         observed=None, broadcast_threshold: int = 0,
+                         n_procs: int = 1) -> None:
+    """Strategy legality, plan-time AND adaptive.  With ``frozen``/
+    ``observed`` supplied (the stats-barrier call), two extra checks
+    run: the decision must equal ``adaptive_join_decision`` recomputed
+    from the same inputs — the gathered manifests are identical on
+    every process, so a mismatch HERE means this process diverged from
+    its peers and matching keys would land on different processes —
+    and an adaptive change of strategy may only ever DEMOTE to a
+    broadcast (re-bucketing lanes mid-flight is never legal)."""
     from ..parallel import crossproc as X
 
     if strategy not in _STRATEGIES:
@@ -84,6 +98,23 @@ def verify_join_strategy(join, strategy: str, range_eligible: bool,
         raise PlanInvariantError(
             join, "equi-keys",
             f"{strategy} exchange chosen for a join with no equi keys")
+    if frozen is not None:
+        expect = X.adaptive_join_decision(
+            frozen, join.how, broadcast_threshold, n_procs, observed)
+        if strategy != expect:
+            raise PlanInvariantError(
+                join, "adaptive-decision-agreement",
+                f"adaptive decision {strategy!r} differs from the "
+                f"recomputed {expect!r} (frozen {frozen!r}, observed "
+                f"{observed!r}) — this process diverged from its peers "
+                "and matching keys would land on different processes")
+        if strategy != frozen and strategy not in ("broadcast_left",
+                                                   "broadcast_right"):
+            raise PlanInvariantError(
+                join, "adaptive-demotion-legality",
+                f"adaptive re-decision moved {frozen!r} to {strategy!r}: "
+                "only a demotion to broadcast is legal once the map "
+                "sides are materialized for the frozen lane")
 
 
 def _live_mask(host) -> np.ndarray:

@@ -440,6 +440,23 @@ CROSSPROC_AUTO_BROADCAST = conf(
     "0 = never broadcast."
 ).check(lambda v: v >= 0).int(1 << 20)
 
+CROSSPROC_ADAPTIVE_REPLAN = conf(
+    "spark.tpu.crossproc.adaptiveReplan").doc(
+    "Adaptive re-planning of the cross-process join strategy from "
+    "OBSERVED exchange statistics: after both map sides are bucketed "
+    "(and before any data block ships), the size-manifest round also "
+    "carries each side's observed byte/row totals, every process re-runs "
+    "choose_join_strategy against them, and a hash/range plan whose "
+    "small side's real volume contradicts the digest probe demotes to "
+    "broadcast (the small side ships ONCE instead of co-partitioning "
+    "both sides).  Observed cardinalities are also recorded in the "
+    "session's StatsFeedback and consulted by later plan-time decisions "
+    "of the same query sequence.  Demotion additionally requires a "
+    "positive autoBroadcastThreshold; a lost or corrupt stats round "
+    "falls back to the frozen plan-time strategy.  Off = strategies "
+    "freeze at plan time (the digest probe alone decides)."
+).boolean(True)
+
 SHUFFLE_RANGE_SAMPLE_SIZE = conf("spark.tpu.shuffle.rangeSampleSize").doc(
     "Per-process, per-side number of join-key sample points published "
     "in the range-partitioning sample round.  Larger = tighter cut "

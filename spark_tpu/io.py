@@ -1175,6 +1175,15 @@ class DataFrameWriter:
         else:
             self._write_table(table, path, ext)
         open(os.path.join(path, "_SUCCESS"), "w").close()
+        # DataFrame-API writes mutate the same paths the SQL commands do
+        # (CREATE TABLE AS / INSERT INTO route through this writer): a
+        # serving plan cache holding entries that READ this path would
+        # replay stale capacities/CBO sides, so the write goes through
+        # the same invalidation hook the SQL commands use
+        session = getattr(self._df, "session", None)
+        invalidate = getattr(session, "_invalidate_plan_cache", None)
+        if invalidate is not None:
+            invalidate(path=os.path.abspath(path))
 
     def parquet(self, path: str) -> None:
         self.format("parquet").save(path)

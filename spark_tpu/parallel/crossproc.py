@@ -97,6 +97,8 @@ from .. import wire
 from .hostshuffle import ExchangeFetchFailed, FetchSink, HostShuffleService
 
 __all__ = ["host_exchange_group_agg", "crossproc_execute",
+           "choose_join_strategy", "adaptive_join_decision",
+           "observed_side_stats", "StatsFeedback",
            "ExchangeFetchFailed"]
 
 
@@ -234,38 +236,43 @@ def _batch_digest(batch: ColumnBatch) -> int:
     return int.from_bytes(h.digest()[:8], "little", signed=True)
 
 
+def _rebase_first_ranks(partial_node, partial: ColumnBatch, pid: int,
+                        n: int) -> ColumnBatch:
+    """The host partial numbered first/last value-carry ranks with
+    shard=0, so two processes' ranks would collide and the merge would
+    crown a LOCAL-row winner; rebase live ranks to the mesh encoding
+    (pid << 48 | row) so "globally first" means the same thing it does
+    in-slice.  Dead ranks keep their sentinels — offsetting last's -1
+    would let its max-reduce resurrect a dead row."""
+    from ..aggregates import First
+
+    if n <= 1:
+        return partial
+    base = np.int64(pid) << np.int64(48)
+    vecs = list(partial.vectors)
+    names = list(partial.names)
+    for i, (func, _n) in enumerate(partial_node.slots):
+        if not isinstance(func, First):
+            continue
+        is_last = getattr(func, "ARGREDUCE", "first") == "last"
+        dead = np.int64(-1) if is_last else np.int64(1 << 62)
+        bn_rank, _bn_val, _bn_valid = partial_node.buffer_names(i, func)
+        j = names.index(bn_rank)
+        r = np.asarray(vecs[j].data)
+        vecs[j] = ColumnVector(np.where(r == dead, r, r + base),
+                               vecs[j].dtype, vecs[j].valid, None)
+    return ColumnBatch(names, vecs, partial.row_valid, partial.capacity)
+
+
 def _route_exchange_merge(session, plan, partial_node, partial: ColumnBatch,
                           svc: HostShuffleService, xid: str) -> ColumnBatch:
     """Steps 2-4 of the aggregation exchange, shared by both entry
     points: key-hash route partial rows → DCN hop → merge colliding
     partials + finish with the SAME final node the in-slice path uses,
     so the two exchange flavors cannot diverge."""
-    from ..aggregates import First
     from .dist import DFinalAggregate
 
-    # the host partial numbered first/last value-carry ranks with
-    # shard=0, so two processes' ranks would collide and the merge would
-    # crown a LOCAL-row winner; rebase live ranks to the mesh encoding
-    # (pid << 48 | row) so "globally first" means the same thing it does
-    # in-slice.  Dead ranks keep their sentinels — offsetting last's -1
-    # would let its max-reduce resurrect a dead row.
-    if svc.n > 1:
-        base = np.int64(svc.pid) << np.int64(48)
-        vecs = list(partial.vectors)
-        names = list(partial.names)
-        for i, (func, _n) in enumerate(partial_node.slots):
-            if not isinstance(func, First):
-                continue
-            is_last = getattr(func, "ARGREDUCE", "first") == "last"
-            dead = np.int64(-1) if is_last else np.int64(1 << 62)
-            bn_rank, _bn_val, _bn_valid = partial_node.buffer_names(i, func)
-            j = names.index(bn_rank)
-            r = np.asarray(vecs[j].data)
-            vecs[j] = ColumnVector(np.where(r == dead, r, r + base),
-                                   vecs[j].dtype, vecs[j].valid, None)
-        partial = ColumnBatch(names, vecs, partial.row_valid,
-                              partial.capacity)
-
+    partial = _rebase_first_ranks(partial_node, partial, svc.pid, svc.n)
     key_refs = [Col(k.name) for k in plan.keys]
     ectx = EvalContext(partial, np)
     h = ectx.broadcast(Hash64(*key_refs).eval(ectx)).data
@@ -618,13 +625,89 @@ def _stage_map_side(svc: HostShuffleService, exchange: str,
                        rows=rows, dead=dead)
 
 
+def _bucket_payload_sizes(local: ColumnBatch, fine: np.ndarray,
+                          n_parts: int
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-fine-bucket ``(counts, payload bytes)`` of ``local`` WITHOUT
+    materializing the buckets — byte-identical to ``payload_nbytes``
+    over the ``partition_host_slices`` slices, because raw bytes are a
+    pure function of per-bucket row counts, dtypes, and mask presence,
+    and dictionary word mass is the distinct words each bucket's codes
+    reference.  Sizing this way keeps the stats round AHEAD of the
+    bucketing sort, so a side the adaptive decision demotes never pays
+    the permutation of data it will not ship."""
+    live = np.asarray(local.row_valid_or_true())
+    cnt = np.bincount(np.asarray(fine)[live],
+                      minlength=n_parts).astype(np.int64)
+    raw = np.zeros(n_parts, np.int64)
+    cap = int(local.capacity)
+    for v in local.vectors:
+        data = np.asarray(v.data)
+        raw += cnt * (data.nbytes // cap if cap else 0)
+        if v.valid is not None:
+            raw += (cnt + 7) // 8
+        words = v.dictionary
+        if words:
+            nw = len(words)
+            codes = data.ravel()
+            bf = fine if data.ndim == 1 else np.repeat(fine, data.shape[1])
+            bl = live if data.ndim == 1 else np.repeat(live, data.shape[1])
+            ok = bl & (codes >= 0) & (codes < nw)
+            pair = np.unique(bf[ok].astype(np.int64) * nw
+                             + codes[ok].astype(np.int64))
+            wl = np.fromiter((len(w) for w in words), np.int64, nw)
+            np.add.at(raw, pair // nw, wl[pair % nw])
+    return cnt, raw
+
+
+def _demote_locals_to_broadcast(svc: HostShuffleService, xid: str,
+                                decision: str, locals_: List[ColumnBatch]
+                                ) -> Tuple[ColumnBatch, ColumnBatch]:
+    """Hash-lane demotion: the map sides were never bucketed or staged
+    (sizing runs ahead of the permutation), so the locally-executed rows
+    broadcast as they are — the big side never moves, the small side
+    gathers through the single-use ``{xid}-bcast`` exchange (also the
+    fault-injection address for kill-mid-demotion coverage)."""
+    small_i = 0 if decision == "broadcast_left" else 1
+    small = _gather_all(svc, f"{xid}-bcast", locals_[small_i],
+                        dedup=False)
+    if not int(np.asarray(small.num_rows())):
+        small = _one_dead_row(locals_[small_i])
+    out = [None, None]
+    out[small_i] = small
+    out[1 - small_i] = locals_[1 - small_i]
+    return out[0], out[1]
+
+
+class _AggSideSpec:
+    """A join side qualifying for partial-aggregate pushdown: the keyed
+    ``Aggregate`` core, any pass-through projections the SQL layer left
+    between it and the join (derived tables optimize to
+    ``Project(Aggregate)`` — the Project only renames/reorders the
+    aggregate's output), and the join-key name map through those
+    projections (outer name → aggregate key name), so the map side
+    hashes the column the partial state actually carries."""
+
+    __slots__ = ("agg", "projs", "key_map")
+
+    def __init__(self, agg, projs, key_map):
+        self.agg = agg
+        self.projs = projs            # outermost-first Project nodes
+        self.key_map = key_map        # join-expr name -> agg key name
+
+
 def _shuffled_join_shards(session, join, key_pairs,
-                          svc: HostShuffleService, xid: str
-                          ) -> Tuple[ColumnBatch, ColumnBatch]:
+                          svc: HostShuffleService, xid: str,
+                          adaptive=None, side_aggs: Tuple = (None, None)
+                          ) -> Tuple[ColumnBatch, ColumnBatch,
+                                     Optional[str]]:
     """Co-partition BOTH join sides by join-key hash through the host
     shuffle service; returns this process's disjoint (left, right) key
-    range (the ShuffleExchangeExec placement + ExchangeCoordinator
-    protocol, DCN-shaped):
+    range plus the demotion verdict — ``(left, right, None)`` when the
+    hash exchange ran, ``(local_big, broadcast_small, decision)``-shaped
+    when the stats barrier demoted the plan to a broadcast before any
+    data block shipped (the ShuffleExchangeExec placement +
+    ExchangeCoordinator protocol, DCN-shaped):
 
     1. each side's subtree runs locally (device path) per process;
     2. rows bucket by ``Hash64(keys) % n_fine`` on device
@@ -654,38 +737,79 @@ def _shuffled_join_shards(session, join, key_pairs,
     sdir = _exchange_spill_dir(session, xid)
     try:
         # per side: local run -> key hash -> fine bucketing -> host
-        # slices, staged in RAM (ledger-reserved) or a spill file
-        sides: List[_StagedSide] = []
+        # slices, staged in RAM (ledger-reserved) or a spill file.  A
+        # side carrying a pushed-down aggregate ships pre-aggregated
+        # PARTIAL STATE instead of raw rows: legal because its aggregate
+        # keys subsume the join keys, so same-group rows share the
+        # join-key hash and every partial of a group collides on ONE
+        # reducer, which finishes the aggregate before joining.
+        pending: List[Tuple[ColumnBatch, np.ndarray, np.ndarray]] = []
         sizes: Dict[int, int] = {}
-        for tag, (subtree, exprs) in zip(("jL", "jR"), (
-                (join.children[0], [l for l, _ in key_pairs]),
-                (join.children[1], [r for _, r in key_pairs]))):
-            local = _run_local(session, subtree).to_host()
+        side_obs: Dict[str, List[int]] = {}
+        partial_nodes = [None, None]
+        for i, (tag, skey, (subtree, exprs)) in enumerate(zip(
+                ("jL", "jR"), ("l", "r"), (
+                    (join.children[0], [l for l, _ in key_pairs]),
+                    (join.children[1], [r for _, r in key_pairs])))):
+            spec = side_aggs[i]
+            if spec is not None:
+                agg = spec.agg
+                below = _run_local(session, agg.children[0])
+                partial_nodes[i], local = _partial_over(agg, below)
+                local = _rebase_first_ranks(partial_nodes[i], local,
+                                            svc.pid, svc.n)
+                # partial state carries the AGGREGATE's column names;
+                # join exprs name the side's (possibly projected) output
+                hash_exprs = [Col(spec.key_map[e.name]) for e in exprs]
+            else:
+                local = _run_local(session, subtree).to_host()
+                hash_exprs = exprs
             ectx = EvalContext(local, np)
-            h = ectx.broadcast(Hash64(*exprs).eval(ectx)).data
+            h = ectx.broadcast(Hash64(*hash_exprs).eval(ectx)).data
             fine = (np.asarray(h).astype(np.uint64)
                     % np.uint64(n_fine)).astype(np.int32)
-            bucketed, off, cnt = partition_host_slices(np, local, fine,
-                                                       n_fine)
-            raw = np.zeros(n_fine, np.int64)
+            # payload sizing (dict columns weigh their word subset, or
+            # codes-only sizing hides string mass) runs BEFORE the
+            # bucketing sort: a demoted side never pays the permutation
+            cnt, raw = _bucket_payload_sizes(local, fine, n_fine)
             for p in range(n_fine):
                 if int(cnt[p]):
-                    raw[p] = wire.raw_nbytes(
-                        [slice_rows(bucketed, int(off[p]), int(cnt[p]))])
                     sizes[p] = sizes.get(p, 0) + int(raw[p])
+            side_obs[skey] = [int(raw.sum()), int(cnt.sum())]
+            pending.append((local, fine, raw))
+
+        # ONE coordination round covers both sides: the assignment must
+        # be shared or matching keys would land on different processes.
+        # The same manifests piggyback each side's OBSERVED byte/row
+        # totals (the ``sizes`` dict sums both sides per partition, so
+        # per-side volumes are unrecoverable from it) — the adaptive
+        # re-decision reads them before any side is even bucketed, let
+        # alone a data block shipped.
+        svc.publish_sizes(f"{xid}-plan", sizes,
+                          extra={"sides": side_obs})
+        totals, mans = svc.gather_sizes_ex(f"{xid}-plan", n_fine)
+        decision = _adaptive_redecide(join, svc, xid, adaptive, "hash",
+                                      mans)
+        if decision != "hash":
+            left, right = _demote_locals_to_broadcast(
+                svc, xid, decision, [p[0] for p in pending])
+            return left, right, decision
+        bounds = svc.plan_reducers(totals, target)
+
+        # hash confirmed: NOW bucket each side into host slices and
+        # stage them in RAM (ledger-reserved) or a spill file
+        sides: List[_StagedSide] = []
+        for tag, (local, fine, raw) in zip(("jL", "jR"), pending):
+            bucketed, off, cnt = partition_host_slices(np, local, fine,
+                                                       n_fine)
             sides.append(_stage_map_side(
                 svc, f"{xid}-{tag}", f"shuffle:{xid}:{tag}-map",
                 bucketed, off, cnt, raw, sdir))
             del bucketed, local    # a spilled side frees its rows here
-
-        # ONE coordination round covers both sides: the assignment must
-        # be shared or matching keys would land on different processes
-        svc.publish_sizes(f"{xid}-plan", sizes)
-        totals = svc.gather_sizes(f"{xid}-plan", n_fine)
-        bounds = svc.plan_reducers(totals, target)
+        del pending
 
         shards: List[ColumnBatch] = []
-        for tag, side in zip(("jL", "jR"), sides):
+        for i, (tag, side) in enumerate(zip(("jL", "jR"), sides)):
             sink = FetchSink(svc, f"shuffle:{xid}:{tag}-fetch",
                              f"{xid}-{tag}", sdir)
             try:
@@ -715,8 +839,20 @@ def _shuffled_join_shards(session, join, key_pairs,
                 received = [b for b in received
                             if int(np.asarray(b.num_rows()))] or \
                     [_one_dead_row(side.dead)]
-                shards.append(union_all(received) if len(received) > 1
-                              else received[0])
+                shard = (union_all(received) if len(received) > 1
+                         else received[0])
+                if partial_nodes[i] is not None:
+                    shard = _finalize_partial_side(side_aggs[i].agg,
+                                                   partial_nodes[i],
+                                                   shard)
+                    # re-apply the pass-through projections (innermost
+                    # first) so the shard's schema matches the join side
+                    from ..sql import logical as L
+                    for p in reversed(side_aggs[i].projs):
+                        shard = _run_local(
+                            session,
+                            L.Project(p.exprs, L.LocalRelation(shard)))
+                shards.append(shard)
                 # the shipped bucketed output is gone (remote shares on
                 # disk, the own share re-accounted by the sink): the
                 # map-side reservation must not keep inflating the
@@ -729,7 +865,7 @@ def _shuffled_join_shards(session, join, key_pairs,
             _az.verify_hash_copartition(join, key_pairs, bounds, n_fine,
                                         svc.pid, shards[0], shards[1])
             _az.verify_unified_dictionaries(join, shards)
-        return shards[0], shards[1]
+        return shards[0], shards[1], None
     finally:
         shutil.rmtree(sdir, ignore_errors=True)
 
@@ -746,13 +882,18 @@ _BCAST_LEFT_OK = ("inner", "right")
 def choose_join_strategy(how: str, range_eligible: bool,
                          sort_merge_enabled: bool, shuffled_enabled: bool,
                          broadcast_threshold: int, n_procs: int,
-                         left_bytes: int, right_bytes: int) -> str:
+                         left_bytes: int, right_bytes: int,
+                         observed_left: Optional[Tuple[int, int]] = None,
+                         observed_right: Optional[Tuple[int, int]] = None,
+                         feedback: Optional["StatsFeedback"] = None,
+                         left_sig: Optional[str] = None,
+                         right_sig: Optional[str] = None) -> str:
     """The cross-process equi-join strategy decision, as a PURE function
     of the statistics (unit-testable without a cluster): one of
     ``broadcast_left`` / ``broadcast_right`` / ``range`` / ``hash`` /
     ``gather``.  Both sides are already known to hold exactly one
-    partitioned leaf each (``_side_ok``); the keyed-aggregate fast path
-    was ruled out upstream.
+    partitioned leaf each (``_side_spec``); the keyed-aggregate fast
+    path was ruled out upstream.
 
     Broadcast wins first: when one side's GLOBAL volume fits under the
     threshold AND under the other side's per-process share (the ROADMAP
@@ -760,7 +901,27 @@ def choose_join_strategy(how: str, range_eligible: bool,
     |small| << |large| / n), gathering it costs one exchange of the
     small side instead of two exchanges of everything.  Then range
     (sorted-merge + skew splitting) when the key is orderable, then the
-    hash exchange, then the centralize-everything gather."""
+    hash exchange, then the centralize-everything gather.
+
+    Adaptive inputs override the probe: ``observed_left`` /
+    ``observed_right`` are ``(bytes, rows)`` measurements (the map
+    sides' bucketed output, or a recorded earlier stage) that REPLACE
+    the corresponding probe estimate when present.  When a side has no
+    direct measurement, a ``feedback`` object is consulted with that
+    side's plan ``left_sig``/``right_sig`` — cardinalities the adaptive
+    replanner recorded for the SAME subtree in an earlier stage of the
+    query.  All inputs are plain values, so the decision stays pure:
+    every process holds identical manifests/feedback and derives the
+    identical strategy."""
+    if feedback is not None:
+        if observed_left is None and left_sig is not None:
+            observed_left = feedback.lookup(left_sig)
+        if observed_right is None and right_sig is not None:
+            observed_right = feedback.lookup(right_sig)
+    if observed_left is not None:
+        left_bytes = int(observed_left[0])
+    if observed_right is not None:
+        right_bytes = int(observed_right[0])
     if broadcast_threshold > 0:
         share = max(n_procs, 1)
         cand = []
@@ -779,12 +940,296 @@ def choose_join_strategy(how: str, range_eligible: bool,
     return "gather"
 
 
+class StatsFeedback:
+    """Observed per-side output cardinalities recorded by the adaptive
+    replanner, keyed by a STRUCTURAL plan signature, consulted by
+    ``choose_join_strategy`` for LATER stages of the same session
+    (``session.statsFeedback`` exposes it for inspection).
+
+    Every entry comes out of a gathered stats round — the same manifests
+    on every process — so lookups feed the plan-time decision identical
+    values everywhere.  Feedback is an ESTIMATE source only, never a
+    correctness input: a signature collision or stale entry costs plan
+    quality, not results."""
+
+    __slots__ = ("_observed", "hits")
+
+    def __init__(self):
+        self._observed: Dict[str, Tuple[int, int, str]] = {}
+        self.hits = 0
+
+    @staticmethod
+    def signature(plan) -> str:
+        """Structural signature of a plan subtree: node type names,
+        expression reprs (structural, address-free — ``Col`` prints its
+        name, operators print over child reprs), and leaf identity
+        (schema fields / file paths).  Deterministic across processes by
+        construction."""
+        from ..sql import logical as L
+        parts: List[str] = []
+
+        def walk(node):
+            parts.append(type(node).__name__)
+            for attr in ("exprs", "condition", "keys", "on", "using",
+                         "how", "alias"):
+                v = getattr(node, attr, None)
+                if v is not None:
+                    parts.append(f"{attr}={v!r}"[:200])
+            if isinstance(node, L.Aggregate):
+                parts.append(",".join(n for _f, n in node.aggs))
+            if isinstance(node, L.LocalRelation):
+                b = node.batch
+                parts.append(",".join(
+                    f"{n}:{v.dtype}" for n, v in zip(b.names, b.vectors)))
+            if isinstance(node, L.FileRelation):
+                parts.append(repr(getattr(node, "path", ""))[:200])
+            for c in node.children:
+                walk(c)
+
+        walk(plan)
+        return "|".join(parts)
+
+    def record(self, sig: str, nbytes: int, rows: int,
+               xid: str = "") -> None:
+        self._observed[sig] = (int(nbytes), int(rows), xid)
+
+    def lookup(self, sig: str) -> Optional[Tuple[int, int]]:
+        """(bytes, rows) for ``sig``, counting the hit (the
+        ``stats_feedback_hits`` gauge reads consults that changed an
+        input); ``peek`` is the side-effect-free flavor."""
+        rec = self._observed.get(sig)
+        if rec is None:
+            return None
+        self.hits += 1
+        return rec[0], rec[1]
+
+    def peek(self, sig: str) -> Optional[Tuple[int, int]]:
+        rec = self._observed.get(sig)
+        return None if rec is None else (rec[0], rec[1])
+
+    def clear(self) -> None:
+        self._observed.clear()
+        self.hits = 0
+
+    def snapshot(self) -> Dict[str, Tuple[int, int, str]]:
+        return dict(self._observed)
+
+    def __len__(self) -> int:
+        return len(self._observed)
+
+
+def observed_side_stats(mans: Dict[int, dict], n_senders: int
+                        ) -> Optional[Tuple[int, int, int, int]]:
+    """Sum the per-side observed totals piggybacked on the stats-round
+    manifests: ``(left_bytes, left_rows, right_bytes, right_rows)``, or
+    None when the round is INCOMPLETE or malformed — any missing sender
+    (lost manifest), or any manifest without a well-formed ``sides``
+    payload (corrupt round, or a peer running an older protocol).  None
+    means: keep the frozen plan-time strategy.  Pure function of the
+    gathered manifests, so every process that read the same set derives
+    the same verdict."""
+    if len(mans) < n_senders:
+        return None
+    l_bytes = l_rows = r_bytes = r_rows = 0
+    for s in mans:
+        sides = mans[s].get("sides") if isinstance(mans[s], dict) else None
+        if not isinstance(sides, dict):
+            return None
+        try:
+            lb, lr = sides["l"]
+            rb, rr = sides["r"]
+            l_bytes += int(lb)
+            l_rows += int(lr)
+            r_bytes += int(rb)
+            r_rows += int(rr)
+        except (KeyError, TypeError, ValueError):
+            return None
+    return l_bytes, l_rows, r_bytes, r_rows
+
+
+def adaptive_join_decision(frozen: str, how: str, broadcast_threshold: int,
+                           n_procs: int,
+                           observed: Optional[Tuple[int, int, int, int]]
+                           ) -> str:
+    """Re-decide the join strategy at the stats barrier, PURELY from the
+    frozen plan-time choice and the observed per-side totals: the only
+    legal move is DEMOTING a co-partitioning lane (hash/range) to a
+    broadcast — by the time stats exist, both map sides are already
+    bucketed for that lane, so promoting (e.g. gather→hash) or switching
+    lanes (hash↔range) would re-bucket everything for no saved bytes.
+    Incomplete stats (None) keep the frozen strategy — the lost-round
+    fallback."""
+    if observed is None or frozen not in ("hash", "range"):
+        return frozen
+    l_bytes, _l_rows, r_bytes, _r_rows = observed
+    redecided = choose_join_strategy(
+        how, False, False, True, broadcast_threshold, n_procs,
+        int(l_bytes), int(r_bytes))
+    if redecided in ("broadcast_left", "broadcast_right"):
+        return redecided
+    return frozen
+
+
+class _AdaptiveCtx:
+    """Per-query adaptive replanning context threaded into the exchange
+    lanes: the plan-time broadcast threshold (the demotion bar), the
+    session's ``StatsFeedback`` plus both side signatures (observed
+    totals are recorded whether or not a demotion fires), the join's
+    equi-key pairs (for the runtime decision check), and whether the
+    analysis runtime checks are on."""
+
+    __slots__ = ("broadcast_threshold", "feedback", "left_sig",
+                 "right_sig", "key_pairs", "checks")
+
+    def __init__(self, broadcast_threshold, feedback, left_sig, right_sig,
+                 key_pairs, checks):
+        self.broadcast_threshold = broadcast_threshold
+        self.feedback = feedback
+        self.left_sig = left_sig
+        self.right_sig = right_sig
+        self.key_pairs = key_pairs
+        self.checks = checks
+
+
+def _adaptive_redecide(join, svc: HostShuffleService, xid: str,
+                       adaptive: Optional[_AdaptiveCtx], frozen: str,
+                       mans: Dict[int, dict]) -> str:
+    """The adaptive re-decision at a lane's stats barrier.  Every input
+    is either shared (the gathered manifests) or derived identically at
+    plan time (the context), so every process returns the same strategy;
+    an incomplete/corrupt round degrades to the frozen strategy on every
+    process that saw it incomplete, and a process that somehow read a
+    complete round while peers did not diverges into the exchange
+    barrier, which fails BOUNDED (deadline + structured error) — never a
+    hang, never a partial result."""
+    if adaptive is None:
+        return frozen
+    observed = observed_side_stats(mans, svc.n)
+    if observed is None:
+        return frozen
+    svc.counters["adaptive_replans"] += 1
+    if adaptive.feedback is not None:
+        if adaptive.left_sig:
+            adaptive.feedback.record(adaptive.left_sig, observed[0],
+                                     observed[1], xid)
+        if adaptive.right_sig:
+            adaptive.feedback.record(adaptive.right_sig, observed[2],
+                                     observed[3], xid)
+    decision = adaptive_join_decision(
+        frozen, join.how, adaptive.broadcast_threshold, svc.n, observed)
+    if adaptive.checks:
+        from ..analysis import runtime as _az
+        _az.verify_join_strategy(
+            join, decision, frozen == "range", adaptive.key_pairs,
+            frozen=frozen, observed=observed,
+            broadcast_threshold=adaptive.broadcast_threshold,
+            n_procs=svc.n)
+    if decision != frozen:
+        svc.counters["strategy_demotions"] += 1
+    return decision
+
+
+def _staged_local_rows(svc: HostShuffleService, exchange: str,
+                       side: _StagedSide) -> ColumnBatch:
+    """Rematerialize one side's LOCAL rows from its staged map output
+    (the demotion path runs after bucketing but before any block ships):
+    the live prefix of the in-RAM bucketed batch
+    (``partition_host_slices`` parks dead rows at the tail), or every
+    non-empty partition frame of the spill file."""
+    if side.kind == "mem":
+        n_live = int(np.asarray(side.cnt).sum())
+        if not n_live:
+            return _one_dead_row(side.dead)
+        return slice_rows(side.bucketed, 0, n_live)
+    parts = [(int(side.offsets[p]),
+              int(side.offsets[p + 1] - side.offsets[p]))
+             for p in range(len(side.offsets) - 1)
+             if side.offsets[p + 1] > side.offsets[p]]
+    if not parts:
+        return _one_dead_row(side.dead)
+    got = svc.decode_spilled(exchange, side.path, parts)
+    alive = [b for b in got if int(np.asarray(b.num_rows()))]
+    if not alive:
+        return _one_dead_row(side.dead)
+    return union_all(alive) if len(alive) > 1 else alive[0]
+
+
+def _demote_to_broadcast(svc: HostShuffleService, xid: str, decision: str,
+                         staged: List[_StagedSide],
+                         tags: Tuple[str, str]
+                         ) -> Tuple[ColumnBatch, ColumnBatch]:
+    """Execute a demotion: rematerialize both sides' local rows from the
+    staging area, drop both map reservations (nothing co-partitioned
+    ships), and gather ONLY the small side through a fresh exchange id
+    (exchange ids are single-use; ``{xid}-bcast`` is also the fault
+    injection address for kill-mid-demotion coverage).  The big side
+    never moves — that is the entire point of demoting."""
+    locals_ = [_staged_local_rows(svc, f"{xid}-{tag}", side)
+               for tag, side in zip(tags, staged)]
+    for tag in tags:
+        svc.ledger.release(f"shuffle:{xid}:{tag}-map")
+    small_i = 0 if decision == "broadcast_left" else 1
+    small = _gather_all(svc, f"{xid}-bcast", locals_[small_i],
+                        dedup=False)
+    if not int(np.asarray(small.num_rows())):
+        small = _one_dead_row(staged[small_i].dead)
+    out = [None, None]
+    out[small_i] = small
+    out[1 - small_i] = locals_[1 - small_i]
+    return out[0], out[1]
+
+
+def _finalize_partial_side(agg_node, partial_node, state: ColumnBatch
+                           ) -> ColumnBatch:
+    """Finish a pushed-down partial aggregate over one reducer's union
+    of shipped state rows.  The aggregate keys subsume the join keys, so
+    same-group rows shared the join-key hash and EVERY partial of each
+    group landed on this one reducer — the final here sees each group
+    whole, exactly as the unpushed plan would."""
+    from .dist import DFinalAggregate
+    final = compact(np, DFinalAggregate(
+        agg_node.keys, agg_node.aggs, partial_node,
+        P.PScan(0, state.schema)).run(P.ExecContext(np, [state])))
+    if not int(final.capacity):
+        final = _one_dead_row(final)
+    return final
+
+
+def _estimated_span_weights(pts, wts, cuts) -> np.ndarray:
+    """The sample round's ESTIMATE of each span's mass: bucket the
+    sample points by the agreed cuts (same ``side="right"`` rule as
+    ``range_bucket``) and sum their weights.  The replanner compares the
+    skew set of this estimate against the observed one to attribute each
+    split (``post_sample_skew_splits`` counts the splits only the
+    observed weights revealed)."""
+    n_spans = len(cuts) + 1
+    est = np.zeros(n_spans, np.float64)
+    if len(pts):
+        spans = np.searchsorted(np.asarray(cuts), np.asarray(pts),
+                                side="right")
+        np.add.at(est, spans, np.asarray(wts, np.float64))
+    return est
+
+
+def _session_feedback(session) -> StatsFeedback:
+    fb = getattr(session, "_stats_feedback", None)
+    if fb is None:
+        fb = StatsFeedback()
+        session._stats_feedback = fb
+    return fb
+
+
 def _range_merge_join_shards(session, join, spec,
-                             svc: HostShuffleService, xid: str
-                             ) -> Tuple[ColumnBatch, ColumnBatch]:
+                             svc: HostShuffleService, xid: str,
+                             adaptive=None
+                             ) -> Tuple[ColumnBatch, ColumnBatch,
+                                        Optional[str]]:
     """Co-partition BOTH join sides by key RANGE and deliver this
-    process's spans with the build side already globally sorted (the
-    SortMergeJoinExec + RangePartitioner protocol, DCN-shaped):
+    process's spans with the build side already globally sorted, or —
+    when the stats barrier demotes — the local big side plus the
+    broadcast small side and the demotion verdict (third element; None
+    means the range exchange ran).  (The SortMergeJoinExec +
+    RangePartitioner protocol, DCN-shaped):
 
     1. each side runs locally; join keys get the monotonic
        process-independent int64 encoding (``range_encode_key`` — the
@@ -879,8 +1324,10 @@ def _range_merge_join_shards(session, join, spec,
         cut_idx = np.clip(np.searchsorted(cum, qs, side="left"),
                           0, len(pts) - 1)
         cuts = np.unique(pts[cut_idx])
+        est_span_w = _estimated_span_weights(pts, wts, cuts)
     else:
         cuts = np.zeros(0, pt_dtype)
+        est_span_w = None
     svc.last_range_cutpoints = [str(c) for c in cuts] if is_str \
         else [int(c) for c in cuts]
     n_spans = len(cuts) + 1
@@ -902,6 +1349,7 @@ def _range_merge_join_shards(session, join, spec,
     try:
         staged_sides: List[_StagedSide] = []
         sizes: Dict[int, int] = {}
+        side_obs: Dict[str, List[int]] = {}
         for (base, tag), (local, enc, ok, kdict) in zip(
                 ((0, "rL"), (n_spans, "rR")), sides):
             local_cuts = np.searchsorted(
@@ -914,17 +1362,40 @@ def _range_merge_join_shards(session, join, spec,
             raw = np.zeros(n_spans, np.int64)
             for p in range(n_spans):
                 if int(cnt[p]):
-                    raw[p] = wire.raw_nbytes(
+                    # payload, not raw: a span of fat strings must weigh
+                    # its dictionary words or byte skew stays invisible
+                    raw[p] = wire.payload_nbytes(
                         [slice_rows(bucketed, int(off[p]), int(cnt[p]))])
                     sizes[base + p] = sizes.get(base + p, 0) + int(raw[p])
+            side_obs["l" if base == 0 else "r"] = [
+                int(raw.sum()), int(np.asarray(cnt, np.int64).sum())]
             staged_sides.append(_stage_map_side(
                 svc, f"{xid}-{tag}", f"shuffle:{xid}:{tag}-map",
                 bucketed, off, cnt, raw, sdir))
             del bucketed
-        svc.publish_sizes(f"{xid}-plan", sizes)
-        totals = svc.gather_sizes(f"{xid}-plan", 2 * n_spans)
+        # the size round doubles as the adaptive stats round: per-side
+        # observed totals ride the same manifests, and the re-decision
+        # runs before any data block ships
+        svc.publish_sizes(f"{xid}-plan", sizes,
+                          extra={"sides": side_obs})
+        totals, mans = svc.gather_sizes_ex(f"{xid}-plan", 2 * n_spans)
+        decision = _adaptive_redecide(join, svc, xid, adaptive, "range",
+                                      mans)
+        if decision != "range":
+            left, right = _demote_to_broadcast(
+                svc, xid, decision, staged_sides, ("rL", "rR"))
+            return left, right, decision
         owners = svc.plan_range_reducers(totals[:n_spans],
                                          totals[n_spans:], target)
+        if est_span_w is not None:
+            # post-sample skew accounting: the observed-weight reducer
+            # plan above IS the second pass the sample round couldn't
+            # make — count the splits the sample's estimated weights
+            # would NOT have flagged under the same skew rule
+            est_split = svc.skew_spans(est_span_w.astype(np.int64))
+            svc.counters["post_sample_skew_splits"] += sum(
+                1 for p in range(n_spans)
+                if len(owners[p]) > 1 and p not in est_split)
         if checks:
             _az.verify_span_owners(join, owners, n_spans, svc.n)
             _az.verify_skew_split(join, owners)
@@ -1057,7 +1528,7 @@ def _range_merge_join_shards(session, join, spec,
                                        r_as_float)
             _az.verify_unified_dictionaries(join, (probe_shard,
                                                    build_shard))
-        return probe_shard, build_shard
+        return probe_shard, build_shard, None
     finally:
         shutil.rmtree(sdir, ignore_errors=True)
 
@@ -1153,40 +1624,136 @@ def _crossproc_execute(session, optimized, svc: HostShuffleService,
 
     # shuffled-join precondition: EACH side holds exactly one
     # partitioned leaf and is itself partition-safe to run locally —
-    # the shape that previously forced the centralize-everything path
-    def _side_ok(side, base: int) -> bool:
-        n = _n_leaves(side)
-        return (sum(flags[base: base + n]) == 1
-                and not _has_global_ops(side)
-                and _joins_partition_safe(side, flags, base))
+    # the shape that previously forced the centralize-everything path.
+    # Two qualifying side shapes: "plain" (per-row subtree), or "agg" —
+    # a keyed Aggregate (under aliases) whose keys SUBSUME the join keys
+    # (every join expr a bare Col naming an aggregate key), which ships
+    # pre-aggregated partial state through the hash exchange instead of
+    # raw rows (partial aggregate pushdown below the join exchange).
+    def _side_spec(side, base: int, join_exprs):
+        from ..expressions import Alias
 
-    sides_ok = (not fast and join is not None and flags is not None
-                and _side_ok(join.children[0], 0)
-                and _side_ok(join.children[1],
-                             _n_leaves(join.children[0])))
+        n = _n_leaves(side)
+        if sum(flags[base: base + n]) != 1:
+            return None
+
+        def base_col(e):
+            # strip (possibly nested) aliases down to a bare column
+            while isinstance(e, Alias):
+                e = e.children[0]
+            return e if isinstance(e, Col) else None
+
+        core = side
+        projs = []            # pass-through Projects, outermost first
+        while True:
+            if isinstance(core, L.SubqueryAlias):
+                core = core.children[0]
+            elif isinstance(core, L.Project) and all(
+                    base_col(e) is not None for e in core.exprs):
+                # derived tables optimize to Project(Aggregate) where
+                # the Project only renames/reorders aggregate output —
+                # transparent to the pushdown once names are mapped
+                projs.append(core)
+                core = core.children[0]
+            else:
+                break
+        if isinstance(core, L.Aggregate):
+            if not core.keys:
+                return None
+
+            def inner_name(nm):
+                # outer column name → the core's output name, through
+                # every pass-through projection on the way down
+                for p in projs:
+                    nxt = next((base_col(e).name for e in p.exprs
+                                if e.name == nm), None)
+                    if nxt is None:
+                        return None
+                    nm = nxt
+                return nm
+
+            key_names = {k.name for k in core.keys}
+            key_map = {}
+            for e in join_exprs:
+                if not isinstance(e, Col):
+                    return None
+                nm = inner_name(e.name)
+                if nm not in key_names:
+                    return None
+                key_map[e.name] = nm
+            if _has_global_ops(core.children[0]) \
+                    or not _joins_partition_safe(core.children[0],
+                                                 flags, base):
+                return None
+            return ("agg", _AggSideSpec(core, tuple(projs), key_map))
+        if _has_global_ops(side) \
+                or not _joins_partition_safe(side, flags, base):
+            return None
+        return ("plain", None)
+
+    l_side_spec = r_side_spec = None
+    if not fast and join is not None and flags is not None:
+        l_side_spec = _side_spec(join.children[0], 0,
+                                 [l for l, _ in key_pairs])
+        r_side_spec = _side_spec(join.children[1],
+                                 _n_leaves(join.children[0]),
+                                 [r for _, r in key_pairs])
+    sides_ok = l_side_spec is not None and r_side_spec is not None
+    has_agg_side = sides_ok and (l_side_spec[0] == "agg"
+                                 or r_side_spec[0] == "agg")
 
     # strategy decision off the digest-probe statistics (pure function
     # of them — unit-tested directly).  Leaf bytes over-approximate each
     # side's output (filters/projects run after), the conservative
-    # direction for the broadcast threshold.
+    # direction for the broadcast threshold.  With adaptive replanning
+    # on, recorded StatsFeedback cardinalities override the probe for
+    # subtrees an earlier stage already measured, and the chosen
+    # hash/range lane carries an _AdaptiveCtx so the stats barrier can
+    # re-decide from observed volumes.
     strategy: Optional[str] = None
     range_spec = None
+    adaptive_on = False
+    feedback = None
+    l_sig = r_sig = None
+    actx = None
     if sides_ok:
         from ..sql.joins import range_key_spec
-        range_spec = range_key_spec(join, join.children[0].schema(),
-                                    join.children[1].schema())
+        if not has_agg_side:
+            range_spec = range_key_spec(join, join.children[0].schema(),
+                                        join.children[1].schema())
         ln = _n_leaves(join.children[0])
         rn = _n_leaves(join.children[1])
+        # an agg side pins the lane to hash: broadcasting the OTHER side
+        # would leave the agg side's partials split across processes,
+        # and the range lane would finish the aggregate per span slice —
+        # both wrong.  Zeroing the threshold for the decision (and
+        # skipping the adaptive ctx) keeps every broadcast door shut.
+        eff_threshold = 0 if has_agg_side else bcast_threshold
+        adaptive_on = (session.conf.get(C.CROSSPROC_ADAPTIVE_REPLAN)
+                       and eff_threshold > 0)
+        hits0 = 0
+        if adaptive_on:
+            feedback = _session_feedback(session)
+            l_sig = StatsFeedback.signature(join.children[0])
+            r_sig = StatsFeedback.signature(join.children[1])
+            hits0 = feedback.hits
         strategy = choose_join_strategy(
             join.how, range_spec is not None, smj_on, shuffled_on,
-            bcast_threshold, svc.n,
-            sum(leaf_sizes[:ln]), sum(leaf_sizes[ln:ln + rn]))
+            eff_threshold, svc.n,
+            sum(leaf_sizes[:ln]), sum(leaf_sizes[ln:ln + rn]),
+            feedback=feedback, left_sig=l_sig, right_sig=r_sig)
+        if adaptive_on:
+            svc.counters["stats_feedback_hits"] += feedback.hits - hits0
         from ..analysis import runtime as _az
-        if _az.runtime_checks_enabled(session):
+        checks = _az.runtime_checks_enabled(session)
+        if checks:
             _az.verify_join_strategy(join, strategy,
                                      range_spec is not None, key_pairs)
         if strategy == "gather":
             strategy = None
+        if adaptive_on and strategy in ("hash", "range"):
+            actx = _AdaptiveCtx(bcast_threshold, feedback, l_sig, r_sig,
+                                key_pairs, checks)
 
     if fast:
         svc.counters["fast_path_aggs"] += 1
@@ -1204,26 +1771,49 @@ def _crossproc_execute(session, optimized, svc: HostShuffleService,
             svc.counters["broadcast_joins"] += 1
             side_i = 0 if strategy == "broadcast_left" else 1
             side = join.children[side_i]
-            base = 0 if side_i == 0 else _n_leaves(join.children[0])
-            nl = _n_leaves(side)
-            side2 = _gather_leaf_relations(
-                session, side, svc, xid, dedup=True,
-                preloaded=leaf_cache[base: base + nl] or None)
-            join2 = _replace_node(join, side, side2)
+            sig = (l_sig, r_sig)[side_i]
+            if adaptive_on and sig is not None \
+                    and feedback.peek(sig) is not None:
+                # the decision came from a RECORDED output cardinality
+                # (an earlier stage measured this subtree's bucketed
+                # output): gather the side's executed OUTPUT — the
+                # quantity that was measured — not its raw leaves, whose
+                # bytes a selective filter may dwarf
+                side_out = compact(np,
+                                   _run_local(session, side).to_host())
+                full_small = _gather_all(svc, f"{xid}-bcast", side_out,
+                                         dedup=False)
+                if not int(np.asarray(full_small.num_rows())):
+                    full_small = _one_dead_row(side_out)
+                join2 = _replace_node(join, side,
+                                      L.LocalRelation(full_small))
+            else:
+                base = 0 if side_i == 0 else _n_leaves(join.children[0])
+                nl = _n_leaves(side)
+                side2 = _gather_leaf_relations(
+                    session, side, svc, xid, dedup=True,
+                    preloaded=leaf_cache[base: base + nl] or None)
+                join2 = _replace_node(join, side, side2)
         elif strategy == "range":
-            svc.counters["range_merge_joins"] += 1
-            left_shard, right_shard = _range_merge_join_shards(
-                session, join, range_spec, svc, xid)
+            left_shard, right_shard, demoted = _range_merge_join_shards(
+                session, join, range_spec, svc, xid, adaptive=actx)
             join2 = L.Join(L.LocalRelation(left_shard),
                            L.LocalRelation(right_shard),
                            join.how, join.on, join.using)
-            # build arrives globally (flag, key)-sorted from the k-way
-            # merge → the planner picks PMergeJoin (no build re-sort)
-            join2._presorted_build = True
+            if demoted is None:
+                svc.counters["range_merge_joins"] += 1
+                # build arrives globally (flag, key)-sorted from the
+                # k-way merge → the planner picks PMergeJoin (no build
+                # re-sort); a demoted join has no presorted build
+                join2._presorted_build = True
+            else:
+                svc.counters["broadcast_joins"] += 1
         else:
-            svc.counters["shuffled_joins"] += 1
-            left_shard, right_shard = _shuffled_join_shards(
-                session, join, key_pairs, svc, xid)
+            left_shard, right_shard, demoted = _shuffled_join_shards(
+                session, join, key_pairs, svc, xid, adaptive=actx,
+                side_aggs=(l_side_spec[1], r_side_spec[1]))
+            svc.counters["shuffled_joins" if demoted is None
+                         else "broadcast_joins"] += 1
             join2 = L.Join(L.LocalRelation(left_shard),
                            L.LocalRelation(right_shard),
                            join.how, join.on, join.using)

@@ -430,6 +430,14 @@ class HostShuffleService:
             # execution-shape counters bumped by crossproc_execute
             "shuffled_joins": 0, "fast_path_aggs": 0,
             "range_merge_joins": 0, "broadcast_joins": 0,
+            # adaptive re-planning from observed exchange statistics:
+            # completed stats rounds that re-ran the strategy decision,
+            # hash/range plans demoted to broadcast, skewed spans whose
+            # split only the observed weights (not the sample round's
+            # estimates) revealed, and plan-time strategy decisions that
+            # consulted recorded StatsFeedback cardinalities
+            "adaptive_replans": 0, "strategy_demotions": 0,
+            "post_sample_skew_splits": 0, "stats_feedback_hits": 0,
             # encoded execution: dictionary columns framed as codes with
             # the word list deduplicated into a once-per-sender sidecar,
             # and receiver-side remaps into the unified code space
@@ -859,16 +867,37 @@ class HostShuffleService:
             pending = still
         return out, nbytes
 
-    def publish_sizes(self, exchange: str, sizes: Dict[int, int]) -> None:
+    def publish_sizes(self, exchange: str, sizes: Dict[int, int],
+                      extra: Optional[dict] = None) -> None:
         """Manifest-ONLY commit: publish this sender's per-fine-partition
         byte counts with no data blocks (the MapOutputStatistics half of
         the ExchangeCoordinator protocol).  The map output itself stays
         in host memory until ``plan_reducers`` fixes the assignment, so
         rows destined for this process never touch the filesystem —
         unlike the reference, whose executors must spill map output to
-        local disk before statistics exist."""
-        self.publish_manifest(exchange, {
-            "partitions": {str(p): int(sz) for p, sz in sizes.items()}})
+        local disk before statistics exist.  ``extra`` merges additional
+        JSON payload keys into the same marker (the adaptive replanner's
+        observed per-side totals ride the round for free — size readers
+        only consume ``partitions``)."""
+        payload = {
+            "partitions": {str(p): int(sz) for p, sz in sizes.items()}}
+        if extra:
+            payload.update(extra)
+        self.publish_manifest(exchange, payload)
+
+    def gather_sizes_ex(self, exchange: str, n_partitions: int
+                        ) -> Tuple[np.ndarray, Dict[int, dict]]:
+        """``gather_sizes`` plus the raw manifest set it summed, so the
+        adaptive replanner can read piggybacked payload keys (observed
+        per-side totals) out of the SAME coordination round without a
+        second barrier."""
+        mans, _nbytes = self.gather_manifests(exchange)
+        totals = np.zeros(n_partitions, np.int64)
+        for man in mans.values():
+            for p, sz in man.get("partitions", {}).items():
+                if 0 <= int(p) < n_partitions:
+                    totals[int(p)] += int(sz)
+        return totals, mans
 
     def gather_sizes(self, exchange: str, n_partitions: int) -> np.ndarray:
         """Barrier on the size manifests, then sum every sender's
@@ -878,13 +907,7 @@ class HostShuffleService:
         of on a driver.  Excluded (blacklisted-dead) senders simply
         contribute nothing; their data loss surfaces later on the data
         exchange with the usual structured failure."""
-        mans, _nbytes = self.gather_manifests(exchange)
-        totals = np.zeros(n_partitions, np.int64)
-        for man in mans.values():
-            for p, sz in man.get("partitions", {}).items():
-                if 0 <= int(p) < n_partitions:
-                    totals[int(p)] += int(sz)
-        return totals
+        return self.gather_sizes_ex(exchange, n_partitions)[0]
 
     def plan_reducers(self, sizes: np.ndarray,
                       target_bytes: int) -> List[int]:
@@ -929,6 +952,19 @@ class HostShuffleService:
             self.last_partition_bytes = group_bytes
         return bounds
 
+    def skew_spans(self, totals: np.ndarray) -> set:
+        """The spans of ``totals`` flagged skewed by the shared rule
+        (weight above ``SKEW_FACTOR × median`` of the positive weights).
+        Factored out of ``plan_range_reducers`` so the adaptive replanner
+        can evaluate the SAME rule against the sample round's estimated
+        weights and attribute each split to the estimate or to the
+        observed sizes (``post_sample_skew_splits``)."""
+        totals = np.asarray(totals, np.int64)
+        pos = totals[totals > 0]
+        med = float(np.median(pos)) if len(pos) else 0.0
+        return {s for s in range(len(totals))
+                if med > 0 and totals[s] > self.SKEW_FACTOR * med}
+
     def plan_range_reducers(self, probe_sizes: np.ndarray,
                             build_sizes: np.ndarray,
                             target_bytes: int) -> List[List[int]]:
@@ -955,8 +991,7 @@ class HostShuffleService:
         med = float(np.median(pos)) if len(pos) else 0.0
         split_target = float(target_bytes) if target_bytes > 0 \
             else max(med, 1.0)
-        split_set = {s for s in range(n_spans)
-                     if med > 0 and totals[s] > self.SKEW_FACTOR * med}
+        split_set = self.skew_spans(totals)
 
         # span-order work list: contiguous coalesced runs + split spans
         work: List[Tuple[str, List[int]]] = []

@@ -105,6 +105,8 @@ PLANNING_CONF_ENTRIES = (
     # processes; dedupReplicated changes the gather plan
     C.SHUFFLE_FINE_PARTITIONS, C.SHUFFLE_TARGET_PARTITION_BYTES,
     C.SHUFFLE_RANGE_SAMPLE_SIZE, C.CROSSPROC_DEDUP_REPLICATED,
+    # adaptive replanning changes which exchange lane a join takes
+    C.CROSSPROC_ADAPTIVE_REPLAN,
 )
 
 PLANNING_CONF_KEYS = frozenset(e.key for e in PLANNING_CONF_ENTRIES)

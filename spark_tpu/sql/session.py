@@ -854,3 +854,14 @@ class SparkSession:
 
     def disableHostShuffle(self) -> None:
         self._crossproc_svc = None
+
+    @property
+    def statsFeedback(self):
+        """The session's adaptive-execution ``StatsFeedback``: observed
+        per-side cardinalities the cross-process replanner recorded at
+        exchange stats barriers, consulted by later plan-time join
+        decisions and exposed here for inspection (``snapshot()``,
+        ``hits``, ``clear()``).  Lazily created so sessions that never
+        touch the adaptive path pay nothing."""
+        from ..parallel.crossproc import _session_feedback
+        return _session_feedback(self)

@@ -66,7 +66,7 @@ __all__ = [
     "TruncatedBlockError", "DictFingerprintError", "encode_batches",
     "decode_batches", "decode_frames", "dict_fingerprint",
     "encode_dict_table", "decode_dict_table", "frame_info",
-    "frame_length", "raw_nbytes", "trim_host",
+    "frame_length", "raw_nbytes", "payload_nbytes", "trim_host",
 ]
 
 MAGIC = b"STCB"
@@ -259,6 +259,26 @@ def raw_nbytes(batches: Sequence[ColumnBatch]) -> int:
                 total += (b.capacity + 7) // 8
         if b.row_valid is not None:
             total += (b.capacity + 7) // 8
+    return total
+
+
+def payload_nbytes(batches: Sequence[ColumnBatch]) -> int:
+    """Wire-payload size of ``batches``: the raw array bytes plus the
+    dictionary words their codes reference.  A dict-encoded block ships
+    its word subset alongside the codes, so ``raw_nbytes`` (codes only)
+    makes a span of fat strings look as cheap as a span of short ones —
+    exactly the byte skew the exchange's observed-size round exists to
+    catch.  Used for exchange SIZING; metrics keep ``raw_nbytes``."""
+    total = raw_nbytes(batches)
+    for b in batches:
+        for v in b.vectors:
+            words = v.dictionary
+            if not words:
+                continue
+            codes = np.asarray(v.data).ravel()
+            codes = codes[(codes >= 0) & (codes < len(words))]
+            for c in np.unique(codes):
+                total += len(words[int(c)])
     return total
 
 

@@ -153,6 +153,128 @@ def test_choose_fallback_ladder():
                    **big) == "gather"
 
 
+# ---------------------------------------------------------------------------
+# adaptive re-decision: observed stats override the probe, feedback
+# fills unmeasured sides, and the barrier decision only ever demotes
+# ---------------------------------------------------------------------------
+
+def test_choose_observed_overrides_probe():
+    # probe says right is tiny, observation says it is huge → no bcast
+    assert _choose(observed_right=(1 << 30, 1000)) == "range"
+    # probe says both huge, observation says right tiny → broadcast
+    assert _choose(left_bytes=1 << 30, right_bytes=1 << 30,
+                   observed_right=(1 << 10, 7)) == "broadcast_right"
+
+
+def test_choose_feedback_fills_unmeasured_side():
+    from spark_tpu.parallel.crossproc import StatsFeedback
+    fb = StatsFeedback()
+    fb.record("sigR", 1 << 10, 7, "xq000001")
+    assert _choose(left_bytes=1 << 30, right_bytes=1 << 30,
+                   feedback=fb, right_sig="sigR") == "broadcast_right"
+    assert fb.hits == 1
+    # a direct observation beats the recorded feedback
+    assert _choose(left_bytes=1 << 30, right_bytes=1 << 30,
+                   feedback=fb, right_sig="sigR",
+                   observed_right=(1 << 30, 1000)) == "range"
+    assert fb.hits == 1          # observed side is not consulted
+    # unknown signature: probe value stands, no hit
+    assert _choose(feedback=fb, right_sig="nope",
+                   right_bytes=1 << 30) == "range"
+    assert fb.hits == 1 and fb.peek("sigR") == (1 << 10, 7)
+    fb.clear()
+    assert len(fb) == 0 and fb.hits == 0
+
+
+def test_adaptive_join_decision_demotes_only_to_broadcast():
+    from spark_tpu.parallel.crossproc import adaptive_join_decision
+    # small observed right under a hash plan → demote
+    assert adaptive_join_decision(
+        "hash", "inner", 1 << 20, 2,
+        (1 << 30, 1000, 1 << 10, 7)) == "broadcast_right"
+    assert adaptive_join_decision(
+        "range", "inner", 1 << 20, 2,
+        (1 << 10, 7, 1 << 30, 1000)) == "broadcast_left"
+    # observed contradicts nothing → frozen stays
+    assert adaptive_join_decision(
+        "hash", "inner", 1 << 20, 2,
+        (1 << 30, 1000, 1 << 30, 1000)) == "hash"
+    # lost/corrupt stats round → frozen, always
+    assert adaptive_join_decision("hash", "inner", 1 << 20, 2,
+                                  None) == "hash"
+    # join type forbids broadcasting the small (left) side → frozen
+    assert adaptive_join_decision(
+        "hash", "left", 1 << 20, 2,
+        (1 << 10, 7, 1 << 30, 1000)) == "hash"
+    # non-demotable frozen strategies never move
+    for frozen in ("broadcast_right", "gather"):
+        assert adaptive_join_decision(
+            frozen, "inner", 1 << 20, 2,
+            (1 << 30, 1000, 1 << 10, 7)) == frozen
+
+
+def test_observed_side_stats_requires_complete_round():
+    from spark_tpu.parallel.crossproc import observed_side_stats
+    good = {"sides": {"l": [100, 10], "r": [6, 2]}}
+    assert observed_side_stats({0: good, 1: good}, 2) \
+        == (200, 20, 12, 4)
+    # missing sender → None (lost manifest: frozen fallback)
+    assert observed_side_stats({0: good}, 2) is None
+    # malformed payloads → None, never a crash
+    for bad in ({}, {"sides": "x"}, {"sides": {"l": [1, 2]}},
+                {"sides": {"l": [1], "r": [2, 3]}},
+                {"sides": {"l": [1, "x"], "r": [2, 3]}}):
+        assert observed_side_stats({0: good, 1: bad}, 2) is None
+
+
+def test_stats_feedback_signature_is_structural():
+    from spark_tpu.parallel.crossproc import StatsFeedback
+    import spark_tpu.sql.logical as L
+    from spark_tpu.columnar import ColumnBatch
+    import spark_tpu.types as T
+    batch = ColumnBatch.from_arrays(
+        {"k": np.arange(4, dtype=np.int64)},
+        schema=T.StructType([T.StructField("k", T.int64)]))
+    a = L.Filter(F.col("k") > F.lit(1), L.LocalRelation(batch))
+    b = L.Filter(F.col("k") > F.lit(1), L.LocalRelation(batch))
+    c = L.Filter(F.col("k") > F.lit(2), L.LocalRelation(batch))
+    sig = StatsFeedback.signature
+    assert sig(a) == sig(b)          # same structure, fresh objects
+    assert sig(a) != sig(c)          # different literal → different sig
+
+
+def test_verify_join_strategy_adaptive_checks():
+    from spark_tpu.analysis.runtime import verify_join_strategy
+    from spark_tpu.analysis.errors import PlanInvariantError
+    import spark_tpu.sql.logical as L
+    from spark_tpu.columnar import ColumnBatch
+    import spark_tpu.types as T
+
+    def leaf(name):
+        return L.LocalRelation(ColumnBatch.from_arrays(
+            {name: np.arange(2, dtype=np.int64)},
+            schema=T.StructType([T.StructField(name, T.int64)])))
+
+    join = L.Join(leaf("a"), leaf("b"), "inner",
+                  F.col("a") == F.col("b"), None)
+    kp = [(F.col("a"), F.col("b"))]
+    observed = (1 << 30, 1000, 1 << 10, 7)
+    # agreeing demotion passes
+    verify_join_strategy(join, "broadcast_right", False, kp,
+                         frozen="hash", observed=observed,
+                         broadcast_threshold=1 << 20, n_procs=2)
+    # a decision the recomputation does not reproduce = divergence
+    with pytest.raises(PlanInvariantError,
+                       match="adaptive-decision-agreement"):
+        verify_join_strategy(join, "hash", False, kp,
+                             frozen="hash", observed=observed,
+                             broadcast_threshold=1 << 20, n_procs=2)
+    # frozen fallback (no stats) must keep the frozen strategy
+    verify_join_strategy(join, "hash", False, kp, frozen="hash",
+                         observed=None, broadcast_threshold=1 << 20,
+                         n_procs=2)
+
+
 def test_broadcast_flag_safe_single_process(xs):
     """n=1 degenerate: every leaf is 'replicated', the strategy search
     never engages, and the threshold default changes no result."""

@@ -658,3 +658,101 @@ def test_spill_disk_full_fails_bounded(tmp_path):
     # p1 fails immediately at the spill; p0 holds only to its exchange
     # deadline (+ refetch), plus jit/startup slack
     assert elapsed < 3 * 8.0 + 30, elapsed
+
+# ---------------------------------------------------------------------------
+# the ADAPTIVE stats round under faults: the observed-size manifests that
+# drive the re-decision ride the size round, so a lost or corrupt stats
+# payload must degrade to the FROZEN plan-time strategy with full parity
+# (never a hang, never a partial result), a transient loss must heal and
+# still demote, and a peer dying mid-demotion must fail bounded
+# ---------------------------------------------------------------------------
+
+def _spawn_adaptive_fault_worker(pid, root, plan, timeout_s):
+    """One process of the 2-process adaptive fault scenario: the worker
+    runs ONE misestimated join whose frozen plan is a hash shuffle and
+    whose observed stats demote it to broadcast.  First query →
+    exchanges ``xq000001-plan`` (the size/stats round) and
+    ``xq000001-bcast`` (the demotion gather), so rules can target the
+    stats payload or the demotion itself."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "adaptive_worker.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(FAULT_PLAN_ENV, None)
+    if plan is not None:
+        env[FAULT_PLAN_ENV] = plan.to_env()
+    return subprocess.Popen(
+        [sys.executable, worker, str(pid), "2", root, "fault-adapt",
+         str(timeout_s)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+
+
+def test_adaptive_stats_corrupted_falls_back_to_frozen(tmp_path):
+    """p1's size/stats manifest gets a byte flipped with no heal: the
+    lenient gather skips it on EVERY process, so the observed per-side
+    stats are incomplete and BOTH processes keep the frozen hash plan —
+    the query completes through the full shuffle with oracle parity and
+    ZERO demotions.  A lost stats round costs the optimization, never
+    the answer."""
+    plan = FaultPlan().corrupt(exchange="xq000001-plan")
+    root = str(tmp_path / "shuf")
+    p0 = _spawn_adaptive_fault_worker(0, root, None, 15.0)
+    p1 = _spawn_adaptive_fault_worker(1, root, plan, 15.0)
+    out0 = p0.communicate(timeout=120)[0]
+    out1 = p1.communicate(timeout=120)[0]
+    assert p0.returncode == 0, out0
+    assert p1.returncode == 0, out1
+    for pid, out in ((0, out0), (1, out1)):
+        line = [ln for ln in out.splitlines() if f"[p{pid}] OK" in ln][-1]
+        assert "demotions=0" in line, out
+        assert "replans=0" in line, out       # stats incomplete → no replan
+        assert "shuffled=1" in line and "bcast=0" in line, out
+    assert "PARTIAL" not in out0 + out1
+    assert "FAILED" not in out0 + out1
+
+
+def test_adaptive_stats_dropped_then_heals_still_demotes(tmp_path):
+    """p1's stats manifest vanishes right after the publish and
+    reappears 2s later — inside the size-round barrier window.  The
+    round completes with FULL stats, so the demotion still fires on both
+    processes: broadcast join, oracle parity, one demotion each."""
+    plan = FaultPlan().drop(exchange="xq000001-plan", heal_after_s=2.0)
+    root = str(tmp_path / "shuf")
+    p0 = _spawn_adaptive_fault_worker(0, root, None, 20.0)
+    p1 = _spawn_adaptive_fault_worker(1, root, plan, 20.0)
+    out0 = p0.communicate(timeout=120)[0]
+    out1 = p1.communicate(timeout=120)[0]
+    assert p0.returncode == 0, out0
+    assert p1.returncode == 0, out1
+    for pid, out in ((0, out0), (1, out1)):
+        line = [ln for ln in out.splitlines() if f"[p{pid}] OK" in ln][-1]
+        assert "demotions=1" in line and "replans=1" in line, out
+        assert "bcast=1" in line and "shuffled=0" in line, out
+    assert "PARTIAL" not in out0 + out1
+    assert "FAILED" not in out0 + out1
+
+
+def test_peer_killed_mid_demotion_fails_bounded(tmp_path):
+    """p1 dies (os._exit) right after putting its share into the
+    demotion's broadcast gather, before committing: p0 observes the same
+    stats, takes the same demotion, and then times out STRUCTURED at the
+    ``xq000001-bcast`` barrier — bounded by the exchange deadline, and
+    neither process ever emits a partial result."""
+    plan = FaultPlan().die_after_put(exchange="xq000001-bcast")
+    root = str(tmp_path / "shuf")
+    t0 = time.monotonic()
+    p0 = _spawn_adaptive_fault_worker(0, root, None, 6.0)
+    p1 = _spawn_adaptive_fault_worker(1, root, plan, 6.0)
+    out0 = p0.communicate(timeout=120)[0]
+    out1 = p1.communicate(timeout=120)[0]
+    elapsed = time.monotonic() - t0
+    assert p1.returncode == 43, out1               # died where planned
+    assert "dying after put in 'xq000001-bcast'" in out1, out1
+    assert p0.returncode == 0, out0
+    line0 = [ln for ln in out0.splitlines() if "[p0]" in ln][-1]
+    assert "FAILED" in line0, out0
+    assert "OK" not in out0, out0
+    assert "PARTIAL" not in out0 + out1
+    # p0 holds to its exchange deadline (+ refetch re-barrier), plus
+    # jit/startup slack — bounded, far from a hang
+    assert elapsed < 3 * 6.0 + 30, elapsed

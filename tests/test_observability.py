@@ -179,6 +179,36 @@ def test_shuffle_range_gauges_exported(spark, tmp_path):
         ms._sources = [s for s in ms._sources if s.name != "shuffle"]
 
 
+def test_adaptive_replan_gauges_exported(spark, tmp_path):
+    """The adaptive execution plane is observable: stats-barrier
+    re-decisions, strategy demotions, skew splits only the observed
+    sizes revealed, and feedback-driven plan-time decisions all surface
+    as gauges on the shuffle metrics source (zero until the counters
+    move, so dashboards can alert on first divergence from the frozen
+    plan)."""
+    prev = getattr(spark, "_crossproc_svc", None)
+    ms = spark.metricsSystem
+    try:
+        svc = spark.enableHostShuffle(str(tmp_path), process_id=0,
+                                      n_processes=1, timeout_s=5.0)
+        snap0 = ms.snapshots()["shuffle"]
+        for g in ("adaptive_replans", "strategy_demotions",
+                  "post_sample_skew_splits", "stats_feedback_hits"):
+            assert snap0[g] == 0, (g, snap0)
+        svc.counters["adaptive_replans"] += 2
+        svc.counters["strategy_demotions"] += 1
+        svc.counters["post_sample_skew_splits"] += 3
+        svc.counters["stats_feedback_hits"] += 4
+        snap = ms.snapshots()["shuffle"]
+        assert snap["adaptive_replans"] == 2
+        assert snap["strategy_demotions"] == 1
+        assert snap["post_sample_skew_splits"] == 3
+        assert snap["stats_feedback_hits"] == 4
+    finally:
+        spark._crossproc_svc = prev
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]
+
+
 def test_shuffle_dict_gauges_exported(spark, tmp_path):
     """Encoded execution is observable: dictionary columns framed as
     codes, sidecar bytes saved by the dedup, receiver-side code remaps,

@@ -126,6 +126,39 @@ def test_plan_cache_invalidation_oracle_exact(serve_root):
         s1.sql(q).collect()
 
 
+def test_dataframe_write_invalidates_plan_cache(serve_root, tmp_path):
+    """Regression: DataFrame-API writes (``df.write...save``) mutate the
+    same paths the SQL commands do, but only the SQL commands called the
+    cache's path-invalidation hook — a cached plan reading the written
+    path replayed STALE rows after an API overwrite.  The writer now
+    routes through ``_invalidate_plan_cache``: the entry is evicted and
+    the next run matches a fresh-session oracle."""
+    cache = PlanCache(serve_root.conf_obj)
+    s = serve_root.newSession()
+    s._plan_cache = cache
+    path = str(tmp_path / "pcw.parquet")
+    s.sql("SELECT id AS k, id * 3 AS v FROM range(40)").write.parquet(path)
+    q = ("SELECT k % 4 AS g, sum(v) AS sv FROM pcw "
+         "GROUP BY k % 4 ORDER BY g")
+    s.read.parquet(path).createOrReplaceTempView("pcw")
+    a1 = [tuple(r) for r in s.sql(q).collect()]
+    assert [tuple(r) for r in s.sql(q).collect()] == a1
+    assert cache.stats()["hits"] >= 1 and cache.stats()["entries"] >= 1
+
+    # the DataFrame-API overwrite bypasses every SQL command hook — the
+    # writer itself must evict entries whose file leaves read this path
+    before = cache.stats()["invalidations"]
+    s.sql("SELECT id AS k, id AS v FROM range(60)") \
+        .write.mode("overwrite").parquet(path)
+    assert cache.stats()["invalidations"] > before, \
+        "df.write must evict cached plans scanning the written path"
+    a2 = [tuple(r) for r in s.sql(q).collect()]
+    f = serve_root.newSession()
+    f.read.parquet(path).createOrReplaceTempView("pcw")
+    oracle = [tuple(r) for r in f.sql(q).collect()]
+    assert a2 == oracle and a2 != a1
+
+
 def test_response_cache_fields_on_repeat(serve_root):
     srv = SQLServer(serve_root, port=0).start()
     try:
