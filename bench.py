@@ -83,6 +83,10 @@ DA_CUT = 3           # right-side filter: bonus < 3 keeps ~2% of rows, a
                      # ~50x misestimate vs the plan-time raw-leaf probe
 DA_PAY = 12          # left payload columns: the mass the frozen hash
                      # shuffle ships and the demoted broadcast never does
+SC_ROWS = 1 << 14    # stagecache lane: fact rows (full dataset) — sized
+                     # for compile-vs-dispatch accounting, not throughput
+SC_KEYS = 1 << 10    # dim-key cardinality (dim side UNIQUE: fanout 1, so
+                     # the per-op baseline replays without overflow retry)
 
 #: cold axon compiles of the fused agg/join programs run several minutes
 #: (f64/i64 emulation); the persistent jax compile cache under /tmp makes
@@ -770,6 +774,194 @@ def distjoin_worker_main() -> None:
     sys.stdout.flush()
 
 
+def _bench_stagecache() -> dict:
+    """Stagecache lane: whole-stage compilation vs per-operator dispatch,
+    and cold vs warm stage-executable cache, on a 2-process join + agg.
+
+    Two REAL worker processes (``--stagecache-worker``) share a shuffle
+    root and run the same fact⋈dim + group-by statement cold (first
+    execution: every stage traces and compiles through the process
+    StageCache) and then warm three times (median; executables must come
+    back as cache hits with ZERO new builds).  Worker 0 additionally
+    replays the same planned shape single-process both ways: fused (one
+    jitted program per stage, dispatch count from StageCache counters)
+    and per-operator (``stagecompile.run_per_op``, one device dispatch
+    per physical operator — the pre-fusion baseline).  The parent pins
+    checksum parity across processes and across dispatch modes, requires
+    the >=3x dispatch reduction, and reports compile-ms / hit-count /
+    wall-clock figures."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="spark_tpu_bench_sc_")
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("SPARK_TPU_FAULT_PLAN", None)
+        env.pop("SPARK_TPU_PLATFORM", None)
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--stagecache-worker", str(pid), d],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for pid in (0, 1)]
+        outs = [p.communicate(timeout=CHILD_TIMEOUT_S) for p in procs]
+        objs = []
+        for p, (out, err) in zip(procs, outs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"stagecache worker rc={p.returncode}: "
+                    f"{(err or out).strip().splitlines()[-3:]}")
+            line = [ln for ln in out.splitlines()
+                    if ln.strip().startswith("{")][-1]
+            objs.append(json.loads(line))
+        # distributed statement: byte-identical aggregates on both
+        # processes, cold and warm
+        sums = {o["dist"]["checksum"] for o in objs}
+        if len(sums) != 1:
+            raise RuntimeError(f"worker results diverge: {objs}")
+        if not all(o["dist"]["warm_hits"] > 0 for o in objs):
+            raise RuntimeError(f"warm runs never hit the stage cache: "
+                               f"{objs}")
+        if any(o["dist"]["warm_builds"] > 0 for o in objs):
+            raise RuntimeError(
+                f"warm runs recompiled stages (stale cache key?): {objs}")
+        cold_s = max(o["dist"]["cold_s"] for o in objs)
+        warm_s = max(o["dist"]["warm_s"] for o in objs)
+        if warm_s >= cold_s:
+            raise RuntimeError(
+                f"warm stage cache not faster than cold: {cold_s=} "
+                f"{warm_s=}")
+        # dispatch-mode comparison (worker 0's local replay)
+        lo = objs[0]["local"]
+        if lo["fused_checksum"] != lo["per_op_checksum"]:
+            raise RuntimeError(f"fused/per-op results diverge: {lo}")
+        if lo["per_op_overflow"]:
+            raise RuntimeError(f"per-op baseline overflowed: {lo}")
+        reduction = lo["per_op_dispatches"] / max(1,
+                                                  lo["fused_dispatches"])
+        if reduction < 3.0:
+            raise RuntimeError(
+                f"dispatch reduction {reduction:.2f}x < 3x: {lo}")
+        return {
+            "stagecache_cold_s": cold_s,
+            "stagecache_warm_s": warm_s,
+            "stagecache_warm_vs_cold_speedup": round(cold_s / warm_s, 3),
+            "stagecache_compile_ms": round(
+                sum(o["dist"]["compile_ms"] for o in objs), 1),
+            "stagecache_stage_builds": sum(
+                o["dist"]["builds"] for o in objs),
+            "stagecache_warm_hits": sum(
+                o["dist"]["warm_hits"] for o in objs),
+            "stagecache_fused_dispatches": lo["fused_dispatches"],
+            "stagecache_per_op_dispatches": lo["per_op_dispatches"],
+            "stagecache_dispatch_reduction": round(reduction, 2),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def stagecache_worker_main() -> None:
+    """One process of the stagecache lane (see ``_bench_stagecache``).
+
+    argv: --stagecache-worker <pid> <root>.  Prints ONE JSON line with
+    cold/warm wall clocks + StageCache counter deltas for the 2-process
+    statement, and (worker 0) fused-vs-per-op dispatch counts with
+    checksums on a single-process replay of the same shape."""
+    i = sys.argv.index("--stagecache-worker")
+    pid, root = int(sys.argv[i + 1]), sys.argv[i + 2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from spark_tpu import config as C
+    from spark_tpu.sql import stagecompile as SC
+    from spark_tpu.sql.session import SparkSession
+
+    # both workers draw the SAME dataset, keep a strided half; the dim
+    # side is UNIQUE-keyed so join fanout is exactly 1 and the per-op
+    # replay cannot overflow the planned capacities
+    rng = np.random.default_rng(47)
+    sk = rng.integers(0, SC_KEYS, SC_ROWS).astype(np.int64)
+    price = rng.integers(1, 201, SC_ROWS).astype(np.int64)
+    k2 = np.arange(SC_KEYS, dtype=np.int64)
+    bonus = rng.integers(1, 101, SC_KEYS).astype(np.int64)
+    mine = slice(pid, None, 2)
+    Q = ("SELECT sk, count(*) AS c, sum(bonus) AS sb FROM fact "
+         "JOIN dim ON sk = k2 WHERE price < 100 GROUP BY sk")
+
+    def _ck(rows):
+        return int(sum(int(r[1]) * 7 + int(r[2]) for r in rows))
+
+    session = SparkSession.builder.appName(f"bench-sc-{pid}").getOrCreate()
+    cache = SC.stage_cache()
+    out = {"pid": pid, "rows_total": int(SC_ROWS)}
+
+    xs = session.newSession()
+    xs.conf.set(C.MESH_SHARDS.key, "1")
+    xs.enableHostShuffle(os.path.join(root, "x"), process_id=pid,
+                         n_processes=2, timeout_s=300.0)
+    xs.createDataFrame({"sk": sk[mine], "price": price[mine]}) \
+        .createOrReplaceTempView("fact")
+    xs.createDataFrame({"k2": k2[mine], "bonus": bonus[mine]}) \
+        .createOrReplaceTempView("dim")
+
+    s0 = cache.stats()
+    t0 = time.perf_counter()
+    rows = xs.sql(Q).collect()
+    cold_s = time.perf_counter() - t0
+    s1 = cache.stats()
+    checksum = _ck(rows)
+    warm = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        rows = xs.sql(Q).collect()
+        warm.append(time.perf_counter() - t0)
+        if _ck(rows) != checksum:
+            raise RuntimeError("warm run diverged from cold result")
+    s2 = cache.stats()
+    warm.sort()
+    out["dist"] = {
+        "cold_s": round(cold_s, 3),
+        "warm_s": round(warm[len(warm) // 2], 3),
+        "checksum": checksum,
+        "builds": s1["builds"] - s0["builds"],
+        "compile_ms": round(s1["compile_ms"] - s0["compile_ms"], 1),
+        "warm_hits": s2["hits"] - s1["hits"],
+        "warm_builds": s2["builds"] - s1["builds"],
+    }
+
+    if pid == 0:
+        # single-process replay of the same shape: fused dispatch count
+        # (StageCache counters) vs the per-operator baseline
+        from spark_tpu.sql.planner import (Planner, QueryExecution,
+                                           _slice_to_host)
+        ls = session.newSession()
+        ls.conf.set(C.MESH_SHARDS.key, "1")
+        ls.createDataFrame({"sk": sk, "price": price}) \
+            .createOrReplaceTempView("fact")
+        ls.createDataFrame({"k2": k2, "bonus": bonus}) \
+            .createOrReplaceTempView("dim")
+        b0 = cache.stats()
+        fused_ck = _ck(ls.sql(Q).collect())
+        b1 = cache.stats()
+        pq = Planner(ls).plan(QueryExecution(ls, ls.sql(Q)._plan)
+                              .optimized)
+        dev, n_rows, n_disp, flags, _caps, _kinds = SC.run_per_op(
+            pq.physical, pq.leaves)
+        host = _slice_to_host(dev, n_rows)
+        cols = [np.asarray(v.data)[:n_rows] for v in host.vectors]
+        out["local"] = {
+            "fused_dispatches": b1["dispatches"] - b0["dispatches"],
+            "fused_checksum": fused_ck,
+            "per_op_dispatches": n_disp,
+            "per_op_checksum": _ck(list(zip(*cols))),
+            "per_op_overflow": bool(any(f > 0 for f in flags)),
+        }
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
 def _bench_dist_adapt() -> dict:
     """Distadapt lane: adaptive re-planning from observed exchange stats.
 
@@ -1399,6 +1591,15 @@ def _bench_servebench() -> dict:
             raise RuntimeError(f"cache on/off results diverge: {o}")
         if o["on"]["cache_hits"] <= 0:
             raise RuntimeError(f"plan cache never hit: {o}")
+        mb = o["multibatch"]
+        if not mb["checksum_equal"]:
+            raise RuntimeError(f"multibatch sessions diverge: {mb}")
+        if mb["first_cache_hit"] or not mb["second_cache_hit"]:
+            raise RuntimeError(
+                f"multibatch statement not cached cross-session: {mb}")
+        if mb["stage_cache_hits"] <= 0 or mb["stage_builds"] > 0:
+            raise RuntimeError(
+                f"second session recompiled multibatch stages: {mb}")
         return {
             "servebench_sessions": o["sessions"],
             "servebench_statements": o["off"]["statements"],
@@ -1414,6 +1615,9 @@ def _bench_servebench() -> dict:
             "servebench_p50_ms_cache_on": o["on"]["p50_ms"],
             "servebench_p95_ms_cache_on": o["on"]["p95_ms"],
             "servebench_cache_hits": o["on"]["cache_hits"],
+            "servebench_multibatch_second_session_hit":
+                mb["second_cache_hit"],
+            "servebench_multibatch_stage_hits": mb["stage_cache_hits"],
         }
     finally:
         shutil.rmtree(d, ignore_errors=True)
@@ -1517,6 +1721,44 @@ def servebench_worker_main() -> None:
             }
         finally:
             srv.stop()
+
+    # cross-session STAGE cache: a multibatch statement (scan split into
+    # device batches — previously a plan-cache bailout) repeated from a
+    # SECOND session must report cacheHit with the stage executables
+    # served from the shared stage cache, not recompiled
+    mb_sess = base.newSession()
+    mb_sess.conf.set("spark.tpu.mesh.shards", "1")
+    mb_sess.conf.set("spark.sql.warehouse.dir", os.path.join(root, "wh_mb"))
+    mb_sess.conf.set("spark.tpu.server.planCache.enabled", "true")
+    mb_sess.conf.set("spark.tpu.scan.maxBatchRows", "256")
+    mb_sess.sql("CREATE TABLE mb AS SELECT id AS k, (id * 13) % 997 AS v "
+                "FROM range(2000)")
+    MQ = ("SELECT k % 8 AS g, sum(v) AS sv, count(*) AS c FROM mb "
+          "GROUP BY k % 8 ORDER BY g")
+    srv = SQLServer(mb_sess, port=0, workers=2).start()
+    try:
+        runs, stats = [], []
+        for _ in range(2):
+            sid = _http(srv.port, "POST", "/session")["sessionId"]
+            runs.append(_http(srv.port, "POST", "/sql",
+                              {"query": MQ, "session": sid}))
+            stats.append(_http(srv.port, "GET", "/status"))
+            _http(srv.port, "DELETE", f"/session/{sid}")
+        sc0 = stats[0]["stageCache"]
+        sc1 = stats[1]["stageCache"]
+        out["multibatch"] = {
+            "first_cache_hit": bool(runs[0].get("cacheHit")),
+            "second_cache_hit": bool(runs[1].get("cacheHit")),
+            "checksum_equal": runs[0]["rows"] == runs[1]["rows"],
+            "stage_entries": int(
+                stats[1]["planCache"].get("stage_entries", 0)),
+            # second-session deltas: executables must come back as stage
+            # cache hits, never fresh builds
+            "stage_cache_hits": int(sc1["hits"]) - int(sc0["hits"]),
+            "stage_builds": int(sc1["builds"]) - int(sc0["builds"]),
+        }
+    finally:
+        srv.stop()
     print(json.dumps(out))
     sys.stdout.flush()
 
@@ -1628,6 +1870,14 @@ def child_main() -> None:
         print(f"[bench-child] distspill bench failed: {e}", file=sys.stderr)
         extras["distspill_error"] = str(e)[:300]
     try:
+        # whole-stage compilation: 2 real worker processes, fused vs
+        # per-operator dispatch and cold vs warm stage-executable cache
+        extras.update(_bench_stagecache())
+    except Exception as e:   # secondary must not sink the primary
+        print(f"[bench-child] stagecache bench failed: {e}",
+              file=sys.stderr)
+        extras["stagecache_error"] = str(e)[:300]
+    try:
         # multi-tenant serving: concurrent HTTP sessions replaying a
         # parameterized query mix, shared plan cache off vs on
         extras.update(_bench_servebench())
@@ -1667,6 +1917,8 @@ if __name__ == "__main__":
         distdict_worker_main()
     elif "--distspill-worker" in sys.argv:
         distspill_worker_main()
+    elif "--stagecache-worker" in sys.argv:
+        stagecache_worker_main()
     elif "--servebench-worker" in sys.argv:
         servebench_worker_main()
     elif "--child" in sys.argv:

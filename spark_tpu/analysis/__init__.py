@@ -24,11 +24,13 @@ The checked invariants are catalogued in ``docs/INVARIANTS.md``.
 
 from .errors import PlanInvariantError
 from .verifier import (
-    maybe_verify_physical, maybe_verify_plan, runtime_checks_enabled,
-    verify_physical, verify_plan,
+    maybe_verify_physical, maybe_verify_plan, maybe_verify_stage_contract,
+    runtime_checks_enabled, verify_physical, verify_plan,
+    verify_stage_contract,
 )
 
 __all__ = [
     "PlanInvariantError", "verify_plan", "verify_physical",
-    "maybe_verify_plan", "maybe_verify_physical", "runtime_checks_enabled",
+    "verify_stage_contract", "maybe_verify_plan", "maybe_verify_physical",
+    "maybe_verify_stage_contract", "runtime_checks_enabled",
 ]

@@ -27,6 +27,13 @@ codebase (or its reference lineage), rather than generic style:
   HZ107 shadow-builtin            a binding that shadows a risky builtin
         (``id``/``type``/``open``/...), the classic source of confusing
         NameErrors three edits later.
+  HZ108 jit-outside-stage-cache   a bare ``jax.jit(`` constructed inside
+        a function body: a fresh jit object per call re-traces (and on
+        remote-compile backends re-COMPILES) the identical program every
+        query/batch.  Compilation on execution paths must go through
+        ``sql.stagecompile.StageCache.get_or_build``; intentional sites
+        (the cache itself, one-shot model fits, the per-op bench
+        baseline) carry waivers.
 
 Justified exceptions live in ``tools/lint_waivers.toml`` (every waiver
 carries a reason).  Exit status: 0 when every finding is waived, 1
@@ -406,12 +413,49 @@ def _rule_shadow_builtins(tree, path, qnames) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# HZ108: bare jax.jit construction inside function bodies
+# ---------------------------------------------------------------------------
+
+def _is_bare_jit_call(n) -> bool:
+    if not isinstance(n, ast.Call):
+        return False
+    f = n.func
+    if isinstance(f, ast.Name) and f.id == "jit":
+        return True
+    if isinstance(f, ast.Attribute) and f.attr == "jit":
+        # jax.jit(...) / anything.jit(...) — the module alias doesn't
+        # matter, constructing the object per call is the hazard
+        return True
+    return False
+
+
+def _rule_jit_outside_stage_cache(tree, path, qnames) -> List[Finding]:
+    """Execution paths run per query / per batch; a ``jax.jit(``
+    constructed inside one builds a NEW traced executable each time —
+    exactly the re-trace hazard the stage-executable cache
+    (``sql.stagecompile.StageCache``) exists to kill.  Module-level jit
+    (built once at import) and ``@jit`` decorators are fine."""
+    out = []
+    for fn, qual in _functions(tree):
+        for n in _shallow_walk(fn):
+            if _is_bare_jit_call(n):
+                out.append(Finding(
+                    "HZ108", path, n.lineno, n.col_offset, qual,
+                    f"`{_src(n.func)}(` constructed inside a function: "
+                    "per-call jit objects re-trace the identical program "
+                    "— obtain the executable from "
+                    "sql.stagecompile.StageCache.get_or_build"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # drivers
 # ---------------------------------------------------------------------------
 
 _FILE_RULES = (_rule_jit_materialize, _rule_reserve_release,
                _rule_unlocked_state, _rule_io_under_lock,
-               _rule_unused_imports, _rule_shadow_builtins)
+               _rule_unused_imports, _rule_shadow_builtins,
+               _rule_jit_outside_stage_cache)
 
 
 def lint_source(src: str, path: str = "<snippet>") -> List[Finding]:

@@ -34,8 +34,9 @@ from .. import config as C
 from .. import types as T
 from .errors import PlanInvariantError
 
-__all__ = ["verify_plan", "verify_physical", "maybe_verify_plan",
-           "maybe_verify_physical", "runtime_checks_enabled"]
+__all__ = ["verify_plan", "verify_physical", "verify_stage_contract",
+           "maybe_verify_plan", "maybe_verify_physical",
+           "maybe_verify_stage_contract", "runtime_checks_enabled"]
 
 
 # ---------------------------------------------------------------------------
@@ -81,6 +82,17 @@ def maybe_verify_physical(session, pq) -> None:
         return
     t0 = time.perf_counter()
     verify_physical(pq.physical, pq.leaves)
+    _bump(session, (time.perf_counter() - t0) * 1e3)
+
+
+def maybe_verify_stage_contract(session, stage) -> None:
+    """Session-gated ``verify_stage_contract``, called once per stage
+    COMPILE (not per dispatch) by the stage-executable cache's call
+    sites — a bad boundary is caught before the first batch runs."""
+    if not runtime_checks_enabled(session):
+        return
+    t0 = time.perf_counter()
+    verify_stage_contract(stage)
     _bump(session, (time.perf_counter() - t0) * 1e3)
 
 
@@ -219,6 +231,74 @@ def verify_physical(physical, leaves: Optional[List] = None) -> None:
                 physical, "scan-leaf-index",
                 f"PScan reads leaf {physical.index} of {len(leaves)}")
         _check_scan_leaf(physical, leaves[physical.index])
+
+
+# ---------------------------------------------------------------------------
+# fused-stage contract
+# ---------------------------------------------------------------------------
+
+def verify_stage_contract(stage) -> None:
+    """One fused stage's boundary contract: the input/output schemas and
+    np-dtypes the stage compiler RECORDED at every cut point must equal
+    what the unfused physical tree derives bottom-up.  Fusion may change
+    dispatch structure, never the data contract at a cut — a mismatch
+    means a stage compiler bug would feed the next stage (a merger, an
+    exchange, another stage's scan) rows it cannot interpret.
+
+    ``stage`` is a ``sql.stagecompile.Stage``: ``physical`` (the fused
+    tree), ``in_schemas`` (leaf StructTypes in planner order), and
+    ``out_schema`` (the StructType at the output cut)."""
+    from ..sql import physical as P
+
+    phys = stage.physical
+    derived = _schema_of(phys)
+    want = stage.out_schema
+    if [f.name for f in derived.fields] != [f.name for f in want.fields]:
+        raise PlanInvariantError(
+            phys, "stage-cut-schema",
+            f"stage output cut claims columns "
+            f"{[f.name for f in want.fields]} but the unfused tree "
+            f"derives {[f.name for f in derived.fields]}")
+    for df, wf in zip(derived.fields, want.fields):
+        if isinstance(df.dataType, T.ArrayType) \
+                or isinstance(wf.dataType, T.ArrayType):
+            continue
+        if np.dtype(df.dataType.np_dtype) != np.dtype(wf.dataType.np_dtype):
+            raise PlanInvariantError(
+                phys, "stage-cut-dtype",
+                f"stage output column {wf.name!r} claims {wf.dataType} "
+                f"but the unfused tree derives {df.dataType}")
+
+    def scans(node):
+        if isinstance(node, P.PScan):
+            yield node
+        for c in node.children:
+            yield from scans(c)
+
+    for scan in scans(phys):
+        if not (0 <= scan.index < len(stage.in_schemas)):
+            raise PlanInvariantError(
+                scan, "stage-scan-leaf",
+                f"stage input cut {scan.index} has no recorded schema "
+                f"({len(stage.in_schemas)} inputs)")
+        cut = stage.in_schemas[scan.index]
+        claimed = scan.schema()
+        if [f.name for f in claimed.fields] != [f.name for f in cut.fields]:
+            raise PlanInvariantError(
+                scan, "stage-cut-schema",
+                f"stage input cut {scan.index} recorded columns "
+                f"{[f.name for f in cut.fields]} but the scan claims "
+                f"{[f.name for f in claimed.fields]}")
+        for cf, sf in zip(cut.fields, claimed.fields):
+            if isinstance(cf.dataType, T.ArrayType) \
+                    or isinstance(sf.dataType, T.ArrayType):
+                continue
+            if np.dtype(cf.dataType.np_dtype) \
+                    != np.dtype(sf.dataType.np_dtype):
+                raise PlanInvariantError(
+                    scan, "stage-cut-dtype",
+                    f"stage input cut {scan.index} column {cf.name!r}: "
+                    f"recorded {cf.dataType}, scan claims {sf.dataType}")
 
 
 def _check_scan_leaf(scan, batch) -> None:

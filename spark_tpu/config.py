@@ -550,3 +550,17 @@ SERVER_PLAN_CACHE_MAX_BYTES = conf("spark.tpu.server.planCache.maxBytes").doc(
     "local input batches + per-entry executable overhead) stay under this "
     "via LRU eviction."
 ).int(256 << 20)
+
+STAGE_FUSION = conf("spark.tpu.stage.fusion").doc(
+    "Whole-stage tensor compilation: every exchange-bounded stage "
+    "executes as ONE compiled program obtained from the process-local "
+    "stage-executable cache (sql/stagecompile.py).  Off drops to "
+    "per-operator dispatch — one jitted kernel per physical node — the "
+    "debug/baseline mode the stagecache bench lane compares against."
+).boolean(True)
+
+STAGE_CACHE_MAX_ENTRIES = conf("spark.tpu.stage.cacheMaxEntries").doc(
+    "Entry bound of the process-local stage-executable cache (LRU "
+    "beyond it).  The cache is per PROCESS, not per session: subprocess "
+    "reducers reuse compiled stages across queries within a worker."
+).int(256)

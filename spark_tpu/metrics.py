@@ -194,6 +194,10 @@ def default_sources(session) -> List[Source]:
         "plan_verify_ms": lambda: getattr(
             session, "_analysis_stats", {}).get("plan_verify_ms", 0.0),
     }))
+    from .sql.stagecompile import metrics_source as _stage_gauges
+    # whole-stage compilation: the process stage-executable cache
+    # (compile cost, hit ratio, fusion width — CodegenMetrics analog)
+    srcs.append(Source("compile", _stage_gauges()))
     svc = getattr(session, "_crossproc_svc", None)
     if svc is not None and hasattr(svc, "metrics_source"):
         # DCN exchange retry/blacklist counters (RetryingBlockReader +

@@ -146,6 +146,13 @@ class SQLServer:
             self._plan_cache = PlanCache(session.conf_obj)
         # the default session executes through the shared cache too
         session._plan_cache = self._plan_cache
+        # ONE StatsFeedback serves every session: observed exchange
+        # cardinalities from any statement feed later statements'
+        # choose_join_strategy server-wide (a repeated misestimated join
+        # plans broadcast on its second run, whichever session runs it)
+        from .parallel.crossproc import StatsFeedback
+        self._stats_feedback = StatsFeedback()
+        session._stats_feedback = self._stats_feedback
         self._sessions_expired = 0
         self._reaper_stop = threading.Event()
         self._reaper: Optional[threading.Thread] = None
@@ -171,6 +178,7 @@ class SQLServer:
                     f"session limit {self.max_sessions} reached")
             sess = self.session.newSession()
             sess._plan_cache = self._plan_cache   # shared plan→executable
+            sess._stats_feedback = self._stats_feedback  # shared stats
             sid = uuid.uuid4().hex[:16]
             self._sessions[sid] = _ServerSession(sess)
         return sid
@@ -433,6 +441,8 @@ class SQLServer:
         }
         if self._plan_cache is not None:
             out["planCache"] = self._plan_cache.stats()
+        from .sql.stagecompile import stage_cache
+        out["stageCache"] = stage_cache().stats()
         return out
 
     # -- http plumbing ---------------------------------------------------

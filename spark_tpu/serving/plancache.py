@@ -107,6 +107,8 @@ PLANNING_CONF_ENTRIES = (
     C.SHUFFLE_RANGE_SAMPLE_SIZE, C.CROSSPROC_DEDUP_REPLICATED,
     # adaptive replanning changes which exchange lane a join takes
     C.CROSSPROC_ADAPTIVE_REPLAN,
+    # whole-stage fusion toggles the fused-vs-per-op execution shape
+    C.STAGE_FUSION,
 )
 
 PLANNING_CONF_KEYS = frozenset(e.key for e in PLANNING_CONF_ENTRIES)
@@ -137,6 +139,12 @@ def _ser_expr(e: E.Expression, slots: List[E.Literal],
         return f"lit[{e.value!r}:{e.dtype.simpleString()}]"
     child_ok = isinstance(e, _SLOT_PARENTS)
     fields = []
+    if isinstance(e, (E.Col, E.Alias, E.LambdaVar)):
+        # the identity of these leaves/binders lives in a PRIVATE field
+        # the vars() walk below skips — without it `sum(a)` and `sum(b)`
+        # serialize identically and two different plans share one
+        # fingerprint (and, downstream, one compiled stage executable)
+        fields.append(f"name={e.name!r}")
     for name in sorted(vars(e)):
         if name == "children" or name.startswith("_"):
             continue
@@ -235,6 +243,34 @@ class _Entry:
         self.built_at = time.time()
 
 
+class _StageEntry:
+    """One cached DISTRIBUTED/MULTIBATCH statement: bookkeeping only.
+
+    These shapes cannot be one host-callable executable (they stream
+    batches, fork subprocesses, run shard_map collectives), so what the
+    plan cache stores for them is the STATEMENT-level record — its
+    fingerprint, the file paths and conf snapshot invalidation needs,
+    and the stage trace+compile cost the first run paid.  The compiled
+    stage executables themselves live in the process-local
+    ``sql.stagecompile.StageCache`` (where subprocess reducers and every
+    session share them); a hit here means the statement's whole
+    stage-executable SET is known-warm, so the server reports
+    ``cacheHit`` and skips nothing but re-proving it."""
+
+    __slots__ = ("key", "kind", "paths", "conf_snapshot", "planning_ms",
+                 "hits", "built_at")
+
+    def __init__(self, key: str, kind: str, paths, conf_snapshot,
+                 planning_ms: float):
+        self.key = key
+        self.kind = kind                # crossproc | dist | multibatch | …
+        self.paths = paths
+        self.conf_snapshot = conf_snapshot
+        self.planning_ms = planning_ms
+        self.hits = 0
+        self.built_at = time.time()
+
+
 #: fixed per-entry cost estimate for the executable + plan objects; the
 #: dominant VARIABLE cost (pinned LocalRelation inputs) is measured
 _ENTRY_OVERHEAD_BYTES = 64 << 10
@@ -261,6 +297,12 @@ class PlanCache:
         self.evictions = 0
         self.invalidations = 0
         self.uncacheable = 0
+        # distributed/multibatch statements: bookkeeping entries whose
+        # executables live in the process StageCache (see _StageEntry)
+        self._stage_entries: "collections.OrderedDict[str, _StageEntry]" \
+            = collections.OrderedDict()
+        self.stage_hits = 0
+        self.stage_misses = 0
 
     # -- stats ---------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
@@ -271,6 +313,9 @@ class PlanCache:
                 "invalidations": self.invalidations,
                 "uncacheable": self.uncacheable,
                 "entries": len(self._entries), "bytes": self._bytes,
+                "stage_entries": len(self._stage_entries),
+                "stage_hits": self.stage_hits,
+                "stage_misses": self.stage_misses,
             }
 
     @property
@@ -330,20 +375,29 @@ class PlanCache:
         database directory a DDL/DML just mutated)."""
         import os
         p = os.path.abspath(path)
+
+        def overlaps(entry):
+            for leaf in entry.paths:
+                if leaf == p or leaf.startswith(p + os.sep) \
+                        or p.startswith(leaf + os.sep):
+                    return True
+            return False
+
         victims = []
         with self._lock:
             for key, entry in self._entries.items():
-                for leaf in entry.paths:
-                    if leaf == p or leaf.startswith(p + os.sep) \
-                            or p.startswith(leaf + os.sep):
-                        victims.append(key)
-                        break
+                if overlaps(entry):
+                    victims.append(key)
             for key in victims:
                 entry = self._entries.pop(key, None)
                 if entry is not None:
                     self._bytes -= entry.nbytes
-            self.invalidations += len(victims)
-        return len(victims)
+            stage_victims = [k for k, e in self._stage_entries.items()
+                             if overlaps(e)]
+            for k in stage_victims:
+                self._stage_entries.pop(k, None)
+            self.invalidations += len(victims) + len(stage_victims)
+        return len(victims) + len(stage_victims)
 
     def invalidate_conf(self, key: str, old: Any, new: Any) -> int:
         """A planning-relevant conf changed in SOME session: evict
@@ -361,13 +415,18 @@ class PlanCache:
                 entry = self._entries.pop(k, None)
                 if entry is not None:
                     self._bytes -= entry.nbytes
-            self.invalidations += len(victims)
-        return len(victims)
+            stage_victims = [k for k, e in self._stage_entries.items()
+                             if e.conf_snapshot.get(key) == old]
+            for k in stage_victims:
+                self._stage_entries.pop(k, None)
+            self.invalidations += len(victims) + len(stage_victims)
+        return len(victims) + len(stage_victims)
 
     def invalidate_all(self) -> None:
         with self._lock:
-            n = len(self._entries)
+            n = len(self._entries) + len(self._stage_entries)
             self._entries.clear()
+            self._stage_entries.clear()
             self._bytes = 0
             self.invalidations += n
 
@@ -430,6 +489,71 @@ class PlanCache:
         entry.hits += 1
         info["hit"] = True
         info["skippedMs"] = entry.planning_ms
+        return out
+
+    def run_staged(self, qe, kind: str, thunk) -> Any:
+        """The cache hook for DISTRIBUTED / MULTIBATCH statements, the
+        shapes ``try_execute`` used to bail on.  Execution always goes
+        through ``thunk`` (these lanes stream, fork and shard — there is
+        no single host callable to store); what is cached cross-session
+        is the statement-level ``_StageEntry``, with the compiled stage
+        executables living in the process ``StageCache`` keyed by stage
+        fingerprint.  A hit reports ``cacheHit``/``planningSkippedMs``
+        to the server; literals in slot positions share one entry by
+        the same fingerprint slotting as the local path."""
+        session = qe.session
+        info = {"hit": False, "skippedMs": 0.0}
+        session._last_plan_cache_info = info
+        if not session.conf.get(C.CODEGEN_ENABLED):
+            return thunk()
+        fp = fingerprint(session, qe.optimized)
+        if fp is None:
+            with self._lock:
+                self.uncacheable += 1
+            return thunk()
+        key = f"stage|{kind}|{fp.key}"
+        with self._lock:
+            entry = self._stage_entries.get(key)
+            if entry is not None:
+                self._stage_entries.move_to_end(key)
+        if entry is not None:
+            out = thunk()
+            with self._lock:
+                self.stage_hits += 1
+            entry.hits += 1
+            info["hit"] = True
+            info["skippedMs"] = entry.planning_ms
+            return out
+        # miss: run the statement, charging it the stage trace+compile
+        # cost the process StageCache pays during this execution — the
+        # cost every later fingerprint-equal statement skips
+        from ..sql.stagecompile import stage_cache
+        sc = stage_cache(session)
+        ms0 = sc.stats()["compile_ms"]
+        out = thunk()                    # exceptions propagate unrecorded
+        from ..sql.logical import FileRelation
+        import os
+
+        paths: List[str] = []
+
+        def walk(node):
+            if isinstance(node, FileRelation):
+                paths.extend(os.path.abspath(p) for p in node.paths)
+            for c in node.children:
+                walk(c)
+
+        walk(qe.optimized)
+        conf_snapshot = {e.key: session.conf.get(e)
+                         for e in PLANNING_CONF_ENTRIES}
+        planning_ms = round(max(sc.stats()["compile_ms"] - ms0, 0.0), 1)
+        entry = _StageEntry(key, kind, paths, conf_snapshot, planning_ms)
+        max_entries = int(self._conf.get(C.SERVER_PLAN_CACHE_MAX_ENTRIES))
+        with self._lock:
+            self.stage_misses += 1
+            self._stage_entries[key] = entry
+            while len(self._stage_entries) > max(max_entries, 1):
+                self._stage_entries.popitem(last=False)
+                self.evictions += 1
         return out
 
     def _build_and_run(self, qe, fp: PlanFingerprint) -> Optional[Any]:
@@ -545,4 +669,9 @@ class PlanCache:
                 lambda: self.stats()["invalidations"],
             "plan_cache_bytes": lambda: self.stats()["bytes"],
             "plan_cache_entries": lambda: self.stats()["entries"],
+            "plan_cache_stage_entries":
+                lambda: self.stats()["stage_entries"],
+            "plan_cache_stage_hits": lambda: self.stats()["stage_hits"],
+            "plan_cache_stage_misses":
+                lambda: self.stats()["stage_misses"],
         }

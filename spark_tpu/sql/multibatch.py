@@ -30,7 +30,6 @@ from typing import List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
 from .. import config as C
@@ -704,31 +703,61 @@ class MultiBatchExecution:
     def _build_step(self, template: ColumnBatch):
         """(jitted step fn, spine output schema) for one padded scan batch.
 
-        The jitted step is cached on the session by the plan's structural
-        fingerprint (same discipline as the eager executor's jit cache):
-        a fresh ``jax.jit`` object per execution would re-trace — and on
-        remote-compile backends re-COMPILE — the identical program for
-        every run of the same query."""
+        The jitted step is one fused STAGE (scan→spine→breaker-partial,
+        the map side of the exchange) and lives in the PROCESS-LOCAL
+        stage-executable cache (``sql/stagecompile.py``), keyed by the
+        structural fingerprint with filter/projection literals slotted
+        out as runtime arguments: a fresh ``jax.jit`` object per
+        execution would re-trace — and on remote-compile backends
+        re-COMPILE — the identical program for every run of the same
+        query, and a per-SESSION cache would still re-compile it once
+        per server session."""
+        from . import stagecompile as SC
         phys, spine_schema = self._step_physical(template)
-        ck = f"mb:{self.capacity}:" + phys.key()
-        cached = self.session._jit_cache.get(ck)
-        if cached is not None:
-            return cached, spine_schema
+        cache = SC.stage_cache(self.session)
+        skey, slots = SC.stage_fingerprint(phys)
+        skey = (f"mb|{skey}|{SC.leaf_signature([template])}"
+                f"|{SC._conf_component(self.session)}")
         skip_compact = _prefix_live(phys)
 
-        def step(leaf):
-            ctx = P.ExecContext(jnp, [leaf])
-            out = phys.run(ctx)
-            # compact = a full sort; skip it when the spine provably
-            # emits live rows as a prefix already (aggregation stages
-            # scatter groups to slots 0..k-1; sorted/limited outputs are
-            # prefix-compacted by construction) — on TPU this sort was
-            # the single largest cost of every streamed agg/scan step
-            c = out if skip_compact else compact(jnp, out)
-            return c, c.num_rows()
+        def make():
+            from ..analysis import maybe_verify_stage_contract
+            maybe_verify_stage_contract(
+                self.session, SC.Stage(phys, [template.schema],
+                                       phys.schema(), skey))
+            entry_slots = slots          # entry owns THIS plan's literals
 
-        jitted = jax.jit(step)
-        self.session._jit_cache[ck] = jitted
+            def step(leaf, params):
+                from .. import expressions as E
+                E._slot_bindings.map = {
+                    id(l): p for l, p in zip(entry_slots, params)}
+                try:
+                    ctx = P.ExecContext(jnp, [leaf])
+                    out = phys.run(ctx)
+                    # compact = a full sort; skip it when the spine
+                    # provably emits live rows as a prefix already
+                    # (aggregation stages scatter groups to slots
+                    # 0..k-1; sorted/limited outputs are prefix-
+                    # compacted by construction) — on TPU this sort was
+                    # the single largest cost of every streamed step
+                    c = out if skip_compact else compact(jnp, out)
+                    return c, c.num_rows()
+                finally:
+                    E._slot_bindings.map = None
+
+            return step, None
+
+        entry = cache.get_or_build(skey, make, n_ops=SC.count_ops(phys),
+                                   session=self.session)
+        params = SC.param_values(slots)
+
+        def jitted(leaf):
+            return cache.dispatch(entry, leaf, params)
+
+        # introspection contract: the compiled stage program stays
+        # reachable through .lower() exactly like a bare jit object
+        # (program-cost tests read its HLO/cost_analysis)
+        jitted.lower = lambda leaf: entry.fn.lower(leaf, params)
         return jitted, spine_schema
 
     # -- per-batch transfer + host-ification (overridden when sharded) ---
@@ -982,35 +1011,60 @@ class DistributedMultiBatchExecution(MultiBatchExecution):
         self.n = mesh_shards(mesh)
 
     def _build_step(self, template: ColumnBatch):
-        from jax.sharding import PartitionSpec
-        from jax import shard_map
-        from ..parallel.mesh import DATA_AXIS
+        from . import stagecompile as SC
 
         phys, spine_schema = self._step_physical(template)
-        ck = f"mbdist{self.n}:{self.capacity}:" + phys.key()
-        cached = self.session._jit_cache.get(ck)
-        if cached is not None:
-            return cached, spine_schema
-
+        cache = SC.stage_cache(self.session)
+        skey, slots = SC.stage_fingerprint(phys)
+        skey = (f"mbdist{self.n}|{skey}|{SC.leaf_signature([template])}"
+                f"|{SC._conf_component(self.session)}")
         skip_compact = _prefix_live(phys)
 
-        def shard_fn(leaf):
-            ctx = P.ExecContext(jnp, [leaf])
-            out = phys.run(ctx)
-            # same skip as the local step: per-shard outputs of the
-            # aggregation stages are prefix-live by construction, and
-            # _run_batch passes whole shard slices (mergers consume
-            # row_valid), so layout requirements are unchanged
-            return out if skip_compact else compact(jnp, out)
+        def make():
+            from jax.sharding import PartitionSpec
+            from jax import shard_map
+            from ..analysis import maybe_verify_stage_contract
+            from ..parallel.mesh import DATA_AXIS
+            maybe_verify_stage_contract(
+                self.session, SC.Stage(phys, [template.schema],
+                                       phys.schema(), skey))
+            entry_slots = slots
 
-        wrapped = shard_map(
-            shard_fn, mesh=self.mesh,
-            in_specs=(PartitionSpec(DATA_AXIS),),
-            out_specs=PartitionSpec(DATA_AXIS),
-            check_vma=False,
-        )
-        jitted = jax.jit(wrapped)
-        self.session._jit_cache[ck] = jitted
+            def shard_fn(leaf, params):
+                from .. import expressions as E
+                E._slot_bindings.map = {
+                    id(l): p for l, p in zip(entry_slots, params)}
+                try:
+                    ctx = P.ExecContext(jnp, [leaf])
+                    out = phys.run(ctx)
+                    # same skip as the local step: per-shard outputs of
+                    # the aggregation stages are prefix-live by
+                    # construction, and _run_batch passes whole shard
+                    # slices (mergers consume row_valid), so layout
+                    # requirements are unchanged
+                    return out if skip_compact else compact(jnp, out)
+                finally:
+                    E._slot_bindings.map = None
+
+            wrapped = shard_map(
+                shard_fn, mesh=self.mesh,
+                in_specs=(PartitionSpec(DATA_AXIS), PartitionSpec()),
+                out_specs=PartitionSpec(DATA_AXIS),
+                check_vma=False,
+            )
+            return wrapped, None
+
+        entry = cache.get_or_build(skey, make, n_ops=SC.count_ops(phys),
+                                   session=self.session)
+        params = SC.param_values(slots)
+
+        def jitted(leaf):
+            return cache.dispatch(entry, leaf, params)
+
+        # introspection contract: the compiled stage program stays
+        # reachable through .lower() exactly like a bare jit object
+        # (program-cost tests read its HLO/cost_analysis)
+        jitted.lower = lambda leaf: entry.fn.lower(leaf, params)
         return jitted, spine_schema
 
     def _place(self, b: ColumnBatch):

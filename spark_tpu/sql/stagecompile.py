@@ -1,0 +1,410 @@
+"""Whole-stage tensor compilation with a process-local executable cache.
+
+The physical tree between two exchange/breaker boundaries — one STAGE —
+already executes as a single ``jax.jit`` trace (XLA fusion is the
+WholeStageCodegen analog, ``physical.py`` header).  What the engine was
+missing is ONE owner for those compiled stage programs: the eager
+executor, the multi-batch streamers, the stage-DAG mapped streams and
+every crossproc lane sub-plan each kept (or worse, rebuilt) private jit
+objects, so a subprocess reducer recompiled the identical stage for
+every query and every ``_MappedStream`` instance re-traced per stream.
+
+``StageCache`` is that owner: a process-local, thread-safe LRU from a
+STRUCTURAL stage fingerprint — ``PhysicalPlan.key()`` semantics grown
+with literal slotting, leaf batch-shape/dtype signatures and the
+planning-conf values that leak into traces (``getActiveSession`` reads
+like the collect cap) — to the compiled executable.  Builds are
+single-flight per fingerprint; literals in arithmetic/comparison
+positions ride in as runtime scalar ARGUMENTS (the serving plan cache's
+``expressions._slot_bindings`` protocol), so ``WHERE v < 10`` and
+``WHERE v < 20`` share one stage executable.
+
+The cache is deliberately per PROCESS, not per session: the serving
+tier's sessions and the crossproc subprocess reducers are exactly the
+places where per-session ``_jit_cache`` dicts made compile cost
+O(sessions x queries) instead of O(distinct stage shapes).
+
+``run_per_op`` is the measured BASELINE the fusion claim is judged
+against (bench.py ``stagecache`` lane): the same physical tree executed
+as one fresh jitted kernel per operator, the dispatch structure Spark
+has without WholeStageCodegen.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import config as C
+
+__all__ = [
+    "Stage", "StageCache", "stage_cache", "stage_fingerprint",
+    "leaf_signature", "count_ops", "metrics_source", "run_per_op",
+]
+
+
+# ---------------------------------------------------------------------------
+# stage fingerprints
+# ---------------------------------------------------------------------------
+
+def count_ops(physical) -> int:
+    """Number of physical operators fused into one stage program."""
+    return 1 + sum(count_ops(c) for c in physical.children)
+
+
+def leaf_signature(leaves) -> str:
+    """Batch-shape/dtype signature of a stage's input leaves: the part
+    of the key ``PhysicalPlan.key()`` cannot see (capacities and vector
+    dtypes decide the traced program's shapes)."""
+    parts = []
+    for b in leaves:
+        dts = ",".join(str(v.dtype) for v in b.vectors)
+        parts.append(f"{b.capacity}[{dts}]")
+    return "x".join(parts)
+
+
+def _ser_physical(node, slots: List) -> str:
+    """Slot-aware structural serialization of a physical tree.
+
+    Same discipline as the serving plan cache's ``_ser_plan`` but over
+    PHYSICAL operators: every non-child field is serialized, expression
+    fields reuse ``plancache._ser_expr`` so int/float/bool literals in
+    arithmetic/comparison positions slot out as ``?i`` markers (their
+    values become runtime arguments of the stage executable)."""
+    from ..serving.plancache import _ser_val
+    fields = []
+    for name in sorted(vars(node)):
+        if name == "children":
+            continue
+        v = vars(node)[name]
+        if name.startswith("_"):
+            # private fields are planner memos EXCEPT the scan schema,
+            # which decides the leaf layout the trace was built for
+            from .. import types as T
+            if name == "_schema" and isinstance(v, T.StructType):
+                fields.append(f"schema={v.simpleString()}")
+            continue
+        fields.append(f"{name}={_ser_val(v, slots)}")
+    inner = ",".join(_ser_physical(c, slots) for c in node.children)
+    return f"{type(node).__name__}[{';'.join(fields)}]({inner})"
+
+
+def stage_fingerprint(physical) -> Tuple[str, List]:
+    """(structural key, slotted Literal objects) for one stage tree.
+
+    Falls back to the un-slotted ``physical.key()`` (literal values
+    inlined, no parameters) when a field defeats the serializer —
+    degraded sharing, never wrong sharing."""
+    from ..serving.plancache import _Unfingerprintable
+    slots: List = []
+    try:
+        body = _ser_physical(physical, slots)
+    except (_Unfingerprintable, RecursionError):
+        return physical.key(), []
+    return body, slots
+
+
+def _conf_component(session) -> str:
+    """Planning-conf values that can leak into a trace through
+    ``getActiveSession`` reads (collect cap, time zone, metrics flag):
+    sessions with different values must not share a stage executable."""
+    if session is None:
+        return ""
+    from ..serving.plancache import PLANNING_CONF_ENTRIES
+    return ";".join(f"{e.key}={session.conf.get(e)!r}"
+                    for e in PLANNING_CONF_ENTRIES)
+
+
+def param_values(slots) -> Tuple:
+    """Runtime argument tuple for one execution of a slotted stage —
+    positionally aligned with any fingerprint-equal plan's slots."""
+    return tuple(np.asarray(l.value, dtype=l.dtype.np_dtype)
+                 for l in slots)
+
+
+# ---------------------------------------------------------------------------
+# stage record (the verifier's contract surface)
+# ---------------------------------------------------------------------------
+
+class Stage:
+    """One compiled stage: the fused physical tree plus the input/output
+    schemas at its cut points, recorded AT COMPILE TIME so
+    ``analysis.verify_stage_contract`` can re-derive them bottom-up and
+    prove fusion changed dispatch structure, never semantics."""
+
+    __slots__ = ("physical", "in_schemas", "out_schema", "key", "n_ops")
+
+    def __init__(self, physical, in_schemas, out_schema, key: str = "",
+                 n_ops: int = 0):
+        self.physical = physical
+        self.in_schemas = list(in_schemas)   # [StructType] in leaf order
+        self.out_schema = out_schema         # StructType at the out cut
+        self.key = key
+        self.n_ops = n_ops or count_ops(physical)
+
+
+# ---------------------------------------------------------------------------
+# the process-local executable cache
+# ---------------------------------------------------------------------------
+
+class _CachedStage:
+    """Payload of one cache entry: the jitted callable (built ONCE by
+    the cache, the only ``jax.jit`` construction site on the execution
+    paths — HZ108) plus whatever entry-owned state the builder returned
+    (shape-keyed trace metadata, slot literals)."""
+
+    __slots__ = ("fn", "aux", "n_ops", "compile_ms", "hits", "built_at",
+                 "_first", "_lock")
+
+    def __init__(self, fn, aux, n_ops: int):
+        self.fn = fn
+        self.aux = aux
+        self.n_ops = n_ops
+        self.compile_ms = 0.0
+        self.hits = 0
+        self.built_at = time.time()
+        self._first = True
+        self._lock = threading.Lock()
+
+
+class StageCache:
+    """Thread-safe process-local LRU: stage fingerprint → executable."""
+
+    def __init__(self, max_entries: int = 256):
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[str, _CachedStage]" = \
+            collections.OrderedDict()
+        # per-fingerprint single-flight build locks (plan cache idiom):
+        # N threads missing one stage pay ONE trace+compile, not N
+        self._building: Dict[str, threading.Lock] = {}
+        self.hits = 0
+        self.misses = 0
+        self.builds = 0
+        self.dispatches = 0
+        self.compile_ms = 0.0
+        self.total_ops = 0
+
+    # -- lookup / build ------------------------------------------------
+    def get_or_build(self, key: str, make_fn: Callable[[], Tuple],
+                     n_ops: int = 1, session=None) -> _CachedStage:
+        """The single integration surface for every execution path.
+
+        ``make_fn`` returns ``(traceable, aux)`` — the pure step
+        function to compile and any entry-owned metadata; the cache
+        jits it, so call sites never construct jit objects themselves
+        (a fresh ``jax.jit`` per execution re-traces — and on
+        remote-compile backends re-COMPILES — the identical program)."""
+        if session is not None:
+            try:
+                self.max_entries = int(
+                    session.conf.get(C.STAGE_CACHE_MAX_ENTRIES))
+            except Exception:
+                pass
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                entry.hits += 1
+                return entry
+            build_lock = self._building.setdefault(key, threading.Lock())
+        with build_lock:
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None:      # lost the build race: a hit
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    entry.hits += 1
+                    return entry
+            import jax
+            fn, aux = make_fn()
+            entry = _CachedStage(jax.jit(fn), aux, n_ops)
+            with self._lock:
+                self.misses += 1
+                self.builds += 1
+                self.total_ops += n_ops
+                self._entries[key] = entry
+                while len(self._entries) > max(self.max_entries, 1):
+                    self._entries.popitem(last=False)
+                self._building.pop(key, None)
+            return entry
+
+    def dispatch(self, entry: _CachedStage, *args):
+        """Invoke one compiled stage, counting the dispatch; the first
+        invocation per entry is timed as the stage's trace+compile cost
+        (jax traces lazily at first call)."""
+        with self._lock:
+            self.dispatches += 1
+        if entry._first:
+            with entry._lock:
+                if entry._first:
+                    t0 = time.perf_counter()
+                    out = entry.fn(*args)
+                    ms = (time.perf_counter() - t0) * 1000.0
+                    entry.compile_ms = round(ms, 2)
+                    with self._lock:
+                        self.compile_ms += ms
+                    entry._first = False
+                    return out
+        return entry.fn(*args)
+
+    # -- introspection -------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            n = len(self._entries)
+            return {
+                "hits": self.hits, "misses": self.misses,
+                "builds": self.builds, "dispatches": self.dispatches,
+                "compile_ms": round(self.compile_ms, 2),
+                "entries": n, "max_entries": self.max_entries,
+                "stages_fused": self.builds,
+                "ops_per_stage": round(
+                    self.total_ops / self.builds, 2) if self.builds else 0.0,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._building.clear()
+            self.hits = self.misses = self.builds = 0
+            self.dispatches = 0
+            self.compile_ms = 0.0
+            self.total_ops = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+#: THE process-local cache (one per worker process by construction —
+#: subprocess reducers each get their own on first import)
+_CACHE: Optional[StageCache] = None
+_CACHE_LOCK = threading.Lock()
+
+
+def stage_cache(session=None) -> StageCache:
+    global _CACHE
+    if _CACHE is None:
+        with _CACHE_LOCK:
+            if _CACHE is None:
+                _CACHE = StageCache()
+    return _CACHE
+
+
+def metrics_source() -> Dict[str, Callable]:
+    """Gauges for the 'compile' metrics Source (ISSUE 11 observability):
+    resolved per read so a source registered before the first stage
+    compile still reports live numbers."""
+    def g(key, default=0):
+        def read():
+            return stage_cache().stats().get(key, default)
+        return read
+    return {
+        "stage_compile_ms": g("compile_ms", 0.0),
+        "stage_cache_hits": g("hits"),
+        "stage_cache_misses": g("misses"),
+        "stage_cache_entries": g("entries"),
+        "stage_dispatches": g("dispatches"),
+        "stages_fused": g("stages_fused"),
+        "ops_per_stage": g("ops_per_stage", 0.0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# per-operator dispatch baseline (fusion off / bench comparison)
+# ---------------------------------------------------------------------------
+
+class _Fixed:
+    """Leaf stand-in holding an already-computed child output so one
+    operator can run in isolation (its children become constants of the
+    single-op trace)."""
+
+    children: Tuple = ()
+    op_id: int = 0
+
+    def __init__(self, batch, schema):
+        self._batch = batch
+        self._schema = schema
+
+    @property
+    def row_offset(self) -> int:
+        return 0
+
+    def offset_in(self, ctx):
+        return getattr(ctx, "shard_offset", 0)
+
+    def schema(self):
+        return self._schema
+
+    def key(self) -> str:
+        return "Fixed"
+
+    def run(self, ctx):
+        return self._batch
+
+
+def run_per_op(physical, leaves
+               ) -> Tuple[Any, int, int, List[int], List[int], List[str]]:
+    """Execute a physical tree as ONE JITTED KERNEL PER OPERATOR —
+    Spark's dispatch structure without WholeStageCodegen, kept as the
+    measured baseline for the fusion claim (bench ``stagecache`` lane;
+    ``spark.tpu.stage.fusion=false``).
+
+    Returns ``(compacted device batch, n_rows, dispatch count,
+    int overflow flags, flag caps, flag kinds)``.  Flags are read back
+    per operator so the adaptive replan loop still sees overflows;
+    per-op execution drops the device-side metric counters (each op runs
+    in its own context), which is why this is a bench/debug lane, not a
+    production mode."""
+    import copy
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels import compact
+    from . import physical as P
+
+    dev = [b.to_device() for b in leaves]
+    n_dispatch = 0
+    int_flags: List[int] = []
+    flag_caps: List[int] = []
+    flag_kinds: List[str] = []
+
+    def rec(node):
+        nonlocal n_dispatch
+        kids = [rec(c) for c in node.children]
+        one = copy.copy(node)
+        one.children = tuple(
+            _Fixed(k, c.schema()) for k, c in zip(kids, node.children))
+        cap_box = []
+
+        def step(ls):
+            ctx = P.ExecContext(jnp, list(ls))
+            out = one.run(ctx)
+            cap_box.append((list(ctx.flag_caps), list(ctx.flag_kinds)))
+            return out, ctx.flags
+
+        n_dispatch += 1
+        # deliberately uncached: this IS the per-op re-trace baseline
+        out, flags = jax.jit(step)(dev)
+        caps, kinds = cap_box[-1]
+        int_flags.extend(int(np.asarray(f)) for f in flags)
+        flag_caps.extend(caps)
+        flag_kinds.extend(kinds)
+        return out
+
+    out = rec(physical)
+
+    def fin(b):
+        c = compact(jnp, b)
+        return c, c.num_rows()
+
+    n_dispatch += 1
+    c, n = jax.jit(fin)(out)
+    return c, int(np.asarray(n)), n_dispatch, int_flags, flag_caps, \
+        flag_kinds

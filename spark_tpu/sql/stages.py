@@ -43,7 +43,6 @@ from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
 from .. import config as C
@@ -263,7 +262,17 @@ class _MappedStream(BatchStream):
         return node
 
     def _compile(self, template: ColumnBatch, phys_wrap=None):
-        """(jitted step, extra device leaves, shape-keyed meta)."""
+        """(jitted step, extra device leaves, shape-keyed meta).
+
+        The step is one fused STAGE and its executable lives in the
+        process-local stage cache (``stagecompile.py``): a second
+        ``_MappedStream`` instance over the same plan shape — another
+        query, another grace bucket, another server session — reuses
+        the compiled program instead of re-tracing per instance.
+        Planning (``_to_physical``) still runs per compile call to
+        collect THIS instance's extra leaves (broadcast build sides are
+        data, never part of the cached executable)."""
+        from . import stagecompile as SC
         from .planner import Planner
         planner = Planner(self.session, join_factor_override=self._factors)
         node = self.compose(L.LocalRelation(template))
@@ -275,48 +284,83 @@ class _MappedStream(BatchStream):
         if not leaves or leaves[0] is not template:
             raise NotStreamable("streamed leaf is not the planner's first "
                                 "leaf; cannot swap batches per step")
-        meta: Dict[tuple, tuple] = {}
-
-        if self.mesh is None:
-            def step(all_leaves):
-                ctx = P.ExecContext(jnp, list(all_leaves))
-                out = phys.run(ctx)
-                c = compact(jnp, out)
-                # host-side capture at trace time, keyed by capacities
-                meta[tuple(b.capacity for b in all_leaves)] = (
-                    list(ctx.flag_caps), list(ctx.flag_kinds))
-                return c, c.num_rows(), ctx.flags
-
-            extra = [b.to_device() for b in leaves[1:]]
-            return jax.jit(step), extra, meta
-
-        from jax import lax, shard_map
-        from jax.sharding import PartitionSpec
-        from ..parallel.mesh import DATA_AXIS
-        n_extra = len(leaves) - 1
-
-        def shard_fn(all_leaves):
-            ctx = P.ExecContext(jnp, list(all_leaves))
-            ctx.shard_offset = lax.axis_index(DATA_AXIS).astype(
-                np.int64) << 48
-            out = phys.run(ctx)
-            c = compact(jnp, out)
-            meta[tuple(b.capacity for b in all_leaves)] = (
-                list(ctx.flag_caps), list(ctx.flag_kinds))
-            # worst per-shard overflow drives the adaptive retry
-            flags = [lax.pmax(f, DATA_AXIS) for f in ctx.flags]
-            return c, lax.psum(c.num_rows(), DATA_AXIS), flags
-
-        wrapped = shard_map(
-            shard_fn, mesh=self.mesh,
-            in_specs=([PartitionSpec(DATA_AXIS)]
-                      + [PartitionSpec()] * n_extra,),
-            out_specs=(PartitionSpec(DATA_AXIS), PartitionSpec(),
-                       PartitionSpec()),
-            check_vma=False,
-        )
+        cache = SC.stage_cache(self.session)
+        skey, slots = SC.stage_fingerprint(phys)
+        from ..parallel.mesh import mesh_shards
+        mesh_tag = "local" if self.mesh is None else \
+            f"mesh{mesh_shards(self.mesh)}"
+        skey = (f"stream|{mesh_tag}|{skey}|{SC.leaf_signature(leaves)}"
+                f"|{SC._conf_component(self.session)}")
+        params = SC.param_values(slots)
         extra = [b.to_device() for b in leaves[1:]]
-        return jax.jit(wrapped), extra, meta
+
+        def make():
+            from ..analysis import maybe_verify_stage_contract
+            maybe_verify_stage_contract(
+                self.session, SC.Stage(phys, [b.schema for b in leaves],
+                                       phys.schema(), skey))
+            entry_slots = slots          # entry owns THIS plan's literals
+            meta: Dict[tuple, tuple] = {}
+
+            if self.mesh is None:
+                def step(all_leaves, params):
+                    from .. import expressions as E
+                    E._slot_bindings.map = {
+                        id(l): p for l, p in zip(entry_slots, params)}
+                    try:
+                        ctx = P.ExecContext(jnp, list(all_leaves))
+                        out = phys.run(ctx)
+                        c = compact(jnp, out)
+                        # host-side capture at trace time, by capacities
+                        meta[tuple(b.capacity for b in all_leaves)] = (
+                            list(ctx.flag_caps), list(ctx.flag_kinds))
+                        return c, c.num_rows(), ctx.flags
+                    finally:
+                        E._slot_bindings.map = None
+
+                return step, meta
+
+            from jax import lax, shard_map
+            from jax.sharding import PartitionSpec
+            from ..parallel.mesh import DATA_AXIS
+            n_extra = len(leaves) - 1
+
+            def shard_fn(all_leaves, params):
+                from .. import expressions as E
+                E._slot_bindings.map = {
+                    id(l): p for l, p in zip(entry_slots, params)}
+                try:
+                    ctx = P.ExecContext(jnp, list(all_leaves))
+                    ctx.shard_offset = lax.axis_index(DATA_AXIS).astype(
+                        np.int64) << 48
+                    out = phys.run(ctx)
+                    c = compact(jnp, out)
+                    meta[tuple(b.capacity for b in all_leaves)] = (
+                        list(ctx.flag_caps), list(ctx.flag_kinds))
+                    # worst per-shard overflow drives the adaptive retry
+                    flags = [lax.pmax(f, DATA_AXIS) for f in ctx.flags]
+                    return c, lax.psum(c.num_rows(), DATA_AXIS), flags
+                finally:
+                    E._slot_bindings.map = None
+
+            wrapped = shard_map(
+                shard_fn, mesh=self.mesh,
+                in_specs=([PartitionSpec(DATA_AXIS)]
+                          + [PartitionSpec()] * n_extra,
+                          PartitionSpec()),
+                out_specs=(PartitionSpec(DATA_AXIS), PartitionSpec(),
+                           PartitionSpec()),
+                check_vma=False,
+            )
+            return wrapped, meta
+
+        entry = cache.get_or_build(skey, make, n_ops=SC.count_ops(phys),
+                                   session=self.session)
+
+        def jstep(all_leaves):
+            return cache.dispatch(entry, all_leaves, params)
+
+        return jstep, extra, entry.aux
 
     def _to_runs(self, out, n) -> List[ColumnBatch]:
         """Host batches from one step output: the live prefix locally, or

@@ -332,6 +332,46 @@ def test_lint_shadowed_builtin():
     assert "HZ107" not in _rules("def f(uid):\n    return uid\n")
 
 
+def test_lint_jit_outside_stage_cache():
+    # a fresh jit object per call inside an execution path: flagged
+    bad = """
+        import jax
+
+        def run(step, leaves):
+            return jax.jit(step)(leaves)
+    """
+    assert "HZ108" in _rules(bad)
+    # the bare `jit(` spelling too
+    assert "HZ108" in _rules(
+        "from jax import jit\n\ndef run(f, x):\n    return jit(f)(x)\n")
+    # module-level jit (built once at import) is fine
+    ok_module = """
+        import jax
+
+        def _step(x):
+            return x + 1
+
+        STEP = jax.jit(_step)
+    """
+    assert "HZ108" not in _rules(ok_module)
+    # the @jit decorator form is a definition, not a per-call build
+    ok_decorator = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x + 1
+    """
+    assert "HZ108" not in _rules(ok_decorator)
+    # routing through the stage cache carries no bare jit( at the site
+    ok_cached = """
+        def run(cache, key, make, leaves):
+            entry = cache.get_or_build(key, make)
+            return cache.dispatch(entry, leaves)
+    """
+    assert "HZ108" not in _rules(ok_cached)
+
+
 def test_waiver_file_parses_and_matches():
     waivers = load_waivers(WAIVERS)
     assert waivers and all(w.get("reason") for w in waivers)
@@ -369,8 +409,10 @@ def test_planning_conf_coverage_complete():
 def test_repo_is_lint_clean():
     unwaived, waived = lint_paths([PKG], WAIVERS)
     assert unwaived == [], "\n".join(str(f) for f in unwaived)
-    # waivers stay justified, not a dumping ground
-    assert len(waived) <= 16
+    # waivers stay justified, not a dumping ground (the 9 HZ108 entries
+    # are the catalogued intentional jit sites: the stage cache itself,
+    # the per-op bench baseline, one-shot ml fits and probes)
+    assert len(waived) <= 24
 
 
 def test_lint_cli_main_exit_codes(tmp_path, capsys):

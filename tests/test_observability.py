@@ -318,3 +318,25 @@ def test_analysis_verifier_gauges(spark, mdf):
     assert after["plans_verified"] > before["plans_verified"]
     assert after["plan_verify_ms"] >= before["plan_verify_ms"]
     assert after["plan_verify_ms"] < 60_000  # sanity: ms, not seconds
+
+
+def test_stage_compile_gauges_exported(spark, mdf):
+    """ISSUE 11 observability: the process stage-executable cache rides
+    the session metrics system as the 'compile' Source — compile cost,
+    hit/miss counters, fusion width (ops_per_stage) all live gauges."""
+    ms = spark.metricsSystem
+    before = ms.report()["compile"]
+    for key in ("stage_compile_ms", "stage_cache_hits",
+                "stage_cache_misses", "stage_cache_entries",
+                "stage_dispatches", "stages_fused", "ops_per_stage"):
+        assert key in before, key
+    mdf.groupBy("k").agg(F.sum("v")).collect()
+    mdf.groupBy("k").agg(F.sum("v")).collect()   # second run: warm
+    after = ms.report()["compile"]
+    assert after["stage_dispatches"] > before["stage_dispatches"]
+    assert after["stage_cache_hits"] > before["stage_cache_hits"]
+    assert after["stages_fused"] >= 1
+    assert after["ops_per_stage"] >= 1.0
+    assert after["stage_compile_ms"] >= 0.0
+    # warm reuse must not have built a new executable for the repeat
+    assert after["stage_cache_entries"] >= 1

@@ -485,3 +485,145 @@ def test_admission_stress_bounded_and_conserving(serve_root):
         t0 = time.monotonic()
         srv.stop()
         assert time.monotonic() - t0 < 10, "stop() must not hang"
+
+
+# ---------------------------------------------------------------------------
+# stage-entry caching: distributed/multibatch statements no longer bail
+# ---------------------------------------------------------------------------
+
+def test_multibatch_statement_stage_cached_cross_session(serve_root):
+    """The lifted bailout: a MULTIBATCH statement (streamed scan wider
+    than one device batch) from a SECOND session reports a cache hit —
+    the statement-level stage entry is shared via the plan cache while
+    the compiled stage executables come from the process stage cache."""
+    from spark_tpu.sql.stagecompile import stage_cache
+    serve_root.conf.set(C.SCAN_MAX_BATCH_ROWS.key, "256")
+    cache = PlanCache(serve_root.conf_obj)
+    s1 = serve_root.newSession()
+    s2 = serve_root.newSession()
+    s1._plan_cache = cache
+    s2._plan_cache = cache
+    s1.sql("CREATE TABLE mbst AS SELECT id AS k, id % 7 AS g, "
+           "id * 3 AS v FROM range(2000)")
+    q = "SELECT g, sum(v) AS sv FROM mbst GROUP BY g ORDER BY g"
+    # prove the statement actually routes through the multibatch lane
+    from spark_tpu.sql.multibatch import plan_multibatch
+    from spark_tpu.sql.planner import QueryExecution
+    qe = QueryExecution(s1, s1.sql(q)._plan)
+    assert plan_multibatch(s1, qe.optimized) is not None
+
+    a1 = [tuple(r) for r in s1.sql(q).collect()]
+    assert s1._last_plan_cache_info["hit"] is False
+    assert cache.stats()["stage_misses"] >= 1
+    sc0 = stage_cache().stats()
+    a2 = [tuple(r) for r in s2.sql(q).collect()]
+    sc1 = stage_cache().stats()
+    assert a2 == a1
+    assert s2._last_plan_cache_info["hit"] is True, \
+        "second session's multibatch statement must report cacheHit"
+    assert cache.stats()["stage_hits"] >= 1
+    assert sc1["hits"] > sc0["hits"], \
+        "the warm statement must reuse compiled stage executables"
+    assert sc1["builds"] == sc0["builds"], \
+        "the warm statement must not compile new stages"
+
+    # DML invalidation: INSERT evicts the stage entry; the next run is
+    # a miss and matches a fresh-session oracle
+    inv0 = cache.stats()["invalidations"]
+    s2.sql("INSERT INTO mbst SELECT id AS k, id % 7 AS g, "
+           "id AS v FROM range(10)")
+    assert cache.stats()["invalidations"] > inv0
+    a3 = [tuple(r) for r in s1.sql(q).collect()]
+    assert s1._last_plan_cache_info["hit"] is False
+    oracle_s = serve_root.newSession()
+    oracle = [tuple(r) for r in oracle_s.sql(q).collect()]
+    assert a3 == oracle and a3 != a1
+
+    # SET of a planning conf evicts stage entries built under the old
+    # value (same hygiene rule as whole-plan entries)
+    assert cache.stats()["stage_entries"] >= 1
+    inv1 = cache.stats()["invalidations"]
+    s1.sql("SET spark.tpu.crossproc.autoBroadcastThreshold=54321")
+    assert cache.stats()["invalidations"] > inv1
+    s1.sql("DROP TABLE mbst")
+
+
+def test_status_reports_stage_cache_occupancy(serve_root):
+    srv = SQLServer(serve_root, port=0).start()
+    try:
+        _, s = _req(srv, "/session", "POST")
+        _sql(srv, "SELECT sum(id) AS s FROM range(128)", s["sessionId"])
+        _, st = _req(srv, "/status")
+        assert "stageCache" in st
+        for key in ("entries", "hits", "misses", "compile_ms",
+                    "stages_fused", "ops_per_stage"):
+            assert key in st["stageCache"], key
+        assert st["stageCache"]["entries"] >= 1
+        # plan-cache stats now carry the stage-entry occupancy too
+        assert "stage_entries" in st["planCache"]
+        assert st["metrics"]["serving"]["plan_cache_stage_hits"] >= 0
+        assert st["metrics"]["compile"]["stage_dispatches"] >= 1
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# serving-tier StatsFeedback persistence
+# ---------------------------------------------------------------------------
+
+def test_stats_feedback_shared_across_server_sessions(serve_root):
+    """Observed exchange cardinalities persist across statements AND
+    sessions in the serving tier: the server presets ONE StatsFeedback
+    on every session it opens (crossproc's _session_feedback finds it
+    instead of creating a per-session empty one)."""
+    from spark_tpu.parallel.crossproc import _session_feedback
+    srv = SQLServer(serve_root, port=0)
+    sid1 = srv._open_session()
+    sid2 = srv._open_session()
+    s1 = srv._sessions[sid1].session
+    s2 = srv._sessions[sid2].session
+    assert _session_feedback(s1) is srv._stats_feedback
+    assert _session_feedback(s2) is srv._stats_feedback
+    assert _session_feedback(serve_root) is srv._stats_feedback
+    # recorded in one session, visible in the other
+    _session_feedback(s1).record("sigX", 4096, 17, "xq000001")
+    assert _session_feedback(s2).peek("sigX") == (4096, 17)
+
+
+def test_repeated_misestimated_join_broadcasts_on_second_run(serve_root):
+    """Regression for the serving-tier feedback loop: the probe
+    misestimates both join sides as huge (-> hash/range), the first
+    run's adaptive replanner records the right side's true tiny
+    cardinality, and the SAME join planned again — from a DIFFERENT
+    server session — chooses broadcast_right at plan time."""
+    from spark_tpu.parallel.crossproc import (StatsFeedback,
+                                              _session_feedback,
+                                              choose_join_strategy)
+    srv = SQLServer(serve_root, port=0)
+    s1 = srv._sessions[srv._open_session()].session
+    s2 = srv._sessions[srv._open_session()].session
+    sig = StatsFeedback.signature  # structural: same plan -> same key
+
+    import spark_tpu.sql.logical as L
+    import spark_tpu.types as T
+    from spark_tpu.columnar import ColumnBatch
+    import numpy as np
+    dim = L.LocalRelation(ColumnBatch.from_arrays(
+        {"d": np.arange(8, dtype=np.int64)},
+        schema=T.StructType([T.StructField("d", T.int64)])))
+    r_sig = sig(dim)
+
+    def plan(session):
+        return choose_join_strategy(
+            "inner", True, True, True,
+            broadcast_threshold=1 << 20, n_procs=2,
+            left_bytes=1 << 30, right_bytes=1 << 30,   # the misestimate
+            feedback=_session_feedback(session), right_sig=r_sig)
+
+    # first run: no feedback yet -> the probe's estimate stands
+    assert plan(s1) != "broadcast_right"
+    # the adaptive runtime records the observed tiny right side
+    _session_feedback(s1).record(r_sig, 2048, 8, "xq000002")
+    # second run, other session: plan-time broadcast, no fragmentation —
+    # feedback changes the strategy input, never the plan fingerprint
+    assert plan(s2) == "broadcast_right"
