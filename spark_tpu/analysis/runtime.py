@@ -56,6 +56,7 @@ __all__ = [
     "verify_hash_copartition", "verify_range_cutpoints",
     "verify_span_owners", "verify_skew_split", "verify_presorted_build",
     "verify_unified_dictionaries", "verify_ledger_scope",
+    "verify_recovery_agreement", "verify_epoch_released",
 ]
 
 _STRATEGIES = ("broadcast_left", "broadcast_right", "range", "hash",
@@ -264,6 +265,58 @@ def verify_unified_dictionaries(node, batches: Sequence) -> None:
                     f"column {name!r}: live code {off} outside the "
                     f"unified dictionary of {len(words)} words — the "
                     "code spaces were not unified across the exchange")
+
+
+def verify_recovery_agreement(svc, xid: str, epoch: int) -> None:
+    """After a ``{xid}-recover`` round: every survivor must have derived
+    the SAME epoch and the same agreed-lost set, or the re-planned
+    ownership maps diverge and matching keys land on different
+    processes.  Re-reads the round's manifests (identical bytes on
+    every process) and recomputes the agreement this process should
+    hold; also pins EPOCH MONOTONICITY — the service epoch never moves
+    backward past an agreed round."""
+    rid = f"{xid}-recover{epoch}"
+    agreed = set()
+    seen_epochs = set()
+    for s in range(svc.n):
+        man = svc._read_manifest(rid, s)
+        if man is None:
+            continue
+        agreed.update(int(p) for p in man.get("lost", []))
+        seen_epochs.add(int(man.get("epoch", epoch)))
+    if seen_epochs and seen_epochs != {epoch}:
+        raise PlanInvariantError(
+            rid, "recovery-agreement",
+            f"recover-round manifests carry epochs {sorted(seen_epochs)} "
+            f"!= the agreed epoch {epoch} — survivors are fencing "
+            "different dead epochs")
+    if not agreed <= set(svc.recovered_pids):
+        raise PlanInvariantError(
+            rid, "recovery-agreement",
+            f"agreed-lost pids {sorted(agreed)} not all absorbed into "
+            f"the service's recovered set {sorted(svc.recovered_pids)} — "
+            "this process's live-set view diverged from the round")
+    if int(svc.epoch) < epoch:
+        raise PlanInvariantError(
+            rid, "epoch-monotonicity",
+            f"service epoch {svc.epoch} is behind the agreed epoch "
+            f"{epoch} — a re-executed exchange would reuse dead-epoch "
+            "ids and read stale blocks")
+
+
+def verify_epoch_released(ledger, xid: str) -> None:
+    """Before an epoch re-executes: every ``shuffle:<xid>``-scoped
+    reservation of the aborted epoch must be gone, or the dead epoch's
+    holders silently shrink the re-execution's host budget (the leak
+    the ``release_prefix``-on-abort bugfix closes)."""
+    scope = f"shuffle:{xid}"
+    stale = sorted(o for o in ledger.owners() if o.startswith(scope))
+    if stale:
+        raise PlanInvariantError(
+            "HostMemoryLedger", "dead-epoch-ledger",
+            f"reservation(s) {stale} from the aborted epoch survive "
+            f"into the re-execution of {xid!r} — release_prefix on "
+            "epoch abort did not pair with them")
 
 
 def verify_ledger_scope(ledger, pre_owners, xid: str) -> None:

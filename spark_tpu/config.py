@@ -486,6 +486,18 @@ SHUFFLE_BLACKLIST_ENABLED = conf("spark.tpu.shuffle.blacklistEnabled").doc(
     "hosts named instead of re-paying the barrier timeout."
 ).boolean(True)
 
+RECOVERY_MAX_STAGE_RETRIES = conf("spark.tpu.recovery.maxStageRetries").doc(
+    "Lineage-based stage recovery budget (the DAGScheduler resubmit "
+    "analog): when a cross-process exchange loses a peer past its block "
+    "retry budget, surviving processes agree on the loss through an "
+    "epoch-tagged {xid}-recover manifest round, re-plan reducer "
+    "ownership over the live set, and deterministically re-execute the "
+    "statement's map stages from leaf recipes under a fresh epoch — up "
+    "to this many times per statement before the structured "
+    "ExchangeFetchFailed propagates.  0 = the pre-recovery contract: "
+    "every exhausted fetch aborts the statement bounded."
+).check(lambda v: v >= 0).int(1)
+
 DEBUG_NANS = conf("spark.tpu.debug.nanChecks").doc(
     "Enable jax_debug_nans for the session's process: XLA computations "
     "fail loudly on NaN/Inf production instead of propagating them — the "

@@ -273,12 +273,18 @@ class HostMemoryLedger:
             else:
                 self._held.pop(owner, None)
 
-    def release_prefix(self, prefix: str) -> None:
+    def release_prefix(self, prefix: str) -> int:
         """Drop every reservation whose owner starts with ``prefix`` —
-        the query-exit safety net against leaks on error paths."""
+        the query-exit safety net against leaks on error paths, and the
+        epoch-abort sweep lineage recovery runs BEFORE re-executing a
+        statement (a dead epoch's map staging must not shrink the
+        re-run's budget).  Returns the number of bytes freed so callers
+        can account the sweep (0 = nothing was held under the scope)."""
+        freed = 0
         with self._lock:
             for owner in [o for o in self._held if o.startswith(prefix)]:
-                del self._held[owner]
+                freed += self._held.pop(owner)
+        return freed
 
 
 # ---------------------------------------------------------------------------

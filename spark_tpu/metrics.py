@@ -202,6 +202,9 @@ def default_sources(session) -> List[Source]:
     if svc is not None and hasattr(svc, "metrics_source"):
         # DCN exchange retry/blacklist counters (RetryingBlockReader +
         # peer blacklist; the shuffle-metrics Source of the reference's
-        # ExternalShuffleServiceSource)
+        # ExternalShuffleServiceSource) plus the lineage-recovery gauges
+        # an operator alarms on: stage_retries / recovered_partitions /
+        # recovery_ms / epoch / recovered_peers — a nonzero epoch means
+        # the process set shrank and stayed shrunk
         srcs.append(svc.metrics_source())
     return srcs

@@ -26,10 +26,18 @@ barrier).  So this module is deliberately small:
 - ``ClusterInfo``      → process/host/device topology introspection
   (``SparkContext.statusTracker`` analog).
 
-Failure response is restart-from-checkpoint (streaming WAL / query rerun),
-matching the lineage-free recovery model SURVEY §2.14 prescribes: TPU
-SPMD cannot surgically replace one executor mid-collective the way the
-reference reschedules one lost task.
+Failure response is LAYERED.  The XLA collective plane still cannot
+surgically replace one executor mid-collective — a dead peer there means
+restart-from-checkpoint (streaming WAL / query rerun).  The DCN exchange
+plane, however, recovers in place: ``hostshuffle``/``crossproc`` run the
+reference's lineage model (DAGScheduler stage resubmission) — survivors
+agree on the loss via a ``{xid}-recover`` manifest round, re-plan
+reducer ownership over ``live_view()`` of the process set, and
+re-execute the lost map partitions from deterministic leaf recipes,
+bounded by ``spark.tpu.recovery.maxStageRetries``.  ``HeartbeatMonitor``
+is the detector both layers share: its stale-beat verdicts feed the
+exchange blacklist (via ``default_host_name``) and the recovery round's
+lost set.
 """
 
 from __future__ import annotations
@@ -38,7 +46,7 @@ import json
 import os
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -132,6 +140,22 @@ def default_host_name(process_id: Optional[int] = None) -> str:
     if process_id is None:
         process_id = jax.process_index()
     return f"host-{process_id}"
+
+
+def live_view(n_processes: int, dead_hosts: Sequence[str] = (),
+              recovered_pids: Sequence[int] = ()) -> List[int]:
+    """The live process set as a PURE function of its inputs: every pid
+    whose canonical host name is not in ``dead_hosts`` (heartbeat
+    verdicts) and that is not in ``recovered_pids`` (the exchange
+    plane's agreed-lost set).  Shared by the executor's topology view
+    and by tooling; the exchange planner itself keys only off the
+    AGREED set (``HostShuffleService.live_pids``) because plan inputs
+    must be identical on every survivor, and local heartbeat verdicts
+    are not."""
+    dead = set(dead_hosts)
+    gone = set(recovered_pids)
+    return [p for p in range(n_processes)
+            if p not in gone and default_host_name(p) not in dead]
 
 
 class HeartbeatMonitor:

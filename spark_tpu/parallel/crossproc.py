@@ -276,15 +276,19 @@ def _route_exchange_merge(session, plan, partial_node, partial: ColumnBatch,
     key_refs = [Col(k.name) for k in plan.keys]
     ectx = EvalContext(partial, np)
     h = ectx.broadcast(Hash64(*key_refs).eval(ectx)).data
-    receiver = (np.asarray(h).astype(np.uint64)
-                % np.uint64(svc.n)).astype(np.int32)
+    # key hash → LIVE pid (identity over all pids until a recovery
+    # round shrinks the live set): agreed-lost peers own no key range,
+    # so a re-executed statement never routes state at a ghost
+    lv = np.asarray(svc.live_pids(), np.int32)
+    receiver = lv[(np.asarray(h).astype(np.uint64)
+                   % np.uint64(len(lv))).astype(np.int64)]
     # one bucketing kernel instead of n per-receiver mask/compact passes:
     # rows sort by receiver id (dead rows to the tail), then each block
     # is a zero-copy contiguous slice of the single bucketed batch
     bucketed, off, cnt = partition_host_slices(np, partial, receiver,
                                                svc.n)
-    routed = {r: [slice_rows(bucketed, int(off[r]), int(cnt[r]))]
-              for r in range(svc.n)}
+    routed = {int(r): [slice_rows(bucketed, int(off[r]), int(cnt[r]))]
+              for r in lv}
     try:
         received = svc.exchange(xid, routed)
     except ExchangeFetchFailed:
@@ -429,6 +433,29 @@ def _leaf_batches(session, node, out: List[ColumnBatch]) -> None:
         out.append(compact(np, read_file_relation(node, session).to_host()))
 
 
+def _harvest_leaf_recipes(node) -> List[dict]:
+    """The deterministic re-read recipe of every leaf, in
+    ``_leaf_batches`` order: a ``FileRelation`` re-reads its path from
+    the shared filesystem (the lineage a survivor can re-execute for a
+    dead peer), a ``LocalRelation`` lives only in this process's memory
+    (``kind: local`` — unrecoverable once the process dies)."""
+    from ..sql import logical as L
+    out: List[dict] = []
+
+    def walk(nd):
+        for c in nd.children:
+            walk(c)
+        if isinstance(nd, L.FileRelation):
+            ps = [str(p) for p in getattr(nd, "paths", None) or ()]
+            out.append({"kind": "file", "fmt": nd.fmt, "paths": ps} if ps
+                       else {"kind": "local"})
+        elif isinstance(nd, L.LocalRelation):
+            out.append({"kind": "local"})
+
+    walk(node)
+    return out
+
+
 def _leaf_partition_flags(session, node, svc: HostShuffleService,
                           xid: str,
                           batches_out: Optional[List[ColumnBatch]] = None,
@@ -441,7 +468,13 @@ def _leaf_partition_flags(session, node, svc: HostShuffleService,
     leaf's raw byte size, so every process learns every leaf's GLOBAL
     volume (partitioned: summed across processes; replicated: one copy)
     — the statistics the broadcast-threshold planner reads;
-    ``sizes_out`` receives them per leaf."""
+    ``sizes_out`` receives them per leaf.
+
+    The probe's commit manifests additionally carry every sender's LEAF
+    RECIPES (``_harvest_leaf_recipes``): if a peer dies later in the
+    statement, survivors re-execute its map stage from the recipe it
+    published here — the lineage half of stage recovery, riding the
+    round that already exists."""
     batches: List[ColumnBatch] = []
     _leaf_batches(session, node, batches)
     if batches_out is not None:
@@ -458,7 +491,17 @@ def _leaf_partition_flags(session, node, svc: HostShuffleService,
          ColumnVector(digests, T.int64, None, None),
          ColumnVector(nbytes, T.int64, None, None)],
         None, len(digests))
-    received = svc.exchange(xid, {r: [probe] for r in range(svc.n)})
+    received = svc.exchange(
+        xid, {r: [probe] for r in range(svc.n)},
+        extra={"recipes": _harvest_leaf_recipes(node),
+               "epoch": svc.epoch})
+    # harvest every surviving sender's recipes; setdefault keeps the
+    # statement's FIRST (pre-loss) recipes through epoch re-runs, and
+    # ``begin_statement`` clears them between statements
+    for s in range(svc.n):
+        man = svc._read_manifest(xid, s)
+        if man is not None and isinstance(man.get("recipes"), list):
+            svc.leaf_recipes.setdefault(s, man["recipes"])
     flags = np.zeros(len(digests), bool)
     totals = np.zeros(len(digests), np.int64)
     n_seen = 0
@@ -813,12 +856,16 @@ def _shuffled_join_shards(session, join, key_pairs,
             sink = FetchSink(svc, f"shuffle:{xid}:{tag}-fetch",
                              f"{xid}-{tag}", sdir)
             try:
+                # group g of the shared bounds belongs to the g-th LIVE
+                # process (group_owner) — after a recovery epoch the
+                # owner list skips agreed-lost pids, so no block is ever
+                # addressed to a dead receiver
                 if side.kind == "mem":
                     routed: Dict[int, List[ColumnBatch]] = {}
                     for g, (lo, hi) in enumerate(zip(bounds, bounds[1:])):
                         n_rows = int(side.cnt[lo:hi].sum())
                         if n_rows:
-                            routed[g] = [slice_rows(
+                            routed[svc.group_owner(g)] = [slice_rows(
                                 side.bucketed, int(side.off[lo]), n_rows)]
                     received = _exchange_with_refetch(
                         svc, f"{xid}-{tag}", routed, sink=sink)
@@ -830,9 +877,11 @@ def _shuffled_join_shards(session, join, key_pairs,
                     for g, (lo, hi) in enumerate(zip(bounds, bounds[1:])):
                         length = side.offsets[hi] - side.offsets[lo]
                         if length:
-                            parts_routed[g] = [(side.offsets[lo], length)]
-                            meta[g] = (int(side.raw[lo:hi].sum()),
-                                       int(side.rows[lo:hi].sum()))
+                            owner = svc.group_owner(g)
+                            parts_routed[owner] = [(side.offsets[lo],
+                                                    length)]
+                            meta[owner] = (int(side.raw[lo:hi].sum()),
+                                           int(side.rows[lo:hi].sum()))
                     received = _exchange_spilled_with_refetch(
                         svc, f"{xid}-{tag}", side.path, parts_routed,
                         meta, sink=sink)
@@ -863,7 +912,8 @@ def _shuffled_join_shards(session, join, key_pairs,
         from ..analysis import runtime as _az
         if _az.runtime_checks_enabled(session):
             _az.verify_hash_copartition(join, key_pairs, bounds, n_fine,
-                                        svc.pid, shards[0], shards[1])
+                                        svc.live_pids().index(svc.pid),
+                                        shards[0], shards[1])
             _az.verify_unified_dictionaries(join, shards)
         return shards[0], shards[1], None
     finally:
@@ -1104,7 +1154,8 @@ def _adaptive_redecide(join, svc: HostShuffleService, xid: str,
     hang, never a partial result."""
     if adaptive is None:
         return frozen
-    observed = observed_side_stats(mans, svc.n)
+    n_live = len(svc.live_pids())
+    observed = observed_side_stats(mans, n_live)
     if observed is None:
         return frozen
     svc.counters["adaptive_replans"] += 1
@@ -1116,14 +1167,14 @@ def _adaptive_redecide(join, svc: HostShuffleService, xid: str,
             adaptive.feedback.record(adaptive.right_sig, observed[2],
                                      observed[3], xid)
     decision = adaptive_join_decision(
-        frozen, join.how, adaptive.broadcast_threshold, svc.n, observed)
+        frozen, join.how, adaptive.broadcast_threshold, n_live, observed)
     if adaptive.checks:
         from ..analysis import runtime as _az
         _az.verify_join_strategy(
             join, decision, frozen == "range", adaptive.key_pairs,
             frozen=frozen, observed=observed,
             broadcast_threshold=adaptive.broadcast_threshold,
-            n_procs=svc.n)
+            n_procs=n_live)
     if decision != frozen:
         svc.counters["strategy_demotions"] += 1
     return decision
@@ -1533,30 +1584,193 @@ def _range_merge_join_shards(session, join, spec,
         shutil.rmtree(sdir, ignore_errors=True)
 
 
+def _unrecoverable(xid: str, hosts: List[str], detail: str
+                   ) -> ExchangeFetchFailed:
+    err = ExchangeFetchFailed(xid, hosts, [], detail=detail)
+    err.recoverable = False
+    return err
+
+
+def _require_recoverable(svc: HostShuffleService, flags: List[bool]
+                         ) -> None:
+    """Post-loss admissibility of a statement: with agreed-lost peers in
+    the roster, a PARTITIONED statement is answerable only if every lost
+    pid published a file recipe for every partitioned leaf (lineage to
+    re-read its partition from).  Checks recipes ONLY — never the local
+    leaf node type, which legitimately becomes a ``LocalRelation`` on
+    the adopter after a re-execution.  Replicated-only statements always
+    pass: every survivor holds complete copies."""
+    if not svc.recovered_pids or not any(flags):
+        return
+    for p in sorted(svc.recovered_pids):
+        rec = svc.leaf_recipes.get(p)
+        for i, partitioned in enumerate(flags):
+            if not partitioned:
+                continue
+            r = rec[i] if rec is not None and i < len(rec) else None
+            if not (isinstance(r, dict) and r.get("kind") == "file"
+                    and r.get("paths")):
+                raise _unrecoverable(
+                    "recovery", [svc.host_name(p)],
+                    f"statement reads partitioned leaf {i} but lost "
+                    f"pid {p} left no file recipe for its partition — "
+                    "the result would silently drop its rows; aborting "
+                    "structured instead")
+
+
+def _recover_epoch(session, svc: HostShuffleService, xid: str,
+                   epoch: int, err: ExchangeFetchFailed,
+                   checks: bool) -> None:
+    """One agreed recovery step after a lost exchange: map the failure's
+    lost hosts (plus locally blacklisted peers) to pids, run the
+    ``{xid}-recover`` agreement round, verify the agreement, and drop
+    every host-memory reservation the dead epoch staged so the
+    re-execution starts from a clean ledger."""
+    lost_now = set()
+    for p in range(svc.n):
+        if p == svc.pid or p in svc.recovered_pids:
+            continue
+        if svc.host_name(p) in err.lost_hosts or p in svc.blacklist:
+            lost_now.add(p)
+    svc.recover_round(xid, epoch, lost_now)
+    from ..analysis import runtime as _az
+    if checks:
+        _az.verify_recovery_agreement(svc, xid, epoch)
+    # the aborted epoch's reservations (map staging, fetch sinks) must
+    # not shrink the re-execution's budget — release them NOW, not at
+    # statement exit
+    svc.ledger.release_prefix(f"shuffle:{xid}")
+    if checks:
+        _az.verify_epoch_released(svc.ledger, xid)
+    with svc._lock:
+        svc.counters["stage_retries"] += 1
+        svc.counters["recovered_partitions"] += max(
+            1, len(err.lost_blocks))
+
+
+def _adopt_lost_leaves(session, optimized, svc: HostShuffleService):
+    """Re-derive the statement's plan for re-execution over the live
+    set: for every PARTITIONED leaf (the statement's first probe-round
+    flags), the survivor that ``recovery_adopt`` assigns a lost pid
+    re-reads that pid's partition from its published leaf recipe and
+    unions it into its own leaf — the deterministic map-stage re-run
+    the recipe exists for.  Always starts from the PRISTINE optimized
+    plan (adoption composes across epochs by re-deriving, never by
+    mutating a mutated plan).  Raises a NON-recoverable structured
+    failure when lineage cannot cover the loss: recipes never published
+    (peer died before the probe round), a lost partition backed only by
+    process memory, or a surviving leaf with no file template to re-read
+    through."""
+    if not svc.recovered_pids:
+        return optimized
+    from ..sql import logical as L
+    flags = svc.last_leaf_flags
+    if flags is None:
+        raise _unrecoverable(
+            "recovery", [svc.host_name(p)
+                         for p in sorted(svc.recovered_pids)],
+            "peer lost before the statement's leaf recipes were "
+            "published — no lineage to re-execute its map stage from")
+    if not any(flags):
+        # replicated-only statement: every survivor holds complete
+        # copies; nothing to adopt
+        return optimized
+    # the agreed guard: every lost pid must have published a FILE recipe
+    # for every partitioned leaf, or its rows are unrecoverable
+    _require_recoverable(svc, flags)
+    leaves: List = []
+
+    def collect(nd):
+        for c in nd.children:
+            collect(c)
+        if isinstance(nd, (L.LocalRelation, L.FileRelation)):
+            leaves.append(nd)
+
+    collect(optimized)
+    mine = [p for p in sorted(svc.recovered_pids)
+            if svc.recovery_adopt.get(p) == svc.pid]
+    plan = optimized
+    if not mine:
+        return plan
+    from ..io import read_file_relation
+    import copy as _copy
+    for i, partitioned in enumerate(flags):
+        if not partitioned or i >= len(leaves):
+            continue
+        leaf = leaves[i]
+        if not isinstance(leaf, L.FileRelation):
+            raise _unrecoverable(
+                "recovery", [svc.host_name(p) for p in mine],
+                f"adopter's leaf {i} is in-memory while the lost "
+                "partition is a file — no template to re-read the "
+                "recipe through")
+        parts = [compact(np, read_file_relation(leaf, session).to_host())]
+        for p in mine:
+            ghost = _copy.copy(leaf)
+            ghost.paths = list(svc.leaf_recipes[p][i]["paths"])
+            parts.append(compact(np, read_file_relation(
+                ghost, session).to_host()))
+        merged = union_all(parts) if len(parts) > 1 else parts[0]
+        plan = _replace_node(plan, leaf, L.LocalRelation(merged))
+    return plan
+
+
 def crossproc_execute(session, optimized, svc: HostShuffleService
                       ) -> ColumnBatch:
     """Execute one optimized plan across processes through the host
     shuffle service; every process returns the SAME complete result (the
-    single-controller collect() contract)."""
+    single-controller collect() contract).
+
+    Failure semantics: bounded RECOVER, then abort.  A structured
+    ``ExchangeFetchFailed`` no longer kills the statement outright —
+    up to ``spark.tpu.recovery.maxStageRetries`` times, the survivors
+    agree on the loss (``recover_round``), re-plan ownership over the
+    live set, adopt the dead peer's partitioned leaves from its
+    published recipes, and re-execute the whole statement under a fresh
+    epoch-suffixed exchange-id family (``{xid}e<epoch>`` — single-use
+    ids make the dead epoch's stale blocks unreachable by
+    construction).  A failure the machinery cannot recover (declared
+    lost by peers, diverged agreement, memory-only lineage) carries
+    ``recoverable=False`` and aborts immediately; with the budget at 0
+    the pre-recovery contract is byte-for-byte intact."""
     seq = getattr(session, "_crossproc_seq", 0) + 1
     session._crossproc_seq = seq
     xid = f"xq{seq:06d}"
     from ..analysis import runtime as _az
     checks = _az.runtime_checks_enabled(session)
-    pre_owners = set(svc.ledger.owners()) if checks else set()
+    svc.begin_statement()
+    plan = optimized
+    epoch = 0
     try:
-        result = _crossproc_execute(session, optimized, svc, xid)
-        if checks:
-            # on SUCCESS only (the finally below releases either way):
-            # every reservation the exchanges staged must sit under the
-            # shuffle:<xid> scope, or release_prefix cannot pair it
-            _az.verify_ledger_scope(svc.ledger, pre_owners, xid)
-        return result
+        while True:
+            run_xid = xid if epoch == 0 else f"{xid}e{epoch}"
+            pre_owners = set(svc.ledger.owners()) if checks else set()
+            try:
+                result = _crossproc_execute(session, plan, svc, run_xid)
+                if checks:
+                    # on SUCCESS only (the finally below releases either
+                    # way): every reservation the exchanges staged must
+                    # sit under the shuffle:<xid> scope, or
+                    # release_prefix cannot pair it
+                    _az.verify_ledger_scope(svc.ledger, pre_owners, xid)
+                return result
+            except ExchangeFetchFailed as err:
+                if epoch >= svc.max_stage_retries \
+                        or not getattr(err, "recoverable", True):
+                    raise
+                epoch += 1
+                # agreement/adoption failures raise non-recoverable
+                # structured errors of their own and propagate — the
+                # recovery path never retries itself
+                _recover_epoch(session, svc, xid, epoch, err, checks)
+                plan = _adopt_lost_leaves(session, optimized, svc)
     finally:
         # every host-memory reservation this query staged (map-side
-        # bucketed output, fetched blocks) is scoped to the query: on
-        # success the shards have been consumed, on failure nothing may
-        # leak into the next statement's budget
+        # bucketed output, fetched blocks) is scoped to the query —
+        # epoch-suffixed owners share the shuffle:<xid> string prefix,
+        # so one release pairs with every epoch: on success the shards
+        # have been consumed, on failure nothing may leak into the next
+        # statement's budget
         svc.ledger.release_prefix(f"shuffle:{xid}")
 
 
@@ -1603,14 +1817,25 @@ def _crossproc_execute(session, optimized, svc: HostShuffleService,
     leaf_cache: List[ColumnBatch] = []
     leaf_sizes: List[int] = []
     flags: Optional[List[bool]] = None
-    if maybe_fast or join is not None:
+    if maybe_fast or join is not None or svc.recovered_pids:
         # one digest exchange classifies every leaf (partitioned vs
         # replicated) and carries per-leaf global byte sizes (broadcast
         # statistics); the execution shapes key off it, and the generic
-        # fallback reuses the materialized batches
+        # fallback reuses the materialized batches.  After a loss the
+        # probe runs unconditionally: a fresh statement must learn
+        # whether it is partitioned (then the lost pid's rows are
+        # unknowable — abort structured) or replicated-only (survivors
+        # hold complete copies — proceed)
         flags = _leaf_partition_flags(session, node, svc,
                                       f"{xid}-digest", leaf_cache,
                                       leaf_sizes)
+    if flags is not None:
+        if svc.last_leaf_flags is None:
+            # the statement's epoch-0 classification — recovery keys
+            # adoption off THESE flags, not a re-run's (the adopter's
+            # leaf turns into a LocalRelation on re-execution)
+            svc.last_leaf_flags = list(flags)
+        _require_recoverable(svc, flags)
 
     # fast-path precondition: EXACTLY one partitioned leaf (the fact);
     # every join beyond it partition-safe given the replication flags
@@ -1739,7 +1964,7 @@ def _crossproc_execute(session, optimized, svc: HostShuffleService,
             hits0 = feedback.hits
         strategy = choose_join_strategy(
             join.how, range_spec is not None, smj_on, shuffled_on,
-            eff_threshold, svc.n,
+            eff_threshold, len(svc.live_pids()),
             sum(leaf_sizes[:ln]), sum(leaf_sizes[ln:ln + rn]),
             feedback=feedback, left_sig=l_sig, right_sig=r_sig)
         if adaptive_on:

@@ -214,6 +214,21 @@ class DistributedExecution:
 
     MAX_ADAPT = ADAPT_MAX_RETRIES
 
+    def live_view(self):
+        """The post-failure process topology this executor would serve:
+        ``cluster.live_view`` over the session's heartbeat verdicts and
+        the exchange plane's agreed-lost set.  Purely observational here
+        — the shard_map program itself cannot drop a participant
+        mid-collective (XLA restarts from checkpoint); the DCN exchange
+        lanes in ``crossproc`` are the layer that actually re-plans over
+        this set."""
+        from .cluster import live_view as _lv
+        svc = getattr(self.session, "_crossproc_svc", None)
+        hb = getattr(svc, "heartbeat", None) if svc is not None else None
+        dead = hb.dead_hosts() if hb is not None else ()
+        gone = sorted(svc.recovered_pids) if svc is not None else ()
+        return _lv(self.n, dead, gone)
+
     def execute(self, optimized: LogicalPlan) -> ColumnBatch:
         """Run with adaptive capacity retry: when an exchange bucket or a
         join output overflows its static capacity, replan with factors

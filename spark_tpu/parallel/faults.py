@@ -24,6 +24,11 @@ side; rules fire when a matching block is published:
 - ``die_after_put``  the PROCESS exits hard right after publishing
                 (peer killed mid-exchange); used via the env plan by
                 subprocess workers.
+- ``die_after_manifest``  the PROCESS exits hard right after writing a
+                commit marker / manifest for the addressed exchange
+                (killed between the coordination round and the data it
+                promised — the post-``publish_sizes`` and
+                mid-recovery-round kill points of the chaos matrix).
 - ``disk_full``  spill writes (``svc.spill_write``) raise
                 ``OSError(ENOSPC)`` once this process has spilled
                 ``after_bytes`` cumulative bytes — the disk backing the
@@ -51,7 +56,7 @@ __all__ = ["FaultInjector", "FaultPlan", "FAULT_PLAN_ENV"]
 FAULT_PLAN_ENV = "SPARK_TPU_FAULT_PLAN"
 
 _KINDS = ("drop", "truncate", "corrupt", "delay", "skip_commit",
-          "die_after_put", "disk_full")
+          "die_after_put", "die_after_manifest", "disk_full")
 
 
 class _Rule:
@@ -134,6 +139,15 @@ class FaultPlan:
         r = _Rule("die_after_put", exchange, None, once=True)
         r.keep_bytes = 1 if commit_first else 0   # reuse slot as the flag
         self.rules.append(r)
+        return self
+
+    def die_after_manifest(self, exchange: Optional[str] = None
+                           ) -> "FaultPlan":
+        """Exit hard right AFTER the commit marker / manifest for the
+        addressed exchange hits the filesystem: peers see this process
+        as a round participant, then it is gone."""
+        self.rules.append(_Rule("die_after_manifest", exchange, None,
+                                once=True))
         return self
 
     def disk_full(self, after_bytes: int = 0,
@@ -230,14 +244,26 @@ class FaultInjector:
                           flush=True)
                     os._exit(43)
 
-        def commit(exchange):
+        def _die_after_manifest(exchange):
+            for rule in injector.plan.rules:
+                if rule.kind == "die_after_manifest" \
+                        and rule.matches(exchange, None):
+                    rule.fired += 1
+                    injector.injected.append(
+                        f"die_after_manifest:{exchange}")
+                    print(f"[faults] dying after manifest in "
+                          f"{exchange!r}", flush=True)
+                    os._exit(43)
+
+        def commit(exchange, extra=None):
             for rule in injector.plan.rules:
                 if rule.kind == "skip_commit" \
                         and rule.matches(exchange, None):
                     rule.fired += 1
                     injector.injected.append(f"skip_commit:{exchange}")
                     return                        # marker never written
-            orig_commit(exchange)
+            orig_commit(exchange, extra=extra)
+            _die_after_manifest(exchange)
 
         orig_spill = getattr(svc, "spill_write", None)
         spilled_total = [0]
@@ -269,6 +295,7 @@ class FaultInjector:
                         and rule.exchange is not None \
                         and rule.matches(exchange, None):
                     injector._apply(rule, path, f"{exchange}/s{svc.pid}.done")
+            _die_after_manifest(exchange)
             return n
 
         svc.put = put

@@ -291,6 +291,30 @@ class FetchSink:
                 self._evict_sender(sender)
 
 
+class _RetryBudget:
+    """Shared retry allowance for one (exchange, sender) pair.
+
+    Without it, N fetch-pool threads each retrying ``max_retries`` times
+    against the SAME dead host multiply the worst-case wall-clock by the
+    pool width before blacklisting can kick in.  Each sleep consumes one
+    token from the shared pool; an exhausted pool converts the next
+    would-be retry into an immediate ``BlockFetchError``, so the total
+    backoff paid per dead peer is bounded by the budget, not by
+    budget × threads."""
+
+    def __init__(self, total: int):
+        self.total = total
+        self._left = total
+        self._lock = threading.Lock()
+
+    def try_acquire(self) -> bool:
+        with self._lock:
+            if self._left <= 0:
+                return False
+            self._left -= 1
+            return True
+
+
 class RetryingBlockReader:
     """Re-reads one filesystem block until it is whole or hopeless.
 
@@ -339,12 +363,16 @@ class RetryingBlockReader:
 
     def read(self, path: str, expect_size: Optional[int] = None,
              deadline: Optional[float] = None,
-             decode: Optional[Callable[[bytes], Any]] = None):
+             decode: Optional[Callable[[bytes], Any]] = None,
+             budget: Optional[_RetryBudget] = None):
         """Decoded payload of ``path``; ``expect_size`` is the sender's
         manifested byte size (mismatch = partial write, retried).
         ``decode`` overrides the block decoder (dictionary sidecars and
         the dedup-aware per-sender closures use this); whatever it
-        raises classifies through the same RETRYABLE/fail-fast split."""
+        raises classifies through the same RETRYABLE/fail-fast split.
+        ``budget`` is a shared ``_RetryBudget`` consumed one token per
+        backoff sleep — the cap that keeps N pool threads from each
+        paying the full retry schedule against one dead sender."""
         last: Optional[BaseException] = None
         for attempt in range(self.max_retries + 1):
             try:
@@ -357,6 +385,11 @@ class RetryingBlockReader:
                 raise BlockFetchError(path, attempt + 1, repr(e))
             if attempt >= self.max_retries:
                 break
+            if budget is not None and not budget.try_acquire():
+                raise BlockFetchError(
+                    path, attempt + 1,
+                    f"shared retry budget exhausted "
+                    f"({budget.total} total): {last!r}")
             wait = min(self.retry_wait_s * (2 ** attempt)
                        * _jitter(path, attempt),
                        self.attempt_timeout_s)
@@ -448,6 +481,13 @@ class HostShuffleService:
             # wait for in-flight-bytes room
             "spill_bytes": 0, "spill_events": 0,
             "fetch_backpressure_waits": 0,
+            # lineage-based stage recovery (DAGScheduler resubmit
+            # analog): recovery rounds agreed, statements that re-ran a
+            # stage under a fresh epoch, partitions re-executed from
+            # leaf recipes, and fetches cut short by the shared
+            # per-sender retry budget
+            "recovery_rounds": 0, "stage_retries": 0,
+            "recovered_partitions": 0, "retry_budget_exhausted": 0,
         }
         #: reduce-partition byte sizes of the most recent ``plan_reducers``
         #: / ``plan_range_reducers`` call (manifest-summed), feeding the
@@ -461,8 +501,33 @@ class HostShuffleService:
         #: reader pool — surfaced as gauges next to the byte counters
         self.timers: Dict[str, float] = {
             "encode_s": 0.0, "write_s": 0.0, "decode_s": 0.0,
-            "fetch_s": 0.0, "commit_wait_s": 0.0,
+            "fetch_s": 0.0, "commit_wait_s": 0.0, "recovery_s": 0.0,
         }
+        # -- lineage-based stage recovery state --------------------------
+        #: recovery budget per statement (0 = pre-recovery contract)
+        self.max_stage_retries = conf.get(C.RECOVERY_MAX_STAGE_RETRIES)
+        #: pids every survivor AGREED are lost (via a {xid}-recover
+        #: round).  Unlike ``blacklist`` — which is local suspicion —
+        #: membership here is part of the shared planning state: it
+        #: persists across statements (dead is dead) and every live
+        #: planning decision derives from it identically on all peers.
+        self.recovered_pids: set = set()
+        #: current recovery epoch; re-executed exchanges run under ids
+        #: suffixed "e<epoch>", so stale blocks from the dead epoch live
+        #: in different directories and are never read (epoch fencing
+        #: for free, courtesy of single-use exchange ids)
+        self.epoch = 0
+        #: deterministic leaf recipes gathered on the statement's probe
+        #: round: sender pid → list of {"kind": "file"|"local", ...};
+        #: a file recipe lets a survivor re-execute the dead peer's map
+        #: stage from source
+        self.leaf_recipes: Dict[int, list] = {}
+        #: partitioned-leaf flags of the statement's last probe round
+        #: (which leaves need adoption on re-execution)
+        self.last_leaf_flags = None
+        #: lost pid → adopting live pid, derived deterministically from
+        #: ``recovered_pids`` after each agreed round
+        self.recovery_adopt: Dict[int, int] = {}
         self._lock = threading.Lock()
         if ledger is None:
             from ..memory import HostMemoryLedger
@@ -624,11 +689,14 @@ class HostShuffleService:
                 self._write_errors = []
                 raise err
 
-    def commit(self, exchange: str) -> None:
+    def commit(self, exchange: str,
+               extra: Optional[dict] = None) -> None:
         """All of this sender's blocks are published.  The marker carries
         a manifest (receiver → block byte size, the MapStatus analog) so
         readers can tell a dropped/truncated block from a sender that
-        simply had nothing for them."""
+        simply had nothing for them.  ``extra`` merges additional JSON
+        payload keys into the marker — coordination data (leaf recipes
+        for lineage recovery) rides the commit round for free."""
         t0 = time.perf_counter()
         self.flush(exchange)
         with self._lock:
@@ -639,6 +707,8 @@ class HostShuffleService:
         man = {"ts": time.time(),
                "host": self.host_name(self.pid),
                "blocks": {str(r): sz for r, sz in staged.items()}}
+        if extra:
+            man.update(extra)
         if refs:
             # dictionary sidecar: every word list this sender's blocks
             # reference by fingerprint, shipped once — published (atomic
@@ -917,16 +987,20 @@ class HostShuffleService:
         Returns contiguous group BOUNDS ``b`` of length n_groups+1
         (``b[0]=0``, ``b[-1]=n_fine``); group ``g`` covers fine
         partitions ``[b[g], b[g+1])`` and is owned by process ``g``,
-        with n_groups ≤ n_processes.  With a positive target, adjacent
-        fine partitions accumulate until the running total reaches the
-        target (tiny neighbors coalesce, counted); with target 0 the
-        split is static and even.  Deterministic in the inputs, so all
-        processes agree without communicating."""
+        with n_groups ≤ n_live_processes — group ``g`` is owned by the
+        g-th LIVE process (``group_owner``), so a recovery round that
+        shrinks the live set re-derives ownership here with no extra
+        coordination.  With a positive target, adjacent fine partitions
+        accumulate until the running total reaches the target (tiny
+        neighbors coalesce, counted); with target 0 the split is static
+        and even.  Deterministic in the inputs, so all processes agree
+        without communicating."""
         sizes = np.asarray(sizes, np.int64)
         n_fine = len(sizes)
+        n_live = len(self.live_pids())
         if target_bytes <= 0:
-            bounds = sorted({round(g * n_fine / self.n)
-                             for g in range(self.n + 1)})
+            bounds = sorted({round(g * n_fine / n_live)
+                             for g in range(n_live + 1)})
             coalesced = 0
         else:
             bounds = [0]
@@ -934,7 +1008,7 @@ class HostShuffleService:
             coalesced = 0
             for i in range(n_fine):
                 if i > bounds[-1]:           # current group is non-empty
-                    if acc >= target_bytes and len(bounds) < self.n:
+                    if acc >= target_bytes and len(bounds) < n_live:
                         bounds.append(i)
                         acc = 0
                     elif acc < target_bytes:
@@ -1017,9 +1091,10 @@ class HostShuffleService:
 
         owners: List[List[int]] = [[] for _ in range(n_spans)]
         loads = [0] * self.n
+        live = self.live_pids()      # recovery-agreed live set only
 
         def least_loaded(k: int) -> List[int]:
-            return sorted(range(self.n), key=lambda p: (loads[p], p))[:k]
+            return sorted(live, key=lambda p: (loads[p], p))[:k]
 
         for kind, spans in work:
             if kind == "run":
@@ -1029,7 +1104,7 @@ class HostShuffleService:
                 loads[p] += int(sum(int(totals[s]) for s in spans))
             else:
                 s = spans[0]
-                k = int(min(self.n, max(
+                k = int(min(len(live), max(
                     2, int(np.ceil(float(totals[s]) / split_target)))))
                 ps = least_loaded(k)
                 owners[s] = ps
@@ -1082,6 +1157,89 @@ class HostShuffleService:
                 return
             self.blacklist[pid] = reason
             self.counters["peers_blacklisted"] += 1
+
+    # -- lineage-based stage recovery ------------------------------------
+    def begin_statement(self) -> None:
+        """Reset per-statement recovery state.  ``recovered_pids`` and
+        ``epoch`` deliberately survive: an agreed-dead peer stays dead
+        for every later statement of the session (live planning keeps
+        excluding it), but leaf recipes and adoption belong to one
+        statement's plan only."""
+        self.leaf_recipes = {}
+        self.last_leaf_flags = None
+        self.recovery_adopt = {}
+
+    def live_pids(self) -> List[int]:
+        """The process ids every live planning decision runs over:
+        everyone NOT agreed-lost through a recovery round.  Locally
+        blacklisted-but-unagreed peers stay in — planning must be a pure
+        function of SHARED state or survivors diverge."""
+        return [p for p in range(self.n) if p not in self.recovered_pids]
+
+    def group_owner(self, g: int) -> int:
+        """Owner pid of hash-reducer group ``g``: the g-th LIVE process.
+        Identity mapping until a recovery round shrinks the live set."""
+        return self.live_pids()[g]
+
+    def recover_round(self, xid: str, epoch: int, lost_now: set) -> None:
+        """The ``{xid}-recover`` agreement round: every survivor
+        publishes the loss it observed, barriers, and derives the SAME
+        lost-pid union — the decentralized stand-in for the driver's
+        single view of a FetchFailedException.
+
+        Raises a non-recoverable ``ExchangeFetchFailed`` when agreement
+        is impossible: a peer that reached the barrier but is in
+        someone's lost set AND published nothing consistent (divergence),
+        or this process itself was declared lost by the others (it must
+        abort, not re-execute as a ghost).  A peer that dies DURING this
+        round is excluded by the barrier without having been named lost
+        by anyone pre-round — detected as divergence, structured abort,
+        never a hang."""
+        t0 = self._clock()
+        for p in lost_now:
+            self._blacklist_peer(p, f"recovery round {xid!r} epoch {epoch}")
+        rid = f"{xid}-recover{epoch}"
+        self.publish_manifest(
+            rid, {"epoch": epoch, "lost": sorted(int(p) for p in lost_now)})
+        mans, _nbytes = self.gather_manifests(rid, strict=True)
+        agreed: set = set()
+        max_epoch = epoch
+        for man in mans.values():
+            agreed.update(int(p) for p in man.get("lost", []))
+            max_epoch = max(max_epoch, int(man.get("epoch", epoch)))
+        participants = set(mans)
+        stray = (set(range(self.n)) - participants
+                 - agreed - self.recovered_pids)
+        if stray:
+            err = ExchangeFetchFailed(
+                rid, [self.host_name(p) for p in sorted(stray)], [],
+                elapsed_s=self._clock() - t0,
+                detail="recovery round diverged: peers "
+                       f"{sorted(stray)} neither participated nor were "
+                       "named lost — no consistent live set exists")
+            err.recoverable = False
+            raise err
+        if self.pid in agreed:
+            err = ExchangeFetchFailed(
+                rid, [self.host_name(self.pid)], [],
+                elapsed_s=self._clock() - t0,
+                detail="this process was declared lost by its peers; "
+                       "aborting instead of re-executing as a ghost")
+            err.recoverable = False
+            raise err
+        with self._lock:
+            self.recovered_pids |= agreed
+            self.epoch = max(self.epoch, max_epoch)
+            self.counters["recovery_rounds"] += 1
+            self.timers["recovery_s"] += self._clock() - t0
+        for p in agreed:
+            self._blacklist_peer(p, f"agreed lost in {rid!r}")
+        # deterministic adoption: lost pids round-robin over the live
+        # set, derived from agreed state only — identical on every peer
+        live = self.live_pids()
+        self.recovery_adopt = {
+            p: live[i % len(live)]
+            for i, p in enumerate(sorted(self.recovered_pids))}
 
     def _pool(self, n_tasks: int) -> ThreadPoolExecutor:
         return ThreadPoolExecutor(
@@ -1170,6 +1328,11 @@ class HostShuffleService:
         for s in range(self.n):
             if s == self.pid:
                 continue
+            if s in self.recovered_pids:
+                # agreed-lost in a recovery round: its partitions were
+                # re-assigned to survivors — nothing to fetch, and NOT a
+                # loss (counting it would re-fail every re-execution)
+                continue
             path = self._part(exchange, s, self.pid)
             if s in excluded:
                 lost_hosts.append(self.host_name(s))
@@ -1189,6 +1352,11 @@ class HostShuffleService:
         results: Dict[int, List[ColumnBatch]] = {}
         if work:
             tf0 = time.perf_counter()
+            # ONE shared retry budget per sender: pool threads fetching
+            # several blocks from the same dead peer split its allowance
+            # instead of each paying the full backoff schedule
+            budgets = {s: _RetryBudget(self._reader.max_retries)
+                       for s, _p, _sz, _h in work}
 
             def fetch_one(item):
                 s, path, size, _host = item
@@ -1198,7 +1366,8 @@ class HostShuffleService:
                     batches = self._reader.read(
                         path, expect_size=size, deadline=deadline,
                         decode=lambda d, s=s: self._decode_with_dicts(
-                            exchange, s, d, deadline))
+                            exchange, s, d, deadline),
+                        budget=budgets[s])
                     if sink is not None:
                         sink.add(s, batches)
                         batches = []
@@ -1212,7 +1381,11 @@ class HostShuffleService:
                     try:
                         s, batches = fut.result()
                         results[s] = batches
-                    except BlockFetchError:
+                    except BlockFetchError as e:
+                        if "retry budget exhausted" in e.reason:
+                            with self._lock:
+                                self.counters[
+                                    "retry_budget_exhausted"] += 1
                         lost_hosts.append(item[3])
                         lost_blocks.append(os.path.basename(item[1]))
             with self._lock:
@@ -1309,7 +1482,8 @@ class HostShuffleService:
 
     def exchange(self, exchange: str,
                  per_receiver: Dict[int, Sequence[ColumnBatch]],
-                 sink=None) -> List[ColumnBatch]:
+                 sink=None, extra: Optional[dict] = None
+                 ) -> List[ColumnBatch]:
         """One full all-to-all hop: publish, commit, barrier, collect.
 
         Exchange ids are SINGLE-USE: a reused id would let the barrier
@@ -1335,7 +1509,7 @@ class HostShuffleService:
         for r, batches in per_receiver.items():
             if r != self.pid:      # own partition never touches the disk
                 self.put(exchange, r, batches)
-        self.commit(exchange)
+        self.commit(exchange, extra=extra)
         return self._gather(exchange, own, t0, sink=sink)
 
     def exchange_spilled(self, exchange: str, spill_path: str,
@@ -1461,6 +1635,13 @@ class HostShuffleService:
         gauges["blacklisted_peers"] = lambda: len(self.blacklist)
         gauges["blacklist"] = lambda: ",".join(
             self.host_name(p) for p in sorted(self.blacklist)) or ""
+        # lineage recovery: current epoch (0 = nothing ever lost) and
+        # wall-clock spent inside agreement + re-planning, in ms
+        gauges["epoch"] = lambda: int(self.epoch)
+        gauges["recovery_ms"] = lambda: round(
+            self.timers["recovery_s"] * 1000.0, 1)
+        gauges["recovered_peers"] = lambda: ",".join(
+            self.host_name(p) for p in sorted(self.recovered_pids)) or ""
         # memory-pressure ladder: the ledger's high-water mark of
         # accounted exchange-staging bytes, against its budget
         gauges["peak_host_bytes"] = lambda: int(self.ledger.peak)

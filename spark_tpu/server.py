@@ -57,6 +57,7 @@ import collections
 import hmac
 import json
 import os
+import re
 import threading
 import time
 import uuid
@@ -69,6 +70,18 @@ from .metrics import Source
 from .serving import AdmissionController, AdmissionRejected, PlanCache
 
 __all__ = ["SQLServer"]
+
+
+_SQL_LITERALS = re.compile(r"'(?:[^']|'')*'|\b\d+(?:\.\d+)?\b")
+_SQL_WS = re.compile(r"\s+")
+
+
+def _cost_key(text: str) -> str:
+    """Query-shape key for per-shape admission cost estimates: the
+    statement with literals blanked and whitespace collapsed, so
+    ``WHERE id = 7`` and ``WHERE id = 9`` share one duration history
+    while a full-table scan keeps its own."""
+    return _SQL_WS.sub(" ", _SQL_LITERALS.sub("?", text)).strip().lower()
 
 
 def _json_safe(v: Any):
@@ -154,6 +167,7 @@ class SQLServer:
         self._stats_feedback = StatsFeedback()
         session._stats_feedback = self._stats_feedback
         self._sessions_expired = 0
+        self._statement_readmits = 0     # transparent recovery re-admits
         self._reaper_stop = threading.Event()
         self._reaper: Optional[threading.Thread] = None
         self._register_metrics()
@@ -164,6 +178,7 @@ class SQLServer:
             gauges.update(self._plan_cache.metrics_source())
         gauges["sessions_open"] = lambda: len(self._sessions)
         gauges["sessions_expired"] = lambda: self._sessions_expired
+        gauges["statement_readmits"] = lambda: self._statement_readmits
         ms = self.session.metricsSystem
         # re-registering (e.g. a second SQLServer on the same session)
         # replaces rather than duplicates the source
@@ -232,20 +247,39 @@ class SQLServer:
     # -- statement execution ---------------------------------------------
     def _run_sql(self, text: str, sid: Optional[str],
                  stmt_id: Optional[str]) -> dict:
+        from .parallel.hostshuffle import ExchangeFetchFailed
+
         ss = self._resolve(sid)          # unknown session → 404, nothing
+        cost_key = _cost_key(text)
         # admission BEFORE registration: a rejected statement leaves no
         # trace — no registry entry, no queue slot, no partial execution
         with self._reg_lock:
             depth = len(ss.queue) + \
                 (1 if (ss.running_stmt or ss.draining) else 0)
-        self._admission.admit(depth)     # raises AdmissionRejected → 429
+        # raises AdmissionRejected → 429; a known shape's Retry-After
+        # comes from ITS duration history, not the global EWMA
+        self._admission.admit(depth, cost_key=cost_key)
         admit_t = time.time()
         try:
-            return self._run_admitted(ss, text, sid, stmt_id)
+            try:
+                return self._run_admitted(ss, text, sid, stmt_id)
+            except ExchangeFetchFailed:
+                # a worker died and the in-query lineage recovery
+                # exhausted its budget (or was disabled): the exchange
+                # plane has already agreed the loss and blacklisted the
+                # peer, so ONE transparent re-admit runs the statement
+                # over the surviving live set.  Idempotent by the data
+                # plane's contract — statements read, or write behind
+                # the commit-marker rename.  Exactly once: a second
+                # fetch failure surfaces to the client.
+                with self._reg_lock:
+                    self._statement_readmits += 1
+                return self._run_admitted(ss, text, sid, stmt_id)
         finally:
-            # release feeds the EWMA behind Retry-After with end-to-end
+            # release feeds the EWMAs behind Retry-After with end-to-end
             # (queue + execute) latency — what a retrying client sees
-            self._admission.release(time.time() - admit_t)
+            self._admission.release(time.time() - admit_t,
+                                    cost_key=cost_key)
 
     def _run_admitted(self, ss: _ServerSession, text: str,
                       sid: Optional[str], stmt_id: Optional[str]) -> dict:

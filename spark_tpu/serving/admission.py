@@ -19,8 +19,14 @@ tunes a running server):
   carries a PR-7 ``HostMemoryLedger``, statements are rejected while its
   free budget is below the floor (memory-pressure shedding).
 
-The Retry-After hint is an EWMA of recent statement durations scaled by
-the current backlog — a serving-quality answer, not a constant."""
+The Retry-After hint is PER QUERY SHAPE when history exists: releases
+tagged with a ``cost_key`` (the server derives one per normalized
+statement text; ``StatsFeedback.signature`` keys work too) feed a
+per-shape duration EWMA, so a rejected tenant running a 50 ms point
+lookup is not told to wait behind the global average of 30 s scans.
+Shapes never seen fall back to the global EWMA of recent statement
+durations — both scaled by the current backlog, a serving-quality
+answer, not a constant."""
 
 from __future__ import annotations
 
@@ -64,46 +70,75 @@ class AdmissionController:
         self.rejected = 0
         self.rejected_by: Dict[str, int] = {}
         self._ewma_s = 0.05            # recent statement duration estimate
+        self._shape_ewma_s: Dict[str, float] = {}   # per-cost-key estimate
+
+    #: per-shape table bound — a serving process must not leak one entry
+    #: per distinct literal-normalized statement forever
+    MAX_SHAPES = 1024
 
     # -- policy --------------------------------------------------------
-    def admit(self, session_queue_depth: int) -> None:
+    def admit(self, session_queue_depth: int,
+              cost_key: Optional[str] = None) -> None:
         """Admit one statement or raise ``AdmissionRejected``.  Callers
-        MUST pair a successful admit with exactly one ``release``."""
+        MUST pair a successful admit with exactly one ``release``.
+        ``cost_key`` identifies the statement's query shape; on
+        rejection the Retry-After hint uses that shape's duration
+        history when any exists."""
         conf = self._conf
         with self._lock:
             cap = int(conf.get(C.SERVER_MAX_CONCURRENT_STATEMENTS))
             if cap > 0 and self.active >= cap:
-                self._reject("maxConcurrentStatements", self.active, cap)
+                self._reject("maxConcurrentStatements", self.active, cap,
+                             cost_key)
             qcap = int(conf.get(C.SERVER_MAX_QUEUED_PER_SESSION))
             if qcap > 0 and session_queue_depth >= qcap:
                 self._reject("maxQueuedPerSession",
-                             session_queue_depth, qcap)
+                             session_queue_depth, qcap, cost_key)
             floor = int(conf.get(C.SERVER_MIN_HOST_HEADROOM))
             if floor > 0:
                 ledger = self._ledger()
                 if ledger is not None and ledger.free < floor:
                     self._reject("hostMemoryHeadroom",
-                                 int(ledger.free), floor)
+                                 int(ledger.free), floor, cost_key)
             self.active += 1
             self.admitted += 1
             self.peak_active = max(self.peak_active, self.active)
 
-    def _reject(self, limit: str, observed, cap) -> None:
+    def _reject(self, limit: str, observed, cap,
+                cost_key: Optional[str] = None) -> None:
         self.rejected += 1
         self.rejected_by[limit] = self.rejected_by.get(limit, 0) + 1
         raise AdmissionRejected(limit, observed, cap,
-                                self._retry_after_locked())
+                                self._retry_after_locked(cost_key))
 
-    def release(self, duration_s: Optional[float] = None) -> None:
+    def release(self, duration_s: Optional[float] = None,
+                cost_key: Optional[str] = None) -> None:
         with self._lock:
             self.active = max(0, self.active - 1)
             if duration_s is not None and duration_s >= 0:
                 self._ewma_s = 0.8 * self._ewma_s + 0.2 * duration_s
+                if cost_key is not None:
+                    prev = self._shape_ewma_s.get(cost_key)
+                    self._shape_ewma_s[cost_key] = duration_s \
+                        if prev is None \
+                        else 0.8 * prev + 0.2 * duration_s
+                    if len(self._shape_ewma_s) > self.MAX_SHAPES:
+                        # drop an arbitrary old entry (insertion order):
+                        # a bound, not an LRU — shapes churn slowly
+                        self._shape_ewma_s.pop(
+                            next(iter(self._shape_ewma_s)))
 
-    def _retry_after_locked(self) -> float:
-        # expected wait ≈ statements ahead of you × recent duration;
-        # floor of 1s keeps well-behaved clients from hammering
-        return max(1.0, self._ewma_s * max(1, self.active))
+    def _retry_after_locked(self, cost_key: Optional[str] = None
+                            ) -> float:
+        # expected wait ≈ statements ahead of you × recent duration —
+        # THIS SHAPE's recent duration when we have seen it before, the
+        # global EWMA otherwise; floor of 1s keeps well-behaved clients
+        # from hammering
+        est = self._shape_ewma_s.get(cost_key) \
+            if cost_key is not None else None
+        if est is None:
+            est = self._ewma_s
+        return max(1.0, est * max(1, self.active))
 
     # -- introspection -------------------------------------------------
     def stats(self) -> Dict[str, Any]:
@@ -113,6 +148,7 @@ class AdmissionController:
                 "active": self.active, "peakActive": self.peak_active,
                 "rejectedBy": dict(self.rejected_by),
                 "avgStatementMs": round(self._ewma_s * 1000, 1),
+                "costShapes": len(self._shape_ewma_s),
             }
 
     def metrics_source(self) -> Dict[str, Callable[[], Any]]:

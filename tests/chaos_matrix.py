@@ -1,0 +1,274 @@
+"""The chaos kill-at-phase matrix: one table of fault scenarios against
+REAL multi-process exchanges, shared by the pytest suite
+(test_recovery.py) and the ``bin/chaos`` runner.
+
+Every scenario names the exchange PHASE the fault lands in (map
+staging, post-publish_sizes, mid-fetch, mid-demotion, during the
+recovery round itself), arms a ``FaultPlan`` on one victim process, and
+declares the oracle verdict per process:
+
+* ``OK``      — the process printed ``[p<i>] OK`` (oracle-exact result;
+                recovery-mode workers additionally self-assert
+                ``stage_retries >= 1`` before printing it);
+* ``FAILED``  — a structured, bounded abort line;
+* ``HOSTMEM`` — the spill-ENOSPC structured abort;
+* ``DIED``    — exit code 43, the injector's planned kill.
+
+The invariant across the WHOLE table: a faulted run either recovers to
+the exact oracle or aborts structured within ``3 x timeout + slack`` —
+never a hang, never a partial result (``PARTIAL`` is grepped out of
+every output).  ``kinds_covered()`` backs the lint gate: every fault
+kind ``parallel.faults`` can inject must appear somewhere in the
+matrix, so adding an injector without a chaos scenario fails a test.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from spark_tpu.parallel.faults import (  # noqa: E402
+    FAULT_PLAN_ENV, FaultPlan, _KINDS)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+#: the exchange phases the matrix must cover (ISSUE contract)
+PHASES = ("map-staging", "post-publish-sizes", "mid-fetch",
+          "mid-demotion", "during-recovery")
+
+
+def _scenario(name, phase, worker, mode, n, timeout_s, plans, expect,
+              tier="slow"):
+    return {"name": name, "phase": phase, "worker": worker,
+            "mode": mode, "n": n, "timeout_s": timeout_s,
+            "plans": plans, "expect": expect, "tier": tier}
+
+
+#: name → scenario.  ``plans`` maps victim pid → zero-arg FaultPlan
+#: builder (fresh plan per run); ``expect`` maps pid → verdict token.
+SCENARIOS = [
+    # -- the acceptance pair: kill mid-fetch, with and without budget --
+    _scenario(
+        "mid-fetch-kill", "mid-fetch", "recovery_worker.py", "recover",
+        2, 20.0, {1: lambda: FaultPlan().die_after_put("xq000001-jL")},
+        {0: "OK", 1: "DIED"}, tier="tier1"),
+    _scenario(
+        "mid-fetch-kill-noretry", "mid-fetch", "recovery_worker.py",
+        "norecover", 2, 8.0,
+        {1: lambda: FaultPlan().die_after_put("xq000001-jL")},
+        {0: "FAILED", 1: "DIED"}, tier="tier1"),
+    # -- kill during map staging: dies right after committing the digest
+    #    round (recipes already published) — lineage covers the loss --
+    _scenario(
+        "map-staging-kill", "map-staging", "recovery_worker.py",
+        "recover", 2, 12.0,
+        {1: lambda: FaultPlan().die_after_manifest("xq000001-digest")},
+        {0: "OK", 1: "DIED"}),
+    # -- kill right after publish_sizes: stats manifest landed, data
+    #    blocks never did — survivor recovers from recipes --
+    _scenario(
+        "post-publish-sizes-kill", "post-publish-sizes",
+        "recovery_worker.py", "recover", 2, 12.0,
+        {1: lambda: FaultPlan().die_after_manifest("xq000001-plan")},
+        {0: "OK", 1: "DIED"}),
+    # -- kill mid-demotion: the adaptive broadcast gather loses its
+    #    peer; in-memory leaves mean no lineage — structured abort --
+    _scenario(
+        "mid-demotion-kill", "mid-demotion", "adaptive_worker.py",
+        "fault-adapt", 2, 6.0,
+        {1: lambda: FaultPlan().die_after_put("xq000001-bcast")},
+        {0: "FAILED", 1: "DIED"}),
+    # -- kill DURING the recovery round: p2 dies mid-fetch, p1 publishes
+    #    its recovery manifest and dies; the agreement completes but the
+    #    epoch-1 re-run loses p1 past the retry budget — bounded abort --
+    _scenario(
+        "recovery-round-kill", "during-recovery", "recovery_worker.py",
+        "recover", 3, 12.0,
+        {2: lambda: FaultPlan().die_after_put("xq000001-jL"),
+         1: lambda: FaultPlan().die_after_manifest("xq000001-recover1")},
+        {0: "FAILED", 1: "DIED", 2: "DIED"}),
+    # -- live-but-faulty peers: declared lost, survivor recovers from
+    #    their on-disk lineage while they abort bounded --
+    _scenario(
+        "block-dropped-alive-peer", "mid-fetch", "recovery_worker.py",
+        "recover", 2, 6.0,
+        {1: lambda: FaultPlan().drop(exchange="xq000001-jL",
+                                     receiver=0)},
+        {0: "OK", 1: "FAILED"}),
+    _scenario(
+        "block-corrupted-alive-peer", "mid-fetch", "recovery_worker.py",
+        "recover", 2, 6.0,
+        {1: lambda: FaultPlan().corrupt(exchange="xq000001-jL",
+                                        receiver=0)},
+        {0: "OK", 1: "FAILED"}),
+    _scenario(
+        "block-truncated-noretry", "mid-fetch", "recovery_worker.py",
+        "norecover", 2, 6.0,
+        {1: lambda: FaultPlan().truncate(exchange="xq000001-jL",
+                                         keep_bytes=3)},
+        {0: "FAILED", 1: "FAILED"}),
+    # -- a slow peer is NOT a dead peer: the delay heals inside the
+    #    retry window, nothing recovers, results stay oracle-exact --
+    _scenario(
+        "slow-peer-heals", "mid-fetch", "recovery_worker.py",
+        "norecover", 2, 8.0,
+        {1: lambda: FaultPlan().delay(0.3, exchange="xq000001-jL")},
+        {0: "OK", 1: "OK"}),
+    # -- a sender that stages but never commits parks the barrier: both
+    #    sides time out structured (map staging never finished) --
+    _scenario(
+        "commit-skipped", "map-staging", "recovery_worker.py",
+        "norecover", 2, 5.0,
+        {1: lambda: FaultPlan().skip_commit(exchange="xq000001-jL")},
+        {0: "FAILED", 1: "FAILED"}),
+    # -- disk pressure: the forced spill hits injected ENOSPC --
+    _scenario(
+        "spill-disk-full", "map-staging", "shuffled_join_worker.py",
+        "spill-fault", 2, 8.0,
+        {1: lambda: FaultPlan().disk_full(after_bytes=0)},
+        {0: "FAILED", 1: "HOSTMEM"}),
+]
+
+
+def by_name(name):
+    for s in SCENARIOS:
+        if s["name"] == name:
+            return s
+    raise KeyError(name)
+
+
+def kinds_covered():
+    """Every fault kind some scenario injects (backs the lint gate that
+    compares this against ``faults._KINDS``)."""
+    kinds = set()
+    for s in SCENARIOS:
+        for build in s["plans"].values():
+            kinds.update(r.kind for r in build().rules)
+    return kinds
+
+
+def all_kinds():
+    return set(_KINDS)
+
+
+def run_scenario(scenario, root):
+    """Launch the scenario's n processes against a fresh ``root``;
+    returns ``(results, elapsed_s)`` with ``results[pid] = (rc, out)``.
+    Never raises on process failure — ``check`` renders the verdict."""
+    worker = os.path.join(HERE, scenario["worker"])
+    procs = {}
+    t0 = time.monotonic()
+    for pid in range(scenario["n"]):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop(FAULT_PLAN_ENV, None)
+        build = scenario["plans"].get(pid)
+        if build is not None:
+            env[FAULT_PLAN_ENV] = build().to_env()
+        procs[pid] = subprocess.Popen(
+            [sys.executable, worker, str(pid), str(scenario["n"]),
+             root, scenario["mode"], str(scenario["timeout_s"])],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+    results = {}
+    for pid, p in procs.items():
+        out = p.communicate(timeout=60 + 6 * scenario["timeout_s"])[0]
+        results[pid] = (p.returncode, out)
+    return results, time.monotonic() - t0
+
+
+def main(argv=None):
+    """The ``bin/chaos`` entry point: run the matrix (or a filtered
+    subset) in a SEEDED deterministic order and print a verdict table.
+    Exit 0 only if every scenario meets its oracle."""
+    import argparse
+    import random
+    import tempfile
+
+    ap = argparse.ArgumentParser(
+        prog="chaos", description="kill-at-phase fault-injection matrix "
+        "over real multi-process exchanges")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="schedule seed: shuffles scenario order "
+                    "deterministically (default 0 = table order)")
+    ap.add_argument("--only", action="append", default=[],
+                    help="run only scenarios whose name contains this "
+                    "substring (repeatable)")
+    ap.add_argument("--tier", choices=("tier1", "slow", "all"),
+                    default="all", help="restrict to one tier")
+    ap.add_argument("--root", default=None,
+                    help="shuffle root parent dir (default: a fresh "
+                    "temp dir per scenario)")
+    args = ap.parse_args(argv)
+
+    todo = [s for s in SCENARIOS
+            if args.tier in ("all", s["tier"])
+            and (not args.only
+                 or any(pat in s["name"] for pat in args.only))]
+    if args.seed:
+        random.Random(args.seed).shuffle(todo)
+    if not todo:
+        print("no scenarios matched")
+        return 2
+
+    rows, failed = [], 0
+    for i, sc in enumerate(todo):
+        parent = args.root or tempfile.mkdtemp(prefix="chaos-")
+        root = os.path.join(parent, f"{i:02d}-{sc['name']}")
+        print(f"[chaos] {i + 1}/{len(todo)} {sc['name']} "
+              f"(phase {sc['phase']}, n={sc['n']}) ...", flush=True)
+        try:
+            results, elapsed = run_scenario(sc, root)
+            bad = check(sc, results, elapsed)
+        except Exception as e:               # runner plumbing, not verdict
+            results, elapsed, bad = {}, 0.0, [f"runner error: {e!r}"]
+        rows.append((sc, elapsed, bad))
+        failed += bool(bad)
+        for b in bad:
+            print(f"  !! {b}", flush=True)
+            for pid, (rc, out) in results.items():
+                print(f"  -- p{pid} rc={rc} tail: "
+                      f"{out.splitlines()[-3:]}", flush=True)
+
+    name_w = max(len(s["name"]) for s, _e, _b in rows)
+    phase_w = max(len(s["phase"]) for s, _e, _b in rows)
+    print(f"\n{'scenario':<{name_w}}  {'phase':<{phase_w}}  "
+          f"{'tier':<5}  {'s':>6}  verdict")
+    for sc, elapsed, bad in rows:
+        verdict = "PASS" if not bad else f"FAIL ({'; '.join(bad)})"
+        print(f"{sc['name']:<{name_w}}  {sc['phase']:<{phase_w}}  "
+              f"{sc['tier']:<5}  {elapsed:>6.1f}  {verdict}")
+    print(f"\n{len(rows) - failed}/{len(rows)} scenarios passed "
+          f"(seed {args.seed})")
+    return 1 if failed else 0
+
+
+def check(scenario, results, elapsed):
+    """The oracle verdict: list of violation strings (empty = pass)."""
+    bad = []
+    bound = 3 * scenario["timeout_s"] + 30
+    if elapsed >= bound:
+        bad.append(f"elapsed {elapsed:.1f}s >= bound {bound:.1f}s")
+    for pid, want in scenario["expect"].items():
+        rc, out = results[pid]
+        lines = [ln for ln in out.splitlines() if f"[p{pid}]" in ln]
+        last = lines[-1] if lines else ""
+        if "PARTIAL" in out:
+            bad.append(f"p{pid}: PARTIAL result surfaced")
+        if want == "DIED":
+            if rc != 43:
+                bad.append(f"p{pid}: rc {rc} != 43 (planned kill)")
+        elif rc != 0:
+            bad.append(f"p{pid}: rc {rc} != 0 ({last!r})")
+        elif want == "OK" and f"[p{pid}] OK" not in last:
+            bad.append(f"p{pid}: expected OK, got {last!r}")
+        elif want == "FAILED" and "FAILED" not in last:
+            bad.append(f"p{pid}: expected FAILED, got {last!r}")
+        elif want == "HOSTMEM" and "FAILED-HOSTMEM" not in last:
+            bad.append(f"p{pid}: expected FAILED-HOSTMEM, got {last!r}")
+    return bad
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,9 @@ def pytest_configure(config):
     # suites (chaos, stress-scale wire round-trips) opt out via this mark
     config.addinivalue_line(
         "markers", "slow: >~5s test, excluded from the tier-1 sweep")
+    config.addinivalue_line(
+        "markers", "chaos_smoke: multi-process fault-injection scenario "
+        "from tests/chaos_matrix.py (also runnable via bin/chaos)")
 
 
 @pytest.fixture(scope="session")

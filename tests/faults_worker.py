@@ -15,6 +15,12 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# persistent jit cache (same dir + policy as conftest.py): worker
+# subprocesses otherwise recompile every program on every test run
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/spark_tpu_jax_cache_cpu")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 
 import numpy as np  # noqa: E402
 

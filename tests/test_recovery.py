@@ -1,0 +1,311 @@
+"""Lineage-based stage recovery: deterministic re-execution over the
+live process set after worker loss.
+
+Unit layer: the ``{xid}-recover`` agreement round (union, divergence,
+ghost self-abort), epoch-abort ledger release, the shared per-exchange
+retry budget, live-set planning, per-shape admission Retry-After, and
+the lint gate pinning the chaos matrix to the full fault-kind set.
+
+Process layer (tests/chaos_matrix.py): real multi-process joins with a
+FaultInjector killing one worker at a chosen exchange phase — the
+survivor either recovers to the exact full-data oracle (with
+``stage_retries >= 1``) or aborts structured and bounded.  The
+acceptance pair (kill mid-fetch, with and without a retry budget) runs
+tier-1; the full matrix is ``slow`` + ``chaos_smoke`` (bin/chaos runs
+it too).
+"""
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+import chaos_matrix as cm
+from spark_tpu import config as C
+from spark_tpu.analysis.errors import PlanInvariantError
+from spark_tpu.analysis.runtime import (
+    verify_epoch_released, verify_recovery_agreement)
+from spark_tpu.memory import HostMemoryLedger
+from spark_tpu.parallel.cluster import live_view
+from spark_tpu.parallel.hostshuffle import (
+    BlockFetchError, ExchangeFetchFailed, HostShuffleService,
+    RetryingBlockReader, _RetryBudget)
+from spark_tpu.serving.admission import (
+    AdmissionController, AdmissionRejected)
+
+
+def _svc(tmp_path, pid, n, **kw):
+    kw.setdefault("timeout_s", 2.0)
+    kw.setdefault("poll_s", 0.02)
+    return HostShuffleService(str(tmp_path), pid, n, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the {xid}-recover agreement round
+# ---------------------------------------------------------------------------
+
+def test_recover_round_agrees_on_lost_union(tmp_path):
+    """Two survivors of a 3-process set each observed pid 2 dead: the
+    round derives the same agreed set, epoch, and adoption map on both,
+    and the recovery-agreement verifier passes."""
+    svc0, svc1 = _svc(tmp_path, 0, 3), _svc(tmp_path, 1, 3)
+    t = threading.Thread(target=svc1.recover_round,
+                         args=("xq9", 1, {2}))
+    t.start()
+    svc0.recover_round("xq9", 1, {2})
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    for svc in (svc0, svc1):
+        assert svc.recovered_pids == {2}
+        assert svc.epoch == 1
+        assert svc.live_pids() == [0, 1]
+        # deterministic round-robin adoption over the live set
+        assert svc.recovery_adopt == {2: 0}
+        assert svc.counters["recovery_rounds"] == 1
+        verify_recovery_agreement(svc, "xq9", 1)
+    # ownership re-derivation: group g belongs to the g-th LIVE pid
+    assert svc0.group_owner(0) == 0 and svc0.group_owner(1) == 1
+    # the lost pid is blacklisted with the recovery round as the reason
+    assert 2 in svc0.blacklist
+
+
+def test_recover_round_divergence_aborts_structured(tmp_path):
+    """A peer that neither participates in the round nor is named lost
+    by anyone (it died DURING recovery, pre-publish) means no consistent
+    live set exists — a NON-recoverable structured failure, never a
+    hang, and the local live view stays untouched."""
+    svc0 = _svc(tmp_path, 0, 2, timeout_s=0.5)
+    svc0.blacklist[1] = "test: excluded but never agreed"
+    with pytest.raises(ExchangeFetchFailed, match="diverged") as ei:
+        svc0.recover_round("xq8", 1, set())
+    assert ei.value.recoverable is False
+    assert "host-1" in ei.value.lost_hosts
+    assert svc0.recovered_pids == set()
+    assert svc0.epoch == 0
+
+
+def test_recover_round_ghost_self_abort(tmp_path):
+    """A process its peers declared lost must abort instead of
+    re-executing as a ghost — its writes under the new epoch would race
+    the survivor that adopted its partitions."""
+    svc0, svc1 = _svc(tmp_path, 0, 2), _svc(tmp_path, 1, 2)
+    svc1.publish_manifest("xq7-recover1", {"epoch": 1, "lost": [0]})
+    with pytest.raises(ExchangeFetchFailed, match="declared lost") as ei:
+        svc0.recover_round("xq7", 1, set())
+    assert ei.value.recoverable is False
+    assert svc0.host_name(0) in ei.value.lost_hosts
+
+
+def test_recovery_agreement_verifier_pins_epoch_monotonicity(tmp_path):
+    svc0, svc1 = _svc(tmp_path, 0, 2), _svc(tmp_path, 1, 2)
+    svc1.publish_manifest("xq6-recover2", {"epoch": 2, "lost": [1]})
+    svc0.recover_round("xq6", 2, {1})
+    assert svc0.epoch == 2
+    verify_recovery_agreement(svc0, "xq6", 2)
+    # an epoch that moved backward past the agreed round must be caught
+    svc0.epoch = 1
+    with pytest.raises(PlanInvariantError, match="epoch"):
+        verify_recovery_agreement(svc0, "xq6", 2)
+
+
+# ---------------------------------------------------------------------------
+# epoch abort releases the dead epoch's host-memory reservations
+# ---------------------------------------------------------------------------
+
+def test_epoch_abort_releases_ledger_prefix():
+    ledger = HostMemoryLedger(budget=1 << 20)
+    ledger.reserve("shuffle:xq5:jL-map", 1000, exchange="xq5-jL")
+    ledger.reserve("shuffle:xq5:jL-fetch", 500, exchange="xq5-jL")
+    ledger.reserve("shuffle:xq6:jL-map", 300, exchange="xq6-jL")
+    with pytest.raises(PlanInvariantError, match="dead-epoch-ledger"):
+        verify_epoch_released(ledger, "xq5")
+    freed = ledger.release_prefix("shuffle:xq5")
+    assert freed == 1500                      # the bugfix: bytes reported
+    verify_epoch_released(ledger, "xq5")      # no dead-epoch holders left
+    assert ledger.used == 300                 # other statements untouched
+    assert ledger.release_prefix("shuffle:xq5") == 0
+
+
+# ---------------------------------------------------------------------------
+# shared per-exchange retry budget: pool width must not multiply backoff
+# ---------------------------------------------------------------------------
+
+def test_shared_retry_budget_bounds_pool_backoff(tmp_path):
+    """Four pool threads fetching from the SAME dead sender share ONE
+    retry budget: total backoff sleeps stay <= the budget (not
+    budget x threads), and the losers fail fast with the budget named."""
+    sleeps = []
+    lock = threading.Lock()
+
+    def record(s):
+        with lock:
+            sleeps.append(s)
+
+    reader = RetryingBlockReader(max_retries=8, retry_wait_s=0.01,
+                                 attempt_timeout_s=0.2, sleep=record)
+    budget = _RetryBudget(reader.max_retries)
+    missing = str(tmp_path / "never-written.blk")
+    errs = []
+
+    def fetch(_):
+        try:
+            reader.read(missing, budget=budget)
+        except BlockFetchError as e:
+            with lock:
+                errs.append(e)
+
+    with ThreadPoolExecutor(4) as pool:
+        list(pool.map(fetch, range(4)))
+    assert len(errs) == 4
+    # unshared, 4 threads x 8 retries would be 32 sleeps; the shared
+    # budget caps the TOTAL at 8
+    assert len(sleeps) <= reader.max_retries, sleeps
+    assert any("shared retry budget exhausted (8 total)" in e.reason
+               for e in errs), [e.reason for e in errs]
+
+
+# ---------------------------------------------------------------------------
+# live-set planning view
+# ---------------------------------------------------------------------------
+
+def test_live_view_excludes_dead_and_recovered():
+    assert live_view(4) == [0, 1, 2, 3]
+    assert live_view(4, dead_hosts=["host-2"]) == [0, 1, 3]
+    assert live_view(4, recovered_pids=[1]) == [0, 2, 3]
+    assert live_view(4, dead_hosts=["host-0"],
+                     recovered_pids=[3]) == [1, 2]
+    assert live_view(1) == [0]
+
+
+# ---------------------------------------------------------------------------
+# admission Retry-After from per-query-shape cost estimates
+# ---------------------------------------------------------------------------
+
+def test_retry_after_uses_shape_history_with_ewma_fallback():
+    conf = C.Conf().set(C.SERVER_MAX_CONCURRENT_STATEMENTS.key, "1")
+    ac = AdmissionController(conf)
+    ac.admit(0, cost_key="shape-slow")
+    ac.release(10.0, cost_key="shape-slow")   # first observation: 10s
+    ac.admit(0, cost_key="shape-slow")        # occupies the single slot
+    with pytest.raises(AdmissionRejected) as slow:
+        ac.admit(0, cost_key="shape-slow")
+    # seen shape: its own EWMA (10s) x 1 active statement
+    assert slow.value.retry_after_s == pytest.approx(10.0)
+    with pytest.raises(AdmissionRejected) as unseen:
+        ac.admit(0, cost_key="shape-never-seen")
+    # unseen shape: global EWMA fallback — 0.8*0.05 + 0.2*10.0
+    assert unseen.value.retry_after_s == pytest.approx(2.04)
+    assert unseen.value.retry_after_s < slow.value.retry_after_s
+    assert slow.value.to_json()["retryAfterSeconds"] == 10.0
+    assert ac.stats()["costShapes"] == 1
+    # blending: a faster rerun pulls the shape estimate down
+    ac.release(2.0, cost_key="shape-slow")
+    ac.admit(0, cost_key="x")
+    with pytest.raises(AdmissionRejected) as again:
+        ac.admit(0, cost_key="shape-slow")
+    assert again.value.retry_after_s == pytest.approx(0.8 * 10.0
+                                                      + 0.2 * 2.0)
+
+
+def test_retry_after_floor_and_shape_table_bound():
+    conf = C.Conf().set(C.SERVER_MAX_CONCURRENT_STATEMENTS.key, "1")
+    ac = AdmissionController(conf)
+    ac.MAX_SHAPES = 4
+    ac.admit(0, cost_key="a")
+    ac.release(0.001, cost_key="a")           # far below the 1s floor
+    ac.admit(0, cost_key="a")
+    with pytest.raises(AdmissionRejected) as ei:
+        ac.admit(0, cost_key="a")
+    assert ei.value.retry_after_s == 1.0      # floor keeps clients civil
+    ac.release(0.01, cost_key="a")
+    for i in range(10):                       # table stays bounded
+        ac.admit(0, cost_key=f"shape-{i}")
+        ac.release(0.5, cost_key=f"shape-{i}")
+    assert ac.stats()["costShapes"] <= 4
+
+
+def test_cost_key_normalizes_literals_and_whitespace():
+    from spark_tpu.server import _cost_key
+    a = _cost_key("SELECT * FROM t WHERE x = 42 AND name = 'bob'")
+    b = _cost_key("select  *   from t\nwhere x = 17 and name = 'ali''ce'")
+    assert a == b == "select * from t where x = ? and name = ?"
+    assert _cost_key("SELECT count(*) FROM t") != a
+    assert _cost_key("SELECT x FROM t WHERE y < 1.5") \
+        == _cost_key("SELECT x FROM t WHERE y < 2500.125")
+
+
+# ---------------------------------------------------------------------------
+# lint gate: the chaos matrix must cover every injectable fault kind,
+# every phase, and stay runnable (worker files exist, verdicts total)
+# ---------------------------------------------------------------------------
+
+def test_chaos_matrix_covers_every_fault_kind_and_phase():
+    missing = cm.all_kinds() - cm.kinds_covered()
+    assert not missing, (
+        f"fault kind(s) {sorted(missing)} have no chaos scenario — "
+        "extend tests/chaos_matrix.py when adding injectors")
+    assert set(cm.PHASES) <= {s["phase"] for s in cm.SCENARIOS}
+    for s in cm.SCENARIOS:
+        assert os.path.exists(os.path.join(cm.HERE, s["worker"])), s
+        assert set(s["expect"]) == set(range(s["n"])), s["name"]
+        assert set(s["plans"]) <= set(range(s["n"])), s["name"]
+        assert s["tier"] in ("tier1", "slow"), s["name"]
+    # the acceptance pair must stay in the tier-1 sweep
+    assert cm.by_name("mid-fetch-kill")["tier"] == "tier1"
+    assert cm.by_name("mid-fetch-kill-noretry")["tier"] == "tier1"
+
+
+# ---------------------------------------------------------------------------
+# the real thing: 2-process join, one worker killed mid-exchange
+# ---------------------------------------------------------------------------
+
+def test_kill_mid_fetch_recovers_oracle_exact(tmp_path):
+    """The tentpole acceptance: worker 1 dies after putting its join map
+    output; worker 0 runs the recovery round, adopts the dead worker's
+    parquet partitions from its published leaf recipes, re-executes
+    under epoch 1, and returns the EXACT full-data oracle rows — the
+    worker itself asserts ``stage_retries >= 1``,
+    ``recovered_partitions > 0`` and a nonzero epoch before printing
+    OK."""
+    sc = cm.by_name("mid-fetch-kill")
+    results, elapsed = cm.run_scenario(sc, str(tmp_path / "shuf"))
+    bad = cm.check(sc, results, elapsed)
+    assert not bad, (bad, results)
+    out0 = results[0][1]
+    assert "retries=1" in out0 and "recovered=1" in out0, out0
+    assert "epoch=1" in out0, out0
+    assert "dying after put in 'xq000001-jL'" in results[1][1]
+
+
+def test_kill_mid_fetch_without_budget_aborts_bounded(tmp_path):
+    """``maxStageRetries=0`` restores the PR-1 contract byte-for-byte:
+    the survivor fails with the structured ExchangeFetchFailed naming
+    the lost host, within the exchange deadline — no recovery round, no
+    re-execution, no partial rows."""
+    sc = cm.by_name("mid-fetch-kill-noretry")
+    results, elapsed = cm.run_scenario(sc, str(tmp_path / "shuf"))
+    bad = cm.check(sc, results, elapsed)
+    assert not bad, (bad, results)
+    out0 = results[0][1]
+    line = [ln for ln in out0.splitlines() if "[p0]" in ln][-1]
+    assert "host-1" in line, out0
+    assert "retries=" not in line                # recovery never engaged
+
+
+# ---------------------------------------------------------------------------
+# the full kill-at-phase matrix (slow; bin/chaos runs the same table)
+# ---------------------------------------------------------------------------
+
+_SLOW = [s["name"] for s in cm.SCENARIOS if s["tier"] != "tier1"]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos_smoke
+@pytest.mark.parametrize("name", _SLOW)
+def test_chaos_scenario(tmp_path, name):
+    sc = cm.by_name(name)
+    results, elapsed = cm.run_scenario(sc, str(tmp_path / "shuf"))
+    bad = cm.check(sc, results, elapsed)
+    assert not bad, (bad, {p: (rc, out[-400:])
+                           for p, (rc, out) in results.items()})
