@@ -87,6 +87,11 @@ SC_ROWS = 1 << 14    # stagecache lane: fact rows (full dataset) — sized
                      # for compile-vs-dispatch accounting, not throughput
 SC_KEYS = 1 << 10    # dim-key cardinality (dim side UNIQUE: fanout 1, so
                      # the per-op baseline replays without overflow retry)
+GG_ROWS = 1 << 15    # distgrace lane: rows per table (full dataset)
+GG_KEYS = 1 << 11    # join-key cardinality (multiplicity 16 on the right)
+GG_BUDGET = 96 << 10  # host budget: below EVERY reducer's drained share
+                      # (~128 KiB/side at 2 procs) but above each of the
+                      # 32 grace buckets (~24 KiB both sides)
 
 #: cold axon compiles of the fused agg/join programs run several minutes
 #: (f64/i64 emulation); the persistent jax compile cache under /tmp makes
@@ -1556,6 +1561,172 @@ def distspill_worker_main() -> None:
     sys.stdout.flush()
 
 
+def _bench_dist_grace() -> dict:
+    """Distgrace lane: graceful degradation past the exchange.
+
+    A 2-process join+group-by runs with the host budget capped below
+    EVERY reducer's drained working set — a budget the plain spill path
+    cannot absorb, because the fetched shard itself does not fit.  With
+    grace buckets enabled the reducers re-bucket the drained runs into
+    spill files and join bucket-by-bucket: the lane pins that the capped
+    run COMPLETES byte-identical to the uncapped run, reports nonzero
+    grace buckets/spill, keeps the ledger peak under the cap — and the
+    wall-clock overhead of degrading is the tracked figure.  With
+    ``graceBuckets=0`` the same query must abort with the structured
+    ``HostMemoryError`` (the pre-grace contract), never a wrong
+    answer."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="spark_tpu_bench_dgrace_")
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("SPARK_TPU_FAULT_PLAN", None)
+        env.pop("SPARK_TPU_PLATFORM", None)
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--distgrace-worker", str(pid), d],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for pid in (0, 1)]
+        outs = [p.communicate(timeout=CHILD_TIMEOUT_S) for p in procs]
+        objs = []
+        for p, (out, err) in zip(procs, outs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"distgrace worker rc={p.returncode}: "
+                    f"{(err or out).strip().splitlines()[-3:]}")
+            line = [ln for ln in out.splitlines()
+                    if ln.strip().startswith("{")][-1]
+            objs.append(json.loads(line))
+        # degraded or not: byte-identical aggregates
+        sums = {o[m]["checksum"] for o in objs for m in ("uncapped",
+                                                         "grace")}
+        if len(sums) != 1:
+            raise RuntimeError(f"grace/uncapped results diverge: {objs}")
+        for o in objs:
+            if o["grace"]["grace_buckets_used"] <= 0:
+                raise RuntimeError(f"capped run never graced: {objs}")
+            if o["grace"]["peak_host_bytes"] > o["grace"]["budget_bytes"]:
+                raise RuntimeError(f"ledger peak blew the cap: {objs}")
+            if not o["nograce"]["aborted"]:
+                raise RuntimeError(
+                    f"graceBuckets=0 run did not abort bounded: {objs}")
+        rows = objs[0]["rows_total"]
+        gra_s = max(o["grace"]["seconds"] for o in objs)
+        unc_s = max(o["uncapped"]["seconds"] for o in objs)
+        return {
+            "distgrace_rows_per_sec": round(rows / gra_s, 1),
+            "distgrace_overhead_vs_uncapped": round(gra_s / unc_s, 3),
+            "distgrace_buckets": sum(
+                o["grace"]["grace_buckets_used"] for o in objs),
+            "distgrace_spill_bytes": sum(
+                o["grace"]["grace_spill_bytes"] for o in objs),
+            "distgrace_peak_host_bytes": max(
+                o["grace"]["peak_host_bytes"] for o in objs),
+            "distgrace_budget_bytes": objs[0]["grace"]["budget_bytes"],
+            "distgrace_nograce_aborts": sum(
+                1 for o in objs if o["nograce"]["aborted"]),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def distgrace_worker_main() -> None:
+    """One process of the distgrace lane (see ``_bench_dist_grace``).
+
+    argv: --distgrace-worker <pid> <root>.  Runs the join uncapped,
+    then capped below the reducers' drained working set with grace
+    buckets on (must complete via grace), then the same cap with
+    ``graceBuckets=0`` (must abort with the structured HostMemoryError);
+    prints ONE JSON line."""
+    i = sys.argv.index("--distgrace-worker")
+    pid, root = int(sys.argv[i + 1]), sys.argv[i + 2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from spark_tpu import config as C
+    from spark_tpu.memory import HOST_BUDGET, HostMemoryError
+    from spark_tpu.sql.session import SparkSession
+
+    rng = np.random.default_rng(47)
+    sk = rng.integers(0, GG_KEYS, GG_ROWS).astype(np.int64)
+    price = rng.integers(1, 201, GG_ROWS).astype(np.int64)
+    k2 = rng.integers(0, GG_KEYS, GG_ROWS).astype(np.int64)
+    bonus = rng.integers(1, 101, GG_ROWS).astype(np.int64)
+    mine = slice(pid, None, 2)
+    # projection subqueries: sides ship ONLY the joined/aggregated
+    # columns, so the shipped working set (and the grace buckets) stay
+    # deliberately sized against GG_BUDGET
+    Q = ("SELECT sk, count(*) AS c, sum(bonus) AS sb "
+         "FROM (SELECT sk FROM fact) f "
+         "JOIN (SELECT k2, bonus FROM fact2) f2 ON sk = k2 "
+         "GROUP BY sk")
+
+    session = SparkSession.builder.appName(
+        f"bench-dgrace-{pid}").getOrCreate()
+    out = {"pid": pid, "rows_total": int(2 * GG_ROWS)}
+    for mode in ("uncapped", "grace", "nograce"):
+        xs = session.newSession()
+        xs.conf.set(C.MESH_SHARDS.key, "1")
+        xs.conf.set(C.CROSSPROC_SHUFFLED_JOIN.key, "true")
+        xs.conf.set(C.CROSSPROC_SORT_MERGE_JOIN.key, "false")
+        xs.conf.set(C.CROSSPROC_AUTO_BROADCAST.key, "0")
+        # balance the two reducer shards: greedy span packing to half
+        # the shipped working set (fact ships sk at 8 B/row, fact2
+        # ships k2+bonus at 16 B/row)
+        xs.conf.set(C.SHUFFLE_TARGET_PARTITION_BYTES.key,
+                    str(GG_ROWS * 24 // 2))
+        if mode != "uncapped":
+            xs.conf.set(C.SHUFFLE_SPILL_THRESHOLD.key, str(8 << 10))
+            xs.conf.set(HOST_BUDGET.key, str(GG_BUDGET))
+        if mode == "nograce":
+            xs.conf.set(C.CROSSPROC_GRACE_BUCKETS.key, "0")
+        svc = xs.enableHostShuffle(os.path.join(root, mode),
+                                   process_id=pid, n_processes=2,
+                                   timeout_s=300.0)
+        xs.createDataFrame({"sk": sk[mine], "price": price[mine]}) \
+            .createOrReplaceTempView("fact")
+        xs.createDataFrame({"k2": k2[mine], "bonus": bonus[mine]}) \
+            .createOrReplaceTempView("fact2")
+        if mode == "nograce":
+            # the pre-grace contract: a shard that cannot be staged is a
+            # STRUCTURED bounded failure, never a wrong answer
+            t0 = time.perf_counter()
+            try:
+                xs.sql(Q).collect()
+                aborted, detail = False, ""
+            except HostMemoryError as e:
+                aborted, detail = True, str(e)[:200]
+            out[mode] = {
+                "seconds": round(time.perf_counter() - t0, 3),
+                "aborted": aborted,
+                "error": detail,
+            }
+            continue
+        xs.sql(Q).collect()                  # warm: compile + caches
+        base_gb = int(svc.counters["grace_buckets_used"])
+        base_gs = int(svc.counters["grace_spill_bytes"])
+        t0 = time.perf_counter()
+        rows = xs.sql(Q).collect()
+        elapsed = time.perf_counter() - t0
+        out[mode] = {
+            "seconds": round(elapsed, 3),
+            "groups": len(rows),
+            "checksum": int(sum(int(r[1]) * 7 + int(r[2]) for r in rows)),
+            "grace_buckets_used":
+                int(svc.counters["grace_buckets_used"]) - base_gb,
+            "grace_spill_bytes":
+                int(svc.counters["grace_spill_bytes"]) - base_gs,
+            "peak_host_bytes": int(svc.ledger.peak),
+            "budget_bytes": int(svc.ledger.budget),
+        }
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
 def _bench_servebench() -> dict:
     """Servebench lane: multi-tenant serving throughput, plan cache on/off.
 
@@ -1870,6 +2041,15 @@ def child_main() -> None:
         print(f"[bench-child] distspill bench failed: {e}", file=sys.stderr)
         extras["distspill_error"] = str(e)[:300]
     try:
+        # graceful degradation: the join with the host budget capped
+        # below the reducers' drained shard — must complete via grace
+        # partitioning, match the uncapped aggregates, and abort
+        # structured when grace is disabled
+        extras.update(_bench_dist_grace())
+    except Exception as e:   # secondary must not sink the primary
+        print(f"[bench-child] distgrace bench failed: {e}", file=sys.stderr)
+        extras["distgrace_error"] = str(e)[:300]
+    try:
         # whole-stage compilation: 2 real worker processes, fused vs
         # per-operator dispatch and cold vs warm stage-executable cache
         extras.update(_bench_stagecache())
@@ -1917,6 +2097,8 @@ if __name__ == "__main__":
         distdict_worker_main()
     elif "--distspill-worker" in sys.argv:
         distspill_worker_main()
+    elif "--distgrace-worker" in sys.argv:
+        distgrace_worker_main()
     elif "--stagecache-worker" in sys.argv:
         stagecache_worker_main()
     elif "--servebench-worker" in sys.argv:

@@ -57,6 +57,7 @@ __all__ = [
     "verify_span_owners", "verify_skew_split", "verify_presorted_build",
     "verify_unified_dictionaries", "verify_ledger_scope",
     "verify_recovery_agreement", "verify_epoch_released",
+    "verify_elastic_reducer_plan", "verify_grace_bucket_partition",
 ]
 
 _STRATEGIES = ("broadcast_left", "broadcast_right", "range", "hash",
@@ -135,7 +136,13 @@ def verify_hash_copartition(join, key_pairs, bounds, n_fine: int,
             join, "reducer-bounds",
             f"shared reducer bounds {[int(x) for x in b]} do not cover "
             f"[0, {n_fine}) monotonically")
-    lo, hi = int(b[pid]), int(b[pid + 1])
+    if pid + 1 < b.size:
+        lo, hi = int(b[pid]), int(b[pid + 1])
+    else:
+        # an ELASTIC plan narrower than the live set leaves trailing
+        # processes with no reducer group: they own the empty fine
+        # range, so ANY live row here is a co-partitioning violation
+        lo = hi = n_fine
     for side, shard, exprs in (
             ("left", left_shard, [l for l, _ in key_pairs]),
             ("right", right_shard, [r for _, r in key_pairs])):
@@ -330,3 +337,52 @@ def verify_ledger_scope(ledger, pre_owners, xid: str) -> None:
             f"exchange reservation(s) {stray} survive the query outside "
             f"the release scope {scope!r} — release_prefix cannot pair "
             "them and the bytes leak into the next statement's budget")
+
+
+def verify_elastic_reducer_plan(join, width: int, mans, n_live: int,
+                                target_bytes: int) -> None:
+    """Every process must re-derive the SAME elastic reducer width from
+    the shared plan-round manifests, or the sender/receiver reducer
+    sets diverge and routed rows vanish.  Recomputes the width from the
+    manifest bytes this process read (identical on every process) and
+    pins it against the width the planner actually used."""
+    from ..parallel.crossproc import elastic_reducer_width, \
+        observed_side_stats
+    obs = observed_side_stats(mans, n_live)
+    expect = n_live
+    if obs is not None:
+        expect = elastic_reducer_width(obs[0] + obs[2], target_bytes,
+                                       n_live)
+    if int(width) != int(expect):
+        raise PlanInvariantError(
+            join, "elastic-plan-agreement",
+            f"this process derived elastic width {width} but the shared "
+            f"manifests imply {expect} (observed={obs}, n_live={n_live}, "
+            f"target={target_bytes}) — elastic plans must agree "
+            "byte-for-byte across processes")
+
+
+def verify_grace_bucket_partition(join, exprs_l, exprs_r, n_buckets: int,
+                                  salt: int, bucket: int, left,
+                                  right) -> None:
+    """Grace buckets must partition the join-key space EXACTLY: every
+    live row assembled for bucket ``bucket`` must hash back into that
+    bucket under the same (salt, n_buckets) split, or a key's matches
+    were torn across buckets and the bucket-wise join silently drops
+    or duplicates pairs."""
+    from ..parallel.crossproc import _grace_bucket_ids
+    for side, (exprs, batch) in enumerate(
+            ((exprs_l, left), (exprs_r, right))):
+        if batch is None or batch.num_rows == 0:
+            continue
+        ids = np.asarray(_grace_bucket_ids(batch, exprs, n_buckets,
+                                           salt))
+        live = _live_mask(batch)
+        bad = ids[live] != np.int32(bucket)
+        if bool(np.any(bad)):
+            raise PlanInvariantError(
+                join, "grace-bucket-partition",
+                f"{int(np.count_nonzero(bad))} live row(s) on side "
+                f"{side} of grace bucket {bucket} (salt={salt}, "
+                f"n_buckets={n_buckets}) hash to other buckets — the "
+                "grace split tore a join key across buckets")

@@ -457,6 +457,19 @@ CROSSPROC_ADAPTIVE_REPLAN = conf(
     "freeze at plan time (the digest probe alone decides)."
 ).boolean(True)
 
+CROSSPROC_GRACE_BUCKETS = conf("spark.tpu.crossproc.graceBuckets").doc(
+    "Grace-partition fan-out for the distributed join lanes' degraded "
+    "mode: when a reducer cannot reserve its drained post-exchange shard "
+    "(or the joined output) under the host-memory ledger, the probe and "
+    "build runs re-bucket by join-key hash into this many wire-framed "
+    "spill files and the join runs bucket-by-bucket through the "
+    "stage-compiled join step, keeping peak ledger bytes to roughly "
+    "1/buckets of the shard (the local stage grace path's distributed "
+    "twin).  A single key overflowing its bucket falls back to a salted "
+    "re-split.  0 = disabled: post-exchange memory pressure stays a "
+    "bounded HostMemoryError."
+).check(lambda v: v >= 0).int(32)
+
 SHUFFLE_RANGE_SAMPLE_SIZE = conf("spark.tpu.shuffle.rangeSampleSize").doc(
     "Per-process, per-side number of join-key sample points published "
     "in the range-partitioning sample round.  Larger = tighter cut "

@@ -97,6 +97,16 @@ class HostMemoryError(MemoryError):
         super().__init__(msg)
 
 
+class HostMemoryPressure(HostMemoryError):
+    """A ledger reservation failed at a point where a DEGRADED mode can
+    still complete the query (the drained post-exchange shard of a
+    distributed join, which the crossproc grace path can re-bucket to
+    disk and join piecewise).  Raisers guarantee the underlying state is
+    intact and re-consumable; callers with no grace path installed may
+    treat it exactly as its ``HostMemoryError`` base — bounded, never
+    partial."""
+
+
 def batch_nbytes(batch: ColumnBatch) -> int:
     total = 0
     for v in batch.vectors:

@@ -87,18 +87,20 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..columnar import ColumnBatch, ColumnVector
-from ..expressions import Col, EvalContext, Hash64
+from ..expressions import Col, EvalContext, Hash64, Literal
 from ..kernels import (
     compact, partition_host_slices, range_bucket, slice_rows, take_batch,
     union_all,
 )
+from ..memory import HostMemoryError, HostMemoryPressure
 from ..sql import physical as P
+from .. import types as T
 from .. import wire
 from .hostshuffle import ExchangeFetchFailed, FetchSink, HostShuffleService
 
 __all__ = ["host_exchange_group_agg", "crossproc_execute",
            "choose_join_strategy", "adaptive_join_decision",
-           "observed_side_stats", "StatsFeedback",
+           "observed_side_stats", "elastic_reducer_width", "StatsFeedback",
            "ExchangeFetchFailed"]
 
 
@@ -790,6 +792,8 @@ def _shuffled_join_shards(session, join, key_pairs,
         sizes: Dict[int, int] = {}
         side_obs: Dict[str, List[int]] = {}
         partial_nodes = [None, None]
+        side_exprs: List[list] = []       # join keys on the side OUTPUT
+        side_hash_exprs: List[list] = []  # join keys on the SHIPPED rows
         for i, (tag, skey, (subtree, exprs)) in enumerate(zip(
                 ("jL", "jR"), ("l", "r"), (
                     (join.children[0], [l for l, _ in key_pairs]),
@@ -807,6 +811,8 @@ def _shuffled_join_shards(session, join, key_pairs,
             else:
                 local = _run_local(session, subtree).to_host()
                 hash_exprs = exprs
+            side_exprs.append(exprs)
+            side_hash_exprs.append(hash_exprs)
             ectx = EvalContext(local, np)
             h = ectx.broadcast(Hash64(*hash_exprs).eval(ectx)).data
             fine = (np.asarray(h).astype(np.uint64)
@@ -837,7 +843,8 @@ def _shuffled_join_shards(session, join, key_pairs,
             left, right = _demote_locals_to_broadcast(
                 svc, xid, decision, [p[0] for p in pending])
             return left, right, decision
-        bounds = svc.plan_reducers(totals, target)
+        width = _elastic_width(svc, session, join, mans, target)
+        bounds = svc.plan_reducers(totals, target, n_max=width)
 
         # hash confirmed: NOW bucket each side into host slices and
         # stage them in RAM (ledger-reserved) or a spill file
@@ -851,30 +858,42 @@ def _shuffled_join_shards(session, join, key_pairs,
             del bucketed, local    # a spilled side frees its rows here
         del pending
 
-        shards: List[ColumnBatch] = []
-        for i, (tag, side) in enumerate(zip(("jL", "jR"), sides)):
-            sink = FetchSink(svc, f"shuffle:{xid}:{tag}-fetch",
-                             f"{xid}-{tag}", sdir)
-            try:
+        shards: List[Optional[ColumnBatch]] = []
+        sinks: List[FetchSink] = []
+        grace_from: Optional[int] = None
+        try:
+            for i, (tag, side) in enumerate(zip(("jL", "jR"), sides)):
+                sink = FetchSink(svc, f"shuffle:{xid}:{tag}-fetch",
+                                 f"{xid}-{tag}", sdir)
+                sinks.append(sink)
+                # once a SIBLING side pressured into grace, later sides
+                # exchange delivery-only: their entries stay in the sink
+                # for the grace pass to stream
+                sink.defer_drain = grace_from is not None
                 # group g of the shared bounds belongs to the g-th LIVE
                 # process (group_owner) — after a recovery epoch the
                 # owner list skips agreed-lost pids, so no block is ever
                 # addressed to a dead receiver
                 if side.kind == "mem":
                     routed: Dict[int, List[ColumnBatch]] = {}
-                    for g, (lo, hi) in enumerate(zip(bounds, bounds[1:])):
+                    for g, (lo, hi) in enumerate(zip(bounds,
+                                                     bounds[1:])):
                         n_rows = int(side.cnt[lo:hi].sum())
                         if n_rows:
                             routed[svc.group_owner(g)] = [slice_rows(
-                                side.bucketed, int(side.off[lo]), n_rows)]
-                    received = _exchange_with_refetch(
-                        svc, f"{xid}-{tag}", routed, sink=sink)
+                                side.bucketed, int(side.off[lo]),
+                                n_rows)]
+                    exchange = (lambda routed=routed:
+                                _exchange_with_refetch(
+                                    svc, f"{xid}-{tag}", routed,
+                                    sink=sink))
                 else:
                     # ship straight from the spill file: a reducer's
                     # contiguous fine range is one contiguous byte span
                     parts_routed: Dict[int, list] = {}
                     meta: Dict[int, Tuple[int, int]] = {}
-                    for g, (lo, hi) in enumerate(zip(bounds, bounds[1:])):
+                    for g, (lo, hi) in enumerate(zip(bounds,
+                                                     bounds[1:])):
                         length = side.offsets[hi] - side.offsets[lo]
                         if length:
                             owner = svc.group_owner(g)
@@ -882,9 +901,29 @@ def _shuffled_join_shards(session, join, key_pairs,
                                                     length)]
                             meta[owner] = (int(side.raw[lo:hi].sum()),
                                            int(side.rows[lo:hi].sum()))
-                    received = _exchange_spilled_with_refetch(
-                        svc, f"{xid}-{tag}", side.path, parts_routed,
-                        meta, sink=sink)
+                    exchange = (lambda parts_routed=parts_routed,
+                                meta=meta:
+                                _exchange_spilled_with_refetch(
+                                    svc, f"{xid}-{tag}", side.path,
+                                    parts_routed, meta, sink=sink))
+                try:
+                    received = exchange()
+                except HostMemoryPressure:
+                    # blocks all shipped/landed — only the DRAIN failed,
+                    # with the sink's entries intact: grace takes over
+                    # (the bounded abort remains for grace off, and for
+                    # spill-disk exhaustion, which raises plain
+                    # HostMemoryError from the write path)
+                    if not svc.grace_buckets:
+                        raise
+                    grace_from = i
+                    shards.append(None)
+                    svc.ledger.release(f"shuffle:{xid}:{tag}-map")
+                    continue
+                svc.ledger.release(f"shuffle:{xid}:{tag}-map")
+                if sink.defer_drain:
+                    shards.append(None)
+                    continue
                 received = [b for b in received
                             if int(np.asarray(b.num_rows()))] or \
                     [_one_dead_row(side.dead)]
@@ -906,9 +945,43 @@ def _shuffled_join_shards(session, join, key_pairs,
                 # disk, the own share re-accounted by the sink): the
                 # map-side reservation must not keep inflating the
                 # ledger while the OTHER side stages
-                svc.ledger.release(f"shuffle:{xid}:{tag}-map")
-            finally:
                 sink.close()
+            if grace_from is not None:
+                grace_sides = []
+                for i, side in enumerate(sides):
+                    if shards[i] is not None:
+                        # drained before the pressure: already finalized
+                        # — re-bucket the shard by its OUTPUT join keys.
+                        # Its drain-time reservation is given back NOW:
+                        # the grace pass streams the shard to disk, and
+                        # the freed budget is exactly what the bucket
+                        # joins reserve against
+                        svc.ledger.release(sinks[i].owner)
+                        grace_sides.append((("batches", [shards[i]]),
+                                            side_exprs[i], None,
+                                            shards[i]))
+                        continue
+                    finisher = None
+                    if partial_nodes[i] is not None:
+                        def finisher(batch, i=i):
+                            from ..sql import logical as L
+                            out = _finalize_partial_side(
+                                side_aggs[i].agg, partial_nodes[i],
+                                batch)
+                            for p in reversed(side_aggs[i].projs):
+                                out = _run_local(
+                                    session, L.Project(
+                                        p.exprs, L.LocalRelation(out)))
+                            return out
+                    grace_sides.append((("sink", sinks[i]),
+                                        side_hash_exprs[i], finisher,
+                                        side.dead))
+                joined = _grace_bucket_join(session, join, svc, xid,
+                                            sdir, grace_sides)
+                return joined, None, "grace"
+        finally:
+            for s in sinks:
+                s.close()
         from ..analysis import runtime as _az
         if _az.runtime_checks_enabled(session):
             _az.verify_hash_copartition(join, key_pairs, bounds, n_fine,
@@ -1097,6 +1170,40 @@ def observed_side_stats(mans: Dict[int, dict], n_senders: int
     return l_bytes, l_rows, r_bytes, r_rows
 
 
+def elastic_reducer_width(observed_bytes: Optional[int],
+                          target_bytes: int, n_live: int) -> int:
+    """Reducer-set width from OBSERVED exchange volume: enough reducers
+    to keep each near the advisory target, never more than the live set,
+    never fewer than one.  Incomplete stats (None) or no advisory target
+    keep the full width — the same lost-round fallback as the adaptive
+    strategy decision.  Pure function of shared inputs, so every process
+    derives the SAME width without a driver (the agreement
+    ``verify_elastic_reducer_plan`` pins)."""
+    if observed_bytes is None or target_bytes <= 0 or n_live <= 0:
+        return n_live
+    return max(1, min(n_live,
+                      -(-int(observed_bytes) // int(target_bytes))))
+
+
+def _elastic_width(svc: HostShuffleService, session, join,
+                   mans: Dict[int, dict], target: int) -> int:
+    """Derive (and account) the elastic reducer width for one exchange
+    from the ``{xid}-plan`` round's piggybacked side totals."""
+    n_live = len(svc.live_pids())
+    obs = observed_side_stats(mans, n_live)
+    width = elastic_reducer_width(
+        (int(obs[0]) + int(obs[2])) if obs is not None else None,
+        target, n_live)
+    svc.counters["reducers_planned"] += n_live
+    svc.counters["reducers_observed"] += width
+    if width != n_live:
+        svc.counters["reducers_elastic"] += 1
+    from ..analysis import runtime as _az
+    if _az.runtime_checks_enabled(session):
+        _az.verify_elastic_reducer_plan(join, width, mans, n_live, target)
+    return width
+
+
 def adaptive_join_decision(frozen: str, how: str, broadcast_threshold: int,
                            n_procs: int,
                            observed: Optional[Tuple[int, int, int, int]]
@@ -1244,6 +1351,233 @@ def _finalize_partial_side(agg_node, partial_node, state: ColumnBatch
     if not int(final.capacity):
         final = _one_dead_row(final)
     return final
+
+
+# ---------------------------------------------------------------------------
+# grace-partitioned degraded mode: the distributed twin of the local
+# stage grace join.  When a reducer's drained post-exchange shard (or
+# its join output) cannot be reserved under the host-memory ledger, the
+# lanes re-bucket BOTH sides' wire-format runs by join-key hash into
+# spill files and join bucket-by-bucket through the ordinary local join
+# step (which rides the stage-compiled planner cache, keyed per bucket
+# capacity) — peak ledger bytes drop to roughly one bucket's worth.  A
+# single key overflowing its bucket re-splits under a constant salt
+# (identical keys stay together, distinct co-bucketed keys separate);
+# only a bucket that still cannot fit after _GRACE_MAX_SALT_DEPTH
+# re-splits raises the bounded HostMemoryError.
+# ---------------------------------------------------------------------------
+
+_GRACE_SUB_BUCKETS = 16
+_GRACE_MAX_SALT_DEPTH = 3
+
+
+def _grace_bucket_ids(batch: ColumnBatch, key_exprs, n_buckets: int,
+                      salt: int) -> np.ndarray:
+    """Per-row grace bucket ids: ``Hash64(salt?, keys) % n_buckets``.
+    ``Hash64`` hashes dictionary columns through their WORD hashes, so
+    the assignment is value-consistent across the differing per-sender
+    code spaces a sink streams — no unification needed to bucket."""
+    ectx = EvalContext(batch, np)
+    exprs = ([Literal(int(salt), T.int64)] if salt else []) + \
+        list(key_exprs)
+    h = ectx.broadcast(Hash64(*exprs).eval(ectx)).data
+    return (np.asarray(h).astype(np.uint64)
+            % np.uint64(n_buckets)).astype(np.int32)
+
+
+def _grace_skip(how: str, l_empty: bool, r_empty: bool) -> bool:
+    """Buckets a join type cannot produce rows from (the local grace
+    path's skip rule): joins preserving neither side need both, a
+    side-preserving join needs its preserved side, full needs either."""
+    if how in ("inner", "cross", "left_semi"):
+        return l_empty or r_empty
+    if how in ("left", "left_anti"):
+        return l_empty
+    if how == "right":
+        return r_empty
+    return l_empty and r_empty               # full
+
+
+def _grace_spill_buckets(svc: HostShuffleService, xid: str, sdir: str,
+                         tag: str, batches, key_exprs,
+                         n_buckets: int, salt: int) -> Dict[int, list]:
+    """Re-bucket a stream of batches by (salted) join-key hash into
+    wire-framed spill files under ``sdir``; returns
+    ``bucket -> [path, raw_bytes, rows]`` for the buckets that got rows.
+    Dead rows fold out via ``partition_host_slices``' virtual tail
+    partition.  A failed spill write (disk exhausted) is the genuinely
+    unspillable case: structured ``HostMemoryError``, never partial."""
+    exch = f"{xid}-grace"
+    out: Dict[int, list] = {}
+    for b in batches:
+        host = b.to_host()
+        if not int(np.asarray(host.num_rows())):
+            continue
+        ids = _grace_bucket_ids(host, key_exprs, n_buckets, salt)
+        bucketed, off, cnt = partition_host_slices(np, host, ids,
+                                                   n_buckets)
+        for p in range(n_buckets):
+            c = int(cnt[p])
+            if not c:
+                continue
+            sub = slice_rows(bucketed, int(off[p]), c)
+            buf = wire.encode_batches(
+                [sub], codec=svc.wire_codec,
+                compress_threshold=svc.wire_threshold)
+            path = os.path.join(sdir, f"{exch}-{tag}-b{p:04d}.run")
+            entry = out.setdefault(p, [path, 0, 0])
+            try:
+                svc.spill_write(path, buf, append=entry[2] > 0,
+                                exchange=exch)
+            except OSError as e:
+                raise HostMemoryError(
+                    f"shuffle:{xid}:grace", wire.raw_nbytes([sub]),
+                    svc.ledger.budget,
+                    holders={o: svc.ledger.held(o)
+                             for o in svc.ledger.owners()},
+                    exchange=exch, detail=f"grace spill failed: {e}")
+            entry[1] += int(wire.raw_nbytes([sub]))
+            entry[2] += c
+            svc.counters["grace_spill_bytes"] += len(buf)
+    return out
+
+
+def _grace_join_bucket(session, join, svc: HostShuffleService, xid: str,
+                       sdir: str, lmeta, rmeta, grace_sides,
+                       n_buckets: int, depth: int, bucket: int,
+                       outputs: List[ColumnBatch]) -> None:
+    """Join ONE grace bucket under a hard ledger reservation — or, when
+    even one bucket cannot fit, re-split it under a constant salt and
+    recurse.  ``grace_sides[i] = (source, key_exprs, finisher, dead)``;
+    only ``key_exprs``/``finisher``/``dead`` are read here (sources were
+    consumed by the top-level spill pass)."""
+    from ..sql import logical as L
+
+    owner = f"shuffle:{xid}:grace"
+    exch = f"{xid}-grace"
+    l_empty = lmeta is None or not lmeta[2]
+    r_empty = rmeta is None or not rmeta[2]
+
+    def _drop_files():
+        for meta in (lmeta, rmeta):
+            if meta is not None:
+                try:
+                    os.remove(meta[0])
+                except OSError:
+                    pass
+
+    if _grace_skip(join.how, l_empty, r_empty):
+        _drop_files()
+        return
+    need = (int(lmeta[1]) if lmeta else 0) + \
+        (int(rmeta[1]) if rmeta else 0)
+    if not svc.ledger.try_reserve(owner, need):
+        if depth >= _GRACE_MAX_SALT_DEPTH:
+            # genuinely unspillable: a single key's rows exceed the
+            # budget even after salted re-splits — fail structured (if
+            # a raced release lets this reserve through, give it back
+            # and re-split anyway so there is one code path below)
+            svc.ledger.reserve(owner, need, exchange=exch)
+            svc.ledger.release(owner, need)
+        svc.counters["grace_salted_resplits"] += 1
+        subs: List[Dict[int, list]] = []
+        for i, meta in enumerate((lmeta, rmeta)):
+            if meta is None:
+                subs.append({})
+                continue
+            with open(meta[0], "rb") as f:
+                data = f.read()
+            frames = wire.decode_frames(data)
+            del data
+            os.remove(meta[0])
+            subs.append(_grace_spill_buckets(
+                svc, xid, sdir, f"d{depth + 1}-b{bucket:04d}-s{i}",
+                frames, grace_sides[i][1], _GRACE_SUB_BUCKETS,
+                salt=depth + 1))
+        for sb in sorted(set(subs[0]) | set(subs[1])):
+            _grace_join_bucket(session, join, svc, xid, sdir,
+                               subs[0].get(sb), subs[1].get(sb),
+                               grace_sides, _GRACE_SUB_BUCKETS,
+                               depth + 1, sb, outputs)
+        return
+    try:
+        from ..analysis import runtime as _az
+        checks = _az.runtime_checks_enabled(session)
+        assembled: List[ColumnBatch] = []
+        for i, meta in enumerate((lmeta, rmeta)):
+            _source, _exprs, finisher, dead = grace_sides[i]
+            if meta is None or not meta[2]:
+                side_b = _one_dead_row(dead)
+            else:
+                with open(meta[0], "rb") as f:
+                    data = f.read()
+                runs = svc._unify_code_space(wire.decode_frames(data))
+                side_b = union_all(runs) if len(runs) > 1 else runs[0]
+            assembled.append(side_b)
+        if checks:
+            _az.verify_grace_bucket_partition(
+                join, grace_sides[0][1], grace_sides[1][1], n_buckets,
+                depth, bucket, assembled[0], assembled[1])
+        for i in range(2):
+            finisher = grace_sides[i][2]
+            if finisher is not None:
+                assembled[i] = finisher(assembled[i])
+        joined = _run_local(session, L.Join(
+            L.LocalRelation(assembled[0]), L.LocalRelation(assembled[1]),
+            join.how, join.on, join.using)).to_host()
+        if int(np.asarray(joined.num_rows())):
+            outputs.append(compact(np, joined))
+        svc.counters["grace_buckets_used"] += 1
+    finally:
+        svc.ledger.release(owner, need)
+        _drop_files()
+
+
+def _grace_bucket_join(session, join, svc: HostShuffleService, xid: str,
+                       sdir: str, grace_sides) -> ColumnBatch:
+    """The degraded-mode join: stream both sides (a pressured/deferred
+    ``FetchSink``, or the already-drained shard) through the grace
+    re-bucketing pass, then join bucket-by-bucket and union the merged
+    outputs.  ``grace_sides[i] = (source, key_exprs, finisher, dead)``
+    with ``source`` one of ``("sink", FetchSink)`` /
+    ``("batches", [ColumnBatch, ...])``; ``finisher`` (pushed-down
+    aggregate finalization) runs per assembled bucket side — legal
+    because the aggregate keys subsume the join keys, so every partial
+    of a group shares the (salted) bucket."""
+    from ..sql import logical as L
+
+    n_buckets = max(1, int(svc.grace_buckets))
+    per_side: List[Dict[int, list]] = []
+    for i, (source, key_exprs, _finisher, _dead) in \
+            enumerate(grace_sides):
+        kind, payload = source
+        batches = payload.pop_entries() if kind == "sink" else payload
+        per_side.append(_grace_spill_buckets(
+            svc, xid, sdir, f"d0-s{i}", batches, key_exprs, n_buckets,
+            salt=0))
+        if kind == "sink":
+            payload.close()
+    outputs: List[ColumnBatch] = []
+    for b in sorted(set(per_side[0]) | set(per_side[1])):
+        _grace_join_bucket(session, join, svc, xid, sdir,
+                           per_side[0].get(b), per_side[1].get(b),
+                           grace_sides, n_buckets, 0, b, outputs)
+    if outputs:
+        merged = svc._unify_code_space(outputs)
+        return union_all(merged) if len(merged) > 1 else merged[0]
+    # every bucket skipped/empty: synthesize the JOINED schema by
+    # running the join over the two all-dead side templates (finished
+    # first, so an agg-state side contributes its FINAL schema)
+    dead_sides = []
+    for _source, _exprs, finisher, dead in grace_sides:
+        d = _one_dead_row(dead)
+        dead_sides.append(finisher(d) if finisher is not None else d)
+    empty = _run_local(session, L.Join(
+        L.LocalRelation(dead_sides[0]), L.LocalRelation(dead_sides[1]),
+        join.how, join.on, join.using)).to_host()
+    if not int(empty.capacity):
+        empty = _one_dead_row(empty)
+    return empty
 
 
 def _estimated_span_weights(pts, wts, cuts) -> np.ndarray:
@@ -1436,8 +1770,10 @@ def _range_merge_join_shards(session, join, spec,
             left, right = _demote_to_broadcast(
                 svc, xid, decision, staged_sides, ("rL", "rR"))
             return left, right, decision
+        width = _elastic_width(svc, session, join, mans, target)
         owners = svc.plan_range_reducers(totals[:n_spans],
-                                         totals[n_spans:], target)
+                                         totals[n_spans:], target,
+                                         n_max=width)
         if est_span_w is not None:
             # post-sample skew accounting: the observed-weight reducer
             # plan above IS the second pass the sample round couldn't
@@ -1516,27 +1852,66 @@ def _range_merge_join_shards(session, join, spec,
                                 wire.raw_nbytes([sub]), nrows)
             return routed, {r: (m[0], m[1]) for r, m in meta.items()}
 
-        recvs: List[List[ColumnBatch]] = []
-        for side, tag, is_build in ((staged_sides[0], "rL", False),
-                                    (staged_sides[1], "rR", True)):
-            exch = f"{xid}-{tag}"
-            sink = FetchSink(svc, f"shuffle:{xid}:{tag}-fetch", exch,
-                             sdir)
-            try:
-                if side.kind == "mem":
-                    recvs.append(_exchange_with_refetch(
-                        svc, exch, route(side, is_build), sink=sink))
-                else:
-                    parts_routed, meta = route_spilled(side, exch,
-                                                       is_build)
-                    recvs.append(_exchange_spilled_with_refetch(
-                        svc, exch, side.path, parts_routed, meta,
-                        sink=sink))
+        recvs: List[Optional[List[ColumnBatch]]] = []
+        sinks: List[FetchSink] = []
+        grace_from: Optional[int] = None
+        try:
+            for i, (side, tag, is_build) in enumerate((
+                    (staged_sides[0], "rL", False),
+                    (staged_sides[1], "rR", True))):
+                exch = f"{xid}-{tag}"
+                sink = FetchSink(svc, f"shuffle:{xid}:{tag}-fetch",
+                                 exch, sdir)
+                sinks.append(sink)
+                sink.defer_drain = grace_from is not None
+                try:
+                    if side.kind == "mem":
+                        received = _exchange_with_refetch(
+                            svc, exch, route(side, is_build), sink=sink)
+                    else:
+                        parts_routed, meta = route_spilled(side, exch,
+                                                           is_build)
+                        received = _exchange_spilled_with_refetch(
+                            svc, exch, side.path, parts_routed, meta,
+                            sink=sink)
+                except HostMemoryPressure:
+                    # drain failed with the sink intact: grace takes
+                    # over (spill-disk exhaustion still aborts bounded
+                    # via plain HostMemoryError from the write path)
+                    if not svc.grace_buckets:
+                        raise
+                    grace_from = i
+                    recvs.append(None)
+                    svc.ledger.release(f"shuffle:{xid}:{tag}-map")
+                    continue
                 # shipped: stop charging the map-side staging for this
                 # tag while the other side exchanges
                 svc.ledger.release(f"shuffle:{xid}:{tag}-map")
-            finally:
-                sink.close()
+                if sink.defer_drain:
+                    recvs.append(None)
+                else:
+                    recvs.append(received)
+                    sink.close()
+            if grace_from is not None:
+                grace_sides = []
+                for i, expr in enumerate((l_expr, r_expr)):
+                    if recvs[i] is not None:
+                        # already drained: hand the budget back before
+                        # the grace pass re-spills the batches to disk
+                        svc.ledger.release(sinks[i].owner)
+                        src = ("batches",
+                               [b for b in recvs[i]
+                                if int(np.asarray(b.num_rows()))])
+                    else:
+                        src = ("sink", sinks[i])
+                    grace_sides.append((src, [expr], None,
+                                        staged_sides[i].dead))
+                joined = _grace_bucket_join(session, join, svc, xid,
+                                            sdir, grace_sides)
+                return joined, None, "grace"
+        finally:
+            for s in sinks:
+                s.close()
         probe_recv, build_recv = recvs
 
         probe_runs = [b for b in probe_recv
@@ -2022,26 +2397,38 @@ def _crossproc_execute(session, optimized, svc: HostShuffleService,
         elif strategy == "range":
             left_shard, right_shard, demoted = _range_merge_join_shards(
                 session, join, range_spec, svc, xid, adaptive=actx)
-            join2 = L.Join(L.LocalRelation(left_shard),
-                           L.LocalRelation(right_shard),
-                           join.how, join.on, join.using)
-            if demoted is None:
+            if demoted == "grace":
+                # the grace pass already JOINED this process's key
+                # spans bucket-by-bucket: the shard replaces the whole
+                # join subtree (degraded but exact)
                 svc.counters["range_merge_joins"] += 1
-                # build arrives globally (flag, key)-sorted from the
-                # k-way merge → the planner picks PMergeJoin (no build
-                # re-sort); a demoted join has no presorted build
-                join2._presorted_build = True
+                join2 = L.LocalRelation(left_shard)
             else:
-                svc.counters["broadcast_joins"] += 1
+                join2 = L.Join(L.LocalRelation(left_shard),
+                               L.LocalRelation(right_shard),
+                               join.how, join.on, join.using)
+                if demoted is None:
+                    svc.counters["range_merge_joins"] += 1
+                    # build arrives globally (flag, key)-sorted from the
+                    # k-way merge → the planner picks PMergeJoin (no
+                    # build re-sort); a demoted join has no presorted
+                    # build
+                    join2._presorted_build = True
+                else:
+                    svc.counters["broadcast_joins"] += 1
         else:
             left_shard, right_shard, demoted = _shuffled_join_shards(
                 session, join, key_pairs, svc, xid, adaptive=actx,
                 side_aggs=(l_side_spec[1], r_side_spec[1]))
-            svc.counters["shuffled_joins" if demoted is None
+            svc.counters["shuffled_joins" if demoted in (None, "grace")
                          else "broadcast_joins"] += 1
-            join2 = L.Join(L.LocalRelation(left_shard),
-                           L.LocalRelation(right_shard),
-                           join.how, join.on, join.using)
+            if demoted == "grace":
+                # grace pass output is the joined shard itself
+                join2 = L.LocalRelation(left_shard)
+            else:
+                join2 = L.Join(L.LocalRelation(left_shard),
+                               L.LocalRelation(right_shard),
+                               join.how, join.on, join.using)
         if isinstance(node, L.Aggregate) and bool(node.keys):
             # keyed Aggregate above the join: merge via the existing
             # partial→route→merge pipeline instead of gathering raw join

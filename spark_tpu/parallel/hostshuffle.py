@@ -206,6 +206,11 @@ class FetchSink:
         #: sender → (ordered entries, run-file path or None, file end)
         #: entry: ("mem", batch, nbytes) | ("disk", start, length, raw)
         self._senders: Dict[int, Tuple[list, Optional[str], int]] = {}
+        #: crossproc grace mode flips this once a SIBLING side has
+        #: already hit pressure: ``drain`` becomes a no-op so the
+        #: exchange completes delivery-only and the grace pass streams
+        #: this sink's entries itself via ``pop_entries``
+        self.defer_drain = False
 
     def _run_path(self, sender: int) -> str:
         return os.path.join(self.spill_dir,
@@ -261,10 +266,18 @@ class FetchSink:
     def drain(self) -> List[ColumnBatch]:
         """Everything delivered, own-first then sorted sender order,
         spilled runs loaded back under a HARD ledger reservation (by
-        now the in-flight fetches are done; if the drained shard itself
-        cannot fit, that is a structured ``HostMemoryError``, not an
-        opaque OOM)."""
+        now the in-flight fetches are done).  A reservation failure here
+        is raised as ``HostMemoryPressure`` — every drain-made
+        reservation is rolled back first and the sender entries stay
+        intact, so a grace-capable caller can re-stream the sink through
+        ``pop_entries`` instead; with no grace path installed the
+        exception is still its bounded ``HostMemoryError`` base."""
+        from ..memory import HostMemoryError, HostMemoryPressure
+
+        if self.defer_drain:
+            return []
         out: List[ColumnBatch] = []
+        drained = 0                  # hard bytes reserved by THIS drain
         with self._lock:
             for sender in sorted(self._senders):
                 entries, path, _end = self._senders[sender]
@@ -273,8 +286,19 @@ class FetchSink:
                         out.append(entry[1])
                         continue
                     _kind, start, length, raw = entry
-                    self.svc.ledger.reserve(self.owner, raw,
-                                            exchange=self.exchange)
+                    try:
+                        self.svc.ledger.reserve(self.owner, raw,
+                                                exchange=self.exchange)
+                    except HostMemoryError as e:
+                        if drained:
+                            self.svc.ledger.release(self.owner, drained)
+                        raise HostMemoryPressure(
+                            self.owner, int(raw), self.svc.ledger.budget,
+                            holders=e.holders, exchange=self.exchange,
+                            detail="drained shard exceeds the host "
+                                   "budget; sink entries intact for a "
+                                   "grace pass")
+                    drained += int(raw)
                     with open(path, "rb") as f:
                         f.seek(start)
                         data = f.read(length)
@@ -284,6 +308,42 @@ class FetchSink:
                             f"of {length} B at {start}")
                     out.extend(wire.decode_frames(data))
         return out
+
+    def pop_entries(self):
+        """Destructively stream every delivered batch, own-first then
+        sorted sender order (the ``drain`` order), WITHOUT accumulating:
+        each mem entry's reservation is released as it is yielded and
+        each disk frame is decoded one entry at a time, so the caller
+        (the grace re-bucketing pass) holds at most one entry's worth of
+        decoded rows beyond its own accounting.  Run files are removed
+        as their senders are exhausted."""
+        with self._lock:
+            senders = sorted(self._senders)
+        for sender in senders:
+            with self._lock:
+                entries, path, _end = self._senders.pop(
+                    sender, ([], None, 0))
+            for entry in entries:
+                if entry[0] == "mem":
+                    _kind, batch, nb = entry
+                    self.svc.ledger.release(self.owner, nb)
+                    yield batch
+                    continue
+                _kind, start, length, _raw = entry
+                with open(path, "rb") as f:
+                    f.seek(start)
+                    data = f.read(length)
+                if len(data) != length:
+                    raise OSError(
+                        f"spill run {path}: short read {len(data)} "
+                        f"of {length} B at {start}")
+                for batch in wire.decode_frames(data):
+                    yield batch
+            if path is not None:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
 
     def close(self) -> None:
         with self._lock:
@@ -488,6 +548,15 @@ class HostShuffleService:
             # per-sender retry budget
             "recovery_rounds": 0, "stage_retries": 0,
             "recovered_partitions": 0, "retry_budget_exhausted": 0,
+            # graceful degradation past the exchange: buckets actually
+            # joined by the grace pass, wire bytes it spilled, buckets a
+            # single hot key forced through a salted re-split — and the
+            # elastic reducer plan: full-width vs observed-volume widths
+            # the planners derived, exchanges where they differed
+            "grace_buckets_used": 0, "grace_spill_bytes": 0,
+            "grace_salted_resplits": 0,
+            "reducers_planned": 0, "reducers_observed": 0,
+            "reducers_elastic": 0,
         }
         #: reduce-partition byte sizes of the most recent ``plan_reducers``
         #: / ``plan_range_reducers`` call (manifest-summed), feeding the
@@ -537,6 +606,7 @@ class HostShuffleService:
         #: reserve spill to disk through ``spill_write``
         self.ledger = ledger
         self.spill_threshold = conf.get(C.SHUFFLE_SPILL_THRESHOLD)
+        self._conf = conf
         self.max_inflight_bytes = conf.get(C.SHUFFLE_IO_MAX_INFLIGHT)
         self._gate = _InflightGate(self.max_inflight_bytes,
                                    on_wait=self._count_backpressure)
@@ -568,6 +638,14 @@ class HostShuffleService:
         self._drained = threading.Condition(self._lock)
         self._write_errors: List[BaseException] = []
         os.makedirs(root, exist_ok=True)
+
+    @property
+    def grace_buckets(self) -> int:
+        """Grace-partition fan-out for post-exchange memory pressure,
+        read LIVE from the conf so ``SET`` tunes a running service
+        (0 = grace disabled; pressure stays a bounded
+        ``HostMemoryError``)."""
+        return int(self._conf.get(C.CROSSPROC_GRACE_BUCKETS))
 
     def _count_retry(self, _path: str) -> None:
         with self._lock:
@@ -979,8 +1057,8 @@ class HostShuffleService:
         exchange with the usual structured failure."""
         return self.gather_sizes_ex(exchange, n_partitions)[0]
 
-    def plan_reducers(self, sizes: np.ndarray,
-                      target_bytes: int) -> List[int]:
+    def plan_reducers(self, sizes: np.ndarray, target_bytes: int,
+                      n_max: Optional[int] = None) -> List[int]:
         """Fine-partition → reducer assignment off the manifest totals
         (the ExchangeCoordinator.doEstimationIfNecessary analog).
 
@@ -994,10 +1072,17 @@ class HostShuffleService:
         accumulate until the running total reaches the target (tiny
         neighbors coalesce, counted); with target 0 the split is static
         and even.  Deterministic in the inputs, so all processes agree
-        without communicating."""
+        without communicating.
+
+        ``n_max`` caps the reducer set narrower than the live set (the
+        ELASTIC plan): an observed-volume width derived identically on
+        every process — groups beyond it never form, so tiny joins stop
+        paying full-width coalescing."""
         sizes = np.asarray(sizes, np.int64)
         n_fine = len(sizes)
         n_live = len(self.live_pids())
+        if n_max is not None:
+            n_live = max(1, min(int(n_max), n_live))
         if target_bytes <= 0:
             bounds = sorted({round(g * n_fine / n_live)
                              for g in range(n_live + 1)})
@@ -1040,8 +1125,9 @@ class HostShuffleService:
                 if med > 0 and totals[s] > self.SKEW_FACTOR * med}
 
     def plan_range_reducers(self, probe_sizes: np.ndarray,
-                            build_sizes: np.ndarray,
-                            target_bytes: int) -> List[List[int]]:
+                            build_sizes: np.ndarray, target_bytes: int,
+                            n_max: Optional[int] = None
+                            ) -> List[List[int]]:
         """Key-span → reducer assignment for the RANGE exchange, with
         skew-span SPLITTING (the OptimizeSkewedJoin mitigation the hash
         path can only flag).
@@ -1092,6 +1178,8 @@ class HostShuffleService:
         owners: List[List[int]] = [[] for _ in range(n_spans)]
         loads = [0] * self.n
         live = self.live_pids()      # recovery-agreed live set only
+        if n_max is not None:        # elastic: first n_max live pids
+            live = live[:max(1, min(int(n_max), len(live)))]
 
         def least_loaded(k: int) -> List[int]:
             return sorted(live, key=lambda p: (loads[p], p))[:k]

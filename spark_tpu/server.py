@@ -153,7 +153,8 @@ class SQLServer:
         # -- multi-tenant serving core: shared across ALL sessions -------
         self._admission = AdmissionController(
             session.conf_obj,
-            lambda: getattr(session, "_host_ledger", None))
+            lambda: getattr(session, "_host_ledger", None),
+            grace_supplier=self._grace_total)
         self._plan_cache: Optional[PlanCache] = None
         if session.conf_obj.get(C.SERVER_PLAN_CACHE_ENABLED):
             self._plan_cache = PlanCache(session.conf_obj)
@@ -171,6 +172,36 @@ class SQLServer:
         self._reaper_stop = threading.Event()
         self._reaper: Optional[threading.Thread] = None
         self._register_metrics()
+
+    # -- grace-degradation visibility ------------------------------------
+    @staticmethod
+    def _grace_stats(session) -> Dict[str, int]:
+        """One session's cumulative grace-mode activity, read off its
+        host-shuffle service counters (empty when host shuffle is off or
+        the session never degraded)."""
+        svc = getattr(session, "_crossproc_svc", None)
+        counters = getattr(svc, "counters", None) if svc is not None \
+            else None
+        if not counters:
+            return {}
+        out = {k: int(counters.get(k, 0))
+               for k in ("grace_buckets_used", "grace_spill_bytes",
+                         "grace_salted_resplits", "reducers_elastic")}
+        return out if any(out.values()) else {}
+
+    def _grace_total(self) -> int:
+        """Cumulative grace-degradation events across every session —
+        the admission controller's learned signal that running near the
+        headroom floor now costs spill-speed joins."""
+        try:
+            with self._reg_lock:
+                sessions = [ss.session for ss in self._sessions.values()]
+            sessions.append(self.session)
+            return sum(
+                self._grace_stats(s).get("grace_buckets_used", 0)
+                for s in sessions)
+        except Exception:
+            return 0
 
     def _register_metrics(self) -> None:
         gauges = dict(self._admission.metrics_source())
@@ -463,6 +494,11 @@ class SQLServer:
             queues = {sid: {"queued": len(ss.queue),
                             "running": ss.running_stmt is not None}
                       for sid, ss in self._sessions.items()}
+            grace = {sid: g for sid, ss in self._sessions.items()
+                     if (g := self._grace_stats(ss.session))}
+        default_grace = self._grace_stats(self.session)
+        if default_grace:
+            grace["default"] = default_grace
         out = {
             "version": self.session.version,
             "queriesExecuted": getattr(self.session, "_query_count", 0),
@@ -471,6 +507,7 @@ class SQLServer:
             "activeStatements": stmts,
             "sessionQueues": queues,
             "admission": self._admission.stats(),
+            "graceActivity": grace,
             "metrics": self.session.metricsSystem.snapshots(),
         }
         if self._plan_cache is not None:

@@ -60,9 +60,11 @@ class AdmissionRejected(RuntimeError):
 
 class AdmissionController:
     def __init__(self, conf,
-                 ledger_supplier: Optional[Callable[[], Any]] = None):
+                 ledger_supplier: Optional[Callable[[], Any]] = None,
+                 grace_supplier: Optional[Callable[[], int]] = None):
         self._conf = conf
         self._ledger = ledger_supplier or (lambda: None)
+        self._grace = grace_supplier or (lambda: 0)
         self._lock = threading.Lock()
         self.active = 0                # admitted, not yet released
         self.peak_active = 0
@@ -75,6 +77,13 @@ class AdmissionController:
     #: per-shape table bound — a serving process must not leak one entry
     #: per distinct literal-normalized statement forever
     MAX_SHAPES = 1024
+
+    #: once a session has been seen degrading into grace-mode joins, the
+    #: headroom floor is scaled by this factor: grace keeps those
+    #: queries CORRECT under pressure but at spill-disk speed, so the
+    #: server starts shedding earlier instead of stacking more tenants
+    #: onto an already-degraded ledger
+    GRACE_HEADROOM_FACTOR = 2.0
 
     # -- policy --------------------------------------------------------
     def admit(self, session_queue_depth: int,
@@ -96,6 +105,15 @@ class AdmissionController:
                              session_queue_depth, qcap, cost_key)
             floor = int(conf.get(C.SERVER_MIN_HOST_HEADROOM))
             if floor > 0:
+                try:
+                    degraded = int(self._grace() or 0)
+                except Exception:
+                    degraded = 0
+                if degraded > 0:
+                    # grace activity observed: the learned cost of
+                    # running this close to the budget is a degraded
+                    # (spill-speed) join, so demand more headroom
+                    floor = int(floor * self.GRACE_HEADROOM_FACTOR)
                 ledger = self._ledger()
                 if ledger is not None and ledger.free < floor:
                     self._reject("hostMemoryHeadroom",
@@ -149,6 +167,7 @@ class AdmissionController:
                 "rejectedBy": dict(self.rejected_by),
                 "avgStatementMs": round(self._ewma_s * 1000, 1),
                 "costShapes": len(self._shape_ewma_s),
+                "graceDegraded": int(self._grace() or 0),
             }
 
     def metrics_source(self) -> Dict[str, Callable[[], Any]]:

@@ -36,7 +36,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 
 #: the exchange phases the matrix must cover (ISSUE contract)
 PHASES = ("map-staging", "post-publish-sizes", "mid-fetch",
-          "mid-demotion", "during-recovery")
+          "mid-demotion", "during-recovery", "during-grace")
 
 
 def _scenario(name, phase, worker, mode, n, timeout_s, plans, expect,
@@ -128,6 +128,30 @@ SCENARIOS = [
         "spill-disk-full", "map-staging", "shuffled_join_worker.py",
         "spill-fault", 2, 8.0,
         {1: lambda: FaultPlan().disk_full(after_bytes=0)},
+        {0: "FAILED", 1: "HOSTMEM"}),
+    # -- kill a peer while the survivor grace-degrades: the victim
+    #    commits its jR map output then dies, so the survivor's capped
+    #    budget sends it through grace buckets before the -fin merge
+    #    exposes the loss — the recovery epoch must replay cleanly over
+    #    the partially-spilled grace state, oracle-exact --
+    _scenario(
+        "grace-kill", "during-grace", "recovery_worker.py",
+        "grace-recover", 2, 20.0,
+        {1: lambda: FaultPlan().die_after_manifest("xq000001-jR")},
+        {0: "OK", 1: "DIED"}, tier="tier1"),
+    _scenario(
+        "grace-kill-3proc", "during-grace", "recovery_worker.py",
+        "grace-recover", 3, 20.0,
+        {2: lambda: FaultPlan().die_after_manifest("xq000001-jR")},
+        {0: "OK", 1: "OK", 2: "DIED"}),
+    # -- spill-disk exhaustion DURING the grace pass itself: the only
+    #    genuinely unspillable shape — a structured bounded abort, the
+    #    error detail naming the failed grace spill --
+    _scenario(
+        "grace-disk-full", "during-grace", "shuffled_join_worker.py",
+        "grace-fault", 2, 8.0,
+        {1: lambda: FaultPlan().disk_full(after_bytes=0,
+                                          exchange="xq000001-grace")},
         {0: "FAILED", 1: "HOSTMEM"}),
 ]
 

@@ -23,6 +23,13 @@ mode "norecover" — ``maxStageRetries=0``: the pre-recovery contract
     byte-for-byte — the survivor fails BOUNDED with the structured
     error naming the lost host: ``[p<pid>] FAILED <elapsed> <lost>``,
     and the recovery counters stay zero.
+mode "grace-recover" — the "recover" contract under a host budget
+    CAPPED below the reducers' drained working set: the survivor is
+    mid-GRACE (sink re-bucketed into spill files) when the victim's
+    death surfaces at the -fin merge, so the recovery epoch must replay
+    cleanly over partially-spilled grace state — and the replay, now
+    holding the whole data on fewer processes, grace-degrades again.
+    Additionally asserts nonzero ``grace_buckets_used`` before OK.
 
 Any partial result prints ``[p<pid>] PARTIAL`` and exits 1 — the
 launcher greps for it; it must never appear.
@@ -65,6 +72,18 @@ f_sk = rng.integers(0, 40, N).astype(np.int64)
 f_price = rng.integers(1, 200, N).astype(np.int64)
 k2 = (rng.integers(0, 20, M) * 2).astype(np.int64)
 b2 = rng.integers(1, 100, M).astype(np.int64)
+if mode == "grace-recover":
+    # 40 distinct keys hash so unevenly across two reducers that one
+    # shard stays under any budget the other can survive — widen the
+    # key space AND the row counts so EVERY reducer's drained share of
+    # EACH side alone overflows the grace-mode cap (the lane trades a
+    # side's fetch reservation for its compacted shard, so pressure
+    # must arrive within one side's drain)
+    N, M = 1500, 1000
+    f_sk = rng.integers(0, 200, N).astype(np.int64)
+    f_price = rng.integers(1, 200, N).astype(np.int64)
+    k2 = (rng.integers(0, 100, M) * 2).astype(np.int64)
+    b2 = rng.integers(1, 100, M).astype(np.int64)
 mine = slice(pid, None, n)
 
 session = SparkSession.builder.appName(f"recov-{pid}").getOrCreate()
@@ -92,6 +111,22 @@ xs.conf.set("spark.tpu.cluster.heartbeatIntervalMs", "100")
 xs.conf.set("spark.tpu.cluster.heartbeatTimeoutMs", "600")
 if mode == "norecover":
     xs.conf.set(C.RECOVERY_MAX_STAGE_RETRIES.key, "0")
+elif mode == "grace-recover":
+    # forced-spill staging plus a budget EVERY reducer's drained share
+    # must overflow.  ``plan_reducers`` packs fine buckets greedily to
+    # the partition-bytes target, so the 2048 default above would hand
+    # reducer 0 a ~2 KiB sliver and the rest to the last reducer —
+    # raise the target to ~half the shipped working set (~28 KiB: the
+    # fact side prunes to sk at 8 B/row, fact2 ships k2+bonus at
+    # 16 B/row) so both reducer shards land near 14 KiB and every
+    # per-side drain (~6/8 KiB) alone overflows the 4 KiB budget.  Set
+    # BEFORE enableHostShuffle, the ledger reads it at construction.
+    # The keys are near-uniform, so grace buckets stay far below the
+    # budget in every epoch.
+    from spark_tpu.memory import HOST_BUDGET
+    xs.conf.set(C.SHUFFLE_TARGET_PARTITION_BYTES.key, "14336")
+    xs.conf.set(C.SHUFFLE_SPILL_THRESHOLD.key, "1024")
+    xs.conf.set(HOST_BUDGET.key, str(4 << 10))
 hb = HeartbeatMonitor(os.path.join(root, "beats"),
                       host_id=f"host-{pid}", conf=xs.conf_obj)
 hb.start()
@@ -124,15 +159,24 @@ except (ExchangeFetchFailed, TimeoutError) as e:
 if got != exp:
     print(f"[p{pid}] PARTIAL got={len(got)} exp={len(exp)}", flush=True)
     os._exit(1)
-if mode == "recover":
+if mode in ("recover", "grace-recover"):
     gauges = svc.metrics_source().snapshot()
     assert svc.counters["stage_retries"] >= 1, svc.counters
     assert svc.counters["recovered_partitions"] > 0, svc.counters
     assert gauges["epoch"] >= 1, gauges
+    if mode == "grace-recover":
+        # the capped budget really did force the degraded path (before
+        # the loss, after it, or both), and the epoch replay over the
+        # partially-spilled grace state still reached the exact oracle
+        assert svc.counters["grace_buckets_used"] > 0, svc.counters
+        assert svc.counters["grace_spill_bytes"] > 0, svc.counters
+        assert 0 < gauges["peak_host_bytes"] \
+            <= gauges["host_budget_bytes"], gauges
     print(f"[p{pid}] OK {len(got)} "
           f"retries={svc.counters['stage_retries']} "
           f"recovered={svc.counters['recovered_partitions']} "
-          f"epoch={gauges['epoch']}", flush=True)
+          f"epoch={gauges['epoch']} "
+          f"grace={svc.counters['grace_buckets_used']}", flush=True)
 else:
     # norecover with no fault on this process's path: plain success,
     # and the recovery machinery must not have stirred

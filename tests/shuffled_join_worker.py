@@ -38,6 +38,23 @@ mode "spill-fault": forced-spill conf plus a ``disk_full`` FaultInjector
 rule from SPARK_TPU_FAULT_PLAN: the spill write fails with ENOSPC, and
 the query must fail BOUNDED with a structured ``HostMemoryError`` (the
 peer fails bounded on its exchange timeout) — never partial results.
+
+mode "grace": a host budget CAPPED BELOW the reducers' drained working
+set, so fetching a joined shard raises ``HostMemoryPressure`` and the
+join lanes must degrade into grace buckets (re-bucket the sink by join
+key hash, join bucket-by-bucket under the budget) instead of aborting.
+A battery of keyed-aggregate-above-join queries (inner / left / semi,
+plus dictionary-coded string keys) runs on BOTH the range and hash
+lanes and must equal the uncapped full-data oracle exactly; then a huge
+advisory target forces the ELASTIC planner to narrow the reducer set
+below the live set (``reducers_elastic``), still oracle-exact.  Asserts
+nonzero ``grace_buckets_used`` / ``grace_spill_bytes`` and
+``peak_host_bytes <= host_budget_bytes``.  Final line ``GRACE-OK``.
+
+mode "grace-fault": the grace conf plus a ``disk_full`` rule aimed at
+the ``<xid>-grace`` exchange: the grace SPILL hits ENOSPC mid-degrade,
+and the query must abort bounded with a structured ``HostMemoryError``
+whose detail names the failed grace spill — never partial results.
 """
 
 import os
@@ -98,6 +115,14 @@ if mode in ("spill", "spill-fault"):
     # BEFORE enableHostShuffle (the ledger reads it at construction)
     xs.conf.set(C.SHUFFLE_SPILL_THRESHOLD.key, "1024")
     xs.conf.set(HOST_BUDGET.key, str(32 << 20))
+elif mode in ("grace", "grace-fault"):
+    # same forced-spill staging, but the budget sits BELOW the bytes a
+    # reducer drains for one join (each side lands ~3-5 KiB per process
+    # here): the second side's drain must overflow the ledger and the
+    # lanes must grace-degrade rather than abort.  Single buckets
+    # (~1/32nd of a side, plus the whole hot key) still fit.
+    xs.conf.set(C.SHUFFLE_SPILL_THRESHOLD.key, "1024")
+    xs.conf.set(HOST_BUDGET.key, str(7 << 10))
 svc = xs.enableHostShuffle(root, process_id=pid, n_processes=n,
                            timeout_s=timeout_s)
 # small advisory target: the test tables are tiny, and with the 4 MiB
@@ -244,6 +269,137 @@ if mode == "spill-fault":
         os._exit(0)
     print(f"[p{pid}] PARTIAL rows={len(got)}", flush=True)
     os._exit(1)
+
+# keyed aggregates ABOVE the join: the sides are plain leaves, so RAW
+# rows ride the join exchange (nothing pushes down) and the pressure
+# lands exactly on the reducer's drain — while the merged group states
+# keep every post-join exchange far below the capped budget
+GRACE_QUERIES = [
+    ("grace-inner",
+     "SELECT sk, count(*) AS c, sum(bonus) AS sb "
+     "FROM (SELECT sk FROM fact) f "
+     "JOIN (SELECT k2, bonus FROM fact2) f2 ON sk = k2 "
+     "GROUP BY sk ORDER BY sk"),
+    ("grace-left",
+     "SELECT sk, count(bonus) AS cb, count(*) AS c "
+     "FROM (SELECT sk FROM fact) f "
+     "LEFT JOIN (SELECT k2, bonus FROM fact2) f2 ON sk = k2 "
+     "GROUP BY sk ORDER BY sk"),
+    ("grace-semi",
+     "SELECT sk, count(*) AS c FROM (SELECT sk FROM fact) f "
+     "LEFT SEMI JOIN (SELECT k2 FROM fact2) f2 ON sk = k2 "
+     "GROUP BY sk ORDER BY sk"),
+    ("grace-string",
+     "SELECT g, count(*) AS c, sum(bonus) AS sb "
+     "FROM (SELECT g FROM fact) f "
+     "JOIN (SELECT g2, bonus FROM fact2) f2 ON g = g2 "
+     "GROUP BY g ORDER BY g"),
+]
+#: grace runs BOTH distributed lanes (gather has no reducer drain)
+GRACE_MODES = (("range", "range_merge_joins"), ("hash", "shuffled_joins"))
+
+if mode == "grace-fault":
+    FaultInjector().attach(svc)    # disk_full on the -grace exchange
+    set_mode("hash")
+    _name, sql = GRACE_QUERIES[0]
+    t0 = time.time()
+    try:
+        got = run(xs, sql)
+    except HostMemoryError as e:
+        # the faulted process: the grace SPILL hit injected ENOSPC —
+        # the degraded path itself fails structured and bounded
+        assert e.owner and "grace spill failed" in str(e), e
+        print(f"[p{pid}] FAILED-HOSTMEM {time.time() - t0:.2f} "
+              f"{e.owner}", flush=True)
+        os._exit(0)
+    except (ExchangeFetchFailed, TimeoutError):
+        # the healthy peer fails bounded on its exchange timeout
+        print(f"[p{pid}] FAILED {time.time() - t0:.2f} []", flush=True)
+        os._exit(0)
+    print(f"[p{pid}] PARTIAL rows={len(got)}", flush=True)
+    os._exit(1)
+
+if mode == "grace":
+    for name, sql in GRACE_QUERIES:
+        exp = run(oracle, sql)
+        for m, want in GRACE_MODES:
+            set_mode(m)
+            before = dict(svc.counters)
+            got = run(xs, sql)
+            assert svc.counters[want] > before[want], (
+                f"{name}/{m}: expected the {want} path, {svc.counters}")
+            if got != exp:
+                print(f"[p{pid}] GRACE-PARITY-FAIL {name}/{m} "
+                      f"got={got[:4]} exp={exp[:4]}", flush=True)
+                os._exit(1)
+        print(f"[p{pid}] GRACE-PARITY-OK {name} ({len(exp)} rows)",
+              flush=True)
+    # elastic narrowing: one reducer's worth of target bytes swallows
+    # the whole observed working set, so the plan round must narrow the
+    # reducer set below the live set — re-derived deterministically on
+    # EVERY process (the runtime invariant cross-checks it against the
+    # shared manifests) — and the lone reducer's drain grace-degrades
+    xs.conf.set(C.SHUFFLE_TARGET_PARTITION_BYTES.key, str(1 << 20))
+    name, sql = GRACE_QUERIES[0]
+    exp = run(oracle, sql)
+    for m, want in GRACE_MODES:
+        set_mode(m)
+        before = dict(svc.counters)
+        got = run(xs, sql)
+        assert svc.counters[want] > before[want], (
+            f"elastic/{m}: expected the {want} path, {svc.counters}")
+        if got != exp:
+            print(f"[p{pid}] GRACE-PARITY-FAIL elastic/{m} "
+                  f"got={got[:4]} exp={exp[:4]}", flush=True)
+            os._exit(1)
+    print(f"[p{pid}] GRACE-PARITY-OK elastic ({len(exp)} rows)",
+          flush=True)
+    # salted re-split: ONE grace bucket holds a reducer's whole working
+    # set, so it cannot fit under the budget and must re-split under a
+    # salt — the sub-buckets fit, and results still match the oracle.
+    # Two legs so at two processes EACH pressures at least once: at the
+    # small advisory target the hot-key owner degrades; at the huge
+    # target the elastic plan routes everything to the lone first
+    # reducer.  (At other widths a process may own no pressured shard
+    # in either leg — the re-split assert then stays with whoever
+    # actually graced.)
+    xs.conf.set(C.CROSSPROC_GRACE_BUCKETS.key, "1")
+    set_mode("hash")
+    before = dict(svc.counters)
+    for tgt in ("2048", str(1 << 20)):
+        xs.conf.set(C.SHUFFLE_TARGET_PARTITION_BYTES.key, tgt)
+        got = run(xs, sql)
+        if got != exp:
+            print(f"[p{pid}] GRACE-PARITY-FAIL resplit@{tgt} "
+                  f"got={got[:4]} exp={exp[:4]}", flush=True)
+            os._exit(1)
+    if n == 2 or svc.counters["grace_buckets_used"] > \
+            before["grace_buckets_used"]:
+        assert svc.counters["grace_salted_resplits"] > \
+            before["grace_salted_resplits"], svc.counters
+    print(f"[p{pid}] GRACE-PARITY-OK resplit ({len(exp)} rows)",
+          flush=True)
+    xs.conf.set(C.CROSSPROC_GRACE_BUCKETS.key,
+                str(C.CROSSPROC_GRACE_BUCKETS.default))
+    assert svc.counters["reducers_elastic"] > 0, svc.counters
+    assert 0 < svc.counters["reducers_observed"] \
+        < svc.counters["reducers_planned"], svc.counters
+    if n == 2:
+        # the budget is tuned so BOTH processes demonstrably grace at
+        # two processes; at wider sets a process may own only shards
+        # that fit, so the cumulative evidence lives on the pressured
+        # peers (parity above still ran everywhere)
+        assert svc.counters["grace_buckets_used"] > 0, svc.counters
+        assert svc.counters["grace_spill_bytes"] > 0, svc.counters
+    gauges = svc.metrics_source().snapshot()
+    assert 0 < gauges["peak_host_bytes"] <= gauges["host_budget_bytes"], \
+        gauges
+    print(f"[p{pid}] GRACE-OK buckets={svc.counters['grace_buckets_used']} "
+          f"spill={svc.counters['grace_spill_bytes']} "
+          f"resplits={svc.counters['grace_salted_resplits']} "
+          f"elastic={svc.counters['reducers_elastic']} "
+          f"peak={gauges['peak_host_bytes']}", flush=True)
+    os._exit(0)
 
 JOIN_COUNTERS = ("range_merge_joins", "shuffled_joins", "broadcast_joins")
 for name, sql, expected in QUERIES:

@@ -227,6 +227,77 @@ def test_observed_side_stats_requires_complete_round():
         assert observed_side_stats({0: good, 1: bad}, 2) is None
 
 
+def test_elastic_reducer_width_pure_function():
+    from spark_tpu.parallel.crossproc import elastic_reducer_width
+    # ceil(observed / target), clamped to [1, n_live]
+    assert elastic_reducer_width(10_000, 4096, 4) == 3
+    assert elastic_reducer_width(1, 4096, 4) == 1
+    assert elastic_reducer_width(1 << 30, 4096, 4) == 4
+    assert elastic_reducer_width(8192, 4096, 8) == 2   # exact multiple
+    assert elastic_reducer_width(8193, 4096, 8) == 3   # spill over
+    # an empty exchange still plans one reducer
+    assert elastic_reducer_width(0, 4096, 4) == 1
+    # lost round / no advisory target → full-width fallback, the same
+    # contract as the adaptive strategy decision
+    assert elastic_reducer_width(None, 4096, 4) == 4
+    assert elastic_reducer_width(10_000, 0, 4) == 4
+
+
+def test_elastic_width_deterministic_across_processes(tmp_path):
+    """No driver: every process derives the SAME width from the shared
+    ``{xid}-plan`` manifests, and ``plan_reducers`` under that ``n_max``
+    emits identical bounds on every process."""
+    from spark_tpu.parallel.crossproc import (
+        elastic_reducer_width, observed_side_stats)
+    from spark_tpu.parallel.hostshuffle import HostShuffleService
+    man = {"sides": {"l": [6000, 100], "r": [4000, 50]}}
+    mans = {0: dict(man), 1: dict(man)}
+    obs = observed_side_stats(mans, 2)
+    assert obs == (12000, 200, 8000, 100)
+    widths = {elastic_reducer_width(obs[0] + obs[2], 1 << 20, 2)
+              for _ in range(4)}
+    assert widths == {1}                      # narrowed below the live set
+    sizes = np.array([37, 0, 12, 900, 4, 4, 4, 250, 0, 66], np.int64)
+    svc0 = HostShuffleService(str(tmp_path / "a"), 0, 4, timeout_s=5.0)
+    svc1 = HostShuffleService(str(tmp_path / "b"), 1, 4, timeout_s=5.0)
+    b0 = svc0.plan_reducers(sizes, 200, n_max=2)
+    b1 = svc1.plan_reducers(sizes, 200, n_max=2)
+    assert b0 == b1
+    assert len(b0) - 1 <= 2                   # never wider than n_max
+    # and the elastic clamp really narrows relative to the full set
+    wide = svc0.plan_reducers(sizes, 200)
+    assert len(b0) <= len(wide)
+
+
+def test_verify_elastic_reducer_plan_agreement():
+    from spark_tpu.analysis.errors import PlanInvariantError
+    from spark_tpu.analysis.runtime import verify_elastic_reducer_plan
+    import spark_tpu.sql.logical as L
+    from spark_tpu.columnar import ColumnBatch
+    import spark_tpu.types as T
+
+    def leaf(name):
+        return L.LocalRelation(ColumnBatch.from_arrays(
+            {name: np.arange(2, dtype=np.int64)},
+            schema=T.StructType([T.StructField(name, T.int64)])))
+
+    join = L.Join(leaf("a"), leaf("b"), "inner",
+                  F.col("a") == F.col("b"), None)
+    man = {"sides": {"l": [6000, 100], "r": [4000, 50]}}
+    mans = {0: dict(man), 1: dict(man)}
+    # the width the recomputation reproduces passes
+    verify_elastic_reducer_plan(join, 1, mans, 2, 1 << 20)
+    # a diverged width is a broken agreement, named as such
+    with pytest.raises(PlanInvariantError,
+                       match="elastic-plan-agreement"):
+        verify_elastic_reducer_plan(join, 2, mans, 2, 1 << 20)
+    # incomplete round: only the full-width fallback is legal
+    verify_elastic_reducer_plan(join, 2, {0: dict(man)}, 2, 1 << 20)
+    with pytest.raises(PlanInvariantError,
+                       match="elastic-plan-agreement"):
+        verify_elastic_reducer_plan(join, 1, {0: dict(man)}, 2, 1 << 20)
+
+
 def test_stats_feedback_signature_is_structural():
     from spark_tpu.parallel.crossproc import StatsFeedback
     import spark_tpu.sql.logical as L

@@ -340,3 +340,82 @@ def test_stage_compile_gauges_exported(spark, mdf):
     assert after["stage_compile_ms"] >= 0.0
     # warm reuse must not have built a new executable for the repeat
     assert after["stage_cache_entries"] >= 1
+
+
+def test_grace_and_elastic_gauges_exported(spark, tmp_path):
+    """ISSUE 13 observability: graceful-degradation and elastic-reducer
+    activity ride the shuffle Source as live gauges — grace bucket
+    count, grace spill bytes, salted re-splits, and the planned vs
+    observed vs narrowed reducer tallies."""
+    prev = getattr(spark, "_crossproc_svc", None)
+    prev_ledger = getattr(spark, "_host_ledger", None)
+    ms = spark.metricsSystem
+    try:
+        svc = spark.enableHostShuffle(str(tmp_path), process_id=0,
+                                      n_processes=1, timeout_s=5.0)
+        snap0 = ms.snapshots()["shuffle"]
+        for key in ("grace_buckets_used", "grace_spill_bytes",
+                    "grace_salted_resplits", "reducers_planned",
+                    "reducers_observed", "reducers_elastic"):
+            assert key in snap0, key
+            assert snap0[key] == 0, (key, snap0[key])
+        svc.counters["grace_buckets_used"] += 3
+        svc.counters["grace_spill_bytes"] += 4096
+        svc.counters["grace_salted_resplits"] += 1
+        svc.counters["reducers_planned"] += 4
+        svc.counters["reducers_observed"] += 2
+        svc.counters["reducers_elastic"] += 1
+        snap = ms.snapshots()["shuffle"]
+        assert snap["grace_buckets_used"] == 3
+        assert snap["grace_spill_bytes"] == 4096
+        assert snap["grace_salted_resplits"] == 1
+        assert snap["reducers_planned"] == 4
+        assert snap["reducers_observed"] == 2
+        assert snap["reducers_elastic"] == 1
+    finally:
+        spark._crossproc_svc = prev
+        spark._host_ledger = prev_ledger
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]
+
+
+def test_grace_activity_in_status_and_admission(spark, tmp_path):
+    """/status surfaces per-session grace activity, and the admission
+    controller both reports the cluster-wide degraded-event total and
+    widens its memory headroom floor while degradation is live."""
+    import urllib.request
+
+    from spark_tpu.server import SQLServer
+    prev = getattr(spark, "_crossproc_svc", None)
+    prev_ledger = getattr(spark, "_host_ledger", None)
+    ms = spark.metricsSystem
+    srv = None
+    try:
+        svc = spark.enableHostShuffle(str(tmp_path), process_id=0,
+                                      n_processes=1, timeout_s=5.0)
+        srv = SQLServer(spark, port=0).start()
+
+        def status():
+            with urllib.request.urlopen(
+                    f"http://{srv.host}:{srv.port}/status",
+                    timeout=30) as r:
+                return json.loads(r.read())
+
+        st = status()
+        assert st["graceActivity"] == {}          # quiet cluster
+        assert st["admission"]["graceDegraded"] == 0
+        svc.counters["grace_buckets_used"] += 2
+        svc.counters["grace_spill_bytes"] += 8192
+        st = status()
+        got = st["graceActivity"]["default"]
+        assert got["grace_buckets_used"] == 2
+        assert got["grace_spill_bytes"] == 8192
+        assert st["admission"]["graceDegraded"] == 2
+        ac = srv._admission
+        assert ac._grace() == 2
+        assert ac.GRACE_HEADROOM_FACTOR > 1.0
+    finally:
+        if srv is not None:
+            srv.stop()
+        spark._crossproc_svc = prev
+        spark._host_ledger = prev_ledger
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]

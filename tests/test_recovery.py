@@ -254,6 +254,8 @@ def test_chaos_matrix_covers_every_fault_kind_and_phase():
     # the acceptance pair must stay in the tier-1 sweep
     assert cm.by_name("mid-fetch-kill")["tier"] == "tier1"
     assert cm.by_name("mid-fetch-kill-noretry")["tier"] == "tier1"
+    # worker loss over partially-spilled grace state stays tier-1 too
+    assert cm.by_name("grace-kill")["tier"] == "tier1"
 
 
 # ---------------------------------------------------------------------------
@@ -276,6 +278,28 @@ def test_kill_mid_fetch_recovers_oracle_exact(tmp_path):
     assert "retries=1" in out0 and "recovered=1" in out0, out0
     assert "epoch=1" in out0, out0
     assert "dying after put in 'xq000001-jL'" in results[1][1]
+
+
+def test_kill_during_grace_recovers_oracle_exact(tmp_path):
+    """Worker loss over partially-spilled grace state: the host budget
+    is capped below every reducer's drained share, so the survivor is
+    already grace-degraded (sink re-bucketed into spill files, joined
+    bucket-by-bucket) when the victim's death surfaces — the recovery
+    epoch must replay cleanly over that state and STILL produce the
+    exact full-data oracle, grace-degrading again on the replay.  The
+    worker asserts nonzero ``grace_buckets_used`` and
+    ``peak_host_bytes <= host_budget_bytes`` before printing OK."""
+    sc = cm.by_name("grace-kill")
+    results, elapsed = cm.run_scenario(sc, str(tmp_path / "shuf"))
+    bad = cm.check(sc, results, elapsed)
+    assert not bad, (bad, results)
+    out0 = results[0][1]
+    assert "retries=1" in out0 and "recovered=1" in out0, out0
+    assert "epoch=1" in out0, out0
+    line = [ln for ln in out0.splitlines() if "[p0] OK" in ln][-1]
+    grace = int(line.rsplit("grace=", 1)[1])
+    assert grace > 0, out0
+    assert "dying after manifest in 'xq000001-jR'" in results[1][1]
 
 
 def test_kill_mid_fetch_without_budget_aborts_bounded(tmp_path):

@@ -280,3 +280,42 @@ def test_spill_parity_two_processes(tmp_path):
 @pytest.mark.slow
 def test_spill_parity_three_processes(tmp_path):
     _run_spill_parity(tmp_path, 3)
+
+
+# ---------------------------------------------------------------------------
+# grace parity: a host budget CAPPED below the reducers' drained working
+# set — every join must still complete byte-identical to the oracle by
+# re-bucketing the sink into spill files and joining bucket-by-bucket
+# ---------------------------------------------------------------------------
+
+def _run_grace_parity(tmp_path, n, timeout_s=90.0):
+    root = str(tmp_path / "shuf")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("SPARK_TPU_FAULT_PLAN", None)
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(pid), str(n), root, "grace",
+         str(timeout_s)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(n)]
+    outs = [p.communicate(timeout=420)[0] for p in procs]
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid}:\n{out}"
+        assert f"[p{pid}] GRACE-OK" in out, out
+        assert "GRACE-PARITY-FAIL" not in out, out
+        # the worker itself asserted elastic narrowing, grace activity
+        # (both processes at n=2) and peak <= budget before printing OK
+        line = [ln for ln in out.splitlines()
+                if f"[p{pid}] GRACE-OK" in ln][-1]
+        if n == 2:
+            assert "buckets=0" not in line, out
+            assert "resplits=0" not in line, out
+    return outs
+
+
+def test_grace_parity_two_processes(tmp_path):
+    _run_grace_parity(tmp_path, 2)
+
+
+@pytest.mark.slow
+def test_grace_parity_three_processes(tmp_path):
+    _run_grace_parity(tmp_path, 3)
