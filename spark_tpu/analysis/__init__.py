@@ -1,4 +1,4 @@
-"""Static analysis for the engine — two heads, one package.
+"""Static analysis for the engine — three heads, one package.
 
 Head 1 (``verifier`` + ``runtime``): ``verify_plan``, a post-optimizer
 pass that walks logical/physical plans checking schema/dtype propagation
@@ -17,7 +17,21 @@ repo-specific rules — host materialization inside jitted code, ledger
 state in threaded classes, blocking I/O under a lock, planning-relevant
 conf reads missing from the plan cache fingerprint, dead imports,
 builtin shadowing.  Justified exceptions live in
-``tools/lint_waivers.toml``.
+``tools/lint_waivers.toml``; a waiver matching no finding fails the
+default full-repo lint.
+
+Head 3 (``determinism`` + ``protocol``): replica-determinism and
+exchange-protocol conformance.  ``determinism.DECISION_ROOTS`` is the
+registry of replica-deterministic entry points — the decision pipeline
+every process re-executes independently and must replicate
+bit-identically; an AST taint/call-graph pass flags nondeterministic
+sources (HZ109) and set-iteration order escaping into decisions
+(HZ110) inside its closure.  ``protocol`` statically extracts the
+manifest-round id templates from the crossproc/hostshuffle pair and
+checks publish/gather pairing, single-use discipline and epoch fencing
+(HZ111).  The runtime backstop (``runtime.verify_decision_trace``)
+piggybacks a ``decision_trace`` hash on the ``{xid}-plan`` round —
+zero added barriers — and fails structured on divergence.
 
 The checked invariants are catalogued in ``docs/INVARIANTS.md``.
 """

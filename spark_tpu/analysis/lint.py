@@ -34,11 +34,26 @@ codebase (or its reference lineage), rather than generic style:
         ``sql.stagecompile.StageCache.get_or_build``; intentional sites
         (the cache itself, one-shot model fits, the per-op bench
         baseline) carry waivers.
+  HZ109 nondet-source-in-replica-decision   a nondeterministic source
+        (wall clock, unseeded RNG, ``id()``, ``os.environ``/``urandom``,
+        thread identity) reachable from a replica-deterministic decision
+        function — the registry in ``determinism.DECISION_ROOTS``;
+        every process re-executes these and must agree bit-for-bit.
+  HZ110 unordered-iteration-escapes-decision   ``set``/unordered
+        iteration whose element order escapes into a decision value
+        inside the same call graph (``sorted(set(...))`` is clean).
+  HZ111 exchange-protocol-conformance   manifest-round misuse in the
+        ``crossproc``/``hostshuffle`` protocol pair: a published round
+        nobody gathers (or vice versa), a round id published twice in
+        one function, or an un-fenced round id inside the epoch loop.
+        See ``protocol.py``.
 
 Justified exceptions live in ``tools/lint_waivers.toml`` (every waiver
-carries a reason).  Exit status: 0 when every finding is waived, 1
-otherwise.  The same entry points back the tier-1 test
-(``tests/test_analysis.py``) and ``bin/planlint``.
+carries a reason); a waiver matching NO finding fails the default
+full-repo lint with a "remove dead waiver" message.  Exit status: 0
+when every finding is waived, 1 otherwise.  The same entry points back
+the tier-1 test (``tests/test_analysis.py``) and ``bin/planlint``
+(which grows ``--determinism`` / ``--protocol`` rule filters).
 """
 
 from __future__ import annotations
@@ -50,7 +65,9 @@ import sys
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence
 
-from .waivers import is_waived, load_waivers
+from .determinism import rule_nondet_sources, rule_unordered_iteration
+from .protocol import repo_pairing_findings, rule_protocol
+from .waivers import dead_waivers, is_waived, load_waivers
 
 __all__ = ["Finding", "lint_source", "lint_files", "lint_paths", "main"]
 
@@ -455,7 +472,9 @@ def _rule_jit_outside_stage_cache(tree, path, qnames) -> List[Finding]:
 _FILE_RULES = (_rule_jit_materialize, _rule_reserve_release,
                _rule_unlocked_state, _rule_io_under_lock,
                _rule_unused_imports, _rule_shadow_builtins,
-               _rule_jit_outside_stage_cache)
+               _rule_jit_outside_stage_cache,
+               rule_nondet_sources, rule_unordered_iteration,
+               rule_protocol)
 
 
 def lint_source(src: str, path: str = "<snippet>") -> List[Finding]:
@@ -511,9 +530,11 @@ def lint_paths(paths: Sequence[str], waiver_file: Optional[str] = None,
                conf_coverage: bool = True):
     """Lint files/directories; returns ``(unwaived, waived)`` finding
     lists, sorted by location."""
-    findings = lint_files(_collect_py(paths))
+    files = _collect_py(paths)
+    findings = lint_files(files)
     if conf_coverage:
         findings.extend(_conf_coverage_findings())
+    findings.extend(repo_pairing_findings(files))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     waivers = load_waivers(waiver_file) if waiver_file else []
     unwaived = [f for f in findings if not is_waived(f, waivers)]
@@ -538,18 +559,43 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="waiver TOML (default: tools/lint_waivers.toml)")
     ap.add_argument("--no-waivers", action="store_true",
                     help="report every finding, ignoring the waiver file")
+    ap.add_argument("--determinism", action="store_true",
+                    help="only the replica-determinism rules "
+                         "(HZ109/HZ110)")
+    ap.add_argument("--protocol", action="store_true",
+                    help="only the exchange-protocol rules (HZ111)")
     args = ap.parse_args(argv)
 
+    only = set()
+    if args.determinism:
+        only |= {"HZ109", "HZ110"}
+    if args.protocol:
+        only |= {"HZ111"}
     paths = args.paths or \
         [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
     waiver_file = None if args.no_waivers else \
         (args.waivers or _default_waiver_file())
     unwaived, waived = lint_paths(paths, waiver_file)
+    if only:
+        unwaived = [f for f in unwaived if f.rule in only]
+        waived = [f for f in waived if f.rule in only]
     for f in unwaived:
         print(f)
+    rc = 1 if unwaived else 0
+    # stale-waiver detection: only the default full-package lint can
+    # prove a waiver dead (a path or rule subset simply never produces
+    # the findings the waiver exists for)
+    if not args.paths and not only and waiver_file:
+        for w in dead_waivers(unwaived + waived,
+                              load_waivers(waiver_file)):
+            print(f"planlint: remove dead waiver {w['rule']} "
+                  f"path={w.get('path', '*')!r} "
+                  f"symbol={w.get('symbol', '*')!r} — it matches no "
+                  "finding; the code it excused has moved on")
+            rc = 1
     print(f"planlint: {len(unwaived)} finding(s), {len(waived)} waived",
           file=sys.stderr)
-    return 1 if unwaived else 0
+    return rc
 
 
 if __name__ == "__main__":

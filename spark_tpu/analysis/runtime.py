@@ -44,7 +44,9 @@ What each check pins down (docs/INVARIANTS.md has the catalogue):
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+import hashlib
+import json
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -58,6 +60,7 @@ __all__ = [
     "verify_unified_dictionaries", "verify_ledger_scope",
     "verify_recovery_agreement", "verify_epoch_released",
     "verify_elastic_reducer_plan", "verify_grace_bucket_partition",
+    "decision_trace", "verify_decision_trace",
 ]
 
 _STRATEGIES = ("broadcast_left", "broadcast_right", "range", "hash",
@@ -360,6 +363,120 @@ def verify_elastic_reducer_plan(join, width: int, mans, n_live: int,
             f"manifests imply {expect} (observed={obs}, n_live={n_live}, "
             f"target={target_bytes}) — elastic plans must agree "
             "byte-for-byte across processes")
+
+
+def decision_trace(components: Dict) -> str:
+    """Canonical hash of one exchange's replicated-decision inputs.
+
+    The components dict holds every pre-round value a process derived
+    INDEPENDENTLY that its peers must have derived bit-identically (the
+    frozen strategy, the epoch, the live set, the adopted-lost set, the
+    range cut points, the estimated skew splits).  Canonical JSON —
+    sorted keys, no whitespace — so two processes hash equal iff the
+    decisions are equal; blake2b-128 keeps the digest small enough to
+    piggyback on the ``{xid}-plan`` manifests for free (zero added
+    barriers)."""
+    blob = json.dumps(components, sort_keys=True, separators=(",", ":"),
+                      default=str)
+    return hashlib.blake2b(blob.encode("utf-8"),
+                           digest_size=16).hexdigest()
+
+
+def _trace_stats(session, diverged: bool) -> None:
+    if session is None:
+        return
+    st = session.__dict__.setdefault("_analysis_stats", {})
+    st["decision_trace_checks"] = st.get("decision_trace_checks", 0) + 1
+    if diverged:
+        st["decision_trace_divergence"] = \
+            st.get("decision_trace_divergence", 0) + 1
+
+
+def verify_decision_trace(session, join, svc, exchange: str,
+                          mans: Dict[int, dict], inputs: Dict,
+                          local: Optional[Dict] = None) -> None:
+    """The decision-trace agreement check, in two phases.
+
+    **Peer agreement** — every ``{xid}-plan`` manifest piggybacks the
+    sender's ``decision_trace`` hash plus its raw components
+    (``dtrace = {"h": ..., "c": {...}}``).  Any peer hash differing
+    from this process's own means the replicated pre-round decisions
+    (cut points, epoch, live set, recovery adoption, skew estimate)
+    diverged; the raw components name WHICH decision split so the
+    structured error is actionable.  Senders without a ``dtrace``
+    payload are skipped — a lost stats round degrades lenient, same as
+    ``observed_side_stats``.
+
+    **Local recompute** — with ``local`` supplied (the post-gather
+    call), the round's manifests are re-read FROM DISK and the adaptive
+    decision and elastic width are recomputed from those shared bytes.
+    The disk bytes are identical on every process, so a mismatch
+    against what this process actually decided means its in-memory
+    gathered view diverged from what its peers read — the failure mode
+    a symmetric file-level check can never see.  Recomputation uses
+    only the pure functions (``observed_side_stats``,
+    ``adaptive_join_decision``, ``elastic_reducer_width``); the
+    counter-bumping planners never run twice."""
+    from ..parallel import crossproc as X
+
+    mine = decision_trace(inputs)
+    for s in sorted(mans):
+        man = mans[s]
+        dt = man.get("dtrace") if isinstance(man, dict) else None
+        if not isinstance(dt, dict) or "h" not in dt:
+            continue
+        if dt["h"] == mine:
+            continue
+        theirs = dt.get("c") if isinstance(dt.get("c"), dict) else {}
+        diff = sorted(k for k in set(inputs) | set(theirs)
+                      if inputs.get(k) != theirs.get(k)) or ["<hash>"]
+        _trace_stats(session, diverged=True)
+        raise PlanInvariantError(
+            join, "decision-trace-agreement",
+            f"decision trace for round {exchange!r} diverged from "
+            f"process {s}: component(s) {diff} differ (mine {mine}, "
+            f"theirs {dt['h']!r}) — the replicated decision pipeline is "
+            "no longer bit-identical and matching keys would land on "
+            "different processes")
+    if local is not None:
+        fresh: Dict[int, dict] = {}
+        for s in mans:
+            man = svc._read_manifest(exchange, s)
+            if man is not None:
+                fresh[s] = man
+        n_live = int(local["n_live"])
+        obs = X.observed_side_stats(fresh, n_live)
+        if "decision" in local:
+            expect = local["frozen"]
+            if local.get("adaptive"):
+                expect = X.adaptive_join_decision(
+                    local["frozen"], local["how"],
+                    int(local.get("broadcast_threshold", 0)), n_live,
+                    obs)
+            if local["decision"] != expect:
+                _trace_stats(session, diverged=True)
+                raise PlanInvariantError(
+                    join, "decision-trace-agreement",
+                    f"round {exchange!r}: this process decided "
+                    f"{local['decision']!r} but the round's on-disk "
+                    f"manifests imply {expect!r} (observed={obs}, "
+                    f"frozen={local['frozen']!r}) — the gathered view "
+                    "this process acted on diverged from the shared "
+                    "bytes its peers read")
+        if "width" in local:
+            expect_w = X.elastic_reducer_width(
+                (int(obs[0]) + int(obs[2])) if obs is not None else None,
+                int(local.get("target", 0)), n_live)
+            if int(local["width"]) != int(expect_w):
+                _trace_stats(session, diverged=True)
+                raise PlanInvariantError(
+                    join, "decision-trace-agreement",
+                    f"round {exchange!r}: this process sized the "
+                    f"elastic reducer set at {local['width']} but the "
+                    f"round's on-disk manifests imply {expect_w} "
+                    f"(observed={obs}, n_live={n_live}) — reducer sets "
+                    "would diverge and routed rows vanish")
+    _trace_stats(session, diverged=False)
 
 
 def verify_grace_bucket_partition(join, exprs_l, exprs_r, n_buckets: int,

@@ -16,7 +16,7 @@ from __future__ import annotations
 import re
 from typing import Dict, List
 
-__all__ = ["load_waivers", "is_waived"]
+__all__ = ["load_waivers", "is_waived", "dead_waivers"]
 
 _KV = re.compile(r'^([A-Za-z_][A-Za-z0-9_-]*)\s*=\s*"((?:[^"\\]|\\.)*)"\s*$')
 
@@ -53,15 +53,27 @@ def load_waivers(path: str) -> List[Dict[str, str]]:
     return waivers
 
 
+def _matches(finding, w: Dict[str, str]) -> bool:
+    if w["rule"] != finding.rule:
+        return False
+    if w.get("path") and not finding.path.endswith(w["path"]):
+        return False
+    if w.get("symbol") and w["symbol"] != finding.symbol:
+        return False
+    if w.get("contains") and w["contains"] not in finding.message:
+        return False
+    return True
+
+
 def is_waived(finding, waivers: List[Dict[str, str]]) -> bool:
-    for w in waivers:
-        if w["rule"] != finding.rule:
-            continue
-        if w.get("path") and not finding.path.endswith(w["path"]):
-            continue
-        if w.get("symbol") and w["symbol"] != finding.symbol:
-            continue
-        if w.get("contains") and w["contains"] not in finding.message:
-            continue
-        return True
-    return False
+    return any(_matches(finding, w) for w in waivers)
+
+
+def dead_waivers(findings, waivers: List[Dict[str, str]]
+                 ) -> List[Dict[str, str]]:
+    """Waivers matching NO finding in a full-repo lint: the code they
+    excused has moved or been fixed, and a stale waiver would silently
+    swallow the next REAL finding that happens to match it.  The lint
+    CLI fails on these with a "remove dead waiver" message."""
+    return [w for w in waivers
+            if not any(_matches(f, w) for f in findings)]

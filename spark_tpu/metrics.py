@@ -193,6 +193,16 @@ def default_sources(session) -> List[Source]:
             session, "_analysis_stats", {}).get("plans_verified", 0),
         "plan_verify_ms": lambda: getattr(
             session, "_analysis_stats", {}).get("plan_verify_ms", 0.0),
+        # replica-determinism backstop (analysis.runtime.
+        # verify_decision_trace): checks run / divergences caught — any
+        # nonzero divergence means a process's decision pipeline split
+        # from its peers and the exchange was aborted structured
+        "decision_trace_checks": lambda: getattr(
+            session, "_analysis_stats", {}).get(
+                "decision_trace_checks", 0),
+        "decision_trace_divergence": lambda: getattr(
+            session, "_analysis_stats", {}).get(
+                "decision_trace_divergence", 0),
     }))
     from .sql.stagecompile import metrics_source as _stage_gauges
     # whole-stage compilation: the process stage-executable cache

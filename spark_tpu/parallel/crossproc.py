@@ -834,16 +834,38 @@ def _shuffled_join_shards(session, join, key_pairs,
         # per-side volumes are unrecoverable from it) — the adaptive
         # re-decision reads them before any side is even bucketed, let
         # alone a data block shipped.
+        from ..analysis import runtime as _az
+        checks = _az.runtime_checks_enabled(session)
+        dt_in = decision_inputs(svc, "hash")
         svc.publish_sizes(f"{xid}-plan", sizes,
-                          extra={"sides": side_obs})
+                          extra={"sides": side_obs,
+                                 "dtrace": {"h": _az.decision_trace(dt_in),
+                                            "c": dt_in}})
         totals, mans = svc.gather_sizes_ex(f"{xid}-plan", n_fine)
         decision = _adaptive_redecide(join, svc, xid, adaptive, "hash",
                                       mans)
+        n_live = len(svc.live_pids())
+        bt = adaptive.broadcast_threshold if adaptive is not None else 0
+        # the trace check runs BEFORE the demote branch: a DIVERGENT
+        # demotion must abort structured here, not deadlock its peers
+        # at the one-sided ``-bcast`` gather
+        if checks:
+            _az.verify_decision_trace(
+                session, join, svc, f"{xid}-plan", mans, dt_in,
+                local={"frozen": "hash", "how": join.how,
+                       "adaptive": adaptive is not None,
+                       "broadcast_threshold": bt, "n_live": n_live,
+                       "decision": decision})
         if decision != "hash":
             left, right = _demote_locals_to_broadcast(
                 svc, xid, decision, [p[0] for p in pending])
             return left, right, decision
         width = _elastic_width(svc, session, join, mans, target)
+        if checks:
+            _az.verify_decision_trace(
+                session, join, svc, f"{xid}-plan", mans, dt_in,
+                local={"frozen": "hash", "n_live": n_live,
+                       "width": width, "target": target})
         bounds = svc.plan_reducers(totals, target, n_max=width)
 
         # hash confirmed: NOW bucket each side into host slices and
@@ -982,8 +1004,7 @@ def _shuffled_join_shards(session, join, key_pairs,
         finally:
             for s in sinks:
                 s.close()
-        from ..analysis import runtime as _az
-        if _az.runtime_checks_enabled(session):
+        if checks:
             _az.verify_hash_copartition(join, key_pairs, bounds, n_fine,
                                         svc.live_pids().index(svc.pid),
                                         shards[0], shards[1])
@@ -1204,6 +1225,30 @@ def _elastic_width(svc: HostShuffleService, session, join,
     return width
 
 
+def decision_inputs(svc: HostShuffleService, frozen: str, cuts=None,
+                    est_splits=None) -> Dict[str, object]:
+    """The replicated pre-round decision components one process derived
+    INDEPENDENTLY before publishing its ``{xid}-plan`` manifest: the
+    frozen plan-time strategy, the recovery epoch, the live set, the
+    adopted-lost set, and (range lane) the derived cut points and
+    sample-estimated skew splits.  Every peer must derive this dict
+    bit-identically; its ``decision_trace`` hash rides the plan round's
+    ``extra`` so ``verify_decision_trace`` can prove it.  Pure function
+    of shared service state — registry-listed in
+    ``analysis.determinism.DECISION_ROOTS``."""
+    d: Dict[str, object] = {
+        "frozen": frozen,
+        "epoch": int(svc.epoch),
+        "live": [int(p) for p in svc.live_pids()],
+        "adopt": sorted(int(p) for p in svc.recovered_pids),
+    }
+    if cuts is not None:
+        d["cuts"] = [str(c) for c in cuts]
+    if est_splits is not None:
+        d["splits"] = sorted(int(p) for p in est_splits)
+    return d
+
+
 def adaptive_join_decision(frozen: str, how: str, broadcast_threshold: int,
                            n_procs: int,
                            observed: Optional[Tuple[int, int, int, int]]
@@ -1264,6 +1309,18 @@ def _adaptive_redecide(join, svc: HostShuffleService, xid: str,
     n_live = len(svc.live_pids())
     observed = observed_side_stats(mans, n_live)
     if observed is None:
+        # lenient-gather fallback (lost/incomplete stats round): the
+        # frozen strategy stands, but the decisions that DID replicate
+        # — the frozen choice itself and its legality — must still
+        # agree with a recompute from the same inputs; skipping the
+        # check here left the lost-round path entirely unverified
+        if adaptive.checks:
+            from ..analysis import runtime as _az
+            _az.verify_join_strategy(
+                join, frozen, frozen == "range", adaptive.key_pairs,
+                frozen=frozen, observed=None,
+                broadcast_threshold=adaptive.broadcast_threshold,
+                n_procs=n_live)
         return frozen
     svc.counters["adaptive_replans"] += 1
     if adaptive.feedback is not None:
@@ -1760,17 +1817,42 @@ def _range_merge_join_shards(session, join, spec,
             del bucketed
         # the size round doubles as the adaptive stats round: per-side
         # observed totals ride the same manifests, and the re-decision
-        # runs before any data block ships
+        # runs before any data block ships.  The sample-estimated skew
+        # splits are derived HERE (before the round) so they feed the
+        # decision trace alongside the cut points they were cut from.
+        est_split = svc.skew_spans(est_span_w.astype(np.int64)) \
+            if est_span_w is not None else set()
+        dt_in = decision_inputs(svc, "range",
+                                cuts=svc.last_range_cutpoints,
+                                est_splits=est_split)
         svc.publish_sizes(f"{xid}-plan", sizes,
-                          extra={"sides": side_obs})
+                          extra={"sides": side_obs,
+                                 "dtrace": {"h": _az.decision_trace(dt_in),
+                                            "c": dt_in}})
         totals, mans = svc.gather_sizes_ex(f"{xid}-plan", 2 * n_spans)
         decision = _adaptive_redecide(join, svc, xid, adaptive, "range",
                                       mans)
+        n_live = len(svc.live_pids())
+        bt = adaptive.broadcast_threshold if adaptive is not None else 0
+        # trace check BEFORE the demote branch: a divergent demotion
+        # aborts structured instead of deadlocking the ``-bcast`` gather
+        if checks:
+            _az.verify_decision_trace(
+                session, join, svc, f"{xid}-plan", mans, dt_in,
+                local={"frozen": "range", "how": join.how,
+                       "adaptive": adaptive is not None,
+                       "broadcast_threshold": bt, "n_live": n_live,
+                       "decision": decision})
         if decision != "range":
             left, right = _demote_to_broadcast(
                 svc, xid, decision, staged_sides, ("rL", "rR"))
             return left, right, decision
         width = _elastic_width(svc, session, join, mans, target)
+        if checks:
+            _az.verify_decision_trace(
+                session, join, svc, f"{xid}-plan", mans, dt_in,
+                local={"frozen": "range", "n_live": n_live,
+                       "width": width, "target": target})
         owners = svc.plan_range_reducers(totals[:n_spans],
                                          totals[n_spans:], target,
                                          n_max=width)
@@ -1779,7 +1861,6 @@ def _range_merge_join_shards(session, join, spec,
             # plan above IS the second pass the sample round couldn't
             # make — count the splits the sample's estimated weights
             # would NOT have flagged under the same skew rule
-            est_split = svc.skew_spans(est_span_w.astype(np.int64))
             svc.counters["post_sample_skew_splits"] += sum(
                 1 for p in range(n_spans)
                 if len(owners[p]) > 1 and p not in est_split)

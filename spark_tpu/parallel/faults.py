@@ -34,6 +34,14 @@ side; rules fire when a matching block is published:
                 ``after_bytes`` cumulative bytes — the disk backing the
                 spill directory filling up mid-query; the memory-pressure
                 paths must fail BOUNDED, never emit partial results.
+- ``skew_decision``  THIS process's gathered view of a stats round
+                (``svc.gather_sizes_ex``) comes back with one side's
+                observed totals perturbed to ``[1, 1]`` — a replica-
+                determinism violation: the on-disk bytes every peer
+                reads stay intact, so only the armed process re-decides
+                the adaptive strategy from different inputs.  The
+                decision-trace check (``verify_decision_trace``) must
+                abort it structured before any data block ships.
 
 Rules are matched by (exchange, receiver) for this service's own writes;
 healing is driven by daemon timers (wall-clock, generous vs CI retry
@@ -56,14 +64,16 @@ __all__ = ["FaultInjector", "FaultPlan", "FAULT_PLAN_ENV"]
 FAULT_PLAN_ENV = "SPARK_TPU_FAULT_PLAN"
 
 _KINDS = ("drop", "truncate", "corrupt", "delay", "skip_commit",
-          "die_after_put", "die_after_manifest", "disk_full")
+          "die_after_put", "die_after_manifest", "disk_full",
+          "skew_decision")
 
 
 class _Rule:
     def __init__(self, kind: str, exchange: Optional[str] = None,
                  receiver: Optional[int] = None, once: bool = True,
                  heal_after_s: Optional[float] = None,
-                 keep_bytes: int = 16, after_bytes: int = 0):
+                 keep_bytes: int = 16, after_bytes: int = 0,
+                 side: str = "r"):
         if kind not in _KINDS:
             raise ValueError(f"unknown fault kind {kind!r}; one of {_KINDS}")
         self.kind = kind
@@ -73,6 +83,7 @@ class _Rule:
         self.heal_after_s = heal_after_s
         self.keep_bytes = keep_bytes
         self.after_bytes = after_bytes    # disk_full: free bytes left
+        self.side = side                  # skew_decision: "l" or "r"
         self.fired = 0
 
     def matches(self, exchange: str, receiver: Optional[int]) -> bool:
@@ -90,7 +101,7 @@ class _Rule:
                 "receiver": self.receiver, "once": self.once,
                 "heal_after_s": self.heal_after_s,
                 "keep_bytes": self.keep_bytes,
-                "after_bytes": self.after_bytes}
+                "after_bytes": self.after_bytes, "side": self.side}
 
 
 class FaultPlan:
@@ -158,6 +169,16 @@ class FaultPlan:
         write fails).  ``once=False``: a full disk stays full."""
         self.rules.append(_Rule("disk_full", exchange, None, once,
                                 after_bytes=after_bytes))
+        return self
+
+    def skew_decision(self, exchange: Optional[str] = None,
+                      side: str = "r", once: bool = True) -> "FaultPlan":
+        """Perturb one side's observed totals in THIS process's gathered
+        stats round — an in-memory, asymmetric fault: the manifests on
+        disk stay byte-identical for every peer, so the armed process
+        alone re-derives its adaptive decision from divergent inputs."""
+        self.rules.append(_Rule("skew_decision", exchange, None, once,
+                                side=side))
         return self
 
     # -- env transport ---------------------------------------------------
@@ -298,10 +319,33 @@ class FaultInjector:
             _die_after_manifest(exchange)
             return n
 
+        orig_gather_ex = getattr(svc, "gather_sizes_ex", None)
+
+        def gather_sizes_ex(exchange, n_partitions):
+            totals, mans = orig_gather_ex(exchange, n_partitions)
+            # perturb the RETURNED view only: the round's files are
+            # untouched, so every peer (and any re-read of the disk
+            # bytes) still sees the true totals — exactly the
+            # asymmetric divergence replica-determinism forbids
+            for rule in injector.plan.rules:
+                if rule.kind == "skew_decision" \
+                        and rule.matches(exchange, None):
+                    rule.fired += 1
+                    injector.injected.append(
+                        f"skew_decision:{exchange}:{rule.side}")
+                    for man in mans.values():
+                        sides = man.get("sides") \
+                            if isinstance(man, dict) else None
+                        if isinstance(sides, dict) and rule.side in sides:
+                            sides[rule.side] = [1, 1]
+            return totals, mans
+
         svc.put = put
         svc.commit = commit
         if orig_publish is not None:
             svc.publish_manifest = publish_manifest
         if orig_spill is not None:
             svc.spill_write = spill_write
+        if orig_gather_ex is not None:
+            svc.gather_sizes_ex = gather_sizes_ex
         return self

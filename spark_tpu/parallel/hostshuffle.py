@@ -1284,7 +1284,7 @@ class HostShuffleService:
         by anyone pre-round — detected as divergence, structured abort,
         never a hang."""
         t0 = self._clock()
-        for p in lost_now:
+        for p in sorted(lost_now):
             self._blacklist_peer(p, f"recovery round {xid!r} epoch {epoch}")
         rid = f"{xid}-recover{epoch}"
         self.publish_manifest(
@@ -1320,7 +1320,7 @@ class HostShuffleService:
             self.epoch = max(self.epoch, max_epoch)
             self.counters["recovery_rounds"] += 1
             self.timers["recovery_s"] += self._clock() - t0
-        for p in agreed:
+        for p in sorted(agreed):
             self._blacklist_peer(p, f"agreed lost in {rid!r}")
         # deterministic adoption: lost pids round-robin over the live
         # set, derived from agreed state only — identical on every peer

@@ -39,6 +39,21 @@ stats round is exchange ``xq000001-plan`` and a demotion gather would
 be ``xq000001-bcast``).  Prints ``OK ...`` with the path counters when
 the query completed (result must equal the oracle — never partial), or
 ``FAILED <elapsed> <lost>`` on a structured, bounded failure.
+
+mode "trace": the replica-determinism parity run — one full hash
+exchange plus one range exchange with the decision-trace runtime check
+pinned ON; every process must produce oracle-identical rows and report
+``decision_trace_checks > 0`` with ZERO divergence
+(``[p<i>] TRACE-OK rows=... checks=... div=0``).
+
+mode "skew-decision": same hash-lane query with a FaultInjector armed
+from SPARK_TPU_FAULT_PLAN (the ``skew_decision`` kind): the armed
+process's GATHERED view of the ``xq000001-plan`` round is perturbed
+while the on-disk manifests stay byte-identical — its adaptive
+re-decision diverges from its peers and ``verify_decision_trace`` must
+abort it structured (``[p<i>] FAILED-DIVERGED ... prop=decision-trace-
+agreement``), never letting a divergently-demoted exchange emit
+partial rows; the unarmed peer fails BOUNDED at its data barrier.
 """
 
 import os
@@ -63,6 +78,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as np  # noqa: E402
 
 from spark_tpu import config as C  # noqa: E402
+from spark_tpu.analysis.errors import PlanInvariantError  # noqa: E402
 from spark_tpu.parallel.faults import FaultInjector  # noqa: E402
 from spark_tpu.parallel.hostshuffle import ExchangeFetchFailed  # noqa: E402
 from spark_tpu.sql.session import SparkSession  # noqa: E402
@@ -139,6 +155,12 @@ Q_SKEW = ("SELECT rk, count(*) AS c, min(t) AS tlo, sum(w2) AS sw "
 Q_AGG = ("SELECT sk, price, sb FROM fact JOIN "
          "(SELECT k2, sum(bonus) AS sb FROM fact2 GROUP BY k2) a "
          "ON sk = k2 ORDER BY sk, price, sb")
+# trace/skew-decision modes: NO filter, so both observed sides stay far
+# above the broadcast threshold and the adaptive re-decision keeps the
+# frozen hash lane — the only way the armed process can diverge is the
+# injected perturbation of its gathered stats view
+Q_HASH = ("SELECT sk, price, bonus FROM fact JOIN fact2 ON sk = k2 "
+          "ORDER BY sk, price, bonus")
 
 
 def run(sess, sql):
@@ -168,6 +190,50 @@ if mode == "fault-adapt":
           f"demotions={c['strategy_demotions']} "
           f"bcast={c['broadcast_joins']} shuffled={c['shuffled_joins']}",
           flush=True)
+    os._exit(0)
+
+if mode in ("trace", "skew-decision"):
+    xs, svc = make_session(root, adaptive=True)
+    # the decision-trace backstop must run deterministically here,
+    # pytest parent or not (bin/chaos launches this worker too)
+    xs.conf.set(C.ANALYSIS_VERIFY_PLANS.key, "true")
+    if mode == "skew-decision":
+        FaultInjector().attach(svc)   # plan from SPARK_TPU_FAULT_PLAN
+    exp = run(oracle, Q_HASH)
+    t0 = time.time()
+    try:
+        got = run(xs, Q_HASH)
+    except PlanInvariantError as e:
+        st = getattr(xs, "_analysis_stats", {})
+        print(f"[p{pid}] FAILED-DIVERGED {time.time() - t0:.2f} "
+              f"prop={e.property} div="
+              f"{st.get('decision_trace_divergence', 0)} detail={e}",
+              flush=True)
+        os._exit(0)
+    except (ExchangeFetchFailed, TimeoutError) as e:
+        lost = sorted(getattr(e, "lost_hosts", []) or [])
+        print(f"[p{pid}] FAILED {time.time() - t0:.2f} {lost}",
+              flush=True)
+        os._exit(0)
+    if got != exp:
+        print(f"[p{pid}] PARTIAL got={len(got)} exp={len(exp)}",
+              flush=True)
+        os._exit(1)
+    if mode == "trace":
+        # the range lane's trace (cut points + skew-split estimate)
+        # rides the same check: pin the range lane and run the skew join
+        xs.conf.set(C.CROSSPROC_AUTO_BROADCAST.key, "0")
+        xs.conf.set(C.CROSSPROC_SORT_MERGE_JOIN.key, "true")
+        exp_s = run(oracle, Q_SKEW)
+        got_s = run(xs, Q_SKEW)
+        if got_s != exp_s:
+            print(f"[p{pid}] PARTIAL got={len(got_s)} exp={len(exp_s)}",
+                  flush=True)
+            os._exit(1)
+    st = getattr(xs, "_analysis_stats", {})
+    print(f"[p{pid}] TRACE-OK rows={len(got)} "
+          f"checks={st.get('decision_trace_checks', 0)} "
+          f"div={st.get('decision_trace_divergence', 0)}", flush=True)
     os._exit(0)
 
 xs, svc = make_session(root, adaptive=True)

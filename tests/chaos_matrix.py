@@ -153,6 +153,16 @@ SCENARIOS = [
         {1: lambda: FaultPlan().disk_full(after_bytes=0,
                                           exchange="xq000001-grace")},
         {0: "FAILED", 1: "HOSTMEM"}),
+    # -- replica-determinism divergence: the victim's GATHERED view of
+    #    the stats round is perturbed while the on-disk manifests every
+    #    peer reads stay intact — verify_decision_trace aborts the
+    #    divergent re-decision structured before any data block ships;
+    #    the unarmed peer fails bounded at its data barrier --
+    _scenario(
+        "skew-decision-divergence", "post-publish-sizes",
+        "adaptive_worker.py", "skew-decision", 2, 6.0,
+        {1: lambda: FaultPlan().skew_decision("xq000001-plan")},
+        {0: "FAILED", 1: "FAILED"}),
 ]
 
 

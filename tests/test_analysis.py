@@ -16,7 +16,9 @@ from spark_tpu.analysis import runtime as az_rt
 from spark_tpu.analysis.confcheck import (missing_planning_confs,
                                           planning_conf_reads)
 from spark_tpu.analysis.lint import lint_paths, lint_source, main
-from spark_tpu.analysis.waivers import is_waived, load_waivers
+from spark_tpu.analysis.protocol import lint_protocol_sources
+from spark_tpu.analysis.waivers import (dead_waivers, is_waived,
+                                        load_waivers)
 from spark_tpu.columnar import ColumnBatch, ColumnVector
 from spark_tpu.expressions import Col
 from spark_tpu.sql import logical as L
@@ -372,6 +374,197 @@ def test_lint_jit_outside_stage_cache():
     assert "HZ108" not in _rules(ok_cached)
 
 
+# ---------------------------------------------------------------------------
+# HZ109/HZ110: replica-determinism rules on synthetic snippets
+# ---------------------------------------------------------------------------
+
+def test_lint_nondet_source_in_decision_root():
+    bad = """
+        import os
+
+        def plan_reducers(sizes, n):
+            seed = os.getpid()
+            return [seed % n]
+    """
+    fs = [f for f in _lint(bad) if f.rule == "HZ109"]
+    assert len(fs) == 1 and fs[0].symbol == "plan_reducers"
+    assert "os.getpid" in fs[0].message
+    # the same source OUTSIDE the decision registry is not our business
+    ok = """
+        import os
+
+        def temp_file_name(n):
+            return f"part-{os.getpid()}-{n}"
+    """
+    assert "HZ109" not in _rules(ok)
+
+
+def test_lint_nondet_source_through_call_closure():
+    """The registry closes over same-module calls: a helper a decision
+    root delegates to is held to the same standard."""
+    bad = """
+        import random
+
+        def _pick(xs):
+            return xs[random.randrange(len(xs))]
+
+        def adaptive_join_decision(frozen, options):
+            return _pick(options)
+    """
+    fs = [f for f in _lint(bad) if f.rule == "HZ109"]
+    assert len(fs) == 1 and fs[0].symbol == "_pick"
+    assert "adaptive_join_decision" in fs[0].message
+    # the identical helper with no decision root calling it: clean
+    ok = """
+        import random
+
+        def _pick(xs):
+            return xs[random.randrange(len(xs))]
+    """
+    assert "HZ109" not in _rules(ok)
+
+
+def test_lint_clock_flags_decision_values_not_deadlines():
+    bad = """
+        import time
+
+        def elastic_reducer_width(total, target, n):
+            w = time.time()
+            return int(w) % n
+    """
+    fs = [f for f in _lint(bad) if f.rule == "HZ109"]
+    assert len(fs) == 1 and "wall-clock" in fs[0].message
+    # deadline/timer use of the clock inside a decision root is the
+    # protocol's business — only values REACHING the return are hazards
+    ok = """
+        import time
+
+        def recover_round(svc, timeout):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                svc.poll()
+            return []
+    """
+    assert "HZ109" not in _rules(ok)
+
+
+def test_lint_unordered_iteration_in_decision():
+    bad = """
+        def group_owner(pids):
+            owners = set(pids)
+            out = []
+            for p in owners:
+                out.append(p)
+            return out
+    """
+    fs = [f for f in _lint(bad) if f.rule == "HZ110"]
+    assert len(fs) == 1 and fs[0].symbol == "group_owner"
+    assert "sorted" in fs[0].message
+    # iterating sorted(...) is the prescribed fix
+    ok = """
+        def group_owner(pids):
+            owners = set(pids)
+            out = []
+            for p in sorted(owners):
+                out.append(p)
+            return out
+    """
+    assert "HZ110" not in _rules(ok)
+
+
+def test_lint_unordered_consumers_and_order_free_folds():
+    # list() over a set exposes its order...
+    assert "HZ110" in _rules("""
+        def live_pids(procs):
+            alive = {p for p in procs}
+            return list(alive)
+    """)
+    # ...while order-insensitive folds never do
+    assert "HZ110" not in _rules("""
+        def live_pids(procs):
+            alive = {p for p in procs}
+            return max(alive) if alive else 0
+    """)
+
+
+def test_lint_set_returning_helper_propagates():
+    """A module helper that syntactically returns a set taints its call
+    sites inside the decision closure (the ``skew_spans`` shape)."""
+    assert "HZ110" in _rules("""
+        def _candidates(xs):
+            return {x for x in xs}
+
+        def plan_range_reducers(xs):
+            out = []
+            for c in _candidates(xs):
+                out.append(c)
+            return out
+    """)
+
+
+# ---------------------------------------------------------------------------
+# HZ111: exchange-protocol conformance on synthetic protocol sources
+# ---------------------------------------------------------------------------
+
+def _protocol(sources):
+    return lint_protocol_sources(
+        {p: textwrap.dedent(s) for p, s in sources.items()})
+
+
+def test_protocol_one_sided_round_flagged():
+    pub = """
+        def stage(svc, xid):
+            svc.publish_manifest(f"{xid}-plan", {})
+    """
+    fs = _protocol({"a.py": pub})
+    assert len(fs) == 1 and fs[0].rule == "HZ111"
+    assert "published but never gathered" in fs[0].message
+    # pairing is cross-file: the gather may live in the other protocol
+    # file
+    gath = """
+        def read(svc, xid, n):
+            return svc.gather_manifests(f"{xid}-plan", n)
+    """
+    assert _protocol({"a.py": pub, "b.py": gath}) == []
+    assert "gathered but never published" in \
+        _protocol({"b.py": gath})[0].message
+
+
+def test_protocol_single_use_discipline():
+    fs = _protocol({"a.py": """
+        def stage(svc, xid, n):
+            svc.publish_manifest(f"{xid}-plan", {})
+            svc.publish_manifest(f"{xid}-plan", {})
+            svc.gather_manifests(f"{xid}-plan", n)
+    """})
+    assert len(fs) == 1 and fs[0].rule == "HZ111"
+    assert "published more than once" in fs[0].message
+
+
+def test_protocol_epoch_fencing():
+    unfenced = """
+        def run(svc, xid, n):
+            epoch = 0
+            while True:
+                run_id = f"{xid}e{epoch}"
+                svc.publish_manifest(f"{xid}-fin", {})
+                svc.gather_manifests(f"{xid}-fin", n)
+                epoch += 1
+    """
+    fs = _protocol({"a.py": unfenced})
+    assert fs and all("un-fenced" in f.message for f in fs)
+    fenced = """
+        def run(svc, xid, n):
+            epoch = 0
+            while True:
+                run_id = f"{xid}e{epoch}"
+                svc.publish_manifest(f"{run_id}-fin", {})
+                svc.gather_manifests(f"{run_id}-fin", n)
+                epoch += 1
+    """
+    assert _protocol({"a.py": fenced}) == []
+
+
 def test_waiver_file_parses_and_matches():
     waivers = load_waivers(WAIVERS)
     assert waivers and all(w.get("reason") for w in waivers)
@@ -391,6 +584,31 @@ def test_waiver_rejects_unsupported_syntax(tmp_path):
     p.write_text("[[waiver]]\nrule = [1, 2]\n")
     with pytest.raises(ValueError, match="unsupported"):
         load_waivers(str(p))
+
+
+def test_dead_waiver_detection():
+    findings = lint_source("import os\n\nx = 1\n")
+    live = {"rule": "HZ106", "reason": "kept"}
+    dead = {"rule": "HZ104", "path": "never/matches.py",
+            "reason": "the code this excused is long gone"}
+    assert dead_waivers(findings, [live, dead]) == [dead]
+
+
+def test_stale_waiver_fails_default_lint(tmp_path, capsys):
+    """A waiver matching no finding fails the default full-repo lint
+    (a stale waiver would silently swallow the next REAL finding that
+    happens to match it) — and the checked-in file carries none."""
+    with open(WAIVERS, encoding="utf-8") as f:
+        body = f.read()
+    stale = tmp_path / "w.toml"
+    stale.write_text(body + '\n[[waiver]]\nrule = "HZ104"\n'
+                     'path = "parallel/never_written.py"\n'
+                     'reason = "left behind after a refactor"\n')
+    assert main(["--waivers", str(stale)]) == 1
+    out = capsys.readouterr().out
+    assert "remove dead waiver" in out and "never_written.py" in out
+    # the repo's own waiver file is dead-weight-free
+    assert main([]) == 0
 
 
 # ---------------------------------------------------------------------------
@@ -446,3 +664,123 @@ def test_set_planning_conf_invalidates_plan_cache(spark, key, val):
     assert cache.stats()["invalidations"] > before, \
         f"SET {key} must evict entries built under the old value"
     assert [tuple(r) for r in s.sql(q).collect()] == r1
+
+
+# ---------------------------------------------------------------------------
+# the decision-trace runtime backstop (analysis.runtime.
+# verify_decision_trace) on synthetic exchange state
+# ---------------------------------------------------------------------------
+
+class _Sess:
+    pass
+
+
+def _trace_inputs(**over):
+    d = {"frozen": "hash", "epoch": 0, "live": [0, 1], "adopt": []}
+    d.update(over)
+    return d
+
+
+def test_decision_trace_hash_is_canonical():
+    a = az_rt.decision_trace({"frozen": "hash", "epoch": 0})
+    b = az_rt.decision_trace({"epoch": 0, "frozen": "hash"})
+    assert a == b                         # key order never matters
+    assert a != az_rt.decision_trace({"frozen": "hash", "epoch": 1})
+
+
+def test_decision_trace_peer_divergence_names_component():
+    inputs = _trace_inputs()
+    theirs = _trace_inputs(epoch=1)
+    mans = {0: {"dtrace": {"h": az_rt.decision_trace(inputs),
+                           "c": inputs}},
+            1: {"dtrace": {"h": az_rt.decision_trace(theirs),
+                           "c": theirs}}}
+    sess = _Sess()
+    with pytest.raises(PlanInvariantError) as e:
+        az_rt.verify_decision_trace(sess, _join(), None, "xq000001-plan",
+                                    mans, inputs)
+    assert e.value.property == "decision-trace-agreement"
+    assert "xq000001-plan" in str(e.value) and "epoch" in str(e.value)
+    st = sess._analysis_stats
+    assert st["decision_trace_checks"] == 1
+    assert st["decision_trace_divergence"] == 1
+    # agreeing peers pass; a sender without a dtrace payload degrades
+    # lenient, same as observed_side_stats
+    ok = {0: mans[0], 1: {"partitions": {}}}
+    az_rt.verify_decision_trace(sess, _join(), None, "xq000001-plan",
+                                ok, inputs)
+    assert st["decision_trace_checks"] == 2
+    assert st["decision_trace_divergence"] == 1
+
+
+class _DiskSvc:
+    """A service whose on-disk manifests are fixed — the shared bytes
+    every peer read."""
+
+    def __init__(self, mans):
+        self._m = mans
+
+    def _read_manifest(self, exchange, sender):
+        return self._m.get(sender)
+
+
+def test_decision_trace_local_recompute_catches_split_view():
+    """This process 'decided' a demotion its peers' shared bytes do not
+    imply — the asymmetric in-memory perturbation a symmetric file
+    check can never see."""
+    disk = {0: {"sides": {"l": [9000, 90], "r": [9000, 90]}},
+            1: {"sides": {"l": [9000, 90], "r": [9000, 90]}}}
+    inputs = _trace_inputs()
+    base = {"frozen": "hash", "how": "inner", "adaptive": True,
+            "broadcast_threshold": 2048, "n_live": 2}
+    with pytest.raises(PlanInvariantError) as e:
+        az_rt.verify_decision_trace(
+            None, _join(), _DiskSvc(disk), "xq000001-plan", disk, inputs,
+            local=dict(base, decision="broadcast_right"))
+    assert e.value.property == "decision-trace-agreement"
+    assert "broadcast_right" in str(e.value)
+    # the decision the disk bytes imply passes
+    az_rt.verify_decision_trace(
+        None, _join(), _DiskSvc(disk), "xq000001-plan", disk, inputs,
+        local=dict(base, decision="hash"))
+
+
+def test_decision_trace_local_recompute_checks_width():
+    disk = {0: {"sides": {"l": [9000, 90], "r": [9000, 90]}},
+            1: {"sides": {"l": [9000, 90], "r": [9000, 90]}}}
+    inputs = _trace_inputs()
+    az_rt.verify_decision_trace(
+        None, _join(), _DiskSvc(disk), "xq000001-plan", disk, inputs,
+        local={"frozen": "hash", "n_live": 2, "width": 2, "target": 0})
+    with pytest.raises(PlanInvariantError) as e:
+        az_rt.verify_decision_trace(
+            None, _join(), _DiskSvc(disk), "xq000001-plan", disk, inputs,
+            local={"frozen": "hash", "n_live": 2, "width": 1,
+                   "target": 0})
+    assert e.value.property == "decision-trace-agreement"
+
+
+# ---------------------------------------------------------------------------
+# satellite: the lenient-gather fallback still asserts frozen-strategy
+# legality (the adaptive-agreement check used to skip this path whole)
+# ---------------------------------------------------------------------------
+
+def test_adaptive_redecide_checks_frozen_on_lost_stats_round():
+    from spark_tpu.parallel.crossproc import (_adaptive_redecide,
+                                              _AdaptiveCtx)
+
+    class _Svc:
+        def live_pids(self):
+            return [0, 1]
+
+    ctx = _AdaptiveCtx(1024, None, None, None,
+                       [(Col("k"), Col("k"))], True)
+    # manifests without a 'sides' payload: observed stats incomplete,
+    # so the frozen strategy stands — but its legality is still checked
+    mans = {0: {"partitions": {}}, 1: {"partitions": {}}}
+    assert _adaptive_redecide(_join(), _Svc(), "xq000001", ctx,
+                              "hash", mans) == "hash"
+    with pytest.raises(PlanInvariantError) as e:
+        _adaptive_redecide(_join(), _Svc(), "xq000001", ctx,
+                           "sideways", mans)
+    assert e.value.property == "join-strategy"

@@ -320,6 +320,42 @@ def test_analysis_verifier_gauges(spark, mdf):
     assert after["plan_verify_ms"] < 60_000  # sanity: ms, not seconds
 
 
+def test_decision_trace_gauges_exported(spark):
+    """The replica-determinism backstop's accounting rides the same
+    analysis Source: every verify_decision_trace call bumps
+    decision_trace_checks, a caught divergence bumps
+    decision_trace_divergence — the gauge an operator alarms on."""
+    from spark_tpu import types as T
+    from spark_tpu.analysis import PlanInvariantError
+    from spark_tpu.analysis import runtime as az_rt
+    from spark_tpu.columnar import ColumnBatch, ColumnVector
+    from spark_tpu.expressions import Col
+    from spark_tpu.sql import logical as L
+
+    ms = spark.metricsSystem
+    before = ms.report()["analysis"]
+    assert before["decision_trace_divergence"] == 0
+    inputs = {"frozen": "hash", "epoch": 0, "live": [0, 1], "adopt": []}
+    arr = np.asarray([1], dtype=np.int64)
+    rel = L.LocalRelation(ColumnBatch(
+        ["k"], [ColumnVector(arr, T.LongType())], np.ones(1, bool), 1))
+    join = L.Join(rel, rel, "inner", on=Col("k") == Col("k"))
+    mans = {0: {"dtrace": {"h": az_rt.decision_trace(inputs),
+                           "c": inputs}}}
+    az_rt.verify_decision_trace(spark, join, None, "xq000001-plan",
+                                mans, inputs)
+    theirs = dict(inputs, epoch=1)
+    mans[1] = {"dtrace": {"h": az_rt.decision_trace(theirs),
+                          "c": theirs}}
+    with pytest.raises(PlanInvariantError):
+        az_rt.verify_decision_trace(spark, join, None, "xq000001-plan",
+                                    mans, inputs)
+    after = ms.report()["analysis"]
+    assert after["decision_trace_checks"] == \
+        before["decision_trace_checks"] + 2
+    assert after["decision_trace_divergence"] == 1
+
+
 def test_stage_compile_gauges_exported(spark, mdf):
     """ISSUE 11 observability: the process stage-executable cache rides
     the session metrics system as the 'compile' Source — compile cost,
