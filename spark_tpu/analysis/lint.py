@@ -47,6 +47,12 @@ codebase (or its reference lineage), rather than generic style:
         nobody gathers (or vice versa), a round id published twice in
         one function, or an un-fenced round id inside the epoch loop.
         See ``protocol.py``.
+  HZ112 nonatomic-durable-write   a bare ``open(path, "w"/"wb")`` in a
+        commit-flavored method (``commit``/``add``/``snapshot``/
+        ``save``) of a checkpoint/log/sink/state class with no
+        ``os.replace``/``os.rename`` anywhere in that method: a crash
+        mid-``write(2)`` leaves a TORN entry a later reader may trust.
+        Durable commit writes must stage to a temp file and rename.
 
 Justified exceptions live in ``tools/lint_waivers.toml`` (every waiver
 carries a reason); a waiver matching NO finding fails the default
@@ -466,6 +472,64 @@ def _rule_jit_outside_stage_cache(tree, path, qnames) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# HZ112: non-atomic writes in durable commit paths
+# ---------------------------------------------------------------------------
+
+_DURABLE_CLASS_HINTS = ("Log", "Sink", "Checkpoint", "State")
+_COMMIT_METHOD_HINTS = ("commit", "add", "snapshot", "save")
+
+
+def _is_write_open(n) -> bool:
+    if not isinstance(n, ast.Call):
+        return False
+    f = n.func
+    name = f.id if isinstance(f, ast.Name) else \
+        f.attr if isinstance(f, ast.Attribute) else ""
+    if name != "open" or len(n.args) < 2:
+        return False
+    mode = n.args[1]
+    return isinstance(mode, ast.Constant) and isinstance(mode.value, str) \
+        and "w" in mode.value
+
+
+def _rule_nonatomic_durable_write(tree, path, qnames) -> List[Finding]:
+    """A checkpoint/log/sink/state class's commit-flavored method that
+    writes a file in place (``open(..., "w")`` with no ``os.replace`` /
+    ``os.rename`` in the same method) can be torn by a crash mid-write —
+    and unlike a torn TEMP file, a torn final file is what recovery will
+    read.  The exactly-once contract (docs/INVARIANTS.md
+    checkpoint-atomicity) requires tmp + fsync + rename."""
+    out = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef) \
+                or not any(h in cls.name for h in _DURABLE_CLASS_HINTS):
+            continue
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                    or not any(h in meth.name
+                               for h in _COMMIT_METHOD_HINTS):
+                continue
+            atomic = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("replace", "rename")
+                for n in ast.walk(meth))
+            if atomic:
+                continue
+            for n in ast.walk(meth):
+                if _is_write_open(n):
+                    out.append(Finding(
+                        "HZ112", path, n.lineno, n.col_offset,
+                        f"{cls.name}.{meth.name}",
+                        "bare `open(..., \"w\")` in a durable commit "
+                        "method with no rename: a crash mid-write "
+                        "leaves a torn entry — stage to a temp file "
+                        "and `os.replace`"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # drivers
 # ---------------------------------------------------------------------------
 
@@ -473,6 +537,7 @@ _FILE_RULES = (_rule_jit_materialize, _rule_reserve_release,
                _rule_unlocked_state, _rule_io_under_lock,
                _rule_unused_imports, _rule_shadow_builtins,
                _rule_jit_outside_stage_cache,
+               _rule_nonatomic_durable_write,
                rule_nondet_sources, rule_unordered_iteration,
                rule_protocol)
 

@@ -544,6 +544,16 @@ SERVER_MIN_HOST_HEADROOM = conf(
     "statements are rejected with 429 until pressure clears.  0 = off."
 ).int(0)
 
+SERVER_MAX_STANDING_QUERIES = conf(
+    "spark.tpu.server.maxStandingQueries").doc(
+    "Cap on STANDING (streaming) queries registered across all server "
+    "sessions.  A standing query is a long-lived tenant: it holds its "
+    "admission slot from registration until stop, and each of its "
+    "micro-batches passes a non-blocking headroom gate (deferred batches "
+    "retry at the trigger interval).  Over the cap, POST /stream fails "
+    "fast with 429 + Retry-After.  0 = unlimited."
+).int(16)
+
 SERVER_STATEMENT_TIMEOUT = conf("spark.tpu.server.statementTimeout").doc(
     "Per-statement deadline in SECONDS, riding the cooperative cancel "
     "machinery: a statement still queued past its deadline is dropped, a "

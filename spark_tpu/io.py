@@ -1115,10 +1115,15 @@ class DataFrameWriter:
                         if f.startswith("part-")]) if os.path.exists(path) else 0
         return os.path.join(path, f"part-{existing:05d}{ext}")
 
-    def _write_table(self, table, path: str, ext: str) -> None:
+    def _write_table(self, table, path: str, ext: str,
+                     out: Optional[str] = None) -> None:
         import pyarrow as pa
         os.makedirs(path, exist_ok=True)
-        out = self._next_part(path, ext)
+        if out is None:
+            # batch writes pick the next free part slot; streaming sinks
+            # pass an explicit deterministic target instead (idempotent
+            # replay must overwrite, not append a new part)
+            out = self._next_part(path, ext)
         if self._fmt == "parquet":
             import pyarrow.parquet as pq
             pq.write_table(table, out)

@@ -208,6 +208,38 @@ def default_sources(session) -> List[Source]:
     # whole-stage compilation: the process stage-executable cache
     # (compile cost, hit ratio, fusion width — CodegenMetrics analog)
     srcs.append(Source("compile", _stage_gauges()))
+    def _stream_sum(key):
+        # resolved per read: standing queries register themselves on
+        # session._stream_execs at construction and leave on stop()
+        def g():
+            return sum(int(ex.metrics.get(key, 0))
+                       for ex in getattr(session, "_stream_execs", []))
+        return g
+
+    srcs.append(Source("streaming", {
+        # standing-query health: commits vs replays (recovery activity),
+        # stage rebuilds (0 after the first batch when the stage cache
+        # holds), state residency vs spill (ledger pressure), watermark
+        # progress + rows evicted past it
+        "standing_queries": lambda: len(
+            getattr(session, "_stream_execs", [])),
+        "batches_committed": _stream_sum("batches_committed"),
+        "replayed_batches": _stream_sum("replayed_batches"),
+        "stage_rebuilds_last": _stream_sum("stage_rebuilds_last"),
+        "state_bytes": _stream_sum("state_bytes"),
+        "state_rows": _stream_sum("state_rows"),
+        "spill_bytes": _stream_sum("spill_bytes"),
+        "spill_events": _stream_sum("spill_events"),
+        "evicted_rows": _stream_sum("evicted_rows"),
+        "watermark_us": lambda: max(
+            [int(ex.metrics.get("watermark_us", 0))
+             for ex in getattr(session, "_stream_execs", [])] or [0]),
+        "admission_deferred": _stream_sum("admission_deferred"),
+        "state_versions_spilled": lambda: sum(
+            int(getattr(ex, "_fmgws_provider", None) and
+                ex._fmgws_provider.versions_spilled or 0)
+            for ex in getattr(session, "_stream_execs", [])),
+    }))
     svc = getattr(session, "_crossproc_svc", None)
     if svc is not None and hasattr(svc, "metrics_source"):
         # DCN exchange retry/blacklist counters (RetryingBlockReader +

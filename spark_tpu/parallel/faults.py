@@ -42,6 +42,16 @@ side; rules fire when a matching block is published:
                 the adaptive strategy from different inputs.  The
                 decision-trace check (``verify_decision_trace``) must
                 abort it structured before any data block ships.
+- ``torn_checkpoint``  a streaming COMMIT entry is cut short in place
+                right after its atomic rename (optionally killing the
+                process mid-commit) — the entry's checksum must fail and
+                the batch must replay; armed via
+                ``FaultInjector.attach_stream``.
+- ``die_after_state_commit``  the PROCESS exits hard between a streaming
+                batch's durable state commit and its sink write — the
+                post-state-commit-pre-sink kill point of the exactly-once
+                protocol; recovery replays the batch and the idempotent
+                sink dedups the re-emission.
 
 Rules are matched by (exchange, receiver) for this service's own writes;
 healing is driven by daemon timers (wall-clock, generous vs CI retry
@@ -65,7 +75,7 @@ FAULT_PLAN_ENV = "SPARK_TPU_FAULT_PLAN"
 
 _KINDS = ("drop", "truncate", "corrupt", "delay", "skip_commit",
           "die_after_put", "die_after_manifest", "disk_full",
-          "skew_decision")
+          "skew_decision", "torn_checkpoint", "die_after_state_commit")
 
 
 class _Rule:
@@ -181,6 +191,32 @@ class FaultPlan:
                                 side=side))
         return self
 
+    def torn_checkpoint(self, keep_bytes: int = 16, after_entries: int = 0,
+                        once: bool = True, die: bool = False) -> "FaultPlan":
+        """Tear a streaming checkpoint COMMIT entry: after the micro-batch
+        engine writes commit entry number ``after_entries`` (0 = the very
+        first), the just-renamed file is cut to ``keep_bytes`` bytes — the
+        torn tail a crash mid-``write(2)`` would leave if the log skipped
+        its tmp+rename discipline.  ``die=True`` additionally exits the
+        process hard right after tearing (the mid-commit kill point); the
+        checksum must make the tear read as UNCOMMITTED either way."""
+        r = _Rule("torn_checkpoint", None, None, once,
+                  keep_bytes=keep_bytes, after_bytes=after_entries,
+                  side="die" if die else "r")
+        self.rules.append(r)
+        return self
+
+    def die_after_state_commit(self, after_entries: int = 0
+                               ) -> "FaultPlan":
+        """Exit hard BETWEEN the state-version commit and the sink write
+        of streaming micro-batch number ``after_entries``: state is
+        durable, the sink and the commit entry are not — recovery must
+        replay the batch and the idempotent sink must swallow the
+        re-emission without duplicating rows."""
+        self.rules.append(_Rule("die_after_state_commit", None, None,
+                                once=True, after_bytes=after_entries))
+        return self
+
     # -- env transport ---------------------------------------------------
     def to_env(self) -> str:
         return json.dumps([r.to_dict() for r in self.rules])
@@ -206,6 +242,10 @@ class FaultInjector:
         self.plan = plan if plan is not None else FaultPlan.from_env()
         self.injected: List[str] = []        # audit log of fired faults
         self._timers: List[threading.Timer] = []
+        # process-kill primitive: subprocess chaos workers keep the hard
+        # exit; in-process tests substitute a raiser to simulate the kill
+        # without taking the test runner down with it
+        self.die = lambda code: os._exit(code)
 
     # -- file perturbations ---------------------------------------------
     def _heal_later(self, path: str, payload: bytes, delay: float) -> None:
@@ -348,4 +388,71 @@ class FaultInjector:
             svc.spill_write = spill_write
         if orig_gather_ex is not None:
             svc.gather_sizes_ex = gather_sizes_ex
+        return self
+
+    # -- streaming commit-protocol wrapping -------------------------------
+    def attach_stream(self, execution) -> "FaultInjector":
+        """Arms a ``StreamExecution``'s exactly-once commit protocol.
+
+        - ``torn_checkpoint``: after commit entry number ``after_entries``
+          lands atomically, the entry file is cut to ``keep_bytes`` in
+          place — the torn tail a mid-``write(2)`` crash would leave; with
+          ``die=True`` the process then exits hard (the mid-commit kill
+          point).  Either way the entry's checksum must fail and the batch
+          must read as UNCOMMITTED on recovery.
+        - ``die_after_state_commit``: the process exits between the
+          durable state-version commit and the sink write of batch number
+          ``after_entries`` via ``execution._post_state_commit_hook``.
+
+        Kills go through ``self.die`` so in-process batteries can swap a
+        raiser in for ``os._exit``."""
+        injector = self
+        log = execution.commit_log
+        orig_add = log.add
+        commits_seen = [0]
+
+        def add(batch_id, payload):
+            orig_add(batch_id, payload)
+            n = commits_seen[0]
+            commits_seen[0] += 1
+            path = os.path.join(log.path, str(batch_id))
+            for rule in injector.plan.rules:
+                if rule.kind == "torn_checkpoint" \
+                        and rule.matches("", None) \
+                        and n >= rule.after_bytes:
+                    rule.fired += 1
+                    with open(path, "rb") as f:
+                        body = f.read()
+                    with open(path, "wb") as f:
+                        f.write(body[: rule.keep_bytes])
+                    injector.injected.append(f"torn_checkpoint:{batch_id}")
+                    if rule.side == "die":
+                        print(f"[faults] dying mid-commit at batch "
+                              f"{batch_id}", flush=True)
+                        injector.die(43)
+
+        log.add = add
+
+        hooks_seen = [0]
+        prev_hook = execution._post_state_commit_hook
+
+        def hook(batch_id):
+            if prev_hook is not None:
+                prev_hook(batch_id)
+            n = hooks_seen[0]
+            hooks_seen[0] += 1
+            for rule in injector.plan.rules:
+                if rule.kind == "die_after_state_commit" \
+                        and rule.matches("", None) \
+                        and n >= rule.after_bytes:
+                    rule.fired += 1
+                    injector.injected.append(
+                        f"die_after_state_commit:{batch_id}")
+                    print(f"[faults] dying after state commit at batch "
+                          f"{batch_id}", flush=True)
+                    injector.die(43)
+
+        if any(r.kind == "die_after_state_commit"
+               for r in self.plan.rules):
+            execution._post_state_commit_hook = hook
         return self

@@ -46,9 +46,23 @@ via --token or SPARK_TPU_SERVER_TOKEN):
                                 cancel; queued statements are removed
                                 from their session FIFO immediately
     GET    /statement/<id>      statement status (running/done/...)
+    POST   /stream              register a STANDING incremental query:
+                                {"session", "source": {"format", "path",
+                                "schema"?, "options"?}, "select"?,
+                                "sink": {"format", "path"}, "mode"?,
+                                "checkpoint"?, "interval"?} →
+                                {"streamId"}; the query is an admission
+                                tenant (429 + Retry-After over
+                                maxStandingQueries / headroom) and its
+                                session is never idle-reaped while it
+                                lives
+    GET    /stream/<id>         standing-query status: batch id, commit/
+                                replay/spill/watermark metrics, last
+                                progress, deferral Retry-After
+    DELETE /stream/<id>         stop a standing query, release its slot
     GET    /status              version, sessions, statements, per-
-                                session queue depths, admission counters,
-                                plan-cache stats
+                                session queue depths, standing queries,
+                                admission counters, plan-cache stats
 """
 
 from __future__ import annotations
@@ -120,6 +134,10 @@ class _ServerSession:
         # while other sessions starve
         self.queue: collections.deque = collections.deque()
         self.draining = False
+        # standing (streaming) queries registered on this session, keyed
+        # by stream id — a session carrying one is ALWAYS live for the
+        # idle reaper, however long since its last statement
+        self.streams: Dict[str, Any] = {}
 
 
 class _Statement:
@@ -169,6 +187,7 @@ class SQLServer:
         session._stats_feedback = self._stats_feedback
         self._sessions_expired = 0
         self._statement_readmits = 0     # transparent recovery re-admits
+        self._stream_retry: Dict[str, float] = {}  # last deferral hints
         self._reaper_stop = threading.Event()
         self._reaper: Optional[threading.Thread] = None
         self._register_metrics()
@@ -225,6 +244,12 @@ class SQLServer:
             sess = self.session.newSession()
             sess._plan_cache = self._plan_cache   # shared plan→executable
             sess._stats_feedback = self._stats_feedback  # shared stats
+            # one standing-query registry across the whole tier: the root
+            # session's ``streaming`` metrics Source must see every
+            # session's execs, so all sessions share the root's list
+            if getattr(self.session, "_stream_execs", None) is None:
+                self.session._stream_execs = []
+            sess._stream_execs = self.session._stream_execs
             sid = uuid.uuid4().hex[:16]
             self._sessions[sid] = _ServerSession(sess)
         return sid
@@ -234,9 +259,22 @@ class SQLServer:
             ss = self._sessions.pop(sid, None)
         if ss is None:
             return False
+        self._release_session_streams(ss)
         ss.session.cancelAllQueries()
         ss.session._plan_cache = None
         return True
+
+    def _release_session_streams(self, ss: _ServerSession) -> None:
+        """Stop a departing session's standing queries and give their
+        admission slots back — closing a session must not leak tenancy."""
+        for stream_id, q in list(ss.streams.items()):
+            try:
+                q.stop()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+            self._stream_retry.pop(stream_id, None)
+            self._admission.unregister_stream()
+        ss.streams.clear()
 
     def _resolve(self, sid: Optional[str]) -> _ServerSession:
         if not sid:
@@ -249,7 +287,11 @@ class SQLServer:
     def _expire_idle_sessions(self, now: Optional[float] = None) -> int:
         """Evict sessions idle longer than spark.tpu.server.sessionTimeout
         seconds.  Sessions with queued or running work are never touched —
-        eviction must not lose admitted statements.  Returns the count."""
+        eviction must not lose admitted statements — and neither are
+        sessions carrying a registered STANDING query: a stream triggers
+        between client requests, so last_used alone says nothing about
+        liveness (reaping it would kill an admitted tenant mid-protocol).
+        Returns the count."""
         ttl = float(self.session.conf_obj.get(C.SERVER_SESSION_TIMEOUT))
         if ttl <= 0:
             return 0
@@ -259,6 +301,7 @@ class SQLServer:
             victims = [(sid, ss) for sid, ss in self._sessions.items()
                        if not ss.queue and not ss.draining
                        and ss.running_stmt is None
+                       and not ss.streams
                        and now - ss.last_used > ttl]
             for sid, _ss in victims:
                 self._sessions.pop(sid, None)
@@ -274,6 +317,110 @@ class SQLServer:
                 self._expire_idle_sessions()
             except Exception:   # noqa: BLE001 — the reaper must survive
                 pass
+
+    # -- standing queries -------------------------------------------------
+    def _start_stream(self, payload: Dict[str, Any]) -> dict:
+        """Register a standing incremental query on a server session.
+
+        The query is a long-lived admission TENANT: ``register_stream``
+        takes a slot (429 + Retry-After over
+        ``spark.tpu.server.maxStandingQueries`` or under the grace-scaled
+        headroom floor) held until DELETE /stream/<id>, and every
+        micro-batch then passes the non-raising batch gate — a deferred
+        batch leaves no WAL entry, so deferral never dents exactly-once.
+
+        Spec: ``{"session": sid?, "source": {"format", "path", "schema"?,
+        "options"?}, "select": [cols]?, "sink": {"format", "path"},
+        "mode"?, "checkpoint"?, "interval"?}``."""
+        ss = self._resolve(payload.get("session"))
+        src = payload.get("source") or {}
+        sink = payload.get("sink") or {}
+        if not src.get("path") or not sink.get("path"):
+            raise ValueError("stream spec needs source.path and sink.path")
+        # the slot is taken BEFORE anything starts: a rejected standing
+        # query leaves no thread, no checkpoint dir, no registry entry
+        self._admission.register_stream()
+        try:
+            reader = ss.session.readStream.format(
+                src.get("format", "json"))
+            if src.get("schema"):
+                reader = reader.schema(src["schema"])
+            for k, v in (src.get("options") or {}).items():
+                reader = reader.option(k, v)
+            df = reader.load(src.get("path"))
+            if payload.get("select"):
+                df = df.select(*payload["select"])
+            w = (df.writeStream.format(sink.get("format", "json"))
+                 .outputMode(payload.get("mode", "append")))
+            if payload.get("checkpoint"):
+                w = w.option("checkpointLocation", payload["checkpoint"])
+            w = w.trigger(
+                processingTime=f"{float(payload.get('interval', 0.5))} "
+                               "seconds")
+            q = w.start(sink.get("path"))
+        except Exception:
+            self._admission.unregister_stream()
+            raise
+        return self.adopt_stream(payload.get("session"), q)
+
+    def adopt_stream(self, sid: Optional[str], q) -> dict:
+        """Wire an already-started StreamingQuery into the serving tier:
+        batch-admission gate + session stream registry (reaper
+        protection).  The programmatic entry point for embedding servers;
+        the caller (or ``_start_stream``) owns the admission slot."""
+        ss = self._resolve(sid)
+        ex = q._ex
+        key = f"stream:{ex.id[:8]}"
+
+        def gate() -> bool:
+            try:
+                self._admission.admit_stream_batch(cost_key=key)
+                self._stream_retry.pop(ex.id, None)
+                return True
+            except AdmissionRejected as e:
+                # remembered so GET /stream/<id> can surface the hint the
+                # trigger loop acted on
+                self._stream_retry[ex.id] = e.retry_after_s
+                return False
+
+        ex._batch_admit = gate
+        with self._reg_lock:
+            ss.streams[ex.id] = q
+        ss.last_used = time.time()
+        return {"streamId": ex.id, "name": ex.name}
+
+    def _find_stream(self, stream_id: str):
+        with self._reg_lock:
+            pool = [self._default] + list(self._sessions.values())
+            for ss in pool:
+                if stream_id in ss.streams:
+                    return ss, ss.streams[stream_id]
+        raise KeyError(f"no such stream {stream_id!r}")
+
+    def _stream_status(self, stream_id: str) -> dict:
+        _ss, q = self._find_stream(stream_id)
+        ex = q._ex
+        out = {"streamId": ex.id, "name": ex.name, "active": q.isActive,
+               "batchId": ex.batch_id, "metrics": dict(ex.metrics),
+               "lastProgress": q.lastProgress}
+        if ex.exception is not None:
+            out["error"] = \
+                f"{type(ex.exception).__name__}: {ex.exception}"[:2000]
+        retry = self._stream_retry.get(ex.id)
+        if retry is not None:
+            out["retryAfterSeconds"] = round(retry, 1)
+        return out
+
+    def _stop_stream(self, stream_id: str) -> dict:
+        ss, q = self._find_stream(stream_id)
+        q.stop()
+        with self._reg_lock:
+            ss.streams.pop(stream_id, None)
+        self._stream_retry.pop(stream_id, None)
+        self._admission.unregister_stream()
+        ss.last_used = time.time()
+        return {"stopped": stream_id,
+                "batchesCommitted": q._ex.metrics["batches_committed"]}
 
     # -- statement execution ---------------------------------------------
     def _run_sql(self, text: str, sid: Optional[str],
@@ -494,6 +641,10 @@ class SQLServer:
             queues = {sid: {"queued": len(ss.queue),
                             "running": ss.running_stmt is not None}
                       for sid, ss in self._sessions.items()}
+            streams = {stream_id: {"session": sid, "active": q.isActive}
+                       for sid, ss in [("default", self._default),
+                                       *self._sessions.items()]
+                       for stream_id, q in ss.streams.items()}
             grace = {sid: g for sid, ss in self._sessions.items()
                      if (g := self._grace_stats(ss.session))}
         default_grace = self._grace_stats(self.session)
@@ -506,6 +657,7 @@ class SQLServer:
             "sessionsExpired": self._sessions_expired,
             "activeStatements": stmts,
             "sessionQueues": queues,
+            "standingQueries": streams,
             "admission": self._admission.stats(),
             "graceActivity": grace,
             "metrics": self.session.metricsSystem.snapshots(),
@@ -561,6 +713,12 @@ class SQLServer:
                         self._reply(200, {
                             "statementId": stmt.id, "status": stmt.status,
                             "submitted": stmt.submitted})
+                elif path.startswith("/stream/"):
+                    try:
+                        self._reply(200, server._stream_status(
+                            path.rsplit("/", 1)[1]))
+                    except KeyError as e:
+                        self._reply(404, {"error": str(e)})
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
@@ -574,6 +732,12 @@ class SQLServer:
                         self._reply(200, {"closed": sid})
                     else:
                         self._reply(404, {"error": f"no session {sid!r}"})
+                elif path.startswith("/stream/"):
+                    try:
+                        self._reply(200, server._stop_stream(
+                            path.rsplit("/", 1)[1]))
+                    except KeyError as e:
+                        self._reply(404, {"error": str(e)})
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
@@ -594,6 +758,19 @@ class SQLServer:
                         self._reply(200, {"sessionId": server._open_session()})
                     except RuntimeError as e:
                         self._reply(429, {"error": str(e)})
+                    return
+                if path == "/stream":
+                    try:
+                        self._reply(200, server._start_stream(payload))
+                    except AdmissionRejected as e:
+                        self._reply(429, e.to_json(), headers={
+                            "Retry-After": str(max(1, int(
+                                e.retry_after_s + 0.999)))})
+                    except KeyError as e:
+                        self._reply(404, {"error": str(e)})
+                    except Exception as e:  # noqa: BLE001 — to client
+                        self._reply(400, {
+                            "error": f"{type(e).__name__}: {e}"[:2000]})
                     return
                 if path == "/cancel":
                     sid = payload.get("id") or \
@@ -663,6 +840,8 @@ class SQLServer:
         self._pool.shutdown(wait=False, cancel_futures=True)
         with self._reg_lock:
             sessions = list(self._sessions.values())
+        for ss in [self._default] + sessions:
+            self._release_session_streams(ss)
         for ss in sessions:
             ss.session._plan_cache = None
         self.session._plan_cache = None

@@ -73,6 +73,13 @@ class AdmissionController:
         self.rejected_by: Dict[str, int] = {}
         self._ewma_s = 0.05            # recent statement duration estimate
         self._shape_ewma_s: Dict[str, float] = {}   # per-cost-key estimate
+        # standing (streaming) queries: long-lived tenants holding a slot
+        # from register until unregister, with a per-micro-batch gate
+        self.standing = 0
+        self.peak_standing = 0
+        self.streams_admitted = 0
+        self.stream_batches = 0
+        self.stream_batches_deferred = 0
 
     #: per-shape table bound — a serving process must not leak one entry
     #: per distinct literal-normalized statement forever
@@ -103,24 +110,61 @@ class AdmissionController:
             if qcap > 0 and session_queue_depth >= qcap:
                 self._reject("maxQueuedPerSession",
                              session_queue_depth, qcap, cost_key)
-            floor = int(conf.get(C.SERVER_MIN_HOST_HEADROOM))
-            if floor > 0:
-                try:
-                    degraded = int(self._grace() or 0)
-                except Exception:
-                    degraded = 0
-                if degraded > 0:
-                    # grace activity observed: the learned cost of
-                    # running this close to the budget is a degraded
-                    # (spill-speed) join, so demand more headroom
-                    floor = int(floor * self.GRACE_HEADROOM_FACTOR)
-                ledger = self._ledger()
-                if ledger is not None and ledger.free < floor:
-                    self._reject("hostMemoryHeadroom",
-                                 int(ledger.free), floor, cost_key)
+            self._check_headroom_locked(cost_key)
             self.active += 1
             self.admitted += 1
             self.peak_active = max(self.peak_active, self.active)
+
+    def _check_headroom_locked(self, cost_key: Optional[str]) -> None:
+        floor = int(self._conf.get(C.SERVER_MIN_HOST_HEADROOM))
+        if floor <= 0:
+            return
+        try:
+            degraded = int(self._grace() or 0)
+        except Exception:
+            degraded = 0
+        if degraded > 0:
+            # grace activity observed: the learned cost of running this
+            # close to the budget is a degraded (spill-speed) join, so
+            # demand more headroom
+            floor = int(floor * self.GRACE_HEADROOM_FACTOR)
+        ledger = self._ledger()
+        if ledger is not None and ledger.free < floor:
+            self._reject("hostMemoryHeadroom",
+                         int(ledger.free), floor, cost_key)
+
+    # -- standing (streaming) queries ----------------------------------
+    def register_stream(self) -> None:
+        """Admit one STANDING query — a tenant that holds its slot from
+        here until ``unregister_stream`` — or raise ``AdmissionRejected``.
+        Counts against ``spark.tpu.server.maxStandingQueries`` and the
+        (grace-scaled) host-headroom floor."""
+        with self._lock:
+            cap = int(self._conf.get(C.SERVER_MAX_STANDING_QUERIES))
+            if cap > 0 and self.standing >= cap:
+                self._reject("maxStandingQueries", self.standing, cap)
+            self._check_headroom_locked(None)
+            self.standing += 1
+            self.streams_admitted += 1
+            self.peak_standing = max(self.peak_standing, self.standing)
+
+    def unregister_stream(self) -> None:
+        with self._lock:
+            self.standing = max(0, self.standing - 1)
+
+    def admit_stream_batch(self, cost_key: Optional[str] = None) -> None:
+        """Per-micro-batch gate for an already-registered standing query:
+        raises ``AdmissionRejected`` (with a shape-aware Retry-After)
+        under host-memory pressure; the caller defers the batch and the
+        trigger loop retries — a deferred batch leaves no WAL entry and
+        no state change, so deferral is invisible to exactly-once."""
+        with self._lock:
+            self.stream_batches += 1
+            try:
+                self._check_headroom_locked(cost_key)
+            except AdmissionRejected:
+                self.stream_batches_deferred += 1
+                raise
 
     def _reject(self, limit: str, observed, cap,
                 cost_key: Optional[str] = None) -> None:
@@ -168,6 +212,10 @@ class AdmissionController:
                 "avgStatementMs": round(self._ewma_s * 1000, 1),
                 "costShapes": len(self._shape_ewma_s),
                 "graceDegraded": int(self._grace() or 0),
+                "standingQueries": self.standing,
+                "peakStandingQueries": self.peak_standing,
+                "streamBatches": self.stream_batches,
+                "streamBatchesDeferred": self.stream_batches_deferred,
             }
 
     def metrics_source(self) -> Dict[str, Callable[[], Any]]:
@@ -176,4 +224,7 @@ class AdmissionController:
             "admission_rejected": lambda: self.stats()["rejected"],
             "admission_active": lambda: self.stats()["active"],
             "admission_peak_active": lambda: self.stats()["peakActive"],
+            "admission_standing_queries": lambda: self.standing,
+            "admission_stream_batches_deferred":
+                lambda: self.stream_batches_deferred,
         }

@@ -26,6 +26,7 @@ import pickle
 import threading
 import time
 import uuid
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -43,7 +44,22 @@ __all__ = [
     "StreamingRelation", "Source", "MemoryStream", "FileStreamSource",
     "RateStreamSource", "MemorySink", "ConsoleSink", "FileSink",
     "ForeachBatchSink", "StreamExecution", "StreamingQuery",
+    "MetadataLog", "CheckpointCorruption",
 ]
+
+
+class CheckpointCorruption(RuntimeError):
+    """Unrecoverable checkpoint damage: a COMMITTED batch's durable
+    artifacts (state snapshot vs. the fingerprint its commit entry
+    recorded) disagree.  Torn/truncated LOG entries are NOT this — they
+    fail their checksum and simply read as uncommitted, so the batch
+    replays.  This is raised only when replay cannot help, and it names
+    the batch id so an operator knows exactly where the log broke."""
+
+    def __init__(self, batch_id: int, detail: str):
+        self.batch_id = batch_id
+        super().__init__(
+            f"checkpoint corrupt at batch {batch_id}: {detail}")
 
 
 # ---------------------------------------------------------------------------
@@ -148,6 +164,12 @@ class FileStreamSource(Source):
         self.options = options
         self._seen: List[str] = []
         self._schema = schema
+        # bounded trigger (maxFilesPerTrigger): the engine clamps each
+        # batch to this many new files, so a backlog after restart drains
+        # as the SAME batch sequence the live run would have produced —
+        # the chaos battery's byte-parity oracle depends on it
+        self.max_per_trigger = int(options.get("maxfilespertrigger", 0)
+                                   or 0)
 
     def _list(self) -> List[str]:
         if not os.path.isdir(self.path):
@@ -263,10 +285,22 @@ class ConsoleSink:
 
 
 class FileSink:
+    """Idempotent per-batch file sink: batch id → ONE deterministic
+    part file plus a commit marker, both placed by atomic rename.  A
+    replayed batch (crash between data write and commit entry) either
+    early-returns on the marker or overwrites the same part file with
+    the same bytes — the sink never duplicates and never tears, which
+    is the sink half of the exactly-once protocol."""
+
     def __init__(self, fmt: str, path: str, options: Dict[str, str]):
         self.fmt = fmt
         self.path = path
         self.options = options
+
+    def _part_path(self, batch_id: int) -> str:
+        ext = {"parquet": ".parquet", "csv": ".csv",
+               "json": ".json", "text": ".txt"}[self.fmt]
+        return os.path.join(self.path, f"part-{batch_id:05d}{ext}")
 
     def add_batch(self, batch_id: int, batch: ColumnBatch, mode: str) -> None:
         # idempotent per batch id (exactly-once with the commit log)
@@ -276,16 +310,27 @@ class FileSink:
         from ..io import DataFrameWriter
         from ..sql.dataframe import DataFrame
         from ..sql.session import SparkSession
-        session = SparkSession.builder.getOrCreate()
+        # write through the owning execution's session (bound at
+        # StreamExecution init): the global active session may belong to
+        # another tenant with a different mesh/conf
+        session = getattr(self, "_session", None) \
+            or SparkSession.builder.getOrCreate()
         df = DataFrame(session, L.LocalRelation(batch))
         w = DataFrameWriter(df).format(self.fmt).mode("append")
         for k, v in self.options.items():
             w.option(k, v)
         os.makedirs(self.path, exist_ok=True)
-        w._write_table(w._arrow_table(df), self.path,
-                       {"parquet": ".parquet", "csv": ".csv",
-                        "json": ".json", "text": ".txt"}[self.fmt])
-        open(marker, "w").close()
+        out = self._part_path(batch_id)
+        tmp = f"{out}.{os.getpid()}.tmp"
+        ext = os.path.splitext(out)[1]
+        w._write_table(w._arrow_table(df), self.path, ext, out=tmp)
+        os.replace(tmp, out)
+        mtmp = f"{marker}.{os.getpid()}.tmp"
+        with open(mtmp, "w") as f:
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(mtmp, marker)
+        _fsync_dir(self.path)
 
 
 class ForeachBatchSink:
@@ -302,30 +347,89 @@ class ForeachBatchSink:
 # WAL logs (`HDFSMetadataLog` / `OffsetSeqLog` / `BatchCommitLog`)
 # ---------------------------------------------------------------------------
 
+def _fsync_dir(path: str) -> None:
+    """Durably record a rename: fsync the DIRECTORY so the new entry
+    survives a crash (the rename itself is atomic; its persistence is
+    not until the directory inode is flushed)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 class MetadataLog:
+    """Checksummed atomic WAL: every entry is one file ``<batch_id>``
+    whose content is ``<adler32-hex> <compact-json>``, written
+    tmp → flush → fsync → atomic rename → directory fsync.  A torn,
+    truncated, or bit-flipped entry fails its checksum and reads as
+    ABSENT — the commit protocol treats it as uncommitted and replays
+    the batch, which is exactly the exactly-once contract's safe side.
+    Legacy plain-JSON entries (pre-checksum checkpoints) still parse."""
+
     def __init__(self, path: str):
         self.path = path
         os.makedirs(path, exist_ok=True)
 
     def add(self, batch_id: int, payload: dict) -> None:
+        body = json.dumps(payload, separators=(",", ":"))
+        line = f"{zlib.adler32(body.encode()) & 0xFFFFFFFF:08x} {body}"
         tmp = os.path.join(self.path, f".{batch_id}.tmp")
         with open(tmp, "w") as f:
-            json.dump(payload, f)
+            f.write(line)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.path, str(batch_id)))
+        _fsync_dir(self.path)
 
     def get(self, batch_id: int) -> Optional[dict]:
         p = os.path.join(self.path, str(batch_id))
-        if not os.path.exists(p):
+        try:
+            with open(p) as f:
+                raw = f.read()
+        except OSError:
             return None
-        with open(p) as f:
-            return json.load(f)
+        return self._parse(raw)
+
+    @staticmethod
+    def _parse(raw: str) -> Optional[dict]:
+        raw = raw.strip()
+        if not raw:
+            return None
+        if raw.startswith("{"):
+            # legacy entry with no checksum: best-effort parse — a torn
+            # one fails json and reads as uncommitted instead of crashing
+            try:
+                out = json.loads(raw)
+            except ValueError:
+                return None
+            return out if isinstance(out, dict) else None
+        crc, _, body = raw.partition(" ")
+        if len(crc) != 8 or not body:
+            return None
+        try:
+            if int(crc, 16) != (zlib.adler32(body.encode()) & 0xFFFFFFFF):
+                return None
+            out = json.loads(body)
+        except ValueError:
+            return None
+        return out if isinstance(out, dict) else None
 
     def latest(self) -> Tuple[Optional[int], Optional[dict]]:
-        ids = [int(f) for f in os.listdir(self.path) if f.isdigit()]
-        if not ids:
-            return None, None
-        i = max(ids)
-        return i, self.get(i)
+        ids = sorted((int(f) for f in os.listdir(self.path)
+                      if f.isdigit()), reverse=True)
+        # a torn tail entry is an uncommitted batch: skip back to the
+        # newest entry that verifies, never return (id, None)
+        for i in ids:
+            payload = self.get(i)
+            if payload is not None:
+                return i, payload
+        return None, None
 
 
 # ---------------------------------------------------------------------------
@@ -357,6 +461,7 @@ class AggregationState:
         self.slots = slots
         self.child_schema = child_schema
         self.state: Optional[ColumnBatch] = None
+        self.evicted_rows = 0           # watermark-finalized groups dropped
         self._buf_names: List[str] = []
         self._buf_counts: List[int] = []
         for f, name in slots:
@@ -505,6 +610,7 @@ class AggregationState:
             final = live & kvalid & (kv < wm_us)
         if not final.any():
             return None
+        self.evicted_rows += int(final.sum())
         out = None
         if emit:
             finished = self.finished()
@@ -516,7 +622,11 @@ class AggregationState:
             self.state.names, self.state.vectors, keep, self.state.capacity))
         return out
 
-    def snapshot(self, path: str, batch_id: int) -> None:
+    def snapshot(self, path: str, batch_id: int) -> int:
+        """Atomically write the versioned state snapshot for ``batch_id``
+        and return its adler32 fingerprint, which rides the commit-log
+        entry — recovery verifies the snapshot it restores is the one
+        the commit named, or aborts structured."""
         os.makedirs(path, exist_ok=True)
         payload = None
         if self.state is not None:
@@ -531,15 +641,37 @@ class AggregationState:
                 else np.asarray(self.state.row_valid),
                 "capacity": self.state.capacity,
             }
-        with open(os.path.join(path, f"{batch_id}.snapshot"), "wb") as f:
-            pickle.dump(payload, f)
+        buf = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        crc = zlib.adler32(buf) & 0xFFFFFFFF
+        dest = os.path.join(path, f"{batch_id}.snapshot")
+        tmp = f"{dest}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(buf)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, dest)
+        _fsync_dir(path)
+        return crc
 
-    def restore(self, path: str, batch_id: int) -> bool:
+    def restore(self, path: str, batch_id: int,
+                expected_crc: Optional[int] = None) -> bool:
         p = os.path.join(path, f"{batch_id}.snapshot")
         if not os.path.exists(p):
             return False
         with open(p, "rb") as f:
-            payload = pickle.load(f)
+            buf = f.read()
+        if expected_crc is not None \
+                and (zlib.adler32(buf) & 0xFFFFFFFF) != expected_crc:
+            raise CheckpointCorruption(
+                batch_id, f"state snapshot {p} fails its committed "
+                f"fingerprint (expected {expected_crc:08x})")
+        try:
+            payload = pickle.loads(buf)
+        except Exception as e:
+            if expected_crc is not None:
+                raise CheckpointCorruption(
+                    batch_id, f"state snapshot {p} unreadable: {e}")
+            return False
         if payload is None:
             self.state = None
             return True
@@ -606,6 +738,7 @@ class DedupState:
             keep.append(wm_col)
         self._state_cols = keep
         self.state: Optional[ColumnBatch] = None
+        self.evicted_rows = 0           # keys released past the watermark
         # reuse the aggregation snapshot format by delegation
         self._io = AggregationState([], [], schema)
 
@@ -651,22 +784,26 @@ class DedupState:
             else compact(np, union_all([self.state, new_keys]))
         return out
 
-    def evict(self, col_name: str, wm_us: int) -> None:
+    def evict(self, col_name: str, wm_us: int) -> int:
         if self.state is None or col_name not in self.state.names:
-            return
+            return 0
         kv, kvalid = _numeric_event_col(self.state.column(col_name),
                                         self.state.capacity)
-        keep = np.asarray(self.state.row_valid_or_true()) \
-            & ~(kvalid & (kv < wm_us))
+        live = np.asarray(self.state.row_valid_or_true())
+        keep = live & ~(kvalid & (kv < wm_us))
+        n = int(live.sum()) - int(keep.sum())
+        self.evicted_rows += n
         self.state = compact(np, ColumnBatch(
             self.state.names, self.state.vectors, keep, self.state.capacity))
+        return n
 
-    def snapshot(self, path: str, batch_id: int) -> None:
+    def snapshot(self, path: str, batch_id: int) -> int:
         self._io.state = self.state
-        self._io.snapshot(path, batch_id)
+        return self._io.snapshot(path, batch_id)
 
-    def restore(self, path: str, batch_id: int) -> bool:
-        ok = self._io.restore(path, batch_id)
+    def restore(self, path: str, batch_id: int,
+                expected_crc: Optional[int] = None) -> bool:
+        ok = self._io.restore(path, batch_id, expected_crc)
         if ok:
             self.state = self._io.state
         return ok
@@ -701,6 +838,12 @@ class StreamExecution:
         self.session = session
         self.plan = plan
         self.sink = sink
+        # sinks execute their writes through the owning session, never
+        # the process-global active one (another tenant's mesh conf)
+        try:
+            self.sink._session = session
+        except Exception:
+            pass
         self.mode = output_mode
         self.checkpoint = checkpoint
         self.interval = trigger_interval
@@ -767,7 +910,33 @@ class StreamExecution:
         self._thread: Optional[threading.Thread] = None
         self.exception: Optional[BaseException] = None
         self.progress: List[dict] = []
+        # -- serving-tier tenancy ----------------------------------------
+        # streaming state is a ledger tenant like any exchange: bytes are
+        # re-accounted per batch under stream:<id>:state, and over budget
+        # the state parks as a wire-format spill file until the next batch
+        self._ledger = getattr(session, "_host_ledger", None)
+        self._ledger_owner = f"stream:{self.id[:8]}:state"
+        self._spilled: set = set()       # state tags parked on disk
+        self.metrics: Dict[str, int] = {
+            "batches_committed": 0, "replayed_batches": 0,
+            "stage_rebuilds_last": 0, "state_bytes": 0, "state_rows": 0,
+            "spill_bytes": 0, "spill_events": 0, "evicted_rows": 0,
+            "watermark_us": 0, "admission_deferred": 0,
+        }
+        # chaos/fault hook: fires between the state commit and the sink
+        # write (parallel.faults die_after_state_commit arms it)
+        self._post_state_commit_hook = None
+        # serving admission: a callback returning False defers this batch
+        # (the trigger loop retries after its interval)
+        self._batch_admit = None
         self._recover()
+        # register only AFTER recovery: a CheckpointCorruption abort in
+        # _recover must not leave a half-built execution on the session
+        regs = getattr(session, "_stream_execs", None)
+        if regs is None:
+            regs = []
+            session._stream_execs = regs
+        regs.append(self)
 
     # -- stateful plan surgery -------------------------------------------
     #
@@ -953,8 +1122,12 @@ class StreamExecution:
             self._fmgws_node = node
             from .state import StateStoreProvider
             self._fmgws_provider = (
-                StateStoreProvider(self.checkpoint, operator_id=0,
-                                   conf=self.session.conf_obj)
+                StateStoreProvider(
+                    self.checkpoint, operator_id=0,
+                    conf=self.session.conf_obj,
+                    ledger_supplier=lambda: getattr(
+                        self.session, "_host_ledger", None),
+                    ledger_owner=f"stream:{self.id[:8]}:versions")
                 if self.checkpoint else None)
             self._fmgws_states: dict = {}
             self._agg_node = None
@@ -1142,12 +1315,30 @@ class StreamExecution:
                 if self.watermark_us is None \
                         or entry["wm"] > self.watermark_us:
                     self.watermark_us = entry["wm"]
+        # the commit entry names the state fingerprint it covered; the
+        # restored snapshot must match or recovery aborts structured —
+        # a silently-different state would break exactly-once re-emission
+        state_crc = (commit_meta or {}).get("state", {}).get("crc") \
+            if isinstance((commit_meta or {}).get("state"), dict) else None
+        # state-version/offset agreement: the committed entry carries the
+        # offsets it covered; they must match the WAL entry of the same
+        # batch or the checkpoint is internally inconsistent
+        com_off = (commit_meta or {}).get("off")
+        if last_commit is not None and isinstance(com_off, dict):
+            wal = self.offset_log.get(last_commit)
+            if wal is not None and wal.get("end") != com_off.get("end"):
+                raise CheckpointCorruption(
+                    last_commit,
+                    f"commit covers offsets {com_off} but the offset WAL "
+                    f"recorded end={wal.get('end')!r}")
         if last_commit is not None and self._agg_state is not None \
                 and self.state_dir:
-            self._agg_state.restore(self.state_dir, last_commit)
+            self._agg_state.restore(self.state_dir, last_commit,
+                                    expected_crc=state_crc)
         if last_commit is not None and self._dedup_state is not None \
                 and self.state_dir:
-            self._dedup_state.restore(self.state_dir, last_commit)
+            self._dedup_state.restore(self.state_dir, last_commit,
+                                      expected_crc=state_crc)
         if last_commit is not None and self._ssjoin_node is not None:
             self._ssjoin_restore(last_commit)
         if last_commit is not None and self._fmgws_node is not None \
@@ -1180,20 +1371,33 @@ class StreamExecution:
     def _run_one_batch_locked(self) -> bool:
         if self._multi:
             return self._run_one_batch_multi()
-        # replay path: offsets already logged for this batch id
+        # serving-tier admission: a deferred batch leaves NOTHING behind
+        # (no WAL entry, no state change) — the trigger loop retries
+        if self._batch_admit is not None and not self._batch_admit():
+            self.metrics["admission_deferred"] += 1
+            return False
+        # replay path: offsets already logged for this batch id (a torn
+        # offset entry reads as absent and the batch re-plans fresh)
         logged = self.offset_log.get(self.batch_id)
         if logged is not None:
             start, end = logged.get("start"), logged["end"]
             if "wm" in logged:
                 self.watermark_us = logged["wm"]
+            self.metrics["replayed_batches"] += 1
         else:
             end = self.source.get_offset()
             start = self.committed_offset
             if end is None or end == start:
                 return False
-            # WAL BEFORE compute (exactly-once contract); include any
-            # source-side offset→data mapping so the batch replays exactly,
-            # and the start-of-batch watermark (derived from prior batches)
+            cap = int(getattr(self.source, "max_per_trigger", 0) or 0)
+            if cap > 0 and end - (start or 0) > cap:
+                # bounded trigger: a backlog drains as several
+                # deterministic batches, never one giant catch-up batch
+                end = (start or 0) + cap
+            # phase 1 — offset WAL BEFORE compute (exactly-once
+            # contract); include any source-side offset→data mapping so
+            # the batch replays exactly, and the start-of-batch
+            # watermark (derived from prior batches)
             payload = {"start": start, "end": end}
             if self._wm_col is not None:
                 payload["wm"] = self.watermark_us
@@ -1202,15 +1406,26 @@ class StreamExecution:
                 payload["meta"] = meta
             self.offset_log.add(self.batch_id, payload)
         t0 = time.time()
+        # phase 2 — compute: plans once through the stage-executable
+        # cache; the rebuild delta proves the second batch reuses the
+        # first batch's compiled stages
+        self._unspill_state()
         batch = self.source.get_batch(start, end)
         if self._wm_col is not None:
             batch = self._apply_watermark_input(batch)
+        builds0 = self._stage_builds()
         out = self._execute_batch(batch)
-        self.sink.add_batch(self.batch_id, out, self.mode)
+        self.metrics["stage_rebuilds_last"] = \
+            self._stage_builds() - builds0
+        # phase 3 — stage state versions durably (atomic snapshot
+        # writes); the fingerprint rides the commit entry below
+        state_crc = None
         if self._agg_state is not None and self.state_dir:
-            self._agg_state.snapshot(self.state_dir, self.batch_id)
+            state_crc = self._agg_state.snapshot(self.state_dir,
+                                                 self.batch_id)
         if self._dedup_state is not None and self.state_dir:
-            self._dedup_state.snapshot(self.state_dir, self.batch_id)
+            state_crc = self._dedup_state.snapshot(self.state_dir,
+                                                   self.batch_id)
         if self._fmgws_node is not None and self._fmgws_provider is not None:
             # versioned commit: state AFTER batch b is version b+1; the
             # change sets from this batch become the delta
@@ -1221,17 +1436,38 @@ class StreamExecution:
             for k in removed:
                 store.remove(k)
             store.commit()
-        commit_payload = {"ts": time.time()}
+        if self._post_state_commit_hook is not None:
+            # chaos kill point: state committed, sink not yet written —
+            # recovery must replay this batch and the idempotent sink
+            # must dedup the re-emission
+            self._post_state_commit_hook(self.batch_id)
+        # phase 4 — sink write, idempotent by batch id
+        self.sink.add_batch(self.batch_id, out, self.mode)
+        # phase 5 — THE commit point: source offsets + state-version
+        # fingerprint + sink batch id land as ONE checksummed
+        # atomic-rename entry; a crash before the rename replays the
+        # batch, a torn entry reads as uncommitted and replays too
+        commit_payload = {"ts": time.time(),
+                          "off": {"start": start, "end": end},
+                          "sink": self.batch_id}
+        if state_crc is not None:
+            commit_payload["state"] = {"ver": self.batch_id,
+                                       "crc": state_crc}
         if self._wm_col is not None:
             # persist event-time progress: recovery must not rewind the
             # watermark (a rewound watermark would readmit evicted keys)
             commit_payload["max_event"] = self._max_event_us
             commit_payload["wm"] = self.watermark_us
         self.commit_log.add(self.batch_id, commit_payload)
+        # phase 6 — post-commit: ledger re-accounting (may spill), source
+        # release, progress
+        self.metrics["batches_committed"] += 1
+        self._account_state()
         n_rows = len(batch.to_pylist())
         self.progress.append({
             "batchId": self.batch_id, "numInputRows": n_rows,
             "processedRowsPerSecond": n_rows / max(time.time() - t0, 1e-9),
+            "stageRebuilds": self.metrics["stage_rebuilds_last"],
         })
         self.committed_offset = end
         try:
@@ -1240,6 +1476,92 @@ class StreamExecution:
             _log.warning("source.commit(%s) failed", end, exc_info=True)
         self.batch_id += 1
         return True
+
+    # -- stage-cache + ledger tenancy -------------------------------------
+    def _stage_builds(self) -> int:
+        try:
+            from ..sql.stagecompile import stage_cache
+            return int(stage_cache(self.session).stats()["builds"])
+        except Exception:
+            return 0
+
+    def _pad(self, batch: ColumnBatch) -> ColumnBatch:
+        """Pad every per-batch LocalRelation to a power-of-two capacity:
+        the stage cache keys executables on leaf capacity, so unpadded
+        micro-batches of 3 then 5 rows would recompile every trigger."""
+        from ..columnar import pad_capacity, pad_to_capacity
+        batch = batch.to_host()
+        cap = pad_capacity(batch.capacity)
+        return pad_to_capacity(batch, cap) if cap != batch.capacity \
+            else batch
+
+    def _state_parts(self) -> List[Tuple[str, Any]]:
+        out = []
+        if self._agg_state is not None:
+            out.append(("agg", self._agg_state))
+        if self._dedup_state is not None:
+            out.append(("dedup", self._dedup_state))
+        return out
+
+    def _account_state(self) -> None:
+        """Re-account this stream's state bytes under the host ledger;
+        on reservation failure the state spills in wire format and the
+        host copy drops (reloaded lazily next batch)."""
+        from ..memory import batch_nbytes
+        nbytes = rows = 0
+        for _tag, st in self._state_parts():
+            if st.state is not None:
+                nbytes += batch_nbytes(st.state)
+                rows += int(np.asarray(st.state.num_rows()))
+        self.metrics["state_bytes"] = nbytes
+        self.metrics["state_rows"] = rows
+        self.metrics["evicted_rows"] = sum(
+            st.evicted_rows for _t, st in self._state_parts())
+        if self.watermark_us is not None:
+            self.metrics["watermark_us"] = int(self.watermark_us)
+        led = self._ledger
+        if led is None:
+            return
+        led.release(self._ledger_owner)
+        if nbytes and not led.try_reserve(self._ledger_owner, nbytes):
+            self._spill_state()
+
+    def _spill_state(self) -> None:
+        """Ledger pressure: park the state batches as wire-format files
+        under the checkpoint and drop the host copies.  The durable
+        snapshot already exists (phase 3), so the spill is a fast-path
+        cache, not a correctness artifact — without a checkpoint dir the
+        state simply stays resident (nothing durable to reload from)."""
+        if not self.state_dir:
+            return
+        from ..wire import encode_batches
+        d = os.path.join(self.state_dir, "spill")
+        os.makedirs(d, exist_ok=True)
+        for tag, st in self._state_parts():
+            if st.state is None:
+                continue
+            buf = encode_batches([st.state.to_host()])
+            dest = os.path.join(d, f"{tag}.wire")
+            tmp = f"{dest}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as f:
+                f.write(buf)
+            os.replace(tmp, dest)
+            self.metrics["spill_bytes"] += len(buf)
+            self.metrics["spill_events"] += 1
+            st.state = None
+            self._spilled.add(tag)
+        self.metrics["state_bytes"] = 0
+
+    def _unspill_state(self) -> None:
+        if not self._spilled:
+            return
+        from ..wire import decode_batches
+        d = os.path.join(self.state_dir, "spill")
+        for tag, st in self._state_parts():
+            if tag in self._spilled:
+                with open(os.path.join(d, f"{tag}.wire"), "rb") as f:
+                    st.state = decode_batches(f.read())[0]
+        self._spilled.clear()
 
     # -- watermark bookkeeping --------------------------------------------
     def _apply_watermark_input(self, batch: ColumnBatch) -> ColumnBatch:
@@ -1522,6 +1844,10 @@ class StreamExecution:
     def _execute_batch(self, data: ColumnBatch) -> ColumnBatch:
         from ..sql.planner import QueryExecution
 
+        # stage-cache friendliness: executables key on leaf CAPACITY, so
+        # every per-batch relation lands on a power-of-two capacity —
+        # otherwise a 3-row then 5-row trigger recompiles every batch
+        data = self._pad(data)
         if self._fmgws_node is not None:
             from .groupstate import run_flat_map_groups
             node = self._fmgws_node
@@ -1534,7 +1860,8 @@ class StreamExecution:
                 timeout_conf=node.timeout_conf)
             self._fmgws_states = new_states
             self._fmgws_changes = (changed, removed)
-            above = self._rebuild_above_plan(node, L.LocalRelation(out))
+            above = self._rebuild_above_plan(
+                node, L.LocalRelation(self._pad(out)))
             return QueryExecution(self.session, above).execute()
 
         if self._dedup_state is not None:
@@ -1547,7 +1874,8 @@ class StreamExecution:
             # reorder to the dedup node's output schema, then re-apply
             # whatever sits above it
             names = self._dedup_node.schema().names
-            plan = L.Project([Col(n) for n in names], L.LocalRelation(emit))
+            plan = L.Project([Col(n) for n in names],
+                             L.LocalRelation(self._pad(emit)))
             above = self._rebuild_above_plan(self._dedup_node, plan)
             return QueryExecution(self.session, above).execute()
 
@@ -1598,7 +1926,7 @@ class StreamExecution:
         ORIGINAL node — _agg_node may be the sliding-rewrite clone)."""
         return self._rebuild_above_plan(
             getattr(self, "_agg_anchor", self._agg_node) or self._agg_node,
-            L.LocalRelation(finished))
+            L.LocalRelation(self._pad(finished)))
 
     def _rebuild_above_plan(self, anchor: L.LogicalPlan,
                             plan: L.LogicalPlan) -> L.LogicalPlan:
@@ -1630,6 +1958,18 @@ class StreamExecution:
         self._stopped.set()
         if self._thread:
             self._thread.join(timeout=10)
+        # serving-tier teardown: release the ledger tenancy and leave the
+        # session registry so the idle reaper / metrics stop seeing us
+        if self._ledger is not None:
+            try:
+                # both the resident-state owner and the StateStore
+                # version-cache owner share the stream:<id8>: prefix
+                self._ledger.release_prefix(f"stream:{self.id[:8]}:")
+            except Exception:
+                pass
+        regs = getattr(self.session, "_stream_execs", None)
+        if regs is not None and self in regs:
+            regs.remove(self)
 
 
 class _MemLog(MetadataLog):

@@ -108,7 +108,8 @@ class StateStoreProvider:
     """Versioned persistence for one (operator, partition) state."""
 
     def __init__(self, checkpoint_dir: str, operator_id: int = 0,
-                 partition_id: int = 0, conf=None):
+                 partition_id: int = 0, conf=None,
+                 ledger_supplier=None, ledger_owner: Optional[str] = None):
         conf = conf or C.Conf()
         self.dir = os.path.join(checkpoint_dir, "state", str(operator_id),
                                 str(partition_id))
@@ -116,6 +117,14 @@ class StateStoreProvider:
         self.snapshot_interval = conf.get(SNAPSHOT_INTERVAL)
         self.retain = conf.get(STATE_RETAIN)
         self._cache: Dict[int, Dict[Any, Any]] = {}   # version → full map
+        self._bytes: Dict[int, int] = {}    # version → resident estimate
+        # host-ledger tenancy: cached (host-resident) versions are
+        # accounted under ledger_owner; over budget, old versions leave
+        # the cache — they stay reconstructable from delta/snapshot
+        # files, so this is a spill, never a loss
+        self._ledger_supplier = ledger_supplier or (lambda: None)
+        self._ledger_owner = ledger_owner or f"statestore:{self.dir}"
+        self.versions_spilled = 0
 
     # -- loading ------------------------------------------------------------
     def _files(self) -> Dict[int, str]:
@@ -163,6 +172,8 @@ class StateStoreProvider:
                 state.pop(k, None)
             state.update(puts)
         self._cache[version] = state
+        self._bytes[version] = len(pickle.dumps(state))
+        self._account(version)
         return state
 
     # -- committing ---------------------------------------------------------
@@ -175,10 +186,34 @@ class StateStoreProvider:
         tmp = os.path.join(self.dir, name + ".tmp")
         with open(tmp, "wb") as f:
             pickle.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.dir, name))
         self._cache[version] = full
+        self._bytes[version] = len(pickle.dumps(full))
         self.maintenance(version)
+        self._account(version)
         return version
+
+    def _account(self, current: int) -> None:
+        """Re-reserve the cache's resident bytes; on rejection spill the
+        oldest non-current versions out of the host cache (their files
+        stay — ``_load`` reconstructs on demand)."""
+        ledger = self._ledger_supplier()
+        if ledger is None:
+            return
+        ledger.release(self._ledger_owner)
+        total = sum(self._bytes.get(v, 0) for v in self._cache)
+        while total and not ledger.try_reserve(self._ledger_owner, total):
+            old = [v for v in sorted(self._cache) if v != current]
+            if not old:
+                # the current version alone is over budget: keep it
+                # resident unaccounted rather than thrash reload it
+                return
+            v = old[0]
+            del self._cache[v]
+            total -= self._bytes.pop(v, 0)
+            self.versions_spilled += 1
 
     def maintenance(self, current: int) -> None:
         """Drop cache entries and files older than the retention window,
@@ -200,3 +235,4 @@ class StateStoreProvider:
         for v in list(self._cache):
             if v < current - self.retain:
                 del self._cache[v]
+                self._bytes.pop(v, None)

@@ -166,8 +166,48 @@ SCENARIOS = [
 ]
 
 
+#: the streaming micro-batch commit phases the --streaming group must
+#: kill at (ISSUE 15 contract); the in-process battery
+#: (test_streaming_recovery.py) additionally covers mid-batch, which
+#: needs no injector at all — nothing durable has happened yet
+STREAM_PHASES = ("post-state-commit", "mid-commit")
+
+#: kill-at-phase against a REAL standing query: pid 1 runs the stream
+#: and dies hard at the planned commit phase; pid 0 restarts over the
+#: same checkpoint and byte-compares the sink to an uninterrupted
+#: oracle (see streaming_worker.py).  ``bin/chaos --streaming``.
+STREAM_SCENARIOS = [
+    # -- die between the state snapshot and the sink write: replay must
+    #    re-emit the batch, not trust the orphaned snapshot --
+    _scenario(
+        "stream-die-post-state-commit", "post-state-commit",
+        "streaming_worker.py", "wagg", 2, 60.0,
+        {1: lambda: FaultPlan().die_after_state_commit(after_entries=1)},
+        {0: "OK", 1: "DIED"}),
+    _scenario(
+        "stream-die-post-state-commit-dedup", "post-state-commit",
+        "streaming_worker.py", "dedup", 2, 60.0,
+        {1: lambda: FaultPlan().die_after_state_commit(after_entries=1)},
+        {0: "OK", 1: "DIED"}),
+    # -- die mid-commit with the entry TORN on disk: the checksum makes
+    #    the torn entry read as uncommitted and the batch replays --
+    _scenario(
+        "stream-torn-commit-kill", "mid-commit",
+        "streaming_worker.py", "wagg", 2, 60.0,
+        {1: lambda: FaultPlan().torn_checkpoint(
+            keep_bytes=11, after_entries=1, die=True)},
+        {0: "OK", 1: "DIED"}),
+    _scenario(
+        "stream-torn-commit-kill-dedup", "mid-commit",
+        "streaming_worker.py", "dedup", 2, 60.0,
+        {1: lambda: FaultPlan().torn_checkpoint(
+            keep_bytes=11, after_entries=1, die=True)},
+        {0: "OK", 1: "DIED"}),
+]
+
+
 def by_name(name):
-    for s in SCENARIOS:
+    for s in SCENARIOS + STREAM_SCENARIOS:
         if s["name"] == name:
             return s
     raise KeyError(name)
@@ -177,7 +217,7 @@ def kinds_covered():
     """Every fault kind some scenario injects (backs the lint gate that
     compares this against ``faults._KINDS``)."""
     kinds = set()
-    for s in SCENARIOS:
+    for s in SCENARIOS + STREAM_SCENARIOS:
         for build in s["plans"].values():
             kinds.update(r.kind for r in build().rules)
     return kinds
@@ -234,9 +274,14 @@ def main(argv=None):
     ap.add_argument("--root", default=None,
                     help="shuffle root parent dir (default: a fresh "
                     "temp dir per scenario)")
+    ap.add_argument("--streaming", action="store_true",
+                    help="run the standing-query kill/restart group "
+                    "(supervised exactly-once recovery) instead of the "
+                    "exchange matrix")
     args = ap.parse_args(argv)
 
-    todo = [s for s in SCENARIOS
+    table = STREAM_SCENARIOS if args.streaming else SCENARIOS
+    todo = [s for s in table
             if args.tier in ("all", s["tier"])
             and (not args.only
                  or any(pat in s["name"] for pat in args.only))]

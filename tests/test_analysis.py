@@ -374,6 +374,48 @@ def test_lint_jit_outside_stage_cache():
     assert "HZ108" not in _rules(ok_cached)
 
 
+def test_lint_nonatomic_durable_write():
+    # a commit method of a log class writing the final file in place:
+    # a crash mid-write leaves a torn entry recovery will read
+    bad = """
+        class MetadataLog:
+            def add(self, batch_id, payload):
+                with open(self.path(batch_id), "w") as f:
+                    f.write(payload)
+    """
+    assert "HZ112" in _rules(bad)
+    # the tmp + os.replace discipline in the same method is clean
+    ok_atomic = """
+        import os
+
+        class MetadataLog:
+            def add(self, batch_id, payload):
+                tmp = self.path(batch_id) + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(payload)
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path(batch_id))
+    """
+    assert "HZ112" not in _rules(ok_atomic)
+    # write-mode opens outside durable classes / commit methods: not ours
+    assert "HZ112" not in _rules(
+        "class Report:\n"
+        "    def render(self):\n"
+        "        with open('r.html', 'w') as f:\n"
+        "            f.write('x')\n")
+    assert "HZ112" not in _rules(
+        "class FileSink:\n"
+        "    def describe(self):\n"
+        "        with open('d.txt', 'w') as f:\n"
+        "            f.write('x')\n")
+    # read-mode opens in commit methods are fine
+    assert "HZ112" not in _rules(
+        "class FileSink:\n"
+        "    def add_batch(self, b):\n"
+        "        with open('d.txt') as f:\n"
+        "            return f.read()\n")
+
+
 # ---------------------------------------------------------------------------
 # HZ109/HZ110: replica-determinism rules on synthetic snippets
 # ---------------------------------------------------------------------------
@@ -629,8 +671,10 @@ def test_repo_is_lint_clean():
     assert unwaived == [], "\n".join(str(f) for f in unwaived)
     # waivers stay justified, not a dumping ground (the 9 HZ108 entries
     # are the catalogued intentional jit sites: the stage cache itself,
-    # the per-op bench baseline, one-shot ml fits and probes)
-    assert len(waived) <= 24
+    # the per-op bench baseline, one-shot ml fits and probes; the 3
+    # streaming entries cover lock-serialized metrics writes and the
+    # state-store accounting's deliberate release/re-reserve cycle)
+    assert len(waived) <= 27
 
 
 def test_lint_cli_main_exit_codes(tmp_path, capsys):

@@ -421,6 +421,69 @@ def test_disk_full_fails_spill_writes_after_budget(tmp_path):
     assert os.path.getsize(path) == 100    # no torn partial append
 
 
+def test_stream_fault_plan_env_roundtrip():
+    plan = (FaultPlan()
+            .torn_checkpoint(keep_bytes=11, after_entries=2, die=True)
+            .die_after_state_commit(after_entries=1))
+    back = FaultPlan.from_env({FAULT_PLAN_ENV: plan.to_env()})
+    assert [r.to_dict() for r in back.rules] \
+        == [r.to_dict() for r in plan.rules]
+
+
+def _fake_stream(tmp_path):
+    """The minimal surface attach_stream arms: a real commit log plus
+    the post-state-commit hook slot."""
+    import types as pytypes
+
+    from spark_tpu.streaming.core import MetadataLog
+    return pytypes.SimpleNamespace(
+        commit_log=MetadataLog(str(tmp_path / "commits")),
+        _post_state_commit_hook=None)
+
+
+def test_torn_checkpoint_tears_the_chosen_entry(tmp_path):
+    ex = _fake_stream(tmp_path)
+    inj = FaultInjector(FaultPlan().torn_checkpoint(keep_bytes=9,
+                                                    after_entries=1))
+    inj.attach_stream(ex)
+    ex.commit_log.add(0, {"off": 0})
+    ex.commit_log.add(1, {"off": 1})
+    assert inj.injected == ["torn_checkpoint:1"]
+    assert os.path.getsize(tmp_path / "commits" / "1") == 9
+    # entry 0 landed intact; the torn entry reads as ABSENT, not garbage
+    assert ex.commit_log.get(0) == {"off": 0}
+    assert ex.commit_log.get(1) is None
+    # hook stays unarmed — no die_after_state_commit rule in the plan
+    assert ex._post_state_commit_hook is None
+
+
+def test_torn_checkpoint_die_goes_through_injector_die(tmp_path):
+    ex = _fake_stream(tmp_path)
+    inj = FaultInjector(FaultPlan().torn_checkpoint(keep_bytes=5,
+                                                    die=True))
+    died = []
+    inj.die = died.append               # battery seam instead of os._exit
+    inj.attach_stream(ex)
+    ex.commit_log.add(0, {"off": 0})
+    assert died == [43]
+    assert ex.commit_log.get(0) is None
+
+
+def test_die_after_state_commit_fires_at_planned_batch(tmp_path):
+    ex = _fake_stream(tmp_path)
+    inj = FaultInjector(FaultPlan().die_after_state_commit(
+        after_entries=1))
+    died = []
+    inj.die = died.append
+    inj.attach_stream(ex)
+    assert ex._post_state_commit_hook is not None
+    ex._post_state_commit_hook(0)       # batch 0: before the threshold
+    assert died == []
+    ex._post_state_commit_hook(1)
+    assert died == [43]
+    assert inj.injected == ["die_after_state_commit:1"]
+
+
 def test_fault_plan_env_roundtrip(tmp_path):
     plan = (FaultPlan().drop(exchange="a", receiver=1)
             .truncate(heal_after_s=0.5, keep_bytes=3)

@@ -455,3 +455,130 @@ def test_grace_activity_in_status_and_admission(spark, tmp_path):
         spark._crossproc_svc = prev
         spark._host_ledger = prev_ledger
         ms._sources = [s for s in ms._sources if s.name != "shuffle"]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15 observability: standing-query state/recovery gauges on the
+# `streaming` Source — state residency in the host ledger, watermark
+# progress, eviction counts, and wire-format spill under a capped budget
+# with byte parity against the uncapped run
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def _single_shard(spark):
+    """Streaming micro-batches run local single-shard; pin the shared
+    session in case an earlier module leaked a wider mesh conf."""
+    prev = spark.conf.get("spark.tpu.mesh.shards")
+    spark.conf.set("spark.tpu.mesh.shards", "1")
+    yield spark
+    spark.conf.set("spark.tpu.mesh.shards", str(prev))
+
+
+def _stream_feeds(spark, in_dir):
+    def s(n):
+        return int(n * 1_000_000)
+    feeds = [[(s(1), "a", 1), (s(9), "b", 2)],
+             [(s(20), "a", 4), (s(21), "b", 1)],
+             [(s(35), "c", 8)],
+             [(s(50), "a", 3), (s(51), "d", 9)]]
+    os.makedirs(in_dir, exist_ok=True)
+    for i, rows in enumerate(feeds):
+        spark.createDataFrame({
+            "ts": np.array([r[0] for r in rows], "datetime64[us]"),
+            "k": [r[1] for r in rows],
+            "v": np.array([r[2] for r in rows], np.int64),
+        }).write.parquet(os.path.join(in_dir, f"f{i}"))
+
+
+def _stream_lifetime(spark, in_dir, ckpt, out):
+    from spark_tpu import types as T
+    from spark_tpu.sql.dataframe import DataFrame
+    from spark_tpu.streaming.core import (
+        FileSink, FileStreamSource, StreamExecution, StreamingRelation)
+    schema = T.StructType([
+        T.StructField("ts", T.timestamp),
+        T.StructField("k", T.string),
+        T.StructField("v", T.int64)])
+    src = FileStreamSource("parquet", in_dir, schema,
+                          {"maxfilespertrigger": "1"})
+    df = (DataFrame(spark, StreamingRelation(src))
+          .withWatermark("ts", "5 seconds")
+          .groupBy(F.window("ts", "10 seconds").alias("w"))
+          .agg(F.sum("v").alias("s")))
+    return StreamExecution(spark, df._plan, FileSink("json", out, {}),
+                           "append", ckpt, 0.1, None)
+
+
+def test_streaming_gauges_and_ledger_tenancy(_single_shard, spark, tmp_path):
+    from spark_tpu.memory import HostMemoryLedger
+    prev_ledger = getattr(spark, "_host_ledger", None)
+    ms = spark.metricsSystem
+    spark._host_ledger = HostMemoryLedger(budget=64 << 20)
+    try:
+        in_dir = str(tmp_path / "in")
+        _stream_feeds(spark, in_dir)
+        ex = _stream_lifetime(spark, in_dir, str(tmp_path / "ckpt"),
+                              str(tmp_path / "out"))
+        ex.process_all_available()
+        snap = ms.snapshots()["streaming"]
+        assert snap["standing_queries"] == 1
+        assert snap["batches_committed"] == 4
+        assert snap["replayed_batches"] == 0
+        assert snap["stage_rebuilds_last"] == 0    # batch 4 ran cached
+        assert snap["state_bytes"] > 0
+        assert snap["state_rows"] > 0
+        # watermark advanced to max_event - 5s of the last feed
+        assert snap["watermark_us"] == 51_000_000 - 5_000_000
+        # append mode finalized + evicted the closed windows
+        assert snap["evicted_rows"] > 0
+        assert snap["spill_events"] == 0           # budget was ample
+        assert "state_versions_spilled" in snap
+        # the resident state is a ledger tenant under the stream's owner
+        owner = f"stream:{ex.id[:8]}:state"
+        assert spark._host_ledger.held(owner) == snap["state_bytes"]
+        ex.stop()
+        # stop() releases the whole tenancy prefix and leaves the Source
+        assert spark._host_ledger.held(owner) == 0
+        snap = ms.snapshots()["streaming"]
+        assert snap["standing_queries"] == 0
+        assert snap["state_bytes"] == 0
+    finally:
+        spark._host_ledger = prev_ledger
+
+
+def test_streaming_state_spills_under_capped_ledger_with_parity(
+        _single_shard, spark, tmp_path):
+    """Capping the host ledger BELOW the streaming working set forces
+    the state between micro-batches into wire-format spill files — the
+    spill gauges light up, and the sink stays byte-identical to the
+    uncapped run."""
+    import glob
+
+    from spark_tpu.memory import HostMemoryLedger
+    prev_ledger = getattr(spark, "_host_ledger", None)
+    try:
+        in_dir = str(tmp_path / "in")
+        _stream_feeds(spark, in_dir)
+
+        def run(tag, budget):
+            spark._host_ledger = HostMemoryLedger(budget=budget)
+            ex = _stream_lifetime(spark, in_dir,
+                                  str(tmp_path / f"{tag}-ckpt"),
+                                  str(tmp_path / f"{tag}-out"))
+            ex.process_all_available()
+            metrics = dict(ex.metrics)
+            ex.stop()
+            files = {os.path.basename(p): open(p, "rb").read()
+                     for p in sorted(glob.glob(
+                         os.path.join(tmp_path, f"{tag}-out", "part-*")))}
+            return metrics, files
+
+        free_metrics, free_files = run("free", 64 << 20)
+        capped_metrics, capped_files = run("capped", 256)  # < working set
+        assert free_metrics["spill_events"] == 0
+        assert capped_metrics["spill_events"] > 0
+        assert capped_metrics["spill_bytes"] > 0
+        # pressure changed WHERE state lived, never WHAT was emitted
+        assert capped_files == free_files and free_files
+    finally:
+        spark._host_ledger = prev_ledger

@@ -246,7 +246,10 @@ def test_chaos_matrix_covers_every_fault_kind_and_phase():
         f"fault kind(s) {sorted(missing)} have no chaos scenario — "
         "extend tests/chaos_matrix.py when adding injectors")
     assert set(cm.PHASES) <= {s["phase"] for s in cm.SCENARIOS}
-    for s in cm.SCENARIOS:
+    # the streaming commit phases each get a real-kill scenario too
+    assert set(cm.STREAM_PHASES) \
+        <= {s["phase"] for s in cm.STREAM_SCENARIOS}
+    for s in cm.SCENARIOS + cm.STREAM_SCENARIOS:
         assert os.path.exists(os.path.join(cm.HERE, s["worker"])), s
         assert set(s["expect"]) == set(range(s["n"])), s["name"]
         assert set(s["plans"]) <= set(range(s["n"])), s["name"]

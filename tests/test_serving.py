@@ -378,6 +378,111 @@ def test_idle_session_ttl_eviction(serve_root):
         srv.stop()
 
 
+def _start_json_stream(srv, sid, tmp_path, tag="s"):
+    """POST /stream over a one-file json source; returns (streamId, dirs)."""
+    import numpy as np
+    data = tmp_path / f"{tag}-in"
+    data.mkdir(exist_ok=True)
+    srv.session.createDataFrame(
+        {"x": np.arange(4, dtype=np.int64)}).write.json(
+            str(data / "f1"))
+    spec = {"session": sid,
+            "source": {"format": "json", "path": str(data),
+                       "schema": "x bigint"},
+            "sink": {"format": "json", "path": str(tmp_path / f"{tag}-out")},
+            "checkpoint": str(tmp_path / f"{tag}-ckpt"),
+            "interval": 0.1}
+    _, r = _req(srv, "/stream", "POST", json.dumps(spec))
+    return r["streamId"]
+
+
+def _wait_stream_commit(srv, stream_id, n=1, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        _, st = _req(srv, f"/stream/{stream_id}")
+        if st["metrics"]["batches_committed"] >= n:
+            return st
+        time.sleep(0.05)
+    raise AssertionError(f"stream {stream_id} never committed {n} batches")
+
+
+def test_stream_endpoint_register_status_stop(serve_root, tmp_path):
+    srv = SQLServer(serve_root, port=0).start()
+    try:
+        _, s = _req(srv, "/session", "POST")
+        sid = s["sessionId"]
+        stream_id = _start_json_stream(srv, sid, tmp_path)
+        st = _wait_stream_commit(srv, stream_id)
+        assert st["active"] and st["batchId"] >= 1
+        assert st["metrics"]["replayed_batches"] == 0
+        assert st["lastProgress"]["stageRebuilds"] is not None
+        # visible as a serving-tier tenant end to end
+        _, status = _req(srv, "/status")
+        assert status["standingQueries"][stream_id]["session"] == sid
+        assert status["admission"]["standingQueries"] == 1
+        assert status["metrics"]["streaming"]["standing_queries"] == 1
+        assert status["metrics"]["streaming"]["batches_committed"] >= 1
+        # sink really received the batch
+        out = tmp_path / "s-out"
+        assert any(out.glob("part-*"))
+        _, r = _req(srv, f"/stream/{stream_id}", "DELETE")
+        assert r["stopped"] == stream_id
+        _, status = _req(srv, "/status")
+        assert status["admission"]["standingQueries"] == 0
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(srv, f"/stream/{stream_id}")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_session_with_standing_query_never_idle_reaped(serve_root,
+                                                       tmp_path):
+    """Regression: the idle-TTL reaper must skip a session carrying a
+    live standing query, however stale its last statement — reaping it
+    would orphan the query's admission slot and kill the stream."""
+    serve_root.conf.set(C.SERVER_SESSION_TIMEOUT.key, "10")
+    srv = SQLServer(serve_root, port=0).start()
+    try:
+        _, s1 = _req(srv, "/session", "POST")
+        _, s2 = _req(srv, "/session", "POST")
+        sid1, sid2 = s1["sessionId"], s2["sessionId"]
+        stream_id = _start_json_stream(srv, sid1, tmp_path)
+        _wait_stream_commit(srv, stream_id)
+        n = srv._expire_idle_sessions(now=time.time() + 60)
+        assert n == 1                       # only the streamless session
+        assert sid1 in srv._sessions and sid2 not in srv._sessions
+        _, st = _req(srv, f"/stream/{stream_id}")
+        assert st["active"]
+        # once the query stops, the session is ordinary idle prey again
+        _req(srv, f"/stream/{stream_id}", "DELETE")
+        assert srv._expire_idle_sessions(now=time.time() + 60) == 1
+        assert sid1 not in srv._sessions
+    finally:
+        srv.stop()
+
+
+def test_standing_query_cap_rejects_429_with_retry_after(serve_root,
+                                                         tmp_path):
+    serve_root.conf.set(C.SERVER_MAX_STANDING_QUERIES.key, "1")
+    srv = SQLServer(serve_root, port=0).start()
+    try:
+        _, s = _req(srv, "/session", "POST")
+        sid = s["sessionId"]
+        stream_id = _start_json_stream(srv, sid, tmp_path, tag="a")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _start_json_stream(srv, sid, tmp_path, tag="b")
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        body = json.loads(ei.value.read())
+        assert "standing" in json.dumps(body).lower()
+        # the slot frees on DELETE and the next registration succeeds
+        _req(srv, f"/stream/{stream_id}", "DELETE")
+        _start_json_stream(srv, sid, tmp_path, tag="c")
+    finally:
+        srv.stop()
+
+
 def test_status_exposes_serving_state(serve_root):
     srv = SQLServer(serve_root, port=0).start()
     try:

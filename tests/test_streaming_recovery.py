@@ -1,0 +1,258 @@
+"""Kill-at-phase chaos battery for exactly-once standing queries.
+
+The in-process half of the streaming chaos matrix: the engine is
+"killed" (a ``_SimKill`` raised through the fault injector's ``die``
+seam) at each phase of the micro-batch commit protocol —
+
+  mid-batch               offsets WAL'd, nothing else durable
+  post-state-commit       state snapshot durable, sink + commit not
+  mid-commit              commit entry TORN right after its rename
+
+— then a fresh execution recovers from the same checkpoint and the
+final FileSink contents must be BYTE-identical to an uninterrupted
+oracle run, for a windowed aggregate and a stateful dedup.  The
+subprocess half (real ``os._exit(43)`` kills) lives in
+``tests/chaos_matrix.py --streaming``.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from spark_tpu import types as T
+from spark_tpu.parallel.faults import FaultInjector, FaultPlan
+from spark_tpu.sql import functions as F
+from spark_tpu.streaming.core import (
+    CheckpointCorruption, FileSink, FileStreamSource, MetadataLog,
+    StreamExecution,
+)
+
+
+@pytest.fixture(autouse=True)
+def _single_shard(spark):
+    """Micro-batches replay local single-shard; pin the shared session
+    in case an earlier module leaked a wider mesh conf."""
+    prev = spark.conf.get("spark.tpu.mesh.shards")
+    spark.conf.set("spark.tpu.mesh.shards", "1")
+    yield
+    spark.conf.set("spark.tpu.mesh.shards", str(prev))
+
+
+def sec(n) -> int:
+    return int(n * 1_000_000)     # timestamps are int64 microseconds
+
+
+SCHEMA = T.StructType([
+    T.StructField("ts", T.timestamp),
+    T.StructField("k", T.string),
+    T.StructField("v", T.int64),
+])
+
+# one input FILE per feed; with maxFilesPerTrigger=1 each becomes one
+# micro-batch, in the same order, in every lifetime (live or recovered)
+FEEDS = [
+    [(sec(1), "a", 1), (sec(9), "b", 2)],
+    [(sec(20), "a", 4), (sec(21), "b", 1)],
+    [(sec(35), "c", 8), (sec(35), "c", 8)],     # in-batch duplicate
+    [(sec(50), "a", 3), (sec(51), "d", 9)],
+]
+
+
+def _windowed_agg(df):
+    return (df.withWatermark("ts", "5 seconds")
+            .groupBy(F.window("ts", "10 seconds").alias("w"))
+            .agg(F.sum("v").alias("s")))
+
+
+def _stateful_dedup(df):
+    return (df.withWatermark("ts", "5 seconds")
+            .dropDuplicates(["k", "ts"]))
+
+
+SHAPES = {"windowed_agg": _windowed_agg, "stateful_dedup": _stateful_dedup}
+
+PHASES = ["mid_batch", "post_state_commit", "mid_commit"]
+
+
+class _SimKill(BaseException):
+    """Simulated hard process death (BaseException so no engine-level
+    ``except Exception`` can swallow the kill)."""
+
+
+def _write_inputs(spark, in_dir: str) -> None:
+    os.makedirs(in_dir, exist_ok=True)
+    for i, rows in enumerate(FEEDS):
+        spark.createDataFrame({
+            "ts": np.array([r[0] for r in rows], "datetime64[us]"),
+            "k": [r[1] for r in rows],
+            "v": np.array([r[2] for r in rows], np.int64),
+        }).write.parquet(os.path.join(in_dir, f"f{i}"))
+
+
+def _arm(ex: StreamExecution, phase: str, at_batch: int) -> None:
+    if phase == "mid_batch":
+        orig = ex._execute_batch
+
+        def execute(batch):
+            out = orig(batch)
+            if ex.batch_id == at_batch:
+                raise _SimKill(f"mid-batch {ex.batch_id}")
+            return out
+
+        ex._execute_batch = execute
+        return
+
+    def raiser(code):
+        raise _SimKill(code)
+
+    if phase == "post_state_commit":
+        plan = FaultPlan().die_after_state_commit(after_entries=at_batch)
+    else:   # mid_commit: the entry is torn in place, then the kill
+        plan = FaultPlan().torn_checkpoint(
+            keep_bytes=11, after_entries=at_batch, die=True)
+    inj = FaultInjector(plan)
+    inj.die = raiser
+    inj.attach_stream(ex)
+
+
+def _lifetime(spark, shape_fn, in_dir: str, ckpt: str, out: str,
+              kill=None) -> StreamExecution:
+    """One 'process lifetime': fresh source + execution over the shared
+    checkpoint, drain everything available (or die trying)."""
+    src = FileStreamSource("parquet", in_dir, SCHEMA,
+                          {"maxfilespertrigger": "1"})
+    from spark_tpu.sql.dataframe import DataFrame
+    from spark_tpu.streaming.core import StreamingRelation
+    df = shape_fn(DataFrame(spark, StreamingRelation(src)))
+    ex = StreamExecution(spark, df._plan, FileSink("json", out, {}),
+                         "append", ckpt, 0.1, None)
+    if kill is not None:
+        _arm(ex, *kill)
+    try:
+        ex.process_all_available()
+    finally:
+        # a killed lifetime leaves its durable state exactly as the kill
+        # left it; only the in-process registration goes away, as a real
+        # process exit would take it
+        regs = getattr(spark, "_stream_execs", [])
+        if ex in regs:
+            regs.remove(ex)
+    return ex
+
+
+def _sink_files(out: str):
+    return {os.path.basename(p): open(p, "rb").read()
+            for p in sorted(glob.glob(os.path.join(out, "part-*")))}
+
+
+@pytest.mark.parametrize("phase", PHASES)
+@pytest.mark.parametrize("shape", sorted(SHAPES))
+def test_kill_at_phase_byte_parity(spark, tmp_path, shape, phase):
+    shape_fn = SHAPES[shape]
+    in_dir = str(tmp_path / "in")
+    _write_inputs(spark, in_dir)
+
+    oracle_out = str(tmp_path / "oracle_out")
+    _lifetime(spark, shape_fn, in_dir,
+              str(tmp_path / "oracle_ckpt"), oracle_out)
+    oracle = _sink_files(oracle_out)
+    assert oracle, "the oracle run must emit something to compare"
+
+    ckpt, out = str(tmp_path / "ckpt"), str(tmp_path / "out")
+    with pytest.raises(_SimKill):
+        _lifetime(spark, shape_fn, in_dir, ckpt, out, kill=(phase, 1))
+    # the engine restarts: a fresh execution over the same checkpoint
+    ex = _lifetime(spark, shape_fn, in_dir, ckpt, out)
+    assert ex.exception is None
+    # no duplicated, no lost rows — byte-for-byte the oracle's files
+    assert _sink_files(out) == oracle
+    # the killed batch really was replayed from its WAL entry
+    assert ex.metrics["replayed_batches"] >= 1
+    assert ex.metrics["batches_committed"] >= 1
+
+
+def test_corrupt_state_snapshot_aborts_structured(spark, tmp_path):
+    """A COMMITTED batch whose state snapshot no longer matches the
+    fingerprint in its commit entry is unrecoverable: recovery must abort
+    naming the batch id, never silently restore divergent state."""
+    in_dir = str(tmp_path / "in")
+    _write_inputs(spark, in_dir)
+    ckpt, out = str(tmp_path / "ckpt"), str(tmp_path / "out")
+    _lifetime(spark, _windowed_agg, in_dir, ckpt, out)
+
+    commits = os.path.join(ckpt, "commits")
+    last = max(int(f) for f in os.listdir(commits) if f.isdigit())
+    snap = os.path.join(ckpt, "state", f"{last}.snapshot")
+    buf = open(snap, "rb").read()
+    with open(snap, "wb") as f:           # flip payload bytes in place
+        f.write(buf[:-8] + bytes(b ^ 0xFF for b in buf[-8:]))
+
+    with pytest.raises(CheckpointCorruption) as ei:
+        _lifetime(spark, _windowed_agg, in_dir, ckpt, out)
+    assert ei.value.batch_id == last
+    assert str(last) in str(ei.value)
+
+
+def test_torn_commit_replays_not_crashes(spark, tmp_path):
+    """torn_checkpoint WITHOUT the kill: the torn entry simply reads as
+    uncommitted and the next drain replays + recommits that batch."""
+    in_dir = str(tmp_path / "in")
+    _write_inputs(spark, in_dir)
+    ckpt, out = str(tmp_path / "ckpt"), str(tmp_path / "out")
+
+    # tear the LAST commit entry (batch 3) — the realistic torn tail a
+    # mid-write power cut leaves behind
+    last = len(FEEDS) - 1
+    plan = FaultPlan().torn_checkpoint(keep_bytes=7, after_entries=last)
+    src = FileStreamSource("parquet", in_dir, SCHEMA,
+                          {"maxfilespertrigger": "1"})
+    from spark_tpu.sql.dataframe import DataFrame
+    from spark_tpu.streaming.core import StreamingRelation
+    df = _stateful_dedup(DataFrame(spark, StreamingRelation(src)))
+    ex = StreamExecution(spark, df._plan, FileSink("json", out, {}),
+                         "append", ckpt, 0.1, None)
+    inj = FaultInjector(plan)
+    inj.attach_stream(ex)
+    ex.process_all_available()
+    assert any(s.startswith("torn_checkpoint:") for s in inj.injected)
+    # the torn entry must read as uncommitted, not crash the reader
+    assert MetadataLog(os.path.join(ckpt, "commits")).get(last) is None
+    ex.stop()
+
+    # recovery replays the torn batch and recommits it intact
+    ex2 = _lifetime(spark, _stateful_dedup, in_dir, ckpt, out)
+    assert ex2.metrics["replayed_batches"] >= 1
+    assert MetadataLog(os.path.join(ckpt, "commits")).get(last) is not None
+
+
+def test_second_batch_zero_stage_rebuilds(spark, tmp_path):
+    """The standing query plans once: batch 2 runs entirely out of the
+    stage-executable cache (capacity-padded leaves keep signatures
+    stable) and reports zero rebuilds."""
+    in_dir = str(tmp_path / "in")
+    _write_inputs(spark, in_dir)
+    ckpt, out = str(tmp_path / "ckpt"), str(tmp_path / "out")
+    ex = _lifetime(spark, _windowed_agg, in_dir, ckpt, out)
+    assert len(ex.progress) >= 2
+    assert ex.progress[1]["stageRebuilds"] == 0
+    assert ex.progress[-1]["stageRebuilds"] == 0
+
+
+def test_metadata_log_torn_entry_regression(tmp_path):
+    """Satellite: a truncated entry fails its checksum and reads as
+    ABSENT; latest() skips the torn tail; legacy plain-JSON parses."""
+    log = MetadataLog(str(tmp_path / "log"))
+    log.add(0, {"a": 1})
+    log.add(1, {"b": 2})
+    p = tmp_path / "log" / "1"
+    raw = p.read_bytes()
+    p.write_bytes(raw[: len(raw) // 2])           # torn mid-write
+    assert log.get(1) is None
+    assert log.latest() == (0, {"a": 1})
+    (tmp_path / "log" / "2").write_text('{"c": 3}')   # legacy entry
+    assert log.get(2) == {"c": 3}
+    (tmp_path / "log" / "3").write_text('{"c": 3')    # torn legacy
+    assert log.get(3) is None
+    assert log.latest() == (2, {"c": 3})
