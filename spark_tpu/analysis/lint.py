@@ -53,6 +53,14 @@ codebase (or its reference lineage), rather than generic style:
         ``os.replace``/``os.rename`` anywhere in that method: a crash
         mid-``write(2)`` leaves a TORN entry a later reader may trust.
         Durable commit writes must stage to a temp file and rename.
+  HZ113 block-path-outside-resolver   a string literal (or f-string)
+        that builds a block wire-format file name — one ending in a
+        ``part``/``done``/``dict``/``reg``/``delta``/``snapshot``
+        block suffix — OUTSIDE the resolver seam (``hostshuffle`` /
+        ``blockserver`` / ``streaming.state``): with the disaggregated
+        block service holding custody of those files, a hand-built
+        path bypasses registration, adoption, and the orphan reaper —
+        the file it names can be reclaimed under the caller's feet.
 
 Justified exceptions live in ``tools/lint_waivers.toml`` (every waiver
 carries a reason); a waiver matching NO finding fails the default
@@ -530,6 +538,84 @@ def _rule_nonatomic_durable_write(tree, path, qnames) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# HZ113: block wire-format paths built outside the resolver seam
+# ---------------------------------------------------------------------------
+
+#: the block-service wire-format suffix set, assembled from bare stems
+#: so the tuple's own literals don't trip the rule on this file
+_BLOCK_FILE_SUFFIXES = tuple(
+    "." + stem for stem in ("part", "done", "dict", "reg",
+                            "delta", "snapshot"))
+
+#: the resolver seam: the only modules allowed to spell block file
+#: names — everything else must go through their path helpers so the
+#: block service sees (and can adopt / reap) every file
+_BLOCK_PATH_OWNERS = ("parallel/hostshuffle.py",
+                      "parallel/blockserver.py",
+                      "streaming/state.py")
+
+
+def _block_suffix_of(node) -> Optional[str]:
+    """The block-file suffix a string literal ends with, else None.
+    For f-strings the TAIL constant decides — ``f"{x}.part"`` names a
+    block file, ``f".part of {x}"`` does not."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        s = node.value
+    elif isinstance(node, ast.JoinedStr) and node.values \
+            and isinstance(node.values[-1], ast.Constant) \
+            and isinstance(node.values[-1].value, str):
+        s = node.values[-1].value
+    else:
+        return None
+    for suf in _BLOCK_FILE_SUFFIXES:
+        if s.endswith(suf):
+            return suf
+    return None
+
+
+def _rule_block_path_outside_resolver(tree, path, qnames) -> List[Finding]:
+    """A literal spelling a block wire-format file name outside the
+    resolver modules: the block service owns those files (custody,
+    adoption, TTL reclamation), so a hand-built path is a file the
+    service cannot see — it dodges registration on the write side and
+    races the orphan reaper on the read side.  Construct block paths
+    through the owning module's helpers instead."""
+    norm = path.replace(os.sep, "/")
+    if any(norm.endswith(owner) for owner in _BLOCK_PATH_OWNERS):
+        return []
+    # docstrings and other bare-expression strings are prose, not paths
+    prose = set()
+    for n in ast.walk(tree):
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(n, field, None)
+            if isinstance(stmts, list):
+                prose.update(id(s.value) for s in stmts
+                             if isinstance(s, ast.Expr))
+    out = []
+
+    def visit(node, symbol):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _SCOPES):
+                visit(child, qnames.get(child, child.name))
+                continue
+            suf = _block_suffix_of(child)
+            if suf is not None and id(child) not in prose:
+                out.append(Finding(
+                    "HZ113", path, child.lineno, child.col_offset,
+                    symbol,
+                    f"block file name built outside the resolver seam "
+                    f"(literal ends with `{suf}`): the block service "
+                    "cannot register/adopt/reap a path it never sees — "
+                    "use the owning module's path helpers"))
+            if not isinstance(child, ast.JoinedStr):
+                # a flagged f-string's tail constant would re-flag
+                visit(child, symbol)
+
+    visit(tree, "<module>")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # drivers
 # ---------------------------------------------------------------------------
 
@@ -538,6 +624,7 @@ _FILE_RULES = (_rule_jit_materialize, _rule_reserve_release,
                _rule_unused_imports, _rule_shadow_builtins,
                _rule_jit_outside_stage_cache,
                _rule_nonatomic_durable_write,
+               _rule_block_path_outside_resolver,
                rule_nondet_sources, rule_unordered_iteration,
                rule_protocol)
 

@@ -511,6 +511,33 @@ RECOVERY_MAX_STAGE_RETRIES = conf("spark.tpu.recovery.maxStageRetries").doc(
     "every exhausted fetch aborts the statement bounded."
 ).check(lambda v: v >= 0).int(1)
 
+BLOCKSERVER_ENABLED = conf("spark.tpu.blockserver.enabled").doc(
+    "Disaggregated block service (the external-shuffle-service analog): "
+    "the shuffle service hard-links every committed map output, spill "
+    "frame, and dict sidecar into a <root>/_blockstore/ area it OWNS and "
+    "seals a per-sender registration record at manifest-commit time.  A "
+    "survivor whose peer died after registering ADOPTS the materialized "
+    "output from the store (zero map re-execution) instead of paying the "
+    "r12 re-plan/re-execute epoch; when the service is down the client "
+    "degrades to peer-direct reads and lineage recovery.  Off by "
+    "default: registration doubles directory entries per exchange."
+).boolean(False)
+
+BLOCKSERVER_ORPHAN_TTL = conf("spark.tpu.blockserver.orphanTtlSeconds").doc(
+    "TTL for the orphaned-block reaper: an exchange whose every owner "
+    "lease has been silent this long (and whose files are equally stale) "
+    "is reclaimed, as are raw exchange dirs under swept shuffle roots.  "
+    "Registered STATE dirs (streaming checkpoints) are reclaimed only "
+    "after explicit ownership release PLUS this TTL — a crashed owner's "
+    "checkpoint is never reaped, restart recovery needs it."
+).check(lambda v: v >= 0).int(3600)
+
+BLOCKSERVER_GC_INTERVAL = conf("spark.tpu.blockserver.gcIntervalSeconds").doc(
+    "Period of the block-service reaper thread the SQL server runs while "
+    "started (serving-tier lifecycle: elastic worker reap/spawn leaves "
+    "orphans only the service may delete).  0 = reaper disabled."
+).check(lambda v: v >= 0).int(60)
+
 DEBUG_NANS = conf("spark.tpu.debug.nanChecks").doc(
     "Enable jax_debug_nans for the session's process: XLA computations "
     "fail loudly on NaN/Inf production instead of propagating them — the "

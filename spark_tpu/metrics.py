@@ -249,4 +249,18 @@ def default_sources(session) -> List[Source]:
         # recovery_ms / epoch / recovered_peers — a nonzero epoch means
         # the process set shrank and stayed shrunk
         srcs.append(svc.metrics_source())
+    store = getattr(getattr(svc, "blockclient", None), "store", None)
+    if store is not None:
+        # disaggregated block service hygiene: what the store currently
+        # holds (exchanges awaiting adoption/cleanup, owner leases,
+        # registered state dirs) and the orphan reaper's lifetime
+        # reclaim total — all read live off the shared store
+        srcs.append(Source("blockstore", {
+            "available": lambda: int(store.available),
+            "exchanges_held": lambda: store.stats()["exchangesHeld"],
+            "leases": lambda: store.stats()["leases"],
+            "state_registrations": lambda: store.stats()[
+                "stateRegistrations"],
+            "orphaned_blocks_reclaimed": lambda: store.reclaimed_total(),
+        }))
     return srcs

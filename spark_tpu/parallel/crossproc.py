@@ -2098,6 +2098,17 @@ def _recover_epoch(session, svc: HostShuffleService, xid: str,
     svc.ledger.release_prefix(f"shuffle:{xid}")
     if checks:
         _az.verify_epoch_released(svc.ledger, xid)
+    # block-service ownership of the agreed-dead: survivors never delete
+    # a dead peer's registered blocks directly — they expire its LEASE
+    # with the service (safe post-agreement: every live peer derived the
+    # same lost set) and the TTL reaper reclaims on the service's clock.
+    # The r16 adoption fast path runs EARLIER, at the fetch barrier: a
+    # registered output is re-adopted before the loss ever surfaces
+    # here, so reaching this round means lineage re-execution really is
+    # required for the remainder.
+    if svc.blockclient is not None:
+        for p in sorted(svc.recovered_pids):
+            svc.blockclient.expire_owner(svc.host_name(p))
     with svc._lock:
         svc.counters["stage_retries"] += 1
         svc.counters["recovered_partitions"] += max(

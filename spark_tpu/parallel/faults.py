@@ -52,6 +52,20 @@ side; rules fire when a matching block is published:
                 post-state-commit-pre-sink kill point of the exactly-once
                 protocol; recovery replays the batch and the idempotent
                 sink dedups the re-emission.
+- ``die_during_register``  the PROCESS exits hard MID-REGISTRATION with
+                the block service (``blockserver.py``): blocks are
+                staged but the ``.reg`` record never sealed
+                (``after_seal=False`` — survivors must degrade to plain
+                r12 lineage recovery), or sealed with the exchange
+                commit marker still unwritten (``after_seal=True`` — the
+                adoption window: survivors re-register the output with
+                zero map re-execution).
+- ``blockserver_unavailable``  the block service is DOWN for this
+                process: every client call degrades to a structured
+                no-op (registration skipped, adoption/restore denied) —
+                reads must fall back peer-direct and recovery must stay
+                r12-shaped, never a hang; ``heal_after_s`` brings the
+                service back on a timer.
 
 Rules are matched by (exchange, receiver) for this service's own writes;
 healing is driven by daemon timers (wall-clock, generous vs CI retry
@@ -75,7 +89,8 @@ FAULT_PLAN_ENV = "SPARK_TPU_FAULT_PLAN"
 
 _KINDS = ("drop", "truncate", "corrupt", "delay", "skip_commit",
           "die_after_put", "die_after_manifest", "disk_full",
-          "skew_decision", "torn_checkpoint", "die_after_state_commit")
+          "skew_decision", "torn_checkpoint", "die_after_state_commit",
+          "die_during_register", "blockserver_unavailable")
 
 
 class _Rule:
@@ -215,6 +230,30 @@ class FaultPlan:
         re-emission without duplicating rows."""
         self.rules.append(_Rule("die_after_state_commit", None, None,
                                 once=True, after_bytes=after_entries))
+        return self
+
+    def die_during_register(self, exchange: Optional[str] = None,
+                            after_seal: bool = False) -> "FaultPlan":
+        """Exit hard MID-REGISTRATION with the block service for the
+        addressed exchange.  ``after_seal=False``: before the ``.reg``
+        record lands — the upload is invisible and survivors must pay
+        plain lineage recovery.  ``after_seal=True``: the record is
+        sealed but the exchange commit marker is not — the exact window
+        the adoption fast path exists for."""
+        self.rules.append(_Rule("die_during_register", exchange, None,
+                                once=True,
+                                side="post" if after_seal else "pre"))
+        return self
+
+    def blockserver_unavailable(self, heal_after_s: Optional[float] = None
+                                ) -> "FaultPlan":
+        """Take the block service DOWN for this process at attach time:
+        every client call degrades structured (no registration, no
+        adoption, no restore) and the ``blockserver_unavailable``
+        counter records each denied call.  ``heal_after_s`` restores the
+        service on a daemon timer."""
+        self.rules.append(_Rule("blockserver_unavailable", None, None,
+                                once=False, heal_after_s=heal_after_s))
         return self
 
     # -- env transport ---------------------------------------------------
@@ -388,6 +427,37 @@ class FaultInjector:
             svc.spill_write = spill_write
         if orig_gather_ex is not None:
             svc.gather_sizes_ex = gather_sizes_ex
+
+        # -- block-service faults (blockserver.py) ----------------------
+        store = getattr(getattr(svc, "blockclient", None), "store", None)
+        if store is not None:
+            def register_hook(exchange, sender, phase):
+                for rule in injector.plan.rules:
+                    if rule.kind == "die_during_register" \
+                            and rule.side == phase \
+                            and rule.matches(exchange, None):
+                        rule.fired += 1
+                        injector.injected.append(
+                            f"die_during_register:{exchange}:{phase}")
+                        print(f"[faults] dying {'after' if phase == 'post' else 'before'} "
+                              f"register seal in {exchange!r}", flush=True)
+                        injector.die(43)
+
+            if any(r.kind == "die_during_register"
+                   for r in self.plan.rules):
+                store._register_hook = register_hook
+            for rule in self.plan.rules:
+                if rule.kind == "blockserver_unavailable":
+                    rule.fired += 1
+                    injector.injected.append("blockserver_unavailable")
+                    store.available = False
+                    if rule.heal_after_s is not None:
+                        t = threading.Timer(
+                            rule.heal_after_s,
+                            lambda s=store: setattr(s, "available", True))
+                        t.daemon = True
+                        t.start()
+                        self._timers.append(t)
         return self
 
     # -- streaming commit-protocol wrapping -------------------------------

@@ -557,6 +557,16 @@ class HostShuffleService:
             "grace_salted_resplits": 0,
             "reducers_planned": 0, "reducers_observed": 0,
             "reducers_elastic": 0,
+            # disaggregated block service: map outputs registered at
+            # commit time, dead peers' outputs adopted back (manifests
+            # whole-sale at the barrier, single blocks on fetch
+            # failure), reads served from the store after a peer-direct
+            # miss, degraded client calls while the service was down,
+            # and files the orphan reaper reclaimed
+            "blocks_registered": 0, "manifests_registered": 0,
+            "manifests_adopted": 0, "blocks_adopted": 0,
+            "blockserver_fallback_reads": 0, "blockserver_unavailable": 0,
+            "orphaned_blocks_reclaimed": 0,
         }
         #: reduce-partition byte sizes of the most recent ``plan_reducers``
         #: / ``plan_range_reducers`` call (manifest-summed), feeding the
@@ -638,6 +648,18 @@ class HostShuffleService:
         self._drained = threading.Condition(self._lock)
         self._write_errors: List[BaseException] = []
         os.makedirs(root, exist_ok=True)
+        # -- disaggregated block service -------------------------------
+        #: degrading client for the store that owns committed shuffle/
+        #: spill/state files past worker death (blockserver.py); None
+        #: when the service is disabled — every consumer must treat the
+        #: two identically except for the adoption fast path
+        self.blockclient = None
+        if conf.get(C.BLOCKSERVER_ENABLED):
+            from .blockserver import BlockServiceClient, BlockStore
+            self.blockclient = BlockServiceClient(
+                BlockStore(root, conf=conf),
+                owner=self.host_name(self.pid),
+                on_event=self._count_blockserver_event)
 
     @property
     def grace_buckets(self) -> int:
@@ -660,6 +682,11 @@ class HostShuffleService:
     def _count_backpressure(self) -> None:
         with self._lock:
             self.counters["fetch_backpressure_waits"] += 1
+
+    def _count_blockserver_event(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            if name in self.counters:
+                self.counters[name] += n
 
     def host_name(self, pid: int) -> str:
         return self._host_names(pid)
@@ -701,6 +728,11 @@ class HostShuffleService:
         with open(tmp, "wb") as f:
             f.write(buf)
         os.replace(tmp, path)
+        if self.blockclient is not None:
+            # custody at WRITE time (a hard link, before any fault can
+            # unlink the exchange-dir name); sealed at commit
+            self.blockclient.stage_block(
+                exchange, os.path.basename(path), path)
         t2 = time.perf_counter()
         with self._lock:
             self._staged.setdefault(exchange, {})[receiver] = len(buf)
@@ -799,8 +831,20 @@ class HostShuffleService:
                 f.write(blob)
             os.replace(dtmp, dpath)
             man["dict_bytes"] = len(blob)
+            if self.blockclient is not None:
+                self.blockclient.stage_block(
+                    exchange, os.path.basename(dpath), dpath)
             with self._lock:
                 self.counters["bytes_written"] += len(blob)
+        # registration commit point: the block service seals this
+        # sender's manifest BEFORE the exchange marker goes live — a
+        # sender that dies in the gap is adoptable by any survivor; one
+        # that dies before the seal degrades to plain lineage recovery
+        if self.blockclient is not None:
+            if self.blockclient.seal(exchange, self.pid, man):
+                with self._lock:
+                    self.counters["manifests_registered"] += 1
+                    self.counters["blocks_registered"] += len(staged)
         path = self._done(exchange, self.pid)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
@@ -914,6 +958,9 @@ class HostShuffleService:
         with open(tmp, "wb") as f:
             f.write(buf)
         os.replace(tmp, path)
+        if self.blockclient is not None:
+            self.blockclient.stage_block(
+                exchange, os.path.basename(path), path)
         with self._lock:
             self._staged.setdefault(exchange, {})[receiver] = len(buf)
             self.counters["blocks_written"] += 1
@@ -1394,6 +1441,64 @@ class HostShuffleService:
                 out.extend(batches)
         return out
 
+    # -- block-service adoption (the r16 fast path) ----------------------
+    def _adopt_manifests(self, exchange: str, excluded: set) -> None:
+        """Adoption fast path: a barrier-excluded sender that SEALED its
+        registration with the block service before dying has its whole
+        committed output re-registered into the live exchange — blocks,
+        sidecar, then commit marker, the publish order readers rely on —
+        so the statement proceeds with ZERO map re-execution instead of
+        paying the r12 re-plan/re-execute epoch.  The restored marker
+        also unblocks any peer still waiting in ``barrier``.  Removes
+        adopted senders from ``excluded`` in place."""
+        if self.blockclient is None or not excluded:
+            return
+        for s in sorted(excluded):
+            if s == self.pid or s in self.recovered_pids:
+                continue
+            adopted = self.blockclient.adopt(exchange, s,
+                                             self._dir(exchange))
+            if adopted is None:
+                continue
+            excluded.discard(s)
+            with self._lock:
+                self.counters["manifests_adopted"] += 1
+                self.counters["blocks_adopted"] += int(
+                    adopted.get("restored", 0))
+
+    def _adopt_block(self, exchange: str, item, results, sink,
+                     deadline: Optional[float]) -> bool:
+        """Last-resort read path after the peer-direct retry schedule is
+        exhausted: restore the single lost block (and, if missing, the
+        sender's dict sidecar) from the block service and read it once
+        more.  True only when the restored block decoded — the caller
+        records a loss otherwise."""
+        if self.blockclient is None:
+            return False
+        s, path, size, _host = item
+        if not self.blockclient.restore_block(
+                exchange, os.path.basename(path), path, expect_size=size):
+            return False
+        dpath = self._dict_path(exchange, s)
+        if not os.path.exists(dpath):
+            self.blockclient.restore_block(
+                exchange, os.path.basename(dpath), dpath)
+        try:
+            batches = self._reader.read(
+                path, expect_size=size, deadline=deadline,
+                decode=lambda d: self._decode_with_dicts(
+                    exchange, s, d, deadline))
+        except (BlockFetchError, OSError):
+            return False
+        if sink is not None:
+            sink.add(s, batches)
+            batches = []
+        results[s] = batches
+        with self._lock:
+            self.counters["blocks_adopted"] += 1
+            self.counters["blockserver_fallback_reads"] += 1
+        return True
+
     def _fetch_remote(self, exchange: str, t0: float,
                       sink=None) -> List[ColumnBatch]:
         """One bounded fetch attempt: barrier, then manifest-driven reads
@@ -1409,6 +1514,7 @@ class HostShuffleService:
         return value is then empty; drain the sink)."""
         deadline = self._clock() + self.timeout_s
         excluded = set(self.barrier(exchange, deadline=deadline))
+        self._adopt_manifests(exchange, excluded)
         lost_hosts: List[str] = []
         lost_blocks: List[str] = []
         #: (sender, path, manifested size, host name) fetch work list
@@ -1474,6 +1580,9 @@ class HostShuffleService:
                             with self._lock:
                                 self.counters[
                                     "retry_budget_exhausted"] += 1
+                        if self._adopt_block(exchange, item, results,
+                                             sink, deadline):
+                            continue
                         lost_hosts.append(item[3])
                         lost_blocks.append(os.path.basename(item[1]))
             with self._lock:
@@ -1734,6 +1843,18 @@ class HostShuffleService:
         # accounted exchange-staging bytes, against its budget
         gauges["peak_host_bytes"] = lambda: int(self.ledger.peak)
         gauges["host_budget_bytes"] = lambda: int(self.ledger.budget)
+        # disaggregated block service: whether one is attached, and the
+        # orphan reaper's LIFETIME reclaim total — persisted inside the
+        # store so the gauge survives worker restarts and is identical
+        # from every process sharing the root (the per-service counter
+        # of the same name stays 0 and is shadowed here)
+        if self.blockclient is not None:
+            store = self.blockclient.store
+            gauges["blockserver_enabled"] = lambda: 1
+            gauges["orphaned_blocks_reclaimed"] = (
+                lambda: int(store.reclaimed_total()))
+        else:
+            gauges["blockserver_enabled"] = lambda: 0
         return Source("shuffle", gauges)
 
     def cleanup(self, exchange: str) -> None:
@@ -1747,6 +1868,11 @@ class HostShuffleService:
             self._dict_refs.pop(exchange, None)
             for key in [k for k in self._dict_tables if k[0] == exchange]:
                 del self._dict_tables[key]
+        if self.blockclient is not None:
+            # owner-side eager release: the statement is done with this
+            # exchange on every peer (cleanup runs post-barrier), so the
+            # store drops its copies without waiting for the TTL reaper
+            self.blockclient.release_exchange(exchange)
         try:
             for name in os.listdir(d):
                 try:

@@ -172,7 +172,10 @@ class SQLServer:
         self._admission = AdmissionController(
             session.conf_obj,
             lambda: getattr(session, "_host_ledger", None),
-            grace_supplier=self._grace_total)
+            grace_supplier=self._grace_total,
+            blockstore_supplier=lambda: getattr(
+                getattr(getattr(session, "_crossproc_svc", None),
+                        "blockclient", None), "store", None))
         self._plan_cache: Optional[PlanCache] = None
         if session.conf_obj.get(C.SERVER_PLAN_CACHE_ENABLED):
             self._plan_cache = PlanCache(session.conf_obj)
@@ -190,6 +193,11 @@ class SQLServer:
         self._stream_retry: Dict[str, float] = {}  # last deferral hints
         self._reaper_stop = threading.Event()
         self._reaper: Optional[threading.Thread] = None
+        # block-service lifecycle (started/stopped with the server): when
+        # the shared session runs a block-service-backed shuffle, the
+        # serving tier owns the orphan reaper — elastic worker reap/spawn
+        # leaves exchange/state orphans only the service may delete
+        self._blockserver = None
         self._register_metrics()
 
     # -- grace-degradation visibility ------------------------------------
@@ -229,6 +237,12 @@ class SQLServer:
         gauges["sessions_open"] = lambda: len(self._sessions)
         gauges["sessions_expired"] = lambda: self._sessions_expired
         gauges["statement_readmits"] = lambda: self._statement_readmits
+        # block-service lifecycle: whether the tier runs the reaper, and
+        # its lifetime reclaim total (0 until start() attaches one)
+        gauges["blockserver_attached"] = (
+            lambda: int(self._blockserver is not None))
+        gauges["blockserver_gc_runs"] = lambda: (
+            self._blockserver.gc_runs if self._blockserver else 0)
         ms = self.session.metricsSystem
         # re-registering (e.g. a second SQLServer on the same session)
         # replaces rather than duplicates the source
@@ -664,6 +678,8 @@ class SQLServer:
         }
         if self._plan_cache is not None:
             out["planCache"] = self._plan_cache.stats()
+        if self._blockserver is not None:
+            out["blockStore"] = self._blockserver.stats()
         from .sql.stagecompile import stage_cache
         out["stageCache"] = stage_cache().stats()
         return out
@@ -823,6 +839,15 @@ class SQLServer:
             target=self._reap_loop, daemon=True,
             name=f"sql-server-reaper-{self.port}")
         self._reaper.start()
+        bc = getattr(getattr(self.session, "_crossproc_svc", None),
+                     "blockclient", None)
+        if bc is not None and self._blockserver is None:
+            from .parallel.blockserver import BlockServer
+            self._blockserver = BlockServer(
+                bc.store, roots=(bc.store.root,),
+                interval_s=float(self.session.conf_obj.get(
+                    C.BLOCKSERVER_GC_INTERVAL)))
+            self._blockserver.start()
         return self
 
     def stop(self) -> None:
@@ -830,6 +855,9 @@ class SQLServer:
         if self._reaper is not None:
             self._reaper.join(timeout=2.0)
             self._reaper = None
+        if self._blockserver is not None:
+            self._blockserver.stop()
+            self._blockserver = None
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -61,10 +61,16 @@ class AdmissionRejected(RuntimeError):
 class AdmissionController:
     def __init__(self, conf,
                  ledger_supplier: Optional[Callable[[], Any]] = None,
-                 grace_supplier: Optional[Callable[[], int]] = None):
+                 grace_supplier: Optional[Callable[[], int]] = None,
+                 blockstore_supplier: Optional[Callable[[], Any]] = None):
         self._conf = conf
         self._ledger = ledger_supplier or (lambda: None)
         self._grace = grace_supplier or (lambda: 0)
+        # disaggregated block service (blockserver.BlockStore or None):
+        # purely observational here — admission surfaces the store's
+        # hygiene next to its own counters so a serving operator sees
+        # disk ownership and tenancy pressure in one place
+        self._blockstore = blockstore_supplier or (lambda: None)
         self._lock = threading.Lock()
         self.active = 0                # admitted, not yet released
         self.peak_active = 0
@@ -204,8 +210,13 @@ class AdmissionController:
 
     # -- introspection -------------------------------------------------
     def stats(self) -> Dict[str, Any]:
+        store = None
+        try:
+            store = self._blockstore()
+        except Exception:
+            pass
         with self._lock:
-            return {
+            out = {
                 "admitted": self.admitted, "rejected": self.rejected,
                 "active": self.active, "peakActive": self.peak_active,
                 "rejectedBy": dict(self.rejected_by),
@@ -217,6 +228,9 @@ class AdmissionController:
                 "streamBatches": self.stream_batches,
                 "streamBatchesDeferred": self.stream_batches_deferred,
             }
+        if store is not None:
+            out["blockStore"] = store.stats()
+        return out
 
     def metrics_source(self) -> Dict[str, Callable[[], Any]]:
         return {

@@ -853,6 +853,14 @@ class SparkSession:
         return self._crossproc_svc
 
     def disableHostShuffle(self) -> None:
+        svc = getattr(self, "_crossproc_svc", None)
+        bc = getattr(svc, "blockclient", None)
+        if bc is not None:
+            # orderly departure: release this process's block-service
+            # lease so the orphan reaper's TTL clock starts on whatever
+            # the process leaves registered (a crash skips this and the
+            # lease simply goes stale — same clock, later start)
+            bc.expire_owner(bc.owner)
         self._crossproc_svc = None
 
     @property

@@ -19,6 +19,7 @@ merge exactly.  Snapshots are written per batch under
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -929,6 +930,27 @@ class StreamExecution:
         # serving admission: a callback returning False defers this batch
         # (the trigger loop retries after its interval)
         self._batch_admit = None
+        # -- block-service checkpoint ownership --------------------------
+        # the checkpoint moves behind the same ownership boundary as
+        # shuffle blocks (blockserver.py): registered under a key derived
+        # from the checkpoint PATH — stable across worker restarts, unlike
+        # self.id — so a rolling restart re-registers the SAME record and
+        # resumes in place.  Every durable commit renews the lease; only
+        # stop() releases ownership, and the TTL reaper may reclaim the
+        # state dir release + TTL later.  A crashed owner keeps its lease
+        # file (stale), so its checkpoint is never reaped out from under
+        # the recovery that needs it.
+        self._blockclient = None
+        self._ck_owner: Optional[str] = None
+        _bc = getattr(getattr(session, "_crossproc_svc", None),
+                      "blockclient", None)
+        if _bc is not None and checkpoint:
+            self._blockclient = _bc
+            digest = hashlib.sha256(
+                os.path.abspath(checkpoint).encode()).hexdigest()[:16]
+            self._ck_owner = f"stream-{digest}"
+            _bc.register_state(self._ck_owner, checkpoint,
+                               owner=self._ck_owner)
         self._recover()
         # register only AFTER recovery: a CheckpointCorruption abort in
         # _recover must not leave a half-built execution on the session
@@ -1127,7 +1149,8 @@ class StreamExecution:
                     conf=self.session.conf_obj,
                     ledger_supplier=lambda: getattr(
                         self.session, "_host_ledger", None),
-                    ledger_owner=f"stream:{self.id[:8]}:versions")
+                    ledger_owner=f"stream:{self.id[:8]}:versions",
+                    on_commit=lambda _v: self._renew_ownership())
                 if self.checkpoint else None)
             self._fmgws_states: dict = {}
             self._agg_node = None
@@ -1462,6 +1485,7 @@ class StreamExecution:
         # phase 6 — post-commit: ledger re-accounting (may spill), source
         # release, progress
         self.metrics["batches_committed"] += 1
+        self._renew_ownership()
         self._account_state()
         n_rows = len(batch.to_pylist())
         self.progress.append({
@@ -1476,6 +1500,14 @@ class StreamExecution:
             _log.warning("source.commit(%s) failed", end, exc_info=True)
         self.batch_id += 1
         return True
+
+    def _renew_ownership(self) -> None:
+        """Renew the block-service checkpoint lease on every durable
+        commit (batch commit or state-store commit): a standing query is
+        'alive' to the orphan reaper exactly as long as it keeps
+        committing.  Degrades to a no-op when no service is attached."""
+        if self._blockclient is not None and self._ck_owner:
+            self._blockclient.touch_owner(self._ck_owner)
 
     # -- stage-cache + ledger tenancy -------------------------------------
     def _stage_builds(self) -> int:
@@ -1970,6 +2002,12 @@ class StreamExecution:
         regs = getattr(self.session, "_stream_execs", None)
         if regs is not None and self in regs:
             regs.remove(self)
+        # EXPLICIT checkpoint-ownership release: only a stopped query
+        # starts the reaper's release+TTL clock — a crash skips this, so
+        # a crashed owner's checkpoint survives for restart recovery
+        if self._blockclient is not None and self._ck_owner:
+            self._blockclient.release_state(self._ck_owner,
+                                            owner=self._ck_owner)
 
 
 class _MemLog(MetadataLog):

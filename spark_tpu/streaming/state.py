@@ -109,13 +109,19 @@ class StateStoreProvider:
 
     def __init__(self, checkpoint_dir: str, operator_id: int = 0,
                  partition_id: int = 0, conf=None,
-                 ledger_supplier=None, ledger_owner: Optional[str] = None):
+                 ledger_supplier=None, ledger_owner: Optional[str] = None,
+                 on_commit=None):
         conf = conf or C.Conf()
         self.dir = os.path.join(checkpoint_dir, "state", str(operator_id),
                                 str(partition_id))
         os.makedirs(self.dir, exist_ok=True)
         self.snapshot_interval = conf.get(SNAPSHOT_INTERVAL)
         self.retain = conf.get(STATE_RETAIN)
+        # block-service registrar: called with the committed version
+        # after each durable state write so the owning stream renews its
+        # checkpoint lease with the block service (blockserver.py) —
+        # state files stay 'live' to the orphan reaper while commits flow
+        self._on_commit = on_commit
         self._cache: Dict[int, Dict[Any, Any]] = {}   # version → full map
         self._bytes: Dict[int, int] = {}    # version → resident estimate
         # host-ledger tenancy: cached (host-resident) versions are
@@ -193,6 +199,8 @@ class StateStoreProvider:
         self._bytes[version] = len(pickle.dumps(full))
         self.maintenance(version)
         self._account(version)
+        if self._on_commit is not None:
+            self._on_commit(version)
         return version
 
     def _account(self, current: int) -> None:

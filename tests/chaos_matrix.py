@@ -36,7 +36,8 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 
 #: the exchange phases the matrix must cover (ISSUE contract)
 PHASES = ("map-staging", "post-publish-sizes", "mid-fetch",
-          "mid-demotion", "during-recovery", "during-grace")
+          "mid-demotion", "during-recovery", "during-grace",
+          "post-register")
 
 
 def _scenario(name, phase, worker, mode, n, timeout_s, plans, expect,
@@ -163,6 +164,49 @@ SCENARIOS = [
         "adaptive_worker.py", "skew-decision", 2, 6.0,
         {1: lambda: FaultPlan().skew_decision("xq000001-plan")},
         {0: "FAILED", 1: "FAILED"}),
+    # -- the disaggregated-block-service battery (``--blockserver``) --
+    # kill AFTER the map output registered with the block service: the
+    # victim drops its shipped jR block from the exchange dir (so the
+    # raw-path fetch fails) and dies once its LAST manifest lands — the
+    # survivor must finish from block-service custody alone, with the
+    # retry budget at ZERO so any recovery attempt would fail the
+    # query: OK here is a proof of zero re-executed map tasks
+    _scenario(
+        "blockserver-adopt-zero-rerun", "post-register",
+        "recovery_worker.py", "bs-zero", 2, 20.0,
+        {1: lambda: FaultPlan().drop(exchange="xq000001-jR", receiver=0)
+            .die_after_manifest("xq000001-gather")},
+        {0: "OK", 1: "DIED"}, tier="tier1"),
+    # -- die in the register gap, AFTER the seal record committed but
+    #    BEFORE the exchange .done marker: the survivor's barrier sees
+    #    a dead silent peer, yet adoption re-publishes the sealed
+    #    manifest + blocks; the victim's unfinished downstream stages
+    #    still need the recovery epoch (asserted manifests_adopted>=1)
+    _scenario(
+        "blockserver-adopt-sealed-manifest", "post-register",
+        "recovery_worker.py", "bs-adopt", 2, 20.0,
+        {1: lambda: FaultPlan().die_during_register(
+            "xq000001-jR", after_seal=True)},
+        {0: "OK", 1: "DIED"}),
+    # -- die in the register gap BEFORE the seal: nothing adoptable, the
+    #    survivor must fall all the way back to lineage re-execution
+    #    (asserted manifests_adopted == 0) --
+    _scenario(
+        "blockserver-die-mid-register", "post-register",
+        "recovery_worker.py", "bs-recover", 2, 20.0,
+        {1: lambda: FaultPlan().die_during_register("xq000001-jR")},
+        {0: "OK", 1: "DIED"}),
+    # -- block service down on the SURVIVOR while a committed peer's
+    #    block is missing and the peer dead: adoption degrades to a
+    #    counted event (never a hang) and r12 recovery still lands the
+    #    exact oracle --
+    _scenario(
+        "blockserver-unavailable-fallback", "mid-fetch",
+        "recovery_worker.py", "bs-unavail", 2, 20.0,
+        {0: lambda: FaultPlan().blockserver_unavailable(),
+         1: lambda: FaultPlan().drop(exchange="xq000001-jR", receiver=0)
+            .die_after_manifest("xq000001-jR")},
+        {0: "OK", 1: "DIED"}),
 ]
 
 
@@ -278,6 +322,11 @@ def main(argv=None):
                     help="run the standing-query kill/restart group "
                     "(supervised exactly-once recovery) instead of the "
                     "exchange matrix")
+    ap.add_argument("--blockserver", action="store_true",
+                    help="run only the disaggregated block-service "
+                    "battery: kill-after-register adoption (zero "
+                    "re-execution), register-gap deaths, and the "
+                    "service-unavailable degradation path")
     args = ap.parse_args(argv)
 
     table = STREAM_SCENARIOS if args.streaming else SCENARIOS
@@ -285,6 +334,8 @@ def main(argv=None):
             if args.tier in ("all", s["tier"])
             and (not args.only
                  or any(pat in s["name"] for pat in args.only))]
+    if args.blockserver:
+        todo = [s for s in todo if s["name"].startswith("blockserver-")]
     if args.seed:
         random.Random(args.seed).shuffle(todo)
     if not todo:

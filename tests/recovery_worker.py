@@ -30,6 +30,21 @@ mode "grace-recover" — the "recover" contract under a host budget
     cleanly over partially-spilled grace state — and the replay, now
     holding the whole data on fewer processes, grace-degrades again.
     Additionally asserts nonzero ``grace_buckets_used`` before OK.
+mode "bs-*" — the disaggregated-block-service battery: same query with
+    ``spark.tpu.blockserver.enabled`` on.
+    "bs-zero"    — retry budget forced to ZERO: the survivor must reach
+        the exact oracle purely by adopting the dead peer's registered
+        blocks (asserts ``stage_retries == 0``, ``epoch == 0`` and
+        nonzero adoption counters — zero re-executed map tasks).
+    "bs-adopt"   — victim dies post-seal/pre-marker: the sealed
+        manifest adopts, the unfinished downstream stages recover
+        (asserts ``manifests_adopted >= 1`` AND ``stage_retries >= 1``).
+    "bs-recover" — victim dies pre-seal: nothing adoptable, pure r12
+        re-execution (asserts ``manifests_adopted == 0``).
+    "bs-unavail" — the SURVIVOR's block service is down: adoption
+        degrades to a counted event, recovery still lands the oracle
+        (asserts ``blockserver_unavailable >= 1``,
+        ``blocks_adopted == 0``).
 
 Any partial result prints ``[p<pid>] PARTIAL`` and exits 1 — the
 launcher greps for it; it must never appear.
@@ -109,6 +124,16 @@ xs.conf.set(C.CROSSPROC_SHUFFLED_JOIN.key, "true")
 # exclusion well inside one exchange deadline
 xs.conf.set("spark.tpu.cluster.heartbeatIntervalMs", "100")
 xs.conf.set("spark.tpu.cluster.heartbeatTimeoutMs", "600")
+if mode.startswith("bs-"):
+    # every process registers its map outputs with the shared block
+    # service at manifest-commit time; set BEFORE enableHostShuffle —
+    # the client attaches at service construction
+    xs.conf.set(C.BLOCKSERVER_ENABLED.key, "true")
+    if mode == "bs-zero":
+        # the zero-re-execution proof: ANY recovery attempt would blow
+        # the zero budget and fail the query, so an oracle-exact OK can
+        # only come from adopting the dead peer's registered output
+        xs.conf.set(C.RECOVERY_MAX_STAGE_RETRIES.key, "0")
 if mode == "norecover":
     xs.conf.set(C.RECOVERY_MAX_STAGE_RETRIES.key, "0")
 elif mode == "grace-recover":
@@ -177,6 +202,40 @@ if mode in ("recover", "grace-recover"):
           f"recovered={svc.counters['recovered_partitions']} "
           f"epoch={gauges['epoch']} "
           f"grace={svc.counters['grace_buckets_used']}", flush=True)
+elif mode.startswith("bs-"):
+    gauges = svc.metrics_source().snapshot()
+    if mode == "bs-zero":
+        # the dead peer's registered output was ADOPTED: exact oracle
+        # with the recovery machinery never armed — zero re-executed
+        # map tasks, zero epochs, and the adoption counters prove the
+        # block really came out of service custody
+        assert svc.counters["stage_retries"] == 0, svc.counters
+        assert gauges["epoch"] == 0, gauges
+        assert svc.counters["blocks_adopted"] >= 1, svc.counters
+        assert svc.counters["blockserver_fallback_reads"] >= 1, \
+            svc.counters
+    elif mode == "bs-adopt":
+        # sealed-but-unmarked manifest adopted at the barrier; the
+        # victim's unfinished downstream stages still needed recovery
+        assert svc.counters["manifests_adopted"] >= 1, svc.counters
+        assert svc.counters["stage_retries"] >= 1, svc.counters
+    elif mode == "bs-recover":
+        # death BEFORE the seal: nothing adoptable, pure re-execution
+        assert svc.counters["manifests_adopted"] == 0, svc.counters
+        assert svc.counters["stage_retries"] >= 1, svc.counters
+    elif mode == "bs-unavail":
+        # service down on this side: every adoption attempt degraded to
+        # a counted event (no hang, no partial), recovery did the rest
+        assert svc.counters["blockserver_unavailable"] >= 1, svc.counters
+        assert svc.counters["blocks_adopted"] == 0, svc.counters
+        assert svc.counters["stage_retries"] >= 1, svc.counters
+    print(f"[p{pid}] OK {len(got)} "
+          f"retries={svc.counters['stage_retries']} "
+          f"adopted={svc.counters['manifests_adopted']}m"
+          f"/{svc.counters['blocks_adopted']}b "
+          f"fallback={svc.counters['blockserver_fallback_reads']} "
+          f"unavail={svc.counters['blockserver_unavailable']}",
+          flush=True)
 else:
     # norecover with no fault on this process's path: plain success,
     # and the recovery machinery must not have stirred

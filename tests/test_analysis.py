@@ -416,6 +416,33 @@ def test_lint_nonatomic_durable_write():
         "            return f.read()\n")
 
 
+def test_lint_block_path_outside_resolver():
+    # spelling a block wire-format name outside the resolver seam: the
+    # block service can neither register nor reap a path it never sees
+    bad = """
+        import os
+
+        def peek(root, pid):
+            return os.path.join(root, f"s{pid:04d}.done")
+    """
+    assert "HZ113" in _rules(bad)
+    found = [f for f in _lint(bad) if f.rule == "HZ113"]
+    assert found[0].symbol == "peek"
+    assert "`.done`" in found[0].message
+    # the f-string TAIL decides: a suffix mid-string is prose, not a path
+    assert "HZ113" in _rules("def f(b):\n    return f'{b}.snapshot'\n")
+    assert "HZ113" not in _rules(
+        "def f(x):\n    return f'.part of {x}'\n")
+    # docstrings and bare-expression strings are prose
+    assert "HZ113" not in _rules(
+        'def f():\n    "reads the s0000.part"\n    return 1\n')
+    # the resolver modules themselves are the seam — exempt by path
+    from spark_tpu.analysis.lint import lint_source as _ls
+    owner = _ls(textwrap.dedent(bad),
+                path="spark_tpu/parallel/hostshuffle.py")
+    assert not [f for f in owner if f.rule == "HZ113"]
+
+
 # ---------------------------------------------------------------------------
 # HZ109/HZ110: replica-determinism rules on synthetic snippets
 # ---------------------------------------------------------------------------
@@ -673,8 +700,10 @@ def test_repo_is_lint_clean():
     # are the catalogued intentional jit sites: the stage cache itself,
     # the per-op bench baseline, one-shot ml fits and probes; the 3
     # streaming entries cover lock-serialized metrics writes and the
-    # state-store accounting's deliberate release/re-reserve cycle)
-    assert len(waived) <= 27
+    # state-store accounting's deliberate release/re-reserve cycle; the
+    # 3 HZ113 entries are the injector's deliberate manifest tampering
+    # and the pre-seam AggregationState snapshot naming)
+    assert len(waived) <= 31
 
 
 def test_lint_cli_main_exit_codes(tmp_path, capsys):

@@ -1,0 +1,535 @@
+"""Unit battery for the disaggregated block service (PR 16).
+
+Four layers, matching the ownership boundary in docs/DECISIONS.md:
+
+* ``BlockStore`` registration mechanics — stage / seal / adopt
+  round-trips, idempotent adoption, size-verified restores, and the
+  refusal to adopt a seal whose bytes are incomplete;
+* structured DEGRADATION — the fault kinds ``die_during_register``
+  (both sides of the seal) and ``blockserver_unavailable`` produce
+  bounded, counted outcomes through the degrading client, never a hang
+  and never an unhandled raise;
+* the TTL orphan reaper — stale sealed exchanges reclaimed once every
+  owner's lease goes silent, registered state dirs reclaimed ONLY
+  after explicit release + TTL (a crashed owner's checkpoint is never
+  reaped), raw swept roots touched only when a directory holds nothing
+  but wire-format block files, and the ``orphaned_blocks_reclaimed``
+  gauge persisting across store instances;
+* the rolling-restart acceptance — a standing query stopped and
+  resumed over block-service-registered checkpoint state lands a sink
+  BYTE-identical to an uninterrupted oracle run.
+
+The subprocess half (real worker kills, adoption with zero re-executed
+map tasks) lives in ``tests/chaos_matrix.py --blockserver``.
+"""
+
+import glob
+import os
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from spark_tpu import config as C
+from spark_tpu import types as T
+from spark_tpu.parallel.blockserver import (
+    BlockServer, BlockServerUnavailable, BlockServiceClient, BlockStore,
+)
+from spark_tpu.parallel.faults import FaultInjector, FaultPlan
+from spark_tpu.sql import functions as F
+
+TTL = 120.0
+
+
+def _store(root, ttl=TTL):
+    """A store over ``root`` with a settable clock: tests advance
+    ``now[0]`` instead of sleeping; file mtimes stay real wall-clock,
+    so the base must be ``time.time()``."""
+    conf = C.Conf()
+    conf.set(C.BLOCKSERVER_ORPHAN_TTL.key, str(int(ttl)))
+    now = [time.time()]
+    return BlockStore(str(root), conf=conf, clock=lambda: now[0]), now
+
+
+def _publish(tmp_path, store, exchange="xq000042-jL", sender=0,
+             owner="host-0", dict_bytes=0, seal=True):
+    """Simulate a live sender's publish: block files on disk, staged
+    into the store, then (optionally) sealed with their manifest."""
+    src = tmp_path / "live" / exchange
+    os.makedirs(src, exist_ok=True)
+    blocks = {}
+    for r, payload in enumerate((b"alpha-rows", b"beta-rows!!")):
+        name = f"s{sender:04d}-r{r:04d}.part"
+        (src / name).write_bytes(payload)
+        store.stage_block(exchange, name, str(src / name))
+        blocks[str(r)] = len(payload)
+    man = {"ts": 1.0, "host": owner, "blocks": blocks}
+    if dict_bytes:
+        name = f"s{sender:04d}.dict"
+        (src / name).write_bytes(b"d" * dict_bytes)
+        store.stage_block(exchange, name, str(src / name))
+        man["dict_bytes"] = dict_bytes
+    if seal:
+        store.seal(exchange, sender, man, owner)
+    return man
+
+
+# ---------------------------------------------------------------------------
+# registration mechanics
+# ---------------------------------------------------------------------------
+
+def test_stage_seal_adopt_roundtrip(tmp_path):
+    store, _now = _store(tmp_path / "shuf")
+    man = _publish(tmp_path, store, dict_bytes=7)
+    dest = str(tmp_path / "adopted")
+
+    got = store.adopt("xq000042-jL", 0, dest)
+    assert got is not None
+    assert got["restored"] == 3                  # 2 parts + dict sidecar
+    assert open(os.path.join(dest, "s0000-r0000.part"), "rb").read() \
+        == b"alpha-rows"
+    assert open(os.path.join(dest, "s0000-r0001.part"), "rb").read() \
+        == b"beta-rows!!"
+    # commit marker written LAST carries the manifest minus the store's
+    # own owner field — readers see exactly a live sender's publish
+    import json
+    with open(os.path.join(dest, "s0000.done")) as f:
+        marker = json.load(f)
+    assert marker["blocks"] == man["blocks"]
+    assert "owner" not in marker
+    # re-adoption (a second surviving reader) is an idempotent no-op
+    again = store.adopt("xq000042-jL", 0, dest)
+    assert again is not None and again["restored"] == 0
+
+
+def test_adopt_refuses_unsealed_and_incomplete(tmp_path):
+    store, _now = _store(tmp_path / "shuf")
+    # staged but never sealed: invisible to adoption
+    _publish(tmp_path, store, exchange="xq000001-jL", seal=False)
+    assert store.adopt("xq000001-jL", 0, str(tmp_path / "d1")) is None
+    # sealed, but the manifest names a block the store never got (a
+    # crash between stage and seal): adoption refuses the whole seal
+    store.seal("xq000002-jL", 0,
+               {"blocks": {"0": 10, "1": 999}}, "host-0")
+    assert store.adopt("xq000002-jL", 0, str(tmp_path / "d2")) is None
+    assert not os.path.exists(str(tmp_path / "d2" / "s0000.done"))
+
+
+def test_restore_block_verifies_size(tmp_path):
+    store, _now = _store(tmp_path / "shuf")
+    _publish(tmp_path, store)
+    dest = str(tmp_path / "r0000.part")
+    assert store.restore_block("xq000042-jL", "s0000-r0000.part", dest,
+                               expect_size=len(b"alpha-rows"))
+    assert open(dest, "rb").read() == b"alpha-rows"
+    # wrong expected size or never-staged name: a clean False, no file
+    assert not store.restore_block("xq000042-jL", "s0000-r0000.part",
+                                   str(tmp_path / "x"), expect_size=5)
+    assert not store.restore_block("xq000042-jL", "s0099-r0000.part",
+                                   str(tmp_path / "y"))
+    assert not os.path.exists(str(tmp_path / "x"))
+
+
+def test_release_exchange_drops_custody(tmp_path):
+    store, _now = _store(tmp_path / "shuf")
+    _publish(tmp_path, store)
+    assert store.stats()["exchangesHeld"] == 1
+    store.release_exchange("xq000042-jL")
+    assert store.stats()["exchangesHeld"] == 0
+    assert store.adopt("xq000042-jL", 0, str(tmp_path / "d")) is None
+
+
+# ---------------------------------------------------------------------------
+# structured degradation: the client and the fault kinds
+# ---------------------------------------------------------------------------
+
+def test_unavailable_store_raises_and_client_degrades(tmp_path):
+    store, _now = _store(tmp_path / "shuf")
+    _publish(tmp_path, store)
+    events = []
+    client = BlockServiceClient(store, owner="host-0",
+                                on_event=lambda name, n=1: events.append(name))
+    store.available = False
+    with pytest.raises(BlockServerUnavailable):
+        store.seal("xq000042-jL", 1, {"blocks": {}}, "host-1")
+    # every client verb: structured default, an event, never a raise
+    assert client.stage_block("xq000042-jL", "s0001-r0000.part",
+                              str(tmp_path / "nope")) is False
+    assert client.seal("xq000042-jL", 1, {"blocks": {}}) is False
+    assert client.adopt("xq000042-jL", 0, str(tmp_path / "d")) is None
+    assert client.restore_block("xq000042-jL", "s0000-r0000.part",
+                                str(tmp_path / "r")) is False
+    assert client.register_state("k", str(tmp_path), owner="o") is False
+    assert events == ["blockserver_unavailable"] * 5
+    # the store healing restores full service
+    store.available = True
+    assert client.adopt("xq000042-jL", 0, str(tmp_path / "d")) is not None
+
+
+def test_client_degrades_on_filesystem_errors(tmp_path):
+    store, _now = _store(tmp_path / "shuf")
+    events = []
+    client = BlockServiceClient(store, owner="host-0",
+                                on_event=lambda name, n=1: events.append(name))
+    # staging a source file that vanished (the race adoption exists
+    # for): an OSError inside the store, a counted False outside
+    assert client.stage_block("xq000001-jL", "s0000-r0000.part",
+                              str(tmp_path / "gone.part")) is False
+    assert events == ["blockserver_unavailable"]
+
+
+class _Kill(BaseException):
+    """In-process stand-in for the injector's hard exit."""
+
+
+def _armed_store(tmp_path, plan):
+    """A store + degrading client wired through ``FaultInjector.attach``
+    the way a real ``HostShuffleService`` would be (the injector only
+    needs the ``blockclient`` seam plus put/commit to wrap)."""
+    store, _now = _store(tmp_path / "shuf")
+    client = BlockServiceClient(store, owner="host-1")
+    svc = SimpleNamespace(put=lambda *a: None, commit=lambda *a: None,
+                          blockclient=client)
+    inj = FaultInjector(plan)
+    inj.die = lambda code: (_ for _ in ()).throw(_Kill(code))
+    inj.attach(svc)
+    return store, inj
+
+
+def test_die_during_register_before_seal(tmp_path):
+    store, inj = _armed_store(
+        tmp_path, FaultPlan().die_during_register("xq000001-jL"))
+    with pytest.raises(_Kill):
+        store.seal("xq000001-jL", 1, {"blocks": {}}, "host-1")
+    # death BEFORE the seal: no record — survivors see "never
+    # registered" and pay plain lineage recovery
+    assert store.sealed_manifest("xq000001-jL", 1) is None
+    assert inj.injected == ["die_during_register:xq000001-jL:pre"]
+
+
+def test_die_during_register_after_seal_is_adoptable(tmp_path):
+    store, inj = _armed_store(
+        tmp_path,
+        FaultPlan().die_during_register("xq000001-jL", after_seal=True))
+    src = tmp_path / "blk.part"
+    src.write_bytes(b"payload")
+    store.stage_block("xq000001-jL", "s0001-r0000.part", str(src))
+    with pytest.raises(_Kill):
+        store.seal("xq000001-jL", 1, {"blocks": {"0": 7}}, "host-1")
+    # death AFTER the seal: the record is durable — exactly the window
+    # the adoption fast path exists for
+    assert store.sealed_manifest("xq000001-jL", 1) is not None
+    got = store.adopt("xq000001-jL", 1, str(tmp_path / "dest"))
+    assert got is not None and got["restored"] == 1
+    assert inj.injected == ["die_during_register:xq000001-jL:post"]
+    # the kill is once-per-rule: a later seal (the recovery epoch's
+    # re-publish would use a fresh exchange anyway) must not re-fire
+    store.seal("xq000002-jL", 1, {"blocks": {}}, "host-1")
+
+
+def test_die_during_register_filters_by_exchange(tmp_path):
+    store, inj = _armed_store(
+        tmp_path, FaultPlan().die_during_register("xq000009-jR"))
+    store.seal("xq000001-jL", 1, {"blocks": {}}, "host-1")   # no match
+    assert inj.injected == []
+
+
+def test_blockserver_unavailable_fault_heals_on_timer(tmp_path):
+    plan = FaultPlan().blockserver_unavailable(heal_after_s=0.15)
+    store, inj = _armed_store(tmp_path, plan)
+    assert store.available is False                 # down at attach time
+    assert inj.injected == ["blockserver_unavailable"]
+    deadline = time.time() + 5.0
+    while not store.available and time.time() < deadline:
+        time.sleep(0.02)
+    assert store.available is True                  # healed, full service
+    store.seal("xq000001-jL", 1, {"blocks": {}}, "host-1")
+
+
+def test_new_fault_kinds_round_trip_env():
+    plan = (FaultPlan()
+            .die_during_register("xq000001-jR", after_seal=True)
+            .blockserver_unavailable(heal_after_s=2.0))
+    back = FaultPlan.from_env({"SPARK_TPU_FAULT_PLAN": plan.to_env()})
+    kinds = [r.kind for r in back.rules]
+    assert kinds == ["die_during_register", "blockserver_unavailable"]
+    assert back.rules[0].side == "post"             # the seal-side flag
+    assert back.rules[1].heal_after_s == 2.0
+
+
+# ---------------------------------------------------------------------------
+# the TTL orphan reaper
+# ---------------------------------------------------------------------------
+
+def test_gc_reclaims_exchange_only_after_owner_silence(tmp_path):
+    store, now = _store(tmp_path / "shuf")
+    _publish(tmp_path, store, owner="host-0")
+    # fresh files + fresh lease: nothing to reap
+    assert store.gc(roots=()) == 0
+    assert store.stats()["exchangesHeld"] == 1
+    # a TTL past: files stale AND the owner's lease stale — reclaimed
+    now[0] += TTL + 1
+    reclaimed = store.gc(roots=())
+    assert reclaimed == 3                           # 2 parts + .reg seal
+    assert store.stats()["exchangesHeld"] == 0
+    assert store.reclaimed_total() == 3
+
+
+def test_gc_spares_stale_exchange_while_owner_lease_fresh(tmp_path):
+    store, now = _store(tmp_path / "shuf")
+    _publish(tmp_path, store, owner="host-0")
+    now[0] += TTL + 1
+    # the owner is alive (lease renewed at the advanced clock): its
+    # stale-looking exchange must survive — only silence reclaims
+    os.utime(store._lease_path("host-0"), (now[0], now[0]))
+    assert store.gc(roots=()) == 0
+    assert store.stats()["exchangesHeld"] == 1
+
+
+def test_gc_never_reaps_crashed_owner_state(tmp_path):
+    store, now = _store(tmp_path / "shuf")
+    ckpt = tmp_path / "ckpt"
+    os.makedirs(ckpt)
+    (ckpt / "0.delta").write_bytes(b"state")
+    store.register_state("stream-abc", str(ckpt), "stream-abc")
+    # the owner CRASHES: its lease file stays on disk, merely stale.
+    # Any amount of time later the checkpoint must still be there —
+    # restart recovery needs it; only an explicit release starts the
+    # reaper's clock
+    now[0] += 100 * TTL
+    assert store.gc(roots=()) == 0
+    assert os.path.isdir(str(ckpt))
+    assert store.state_record("stream-abc") is not None
+
+
+def test_gc_reclaims_state_after_explicit_release_plus_ttl(tmp_path):
+    store, now = _store(tmp_path / "shuf")
+    ckpt = tmp_path / "ckpt"
+    os.makedirs(ckpt)
+    (ckpt / "0.delta").write_bytes(b"state")
+    (ckpt / "1.delta").write_bytes(b"more")
+    store.register_state("stream-abc", str(ckpt), "stream-abc")
+    store.release_state("stream-abc", "stream-abc")   # query stop()
+    # released but inside the TTL: still recoverable (an operator
+    # restarting the query keeps its state)
+    assert store.gc(roots=()) == 0
+    assert os.path.isdir(str(ckpt))
+    # release + TTL: reclaimed, record dropped
+    now[0] += TTL + 1
+    rec = store._state_rec("stream-abc")
+    os.utime(rec, (now[0] - TTL - 1, now[0] - TTL - 1))
+    assert store.gc(roots=()) == 2
+    assert not os.path.exists(str(ckpt))
+    assert store.state_record("stream-abc") is None
+
+
+def test_gc_raw_root_sweep_only_touches_block_dirs(tmp_path):
+    store, now = _store(tmp_path / "shuf")
+    root = str(tmp_path / "shuf")
+    # a dead session's exchange dir: wire-format files only
+    dead = os.path.join(root, "xq000001-jL")
+    os.makedirs(dead)
+    open(os.path.join(dead, "s0000-r0000.part"), "wb").write(b"x")
+    open(os.path.join(dead, "s0000.done"), "w").write("{}")
+    # a directory with a foreign file is NOT an exchange dir — never
+    # touched no matter how stale
+    mixed = os.path.join(root, "leaves")
+    os.makedirs(mixed)
+    open(os.path.join(mixed, "notes.txt"), "w").write("keep me")
+    open(os.path.join(mixed, "s0000-r0000.part"), "wb").write(b"x")
+    now[0] += TTL + 1
+    reclaimed = store.gc(roots=(root,))
+    assert reclaimed == 2
+    assert not os.path.exists(dead)
+    assert os.path.exists(os.path.join(mixed, "notes.txt"))
+    # the store's own area is skipped by name even under the root
+    assert os.path.isdir(store.dir)
+
+
+def test_reclaimed_gauge_persists_across_store_instances(tmp_path):
+    store, now = _store(tmp_path / "shuf")
+    _publish(tmp_path, store)
+    now[0] += TTL + 1
+    assert store.gc(roots=()) == 3
+    # a different process constructing its own store over the same root
+    # reads the same lifetime total — the gauge survives restarts
+    fresh, _now2 = _store(tmp_path / "shuf")
+    assert fresh.reclaimed_total() == 3
+    assert fresh.stats()["orphanedBlocksReclaimed"] == 3
+
+
+def test_blockserver_reaper_lifecycle(tmp_path):
+    store, now = _store(tmp_path / "shuf")
+    _publish(tmp_path, store)
+    now[0] += TTL + 1
+    server = BlockServer(store, interval_s=3600.0, roots=())
+    assert server.run_gc() == 3
+    stats = server.stats()
+    assert stats["gcRuns"] == 1 and stats["lastReclaimed"] == 3
+    # a down store makes the reaper a no-op, not an error
+    store.available = False
+    assert server.run_gc() == 0
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# service integration: gauges on the shuffle metrics source
+# ---------------------------------------------------------------------------
+
+def test_shuffle_source_exports_blockserver_gauges(spark, tmp_path):
+    prev = getattr(spark, "_crossproc_svc", None)
+    ms = spark.metricsSystem
+    xs = spark.newSession()
+    xs.conf.set(C.BLOCKSERVER_ENABLED.key, "true")
+    try:
+        svc = xs.enableHostShuffle(str(tmp_path), process_id=0,
+                                   n_processes=1, timeout_s=5.0)
+        assert svc.blockclient is not None
+        snap = svc.metrics_source().snapshot()
+        assert snap["blockserver_enabled"] == 1
+        assert snap["orphaned_blocks_reclaimed"] == 0
+        for k in ("blocks_registered", "manifests_registered",
+                  "manifests_adopted", "blocks_adopted",
+                  "blockserver_fallback_reads", "blockserver_unavailable"):
+            assert snap[k] == 0, (k, snap)
+        # the gauge reads the store's persistent total, not the local
+        # counter — reaper activity in ANY process shows up here
+        svc.blockclient.store._bump_reclaimed(5)
+        assert svc.metrics_source().snapshot()[
+            "orphaned_blocks_reclaimed"] == 5
+    finally:
+        xs._crossproc_svc = None
+        spark._crossproc_svc = prev
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]
+
+
+def test_shuffle_source_gauge_off_without_blockserver(spark, tmp_path):
+    prev = getattr(spark, "_crossproc_svc", None)
+    ms = spark.metricsSystem
+    xs = spark.newSession()
+    try:
+        svc = xs.enableHostShuffle(str(tmp_path), process_id=0,
+                                   n_processes=1, timeout_s=5.0)
+        assert svc.blockclient is None
+        snap = svc.metrics_source().snapshot()
+        assert snap["blockserver_enabled"] == 0
+        assert snap["orphaned_blocks_reclaimed"] == 0
+    finally:
+        xs._crossproc_svc = None
+        spark._crossproc_svc = prev
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]
+
+
+# ---------------------------------------------------------------------------
+# rolling restart: a standing query resumes byte-identically from
+# block-service-registered checkpoint state
+# ---------------------------------------------------------------------------
+
+def sec(n) -> int:
+    return int(n * 1_000_000)
+
+
+_SCHEMA = T.StructType([
+    T.StructField("ts", T.timestamp),
+    T.StructField("k", T.string),
+    T.StructField("v", T.int64),
+])
+
+# one file per feed = one micro-batch per feed in every lifetime
+_FEEDS = [
+    [(sec(1), "a", 1), (sec(9), "b", 2)],
+    [(sec(20), "a", 4), (sec(21), "b", 1)],
+    [(sec(50), "a", 3), (sec(51), "d", 9)],
+]
+
+
+def _write_feed(session, in_dir, i):
+    rows = _FEEDS[i]
+    session.createDataFrame({
+        "ts": np.array([r[0] for r in rows], "datetime64[us]"),
+        "k": [r[1] for r in rows],
+        "v": np.array([r[2] for r in rows], np.int64),
+    }).write.parquet(os.path.join(in_dir, f"f{i}"))
+
+
+def _lifetime(session, in_dir, ckpt, out):
+    """One worker lifetime: fresh execution over the shared checkpoint,
+    drain everything currently available, stop."""
+    from spark_tpu.sql.dataframe import DataFrame
+    from spark_tpu.streaming.core import (
+        FileSink, FileStreamSource, StreamExecution, StreamingRelation,
+    )
+    src = FileStreamSource("parquet", in_dir, _SCHEMA,
+                           {"maxfilespertrigger": "1"})
+    df = (DataFrame(session, StreamingRelation(src))
+          .withWatermark("ts", "5 seconds")
+          .groupBy(F.window("ts", "10 seconds").alias("w"))
+          .agg(F.sum("v").alias("s")))
+    ex = StreamExecution(session, df._plan, FileSink("json", out, {}),
+                         "append", ckpt, 0.1, None)
+    try:
+        ex.process_all_available()
+        assert ex.exception is None, ex.exception
+    finally:
+        ex.stop()
+    return ex
+
+
+def _sink_files(out):
+    return {os.path.basename(p): open(p, "rb").read()
+            for p in sorted(glob.glob(os.path.join(out, "part-*")))}
+
+
+def test_rolling_restart_resumes_byte_identical(spark, tmp_path):
+    """Stop every worker and bring up fresh ones over the same
+    checkpoint: the state the block service holds registered ownership
+    of carries the query across the restart, and the resumed sink is
+    BYTE-identical to an uninterrupted oracle.  Along the way the
+    ownership protocol is observable: register at construction (a key
+    derived from the checkpoint PATH, stable across lifetimes), a live
+    lease while running, explicit release on stop."""
+    prev = getattr(spark, "_crossproc_svc", None)
+    ms = spark.metricsSystem
+    xs = spark.newSession()
+    xs.conf.set("spark.tpu.mesh.shards", "1")
+    xs.conf.set(C.BLOCKSERVER_ENABLED.key, "true")
+    try:
+        svc = xs.enableHostShuffle(str(tmp_path / "shuf"), process_id=0,
+                                   n_processes=1, timeout_s=10.0)
+        store = svc.blockclient.store
+
+        in_all = str(tmp_path / "in_all")
+        for i in range(len(_FEEDS)):
+            _write_feed(xs, in_all, i)
+        oracle_out = str(tmp_path / "oracle_out")
+        _lifetime(xs, in_all, str(tmp_path / "oracle_ckpt"), oracle_out)
+        oracle = _sink_files(oracle_out)
+        assert oracle, "the oracle run must emit something to compare"
+
+        # lifetime 1: only the first two feeds exist yet
+        in_dir = str(tmp_path / "in")
+        ckpt, out = str(tmp_path / "ckpt"), str(tmp_path / "out")
+        for i in range(2):
+            _write_feed(xs, in_dir, i)
+        ex1 = _lifetime(xs, in_dir, ckpt, out)
+        key = ex1._ck_owner
+        assert key and key.startswith("stream-")
+        rec = store.state_record(key)
+        assert rec is not None
+        assert rec["path"] == os.path.abspath(ckpt)
+        # stop() released ownership: the lease is gone, the record
+        # (and the checkpoint itself) stay for the reaper's TTL clock
+        assert not os.path.exists(store._lease_path(key))
+
+        # the restarted worker: same checkpoint, the remaining feed
+        _write_feed(xs, in_dir, 2)
+        ex2 = _lifetime(xs, in_dir, ckpt, out)
+        # the checkpoint-path-derived key re-registered the SAME record
+        assert ex2._ck_owner == key
+        assert store.state_record(key) is not None
+        assert _sink_files(out) == oracle
+    finally:
+        xs._crossproc_svc = None
+        spark._crossproc_svc = prev
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]

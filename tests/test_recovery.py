@@ -259,6 +259,8 @@ def test_chaos_matrix_covers_every_fault_kind_and_phase():
     assert cm.by_name("mid-fetch-kill-noretry")["tier"] == "tier1"
     # worker loss over partially-spilled grace state stays tier-1 too
     assert cm.by_name("grace-kill")["tier"] == "tier1"
+    # kill-after-register adoption (zero re-execution) stays tier-1
+    assert cm.by_name("blockserver-adopt-zero-rerun")["tier"] == "tier1"
 
 
 # ---------------------------------------------------------------------------
@@ -303,6 +305,27 @@ def test_kill_during_grace_recovers_oracle_exact(tmp_path):
     grace = int(line.rsplit("grace=", 1)[1])
     assert grace > 0, out0
     assert "dying after manifest in 'xq000001-jR'" in results[1][1]
+
+
+def test_kill_after_register_adopts_with_zero_rerun(tmp_path):
+    """The block-service acceptance: worker 1's jR map output is
+    REGISTERED with the block service at manifest-commit time; the
+    worker then loses the shipped block from the raw exchange dir and
+    dies after its last manifest.  Worker 0 — with the stage-retry
+    budget forced to ZERO, so any recovery attempt would fail the
+    query — still lands the exact oracle by adopting the dead worker's
+    registered blocks: zero re-executed map tasks, zero recovery
+    epochs (the worker asserts both, plus nonzero adoption counters,
+    before printing OK)."""
+    sc = cm.by_name("blockserver-adopt-zero-rerun")
+    results, elapsed = cm.run_scenario(sc, str(tmp_path / "shuf"))
+    bad = cm.check(sc, results, elapsed)
+    assert not bad, (bad, results)
+    out0 = results[0][1]
+    line = [ln for ln in out0.splitlines() if "[p0] OK" in ln][-1]
+    assert "retries=0" in line, out0
+    assert "fallback=0" not in line, out0        # the adopted-read path ran
+    assert "dying after manifest in 'xq000001-gather'" in results[1][1]
 
 
 def test_kill_mid_fetch_without_budget_aborts_bounded(tmp_path):
