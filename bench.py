@@ -179,6 +179,7 @@ def _run_tpu_probes() -> None:
     t_end = time.time() + budget
     for script, out_name in [("tools/prof_agg2.py", "TPU_PROFILE_LATEST.txt"),
                              ("tools/prof_join.py", "TPU_JOIN_PROFILE_LATEST.txt"),
+                             ("tools/prof_ici.py", "TPU_ICI_PROFILE_LATEST.txt"),
                              ("tools/bisect_q3.py", "TPU_BISECT_LATEST.txt")]:
         left = t_end - time.time()
         if left < 60:
@@ -776,6 +777,210 @@ def distjoin_worker_main() -> None:
             "shuffled_joins": int(svc.counters["shuffled_joins"]),
         }
     print(json.dumps(out))
+    sys.stdout.flush()
+
+
+def _bench_dist_ici() -> dict:
+    """Distici lane: the two-tier exchange (ICI device tier over the
+    host/DCN wire tier).
+
+    Phase one, 2 REAL worker processes (``--distici-worker``): the
+    dict-free distjoin workload runs with the device tier armed (one
+    ICI domain spanning both pids, zero byte floor) and then disarmed
+    on a fresh root.  jax CPU backends cannot span two OS processes, so
+    every armed attempt must fold back structured onto the host tier —
+    the lane pins that ladder: fallbacks counted in tiered mode, zero
+    in host mode, aggregates byte-identical, and the fallback overhead
+    (pack + probe per exchange) measured as a wall-clock ratio.
+
+    Phase two, one forced 4-device CPU mesh (``--distici-mesh``): the
+    SAME pack/collective/unpack that ships HBM→HBM moves real bucketed
+    spans device-to-device and is timed against the host wire plane
+    (encode + decode of identical outboxes) — the structural number the
+    tier exists for, portable to a TPU window unchanged."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="spark_tpu_bench_di_")
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("SPARK_TPU_FAULT_PLAN", None)
+        env.pop("SPARK_TPU_PLATFORM", None)
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--distici-worker", str(pid), d],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for pid in (0, 1)]
+        outs = [p.communicate(timeout=CHILD_TIMEOUT_S) for p in procs]
+        objs = []
+        for p, (out, err) in zip(procs, outs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"distici worker rc={p.returncode}: "
+                    f"{(err or out).strip().splitlines()[-3:]}")
+            line = [ln for ln in out.splitlines()
+                    if ln.strip().startswith("{")][-1]
+            objs.append(json.loads(line))
+        sums = {o[m]["checksum"] for o in objs for m in ("tiered",
+                                                         "host")}
+        if len(sums) != 1:
+            raise RuntimeError(f"tiered/host results diverge: {objs}")
+        if not all(o["tiered"]["dcn_fallbacks"] > 0 for o in objs):
+            raise RuntimeError(f"armed tier never attempted: {objs}")
+        if any(o["host"]["dcn_fallbacks"] > 0 for o in objs):
+            raise RuntimeError(f"disarmed tier attempted: {objs}")
+        ti_s = max(o["tiered"]["seconds"] for o in objs)
+        ho_s = max(o["host"]["seconds"] for o in objs)
+        res = {
+            "distici_fallback_rows_per_sec": round(
+                objs[0]["rows_total"] / ti_s, 1),
+            "distici_host_rows_per_sec": round(
+                objs[0]["rows_total"] / ho_s, 1),
+            # armed-but-degraded vs never-armed: the price of probing
+            # the device tier when it cannot serve (should stay ~1.0)
+            "distici_fallback_overhead": round(ti_s / ho_s, 3),
+            "distici_dcn_fallbacks": sum(
+                o["tiered"]["dcn_fallbacks"] for o in objs),
+        }
+        mesh_env = dict(env,
+                        XLA_FLAGS="--xla_force_host_platform_device_count=4")
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--distici-mesh"],
+            capture_output=True, text=True, env=mesh_env,
+            timeout=CHILD_TIMEOUT_S)
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"distici mesh rc={p.returncode}: "
+                f"{(p.stderr or p.stdout).strip().splitlines()[-3:]}")
+        line = [ln for ln in p.stdout.splitlines()
+                if ln.strip().startswith("{")][-1]
+        res.update(json.loads(line))
+        return res
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def distici_worker_main() -> None:
+    """One process of the distici lane's 2-process phase (see
+    ``_bench_dist_ici``).
+
+    argv: --distici-worker <pid> <root>.  Prints ONE JSON line with
+    warm wall-clock and tier counters for the armed (tiered) and
+    disarmed (host) modes."""
+    i = sys.argv.index("--distici-worker")
+    pid, root = int(sys.argv[i + 1]), sys.argv[i + 2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from spark_tpu import config as C
+    from spark_tpu.sql.session import SparkSession
+
+    rng = np.random.default_rng(47)
+    sk = rng.integers(0, DJ_KEYS, DJ_ROWS).astype(np.int64)
+    k2 = rng.integers(0, DJ_KEYS, DJ_ROWS).astype(np.int64)
+    bonus = rng.integers(1, 101, DJ_ROWS).astype(np.int64)
+    mine = slice(pid, None, 2)
+    # projected int-only sides: the shape the device tier accepts (a
+    # dictionary column would pin the exchange to the host tier)
+    Q = ("SELECT sk, count(*) AS c, sum(bonus) AS sb "
+         "FROM (SELECT sk FROM fact) f "
+         "JOIN (SELECT k2, bonus FROM fact2) f2 ON sk = k2 "
+         "GROUP BY sk")
+
+    session = SparkSession.builder.appName(f"bench-di-{pid}").getOrCreate()
+    out = {"pid": pid, "rows_total": int(2 * DJ_ROWS)}
+    for mode in ("tiered", "host"):
+        xs = session.newSession()
+        xs.conf.set(C.MESH_SHARDS.key, "1")
+        xs.conf.set(C.CROSSPROC_SHUFFLED_JOIN.key, "true")
+        xs.conf.set(C.CROSSPROC_SORT_MERGE_JOIN.key, "false")
+        xs.conf.set(C.CROSSPROC_AUTO_BROADCAST.key, "0")
+        if mode == "tiered":
+            xs.conf.set(C.SHUFFLE_ICI_ENABLED.key, "true")
+            xs.conf.set(C.SHUFFLE_ICI_MIN_BYTES.key, "0")
+            xs.conf.set(C.SHUFFLE_ICI_TIER_OVERRIDE.key, "0,1")
+        svc = xs.enableHostShuffle(os.path.join(root, mode),
+                                   process_id=pid, n_processes=2,
+                                   timeout_s=300.0)
+        xs.createDataFrame({"sk": sk[mine]}) \
+            .createOrReplaceTempView("fact")
+        xs.createDataFrame({"k2": k2[mine], "bonus": bonus[mine]}) \
+            .createOrReplaceTempView("fact2")
+        xs.sql(Q).collect()                  # warm: compile + caches
+        base_fb = int(svc.counters["dcn_fallback_exchanges"])
+        t0 = time.perf_counter()
+        rows = xs.sql(Q).collect()
+        elapsed = time.perf_counter() - t0
+        out[mode] = {
+            "seconds": round(elapsed, 3),
+            "dcn_fallbacks": int(svc.counters["dcn_fallback_exchanges"])
+            - base_fb,
+            "ici_exchanges": int(svc.counters["ici_exchanges"]),
+            "checksum": int(sum(int(r[1]) * 7 + int(r[2]) for r in rows)),
+        }
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
+def distici_mesh_main() -> None:
+    """The distici lane's forced-mesh phase: device all-to-all vs the
+    host wire plane over identical bucketed spans.
+
+    argv: --distici-mesh (XLA_FLAGS forces a 4-device CPU world).
+    Prints ONE JSON line: MB/s through ``local_device_exchange`` (pack
+    + collective + unpack, warm stage cache) and through wire encode +
+    decode of the same outboxes, plus the ratio."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from spark_tpu import types as T
+    from spark_tpu import wire
+    from spark_tpu.columnar import ColumnBatch, ColumnVector
+    from spark_tpu.parallel import ici
+
+    n = 4
+    per = 1 << 13                        # rows per sender→receiver span
+    rng = np.random.default_rng(53)
+
+    def batch(m):
+        vals = rng.integers(-(1 << 40), 1 << 40, m)
+        return ColumnBatch(
+            ["k"], [ColumnVector(vals, T.LongType(), None, None)],
+            None, m)
+
+    outboxes = [{r: [batch(per)] for r in range(n)} for _s in range(n)]
+    tpl = batch(1)
+    total = sum(wire.raw_nbytes(bs) for ob in outboxes
+                for bs in ob.values())
+
+    ici.local_device_exchange(outboxes, tpl)       # warm: trace+compile
+    t0 = time.perf_counter()
+    for _ in range(BENCH_RUNS):
+        ici.local_device_exchange(outboxes, tpl)
+    dev_s = (time.perf_counter() - t0) / BENCH_RUNS
+
+    def wire_pass():
+        for ob in outboxes:
+            for bs in ob.values():
+                wire.decode_batches(wire.encode_batches(bs))
+
+    wire_pass()                                    # warm codec paths
+    t0 = time.perf_counter()
+    for _ in range(BENCH_RUNS):
+        wire_pass()
+    host_s = (time.perf_counter() - t0) / BENCH_RUNS
+
+    print(json.dumps({
+        "distici_mesh_device_mb_per_s": round(total / dev_s / 1e6, 1),
+        "distici_mesh_wire_mb_per_s": round(total / host_s / 1e6, 1),
+        "distici_mesh_device_vs_wire": round(host_s / dev_s, 3),
+        "distici_mesh_bytes": int(total),
+    }))
     sys.stdout.flush()
 
 
@@ -2050,6 +2255,14 @@ def child_main() -> None:
         print(f"[bench-child] distgrace bench failed: {e}", file=sys.stderr)
         extras["distgrace_error"] = str(e)[:300]
     try:
+        # two-tier exchange: armed-vs-disarmed device tier across 2 real
+        # processes (structured fallback ladder), plus the forced-mesh
+        # device-vs-wire data-plane comparison
+        extras.update(_bench_dist_ici())
+    except Exception as e:   # secondary must not sink the primary
+        print(f"[bench-child] distici bench failed: {e}", file=sys.stderr)
+        extras["distici_error"] = str(e)[:300]
+    try:
         # whole-stage compilation: 2 real worker processes, fused vs
         # per-operator dispatch and cold vs warm stage-executable cache
         extras.update(_bench_stagecache())
@@ -2099,6 +2312,10 @@ if __name__ == "__main__":
         distspill_worker_main()
     elif "--distgrace-worker" in sys.argv:
         distgrace_worker_main()
+    elif "--distici-worker" in sys.argv:
+        distici_worker_main()
+    elif "--distici-mesh" in sys.argv:
+        distici_mesh_main()
     elif "--stagecache-worker" in sys.argv:
         stagecache_worker_main()
     elif "--servebench-worker" in sys.argv:

@@ -62,6 +62,9 @@ DECISION_ROOTS = frozenset({
     # hostshuffle: reducer assignment, ownership, recovery adoption
     "plan_reducers", "plan_range_reducers", "skew_spans",
     "group_owner", "live_pids", "recover_round",
+    # ici: the tier split every replica must agree on before any
+    # device collective (its fingerprint rides decision_inputs)
+    "probe_topology",
 })
 
 

@@ -511,6 +511,35 @@ RECOVERY_MAX_STAGE_RETRIES = conf("spark.tpu.recovery.maxStageRetries").doc(
     "every exhausted fetch aborts the statement bounded."
 ).check(lambda v: v >= 0).int(1)
 
+SHUFFLE_ICI_ENABLED = conf("spark.tpu.shuffle.ici.enabled").doc(
+    "Two-tier exchange: ship bucketed join columns HBM→HBM over ICI "
+    "(device collective under shard_map; Pallas remote-DMA ring on TPU) "
+    "between peers the topology probe places in one ICI domain, keeping "
+    "the wire-format host shuffle as the cross-pod DCN tier and the "
+    "fault-tolerant fallback.  ALL control-plane rounds ({xid}-plan "
+    "manifests, adaptive stats, decision traces, recovery agreement) "
+    "stay on the host path regardless; any device-tier failure folds "
+    "the spans back onto the host tier, counted, never partial."
+).boolean(False)
+
+SHUFFLE_ICI_MIN_BYTES = conf("spark.tpu.shuffle.ici.minBytes").doc(
+    "Smallest AGREED side byte total (summed over the gathered plan-"
+    "round manifests, so every replica derives the same verdict) that "
+    "takes the ICI device tier; smaller sides stay on the host path "
+    "where the fixed collective cost would dominate.  The gate reads "
+    "shared manifest totals, never local sizes — asymmetric tier "
+    "participation would hang a device collective."
+).check(lambda v: v >= 0).int(1 << 16)
+
+SHUFFLE_ICI_TIER_OVERRIDE = conf("spark.tpu.shuffle.ici.tierOverride").doc(
+    "Manual ICI domain map overriding the topology probe: pipe-"
+    "separated comma groups of process ids ('0,1|2,3' = two 2-chip "
+    "pods).  Pids left unmentioned form singleton (host-tier-only) "
+    "domains.  Empty = probe the jax world (peers sharing a TPU slice "
+    "in a multi-controller world share a domain; anything else — "
+    "including CPU — yields singleton domains and the host tier)."
+).string("")
+
 BLOCKSERVER_ENABLED = conf("spark.tpu.blockserver.enabled").doc(
     "Disaggregated block service (the external-shuffle-service analog): "
     "the shuffle service hard-links every committed map output, spill "

@@ -96,6 +96,7 @@ from ..memory import HostMemoryError, HostMemoryPressure
 from ..sql import physical as P
 from .. import types as T
 from .. import wire
+from . import ici
 from .hostshuffle import ExchangeFetchFailed, FetchSink, HostShuffleService
 
 __all__ = ["host_exchange_group_agg", "crossproc_execute",
@@ -604,6 +605,105 @@ def _exchange_spilled_with_refetch(svc: HostShuffleService, xid: str,
         return svc.refetch_spilled(xid, spill_path, routed, sink=sink)
 
 
+def _ici_tier(session, svc: HostShuffleService):
+    """Read the device-tier confs and build the agreed tier split for
+    this exchange plan.  Returns ``(tier, min_bytes)`` — ``tier`` is
+    None when the device tier is off or the probe leaves this process
+    without intra-domain peers (singleton domains everywhere on CPU).
+    The split's fingerprint rides ``decision_inputs`` into the
+    decision-trace hash, so replicas that would disagree about WHO
+    shares an ICI domain abort structured at the plan round instead of
+    hanging a device collective."""
+    from .. import config as C
+    enabled = session.conf.get(C.SHUFFLE_ICI_ENABLED)
+    min_bytes = session.conf.get(C.SHUFFLE_ICI_MIN_BYTES)
+    override = session.conf.get(C.SHUFFLE_ICI_TIER_OVERRIDE)
+    if not enabled:
+        return None, 0
+    tier = ici.probe_topology(override, svc.pid, svc.n, svc.live_pids())
+    with svc._lock:
+        svc.counters["tier_split_peers"] = len(tier.peers())
+    return (tier if tier.peers() else None), int(min_bytes)
+
+
+def _tiered_exchange_with_refetch(svc: HostShuffleService, session, plan,
+                                  xid: str,
+                                  routed: Dict[int, List[ColumnBatch]],
+                                  sink, template) -> List[ColumnBatch]:
+    """``_exchange_with_refetch`` with the ICI device tier in front:
+    when the replica-agreed ``plan`` is active, intra-domain spans ship
+    HBM→HBM (landing in the sink keyed by sender, where they merge into
+    the canonical own-first sorted-sender order) and only cross-domain
+    spans — plus the commit barrier every peer still meets — ride the
+    host path.  Removing a span from the host routed dict is protocol-
+    safe: a receiver with no part for it reads the part as empty, which
+    is exactly what the sink-injected device delivery replaces.  Any
+    device-tier failure folds EVERYTHING back onto the host tier,
+    counted — the fallback re-ships the full routed dict, so no row is
+    ever lost to a half-taken tier."""
+    if plan is None or not plan.active \
+            or not ici.schema_eligible(template):
+        return _exchange_with_refetch(svc, xid, routed, sink=sink)
+    dev = {r: bs for r, bs in routed.items()
+           if r != svc.pid and plan.tier.same_domain(r)}
+    try:
+        # participation is unconditional once the plan is active — a
+        # member with nothing to send still joins the collective (it
+        # may have everything to RECEIVE, and a device all-to-all is
+        # symmetric or it is a hang)
+        inbox = ici.device_exchange(svc, session, plan, xid, dev,
+                                    template)
+    except ici.IciUnavailable:
+        with svc._lock:
+            svc.counters["dcn_fallback_exchanges"] += 1
+        return _exchange_with_refetch(svc, xid, routed, sink=sink)
+    for sender in sorted(inbox):
+        sink.add(sender, inbox[sender])
+    host_routed = {r: bs for r, bs in routed.items() if r not in dev}
+    return _exchange_with_refetch(svc, xid, host_routed, sink=sink)
+
+
+def _tiered_exchange_spilled_with_refetch(svc: HostShuffleService, session,
+                                          plan, xid: str, spill_path: str,
+                                          routed: Dict[int, list],
+                                          meta: Dict[int, Tuple[int, int]],
+                                          sink, template
+                                          ) -> List[ColumnBatch]:
+    """The spilled-side face of ``_tiered_exchange_with_refetch``: a
+    locally-spilled side still participates in an ACTIVE device
+    collective (activation is agreed from manifests; whether one
+    replica spilled is not, and a no-show would hang its domain).  Its
+    intra-domain spans rematerialize through the same per-exchange
+    decode the skew-split path already uses, ship on-device, and drop
+    from the host publication; cross-domain spans ship as byte spans
+    untouched."""
+    if plan is None or not plan.active \
+            or not ici.schema_eligible(template):
+        return _exchange_spilled_with_refetch(svc, xid, spill_path,
+                                              routed, meta, sink=sink)
+    dev: Dict[int, List[ColumnBatch]] = {}
+    try:
+        for r in sorted(routed):
+            if r == svc.pid or not plan.tier.same_domain(r):
+                continue
+            dev[r] = svc.decode_spilled(xid, spill_path, routed[r])
+        inbox = ici.device_exchange(svc, session, plan, xid, dev,
+                                    template)
+    except ici.IciUnavailable:
+        with svc._lock:
+            svc.counters["dcn_fallback_exchanges"] += 1
+        return _exchange_spilled_with_refetch(svc, xid, spill_path,
+                                              routed, meta, sink=sink)
+    for sender in sorted(inbox):
+        sink.add(sender, inbox[sender])
+    host_routed = {r: parts for r, parts in routed.items()
+                   if r not in dev}
+    host_meta = {r: m for r, m in meta.items() if r not in dev}
+    return _exchange_spilled_with_refetch(svc, xid, spill_path,
+                                          host_routed, host_meta,
+                                          sink=sink)
+
+
 def _exchange_spill_dir(session, xid: str) -> str:
     """A fresh per-query directory for exchange spill files (map-side
     partition frames, reduce-side fetch runs), under the same root the
@@ -779,6 +879,7 @@ def _shuffled_join_shards(session, join, key_pairs,
 
     n_fine = svc.n * session.conf.get(C.SHUFFLE_FINE_PARTITIONS)
     target = session.conf.get(C.SHUFFLE_TARGET_PARTITION_BYTES)
+    tier, ici_min_bytes = _ici_tier(session, svc)
     sdir = _exchange_spill_dir(session, xid)
     try:
         # per side: local run -> key hash -> fine bucketing -> host
@@ -836,7 +937,7 @@ def _shuffled_join_shards(session, join, key_pairs,
         # alone a data block shipped.
         from ..analysis import runtime as _az
         checks = _az.runtime_checks_enabled(session)
-        dt_in = decision_inputs(svc, "hash")
+        dt_in = decision_inputs(svc, "hash", tier=tier)
         svc.publish_sizes(f"{xid}-plan", sizes,
                           extra={"sides": side_obs,
                                  "dtrace": {"h": _az.decision_trace(dt_in),
@@ -867,6 +968,13 @@ def _shuffled_join_shards(session, join, key_pairs,
                 local={"frozen": "hash", "n_live": n_live,
                        "width": width, "target": target})
         bounds = svc.plan_reducers(totals, target, n_max=width)
+        # device-tier activation per side, from AGREED manifest totals
+        # only (a locally-gated collective is a hang).  max_runs covers
+        # the spilled shape too: a spilled side's contiguous range
+        # decodes to one run per non-empty fine partition.
+        ici_plans = {s: ici.plan_side(tier, mans, s, ici_min_bytes,
+                                      max_runs=n_fine)
+                     for s in ("l", "r")}
 
         # hash confirmed: NOW bucket each side into host slices and
         # stage them in RAM (ledger-reserved) or a spill file
@@ -896,6 +1004,7 @@ def _shuffled_join_shards(session, join, key_pairs,
                 # process (group_owner) — after a recovery epoch the
                 # owner list skips agreed-lost pids, so no block is ever
                 # addressed to a dead receiver
+                plan = ici_plans["l" if i == 0 else "r"]
                 if side.kind == "mem":
                     routed: Dict[int, List[ColumnBatch]] = {}
                     for g, (lo, hi) in enumerate(zip(bounds,
@@ -905,10 +1014,11 @@ def _shuffled_join_shards(session, join, key_pairs,
                             routed[svc.group_owner(g)] = [slice_rows(
                                 side.bucketed, int(side.off[lo]),
                                 n_rows)]
-                    exchange = (lambda routed=routed:
-                                _exchange_with_refetch(
-                                    svc, f"{xid}-{tag}", routed,
-                                    sink=sink))
+                    exchange = (lambda routed=routed, plan=plan,
+                                side=side:
+                                _tiered_exchange_with_refetch(
+                                    svc, session, plan, f"{xid}-{tag}",
+                                    routed, sink, side.dead))
                 else:
                     # ship straight from the spill file: a reducer's
                     # contiguous fine range is one contiguous byte span
@@ -924,10 +1034,11 @@ def _shuffled_join_shards(session, join, key_pairs,
                             meta[owner] = (int(side.raw[lo:hi].sum()),
                                            int(side.rows[lo:hi].sum()))
                     exchange = (lambda parts_routed=parts_routed,
-                                meta=meta:
-                                _exchange_spilled_with_refetch(
-                                    svc, f"{xid}-{tag}", side.path,
-                                    parts_routed, meta, sink=sink))
+                                meta=meta, plan=plan, side=side:
+                                _tiered_exchange_spilled_with_refetch(
+                                    svc, session, plan, f"{xid}-{tag}",
+                                    side.path, parts_routed, meta,
+                                    sink, side.dead))
                 try:
                     received = exchange()
                 except HostMemoryPressure:
@@ -1226,7 +1337,7 @@ def _elastic_width(svc: HostShuffleService, session, join,
 
 
 def decision_inputs(svc: HostShuffleService, frozen: str, cuts=None,
-                    est_splits=None) -> Dict[str, object]:
+                    est_splits=None, tier=None) -> Dict[str, object]:
     """The replicated pre-round decision components one process derived
     INDEPENDENTLY before publishing its ``{xid}-plan`` manifest: the
     frozen plan-time strategy, the recovery epoch, the live set, the
@@ -1246,6 +1357,11 @@ def decision_inputs(svc: HostShuffleService, frozen: str, cuts=None,
         d["cuts"] = [str(c) for c in cuts]
     if est_splits is not None:
         d["splits"] = sorted(int(p) for p in est_splits)
+    if tier is not None:
+        # the ICI tier split: replicas that disagree about who shares a
+        # device domain must abort here, at the plan barrier — an
+        # asymmetric device collective would hang, not fail
+        d["tier"] = tier.fingerprint()
     return d
 
 
@@ -1704,6 +1820,7 @@ def _range_merge_join_shards(session, join, spec,
     n_fine = svc.n * session.conf.get(C.SHUFFLE_FINE_PARTITIONS)
     target = session.conf.get(C.SHUFFLE_TARGET_PARTITION_BYTES)
     sample_k = session.conf.get(C.SHUFFLE_RANGE_SAMPLE_SIZE)
+    tier, ici_min_bytes = _ici_tier(session, svc)
 
     # 1. local runs + monotonic key encodings.  String keys encode as
     # dictionary CODES — monotone in the words locally (sorted
@@ -1824,7 +1941,7 @@ def _range_merge_join_shards(session, join, spec,
             if est_span_w is not None else set()
         dt_in = decision_inputs(svc, "range",
                                 cuts=svc.last_range_cutpoints,
-                                est_splits=est_split)
+                                est_splits=est_split, tier=tier)
         svc.publish_sizes(f"{xid}-plan", sizes,
                           extra={"sides": side_obs,
                                  "dtrace": {"h": _az.decision_trace(dt_in),
@@ -1867,6 +1984,12 @@ def _range_merge_join_shards(session, join, spec,
         if checks:
             _az.verify_span_owners(join, owners, n_spans, svc.n)
             _az.verify_skew_split(join, owners)
+        # device-tier activation per side (agreed inputs only); every
+        # span is a presorted run and ships as one — max_runs bounds
+        # the runs any receiver can get at one per span
+        ici_plans = {s: ici.plan_side(tier, mans, s, ici_min_bytes,
+                                      max_runs=n_spans)
+                     for s in ("l", "r")}
 
         # 4a. probe side: a split span's sorted slice chops into
         # contiguous sub-runs, one per owner; build side: each span
@@ -1945,16 +2068,18 @@ def _range_merge_join_shards(session, join, spec,
                                  exch, sdir)
                 sinks.append(sink)
                 sink.defer_drain = grace_from is not None
+                plan = ici_plans["l" if not is_build else "r"]
                 try:
                     if side.kind == "mem":
-                        received = _exchange_with_refetch(
-                            svc, exch, route(side, is_build), sink=sink)
+                        received = _tiered_exchange_with_refetch(
+                            svc, session, plan, exch,
+                            route(side, is_build), sink, side.dead)
                     else:
                         parts_routed, meta = route_spilled(side, exch,
                                                            is_build)
-                        received = _exchange_spilled_with_refetch(
-                            svc, exch, side.path, parts_routed, meta,
-                            sink=sink)
+                        received = _tiered_exchange_spilled_with_refetch(
+                            svc, session, plan, exch, side.path,
+                            parts_routed, meta, sink, side.dead)
                 except HostMemoryPressure:
                     # drain failed with the sink intact: grace takes
                     # over (spill-disk exhaustion still aborts bounded

@@ -66,6 +66,17 @@ side; rules fire when a matching block is published:
                 reads must fall back peer-direct and recovery must stay
                 r12-shaped, never a hang; ``heal_after_s`` brings the
                 service back on a timer.
+- ``ici_unavailable``  the ICI device-exchange tier (``ici.py``) fails
+                structured at the attempt point for the addressed
+                exchange — the kernel-unavailable / driver-error case;
+                the lane must fold the spans back onto the host tier
+                (``dcn_fallback_exchanges``) with byte-identical
+                results, never a hang, never partial rows.
+- ``die_mid_device_copy``  the PROCESS exits hard at the device tier's
+                copy point — after packing, the moment the DMA would
+                start.  Peers see the death at the host commit barrier
+                (the device tier adds no barrier of its own) and take
+                the ordinary refetch → r12 recovery ladder.
 
 Rules are matched by (exchange, receiver) for this service's own writes;
 healing is driven by daemon timers (wall-clock, generous vs CI retry
@@ -90,7 +101,8 @@ FAULT_PLAN_ENV = "SPARK_TPU_FAULT_PLAN"
 _KINDS = ("drop", "truncate", "corrupt", "delay", "skip_commit",
           "die_after_put", "die_after_manifest", "disk_full",
           "skew_decision", "torn_checkpoint", "die_after_state_commit",
-          "die_during_register", "blockserver_unavailable")
+          "die_during_register", "blockserver_unavailable",
+          "ici_unavailable", "die_mid_device_copy")
 
 
 class _Rule:
@@ -256,6 +268,24 @@ class FaultPlan:
                                 once=False, heal_after_s=heal_after_s))
         return self
 
+    def ici_unavailable(self, exchange: Optional[str] = None,
+                        once: bool = True) -> "FaultPlan":
+        """The device-exchange tier raises ``IciUnavailable`` at its
+        attempt point for the addressed exchange (None = every device
+        attempt): the structured kernel-unavailable failure the host-
+        tier fallback ladder exists for."""
+        self.rules.append(_Rule("ici_unavailable", exchange, None, once))
+        return self
+
+    def die_mid_device_copy(self, exchange: Optional[str] = None
+                            ) -> "FaultPlan":
+        """Exit hard at the device tier's copy point — spans packed,
+        DMA about to start.  Survivors must observe an ordinary peer
+        death at the host commit barrier, never a wedged collective."""
+        self.rules.append(_Rule("die_mid_device_copy", exchange, None,
+                                once=True))
+        return self
+
     # -- env transport ---------------------------------------------------
     def to_env(self) -> str:
         return json.dumps([r.to_dict() for r in self.rules])
@@ -419,6 +449,29 @@ class FaultInjector:
                             sides[rule.side] = [1, 1]
             return totals, mans
 
+        def ici_fault(exchange, point):
+            # consulted by ici.device_exchange at its fault points:
+            # "attempt" (before any device work) and "copy" (spans
+            # packed, DMA about to start)
+            for rule in injector.plan.rules:
+                if rule.kind == "ici_unavailable" and point == "attempt" \
+                        and rule.matches(exchange, None):
+                    rule.fired += 1
+                    injector.injected.append(f"ici_unavailable:{exchange}")
+                    from .ici import IciUnavailable
+                    raise IciUnavailable(
+                        f"injected: device tier unavailable for "
+                        f"{exchange!r}")
+                if rule.kind == "die_mid_device_copy" and point == "copy" \
+                        and rule.matches(exchange, None):
+                    rule.fired += 1
+                    injector.injected.append(
+                        f"die_mid_device_copy:{exchange}")
+                    print(f"[faults] dying mid device copy in "
+                          f"{exchange!r}", flush=True)
+                    injector.die(43)
+
+        svc._ici_fault = ici_fault
         svc.put = put
         svc.commit = commit
         if orig_publish is not None:

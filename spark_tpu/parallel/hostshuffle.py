@@ -567,6 +567,13 @@ class HostShuffleService:
             "manifests_adopted": 0, "blocks_adopted": 0,
             "blockserver_fallback_reads": 0, "blockserver_unavailable": 0,
             "orphaned_blocks_reclaimed": 0,
+            # two-tier exchange: sides that shipped HBM→HBM over the
+            # ICI device tier (and the raw bytes they moved), device
+            # attempts that folded back onto the host/DCN tier, and the
+            # intra-domain peer count the topology probe agreed on for
+            # the most recent tier split
+            "ici_exchanges": 0, "ici_bytes_moved": 0,
+            "dcn_fallback_exchanges": 0, "tier_split_peers": 0,
         }
         #: reduce-partition byte sizes of the most recent ``plan_reducers``
         #: / ``plan_range_reducers`` call (manifest-summed), feeding the

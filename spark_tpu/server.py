@@ -216,6 +216,23 @@ class SQLServer:
                          "grace_salted_resplits", "reducers_elastic")}
         return out if any(out.values()) else {}
 
+    # -- exchange-tier visibility ----------------------------------------
+    @staticmethod
+    def _ici_stats(session) -> Dict[str, int]:
+        """One session's cumulative ICI device-tier activity (sides
+        shipped HBM→HBM, raw bytes moved, device attempts folded back
+        onto the host/DCN tier, agreed intra-domain peer count); empty
+        when host shuffle is off or the device tier never engaged."""
+        svc = getattr(session, "_crossproc_svc", None)
+        counters = getattr(svc, "counters", None) if svc is not None \
+            else None
+        if not counters:
+            return {}
+        out = {k: int(counters.get(k, 0))
+               for k in ("ici_exchanges", "ici_bytes_moved",
+                         "dcn_fallback_exchanges", "tier_split_peers")}
+        return out if any(out.values()) else {}
+
     def _grace_total(self) -> int:
         """Cumulative grace-degradation events across every session —
         the admission controller's learned signal that running near the
@@ -661,9 +678,14 @@ class SQLServer:
                        for stream_id, q in ss.streams.items()}
             grace = {sid: g for sid, ss in self._sessions.items()
                      if (g := self._grace_stats(ss.session))}
+            ici = {sid: g for sid, ss in self._sessions.items()
+                   if (g := self._ici_stats(ss.session))}
         default_grace = self._grace_stats(self.session)
         if default_grace:
             grace["default"] = default_grace
+        default_ici = self._ici_stats(self.session)
+        if default_ici:
+            ici["default"] = default_ici
         out = {
             "version": self.session.version,
             "queriesExecuted": getattr(self.session, "_query_count", 0),
@@ -674,6 +696,7 @@ class SQLServer:
             "standingQueries": streams,
             "admission": self._admission.stats(),
             "graceActivity": grace,
+            "iciActivity": ici,
             "metrics": self.session.metricsSystem.snapshots(),
         }
         if self._plan_cache is not None:

@@ -109,6 +109,11 @@ PLANNING_CONF_ENTRIES = (
     C.CROSSPROC_ADAPTIVE_REPLAN,
     # whole-stage fusion toggles the fused-vs-per-op execution shape
     C.STAGE_FUSION,
+    # exchange tiering: which peers (if any) take the ICI device tier,
+    # and the agreed byte floor below which a side stays on the host
+    # path, both feed the tier-split decision the lanes replicate
+    C.SHUFFLE_ICI_ENABLED, C.SHUFFLE_ICI_MIN_BYTES,
+    C.SHUFFLE_ICI_TIER_OVERRIDE,
 )
 
 PLANNING_CONF_KEYS = frozenset(e.key for e in PLANNING_CONF_ENTRIES)

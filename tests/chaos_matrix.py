@@ -37,7 +37,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 #: the exchange phases the matrix must cover (ISSUE contract)
 PHASES = ("map-staging", "post-publish-sizes", "mid-fetch",
           "mid-demotion", "during-recovery", "during-grace",
-          "post-register")
+          "post-register", "mid-device-copy")
 
 
 def _scenario(name, phase, worker, mode, n, timeout_s, plans, expect,
@@ -207,6 +207,27 @@ SCENARIOS = [
          1: lambda: FaultPlan().drop(exchange="xq000001-jR", receiver=0)
             .die_after_manifest("xq000001-jR")},
         {0: "OK", 1: "DIED"}),
+    # -- the ICI device-exchange tier (worker mode ``ici-fault``: tier
+    #    armed over a dict-free join, so every exchange genuinely
+    #    attempts the device path) --
+    # the tier raises IciUnavailable at the attempt point on ONE process
+    # only: both replicas still converge — the faulted one counts a
+    # dcn_fallback and re-ships the full routed set over the host tier,
+    # the clean one merely reaches the same host barrier — oracle-exact
+    _scenario(
+        "ici-unavailable-fallback", "mid-device-copy",
+        "shuffled_join_worker.py", "ici-fault", 2, 8.0,
+        {0: lambda: FaultPlan().ici_unavailable()},
+        {0: "OK", 1: "OK"}),
+    # exit hard at the copy point — spans packed, device transfer about
+    # to start: the survivor must see an ordinary peer death at the host
+    # commit barrier (bounded ExchangeFetchFailed), never a wedged
+    # collective or a partial result
+    _scenario(
+        "ici-die-mid-device-copy", "mid-device-copy",
+        "shuffled_join_worker.py", "ici-fault", 2, 8.0,
+        {0: lambda: FaultPlan().die_mid_device_copy()},
+        {0: "DIED", 1: "FAILED"}),
 ]
 
 

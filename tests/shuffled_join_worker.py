@@ -39,6 +39,21 @@ rule from SPARK_TPU_FAULT_PLAN: the spill write fails with ENOSPC, and
 the query must fail BOUNDED with a structured ``HostMemoryError`` (the
 peer fails bounded on its exchange timeout) — never partial results.
 
+mode "ici": the full parity battery with the ICI device-exchange tier
+ARMED (enabled, minBytes=0, tierOverride placing every pid in one
+domain).  On CPU a cross-process device collective cannot exist
+(single-process jax world), so every device attempt must degrade
+STRUCTURED to the host tier — results byte-identical to the plain
+parity battery, ``dcn_fallback_exchanges`` > 0, ``ici_exchanges`` == 0,
+``tier_split_peers`` == n-1, and the decision-trace checks prove the
+tier split itself agreed on every replica (divergence = 0).
+
+mode "ici-fault": the ICI confs armed plus a FaultInjector plan from
+SPARK_TPU_FAULT_PLAN aimed at the device tier (``ici_unavailable`` at
+the attempt point, or ``die_mid_device_copy`` at the copy point); runs
+ONE hash-lane join with the "fault" mode's contract — ``OK <rows>``
+(oracle-exact) or ``FAILED`` (structured, bounded), never partial.
+
 mode "grace": a host budget CAPPED BELOW the reducers' drained working
 set, so fetching a joined shard raises ``HostMemoryPressure`` and the
 join lanes must degrade into grace buckets (re-bucket the sink by join
@@ -138,6 +153,14 @@ xs.conf.set(C.CROSSPROC_AUTO_BROADCAST.key, "0")
 # the 5x-median test compares against (8/proc would leave the hot span
 # just under threshold on this small table)
 xs.conf.set(C.SHUFFLE_FINE_PARTITIONS.key, "32")
+if mode in ("ici", "ici-fault"):
+    # arm the device tier with every pid in ONE ICI domain and no byte
+    # floor: every eligible exchange must ATTEMPT the device tier, and
+    # on CPU every attempt must fold back onto the host tier structured
+    xs.conf.set(C.SHUFFLE_ICI_ENABLED.key, "true")
+    xs.conf.set(C.SHUFFLE_ICI_MIN_BYTES.key, "0")
+    xs.conf.set(C.SHUFFLE_ICI_TIER_OVERRIDE.key,
+                ",".join(str(p) for p in range(n)))
 # tags has a UNIQUE word per row: each process's slice builds a fully
 # DISJOINT dictionary, so the cross-process string min/max below can only
 # be right if the exchange genuinely unifies the code spaces
@@ -227,12 +250,40 @@ def run(sess, sql):
     return [tuple(r) for r in sess.sql(sql).collect()]
 
 
-if mode in ("fault", "fault-sample"):
+#: dict-free sides (projected to int columns) — the ONLY shape the ICI
+#: device tier accepts: dictionary-coded columns are pinned to the host
+#: tier, where the code-space unification lives.  The unprojected
+#: QUERIES battery above doubles as the dict-code lane: its string
+#: columns keep every exchange on the host path even with the tier
+#: armed, results still byte-identical.
+ICI_QUERIES = [
+    ("ici-inner-agg",
+     "SELECT sk, count(*) AS c, sum(bonus) AS sb "
+     "FROM (SELECT sk FROM fact) f "
+     "JOIN (SELECT k2, bonus FROM fact2) f2 ON sk = k2 "
+     "GROUP BY sk ORDER BY sk"),
+    ("ici-inner-rows",
+     "SELECT sk, price, bonus FROM (SELECT sk, price FROM fact) f "
+     "JOIN (SELECT k2, bonus FROM fact2) f2 ON sk = k2 "
+     "WHERE bonus > 40 ORDER BY sk, price, bonus"),
+    ("ici-left-agg",
+     "SELECT sk, count(bonus) AS cb, count(*) AS c "
+     "FROM (SELECT sk FROM fact) f "
+     "LEFT JOIN (SELECT k2, bonus FROM fact2) f2 ON sk = k2 "
+     "GROUP BY sk ORDER BY sk"),
+]
+
+if mode in ("fault", "fault-sample", "ici-fault"):
     FaultInjector().attach(svc)        # plan comes from SPARK_TPU_FAULT_PLAN
     set_mode("range" if mode == "fault-sample" else "hash")
     join_counter = ("range_merge_joins" if mode == "fault-sample"
                     else "shuffled_joins")
-    name, sql, _ = QUERIES[0]
+    if mode == "ici-fault":
+        # dict-free sides so the device tier genuinely ATTEMPTS (and
+        # the armed fault point actually fires) before degrading
+        name, sql = ICI_QUERIES[0]
+    else:
+        name, sql, _ = QUERIES[0]
     exp = run(oracle, sql)
     t0 = time.time()
     try:
@@ -460,6 +511,38 @@ if mode == "spill":
           f"events={svc.counters['spill_events']} "
           f"peak={gauges['peak_host_bytes']}", flush=True)
     os._exit(0)
+if mode == "ici":
+    # the dict-column battery above kept every exchange on the host
+    # path (the code-space gate) — results byte-identical with the
+    # tier armed.  Now dict-FREE sides, where the device tier must
+    # genuinely attempt every exchange: no CPU process can span the
+    # 2-process domain, so each attempt must fold back structured onto
+    # the host tier and still match the oracle exactly, on BOTH lanes.
+    assert svc.counters["dcn_fallback_exchanges"] == 0, svc.counters
+    for name, sql in ICI_QUERIES:
+        exp = run(oracle, sql)
+        for m, want in (("range", "range_merge_joins"),
+                        ("hash", "shuffled_joins")):
+            set_mode(m)
+            before = dict(svc.counters)
+            got = run(xs, sql)
+            assert svc.counters[want] > before[want], (
+                f"{name}/{m}: expected the {want} path, {svc.counters}")
+            assert svc.counters["dcn_fallback_exchanges"] > \
+                before["dcn_fallback_exchanges"], (
+                f"{name}/{m}: no device-tier attempt, {svc.counters}")
+            if got != exp:
+                print(f"[p{pid}] ICI-PARITY-FAIL {name}/{m} "
+                      f"got={got[:4]} exp={exp[:4]}", flush=True)
+                os._exit(1)
+        print(f"[p{pid}] ICI-PARITY-OK {name} ({len(exp)} rows)",
+              flush=True)
+    assert svc.counters["ici_exchanges"] == 0, svc.counters
+    assert svc.counters["ici_bytes_moved"] == 0, svc.counters
+    assert svc.counters["tier_split_peers"] == n - 1, svc.counters
+    print(f"[p{pid}] ICI-FALLBACK-OK "
+          f"fallbacks={svc.counters['dcn_fallback_exchanges']} "
+          f"peers={svc.counters['tier_split_peers']}", flush=True)
 print(f"[p{pid}] ALL-OK range={svc.counters['range_merge_joins']} "
       f"shuffled={svc.counters['shuffled_joins']} "
       f"fast={svc.counters['fast_path_aggs']} "

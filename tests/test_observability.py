@@ -414,6 +414,74 @@ def test_grace_and_elastic_gauges_exported(spark, tmp_path):
         ms._sources = [s for s in ms._sources if s.name != "shuffle"]
 
 
+def test_ici_tier_gauges_exported(spark, tmp_path):
+    """The two-tier exchange is observable: device-tier exchange count
+    and HBM bytes moved, host-tier fallbacks, and the agreed tier
+    split's peer count all ride the shuffle Source as live gauges —
+    zero until the tier engages, so dashboards can alert on the first
+    fallback (ICI degraded to DCN) the moment it happens."""
+    prev = getattr(spark, "_crossproc_svc", None)
+    ms = spark.metricsSystem
+    try:
+        svc = spark.enableHostShuffle(str(tmp_path), process_id=0,
+                                      n_processes=1, timeout_s=5.0)
+        snap0 = ms.snapshots()["shuffle"]
+        for key in ("ici_exchanges", "ici_bytes_moved",
+                    "dcn_fallback_exchanges", "tier_split_peers"):
+            assert key in snap0, key
+            assert snap0[key] == 0, (key, snap0[key])
+        svc.counters["ici_exchanges"] += 5
+        svc.counters["ici_bytes_moved"] += 1 << 20
+        svc.counters["dcn_fallback_exchanges"] += 1
+        svc.counters["tier_split_peers"] = 3
+        snap = ms.snapshots()["shuffle"]
+        assert snap["ici_exchanges"] == 5
+        assert snap["ici_bytes_moved"] == 1 << 20
+        assert snap["dcn_fallback_exchanges"] == 1
+        assert snap["tier_split_peers"] == 3
+    finally:
+        spark._crossproc_svc = prev
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]
+
+
+def test_ici_activity_in_status(spark, tmp_path):
+    """/status surfaces per-session device-tier activity the same way
+    it surfaces grace degradation: {} while quiet, live counters once
+    the tier moves bytes or folds back."""
+    import urllib.request
+
+    from spark_tpu.server import SQLServer
+    prev = getattr(spark, "_crossproc_svc", None)
+    ms = spark.metricsSystem
+    srv = None
+    try:
+        svc = spark.enableHostShuffle(str(tmp_path), process_id=0,
+                                      n_processes=1, timeout_s=5.0)
+        srv = SQLServer(spark, port=0).start()
+
+        def status():
+            with urllib.request.urlopen(
+                    f"http://{srv.host}:{srv.port}/status",
+                    timeout=30) as r:
+                return json.loads(r.read())
+
+        st = status()
+        assert st["iciActivity"] == {}            # tier never engaged
+        svc.counters["ici_exchanges"] += 2
+        svc.counters["ici_bytes_moved"] += 4096
+        svc.counters["dcn_fallback_exchanges"] += 1
+        st = status()
+        got = st["iciActivity"]["default"]
+        assert got["ici_exchanges"] == 2
+        assert got["ici_bytes_moved"] == 4096
+        assert got["dcn_fallback_exchanges"] == 1
+    finally:
+        if srv is not None:
+            srv.stop()
+        spark._crossproc_svc = prev
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]
+
+
 def test_grace_activity_in_status_and_admission(spark, tmp_path):
     """/status surfaces per-session grace activity, and the admission
     controller both reports the cluster-wide degraded-event total and
