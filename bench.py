@@ -2139,6 +2139,230 @@ def servebench_worker_main() -> None:
     sys.stdout.flush()
 
 
+def _bench_dist_pool() -> dict:
+    """Distpool lane: burst admission, fixed server vs elastic pool.
+
+    One CPU worker process runs an in-process SQL server twice under the
+    same burst — 6 concurrent HTTP clients hammering SELECTs through a
+    maxConcurrentStatements=4 admission cap with a single local executor
+    thread.  Fixed mode has only that thread, so the burst piles up
+    behind admission and clients eat 429 + retry; elastic mode lets the
+    supervisor spawn real pool workers off the demand signal and offload
+    admitted SELECTs to them, so slots drain faster.  The lane pins
+    result equality across modes and proves the whole elastic loop in
+    one number set: workers spawned under burst, statements served by
+    the pool, and the idle pool reaped back to zero."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="spark_tpu_bench_pool_")
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("SPARK_TPU_FAULT_PLAN", None)
+        env.pop("SPARK_TPU_PLATFORM", None)
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--distpool-worker", d],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        out, err = p.communicate(timeout=CHILD_TIMEOUT_S)
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"distpool worker rc={p.returncode}: "
+                f"{(err or out).strip().splitlines()[-3:]}")
+        o = json.loads([ln for ln in out.splitlines()
+                        if ln.strip().startswith("{")][-1])
+        if o["fixed"]["checksum"] != o["elastic"]["checksum"]:
+            raise RuntimeError(f"fixed/elastic results diverge: {o}")
+        el = o["elastic"]
+        if el["workers_spawned"] <= 0:
+            raise RuntimeError(f"pool never spawned under burst: {o}")
+        if el["pool_served"] <= 0:
+            raise RuntimeError(f"pool served no statements: {o}")
+        # self-exited workers are collected without a reap count, so
+        # reaped==spawned is not guaranteed — but an idle pool must
+        # shed at least one worker and end empty
+        if el["workers_reaped"] <= 0 or el["pool_live_end"] != 0:
+            raise RuntimeError(f"idle pool never reaped: {o}")
+        return {
+            "distpool_clients": o["clients"],
+            "distpool_statements": o["fixed"]["statements"],
+            "distpool_stmts_per_sec_fixed": o["fixed"]["stmts_per_sec"],
+            "distpool_stmts_per_sec_elastic": el["stmts_per_sec"],
+            "distpool_p95_ms_fixed": o["fixed"]["p95_ms"],
+            "distpool_p95_ms_elastic": el["p95_ms"],
+            "distpool_429_rate_fixed": o["fixed"]["rate_429"],
+            "distpool_429_rate_elastic": el["rate_429"],
+            "distpool_workers_spawned": el["workers_spawned"],
+            "distpool_workers_reaped": el["workers_reaped"],
+            "distpool_pool_served": el["pool_served"],
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def distpool_worker_main() -> None:
+    """The distpool lane's single worker (see ``_bench_dist_pool``).
+
+    argv: --distpool-worker <root>.  Starts an in-process SQLServer
+    twice — pool off then pool on — with 6 concurrent HTTP clients each
+    replaying the same SELECT burst through a tight admission cap.
+    Clients retry on 429 and count every rejection; latency is measured
+    end to end INCLUDING retry waits, because that is what a
+    backpressured client actually experiences.  Prints ONE JSON line
+    with per-mode latency/throughput/429 stats, a result checksum, and
+    the elastic mode's pool counters."""
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    i = sys.argv.index("--distpool-worker")
+    root = sys.argv[i + 1]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    # env var, not jax.config: pool WORKER processes inherit it, so a
+    # compile done by any process (this one or a worker) serves the rest
+    cache_dir = tempfile.mkdtemp(prefix="jaxcache_", dir=root)
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+
+    from spark_tpu.server import SQLServer
+    from spark_tpu.sql.session import SparkSession
+
+    def _http(port, method, path, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=(json.dumps(body).encode() if body is not None else None),
+            method=method)
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return json.loads(resp.read().decode())
+
+    N_CLIENTS, N_STMTS = 6, 6
+    QUERY = ("SELECT k % 16 AS g, sum(v) AS sv, count(*) AS c "
+             "FROM pool_f GROUP BY k % 16 ORDER BY g")
+    base = SparkSession.builder.appName("distpool").getOrCreate()
+    out = {"clients": N_CLIENTS}
+    for mode in ("fixed", "elastic"):
+        srv_sess = base.newSession()
+        srv_sess.conf.set("spark.tpu.mesh.shards", "1")
+        srv_sess.conf.set("spark.sql.warehouse.dir",
+                          os.path.join(root, f"wh_{mode}"))
+        # tight global admission cap + ONE local executor thread: the
+        # fixed server's whole capacity.  The elastic pool's workers are
+        # the only way mode two gets more parallelism.
+        srv_sess.conf.set("spark.tpu.server.maxConcurrentStatements", "4")
+        if mode == "elastic":
+            srv_sess.conf.set("spark.tpu.server.pool.enabled", "true")
+            srv_sess.conf.set("spark.tpu.server.pool.maxWorkers", "3")
+            srv_sess.conf.set(
+                "spark.tpu.server.pool.statementsPerWorker", "1")
+            srv_sess.conf.set("spark.tpu.server.pool.cooldownSeconds", "0")
+            srv_sess.conf.set("spark.tpu.server.pool.pollSeconds", "0.05")
+            # 2s of continuous idle before the first reap: long enough
+            # to survive the gap between the warm-up and measured
+            # bursts, short enough to drain well inside the post-run
+            # reap wait below
+            srv_sess.conf.set(
+                "spark.tpu.server.pool.scaleDownRounds", "40")
+        srv_sess.sql("CREATE TABLE pool_f AS SELECT id AS k, "
+                     "(id * 7) % 1000 AS v FROM range(120000)")
+        srv = SQLServer(srv_sess, port=0, workers=1).start()
+        try:
+            def burst():
+                lat_ms, sums, errs = [], [], []
+                n429 = [0]
+                lock = threading.Lock()
+
+                def client(_cid):
+                    try:
+                        sid = _http(srv.port, "POST",
+                                    "/session")["sessionId"]
+                        for _rep in range(N_STMTS):
+                            t0 = time.perf_counter()
+                            for _attempt in range(400):
+                                try:
+                                    r = _http(srv.port, "POST", "/sql",
+                                              {"query": QUERY,
+                                               "session": sid})
+                                    break
+                                except urllib.error.HTTPError as e:
+                                    if e.code != 429:
+                                        raise
+                                    with lock:
+                                        n429[0] += 1
+                                    time.sleep(0.05)
+                            else:
+                                raise RuntimeError(
+                                    "429 retry budget exhausted")
+                            dt = (time.perf_counter() - t0) * 1000
+                            s = sum(c for row in r["rows"] for c in row
+                                    if isinstance(c, int))
+                            with lock:
+                                lat_ms.append(dt)
+                                sums.append(s)
+                        _http(srv.port, "DELETE", f"/session/{sid}")
+                    except Exception as e:   # noqa: BLE001 — report
+                        with lock:
+                            errs.append(f"{type(e).__name__}: {e}")
+
+                t0 = time.perf_counter()
+                threads = [threading.Thread(target=client, args=(c,))
+                           for c in range(N_CLIENTS)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                if errs:
+                    raise RuntimeError(f"distpool {mode}: {errs[:3]}")
+                return lat_ms, sums, n429[0], wall
+
+            # warm-up burst (unmeasured): pays the first-compile in
+            # both modes, and in elastic mode gives the supervisor a
+            # demand spike to scale up on so the MEASURED burst hits a
+            # warm pool — steady-state elasticity, not boot cost
+            burst()
+            lat_ms, sums, n429, wall = burst()
+            lat_ms.sort()
+            spawned = reaped = served = live_end = 0
+            sup = srv._pool_supervisor
+            if sup is not None:
+                # demand is gone; give the reconcile loop time to walk
+                # the pool back down so the lane can report a full
+                # spawn->serve->reap cycle
+                deadline = time.time() + 20.0
+                while time.time() < deadline:
+                    c = sup.counters
+                    if int(c["workers_spawned"]) > 0 \
+                            and sup.stats()["live"] == 0:
+                        break
+                    time.sleep(0.1)
+                c = sup.counters
+                spawned = int(c["workers_spawned"])
+                reaped = int(c["workers_reaped"])
+                served = int(c["pool_statements_served"])
+                live_end = int(sup.stats()["live"])
+            out[mode] = {
+                "statements": len(lat_ms),
+                "stmts_per_sec": round(len(lat_ms) / wall, 2),
+                "p50_ms": round(lat_ms[len(lat_ms) // 2], 1),
+                "p95_ms": round(lat_ms[int(len(lat_ms) * 0.95) - 1], 1),
+                "rate_429": round(n429 / max(n429 + len(lat_ms), 1), 3),
+                "checksum": int(sum(sums)),
+                "workers_spawned": spawned,
+                "workers_reaped": reaped,
+                "pool_served": served,
+                "pool_live_end": live_end,
+            }
+        finally:
+            srv.stop()
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
 def child_main() -> None:
     import numpy as np
     import jax
@@ -2277,6 +2501,13 @@ def child_main() -> None:
     except Exception as e:   # secondary must not sink the primary
         print(f"[bench-child] servebench failed: {e}", file=sys.stderr)
         extras["servebench_error"] = str(e)[:300]
+    try:
+        # elastic worker pool: burst of concurrent HTTP clients through
+        # a tight admission cap, fixed server vs demand-driven pool
+        extras.update(_bench_dist_pool())
+    except Exception as e:   # secondary must not sink the primary
+        print(f"[bench-child] distpool failed: {e}", file=sys.stderr)
+        extras["distpool_error"] = str(e)[:300]
 
     try:
         load_1m = round(os.getloadavg()[0], 2)
@@ -2320,6 +2551,8 @@ if __name__ == "__main__":
         stagecache_worker_main()
     elif "--servebench-worker" in sys.argv:
         servebench_worker_main()
+    elif "--distpool-worker" in sys.argv:
+        distpool_worker_main()
     elif "--child" in sys.argv:
         child_main()
     else:

@@ -138,7 +138,6 @@ def cmd_launch(args) -> int:
       the operator (or the GKE JobSet) runs THIS mode once per host."""
     import os
     import socket
-    import subprocess
 
     if args.process_id is None:
         # negatives clamp to 0 (no infinite-restart mode: a crash-looping
@@ -158,23 +157,22 @@ def cmd_launch(args) -> int:
                 with socket.socket() as s:
                     s.bind(("localhost", 0))
                     coord = f"localhost:{s.getsockname()[1]}"
-            procs = []
-            try:
-                for i in range(args.processes):
-                    argv = [sys.executable, "-m", "spark_tpu.cli",
-                            "launch", "--coordinator", coord,
-                            "--processes", str(args.processes),
-                            "--process-id", str(i)]
-                    for c in args.conf:
-                        argv += ["--conf", c]
-                    argv += [args.script] + list(args.script_args)
-                    procs.append(subprocess.Popen(argv))
-            except Exception:
-                # partial spawn: the already-started workers would spin
-                # at the rendezvous for jax's whole init timeout
-                for pr in procs:
-                    pr.terminate()
-                raise
+            cmds = []
+            for i in range(args.processes):
+                argv = [sys.executable, "-m", "spark_tpu.cli",
+                        "launch", "--coordinator", coord,
+                        "--processes", str(args.processes),
+                        "--process-id", str(i)]
+                for c in args.conf:
+                    argv += ["--conf", c]
+                argv += [args.script] + list(args.script_args)
+                cmds.append(argv)
+            # all-or-none through the pool's spawn seam: on a partial
+            # spawn the already-started workers are terminated AND
+            # waited (previously they were only sent SIGTERM and could
+            # linger at the rendezvous for jax's whole init timeout)
+            from .serving.pool import spawn_gang
+            procs = spawn_gang(cmds)
             # any worker failing (incl. SIGNAL deaths, which report
             # negative) fails the attempt and kills the siblings —
             # otherwise survivors spin at the jax.distributed rendezvous

@@ -642,6 +642,70 @@ SERVER_PLAN_CACHE_MAX_BYTES = conf("spark.tpu.server.planCache.maxBytes").doc(
     "via LRU eviction."
 ).int(256 << 20)
 
+# -- elastic worker pool (spark_tpu.serving.pool) ---------------------------
+
+SERVER_POOL_ENABLED = conf("spark.tpu.server.pool.enabled").doc(
+    "Elastic worker pool: the SQL server runs a supervisor that derives "
+    "a target pool size from the admission demand signal (running + "
+    "queued depth, cost-EWMA backlog, host headroom) and reconciles it "
+    "by fork/exec'ing real worker processes against the shared root — "
+    "the dynamic-allocation analog (ExecutorAllocationManager over the "
+    "external shuffle service).  Scale-down is 'stop heartbeating and "
+    "hand off the lease': sealed-block adoption plus the TTL reaper "
+    "absorb the rest, never a drain barrier.  Off by default."
+).boolean(False)
+
+SERVER_POOL_MIN_WORKERS = conf("spark.tpu.server.pool.minWorkers").doc(
+    "Floor of the elastic pool: the supervisor never reaps below this "
+    "many live workers (0 = the pool may drain completely when idle)."
+).check(lambda v: v >= 0).int(0)
+
+SERVER_POOL_MAX_WORKERS = conf("spark.tpu.server.pool.maxWorkers").doc(
+    "Ceiling of the elastic pool: the supervisor never spawns above "
+    "this many live workers regardless of demand."
+).check(lambda v: v >= 1).int(4)
+
+SERVER_POOL_STATEMENTS_PER_WORKER = conf(
+    "spark.tpu.server.pool.statementsPerWorker").doc(
+    "Demand divisor of the pool policy: target = "
+    "ceil((running + queued + recently-rejected) / this), clamped to "
+    "[minWorkers, maxWorkers].  Lower = more aggressive scale-up."
+).check(lambda v: v >= 1).int(2)
+
+SERVER_POOL_SCALE_DOWN_ROUNDS = conf(
+    "spark.tpu.server.pool.scaleDownRounds").doc(
+    "Hysteresis: the policy must observe demand below the current pool "
+    "size for this many CONSECUTIVE evaluations before it scales down "
+    "(one transient idle poll never reaps a warm worker)."
+).check(lambda v: v >= 1).int(3)
+
+SERVER_POOL_COOLDOWN = conf("spark.tpu.server.pool.cooldownSeconds").doc(
+    "Minimum seconds between pool scale DECISIONS (up or down): after "
+    "any resize the policy holds for this long so spawn cost is "
+    "amortized and flapping demand cannot thrash the pool."
+).check(lambda v: v >= 0).float(2.0)
+
+SERVER_POOL_POLL = conf("spark.tpu.server.pool.pollSeconds").doc(
+    "Period of the supervisor's reconcile loop (demand sample -> policy "
+    "-> spawn/reap)."
+).check(lambda v: v > 0).float(0.25)
+
+SERVER_POOL_HEADROOM = conf(
+    "spark.tpu.server.pool.minHostHeadroomBytes").doc(
+    "Host-memory clamp on scale-up: when the demand signal reports free "
+    "host budget below this many bytes, the policy never raises the "
+    "target above the live count (spawning under memory pressure only "
+    "deepens it).  0 = off."
+).check(lambda v: v >= 0).int(0)
+
+SERVER_POOL_OFFLOAD = conf("spark.tpu.server.pool.offload").doc(
+    "Route eligible admitted statements (SELECTs against persistent "
+    "tables, no session temp views) to pool workers through the shared "
+    "filesystem spool instead of the session FIFO.  Any offload miss — "
+    "no live worker, timeout, worker error — falls back silently to the "
+    "local path, so results are never worse than pool-off."
+).boolean(True)
+
 STAGE_FUSION = conf("spark.tpu.stage.fusion").doc(
     "Whole-stage tensor compilation: every exchange-bounded stage "
     "executes as ONE compiled program obtained from the process-local "

@@ -264,12 +264,54 @@ class BlockStore:
         except OSError:
             pass
 
-    def lease_fresh(self, owner: str, now: float) -> bool:
+    #: heir chains longer than this read as cold — a bound, not a
+    #: design point; real chains are one hop (reaped worker -> pool
+    #: supervisor) and the bound only guards a cyclic sidecar from
+    #: looping the freshness check
+    MAX_HEIR_DEPTH = 4
+
+    def handoff_lease(self, owner: str, heir: str) -> None:
+        """The scale-down-safety seam: BEFORE a reaped worker's lease
+        may be released, its ownership is handed to ``heir`` (the pool
+        supervisor) via an fsynced sidecar, so every sealed block the
+        owner registered stays adoptable — ``lease_fresh(owner)`` keeps
+        answering True for as long as the heir's own lease is fresh.
+        Crash ordering: the sidecar lands (rename-atomic) before the
+        caller releases the owner lease; a crash between the two leaves
+        BOTH records, which is merely conservative."""
+        self._check("lease")
+        self.touch_lease(heir)
+        p = self._lease_path(owner) + ".heir"
+        tmp = p + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"heir": heir, "ts": self._clock()}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+
+    def _heir_of(self, owner: str) -> Optional[str]:
         try:
-            return now - os.path.getmtime(self._lease_path(owner)) \
-                <= self.ttl_s
+            with open(self._lease_path(owner) + ".heir") as f:
+                rec = json.load(f)
+            heir = rec.get("heir") if isinstance(rec, dict) else None
+            return heir if isinstance(heir, str) and heir else None
+        except (OSError, ValueError):
+            return None
+
+    def lease_fresh(self, owner: str, now: float,
+                    _depth: int = 0) -> bool:
+        try:
+            if now - os.path.getmtime(self._lease_path(owner)) \
+                    <= self.ttl_s:
+                return True
         except OSError:
+            pass
+        if _depth >= self.MAX_HEIR_DEPTH:
             return False
+        heir = self._heir_of(owner)
+        if heir is not None and heir != owner:
+            return self.lease_fresh(heir, now, _depth + 1)
+        return False
 
     # -- state-dir ownership (streaming checkpoints) ---------------------
     def register_state(self, key: str, path: str, owner: str) -> None:
@@ -389,10 +431,12 @@ class BlockStore:
             except (OSError, ValueError):
                 continue
             owner = str(rec.get("owner", ""))
-            if os.path.exists(self._lease_path(owner)):
-                # lease present — live, or crashed-with-stale-lease.
-                # Either way the checkpoint survives: only an explicit
-                # release (which removes the lease) starts the clock.
+            if os.path.exists(self._lease_path(owner)) \
+                    or self._heir_of(owner) is not None:
+                # lease present — live, or crashed-with-stale-lease —
+                # or ownership handed off to a live heir.  Either way
+                # the checkpoint survives: only an explicit release
+                # (which removes the lease) starts the clock.
                 continue
             if now - released_ts <= self.ttl_s:
                 continue
@@ -428,13 +472,34 @@ class BlockStore:
                        for o in self._owners_of(d) + self._live_owners()):
                     continue
                 reclaimed += self._reap_dir(d)
+        # heir sidecars whose whole succession chain has gone cold
+        # protect nothing — drop them so a reaped worker's record does
+        # not outlive the supervisor that inherited it
+        try:
+            names = sorted(
+                os.listdir(os.path.join(self.dir, "leases")))
+        except OSError:
+            names = []
+        for name in names:
+            if not name.endswith(".heir"):
+                continue
+            owner = name[:-len(".heir")]
+            if not self.lease_fresh(owner, now):
+                try:
+                    os.remove(os.path.join(self.dir, "leases", name))
+                except OSError:
+                    pass
         if reclaimed:
             self._bump_reclaimed(reclaimed)
         return reclaimed
 
     def _live_owners(self) -> List[str]:
+        # heir sidecars live in the leases dir but are NOT owners —
+        # ``<owner>.heir`` names a succession record, not a tenant
         try:
-            return os.listdir(os.path.join(self.dir, "leases"))
+            return [n for n in
+                    os.listdir(os.path.join(self.dir, "leases"))
+                    if not n.endswith(".heir")]
         except OSError:
             return []
 
@@ -467,7 +532,7 @@ class BlockStore:
         return {
             "available": int(self.available),
             "exchangesHeld": _count("exchanges"),
-            "leases": _count("leases"),
+            "leases": len(self._live_owners()),
             "stateRegistrations": _count("state"),
             "orphanedBlocksReclaimed": self.reclaimed_total(),
         }
@@ -550,6 +615,21 @@ class BlockServiceClient:
         survivor never deletes blocks directly, it only expires the
         lease and lets the service's clock run."""
         self._guard("expire", lambda: self.store.release_lease(owner))
+
+    def handoff(self, owner: str, heir: Optional[str] = None) -> bool:
+        """Scale-down succession: hand ``owner``'s lease to ``heir``
+        (default: this client's own identity) BEFORE expiring it, so a
+        reaped worker's sealed output stays adoptable — the invariant
+        the pool supervisor's reap path rides.  Returns False (after
+        the structured degrade event) when the service is down; the
+        caller then must NOT expire the lease, since nothing inherited
+        it."""
+        return self._guard(
+            "handoff",
+            lambda: (self.store.handoff_lease(owner,
+                                              heir or self.owner),
+                     True)[1],
+            default=False)
 
 
 class BlockServer:

@@ -142,19 +142,42 @@ def default_host_name(process_id: Optional[int] = None) -> str:
     return f"host-{process_id}"
 
 
+def parse_host_pid(host: str) -> Optional[int]:
+    """Inverse of ``default_host_name`` where one exists: ``host-<n>``
+    parses to ``n``; anything else — notably ``pool-*`` serving-tier
+    tenants, whose lifecycle is the supervisor's, not the exchange
+    plane's — parses to ``None`` and never enters the exchange world."""
+    if host.startswith("host-"):
+        try:
+            return int(host[len("host-"):])
+        except ValueError:
+            return None
+    return None
+
+
 def live_view(n_processes: int, dead_hosts: Sequence[str] = (),
-              recovered_pids: Sequence[int] = ()) -> List[int]:
+              recovered_pids: Sequence[int] = (),
+              joined_hosts: Sequence[str] = ()) -> List[int]:
     """The live process set as a PURE function of its inputs: every pid
     whose canonical host name is not in ``dead_hosts`` (heartbeat
     verdicts) and that is not in ``recovered_pids`` (the exchange
-    plane's agreed-lost set).  Shared by the executor's topology view
-    and by tooling; the exchange planner itself keys only off the
-    AGREED set (``HostShuffleService.live_pids``) because plan inputs
-    must be identical on every survivor, and local heartbeat verdicts
-    are not."""
+    plane's agreed-lost set), unioned with any ``joined_hosts`` beyond
+    the static world — workers an elastic pool spawned after launch,
+    visible once they beat (their canonical names parse back to pids;
+    non-canonical tenants like ``pool-*`` are ignored).  Shared by the
+    executor's topology view and by tooling; the exchange planner
+    itself keys only off the AGREED set
+    (``HostShuffleService.live_pids``) because plan inputs must be
+    identical on every survivor, and local heartbeat verdicts are
+    not."""
     dead = set(dead_hosts)
     gone = set(recovered_pids)
-    return [p for p in range(n_processes)
+    world = set(range(n_processes))
+    for host in joined_hosts:
+        pid = parse_host_pid(host)
+        if pid is not None and pid >= 0:
+            world.add(pid)
+    return [p for p in sorted(world)
             if p not in gone and default_host_name(p) not in dead]
 
 
@@ -217,6 +240,19 @@ class HeartbeatMonitor:
             self._thread.join(timeout=2 * self.interval_s)
             self._thread = None
 
+    def retire(self) -> None:
+        """Clean LEAVE, as distinct from death: stop beating and remove
+        our own beat file, so observers see the host disappear from the
+        world rather than linger until the staleness timeout and be
+        blacklisted as dead.  The elastic pool's scale-down path — a
+        reaped worker retires; a crashed one goes stale."""
+        self.stop()
+        try:
+            os.remove(os.path.join(self.beat_dir,
+                                   f"beat_{self.host_id}.json"))
+        except OSError:
+            pass
+
     # -- detection ----------------------------------------------------------
     def on_failure(self, cb: Callable[[str], None]) -> None:
         self._callbacks.append(cb)
@@ -237,6 +273,15 @@ class HeartbeatMonitor:
             except Exception:
                 continue        # torn write: the NEXT beat will be whole
         return out
+
+    def live_hosts(self) -> List[str]:
+        """Hosts with a FRESH beat (self included) — the changing-world
+        complement of ``dead_hosts``: a pool worker that joined after
+        launch shows up here as soon as it beats, one that retired
+        vanishes immediately (its beat file is gone, not stale)."""
+        now = self._clock()
+        return sorted(host for host, rec in self.snapshot().items()
+                      if now - rec["ts"] <= self.timeout_s)
 
     def dead_hosts(self) -> List[str]:
         """Hosts whose last beat is stale; fires callbacks for new deaths."""

@@ -2208,11 +2208,24 @@ def _recover_epoch(session, svc: HostShuffleService, xid: str,
     every host-memory reservation the dead epoch staged so the
     re-execution starts from a clean ledger."""
     lost_now = set()
+    world = {svc.host_name(p) for p in range(svc.n)}
     for p in range(svc.n):
         if p == svc.pid or p in svc.recovered_pids:
             continue
         if svc.host_name(p) in err.lost_hosts or p in svc.blacklist:
             lost_now.add(p)
+    # loss reports naming hosts OUTSIDE the static exchange world — an
+    # elastic pool-* tenant the supervisor reaped, or a worker that
+    # joined after launch — are counted and dropped: their lifecycle is
+    # the serving tier's, and letting them into the agreement would
+    # diverge the recovered set across survivors whose local views of
+    # the wider world differ
+    foreign = set(err.lost_hosts) - world
+    if foreign:
+        with svc._lock:
+            fresh = foreign - svc._foreign_seen
+            svc._foreign_seen |= fresh
+            svc.counters["foreign_hosts_ignored"] += len(fresh)
     svc.recover_round(xid, epoch, lost_now)
     from ..analysis import runtime as _az
     if checks:

@@ -102,7 +102,7 @@ _KINDS = ("drop", "truncate", "corrupt", "delay", "skip_commit",
           "die_after_put", "die_after_manifest", "disk_full",
           "skew_decision", "torn_checkpoint", "die_after_state_commit",
           "die_during_register", "blockserver_unavailable",
-          "ici_unavailable", "die_mid_device_copy")
+          "ici_unavailable", "die_mid_device_copy", "spawn_exec_error")
 
 
 class _Rule:
@@ -284,6 +284,17 @@ class FaultPlan:
         death at the host commit barrier, never a wedged collective."""
         self.rules.append(_Rule("die_mid_device_copy", exchange, None,
                                 once=True))
+        return self
+
+    def spawn_exec_error(self, after_spawns: int = 0,
+                         once: bool = False) -> "FaultPlan":
+        """The pool supervisor's exec seam fails with ``OSError`` (exec
+        format error) once ``after_spawns`` worker processes have
+        started successfully (0 = the very first spawn fails).
+        ``once=False``: a broken worker binary stays broken — the pool
+        must converge BELOW target, structured, never hang or retry-storm."""
+        self.rules.append(_Rule("spawn_exec_error", None, None, once,
+                                after_bytes=after_spawns))
         return self
 
     # -- env transport ---------------------------------------------------
@@ -514,6 +525,33 @@ class FaultInjector:
         return self
 
     # -- streaming commit-protocol wrapping -------------------------------
+    def attach_pool(self, supervisor) -> "FaultInjector":
+        """Arms a ``WorkerPoolSupervisor``'s exec seam: once
+        ``after_spawns`` worker processes have started successfully, a
+        matching ``spawn_exec_error`` rule makes every further spawn
+        raise ``OSError(ENOEXEC)`` — the broken-binary / bad-interpreter
+        failure the supervisor must absorb structured (count
+        ``spawn_failures``, converge below target, never hang)."""
+        injector = self
+        orig_popen = supervisor._popen
+        spawned_ok = [0]
+
+        def popen(*a, **kw):
+            for rule in injector.plan.rules:
+                if rule.kind == "spawn_exec_error" \
+                        and rule.matches("", None) \
+                        and spawned_ok[0] >= rule.after_bytes:
+                    rule.fired += 1
+                    injector.injected.append(
+                        f"spawn_exec_error:after{spawned_ok[0]}")
+                    raise OSError(8, "Exec format error (injected)")
+            pr = orig_popen(*a, **kw)
+            spawned_ok[0] += 1
+            return pr
+
+        supervisor._popen = popen
+        return self
+
     def attach_stream(self, execution) -> "FaultInjector":
         """Arms a ``StreamExecution``'s exactly-once commit protocol.
 

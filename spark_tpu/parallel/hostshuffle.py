@@ -501,9 +501,19 @@ class HostShuffleService:
         #: peer blacklist, pid → reason; persists across the exchanges of
         #: one query (the HealthTracker executor-exclusion analog)
         self.blacklist: Dict[int, str] = {}
+        #: out-of-world host names already counted as ignored, so one
+        #: lingering stale pool beat bumps the counter once, not once
+        #: per barrier poll
+        self._foreign_seen: set = set()
         self.counters: Dict[str, int] = {
             "exchanges": 0, "block_retries": 0, "blocks_lost": 0,
             "barrier_excluded": 0, "peers_blacklisted": 0,
+            # changing-world tolerance: heartbeat verdicts / loss
+            # reports naming hosts OUTSIDE the static exchange world
+            # (elastic pool-* tenants, workers joined after launch) —
+            # counted and ignored, never allowed to perturb the
+            # agreement or the blacklist
+            "foreign_hosts_ignored": 0,
             "fetch_failures": 0, "refetches": 0,
             "blocks_written": 0, "blocks_read": 0,
             "bytes_written": 0, "bytes_raw": 0, "bytes_read": 0,
@@ -1282,6 +1292,19 @@ class HostShuffleService:
                 return missing
             if self.heartbeat is not None and self.blacklist_enabled:
                 dead = set(self.heartbeat.dead_hosts())
+                # verdicts about hosts outside the static exchange
+                # world — a reaped pool-* tenant whose beat went stale,
+                # a worker that joined after launch — must not perturb
+                # the blacklist: count and drop them
+                world = {self.host_name(s) for s in range(self.n)}
+                foreign = dead - world
+                if foreign:
+                    with self._lock:
+                        fresh = foreign - self._foreign_seen
+                        self._foreign_seen |= fresh
+                        self.counters["foreign_hosts_ignored"] += \
+                            len(fresh)
+                    dead &= world
                 for s in waiting:
                     if self.host_name(s) in dead:
                         self._blacklist_peer(

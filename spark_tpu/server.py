@@ -175,7 +175,8 @@ class SQLServer:
             grace_supplier=self._grace_total,
             blockstore_supplier=lambda: getattr(
                 getattr(getattr(session, "_crossproc_svc", None),
-                        "blockclient", None), "store", None))
+                        "blockclient", None), "store", None),
+            queued_supplier=self._queued_total)
         self._plan_cache: Optional[PlanCache] = None
         if session.conf_obj.get(C.SERVER_PLAN_CACHE_ENABLED):
             self._plan_cache = PlanCache(session.conf_obj)
@@ -198,6 +199,10 @@ class SQLServer:
         # serving tier owns the orphan reaper — elastic worker reap/spawn
         # leaves exchange/state orphans only the service may delete
         self._blockserver = None
+        # elastic worker pool (started with the server when
+        # spark.tpu.server.pool.enabled): admission demand drives
+        # spawn/reap of real worker processes over the block service
+        self._pool_supervisor = None
         self._register_metrics()
 
     # -- grace-degradation visibility ------------------------------------
@@ -233,6 +238,18 @@ class SQLServer:
                          "dcn_fallback_exchanges", "tier_split_peers")}
         return out if any(out.values()) else {}
 
+    def _queued_total(self) -> int:
+        """Total statements waiting on session FIFOs tier-wide — the
+        ``queued`` component of the admission demand signal.  Takes only
+        ``_reg_lock``; the admission controller consults it OUTSIDE its
+        own lock."""
+        try:
+            with self._reg_lock:
+                sessions = [self._default] + list(self._sessions.values())
+                return sum(len(ss.queue) for ss in sessions)
+        except Exception:
+            return 0
+
     def _grace_total(self) -> int:
         """Cumulative grace-degradation events across every session —
         the admission controller's learned signal that running near the
@@ -262,9 +279,23 @@ class SQLServer:
             self._blockserver.gc_runs if self._blockserver else 0)
         ms = self.session.metricsSystem
         # re-registering (e.g. a second SQLServer on the same session)
-        # replaces rather than duplicates the source
-        ms._sources = [s for s in ms._sources if s.name != "serving"]
+        # replaces rather than duplicates the sources
+        ms._sources = [s for s in ms._sources
+                       if s.name not in ("serving", "pool")]
         ms.register_source(Source("serving", gauges))
+
+        # elastic-pool gauges read through the supervisor handle so they
+        # are live the moment start() attaches one (0 until then)
+        def _pool_counter(name):
+            def get():
+                sup = self._pool_supervisor
+                return sup.counters.get(name, 0) if sup else 0
+            return get
+
+        pool_gauges = {k: _pool_counter(k) for k in (
+            "workers_spawned", "workers_reaped", "pool_target",
+            "pool_live", "scale_decisions", "spawn_failures")}
+        ms.register_source(Source("pool", pool_gauges))
 
     # -- session registry ------------------------------------------------
     def _open_session(self) -> str:
@@ -490,9 +521,33 @@ class SQLServer:
             self._admission.release(time.time() - admit_t,
                                     cost_key=cost_key)
 
+    def _offloadable(self, ss: _ServerSession, text: str) -> bool:
+        """Pool-eligible statements: plain SELECTs against PERSISTENT
+        tables only — a session temp view lives in this process's
+        memory, a pool worker cannot see it, and anything non-SELECT may
+        mutate catalog state the session expects to observe."""
+        if self._pool_supervisor is None:
+            return False
+        if not ss.session.conf_obj.get(C.SERVER_POOL_OFFLOAD):
+            return False
+        if ss.session.catalog._views:
+            return False
+        return text.strip().lower().startswith("select")
+
     def _run_admitted(self, ss: _ServerSession, text: str,
                       sid: Optional[str], stmt_id: Optional[str]) -> dict:
         from .sql.session import QueryCancelled
+
+        if self._offloadable(ss, text):
+            # any miss (no live worker, timeout, worker error) returns
+            # None and the statement falls through to the local FIFO —
+            # offload never makes a result worse than pool-off
+            out = self._pool_supervisor.execute(text)
+            if out is not None:
+                out.setdefault("statementId",
+                               stmt_id or uuid.uuid4().hex[:16])
+                ss.last_used = time.time()
+                return out
 
         stmt = _Statement(stmt_id or uuid.uuid4().hex[:16], sid or "", text)
         with self._reg_lock:
@@ -703,6 +758,8 @@ class SQLServer:
             out["planCache"] = self._plan_cache.stats()
         if self._blockserver is not None:
             out["blockStore"] = self._blockserver.stats()
+        if self._pool_supervisor is not None:
+            out["poolActivity"] = self._pool_supervisor.stats()
         from .sql.stagecompile import stage_cache
         out["stageCache"] = stage_cache().stats()
         return out
@@ -871,6 +928,23 @@ class SQLServer:
                 interval_s=float(self.session.conf_obj.get(
                     C.BLOCKSERVER_GC_INTERVAL)))
             self._blockserver.start()
+        if self.session.conf_obj.get(C.SERVER_POOL_ENABLED) \
+                and self._pool_supervisor is None:
+            from .serving.pool import WorkerPoolSupervisor
+            svc = getattr(self.session, "_crossproc_svc", None)
+            pool_root = os.path.join(
+                getattr(svc, "root", None)
+                or os.path.abspath(self.session.conf_obj.get(
+                    C.WAREHOUSE_DIR)) + "-ctl",
+                "_pool")
+            self._pool_supervisor = WorkerPoolSupervisor(
+                pool_root, self.session.conf_obj,
+                demand_supplier=self._admission.demand_signal,
+                warehouse=os.path.abspath(
+                    self.session.conf_obj.get(C.WAREHOUSE_DIR)),
+                blockstore_root=(bc.store.root if bc is not None
+                                 else None))
+            self._pool_supervisor.start()
         return self
 
     def stop(self) -> None:
@@ -878,6 +952,9 @@ class SQLServer:
         if self._reaper is not None:
             self._reaper.join(timeout=2.0)
             self._reaper = None
+        if self._pool_supervisor is not None:
+            self._pool_supervisor.stop()
+            self._pool_supervisor = None
         if self._blockserver is not None:
             self._blockserver.stop()
             self._blockserver = None
@@ -897,7 +974,8 @@ class SQLServer:
             ss.session._plan_cache = None
         self.session._plan_cache = None
         ms = self.session.metricsSystem
-        ms._sources = [s for s in ms._sources if s.name != "serving"]
+        ms._sources = [s for s in ms._sources
+                       if s.name not in ("serving", "pool")]
 
 
 def main(argv=None) -> int:

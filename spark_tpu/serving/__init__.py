@@ -6,8 +6,9 @@ Janino codegen-cache analog), ``admission`` bounds what a shared server
 accepts (the thriftserver pool-backpressure analog).  ``server.py``
 wires both into the HTTP statement path."""
 
-from .admission import AdmissionController, AdmissionRejected
+from .admission import (AdmissionController, AdmissionRejected,
+                        DemandSignal)
 from .plancache import PLANNING_CONF_KEYS, PlanCache, fingerprint
 
-__all__ = ["AdmissionController", "AdmissionRejected", "PlanCache",
-           "PLANNING_CONF_KEYS", "fingerprint"]
+__all__ = ["AdmissionController", "AdmissionRejected", "DemandSignal",
+           "PlanCache", "PLANNING_CONF_KEYS", "fingerprint"]

@@ -31,11 +31,39 @@ answer, not a constant."""
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, NamedTuple, Optional
 
 from .. import config as C
 
-__all__ = ["AdmissionController", "AdmissionRejected"]
+__all__ = ["AdmissionController", "AdmissionRejected", "DemandSignal"]
+
+
+class DemandSignal(NamedTuple):
+    """One typed snapshot of serving demand — everything the elastic
+    pool policy consumes, so it never pokes controller internals.
+
+    ``running`` is admitted-and-unfinished statements, ``queued`` the
+    total FIFO depth behind them, ``rejected_recent`` rejections since
+    the previous snapshot (burst pressure the caps already shed),
+    ``cost_ewma_s`` the global recent-duration estimate and
+    ``backlog_s`` its product with the demand — expected seconds of
+    work standing in line.  ``host_free`` is the host ledger's free
+    budget (-1 = no ledger wired) and ``standing`` the long-lived
+    streaming tenants."""
+
+    running: int = 0
+    queued: int = 0
+    rejected_recent: int = 0
+    cost_ewma_s: float = 0.0
+    backlog_s: float = 0.0
+    host_free: int = -1
+    standing: int = 0
+
+    @property
+    def demand(self) -> int:
+        """Statements wanting service right now: running + queued depth
+        + what the caps just turned away."""
+        return self.running + self.queued + self.rejected_recent
 
 
 class AdmissionRejected(RuntimeError):
@@ -62,10 +90,17 @@ class AdmissionController:
     def __init__(self, conf,
                  ledger_supplier: Optional[Callable[[], Any]] = None,
                  grace_supplier: Optional[Callable[[], int]] = None,
-                 blockstore_supplier: Optional[Callable[[], Any]] = None):
+                 blockstore_supplier: Optional[Callable[[], Any]] = None,
+                 queued_supplier: Optional[Callable[[], int]] = None):
         self._conf = conf
         self._ledger = ledger_supplier or (lambda: None)
         self._grace = grace_supplier or (lambda: 0)
+        # total FIFO depth across server sessions, read OUTSIDE the
+        # admission lock (the server's supplier takes its own
+        # registration lock; admission->registration is the established
+        # order and demand_signal must not create the reverse nesting)
+        self._queued = queued_supplier or (lambda: 0)
+        self._signal_rejected_mark = 0     # rejected at last demand_signal
         # disaggregated block service (blockserver.BlockStore or None):
         # purely observational here — admission surfaces the store's
         # hygiene next to its own counters so a serving operator sees
@@ -208,6 +243,37 @@ class AdmissionController:
             est = self._ewma_s
         return max(1.0, est * max(1, self.active))
 
+    # -- demand signal (elastic pool input) ----------------------------
+    def demand_signal(self) -> DemandSignal:
+        """Snapshot serving demand as one typed struct.  Suppliers that
+        take their own locks (queued depth, host ledger) are consulted
+        OUTSIDE the admission lock; ``rejected_recent`` is the rejection
+        delta since the previous call, so each snapshot reports burst
+        pressure once instead of forever."""
+        try:
+            queued = int(self._queued() or 0)
+        except Exception:
+            queued = 0
+        host_free = -1
+        try:
+            ledger = self._ledger()
+            if ledger is not None:
+                host_free = int(ledger.free)
+        except Exception:
+            pass
+        with self._lock:
+            rejected_recent = self.rejected - self._signal_rejected_mark
+            self._signal_rejected_mark = self.rejected
+            running = self.active
+            ewma = self._ewma_s
+            standing = self.standing
+        demand = running + queued + rejected_recent
+        return DemandSignal(
+            running=running, queued=queued,
+            rejected_recent=rejected_recent,
+            cost_ewma_s=ewma, backlog_s=ewma * demand,
+            host_free=host_free, standing=standing)
+
     # -- introspection -------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         store = None
@@ -215,6 +281,10 @@ class AdmissionController:
             store = self._blockstore()
         except Exception:
             pass
+        try:
+            queued = int(self._queued() or 0)
+        except Exception:
+            queued = 0
         with self._lock:
             out = {
                 "admitted": self.admitted, "rejected": self.rejected,
@@ -227,6 +297,15 @@ class AdmissionController:
                 "peakStandingQueries": self.peak_standing,
                 "streamBatches": self.stream_batches,
                 "streamBatchesDeferred": self.stream_batches_deferred,
+                # a NON-consuming view of the demand signal (the delta
+                # mark belongs to demand_signal's caller, the pool)
+                "demand": {
+                    "running": self.active, "queued": queued,
+                    "rejectedSinceSignal":
+                        self.rejected - self._signal_rejected_mark,
+                    "backlogSeconds": round(
+                        self._ewma_s * (self.active + queued), 3),
+                },
             }
         if store is not None:
             out["blockStore"] = store.stats()

@@ -1,0 +1,649 @@
+"""Elastic worker pool: admission-driven spawn/reap over the block
+service.
+
+The reference's dynamic allocation splits into two halves: the external
+shuffle service makes a dead executor's map output outlive it, and the
+``ExecutorAllocationManager`` turns pending-task pressure into executor
+requests with hysteresis ("schedulerBacklogTimeout") and an idle
+timeout on the way down.  r16's disaggregated block service reproduced
+the first half; this module is the second.  Three pieces:
+
+* ``decide_target`` — a PURE policy function: one ``DemandSignal``
+  snapshot (running + queued depth, recent rejections, cost-EWMA
+  backlog, host headroom) in, one ``PoolDecision`` out.  Hysteresis
+  (``scaleDownRounds`` consecutive low observations before a reap),
+  cooldown between resizes, min/max bounds, and a headroom clamp that
+  refuses to scale up into host-memory pressure.  No threads, no
+  clocks of its own — the unit-test surface.
+
+* ``WorkerPoolSupervisor`` — the serving tier's reconcile loop: sample
+  the demand signal, run the policy, and close the gap by fork/exec'ing
+  REAL worker processes against a shared pool root (the
+  ``recovery_worker``/``cli.py`` fan-out shape).  Workers heartbeat
+  into a pool-scoped ``HeartbeatMonitor`` (``pool-<wid>`` ids, a
+  namespace ``parse_host_pid`` maps to None so they can never enter the
+  exchange world's blacklist) and hold a block-service lease.
+  Statements reach workers through a filesystem spool (claim =
+  atomic rename), results come back the same way — the same
+  no-listener-thread discipline as every other control-plane piece.
+
+* Scale-down is "stop heartbeating and hand off the lease", NEVER a
+  drain barrier: the supervisor writes a reap marker, the worker
+  retires its beat (clean leave, not death) and exits; the supervisor
+  then inherits the worker's block-service lease
+  (``handoff_lease`` — the scale-down-safety invariant: sealed output
+  must stay adoptable before the lease may expire) and releases the
+  original.  Sealed-block adoption plus the TTL reaper absorb
+  everything else.
+
+``spawn_gang`` is the shared partial-spawn seam: start a list of
+processes and, if any exec fails, terminate AND wait every
+already-started sibling before re-raising — ``cli.py``'s launch fan-out
+routes through it too, fixing the leak where an exec failure left
+earlier workers spinning.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
+
+from .. import config as C
+from .admission import DemandSignal
+
+__all__ = ["PoolPolicy", "PoolDecision", "decide_target", "spawn_gang",
+           "WorkerPoolSupervisor", "worker_main"]
+
+#: the supervisor's own block-service identity — the heir every reaped
+#: worker's lease is handed to
+SUPERVISOR_OWNER = "pool-supervisor"
+
+
+# ---------------------------------------------------------------------------
+# policy — pure
+# ---------------------------------------------------------------------------
+
+class PoolPolicy(NamedTuple):
+    """The policy's knobs, captured as plain values so ``decide_target``
+    stays a pure function of its arguments."""
+
+    min_workers: int = 0
+    max_workers: int = 4
+    statements_per_worker: int = 2
+    scale_down_rounds: int = 3
+    cooldown_s: float = 2.0
+    min_headroom_bytes: int = 0
+
+    @classmethod
+    def from_conf(cls, conf) -> "PoolPolicy":
+        return cls(
+            min_workers=int(conf.get(C.SERVER_POOL_MIN_WORKERS)),
+            max_workers=int(conf.get(C.SERVER_POOL_MAX_WORKERS)),
+            statements_per_worker=int(
+                conf.get(C.SERVER_POOL_STATEMENTS_PER_WORKER)),
+            scale_down_rounds=int(
+                conf.get(C.SERVER_POOL_SCALE_DOWN_ROUNDS)),
+            cooldown_s=float(conf.get(C.SERVER_POOL_COOLDOWN)),
+            min_headroom_bytes=int(conf.get(C.SERVER_POOL_HEADROOM)))
+
+
+class PoolDecision(NamedTuple):
+    """One policy verdict: the target the supervisor should reconcile
+    toward, what kind of move it is, why, and the hysteresis counter to
+    carry into the next evaluation."""
+
+    target: int
+    action: str              # "up" | "down" | "hold"
+    reason: str
+    low_rounds: int = 0
+
+
+def decide_target(policy: PoolPolicy, signal: DemandSignal, live: int,
+                  now: float, last_scale_ts: float,
+                  low_rounds: int) -> PoolDecision:
+    """Derive the target pool size from one demand snapshot.  Pure:
+    callers thread ``low_rounds`` (consecutive below-capacity
+    observations) and ``last_scale_ts`` (monotonic time of the last
+    resize) through successive calls.
+
+    Scale-up is eager — one burst observation past cooldown grows the
+    pool to ``ceil(demand / statements_per_worker)`` — because a queued
+    client is paying latency NOW.  Scale-down is reluctant: demand must
+    sit below the current size for ``scale_down_rounds`` consecutive
+    evaluations first, because a reaped worker's warm caches are gone
+    for good.  The headroom clamp refuses to grow into host-memory
+    pressure (spawning there only deepens it); min/max bound both
+    directions."""
+    desired = 0 if signal.demand <= 0 else int(
+        math.ceil(signal.demand / max(1, policy.statements_per_worker)))
+    desired = max(policy.min_workers,
+                  min(policy.max_workers, desired))
+    if policy.min_headroom_bytes > 0 \
+            and 0 <= signal.host_free < policy.min_headroom_bytes \
+            and desired > live:
+        return PoolDecision(
+            live, "hold",
+            f"headroom clamp: host_free {signal.host_free} < floor "
+            f"{policy.min_headroom_bytes}", 0)
+    if desired > live:
+        # demand recovered: any scale-down streak is void
+        if now - last_scale_ts < policy.cooldown_s:
+            return PoolDecision(live, "hold", "cooldown", 0)
+        return PoolDecision(
+            desired, "up",
+            f"demand {signal.demand} wants {desired} workers "
+            f"(live {live})", 0)
+    if desired < live:
+        low_rounds += 1
+        if low_rounds < policy.scale_down_rounds:
+            return PoolDecision(
+                live, "hold",
+                f"hysteresis {low_rounds}/{policy.scale_down_rounds}",
+                low_rounds)
+        if now - last_scale_ts < policy.cooldown_s:
+            return PoolDecision(live, "hold", "cooldown", low_rounds)
+        return PoolDecision(
+            desired, "down",
+            f"demand {signal.demand} sustained below capacity "
+            f"({low_rounds} rounds)", 0)
+    return PoolDecision(live, "hold", "steady", 0)
+
+
+# ---------------------------------------------------------------------------
+# spawn seam — shared with cli.py's launch fan-out
+# ---------------------------------------------------------------------------
+
+def spawn_gang(cmds: List[List[str]],
+               popen: Optional[Callable[..., Any]] = None,
+               **popen_kwargs) -> List[Any]:
+    """Start every command, or none: if any exec fails the
+    already-started siblings are terminated AND waited before the error
+    re-raises — a partial gang never outlives the failure that orphaned
+    it (the ``cli.py`` leak this seam fixes left them spinning)."""
+    popen = popen or subprocess.Popen
+    procs: List[Any] = []
+    try:
+        for cmd in cmds:
+            procs.append(popen(cmd, **popen_kwargs))
+    except BaseException:
+        for pr in procs:
+            try:
+                pr.terminate()
+            except Exception:
+                pass
+        for pr in procs:
+            try:
+                pr.wait(timeout=10)
+            except Exception:
+                pass
+        raise
+    return procs
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+def _write_json(path: str, obj: Any) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[Any]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class WorkerPoolSupervisor:
+    """Reconcile live worker processes against the policy's target.
+
+    Owns the pool root's layout::
+
+        <root>/config.json   worker bootstrap (warehouse, conf pairs)
+        <root>/beats/        pool-scoped heartbeats (pool-<wid> ids)
+        <root>/spool/        statement spool: s<n>.json -> claim -> result
+        <root>/reap/         reap markers (scale-down requests)
+
+    The reconcile thread samples ``demand_supplier`` every
+    ``pollSeconds``, runs ``decide_target``, and closes the gap: up =
+    spawn the missing workers through the ``spawn_gang`` seam (exec
+    failure counts ``spawn_failures`` and converges the pool BELOW
+    target, structured, never a hang); down = reap ONE worker per tick
+    (marker, bounded wait, lease handoff to the supervisor, lease
+    release) so a demand cliff cannot mass-terminate warm workers in
+    one beat."""
+
+    def __init__(self, root: str, conf,
+                 demand_supplier: Callable[[], DemandSignal],
+                 warehouse: Optional[str] = None,
+                 blockstore_root: Optional[str] = None,
+                 extra_conf: Optional[Dict[str, Any]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.root = os.path.abspath(root)
+        self._conf = conf
+        self._demand = demand_supplier
+        self._warehouse = warehouse
+        self._blockstore_root = blockstore_root
+        self._extra_conf = dict(extra_conf or {})
+        self._clock = clock
+        self.poll_s = float(conf.get(C.SERVER_POOL_POLL))
+        self.owner = SUPERVISOR_OWNER
+        self._workers: Dict[int, Any] = {}       # wid -> Popen
+        self._next_wid = 0
+        self._next_stmt = 0
+        self._low_rounds = 0
+        self._last_scale_ts = -1e9
+        self._last_decision: Optional[PoolDecision] = None
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._hb = None
+        self._store = None
+        #: the per-process exec seam ``FaultInjector.attach_pool`` wraps
+        #: (``spawn_exec_error`` lands here)
+        self._popen: Callable[..., Any] = subprocess.Popen
+        self.counters: Dict[str, int] = {
+            "workers_spawned": 0, "workers_reaped": 0,
+            "pool_target": 0, "pool_live": 0,
+            "scale_decisions": 0, "spawn_failures": 0,
+            "pool_statements_served": 0, "offload_fallbacks": 0,
+        }
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self, reconcile: bool = True) -> None:
+        """Lay out the pool root and begin supervising.  With
+        ``reconcile=False`` the background loop is not started — tests
+        and chaos workers drive ``tick()`` themselves."""
+        if self._thread is not None:
+            return
+        for sub in ("beats", "spool", "reap"):
+            os.makedirs(os.path.join(self.root, sub), exist_ok=True)
+        conf_pairs = dict(self._extra_conf)
+        if self._warehouse is not None:
+            conf_pairs.setdefault("spark.sql.warehouse.dir",
+                                  self._warehouse)
+        # workers must plan in the SAME world as the supervisor's
+        # session: without this a worker on a multi-device host would
+        # pick its own mesh width and produce differently-planned (and
+        # differently-batched) results than the local path it stands in
+        # for
+        conf_pairs.setdefault(C.MESH_SHARDS.key,
+                              str(self._conf.get(C.MESH_SHARDS)))
+        _write_json(os.path.join(self.root, "config.json"), {
+            "conf": conf_pairs,
+            "blockstore_root": self._blockstore_root,
+            "supervisor_pid": os.getpid(),
+            "poll_s": self.poll_s,
+        })
+        from ..parallel.cluster import HeartbeatMonitor
+        self._hb = HeartbeatMonitor(
+            os.path.join(self.root, "beats"), host_id=self.owner,
+            conf=self._conf)
+        self._hb.start()
+        if self._blockstore_root:
+            from ..parallel.blockserver import BlockStore
+            self._store = BlockStore(self._blockstore_root, self._conf)
+            self._touch_own_lease()
+        self._stop_evt.clear()
+        if reconcile:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="pool-supervisor")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+        with self._lock:
+            wids = sorted(self._workers)
+        for wid in wids:
+            self._reap(wid)
+        if self._hb is not None:
+            self._hb.retire()
+            self._hb = None
+
+    @property
+    def live(self) -> int:
+        with self._lock:
+            return len(self._workers)
+
+    def live_wids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._workers)
+
+    # -- reconcile loop ------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop_evt.wait(self.poll_s):
+            try:
+                self.tick()
+            except Exception:
+                # the supervisor must outlive any single bad tick; the
+                # next sample retries from scratch
+                pass
+
+    def tick(self) -> PoolDecision:
+        """One reconcile step (public so tests and chaos workers can
+        drive the loop deterministically without the thread)."""
+        self._collect_exited()
+        self._touch_own_lease()
+        signal = self._demand()
+        now = self._clock()
+        decision = decide_target(
+            PoolPolicy.from_conf(self._conf), signal, self.live,
+            now, self._last_scale_ts, self._low_rounds)
+        self._low_rounds = decision.low_rounds
+        self._last_decision = decision
+        self.counters["pool_target"] = decision.target
+        if decision.action == "up":
+            self.counters["scale_decisions"] += 1
+            self._last_scale_ts = now
+            self._scale_up(decision.target)
+        elif decision.action == "down":
+            self.counters["scale_decisions"] += 1
+            self._last_scale_ts = now
+            with self._lock:
+                doomed = max(self._workers) if self._workers else None
+            if doomed is not None and self.live > decision.target:
+                self._reap(doomed)
+        self.counters["pool_live"] = self.live
+        return decision
+
+    def _collect_exited(self) -> None:
+        with self._lock:
+            gone = [w for w, pr in self._workers.items()
+                    if pr.poll() is not None]
+            for w in gone:
+                del self._workers[w]
+
+    def _touch_own_lease(self) -> None:
+        if self._store is not None:
+            try:
+                self._store.touch_lease(self.owner)
+            except Exception:
+                pass
+
+    # -- spawn / reap --------------------------------------------------
+    def _worker_cmd(self, wid: int) -> List[str]:
+        return [sys.executable, "-m", "spark_tpu.serving.pool",
+                "--worker", self.root, str(wid)]
+
+    def _worker_env(self) -> Dict[str, str]:
+        env = dict(os.environ)
+        # a fault plan aimed at the SERVER process must not replay
+        # inside every pool worker it spawns
+        env.pop("SPARK_TPU_FAULT_PLAN", None)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        return env
+
+    def _scale_up(self, target: int) -> None:
+        want = target - self.live
+        env = self._worker_env()
+        for _ in range(max(0, want)):
+            with self._lock:
+                wid = self._next_wid
+                self._next_wid += 1
+            try:
+                pr = self._popen(self._worker_cmd(wid), env=env)
+            except Exception:
+                # structured convergence below target: count it, leave
+                # the pool where it is, let the next tick re-decide
+                self.counters["spawn_failures"] += 1
+                continue
+            with self._lock:
+                self._workers[wid] = pr
+            self.counters["workers_spawned"] += 1
+
+    def _reap(self, wid: int) -> None:
+        """Scale-down one worker: marker -> bounded wait -> lease
+        handoff -> lease release.  No drain barrier — in-flight sealed
+        output stays adoptable through the heir lease, and the TTL
+        reaper absorbs the rest."""
+        with self._lock:
+            pr = self._workers.pop(wid, None)
+        if pr is None:
+            return
+        marker = os.path.join(self.root, "reap", str(wid))
+        try:
+            with open(marker, "w") as f:
+                f.write("reap")
+        except OSError:
+            pass
+        deadline = time.time() + max(2.0, 8 * self.poll_s)
+        while pr.poll() is None and time.time() < deadline:
+            time.sleep(0.02)
+        if pr.poll() is None:
+            try:
+                pr.terminate()
+                pr.wait(timeout=5)
+            except Exception:
+                pass
+        # scale-down safety: the worker's sealed output must remain
+        # adoptable BEFORE its lease may expire — hand the lease to the
+        # supervisor, only then release the original
+        if self._store is not None:
+            try:
+                self._store.handoff_lease(f"pool-{wid}", self.owner)
+                self._store.release_lease(f"pool-{wid}")
+            except Exception:
+                pass
+        try:
+            os.remove(marker)
+        except OSError:
+            pass
+        self.counters["workers_reaped"] += 1
+
+    # -- statement offload ---------------------------------------------
+    def execute(self, sql: str,
+                timeout_s: float = 30.0) -> Optional[dict]:
+        """Offer one statement to the pool through the spool; returns
+        the server-shaped result dict, or None when no worker picked it
+        up in time / the worker errored — the caller falls back to the
+        local path, so offload can only ever help."""
+        if self.live <= 0:
+            self.counters["offload_fallbacks"] += 1
+            return None
+        with self._lock:
+            sid = self._next_stmt
+            self._next_stmt += 1
+        base = os.path.join(self.root, "spool", f"s{sid:06d}")
+        _write_json(base + ".json", {"sql": sql})
+        result_path = base + ".result.json"
+        deadline = time.time() + timeout_s
+        try:
+            while time.time() < deadline:
+                rec = _read_json(result_path)
+                if rec is not None:
+                    if rec.get("ok"):
+                        self.counters["pool_statements_served"] += 1
+                        return rec["result"]
+                    self.counters["offload_fallbacks"] += 1
+                    return None
+                if self.live <= 0:
+                    # every worker died while we waited; reclaim the
+                    # statement if still unclaimed and fall back
+                    try:
+                        os.remove(base + ".json")
+                    except OSError:
+                        pass
+                    self.counters["offload_fallbacks"] += 1
+                    return None
+                time.sleep(0.01)
+            # timeout: withdraw the offer if nobody claimed it (a
+            # claimed statement may still finish; its result file is
+            # simply never read — SELECTs are side-effect free)
+            try:
+                os.remove(base + ".json")
+            except OSError:
+                pass
+            self.counters["offload_fallbacks"] += 1
+            return None
+        finally:
+            for suffix in (".result.json",):
+                try:
+                    if os.path.exists(base + suffix) \
+                            and _read_json(result_path) is not None:
+                        os.remove(base + suffix)
+                except OSError:
+                    pass
+
+    # -- observability -------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        d = self._last_decision
+        out: Dict[str, Any] = {
+            "live": self.live, "workers": self.live_wids(),
+            "counters": dict(self.counters),
+        }
+        if d is not None:
+            out["lastDecision"] = {"target": d.target,
+                                   "action": d.action,
+                                   "reason": d.reason}
+        return out
+
+
+# ---------------------------------------------------------------------------
+# worker side
+# ---------------------------------------------------------------------------
+
+def _json_safe(v: Any):
+    # mirror of server._json_safe (pool workers must not import the
+    # HTTP layer): results round-trip through the spool as strict JSON
+    if isinstance(v, float):
+        if v != v:
+            return None
+        if v in (float("inf"), float("-inf")):
+            return str(v)
+        return v
+    if v is None or isinstance(v, (bool, int, str)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    return str(v)
+
+
+def _claim_statements(spool: str, wid: int) -> List[str]:
+    """Claim every unclaimed statement by atomic rename — two workers
+    racing on one file: exactly one rename succeeds."""
+    claimed = []
+    try:
+        names = sorted(os.listdir(spool))
+    except OSError:
+        return claimed
+    for name in names:
+        if not name.endswith(".json") or ".claim" in name \
+                or name.endswith(".result.json"):
+            continue
+        src = os.path.join(spool, name)
+        dst = f"{src}.claim{wid}"
+        try:
+            os.rename(src, dst)
+        except OSError:
+            continue                      # a sibling won the race
+        claimed.append(dst)
+    return claimed
+
+
+def worker_main(root: str, wid: int) -> int:
+    """One elastic pool worker: heartbeat as ``pool-<wid>``, hold a
+    block-service lease, serve spooled statements against a session
+    sharing the server's warehouse (persistent tables travel through
+    the filesystem catalog — no RPC), exit on the reap marker (clean
+    retire) or when the supervisor itself disappears (orphan guard)."""
+    root = os.path.abspath(root)
+    cfg = None
+    for _ in range(100):                  # config.json lands before spawn
+        cfg = _read_json(os.path.join(root, "config.json"))
+        if cfg is not None:
+            break
+        time.sleep(0.05)
+    if cfg is None:
+        return 41
+    conf_pairs = dict(cfg.get("conf") or {})
+    from ..sql.session import SparkSession
+    session = SparkSession(C.Conf(conf_pairs))
+    conf = session.conf_obj
+    poll_s = float(cfg.get("poll_s") or 0.25)
+
+    from ..parallel.cluster import HeartbeatMonitor
+    hb = HeartbeatMonitor(os.path.join(root, "beats"),
+                          host_id=f"pool-{wid}", conf=conf)
+    hb.start()
+    store = None
+    if cfg.get("blockstore_root"):
+        from ..parallel.blockserver import BlockStore
+        try:
+            store = BlockStore(cfg["blockstore_root"], conf)
+            store.touch_lease(f"pool-{wid}")
+        except Exception:
+            store = None
+
+    spool = os.path.join(root, "spool")
+    reap_marker = os.path.join(root, "reap", str(wid))
+    sup_beat = os.path.join(root, "beats",
+                            f"beat_{SUPERVISOR_OWNER}.json")
+    served = 0
+    try:
+        while True:
+            if os.path.exists(reap_marker):
+                return 0                  # clean retire (finally beats)
+            rec = _read_json(sup_beat)
+            if rec is None:
+                return 0                  # supervisor retired: orphaned
+            if time.monotonic() - float(rec.get("ts", 0)) \
+                    > 4 * hb.timeout_s:
+                return 0                  # supervisor hung/killed
+            for claim in _claim_statements(spool, wid):
+                stmt = _read_json(claim) or {}
+                base = claim.split(".json.claim")[0]
+                t0 = time.time()
+                try:
+                    df = session.sql(str(stmt.get("sql", "")))
+                    columns = list(df.schema.names)
+                    rows = [[_json_safe(v) for v in r]
+                            for r in df.collect()]
+                    out = {"ok": True, "result": {
+                        "columns": columns, "rows": rows,
+                        "rowCount": len(rows),
+                        "durationMs":
+                            round((time.time() - t0) * 1000, 1),
+                        "pooled": True, "poolWorker": wid}}
+                except Exception as e:  # noqa: BLE001 — spooled back
+                    out = {"ok": False,
+                           "error": f"{type(e).__name__}: {e}"[:2000]}
+                _write_json(base + ".result.json", out)
+                try:
+                    os.remove(claim)
+                except OSError:
+                    pass
+                served += 1
+                if store is not None:
+                    try:
+                        store.touch_lease(f"pool-{wid}")
+                    except Exception:
+                        pass
+            time.sleep(poll_s / 2)
+    finally:
+        hb.retire()
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) >= 3 and argv[0] == "--worker":
+        return worker_main(argv[1], int(argv[2]))
+    print("usage: python -m spark_tpu.serving.pool --worker <root> <wid>",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

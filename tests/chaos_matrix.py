@@ -228,6 +228,33 @@ SCENARIOS = [
         "shuffled_join_worker.py", "ici-fault", 2, 8.0,
         {0: lambda: FaultPlan().die_mid_device_copy()},
         {0: "DIED", 1: "FAILED"}),
+    # -- the elastic-pool battery (``--pool``; see pool_worker.py) --
+    # scale-down mid-fetch: the peer is cooperatively REAPED once its
+    # last manifest lands (stops beating, lease handed to the pool
+    # supervisor) while its shipped jR block is dropped — the survivor
+    # must land the exact oracle from block-service custody alone, with
+    # the retry budget at ZERO (zero re-executed map tasks) and the
+    # reaped worker's lease still fresh through the heir chain
+    _scenario(
+        "pool-reap-mid-fetch", "post-register", "pool_worker.py",
+        "reap", 2, 20.0,
+        {1: lambda: FaultPlan().drop(exchange="xq000001-jR",
+                                     receiver=0)},
+        {0: "OK", 1: "OK"}, tier="tier1"),
+    # spawn exec failure: demand wants 2 workers, the second exec
+    # raises — the pool converges BELOW target (counted spawn_failures,
+    # never a hang) and the one real worker still serves
+    _scenario(
+        "pool-spawn-exec-error", "worker-spawn", "pool_worker.py",
+        "spawn-fail", 1, 20.0,
+        {0: lambda: FaultPlan().spawn_exec_error(after_spawns=1)},
+        {0: "OK"}),
+    # scale-up mid-standing-query: a real worker joins between
+    # micro-batches; the stream's sink must stay BYTE-identical to an
+    # uninterrupted no-pool oracle lifetime
+    _scenario(
+        "pool-scaleup-midstream", "mid-standing-query", "pool_worker.py",
+        "scaleup", 1, 60.0, {}, {0: "OK"}),
 ]
 
 
@@ -348,6 +375,11 @@ def main(argv=None):
                     "battery: kill-after-register adoption (zero "
                     "re-execution), register-gap deaths, and the "
                     "service-unavailable degradation path")
+    ap.add_argument("--pool", action="store_true",
+                    help="run only the elastic worker-pool battery: "
+                    "reap-mid-fetch adoption (zero re-execution), "
+                    "spawn exec-error convergence, and scale-up "
+                    "mid-standing-query byte-identity")
     args = ap.parse_args(argv)
 
     table = STREAM_SCENARIOS if args.streaming else SCENARIOS
@@ -357,6 +389,8 @@ def main(argv=None):
                  or any(pat in s["name"] for pat in args.only))]
     if args.blockserver:
         todo = [s for s in todo if s["name"].startswith("blockserver-")]
+    if args.pool:
+        todo = [s for s in todo if s["name"].startswith("pool-")]
     if args.seed:
         random.Random(args.seed).shuffle(todo)
     if not todo:

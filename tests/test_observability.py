@@ -3,6 +3,7 @@
 
 import json
 import os
+import time
 
 import numpy as np
 import pandas as pd
@@ -650,3 +651,91 @@ def test_streaming_state_spills_under_capped_ledger_with_parity(
         assert capped_files == free_files and free_files
     finally:
         spark._host_ledger = prev_ledger
+
+
+# ---------------------------------------------------------------------------
+# elastic-pool observability: the `pool` Source gauges + /status
+# poolActivity (spawn/reap/target/live/decisions/failures)
+# ---------------------------------------------------------------------------
+
+POOL_GAUGES = ("workers_spawned", "workers_reaped", "pool_target",
+               "pool_live", "scale_decisions", "spawn_failures")
+
+
+def test_pool_source_registered_and_zero_when_pool_off(spark):
+    """The `pool` Source exists on every server (gauges read through
+    the supervisor handle, 0 until one attaches) and /status carries no
+    poolActivity while the pool is disabled."""
+    import urllib.request
+
+    from spark_tpu.server import SQLServer
+    ms = spark.metricsSystem
+    srv = None
+    try:
+        srv = SQLServer(spark, port=0).start()
+        snap = ms.snapshots()["pool"]
+        for g in POOL_GAUGES:
+            assert snap[g] == 0, (g, snap)
+        with urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}/status", timeout=30) as r:
+            st = json.loads(r.read())
+        assert "poolActivity" not in st
+        assert "pool" in st["metrics"]
+    finally:
+        if srv is not None:
+            srv.stop()
+        ms._sources = [s for s in ms._sources
+                       if s.name not in ("serving", "pool")]
+
+
+def test_pool_gauges_and_status_activity(spark, tmp_path):
+    """With the pool enabled the server starts a real supervisor; its
+    counters flow through the `pool` Source gauges live, and /status
+    surfaces the full poolActivity block (live set, counters, last
+    decision)."""
+    import urllib.request
+
+    from spark_tpu.server import SQLServer
+    ms = spark.metricsSystem
+    prev_wh = spark.conf.get("spark.sql.warehouse.dir")
+    spark.conf.set("spark.sql.warehouse.dir", str(tmp_path / "wh"))
+    spark.conf.set(C.SERVER_POOL_ENABLED.key, "true")
+    spark.conf.set(C.SERVER_POOL_POLL.key, "0.05")
+    srv = None
+    try:
+        srv = SQLServer(spark, port=0).start()
+        sup = srv._pool_supervisor
+        assert sup is not None
+        deadline = time.time() + 10
+        while sup._last_decision is None and time.time() < deadline:
+            time.sleep(0.02)                  # first reconcile tick
+        # an idle server: the reconcile loop holds the pool at zero
+        snap = ms.snapshots()["pool"]
+        assert snap["pool_live"] == 0 and snap["workers_spawned"] == 0
+        # counters flow through the gauges with no re-registration
+        sup.counters["workers_spawned"] = 3
+        sup.counters["workers_reaped"] = 2
+        sup.counters["spawn_failures"] = 1
+        snap = ms.snapshots()["pool"]
+        assert snap["workers_spawned"] == 3
+        assert snap["workers_reaped"] == 2
+        assert snap["spawn_failures"] == 1
+        with urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}/status", timeout=30) as r:
+            st = json.loads(r.read())
+        pa = st["poolActivity"]
+        assert pa["live"] == 0 and pa["workers"] == []
+        assert pa["counters"]["workers_spawned"] == 3
+        assert "lastDecision" in pa           # the loop has ticked
+        assert pa["lastDecision"]["action"] == "hold"
+        # the admission stats carry the non-consuming demand view the
+        # supervisor's signal samples from
+        assert st["admission"]["demand"]["running"] == 0
+    finally:
+        if srv is not None:
+            srv.stop()
+        spark.conf.set("spark.sql.warehouse.dir", prev_wh)
+        spark.conf_obj.unset(C.SERVER_POOL_ENABLED.key)
+        spark.conf_obj.unset(C.SERVER_POOL_POLL.key)
+        ms._sources = [s for s in ms._sources
+                       if s.name not in ("serving", "pool")]

@@ -1,0 +1,393 @@
+"""Elastic worker pool: admission-driven spawn/reap over the block
+service.
+
+Unit layer: the pure ``decide_target`` policy (eager scale-up,
+hysteresis + cooldown on the way down, headroom clamp, min/max bounds),
+the controller's typed ``DemandSignal`` (including the consuming
+rejection delta), the ``spawn_gang`` all-or-none seam, the changing
+world view (``live_view`` + ``parse_host_pid``), and the
+scale-down-safety lease handoff (heir chains on the ``BlockStore``).
+
+Process layer: a REAL supervisor under a synthetic burst spawns real
+worker subprocesses that serve spooled statements oracle-exactly, then
+reaps them through hysteresis; and the tier-1 chaos cell
+``pool-reap-mid-fetch`` (tests/pool_worker.py) — a worker reaped
+mid-fetch whose sealed output the survivor adopts with ZERO re-executed
+map tasks, the reaped lease still fresh through the heir chain.
+"""
+
+import os
+import time
+
+import pytest
+
+import chaos_matrix as cm
+from spark_tpu import config as C
+from spark_tpu.parallel.blockserver import BlockStore
+from spark_tpu.parallel.cluster import live_view, parse_host_pid
+from spark_tpu.serving.admission import AdmissionController, DemandSignal
+from spark_tpu.serving.pool import (
+    SUPERVISOR_OWNER, PoolDecision, PoolPolicy, WorkerPoolSupervisor,
+    decide_target, spawn_gang)
+
+
+# ---------------------------------------------------------------------------
+# the pure policy
+# ---------------------------------------------------------------------------
+
+POLICY = PoolPolicy(min_workers=0, max_workers=4,
+                    statements_per_worker=2, scale_down_rounds=3,
+                    cooldown_s=2.0, min_headroom_bytes=0)
+
+
+def _sig(**kw):
+    return DemandSignal(**kw)
+
+
+def test_scale_up_is_eager():
+    """One burst observation past cooldown grows the pool to
+    ceil(demand / statements_per_worker) — a queued client is paying
+    latency NOW."""
+    d = decide_target(POLICY, _sig(queued=5), live=0,
+                      now=100.0, last_scale_ts=0.0, low_rounds=0)
+    assert d == PoolDecision(3, "up", d.reason, 0)
+    assert "demand 5" in d.reason
+
+
+def test_scale_up_counts_running_queued_and_rejections():
+    d = decide_target(POLICY, _sig(running=1, queued=2,
+                                   rejected_recent=3), live=1,
+                      now=100.0, last_scale_ts=0.0, low_rounds=0)
+    assert d.target == 3 and d.action == "up"   # ceil(6/2)
+
+
+def test_scale_up_respects_cooldown():
+    d = decide_target(POLICY, _sig(queued=5), live=0,
+                      now=1.0, last_scale_ts=0.0, low_rounds=0)
+    assert d.action == "hold" and d.target == 0
+    assert d.reason == "cooldown"
+
+
+def test_scale_up_clamps_to_max():
+    d = decide_target(POLICY, _sig(queued=100), live=0,
+                      now=100.0, last_scale_ts=0.0, low_rounds=0)
+    assert d.target == POLICY.max_workers
+
+
+def test_min_workers_floor_holds_under_zero_demand():
+    p = POLICY._replace(min_workers=1)
+    d = decide_target(p, _sig(), live=1,
+                      now=100.0, last_scale_ts=0.0, low_rounds=99)
+    assert d.action == "hold" and d.target == 1
+    assert d.reason == "steady" and d.low_rounds == 0
+
+
+def test_scale_down_needs_hysteresis_rounds():
+    """Demand must sit below capacity for scale_down_rounds consecutive
+    evaluations — callers thread low_rounds through; demand recovery
+    voids the streak."""
+    lr = 0
+    for round_no in (1, 2):
+        d = decide_target(POLICY, _sig(), live=2,
+                          now=100.0 + round_no, last_scale_ts=0.0,
+                          low_rounds=lr)
+        assert d.action == "hold" and d.target == 2
+        assert f"hysteresis {round_no}/3" in d.reason
+        lr = d.low_rounds
+    d = decide_target(POLICY, _sig(), live=2,
+                      now=103.0, last_scale_ts=0.0, low_rounds=lr)
+    assert d.action == "down" and d.target == 0 and d.low_rounds == 0
+    # a burst mid-streak resets the counter
+    d = decide_target(POLICY, _sig(queued=9), live=2,
+                      now=104.0, last_scale_ts=0.0, low_rounds=2)
+    assert d.action == "up" and d.low_rounds == 0
+
+
+def test_scale_down_respects_cooldown_but_keeps_streak():
+    d = decide_target(POLICY, _sig(), live=2,
+                      now=1.0, last_scale_ts=0.0, low_rounds=2)
+    assert d.action == "hold" and d.reason == "cooldown"
+    assert d.low_rounds == 3          # streak preserved for the next tick
+
+
+def test_headroom_clamp_refuses_growth_only():
+    """Host memory below the floor blocks scale-UP (spawning there only
+    deepens the pressure) but never blocks holding or shrinking."""
+    p = POLICY._replace(min_headroom_bytes=1 << 20)
+    d = decide_target(p, _sig(queued=9, host_free=1 << 10), live=1,
+                      now=100.0, last_scale_ts=0.0, low_rounds=0)
+    assert d.action == "hold" and d.target == 1
+    assert "headroom clamp" in d.reason
+    # same pressure, demand below capacity: the down path still runs
+    d = decide_target(p, _sig(host_free=1 << 10), live=2,
+                      now=100.0, last_scale_ts=0.0, low_rounds=2)
+    assert d.action == "down"
+    # no ledger wired (host_free = -1): the clamp never fires
+    d = decide_target(p, _sig(queued=9), live=1,
+                      now=100.0, last_scale_ts=0.0, low_rounds=0)
+    assert d.action == "up"
+
+
+def test_policy_from_conf_reads_pool_keys():
+    conf = C.Conf({C.SERVER_POOL_MIN_WORKERS.key: "1",
+                   C.SERVER_POOL_MAX_WORKERS.key: "8",
+                   C.SERVER_POOL_STATEMENTS_PER_WORKER.key: "3",
+                   C.SERVER_POOL_SCALE_DOWN_ROUNDS.key: "5",
+                   C.SERVER_POOL_COOLDOWN.key: "0.5",
+                   C.SERVER_POOL_HEADROOM.key: "4096"})
+    p = PoolPolicy.from_conf(conf)
+    assert p == PoolPolicy(1, 8, 3, 5, 0.5, 4096)
+
+
+# ---------------------------------------------------------------------------
+# the typed demand signal
+# ---------------------------------------------------------------------------
+
+def test_demand_signal_snapshot_and_rejection_delta():
+    """demand_signal reports running + queued + the rejection delta
+    since the PREVIOUS snapshot — burst pressure registers once, not
+    forever; stats() exposes a non-consuming view."""
+    conf = C.Conf({C.SERVER_MAX_CONCURRENT_STATEMENTS.key: "1"})
+    queue_depth = [0]
+    ac = AdmissionController(conf, queued_supplier=lambda: queue_depth[0])
+    ac.admit(0)
+    queue_depth[0] = 2
+    for _ in range(3):
+        with pytest.raises(Exception):
+            ac.admit(0)
+    sig = ac.demand_signal()
+    assert sig.running == 1 and sig.queued == 2
+    assert sig.rejected_recent == 3
+    assert sig.demand == 6
+    assert sig.backlog_s == pytest.approx(sig.cost_ewma_s * 6)
+    assert sig.host_free == -1        # no ledger wired
+    # the delta was consumed: a fresh snapshot reports no new rejections
+    sig2 = ac.demand_signal()
+    assert sig2.rejected_recent == 0 and sig2.demand == 3
+    # stats() peeks without consuming
+    with pytest.raises(Exception):
+        ac.admit(0)
+    assert ac.stats()["demand"]["rejectedSinceSignal"] == 1
+    assert ac.stats()["demand"]["rejectedSinceSignal"] == 1
+    assert ac.demand_signal().rejected_recent == 1
+
+
+def test_demand_signal_standing_queries_counted():
+    ac = AdmissionController(C.Conf())
+    ac.register_stream()
+    sig = ac.demand_signal()
+    assert sig.standing == 1
+    assert sig.demand == 0            # standing tenants are not backlog
+    ac.unregister_stream()
+
+
+# ---------------------------------------------------------------------------
+# spawn_gang: all-or-none
+# ---------------------------------------------------------------------------
+
+class _FakeProc:
+    def __init__(self):
+        self.terminated = False
+        self.waited = False
+
+    def terminate(self):
+        self.terminated = True
+
+    def wait(self, timeout=None):
+        self.waited = True
+
+
+def test_spawn_gang_kills_and_waits_started_siblings_on_exec_error():
+    """The cli.py leak this seam fixes: a partial gang must never
+    outlive the exec failure that orphaned it — started siblings are
+    terminated AND waited before the error re-raises."""
+    started = []
+
+    def popen(cmd, **kw):
+        if len(started) == 2:
+            raise OSError(8, "Exec format error")
+        pr = _FakeProc()
+        started.append(pr)
+        return pr
+
+    with pytest.raises(OSError):
+        spawn_gang([["a"], ["b"], ["c"], ["d"]], popen=popen)
+    assert len(started) == 2
+    assert all(pr.terminated and pr.waited for pr in started)
+
+
+def test_spawn_gang_returns_all_on_success():
+    procs = spawn_gang([["a"], ["b"]], popen=lambda cmd, **kw: _FakeProc())
+    assert len(procs) == 2
+    assert not any(pr.terminated for pr in procs)
+
+
+# ---------------------------------------------------------------------------
+# the changing world: pool tenants never enter the exchange world
+# ---------------------------------------------------------------------------
+
+def test_parse_host_pid_namespaces():
+    assert parse_host_pid("host-3") == 3
+    assert parse_host_pid("pool-1") is None
+    assert parse_host_pid(SUPERVISOR_OWNER) is None
+    assert parse_host_pid("host-x") is None
+
+
+def test_live_view_unions_joined_hosts():
+    """A worker joined mid-stream widens the planned world; pool-scoped
+    names are ignored — they are serving tenants, not exchange
+    participants."""
+    assert live_view(2, joined_hosts=("host-2", "pool-0",
+                                      "pool-supervisor")) == [0, 1, 2]
+    assert live_view(3, dead_hosts=("host-1",),
+                     joined_hosts=("host-4",)) == [0, 2, 4]
+
+
+# ---------------------------------------------------------------------------
+# scale-down safety: the lease heir chain
+# ---------------------------------------------------------------------------
+
+def test_lease_handoff_keeps_reaped_owner_fresh(tmp_path):
+    """INVARIANTS.md scale-down-safety: after handoff + release, the
+    reaped owner's lease answers fresh exactly as long as the heir's
+    does — sealed output stays adoptable with no file owned by the dead
+    worker."""
+    store = BlockStore(str(tmp_path), C.Conf())
+    store.touch_lease("pool-3")
+    store.handoff_lease("pool-3", SUPERVISOR_OWNER)
+    store.release_lease("pool-3")
+    now = time.time()
+    assert store.lease_fresh("pool-3", now)          # via the heir
+    assert store.lease_fresh(SUPERVISOR_OWNER, now)
+    # heir goes cold -> the whole chain reads cold
+    heir_lease = store._lease_path(SUPERVISOR_OWNER)
+    old = now - store.ttl_s - 10
+    os.utime(heir_lease, (old, old))
+    assert not store.lease_fresh("pool-3", now)
+    # heir sidecars are not owners: stats counts live leases only
+    store.touch_lease(SUPERVISOR_OWNER)
+    assert store.lease_fresh("pool-3", time.time())
+    assert "pool-3.heir" not in store._live_owners()
+
+
+def test_lease_heir_chain_depth_bounded(tmp_path):
+    store = BlockStore(str(tmp_path), C.Conf())
+    # a -> b -> ... beyond MAX_HEIR_DEPTH, last holder fresh
+    names = [f"w{i}" for i in range(store.MAX_HEIR_DEPTH + 2)]
+    for a, b in zip(names, names[1:]):
+        store.handoff_lease(a, b)
+        store.release_lease(a)
+    store.touch_lease(names[-1])
+    assert not store.lease_fresh(names[0], time.time())
+    assert store.lease_fresh(names[-2], time.time())
+
+
+# ---------------------------------------------------------------------------
+# process layer: a real supervisor over real workers
+# ---------------------------------------------------------------------------
+
+def test_pool_spawns_serves_and_reaps_real_workers(spark, tmp_path):
+    """The elasticity acceptance: a burst raises the target and spawns
+    REAL worker processes; one serves a spooled SELECT against the
+    shared warehouse oracle-exactly (marked pooled); idle demand then
+    reaps every worker through hysteresis, handing each lease to the
+    supervisor — and the counters/gauge values tell the same story."""
+    wh = str(tmp_path / "wh")
+    prev_wh = spark.conf_obj.get(C.WAREHOUSE_DIR)
+    spark.conf.set("spark.sql.warehouse.dir", wh)
+    conf = spark.conf_obj
+    conf.set(C.SERVER_POOL_MAX_WORKERS.key, "2")
+    conf.set(C.SERVER_POOL_STATEMENTS_PER_WORKER.key, "2")
+    conf.set(C.SERVER_POOL_SCALE_DOWN_ROUNDS.key, "2")
+    conf.set(C.SERVER_POOL_COOLDOWN.key, "0.0")
+    conf.set(C.SERVER_POOL_POLL.key, "0.1")
+    demand = [DemandSignal()]
+    sup = WorkerPoolSupervisor(
+        str(tmp_path / "pool"), conf, lambda: demand[0],
+        warehouse=wh,
+        blockstore_root=str(tmp_path / "blocks"))
+    try:
+        spark.createDataFrame([(1, "a"), (2, "b"), (3, "c")],
+                              ["id", "name"]).write.saveAsTable("pool_it")
+        sup.start(reconcile=False)
+
+        d = sup.tick()                          # idle: nothing to do
+        assert d.action == "hold" and sup.live == 0
+
+        demand[0] = DemandSignal(queued=3)      # burst: wants 2 workers
+        d = sup.tick()
+        assert d.action == "up" and d.target == 2
+        assert sup.live == 2
+        assert sup.counters["workers_spawned"] == 2
+        assert sup.counters["pool_target"] == 2
+        assert sup.counters["pool_live"] == 2
+
+        deadline = time.monotonic() + 60
+        res = None
+        while res is None and time.monotonic() < deadline:
+            res = sup.execute(
+                "SELECT id, name FROM pool_it ORDER BY id",
+                timeout_s=10.0)
+        assert res is not None, sup.counters
+        assert res["rows"] == [[1, "a"], [2, "b"], [3, "c"]]
+        assert res["pooled"] is True and "poolWorker" in res
+        assert sup.counters["pool_statements_served"] == 1
+
+        store = BlockStore(str(tmp_path / "blocks"), conf)
+        demand[0] = DemandSignal()              # idle: hysteresis reaps
+        deadline = time.monotonic() + 30
+        while sup.live > 0 and time.monotonic() < deadline:
+            sup.tick()
+            time.sleep(0.02)
+        assert sup.live == 0, sup.counters
+        assert sup.counters["workers_reaped"] == 2
+        assert sup.counters["pool_target"] == 0
+        # every reaped worker's lease stays fresh through the heir
+        now = time.time()
+        for wid in (0, 1):
+            assert store.lease_fresh(f"pool-{wid}", now), wid
+        st = sup.stats()
+        assert st["live"] == 0 and st["workers"] == []
+        assert st["lastDecision"]["action"] == "down"
+    finally:
+        sup.stop()
+        spark.conf.set("spark.sql.warehouse.dir", prev_wh)
+        conf.unset(C.SERVER_POOL_MAX_WORKERS.key)
+        conf.unset(C.SERVER_POOL_STATEMENTS_PER_WORKER.key)
+        conf.unset(C.SERVER_POOL_SCALE_DOWN_ROUNDS.key)
+        conf.unset(C.SERVER_POOL_COOLDOWN.key)
+        conf.unset(C.SERVER_POOL_POLL.key)
+
+
+def test_pool_execute_with_no_workers_falls_back():
+    conf = C.Conf()
+    sup = WorkerPoolSupervisor("/nonexistent-pool-root", conf,
+                               lambda: DemandSignal())
+    assert sup.execute("SELECT 1") is None
+    assert sup.counters["offload_fallbacks"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 chaos cell: reap mid-fetch, adoption, zero re-execution
+# ---------------------------------------------------------------------------
+
+def test_reap_mid_fetch_adopts_with_zero_rerun(tmp_path):
+    """The scale-down acceptance (pool_worker.py mode "reap"): worker 1
+    is cooperatively REAPED the moment its last manifest lands — stops
+    beating, hands its lease to the pool supervisor, exits 0 — while
+    its shipped jR block is dropped from the raw exchange dir.  Worker
+    0, with the stage-retry budget at ZERO, still lands the exact
+    oracle by adopting the reaped worker's registered blocks: zero
+    re-executed map tasks, zero recovery epochs, retry budget untouched
+    — and the reaped lease answers fresh through the heir chain."""
+    sc = cm.by_name("pool-reap-mid-fetch")
+    assert sc["tier"] == "tier1"
+    results, elapsed = cm.run_scenario(sc, str(tmp_path / "shuf"))
+    bad = cm.check(sc, results, elapsed)
+    assert not bad, (bad, {p: (rc, out[-400:])
+                           for p, (rc, out) in results.items()})
+    out0, out1 = results[0][1], results[1][1]
+    assert "retries=0" in out0 and "adopted=1b" in out0, out0
+    assert "heir-lease=fresh" in out0, out0
+    assert "reaped at xq000001-gather" in out1, out1
+    assert f"lease->{SUPERVISOR_OWNER}" in out1, out1
