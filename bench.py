@@ -77,6 +77,10 @@ DS_KEYS = 1 << 12    # distsort key cardinality; half the probe mass sits
 DS_HOT = 77          # on this ONE hot key (the skew under test)
 DD_ROWS = 24000      # distdict lane: rows per table (low-cardinality keys)
 DD_KEYS = 2500       # distinct fat words (~30 B each: dict ~75 KiB/column)
+DR_ROWS = 1 << 18    # distrle lane: time-series rows (full dataset) —
+                     # sized so the exchange dwarfs the barrier overhead
+DR_KEYS = 256        # distinct timestamps — each repeats 1024x, so the
+                     # sorted spans carry long runs in ts/sensor/status
 DA_ROWS = 1 << 20    # distadapt lane: rows per table (full dataset)
 DA_KEYS = 1 << 13    # join-key cardinality
 DA_CUT = 3           # right-side filter: bonus < 3 keeps ~2% of rows, a
@@ -1465,6 +1469,171 @@ def distdict_worker_main() -> None:
     sys.stdout.flush()
 
 
+def _bench_dist_rle() -> dict:
+    """Distrle lane: run-length/delta encoded execution over the DCN
+    exchange.  A 2-process time-series join + group-by runs twice with
+    only ``spark.tpu.shuffle.wire.runCodes`` toggled: "runs" lets the
+    sampled-benefit probe RLE/delta-encode each block column (and the
+    range sort-merge path emit its presorted span slices as free runs),
+    "raw" ships every column dense (the legacy wire).  Same range
+    sort-merge path, identical results cross-checked; the byte
+    reduction is the run compression of the sorted ts/sensor/status
+    planes, measured end to end against an incompressible payload
+    column that ships dense in both modes.
+
+    Acceptance (raises into ``distrle_error`` when missed): >=2x DCN
+    byte reduction, runs wall clock <= 1.1x the raw wall, checksums
+    byte-identical across modes and processes."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="spark_tpu_bench_dr_")
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("SPARK_TPU_FAULT_PLAN", None)
+        env.pop("SPARK_TPU_PLATFORM", None)
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--distrle-worker", str(pid), d],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for pid in (0, 1)]
+        outs = [p.communicate(timeout=CHILD_TIMEOUT_S) for p in procs]
+        objs = []
+        for p, (out, err) in zip(procs, outs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"distrle worker rc={p.returncode}: "
+                    f"{(err or out).strip().splitlines()[-3:]}")
+            line = [ln for ln in out.splitlines()
+                    if ln.strip().startswith("{")][-1]
+            objs.append(json.loads(line))
+        # both wire formats, both processes: byte-identical aggregates
+        sums = {o[m]["checksum"] for o in objs for m in ("runs", "raw")}
+        if len(sums) != 1:
+            raise RuntimeError(f"runs/raw results diverge: {objs}")
+        # span ownership need not balance, so a process that keeps its
+        # shard local frames nothing — the EXCHANGE must run-encode
+        if sum(o["runs"]["rle_columns_encoded"] for o in objs) == 0:
+            raise RuntimeError(f"runs run never run-encoded a column: {objs}")
+        if not all(o["raw"]["rle_columns_encoded"] == 0 for o in objs):
+            raise RuntimeError(f"raw run framed run codes: {objs}")
+        rows = objs[0]["rows_total"]
+        ru_s = max(o["runs"]["seconds"] for o in objs)
+        ra_s = max(o["raw"]["seconds"] for o in objs)
+        ru_b = sum(o["runs"]["bytes_written"] for o in objs)
+        ra_b = sum(o["raw"]["bytes_written"] for o in objs)
+        reduction = ra_b / max(1, ru_b)
+        wall_ratio = ru_s / max(1e-9, ra_s)
+        if reduction < 2.0:
+            raise RuntimeError(
+                f"DCN byte reduction {reduction:.2f}x < 2x "
+                f"(runs {ru_b} B vs raw {ra_b} B)")
+        if wall_ratio > 1.1:
+            raise RuntimeError(
+                f"runs wall {ru_s:.3f}s is {wall_ratio:.2f}x raw "
+                f"{ra_s:.3f}s (> 1.1x budget)")
+        return {
+            "distrle_rows_per_sec": round(rows / ru_s, 1),
+            "distrle_raw_rows_per_sec": round(rows / ra_s, 1),
+            "distrle_wall_vs_raw": round(wall_ratio, 3),
+            "distrle_dcn_bytes": ru_b,
+            "distrle_raw_dcn_bytes": ra_b,
+            "distrle_dcn_byte_reduction": round(reduction, 2),
+            "distrle_run_bytes_saved": sum(
+                o["runs"]["run_bytes_saved"] for o in objs),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def distrle_worker_main() -> None:
+    """One process of the distrle lane (see ``_bench_dist_rle``).
+
+    argv: --distrle-worker <pid> <root>.  Prints ONE JSON line with warm
+    wall-clock and service counters for the runs and raw wire modes."""
+    i = sys.argv.index("--distrle-worker")
+    pid, root = int(sys.argv[i + 1]), sys.argv[i + 2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import zlib
+
+    from spark_tpu import config as C
+    from spark_tpu.sql.session import SparkSession
+
+    # time-series shape: ts repeats in long blocks, sensor and status
+    # follow ts (long runs after the range sort), v is an incompressible
+    # random payload that ships dense in both modes — the honest floor
+    rep = DR_ROWS // DR_KEYS
+    ts = np.repeat(np.arange(DR_KEYS, dtype=np.int64), rep)
+    sensor = (ts // 4).astype(np.int64)
+    status = np.array(["ok", "warn", "err"])[
+        (np.arange(DR_ROWS) // 512) % 3]
+    rng = np.random.default_rng(59)
+    v = rng.integers(1, 1 << 30, DR_ROWS).astype(np.int64)
+    dk = np.arange(DR_KEYS, dtype=np.int64)
+    bonus = (dk * 3 + 7).astype(np.int64)
+    mine = slice(pid, None, 2)
+    Q = ("SELECT status, count(*) AS c, sum(v) AS sv, "
+         "sum(sensor) AS ss, sum(bonus) AS sb FROM ev "
+         "JOIN dm ON ts = dk GROUP BY status ORDER BY status")
+
+    session = SparkSession.builder.appName(f"bench-dr-{pid}").getOrCreate()
+    out = {"pid": pid, "rows_total": int(DR_ROWS)}
+    for mode in ("runs", "raw"):
+        xs = session.newSession()
+        xs.conf.set(C.MESH_SHARDS.key, "1")
+        xs.conf.set(C.SHUFFLE_WIRE_RUN_CODES.key,
+                    "true" if mode == "runs" else "false")
+        # pin the range sort-merge path both runs: the sorted spans are
+        # where presorted-slice RLE is free, and this lane measures the
+        # WIRE format, not a join-strategy difference
+        xs.conf.set(C.CROSSPROC_SORT_MERGE_JOIN.key, "true")
+        xs.conf.set(C.CROSSPROC_SHUFFLED_JOIN.key, "false")
+        xs.conf.set(C.CROSSPROC_AUTO_BROADCAST.key, "0")
+        xs.conf.set(C.SHUFFLE_FINE_PARTITIONS.key, "16")
+        svc = xs.enableHostShuffle(os.path.join(root, mode),
+                                   process_id=pid, n_processes=2,
+                                   timeout_s=300.0)
+        xs.createDataFrame({"ts": ts[mine], "sensor": sensor[mine],
+                            "status": status[mine], "v": v[mine]}) \
+            .createOrReplaceTempView("ev")
+        xs.createDataFrame({"dk": dk[mine], "bonus": bonus[mine]}) \
+            .createOrReplaceTempView("dm")
+        xs.sql(Q).collect()                  # warm: compile + caches
+        # median-of-3: filesystem-barrier jitter dominates run-to-run
+        # variance, and both processes must repeat in lockstep anyway
+        iters = []
+        for _ in range(3):
+            it_bytes = int(svc.counters["bytes_written"])
+            it_rows = int(svc.counters["rows_shipped"])
+            t0 = time.perf_counter()
+            rows = xs.sql(Q).collect()
+            iters.append((time.perf_counter() - t0,
+                          int(svc.counters["bytes_written"]) - it_bytes,
+                          int(svc.counters["rows_shipped"]) - it_rows))
+        elapsed, it_bytes, it_rows = sorted(iters)[1]
+        chk = 0
+        for r in rows:                 # order pinned by ORDER BY status
+            chk = (chk * 1000003 + zlib.crc32(str(r[0]).encode())
+                   + 7 * int(r[1]) + int(r[2]) + 3 * int(r[3])
+                   + int(r[4])) & 0xFFFFFFFF
+        out[mode] = {
+            "seconds": round(elapsed, 3),
+            "bytes_written": it_bytes,
+            "rows_shipped": it_rows,
+            "groups": len(rows),
+            "checksum": chk,
+            "rle_columns_encoded": int(
+                svc.counters["rle_columns_encoded"]),
+            "run_bytes_saved": int(svc.counters["run_bytes_saved"]),
+        }
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
 def _bench_dist_sort() -> dict:
     """Distsort lane: the SKEWED 2-process equi-join, range-partitioned
     sort-merge (with skew-span splitting) vs the shuffled hash path.
@@ -2462,6 +2631,13 @@ def child_main() -> None:
         print(f"[bench-child] distdict bench failed: {e}", file=sys.stderr)
         extras["distdict_error"] = str(e)[:300]
     try:
+        # run-length encoded execution: 2 real worker processes,
+        # sorted time-series join, run-coded wire vs dense blocks
+        extras.update(_bench_dist_rle())
+    except Exception as e:   # secondary must not sink the primary
+        print(f"[bench-child] distrle bench failed: {e}", file=sys.stderr)
+        extras["distrle_error"] = str(e)[:300]
+    try:
         # memory-pressure path: the distjoin workload with the host
         # budget capped below the working set — must complete, spill,
         # and match the uncapped aggregates
@@ -2539,6 +2715,8 @@ if __name__ == "__main__":
         distsort_worker_main()
     elif "--distdict-worker" in sys.argv:
         distdict_worker_main()
+    elif "--distrle-worker" in sys.argv:
+        distrle_worker_main()
     elif "--distspill-worker" in sys.argv:
         distspill_worker_main()
     elif "--distgrace-worker" in sys.argv:

@@ -260,12 +260,22 @@ def verify_unified_dictionaries(node, batches: Sequence) -> None:
                         f"column {name!r}: post-exchange dictionary is "
                         f"not strictly sorted ({a!r} !< {w!r}) — code "
                         "order no longer equals word order")
-            codes = np.asarray(v.data)
-            if codes.ndim != 1:
-                continue              # array-of-string planes: 2-D codes
-            mask = rv if v.valid is None \
-                else rv & np.asarray(v.valid).astype(bool)
-            live = codes[mask[:codes.shape[0]]] if codes.size else codes
+            from ..columnar import unmaterialized_runs
+            runs = unmaterialized_runs(v)
+            if runs is not None and v.valid is None and bool(rv.all()):
+                # run-encoded column, fully live: every row's code is one
+                # of the run VALUES — check the run table, don't inflate
+                live = np.asarray(runs.run_values)
+                if live.ndim != 1:
+                    continue
+            else:
+                codes = np.asarray(v.data)
+                if codes.ndim != 1:
+                    continue          # array-of-string planes: 2-D codes
+                mask = rv if v.valid is None \
+                    else rv & np.asarray(v.valid).astype(bool)
+                live = codes[mask[:codes.shape[0]]] if codes.size \
+                    else codes
             if live.size and (int(live.min()) < 0
                               or int(live.max()) >= len(words)):
                 off = int(live.min()) if int(live.min()) < 0 \

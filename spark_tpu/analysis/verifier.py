@@ -170,6 +170,15 @@ def _check_logical(node) -> None:
     # performs the arity/coercion validation — covered by _schema_of.
 
 
+def _vec_np_dtype(v) -> np.dtype:
+    """A vector's physical np dtype WITHOUT touching ``.data`` — that
+    would inflate a lazy run-encoded column just to learn its dtype
+    (the run values share the dense array's dtype by construction)."""
+    from ..columnar import unmaterialized_runs
+    r = unmaterialized_runs(v)
+    return np.dtype((r.run_values if r is not None else v.data).dtype)
+
+
 def _check_leaf_batch(node, schema) -> None:
     """A leaf's claimed field dtypes must match the physical arrays that
     will back the PScan — the dtype-propagation ground truth."""
@@ -178,7 +187,7 @@ def _check_leaf_batch(node, schema) -> None:
         if isinstance(f.dataType, T.ArrayType):
             continue                       # 2-D element planes: elementwise
         want = np.dtype(f.dataType.np_dtype)
-        got = np.dtype(v.data.dtype)      # .dtype avoids device transfer
+        got = _vec_np_dtype(v)            # .dtype avoids device transfer
         if got != want:
             raise PlanInvariantError(
                 node, "leaf-dtype",
@@ -313,7 +322,7 @@ def _check_scan_leaf(scan, batch) -> None:
         if isinstance(f.dataType, T.ArrayType):
             continue
         want = np.dtype(f.dataType.np_dtype)
-        got = np.dtype(v.data.dtype)
+        got = _vec_np_dtype(v)
         if got != want:
             raise PlanInvariantError(
                 scan, "scan-leaf-dtype",

@@ -103,6 +103,36 @@ def late_materialized_rows() -> int:
     return _LATE_MATERIALIZED_ROWS
 
 
+#: running total of run-encoded values expanded to dense arrays — the run
+#: analog of ``_LATE_MATERIALIZED_ROWS``.  Columns that stay run-encoded
+#: through filter/aggregate/join never show up here; only operators that
+#: genuinely need the dense form (or ``to_pylist``) do.
+_RUNS_MATERIALIZED = 0
+
+#: running total of rows whose operator work was done at run granularity
+#: (one predicate eval / one probe / one multiply per run instead of per
+#: row) — proof the run-aware fast paths actually fired.
+_RUN_AWARE_OP_ROWS = 0
+
+
+def runs_materialized() -> int:
+    """Total run-encoded values expanded to dense arrays so far
+    (process-wide; gauge consumers diff against a baseline)."""
+    return _RUNS_MATERIALIZED
+
+
+def run_aware_op_rows() -> int:
+    """Total rows served by run-aware operator fast paths so far
+    (process-wide; gauge consumers diff against a baseline)."""
+    return _RUN_AWARE_OP_ROWS
+
+
+def bump_run_aware(n: int) -> None:
+    """Credit ``n`` rows to the run-aware fast-path counter."""
+    global _RUN_AWARE_OP_ROWS
+    _RUN_AWARE_OP_ROWS += int(n)
+
+
 def encode_strings(values: Sequence[Optional[str]]) -> Tuple[np.ndarray, Tuple[str, ...]]:
     """Dictionary-encode strings: codes into a SORTED dictionary.
 
@@ -318,7 +348,12 @@ class ColumnBatch:
     def row_valid_or_true(self) -> Array:
         if self.row_valid is not None:
             return self.row_valid
-        xp = jnp if any(isinstance(v.data, jax.Array) for v in self.vectors) else np
+        # probe device residency without touching .data — that would
+        # materialize a lazy RunColumnVector (which is host by nature)
+        xp = jnp if any(
+            isinstance(v._dense if isinstance(v, RunColumnVector)
+                       else v.data, jax.Array)
+            for v in self.vectors) else np
         return xp.ones(self.capacity, dtype=bool)
 
     def num_rows(self):
@@ -354,6 +389,84 @@ class ColumnBatch:
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"ColumnBatch({self.schema.simpleString()}, capacity={self.capacity})"
+
+
+class RunColumnVector(ColumnVector):
+    """Run-length encoded column: ``(run_values, run_lengths)`` standing in
+    for a dense array of ``sum(run_lengths)`` elements.
+
+    The dense form is produced lazily on the first ``.data`` access (counted
+    in ``runs_materialized``); run-aware operators read ``run_values`` /
+    ``run_lengths`` directly and never pay the expansion.  Everything else —
+    validity, dtype, dictionary, pytree participation — behaves exactly like
+    a dense ``ColumnVector``, so the lazy form is a drop-in safety net: any
+    code path that was not taught about runs simply materializes."""
+
+    __slots__ = ("run_values", "run_lengths", "_n", "_dense")
+
+    def __init__(self, run_values: Array, run_lengths: Array,
+                 dtype: T.DataType, valid: Optional[Array] = None,
+                 dictionary: Optional[Tuple[str, ...]] = None):
+        self.run_values = np.asarray(run_values)
+        self.run_lengths = np.asarray(run_lengths, dtype=np.int64)
+        self._n = int(self.run_lengths.sum())
+        self._dense = None
+        self.dtype = dtype
+        self.valid = valid
+        self.dictionary = dictionary
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"RunColumnVector({self.dtype!r}, n={self._n}, "
+                f"runs={len(self.run_values)}, "
+                f"materialized={self._dense is not None})")
+
+    @property
+    def is_materialized(self) -> bool:
+        return self._dense is not None
+
+    @property
+    def data(self) -> Array:
+        # shadows the parent's `data` slot: expansion happens here, once
+        if self._dense is None:
+            global _RUNS_MATERIALIZED
+            _RUNS_MATERIALIZED += self._n
+            self._dense = np.repeat(self.run_values, self.run_lengths)
+        return self._dense
+
+    @property
+    def capacity(self) -> int:
+        return self._n
+
+    def valid_or_true(self) -> Array:
+        if self.valid is not None:
+            return self.valid
+        return np.ones(self._n, dtype=bool)
+
+    def to_host(self) -> "ColumnVector":
+        return self  # run tables are always host arrays
+
+    def to_device(self) -> "ColumnVector":
+        return ColumnVector(jnp.asarray(self.data), self.dtype,
+                            None if self.valid is None
+                            else jnp.asarray(self.valid),
+                            self.dictionary)
+
+    def with_run_values(self, run_values: Array,
+                        dictionary: Union[Tuple[str, ...], None,
+                                          type(...)] = ...) -> "RunColumnVector":
+        """New run vector with remapped run values (same run structure) —
+        the seam dictionary-code remapping uses to stay run-preserving."""
+        d = self.dictionary if dictionary is ... else dictionary
+        return RunColumnVector(run_values, self.run_lengths, self.dtype,
+                               self.valid, d)
+
+
+def unmaterialized_runs(v: ColumnVector) -> Optional[RunColumnVector]:
+    """``v`` if it is a run-encoded column whose dense form was never built
+    (so run-granularity work is still a win), else None."""
+    if isinstance(v, RunColumnVector) and not v.is_materialized:
+        return v
+    return None
 
 
 class PrebuiltColumn:

@@ -367,6 +367,16 @@ SHUFFLE_WIRE_DICT_CODES = conf("spark.tpu.shuffle.wire.dictCodes").doc(
     "dictionaries (still always decodable)."
 ).boolean(True)
 
+SHUFFLE_WIRE_RUN_CODES = conf("spark.tpu.shuffle.wire.runCodes").doc(
+    "Run-length/delta encode eligible shuffle wire columns (per-column "
+    "sampled-benefit probe; presorted range-lane spans tag their runs "
+    "for free) and keep RLE columns as lazy run vectors on decode, so "
+    "run-aware operators (filter, count/sum, hash-join probe) work at "
+    "run granularity and expansion happens only where a dense array is "
+    "genuinely needed.  Off = raw columns (legacy frames always decode "
+    "either way)."
+).boolean(True)
+
 SHUFFLE_IO_ASYNC_WRITE = conf("spark.tpu.shuffle.io.asyncWrite").doc(
     "Stage shuffle blocks through a background writer thread so encode+"
     "disk I/O overlaps the device's next exchange step; commit() drains "

@@ -25,8 +25,11 @@ import numpy as np
 
 from . import types as T
 from .aggregates import AggregateFunction, First, IDENTITY
-from .columnar import ColumnBatch, ColumnVector, merge_dictionaries
-from .expressions import EvalContext, Expression, ExprValue
+from .columnar import (ColumnBatch, ColumnVector, RunColumnVector,
+                       bump_run_aware, merge_dictionaries,
+                       unmaterialized_runs)
+from .expressions import (Col, EvalContext, Expression, ExprValue, Rand,
+                          RowIndex, SparkPartitionId)
 
 Array = Any
 
@@ -286,11 +289,112 @@ def compact(xp, batch: ColumnBatch) -> ColumnBatch:
 
 
 # ---------------------------------------------------------------------------
+# run-length / delta codecs (the wire.py "enc" tags; see RunColumnVector)
+# ---------------------------------------------------------------------------
+
+def rle_encode(data: Array) -> Tuple[np.ndarray, np.ndarray]:
+    """Run-length encode a 1-D host array into ``(run_values, run_lengths)``.
+
+    Run detection is ONE vectorized diff + nonzero — no Python loop."""
+    data = np.asarray(data)
+    n = len(data)
+    if n == 0:
+        return data[:0], np.zeros(0, np.int64)
+    change = np.nonzero(data[1:] != data[:-1])[0] + 1
+    starts = np.concatenate([np.zeros(1, np.int64), change])
+    lengths = np.diff(np.concatenate([starts, np.asarray([n], np.int64)]))
+    return data[starts], lengths.astype(np.int64)
+
+
+def rle_expand(xp, run_values: Array, run_lengths: Array) -> Array:
+    """Expand a run table back to the dense array (cumsum/repeat only, so
+    the jax lane traces when the output length is static)."""
+    return xp.repeat(xp.asarray(run_values), xp.asarray(run_lengths))
+
+
+def delta_encode(data: Array) -> Optional[Tuple[int, np.ndarray]]:
+    """Delta / frame-of-reference encode a 1-D signed-int host array as
+    ``(base, diffs)`` with diffs downcast to the narrowest of
+    int8/int16/int32 that bounds them.  Diffs are taken in int64 modular
+    arithmetic, so ``delta_decode``'s cumsum reconstruction is exact even
+    across wraparound.  Returns None when no strictly narrower diff dtype
+    exists (encoding would not shrink the column)."""
+    data = np.asarray(data)
+    if len(data) < 2:
+        return None
+    d64 = np.diff(data.astype(np.int64))
+    lo, hi = int(d64.min()), int(d64.max())
+    for cand in (np.int8, np.int16, np.int32):
+        if np.dtype(cand).itemsize >= data.dtype.itemsize:
+            break
+        info = np.iinfo(cand)
+        if info.min <= lo and hi <= info.max:
+            return int(data[0]), d64.astype(cand)
+    return None
+
+
+def delta_decode(xp, base: int, diffs: Array, np_dtype, n: int) -> Array:
+    """cumsum reconstruction of a delta-encoded column; exact under int64
+    modular arithmetic regardless of the original dtype's wraparound."""
+    if n == 0:
+        return xp.zeros(0, np_dtype)
+    d = xp.asarray(diffs).astype(np.int64)
+    prefix = xp.concatenate([xp.zeros(1, np.int64), xp.cumsum(d)])
+    return (np.int64(base) + prefix).astype(np_dtype)
+
+
+# ---------------------------------------------------------------------------
 # row-mask operators
 # ---------------------------------------------------------------------------
 
+#: expression classes whose value depends on the row's POSITION rather than
+#: the row's data — a run head cannot stand in for its whole run under these
+#: (Randn subclasses Rand; all four read ``ctx.row_offset``)
+_POSITIONAL_EXPRS = (Rand, RowIndex, SparkPartitionId)
+
+
+def _run_aware_filter(batch: ColumnBatch,
+                      pred: Expression) -> Optional[ColumnBatch]:
+    """Evaluate ``pred`` once per run head and expand the selection mask by
+    run length.  Applies when the predicate references exactly one column,
+    that column is an unexpanded run vector covering the batch, and the
+    predicate is data-deterministic (no positional expressions).  Returns
+    None to fall back to the dense path."""
+    refs = pred.references()
+    if len(refs) != 1:
+        return None
+    name = next(iter(refs))
+    if name not in batch.names:
+        return None
+    rv = unmaterialized_runs(batch.column(name))
+    if rv is None or rv.valid is not None or rv.capacity != batch.capacity:
+        return None
+    stack = [pred]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, _POSITIONAL_EXPRS):
+            return None
+        stack.extend(e.children)
+    n_runs = len(rv.run_values)
+    head = ColumnBatch(
+        [name], [ColumnVector(rv.run_values, rv.dtype, None, rv.dictionary)],
+        None, n_runs)
+    v = pred.eval(EvalContext(head, np))
+    keep = np.broadcast_to(np.asarray(v.data), (n_runs,))
+    if v.valid is not None:
+        keep = keep & np.broadcast_to(np.asarray(v.valid), (n_runs,))
+    keep = np.repeat(keep.astype(bool), rv.run_lengths)
+    bump_run_aware(batch.capacity)
+    out_rv = np.asarray(batch.row_valid_or_true()) & keep
+    return ColumnBatch(batch.names, batch.vectors, out_rv, batch.capacity)
+
+
 def apply_filter(xp, batch: ColumnBatch, pred: Expression,
                  row_offset: int = 0) -> ColumnBatch:
+    if _is_np(xp) and row_offset == 0:
+        out = _run_aware_filter(batch, pred)
+        if out is not None:
+            return out
     ctx = EvalContext(batch, xp, row_offset)
     v = pred.eval(ctx)
     keep = v.data
@@ -426,7 +530,68 @@ def grouped_aggregate(
             and _mxu_applicable(batch.schema, key_exprs, agg_slots):
         return _mxu_grouped_aggregate(xp, batch, key_exprs, agg_slots,
                                       bucket_cap)
+    if _is_np(xp) and not key_exprs:
+        out = _run_aware_global_aggregate(batch, agg_slots)
+        if out is not None:
+            return out
     return _sorted_grouped_aggregate(xp, batch, key_exprs, agg_slots)
+
+
+def _run_aware_global_aggregate(
+    batch: ColumnBatch,
+    agg_slots: Sequence[Tuple[AggregateFunction, str]],
+) -> Optional[ColumnBatch]:
+    """Keyless count/sum over run-encoded columns without expansion: a run
+    contributes ``value × length`` with one multiply.  Fires only when the
+    result is provably byte-identical to the dense path — every slot is
+    count(*)/count/sum (non-distinct) over a bare column whose vector is an
+    unexpanded run table with no NULLs covering a fully-live batch; integer
+    sums match the dense path exactly because int64 products and sums both
+    wrap mod 2^64 (floats are excluded: their addition is not associative).
+    Returns None to fall back to the general path."""
+    from .aggregates import Count, CountStar, Sum
+    if batch.row_valid is not None or batch.capacity == 0 or not agg_slots:
+        return None
+    cap = batch.capacity
+    plans = []
+    for func, name in agg_slots:
+        if getattr(func, "is_distinct", False):
+            return None
+        if isinstance(func, CountStar):
+            plans.append((func, name, None))
+            continue
+        if type(func) not in (Count, Sum):
+            return None
+        child = func.children[0]
+        if not isinstance(child, Col) or child.name not in batch.names:
+            return None
+        rv = unmaterialized_runs(batch.column(child.name))
+        if rv is None or rv.valid is not None or rv.capacity != cap:
+            return None
+        if isinstance(func, Sum) \
+                and np.asarray(rv.run_values).dtype.kind not in "iub":
+            return None
+        plans.append((func, name, rv))
+    if all(rv is None for _, _, rv in plans):
+        return None  # nothing run-encoded: nothing to claim credit for
+    schema = batch.schema
+    names: List[str] = []
+    vectors: List[ColumnVector] = []
+    for func, name, rv in plans:
+        dt = func.data_type(schema)
+        if rv is None or isinstance(func, (CountStar, Count)):
+            # no NULLs and no dead rows ⇒ count == capacity
+            out = ColumnVector(np.asarray([cap], dt.np_dtype), dt, None, None)
+        else:
+            out_np = dt.np_dtype
+            total = (np.asarray(rv.run_values).astype(out_np)
+                     * rv.run_lengths.astype(out_np)).sum(dtype=out_np)
+            out = ColumnVector(np.asarray([total], out_np), dt,
+                               np.asarray([True]), None)
+        names.append(name)
+        vectors.append(out)
+    bump_run_aware(cap)
+    return ColumnBatch(names, vectors, None, 1)
 
 
 def _sorted_grouped_aggregate(
@@ -1095,6 +1260,20 @@ def union_all(batches: Sequence[ColumnBatch]) -> ColumnBatch:
         vecs = [b.vectors[ci] for b in batches]
         dtype = vecs[0].dtype
         dicts = [v.dictionary for v in vecs]
+        runs = [unmaterialized_runs(v) for v in vecs]
+        if (all(r is not None and r.valid is None for r in runs)
+                and all(r.capacity == b.capacity
+                        for r, b in zip(runs, batches))
+                and len({d or () for d in dicts}) == 1):
+            # every piece is still run-encoded over one shared code space:
+            # concatenate the run TABLES and stay lazy (adjacent equal
+            # values across a seam are two runs — still a valid table)
+            rvals = np.concatenate(
+                [np.asarray(r.run_values, dtype.np_dtype) for r in runs])
+            rlens = np.concatenate([r.run_lengths for r in runs])
+            vectors.append(RunColumnVector(rvals, rlens, dtype, None,
+                                           dicts[0]))
+            continue
         if dtype.is_string or isinstance(dtype, T.BinaryType):
             if len({d or () for d in dicts}) == 1:
                 data = np.concatenate([np.asarray(v.data) for v in vecs])

@@ -108,9 +108,17 @@ class HostMemoryPressure(HostMemoryError):
 
 
 def batch_nbytes(batch: ColumnBatch) -> int:
+    from .columnar import unmaterialized_runs
     total = 0
     for v in batch.vectors:
-        total += np.dtype(v.dtype.np_dtype).itemsize * batch.capacity
+        runs = unmaterialized_runs(v)
+        if runs is not None:
+            # lazy run vector: the ledger charges what is actually held
+            # (run values + int64 lengths), not the inflated row count
+            total += int(np.asarray(runs.run_values).nbytes
+                         + np.asarray(runs.run_lengths).nbytes)
+        else:
+            total += np.dtype(v.dtype.np_dtype).itemsize * batch.capacity
         if v.valid is not None:
             total += batch.capacity
     if batch.row_valid is not None:

@@ -1596,7 +1596,8 @@ def _grace_spill_buckets(svc: HostShuffleService, xid: str, sdir: str,
             sub = slice_rows(bucketed, int(off[p]), c)
             buf = wire.encode_batches(
                 [sub], codec=svc.wire_codec,
-                compress_threshold=svc.wire_threshold)
+                compress_threshold=svc.wire_threshold,
+                run_codes=svc.run_codes)
             path = os.path.join(sdir, f"{exch}-{tag}-b{p:04d}.run")
             entry = out.setdefault(p, [path, 0, 0])
             try:
@@ -1660,7 +1661,7 @@ def _grace_join_bucket(session, join, svc: HostShuffleService, xid: str,
                 continue
             with open(meta[0], "rb") as f:
                 data = f.read()
-            frames = wire.decode_frames(data)
+            frames = wire.decode_frames(data, keep_runs=svc.run_codes)
             del data
             os.remove(meta[0])
             subs.append(_grace_spill_buckets(
@@ -1684,7 +1685,8 @@ def _grace_join_bucket(session, join, svc: HostShuffleService, xid: str,
             else:
                 with open(meta[0], "rb") as f:
                     data = f.read()
-                runs = svc._unify_code_space(wire.decode_frames(data))
+                runs = svc._unify_code_space(
+                    wire.decode_frames(data, keep_runs=svc.run_codes))
                 side_b = union_all(runs) if len(runs) > 1 else runs[0]
             assembled.append(side_b)
         if checks:
@@ -1909,6 +1911,11 @@ def _range_merge_join_shards(session, join, spec,
         staged_sides: List[_StagedSide] = []
         sizes: Dict[int, int] = {}
         side_obs: Dict[str, List[int]] = {}
+        # every per-span host slice is a SORTED RUN (tie sort below), so
+        # tag both range exchanges presorted: the wire encoder ships the
+        # spans as run tables without paying the sampled-benefit probe
+        for tag in ("rL", "rR"):
+            svc.mark_presorted(f"{xid}-{tag}")
         for (base, tag), (local, enc, ok, kdict) in zip(
                 ((0, "rL"), (n_spans, "rR")), sides):
             local_cuts = np.searchsorted(

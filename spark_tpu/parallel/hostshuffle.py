@@ -126,16 +126,19 @@ def _jitter(seed: str, attempt: int) -> float:
 
 
 def _decode_block(data: bytes,
-                  dict_table: Optional[Dict[str, tuple]] = None
-                  ) -> List[ColumnBatch]:
+                  dict_table: Optional[Dict[str, tuple]] = None,
+                  keep_runs: bool = False) -> List[ColumnBatch]:
     """Wire-framed payload → batches; pre-wire pickle blocks (a mixed-
     version pod mid-upgrade) still decode, keyed off the magic bytes.
     ``dict_table`` resolves fingerprint-only dictionary references
     (blocks written with the dedup wire, ``wire.dict_fingerprint``).
-    A block may hold SEVERAL back-to-back frames (map-side spill spans
-    copied straight from a spill file) — all of them decode."""
+    ``keep_runs`` leaves RLE columns as lazy run vectors for the
+    run-aware operator fast paths.  A block may hold SEVERAL
+    back-to-back frames (map-side spill spans copied straight from a
+    spill file) — all of them decode."""
     if data[:4] == wire.MAGIC or len(data) < wire.PREFIX_LEN:
-        return wire.decode_frames(data, dict_table=dict_table)
+        return wire.decode_frames(data, dict_table=dict_table,
+                                  keep_runs=keep_runs)
     return pickle.loads(data)
 
 
@@ -246,7 +249,8 @@ class FetchSink:
                 # resolved theirs, so the frame is self-contained)
                 buf = wire.encode_batches(
                     [b], codec=self.svc.wire_codec,
-                    compress_threshold=self.svc.wire_threshold)
+                    compress_threshold=self.svc.wire_threshold,
+                    run_codes=self.svc.run_codes)
                 if path is None:
                     path = self._run_path(sender)
                 try:
@@ -306,7 +310,8 @@ class FetchSink:
                         raise OSError(
                             f"spill run {path}: short read {len(data)} "
                             f"of {length} B at {start}")
-                    out.extend(wire.decode_frames(data))
+                    out.extend(wire.decode_frames(
+                        data, keep_runs=self.svc.run_codes))
         return out
 
     def pop_entries(self):
@@ -337,7 +342,8 @@ class FetchSink:
                     raise OSError(
                         f"spill run {path}: short read {len(data)} "
                         f"of {length} B at {start}")
-                for batch in wire.decode_frames(data):
+                for batch in wire.decode_frames(
+                        data, keep_runs=self.svc.run_codes):
                     yield batch
             if path is not None:
                 try:
@@ -491,6 +497,11 @@ class HostShuffleService:
         self.wire_codec = conf.get(C.SHUFFLE_WIRE_CODEC)
         self.wire_threshold = conf.get(C.SHUFFLE_WIRE_COMPRESS_THRESHOLD)
         self.dict_codes = conf.get(C.SHUFFLE_WIRE_DICT_CODES)
+        self.run_codes = conf.get(C.SHUFFLE_WIRE_RUN_CODES)
+        #: exchanges whose map output is presorted span slices (the range
+        #: sort-merge lane): their sorted runs are free RLE fodder, so
+        #: encode skips the sampled probe and tags them directly
+        self._presorted_exchanges: set = set()
         if host_names is None:
             # single-sourced naming convention (lazy: cluster pulls jax)
             from .cluster import default_host_name
@@ -546,6 +557,15 @@ class HostShuffleService:
             # and receiver-side remaps into the unified code space
             "dict_columns_encoded": 0, "dict_bytes_saved": 0,
             "codes_remapped": 0,
+            # run-length/delta encoded execution: columns that shipped
+            # as run tables or narrow deltas instead of raw, the raw
+            # bytes those encodings never paid, rows served by run-aware
+            # operator fast paths, and run values expanded to dense form
+            # (the last two shadow process-wide module counters in
+            # ``metrics_source``; the dict slots keep registration
+            # uniform for /status and the stats merge)
+            "rle_columns_encoded": 0, "run_bytes_saved": 0,
+            "run_aware_op_rows": 0, "runs_materialized": 0,
             # memory-pressure ladder: bytes/events spilled to disk on
             # either side of an exchange, and fetch workers that had to
             # wait for in-flight-bytes room
@@ -658,6 +678,10 @@ class HostShuffleService:
         #: process-wide late-materialization count at service birth, so
         #: the gauge reports this service's lifetime only
         self._latemat_base = _col.late_materialized_rows()
+        #: run-counter analogs of ``_latemat_base`` — module-wide totals
+        #: at service birth, diffed by the run gauges
+        self._run_aware_base = _col.run_aware_op_rows()
+        self._runs_mat_base = _col.runs_materialized()
         # background writer: lazily started, drained by commit()/flush()
         self._write_q: "queue.Queue[Optional[Tuple[str, str, List[ColumnBatch]]]]" = queue.Queue()
         self._writer: Optional[threading.Thread] = None
@@ -723,6 +747,15 @@ class HostShuffleService:
         return os.path.join(self._dir(exchange), f"s{sender:04d}.dict")
 
     # -- write side ------------------------------------------------------
+    def mark_presorted(self, exchange: str) -> None:
+        """Declare ``exchange``'s map output presorted (range sort-merge
+        span slices): its sorted runs are contiguous already, so the wire
+        encoder tags them as RLE directly instead of re-detecting (the
+        ``run_hint`` fast lane).  A separate seam — NOT a ``put`` kwarg —
+        because fault injection wraps ``put`` with a fixed signature."""
+        with self._lock:
+            self._presorted_exchanges.add(exchange)
+
     def _write_block(self, exchange: str, receiver: int,
                      batches: List[ColumnBatch]) -> None:
         """Encode + atomically publish one block; record its manifest
@@ -737,9 +770,11 @@ class HostShuffleService:
         # refs is mutated outside the lock: blocks for one exchange are
         # encoded by a single thread (the writer loop, or the caller
         # when asyncWrite is off), so no concurrent writer exists
-        buf = wire.encode_batches(batches, codec=self.wire_codec,
-                                  compress_threshold=self.wire_threshold,
-                                  dict_refs=refs, stats=stats)
+        buf = wire.encode_batches(
+            batches, codec=self.wire_codec,
+            compress_threshold=self.wire_threshold,
+            dict_refs=refs, stats=stats, run_codes=self.run_codes,
+            run_hint=exchange in self._presorted_exchanges)
         t1 = time.perf_counter()
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
@@ -895,9 +930,11 @@ class HostShuffleService:
             with self._lock:
                 refs = self._dict_refs.setdefault(exchange, {})
         t0 = time.perf_counter()
-        buf = wire.encode_batches(list(batches), codec=self.wire_codec,
-                                  compress_threshold=self.wire_threshold,
-                                  dict_refs=refs, stats=stats)
+        buf = wire.encode_batches(
+            list(batches), codec=self.wire_codec,
+            compress_threshold=self.wire_threshold,
+            dict_refs=refs, stats=stats, run_codes=self.run_codes,
+            run_hint=exchange in self._presorted_exchanges)
         with self._lock:
             self.timers["encode_s"] += time.perf_counter() - t0
             for k, v in stats.items():
@@ -1439,10 +1476,10 @@ class HostShuffleService:
         with self._lock:
             table = self._dict_tables.get((exchange, sender))
         try:
-            return _decode_block(data, table)
+            return _decode_block(data, table, keep_runs=self.run_codes)
         except wire.DictFingerprintError:
             table = self._load_dict_table(exchange, sender, deadline)
-            return _decode_block(data, table)
+            return _decode_block(data, table, keep_runs=self.run_codes)
 
     def collect(self, exchange: str,
                 receiver: Optional[int] = None) -> List[ColumnBatch]:
@@ -1681,6 +1718,18 @@ class HostShuffleService:
                     rm = np.asarray([pos[w] for w in v.dictionary],
                                     np.int32)
                     remaps[key] = rm
+                runs = _col.unmaterialized_runs(v)
+                if runs is not None:
+                    # dictionary+RLE composed column: remap the RUN
+                    # VALUES only (monotone remap, run structure intact)
+                    rdata = remap_codes(np, np.asarray(runs.run_values),
+                                        rm)
+                    vectors[i] = runs.with_run_values(
+                        rdata.astype(runs.run_values.dtype, copy=False),
+                        dictionary=merged)
+                    n_remapped += int(runs.capacity)
+                    changed = True
+                    continue
                 data = remap_codes(np, np.asarray(v.data), rm)
                 vectors[i] = ColumnVector(
                     data.astype(v.data.dtype, copy=False), v.dtype,
@@ -1781,7 +1830,8 @@ class HostShuffleService:
         with self._lock:
             table = dict(self._dict_refs.get(exchange) or {}) or None
         return wire.decode_frames(self._read_parts(spill_path, parts),
-                                  dict_table=table)
+                                  dict_table=table,
+                                  keep_runs=self.run_codes)
 
     def _decode_spilled_own(self, exchange: str, spill_path: str,
                             routed: Dict[int, list]) -> List[ColumnBatch]:
@@ -1859,6 +1909,14 @@ class HostShuffleService:
         # words — only the output boundary (collect) should pay this
         gauges["late_materialized_rows"] = lambda: (
             _col.late_materialized_rows() - self._latemat_base)
+        # run-length/delta execution: rows served at run granularity and
+        # run values expanded to dense form, service-lifetime (module
+        # counters diffed against the birth bases; the counter-dict
+        # slots of the same names stay 0 and are shadowed here)
+        gauges["run_aware_op_rows"] = lambda: (
+            _col.run_aware_op_rows() - self._run_aware_base)
+        gauges["runs_materialized"] = lambda: (
+            _col.runs_materialized() - self._runs_mat_base)
         gauges["blacklisted_peers"] = lambda: len(self.blacklist)
         gauges["blacklist"] = lambda: ",".join(
             self.host_name(p) for p in sorted(self.blacklist)) or ""
@@ -1896,6 +1954,7 @@ class HostShuffleService:
         self._staged.pop(exchange, None)
         with self._lock:
             self._dict_refs.pop(exchange, None)
+            self._presorted_exchanges.discard(exchange)
             for key in [k for k in self._dict_tables if k[0] == exchange]:
                 del self._dict_tables[key]
         if self.blockclient is not None:

@@ -238,6 +238,32 @@ class SQLServer:
                          "dcn_fallback_exchanges", "tier_split_peers")}
         return out if any(out.values()) else {}
 
+    # -- run-length execution visibility ----------------------------------
+    @staticmethod
+    def _run_stats(session) -> Dict[str, int]:
+        """One session's cumulative run-length/delta execution activity
+        (columns shipped encoded, wire bytes saved, rows processed by
+        run-aware operators, rows re-inflated at materialization
+        boundaries); empty when host shuffle is off or run codes never
+        engaged.  The two row counters are module-wide, so they diff
+        against the service's birth snapshot — same math its shuffle
+        metrics Source uses."""
+        svc = getattr(session, "_crossproc_svc", None)
+        counters = getattr(svc, "counters", None) if svc is not None \
+            else None
+        if not counters:
+            return {}
+        from . import columnar as _col
+        out = {k: int(counters.get(k, 0))
+               for k in ("rle_columns_encoded", "run_bytes_saved")}
+        out["run_aware_op_rows"] = max(
+            0, _col.run_aware_op_rows()
+            - int(getattr(svc, "_run_aware_base", 0)))
+        out["runs_materialized"] = max(
+            0, _col.runs_materialized()
+            - int(getattr(svc, "_runs_mat_base", 0)))
+        return out if any(out.values()) else {}
+
     def _queued_total(self) -> int:
         """Total statements waiting on session FIFOs tier-wide — the
         ``queued`` component of the admission demand signal.  Takes only
@@ -735,12 +761,17 @@ class SQLServer:
                      if (g := self._grace_stats(ss.session))}
             ici = {sid: g for sid, ss in self._sessions.items()
                    if (g := self._ici_stats(ss.session))}
+            runact = {sid: g for sid, ss in self._sessions.items()
+                      if (g := self._run_stats(ss.session))}
         default_grace = self._grace_stats(self.session)
         if default_grace:
             grace["default"] = default_grace
         default_ici = self._ici_stats(self.session)
         if default_ici:
             ici["default"] = default_ici
+        default_run = self._run_stats(self.session)
+        if default_run:
+            runact["default"] = default_run
         out = {
             "version": self.session.version,
             "queriesExecuted": getattr(self.session, "_query_count", 0),
@@ -752,6 +783,7 @@ class SQLServer:
             "admission": self._admission.stats(),
             "graceActivity": grace,
             "iciActivity": ici,
+            "runActivity": runact,
             "metrics": self.session.metricsSystem.snapshots(),
         }
         if self._plan_cache is not None:

@@ -114,6 +114,9 @@ PLANNING_CONF_ENTRIES = (
     # path, both feed the tier-split decision the lanes replicate
     C.SHUFFLE_ICI_ENABLED, C.SHUFFLE_ICI_MIN_BYTES,
     C.SHUFFLE_ICI_TIER_OVERRIDE,
+    # run-length/delta wire encoding flips which operator fast paths the
+    # executed plan takes (run-aware vs dense)
+    C.SHUFFLE_WIRE_RUN_CODES,
 )
 
 PLANNING_CONF_KEYS = frozenset(e.key for e in PLANNING_CONF_ENTRIES)

@@ -34,9 +34,11 @@ from typing import Any, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import types as T
-from ..columnar import ColumnBatch, ColumnVector, pad_capacity
+from ..columnar import (ColumnBatch, ColumnVector, bump_run_aware,
+                        pad_capacity, unmaterialized_runs)
 from ..expressions import AnalysisException, Col, EQ, EvalContext, Expression, Hash64
-from ..kernels import multi_key_argsort, searchsorted, take_batch
+from ..kernels import (_POSITIONAL_EXPRS, multi_key_argsort, searchsorted,
+                       take_batch)
 from .logical import Join
 from . import physical as P
 
@@ -374,9 +376,18 @@ class PJoin(P.PhysicalPlan):
         build_live = build.row_valid_or_true()
 
         # exact int64 encodings per key pair (None → hashB fallback for
-        # that pair's verification)
-        encs = [_exact_encode_pair(pctx, bctx, l, r)
-                for l, r in self.key_pairs]
+        # that pair's verification).  A single probe key riding an
+        # unmaterialized run vector encodes at RUN-HEAD granularity —
+        # one binary search per run of identical keys, expanded below.
+        run_rid = None
+        encs = None
+        if xp is np and len(self.key_pairs) == 1:
+            rh = self._run_head_encode(probe, bctx)
+            if rh is not None:
+                encs, run_rid = rh
+        if encs is None:
+            encs = [_exact_encode_pair(pctx, bctx, l, r)
+                    for l, r in self.key_pairs]
 
         if len(encs) == 1 and encs[0] is not None:
             # EXACT search path: sort/search the encoded value itself —
@@ -396,6 +407,8 @@ class PJoin(P.PhysicalPlan):
             b_flag_s = b_flag[perm]
             ba_s = xp.where(b_flag_s == 0, b_enc[perm], _DEAD_BUILD)
             pa = p_enc
+            if run_rid is not None and p_val is not None:
+                p_val = p_val[run_rid]       # head-sized → row-sized
             p_ok = probe_live if p_val is None else (probe_live & p_val)
         else:
             # multi-key / unencodable: combined-hash search with sentinels.
@@ -425,6 +438,14 @@ class PJoin(P.PhysicalPlan):
 
         lo = searchsorted(xp, ba_s, pa, side="left")
         hi = searchsorted(xp, ba_s, pa, side="right")
+        if run_rid is not None:
+            # expand the per-run search results (and the verification
+            # arrays) to row granularity: every row of a run shares its
+            # key, so the gather reproduces dense execution exactly
+            lo, hi = lo[run_rid], hi[run_rid]
+            pe0, pv0, be0, bv0 = encs[0]
+            encs[0] = (pe0[run_rid],
+                       None if pv0 is None else pv0[run_rid], be0, bv0)
         counts = xp.where(p_ok, (hi - lo).astype(np.int64), 0)
         matched_hash = counts > 0
 
@@ -533,6 +554,46 @@ class PJoin(P.PhysicalPlan):
             unmatched_b = build_live_s & ~hit_b
             out = self._append_unmatched_build(ctx, out, build_s, unmatched_b)
         return out
+
+    # ------------------------------------------------------------------
+    def _run_head_encode(self, probe: ColumnBatch, bctx: EvalContext):
+        """Encode the single probe-side key at RUN-HEAD granularity when
+        it rides an unmaterialized run vector.  Returns ``(encs,
+        run_rid)`` — head-sized probe arrays plus the per-row run-id
+        gather that expands them — or None when ineligible (the caller
+        then takes the ordinary dense encode).  Sound because every row
+        of a run shares its key value: the encoding and both binary
+        search bounds are constant within the run, so the expanded
+        results are identical to dense execution."""
+        l, r = self.key_pairs[0]
+        refs = l.references()
+        if len(refs) != 1:
+            return None
+        name = next(iter(refs))
+        if name not in probe.names:
+            return None
+        rv = unmaterialized_runs(probe.vectors[probe.names.index(name)])
+        if rv is None or rv.valid is not None \
+                or int(rv.capacity) != int(probe.capacity):
+            return None
+        stack: List[Expression] = [l]
+        while stack:
+            e = stack.pop()
+            if isinstance(e, _POSITIONAL_EXPRS):
+                return None          # key depends on row position
+            stack.extend(e.children)
+        run_values = np.asarray(rv.run_values)
+        head = ColumnBatch([name],
+                           [ColumnVector(run_values, rv.dtype, None,
+                                         rv.dictionary)],
+                           None, len(run_values))
+        enc0 = _exact_encode_pair(EvalContext(head, np), bctx, l, r)
+        if enc0 is None:
+            return None
+        run_rid = np.repeat(np.arange(len(run_values), dtype=np.int64),
+                            np.asarray(rv.run_lengths))
+        bump_run_aware(int(probe.capacity))
+        return [enc0], run_rid
 
     # ------------------------------------------------------------------
     def _append_unmatched_build(self, ctx, inner_out: ColumnBatch,

@@ -193,8 +193,11 @@ class SpilledRuns:
     Pre-wire pickle spill files still load (magic-byte sniff)."""
 
     def __init__(self, budget_rows: int, spill_dir: str,
-                 budget_bytes: int = 0):
+                 budget_bytes: int = 0, run_codes: bool = False):
         self.budget_rows = budget_rows
+        # run/delta codes on the spill wire: sealed runs keep encoded
+        # frames on disk and reload as lazy run vectors — never inflate
+        self.run_codes = run_codes
         # optional second trigger: raw bytes held in RAM (the host-memory
         # ledger's unit), so wide rows spill before the row budget trips
         self.budget_bytes = budget_bytes
@@ -224,7 +227,8 @@ class SpilledRuns:
         path = os.path.join(self._dir, f"run-{self._n_spilled:05d}.spill")
         self._n_spilled += 1
         with open(path, "wb") as f:
-            f.write(wire.encode_batches([b.to_host() for b in self._mem]))
+            f.write(wire.encode_batches([b.to_host() for b in self._mem],
+                                        run_codes=self.run_codes))
         _log.info("spilled %d rows in %d runs to %s",
                   self._mem_rows, len(self._mem), path)
         self._disk.append(path)
@@ -239,7 +243,8 @@ class SpilledRuns:
             with open(path, "rb") as f:
                 data = f.read()
             if data[:4] == wire.MAGIC:
-                runs.extend(wire.decode_batches(data))
+                runs.extend(wire.decode_batches(data,
+                                                keep_runs=self.run_codes))
             else:                      # legacy pickle spill
                 runs.extend(pickle.loads(data))
             os.remove(path)
@@ -789,7 +794,8 @@ class MultiBatchExecution:
                               conf.get(C.AGG_FOLD_ROWS), str_dicts)
         spill = SpilledRuns(
             conf.get(C.SPILL_MEMORY_ROWS), spill_dir,
-            budget_bytes=conf.get(C.SHUFFLE_SPILL_THRESHOLD))
+            budget_bytes=conf.get(C.SHUFFLE_SPILL_THRESHOLD),
+            run_codes=conf.get(C.SHUFFLE_WIRE_RUN_CODES))
         if isinstance(breaker, L.Sort):
             orders = [(o.child, o.ascending, o.nulls_first)
                       for o in breaker.orders]

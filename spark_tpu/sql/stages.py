@@ -1154,7 +1154,8 @@ def _run_breaker(session, stream: BatchStream, breaker: L.LogicalPlan,
         from .multibatch import SpilledRuns, default_spill_dir
         return SpilledRuns(conf.get(C.SPILL_MEMORY_ROWS),
                            default_spill_dir(conf),
-                           budget_bytes=conf.get(C.SHUFFLE_SPILL_THRESHOLD))
+                           budget_bytes=conf.get(C.SHUFFLE_SPILL_THRESHOLD),
+                           run_codes=conf.get(C.SHUFFLE_WIRE_RUN_CODES))
 
     compiled = None
     merger = None

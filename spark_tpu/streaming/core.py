@@ -1566,13 +1566,16 @@ class StreamExecution:
         state simply stays resident (nothing durable to reload from)."""
         if not self.state_dir:
             return
+        from .. import config as C
         from ..wire import encode_batches
+        run_codes = self.session.conf.get(C.SHUFFLE_WIRE_RUN_CODES)
         d = os.path.join(self.state_dir, "spill")
         os.makedirs(d, exist_ok=True)
         for tag, st in self._state_parts():
             if st.state is None:
                 continue
-            buf = encode_batches([st.state.to_host()])
+            buf = encode_batches([st.state.to_host()],
+                                 run_codes=run_codes)
             dest = os.path.join(d, f"{tag}.wire")
             tmp = f"{dest}.{os.getpid()}.tmp"
             with open(tmp, "wb") as f:
@@ -1587,12 +1590,15 @@ class StreamExecution:
     def _unspill_state(self) -> None:
         if not self._spilled:
             return
+        from .. import config as C
         from ..wire import decode_batches
+        run_codes = self.session.conf.get(C.SHUFFLE_WIRE_RUN_CODES)
         d = os.path.join(self.state_dir, "spill")
         for tag, st in self._state_parts():
             if tag in self._spilled:
                 with open(os.path.join(d, f"{tag}.wire"), "rb") as f:
-                    st.state = decode_batches(f.read())[0]
+                    st.state = decode_batches(f.read(),
+                                              keep_runs=run_codes)[0]
         self._spilled.clear()
 
     # -- watermark bookkeeping --------------------------------------------

@@ -59,7 +59,8 @@ import numpy as np
 from . import codec as _codec
 from . import config as C
 from . import types as T
-from .columnar import ColumnBatch, ColumnVector
+from .columnar import (ColumnBatch, ColumnVector, RunColumnVector,
+                       unmaterialized_runs)
 
 __all__ = [
     "MAGIC", "WIRE_VERSION", "WireFormatError", "ChecksumError",
@@ -248,13 +249,24 @@ def _decode_bitmask(payload: memoryview, entry: dict,
 # encode
 # ---------------------------------------------------------------------------
 
+def _data_nbytes(v: ColumnVector) -> int:
+    """Payload bytes of one column's data as it would SHIP: the run table
+    for a still-encoded run column (never inflates it to measure it),
+    dense array bytes otherwise."""
+    r = unmaterialized_runs(v)
+    if r is not None:
+        return r.run_values.nbytes + r.run_lengths.nbytes
+    return np.asarray(v.data).nbytes
+
+
 def raw_nbytes(batches: Sequence[ColumnBatch]) -> int:
     """Uncompressed payload size of ``batches`` (metrics: the compression
-    ratio numerator) — arithmetic only, no copies."""
+    ratio numerator) — arithmetic only, no copies.  Run-encoded columns
+    count their ENCODED (run-table) bytes, not the inflated width."""
     total = 0
     for b in batches:
         for v in b.vectors:
-            total += np.asarray(v.data).nbytes
+            total += _data_nbytes(v)
             if v.valid is not None:
                 total += (b.capacity + 7) // 8
         if b.row_valid is not None:
@@ -275,11 +287,69 @@ def payload_nbytes(batches: Sequence[ColumnBatch]) -> int:
             words = v.dictionary
             if not words:
                 continue
-            codes = np.asarray(v.data).ravel()
+            r = unmaterialized_runs(v)
+            # run values cover exactly the codes present — no inflation
+            codes = (np.asarray(r.run_values) if r is not None
+                     else np.asarray(v.data)).ravel()
             codes = codes[(codes >= 0) & (codes < len(words))]
             for c in np.unique(codes):
                 total += len(words[int(c)])
     return total
+
+
+#: header-growth margin an ``enc`` tag must beat before a column switches
+#: off raw (the JSON entry plus the lens buffer-table row cost real bytes)
+_ENC_MARGIN = 48
+
+#: columns shorter than this never probe — the enc header would rival the data
+_MIN_RUN_ROWS = 16
+
+
+def _choose_run_encoding(data: np.ndarray, run_hint: bool):
+    """Pick the cheapest of rle/delta/raw for one 1-D integral column.
+
+    Returns ``("rle", run_values, run_lengths)``, ``("delta", base,
+    diffs)``, or None for raw.  ``run_hint`` (presorted span fodder) skips
+    the sampled probe and goes straight to the exact pass; otherwise a
+    prefix sample pays ONE small diff to rule out clearly-raw columns
+    before any full-column work."""
+    n = len(data)
+    itemsize = data.dtype.itemsize
+    raw_cost = n * itemsize
+    delta_eligible = data.dtype.kind == "i" and itemsize >= 2
+    if not run_hint:
+        sample = data[:512]
+        changes = int(np.count_nonzero(sample[1:] != sample[:-1]))
+        est_runs = max(1, (changes * n) // max(1, len(sample) - 1))
+        rle_plausible = est_runs * (itemsize + 8) + _ENC_MARGIN < raw_cost
+        if not rle_plausible and not delta_eligible:
+            return None
+    best = None
+    best_cost = raw_cost - _ENC_MARGIN
+    rvals, rlens = _kernels().rle_encode(data)
+    rle_cost = rvals.nbytes + rlens.nbytes
+    if rle_cost < best_cost:
+        best, best_cost = ("rle", rvals, rlens), rle_cost
+    if delta_eligible:
+        de = _kernels().delta_encode(data)
+        if de is not None:
+            base, diffs = de
+            delta_cost = diffs.nbytes + 16
+            if delta_cost < best_cost:
+                best = ("delta", base, diffs)
+    return best
+
+
+def _kernels():
+    """kernels.py lazily — it pulls the whole expression engine in, which
+    pure wire consumers (sidecar tools) should not pay at import."""
+    from . import kernels
+    return kernels
+
+
+def _bump(stats: Optional[Dict[str, int]], key: str, n: int) -> None:
+    if stats is not None and n:
+        stats[key] = stats.get(key, 0) + n
 
 
 def encode_batches(batches: Sequence[ColumnBatch], *,
@@ -287,7 +357,9 @@ def encode_batches(batches: Sequence[ColumnBatch], *,
                    compress_threshold: Optional[int] = None,
                    conf: Optional[C.Conf] = None,
                    dict_refs: Optional[Dict[str, Tuple]] = None,
-                   stats: Optional[Dict[str, int]] = None) -> bytes:
+                   stats: Optional[Dict[str, int]] = None,
+                   run_codes: bool = False,
+                   run_hint: bool = False) -> bytes:
     """One framed wire block holding ``batches`` (host arrays; device
     batches are pulled to host first).  Faithful: capacity, row masks,
     validity and dictionaries round-trip exactly — padding removal is the
@@ -301,7 +373,17 @@ def encode_batches(batches: Sequence[ColumnBatch], *,
     ``decode_batches`` needs the matching table back.  ``stats`` (when
     given with ``dict_refs``) accumulates ``dict_columns_encoded`` and
     ``dict_bytes_saved`` — the inline header bytes every repeat
-    occurrence no longer pays."""
+    occurrence no longer pays.
+
+    ``run_codes`` turns on per-column run-length/delta encoding: each
+    eligible column (1-D integral/bool, ≥ ``_MIN_RUN_ROWS`` rows) runs a
+    sampled-benefit probe and ships the cheaper of raw / run table /
+    narrow deltas, tagged ``"enc"`` in the header; a column arriving as a
+    still-lazy ``RunColumnVector`` ships its run table DIRECTLY — never
+    inflated — whenever the table is the smaller form.  ``run_hint``
+    (the range lane's presorted spans) skips the probe: sorted slices are
+    known run fodder.  ``stats`` additionally accumulates
+    ``rle_columns_encoded`` and ``run_bytes_saved``."""
     codec = codec if codec is not None else default_codec(conf)
     threshold = (compress_threshold if compress_threshold is not None
                  else default_threshold(conf))
@@ -311,17 +393,66 @@ def encode_batches(batches: Sequence[ColumnBatch], *,
         b = b.to_host()
         cols: List[dict] = []
         for v in b.vectors:
-            data = np.asarray(v.data)
+            enc_meta = None
+            data_entry = None
+            np_str = None
+            shape = None
+            runs = unmaterialized_runs(v) if run_codes else None
+            if runs is not None:
+                rvals = np.asarray(runs.run_values)
+                rlens = np.asarray(runs.run_lengths, np.int64)
+                if rvals.ndim == 1 and \
+                        rvals.nbytes + rlens.nbytes < runs.capacity * \
+                        rvals.dtype.itemsize:
+                    # free fodder: the column is already a run table and
+                    # the table is the smaller form — ship it as-is
+                    np_str = rvals.dtype.str
+                    shape = [int(runs.capacity)]
+                    data_entry = w.add(_array_bytes(rvals))
+                    enc_meta = {"k": "rle", "nr": int(len(rvals)),
+                                "lens": w.add(_array_bytes(rlens))}
+                    _bump(stats, "rle_columns_encoded", 1)
+                    _bump(stats, "run_bytes_saved",
+                          runs.capacity * rvals.dtype.itemsize
+                          - rvals.nbytes - rlens.nbytes)
+            if data_entry is None:
+                data = np.asarray(v.data)
+                if run_codes and data.ndim == 1 \
+                        and data.dtype.kind in "iub" \
+                        and len(data) >= _MIN_RUN_ROWS:
+                    choice = _choose_run_encoding(data, run_hint)
+                    if choice is not None and choice[0] == "rle":
+                        _, rvals, rlens = choice
+                        data_entry = w.add(_array_bytes(rvals))
+                        enc_meta = {"k": "rle", "nr": int(len(rvals)),
+                                    "lens": w.add(_array_bytes(rlens))}
+                        _bump(stats, "rle_columns_encoded", 1)
+                        _bump(stats, "run_bytes_saved",
+                              data.nbytes - rvals.nbytes - rlens.nbytes)
+                    elif choice is not None:
+                        _, base, diffs = choice
+                        data_entry = w.add(_array_bytes(diffs))
+                        enc_meta = {"k": "delta", "base": base,
+                                    "dnp": diffs.dtype.str}
+                        _bump(stats, "rle_columns_encoded", 1)
+                        _bump(stats, "run_bytes_saved",
+                              data.nbytes - diffs.nbytes)
+                np_str = data.dtype.str
+                shape = list(data.shape)
+                if data_entry is None:
+                    data_entry = w.add(_array_bytes(data))
             cm = {
                 "dtype": _dtype_name(v.dtype),
-                "np": data.dtype.str,
-                "shape": list(data.shape),
+                "np": np_str,
+                "shape": shape,
                 "dict": _dict_to_header(v.dictionary),
-                "data": w.add(_array_bytes(data)),
+                "data": data_entry,
                 "valid": (None if v.valid is None else
                           w.add(np.packbits(
                               np.asarray(v.valid).astype(bool)).tobytes())),
             }
+            if enc_meta is not None:
+                cm["enc"] = enc_meta
             if dict_refs is not None and v.dictionary is not None:
                 fp = dict_fingerprint(v.dictionary)
                 if stats is not None:
@@ -391,8 +522,14 @@ def _split_frame(buf: bytes) -> Tuple[dict, memoryview]:
 
 def frame_info(buf: bytes) -> dict:
     """The decoded frame header (buffer table included) — for tests and
-    byte-level observability; does not materialize any column."""
+    byte-level observability; does not materialize any column.  Every
+    column meta gains a derived ``"enc_tag"`` (``raw``/``rle``/``delta``)
+    so callers read the encoding without knowing the tag layout."""
     header, _ = _split_frame(buf)
+    for meta in header.get("batches", []):
+        for cm in meta.get("columns", []):
+            enc = cm.get("enc")
+            cm["enc_tag"] = enc["k"] if enc else "raw"
     return header
 
 
@@ -416,9 +553,46 @@ def frame_length(buf) -> int:
     return PREFIX_LEN + hlen + plen
 
 
+def _decode_run_column(payload: memoryview, cm: dict, dt: T.DataType,
+                       valid, d, keep_runs: bool) -> ColumnVector:
+    """Decode one ``enc``-tagged column; validates the run/delta table
+    against the declared row count so a malformed frame fails STRUCTURED
+    (``WireFormatError``), never as partial/garbage rows."""
+    enc = cm["enc"]
+    kind = enc.get("k")
+    n = int(cm["shape"][0])
+    np_dt = np.dtype(cm["np"])
+    try:
+        if kind == "rle":
+            nr = int(enc["nr"])
+            rvals = _decode_array(payload, cm["data"], np_dt, [nr])
+            rlens = _decode_array(payload, enc["lens"], np.int64, [nr])
+        elif kind == "delta":
+            diffs = _decode_array(payload, cm["data"],
+                                  np.dtype(enc["dnp"]), [max(0, n - 1)])
+        else:
+            raise WireFormatError(f"unknown column encoding {kind!r}")
+    except ValueError as e:
+        raise WireFormatError(f"malformed {kind} column buffers: {e}")
+    if kind == "delta":
+        data = _kernels().delta_decode(np, int(enc["base"]), diffs,
+                                       np_dt, n)
+        return ColumnVector(data, dt, valid, d)
+    if len(rlens) and int(rlens.min()) < 0:
+        raise WireFormatError("malformed run table: negative run length")
+    total = int(rlens.sum())
+    if total != n:
+        raise WireFormatError(
+            f"malformed run table: lengths sum to {total}, header "
+            f"declares {n} rows")
+    if keep_runs:
+        return RunColumnVector(rvals, rlens, dt, valid, d)
+    return ColumnVector(np.repeat(rvals, rlens), dt, valid, d)
+
+
 def decode_batches(buf: bytes,
-                   dict_table: Optional[Dict[str, Tuple]] = None
-                   ) -> List[ColumnBatch]:
+                   dict_table: Optional[Dict[str, Tuple]] = None,
+                   keep_runs: bool = False) -> List[ColumnBatch]:
     """Decode one framed block back into host ``ColumnBatch`` objects.
 
     Uncompressed buffers decode as read-only ``np.frombuffer`` views over
@@ -429,7 +603,14 @@ def decode_batches(buf: bytes,
     table.  A column holding only a ``"dfp"`` fingerprint resolves
     through ``dict_table``; an unknown fingerprint raises
     ``DictFingerprintError`` so the reader can fetch the sender's
-    sidecar and retry the (cheap, header-only-so-far) decode."""
+    sidecar and retry the (cheap, header-only-so-far) decode.
+
+    ``enc``-tagged columns (run-length / delta, see ``encode_batches``)
+    validate their run tables and reconstruct exactly; with
+    ``keep_runs`` an RLE column stays a lazy ``RunColumnVector`` so
+    run-aware operators never pay the expansion (delta always expands —
+    there is no run structure to keep).  Untagged (legacy) frames decode
+    unchanged."""
     header, payload = _split_frame(buf)
     out: List[ColumnBatch] = []
     for meta in header["batches"]:
@@ -448,10 +629,14 @@ def decode_batches(buf: bytes,
                 d = dict_table[fp]
             else:
                 d = None
-            data = _decode_array(payload, cm["data"], np.dtype(cm["np"]),
-                                 cm["shape"])
             valid = (None if cm["valid"] is None else
                      _decode_bitmask(payload, cm["valid"], cap))
+            if cm.get("enc") is not None:
+                vectors.append(_decode_run_column(payload, cm, dt, valid,
+                                                  d, keep_runs))
+                continue
+            data = _decode_array(payload, cm["data"], np.dtype(cm["np"]),
+                                 cm["shape"])
             vectors.append(ColumnVector(data, dt, valid, d))
         rv = (None if meta["row_valid"] is None else
               _decode_bitmask(payload, meta["row_valid"], cap))
@@ -460,8 +645,8 @@ def decode_batches(buf: bytes,
 
 
 def decode_frames(buf: bytes,
-                  dict_table: Optional[Dict[str, Tuple]] = None
-                  ) -> List[ColumnBatch]:
+                  dict_table: Optional[Dict[str, Tuple]] = None,
+                  keep_runs: bool = False) -> List[ColumnBatch]:
     """Decode EVERY frame in a buffer of back-to-back wire blocks (a
     spill file, or several map-side spans concatenated into one shuffle
     block) into one flat batch list, preserving frame order.
@@ -477,7 +662,8 @@ def decode_frames(buf: bytes,
         ln = frame_length(mv[off:])
         # decode_batches ignores trailing bytes past its first frame, so
         # handing it the whole tail decodes just the frame at `off`
-        out.extend(decode_batches(mv[off:], dict_table=dict_table))
+        out.extend(decode_batches(mv[off:], dict_table=dict_table,
+                                  keep_runs=keep_runs))
         off += ln
         if off >= len(mv):
             break

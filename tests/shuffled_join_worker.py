@@ -70,6 +70,17 @@ mode "grace-fault": the grace conf plus a ``disk_full`` rule aimed at
 the ``<xid>-grace`` exchange: the grace SPILL hits ENOSPC mid-degrade,
 and the query must abort bounded with a structured ``HostMemoryError``
 whose detail names the failed grace spill — never partial results.
+
+mode "runcodes": run-encoded vs raw wire parity on BOTH exchange lanes
+(``spark.tpu.shuffle.wire.runCodes`` flipped per leg) over a
+time-series-shaped workload — a sorted key in long runs, a
+dictionary+RLE composed status column (codes are int32 runs) — under
+the forced-spill conf, so encoded frames also stage through disk
+without inflating (the spill-under-budget cell).  Every leg must equal
+the full-data oracle exactly; the encoded legs must bump
+``rle_columns_encoded`` / ``run_bytes_saved`` and fire the run-aware
+operators (``run_aware_op_rows`` / ``runs_materialized``), the raw
+legs must not encode.  Final line ``RUNCODES-OK ...``.
 """
 
 import os
@@ -93,6 +104,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import numpy as np  # noqa: E402
 
+from spark_tpu import columnar as _col  # noqa: E402
 from spark_tpu import config as C  # noqa: E402
 from spark_tpu.memory import HOST_BUDGET, HostMemoryError  # noqa: E402
 from spark_tpu.parallel.faults import FaultInjector  # noqa: E402
@@ -123,11 +135,14 @@ session = SparkSession.builder.appName(f"sjoin-{pid}").getOrCreate()
 
 xs = session.newSession()
 xs.conf.set(C.MESH_SHARDS.key, "1")
-if mode in ("spill", "spill-fault"):
+if mode in ("spill", "spill-fault", "runcodes"):
     # a threshold far below any join side's bytes forces the map output
     # of EVERY join exchange (and, via the FetchSink's force rule, every
     # fetched block) through the spill files; the budget cap must be set
-    # BEFORE enableHostShuffle (the ledger reads it at construction)
+    # BEFORE enableHostShuffle (the ledger reads it at construction).
+    # "runcodes" rides the same forced-spill conf so its whole battery
+    # doubles as the spill-under-budget cell: encoded frames must stage
+    # through disk WITHOUT inflating and still match the oracle.
     xs.conf.set(C.SHUFFLE_SPILL_THRESHOLD.key, "1024")
     xs.conf.set(HOST_BUDGET.key, str(32 << 20))
 elif mode in ("grace", "grace-fault"):
@@ -450,6 +465,105 @@ if mode == "grace":
           f"resplits={svc.counters['grace_salted_resplits']} "
           f"elastic={svc.counters['reducers_elastic']} "
           f"peak={gauges['peak_host_bytes']}", flush=True)
+    os._exit(0)
+
+if mode == "runcodes":
+    # run-encoded vs raw wire parity on BOTH exchange lanes.  The
+    # workload is time-series shaped: a sorted key in LONG runs, a
+    # low-cardinality status string whose dictionary codes are
+    # themselves int32 runs (dictionary+RLE composed), and random
+    # values.  The strided per-process slice keeps every run shape,
+    # just 1/n as long — and the forced-spill conf above makes every
+    # exchange stage its encoded frames through disk.
+    NRK, REP = 48, 64
+    r_ts = np.repeat(np.arange(NRK, dtype=np.int64), REP)
+    r_v = rng.integers(1, 100, NRK * REP).astype(np.int64)
+    r_s = np.array(["ok", "warn", "err"])[(np.arange(NRK * REP) // 256) % 3]
+    r_dk = np.arange(0, NRK, 2, dtype=np.int64)     # even keys → LEFT misses
+    r_bonus = (r_dk * 3 + 7).astype(np.int64)
+    r_s2 = np.array(["ok", "err", "crit", "ok", "warn", "crit"])
+    r_b2 = np.array([11, 23, 37, 5, 41, 2], dtype=np.int64)
+    for s, sl in ((xs, mine), (oracle, slice(None))):
+        s.createDataFrame({"ts": r_ts[sl], "v": r_v[sl], "s": r_s[sl]}) \
+            .createOrReplaceTempView("ev")
+        s.createDataFrame({"dk": r_dk[sl], "bonus": r_bonus[sl]}) \
+            .createOrReplaceTempView("dm")
+        s.createDataFrame({"s2": r_s2[sl], "b2": r_b2[sl]}) \
+            .createOrReplaceTempView("dm2")
+
+    RC_QUERIES = [
+        ("rc-inner-agg",
+         "SELECT ts, count(*) AS c, sum(v) AS sv FROM ev "
+         "JOIN dm ON ts = dk GROUP BY ts ORDER BY ts"),
+        ("rc-rows-filter",
+         "SELECT ts, v, bonus FROM ev JOIN dm ON ts = dk "
+         "WHERE bonus > 20 ORDER BY ts, v, bonus"),
+        ("rc-left-agg",
+         "SELECT ts, count(bonus) AS cb, count(*) AS c FROM ev "
+         "LEFT JOIN dm ON ts = dk GROUP BY ts ORDER BY ts"),
+        ("rc-dict-rle",
+         "SELECT s, count(*) AS c, sum(b2) AS sb FROM ev "
+         "JOIN dm2 ON s = s2 GROUP BY s ORDER BY s"),
+    ]
+
+    def set_runcodes(on):
+        # the service snapshots the conf at construction; the worker
+        # flips BOTH (the conf feeds the SpilledRuns constructors, the
+        # attribute feeds encode/decode) — identically on every process
+        xs.conf.set(C.SHUFFLE_WIRE_RUN_CODES.key,
+                    "true" if on else "false")
+        svc.run_codes = bool(on)
+
+    # three legs per lane: encoded+jit (runs materialize at the jit
+    # boundary, counted), encoded+interpreted (the host lane keeps run
+    # vectors lazy all the way into the operators — the run-aware join
+    # probe and filter paths fire here), and raw+jit (the oracle wire)
+    LEGS = (("on", True, True), ("on-host", True, False),
+            ("off", False, True))
+    for name, sql in RC_QUERIES:
+        exp = run(oracle, sql)
+        for m, want in (("range", "range_merge_joins"),
+                        ("hash", "shuffled_joins")):
+            set_mode(m)
+            for leg, on, jit in LEGS:
+                set_runcodes(on)
+                xs.conf.set(C.CODEGEN_ENABLED.key,
+                            "true" if jit else "false")
+                before = dict(svc.counters)
+                got = run(xs, sql)
+                assert svc.counters[want] > before.get(want, 0), (
+                    f"{name}/{m}: expected the {want} path, {svc.counters}")
+                if not on:
+                    # raw leg: the encoder must not have touched a column
+                    assert svc.counters["rle_columns_encoded"] == \
+                        before.get("rle_columns_encoded", 0), svc.counters
+                if got != exp:
+                    print(f"[p{pid}] RC-PARITY-FAIL {name}/{m}/{leg} "
+                          f"got={got[:4]} exp={exp[:4]}", flush=True)
+                    os._exit(1)
+        print(f"[p{pid}] RC-PARITY-OK {name} ({len(exp)} rows)", flush=True)
+    xs.conf.set(C.CODEGEN_ENABLED.key, "true")
+    set_runcodes(True)
+    # the encoded legs demonstrably run-encoded columns and saved bytes
+    assert svc.counters["rle_columns_encoded"] > 0, svc.counters
+    assert svc.counters["run_bytes_saved"] > 0, svc.counters
+    # run-aware operators fired on lazily-decoded run vectors, and the
+    # collect() late-materialized at least one of them
+    assert _col.run_aware_op_rows() > 0, _col.run_aware_op_rows()
+    assert _col.runs_materialized() > 0, _col.runs_materialized()
+    # spill-under-budget cell: every exchange staged through disk, the
+    # encoded frames never inflated past the capped ledger
+    assert svc.counters["spill_bytes"] > 0, svc.counters
+    gauges = svc.metrics_source().snapshot()
+    assert gauges["rle_columns_encoded"] > 0, gauges
+    assert gauges["run_bytes_saved"] > 0, gauges
+    assert 0 < gauges["peak_host_bytes"] <= gauges["host_budget_bytes"], \
+        gauges
+    print(f"[p{pid}] RUNCODES-OK rle={svc.counters['rle_columns_encoded']} "
+          f"saved={svc.counters['run_bytes_saved']} "
+          f"runaware={_col.run_aware_op_rows()} "
+          f"mat={_col.runs_materialized()} "
+          f"spill={svc.counters['spill_bytes']}", flush=True)
     os._exit(0)
 
 JOIN_COUNTERS = ("range_merge_joins", "shuffled_joins", "broadcast_joins")

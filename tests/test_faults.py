@@ -421,6 +421,76 @@ def test_disk_full_fails_spill_writes_after_budget(tmp_path):
     assert os.path.getsize(path) == 100    # no torn partial append
 
 
+# ---------------------------------------------------------------------------
+# run-length/delta encoded frames under faults: the enc tags change the
+# payload shape, not the taxonomy — corruption is checksum-detected and
+# heals through the same retrying reader; a structurally-bad run table
+# is a WireFormatError, never partial rows
+# ---------------------------------------------------------------------------
+
+def _run_shaped(lo):
+    """A batch whose column RLE-encodes (4 runs of 64) on the run wire."""
+    return ColumnBatch.from_arrays(
+        {"v": np.repeat(np.arange(lo, lo + 4, dtype=np.int64), 64)})
+
+
+def test_corrupted_rle_frame_healed_by_refetch(tmp_path):
+    svc0, svc1 = _pair(tmp_path)
+    assert svc0.run_codes and svc1.run_codes       # default-on conf
+    FaultInjector(FaultPlan().corrupt(exchange="e",
+                                      heal_after_s=0.25)).attach(svc1)
+    svc1.put("e", 0, [_run_shaped(100)])
+    svc1.commit("e")
+    got = svc0.exchange("e", {0: [_batch([1])], 1: [_batch([2])]})
+    assert _values(got) == [1] + sorted([100, 101, 102, 103] * 64)
+    assert svc0.counters["block_retries"] > 0
+    assert svc0.counters["blocks_lost"] == 0
+    assert svc1.counters["rle_columns_encoded"] > 0
+
+
+def test_truncated_run_frame_healed_by_refetch(tmp_path):
+    svc0, svc1 = _pair(tmp_path)
+    FaultInjector(FaultPlan().truncate(exchange="e",
+                                       heal_after_s=0.25)).attach(svc1)
+    svc1.put("e", 0, [_run_shaped(0)])
+    svc1.commit("e")
+    got = svc0.exchange("e", {0: [], 1: []})
+    assert _values(got) == sorted([0, 1, 2, 3] * 64)
+    assert svc0.counters["block_retries"] > 0
+
+
+def test_malformed_run_table_fails_structured_never_partial(tmp_path):
+    """A frame whose run lengths do not sum to the declared row count is
+    structurally bad, not torn: plain ``WireFormatError``, fail-fast in
+    the reader (no retry budget burned), zero rows emitted."""
+    import json
+    import struct
+    import zlib
+    buf = wire.encode_batches([_run_shaped(0).to_host()], run_codes=True)
+    hlen = struct.unpack_from("<I", buf, 8)[0]
+    header = json.loads(buf[wire.PREFIX_LEN:wire.PREFIX_LEN + hlen])
+    assert header["batches"][0]["columns"][0]["enc"]["k"] == "rle"
+    header["batches"][0]["capacity"] = 300          # lengths sum to 256
+    header["batches"][0]["columns"][0]["shape"] = [300]
+    hb = json.dumps(header, separators=(",", ":")).encode()
+    payload = buf[wire.PREFIX_LEN + hlen:]
+    cksum = zlib.adler32(payload, zlib.adler32(hb))
+    bad = wire._PREFIX.pack(wire.MAGIC, wire.WIRE_VERSION, len(hb),
+                            len(payload), cksum) + hb + payload
+    with pytest.raises(wire.WireFormatError, match="run table"):
+        wire.decode_batches(bad)
+    path = str(tmp_path / "b.part")
+    with open(path, "wb") as f:
+        f.write(bad)
+    retries = []
+    reader = RetryingBlockReader(max_retries=5, retry_wait_s=0.01,
+                                 on_retry=retries.append)
+    with pytest.raises(BlockFetchError) as ei:
+        reader.read(path, expect_size=len(bad))
+    assert ei.value.attempts == 1                   # not retryable
+    assert retries == []
+
+
 def test_stream_fault_plan_env_roundtrip():
     plan = (FaultPlan()
             .torn_checkpoint(keep_bytes=11, after_entries=2, die=True)

@@ -250,6 +250,86 @@ def test_shuffle_dict_gauges_exported(spark, tmp_path):
         ms._sources = [s for s in ms._sources if s.name != "shuffle"]
 
 
+def test_shuffle_run_gauges_exported(spark, tmp_path):
+    """Run-length execution is observable: columns shipped as run/delta
+    codes, wire bytes saved, rows the run-aware operators processed
+    without expansion, and rows re-inflated at materialization
+    boundaries all surface as gauges on the shuffle metrics source."""
+    from spark_tpu import types as T
+    from spark_tpu.columnar import ColumnBatch, RunColumnVector
+    from spark_tpu.expressions import Col, GT, Literal
+    from spark_tpu.kernels import apply_filter
+    prev = getattr(spark, "_crossproc_svc", None)
+    ms = spark.metricsSystem
+    try:
+        svc = spark.enableHostShuffle(str(tmp_path), process_id=0,
+                                      n_processes=1, timeout_s=5.0)
+        assert svc.run_codes                       # default-on conf
+        snap0 = ms.snapshots()["shuffle"]
+        for g in ("rle_columns_encoded", "run_bytes_saved",
+                  "run_aware_op_rows", "runs_materialized"):
+            assert snap0[g] == 0, (g, snap0)
+        # a run-shaped block RLE-encodes on the put path
+        b = ColumnBatch.from_arrays(
+            {"v": np.repeat(np.arange(4, dtype=np.int64), 64)})
+        svc.put("rg", 0, [b])
+        svc.commit("rg")
+        # a run-aware filter over a lazy run vector, then the explicit
+        # materialization boundary
+        rv = RunColumnVector(np.asarray([1, 2], np.int64),
+                             np.asarray([32, 32], np.int64), T.int64)
+        rb = ColumnBatch(["x"], [rv], None, 64)
+        apply_filter(np, rb, GT(Col("x"), Literal(1, T.int64)))
+        np.asarray(rv.data)
+        snap = ms.snapshots()["shuffle"]
+        assert snap["rle_columns_encoded"] >= 1
+        assert snap["run_bytes_saved"] > 0
+        assert snap["run_aware_op_rows"] == 64
+        assert snap["runs_materialized"] == 64
+    finally:
+        spark._crossproc_svc = prev
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]
+
+
+def test_run_activity_in_status(spark, tmp_path):
+    """/status surfaces per-session run-length execution activity the
+    same way it surfaces ICI/grace: {} while quiet, live gauges once
+    columns ship encoded or run-aware operators fire."""
+    import urllib.request
+
+    from spark_tpu import columnar as _col
+    from spark_tpu.server import SQLServer
+    prev = getattr(spark, "_crossproc_svc", None)
+    ms = spark.metricsSystem
+    srv = None
+    try:
+        svc = spark.enableHostShuffle(str(tmp_path), process_id=0,
+                                      n_processes=1, timeout_s=5.0)
+        srv = SQLServer(spark, port=0).start()
+
+        def status():
+            with urllib.request.urlopen(
+                    f"http://{srv.host}:{srv.port}/status",
+                    timeout=30) as r:
+                return json.loads(r.read())
+
+        st = status()
+        assert st["runActivity"] == {}            # codes never engaged
+        svc.counters["rle_columns_encoded"] += 3
+        svc.counters["run_bytes_saved"] += 2048
+        _col.bump_run_aware(128)
+        st = status()
+        got = st["runActivity"]["default"]
+        assert got["rle_columns_encoded"] == 3
+        assert got["run_bytes_saved"] == 2048
+        assert got["run_aware_op_rows"] == 128
+    finally:
+        if srv is not None:
+            srv.stop()
+        spark._crossproc_svc = prev
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]
+
+
 def test_spill_and_ledger_gauges_exported(spark, tmp_path):
     """Memory-pressure handling is observable: spill bytes/events, fetch
     backpressure waits, and the host ledger's peak/budget surface as

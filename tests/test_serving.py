@@ -126,6 +126,33 @@ def test_plan_cache_invalidation_oracle_exact(serve_root):
         s1.sql(q).collect()
 
 
+def test_run_codes_conf_invalidates_plan_cache(serve_root):
+    """``spark.tpu.shuffle.wire.runCodes`` is a planning conf: SET must
+    evict cached entries built under the old value (run-encoded and raw
+    wire plans are not interchangeable executables), and the re-planned
+    run must stay oracle-equal."""
+    cache = PlanCache(serve_root.conf_obj)
+    s = serve_root.newSession()
+    s._plan_cache = cache
+    s.sql("CREATE TABLE pcrun_t AS "
+          "SELECT id % 4 AS k, id AS v FROM range(64)")
+    q = ("SELECT k, sum(v) AS sv, count(*) AS c FROM pcrun_t "
+         "GROUP BY k ORDER BY k")
+    a1 = [tuple(r) for r in s.sql(q).collect()]
+    assert [tuple(r) for r in s.sql(q).collect()] == a1
+    assert cache.stats()["hits"] >= 1
+    before = cache.stats()["invalidations"]
+    s.sql("SET spark.tpu.shuffle.wire.runCodes=false")
+    assert cache.stats()["invalidations"] > before, \
+        "runCodes must be fingerprinted as a planning conf"
+    a2 = [tuple(r) for r in s.sql(q).collect()]
+    oracle = [tuple(r)
+              for r in serve_root.newSession().sql(q).collect()]
+    assert a2 == oracle == a1
+    s.sql("SET spark.tpu.shuffle.wire.runCodes=true")
+    s.sql("DROP TABLE pcrun_t")
+
+
 def test_dataframe_write_invalidates_plan_cache(serve_root, tmp_path):
     """Regression: DataFrame-API writes (``df.write...save``) mutate the
     same paths the SQL commands do, but only the SQL commands called the

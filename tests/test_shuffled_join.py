@@ -319,3 +319,42 @@ def test_grace_parity_two_processes(tmp_path):
 @pytest.mark.slow
 def test_grace_parity_three_processes(tmp_path):
     _run_grace_parity(tmp_path, 3)
+
+
+# ---------------------------------------------------------------------------
+# run-codes parity: run-encoded vs raw wire on BOTH exchange lanes over a
+# time-series-shaped workload (sorted key runs + a dictionary+RLE composed
+# status column), under the forced-spill conf so encoded frames also stage
+# through disk without inflating — every leg oracle-exact
+# ---------------------------------------------------------------------------
+
+def _run_runcodes_parity(tmp_path, n, timeout_s=90.0):
+    root = str(tmp_path / "shuf")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("SPARK_TPU_FAULT_PLAN", None)
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(pid), str(n), root, "runcodes",
+         str(timeout_s)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(n)]
+    outs = [p.communicate(timeout=420)[0] for p in procs]
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid}:\n{out}"
+        # the worker asserted the gauge side (rle_columns_encoded,
+        # run_bytes_saved, run_aware_op_rows, runs_materialized, spill
+        # under the capped ledger) before printing its OK line
+        assert f"[p{pid}] RUNCODES-OK" in out, out
+        assert "RC-PARITY-FAIL" not in out, out
+        line = [ln for ln in out.splitlines()
+                if f"[p{pid}] RUNCODES-OK" in ln][-1]
+        assert "rle=0" not in line and "runaware=0" not in line, out
+    return outs
+
+
+def test_runcodes_parity_two_processes(tmp_path):
+    _run_runcodes_parity(tmp_path, 2)
+
+
+@pytest.mark.slow
+def test_runcodes_parity_three_processes(tmp_path):
+    _run_runcodes_parity(tmp_path, 3)

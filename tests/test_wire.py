@@ -500,3 +500,195 @@ def test_spilled_runs_byte_budget_triggers_spill(tmp_path):
     runs = s.drain()
     assert sum(b.capacity for b in runs) == 128
     s.close()
+
+
+# ---------------------------------------------------------------------------
+# run-length & delta encoded wire (never-inflate shuffle)
+# ---------------------------------------------------------------------------
+
+def _enc_tags(buf):
+    return [c["enc_tag"] for m in wire.frame_info(buf)["batches"]
+            for c in m["columns"]]
+
+
+def _run_batch(values, lengths, dt=T.int64):
+    data = np.repeat(np.asarray(values, np.dtype(dt.np_dtype)),
+                     np.asarray(lengths, np.int64))
+    v = ColumnVector(data, dt, None, None)
+    return ColumnBatch(["x"], [v], None, len(data))
+
+
+def test_rle_roundtrip_and_enc_tag():
+    b = _run_batch([7, -3, 7, 0], [40, 20, 30, 10])
+    raw = wire.encode_batches([b])
+    stats = {}
+    enc = wire.encode_batches([b], run_codes=True, stats=stats)
+    assert _enc_tags(enc) == ["rle"]
+    assert len(enc) < len(raw)            # never-inflate, and here: saves
+    assert stats["rle_columns_encoded"] == 1
+    assert stats["run_bytes_saved"] > 0
+    _assert_batches_equal(wire.decode_batches(enc), [b])
+
+
+def test_delta_roundtrip_and_enc_tag():
+    # monotone int64 ids: diffs fit int8 → 8x narrower on the wire
+    b = ColumnBatch.from_arrays(
+        {"id": np.arange(1 << 12, dtype=np.int64) + (1 << 40)})
+    enc = wire.encode_batches([b], run_codes=True)
+    assert _enc_tags(enc) == ["delta"]
+    assert len(enc) < len(wire.encode_batches([b]))
+    _assert_batches_equal(wire.decode_batches(enc), [b])
+
+
+def test_delta_exact_across_wraparound():
+    # diffs that overflow the narrow dtype's range stay exact through
+    # the modular int64 arithmetic or fall back to raw — never corrupt
+    data = np.array([np.iinfo(np.int64).min, np.iinfo(np.int64).max] * 32,
+                    np.int64)
+    b = ColumnBatch.from_arrays({"x": data})
+    enc = wire.encode_batches([b], run_codes=True)
+    _assert_batches_equal(wire.decode_batches(enc), [b])
+
+
+def test_run_codes_never_inflate_high_cardinality():
+    rng = np.random.default_rng(11)
+    b = ColumnBatch.from_arrays(
+        {"x": rng.integers(np.iinfo(np.int64).min, np.iinfo(np.int64).max,
+                           1 << 12, dtype=np.int64)})
+    enc = wire.encode_batches([b], run_codes=True)
+    assert _enc_tags(enc) == ["raw"]      # probe rejected both codecs
+    assert len(enc) <= len(wire.encode_batches([b])) + 16
+    _assert_batches_equal(wire.decode_batches(enc), [b])
+
+
+def test_run_codes_empty_and_single_run_columns():
+    empty = ColumnBatch(["x"], [ColumnVector(np.zeros(0, np.int64),
+                                             T.int64, None, None)], None, 0)
+    buf = wire.encode_batches([empty], run_codes=True)
+    _assert_batches_equal(wire.decode_batches(buf), [empty])
+    one_run = _run_batch([42], [4096])    # constant column: 1 run
+    buf = wire.encode_batches([one_run], run_codes=True)
+    assert _enc_tags(buf) == ["rle"]
+    _assert_batches_equal(wire.decode_batches(buf), [one_run])
+    _assert_batches_equal(
+        wire.decode_batches(buf, keep_runs=True), [one_run])
+
+
+def test_run_codes_float_columns_stay_raw():
+    # float runs are excluded wholesale (NaN/-0.0 equality semantics)
+    b = ColumnBatch.from_arrays({"f": np.zeros(1 << 10, np.float64)})
+    assert _enc_tags(wire.encode_batches([b], run_codes=True)) == ["raw"]
+
+
+def test_legacy_untagged_frames_still_decode():
+    b = _run_batch([1, 2], [32, 32])
+    legacy = wire.encode_batches([b])     # no run_codes: no enc tags
+    assert _enc_tags(legacy) == ["raw"]
+    _assert_batches_equal(wire.decode_batches(legacy), [b])
+    # a run-aware reader over a legacy frame is a plain decode
+    _assert_batches_equal(wire.decode_batches(legacy, keep_runs=True), [b])
+
+
+def test_keep_runs_decodes_lazily_and_counts_materialization():
+    from spark_tpu import columnar as _col
+    b = _run_batch([5, 9], [512, 512])
+    buf = wire.encode_batches([b], run_codes=True)
+    out = wire.decode_batches(buf, keep_runs=True)[0]
+    runs = _col.unmaterialized_runs(out.vectors[0])
+    assert runs is not None and not runs.is_materialized
+    assert out.capacity == 1024
+    base = _col.runs_materialized()
+    np.testing.assert_array_equal(np.asarray(out.vectors[0].data),
+                                  np.asarray(b.vectors[0].data))
+    assert _col.runs_materialized() - base == 1024
+    # second access reuses the dense cache — no double count
+    _ = out.vectors[0].data
+    assert _col.runs_materialized() - base == 1024
+
+
+def test_encode_ships_lazy_run_vector_without_inflating():
+    """The free path: a still-encoded run vector re-ships its run table
+    directly — no materialization, no probe."""
+    from spark_tpu import columnar as _col
+    from spark_tpu.columnar import RunColumnVector
+    rv = RunColumnVector(np.asarray([3, 8], np.int64),
+                         np.asarray([600, 424], np.int64), T.int64)
+    b = ColumnBatch(["x"], [rv], None, 1024)
+    stats = {}
+    buf = wire.encode_batches([b], run_codes=True, stats=stats)
+    assert not rv.is_materialized
+    assert stats["rle_columns_encoded"] == 1
+    assert _enc_tags(buf) == ["rle"]
+    np.testing.assert_array_equal(
+        np.asarray(wire.decode_batches(buf)[0].vectors[0].data),
+        np.repeat([3, 8], [600, 424]))
+    # raw_nbytes/payload_nbytes count the ENCODED bytes, not 1024 rows
+    assert wire.raw_nbytes([b]) == rv.run_values.nbytes \
+        + rv.run_lengths.nbytes
+    assert wire.payload_nbytes([b]) == wire.raw_nbytes([b])
+
+
+def test_dictionary_and_rle_compose():
+    # dictionary codes (int32) in runs: RLE over the CODES, words intact
+    codes = np.repeat(np.array([1, 0, 2], np.int32), [50, 30, 20])
+    v = ColumnVector(codes, T.string, None, ("ash", "fig", "oak"))
+    b = ColumnBatch(["s"], [v], None, 100)
+    buf = wire.encode_batches([b], run_codes=True)
+    assert _enc_tags(buf) == ["rle"]
+    out = wire.decode_batches(buf, keep_runs=True)[0]
+    from spark_tpu import columnar as _col
+    runs = _col.unmaterialized_runs(out.vectors[0])
+    assert runs is not None
+    assert out.vectors[0].dictionary == ("ash", "fig", "oak")
+    _assert_batches_equal(wire.decode_batches(buf), [b])
+
+
+def test_run_codes_with_validity_roundtrip():
+    data = np.repeat(np.array([4, 6], np.int64), [64, 64])
+    valid = np.ones(128, bool)
+    valid[::7] = False
+    b = ColumnBatch(["x"], [ColumnVector(data, T.int64, valid, None)],
+                    None, 128)
+    buf = wire.encode_batches([b], run_codes=True)
+    assert _enc_tags(buf) == ["rle"]
+    _assert_batches_equal(wire.decode_batches(buf), [b])
+
+
+def test_malformed_run_table_fails_structured():
+    import json
+    import struct
+    import zlib
+    b = _run_batch([1, 2], [512, 512])
+    buf = wire.encode_batches([b], run_codes=True)
+    # rewrite the header's declared row count so the run lengths no
+    # longer sum to it — the decoder must refuse, never emit rows
+    hlen = struct.unpack_from("<I", buf, 8)[0]
+    header = json.loads(buf[wire.PREFIX_LEN:wire.PREFIX_LEN + hlen])
+    header["batches"][0]["capacity"] = 1000
+    header["batches"][0]["columns"][0]["shape"] = [1000]
+    hb = json.dumps(header, separators=(",", ":")).encode()
+    payload = buf[wire.PREFIX_LEN + hlen:]
+    cksum = zlib.adler32(payload, zlib.adler32(hb))
+    new = wire._PREFIX.pack(wire.MAGIC, wire.WIRE_VERSION, len(hb),
+                            len(payload), cksum) + hb + payload
+    with pytest.raises(wire.WireFormatError) as ei:
+        wire.decode_batches(new)
+    assert "run table" in str(ei.value)
+    with pytest.raises(wire.WireFormatError):
+        wire.decode_batches(new, keep_runs=True)
+
+
+def test_corrupt_and_truncated_run_frames_classified():
+    # checksum/truncation classification is unchanged by enc tags — the
+    # retryable taxonomy the refetch path heals from
+    for col in (_run_batch([1, 2, 3], [100, 200, 100]),
+                ColumnBatch.from_arrays(
+                    {"id": np.arange(400, dtype=np.int64)})):
+        buf = wire.encode_batches([col], run_codes=True)
+        assert _enc_tags(buf) != ["raw"]
+        flipped = bytearray(buf)
+        flipped[-3] ^= 0xFF
+        with pytest.raises(wire.ChecksumError):
+            wire.decode_batches(bytes(flipped))
+        with pytest.raises(wire.TruncatedBlockError):
+            wire.decode_batches(buf[:-5])
