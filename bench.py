@@ -184,6 +184,7 @@ def _run_tpu_probes() -> None:
     for script, out_name in [("tools/prof_agg2.py", "TPU_PROFILE_LATEST.txt"),
                              ("tools/prof_join.py", "TPU_JOIN_PROFILE_LATEST.txt"),
                              ("tools/prof_ici.py", "TPU_ICI_PROFILE_LATEST.txt"),
+                             ("tools/prof_runs.py", "TPU_RUNS_PROFILE_LATEST.txt"),
                              ("tools/bisect_q3.py", "TPU_BISECT_LATEST.txt")]:
         left = t_end - time.time()
         if left < 60:
@@ -1511,6 +1512,30 @@ def _bench_dist_rle() -> dict:
         sums = {o[m]["checksum"] for o in objs for m in ("runs", "raw")}
         if len(sums) != 1:
             raise RuntimeError(f"runs/raw results diverge: {objs}")
+        # the plane pair runs its own (filter+agg) query: planes on vs
+        # off must be byte-identical across modes AND processes
+        psums = {o[m]["checksum"] for o in objs
+                 for m in ("plane", "noplane")}
+        if len(psums) != 1:
+            raise RuntimeError(f"plane/noplane results diverge: {objs}")
+        # the r20 contract: with runPlanes on the jitted stage lane ran
+        # the eligible query compressed — stages entered as planes, and
+        # not one run expanded on the host during the timed iterations
+        if sum(o["plane"]["run_plane_stages"] for o in objs) == 0:
+            raise RuntimeError(
+                f"plane run never entered a stage compressed: {objs}")
+        mat = sum(o["plane"]["runs_materialized_delta"] for o in objs)
+        if mat != 0:
+            raise RuntimeError(
+                f"plane run materialized {mat} run rows on the host "
+                f"(want 0): {objs}")
+        pl_s = max(o["plane"]["seconds"] for o in objs)
+        npl_s = max(o["noplane"]["seconds"] for o in objs)
+        plane_ratio = pl_s / max(1e-9, npl_s)
+        if plane_ratio > 1.1:
+            raise RuntimeError(
+                f"plane wall {pl_s:.3f}s is {plane_ratio:.2f}x the "
+                f"materializing path {npl_s:.3f}s (> 1.1x budget)")
         # span ownership need not balance, so a process that keeps its
         # shard local frames nothing — the EXCHANGE must run-encode
         if sum(o["runs"]["rle_columns_encoded"] for o in objs) == 0:
@@ -1541,6 +1566,10 @@ def _bench_dist_rle() -> dict:
             "distrle_dcn_byte_reduction": round(reduction, 2),
             "distrle_run_bytes_saved": sum(
                 o["runs"]["run_bytes_saved"] for o in objs),
+            "distrleplane_wall_vs_dense": round(plane_ratio, 3),
+            "distrleplane_rows_per_sec": round(rows / pl_s, 1),
+            "distrleplane_stages": sum(
+                o["plane"]["run_plane_stages"] for o in objs),
         }
     finally:
         shutil.rmtree(d, ignore_errors=True)
@@ -1579,19 +1608,51 @@ def distrle_worker_main() -> None:
     Q = ("SELECT status, count(*) AS c, sum(v) AS sv, "
          "sum(sensor) AS ss, sum(bonus) AS sb FROM ev "
          "JOIN dm ON ts = dk GROUP BY status ORDER BY status")
+    # the plane modes run the eligible filter+agg shape over the sorted
+    # key: on the encoded wire the reduce-side shards arrive run-encoded,
+    # and with runPlanes on the jitted stage lane must execute this query
+    # without materializing a single run on the host
+    QP = (f"SELECT ts, count(*) AS c, sum(v) AS sv FROM ev "
+          f"JOIN dm ON ts = dk WHERE ts < {DR_KEYS // 2} "
+          f"GROUP BY ts ORDER BY ts")
 
+    from spark_tpu import columnar as _col
     session = SparkSession.builder.appName(f"bench-dr-{pid}").getOrCreate()
     out = {"pid": pid, "rows_total": int(DR_ROWS)}
-    for mode in ("runs", "raw"):
+    for mode in ("runs", "raw", "plane", "noplane"):
+        q = QP if mode in ("plane", "noplane") else Q
         xs = session.newSession()
         xs.conf.set(C.MESH_SHARDS.key, "1")
         xs.conf.set(C.SHUFFLE_WIRE_RUN_CODES.key,
-                    "true" if mode == "runs" else "false")
-        # pin the range sort-merge path both runs: the sorted spans are
-        # where presorted-slice RLE is free, and this lane measures the
-        # WIRE format, not a join-strategy difference
-        xs.conf.set(C.CROSSPROC_SORT_MERGE_JOIN.key, "true")
-        xs.conf.set(C.CROSSPROC_SHUFFLED_JOIN.key, "false")
+                    "false" if mode == "raw" else "true")
+        xs.conf.set(C.STAGE_RUN_PLANES.key,
+                    "false" if mode == "noplane" else "true")
+        # runs/raw pin the range sort-merge path: the sorted spans are
+        # where presorted-slice RLE is free, and that pair measures the
+        # WIRE format, not a join-strategy difference.  The plane pair
+        # pins the shuffled hash path instead — under the presorted
+        # merge ev never leaves the process (only dm is gathered), so
+        # only a real shuffle makes the run-shaped probe side cross the
+        # encoded wire and arrive at the reduce-side stage as run
+        # vectors, the boundary the planes compress
+        smj = mode in ("runs", "raw")
+        xs.conf.set(C.CROSSPROC_SORT_MERGE_JOIN.key,
+                    "true" if smj else "false")
+        xs.conf.set(C.CROSSPROC_SHUFFLED_JOIN.key,
+                    "false" if smj else "true")
+        if not smj:
+            # the reducer's own map output normally short-circuits the
+            # wire as a dense slice, and one dense piece in the drain
+            # union forces the whole column dense — the forced-spill
+            # threshold stages EVERY piece through the encoded frames
+            # (the parity battery's configuration), so the reduce-side
+            # union stays run-encoded and the stage boundary sees run
+            # vectors.  The small advisory target keeps both processes
+            # reducing instead of coalescing every fine partition onto
+            # process 0 (the filtered side is ~2 MiB, under the 4 MiB
+            # default)
+            xs.conf.set(C.SHUFFLE_SPILL_THRESHOLD.key, "1024")
+            xs.conf.set(C.SHUFFLE_TARGET_PARTITION_BYTES.key, "65536")
         xs.conf.set(C.CROSSPROC_AUTO_BROADCAST.key, "0")
         xs.conf.set(C.SHUFFLE_FINE_PARTITIONS.key, "16")
         svc = xs.enableHostShuffle(os.path.join(root, mode),
@@ -1602,24 +1663,26 @@ def distrle_worker_main() -> None:
             .createOrReplaceTempView("ev")
         xs.createDataFrame({"dk": dk[mine], "bonus": bonus[mine]}) \
             .createOrReplaceTempView("dm")
-        xs.sql(Q).collect()                  # warm: compile + caches
+        xs.sql(q).collect()                  # warm: compile + caches
         # median-of-3: filesystem-barrier jitter dominates run-to-run
         # variance, and both processes must repeat in lockstep anyway
         iters = []
+        mat0 = _col.runs_materialized()
+        stages0 = _col.run_plane_stages()
         for _ in range(3):
             it_bytes = int(svc.counters["bytes_written"])
             it_rows = int(svc.counters["rows_shipped"])
             t0 = time.perf_counter()
-            rows = xs.sql(Q).collect()
+            rows = xs.sql(q).collect()
             iters.append((time.perf_counter() - t0,
                           int(svc.counters["bytes_written"]) - it_bytes,
                           int(svc.counters["rows_shipped"]) - it_rows))
         elapsed, it_bytes, it_rows = sorted(iters)[1]
         chk = 0
-        for r in rows:                 # order pinned by ORDER BY status
+        for r in rows:                 # order pinned by the ORDER BY
             chk = (chk * 1000003 + zlib.crc32(str(r[0]).encode())
-                   + 7 * int(r[1]) + int(r[2]) + 3 * int(r[3])
-                   + int(r[4])) & 0xFFFFFFFF
+                   + sum((3 + 2 * i) * int(r[i])
+                         for i in range(1, len(r)))) & 0xFFFFFFFF
         out[mode] = {
             "seconds": round(elapsed, 3),
             "bytes_written": it_bytes,
@@ -1629,6 +1692,9 @@ def distrle_worker_main() -> None:
             "rle_columns_encoded": int(
                 svc.counters["rle_columns_encoded"]),
             "run_bytes_saved": int(svc.counters["run_bytes_saved"]),
+            "runs_materialized_delta": int(
+                _col.runs_materialized() - mat0),
+            "run_plane_stages": int(_col.run_plane_stages() - stages0),
         }
     print(json.dumps(out))
     sys.stdout.flush()

@@ -57,7 +57,7 @@ __all__ = [
     "runtime_checks_enabled", "verify_join_strategy",
     "verify_hash_copartition", "verify_range_cutpoints",
     "verify_span_owners", "verify_skew_split", "verify_presorted_build",
-    "verify_unified_dictionaries", "verify_ledger_scope",
+    "verify_run_plane", "verify_unified_dictionaries", "verify_ledger_scope",
     "verify_recovery_agreement", "verify_epoch_released",
     "verify_elastic_reducer_plan", "verify_grace_bucket_partition",
     "decision_trace", "verify_decision_trace",
@@ -146,6 +146,9 @@ def verify_hash_copartition(join, key_pairs, bounds, n_fine: int,
         # processes with no reducer group: they own the empty fine
         # range, so ANY live row here is a co-partitioning violation
         lo = hi = n_fine
+    from ..columnar import ColumnBatch, ColumnVector, unmaterialized_runs
+    from ..expressions import Col
+
     for side, shard, exprs in (
             ("left", left_shard, [l for l, _ in key_pairs]),
             ("right", right_shard, [r for _, r in key_pairs])):
@@ -153,6 +156,22 @@ def verify_hash_copartition(join, key_pairs, bounds, n_fine: int,
         mask = _live_mask(host)
         if not mask.any():
             continue
+        if len(exprs) == 1 and isinstance(exprs[0], Col) \
+                and exprs[0]._name in host.names and bool(mask.all()):
+            src = host.column(exprs[0]._name)
+            rv = unmaterialized_runs(src)
+            if rv is not None and src.valid is None \
+                    and rv.capacity == host.capacity:
+                # run-encoded key, fully live: every row of a run shares
+                # its head's hash, so the per-row range check reduces to
+                # the run HEADS — keep the shard compressed instead of
+                # inflating it just to verify routing
+                host = ColumnBatch(
+                    [exprs[0]._name],
+                    [ColumnVector(np.asarray(rv.run_values), src.dtype,
+                                  dictionary=src.dictionary)],
+                    None, len(rv.run_values))
+                mask = np.ones(host.capacity, bool)
         ectx = EvalContext(host, np)
         h = ectx.broadcast(Hash64(*exprs).eval(ectx)).data
         fine = (np.asarray(h).astype(np.uint64)
@@ -213,10 +232,27 @@ def verify_skew_split(join, owners: Sequence[Sequence[int]]) -> None:
 
 def verify_presorted_build(join, build_shard, r_expr,
                            as_float: bool) -> None:
-    from ..expressions import EvalContext
+    from ..columnar import ColumnBatch, ColumnVector, unmaterialized_runs
+    from ..expressions import Col, EvalContext
     from ..sql.joins import range_encode_key
 
     host = build_shard.to_host()
+    if isinstance(r_expr, Col) and r_expr._name in host.names:
+        src = host.column(r_expr._name)
+        rv = unmaterialized_runs(src)
+        if rv is not None and src.valid is None \
+                and rv.capacity == host.capacity:
+            # Run-encoded build key, fully live: the encoded key is
+            # constant within a run, so the dense (null-prefix, sorted)
+            # properties hold iff they hold over the run HEADS.  Check
+            # the run table directly — materializing the shard just to
+            # verify it would defeat the compressed lane the check
+            # guards (and bump ``runs_materialized`` under tests that
+            # pin it at zero).
+            head = ColumnVector(np.asarray(rv.run_values), src.dtype,
+                                dictionary=src.dictionary)
+            host = ColumnBatch([r_expr._name], [head], None,
+                               len(rv.run_values))
     ectx = EvalContext(host, np)
     encoded = range_encode_key(ectx, r_expr, as_float)
     if encoded is None:
@@ -242,6 +278,36 @@ def verify_presorted_build(join, build_shard, r_expr,
                 f"{int(keys[i])} > row {i + 1}'s {int(keys[i + 1])} — "
                 "the _presorted_build claim would make PMergeJoin "
                 "silently drop matches")
+
+
+def verify_run_plane(rv, capacity: int) -> None:
+    """Stage-boundary contract of a run plane (INVARIANTS.md
+    ``run-plane`` row): the run table the planner is about to pad onto
+    a device plane must decode to EXACTLY the dense batch it stands in
+    for — every run strictly positive (zero-length runs would alias
+    padding and break the searchsorted row-id expansion) and the
+    lengths summing to the batch capacity (anything else silently
+    drops or invents rows inside the jitted stage)."""
+    lengths = np.asarray(rv.run_lengths)
+    if lengths.shape[0] != np.asarray(rv.run_values).shape[0]:
+        raise PlanInvariantError(
+            "stage-leaf", "run-plane",
+            f"run table is ragged: {np.asarray(rv.run_values).shape[0]} "
+            f"values vs {lengths.shape[0]} lengths")
+    if lengths.size and int(lengths.min()) <= 0:
+        i = int(np.argmin(lengths))
+        raise PlanInvariantError(
+            "stage-leaf", "run-plane",
+            f"run {i} has non-positive length {int(lengths[i])} — "
+            "zero-length runs alias the plane's padding and corrupt "
+            "the searchsorted row-id expansion")
+    total = int(lengths.sum())
+    if total != int(capacity):
+        raise PlanInvariantError(
+            "stage-leaf", "run-plane",
+            f"run lengths sum to {total} but the stage leaf holds "
+            f"{int(capacity)} rows — the plane would decode to the "
+            "wrong dense batch inside the jitted stage")
 
 
 def verify_unified_dictionaries(node, batches: Sequence) -> None:

@@ -172,9 +172,13 @@ def _check_logical(node) -> None:
 
 def _vec_np_dtype(v) -> np.dtype:
     """A vector's physical np dtype WITHOUT touching ``.data`` — that
-    would inflate a lazy run-encoded column just to learn its dtype
-    (the run values share the dense array's dtype by construction)."""
-    from ..columnar import unmaterialized_runs
+    would inflate a lazy run-encoded column (or expand a device run
+    plane in-trace) just to learn its dtype (run/plane values share the
+    dense array's dtype by construction)."""
+    from ..columnar import unexpanded_plane, unmaterialized_runs
+    p = unexpanded_plane(v)
+    if p is not None:
+        return np.dtype(p.plane_values.dtype)
     r = unmaterialized_runs(v)
     return np.dtype((r.run_values if r is not None else v.data).dtype)
 

@@ -133,6 +133,60 @@ def bump_run_aware(n: int) -> None:
     _RUN_AWARE_OP_ROWS += int(n)
 
 
+#: run-plane activity — the device-side close of the run line.  A "plane"
+#: is the fixed-capacity pytree form of a run table (see
+#: ``PlaneColumnVector``): stages count stage entries that carried at
+#: least one plane input, rows count the dense rows those planes stood in
+#: for, overflows count run tables too large to compress (fell back to
+#: counted materialization at the boundary), and expansions count
+#: in-TRACE dense expansions (an untaught operator read ``.data`` inside
+#: a jitted stage — paid in device gathers, never host inflation, and
+#: counted once per trace, not per dispatch).
+_RUN_PLANE_STAGES = 0
+_RUN_PLANE_ROWS = 0
+_RUN_PLANE_OVERFLOWS = 0
+_RUN_PLANE_EXPANSIONS = 0
+
+
+def run_plane_stages() -> int:
+    """Stage dispatches that carried at least one run-plane input
+    (process-wide; gauge consumers diff against a baseline)."""
+    return _RUN_PLANE_STAGES
+
+
+def run_plane_rows() -> int:
+    """Dense rows that crossed the jit boundary as run planes instead of
+    materialized arrays (process-wide)."""
+    return _RUN_PLANE_ROWS
+
+
+def run_plane_overflows() -> int:
+    """Run vectors whose run count was too large for a compressing plane
+    — materialized counted at the boundary instead (process-wide)."""
+    return _RUN_PLANE_OVERFLOWS
+
+
+def run_plane_expansions() -> int:
+    """In-trace searchsorted-gather expansions of a plane by an untaught
+    operator — once per trace, not per dispatch (process-wide)."""
+    return _RUN_PLANE_EXPANSIONS
+
+
+def bump_plane_stage() -> None:
+    global _RUN_PLANE_STAGES
+    _RUN_PLANE_STAGES += 1
+
+
+def bump_plane_rows(n: int) -> None:
+    global _RUN_PLANE_ROWS
+    _RUN_PLANE_ROWS += int(n)
+
+
+def bump_plane_overflow() -> None:
+    global _RUN_PLANE_OVERFLOWS
+    _RUN_PLANE_OVERFLOWS += 1
+
+
 def encode_strings(values: Sequence[Optional[str]]) -> Tuple[np.ndarray, Tuple[str, ...]]:
     """Dictionary-encode strings: codes into a SORTED dictionary.
 
@@ -349,11 +403,16 @@ class ColumnBatch:
         if self.row_valid is not None:
             return self.row_valid
         # probe device residency without touching .data — that would
-        # materialize a lazy RunColumnVector (which is host by nature)
-        xp = jnp if any(
-            isinstance(v._dense if isinstance(v, RunColumnVector)
-                       else v.data, jax.Array)
-            for v in self.vectors) else np
+        # materialize a lazy RunColumnVector (host) or expand a
+        # PlaneColumnVector (device) before any operator asked for rows
+        def _probe(v):
+            if isinstance(v, PlaneColumnVector):
+                return v.plane_values if v._dense is None else v._dense
+            if isinstance(v, RunColumnVector):
+                return v._dense
+            return v.data
+        xp = jnp if any(isinstance(_probe(v), jax.Array)
+                        for v in self.vectors) else np
         return xp.ones(self.capacity, dtype=bool)
 
     def num_rows(self):
@@ -446,7 +505,18 @@ class RunColumnVector(ColumnVector):
         return self  # run tables are always host arrays
 
     def to_device(self) -> "ColumnVector":
-        return ColumnVector(jnp.asarray(self.data), self.dtype,
+        if self._dense is None:
+            # expand ON DEVICE: the run table crosses as two small
+            # arrays and the repeat runs compiled (shape-static via
+            # total_repeat_length) — the counted host expansion in
+            # ``.data`` is reserved for operators that genuinely need
+            # dense HOST rows
+            data = jnp.repeat(jnp.asarray(self.run_values),
+                              jnp.asarray(self.run_lengths),
+                              total_repeat_length=self._n)
+        else:
+            data = jnp.asarray(self._dense)
+        return ColumnVector(data, self.dtype,
                             None if self.valid is None
                             else jnp.asarray(self.valid),
                             self.dictionary)
@@ -465,6 +535,120 @@ def unmaterialized_runs(v: ColumnVector) -> Optional[RunColumnVector]:
     """``v`` if it is a run-encoded column whose dense form was never built
     (so run-granularity work is still a win), else None."""
     if isinstance(v, RunColumnVector) and not v.is_materialized:
+        return v
+    return None
+
+
+class PlaneColumnVector(ColumnVector):
+    """Fixed-capacity DEVICE form of a run table — the shape-stable pytree
+    citizen that lets compressed columns cross the jit boundary.
+
+    ``plane_values`` (run values zero-padded to the plane capacity, a
+    ``pad_capacity`` bucket of the run count) and ``plane_lengths``
+    (int64 run lengths, zero-padded) are the two pytree leaves; the dense
+    capacity they stand in for is static aux.  Real runs are exactly the
+    ``lengths > 0`` prefix — RLE never emits zero-length runs, so the
+    zero padding is unambiguous.  Taught jit-lane kernels (segmented
+    filter, keyless count/sum/min/max, bare-column project) read the
+    plane directly; any untaught operator that asks for ``.data`` gets a
+    memoized in-trace searchsorted-gather expansion (counted in
+    ``run_plane_expansions``) — byte-identical, fused and dead-code
+    eliminated by XLA when unused, and it never touches the host
+    ``runs_materialized`` counter.  Planes are a LOCAL stage form: mesh
+    (shard_map) stages never receive them, because slicing a plane along
+    the run axis would not slice the rows it encodes."""
+
+    __slots__ = ("plane_values", "plane_lengths", "n_runs", "_capacity",
+                 "_dense")
+
+    def __init__(self, plane_values: Array, plane_lengths: Array,
+                 dtype: T.DataType, capacity: int,
+                 valid: Optional[Array] = None,
+                 dictionary: Optional[Tuple[str, ...]] = None,
+                 n_runs: Optional[int] = None):
+        self.plane_values = plane_values
+        self.plane_lengths = plane_lengths
+        self.n_runs = None if n_runs is None else int(n_runs)
+        self._capacity = int(capacity)
+        self._dense = None
+        self.dtype = dtype
+        self.valid = valid
+        self.dictionary = dictionary
+
+    @classmethod
+    def from_runs(cls, rv: RunColumnVector,
+                  plane_cap: int, device: bool = True) -> "PlaneColumnVector":
+        """Pad a host run table into a plane of capacity ``plane_cap``
+        (a ``pad_capacity`` bucket ≥ the run count)."""
+        nr = len(rv.run_values)
+        values = np.zeros(plane_cap, rv.run_values.dtype)
+        values[:nr] = rv.run_values
+        lengths = np.zeros(plane_cap, np.int64)
+        lengths[:nr] = rv.run_lengths
+        if device:
+            values, lengths = jnp.asarray(values), jnp.asarray(lengths)
+        return cls(values, lengths, rv.dtype, rv.capacity, rv.valid,
+                   rv.dictionary, n_runs=nr)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"PlaneColumnVector({self.dtype!r}, capacity={self._capacity},"
+                f" plane={int(self.plane_values.shape[0])},"
+                f" runs={self.n_runs}, expanded={self._dense is not None})")
+
+    @property
+    def plane_capacity(self) -> int:
+        return int(self.plane_values.shape[0])
+
+    @property
+    def is_expanded(self) -> bool:
+        return self._dense is not None
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def data(self) -> Array:
+        # shadows the parent's `data` slot: the untaught-operator safety
+        # net — one memoized in-trace expansion per trace, never counted
+        # as host materialization
+        if self._dense is None:
+            global _RUN_PLANE_EXPANSIONS
+            _RUN_PLANE_EXPANSIONS += 1
+            from . import kernels
+            xp = jnp if isinstance(self.plane_values, jax.Array) else np
+            self._dense = kernels.run_expand(
+                xp, self.plane_values, self.plane_lengths, self._capacity)
+        return self._dense
+
+    def valid_or_true(self) -> Array:
+        if self.valid is not None:
+            return self.valid
+        xp = jnp if isinstance(self.plane_values, jax.Array) else np
+        return xp.ones(self._capacity, dtype=bool)
+
+    def to_device(self) -> "ColumnVector":
+        if isinstance(self.plane_values, jax.Array):
+            return self
+        return PlaneColumnVector(
+            jnp.asarray(self.plane_values), jnp.asarray(self.plane_lengths),
+            self.dtype, self._capacity,
+            None if self.valid is None else jnp.asarray(self.valid),
+            self.dictionary, n_runs=self.n_runs)
+
+    def to_host(self) -> "ColumnVector":
+        # leaving the device lane: hand back a dense host vector (planes
+        # have no host consumers; the expansion is the memoized one)
+        return ColumnVector(np.asarray(self.data), self.dtype,
+                            None if self.valid is None
+                            else np.asarray(self.valid),
+                            self.dictionary)
+
+
+def unexpanded_plane(v: ColumnVector) -> Optional[PlaneColumnVector]:
+    """``v`` if it is a run plane whose dense form was never demanded (so
+    plane-granularity work is still a win), else None."""
+    if isinstance(v, PlaneColumnVector) and v._dense is None:
         return v
     return None
 
@@ -621,28 +805,54 @@ def _ingest_column(raw: Any, num_rows: int, cap: int,
 # ---------------------------------------------------------------------------
 
 def _batch_flatten(b: ColumnBatch):
-    children = ([v.data for v in b.vectors],
-                [v.valid for v in b.vectors],
-                b.row_valid)
+    # a run plane contributes its (values, lengths) pair as the data child
+    # (tuples are pytrees, so both pad to leaves); the per-vector plane
+    # marker in aux carries n_runs (-1 when unknown) so unflatten rebuilds
+    # the plane instead of a dense vector
+    datas, planes = [], []
+    for v in b.vectors:
+        if isinstance(v, PlaneColumnVector):
+            datas.append((v.plane_values, v.plane_lengths))
+            planes.append(-1 if v.n_runs is None else v.n_runs)
+        else:
+            datas.append(v.data)
+            planes.append(None)
+    children = (datas, [v.valid for v in b.vectors], b.row_valid)
     aux = (tuple(b.names),
            tuple(v.dtype for v in b.vectors),
            tuple(v.dictionary for v in b.vectors),
-           b.capacity)
+           b.capacity,
+           tuple(planes))
     return children, aux
 
 
 def _batch_unflatten(aux, children):
-    names, dtypes, dicts, capacity = aux
+    if len(aux) == 5:
+        names, dtypes, dicts, capacity, planes = aux
+    else:  # pre-plane aux (serialized treedefs): no plane vectors
+        names, dtypes, dicts, capacity = aux
+        planes = (None,) * len(names)
     datas, valids, row_valid = children
     # Inside shard_map/vmap the leaves are per-shard slices whose length
     # differs from the stored aux capacity — trust the arrays when possible.
+    # Plane children are (values, lengths) tuples: their length is the
+    # plane capacity, not the dense capacity, so they never vote here.
     for leaf in list(datas) + [row_valid]:
+        if isinstance(leaf, tuple):
+            continue
         shape = getattr(leaf, "shape", None)
         if shape is not None and len(shape) >= 1:
             capacity = int(shape[0])
             break
-    vectors = [ColumnVector(d, t, v, dic)
-               for d, v, t, dic in zip(datas, valids, dtypes, dicts)]
+    vectors = []
+    for d, v, t, dic, pl in zip(datas, valids, dtypes, dicts, planes):
+        if pl is not None:
+            pv, plen = d
+            vectors.append(PlaneColumnVector(
+                pv, plen, t, capacity, v, dic,
+                n_runs=None if pl < 0 else pl))
+        else:
+            vectors.append(ColumnVector(d, t, v, dic))
     b = ColumnBatch.__new__(ColumnBatch)
     b.names = list(names)
     b.vectors = vectors

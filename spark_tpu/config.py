@@ -729,3 +729,15 @@ STAGE_CACHE_MAX_ENTRIES = conf("spark.tpu.stage.cacheMaxEntries").doc(
     "beyond it).  The cache is per PROCESS, not per session: subprocess "
     "reducers reuse compiled stages across queries within a worker."
 ).int(256)
+
+STAGE_RUN_PLANES = conf("spark.tpu.stage.runPlanes").doc(
+    "Run planes through the jitted stage lane: an eligible lazy run "
+    "column (no NULLs, run table at most half the dense capacity after "
+    "pow-2 padding) crosses the pytree boundary as a fixed-capacity "
+    "(run_values, run_lengths) device plane instead of materializing "
+    "dense.  Taught kernels — segmented filter, keyless count/sum/min/"
+    "max, bare-column project — work at run granularity; every untaught "
+    "operator expands in-trace via a searchsorted gather, byte-"
+    "identical.  Off restores the pre-r20 counted materialization at "
+    "the boundary."
+).boolean(True)

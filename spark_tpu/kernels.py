@@ -25,9 +25,9 @@ import numpy as np
 
 from . import types as T
 from .aggregates import AggregateFunction, First, IDENTITY
-from .columnar import (ColumnBatch, ColumnVector, RunColumnVector,
-                       bump_run_aware, merge_dictionaries,
-                       unmaterialized_runs)
+from .columnar import (ColumnBatch, ColumnVector, PlaneColumnVector,
+                       RunColumnVector, bump_run_aware, merge_dictionaries,
+                       unexpanded_plane, unmaterialized_runs)
 from .expressions import (Col, EvalContext, Expression, ExprValue, Rand,
                           RowIndex, SparkPartitionId)
 
@@ -312,6 +312,33 @@ def rle_expand(xp, run_values: Array, run_lengths: Array) -> Array:
     return xp.repeat(xp.asarray(run_values), xp.asarray(run_lengths))
 
 
+def run_row_ids(xp, plane_lengths: Array, capacity: int) -> Array:
+    """Row → run-index map for a fixed-capacity run plane (shape-stable,
+    jittable): inclusive-cumsum the zero-padded lengths into run END
+    offsets, then binary-search each row position right of its end.
+    Zero-length (padded) runs collapse to repeated ends that the
+    ``side="right"`` search skips, so every row lands on a REAL run.
+    O(capacity · log planes) compares, no scatter."""
+    ends = xp.cumsum(xp.asarray(plane_lengths).astype(np.int64))
+    rows = xp.arange(capacity, dtype=np.int64)
+    ids = searchsorted(xp, ends, rows, side="right")
+    # rows past sum(lengths) (never produced by a well-formed plane) clamp
+    # into range instead of indexing out of bounds
+    return xp.clip(ids, 0, max(int(plane_lengths.shape[0]) - 1, 0))
+
+
+def run_expand(xp, plane_values: Array, plane_lengths: Array,
+               capacity: int) -> Array:
+    """Searchsorted-gather expansion of a run plane to its dense array —
+    the jit-lane analog of ``rle_expand`` (whose ``repeat`` needs a data-
+    dependent output length).  numpy lane: plain repeat (exact and
+    cheaper on host)."""
+    if _is_np(xp):
+        return np.repeat(np.asarray(plane_values),
+                         np.asarray(plane_lengths))[:capacity]
+    return xp.asarray(plane_values)[run_row_ids(xp, plane_lengths, capacity)]
+
+
 def delta_encode(data: Array) -> Optional[Tuple[int, np.ndarray]]:
     """Delta / frame-of-reference encode a 1-D signed-int host array as
     ``(base, diffs)`` with diffs downcast to the narrowest of
@@ -389,10 +416,55 @@ def _run_aware_filter(batch: ColumnBatch,
     return ColumnBatch(batch.names, batch.vectors, out_rv, batch.capacity)
 
 
+def _plane_filter(xp, batch: ColumnBatch,
+                  pred: Expression) -> Optional[ColumnBatch]:
+    """Jit-lane twin of ``_run_aware_filter``: evaluate ``pred`` once per
+    run HEAD of a device plane, then expand only the boolean keep mask
+    through ``run_row_ids`` — the data column never expands.  Applies
+    when the predicate references exactly one column, that column is an
+    unexpanded run plane covering the batch, and the predicate is
+    data-deterministic (no positional expressions).  Returns None to
+    fall back to the dense path (which expands in-trace, counted)."""
+    refs = pred.references()
+    if len(refs) != 1:
+        return None
+    name = next(iter(refs))
+    if name not in batch.names:
+        return None
+    pv = unexpanded_plane(batch.column(name))
+    if pv is None or pv.valid is not None or pv.capacity != batch.capacity:
+        return None
+    stack = [pred]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, _POSITIONAL_EXPRS):
+            return None
+        stack.extend(e.children)
+    plane_cap = pv.plane_capacity
+    head = ColumnBatch(
+        [name],
+        [ColumnVector(pv.plane_values, pv.dtype, None, pv.dictionary)],
+        None, plane_cap)
+    v = pred.eval(EvalContext(head, xp))
+    keep = xp.broadcast_to(v.data, (plane_cap,))
+    if v.valid is not None:
+        keep = keep & xp.broadcast_to(v.valid, (plane_cap,))
+    keep_rows = keep.astype(bool)[run_row_ids(xp, pv.plane_lengths,
+                                              batch.capacity)]
+    out_rv = batch.row_valid_or_true() & keep_rows
+    return ColumnBatch(batch.names, batch.vectors, out_rv, batch.capacity)
+
+
 def apply_filter(xp, batch: ColumnBatch, pred: Expression,
                  row_offset: int = 0) -> ColumnBatch:
     if _is_np(xp) and row_offset == 0:
         out = _run_aware_filter(batch, pred)
+        if out is not None:
+            return out
+    if not _is_np(xp):
+        # no row_offset gate: the offset only feeds positional
+        # expressions, which _plane_filter already refuses
+        out = _plane_filter(xp, batch, pred)
         if out is not None:
             return out
     ctx = EvalContext(batch, xp, row_offset)
@@ -410,6 +482,16 @@ def apply_project(xp, batch: ColumnBatch, exprs: Sequence[Expression],
     names, vectors = [], []
     schema = batch.schema
     for e in exprs:
+        if isinstance(e, Col) and e._name in batch.names:
+            # bare column select keeps run forms (plane or host run table)
+            # un-inflated — evaluating through EvalContext would expand
+            src = batch.column(e._name)
+            if (unexpanded_plane(src) is not None
+                    or unmaterialized_runs(src) is not None) \
+                    and src.dtype.np_dtype == e.data_type(schema).np_dtype:
+                names.append(e.name)
+                vectors.append(src)
+                continue
         v = ctx.broadcast(e.eval(ctx))
         dt = e.data_type(schema)
         names.append(e.name)
@@ -534,6 +616,10 @@ def grouped_aggregate(
         out = _run_aware_global_aggregate(batch, agg_slots)
         if out is not None:
             return out
+    if not _is_np(xp) and not key_exprs:
+        out = _plane_global_aggregate(xp, batch, agg_slots)
+        if out is not None:
+            return out
     return _sorted_grouped_aggregate(xp, batch, key_exprs, agg_slots)
 
 
@@ -591,6 +677,98 @@ def _run_aware_global_aggregate(
         names.append(name)
         vectors.append(out)
     bump_run_aware(cap)
+    return ColumnBatch(names, vectors, None, 1)
+
+
+def _plane_global_aggregate(
+    xp,
+    batch: ColumnBatch,
+    agg_slots: Sequence[Tuple[AggregateFunction, str]],
+) -> Optional[ColumnBatch]:
+    """Jit-lane twin of ``_run_aware_global_aggregate`` over run PLANES,
+    extended with min/max and a dense row mask: keyless count/sum reduce
+    ``run_values × per-run-live-counts`` (the live counts come from one
+    ``segment_sum`` of the row mask over ``run_row_ids``), min/max reduce
+    the run VALUES under a per-run any-live mask — no arithmetic on
+    expanded rows, so exact for every dtype.  Fires only when provably
+    byte-identical to the dense path: every slot is count(*)/count/sum/
+    min/max (non-distinct) over a bare column whose vector is an
+    unexpanded plane with no NULLs covering the batch; integer-only sums
+    (int64 products and sums both wrap mod 2^64; float addition is not
+    associative).  Returns None to fall back (in-trace expansion,
+    counted)."""
+    from .aggregates import Count, CountStar, Max, Min, Sum
+    if batch.capacity == 0 or not agg_slots:
+        return None
+    cap = batch.capacity
+    plans = []
+    for func, name in agg_slots:
+        if getattr(func, "is_distinct", False):
+            return None
+        if isinstance(func, CountStar):
+            plans.append((func, name, None))
+            continue
+        if type(func) not in (Count, Sum, Min, Max):
+            return None
+        child = func.children[0]
+        if not isinstance(child, Col) or child._name not in batch.names:
+            return None
+        pv = unexpanded_plane(batch.column(child._name))
+        if pv is None or pv.valid is not None or pv.capacity != cap:
+            return None
+        if isinstance(func, Sum) \
+                and np.dtype(pv.dtype.np_dtype).kind not in "iub":
+            return None
+        plans.append((func, name, pv))
+    if all(pv is None for _, _, pv in plans):
+        return None  # nothing plane-encoded: nothing to claim credit for
+
+    live = batch.row_valid  # row masks are always dense, never planes
+    n_live = np.int64(cap) if live is None else xp.sum(live.astype(np.int64))
+    counts_cache: dict = {}
+
+    def run_live_counts(pv: PlaneColumnVector) -> Array:
+        """Live-row count per run slot (zero on padded slots)."""
+        if id(pv) not in counts_cache:
+            if live is None:
+                c = xp.asarray(pv.plane_lengths).astype(np.int64)
+            else:
+                ids = run_row_ids(xp, pv.plane_lengths, cap)
+                c = segment_reduce(xp, live.astype(np.int64), ids,
+                                   pv.plane_capacity, "sum")
+            counts_cache[id(pv)] = c
+        return counts_cache[id(pv)]
+
+    def as1(val, np_dt):
+        return xp.asarray(val).reshape(1).astype(np_dt)
+
+    schema = batch.schema
+    names: List[str] = []
+    vectors: List[ColumnVector] = []
+    for func, name, pv in plans:
+        dt = func.data_type(schema)
+        if pv is None or isinstance(func, (CountStar, Count)):
+            # no NULLs ⇒ count == number of live rows
+            out = ColumnVector(as1(n_live, dt.np_dtype), dt, None, None)
+        elif isinstance(func, Sum):
+            out_np = dt.np_dtype
+            total = (xp.asarray(pv.plane_values).astype(out_np)
+                     * run_live_counts(pv).astype(out_np)).sum()
+            out = ColumnVector(as1(total, out_np), dt,
+                               as1(n_live > 0, np.bool_), None)
+        else:  # Min / Max
+            red_dt = np.dtype(np.int8) if dt.np_dtype == np.bool_ \
+                else np.dtype(dt.np_dtype)
+            ident = IDENTITY[func.kind](red_dt)
+            run_live = run_live_counts(pv) > 0
+            buf = xp.where(run_live,
+                           xp.asarray(pv.plane_values).astype(red_dt),
+                           xp.asarray(ident, red_dt))
+            val = buf.min() if func.kind == "min" else buf.max()
+            out = ColumnVector(as1(val, dt.np_dtype), dt,
+                               as1(n_live > 0, np.bool_), pv.dictionary)
+        names.append(name)
+        vectors.append(out)
     return ColumnBatch(names, vectors, None, 1)
 
 
@@ -1261,19 +1439,39 @@ def union_all(batches: Sequence[ColumnBatch]) -> ColumnBatch:
         dtype = vecs[0].dtype
         dicts = [v.dictionary for v in vecs]
         runs = [unmaterialized_runs(v) for v in vecs]
-        if (all(r is not None and r.valid is None for r in runs)
-                and all(r.capacity == b.capacity
-                        for r, b in zip(runs, batches))
+        if (any(r is not None for r in runs)
+                and all((r.valid is None and r.capacity == b.capacity)
+                        if r is not None else v.valid is None
+                        for r, v, b in zip(runs, vecs, batches))
                 and len({d or () for d in dicts}) == 1):
-            # every piece is still run-encoded over one shared code space:
-            # concatenate the run TABLES and stay lazy (adjacent equal
-            # values across a seam are two runs — still a valid table)
-            rvals = np.concatenate(
-                [np.asarray(r.run_values, dtype.np_dtype) for r in runs])
-            rlens = np.concatenate([r.run_lengths for r in runs])
-            vectors.append(RunColumnVector(rvals, rlens, dtype, None,
-                                           dicts[0]))
-            continue
+            # at least one piece is still run-encoded over one shared
+            # code space: concatenate the run TABLES and stay lazy
+            # (adjacent equal values across a seam are two runs — still
+            # a valid table).  A DENSE sibling piece — typically the
+            # reducer's own map output, which short-circuits the wire
+            # and so was never run-detected — is re-encoded here IF it
+            # compresses (one vectorized diff); a piece that doesn't
+            # falls through to the dense concat, inflating the encoded
+            # pieces exactly as before
+            tables = []
+            for r, v, b in zip(runs, vecs, batches):
+                if r is not None:
+                    tables.append(
+                        (np.asarray(r.run_values, dtype.np_dtype),
+                         r.run_lengths))
+                    continue
+                vals, lens = rle_encode(np.asarray(v.data,
+                                                   dtype.np_dtype))
+                if len(vals) * 2 > b.capacity:
+                    tables = None
+                    break
+                tables.append((vals, lens))
+            if tables is not None:
+                rvals = np.concatenate([t[0] for t in tables])
+                rlens = np.concatenate([t[1] for t in tables])
+                vectors.append(RunColumnVector(rvals, rlens, dtype, None,
+                                               dicts[0]))
+                continue
         if dtype.is_string or isinstance(dtype, T.BinaryType):
             if len({d or () for d in dicts}) == 1:
                 data = np.concatenate([np.asarray(v.data) for v in vecs])

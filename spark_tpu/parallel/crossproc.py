@@ -227,12 +227,27 @@ def _replace_node(root, target, replacement):
 
 def _batch_digest(batch: ColumnBatch) -> int:
     """Order-sensitive content digest of a host batch (leaf replication
-    check)."""
+    check).  Run-encoded columns digest their run TABLE instead of the
+    dense expansion: the wire's run encoding is content-deterministic
+    and every compared copy decoded through the same lane, so run-table
+    equality is content equality — and the probe stays un-inflating, so
+    a dedup check never charges ``runs_materialized`` for rows no
+    operator touched."""
+    from ..columnar import unmaterialized_runs
     h = hashlib.sha256()
     b = batch.to_host()
     h.update(pickle.dumps(list(b.names)))
     for v in b.vectors:
-        h.update(np.ascontiguousarray(np.asarray(v.data)).tobytes())
+        rv = unmaterialized_runs(v)
+        if rv is not None:
+            h.update(b"runs:")
+            h.update(np.ascontiguousarray(
+                np.asarray(rv.run_values)).tobytes())
+            h.update(np.ascontiguousarray(
+                np.asarray(rv.run_lengths)).tobytes())
+        else:
+            h.update(b"dense:")
+            h.update(np.ascontiguousarray(np.asarray(v.data)).tobytes())
         h.update(b"|" if v.valid is None else
                  np.ascontiguousarray(np.asarray(v.valid)).tobytes())
         h.update(pickle.dumps(v.dictionary))
@@ -292,6 +307,10 @@ def _route_exchange_merge(session, plan, partial_node, partial: ColumnBatch,
                                                svc.n)
     routed = {int(r): [slice_rows(bucketed, int(off[r]), int(cnt[r]))]
               for r in lv}
+    # partial states are read exactly once by the final merge right
+    # after the hop — run-coding these small frames would only relocate
+    # a counted host expansion into the merge, so they ship raw
+    svc.mark_raw(xid)
     try:
         received = svc.exchange(xid, routed)
     except ExchangeFetchFailed:

@@ -250,7 +250,8 @@ class FetchSink:
                 buf = wire.encode_batches(
                     [b], codec=self.svc.wire_codec,
                     compress_threshold=self.svc.wire_threshold,
-                    run_codes=self.svc.run_codes)
+                    run_codes=self.svc.run_codes
+                    and self.exchange not in self.svc._raw_exchanges)
                 if path is None:
                     path = self._run_path(sender)
                 try:
@@ -502,6 +503,11 @@ class HostShuffleService:
         #: sort-merge lane): their sorted runs are free RLE fodder, so
         #: encode skips the sampled probe and tags them directly
         self._presorted_exchanges: set = set()
+        #: exchanges whose payload is consumed exactly once, immediately
+        #: after the hop (partial-state routing into a final merge):
+        #: run-coding those frames saves a few hundred bytes but moves a
+        #: counted host expansion into the consumer, so they ship raw
+        self._raw_exchanges: set = set()
         if host_names is None:
             # single-sourced naming convention (lazy: cluster pulls jax)
             from .cluster import default_host_name
@@ -682,6 +688,12 @@ class HostShuffleService:
         #: at service birth, diffed by the run gauges
         self._run_aware_base = _col.run_aware_op_rows()
         self._runs_mat_base = _col.runs_materialized()
+        #: run-plane analogs — stage-lane plane activity at service
+        #: birth, diffed by the plane gauges and /status runActivity
+        self._plane_stage_base = _col.run_plane_stages()
+        self._plane_rows_base = _col.run_plane_rows()
+        self._plane_ovf_base = _col.run_plane_overflows()
+        self._plane_exp_base = _col.run_plane_expansions()
         # background writer: lazily started, drained by commit()/flush()
         self._write_q: "queue.Queue[Optional[Tuple[str, str, List[ColumnBatch]]]]" = queue.Queue()
         self._writer: Optional[threading.Thread] = None
@@ -756,6 +768,16 @@ class HostShuffleService:
         with self._lock:
             self._presorted_exchanges.add(exchange)
 
+    def mark_raw(self, exchange: str) -> None:
+        """Declare ``exchange``'s payload single-read: every row is
+        consumed exactly once, immediately after the hop (the keyed
+        partial-state merge).  Run-coding such frames would only move a
+        counted host expansion into the consumer for a few hundred wire
+        bytes, so every encode site ships them as plain columns.  Same
+        seam style as :meth:`mark_presorted` (not a ``put`` kwarg)."""
+        with self._lock:
+            self._raw_exchanges.add(exchange)
+
     def _write_block(self, exchange: str, receiver: int,
                      batches: List[ColumnBatch]) -> None:
         """Encode + atomically publish one block; record its manifest
@@ -773,7 +795,9 @@ class HostShuffleService:
         buf = wire.encode_batches(
             batches, codec=self.wire_codec,
             compress_threshold=self.wire_threshold,
-            dict_refs=refs, stats=stats, run_codes=self.run_codes,
+            dict_refs=refs, stats=stats,
+            run_codes=self.run_codes
+            and exchange not in self._raw_exchanges,
             run_hint=exchange in self._presorted_exchanges)
         t1 = time.perf_counter()
         tmp = f"{path}.tmp.{os.getpid()}"
@@ -933,7 +957,9 @@ class HostShuffleService:
         buf = wire.encode_batches(
             list(batches), codec=self.wire_codec,
             compress_threshold=self.wire_threshold,
-            dict_refs=refs, stats=stats, run_codes=self.run_codes,
+            dict_refs=refs, stats=stats,
+            run_codes=self.run_codes
+            and exchange not in self._raw_exchanges,
             run_hint=exchange in self._presorted_exchanges)
         with self._lock:
             self.timers["encode_s"] += time.perf_counter() - t0
@@ -1917,6 +1943,18 @@ class HostShuffleService:
             _col.run_aware_op_rows() - self._run_aware_base)
         gauges["runs_materialized"] = lambda: (
             _col.runs_materialized() - self._runs_mat_base)
+        # run planes on device: stages entered with compressed leaves,
+        # dense rows those leaves stood in for, overflow fallbacks to
+        # counted materialization, and in-trace expansions (per trace,
+        # not per row — traces are cached, rows never touch the host)
+        gauges["run_plane_stages"] = lambda: (
+            _col.run_plane_stages() - self._plane_stage_base)
+        gauges["run_plane_rows"] = lambda: (
+            _col.run_plane_rows() - self._plane_rows_base)
+        gauges["run_plane_overflows"] = lambda: (
+            _col.run_plane_overflows() - self._plane_ovf_base)
+        gauges["run_plane_expansions"] = lambda: (
+            _col.run_plane_expansions() - self._plane_exp_base)
         gauges["blacklisted_peers"] = lambda: len(self.blacklist)
         gauges["blacklist"] = lambda: ",".join(
             self.host_name(p) for p in sorted(self.blacklist)) or ""
@@ -1955,6 +1993,7 @@ class HostShuffleService:
         with self._lock:
             self._dict_refs.pop(exchange, None)
             self._presorted_exchanges.discard(exchange)
+            self._raw_exchanges.discard(exchange)
             for key in [k for k in self._dict_tables if k[0] == exchange]:
                 del self._dict_tables[key]
         if self.blockclient is not None:

@@ -262,6 +262,18 @@ class SQLServer:
         out["runs_materialized"] = max(
             0, _col.runs_materialized()
             - int(getattr(svc, "_runs_mat_base", 0)))
+        out["run_plane_stages"] = max(
+            0, _col.run_plane_stages()
+            - int(getattr(svc, "_plane_stage_base", 0)))
+        out["run_plane_rows"] = max(
+            0, _col.run_plane_rows()
+            - int(getattr(svc, "_plane_rows_base", 0)))
+        out["run_plane_overflows"] = max(
+            0, _col.run_plane_overflows()
+            - int(getattr(svc, "_plane_ovf_base", 0)))
+        out["run_plane_expansions"] = max(
+            0, _col.run_plane_expansions()
+            - int(getattr(svc, "_plane_exp_base", 0)))
         return out if any(out.values()) else {}
 
     def _queued_total(self) -> int:

@@ -117,6 +117,9 @@ PLANNING_CONF_ENTRIES = (
     # run-length/delta wire encoding flips which operator fast paths the
     # executed plan takes (run-aware vs dense)
     C.SHUFFLE_WIRE_RUN_CODES,
+    # run planes flip the stage-boundary leaf form (compressed plane vs
+    # dense materialization) and with it the traced stage shapes
+    C.STAGE_RUN_PLANES,
 )
 
 PLANNING_CONF_KEYS = frozenset(e.key for e in PLANNING_CONF_ENTRIES)

@@ -768,8 +768,14 @@ class QueryExecution:
             self.metrics = {}
             return _slice_to_host(c, n_rows), ratio
         cache = SC.stage_cache(self.session)
+        # run-plane decision BEFORE the key: eligible lazy run columns
+        # cross the boundary as fixed-capacity planes, and the plane
+        # markers in leaf_signature re-key the stage (a run-count bucket
+        # overflow re-plans to a larger plane; an oversized run table
+        # falls back to the counted to_device materialization below)
+        stage_leaves = SC.plan_leaves(self.session, pq.leaves)
         skey, slots = SC.stage_fingerprint(pq.physical)
-        skey = (f"local|{skey}|{SC.leaf_signature(pq.leaves)}"
+        skey = (f"local|{skey}|{SC.leaf_signature(stage_leaves)}"
                 f"|{SC._conf_component(self.session)}")
 
         def make():
@@ -778,7 +784,7 @@ class QueryExecution:
             entry_slots = slots          # entry owns THIS plan's literals
             maybe_verify_stage_contract(
                 self.session, SC.Stage(
-                    physical, [b.schema for b in pq.leaves],
+                    physical, [b.schema for b in stage_leaves],
                     physical.schema(), skey))
             meta: Dict[Tuple, List] = {}
 
@@ -809,10 +815,10 @@ class QueryExecution:
                                    n_ops=SC.count_ops(pq.physical),
                                    session=self.session)
         meta = entry.aux
-        dev_leaves = tuple(b.to_device() for b in pq.leaves)
+        dev_leaves = tuple(b.to_device() for b in stage_leaves)
         result, n_rows, flags, metric_vals = cache.dispatch(
             entry, dev_leaves, SC.param_values(slots))
-        shape_key = tuple(b.capacity for b in pq.leaves)
+        shape_key = tuple(b.capacity for b in stage_leaves)
         flag_caps, flag_kinds, metric_keys = meta.get(shape_key,
                                                       ([], [], []))
         int_flags = [int(np.asarray(f)) for f in flags]

@@ -43,7 +43,8 @@ from .. import config as C
 
 __all__ = [
     "Stage", "StageCache", "stage_cache", "stage_fingerprint",
-    "leaf_signature", "count_ops", "metrics_source", "run_per_op",
+    "leaf_signature", "count_ops", "metrics_source", "plan_leaves",
+    "run_per_op",
 ]
 
 
@@ -59,10 +60,20 @@ def count_ops(physical) -> int:
 def leaf_signature(leaves) -> str:
     """Batch-shape/dtype signature of a stage's input leaves: the part
     of the key ``PhysicalPlan.key()`` cannot see (capacities and vector
-    dtypes decide the traced program's shapes)."""
+    dtypes decide the traced program's shapes).
+
+    A run-plane vector signs as ``dtype~r{plane_capacity}``: the plane
+    capacity is a ``pad_capacity`` bucket of the run count (the
+    ``PJoin.factor`` discipline), so a run-count overflow past the
+    bucket re-keys the stage and re-plans to a larger plane instead of
+    feeding a stale trace the wrong shapes."""
+    from ..columnar import unexpanded_plane
     parts = []
     for b in leaves:
-        dts = ",".join(str(v.dtype) for v in b.vectors)
+        dts = ",".join(
+            f"{v.dtype}~r{p.plane_capacity}"
+            if (p := unexpanded_plane(v)) is not None else str(v.dtype)
+            for v in b.vectors)
         parts.append(f"{b.capacity}[{dts}]")
     return "x".join(parts)
 
@@ -124,6 +135,64 @@ def param_values(slots) -> Tuple:
     positionally aligned with any fingerprint-equal plan's slots."""
     return tuple(np.asarray(l.value, dtype=l.dtype.np_dtype)
                  for l in slots)
+
+
+# ---------------------------------------------------------------------------
+# run planes at the stage boundary
+# ---------------------------------------------------------------------------
+
+def plan_leaves(session, leaves):
+    """Decide, per leaf vector, how a lazy run column crosses the jit
+    boundary: as a fixed-capacity run PLANE (compressed, two small pytree
+    leaves) or materialized dense (counted, exactly as before r20).
+
+    Eligibility is strict compression — the padded plane must be at most
+    half the dense capacity (``pad_capacity(n_runs) * 2 <= capacity``) —
+    because a plane that barely compresses pays searchsorted overhead in
+    every untaught operator for nothing.  Run vectors that fail the test
+    bump ``run_plane_overflows`` and fall through to the existing
+    ``to_device`` materialization (byte-identical, never wrong).  Called
+    BEFORE the stage key is computed: conversion changes
+    ``leaf_signature``, so a plane-shaped input can never hit a
+    dense-shaped trace or vice versa.  Returns the (possibly rebuilt)
+    leaf list; callers on mesh paths must not call this for sharded
+    leaves (planes do not slice along rows)."""
+    from ..columnar import (ColumnBatch, PlaneColumnVector,
+                            bump_plane_overflow, bump_plane_rows,
+                            bump_plane_stage, pad_capacity,
+                            unmaterialized_runs)
+    if session is None or not session.conf.get(C.STAGE_RUN_PLANES):
+        return list(leaves)
+    checks = None  # resolved lazily, only if a candidate shows up
+    out, any_planes = [], False
+    for b in leaves:
+        vecs = None
+        for i, v in enumerate(b.vectors):
+            rv = unmaterialized_runs(v)
+            if rv is None or rv.valid is not None \
+                    or rv.capacity != b.capacity:
+                continue
+            plane_cap = pad_capacity(len(rv.run_values))
+            if plane_cap * 2 > b.capacity:
+                bump_plane_overflow()
+                continue
+            if checks is None:
+                from ..analysis import runtime_checks_enabled
+                checks = runtime_checks_enabled(session)
+            if checks:
+                from ..analysis.runtime import verify_run_plane
+                verify_run_plane(rv, b.capacity)
+            if vecs is None:
+                vecs = list(b.vectors)
+            vecs[i] = PlaneColumnVector.from_runs(rv, plane_cap,
+                                                  device=False)
+            bump_plane_rows(b.capacity)
+            any_planes = True
+        out.append(b if vecs is None
+                   else ColumnBatch(b.names, vecs, b.row_valid, b.capacity))
+    if any_planes:
+        bump_plane_stage()
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -304,6 +373,7 @@ def metrics_source() -> Dict[str, Callable]:
         def read():
             return stage_cache().stats().get(key, default)
         return read
+    from .. import columnar as _col
     return {
         "stage_compile_ms": g("compile_ms", 0.0),
         "stage_cache_hits": g("hits"),
@@ -312,6 +382,12 @@ def metrics_source() -> Dict[str, Callable]:
         "stage_dispatches": g("dispatches"),
         "stages_fused": g("stages_fused"),
         "ops_per_stage": g("ops_per_stage", 0.0),
+        # run planes at the stage boundary (ISSUE 20): how often the
+        # jit lane ran compressed, and both fallback counters
+        "run_plane_stages": _col.run_plane_stages,
+        "run_plane_rows": _col.run_plane_rows,
+        "run_plane_overflows": _col.run_plane_overflows,
+        "run_plane_expansions": _col.run_plane_expansions,
     }
 
 

@@ -289,6 +289,12 @@ class _MappedStream(BatchStream):
         from ..parallel.mesh import mesh_shards
         mesh_tag = "local" if self.mesh is None else \
             f"mesh{mesh_shards(self.mesh)}"
+        # broadcast build sides (the extra leaves) take the run-plane
+        # boundary decision on the LOCAL path only: under a mesh every
+        # leaf is sharded or replicated by rows, and planes don't slice
+        # along rows (columnar.PlaneColumnVector contract)
+        if self.mesh is None:
+            leaves = [leaves[0]] + SC.plan_leaves(self.session, leaves[1:])
         skey = (f"stream|{mesh_tag}|{skey}|{SC.leaf_signature(leaves)}"
                 f"|{SC._conf_component(self.session)}")
         params = SC.param_values(slots)

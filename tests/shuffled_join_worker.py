@@ -504,6 +504,13 @@ if mode == "runcodes":
         ("rc-dict-rle",
          "SELECT s, count(*) AS c, sum(b2) AS sb FROM ev "
          "JOIN dm2 ON s = s2 GROUP BY s ORDER BY s"),
+        # the r20 plane query: filter+agg over the run-shaped key — the
+        # reduce-side join shards arrive run-encoded, and on the
+        # encoded+jit leg they must cross the stage boundary as device
+        # planes, WITHOUT a single host materialization
+        ("rc-plane-agg",
+         "SELECT ts, count(*) AS c, sum(v) AS sv FROM ev "
+         "JOIN dm ON ts = dk WHERE ts < 32 GROUP BY ts ORDER BY ts"),
     ]
 
     def set_runcodes(on):
@@ -514,10 +521,12 @@ if mode == "runcodes":
                     "true" if on else "false")
         svc.run_codes = bool(on)
 
-    # three legs per lane: encoded+jit (runs materialize at the jit
-    # boundary, counted), encoded+interpreted (the host lane keeps run
-    # vectors lazy all the way into the operators — the run-aware join
-    # probe and filter paths fire here), and raw+jit (the oracle wire)
+    # three legs per lane: encoded+jit (eligible run leaves cross the
+    # stage boundary as device planes, un-inflated; untaught leaves
+    # still materialize counted), encoded+interpreted (the host lane
+    # keeps run vectors lazy all the way into the operators — the
+    # run-aware join probe and filter paths fire here), and raw+jit
+    # (the oracle wire)
     LEGS = (("on", True, True), ("on-host", True, False),
             ("off", False, True))
     for name, sql in RC_QUERIES:
@@ -530,7 +539,17 @@ if mode == "runcodes":
                 xs.conf.set(C.CODEGEN_ENABLED.key,
                             "true" if jit else "false")
                 before = dict(svc.counters)
+                mat0 = _col.runs_materialized()
                 got = run(xs, sql)
+                if name == "rc-plane-agg" and on and jit:
+                    # the tentpole acceptance: the fully-eligible
+                    # filter+agg pipeline never expands a run on the
+                    # host — planes carry the compressed form through
+                    # the jitted stage on BOTH exchange lanes
+                    assert _col.runs_materialized() == mat0, (
+                        f"{name}/{m}/{leg}: runs_materialized moved "
+                        f"{_col.runs_materialized() - mat0} on the "
+                        "plane leg")
                 assert svc.counters[want] > before.get(want, 0), (
                     f"{name}/{m}: expected the {want} path, {svc.counters}")
                 if not on:

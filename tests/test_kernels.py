@@ -490,3 +490,178 @@ def test_range_bucket_code_space_nonmember_and_empty_cuts():
     spans = range_bucket(np, np.array([0, 1], np.int64),
                          np.zeros(0, np.int64))
     np.testing.assert_array_equal(spans, [0, 0])
+
+
+# ---------------------------------------------------------------------------
+# run planes on device (ISSUE 20): segment-scan kernels vs dense oracle
+# ---------------------------------------------------------------------------
+
+def _plane_batch(heads, lengths, extra=None, device=True, pad_to=None):
+    """A ColumnBatch whose 'ts' column is a run plane over the given run
+    table, plus an optional dense int column 'v'."""
+    from spark_tpu.columnar import PlaneColumnVector, RunColumnVector
+    from spark_tpu.columnar import ColumnVector, pad_capacity
+    heads = np.asarray(heads, np.int64)
+    lengths = np.asarray(lengths, np.int64)
+    cap = int(lengths.sum())
+    rv = RunColumnVector(heads, lengths, T.int64)
+    pv = PlaneColumnVector.from_runs(
+        rv, pad_to or pad_capacity(len(heads)), device=device)
+    names, vecs = ["ts"], [pv]
+    if extra is not None:
+        arr = np.asarray(extra, np.int64)
+        assert arr.shape[0] == cap
+        from spark_tpu.columnar import ColumnVector as CV
+        data = jnp.asarray(arr) if device else arr
+        names.append("v")
+        vecs.append(CV(data, T.int64))
+    return ColumnBatch(names, vecs, None, cap), np.repeat(heads, lengths)
+
+
+def test_run_expand_matches_repeat_oracle():
+    """The searchsorted-gather expansion decodes a zero-padded plane to
+    exactly np.repeat(values, lengths) — including single-run, padded
+    (zero-length) tails, and a full plane with no padding."""
+    from spark_tpu.kernels import run_expand
+    cases = [
+        ([3, 1, 4, 1, 5], [2, 3, 1, 4, 2], 8),       # padded tail
+        ([7], [12], 4),                              # single run
+        ([5, 6, 7, 8], [1, 1, 1, 1], 4),             # capacity edge: full
+        ([0, -3, 2], [5, 1, 10], 4),                 # negatives, long runs
+    ]
+    for heads, lens, plane_cap in cases:
+        heads = np.asarray(heads, np.int64)
+        lens = np.asarray(lens, np.int64)
+        cap = int(lens.sum())
+        pv = np.zeros(plane_cap, np.int64); pv[:len(heads)] = heads
+        pl = np.zeros(plane_cap, np.int64); pl[:len(lens)] = lens
+        oracle = np.repeat(heads, lens)
+        np.testing.assert_array_equal(run_expand(np, pv, pl, cap), oracle)
+        np.testing.assert_array_equal(
+            np.asarray(run_expand(jnp, jnp.asarray(pv), jnp.asarray(pl),
+                                  cap)), oracle)
+
+
+def test_plane_filter_matches_dense_oracle_unexpanded():
+    """A single-column predicate over a run plane filters by run HEAD —
+    same surviving rows as the dense path, and the plane's dense form is
+    never built (the data column crossed the stage compressed)."""
+    from spark_tpu.columnar import unexpanded_plane
+    b, dense = _plane_batch([4, 9, 2, 9, 7], [3, 1, 6, 2, 4])
+    out = apply_filter(jnp, b, (col("ts") % 2) == 1)
+    keep = np.asarray(out.row_valid_or_true())
+    np.testing.assert_array_equal(keep, (dense % 2) == 1)
+    assert unexpanded_plane(out.column("ts")) is not None, \
+        "plane filter must not expand the data column"
+    # and the filtered batch still aggregates exactly
+    agg = grouped_aggregate(jnp, out, [], [(CountStar(), "c")])
+    assert int(np.asarray(agg.column("c").data)[0]) == int(
+        ((dense % 2) == 1).sum())
+
+
+def test_plane_filter_empty_and_total_survivors():
+    b, dense = _plane_batch([1, 2, 3], [4, 4, 4])
+    none = apply_filter(jnp, b, col("ts") > 100)
+    assert int(np.asarray(none.num_rows())) == 0
+    all_ = apply_filter(jnp, b, col("ts") >= 0)
+    assert int(np.asarray(all_.num_rows())) == dense.shape[0]
+
+
+def test_plane_global_aggregate_matches_dense_oracle():
+    """Keyless count/sum/min/max over a run plane reduce over
+    run_values x run_lengths — value-exact against the dense oracle,
+    plane never expanded."""
+    from spark_tpu.columnar import unexpanded_plane
+    b, dense = _plane_batch([11, -2, 40, 7], [5, 2, 9, 3])
+    out = grouped_aggregate(jnp, b, [], [
+        (CountStar(), "c"), (Count(col("ts")), "ct"),
+        (Sum(col("ts")), "s"), (Min(col("ts")), "mn"),
+        (Max(col("ts")), "mx")])
+    assert unexpanded_plane(b.column("ts")) is not None
+    got = {n: int(np.asarray(out.column(n).data)[0])
+           for n in ("c", "ct", "s", "mn", "mx")}
+    assert got == {"c": dense.shape[0], "ct": dense.shape[0],
+                   "s": int(dense.sum()), "mn": int(dense.min()),
+                   "mx": int(dense.max())}
+
+
+def test_plane_global_aggregate_respects_row_mask():
+    """With a dense row mask (a prior filter), the plane aggregate
+    segments the LIVE mask per run — masked rows drop from count/sum and
+    min/max, exactly as the dense path drops them."""
+    b, dense = _plane_batch([11, -2, 40, 7], [5, 2, 9, 3])
+    fb = apply_filter(jnp, b, col("ts") != 40)
+    out = grouped_aggregate(jnp, fb, [], [
+        (CountStar(), "c"), (Sum(col("ts")), "s"),
+        (Min(col("ts")), "mn"), (Max(col("ts")), "mx")])
+    live = dense[dense != 40]
+    got = {n: int(np.asarray(out.column(n).data)[0])
+           for n in ("c", "s", "mn", "mx")}
+    assert got == {"c": live.shape[0], "s": int(live.sum()),
+                   "mn": int(live.min()), "mx": int(live.max())}
+
+
+def test_plane_global_aggregate_all_dead_is_null():
+    """Zero surviving rows: sum/min/max come back NULL (valid false),
+    count 0 — same null semantics as the dense keyless kernel."""
+    b, _ = _plane_batch([1, 2], [4, 4])
+    fb = apply_filter(jnp, b, col("ts") > 10)
+    out = grouped_aggregate(jnp, fb, [], [
+        (CountStar(), "c"), (Sum(col("ts")), "s"), (Min(col("ts")), "mn")])
+    assert int(np.asarray(out.column("c").data)[0]) == 0
+    for n in ("s", "mn"):
+        v = out.column(n)
+        assert v.valid is not None and not bool(np.asarray(v.valid)[0])
+
+
+def test_plane_project_bare_col_stays_unexpanded():
+    """SELECT of a bare plane column re-emits the plane itself; a
+    computed expression over it expands in-trace (counted per trace in
+    run_plane_expansions, never in runs_materialized)."""
+    from spark_tpu import columnar as _col
+    from spark_tpu.columnar import unexpanded_plane
+    b, dense = _plane_batch([4, 9, 2], [3, 5, 8])
+    p = apply_project(jnp, b, [col("ts")])
+    assert unexpanded_plane(p.column("ts")) is not None
+    before_host = _col.runs_materialized()
+    before_exp = _col.run_plane_expansions()
+    p2 = apply_project(jnp, b, [col("ts") * 2])
+    np.testing.assert_array_equal(np.asarray(p2.vectors[0].data),
+                                  dense * 2)
+    assert _col.run_plane_expansions() == before_exp + 1
+    assert _col.runs_materialized() == before_host, \
+        "in-trace plane expansion must not charge the host counter"
+
+
+def test_plane_capacity_edge_full_plane():
+    """A run table that exactly fills its pad bucket (no zero padding at
+    all) filters and aggregates exactly."""
+    from spark_tpu.columnar import pad_capacity
+    n = pad_capacity(6)
+    heads = np.arange(n, dtype=np.int64)
+    lens = np.full(n, 3, dtype=np.int64)
+    b, dense = _plane_batch(heads, lens, pad_to=n)
+    fb = apply_filter(jnp, b, col("ts") >= 2)
+    out = grouped_aggregate(jnp, fb, [], [(Sum(col("ts")), "s")])
+    assert int(np.asarray(out.column("s").data)[0]) == \
+        int(dense[dense >= 2].sum())
+
+
+def test_plane_kernels_jit_match_eager():
+    """The segmented filter+aggregate composes under jax.jit with the
+    plane riding the pytree: jitted result equals eager equals dense
+    oracle."""
+    b, dense = _plane_batch([5, 1, 8, 1], [7, 2, 4, 3])
+
+    def prog(batch):
+        fb = apply_filter(jnp, batch, col("ts") > 1)
+        return grouped_aggregate(jnp, fb, [], [
+            (CountStar(), "c"), (Sum(col("ts")), "s")])
+
+    eager = prog(b)
+    jitted = jax.jit(prog)(b)
+    want_c = int((dense > 1).sum())
+    want_s = int(dense[dense > 1].sum())
+    for out in (eager, jitted):
+        assert int(np.asarray(out.column("c").data)[0]) == want_c
+        assert int(np.asarray(out.column("s").data)[0]) == want_s

@@ -819,3 +819,77 @@ def test_pool_gauges_and_status_activity(spark, tmp_path):
         spark.conf_obj.unset(C.SERVER_POOL_POLL.key)
         ms._sources = [s for s in ms._sources
                        if s.name not in ("serving", "pool")]
+
+
+def test_run_plane_gauges_exported(spark):
+    """ISSUE 20 observability: run-plane activity rides the compile
+    Source — stages entered compressed, dense rows the planes stood in
+    for, overflow fallbacks, and in-trace expansions all live gauges
+    that move when an eligible run leaf crosses the stage boundary."""
+    import spark_tpu.types as T
+    from spark_tpu.columnar import ColumnBatch, ColumnVector, RunColumnVector
+    from spark_tpu.sql import logical as L
+    from spark_tpu.sql.dataframe import DataFrame
+    ms = spark.metricsSystem
+    before = ms.report()["compile"]
+    for key in ("run_plane_stages", "run_plane_rows",
+                "run_plane_overflows", "run_plane_expansions"):
+        assert key in before, key
+    s = spark.newSession()
+    s.conf.set("spark.tpu.mesh.shards", "1")
+    heads = np.arange(16, dtype=np.int64)
+    rv = RunColumnVector(heads, np.full(16, 32, np.int64), T.int64)
+    vv = ColumnVector(np.arange(512, dtype=np.int64), T.int64)
+    b = ColumnBatch(["ts", "v"], [rv, vv], None, 512)
+    DataFrame(s, L.LocalRelation(b)).createOrReplaceTempView("obs_rp")
+    got = s.sql("SELECT count(*) AS c, sum(ts) AS st FROM obs_rp "
+                "WHERE ts < 9").collect()
+    dense = np.repeat(heads, 32)
+    assert got[0]["c"] == int((dense < 9).sum())
+    assert got[0]["st"] == int(dense[dense < 9].sum())
+    after = ms.report()["compile"]
+    assert after["run_plane_stages"] > before["run_plane_stages"]
+    assert after["run_plane_rows"] >= before["run_plane_rows"] + 512
+    assert after["run_plane_overflows"] >= before["run_plane_overflows"]
+    # the eligible filter+agg stage never expanded its plane
+    assert after["run_plane_expansions"] == before["run_plane_expansions"]
+
+
+def test_run_plane_activity_in_status(spark, tmp_path):
+    """/status runActivity carries the plane gauges next to the run-code
+    gauges, diffed against the shuffle service's birth snapshot."""
+    import urllib.request
+
+    from spark_tpu import columnar as _col
+    from spark_tpu.server import SQLServer
+    prev = getattr(spark, "_crossproc_svc", None)
+    ms = spark.metricsSystem
+    srv = None
+    try:
+        svc = spark.enableHostShuffle(str(tmp_path), process_id=0,
+                                      n_processes=1, timeout_s=5.0)
+        srv = SQLServer(spark, port=0).start()
+
+        def status():
+            with urllib.request.urlopen(
+                    f"http://{srv.host}:{srv.port}/status",
+                    timeout=30) as r:
+                return json.loads(r.read())
+
+        _col.bump_plane_stage()
+        _col.bump_plane_rows(4096)
+        _col.bump_plane_overflow()
+        st = status()
+        got = st["runActivity"]["default"]
+        assert got["run_plane_stages"] >= 1
+        assert got["run_plane_rows"] >= 4096
+        assert got["run_plane_overflows"] >= 1
+        # and the shuffle Source mirrors the same diffed gauges
+        snap = ms.snapshots()["shuffle"]
+        assert snap["run_plane_stages"] >= 1
+        assert snap["run_plane_rows"] >= 4096
+    finally:
+        if srv is not None:
+            srv.stop()
+        spark._crossproc_svc = prev
+        ms._sources = [s for s in ms._sources if s.name != "shuffle"]

@@ -153,6 +153,33 @@ def test_run_codes_conf_invalidates_plan_cache(serve_root):
     s.sql("DROP TABLE pcrun_t")
 
 
+def test_run_planes_conf_invalidates_plan_cache(serve_root):
+    """``spark.tpu.stage.runPlanes`` is a planning conf: it decides the
+    stage-boundary leaf form (compressed plane vs dense materialization)
+    and with it the traced stage shapes, so SET must evict entries built
+    under the old value — and the re-planned run must stay oracle-equal."""
+    cache = PlanCache(serve_root.conf_obj)
+    s = serve_root.newSession()
+    s._plan_cache = cache
+    s.sql("CREATE TABLE pcplane_t AS "
+          "SELECT id % 8 AS k, id AS v FROM range(128)")
+    q = ("SELECT count(*) AS c, sum(v) AS sv FROM pcplane_t "
+         "WHERE k < 5")
+    a1 = [tuple(r) for r in s.sql(q).collect()]
+    assert [tuple(r) for r in s.sql(q).collect()] == a1
+    assert cache.stats()["hits"] >= 1
+    before = cache.stats()["invalidations"]
+    s.sql("SET spark.tpu.stage.runPlanes=false")
+    assert cache.stats()["invalidations"] > before, \
+        "runPlanes must be fingerprinted as a planning conf"
+    a2 = [tuple(r) for r in s.sql(q).collect()]
+    oracle = [tuple(r)
+              for r in serve_root.newSession().sql(q).collect()]
+    assert a2 == oracle == a1
+    s.sql("SET spark.tpu.stage.runPlanes=true")
+    s.sql("DROP TABLE pcplane_t")
+
+
 def test_dataframe_write_invalidates_plan_cache(serve_root, tmp_path):
     """Regression: DataFrame-API writes (``df.write...save``) mutate the
     same paths the SQL commands do, but only the SQL commands called the

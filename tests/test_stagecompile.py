@@ -211,3 +211,155 @@ def test_stage_cache_stats_shape(sess):
         assert key in st
     assert st["dispatches"] >= 1 and st["entries"] >= 1
     assert st["ops_per_stage"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# run planes on device (ISSUE 20): the compressed stage-input form
+# ---------------------------------------------------------------------------
+
+def _run_leaf(n_runs=16, rep=32, heads=None):
+    """A one-batch leaf whose 'ts' column is an unmaterialized run table
+    over n_runs*rep rows, plus a dense 'v' column."""
+    from spark_tpu.columnar import ColumnBatch, ColumnVector, RunColumnVector
+    heads = np.arange(n_runs, dtype=np.int64) if heads is None \
+        else np.asarray(heads, np.int64)
+    lens = np.full(len(heads), rep, dtype=np.int64)
+    cap = int(lens.sum())
+    rv = RunColumnVector(heads, lens, T.int64)
+    vv = ColumnVector(np.arange(cap, dtype=np.int64) % 7, T.int64)
+    return ColumnBatch(["ts", "v"], [rv, vv], None, cap)
+
+
+def test_plan_leaves_builds_planes_and_signature(sess):
+    """An eligible run leaf crosses the boundary as a plane, and the
+    leaf signature gains the plane-capacity component that re-keys the
+    stage away from the dense form."""
+    from spark_tpu.columnar import PlaneColumnVector, RunColumnVector
+    b = _run_leaf()
+    out = SC.plan_leaves(sess, [b])[0]
+    assert isinstance(out.column("ts"), PlaneColumnVector)
+    assert not isinstance(out.column("v"), PlaneColumnVector)
+    sig = SC.leaf_signature([out])
+    assert "~r" in sig and SC.leaf_signature([b]) != sig
+
+
+def test_plane_signature_stable_within_bucket_replans_past_it(sess):
+    """Two leaves whose run counts pad to the SAME plane bucket share a
+    signature (one trace serves both); growing the run count past the
+    bucket re-keys — a bigger plane is a new stage program, never a
+    silent shape mismatch."""
+    from spark_tpu.columnar import pad_capacity
+    small, bigger = 9, 13          # both pad to pad_capacity(9)?
+    if pad_capacity(small) != pad_capacity(bigger):
+        bigger = small             # degenerate pad fn: same-count case
+    s1 = SC.leaf_signature(SC.plan_leaves(sess, [_run_leaf(small, 64)]))
+    s2 = SC.leaf_signature(SC.plan_leaves(sess, [_run_leaf(
+        bigger, (small * 64) // bigger if bigger != small else 64,
+        heads=np.arange(bigger))]))
+    # same dense capacity needed for a fair same-bucket comparison
+    grown = 4 * pad_capacity(small)
+    s3 = SC.leaf_signature(SC.plan_leaves(sess, [_run_leaf(grown, 64)]))
+    assert ("~r%d" % pad_capacity(small)) in s1
+    assert s3 != s1 and ("~r%d" % pad_capacity(grown)) in s3
+
+
+def test_plan_leaves_overflow_falls_back_counted(sess):
+    """A run table too large for a winning plane (pad bucket over half
+    the dense capacity) stays a lazy run vector — the stage input
+    materializes counted, exactly the pre-plane behavior — and the
+    overflow gauge records the decision."""
+    from spark_tpu import columnar as _col
+    from spark_tpu.columnar import PlaneColumnVector, RunColumnVector
+    n = 300
+    lens = np.ones(n, dtype=np.int64); lens[:212] += 1
+    rv = RunColumnVector(np.arange(n, dtype=np.int64), lens, T.int64)
+    from spark_tpu.columnar import ColumnBatch
+    b = ColumnBatch(["x"], [rv], None, int(lens.sum()))
+    before = _col.run_plane_overflows()
+    out = SC.plan_leaves(sess, [b])[0]
+    assert isinstance(out.column("x"), RunColumnVector)
+    assert not isinstance(out.column("x"), PlaneColumnVector)
+    assert _col.run_plane_overflows() == before + 1
+    # the fallback leaf materializes counted, byte-identical
+    mat_before = _col.runs_materialized()
+    np.testing.assert_array_equal(
+        np.asarray(out.column("x").data),
+        np.repeat(np.arange(n, dtype=np.int64), lens))
+    assert _col.runs_materialized() > mat_before
+
+
+def test_run_planes_conf_off_keeps_dense_boundary(sess):
+    from spark_tpu.columnar import PlaneColumnVector
+    sess.conf.set(C.STAGE_RUN_PLANES.key, "false")
+    try:
+        out = SC.plan_leaves(sess, [_run_leaf()])[0]
+        assert not isinstance(out.column("ts"), PlaneColumnVector)
+    finally:
+        sess.conf.set(C.STAGE_RUN_PLANES.key, "true")
+
+
+def test_plane_pytree_roundtrip():
+    """flatten → unflatten preserves the plane form: two small leaves on
+    the wire, the rebuilt vector still an unexpanded plane with the
+    dense capacity and run count intact."""
+    import jax
+    from spark_tpu.columnar import (PlaneColumnVector, RunColumnVector,
+                                    pad_capacity, unexpanded_plane)
+    from spark_tpu.columnar import ColumnBatch, ColumnVector
+    heads = np.array([5, 3, 9], np.int64)
+    lens = np.array([100, 20, 8], np.int64)
+    rv = RunColumnVector(heads, lens, T.int64)
+    pv = PlaneColumnVector.from_runs(rv, pad_capacity(3))
+    dense = ColumnVector(np.arange(128, dtype=np.int64), T.int64)
+    b = ColumnBatch(["ts", "v"], [pv, dense], None, 128)
+    leaves, tree = jax.tree_util.tree_flatten(b)
+    assert len(leaves) == 3          # plane_values, plane_lengths, dense
+    rb = jax.tree_util.tree_unflatten(tree, leaves)
+    rp = unexpanded_plane(rb.column("ts"))
+    assert rp is not None
+    assert rp.capacity == 128 and rp.plane_capacity == pad_capacity(3)
+    np.testing.assert_array_equal(np.asarray(rp.data),
+                                  np.repeat(heads, lens))
+
+
+def test_plane_stage_runs_filter_agg_without_expansion(sess):
+    """The tentpole end to end: an eligible filter+aggregate over a run
+    leaf executes through the jitted stage lane with the column NEVER
+    expanded — zero in-trace expansions, zero host materializations —
+    and the answer is oracle-exact."""
+    from spark_tpu import columnar as _col
+    from spark_tpu.sql import logical as L
+    from spark_tpu.sql.dataframe import DataFrame
+    b = _run_leaf(32, 16)
+    dense = np.repeat(np.arange(32, dtype=np.int64), 16)
+    DataFrame(sess, L.LocalRelation(b)).createOrReplaceTempView("rp_ev")
+    mat0 = _col.runs_materialized()
+    exp0 = _col.run_plane_expansions()
+    st0 = _col.run_plane_stages()
+    got = sess.sql("SELECT count(*) AS c, sum(ts) AS st FROM rp_ev "
+                   "WHERE ts < 20").collect()
+    assert got[0]["c"] == int((dense < 20).sum())
+    assert got[0]["st"] == int(dense[dense < 20].sum())
+    assert _col.run_plane_stages() > st0
+    assert _col.run_plane_expansions() == exp0, \
+        "eligible filter+agg must never expand the plane"
+    assert _col.runs_materialized() == mat0, \
+        "the device lane must never charge the host materialization counter"
+
+
+def test_plane_stage_fallback_matches_plane_result(sess):
+    """Planes off vs on over the same run leaf: byte-identical answers
+    (the ISSUE's never-wrong contract for the dense fallback)."""
+    from spark_tpu.sql import logical as L
+    from spark_tpu.sql.dataframe import DataFrame
+    b = _run_leaf(16, 32, heads=np.arange(16)[::-1].copy())
+    DataFrame(sess, L.LocalRelation(b)).createOrReplaceTempView("rp_fb")
+    q = ("SELECT count(*) AS c, sum(ts) AS st, min(ts) AS mn, "
+         "max(ts) AS mx FROM rp_fb WHERE ts % 3 != 1")
+    on = [tuple(r) for r in sess.sql(q).collect()]
+    sess.conf.set(C.STAGE_RUN_PLANES.key, "false")
+    try:
+        off = [tuple(r) for r in sess.sql(q).collect()]
+    finally:
+        sess.conf.set(C.STAGE_RUN_PLANES.key, "true")
+    assert on == off
